@@ -1,0 +1,25 @@
+"""kubernetes_tpu — a TPU-native scheduling framework with the capabilities of
+the Kubernetes kube-scheduler (reference: pohly/kubernetes @ v1.25-dev).
+
+Architecture (see SURVEY.md §7):
+  - ``api/``        lightweight typed API objects (Pod, Node, ...) mirroring the
+                    scheduling-relevant surface of staging/src/k8s.io/api.
+  - ``framework/``  the scheduling-framework contract: 13 extension points,
+                    Status codes, CycleState, plugin registry/runtime — the
+                    analog of pkg/scheduler/framework.
+  - ``cache/``      assume/confirm/expire scheduler cache with generation-based
+                    incremental snapshots (pkg/scheduler/internal/cache).
+  - ``queue/``      activeQ/backoffQ/unschedulable priority queue with
+                    cluster-event gating (pkg/scheduler/internal/queue).
+  - ``ops/``        the TPU compute path: dense tensor schemas, the host-side
+                    selector/taint/port compiler, and batched JAX filter/score
+                    kernels (vmap over the node axis).
+  - ``backend/``    device-resident cluster state with generation-keyed delta
+                    uploads, and the batched scheduling step (lax.scan
+                    sequential-commit over a pod micro-batch).
+  - ``parallel/``   node-axis sharding over a jax.sharding.Mesh.
+  - ``scheduler/``  the Scheduler object and scheduleOne / batched loops.
+  - ``perf/``       scheduler_perf-equivalent YAML workload harness.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,409 @@
+"""Scheduling-relevant API object model.
+
+A deliberately small, typed mirror of the parts of k8s.io/api/core/v1 (plus
+scheduling/v1 priority and policy/v1 PDB) that the scheduler consumes:
+Pod spec (resources, affinity, tolerations, topology-spread, priority, ports),
+Node (allocatable, taints, labels, images), and label/node selectors.
+
+These are plain dataclasses — the "wire format" of this framework is Python
+objects (and, on the hot path, the dense tensors produced by ops/encode.py).
+Reference anchors are cited per type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from . import resource as resource_api
+
+# ---------------------------------------------------------------------------
+# meta
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    resource_version: int = 0
+
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# ---------------------------------------------------------------------------
+# selectors (apimachinery pkg/labels + core/v1 node selectors)
+
+# LabelSelector / NodeSelectorRequirement operators
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+
+@dataclass
+class Requirement:
+    """One match expression. Semantics of labels.Requirement.Matches
+    (apimachinery pkg/labels/selector.go): an absent key matches NotIn and
+    DoesNotExist; Gt/Lt parse the label value as an integer."""
+
+    key: str
+    operator: str
+    values: Tuple[str, ...] = ()
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        has = self.key in labels
+        if self.operator == IN:
+            return has and labels[self.key] in self.values
+        if self.operator == NOT_IN:
+            return not has or labels[self.key] not in self.values
+        if self.operator == EXISTS:
+            return has
+        if self.operator == DOES_NOT_EXIST:
+            return not has
+        if self.operator in (GT, LT):
+            if not has:
+                return False
+            try:
+                lhs = int(labels[self.key])
+                rhs = int(self.values[0])
+            except (ValueError, IndexError):
+                return False
+            return lhs > rhs if self.operator == GT else lhs < rhs
+        raise ValueError(f"unknown operator {self.operator!r}")
+
+
+@dataclass
+class LabelSelector:
+    """metav1.LabelSelector: matchLabels AND matchExpressions (all must hold).
+    An empty selector matches everything; a None selector matches nothing
+    (v1helper.LabelSelectorAsSelector convention) — plugins model that with the
+    shared MATCH_NOTHING sentinel below (labels.Nothing() analog)."""
+
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: Tuple[Requirement, ...] = ()
+    match_nothing: bool = False  # labels.Nothing(): unforgeable never-match
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        if self.match_nothing:
+            return False
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        return all(r.matches(labels) for r in self.match_expressions)
+
+    def signature(self) -> Tuple:
+        """Hashable identity used by the incremental selector-count index
+        (backend/sigindex.py)."""
+        return (
+            tuple(sorted(self.match_labels.items())),
+            tuple((r.key, r.operator, tuple(r.values)) for r in self.match_expressions),
+            self.match_nothing,
+        )
+
+
+MATCH_NOTHING = LabelSelector(match_nothing=True)
+
+
+@dataclass
+class NodeSelectorTerm:
+    """core/v1.NodeSelectorTerm: AND of matchExpressions (+ matchFields, of
+    which only metadata.name is legal — modeled via ``match_fields_name``)."""
+
+    match_expressions: Tuple[Requirement, ...] = ()
+    match_fields_name: Optional[str] = None  # compiled 'metadata.name' In [x]
+
+    def matches(self, node: "Node") -> bool:
+        if self.match_fields_name is not None and node.meta.name != self.match_fields_name:
+            return False
+        if not self.match_expressions and self.match_fields_name is None:
+            return False  # empty term matches nothing (nodeaffinity.go semantics)
+        return all(r.matches(node.meta.labels) for r in self.match_expressions)
+
+
+@dataclass
+class NodeSelector:
+    """core/v1.NodeSelector: OR of terms."""
+
+    terms: Tuple[NodeSelectorTerm, ...] = ()
+
+    def matches(self, node: "Node") -> bool:
+        return any(t.matches(node) for t in self.terms)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int = 1
+    preference: NodeSelectorTerm = field(default_factory=NodeSelectorTerm)
+
+
+@dataclass
+class NodeAffinity:
+    required: Optional[NodeSelector] = None
+    preferred: Tuple[PreferredSchedulingTerm, ...] = ()
+
+
+@dataclass
+class PodAffinityTerm:
+    """core/v1.PodAffinityTerm. ``namespaces`` empty + selector None ⇒ the
+    incoming pod's own namespace (defaulting done at AffinityTerm build time,
+    framework/types.go:193 newAffinityTerm)."""
+
+    label_selector: Optional[LabelSelector] = None
+    topology_key: str = ""
+    namespaces: Tuple[str, ...] = ()
+    namespace_selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int = 1
+    term: PodAffinityTerm = field(default_factory=PodAffinityTerm)
+
+
+@dataclass
+class PodAffinity:
+    required: Tuple[PodAffinityTerm, ...] = ()
+    preferred: Tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass
+class PodAntiAffinity:
+    required: Tuple[PodAffinityTerm, ...] = ()
+    preferred: Tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+# ---------------------------------------------------------------------------
+# taints / tolerations
+
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_NO_EXECUTE = "NoExecute"
+
+TOLERATION_OP_EQUAL = "Equal"
+TOLERATION_OP_EXISTS = "Exists"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = TAINT_NO_SCHEDULE
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """core/v1.Toleration.ToleratesTaint semantics
+    (component-helpers scheduling/corev1 helpers): empty effect matches all
+    effects; empty key with Exists matches all taints."""
+
+    key: str = ""
+    operator: str = TOLERATION_OP_EQUAL
+    value: str = ""
+    effect: str = ""
+
+    def tolerates(self, taint: Taint) -> bool:
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator in ("", TOLERATION_OP_EQUAL):
+            return self.value == taint.value
+        if self.operator == TOLERATION_OP_EXISTS:
+            return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# topology spread
+
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int = 1
+    topology_key: str = ""
+    when_unsatisfiable: str = DO_NOT_SCHEDULE
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+
+
+# ---------------------------------------------------------------------------
+# pod
+
+PROTO_TCP = "TCP"
+PROTO_UDP = "UDP"
+PROTO_SCTP = "SCTP"
+
+
+@dataclass(frozen=True)
+class ContainerPort:
+    host_port: int = 0
+    container_port: int = 0
+    protocol: str = PROTO_TCP
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    requests: Dict[str, object] = field(default_factory=dict)  # resource -> quantity
+    limits: Dict[str, object] = field(default_factory=dict)
+    ports: Tuple[ContainerPort, ...] = ()
+
+
+@dataclass
+class PodSpec:
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: Tuple[Toleration, ...] = ()
+    topology_spread_constraints: Tuple[TopologySpreadConstraint, ...] = ()
+    priority: int = 0
+    priority_class_name: str = ""
+    scheduler_name: str = "default-scheduler"
+    overhead: Dict[str, object] = field(default_factory=dict)
+    volumes: Tuple[str, ...] = ()  # PVC names (volume subsystem modeled by claim name)
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+    nominated_node_name: str = ""
+    start_time: float = 0.0
+
+
+@dataclass
+class Pod:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    def key(self) -> str:
+        return self.meta.key()
+
+    def resource_request(self) -> Dict[str, int]:
+        """computePodResourceRequest (noderesources/fit.go:159): canonical-int
+        per-resource request = max(sum(containers), max(initContainers)) + overhead."""
+        total: Dict[str, int] = {}
+        for c in self.spec.containers:
+            for r, q in c.requests.items():
+                total[r] = total.get(r, 0) + resource_api.canonical(r, q)
+        for c in self.spec.init_containers:
+            for r, q in c.requests.items():
+                v = resource_api.canonical(r, q)
+                if v > total.get(r, 0):
+                    total[r] = v
+        for r, q in self.spec.overhead.items():
+            total[r] = total.get(r, 0) + resource_api.canonical(r, q)
+        return total
+
+    def host_ports(self) -> Tuple[ContainerPort, ...]:
+        return tuple(
+            p for c in self.spec.containers for p in c.ports if p.host_port > 0
+        )
+
+    def clone(self) -> "Pod":
+        return dataclasses.replace(
+            self,
+            meta=dataclasses.replace(self.meta, labels=dict(self.meta.labels)),
+            status=dataclasses.replace(self.status),
+        )
+
+
+# ---------------------------------------------------------------------------
+# node
+
+
+@dataclass(frozen=True)
+class ContainerImage:
+    names: Tuple[str, ...] = ()
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: Tuple[Taint, ...] = ()
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, object] = field(default_factory=dict)
+    allocatable: Dict[str, object] = field(default_factory=dict)
+    images: Tuple[ContainerImage, ...] = ()
+    ready: bool = True
+
+
+@dataclass
+class Node:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    def name(self) -> str:
+        return self.meta.name
+
+    def allocatable_canonical(self) -> Dict[str, int]:
+        return {
+            r: resource_api.canonical(r, q) for r, q in self.status.allocatable.items()
+        }
+
+
+# ---------------------------------------------------------------------------
+# misc cluster objects the scheduler reads
+
+
+@dataclass
+class Namespace:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+
+
+@dataclass
+class PriorityClass:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+
+
+@dataclass
+class PodDisruptionBudget:
+    """policy/v1 PDB, consumed by preemption (preemption.go:397 criteria)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    disruptions_allowed: int = 0
+
+
+@dataclass
+class PersistentVolumeClaim:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class: str = ""
+    bound_pv: str = ""
+    access_modes: Tuple[str, ...] = ()
+
+
+@dataclass
+class Binding:
+    """pods/{name}/binding subresource payload
+    (pkg/registry/core/pod/storage/storage.go:146 BindingREST)."""
+
+    pod_key: str = ""
+    node_name: str = ""

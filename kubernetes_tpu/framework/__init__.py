@@ -1,0 +1,18 @@
+from . import interface, types  # noqa: F401
+from .interface import (  # noqa: F401
+    CycleState,
+    Handle,
+    Status,
+    OK,
+    SUCCESS,
+    ERROR,
+    UNSCHEDULABLE,
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+    WAIT,
+    SKIP,
+    MAX_NODE_SCORE,
+    MIN_NODE_SCORE,
+    NodeScore,
+    PreFilterResult,
+)
+from .types import NodeInfo, Resource, QueuedPodInfo, ClusterEvent, Diagnosis, FitError  # noqa: F401

@@ -1,0 +1,272 @@
+"""The scheduling-framework plugin contract.
+
+Analog of pkg/scheduler/framework/interface.go: Status codes (:139), the
+Plugin base (:305), the per-extension-point interfaces (:315-:492), Framework
+(:505) and Handle (:581), PreFilterResult (:627).  This is the stable ABI both
+the scalar (oracle) plugins and the TPU batched backend implement.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from ..api.types import Node, Pod
+from .types import ClusterEvent, NodeInfo
+
+MAX_NODE_SCORE = 100
+MIN_NODE_SCORE = 0
+MAX_TOTAL_SCORE = (1 << 63) - 1
+
+# ---------------------------------------------------------------------------
+# Status (interface.go:139)
+
+SUCCESS = 0
+ERROR = 1
+UNSCHEDULABLE = 2
+UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+WAIT = 4
+SKIP = 5
+
+_CODE_NAMES = {
+    SUCCESS: "Success",
+    ERROR: "Error",
+    UNSCHEDULABLE: "Unschedulable",
+    UNSCHEDULABLE_AND_UNRESOLVABLE: "UnschedulableAndUnresolvable",
+    WAIT: "Wait",
+    SKIP: "Skip",
+}
+
+
+class Status:
+    __slots__ = ("code", "reasons", "plugin")
+
+    def __init__(self, code: int = SUCCESS, reasons: Tuple[str, ...] = (), plugin: str = ""):
+        self.code = code
+        self.reasons = reasons
+        self.plugin = plugin
+
+    @classmethod
+    def unschedulable(cls, *reasons: str) -> "Status":
+        return cls(UNSCHEDULABLE, reasons)
+
+    @classmethod
+    def unresolvable(cls, *reasons: str) -> "Status":
+        return cls(UNSCHEDULABLE_AND_UNRESOLVABLE, reasons)
+
+    @classmethod
+    def error(cls, *reasons: str) -> "Status":
+        return cls(ERROR, reasons)
+
+    def is_success(self) -> bool:
+        return self.code == SUCCESS
+
+    def is_unschedulable(self) -> bool:
+        return self.code in (UNSCHEDULABLE, UNSCHEDULABLE_AND_UNRESOLVABLE)
+
+    def code_name(self) -> str:
+        return _CODE_NAMES[self.code]
+
+    def with_plugin(self, name: str) -> "Status":
+        self.plugin = name
+        return self
+
+    def __repr__(self):
+        return f"Status({self.code_name()}, {list(self.reasons)}, plugin={self.plugin!r})"
+
+
+OK = Status()
+
+
+# ---------------------------------------------------------------------------
+# CycleState (framework/cycle_state.go)
+
+
+class CycleState:
+    """Per-scheduling-cycle scratch: plugin PreFilter/PreScore state keyed by
+    plugin-chosen string keys; Clone() for preemption dry-runs."""
+
+    def __init__(self):
+        self._data: Dict[str, object] = {}
+        self.skip_filter_plugins: Set[str] = set()
+        self.skip_score_plugins: Set[str] = set()
+        self.record_plugin_metrics = False
+
+    def read(self, key: str):
+        if key not in self._data:
+            raise KeyError(key)
+        return self._data[key]
+
+    def write(self, key: str, value) -> None:
+        self._data[key] = value
+
+    def delete(self, key: str) -> None:
+        self._data.pop(key, None)
+
+    def clone(self) -> "CycleState":
+        cs = CycleState()
+        for k, v in self._data.items():
+            cs._data[k] = v.clone() if hasattr(v, "clone") else v
+        cs.skip_filter_plugins = set(self.skip_filter_plugins)
+        cs.skip_score_plugins = set(self.skip_score_plugins)
+        return cs
+
+
+@dataclass
+class PreFilterResult:
+    """interface.go:627: a PreFilter plugin may pre-restrict the node set."""
+
+    node_names: Optional[Set[str]] = None  # None = all nodes
+
+    def all_nodes(self) -> bool:
+        return self.node_names is None
+
+    def merge(self, other: "PreFilterResult") -> "PreFilterResult":
+        if self.all_nodes():
+            return other
+        if other.all_nodes():
+            return self
+        return PreFilterResult(self.node_names & other.node_names)
+
+
+@dataclass
+class NodeScore:
+    name: str
+    score: int
+
+
+# ---------------------------------------------------------------------------
+# plugin interfaces (interface.go:305-:492)
+
+
+class Plugin(abc.ABC):
+    @abc.abstractmethod
+    def name(self) -> str: ...
+
+
+class QueueSortPlugin(Plugin):
+    @abc.abstractmethod
+    def less(self, a, b) -> bool:
+        """a, b: QueuedPodInfo."""
+
+
+class EnqueueExtensions(Plugin):
+    def events_to_register(self) -> List[ClusterEvent]:
+        return []
+
+
+class PreFilterPlugin(Plugin):
+    @abc.abstractmethod
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]: ...
+
+    def pre_filter_extensions(self) -> Optional["PreFilterExtensions"]:
+        return None
+
+
+class PreFilterExtensions(abc.ABC):
+    """Incremental CycleState updates for preemption dry-runs (AddPod/RemovePod)."""
+
+    @abc.abstractmethod
+    def add_pod(self, state: CycleState, pod: Pod, to_add: Pod, node_info: NodeInfo) -> Status: ...
+
+    @abc.abstractmethod
+    def remove_pod(self, state: CycleState, pod: Pod, to_remove: Pod, node_info: NodeInfo) -> Status: ...
+
+
+class FilterPlugin(Plugin):
+    @abc.abstractmethod
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status: ...
+
+
+class PostFilterPlugin(Plugin):
+    @abc.abstractmethod
+    def post_filter(self, state: CycleState, pod: Pod, filtered_node_status_map) -> Tuple[Optional[str], Status]:
+        """Returns (nominated_node_name, status)."""
+
+
+class PreScorePlugin(Plugin):
+    @abc.abstractmethod
+    def pre_score(self, state: CycleState, pod: Pod, nodes: List[Node]) -> Status: ...
+
+
+class ScoreExtensions(abc.ABC):
+    @abc.abstractmethod
+    def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> Status: ...
+
+
+class ScorePlugin(Plugin):
+    @abc.abstractmethod
+    def score(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[int, Status]: ...
+
+    def score_extensions(self) -> Optional[ScoreExtensions]:
+        return None
+
+
+class ReservePlugin(Plugin):
+    @abc.abstractmethod
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status: ...
+
+    @abc.abstractmethod
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None: ...
+
+
+class PermitPlugin(Plugin):
+    @abc.abstractmethod
+    def permit(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[Status, float]:
+        """Returns (status, timeout_seconds); status WAIT parks the pod."""
+
+
+class PreBindPlugin(Plugin):
+    @abc.abstractmethod
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status: ...
+
+
+class BindPlugin(Plugin):
+    @abc.abstractmethod
+    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status: ...
+
+
+class PostBindPlugin(Plugin):
+    @abc.abstractmethod
+    def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None: ...
+
+
+EXTENSION_POINTS = (
+    "queue_sort", "pre_filter", "filter", "post_filter", "pre_score", "score",
+    "reserve", "permit", "pre_bind", "bind", "post_bind",
+)
+
+
+# ---------------------------------------------------------------------------
+# Handle: runtime services exposed to plugins (interface.go:581)
+
+
+class Handle(abc.ABC):
+    @abc.abstractmethod
+    def snapshot_node_infos(self) -> List[NodeInfo]: ...
+
+    @abc.abstractmethod
+    def get_node_info(self, name: str) -> Optional[NodeInfo]: ...
+
+    @abc.abstractmethod
+    def client(self): ...
+
+    @abc.abstractmethod
+    def parallelizer(self): ...
+
+
+def default_normalize_score(max_priority: int, reverse: bool, scores: List[NodeScore]) -> Status:
+    """helper.DefaultNormalizeScore (plugins/helper/normalize_score.go:30):
+    scale raw scores to [0, max_priority]; reverse flips (lower raw = better).
+    All-zero max ⇒ everyone gets max_priority when reversed, else 0."""
+    max_score = max((s.score for s in scores), default=0)
+    if max_score == 0:
+        if reverse:
+            for s in scores:
+                s.score = max_priority
+        return OK
+    for s in scores:
+        v = max_priority * s.score // max_score
+        s.score = max_priority - v if reverse else v
+    return OK

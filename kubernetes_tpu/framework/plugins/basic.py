@@ -1,0 +1,175 @@
+"""Simple per-node plugins: PrioritySort, NodeUnschedulable, NodeName,
+TaintToleration, NodePorts.
+
+Oracle (scalar) implementations — semantics cited per plugin; these are the
+ground truth the batched tensor kernels (ops/filters.py, ops/scores.py) are
+parity-tested against, and the fallback path when the TPU backend is off.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ...api.types import (
+    Pod,
+    Node,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    TAINT_PREFER_NO_SCHEDULE,
+    Taint,
+    Toleration,
+)
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    NodeScore,
+    OK,
+    PreFilterPlugin,
+    PreFilterResult,
+    PreScorePlugin,
+    QueueSortPlugin,
+    ScoreExtensions,
+    ScorePlugin,
+    Status,
+    default_normalize_score,
+    MAX_NODE_SCORE,
+)
+from ..types import ClusterEvent, NodeInfo, QueuedPodInfo, ports_conflict
+from ..types import ADD, NODE, POD, UPDATE, UPDATE_NODE_LABEL, UPDATE_NODE_TAINT, DELETE
+from . import names
+
+
+class PrioritySort(QueueSortPlugin):
+    """queuesort/priority_sort.go: pod priority desc, then FIFO timestamp."""
+
+    def name(self) -> str:
+        return names.PRIORITY_SORT
+
+    def less(self, a: QueuedPodInfo, b: QueuedPodInfo) -> bool:
+        p1, p2 = a.pod.spec.priority, b.pod.spec.priority
+        return p1 > p2 or (p1 == p2 and a.timestamp < b.timestamp)
+
+
+class NodeUnschedulable(FilterPlugin):
+    """nodeunschedulable/node_unschedulable.go: reject spec.unschedulable nodes
+    unless the pod tolerates the unschedulable taint."""
+
+    ERR_UNSCHEDULABLE = "node(s) were unschedulable"
+    _TAINT = Taint(key="node.kubernetes.io/unschedulable", effect=TAINT_NO_SCHEDULE)
+
+    def name(self) -> str:
+        return names.NODE_UNSCHEDULABLE
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(NODE, ADD | UPDATE_NODE_TAINT)]
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        if node is None:
+            return Status.unresolvable("node(s) had unknown conditions")
+        if node.spec.unschedulable and not any(
+            t.tolerates(self._TAINT) for t in pod.spec.tolerations
+        ):
+            return Status.unresolvable(self.ERR_UNSCHEDULABLE)
+        return OK
+
+
+class NodeName(FilterPlugin):
+    """nodename/node_name.go: pod.spec.nodeName must match, if set."""
+
+    ERR_REASON = "node(s) didn't match the requested node name"
+
+    def name(self) -> str:
+        return names.NODE_NAME
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if pod.spec.node_name and node_info.node and pod.spec.node_name != node_info.node.meta.name:
+            return Status.unresolvable(self.ERR_REASON)
+        return OK
+
+
+def find_matching_untolerated_taint(
+    taints, tolerations, effects
+) -> Optional[Taint]:
+    """v1helper.FindMatchingUntoleratedTaint over the given effects."""
+    for t in taints:
+        if t.effect in effects and not any(tol.tolerates(t) for tol in tolerations):
+            return t
+    return None
+
+
+class TaintToleration(FilterPlugin, PreScorePlugin, ScorePlugin, ScoreExtensions):
+    """tainttoleration/taint_toleration.go:
+    Filter: every NoSchedule/NoExecute taint must be tolerated.
+    Score: count of untolerated PreferNoSchedule taints, normalized reversed."""
+
+    STATE_KEY = "PreScore/TaintToleration"
+
+    def name(self) -> str:
+        return names.TAINT_TOLERATION
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(NODE, ADD | UPDATE_NODE_TAINT)]
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        taint = find_matching_untolerated_taint(
+            node_info.node.spec.taints if node_info.node else (),
+            pod.spec.tolerations,
+            (TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE),
+        )
+        if taint is None:
+            return OK
+        return Status.unresolvable(
+            f"node(s) had untolerated taint {{{taint.key}: {taint.value}}}"
+        )
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        prefer = tuple(
+            t for t in pod.spec.tolerations
+            if t.effect in ("", TAINT_PREFER_NO_SCHEDULE)
+        )
+        state.write(self.STATE_KEY, prefer)
+        return OK
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError  # runtime calls score_node with NodeInfo
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        tolerations: Tuple[Toleration, ...] = state.read(self.STATE_KEY)
+        count = 0
+        for t in node_info.node.spec.taints:
+            if t.effect == TAINT_PREFER_NO_SCHEDULE and not any(
+                tol.tolerates(t) for tol in tolerations
+            ):
+                count += 1
+        return count, OK
+
+    def score_extensions(self):
+        return self
+
+    def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> Status:
+        return default_normalize_score(MAX_NODE_SCORE, True, scores)
+
+
+class NodePorts(PreFilterPlugin, FilterPlugin):
+    """nodeports/node_ports.go: requested hostPorts must not conflict with
+    NodeInfo.UsedPorts."""
+
+    STATE_KEY = "PreFilter/NodePorts"
+    ERR_REASON = "node(s) didn't have free ports for the requested pod ports"
+
+    def name(self) -> str:
+        return names.NODE_PORTS
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(POD, DELETE), ClusterEvent(NODE, ADD)]
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        state.write(self.STATE_KEY, pod.host_ports())
+        return None, OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        wanted = state.read(self.STATE_KEY)
+        if ports_conflict(node_info.used_ports, wanted):
+            return Status.unschedulable(self.ERR_REASON)
+        return OK

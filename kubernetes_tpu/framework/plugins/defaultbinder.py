@@ -1,0 +1,22 @@
+"""DefaultBinder (defaultbinder/default_binder.go): POST pods/{name}/binding."""
+
+from __future__ import annotations
+
+from ...api.types import Binding, Pod
+from ..interface import BindPlugin, CycleState, OK, Status
+from . import names
+
+
+class DefaultBinder(BindPlugin):
+    def __init__(self, client=None):
+        self.client = client  # apiserver.Client
+
+    def name(self) -> str:
+        return names.DEFAULT_BINDER
+
+    def bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        try:
+            self.client.bind(Binding(pod_key=pod.key(), node_name=node_name))
+        except Exception as e:  # noqa: BLE001 — surfaced as Status like AsStatus(err)
+            return Status.error(str(e))
+        return OK

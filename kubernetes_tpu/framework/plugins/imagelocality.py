@@ -1,0 +1,86 @@
+"""ImageLocality score plugin (imagelocality/image_locality.go).
+
+score_node raw value = Σ over the pod's container images present on the node of
+``size · numNodesWithImage / totalNodes``, clamped to
+[23 MB, 1000 MB · numContainers] and scaled to [0, 100].
+
+The per-image node spread (ImageStateSummary.NumNodes, computed by the cache in
+the reference) is derived here at PreScore from the snapshot's node list.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ...api.types import Pod
+from ..interface import CycleState, OK, PreScorePlugin, ScorePlugin, Status, MAX_NODE_SCORE
+from ..types import NodeInfo
+from . import names
+
+MB = 1024 * 1024
+MIN_THRESHOLD = 23 * MB
+MAX_CONTAINER_THRESHOLD = 1000 * MB
+
+
+def normalized_image_name(name: str) -> str:
+    """parsers.NormalizeImageRef-lite: append :latest when no tag/digest."""
+    if "@" in name:
+        return name
+    last = name.rsplit("/", 1)[-1]
+    if ":" not in last:
+        return name + ":latest"
+    return name
+
+
+class _SpreadState:
+    __slots__ = ("num_nodes_with_image", "total_nodes")
+
+    def __init__(self, num_nodes_with_image: Dict[str, int], total_nodes: int):
+        self.num_nodes_with_image = num_nodes_with_image
+        self.total_nodes = total_nodes
+
+    def clone(self):
+        return self
+
+
+class ImageLocality(PreScorePlugin, ScorePlugin):
+    STATE_KEY = "PreScore/ImageLocality"
+
+    def __init__(self, snapshot_fn=None):
+        # snapshot_fn: () -> List[NodeInfo]; injected by the framework runtime
+        self.snapshot_fn = snapshot_fn
+
+    def name(self) -> str:
+        return names.IMAGE_LOCALITY
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        spread: Dict[str, int] = {}
+        # without a snapshot there is no image-spread information: score 0s
+        node_infos: List[NodeInfo] = self.snapshot_fn() if self.snapshot_fn else []
+        for ni in node_infos:
+            for img in ni.image_states:
+                spread[img] = spread.get(img, 0) + 1
+        state.write(self.STATE_KEY, _SpreadState(spread, max(1, len(node_infos))))
+        return OK
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        s: _SpreadState = state.read(self.STATE_KEY)
+        total = 0
+        for c in pod.spec.containers:
+            img = normalized_image_name(c.image)
+            size = node_info.image_states.get(img, node_info.image_states.get(c.image))
+            if size:
+                total += size * s.num_nodes_with_image.get(img, s.num_nodes_with_image.get(c.image, 1)) // s.total_nodes
+        return self._calculate_priority(total, len(pod.spec.containers)), OK
+
+    @staticmethod
+    def _calculate_priority(sum_scores: int, num_containers: int) -> int:
+        max_threshold = MAX_CONTAINER_THRESHOLD * num_containers
+        sum_scores = min(max(sum_scores, MIN_THRESHOLD), max_threshold)
+        return MAX_NODE_SCORE * (sum_scores - MIN_THRESHOLD) // (max_threshold - MIN_THRESHOLD)
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError
+
+    def score_extensions(self):
+        return None

@@ -1,0 +1,317 @@
+"""InterPodAffinity plugin oracle (interpodaffinity/{filtering,scoring}.go).
+
+PreFilter builds three topology-pair count maps by scanning existing pods
+against pre-parsed AffinityTerms (filtering.go:86-135):
+  existing_anti:  existing pods' required anti-affinity terms matching the
+                  incoming pod, bucketed by the existing pod's node domain;
+  affinity:       incoming pod's required affinity terms matching existing pods;
+  anti_affinity:  incoming pod's required anti-affinity terms matching existing pods.
+Filter is then four boolean checks per node (:308-:368), including the
+first-pod-in-cluster special case for self-matching affinity.
+
+Score: preferred (anti-)affinity of the incoming pod against existing pods,
+plus symmetric terms of existing pods toward the incoming pod (required
+affinity terms weighted by hard_pod_affinity_weight, default 1), bucketed per
+topology pair; NormalizeScore maps [min,max] (floored/ceiled at 0) to [0,100].
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, FrozenSet, List, Optional, Tuple
+
+from ...api.types import MATCH_NOTHING, LabelSelector, Pod, PodAffinityTerm
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    NodeScore,
+    OK,
+    PreFilterExtensions,
+    PreFilterPlugin,
+    PreFilterResult,
+    PreScorePlugin,
+    ScoreExtensions,
+    ScorePlugin,
+    Status,
+    MAX_NODE_SCORE,
+)
+from ..types import ADD, DELETE, NODE, POD, UPDATE_NODE_LABEL, ClusterEvent, NodeInfo
+from . import names
+
+ERR_EXISTING_ANTI = "node(s) didn't satisfy existing pods anti-affinity rules"
+ERR_AFFINITY = "node(s) didn't match pod affinity rules"
+ERR_ANTI_AFFINITY = "node(s) didn't match pod anti-affinity rules"
+
+NsLabelsFn = Callable[[str], Dict[str, str]]
+
+
+@dataclass(frozen=True)
+class AffinityTerm:
+    """Pre-parsed term (framework/types.go:193 newAffinityTerm)."""
+
+    selector: LabelSelector
+    topology_key: str
+    namespaces: FrozenSet[str]
+    namespace_selector: Optional[LabelSelector]
+    weight: int = 0
+
+    @classmethod
+    def build(cls, term: PodAffinityTerm, default_ns: str, weight: int = 0) -> "AffinityTerm":
+        ns = frozenset(term.namespaces) if term.namespaces else (
+            frozenset() if term.namespace_selector is not None else frozenset({default_ns})
+        )
+        return cls(
+            selector=term.label_selector if term.label_selector is not None else MATCH_NOTHING,
+            topology_key=term.topology_key,
+            namespaces=ns,
+            namespace_selector=term.namespace_selector,
+            weight=weight,
+        )
+
+    def matches(self, pod: Pod, ns_labels_fn: NsLabelsFn) -> bool:
+        if pod.meta.namespace in self.namespaces:
+            ns_ok = True
+        elif self.namespace_selector is not None:
+            ns_ok = self.namespace_selector.matches(ns_labels_fn(pod.meta.namespace))
+        else:
+            ns_ok = False
+        return ns_ok and self.selector.matches(pod.meta.labels)
+
+
+def _parsed_terms(pod: Pod):
+    """Parse-once-per-pod term cache (the reference parses at PodInfo build,
+    framework/types.go:193; here terms are memoized on the Pod instance)."""
+    cached = pod.__dict__.get("_ipa_terms")
+    if cached is not None:
+        return cached
+    a = pod.spec.affinity
+    req_aff = [AffinityTerm.build(t, pod.meta.namespace) for t in a.pod_affinity.required] if a and a.pod_affinity else []
+    req_anti = [AffinityTerm.build(t, pod.meta.namespace) for t in a.pod_anti_affinity.required] if a and a.pod_anti_affinity else []
+    pref_aff = [AffinityTerm.build(w.term, pod.meta.namespace, w.weight) for w in a.pod_affinity.preferred] if a and a.pod_affinity else []
+    pref_anti = [AffinityTerm.build(w.term, pod.meta.namespace, w.weight) for w in a.pod_anti_affinity.preferred] if a and a.pod_anti_affinity else []
+    cached = (req_aff, req_anti, pref_aff, pref_anti)
+    pod.__dict__["_ipa_terms"] = cached
+    return cached
+
+
+def required_affinity_terms(pod: Pod) -> List[AffinityTerm]:
+    return _parsed_terms(pod)[0]
+
+
+def required_anti_affinity_terms(pod: Pod) -> List[AffinityTerm]:
+    return _parsed_terms(pod)[1]
+
+
+def preferred_affinity_terms(pod: Pod) -> List[AffinityTerm]:
+    return _parsed_terms(pod)[2]
+
+
+def preferred_anti_affinity_terms(pod: Pod) -> List[AffinityTerm]:
+    return _parsed_terms(pod)[3]
+
+
+TopoPair = Tuple[str, str]
+
+
+@dataclass
+class _PreFilterState:
+    affinity_terms: List[AffinityTerm] = field(default_factory=list)
+    anti_affinity_terms: List[AffinityTerm] = field(default_factory=list)
+    existing_anti: Dict[TopoPair, int] = field(default_factory=dict)
+    affinity: Dict[TopoPair, int] = field(default_factory=dict)
+    anti_affinity: Dict[TopoPair, int] = field(default_factory=dict)
+
+    def clone(self) -> "_PreFilterState":
+        s = _PreFilterState(list(self.affinity_terms), list(self.anti_affinity_terms))
+        s.existing_anti = dict(self.existing_anti)
+        s.affinity = dict(self.affinity)
+        s.anti_affinity = dict(self.anti_affinity)
+        return s
+
+
+def _bump(m: Dict[TopoPair, int], pair: TopoPair, delta: int) -> None:
+    v = m.get(pair, 0) + delta
+    if v <= 0:
+        m.pop(pair, None)
+    else:
+        m[pair] = v
+
+
+class InterPodAffinity(PreFilterPlugin, FilterPlugin, PreScorePlugin, ScorePlugin, ScoreExtensions, PreFilterExtensions):
+    PREFILTER_KEY = "PreFilter/InterPodAffinity"
+    PRESCORE_KEY = "PreScore/InterPodAffinity"
+
+    def __init__(self, snapshot_fn=None, ns_labels_fn: Optional[NsLabelsFn] = None,
+                 hard_pod_affinity_weight: int = 1, ignore_preferred_terms_of_existing_pods: bool = False):
+        self.snapshot_fn = snapshot_fn  # () -> List[NodeInfo] (all nodes)
+        self.ns_labels_fn: NsLabelsFn = ns_labels_fn or (lambda ns: {})
+        self.hard_pod_affinity_weight = hard_pod_affinity_weight
+        self.ignore_preferred = ignore_preferred_terms_of_existing_pods
+
+    def name(self) -> str:
+        return names.INTER_POD_AFFINITY
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(POD, ADD | DELETE), ClusterEvent(NODE, ADD | UPDATE_NODE_LABEL)]
+
+    # ------------------------------------------------------------------ filter
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        s = _PreFilterState(
+            affinity_terms=required_affinity_terms(pod),
+            anti_affinity_terms=required_anti_affinity_terms(pod),
+        )
+        all_nodes: List[NodeInfo] = self.snapshot_fn() if self.snapshot_fn else []
+        need_scan = s.affinity_terms or s.anti_affinity_terms
+        for ni in all_nodes:
+            node = ni.node
+            if node is None:
+                continue
+            # existing pods' required anti-affinity vs incoming pod — only pods
+            # with required anti-affinity matter (snapshot pruned list).
+            for ep in ni.pods_with_required_anti_affinity:
+                for term in required_anti_affinity_terms(ep):
+                    if term.topology_key in node.meta.labels and term.matches(pod, self.ns_labels_fn):
+                        _bump(s.existing_anti, (term.topology_key, node.meta.labels[term.topology_key]), 1)
+            if not need_scan:
+                continue
+            for ep in ni.pods:
+                for term in s.affinity_terms:
+                    if term.topology_key in node.meta.labels and term.matches(ep, self.ns_labels_fn):
+                        _bump(s.affinity, (term.topology_key, node.meta.labels[term.topology_key]), 1)
+                for term in s.anti_affinity_terms:
+                    if term.topology_key in node.meta.labels and term.matches(ep, self.ns_labels_fn):
+                        _bump(s.anti_affinity, (term.topology_key, node.meta.labels[term.topology_key]), 1)
+        state.write(self.PREFILTER_KEY, s)
+        return None, OK
+
+    def pre_filter_extensions(self):
+        return self
+
+    def _update_for_pod(self, s: _PreFilterState, incoming: Pod, other: Pod, node, delta: int) -> None:
+        for term in required_anti_affinity_terms(other):
+            if term.topology_key in node.meta.labels and term.matches(incoming, self.ns_labels_fn):
+                _bump(s.existing_anti, (term.topology_key, node.meta.labels[term.topology_key]), delta)
+        for term in s.affinity_terms:
+            if term.topology_key in node.meta.labels and term.matches(other, self.ns_labels_fn):
+                _bump(s.affinity, (term.topology_key, node.meta.labels[term.topology_key]), delta)
+        for term in s.anti_affinity_terms:
+            if term.topology_key in node.meta.labels and term.matches(other, self.ns_labels_fn):
+                _bump(s.anti_affinity, (term.topology_key, node.meta.labels[term.topology_key]), delta)
+
+    def add_pod(self, state: CycleState, pod: Pod, to_add: Pod, node_info: NodeInfo) -> Status:
+        s: _PreFilterState = state.read(self.PREFILTER_KEY)
+        if node_info.node is not None:
+            self._update_for_pod(s, pod, to_add, node_info.node, 1)
+        return OK
+
+    def remove_pod(self, state: CycleState, pod: Pod, to_remove: Pod, node_info: NodeInfo) -> Status:
+        s: _PreFilterState = state.read(self.PREFILTER_KEY)
+        if node_info.node is not None:
+            self._update_for_pod(s, pod, to_remove, node_info.node, -1)
+        return OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        s: _PreFilterState = state.read(self.PREFILTER_KEY)
+        node = node_info.node
+        labels = node.meta.labels
+
+        # check order and codes per filtering.go:377-387:
+        # 1. incoming pod's affinity (satisfyPodAffinity + first-pod case) — Unresolvable
+        if s.affinity_terms:
+            pods_exist = True
+            for term in s.affinity_terms:
+                tv = labels.get(term.topology_key)
+                if tv is None:
+                    return Status.unresolvable(ERR_AFFINITY)
+                if s.affinity.get((term.topology_key, tv), 0) <= 0:
+                    pods_exist = False
+            if not pods_exist:
+                # allowed only as the first pod in the cluster matching its own affinity
+                first_pod_ok = not s.affinity and all(
+                    t.matches(pod, self.ns_labels_fn) for t in s.affinity_terms
+                )
+                if not first_pod_ok:
+                    return Status.unresolvable(ERR_AFFINITY)
+
+        # 2. incoming pod's anti-affinity (satisfyPodAntiAffinity) — Unschedulable
+        for term in s.anti_affinity_terms:
+            tv = labels.get(term.topology_key)
+            if tv is not None and s.anti_affinity.get((term.topology_key, tv), 0) > 0:
+                return Status.unschedulable(ERR_ANTI_AFFINITY)
+
+        # 3. existing pods' anti-affinity — Unschedulable
+        for (tk, tv), cnt in s.existing_anti.items():
+            if cnt > 0 and labels.get(tk) == tv:
+                return Status.unschedulable(ERR_EXISTING_ANTI)
+        return OK
+
+    # ------------------------------------------------------------------ score
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        pref = preferred_affinity_terms(pod)
+        pref_anti = preferred_anti_affinity_terms(pod)
+        topology_score: Dict[TopoPair, int] = {}
+        all_nodes: List[NodeInfo] = self.snapshot_fn() if self.snapshot_fn else []
+        scan_all = bool(pref or pref_anti)
+        for ni in all_nodes:
+            node = ni.node
+            if node is None:
+                continue
+            labels = node.meta.labels
+            existing = ni.pods if scan_all else ni.pods_with_affinity
+            for ep in existing:
+                # incoming pod's preferred terms vs existing pod
+                for term in pref:
+                    tv = labels.get(term.topology_key)
+                    if tv is not None and term.matches(ep, self.ns_labels_fn):
+                        _add_score(topology_score, (term.topology_key, tv), term.weight)
+                for term in pref_anti:
+                    tv = labels.get(term.topology_key)
+                    if tv is not None and term.matches(ep, self.ns_labels_fn):
+                        _add_score(topology_score, (term.topology_key, tv), -term.weight)
+                # symmetric: existing pod's terms vs incoming pod
+                if self.hard_pod_affinity_weight > 0:
+                    for term in required_affinity_terms(ep):
+                        tv = labels.get(term.topology_key)
+                        if tv is not None and term.matches(pod, self.ns_labels_fn):
+                            _add_score(topology_score, (term.topology_key, tv), self.hard_pod_affinity_weight)
+                if not self.ignore_preferred:
+                    for term in preferred_affinity_terms(ep):
+                        tv = labels.get(term.topology_key)
+                        if tv is not None and term.matches(pod, self.ns_labels_fn):
+                            _add_score(topology_score, (term.topology_key, tv), term.weight)
+                    for term in preferred_anti_affinity_terms(ep):
+                        tv = labels.get(term.topology_key)
+                        if tv is not None and term.matches(pod, self.ns_labels_fn):
+                            _add_score(topology_score, (term.topology_key, tv), -term.weight)
+        state.write(self.PRESCORE_KEY, topology_score)
+        return OK
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        topology_score: Dict[TopoPair, int] = state.read(self.PRESCORE_KEY)
+        labels = node_info.node.meta.labels
+        total = 0
+        for (tk, tv), w in topology_score.items():
+            if labels.get(tk) == tv:
+                total += w
+        return total, OK
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError
+
+    def score_extensions(self):
+        return self
+
+    def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> Status:
+        # scoring.go NormalizeScore: min/max floored/ceiled at 0
+        max_count = max([s.score for s in scores] + [0])
+        min_count = min([s.score for s in scores] + [0])
+        diff = max_count - min_count
+        for s in scores:
+            s.score = int(MAX_NODE_SCORE * (s.score - min_count) / diff) if diff > 0 else 0
+        return OK
+
+
+def _add_score(m: Dict[TopoPair, int], pair: TopoPair, w: int) -> None:
+    m[pair] = m.get(pair, 0) + w

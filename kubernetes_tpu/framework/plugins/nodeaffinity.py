@@ -1,0 +1,118 @@
+"""NodeAffinity plugin (nodeaffinity/node_affinity.go).
+
+Filter: pod.spec.nodeSelector (map: all pairs must be node labels) AND
+required NodeSelector terms (OR of terms, AND within a term), plus the
+per-profile AddedAffinity arg.  PreFilter: if every required term is a
+metadata.name matchFields restriction, pre-restrict the candidate set.
+Score: sum of weights of matching preferred terms, DefaultNormalizeScore.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Set, Tuple
+
+from ...api.types import NodeAffinity as NodeAffinityAPI
+from ...api.types import NodeSelector, Pod
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    NodeScore,
+    OK,
+    PreFilterPlugin,
+    PreFilterResult,
+    PreScorePlugin,
+    ScoreExtensions,
+    ScorePlugin,
+    Status,
+    default_normalize_score,
+    MAX_NODE_SCORE,
+)
+from ..types import ADD, NODE, UPDATE_NODE_LABEL, ClusterEvent, NodeInfo
+from . import names
+
+ERR_REASON_POD = "node(s) didn't match Pod's node affinity/selector"
+ERR_REASON_ENFORCED = "node(s) didn't match scheduler-enforced node affinity"
+ERR_REASON_CONFLICT = "node(s) didn't satisfy plugin's node affinity"
+
+
+def _required_terms(pod: Pod) -> Optional[NodeSelector]:
+    a = pod.spec.affinity
+    if a and a.node_affinity and a.node_affinity.required:
+        return a.node_affinity.required
+    return None
+
+
+def _matches_node_selector_map(pod: Pod, labels) -> bool:
+    return all(labels.get(k) == v for k, v in pod.spec.node_selector.items())
+
+
+class NodeAffinity(PreFilterPlugin, FilterPlugin, PreScorePlugin, ScorePlugin, ScoreExtensions):
+    STATE_KEY = "PreFilter/NodeAffinity"
+    PRESCORE_KEY = "PreScore/NodeAffinity"
+
+    def __init__(self, added_affinity: Optional[NodeAffinityAPI] = None):
+        self.added_affinity = added_affinity  # args.AddedAffinity (per-profile)
+
+    def name(self) -> str:
+        return names.NODE_AFFINITY
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(NODE, ADD | UPDATE_NODE_LABEL)]
+
+    # -- PreFilter: metadata.name fast path (node_affinity.go:98-134)
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        required = _required_terms(pod)
+        state.write(self.STATE_KEY, required)
+        if required is None or not required.terms:
+            return None, OK
+        node_names: Set[str] = set()
+        for term in required.terms:
+            if term.match_fields_name is None or term.match_expressions:
+                return None, OK  # some term matches by labels → no pre-restriction
+            node_names.add(term.match_fields_name)
+        if not node_names:
+            return None, Status.unresolvable(ERR_REASON_CONFLICT)
+        return PreFilterResult(node_names), OK
+
+    # -- Filter
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        node = node_info.node
+        if self.added_affinity and self.added_affinity.required:
+            if not self.added_affinity.required.matches(node):
+                return Status.unresolvable(ERR_REASON_ENFORCED)
+        if not _matches_node_selector_map(pod, node.meta.labels):
+            return Status.unresolvable(ERR_REASON_POD)
+        required = _required_terms(pod)
+        if required is not None and not required.matches(node):
+            return Status.unresolvable(ERR_REASON_POD)
+        return OK
+
+    # -- Score
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        preferred = []
+        a = pod.spec.affinity
+        if a and a.node_affinity:
+            preferred.extend(a.node_affinity.preferred)
+        if self.added_affinity:
+            preferred.extend(self.added_affinity.preferred)
+        state.write(self.PRESCORE_KEY, tuple(preferred))
+        return OK
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        total = 0
+        for term in state.read(self.PRESCORE_KEY):
+            if term.weight != 0 and term.preference.matches(node_info.node):
+                total += term.weight
+        return total, OK
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError
+
+    def score_extensions(self):
+        return self
+
+    def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> Status:
+        return default_normalize_score(MAX_NODE_SCORE, False, scores)

@@ -1,0 +1,234 @@
+"""NodeResourcesFit + the resource scoring strategies + BalancedAllocation.
+
+Oracle implementations of noderesources/{fit,least_allocated,most_allocated,
+requested_to_capacity_ratio,resource_allocation,balanced_allocation}.go.
+Exact formulas documented in SURVEY.md §8.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ...api import resource as resource_api
+from ...api.types import Pod
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    OK,
+    PreFilterExtensions,
+    PreFilterPlugin,
+    PreFilterResult,
+    ScorePlugin,
+    Status,
+    MAX_NODE_SCORE,
+)
+from ..types import ADD, DELETE, NODE, POD, UPDATE_NODE_ALLOCATABLE, ClusterEvent, NodeInfo, nonzero_request
+from . import names
+
+# scoring strategy names (apis/config types_pluginargs.go ScoringStrategyType)
+LEAST_ALLOCATED = "LeastAllocated"
+MOST_ALLOCATED = "MostAllocated"
+REQUESTED_TO_CAPACITY_RATIO = "RequestedToCapacityRatio"
+
+DEFAULT_RESOURCES: Tuple[Tuple[str, int], ...] = ((resource_api.CPU, 1), (resource_api.MEMORY, 1))
+
+
+@dataclass
+class InsufficientResource:
+    resource_name: str
+    reason: str
+    requested: int
+    used: int
+    capacity: int
+
+
+class _FitState:
+    """preFilterState (fit.go:142): the pod's canonical-int resource request."""
+
+    __slots__ = ("request",)
+
+    def __init__(self, request: Dict[str, int]):
+        self.request = request
+
+    def clone(self) -> "_FitState":
+        return _FitState(dict(self.request))
+
+
+def fits_request(
+    request: Dict[str, int],
+    node_info: NodeInfo,
+    ignored_extended: frozenset = frozenset(),
+) -> List[InsufficientResource]:
+    """fitsRequest (fit.go:252): per-resource `req ≤ allocatable − requested`,
+    plus the pod-count check; returns every insufficiency (not just first)."""
+    out: List[InsufficientResource] = []
+    allowed = node_info.allocatable.allowed_pod_number
+    if len(node_info.pods) + 1 > allowed:
+        out.append(InsufficientResource(resource_api.PODS, "Too many pods", 1, len(node_info.pods), allowed))
+
+    core = {k: v for k, v in request.items() if k != resource_api.PODS}
+    if all(v == 0 for v in core.values()):
+        return out
+
+    for rname, rq in core.items():
+        if rq == 0:
+            continue
+        if resource_api.is_extended(rname) and rname in ignored_extended:
+            continue
+        free = node_info.allocatable.get(rname) - node_info.requested.get(rname)
+        if rq > free:
+            out.append(
+                InsufficientResource(rname, f"Insufficient {rname}", rq, node_info.requested.get(rname), node_info.allocatable.get(rname))
+            )
+    return out
+
+
+class Fit(PreFilterPlugin, FilterPlugin, ScorePlugin, PreFilterExtensions):
+    """noderesources/fit.go — the CPU-reference predicate, plus the configured
+    scoring strategy (default LeastAllocated)."""
+
+    STATE_KEY = "PreFilter/NodeResourcesFit"
+
+    def __init__(
+        self,
+        strategy: str = LEAST_ALLOCATED,
+        resources: Tuple[Tuple[str, int], ...] = DEFAULT_RESOURCES,
+        shape: Tuple[Tuple[int, int], ...] = (),
+        ignored_extended: frozenset = frozenset(),
+    ):
+        self.strategy = strategy
+        self.resources = resources
+        self.shape = shape or ((0, 0), (100, 10))  # RequestedToCapacityRatio default
+        self.ignored_extended = ignored_extended
+
+    def name(self) -> str:
+        return names.NODE_RESOURCES_FIT
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(POD, DELETE), ClusterEvent(NODE, ADD | UPDATE_NODE_ALLOCATABLE)]
+
+    # -- PreFilter
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        state.write(self.STATE_KEY, _FitState(pod.resource_request()))
+        return None, OK
+
+    def pre_filter_extensions(self):
+        return self
+
+    def add_pod(self, state, pod, to_add, node_info) -> Status:
+        return OK  # fit state is pod-side only; node side comes from NodeInfo
+
+    def remove_pod(self, state, pod, to_remove, node_info) -> Status:
+        return OK
+
+    # -- Filter
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        s: _FitState = state.read(self.STATE_KEY)
+        insufficient = fits_request(s.request, node_info, self.ignored_extended)
+        if insufficient:
+            return Status.unschedulable(*[i.reason for i in insufficient])
+        return OK
+
+    # -- Score (resource_allocation.go scorer shared by the strategies)
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        req = nonzero_request(pod.resource_request())
+        if self.strategy == REQUESTED_TO_CAPACITY_RATIO:
+            # requested_to_capacity_ratio.go:41-54: weight counted only when the
+            # resource scores > 0; result rounded, not floored.
+            num, den = 0, 0
+            for rname, weight in self.resources:
+                alloc = node_info.allocatable.get(rname)
+                requested = node_info.non_zero_requested.get(rname) + req.get(rname, 0)
+                rscore = self._rtcr_score(requested, alloc)
+                if rscore > 0:
+                    num += weight * rscore
+                    den += weight
+            return (round(num / den) if den else 0), OK
+        num, den = 0, 0
+        for rname, weight in self.resources:
+            alloc = node_info.allocatable.get(rname)
+            requested = node_info.non_zero_requested.get(rname) + req.get(rname, 0)
+            num += weight * self._score_one(requested, alloc)
+            den += weight
+        if den == 0:
+            return 0, OK
+        return num // den, OK
+
+    def _score_one(self, requested: int, capacity: int) -> int:
+        if self.strategy == LEAST_ALLOCATED:
+            # least_allocated.go:29: ((capacity − requested) · MaxNodeScore) / capacity
+            if capacity == 0 or requested > capacity:
+                return 0
+            return (capacity - requested) * MAX_NODE_SCORE // capacity
+        # most_allocated.go:29
+        if capacity == 0 or requested > capacity:
+            return 0
+        return requested * MAX_NODE_SCORE // capacity
+
+    def _rtcr_score(self, requested: int, capacity: int) -> int:
+        """resourceScoringFunction: shape scores are pre-scaled ×(100/10)
+        BEFORE interpolation (requested_to_capacity_ratio.go:66), and
+        over-capacity/zero-capacity evaluates the shape at 100% utilization."""
+        util = 100 if (capacity == 0 or requested > capacity) else requested * 100 // capacity
+        scaled = tuple((x, y * (MAX_NODE_SCORE // 10)) for x, y in self.shape)
+        return piecewise_linear(util, scaled)
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError
+
+    def score_extensions(self):
+        return None
+
+
+def piecewise_linear(x: int, shape: Tuple[Tuple[int, int], ...]) -> int:
+    """FunctionShape interpolation (helper.BuildBrokenLinearFunction), shape
+    points are (utilization%, score 0-10); scaling to 0-100 happens in caller."""
+    if x <= shape[0][0]:
+        return shape[0][1]
+    for (x0, y0), (x1, y1) in zip(shape, shape[1:]):
+        if x <= x1:
+            return y0 + (y1 - y0) * (x - x0) // (x1 - x0)
+    return shape[-1][1]
+
+
+class BalancedAllocation(ScorePlugin):
+    """noderesources/balanced_allocation.go: score = (1 − std(fractions)) · 100
+    over the configured resources' utilization fractions (incoming pod included,
+    nonzero requests)."""
+
+    def __init__(self, resources: Tuple[Tuple[str, int], ...] = DEFAULT_RESOURCES):
+        self.resources = resources
+
+    def name(self) -> str:
+        return names.NODE_RESOURCES_BALANCED_ALLOCATION
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(POD, DELETE), ClusterEvent(NODE, ADD | UPDATE_NODE_ALLOCATABLE)]
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        req = nonzero_request(pod.resource_request())
+        fractions: List[float] = []
+        for rname, _w in self.resources:
+            alloc = node_info.allocatable.get(rname)
+            if alloc == 0:
+                fractions.append(1.0)
+                continue
+            requested = node_info.non_zero_requested.get(rname) + req.get(rname, 0)
+            fractions.append(min(1.0, requested / alloc))
+        if len(fractions) == 2:
+            std = abs(fractions[0] - fractions[1]) / 2.0
+        else:
+            mean = sum(fractions) / len(fractions)
+            std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+        return int((1 - std) * MAX_NODE_SCORE), OK
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError
+
+    def score_extensions(self):
+        return None

@@ -1,0 +1,279 @@
+"""PodTopologySpread plugin oracle (podtopologyspread/{filtering,scoring}.go).
+
+Filter: for each DoNotSchedule constraint, over "eligible" nodes (nodes that
+match the incoming pod's nodeSelector/required node affinity AND carry every
+constraint's topology key), count matching pods per topology domain; a node
+passes iff ``matchNum + selfMatch − minMatchNum ≤ maxSkew``.
+
+Score: for each ScheduleAnyway constraint, raw(node) = Σ_i scoreForCount
+(= cnt·ln(size_i+2) + (maxSkew_i−1)); NormalizeScore inverts via
+``100·(max+min−raw)/max`` with ignored nodes scored 0.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ...api.types import (
+    DO_NOT_SCHEDULE,
+    MATCH_NOTHING,
+    SCHEDULE_ANYWAY,
+    LabelSelector,
+    Pod,
+    TopologySpreadConstraint,
+)
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    NodeScore,
+    OK,
+    PreFilterExtensions,
+    PreFilterPlugin,
+    PreFilterResult,
+    PreScorePlugin,
+    ScoreExtensions,
+    ScorePlugin,
+    Status,
+    MAX_NODE_SCORE,
+)
+from ..types import ADD, DELETE, NODE, POD, UPDATE, UPDATE_NODE_LABEL, ClusterEvent, NodeInfo
+from . import names
+
+ERR_REASON_CONSTRAINTS = "node(s) didn't match pod topology spread constraints"
+ERR_REASON_LABEL = ERR_REASON_CONSTRAINTS + " (missing required label)"
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+def _selector_of(c: TopologySpreadConstraint) -> LabelSelector:
+    return c.label_selector if c.label_selector is not None else MATCH_NOTHING
+
+
+def _pod_matches_node_affinity(pod: Pod, node) -> bool:
+    """GetRequiredNodeAffinity.Match: nodeSelector map AND required terms."""
+    if any(node.meta.labels.get(k) != v for k, v in pod.spec.node_selector.items()):
+        return False
+    a = pod.spec.affinity
+    if a and a.node_affinity and a.node_affinity.required:
+        return a.node_affinity.required.matches(node)
+    return True
+
+
+def count_pods_match_selector(pods, selector: LabelSelector, ns: str) -> int:
+    return sum(
+        1 for p in pods if p.meta.namespace == ns and selector.matches(p.meta.labels)
+    )
+
+
+@dataclass
+class _PreFilterState:
+    constraints: List[TopologySpreadConstraint] = field(default_factory=list)
+    tp_pair_to_match_num: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    tp_key_to_domains_num: Dict[str, int] = field(default_factory=dict)
+
+    def clone(self) -> "_PreFilterState":
+        return _PreFilterState(
+            list(self.constraints),
+            dict(self.tp_pair_to_match_num),
+            dict(self.tp_key_to_domains_num),
+        )
+
+    def min_match_num(self, tp_key: str, min_domains: Optional[int]) -> int:
+        vals = [n for (k, _v), n in self.tp_pair_to_match_num.items() if k == tp_key]
+        m = min(vals) if vals else 0
+        if min_domains is not None and self.tp_key_to_domains_num.get(tp_key, 0) < min_domains:
+            return 0  # fewer eligible domains than minDomains ⇒ global min is 0
+        return m
+
+    def update(self, pod: Pod, node, delta: int, incoming_ns: str) -> None:
+        """AddPod/RemovePod extension (filtering.go:166,177 updateWithPod);
+        only nodes carrying every constraint's topology key were counted at
+        PreFilter, so only those may be updated (nodeLabelsMatchSpreadConstraints)."""
+        if pod.meta.namespace != incoming_ns:
+            return
+        if any(c.topology_key not in node.meta.labels for c in self.constraints):
+            return
+        for c in self.constraints:
+            if not _selector_of(c).matches(pod.meta.labels):
+                continue
+            if c.topology_key not in node.meta.labels:
+                continue
+            pair = (c.topology_key, node.meta.labels[c.topology_key])
+            self.tp_pair_to_match_num[pair] = self.tp_pair_to_match_num.get(pair, 0) + delta
+
+
+@dataclass
+class _PreScoreState:
+    constraints: List[TopologySpreadConstraint] = field(default_factory=list)
+    ignored_nodes: Set[str] = field(default_factory=set)
+    topology_pair_to_pod_counts: Dict[Tuple[str, str], int] = field(default_factory=dict)
+    topology_normalizing_weight: List[float] = field(default_factory=list)
+
+    def clone(self):
+        return self
+
+
+class PodTopologySpread(PreFilterPlugin, FilterPlugin, PreScorePlugin, ScorePlugin, ScoreExtensions, PreFilterExtensions):
+    PREFILTER_KEY = "PreFilter/PodTopologySpread"
+    PRESCORE_KEY = "PreScore/PodTopologySpread"
+
+    def __init__(self, snapshot_fn=None, default_constraints: Tuple[TopologySpreadConstraint, ...] = (),
+                 system_defaulted: bool = False):
+        self.snapshot_fn = snapshot_fn  # () -> List[NodeInfo]
+        self.default_constraints = default_constraints
+        # True only when default_constraints are the built-in system defaults
+        # (plugin.go systemDefaulted) — relaxes the require-all-topologies rule
+        self.system_defaulted = system_defaulted
+
+    def name(self) -> str:
+        return names.POD_TOPOLOGY_SPREAD
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [ClusterEvent(POD, ADD | DELETE), ClusterEvent(NODE, ADD | UPDATE_NODE_LABEL)]
+
+    def _constraints(self, pod: Pod, when: str) -> List[TopologySpreadConstraint]:
+        if pod.spec.topology_spread_constraints:
+            return [c for c in pod.spec.topology_spread_constraints if c.when_unsatisfiable == when]
+        return [c for c in self.default_constraints if c.when_unsatisfiable == when]
+
+    # -- PreFilter (filtering.go:238 calPreFilterState)
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        constraints = self._constraints(pod, DO_NOT_SCHEDULE)
+        s = _PreFilterState(constraints=constraints)
+        if constraints:
+            all_nodes: List[NodeInfo] = self.snapshot_fn() if self.snapshot_fn else []
+            for ni in all_nodes:
+                node = ni.node
+                if node is None or not _pod_matches_node_affinity(pod, node):
+                    continue
+                if any(c.topology_key not in node.meta.labels for c in constraints):
+                    continue
+                for c in constraints:
+                    pair = (c.topology_key, node.meta.labels[c.topology_key])
+                    cnt = count_pods_match_selector(ni.pods, _selector_of(c), pod.meta.namespace)
+                    s.tp_pair_to_match_num[pair] = s.tp_pair_to_match_num.get(pair, 0) + cnt
+            for (k, _v) in s.tp_pair_to_match_num:
+                s.tp_key_to_domains_num[k] = s.tp_key_to_domains_num.get(k, 0) + 1
+        state.write(self.PREFILTER_KEY, s)
+        return None, OK
+
+    def pre_filter_extensions(self):
+        return self
+
+    def add_pod(self, state: CycleState, pod: Pod, to_add: Pod, node_info: NodeInfo) -> Status:
+        s: _PreFilterState = state.read(self.PREFILTER_KEY)
+        if s.constraints and node_info.node is not None and _pod_matches_node_affinity(pod, node_info.node):
+            s.update(to_add, node_info.node, 1, pod.meta.namespace)
+        return OK
+
+    def remove_pod(self, state: CycleState, pod: Pod, to_remove: Pod, node_info: NodeInfo) -> Status:
+        s: _PreFilterState = state.read(self.PREFILTER_KEY)
+        if s.constraints and node_info.node is not None and _pod_matches_node_affinity(pod, node_info.node):
+            s.update(to_remove, node_info.node, -1, pod.meta.namespace)
+        return OK
+
+    # -- Filter (filtering.go:335)
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        s: _PreFilterState = state.read(self.PREFILTER_KEY)
+        if not s.constraints:
+            return OK
+        node = node_info.node
+        for c in s.constraints:
+            if c.topology_key not in node.meta.labels:
+                return Status.unresolvable(ERR_REASON_LABEL)
+            min_match = s.min_match_num(c.topology_key, c.min_domains)
+            self_match = 1 if _selector_of(c).matches(pod.meta.labels) else 0
+            pair = (c.topology_key, node.meta.labels[c.topology_key])
+            match_num = s.tp_pair_to_match_num.get(pair, 0)
+            if match_num + self_match - min_match > c.max_skew:
+                return Status.unschedulable(ERR_REASON_CONSTRAINTS)
+        return OK
+
+    # -- Score (scoring.go)
+
+    def pre_score(self, state: CycleState, pod: Pod, filtered_nodes) -> Status:
+        constraints = self._constraints(pod, SCHEDULE_ANYWAY)
+        s = _PreScoreState(constraints=constraints)
+        state.write(self.PRESCORE_KEY, s)
+        if not constraints:
+            return OK
+        require_all = bool(pod.spec.topology_spread_constraints) or not self.system_defaulted
+
+        topo_size = [0] * len(constraints)
+        seen_pairs: Set[Tuple[str, str]] = set()
+        for node in filtered_nodes:
+            if require_all and any(c.topology_key not in node.meta.labels for c in constraints):
+                s.ignored_nodes.add(node.meta.name)
+                continue
+            for i, c in enumerate(constraints):
+                if c.topology_key == HOSTNAME_KEY:
+                    continue
+                pair = (c.topology_key, node.meta.labels.get(c.topology_key, ""))
+                if pair not in seen_pairs:
+                    seen_pairs.add(pair)
+                    s.topology_pair_to_pod_counts[pair] = 0
+                    topo_size[i] += 1
+
+        for i, c in enumerate(constraints):
+            sz = topo_size[i]
+            if c.topology_key == HOSTNAME_KEY:
+                sz = len(filtered_nodes) - len(s.ignored_nodes)
+            s.topology_normalizing_weight.append(math.log(sz + 2))
+
+        all_nodes: List[NodeInfo] = self.snapshot_fn() if self.snapshot_fn else []
+        for ni in all_nodes:
+            node = ni.node
+            if node is None or not _pod_matches_node_affinity(pod, node):
+                continue
+            if require_all and any(c.topology_key not in node.meta.labels for c in constraints):
+                continue
+            for c in constraints:
+                pair = (c.topology_key, node.meta.labels.get(c.topology_key, ""))
+                if pair in s.topology_pair_to_pod_counts:
+                    s.topology_pair_to_pod_counts[pair] += count_pods_match_selector(
+                        ni.pods, _selector_of(c), pod.meta.namespace
+                    )
+        return OK
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        s: _PreScoreState = state.read(self.PRESCORE_KEY)
+        node = node_info.node
+        if not s.constraints or node.meta.name in s.ignored_nodes:
+            return 0, OK
+        score = 0.0
+        for i, c in enumerate(s.constraints):
+            if c.topology_key not in node.meta.labels:
+                continue
+            if c.topology_key == HOSTNAME_KEY:
+                cnt = count_pods_match_selector(node_info.pods, _selector_of(c), pod.meta.namespace)
+            else:
+                cnt = s.topology_pair_to_pod_counts.get((c.topology_key, node.meta.labels[c.topology_key]), 0)
+            score += cnt * s.topology_normalizing_weight[i] + (c.max_skew - 1)
+        return round(score), OK
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError
+
+    def score_extensions(self):
+        return self
+
+    def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> Status:
+        s: _PreScoreState = state.read(self.PRESCORE_KEY)
+        if not s.constraints:
+            return OK
+        valid = [sc.score for sc in scores if sc.name not in s.ignored_nodes]
+        if not valid:
+            return OK
+        min_score, max_score = min(valid), max(valid)
+        for sc in scores:
+            if sc.name in s.ignored_nodes:
+                sc.score = 0
+            elif max_score == 0:
+                sc.score = MAX_NODE_SCORE
+            else:
+                sc.score = MAX_NODE_SCORE * (max_score + min_score - sc.score) // max_score
+        return OK

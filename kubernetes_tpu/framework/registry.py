@@ -1,0 +1,98 @@
+"""Plugin registry and the default plugin configuration.
+
+Analog of plugins/registry.go:46 (name → factory) and
+apis/config/v1beta3/default_plugins.go:32-51 (default enabled set + weights).
+Factories receive a ``handle``-like context dict so plugins can grab the
+snapshot lister, client, and per-plugin args.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Tuple
+
+from .plugins import names
+from .plugins.basic import NodeName, NodePorts, NodeUnschedulable, PrioritySort, TaintToleration
+from .plugins.defaultbinder import DefaultBinder
+from .plugins.imagelocality import ImageLocality
+from .plugins.interpodaffinity import InterPodAffinity
+from .plugins.nodeaffinity import NodeAffinity
+from .plugins.noderesources import BalancedAllocation, Fit
+from .plugins.podtopologyspread import PodTopologySpread
+
+Factory = Callable[[dict, dict], object]  # (handle_ctx, args) -> Plugin
+
+
+def in_tree_registry() -> Dict[str, Factory]:
+    return {
+        names.PRIORITY_SORT: lambda h, a: PrioritySort(),
+        names.NODE_UNSCHEDULABLE: lambda h, a: NodeUnschedulable(),
+        names.NODE_NAME: lambda h, a: NodeName(),
+        names.TAINT_TOLERATION: lambda h, a: TaintToleration(),
+        names.NODE_PORTS: lambda h, a: NodePorts(),
+        names.NODE_AFFINITY: lambda h, a: NodeAffinity(added_affinity=a.get("added_affinity")),
+        names.NODE_RESOURCES_FIT: lambda h, a: Fit(
+            strategy=a.get("strategy", "LeastAllocated"),
+            resources=tuple(a.get("resources", (("cpu", 1), ("memory", 1)))),
+            shape=tuple(a.get("shape", ())),
+        ),
+        names.NODE_RESOURCES_BALANCED_ALLOCATION: lambda h, a: BalancedAllocation(
+            resources=tuple(a.get("resources", (("cpu", 1), ("memory", 1)))),
+        ),
+        names.IMAGE_LOCALITY: lambda h, a: ImageLocality(snapshot_fn=h.get("snapshot_fn")),
+        names.POD_TOPOLOGY_SPREAD: lambda h, a: PodTopologySpread(
+            snapshot_fn=h.get("snapshot_fn"),
+            default_constraints=tuple(a.get("default_constraints", ())),
+            system_defaulted=a.get("system_defaulted", False),
+        ),
+        names.INTER_POD_AFFINITY: lambda h, a: InterPodAffinity(
+            snapshot_fn=h.get("snapshot_fn"),
+            ns_labels_fn=h.get("ns_labels_fn"),
+            hard_pod_affinity_weight=a.get("hard_pod_affinity_weight", 1),
+        ),
+        names.DEFAULT_BINDER: lambda h, a: DefaultBinder(client=h.get("client")),
+    }
+
+
+# (plugin name, weight) per extension point — default_plugins.go:32-51.
+DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
+    "queue_sort": [(names.PRIORITY_SORT, 0)],
+    "pre_filter": [
+        (names.NODE_AFFINITY, 0),
+        (names.NODE_PORTS, 0),
+        (names.NODE_RESOURCES_FIT, 0),
+        (names.POD_TOPOLOGY_SPREAD, 0),
+        (names.INTER_POD_AFFINITY, 0),
+    ],
+    "filter": [
+        (names.NODE_UNSCHEDULABLE, 0),
+        (names.NODE_NAME, 0),
+        (names.TAINT_TOLERATION, 0),
+        (names.NODE_AFFINITY, 0),
+        (names.NODE_PORTS, 0),
+        (names.NODE_RESOURCES_FIT, 0),
+        (names.POD_TOPOLOGY_SPREAD, 0),
+        (names.INTER_POD_AFFINITY, 0),
+    ],
+    "post_filter": [(names.DEFAULT_PREEMPTION, 0)],
+    "pre_score": [
+        (names.TAINT_TOLERATION, 0),
+        (names.NODE_AFFINITY, 0),
+        (names.POD_TOPOLOGY_SPREAD, 0),
+        (names.INTER_POD_AFFINITY, 0),
+        (names.IMAGE_LOCALITY, 0),
+    ],
+    "score": [
+        (names.NODE_RESOURCES_BALANCED_ALLOCATION, 1),
+        (names.IMAGE_LOCALITY, 1),
+        (names.INTER_POD_AFFINITY, 2),
+        (names.NODE_RESOURCES_FIT, 1),
+        (names.NODE_AFFINITY, 2),
+        (names.POD_TOPOLOGY_SPREAD, 2),
+        (names.TAINT_TOLERATION, 3),
+    ],
+    "reserve": [],
+    "permit": [],
+    "pre_bind": [],
+    "bind": [(names.DEFAULT_BINDER, 0)],
+    "post_bind": [],
+}

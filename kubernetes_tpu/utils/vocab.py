@@ -1,0 +1,68 @@
+"""String→id vocabularies for the device encoding.
+
+The device never sees strings: label keys, (key,value) pairs, taints, ports,
+images, extended-resource names and topology keys are interned host-side into
+dense integer ids.  Ids are append-only and stable for the life of a Vocab, so
+device-resident tensors indexed by id never need re-encoding when new strings
+appear (they only need wider padding, handled by capacity doubling in the
+backend).
+
+Id 0 is reserved as "absent/invalid" in every vocab, which lets 0-padded
+tensors be self-masking.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, Iterable, List
+
+
+class Vocab:
+    """Append-only intern table. Id 0 is reserved; real ids start at 1."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self._ids: Dict[Hashable, int] = {}
+        self._items: List[Hashable] = [None]  # index 0 reserved
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def id(self, item: Hashable) -> int:
+        """Intern ``item``, returning its stable id (allocating if new)."""
+        i = self._ids.get(item)
+        if i is None:
+            i = len(self._items)
+            self._ids[item] = i
+            self._items.append(item)
+        return i
+
+    def lookup(self, item: Hashable) -> int:
+        """Id of ``item`` or 0 if never interned (no allocation)."""
+        return self._ids.get(item, 0)
+
+    def item(self, i: int) -> Hashable:
+        return self._items[i]
+
+    def ids(self, items: Iterable[Hashable]) -> List[int]:
+        return [self.id(x) for x in items]
+
+
+class LabelVocabs:
+    """The vocab set the selector/taint compiler works against.
+
+    keys:   label key strings
+    pairs:  (key, value) tuples — the unit of In/NotIn bitset tests
+    """
+
+    def __init__(self):
+        self.keys = Vocab("label-keys")
+        self.pairs = Vocab("label-pairs")
+        # label keys that appear in Gt/Lt expressions get numeric slots
+        self.numeric_keys = Vocab("numeric-label-keys")
+
+    def pair_id(self, key: str, value: str) -> int:
+        self.keys.id(key)
+        return self.pairs.id((key, value))
+
+    def key_id(self, key: str) -> int:
+        return self.keys.id(key)

@@ -1,0 +1,13 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Multi-chip TPU hardware isn't available in CI; sharding tests run on
+xla_force_host_platform_device_count=8 per the driver's dryrun contract.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,350 @@
+"""Table-driven unit tests for the scalar oracle plugins.
+
+Cases are transcribed behaviors from the reference's plugin unit tests
+(fit_test.go, taint_toleration_test.go, node_affinity_test.go, ...) — same
+semantics, newly written.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.api.types import LabelSelector, Requirement
+from kubernetes_tpu.framework.interface import (
+    CycleState,
+    NodeScore,
+    SUCCESS,
+    UNSCHEDULABLE,
+    UNSCHEDULABLE_AND_UNRESOLVABLE,
+)
+from kubernetes_tpu.framework.types import NodeInfo
+from kubernetes_tpu.framework.plugins.basic import (
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    PrioritySort,
+    TaintToleration,
+)
+from kubernetes_tpu.framework.plugins.nodeaffinity import NodeAffinity
+from kubernetes_tpu.framework.plugins.noderesources import BalancedAllocation, Fit
+from kubernetes_tpu.framework.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.framework.plugins.podtopologyspread import PodTopologySpread
+from kubernetes_tpu.framework.types import QueuedPodInfo
+
+
+def ni(node, *pods):
+    info = NodeInfo(node)
+    for p in pods:
+        info.add_pod(p)
+    return info
+
+
+def run_filter(plugin, pod, node_info):
+    state = CycleState()
+    if hasattr(plugin, "pre_filter"):
+        plugin.pre_filter(state, pod)
+    return plugin.filter(state, pod, node_info)
+
+
+# ---------------------------------------------------------------- NodeResourcesFit
+
+
+class TestFit:
+    def mknode(self, cpu="4", mem="8Gi", pods=10):
+        return make_node("n1").capacity({"cpu": cpu, "memory": mem, "pods": pods}).obj()
+
+    def test_fits_empty_node(self):
+        pod = make_pod().req({"cpu": "1", "memory": "1Gi"}).obj()
+        assert run_filter(Fit(), pod, ni(self.mknode())).code == SUCCESS
+
+    def test_insufficient_cpu(self):
+        existing = make_pod("e").req({"cpu": "3500m"}).obj()
+        pod = make_pod().req({"cpu": "600m"}).obj()
+        st = run_filter(Fit(), pod, ni(self.mknode(), existing))
+        assert st.code == UNSCHEDULABLE
+        assert "Insufficient cpu" in st.reasons
+
+    def test_exact_fit_boundary(self):
+        existing = make_pod("e").req({"cpu": "3500m"}).obj()
+        pod = make_pod().req({"cpu": "500m"}).obj()
+        assert run_filter(Fit(), pod, ni(self.mknode(), existing)).code == SUCCESS
+
+    def test_too_many_pods(self):
+        node = self.mknode(pods=1)
+        existing = make_pod("e").obj()
+        pod = make_pod().obj()
+        st = run_filter(Fit(), pod, ni(node, existing))
+        assert st.code == UNSCHEDULABLE
+        assert "Too many pods" in st.reasons
+
+    def test_zero_request_always_fits_resources(self):
+        node = self.mknode(cpu="1")
+        existing = make_pod("e").req({"cpu": "1"}).obj()
+        pod = make_pod().obj()  # no requests
+        assert run_filter(Fit(), pod, ni(node, existing)).code == SUCCESS
+
+    def test_init_container_max(self):
+        # request = max(sum(containers), max(init)) per resource
+        pod = make_pod().req({"cpu": "1"}).init_req({"cpu": "3"}).obj()
+        assert pod.resource_request()["cpu"] == 3000
+        node = self.mknode(cpu="2")
+        st = run_filter(Fit(), pod, ni(node))
+        assert st.code == UNSCHEDULABLE
+
+    def test_extended_resource(self):
+        node = make_node("n").capacity({"cpu": "4", "memory": "8Gi", "pods": 10, "example.com/foo": 2}).obj()
+        ok = make_pod().req({"example.com/foo": 2}).obj()
+        bad = make_pod().req({"example.com/foo": 3}).obj()
+        assert run_filter(Fit(), ok, ni(node)).code == SUCCESS
+        st = run_filter(Fit(), bad, ni(node))
+        assert "Insufficient example.com/foo" in st.reasons
+
+    def test_least_allocated_score(self):
+        # least_allocated.go: ((cap-req)*100/cap per resource, averaged
+        node = self.mknode(cpu="4", mem="4Gi")
+        pod = make_pod().req({"cpu": "1", "memory": "1Gi"}).obj()
+        state = CycleState()
+        score, st = Fit().score_node(state, pod, ni(node))
+        assert st.code == SUCCESS
+        assert score == 75  # (75 + 75) / 2
+
+    def test_most_allocated_score(self):
+        node = self.mknode(cpu="4", mem="4Gi")
+        pod = make_pod().req({"cpu": "1", "memory": "1Gi"}).obj()
+        score, _ = Fit(strategy="MostAllocated").score_node(CycleState(), pod, ni(node))
+        assert score == 25
+
+    def test_balanced_allocation_score(self):
+        node = self.mknode(cpu="4", mem="4Gi")
+        pod = make_pod().req({"cpu": "1", "memory": "2Gi"}).obj()
+        score, _ = BalancedAllocation().score_node(CycleState(), pod, ni(node))
+        # fractions 0.25, 0.5 -> std=(0.125) -> score 87
+        assert score == 87
+
+
+# ---------------------------------------------------------------- basic plugins
+
+
+class TestBasic:
+    def test_node_name(self):
+        pod = make_pod().node("other").obj()
+        st = run_filter(NodeName(), pod, ni(make_node("n1").obj()))
+        assert st.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+        pod2 = make_pod().node("n1").obj()
+        assert run_filter(NodeName(), pod2, ni(make_node("n1").obj())).code == SUCCESS
+
+    def test_node_unschedulable(self):
+        node = make_node("n1").unschedulable().obj()
+        assert run_filter(NodeUnschedulable(), make_pod().obj(), ni(node)).code == UNSCHEDULABLE_AND_UNRESOLVABLE
+        tolerant = make_pod().toleration(key="node.kubernetes.io/unschedulable", operator="Exists", effect="NoSchedule").obj()
+        assert run_filter(NodeUnschedulable(), tolerant, ni(node)).code == SUCCESS
+
+    def test_taint_filter(self):
+        node = make_node("n1").taint("k1", "v1", "NoSchedule").obj()
+        st = run_filter(TaintToleration(), make_pod().obj(), ni(node))
+        assert st.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+        assert st.reasons == ("node(s) had untolerated taint {k1: v1}",)
+        ok = make_pod().toleration(key="k1", operator="Equal", value="v1", effect="NoSchedule").obj()
+        assert run_filter(TaintToleration(), ok, ni(node)).code == SUCCESS
+
+    def test_prefer_no_schedule_ignored_by_filter(self):
+        node = make_node("n1").taint("k1", "v1", "PreferNoSchedule").obj()
+        assert run_filter(TaintToleration(), make_pod().obj(), ni(node)).code == SUCCESS
+
+    def test_taint_score_normalized_reversed(self):
+        tt = TaintToleration()
+        pod = make_pod().obj()
+        state = CycleState()
+        tt.pre_score(state, pod, [])
+        n_clean = ni(make_node("clean").obj())
+        n_tainted = ni(make_node("tainted").taint("k", "v", "PreferNoSchedule").obj())
+        s_clean, _ = tt.score_node(state, pod, n_clean)
+        s_tainted, _ = tt.score_node(state, pod, n_tainted)
+        scores = [NodeScore("clean", s_clean), NodeScore("tainted", s_tainted)]
+        tt.normalize_score(state, pod, scores)
+        assert scores[0].score == 100 and scores[1].score == 0
+
+    def test_node_ports_conflict(self):
+        existing = make_pod("e").host_port(8080).obj()
+        node_info = ni(make_node("n1").capacity({"pods": 10}).obj(), existing)
+        st = run_filter(NodePorts(), make_pod().host_port(8080).obj(), node_info)
+        assert st.code == UNSCHEDULABLE
+        assert run_filter(NodePorts(), make_pod().host_port(8081).obj(), node_info).code == SUCCESS
+        # different protocol is no conflict
+        assert run_filter(NodePorts(), make_pod().host_port(8080, protocol="UDP").obj(), node_info).code == SUCCESS
+
+    def test_priority_sort(self):
+        ps = PrioritySort()
+        hi = QueuedPodInfo(pod=make_pod("hi").priority(10).obj(), timestamp=2.0)
+        lo = QueuedPodInfo(pod=make_pod("lo").priority(1).obj(), timestamp=1.0)
+        assert ps.less(hi, lo) and not ps.less(lo, hi)
+        first = QueuedPodInfo(pod=make_pod("first").priority(1).obj(), timestamp=0.5)
+        assert ps.less(first, lo)
+
+
+# ---------------------------------------------------------------- NodeAffinity
+
+
+class TestNodeAffinity:
+    def test_node_selector_map(self):
+        pod = make_pod().node_selector({"zone": "us-1"}).obj()
+        hit = ni(make_node("a").label("zone", "us-1").obj())
+        miss = ni(make_node("b").label("zone", "us-2").obj())
+        assert run_filter(NodeAffinity(), pod, hit).code == SUCCESS
+        st = run_filter(NodeAffinity(), pod, miss)
+        assert st.code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_required_terms_or(self):
+        pod = (
+            make_pod()
+            .node_affinity_in("zone", ["a"])
+            .obj()
+        )
+        # add a second OR term via wrapper
+        pod2 = make_pod().node_affinity_in("zone", ["a", "b"]).obj()
+        assert run_filter(NodeAffinity(), pod2, ni(make_node("n").label("zone", "b").obj())).code == SUCCESS
+        assert run_filter(NodeAffinity(), pod, ni(make_node("n").label("zone", "b").obj())).code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_not_in_missing_key_matches(self):
+        pod = make_pod().node_affinity_not_in("zone", ["bad"]).obj()
+        assert run_filter(NodeAffinity(), pod, ni(make_node("n").obj())).code == SUCCESS
+
+    def test_preferred_scoring(self):
+        na = NodeAffinity()
+        pod = make_pod().preferred_node_affinity(5, "zone", ["a"]).preferred_node_affinity(3, "disk", ["ssd"]).obj()
+        state = CycleState()
+        na.pre_score(state, pod, [])
+        both = ni(make_node("both").label("zone", "a").label("disk", "ssd").obj())
+        one = ni(make_node("one").label("zone", "a").obj())
+        none = ni(make_node("none").obj())
+        s_both, _ = na.score_node(state, pod, both)
+        s_one, _ = na.score_node(state, pod, one)
+        s_none, _ = na.score_node(state, pod, none)
+        assert (s_both, s_one, s_none) == (8, 5, 0)
+        scores = [NodeScore("both", s_both), NodeScore("one", s_one), NodeScore("none", s_none)]
+        na.normalize_score(state, pod, scores)
+        assert [s.score for s in scores] == [100, 62, 0]
+
+
+# ---------------------------------------------------------------- PodTopologySpread
+
+
+class TestPodTopologySpread:
+    def make_cluster(self):
+        nodes = [
+            make_node(f"n{i}").label("zone", f"z{i % 2}").obj() for i in range(4)
+        ]
+        infos = {n.meta.name: NodeInfo(n) for n in nodes}
+        return nodes, infos
+
+    def test_filter_max_skew(self):
+        nodes, infos = self.make_cluster()
+        sel = LabelSelector(match_labels={"app": "x"})
+        # 2 matching pods in z0, 0 in z1
+        infos["n0"].add_pod(make_pod("p1").label("app", "x").obj())
+        infos["n2"].add_pod(make_pod("p2").label("app", "x").obj())
+        plugin = PodTopologySpread(snapshot_fn=lambda: list(infos.values()))
+        pod = make_pod("new").label("app", "x").spread_constraint(1, "zone", selector=sel).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        # z0 has 2, z1 has 0 -> min=0; placing in z0: 2+1-0=3 > 1 -> reject
+        assert plugin.filter(state, pod, infos["n0"]).code == UNSCHEDULABLE
+        # z1: 0+1-0 = 1 <= 1 -> ok
+        assert plugin.filter(state, pod, infos["n1"]).code == SUCCESS
+
+    def test_filter_missing_label_unresolvable(self):
+        nodes, infos = self.make_cluster()
+        bare = NodeInfo(make_node("bare").obj())
+        infos["bare"] = bare
+        sel = LabelSelector(match_labels={"app": "x"})
+        plugin = PodTopologySpread(snapshot_fn=lambda: list(infos.values()))
+        pod = make_pod("new").label("app", "x").spread_constraint(1, "zone", selector=sel).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        assert plugin.filter(state, pod, bare).code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_score_prefers_less_loaded_domain(self):
+        nodes, infos = self.make_cluster()
+        sel = LabelSelector(match_labels={"app": "x"})
+        infos["n0"].add_pod(make_pod("p1").label("app", "x").obj())
+        infos["n0"].add_pod(make_pod("p2").label("app", "x").obj())
+        plugin = PodTopologySpread(snapshot_fn=lambda: list(infos.values()))
+        pod = make_pod("new").label("app", "x").spread_constraint(1, "zone", "ScheduleAnyway", selector=sel).obj()
+        state = CycleState()
+        plugin.pre_score(state, pod, nodes)
+        raw = {}
+        for name, info in infos.items():
+            raw[name], _ = plugin.score_node(state, pod, info)
+        scores = [NodeScore(n, raw[n]) for n in raw]
+        plugin.normalize_score(state, pod, scores)
+        by_name = {s.name: s.score for s in scores}
+        # z1 nodes (n1, n3) strictly preferred over z0 nodes
+        assert by_name["n1"] > by_name["n0"]
+        assert by_name["n1"] == by_name["n3"] == 100
+
+
+# ---------------------------------------------------------------- InterPodAffinity
+
+
+class TestInterPodAffinity:
+    def setup_cluster(self):
+        n0 = make_node("n0").label("zone", "z0").obj()
+        n1 = make_node("n1").label("zone", "z1").obj()
+        infos = {"n0": NodeInfo(n0), "n1": NodeInfo(n1)}
+        return infos
+
+    def test_required_affinity(self):
+        infos = self.setup_cluster()
+        infos["n0"].add_pod(make_pod("svc").label("app", "db").obj())
+        plugin = InterPodAffinity(snapshot_fn=lambda: list(infos.values()))
+        pod = make_pod("new").pod_affinity("zone", LabelSelector(match_labels={"app": "db"})).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        assert plugin.filter(state, pod, infos["n0"]).code == SUCCESS
+        # unsatisfied required affinity is Unresolvable (filtering.go:379):
+        # evicting pods cannot make it schedulable
+        assert plugin.filter(state, pod, infos["n1"]).code == UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_first_pod_self_match(self):
+        infos = self.setup_cluster()
+        plugin = InterPodAffinity(snapshot_fn=lambda: list(infos.values()))
+        pod = make_pod("new").label("app", "db").pod_affinity("zone", LabelSelector(match_labels={"app": "db"})).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        assert plugin.filter(state, pod, infos["n0"]).code == SUCCESS
+
+    def test_anti_affinity(self):
+        infos = self.setup_cluster()
+        infos["n0"].add_pod(make_pod("svc").label("app", "db").obj())
+        plugin = InterPodAffinity(snapshot_fn=lambda: list(infos.values()))
+        pod = make_pod("new").pod_affinity("zone", LabelSelector(match_labels={"app": "db"}), anti=True).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        assert plugin.filter(state, pod, infos["n0"]).code == UNSCHEDULABLE
+        assert plugin.filter(state, pod, infos["n1"]).code == SUCCESS
+
+    def test_existing_pods_anti_affinity(self):
+        infos = self.setup_cluster()
+        guard = make_pod("guard").label("app", "guard").pod_affinity(
+            "zone", LabelSelector(match_labels={"app": "web"}), anti=True
+        ).obj()
+        infos["n0"].add_pod(guard)
+        plugin = InterPodAffinity(snapshot_fn=lambda: list(infos.values()))
+        pod = make_pod("new").label("app", "web").obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod)
+        assert plugin.filter(state, pod, infos["n0"]).code == UNSCHEDULABLE
+        assert plugin.filter(state, pod, infos["n1"]).code == SUCCESS
+
+    def test_preferred_scoring(self):
+        infos = self.setup_cluster()
+        infos["n0"].add_pod(make_pod("svc").label("app", "db").obj())
+        plugin = InterPodAffinity(snapshot_fn=lambda: list(infos.values()))
+        pod = make_pod("new").preferred_pod_affinity(10, "zone", LabelSelector(match_labels={"app": "db"})).obj()
+        state = CycleState()
+        plugin.pre_score(state, pod, [])
+        s0, _ = plugin.score_node(state, pod, infos["n0"])
+        s1, _ = plugin.score_node(state, pod, infos["n1"])
+        assert s0 == 10 and s1 == 0
+        scores = [NodeScore("n0", s0), NodeScore("n1", s1)]
+        plugin.normalize_score(state, pod, scores)
+        assert scores[0].score == 100 and scores[1].score == 0
