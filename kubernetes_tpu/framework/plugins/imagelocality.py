@@ -33,10 +33,14 @@ def normalized_image_name(name: str) -> str:
 
 
 class _SpreadState:
-    __slots__ = ("num_nodes_with_image", "total_nodes")
+    __slots__ = ("num_nodes_with_image", "sizes", "total_nodes")
 
-    def __init__(self, num_nodes_with_image: Dict[str, int], total_nodes: int):
+    def __init__(self, num_nodes_with_image: Dict[str, int], sizes: Dict[str, int], total_nodes: int):
         self.num_nodes_with_image = num_nodes_with_image
+        # one global size per image name, first occurrence wins — mirrors the
+        # scheduler cache's imageStates map (internal/cache/cache.go
+        # addNodeImageStates), which the summary Size comes from
+        self.sizes = sizes
         self.total_nodes = total_nodes
 
     def clone(self):
@@ -55,12 +59,14 @@ class ImageLocality(PreScorePlugin, ScorePlugin):
 
     def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
         spread: Dict[str, int] = {}
+        sizes: Dict[str, int] = {}
         # without a snapshot there is no image-spread information: score 0s
         node_infos: List[NodeInfo] = self.snapshot_fn() if self.snapshot_fn else []
         for ni in node_infos:
-            for img in ni.image_states:
+            for img, size in ni.image_states.items():
                 spread[img] = spread.get(img, 0) + 1
-        state.write(self.STATE_KEY, _SpreadState(spread, max(1, len(node_infos))))
+                sizes.setdefault(img, size)
+        state.write(self.STATE_KEY, _SpreadState(spread, sizes, max(1, len(node_infos))))
         return OK
 
     def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
@@ -68,9 +74,10 @@ class ImageLocality(PreScorePlugin, ScorePlugin):
         total = 0
         for c in pod.spec.containers:
             img = normalized_image_name(c.image)
-            size = node_info.image_states.get(img, node_info.image_states.get(c.image))
-            if size:
-                total += size * s.num_nodes_with_image.get(img, s.num_nodes_with_image.get(c.image, 1)) // s.total_nodes
+            if img not in node_info.image_states and c.image not in node_info.image_states:
+                continue
+            size = s.sizes.get(img, s.sizes.get(c.image, 0))
+            total += size * s.num_nodes_with_image.get(img, s.num_nodes_with_image.get(c.image, 0)) // s.total_nodes
         return self._calculate_priority(total, len(pod.spec.containers)), OK
 
     @staticmethod

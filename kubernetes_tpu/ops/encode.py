@@ -1,0 +1,469 @@
+"""Host-side compiler: API objects → dense device tensors.
+
+The ClusterEncoder owns every vocabulary (label keys, per-key value vocabs,
+ports, images, scalar resources, node slots) and produces:
+
+  * per-node rows (``encode_node_row``) / full snapshots (``encode_snapshot``)
+    following the NodeTensors schema;
+  * compiled pod batches (``encode_pods``): a deduplicated ExprTable (the
+    batch's unique selector expressions) plus per-pod programs indexing it.
+
+String semantics compiled here, evaluated on device (SURVEY.md §7 "hard parts"
+#1):
+  - label selector expressions → (op, key-slot, value-id-set bitset);
+  - nodeSelector maps → AND-combined single-value IN exprs;
+  - metadata.name matchFields → OP_NODE_NAME on the node-slot axis;
+  - tolerations → (key-id, value-id, op, effect) rows;
+  - host ports → exact wildcard-IP conflict semantics with two vocab bits per
+    used port: ("*", proto, port) marks "any IP uses proto/port", and the
+    concrete (ip, proto, port) bit preserves IP-specific matching
+    (framework/types.go HostPortInfo).
+
+Vocab ids are append-only; id 0 = absent everywhere.  Encoders raise
+CapacityError when a static capacity is exceeded — callers re-encode with
+``Capacities.grow_*`` (the recompilation policy lives in backend/, not here).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import resource as resource_api
+from ..api.types import (
+    EXISTS,
+    DOES_NOT_EXIST,
+    GT,
+    IN,
+    LT,
+    NOT_IN,
+    Pod,
+    Requirement,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    TAINT_PREFER_NO_SCHEDULE,
+    Taint,
+    TOLERATION_OP_EXISTS,
+)
+from ..framework.types import NodeInfo, nonzero_request
+from ..utils.vocab import Vocab
+from . import schema
+from .schema import Capacities, INT_NONE
+
+_EFFECT_CODE = {
+    "": schema.EFFECT_NONE,
+    TAINT_NO_SCHEDULE: schema.EFFECT_NO_SCHEDULE,
+    TAINT_PREFER_NO_SCHEDULE: schema.EFFECT_PREFER_NO_SCHEDULE,
+    TAINT_NO_EXECUTE: schema.EFFECT_NO_EXECUTE,
+}
+
+_UNSCHEDULABLE_TAINT = Taint(key="node.kubernetes.io/unschedulable", effect=TAINT_NO_SCHEDULE)
+
+
+class CapacityError(Exception):
+    """A static tensor capacity was exceeded; re-encode with larger Capacities."""
+
+    def __init__(self, dimension: str, needed: int, capacity: int):
+        self.dimension = dimension
+        self.needed = needed
+        self.capacity = capacity
+        super().__init__(f"capacity exceeded: {dimension} needs {needed} > {capacity}")
+
+
+class ClusterEncoder:
+    def __init__(self, caps: Capacities):
+        self.caps = caps
+        self.key_vocab = Vocab("label-keys")          # key string -> key slot (1-based, < K)
+        self.value_vocabs: Dict[int, Vocab] = {}      # key slot -> value vocab
+        self.port_vocab = Vocab("ports")              # (ip|'*', proto, port) -> id
+        self.image_vocab = Vocab("images")
+        self.scalar_vocab = Vocab("scalar-resources")
+        self.node_slots: Dict[str, int] = {}          # node name -> slot
+        self._free_slots: List[int] = []
+
+    # ------------------------------------------------------------- vocab plumbing
+
+    def key_slot(self, key: str) -> int:
+        slot = self.key_vocab.id(key)
+        if slot >= self.caps.label_keys:
+            raise CapacityError("label_keys", slot + 1, self.caps.label_keys)
+        return slot
+
+    def value_id(self, key: str, value: str) -> int:
+        ks = self.key_slot(key)
+        vv = self.value_vocabs.setdefault(ks, Vocab(f"values[{key}]"))
+        vid = vv.id(value)
+        if vid >= self.caps.value_words * 32:
+            raise CapacityError(f"value vocab for {key!r}", vid + 1, self.caps.value_words * 32)
+        return vid
+
+    def scalar_col(self, resource: str) -> int:
+        col = schema.N_FIXED_COLS + self.scalar_vocab.id(resource) - 1
+        if col >= self.caps.resources:
+            raise CapacityError("resources", col + 1, self.caps.resources)
+        return col
+
+    def resource_col(self, resource: str) -> int:
+        fixed = {
+            resource_api.CPU: schema.COL_CPU,
+            resource_api.MEMORY: schema.COL_MEM,
+            resource_api.EPHEMERAL_STORAGE: schema.COL_EPH,
+            resource_api.PODS: schema.COL_PODS,
+        }
+        if resource in fixed:
+            return fixed[resource]
+        return self.scalar_col(resource)
+
+    def port_id(self, ip: str, proto: str, port: int) -> int:
+        pid = self.port_vocab.id((ip, proto, port))
+        if pid >= self.caps.port_words * 32:
+            raise CapacityError("ports vocab", pid + 1, self.caps.port_words * 32)
+        return pid
+
+    def image_id(self, name: str) -> int:
+        iid = self.image_vocab.id(name)
+        if iid >= self.caps.images:
+            raise CapacityError("image vocab", iid + 1, self.caps.images)
+        return iid
+
+    def node_slot(self, name: str) -> int:
+        slot = self.node_slots.get(name)
+        if slot is None:
+            slot = self._free_slots.pop() if self._free_slots else len(self.node_slots)
+            # slots are dense; a freed slot is reused before extending
+            used = set(self.node_slots.values())
+            if slot in used:  # freed-list raced with dense growth; find a hole
+                slot = next(i for i in range(self.caps.nodes + 1) if i not in used)
+            if slot >= self.caps.nodes:
+                raise CapacityError("nodes", slot + 1, self.caps.nodes)
+            self.node_slots[name] = slot
+        return slot
+
+    def release_node_slot(self, name: str) -> Optional[int]:
+        slot = self.node_slots.pop(name, None)
+        if slot is not None:
+            self._free_slots.append(slot)
+        return slot
+
+    # ------------------------------------------------------------- resources
+
+    def resource_vec(self, m: Dict[str, int]) -> np.ndarray:
+        v = np.zeros(self.caps.resources, np.int32)
+        for rname, val in m.items():
+            v[self.resource_col(rname)] = min(val, 2**31 - 1)
+        return v
+
+    # ------------------------------------------------------------- node rows
+
+    def encode_node_row(self, ni: NodeInfo) -> Dict[str, np.ndarray]:
+        """One NodeTensors row (no slot assignment here)."""
+        caps = self.caps
+        node = ni.node
+        row: Dict[str, np.ndarray] = {}
+        row["valid"] = np.array(node is not None)
+        row["unschedulable"] = np.array(bool(node and node.spec.unschedulable))
+        row["allocatable"] = self.resource_vec(ni.allocatable.as_map())
+        req = ni.requested.as_map()
+        req[resource_api.PODS] = len(ni.pods)
+        row["requested"] = self.resource_vec(req)
+        nzreq = ni.non_zero_requested.as_map()
+        nzreq[resource_api.PODS] = len(ni.pods)
+        row["nonzero_requested"] = self.resource_vec(nzreq)
+
+        label_val = np.zeros(caps.label_keys, np.int32)
+        label_num = np.full(caps.label_keys, INT_NONE, np.int32)
+        if node is not None:
+            for k, v in node.meta.labels.items():
+                ks = self.key_slot(k)
+                label_val[ks] = self.value_id(k, v)
+                try:
+                    label_num[ks] = np.int32(int(v))
+                except (ValueError, OverflowError):
+                    pass
+        row["label_val"] = label_val
+        row["label_num"] = label_num
+
+        tkey = np.zeros(caps.taints, np.int32)
+        tval = np.zeros(caps.taints, np.int32)
+        teff = np.zeros(caps.taints, np.int32)
+        taints = node.spec.taints if node is not None else ()
+        if len(taints) > caps.taints:
+            raise CapacityError("taints", len(taints), caps.taints)
+        for i, t in enumerate(taints):
+            tkey[i] = self.key_slot(t.key)
+            tval[i] = self.value_id(t.key, t.value)
+            teff[i] = _EFFECT_CODE[t.effect]
+        row["taint_key"], row["taint_val"], row["taint_effect"] = tkey, tval, teff
+
+        pbits = np.zeros(caps.port_words, np.uint32)
+        for (ip, proto, port) in ni.used_ports:
+            for pid in (self.port_id(ip, proto, port), self.port_id("*", proto, port)):
+                pbits[pid >> 5] |= np.uint32(1 << (pid & 31))
+        row["port_bits"] = pbits
+
+        ibits = np.zeros(caps.image_words, np.uint32)
+        for name in ni.image_states:
+            iid = self.image_id(name)
+            ibits[iid >> 5] |= np.uint32(1 << (iid & 31))
+        row["image_bits"] = ibits
+        return row
+
+    def image_vocab_arrays(self, node_infos: Sequence[NodeInfo]) -> Tuple[np.ndarray, np.ndarray]:
+        sizes = np.zeros(self.caps.images, np.int32)
+        num_nodes = np.zeros(self.caps.images, np.int32)
+        for ni in node_infos:
+            for name, size in ni.image_states.items():
+                iid = self.image_id(name)
+                if num_nodes[iid] == 0:  # first occurrence wins, even a 0 size
+                    sizes[iid] = min(size, 2**31 - 1)  # (cache.addNodeImageStates)
+                num_nodes[iid] += 1
+        return sizes, num_nodes
+
+    def encode_snapshot(self, node_infos: Sequence[NodeInfo]) -> "schema.NodeTensors":
+        """Full-snapshot encode (tests / resync path; the incremental path is
+        backend/device_state.py)."""
+        import jax.numpy as jnp
+
+        caps = self.caps
+        if len(node_infos) > caps.nodes:
+            raise CapacityError("nodes", len(node_infos), caps.nodes)
+        rows = []
+        for ni in node_infos:
+            self.node_slot(ni.node.meta.name)  # assign slots in order
+            rows.append(self.encode_node_row(ni))
+
+        def stack(field, dtype, shape_tail):
+            out = np.zeros((caps.nodes,) + shape_tail, dtype)
+            if field == "label_num":
+                out[:] = INT_NONE
+            for i, r in enumerate(rows):
+                out[self.node_slots[node_infos[i].node.meta.name]] = r[field]
+            return out
+
+        sizes, num_nodes = self.image_vocab_arrays(node_infos)
+        nt = schema.NodeTensors(
+            valid=jnp.asarray(stack("valid", bool, ())),
+            unschedulable=jnp.asarray(stack("unschedulable", bool, ())),
+            allocatable=jnp.asarray(stack("allocatable", np.int32, (caps.resources,))),
+            requested=jnp.asarray(stack("requested", np.int32, (caps.resources,))),
+            nonzero_requested=jnp.asarray(stack("nonzero_requested", np.int32, (caps.resources,))),
+            label_val=jnp.asarray(stack("label_val", np.int32, (caps.label_keys,))),
+            label_num=jnp.asarray(stack("label_num", np.int32, (caps.label_keys,))),
+            taint_key=jnp.asarray(stack("taint_key", np.int32, (caps.taints,))),
+            taint_val=jnp.asarray(stack("taint_val", np.int32, (caps.taints,))),
+            taint_effect=jnp.asarray(stack("taint_effect", np.int32, (caps.taints,))),
+            port_bits=jnp.asarray(stack("port_bits", np.uint32, (caps.port_words,))),
+            image_bits=jnp.asarray(stack("image_bits", np.uint32, (caps.image_words,))),
+            image_sizes=jnp.asarray(sizes),
+            image_num_nodes=jnp.asarray(num_nodes),
+        )
+        return nt
+
+    # ------------------------------------------------------------- expressions
+
+    def _expr_from_requirement(self, r: Requirement, builder: "_ExprBuilder") -> int:
+        ks = self.key_slot(r.key)
+        if r.operator == IN:
+            ids = frozenset(self.value_id(r.key, v) for v in r.values)
+            return builder.slot((schema.OP_IN, ks, 0, ids))
+        if r.operator == NOT_IN:
+            ids = frozenset(self.value_id(r.key, v) for v in r.values)
+            return builder.slot((schema.OP_NOT_IN, ks, 0, ids))
+        if r.operator == EXISTS:
+            return builder.slot((schema.OP_EXISTS, ks, 0, frozenset()))
+        if r.operator == DOES_NOT_EXIST:
+            return builder.slot((schema.OP_NOT_EXISTS, ks, 0, frozenset()))
+        if r.operator in (GT, LT):
+            try:
+                rhs = int(r.values[0])
+            except (ValueError, IndexError):
+                # unparseable Gt/Lt never matches (labels.NewRequirement errors)
+                return builder.slot((schema.OP_IN, ks, 0, frozenset()))
+            op = schema.OP_GT if r.operator == GT else schema.OP_LT
+            return builder.slot((op, ks, rhs, frozenset()))
+        raise ValueError(f"unknown operator {r.operator}")
+
+    # ------------------------------------------------------------- pod batch
+
+    def encode_pods(self, pods: Sequence[Pod]) -> Tuple["schema.PodBatch", "schema.ExprTable"]:
+        import jax.numpy as jnp
+
+        caps = self.caps
+        P = caps.pods
+        if len(pods) > P:
+            raise CapacityError("pods", len(pods), P)
+        builder = _ExprBuilder(caps)
+
+        valid = np.zeros(P, bool)
+        priority = np.zeros(P, np.int32)
+        req = np.zeros((P, caps.resources), np.int32)
+        nzreq = np.zeros((P, caps.resources), np.int32)
+        node_name = np.full(P, -1, np.int32)
+        tol_key = np.zeros((P, caps.tolerations), np.int32)
+        tol_val = np.zeros((P, caps.tolerations), np.int32)
+        tol_op = np.zeros((P, caps.tolerations), np.int32)
+        tol_effect = np.zeros((P, caps.tolerations), np.int32)
+        tol_prefer = np.zeros((P, caps.tolerations), bool)
+        tolerates_unsched = np.zeros(P, bool)
+        sel_idx = np.zeros((P, caps.sel_exprs), np.int32)
+        term_idx = np.zeros((P, caps.terms, caps.term_exprs), np.int32)
+        term_valid = np.zeros((P, caps.terms), bool)
+        pref_idx = np.zeros((P, caps.pref_terms, caps.term_exprs), np.int32)
+        pref_weight = np.zeros((P, caps.pref_terms), np.int32)
+        port_ids = np.zeros((P, caps.ports), np.int32)
+        image_ids = np.zeros((P, caps.containers), np.int32)
+        num_containers = np.zeros(P, np.int32)
+
+        for p, pod in enumerate(pods):
+            valid[p] = True
+            priority[p] = pod.spec.priority
+            r = pod.resource_request()
+            r[resource_api.PODS] = 1
+            req[p] = self.resource_vec(r)
+            nz = nonzero_request(pod.resource_request())
+            nz[resource_api.PODS] = 1
+            nzreq[p] = self.resource_vec(nz)
+            if pod.spec.node_name:
+                node_name[p] = self.node_slots.get(pod.spec.node_name, -2)  # -2: unknown ⇒ never matches
+
+            tols = pod.spec.tolerations
+            if len(tols) > caps.tolerations:
+                raise CapacityError("tolerations", len(tols), caps.tolerations)
+            for i, t in enumerate(tols):
+                tol_key[p, i] = self.key_slot(t.key) if t.key else 0
+                tol_op[p, i] = schema.TOL_EXISTS if t.operator == TOLERATION_OP_EXISTS else schema.TOL_EQUAL
+                if t.key and tol_op[p, i] == schema.TOL_EQUAL:
+                    tol_val[p, i] = self.value_id(t.key, t.value)
+                tol_effect[p, i] = _EFFECT_CODE[t.effect]
+                tol_prefer[p, i] = t.effect in ("", TAINT_PREFER_NO_SCHEDULE)
+            tolerates_unsched[p] = any(t.tolerates(_UNSCHEDULABLE_TAINT) for t in tols)
+
+            # nodeSelector map → AND of single-value IN exprs
+            sel = list(pod.spec.node_selector.items())
+            if len(sel) > caps.sel_exprs:
+                raise CapacityError("sel_exprs", len(sel), caps.sel_exprs)
+            for i, (k, v) in enumerate(sel):
+                sel_idx[p, i] = self._expr_from_requirement(Requirement(k, IN, (v,)), builder)
+
+            # required node affinity terms
+            a = pod.spec.affinity
+            terms = ()
+            if a and a.node_affinity and a.node_affinity.required:
+                terms = a.node_affinity.required.terms
+            if len(terms) > caps.terms:
+                raise CapacityError("terms", len(terms), caps.terms)
+            for t_i, term in enumerate(terms):
+                n_exprs = len(term.match_expressions) + (term.match_fields_name is not None)
+                if n_exprs > caps.term_exprs:
+                    raise CapacityError("term_exprs", n_exprs, caps.term_exprs)
+                term_valid[p, t_i] = True
+                e_i = 0
+                if not term.match_expressions and term.match_fields_name is None:
+                    # empty term matches nothing (nodeaffinity semantics)
+                    term_idx[p, t_i, 0] = builder.never_slot()
+                    continue
+                for r_ in term.match_expressions:
+                    term_idx[p, t_i, e_i] = self._expr_from_requirement(r_, builder)
+                    e_i += 1
+                if term.match_fields_name is not None:
+                    tgt = self.node_slots.get(term.match_fields_name, -2)
+                    term_idx[p, t_i, e_i] = builder.slot((schema.OP_NODE_NAME, 0, tgt, frozenset()))
+
+            # preferred node affinity
+            prefs = list(a.node_affinity.preferred) if a and a.node_affinity else []
+            if len(prefs) > caps.pref_terms:
+                raise CapacityError("pref_terms", len(prefs), caps.pref_terms)
+            for t_i, wterm in enumerate(prefs):
+                pref_weight[p, t_i] = wterm.weight
+                term = wterm.preference
+                if not term.match_expressions and term.match_fields_name is None:
+                    pref_idx[p, t_i, 0] = builder.never_slot()
+                    continue
+                e_i = 0
+                for r_ in term.match_expressions:
+                    pref_idx[p, t_i, e_i] = self._expr_from_requirement(r_, builder)
+                    e_i += 1
+                if term.match_fields_name is not None:
+                    tgt = self.node_slots.get(term.match_fields_name, -2)
+                    pref_idx[p, t_i, e_i] = builder.slot((schema.OP_NODE_NAME, 0, tgt, frozenset()))
+
+            # host ports: specific IP wants (ip,…) OR (0.0.0.0,…); wildcard wants ("*",…)
+            wanted: List[int] = []
+            for cp in pod.host_ports():
+                ip = cp.host_ip or "0.0.0.0"
+                if ip == "0.0.0.0":
+                    wanted.append(self.port_id("*", cp.protocol, cp.host_port))
+                else:
+                    wanted.append(self.port_id(ip, cp.protocol, cp.host_port))
+                    wanted.append(self.port_id("0.0.0.0", cp.protocol, cp.host_port))
+            if len(wanted) > caps.ports:
+                raise CapacityError("ports", len(wanted), caps.ports)
+            port_ids[p, : len(wanted)] = wanted
+
+            # container images (lookup only: an image on no node scores 0)
+            from ..framework.plugins.imagelocality import normalized_image_name
+
+            imgs = [self.image_vocab.lookup(normalized_image_name(c.image)) for c in pod.spec.containers]
+            if len(imgs) > caps.containers:
+                raise CapacityError("containers", len(imgs), caps.containers)
+            image_ids[p, : len(imgs)] = imgs
+            num_containers[p] = len(pod.spec.containers)
+
+        batch = schema.PodBatch(
+            valid=jnp.asarray(valid),
+            priority=jnp.asarray(priority),
+            req=jnp.asarray(req),
+            nonzero_req=jnp.asarray(nzreq),
+            node_name=jnp.asarray(node_name),
+            tol_key=jnp.asarray(tol_key),
+            tol_val=jnp.asarray(tol_val),
+            tol_op=jnp.asarray(tol_op),
+            tol_effect=jnp.asarray(tol_effect),
+            tol_prefer=jnp.asarray(tol_prefer),
+            tolerates_unschedulable=jnp.asarray(tolerates_unsched),
+            sel_idx=jnp.asarray(sel_idx),
+            term_idx=jnp.asarray(term_idx),
+            term_valid=jnp.asarray(term_valid),
+            pref_idx=jnp.asarray(pref_idx),
+            pref_weight=jnp.asarray(pref_weight),
+            port_ids=jnp.asarray(port_ids),
+            image_ids=jnp.asarray(image_ids),
+            num_containers=jnp.asarray(num_containers),
+        )
+        return batch, builder.table()
+
+
+class _ExprBuilder:
+    """Dedup unique expressions into ExprTable slots. Slot 0 = OP_TRUE."""
+
+    def __init__(self, caps: Capacities):
+        self.caps = caps
+        self._slots: Dict[Tuple, int] = {(schema.OP_TRUE, 0, 0, frozenset()): 0}
+
+    def slot(self, key: Tuple) -> int:
+        s = self._slots.get(key)
+        if s is None:
+            s = len(self._slots)
+            if s >= self.caps.exprs:
+                raise CapacityError("exprs", s + 1, self.caps.exprs)
+            self._slots[key] = s
+        return s
+
+    def never_slot(self) -> int:
+        # IN with an empty value set matches nothing
+        return self.slot((schema.OP_IN, 0, 0, frozenset()))
+
+    def table(self) -> "schema.ExprTable":
+        import jax.numpy as jnp
+
+        E = self.caps.exprs
+        op = np.zeros(E, np.int32)
+        key = np.zeros(E, np.int32)
+        val = np.zeros(E, np.int32)
+        bits = np.zeros((E, self.caps.value_words), np.uint32)
+        for (o, k, v, ids), s in self._slots.items():
+            op[s], key[s], val[s] = o, k, v
+            for vid in ids:
+                bits[s, vid >> 5] |= np.uint32(1 << (vid & 31))
+        return schema.ExprTable(op=jnp.asarray(op), key=jnp.asarray(key), val=jnp.asarray(val), bits=jnp.asarray(bits))

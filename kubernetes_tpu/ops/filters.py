@@ -1,0 +1,148 @@
+"""Batched filter kernels: [P pods, N nodes] boolean predicates.
+
+Each kernel mirrors one oracle Filter plugin (framework/plugins/*) evaluated
+for the whole pod batch × node snapshot at once — the tensorization of the
+reference's per-(pod,node) Filter calls (schedule_one.go:449
+findNodesThatPassFilters runs them node-parallel; here pod×node-parallel).
+
+All kernels are shape-polymorphic pure functions of (PodBatch, ExprTable,
+NodeTensors); everything is gather-based — no O(P·N·V) intermediates.
+Filter short-circuit semantics become mask ANDs (SURVEY.md §8 last bullet):
+the accept set is identical; first-failing-plugin attribution is
+reconstructed host-side from the per-plugin masks when a pod fails.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import schema
+from .schema import ExprTable, NodeTensors, PodBatch
+
+
+def eval_exprs(et: ExprTable, nt: NodeTensors) -> jax.Array:
+    """Evaluate the batch's unique selector expressions → [E, N] bool."""
+    vals = nt.label_val[:, et.key].T       # [E, N] value-id of node for expr's key
+    nums = nt.label_num[:, et.key].T       # [E, N]
+    # IN-set membership: bit `vals` of et.bits[e]
+    word = jnp.take_along_axis(et.bits, (vals >> 5).astype(jnp.int32), axis=1)
+    in_set = ((word >> (vals & 31).astype(jnp.uint32)) & 1).astype(bool)
+
+    has_key = vals > 0
+    has_num = nums != schema.INT_NONE
+    op = et.op[:, None]
+    val = et.val[:, None]
+    n_idx = jnp.arange(nt.capacity, dtype=jnp.int32)[None, :]
+
+    out = jnp.ones_like(in_set)  # OP_TRUE
+    out = jnp.where(op == schema.OP_IN, in_set, out)
+    out = jnp.where(op == schema.OP_NOT_IN, ~in_set, out)
+    out = jnp.where(op == schema.OP_EXISTS, has_key, out)
+    out = jnp.where(op == schema.OP_NOT_EXISTS, ~has_key, out)
+    out = jnp.where(op == schema.OP_GT, has_num & (nums > val), out)
+    out = jnp.where(op == schema.OP_LT, has_num & (nums < val), out)
+    out = jnp.where(op == schema.OP_NODE_NAME, n_idx == val, out)
+    return out
+
+
+def eval_and_program(expr_match: jax.Array, idx: jax.Array) -> jax.Array:
+    """AND over expr slots (slot 0 = TRUE is the neutral pad). idx [P,S] → [P,N]."""
+    return jnp.all(expr_match[idx], axis=1)
+
+
+def eval_term_program(expr_match: jax.Array, term_idx: jax.Array, term_valid: jax.Array) -> jax.Array:
+    """OR over valid terms of AND over each term's exprs; no valid terms ⇒ True.
+    term_idx [P,T,E'] → [P,N]. (NodeSelector term OR-semantics.)"""
+    per_term = jnp.all(expr_match[term_idx], axis=2)          # [P, T, N]
+    any_term = jnp.any(per_term & term_valid[:, :, None], axis=1)
+    has_terms = jnp.any(term_valid, axis=1)
+    return jnp.where(has_terms[:, None], any_term, True)
+
+
+# --------------------------------------------------------------------- filters
+
+
+def filter_node_resources_fit(pb: PodBatch, nt: NodeTensors) -> jax.Array:
+    """NodeResourcesFit (noderesources/fit.go:252 fitsRequest): per resource
+    `req ≤ allocatable − requested`, zero requests skip the check (the pod-count
+    column always requests 1, giving the `len(pods)+1 > allowed` check)."""
+    free = nt.allocatable - nt.requested                       # [N, R]
+    req = pb.req[:, None, :]                                   # [P, 1, R]
+    ok = (req <= free[None]) | (req == 0)
+    return jnp.all(ok, axis=-1)
+
+
+def filter_node_name(pb: PodBatch, nt: NodeTensors) -> jax.Array:
+    n_idx = jnp.arange(nt.capacity, dtype=jnp.int32)[None, :]
+    want = pb.node_name[:, None]
+    return (want == -1) | (want == n_idx)
+
+
+def filter_unschedulable(pb: PodBatch, nt: NodeTensors) -> jax.Array:
+    return (~nt.unschedulable)[None, :] | pb.tolerates_unschedulable[:, None]
+
+
+def _taint_tolerated(pb: PodBatch, nt: NodeTensors, tol_mask: jax.Array) -> jax.Array:
+    """tolerated[p, n, t] = any toleration (restricted by tol_mask [P,L])
+    tolerates node n's taint t (Toleration.ToleratesTaint semantics)."""
+    tk = nt.taint_key[None, :, :, None]      # [1, N, T, 1]
+    tv = nt.taint_val[None, :, :, None]
+    te = nt.taint_effect[None, :, :, None]
+    lk = pb.tol_key[:, None, None, :]        # [P, 1, 1, L]
+    lv = pb.tol_val[:, None, None, :]
+    lo = pb.tol_op[:, None, None, :]
+    le = pb.tol_effect[:, None, None, :]
+    key_ok = (lk == 0) | (lk == tk)
+    eff_ok = (le == schema.EFFECT_NONE) | (le == te)
+    val_ok = (lo == schema.TOL_EXISTS) | ((lo == schema.TOL_EQUAL) & (lv == tv) & (lk == tk))
+    live = (lo != 0) & tol_mask[:, None, None, :]
+    return jnp.any(key_ok & eff_ok & val_ok & live, axis=-1)   # [P, N, T]
+
+
+def filter_taints(pb: PodBatch, nt: NodeTensors) -> jax.Array:
+    """TaintToleration Filter: every NoSchedule/NoExecute taint tolerated."""
+    all_tols = jnp.ones_like(pb.tol_prefer)
+    tolerated = _taint_tolerated(pb, nt, all_tols)
+    relevant = (nt.taint_effect == schema.EFFECT_NO_SCHEDULE) | (
+        nt.taint_effect == schema.EFFECT_NO_EXECUTE
+    )                                                          # [N, T]
+    bad = relevant[None] & (nt.taint_key > 0)[None] & ~tolerated
+    return ~jnp.any(bad, axis=-1)
+
+
+def filter_node_affinity(pb: PodBatch, et: ExprTable, nt: NodeTensors, expr_match=None) -> jax.Array:
+    """NodeAffinity Filter: nodeSelector map AND required terms."""
+    if expr_match is None:
+        expr_match = eval_exprs(et, nt)
+    sel_ok = eval_and_program(expr_match, pb.sel_idx)
+    aff_ok = eval_term_program(expr_match, pb.term_idx, pb.term_valid)
+    return sel_ok & aff_ok
+
+
+def filter_node_ports(pb: PodBatch, nt: NodeTensors) -> jax.Array:
+    """NodePorts: no wanted-port vocab bit set on the node (wildcard-exact)."""
+    ids = pb.port_ids                                          # [P, MP]
+    word = nt.port_bits[:, ids >> 5]                           # [N, P, MP]
+    bit = ((word >> (ids & 31).astype(jnp.uint32)) & 1).astype(bool)
+    conflict = jnp.any(bit & (ids > 0)[None], axis=-1)         # [N, P]
+    return ~conflict.T
+
+
+def run_all_filters(pb: PodBatch, et: ExprTable, nt: NodeTensors) -> dict:
+    """All per-(pod,node) filter masks + the combined feasibility mask.
+    Returned per plugin so host code can attribute failures in config order
+    (Diagnosis.NodeToStatusMap reconstruction)."""
+    expr_match = eval_exprs(et, nt)
+    masks = {
+        "NodeUnschedulable": filter_unschedulable(pb, nt),
+        "NodeName": filter_node_name(pb, nt),
+        "TaintToleration": filter_taints(pb, nt),
+        "NodeAffinity": filter_node_affinity(pb, et, nt, expr_match),
+        "NodePorts": filter_node_ports(pb, nt),
+        "NodeResourcesFit": filter_node_resources_fit(pb, nt),
+    }
+    feasible = nt.valid[None, :] & pb.valid[:, None]
+    for m in masks.values():
+        feasible = feasible & m
+    return {"masks": masks, "feasible": feasible, "expr_match": expr_match}

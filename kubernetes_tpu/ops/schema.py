@@ -1,0 +1,164 @@
+"""Dense tensor schemas for the device-resident cluster mirror.
+
+Design notes (why this is NOT a transliteration of NodeInfo):
+
+* Nodes live in fixed **slots** (stable indices into the N axis); all arrays are
+  padded to static capacities so one compiled program serves the whole run.
+  Slot 0 is always invalid padding (vocab-id convention), real slots start at 1?
+  No — slots are 0-based with an explicit ``valid`` mask; id-like *vocab*
+  columns reserve 0 for "absent".
+
+* Labels are encoded as a dense per-registered-key table instead of bitsets:
+  ``label_val[N, K]`` holds the value-id of node n for key k (per-key value
+  vocab, 0 = absent) and ``label_num[N, K]`` its integer parse (INT_MIN when
+  not numeric).  Selector matching then becomes *gathers*, not giant bit
+  intersections: each scheduling batch compiles its unique selector
+  expressions into an ExprTable evaluated once as an [E, N] bool matrix.
+
+* Resource vectors are int32 with canonical units (api/resource.py):
+  col 0 = cpu milli, 1 = memory KiB, 2 = ephemeral MiB, 3 = pod count /
+  allowed pods, 4.. = scalar resources by scalar-vocab slot.
+
+Reference mapping: framework/types.go:363 NodeInfo → NodeTensors row;
+snapshot (internal/cache/snapshot.go) → the whole NodeTensors value.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+
+INT_NONE = np.int32(-(2**31))  # sentinel for "absent" numeric label
+
+# resource columns
+COL_CPU = 0
+COL_MEM = 1
+COL_EPH = 2
+COL_PODS = 3
+N_FIXED_COLS = 4
+
+# expression opcodes (the selector VM)
+OP_TRUE = 0       # constant true (slot 0 of every ExprTable; AND-neutral padding)
+OP_IN = 1         # label_val[n, key] ∈ value-id set (bitset over the key's value vocab)
+OP_NOT_IN = 2     # absent key matches (labels.Requirement semantics)
+OP_EXISTS = 3
+OP_NOT_EXISTS = 4
+OP_GT = 5         # int(label) > val; absent/non-numeric never matches
+OP_LT = 6
+OP_NODE_NAME = 7  # node slot == val (compiled metadata.name matchFields)
+
+# taint effects
+EFFECT_NONE = 0
+EFFECT_NO_SCHEDULE = 1
+EFFECT_PREFER_NO_SCHEDULE = 2
+EFFECT_NO_EXECUTE = 3
+
+# toleration operators
+TOL_EQUAL = 1
+TOL_EXISTS = 2
+
+
+def pytree_dataclass(cls):
+    cls = dataclasses.dataclass(cls)
+    fields = [f.name for f in dataclasses.fields(cls)]
+    jax.tree_util.register_dataclass(cls, data_fields=fields, meta_fields=[])
+    return cls
+
+
+@pytree_dataclass
+class NodeTensors:
+    """Device-resident per-node state, [N]-padded. The TPU mirror of the
+    scheduler cache snapshot."""
+
+    valid: jax.Array          # [N] bool
+    unschedulable: jax.Array  # [N] bool
+    allocatable: jax.Array    # [N, R] int32 (col PODS = allowed pod count)
+    requested: jax.Array      # [N, R] int32 (col PODS = current pod count)
+    nonzero_requested: jax.Array  # [N, R] int32 (scoring-path requests)
+    label_val: jax.Array      # [N, K] int32 value-id (0 absent)
+    label_num: jax.Array      # [N, K] int32 numeric parse (INT_NONE absent)
+    taint_key: jax.Array      # [N, T] int32 key-id (0 = no taint in slot)
+    taint_val: jax.Array      # [N, T] int32 value-id in key's vocab
+    taint_effect: jax.Array   # [N, T] int32 effect code
+    port_bits: jax.Array      # [N, Wport] uint32 bitset over the port vocab
+    image_bits: jax.Array     # [N, Wimg] uint32 bitset over the image vocab
+    image_sizes: jax.Array    # [Vimg] int32 bytes (vocab-level, not per node)
+    image_num_nodes: jax.Array  # [Vimg] int32 (ImageStateSummary.NumNodes)
+
+    @property
+    def capacity(self) -> int:
+        return self.valid.shape[0]
+
+
+@pytree_dataclass
+class ExprTable:
+    """Batch-level deduplicated selector expressions, evaluated once per batch
+    to an [E, N] match matrix. Slot 0 is OP_TRUE."""
+
+    op: jax.Array      # [E] int32 opcode
+    key: jax.Array     # [E] int32 label-key slot
+    val: jax.Array     # [E] int32 (GT/LT compare value or NODE_NAME slot)
+    bits: jax.Array    # [E, Wv] uint32 value-id set for IN/NOT_IN
+
+
+@pytree_dataclass
+class PodBatch:
+    """A micro-batch of pending pods, [P]-padded, with compiled programs
+    pointing into the batch ExprTable."""
+
+    valid: jax.Array        # [P] bool
+    priority: jax.Array     # [P] int32
+    req: jax.Array          # [P, R] int32 (filter-path request; col PODS == 1)
+    nonzero_req: jax.Array  # [P, R] int32 (scoring-path request)
+    node_name: jax.Array    # [P] int32 target slot or -1 (pod.spec.nodeName)
+    tol_key: jax.Array      # [P, L] int32 (0 = wildcard key)
+    tol_val: jax.Array      # [P, L] int32
+    tol_op: jax.Array       # [P, L] int32 (0 = empty slot)
+    tol_effect: jax.Array   # [P, L] int32 (EFFECT_NONE = matches all effects)
+    tol_prefer: jax.Array   # [P, L] bool: effect ∈ {"", PreferNoSchedule} (taint Score path)
+    tolerates_unschedulable: jax.Array  # [P] bool (precompiled for NodeUnschedulable)
+    # node selector + required affinity: AND(sel_idx) AND OR_t(AND_e(term))
+    sel_idx: jax.Array      # [P, S] int32 expr slots, AND-combined (0 = true)
+    term_idx: jax.Array     # [P, TERM, EXPR] int32 expr slots
+    term_valid: jax.Array   # [P, TERM] bool (no valid terms ⇒ affinity passes)
+    # preferred affinity (weights; invalid slots have weight 0)
+    pref_idx: jax.Array     # [P, PTERM, EXPR] int32
+    pref_weight: jax.Array  # [P, PTERM] int32
+    port_ids: jax.Array     # [P, MP] int32 wanted-port vocab ids (0 = empty)
+    image_ids: jax.Array    # [P, C] int32 container image vocab ids (0 = empty)
+    num_containers: jax.Array  # [P] int32
+
+    @property
+    def capacity(self) -> int:
+        return self.valid.shape[0]
+
+
+@dataclasses.dataclass(frozen=True)
+class Capacities:
+    """Static padding sizes; one compiled executable per Capacities value."""
+
+    nodes: int = 128          # N
+    pods: int = 64            # P
+    resources: int = 6        # R (4 fixed + scalar slots)
+    label_keys: int = 16      # K
+    taints: int = 4           # T per node
+    tolerations: int = 4      # L per pod
+    exprs: int = 64           # E per batch
+    sel_exprs: int = 8        # S per pod
+    terms: int = 4            # affinity terms per pod
+    term_exprs: int = 4       # exprs per term
+    pref_terms: int = 4       # preferred terms per pod
+    value_words: int = 32     # Wv: value-vocab bitset words (per-key vocab ≤ 32*Wv)
+    port_words: int = 16      # Wport
+    ports: int = 8            # MP wanted ports per pod
+    image_words: int = 16     # Wimg
+    images: int = 1 + 16 * 32  # Vimg (vocab capacity = image_words*32, +0 slot)
+    containers: int = 4       # C per pod
+
+    def grow_nodes(self, n: int) -> "Capacities":
+        cap = self.nodes
+        while cap < n:
+            cap *= 2
+        return dataclasses.replace(self, nodes=cap)

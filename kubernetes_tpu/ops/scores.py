@@ -1,0 +1,131 @@
+"""Batched score kernels: [P, N] float32 per plugin + normalization.
+
+Raw-score and NormalizeScore formulas per SURVEY.md §8.  The reference
+computes int64 scores; these kernels use float32 (TPU-native) with floor()
+where the reference floor-divides — parity tests allow ±1 on score values
+(float32 mantissa vs int64 exactness; the winner-selection impact is confined
+to exact ties, which selectHost breaks randomly anyway).
+
+Normalization runs over the *feasible* node set only (prioritizeNodes scores
+only filtered nodes, schedule_one.go:605).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import schema
+from .filters import _taint_tolerated, eval_exprs
+from .schema import ExprTable, NodeTensors, PodBatch
+
+MAX_NODE_SCORE = 100.0
+
+# default scoring resources: cpu + memory, weight 1 each (resource cols)
+DEFAULT_SCORE_COLS: Tuple[Tuple[int, float], ...] = ((schema.COL_CPU, 1.0), (schema.COL_MEM, 1.0))
+
+
+def _requested_with_pod(pb: PodBatch, nt: NodeTensors, col: int) -> jax.Array:
+    """node NonZeroRequested + incoming pod's nonzero request → [P, N] f32."""
+    return (
+        nt.nonzero_requested[None, :, col].astype(jnp.float32)
+        + pb.nonzero_req[:, None, col].astype(jnp.float32)
+    )
+
+
+def score_least_allocated(pb: PodBatch, nt: NodeTensors, cols=DEFAULT_SCORE_COLS) -> jax.Array:
+    """least_allocated.go:29: Σ w·floor((cap−req)·100/cap) / Σ w (0 when
+    req>cap or cap=0)."""
+    num = 0.0
+    den = 0.0
+    for col, w in cols:
+        cap = nt.allocatable[None, :, col].astype(jnp.float32)
+        req = _requested_with_pod(pb, nt, col)
+        s = jnp.floor((cap - req) * MAX_NODE_SCORE / jnp.maximum(cap, 1.0))
+        s = jnp.where((cap == 0) | (req > cap), 0.0, s)
+        num = num + w * s
+        den += w
+    return jnp.floor(num / den)
+
+
+def score_most_allocated(pb: PodBatch, nt: NodeTensors, cols=DEFAULT_SCORE_COLS) -> jax.Array:
+    num = 0.0
+    den = 0.0
+    for col, w in cols:
+        cap = nt.allocatable[None, :, col].astype(jnp.float32)
+        req = _requested_with_pod(pb, nt, col)
+        s = jnp.floor(req * MAX_NODE_SCORE / jnp.maximum(cap, 1.0))
+        s = jnp.where((cap == 0) | (req > cap), 0.0, s)
+        num = num + w * s
+        den += w
+    return jnp.floor(num / den)
+
+
+def score_balanced_allocation(pb: PodBatch, nt: NodeTensors, cols=DEFAULT_SCORE_COLS) -> jax.Array:
+    """balanced_allocation.go: (1 − std(fractions)) · 100, truncated."""
+    fracs = []
+    for col, _w in cols:
+        cap = nt.allocatable[None, :, col].astype(jnp.float32)
+        req = _requested_with_pod(pb, nt, col)
+        f = jnp.where(cap == 0, 1.0, jnp.minimum(1.0, req / jnp.maximum(cap, 1.0)))
+        fracs.append(f)
+    f = jnp.stack(fracs, axis=-1)                        # [P, N, C]
+    if f.shape[-1] == 2:
+        std = jnp.abs(f[..., 0] - f[..., 1]) / 2.0
+    else:
+        mean = jnp.mean(f, axis=-1, keepdims=True)
+        std = jnp.sqrt(jnp.mean((f - mean) ** 2, axis=-1))
+    return jnp.floor((1.0 - std) * MAX_NODE_SCORE)
+
+
+def score_taint_toleration(pb: PodBatch, nt: NodeTensors) -> jax.Array:
+    """Raw score: count of PreferNoSchedule taints NOT tolerated by the pod's
+    {empty, PreferNoSchedule}-effect tolerations (taint_toleration.go:147)."""
+    tolerated = _taint_tolerated(pb, nt, pb.tol_prefer)  # [P, N, T]
+    prefer = (nt.taint_effect == schema.EFFECT_PREFER_NO_SCHEDULE)[None]
+    bad = prefer & (nt.taint_key > 0)[None] & ~tolerated
+    return jnp.sum(bad, axis=-1).astype(jnp.float32)
+
+
+def score_node_affinity(pb: PodBatch, et: ExprTable, nt: NodeTensors, expr_match=None) -> jax.Array:
+    """Σ weights of matching preferred terms (node_affinity.go:260)."""
+    if expr_match is None:
+        expr_match = eval_exprs(et, nt)
+    per_term = jnp.all(expr_match[pb.pref_idx], axis=2)  # [P, PT, N]
+    w = pb.pref_weight[:, :, None].astype(jnp.float32)
+    return jnp.sum(per_term * w, axis=1)
+
+
+_MB = 1024.0 * 1024.0
+_MIN_THRESHOLD = 23.0 * _MB
+_MAX_CONTAINER_THRESHOLD = 1000.0 * _MB
+
+
+def score_image_locality(pb: PodBatch, nt: NodeTensors) -> jax.Array:
+    """imagelocality: Σ_present size·numNodes/totalNodes, clamped+scaled."""
+    ids = pb.image_ids                                   # [P, C]
+    word = nt.image_bits[:, ids >> 5]                    # [N, P, C]
+    present = ((word >> (ids & 31).astype(jnp.uint32)) & 1).astype(jnp.float32)
+    present = jnp.transpose(present, (1, 0, 2))          # [P, N, C]
+    total_nodes = jnp.maximum(jnp.sum(nt.valid), 1).astype(jnp.float32)
+    spread = nt.image_num_nodes[ids].astype(jnp.float32) / total_nodes  # [P, C]
+    contrib = jnp.floor(nt.image_sizes[ids].astype(jnp.float32) * spread)
+    sum_scores = jnp.sum(present * contrib[:, None, :], axis=-1)        # [P, N]
+    max_threshold = _MAX_CONTAINER_THRESHOLD * jnp.maximum(pb.num_containers, 1)[:, None].astype(jnp.float32)
+    clamped = jnp.clip(sum_scores, _MIN_THRESHOLD, max_threshold)
+    return jnp.floor(MAX_NODE_SCORE * (clamped - _MIN_THRESHOLD) / (max_threshold - _MIN_THRESHOLD))
+
+
+def normalize_default(raw: jax.Array, feasible: jax.Array, reverse: bool) -> jax.Array:
+    """helper.DefaultNormalizeScore over the feasible set per pod:
+    scale to [0,100], flip when reverse; all-zero max ⇒ 100s when reversed."""
+    masked = jnp.where(feasible, raw, 0.0)
+    max_score = jnp.max(masked, axis=1, keepdims=True)
+    scaled = jnp.floor(raw * MAX_NODE_SCORE / jnp.maximum(max_score, 1.0))
+    if reverse:
+        out = jnp.where(max_score == 0, MAX_NODE_SCORE, MAX_NODE_SCORE - scaled)
+    else:
+        out = jnp.where(max_score == 0, 0.0, scaled)
+    return out

@@ -1,0 +1,36 @@
+"""Host selection: weighted score sum + masked argmax with uniform tie-break.
+
+selectHost (schedule_one.go:709) picks argmax with a reservoir-sampled uniform
+tie-break.  The kernel equivalent adds U(0, 0.5) jitter to integer-valued
+scores (gap ≥ 1 between distinct totals), which is exactly "uniform among the
+maxima" — and deterministic under a fixed PRNG key for parity tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-(2.0**30))
+
+
+def weighted_total(scores: Dict[str, jax.Array], weights: Dict[str, float]) -> jax.Array:
+    """Σ_plugin weight · normalized-score (runtime/framework.go:951-966)."""
+    total = None
+    for name, s in scores.items():
+        w = weights.get(name, 1.0)
+        total = w * s if total is None else total + w * s
+    return total
+
+
+def select_host(total: jax.Array, feasible: jax.Array, key: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-pod winner: (node_idx [P] int32, best_score [P], any_feasible [P]).
+    node_idx is -1 for pods with no feasible node."""
+    jitter = jax.random.uniform(key, total.shape, jnp.float32, 0.0, 0.5)
+    eff = jnp.where(feasible, total + jitter, NEG_INF)
+    idx = jnp.argmax(eff, axis=1).astype(jnp.int32)
+    any_feasible = jnp.any(feasible, axis=1)
+    best = jnp.take_along_axis(total, idx[:, None], axis=1)[:, 0]
+    return jnp.where(any_feasible, idx, -1), best, any_feasible

@@ -1,0 +1,226 @@
+"""Oracle ↔ batched-kernel parity on randomized clusters.
+
+The scalar oracle plugins (tests/test_oracle_plugins.py pins them to reference
+semantics) are the ground truth; every batched filter mask must match exactly
+and every score within ±1 (float32 vs int64 arithmetic).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import LabelSelector, Requirement
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.framework.interface import CycleState, NodeScore
+from kubernetes_tpu.framework.types import NodeInfo
+from kubernetes_tpu.framework.plugins.basic import (
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    TaintToleration,
+)
+from kubernetes_tpu.framework.plugins.imagelocality import ImageLocality
+from kubernetes_tpu.framework.plugins.nodeaffinity import NodeAffinity
+from kubernetes_tpu.framework.plugins.noderesources import BalancedAllocation, Fit
+from kubernetes_tpu.ops import filters, scores, select
+from kubernetes_tpu.ops.encode import ClusterEncoder
+from kubernetes_tpu.ops.schema import Capacities
+
+ZONES = ["z0", "z1", "z2", "z3"]
+DISKS = ["ssd", "hdd"]
+IMAGES = [f"registry/app{i}:v1" for i in range(6)]
+
+
+def random_cluster(rng: random.Random, n_nodes: int):
+    infos = []
+    for i in range(n_nodes):
+        nw = (
+            make_node(f"node-{i}")
+            .capacity({
+                "cpu": rng.choice(["2", "4", "8", "16"]),
+                "memory": rng.choice(["4Gi", "8Gi", "32Gi"]),
+                "pods": rng.choice([3, 10, 110]),
+            })
+            .label("zone", rng.choice(ZONES))
+            .label("disk", rng.choice(DISKS))
+            .label("idx", str(i))
+        )
+        if rng.random() < 0.15:
+            nw.unschedulable()
+        for _ in range(rng.randint(0, 2)):
+            nw.taint(
+                rng.choice(["dedicated", "team"]),
+                rng.choice(["a", "b", ""]),
+                rng.choice(["NoSchedule", "PreferNoSchedule", "NoExecute"]),
+            )
+        for img in rng.sample(IMAGES, rng.randint(0, 3)):
+            nw.image(img, rng.randint(20, 900) * 1024 * 1024)
+        ni = NodeInfo(nw.obj())
+        for j in range(rng.randint(0, 3)):
+            pw = make_pod(f"existing-{i}-{j}").req(
+                {"cpu": rng.choice(["100m", "500m", "1"]), "memory": rng.choice(["64Mi", "1Gi"])}
+            )
+            if rng.random() < 0.3:
+                pw.host_port(rng.choice([80, 443, 8080]), rng.choice(["TCP", "UDP"]))
+            ni.add_pod(pw.obj())
+        infos.append(ni)
+    return infos
+
+
+def random_pods(rng: random.Random, n_pods: int, n_nodes: int):
+    pods = []
+    for i in range(n_pods):
+        pw = make_pod(f"pending-{i}").req(
+            {"cpu": rng.choice(["100m", "1", "2", "6"]), "memory": rng.choice(["128Mi", "1Gi", "16Gi"])}
+        ).priority(rng.randint(0, 10))
+        r = rng.random()
+        if r < 0.15:
+            pw.node_selector({"disk": rng.choice(DISKS)})
+        elif r < 0.3:
+            pw.node_affinity_in("zone", rng.sample(ZONES, rng.randint(1, 2)))
+        elif r < 0.4:
+            pw.node_affinity_not_in("zone", rng.sample(ZONES, rng.randint(1, 2)))
+        elif r < 0.45:
+            pw.pod.spec.affinity = None
+            from kubernetes_tpu.api.types import NodeSelectorTerm
+            pw._add_required_node_term(
+                NodeSelectorTerm(match_expressions=(Requirement("idx", "Gt", (str(rng.randint(0, n_nodes)),)),))
+            )
+        elif r < 0.5:
+            pw.node(f"node-{rng.randint(0, n_nodes + 2)}")  # sometimes nonexistent
+        if rng.random() < 0.3:
+            pw.preferred_node_affinity(rng.randint(1, 50), "zone", [rng.choice(ZONES)])
+            pw.preferred_node_affinity(rng.randint(1, 50), "disk", [rng.choice(DISKS)])
+        if rng.random() < 0.3:
+            pw.toleration(
+                key=rng.choice(["dedicated", "team"]),
+                operator=rng.choice(["Equal", "Exists"]),
+                value=rng.choice(["a", "b", ""]),
+                effect=rng.choice(["NoSchedule", "NoExecute", "PreferNoSchedule", ""]),
+            )
+        if rng.random() < 0.1:
+            pw.toleration(operator="Exists")  # tolerate everything
+        if rng.random() < 0.25:
+            pw.host_port(rng.choice([80, 443, 8080]), rng.choice(["TCP", "UDP"]))
+        if rng.random() < 0.4:
+            pw.pod.spec.containers[0].image = rng.choice(IMAGES)
+        pods.append(pw.obj())
+    return pods
+
+
+ORACLES = {
+    "NodeUnschedulable": NodeUnschedulable(),
+    "NodeName": NodeName(),
+    "TaintToleration": TaintToleration(),
+    "NodeAffinity": NodeAffinity(),
+    "NodePorts": NodePorts(),
+    "NodeResourcesFit": Fit(),
+}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_filter_parity(seed):
+    rng = random.Random(seed)
+    infos = random_cluster(rng, 24)
+    pods = random_pods(rng, 16, 24)
+    enc = ClusterEncoder(Capacities(nodes=32, pods=16, value_words=32))
+    nt = enc.encode_snapshot(infos)
+    pb, et = enc.encode_pods(pods)
+    out = filters.run_all_filters(pb, et, nt)
+
+    for name, plugin in ORACLES.items():
+        kernel_mask = np.asarray(out["masks"][name])
+        for p, pod in enumerate(pods):
+            state = CycleState()
+            if hasattr(plugin, "pre_filter"):
+                plugin.pre_filter(state, pod)
+            for ni in infos:
+                slot = enc.node_slots[ni.node.meta.name]
+                want = plugin.filter(state, pod, ni).is_success()
+                got = bool(kernel_mask[p, slot])
+                assert got == want, (
+                    f"seed={seed} plugin={name} pod={pod.meta.name} node={ni.node.meta.name}: "
+                    f"kernel={got} oracle={want}"
+                )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_score_parity(seed):
+    rng = random.Random(seed + 100)
+    infos = random_cluster(rng, 16)
+    pods = random_pods(rng, 12, 16)
+    enc = ClusterEncoder(Capacities(nodes=32, pods=16, value_words=32))
+    nt = enc.encode_snapshot(infos)
+    pb, et = enc.encode_pods(pods)
+    out = filters.run_all_filters(pb, et, nt)
+    feasible = out["feasible"]
+
+    kernels = {
+        "NodeResourcesFit": scores.score_least_allocated(pb, nt),
+        "NodeResourcesBalancedAllocation": scores.score_balanced_allocation(pb, nt),
+        "TaintToleration": scores.normalize_default(scores.score_taint_toleration(pb, nt), feasible, reverse=True),
+        "NodeAffinity": scores.normalize_default(
+            scores.score_node_affinity(pb, et, nt, out["expr_match"]), feasible, reverse=False
+        ),
+        "ImageLocality": scores.score_image_locality(pb, nt),
+    }
+    kernels = {k: np.asarray(v) for k, v in kernels.items()}
+    feasible_np = np.asarray(feasible)
+
+    snapshot_fn = lambda: infos  # noqa: E731
+    oracle_plugins = {
+        "NodeResourcesFit": Fit(),
+        "NodeResourcesBalancedAllocation": BalancedAllocation(),
+        "TaintToleration": TaintToleration(),
+        "NodeAffinity": NodeAffinity(),
+        "ImageLocality": ImageLocality(snapshot_fn=snapshot_fn),
+    }
+
+    for p, pod in enumerate(pods):
+        feas_nodes = [ni for ni in infos if feasible_np[p, enc.node_slots[ni.node.meta.name]]]
+        if not feas_nodes:
+            continue
+        for name, plugin in oracle_plugins.items():
+            state = CycleState()
+            if hasattr(plugin, "pre_score"):
+                plugin.pre_score(state, pod, [ni.node for ni in feas_nodes])
+            node_scores = []
+            for ni in feas_nodes:
+                s, _ = plugin.score_node(state, pod, ni)
+                node_scores.append(NodeScore(ni.node.meta.name, s))
+            ext = plugin.score_extensions()
+            if ext is not None:
+                ext.normalize_score(state, pod, node_scores)
+            for ns in node_scores:
+                slot = enc.node_slots[ns.name]
+                got = float(kernels[name][p, slot])
+                assert abs(got - ns.score) <= 1.001, (
+                    f"seed={seed} plugin={name} pod={pod.meta.name} node={ns.name}: "
+                    f"kernel={got} oracle={ns.score}"
+                )
+
+
+def test_select_host_tie_break_uniform():
+    import jax
+
+    total = np.zeros((1, 8), np.float32)
+    total[0, [2, 5, 7]] = 100.0
+    feasible = np.ones((1, 8), bool)
+    picks = set()
+    for i in range(64):
+        idx, best, ok = select.select_host(total, feasible, jax.random.PRNGKey(i))
+        assert bool(ok[0]) and float(best[0]) == 100.0
+        picks.add(int(idx[0]))
+    assert picks == {2, 5, 7}  # all maxima reachable, only maxima picked
+
+
+def test_select_host_infeasible():
+    import jax
+
+    total = np.zeros((2, 4), np.float32)
+    feasible = np.zeros((2, 4), bool)
+    feasible[1, 3] = True
+    idx, _, ok = select.select_host(total, feasible, jax.random.PRNGKey(0))
+    assert int(idx[0]) == -1 and not bool(ok[0])
+    assert int(idx[1]) == 3 and bool(ok[1])
