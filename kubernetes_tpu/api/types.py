@@ -322,9 +322,13 @@ class Pod:
         )
 
     def clone(self) -> "Pod":
+        """Copy with independent meta/spec/status; container/affinity objects
+        are shared (treated as immutable once created — assume/bind only ever
+        rewrites spec.node_name and status fields)."""
         return dataclasses.replace(
             self,
             meta=dataclasses.replace(self.meta, labels=dict(self.meta.labels)),
+            spec=dataclasses.replace(self.spec),
             status=dataclasses.replace(self.status),
         )
 

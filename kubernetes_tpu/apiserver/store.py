@@ -1,0 +1,157 @@
+"""In-process cluster state store: the apiserver + informer seam.
+
+Collapses the reference's L2 (apiserver REST + watch cache) and L3 (client-go
+reflector/informer) into one in-process component: typed object maps with
+synchronous watch-handler fan-out.  The scheduler wires handlers exactly like
+eventhandlers.go:249 addAllEventHandlers; tests and the perf harness drive
+mutations exactly like the integration suite drives a real apiserver.
+
+The binding subresource (``bind``) mirrors BindingREST.Create
+(pkg/registry/core/pod/storage/storage.go:169): it transactionally sets
+``pod.spec.node_name`` and fails if the pod is already bound or gone.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..api.types import Binding, Namespace, Node, Pod, PodDisruptionBudget, PriorityClass
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+Handler = Callable[[str, Optional[object], Optional[object]], None]
+
+
+class Conflict(Exception):
+    """409: binding/update conflict (optimistic concurrency failure)."""
+
+
+class NotFound(Exception):
+    """404."""
+
+
+class ClusterStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.pods: Dict[str, Pod] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.namespaces: Dict[str, Namespace] = {}
+        self.pdbs: Dict[str, PodDisruptionBudget] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+        self._handlers: Dict[str, List[Handler]] = {}
+        self._rv = 0
+
+    def add_event_handler(self, kind: str, handler: Handler) -> None:
+        self._handlers.setdefault(kind, []).append(handler)
+
+    def _notify(self, kind: str, event: str, old, new) -> None:
+        for h in self._handlers.get(kind, []):
+            h(event, old, new)
+
+    def _bump(self, obj) -> None:
+        self._rv += 1
+        obj.meta.resource_version = self._rv
+
+    # ------------------------------------------------------------- nodes
+
+    def create_node(self, node: Node) -> None:
+        with self._lock:
+            self._bump(node)
+            self.nodes[node.meta.name] = node
+        self._notify("Node", ADDED, None, node)
+
+    def update_node(self, node: Node) -> None:
+        with self._lock:
+            old = self.nodes.get(node.meta.name)
+            if old is None:
+                raise NotFound(node.meta.name)
+            self._bump(node)
+            self.nodes[node.meta.name] = node
+        self._notify("Node", MODIFIED, old, node)
+
+    def delete_node(self, name: str) -> None:
+        with self._lock:
+            old = self.nodes.pop(name, None)
+        if old is not None:
+            self._notify("Node", DELETED, old, None)
+
+    # ------------------------------------------------------------- pods
+
+    def create_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._bump(pod)
+            self.pods[pod.key()] = pod
+        self._notify("Pod", ADDED, None, pod)
+
+    def update_pod(self, pod: Pod) -> None:
+        with self._lock:
+            old = self.pods.get(pod.key())
+            if old is None:
+                raise NotFound(pod.key())
+            self._bump(pod)
+            self.pods[pod.key()] = pod
+        self._notify("Pod", MODIFIED, old, pod)
+
+    def delete_pod(self, key: str) -> None:
+        with self._lock:
+            old = self.pods.pop(key, None)
+        if old is not None:
+            self._notify("Pod", DELETED, old, None)
+
+    def get_pod(self, key: str) -> Optional[Pod]:
+        with self._lock:
+            return self.pods.get(key)
+
+    def bind(self, binding: Binding) -> None:
+        """POST pods/{name}/binding (storage.go:169)."""
+        with self._lock:
+            pod = self.pods.get(binding.pod_key)
+            if pod is None:
+                raise NotFound(binding.pod_key)
+            if pod.spec.node_name:
+                raise Conflict(f"pod {binding.pod_key} is already bound to {pod.spec.node_name}")
+            old = pod
+            new = pod.clone()
+            new.spec.node_name = binding.node_name
+            new.status.phase = "Running"
+            self._bump(new)
+            self.pods[binding.pod_key] = new
+        self._notify("Pod", MODIFIED, old, new)
+
+    def update_pod_nominated_node(self, key: str, node_name: str) -> None:
+        """pod.Status.NominatedNodeName persist (schedule_one.go:846)."""
+        with self._lock:
+            pod = self.pods.get(key)
+            if pod is None:
+                raise NotFound(key)
+            old = pod
+            new = pod.clone()
+            new.status.nominated_node_name = node_name
+            self._bump(new)
+            self.pods[key] = new
+        self._notify("Pod", MODIFIED, old, new)
+
+    # ------------------------------------------------------------- misc kinds
+
+    def create_namespace(self, ns: Namespace) -> None:
+        with self._lock:
+            self.namespaces[ns.meta.name] = ns
+        self._notify("Namespace", ADDED, None, ns)
+
+    def ns_labels(self, name: str) -> Dict[str, str]:
+        with self._lock:
+            ns = self.namespaces.get(name)
+            return dict(ns.meta.labels) if ns else {}
+
+    def create_pdb(self, pdb: PodDisruptionBudget) -> None:
+        with self._lock:
+            self.pdbs[pdb.meta.key()] = pdb
+        self._notify("PodDisruptionBudget", ADDED, None, pdb)
+
+    def create_priority_class(self, pc: PriorityClass) -> None:
+        with self._lock:
+            self.priority_classes[pc.meta.name] = pc
+        self._notify("PriorityClass", ADDED, None, pc)

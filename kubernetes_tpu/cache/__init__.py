@@ -1,0 +1,2 @@
+from .cache import Cache  # noqa: F401
+from .snapshot import Snapshot  # noqa: F401

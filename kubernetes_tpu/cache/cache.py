@@ -1,0 +1,244 @@
+"""Scheduler cache: assume/confirm/expire pod state machine + incremental
+snapshot (internal/cache/cache.go).
+
+State machine (interface.go:32-56 diagram):
+
+    AssumePod → (FinishBinding → expire-after-TTL | AddPod confirms |
+                 ForgetPod removes)
+
+Assumed pods are counted in their node's NodeInfo immediately so the next
+cycle sees them (the optimistic-commit that lets scheduling run ahead of
+binding, schedule_one.go:734 assume).  ``cleanup(now)`` sweeps expired
+assumptions (cache.go:731 run/cleanupAssumedPods — here called by the
+scheduler loop instead of a background goroutine).
+
+Snapshot updates are O(changed nodes): every NodeInfo mutation bumps its
+monotonic generation; ``update_snapshot`` re-clones only nodes whose
+generation is newer than the snapshot's (cache.go:198 UpdateSnapshot).  The
+same generation stream drives the TPU backend's delta uploads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api.types import Node, Pod
+from ..framework.types import NodeInfo, next_generation
+from .snapshot import Snapshot
+
+DEFAULT_ASSUME_TTL = 30.0  # durationToExpireAssumedPod (scheduler.go:311)
+
+
+@dataclass
+class _PodState:
+    pod: Pod
+    assumed: bool = False
+    binding_finished: bool = False
+    deadline: Optional[float] = None
+
+
+class Cache:
+    def __init__(self, ttl: float = DEFAULT_ASSUME_TTL, now_fn=time.monotonic):
+        self._lock = threading.RLock()
+        self.ttl = ttl
+        self.now_fn = now_fn
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.pod_states: Dict[str, _PodState] = {}  # pod key -> state
+        self._assumed: set = set()                  # keys with assumed=True
+        # dirty-tracking so update_snapshot is O(changes), like the reference's
+        # generation-ordered node list (cache.go headNode)
+        self._dirty: set = set()
+        self._removed: set = set()
+        self._sync_generation = 0
+
+    # ------------------------------------------------------------- pods
+
+    def assume_pod(self, pod: Pod, node_name: str) -> None:
+        """Optimistically commit ``pod`` to ``node_name``. Takes ownership of
+        the passed object (callers pass a clone; its spec.node_name is set
+        here so Reserve/Permit/Bind plugins see the assignment, matching the
+        reference's assumedPod)."""
+        key = pod.key()
+        with self._lock:
+            if key in self.pod_states:
+                raise KeyError(f"pod {key} already in cache")
+            pod.spec.node_name = node_name
+            self._add_pod_to_node(pod, node_name)
+            self.pod_states[key] = _PodState(pod=pod, assumed=True)
+            self._assumed.add(key)
+
+    def finish_binding(self, pod: Pod) -> None:
+        with self._lock:
+            ps = self.pod_states.get(pod.key())
+            if ps and ps.assumed:
+                ps.binding_finished = True
+                ps.deadline = self.now_fn() + self.ttl
+
+    def forget_pod(self, pod: Pod) -> None:
+        """Binding failed: roll the assumption back (cache.go:416)."""
+        with self._lock:
+            ps = self.pod_states.pop(pod.key(), None)
+            self._assumed.discard(pod.key())
+            if ps is not None:
+                self._remove_pod_from_node(ps.pod, ps.pod.spec.node_name)
+
+    def add_pod(self, pod: Pod) -> None:
+        """Informer confirmation of a bound pod (cache.go:497)."""
+        key = pod.key()
+        with self._lock:
+            ps = self.pod_states.get(key)
+            if ps is not None and ps.assumed:
+                if ps.pod.spec.node_name != pod.spec.node_name:
+                    # scheduled elsewhere than assumed: relocate
+                    self._remove_pod_from_node(ps.pod, ps.pod.spec.node_name)
+                    self._add_pod_to_node(pod, pod.spec.node_name)
+                self.pod_states[key] = _PodState(pod=pod)
+                self._assumed.discard(key)
+                return
+            if ps is not None:
+                return  # duplicate add
+            self._add_pod_to_node(pod, pod.spec.node_name)
+            self.pod_states[key] = _PodState(pod=pod)
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        with self._lock:
+            ps = self.pod_states.get(old.key())
+            if ps is None:
+                self.add_pod(new)
+                return
+            self._remove_pod_from_node(ps.pod, ps.pod.spec.node_name)
+            self._add_pod_to_node(new, new.spec.node_name)
+            self.pod_states[old.key()] = _PodState(pod=new)
+
+    def remove_pod(self, pod: Pod) -> None:
+        with self._lock:
+            ps = self.pod_states.pop(pod.key(), None)
+            self._assumed.discard(pod.key())
+            if ps is not None:
+                self._remove_pod_from_node(ps.pod, ps.pod.spec.node_name)
+
+    def is_assumed(self, pod_key: str) -> bool:
+        with self._lock:
+            ps = self.pod_states.get(pod_key)
+            return bool(ps and ps.assumed)
+
+    def cleanup(self, now: Optional[float] = None) -> List[Pod]:
+        """Expire assumed-but-never-confirmed pods; returns the expired pods
+        (cleanupAssumedPods, cache.go:735)."""
+        now = self.now_fn() if now is None else now
+        expired = []
+        with self._lock:
+            for key in list(self._assumed):
+                ps = self.pod_states.get(key)
+                if ps and ps.binding_finished and ps.deadline is not None and now > ps.deadline:
+                    expired.append(ps.pod)
+                    self.pod_states.pop(key)
+                    self._assumed.discard(key)
+                    self._remove_pod_from_node(ps.pod, ps.pod.spec.node_name)
+        return expired
+
+    # ------------------------------------------------------------- nodes
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            ni = self.nodes.get(node.meta.name)
+            if ni is None:
+                ni = NodeInfo()
+                self.nodes[node.meta.name] = ni
+            ni.set_node(node)
+            self._dirty.add(node.meta.name)
+            self._removed.discard(node.meta.name)
+
+    def update_node(self, node: Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, node_name: str) -> None:
+        with self._lock:
+            ni = self.nodes.get(node_name)
+            if ni is None:
+                return
+            # keep the entry while pods remain (reference keeps ghost nodes
+            # for pods not yet deleted), else drop
+            ni.node = None
+            ni.generation = next_generation()
+            self._dirty.add(node_name)
+            if not ni.pods:
+                del self.nodes[node_name]
+                self._dirty.discard(node_name)
+                self._removed.add(node_name)
+
+    def _node_info(self, node_name: str) -> NodeInfo:
+        ni = self.nodes.get(node_name)
+        if ni is None:
+            ni = NodeInfo()  # pod arrived before its node: ghost entry
+            self.nodes[node_name] = ni
+        return ni
+
+    def _add_pod_to_node(self, pod: Pod, node_name: str) -> None:
+        if node_name:
+            self._node_info(node_name).add_pod(pod)
+            self._dirty.add(node_name)
+            self._removed.discard(node_name)
+
+    def _remove_pod_from_node(self, pod: Pod, node_name: str) -> None:
+        ni = self.nodes.get(node_name)
+        if ni is not None:
+            ni.remove_pod(pod)
+            self._dirty.add(node_name)
+            if ni.node is None and not ni.pods:
+                self.nodes.pop(node_name, None)
+                self._dirty.discard(node_name)
+                self._removed.add(node_name)
+
+    # ------------------------------------------------------------- snapshot
+
+    def update_snapshot(self, snapshot: Snapshot) -> Snapshot:
+        """Incremental: re-clone only NodeInfos dirtied since the snapshot's
+        generation; O(changes) not O(nodes) (cache.go:198's generation-ordered
+        list, realized as a dirty set). A snapshot older than the dirty-set
+        horizon (e.g. a brand-new Snapshot) gets a full resync."""
+        with self._lock:
+            max_gen = snapshot.generation
+            changed = False
+            full = snapshot.generation < self._horizon()
+            names = self.nodes.keys() if full else (self._dirty | self._removed)
+            for name in names:
+                ni = self.nodes.get(name)
+                if ni is None:
+                    if name in snapshot.node_info_map:
+                        del snapshot.node_info_map[name]
+                        changed = True
+                    continue
+                if ni.generation > snapshot.generation:
+                    snapshot.node_info_map[name] = ni.clone()
+                    max_gen = max(max_gen, ni.generation)
+                    changed = True
+            if full:
+                stale = [n for n in snapshot.node_info_map if n not in self.nodes]
+                for n in stale:
+                    del snapshot.node_info_map[n]
+                    changed = True
+            self._dirty.clear()
+            self._removed.clear()
+            self._sync_generation = max_gen
+            if changed:
+                snapshot.refresh_lists()
+            snapshot.generation = max_gen
+        return snapshot
+
+    def _horizon(self) -> int:
+        """Oldest snapshot generation the dirty set can serve incrementally."""
+        return self._sync_generation
+
+    def dirty_nodes(self, since_generation: int) -> List[str]:
+        """Node names whose generation advanced past ``since_generation`` —
+        the TPU backend's delta-upload worklist."""
+        with self._lock:
+            return [n for n, ni in self.nodes.items() if ni.generation > since_generation]
+
+    def node_count(self) -> int:
+        with self._lock:
+            return sum(1 for ni in self.nodes.values() if ni.node is not None)

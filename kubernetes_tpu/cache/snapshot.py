@@ -1,0 +1,37 @@
+"""Per-cycle immutable snapshot (internal/cache/snapshot.go:29).
+
+Holds cloned NodeInfos keyed by name plus the flat list and the pruned
+secondary lists the affinity plugins iterate (have_pods_with_affinity,
+have_pods_with_required_anti_affinity, used PVC set).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from ..framework.types import NodeInfo
+
+
+class Snapshot:
+    def __init__(self):
+        self.node_info_map: Dict[str, NodeInfo] = {}
+        self.node_info_list: List[NodeInfo] = []
+        self.have_pods_with_affinity_list: List[NodeInfo] = []
+        self.have_pods_with_required_anti_affinity_list: List[NodeInfo] = []
+        self.used_pvc_set: Set[str] = set()
+        self.generation: int = 0
+
+    def get(self, name: str) -> Optional[NodeInfo]:
+        return self.node_info_map.get(name)
+
+    def list(self) -> List[NodeInfo]:
+        return self.node_info_list
+
+    def refresh_lists(self) -> None:
+        """Rebuild the flat + pruned lists from node_info_map."""
+        self.node_info_list = [ni for ni in self.node_info_map.values() if ni.node is not None]
+        self.have_pods_with_affinity_list = [ni for ni in self.node_info_list if ni.pods_with_affinity]
+        self.have_pods_with_required_anti_affinity_list = [
+            ni for ni in self.node_info_list if ni.pods_with_required_anti_affinity
+        ]
+        self.used_pvc_set = {k for ni in self.node_info_list for k in ni.pvc_ref_counts}

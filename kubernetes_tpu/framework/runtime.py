@@ -1,0 +1,247 @@
+"""Framework runtime: builds a profile's plugins and executes extension
+points (pkg/scheduler/framework/runtime/framework.go).
+
+Key behaviors mirrored:
+  * run_filter_plugins_with_nominated_pods (:791): filters run twice — first
+    with higher-priority nominated pods added to a cloned NodeInfo/CycleState,
+    then without — and both passes must succeed.
+  * run_score_plugins (:900): raw scores per plugin → NormalizeScore → apply
+    plugin weight; node-parallelism in the reference, vectorized-or-sequential
+    here (the TPU backend replaces this wholesale on the hot path).
+  * Filter short-circuit: plugins run in config order; first non-success
+    status wins and is tagged with the plugin name.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api.types import Pod
+from . import interface as fw
+from .interface import CycleState, NodeScore, PreFilterResult, Status, OK
+from .registry import DEFAULT_PLUGINS, in_tree_registry
+from .types import ClusterEvent, Diagnosis, NodeInfo, QueuedPodInfo
+
+
+class PodNominator:
+    """Tracks preemption nominations (framework/interface.go:690;
+    nominated pods get re-considered by filters before their victims exit)."""
+
+    def __init__(self):
+        self._by_node: Dict[str, List[Pod]] = {}
+        self._node_of: Dict[str, str] = {}
+
+    def add_nominated_pod(self, pod: Pod, node_name: str) -> None:
+        self.delete_nominated_pod_if_exists(pod)
+        if node_name:
+            self._by_node.setdefault(node_name, []).append(pod)
+            self._node_of[pod.key()] = node_name
+
+    def delete_nominated_pod_if_exists(self, pod: Pod) -> None:
+        node = self._node_of.pop(pod.key(), None)
+        if node is not None:
+            self._by_node[node] = [p for p in self._by_node[node] if p.key() != pod.key()]
+
+    def nominated_pods_for_node(self, node_name: str) -> List[Pod]:
+        return self._by_node.get(node_name, [])
+
+
+class Framework:
+    """One profile's plugin set (profile/profile.go maps scheduler-name →
+    one of these)."""
+
+    def __init__(
+        self,
+        handle_ctx: dict,
+        plugin_config: Optional[Dict[str, List[Tuple[str, int]]]] = None,
+        plugin_args: Optional[Dict[str, dict]] = None,
+        registry=None,
+        profile_name: str = "default-scheduler",
+    ):
+        self.profile_name = profile_name
+        self.handle_ctx = handle_ctx
+        self.nominator: PodNominator = handle_ctx.setdefault("nominator", PodNominator())
+        registry = registry or in_tree_registry()
+        config = plugin_config or DEFAULT_PLUGINS
+        args = plugin_args or {}
+
+        self._instances: Dict[str, object] = {}
+        self.points: Dict[str, List[Tuple[object, int]]] = {}
+        for point, entries in config.items():
+            lst = []
+            for name, weight in entries:
+                factory = registry.get(name)
+                if factory is None:
+                    continue  # not-yet-implemented plugin in default config
+                if name not in self._instances:
+                    self._instances[name] = factory(handle_ctx, args.get(name, {}))
+                lst.append((self._instances[name], weight))
+            self.points[point] = lst
+
+    def plugin(self, name: str):
+        return self._instances.get(name)
+
+    # --------------------------------------------------------------- events
+
+    def cluster_event_map(self) -> Dict[ClusterEvent, Set[str]]:
+        """plugin EventsToRegister → event → interested plugin names
+        (fillEventToPluginMap)."""
+        out: Dict[ClusterEvent, Set[str]] = {}
+        for name, plugin in self._instances.items():
+            events = plugin.events_to_register() if hasattr(plugin, "events_to_register") else None
+            if not events:
+                # plugins that don't opt in are movable by any event
+                from .types import WILDCARD_EVENT
+
+                out.setdefault(WILDCARD_EVENT, set()).add(name)
+                continue
+            for ev in events:
+                out.setdefault(ev, set()).add(name)
+        return out
+
+    # --------------------------------------------------------------- queue sort
+
+    def queue_sort_key(self):
+        qs = self.points.get("queue_sort") or []
+        if qs:
+            plugin = qs[0][0]
+            return lambda qp: (-qp.pod.spec.priority, qp.timestamp)
+        return lambda qp: qp.timestamp
+
+    # --------------------------------------------------------------- prefilter
+
+    def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        result: Optional[PreFilterResult] = None
+        for plugin, _w in self.points.get("pre_filter", []):
+            r, status = plugin.pre_filter(state, pod)
+            if not status.is_success():
+                return None, status.with_plugin(plugin.name())
+            if r is not None and not r.all_nodes():
+                result = r if result is None else result.merge(r)
+                if result is not None and not result.node_names:
+                    return result, Status.unresolvable(
+                        "node(s) didn't satisfy plugin(s) prefilter restriction"
+                    ).with_plugin(plugin.name())
+        return result, OK
+
+    # --------------------------------------------------------------- filter
+
+    def run_filter_plugins(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        for plugin, _w in self.points.get("filter", []):
+            status = plugin.filter(state, pod, node_info)
+            if not status.is_success():
+                return status.with_plugin(plugin.name())
+        return OK
+
+    def run_filter_plugins_with_nominated_pods(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        """Two-pass filter (:791): pass 1 with ≥-priority nominated pods
+        added; pass 2 without (both must pass, :813 comment)."""
+        nominated = [
+            p
+            for p in self.nominator.nominated_pods_for_node(node_info.node.meta.name if node_info.node else "")
+            if p.spec.priority >= pod.spec.priority and p.key() != pod.key()
+        ]
+        if nominated:
+            state2 = state.clone()
+            ni2 = node_info.clone()
+            for np_ in nominated:
+                ni2.add_pod(np_)
+                self._run_add_pod_extensions(state2, pod, np_, ni2)
+            status = self.run_filter_plugins(state2, pod, ni2)
+            if not status.is_success():
+                return status
+        return self.run_filter_plugins(state, pod, node_info)
+
+    def _run_add_pod_extensions(self, state: CycleState, pod: Pod, added: Pod, ni: NodeInfo) -> None:
+        for plugin, _w in self.points.get("pre_filter", []):
+            ext = plugin.pre_filter_extensions()
+            if ext is not None:
+                ext.add_pod(state, pod, added, ni)
+
+    def run_remove_pod_extensions(self, state: CycleState, pod: Pod, removed: Pod, ni: NodeInfo) -> None:
+        for plugin, _w in self.points.get("pre_filter", []):
+            ext = plugin.pre_filter_extensions()
+            if ext is not None:
+                ext.remove_pod(state, pod, removed, ni)
+
+    def run_add_pod_extensions(self, state: CycleState, pod: Pod, added: Pod, ni: NodeInfo) -> None:
+        self._run_add_pod_extensions(state, pod, added, ni)
+
+    # --------------------------------------------------------------- postfilter
+
+    def run_post_filter_plugins(self, state: CycleState, pod: Pod, status_map) -> Tuple[Optional[str], Status]:
+        for plugin, _w in self.points.get("post_filter", []):
+            nominated, status = plugin.post_filter(state, pod, status_map)
+            if status.is_success() or status.code == fw.ERROR:
+                return nominated, status.with_plugin(plugin.name())
+        return None, Status.unschedulable("no PostFilter plugin could resolve")
+
+    # --------------------------------------------------------------- score
+
+    def run_pre_score_plugins(self, state: CycleState, pod: Pod, nodes) -> Status:
+        for plugin, _w in self.points.get("pre_score", []):
+            status = plugin.pre_score(state, pod, nodes)
+            if not status.is_success():
+                return status.with_plugin(plugin.name())
+        return OK
+
+    def run_score_plugins(self, state: CycleState, pod: Pod, node_infos: List[NodeInfo]) -> Dict[str, int]:
+        """Returns node name → weighted total (:900-:972)."""
+        totals = {ni.node.meta.name: 0 for ni in node_infos}
+        for plugin, weight in self.points.get("score", []):
+            scores = []
+            for ni in node_infos:
+                raw, status = plugin.score_node(state, pod, ni)
+                if not status.is_success():
+                    raise RuntimeError(f"score plugin {plugin.name()} failed: {status}")
+                scores.append(NodeScore(ni.node.meta.name, raw))
+            ext = plugin.score_extensions()
+            if ext is not None:
+                ext.normalize_score(state, pod, scores)
+            for s in scores:
+                if s.score > fw.MAX_NODE_SCORE or s.score < fw.MIN_NODE_SCORE:
+                    raise RuntimeError(
+                        f"plugin {plugin.name()} returned out-of-range score {s.score}"
+                    )
+                totals[s.name] += s.score * weight
+        return totals
+
+    # --------------------------------------------------------------- later points
+
+    def run_reserve_plugins_reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for plugin, _w in self.points.get("reserve", []):
+            status = plugin.reserve(state, pod, node_name)
+            if not status.is_success():
+                return status.with_plugin(plugin.name())
+        return OK
+
+    def run_reserve_plugins_unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for plugin, _w in reversed(self.points.get("reserve", [])):
+            plugin.unreserve(state, pod, node_name)
+
+    def run_permit_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for plugin, _w in self.points.get("permit", []):
+            status, _timeout = plugin.permit(state, pod, node_name)
+            if not status.is_success() and status.code != fw.WAIT:
+                return status.with_plugin(plugin.name())
+            if status.code == fw.WAIT:
+                return Status(fw.WAIT).with_plugin(plugin.name())
+        return OK
+
+    def run_pre_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for plugin, _w in self.points.get("pre_bind", []):
+            status = plugin.pre_bind(state, pod, node_name)
+            if not status.is_success():
+                return status.with_plugin(plugin.name())
+        return OK
+
+    def run_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        for plugin, _w in self.points.get("bind", []):
+            status = plugin.bind(state, pod, node_name)
+            if status.code != fw.SKIP:
+                return status.with_plugin(plugin.name())
+        return Status.error("no bind plugin accepted the pod")
+
+    def run_post_bind_plugins(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        for plugin, _w in self.points.get("post_bind", []):
+            plugin.post_bind(state, pod, node_name)

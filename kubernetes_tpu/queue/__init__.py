@@ -1,0 +1,1 @@
+from .scheduling_queue import SchedulingQueue  # noqa: F401

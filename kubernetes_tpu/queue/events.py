@@ -1,0 +1,32 @@
+"""Named cluster events that reactivate unschedulable pods
+(internal/queue/events.go:25-91)."""
+
+from ..framework.types import (
+    ADD,
+    ALL,
+    ClusterEvent,
+    DELETE,
+    NODE,
+    POD,
+    PV,
+    PVC,
+    STORAGE_CLASS,
+    UPDATE,
+    UPDATE_NODE_ALLOCATABLE,
+    UPDATE_NODE_LABEL,
+    UPDATE_NODE_TAINT,
+    WILDCARD,
+)
+
+UNSCHEDULABLE_TIMEOUT = ClusterEvent(WILDCARD, ALL, "UnschedulableTimeout")
+NODE_ADD = ClusterEvent(NODE, ADD, "NodeAdd")
+NODE_DELETE = ClusterEvent(NODE, DELETE, "NodeDelete")
+POD_ADD = ClusterEvent(POD, ADD, "PodAdd")
+POD_DELETE = ClusterEvent(POD, DELETE, "AssignedPodDelete")
+POD_UPDATE = ClusterEvent(POD, UPDATE, "AssignedPodUpdate")
+NODE_ALLOCATABLE_CHANGE = ClusterEvent(NODE, UPDATE_NODE_ALLOCATABLE, "NodeAllocatableChange")
+NODE_LABEL_CHANGE = ClusterEvent(NODE, UPDATE_NODE_LABEL, "NodeLabelChange")
+NODE_TAINT_CHANGE = ClusterEvent(NODE, UPDATE_NODE_TAINT, "NodeTaintChange")
+PVC_ADD = ClusterEvent(PVC, ADD, "PvcAdd")
+PV_ADD = ClusterEvent(PV, ADD, "PvAdd")
+STORAGE_CLASS_ADD = ClusterEvent(STORAGE_CLASS, ADD, "StorageClassAdd")

@@ -1,0 +1,215 @@
+"""Three-part scheduling queue (internal/queue/scheduling_queue.go).
+
+activeQ        heap ordered by the profile's QueueSort (priority desc, FIFO)
+podBackoffQ    heap ordered by backoff expiry (1s → 10s doubling, :766)
+unschedulable  map of pods that failed, waiting for a relevant ClusterEvent
+
+Event-driven reactivation (``move_all_to_active_or_backoff``) is gated on the
+cluster-event map: a pod moves only if some plugin it failed on registered
+interest in the fired event (:614,:627), or on the wildcard flush.  The
+``move_request_cycle`` guard (:163-167) keeps pods that failed *during* an
+in-flight cycle eligible for the move that raced with them.
+
+Flush tickers (:432,:463) become explicit ``flush_*`` calls driven by the
+scheduler loop (no background goroutines; the loop is single-threaded and the
+TPU batch path wants deterministic drain points anyway).
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..api.types import Pod
+from ..framework.types import ClusterEvent, QueuedPodInfo
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0
+DEFAULT_POD_MAX_BACKOFF = 10.0
+DEFAULT_UNSCHEDULABLE_TIMEOUT = 300.0  # flushUnschedulablePodsLeftover, 5min
+
+LessFn = Callable[[QueuedPodInfo], object]  # sort-key extractor
+
+
+class SchedulingQueue:
+    def __init__(
+        self,
+        less_key: Optional[LessFn] = None,
+        initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+        max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+        unschedulable_timeout: float = DEFAULT_UNSCHEDULABLE_TIMEOUT,
+        cluster_event_map: Optional[Dict[ClusterEvent, Set[str]]] = None,
+        now_fn=time.monotonic,
+    ):
+        # default QueueSort: priority desc then FIFO (PrioritySort)
+        self.less_key = less_key or (lambda qp: (-qp.pod.spec.priority, qp.timestamp))
+        self.initial_backoff = initial_backoff
+        self.max_backoff = max_backoff
+        self.unschedulable_timeout = unschedulable_timeout
+        self.cluster_event_map = cluster_event_map or {}
+        self.now_fn = now_fn
+
+        self._counter = itertools.count()  # FIFO tie-break inside heaps
+        self._active: List[Tuple[object, int, QueuedPodInfo]] = []
+        self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []
+        self._unschedulable: Dict[str, QueuedPodInfo] = {}
+        self._in_queue: Set[str] = set()  # keys in active/backoff heaps
+        self.scheduling_cycle = 0
+        self.move_request_cycle = -1
+
+    # ------------------------------------------------------------- helpers
+
+    def _backoff_duration(self, qp: QueuedPodInfo) -> float:
+        """calculateBackoffDuration (:766): initial · 2^(attempts-1), capped."""
+        d = self.initial_backoff
+        for _ in range(1, qp.attempts):
+            d *= 2
+            if d >= self.max_backoff:
+                return self.max_backoff
+        return d
+
+    def _push_active(self, qp: QueuedPodInfo) -> None:
+        key = qp.pod.key()
+        if key in self._in_queue:
+            return
+        heapq.heappush(self._active, (self.less_key(qp), next(self._counter), qp))
+        self._in_queue.add(key)
+
+    def _push_backoff(self, qp: QueuedPodInfo) -> None:
+        key = qp.pod.key()
+        if key in self._in_queue:
+            return
+        expiry = qp.timestamp + self._backoff_duration(qp)
+        heapq.heappush(self._backoff, (expiry, next(self._counter), qp))
+        self._in_queue.add(key)
+
+    # ------------------------------------------------------------- API
+
+    def add(self, pod: Pod) -> None:
+        """New unscheduled pod (informer add) → activeQ (:300)."""
+        self._push_active(QueuedPodInfo(pod=pod, timestamp=self.now_fn()))
+
+    def update(self, old: Optional[Pod], new: Pod) -> None:
+        """Pod update may make an unschedulable pod schedulable again (:525);
+        a pod the queue has never seen falls through to activeQ (reference
+        Update's final AddNewPod branch)."""
+        key = new.key()
+        if key in self._in_queue:
+            return  # will be scheduled with fresh object at pop time via store
+        qp = self._unschedulable.pop(key, None)
+        if qp is not None:
+            qp.pod = new
+            self._push_backoff(qp)
+        else:
+            self.add(new)
+
+    def delete(self, pod: Pod) -> None:
+        key = pod.key()
+        self._unschedulable.pop(key, None)
+        if key in self._in_queue:
+            self._in_queue.discard(key)
+            self._active = [e for e in self._active if e[2].pod.key() != key]
+            heapq.heapify(self._active)
+            self._backoff = [e for e in self._backoff if e[2].pod.key() != key]
+            heapq.heapify(self._backoff)
+
+    def pop(self) -> Optional[QueuedPodInfo]:
+        """Next pod to schedule, or None (non-blocking; the reference blocks,
+        :484 — the loop idles instead). Bumps attempts + scheduling_cycle."""
+        self.flush_backoff_completed()
+        if not self._active:
+            return None
+        _, _, qp = heapq.heappop(self._active)
+        self._in_queue.discard(qp.pod.key())
+        qp.attempts += 1
+        self.scheduling_cycle += 1
+        return qp
+
+    def pop_batch(self, k: int) -> List[QueuedPodInfo]:
+        """Drain up to k pods in queue order — the TPU micro-batch feed."""
+        out = []
+        for _ in range(k):
+            qp = self.pop()
+            if qp is None:
+                break
+            out.append(qp)
+        return out
+
+    def add_unschedulable_if_not_present(self, qp: QueuedPodInfo, pod_scheduling_cycle: int) -> None:
+        """Failed pod → unschedulable map, or backoffQ if a move request
+        raced with its cycle (:393 AddUnschedulableIfNotPresent)."""
+        key = qp.pod.key()
+        if key in self._in_queue or key in self._unschedulable:
+            return
+        qp.timestamp = self.now_fn()
+        if self.move_request_cycle >= pod_scheduling_cycle:
+            self._push_backoff(qp)
+        else:
+            self._unschedulable[key] = qp
+
+    def move_all_to_active_or_backoff_queue(self, event: ClusterEvent) -> int:
+        """Reactivate unschedulable pods whose failed plugins registered
+        interest in ``event`` (:614 MoveAllToActiveOrBackoffQueue)."""
+        self.move_request_cycle = self.scheduling_cycle
+        moved = 0
+        for key in list(self._unschedulable):
+            qp = self._unschedulable[key]
+            if self._pod_matches_event(qp, event):
+                del self._unschedulable[key]
+                self._requeue(qp)
+                moved += 1
+        return moved
+
+    def _pod_matches_event(self, qp: QueuedPodInfo, event: ClusterEvent) -> bool:
+        if event.is_wildcard():
+            return True
+        for registered, plugins in self.cluster_event_map.items():
+            if registered.match(event) and (
+                not qp.unschedulable_plugins or plugins & qp.unschedulable_plugins
+            ):
+                return True
+        return False
+
+    def _requeue(self, qp: QueuedPodInfo) -> None:
+        """Moved pods land in backoffQ unless their backoff already lapsed."""
+        if self.now_fn() - qp.timestamp >= self._backoff_duration(qp):
+            self._push_active(qp)
+        else:
+            self._push_backoff(qp)
+
+    def flush_backoff_completed(self) -> None:
+        """backoffQ → activeQ for expired backoffs (:432)."""
+        now = self.now_fn()
+        while self._backoff and self._backoff[0][0] <= now:
+            _, _, qp = heapq.heappop(self._backoff)
+            self._in_queue.discard(qp.pod.key())
+            self._push_active(qp)
+
+    def flush_unschedulable_left_over(self) -> None:
+        """Pods stuck unschedulable > timeout get retried (:463)."""
+        now = self.now_fn()
+        for key in list(self._unschedulable):
+            qp = self._unschedulable[key]
+            if now - qp.timestamp > self.unschedulable_timeout:
+                del self._unschedulable[key]
+                self._requeue(qp)
+
+    def assigned_pod_updated_or_added(self, pod: Pod) -> None:
+        """An assigned pod changed: pods failed on affinity may now fit
+        (movePodsToActiveOrBackoffQueue with Pod events)."""
+        from . import events
+
+        self.move_all_to_active_or_backoff_queue(events.POD_ADD)
+
+    # ------------------------------------------------------------- stats
+
+    def pending_pods(self) -> Dict[str, int]:
+        return {
+            "active": len(self._active),
+            "backoff": len(self._backoff),
+            "unschedulable": len(self._unschedulable),
+        }
+
+    def __len__(self) -> int:
+        return len(self._active) + len(self._backoff) + len(self._unschedulable)

@@ -1,0 +1,396 @@
+"""The Scheduler: wiring + the per-pod scheduling cycle.
+
+Mirrors pkg/scheduler/scheduler.go (object + New wiring), eventhandlers.go
+(informer → cache/queue routing, node-diff → ClusterEvent) and
+schedule_one.go (the cycle: snapshot → PreFilter → Filter(+nominated 2-pass) →
+(adaptive node sampling + rotation) → PreScore/Score → selectHost → assume →
+Reserve → Permit → PreBind → Bind).
+
+This is the *sequential oracle path* — semantically the reference scheduler.
+The TPU batched path (backend/) replaces schedule_pod's filter+score middle
+with one device call over a pod micro-batch; everything around it (queue,
+cache, assume, bind, failure handling) is shared.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..api.types import Node, Pod
+from ..apiserver.store import ADDED, DELETED, MODIFIED, ClusterStore
+from ..cache import Cache, Snapshot
+from ..framework import interface as fw
+from ..framework.interface import CycleState, Status
+from ..framework.runtime import Framework
+from ..framework.types import (
+    ADD,
+    Diagnosis,
+    FitError,
+    NODE,
+    QueuedPodInfo,
+    UPDATE_NODE_ALLOCATABLE,
+    UPDATE_NODE_CONDITION,
+    UPDATE_NODE_LABEL,
+    UPDATE_NODE_TAINT,
+    ClusterEvent,
+)
+from ..queue import SchedulingQueue
+from ..queue import events as qevents
+
+MIN_FEASIBLE_NODES_TO_FIND = 100           # schedule_one.go:52
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # :56
+
+
+class Scheduler:
+    def __init__(
+        self,
+        store: ClusterStore,
+        profiles: Optional[Dict[str, Framework]] = None,
+        percentage_of_nodes_to_score: int = 0,
+        seed: int = 0,
+        pod_initial_backoff: float = 1.0,
+        pod_max_backoff: float = 10.0,
+        assume_ttl: float = 30.0,
+        now_fn=time.monotonic,
+    ):
+        self.store = store
+        self.now_fn = now_fn
+        self.cache = Cache(ttl=assume_ttl, now_fn=now_fn)
+        self.snapshot = Snapshot()
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.next_start_node_index = 0
+        self.rng = random.Random(seed)
+        self.metrics: Dict[str, int] = {
+            "schedule_attempts": 0, "scheduled": 0, "unschedulable": 0, "errors": 0,
+        }
+        self.waiting_pods: Dict[str, Tuple[Framework, CycleState, Pod, str, int]] = {}
+        self._last_cleanup = now_fn()
+        self._last_unsched_flush = now_fn()
+
+        # Profiles are specs (plugin_config/plugin_args/registry dicts), NOT
+        # pre-built Frameworks: the Scheduler owns the handle context, so
+        # plugins always get a live snapshot_fn/client (profile.NewMap analog).
+        handle_base = {
+            "snapshot_fn": lambda: self.snapshot.list(),
+            "ns_labels_fn": store.ns_labels,
+            "client": store,
+        }
+        specs = profiles or {"default-scheduler": {}}
+        self.profiles: Dict[str, Framework] = {}
+        for name, spec in specs.items():
+            if isinstance(spec, Framework):  # escape hatch for tests
+                self.profiles[name] = spec
+                continue
+            self.profiles[name] = Framework(
+                dict(handle_base),
+                plugin_config=spec.get("plugin_config"),
+                plugin_args=spec.get("plugin_args"),
+                registry=spec.get("registry"),
+                profile_name=name,
+            )
+
+        event_map = {}
+        for fwk in self.profiles.values():
+            for ev, plugins in fwk.cluster_event_map().items():
+                event_map.setdefault(ev, set()).update(plugins)
+        first = next(iter(self.profiles.values()))
+        self.queue = SchedulingQueue(
+            less_key=first.queue_sort_key(),
+            initial_backoff=pod_initial_backoff,
+            max_backoff=pod_max_backoff,
+            cluster_event_map=event_map,
+            now_fn=now_fn,
+        )
+        self._add_all_event_handlers()
+
+    # ----------------------------------------------------------- event wiring
+
+    def _add_all_event_handlers(self) -> None:
+        """eventhandlers.go:249 addAllEventHandlers."""
+        self.store.add_event_handler("Pod", self._on_pod_event)
+        self.store.add_event_handler("Node", self._on_node_event)
+
+    def _on_pod_event(self, event: str, old: Optional[Pod], new: Optional[Pod]) -> None:
+        if event == ADDED:
+            if new.spec.node_name:
+                self.cache.add_pod(new)
+                self.queue.assigned_pod_updated_or_added(new)
+            elif self._responsible_for(new):
+                self.queue.add(new)
+        elif event == MODIFIED:
+            if new.spec.node_name:
+                if old is not None and not old.spec.node_name:
+                    self.cache.add_pod(new)  # binding confirmation
+                    self.queue.assigned_pod_updated_or_added(new)
+                else:
+                    self.cache.update_pod(old, new)
+                    self.queue.assigned_pod_updated_or_added(new)
+            elif self._responsible_for(new):
+                self.queue.update(old, new)
+        elif event == DELETED:
+            if old is not None and old.spec.node_name:
+                self.cache.remove_pod(old)
+                self.queue.move_all_to_active_or_backoff_queue(qevents.POD_DELETE)
+            elif old is not None:
+                self.queue.delete(old)
+
+    def _on_node_event(self, event: str, old: Optional[Node], new: Optional[Node]) -> None:
+        if event == ADDED:
+            self.cache.add_node(new)
+            self.queue.move_all_to_active_or_backoff_queue(qevents.NODE_ADD)
+        elif event == MODIFIED:
+            self.cache.update_node(new)
+            ev = self._node_scheduling_properties_change(old, new)
+            if ev is not None:
+                self.queue.move_all_to_active_or_backoff_queue(ev)
+        elif event == DELETED:
+            self.cache.remove_node(old.meta.name)
+
+    @staticmethod
+    def _node_scheduling_properties_change(old: Node, new: Node) -> Optional[ClusterEvent]:
+        """eventhandlers.go:423: minimal ClusterEvent from a node diff."""
+        if old is None:
+            return qevents.NODE_ADD
+        if old.status.allocatable != new.status.allocatable:
+            return ClusterEvent(NODE, UPDATE_NODE_ALLOCATABLE, "NodeAllocatableChange")
+        if old.meta.labels != new.meta.labels:
+            return ClusterEvent(NODE, UPDATE_NODE_LABEL, "NodeLabelChange")
+        if old.spec.taints != new.spec.taints or old.spec.unschedulable != new.spec.unschedulable:
+            return ClusterEvent(NODE, UPDATE_NODE_TAINT, "NodeTaintChange")
+        if old.status.ready != new.status.ready:
+            return ClusterEvent(NODE, UPDATE_NODE_CONDITION, "NodeConditionChange")
+        return None
+
+    def _responsible_for(self, pod: Pod) -> bool:
+        return pod.spec.scheduler_name in self.profiles
+
+    def framework_for_pod(self, pod: Pod) -> Framework:
+        return self.profiles[pod.spec.scheduler_name]
+
+    # ----------------------------------------------------------- the cycle
+
+    def schedule_one(self) -> bool:
+        """One scheduling cycle (schedule_one.go:66). Returns False when the
+        active queue is empty."""
+        self._periodic_housekeeping()
+        qp = self.queue.pop()
+        if qp is None:
+            return False
+        pod = self.store.get_pod(qp.pod.key())
+        if pod is None or pod.spec.node_name or not self._responsible_for(pod):
+            return True  # skipPodSchedule (:285): deleted/bound meanwhile
+        qp.pod = pod
+        fwk = self.framework_for_pod(pod)
+        self.metrics["schedule_attempts"] += 1
+        pod_cycle = self.queue.scheduling_cycle
+        state = CycleState()
+
+        try:
+            node_name = self.schedule_pod(fwk, state, pod)
+        except FitError as fe:
+            self._handle_scheduling_failure(fwk, state, qp, Status.unschedulable(*fe.args), fe.diagnosis, pod_cycle)
+            return True
+        except Exception as e:  # noqa: BLE001 — cycle errors re-enqueue the pod
+            self.metrics["errors"] += 1
+            self._handle_scheduling_failure(fwk, state, qp, Status.error(str(e)), Diagnosis(), pod_cycle)
+            return True
+
+        # assume (schedule_one.go:734): next cycle sees this pod immediately;
+        # the clone (with node_name set by assume_pod) is what every later
+        # extension point receives, like the reference's assumedPod
+        assumed = pod.clone()
+        self.cache.assume_pod(assumed, node_name)
+        fwk.nominator.delete_nominated_pod_if_exists(pod)
+
+        status = fwk.run_reserve_plugins_reserve(state, assumed, node_name)
+        if status.is_success():
+            status = fwk.run_permit_plugins(state, assumed, node_name)
+        if status.code == fw.WAIT:
+            # park: stays assumed; binding resumes on allow_waiting_pod
+            # (runtime/waiting_pods_map.go; WaitOnPermit schedule_one.go:199)
+            self.waiting_pods[assumed.key()] = (fwk, state, assumed, node_name, pod_cycle)
+            return True
+        if not status.is_success():
+            fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
+            self.cache.forget_pod(assumed)
+            self._handle_scheduling_failure(fwk, state, qp, status, Diagnosis(), pod_cycle)
+            return True
+
+        self._binding_cycle(fwk, state, qp, assumed, node_name, pod_cycle)
+        return True
+
+    def allow_waiting_pod(self, pod_key: str) -> bool:
+        """Approve a Permit-parked pod: continue its binding cycle."""
+        entry = self.waiting_pods.pop(pod_key, None)
+        if entry is None:
+            return False
+        fwk, state, assumed, node_name, pod_cycle = entry
+        self._binding_cycle(fwk, state, QueuedPodInfo(pod=assumed), assumed, node_name, pod_cycle)
+        return True
+
+    def reject_waiting_pod(self, pod_key: str) -> bool:
+        entry = self.waiting_pods.pop(pod_key, None)
+        if entry is None:
+            return False
+        fwk, state, assumed, node_name, pod_cycle = entry
+        fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
+        self.cache.forget_pod(assumed)
+        self._handle_scheduling_failure(
+            fwk, state, QueuedPodInfo(pod=assumed), Status.unschedulable("pod rejected while waiting on permit"),
+            Diagnosis(), pod_cycle,
+        )
+        return True
+
+    def _periodic_housekeeping(self) -> None:
+        """The reference's background tickers, driven inline: assume-expiry
+        sweep (1s, cache.go:731) and the unschedulable-timeout flush (30s,
+        scheduling_queue.go:463)."""
+        now = self.now_fn()
+        if now - self._last_cleanup >= 1.0:
+            self._last_cleanup = now
+            for pod in self.cache.cleanup(now):
+                current = self.store.get_pod(pod.key())
+                if current is not None and not current.spec.node_name:
+                    self.queue.add(current)
+        if now - self._last_unsched_flush >= 30.0:
+            self._last_unsched_flush = now
+            self.queue.flush_unschedulable_left_over()
+
+    def _binding_cycle(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, assumed: Pod, node_name: str, pod_cycle: int) -> None:
+        """(schedule_one.go:193) — synchronous here; the perf harness measures
+        end-to-end anyway and the in-process store makes binds cheap."""
+        status = fwk.run_pre_bind_plugins(state, assumed, node_name)
+        if status.is_success():
+            status = fwk.run_bind_plugins(state, assumed, node_name)
+        if not status.is_success():
+            fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
+            self.cache.forget_pod(assumed)
+            self._handle_scheduling_failure(fwk, state, qp, status, Diagnosis(), pod_cycle)
+            return
+        self.cache.finish_binding(assumed)
+        self.metrics["scheduled"] += 1
+        fwk.run_post_bind_plugins(state, assumed, node_name)
+
+    def schedule_pod(self, fwk: Framework, state: CycleState, pod: Pod) -> str:
+        """(schedule_one.go:311) returns the chosen node name or raises FitError."""
+        self.cache.update_snapshot(self.snapshot)
+        all_nodes = self.snapshot.list()
+        if not all_nodes:
+            raise FitError(pod, 0, Diagnosis())
+
+        feasible, diagnosis = self.find_nodes_that_fit_pod(fwk, state, pod, all_nodes)
+        if not feasible:
+            raise FitError(pod, len(all_nodes), diagnosis)
+        if len(feasible) == 1:
+            return feasible[0].node.meta.name
+
+        fwk.run_pre_score_plugins(state, pod, [ni.node for ni in feasible])
+        totals = fwk.run_score_plugins(state, pod, feasible)
+        return self._select_host(totals)
+
+    def find_nodes_that_fit_pod(self, fwk: Framework, state: CycleState, pod: Pod, all_nodes) -> Tuple[List, Diagnosis]:
+        """(schedule_one.go:364) PreFilter → (restricted) node list → filters
+        with adaptive sampling + round-robin start (:449-:545)."""
+        diagnosis = Diagnosis()
+        result, status = fwk.run_pre_filter_plugins(state, pod)
+        if not status.is_success():
+            if status.is_unschedulable():
+                diagnosis.unschedulable_plugins.add(status.plugin)
+                for ni in all_nodes:
+                    diagnosis.node_to_status[ni.node.meta.name] = status
+                raise FitError(pod, len(all_nodes), diagnosis)
+            raise RuntimeError(f"prefilter error: {status}")
+
+        nodes = all_nodes
+        if result is not None and not result.all_nodes():
+            nodes = [ni for ni in all_nodes if ni.node.meta.name in result.node_names]
+
+        num_to_find = self.num_feasible_nodes_to_find(len(nodes))
+        feasible = []
+        checked = 0
+        start = self.next_start_node_index % len(nodes) if nodes else 0
+        for i in range(len(nodes)):
+            ni = nodes[(start + i) % len(nodes)]
+            checked += 1
+            st = fwk.run_filter_plugins_with_nominated_pods(state, pod, ni)
+            if st.is_success():
+                feasible.append(ni)
+                if len(feasible) >= num_to_find:
+                    break
+            else:
+                diagnosis.node_to_status[ni.node.meta.name] = st
+                diagnosis.unschedulable_plugins.add(st.plugin)
+        self.next_start_node_index = (start + checked) % len(nodes) if nodes else 0
+        return feasible, diagnosis
+
+    def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
+        """Adaptive sampling (:525): 100% under 100 nodes; else
+        percentageOfNodesToScore or adaptive 50 − N/125, floored at 5%."""
+        if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or self.percentage_of_nodes_to_score >= 100:
+            return num_all_nodes
+        pct = self.percentage_of_nodes_to_score
+        if pct == 0:
+            pct = int(50 - num_all_nodes / 125)
+            if pct < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+                pct = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+        num = num_all_nodes * pct // 100
+        if num < MIN_FEASIBLE_NODES_TO_FIND:
+            return MIN_FEASIBLE_NODES_TO_FIND
+        return num
+
+    def _select_host(self, totals: Dict[str, int]) -> str:
+        """(schedule_one.go:709) argmax + reservoir uniform tie-break."""
+        best_score = None
+        winner = None
+        cnt = 0
+        for name, score in totals.items():
+            if best_score is None or score > best_score:
+                best_score, winner, cnt = score, name, 1
+            elif score == best_score:
+                cnt += 1
+                if self.rng.random() < 1.0 / cnt:
+                    winner = name
+        return winner
+
+    def _handle_scheduling_failure(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, status: Status, diagnosis: Diagnosis, pod_cycle: int) -> None:
+        """(schedule_one.go:812 + scheduler.go:352 MakeDefaultErrorFunc):
+        try PostFilter (preemption) on fit errors, then re-enqueue w/ backoff."""
+        pod = qp.pod
+        nominated_node = ""
+        if status.is_unschedulable():
+            self.metrics["unschedulable"] += 1
+            if diagnosis.node_to_status and fwk.points.get("post_filter"):
+                nominated, pf_status = fwk.run_post_filter_plugins(state, pod, diagnosis.node_to_status)
+                if pf_status.is_success() and nominated:
+                    nominated_node = nominated
+        if nominated_node:
+            fwk.nominator.add_nominated_pod(pod, nominated_node)
+            try:
+                self.store.update_pod_nominated_node(pod.key(), nominated_node)
+            except Exception:  # noqa: BLE001 — pod vanished; drop nomination
+                fwk.nominator.delete_nominated_pod_if_exists(pod)
+        # re-check existence/binding before re-queueing (MakeDefaultErrorFunc)
+        current = self.store.get_pod(pod.key())
+        if current is None or current.spec.node_name:
+            return
+        qp.pod = current
+        qp.unschedulable_plugins = set(diagnosis.unschedulable_plugins)
+        self.queue.add_unschedulable_if_not_present(qp, pod_cycle)
+
+    # ----------------------------------------------------------- driving
+
+    def run_until_settled(self, max_cycles: int = 100000, flush: bool = True) -> int:
+        """Drive schedule_one until the active queue drains (test/perf helper;
+        the reference's sched.Run loop is wait.Until on scheduleOne)."""
+        cycles = 0
+        while cycles < max_cycles:
+            if not self.schedule_one():
+                if flush:
+                    self.queue.flush_backoff_completed()
+                    if self.queue.pending_pods()["active"] > 0:
+                        continue
+                break
+            cycles += 1
+        return cycles

@@ -1,0 +1,21 @@
+"""Injectable clock (testing/clock analog): real monotonic by default, a
+manually-advanced FakeClock in tests so backoff expiry is deterministic."""
+
+from __future__ import annotations
+
+import time
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0):
+        self._now = start
+
+    def __call__(self) -> float:
+        return self._now
+
+    def advance(self, seconds: float) -> None:
+        self._now += seconds
+
+
+def monotonic() -> float:
+    return time.monotonic()

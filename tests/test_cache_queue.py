@@ -1,0 +1,132 @@
+"""Unit tests for cache assume/expire and queue mechanics (the analog of
+internal/cache/cache_test.go and internal/queue/scheduling_queue_test.go)."""
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.cache import Cache, Snapshot
+from kubernetes_tpu.framework.types import ClusterEvent, NODE, ADD, QueuedPodInfo
+from kubernetes_tpu.queue import SchedulingQueue
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+class TestCache:
+    def test_assume_confirm(self):
+        clock = FakeClock()
+        c = Cache(ttl=30, now_fn=clock)
+        c.add_node(make_node("n1").capacity({"cpu": "4", "pods": 10}).obj())
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        c.assume_pod(pod.clone(), "n1")
+        assert c.nodes["n1"].requested.milli_cpu == 1000
+        c.finish_binding(pod)
+        # informer confirmation before TTL: assumption becomes durable
+        bound = pod.clone()
+        bound.spec.node_name = "n1"
+        c.add_pod(bound)
+        clock.advance(60)
+        assert c.cleanup() == []
+        assert c.nodes["n1"].requested.milli_cpu == 1000
+
+    def test_assume_expiry(self):
+        clock = FakeClock()
+        c = Cache(ttl=30, now_fn=clock)
+        c.add_node(make_node("n1").capacity({"cpu": "4", "pods": 10}).obj())
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        c.assume_pod(pod.clone(), "n1")
+        c.finish_binding(pod)
+        clock.advance(31)
+        expired = c.cleanup()
+        assert [p.key() for p in expired] == ["default/p"]
+        assert c.nodes["n1"].requested.milli_cpu == 0
+
+    def test_forget_rolls_back(self):
+        c = Cache()
+        c.add_node(make_node("n1").capacity({"cpu": "4", "pods": 10}).obj())
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        c.assume_pod(pod.clone(), "n1")
+        c.forget_pod(pod)
+        assert c.nodes["n1"].requested.milli_cpu == 0
+
+    def test_incremental_snapshot_only_clones_dirty(self):
+        c = Cache()
+        c.add_node(make_node("n1").capacity({"cpu": "4", "pods": 10}).obj())
+        c.add_node(make_node("n2").capacity({"cpu": "4", "pods": 10}).obj())
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        n2_before = snap.node_info_map["n2"]
+        c.assume_pod(make_pod("p").req({"cpu": "1"}).obj().clone(), "n1")
+        c.update_snapshot(snap)
+        assert snap.node_info_map["n2"] is n2_before  # untouched node not re-cloned
+        assert snap.node_info_map["n1"].requested.milli_cpu == 1000
+
+    def test_snapshot_node_removal(self):
+        c = Cache()
+        c.add_node(make_node("n1").capacity({"cpu": "4", "pods": 10}).obj())
+        snap = Snapshot()
+        c.update_snapshot(snap)
+        assert "n1" in snap.node_info_map
+        c.remove_node("n1")
+        c.update_snapshot(snap)
+        assert "n1" not in snap.node_info_map
+
+
+class TestQueue:
+    def mkq(self, clock=None, event_map=None):
+        return SchedulingQueue(cluster_event_map=event_map or {}, now_fn=clock or FakeClock())
+
+    def test_priority_pop_order(self):
+        q = self.mkq()
+        q.add(make_pod("lo").priority(1).obj())
+        q.add(make_pod("hi").priority(9).obj())
+        assert q.pop().pod.meta.name == "hi"
+        assert q.pop().pod.meta.name == "lo"
+
+    def test_backoff_doubling(self):
+        q = self.mkq()
+        qp = QueuedPodInfo(pod=make_pod("p").obj())
+        qp.attempts = 1
+        assert q._backoff_duration(qp) == 1.0
+        qp.attempts = 3
+        assert q._backoff_duration(qp) == 4.0
+        qp.attempts = 10
+        assert q._backoff_duration(qp) == 10.0  # capped
+
+    def test_event_gated_reactivation(self):
+        clock = FakeClock()
+        ev_interest = ClusterEvent(NODE, ADD)
+        q = self.mkq(clock, {ev_interest: {"NodeResourcesFit"}})
+        qp = q_pod = QueuedPodInfo(pod=make_pod("p").obj())
+        qp.attempts = 1
+        qp.unschedulable_plugins = {"TaintToleration"}  # different plugin
+        q.add_unschedulable_if_not_present(qp, 0)
+        assert q.move_all_to_active_or_backoff_queue(ClusterEvent(NODE, ADD, "NodeAdd")) == 0
+        qp.unschedulable_plugins = {"NodeResourcesFit"}
+        assert q.move_all_to_active_or_backoff_queue(ClusterEvent(NODE, ADD, "NodeAdd")) == 1
+
+    def test_update_unknown_pod_falls_through_to_active(self):
+        q = self.mkq()
+        pod = make_pod("ghost").obj()
+        q.update(None, pod)  # never seen before -> activeQ
+        assert q.pop().pod.meta.name == "ghost"
+
+    def test_move_request_cycle_race_guard(self):
+        clock = FakeClock()
+        q = self.mkq(clock)
+        q.add(make_pod("p").obj())
+        qp = q.pop()  # scheduling_cycle -> 1
+        cycle = q.scheduling_cycle
+        # a move request fires while the pod's cycle is in flight
+        q.move_all_to_active_or_backoff_queue(ClusterEvent(NODE, ADD, "NodeAdd"))
+        q.add_unschedulable_if_not_present(qp, cycle)
+        # guarded: pod must land in backoff, not unschedulable
+        assert q.pending_pods()["backoff"] == 1
+        assert q.pending_pods()["unschedulable"] == 0
+
+    def test_flush_unschedulable_leftover(self):
+        clock = FakeClock()
+        q = self.mkq(clock)
+        qp = QueuedPodInfo(pod=make_pod("p").obj(), timestamp=clock())
+        qp.attempts = 1
+        q.add_unschedulable_if_not_present(qp, 0)
+        clock.advance(301)
+        q.flush_unschedulable_left_over()
+        assert q.pending_pods()["unschedulable"] == 0
+        assert q.pop() is not None

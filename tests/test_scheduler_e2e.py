@@ -1,0 +1,192 @@
+"""End-to-end scheduler tests against the in-process cluster store —
+the analog of test/integration/scheduler/ (real scheduler, real queue/cache,
+no kubelet: pods only get bound)."""
+
+from kubernetes_tpu.api.types import LabelSelector
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def mkcluster(n_nodes=4, cpu="4", mem="8Gi", pods=110):
+    store = ClusterStore()
+    clock = FakeClock()
+    sched = Scheduler(store, now_fn=clock)
+    sched.clock = clock
+    for i in range(n_nodes):
+        store.create_node(
+            make_node(f"node-{i}").capacity({"cpu": cpu, "memory": mem, "pods": pods})
+            .label("zone", f"z{i % 2}").obj()
+        )
+    return store, sched
+
+
+def settle(sched, rounds=3):
+    """Drain; between rounds advance past the max backoff so moved pods leave
+    backoffQ deterministically."""
+    for _ in range(rounds):
+        sched.run_until_settled()
+        sched.clock.advance(10.1)
+    sched.run_until_settled()
+
+
+def bound_pods(store):
+    return {k: p.spec.node_name for k, p in store.pods.items() if p.spec.node_name}
+
+
+class TestBasicScheduling:
+    def test_all_pods_bound(self):
+        store, sched = mkcluster(4)
+        for i in range(12):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "500m", "memory": "512Mi"}).obj())
+        settle(sched)
+        assert len(bound_pods(store)) == 12
+        assert sched.metrics["scheduled"] == 12
+
+    def test_spreads_by_least_allocated(self):
+        store, sched = mkcluster(4)
+        for i in range(8):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+        settle(sched)
+        per_node = {}
+        for _k, n in bound_pods(store).items():
+            per_node[n] = per_node.get(n, 0) + 1
+        # LeastAllocated balances: every node gets exactly 2
+        assert sorted(per_node.values()) == [2, 2, 2, 2]
+
+    def test_unschedulable_stays_pending(self):
+        store, sched = mkcluster(1, cpu="2")
+        store.create_pod(make_pod("big").req({"cpu": "4"}).obj())
+        settle(sched)
+        assert bound_pods(store) == {}
+        assert sched.metrics["unschedulable"] >= 1
+        assert len(sched.queue) == 1
+
+    def test_node_add_reactivates_unschedulable(self):
+        store, sched = mkcluster(1, cpu="2")
+        store.create_pod(make_pod("big").req({"cpu": "4"}).obj())
+        settle(sched)
+        assert bound_pods(store) == {}
+        # a new big node fires NodeAdd -> NodeResourcesFit registered interest
+        store.create_node(make_node("big-node").capacity({"cpu": "8", "memory": "8Gi", "pods": 10}).obj())
+        settle(sched)
+        assert bound_pods(store) == {"default/big": "big-node"}
+
+    def test_pod_delete_reactivates(self):
+        store, sched = mkcluster(1, cpu="2", pods=10)
+        store.create_pod(make_pod("holder").req({"cpu": "2"}).obj())
+        settle(sched)
+        store.create_pod(make_pod("waiter").req({"cpu": "2"}).obj())
+        settle(sched)
+        assert "default/waiter" not in bound_pods(store)
+        store.delete_pod("default/holder")
+        settle(sched)
+        assert bound_pods(store).get("default/waiter") == "node-0"
+
+    def test_priority_order(self):
+        store, sched = mkcluster(1, cpu="2", pods=10)
+        # both pending before any cycle runs; only one fits
+        store.create_pod(make_pod("low").priority(1).req({"cpu": "2"}).obj())
+        store.create_pod(make_pod("high").priority(100).req({"cpu": "2"}).obj())
+        settle(sched)
+        assert bound_pods(store).get("default/high") == "node-0"
+        assert "default/low" not in bound_pods(store)
+
+    def test_skip_already_bound(self):
+        store, sched = mkcluster(1)
+        store.create_pod(make_pod("p").node("node-0").obj())  # arrives pre-bound
+        settle(sched)
+        assert sched.metrics["schedule_attempts"] == 0
+
+
+class TestPluginsE2E:
+    def test_taints_and_tolerations(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = Scheduler(store, now_fn=clock)
+        sched.clock = clock
+        store.create_node(make_node("tainted").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                          .taint("dedicated", "gpu", "NoSchedule").obj())
+        store.create_node(make_node("open").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        store.create_pod(make_pod("normal").req({"cpu": "1"}).obj())
+        store.create_pod(make_pod("gpu-ok").req({"cpu": "1"})
+                         .toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule")
+                         .node_selector({"kubernetes.io/hostname": "tainted"}).obj())
+        settle(sched)
+        b = bound_pods(store)
+        assert b["default/normal"] == "open"
+        assert b["default/gpu-ok"] == "tainted"
+
+    def test_node_affinity_e2e(self):
+        store, sched = mkcluster(4)
+        store.create_pod(make_pod("pinned").node_affinity_in("zone", ["z1"]).obj())
+        settle(sched)
+        node = bound_pods(store)["default/pinned"]
+        assert node in ("node-1", "node-3")
+
+    def test_topology_spread_e2e(self):
+        store, sched = mkcluster(4)
+        sel = LabelSelector(match_labels={"app": "web"})
+        for i in range(4):
+            store.create_pod(
+                make_pod(f"web-{i}").label("app", "web").req({"cpu": "100m"})
+                .spread_constraint(1, "zone", selector=sel).obj()
+            )
+        settle(sched)
+        zones = {}
+        for _k, n in bound_pods(store).items():
+            z = store.nodes[n].meta.labels["zone"]
+            zones[z] = zones.get(z, 0) + 1
+        assert zones == {"z0": 2, "z1": 2}  # maxSkew 1 forces even split
+
+    def test_pod_anti_affinity_e2e(self):
+        store, sched = mkcluster(4)
+        sel = LabelSelector(match_labels={"app": "db"})
+        for i in range(4):
+            store.create_pod(
+                make_pod(f"db-{i}").label("app", "db").req({"cpu": "100m"})
+                .pod_affinity("kubernetes.io/hostname", sel, anti=True).obj()
+            )
+        settle(sched)
+        nodes = list(bound_pods(store).values())
+        assert len(set(nodes)) == 4  # one per node
+
+    def test_pod_affinity_colocation(self):
+        store, sched = mkcluster(4)
+        store.create_pod(make_pod("db").label("app", "db").req({"cpu": "100m"}).obj())
+        settle(sched)
+        db_node = bound_pods(store)["default/db"]
+        db_zone = store.nodes[db_node].meta.labels["zone"]
+        store.create_pod(
+            make_pod("web").req({"cpu": "100m"})
+            .pod_affinity("zone", LabelSelector(match_labels={"app": "db"})).obj()
+        )
+        settle(sched)
+        web_node = bound_pods(store)["default/web"]
+        assert store.nodes[web_node].meta.labels["zone"] == db_zone
+
+
+class TestCacheBehavior:
+    def test_assume_visible_to_next_cycle(self):
+        # two pods, one node with capacity for one: the second must see the
+        # first's assumed resources and fail
+        store, sched = mkcluster(1, cpu="2", pods=10)
+        store.create_pod(make_pod("a").req({"cpu": "2"}).obj())
+        store.create_pod(make_pod("b").req({"cpu": "2"}).obj())
+        settle(sched)
+        assert len(bound_pods(store)) == 1
+
+    def test_incremental_snapshot_generation(self):
+        store, sched = mkcluster(2)
+        store.create_pod(make_pod("a").req({"cpu": "1"}).obj())
+        settle(sched)
+        sched.cache.update_snapshot(sched.snapshot)  # absorb post-cycle assume/confirm
+        g1 = sched.snapshot.generation
+        # no changes -> snapshot generation stable
+        sched.cache.update_snapshot(sched.snapshot)
+        assert sched.snapshot.generation == g1
+        store.create_pod(make_pod("b").req({"cpu": "1"}).obj())
+        settle(sched)
+        sched.cache.update_snapshot(sched.snapshot)
+        assert sched.snapshot.generation > g1
