@@ -1,0 +1,109 @@
+"""Benchmark: SchedulingBasic/5000Nodes (scheduler_perf's canonical large
+workload — BASELINE.md: 5000 nodes, 1000 init pods, 1000 measured pods).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+value        = TPU-batched path throughput (pods scheduled / second, measured
+               phase only, end-to-end through queue+cache+bind).
+vs_baseline  = speedup over the sequential reference-semantics path (the
+               oracle scheduler in this repo — the stand-in for the Go
+               kube-scheduler, which cannot run in this image; BASELINE.md
+               notes the reference publishes no absolute numbers and its
+               harness must be re-run on local hardware to get a baseline).
+               The sequential path is measured on a sample and reported as
+               pods/s on the same cluster.
+
+Env knobs: BENCH_NODES, BENCH_INIT_PODS, BENCH_PODS, BENCH_SEQ_PODS, BENCH_BATCH.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def build_cluster(store, n_nodes):
+    from kubernetes_tpu.api.wrappers import make_node
+
+    for i in range(n_nodes):
+        store.create_node(
+            make_node(f"node-{i}")
+            .capacity({"cpu": "32", "memory": "128Gi", "pods": 110})
+            .label("zone", f"zone-{i % 10}")
+            .label("region", f"region-{i % 3}")
+            .obj()
+        )
+
+
+def make_pods(store, name_prefix, n):
+    from kubernetes_tpu.api.wrappers import make_pod
+
+    for i in range(n):
+        store.create_pod(
+            make_pod(f"{name_prefix}-{i}")
+            .req({"cpu": "900m", "memory": "2Gi"})
+            .obj()
+        )
+
+
+def run_tpu(n_nodes, n_init, n_measured, batch):
+    from kubernetes_tpu.apiserver import ClusterStore
+    from kubernetes_tpu.backend import TPUScheduler
+
+    store = ClusterStore()
+    sched = TPUScheduler(store, batch_size=batch)
+    build_cluster(store, n_nodes)
+    make_pods(store, "init", n_init)
+    sched.run_until_settled()  # init phase + jit warmup
+    assert sched.metrics["scheduled"] == n_init, sched.metrics
+
+    make_pods(store, "meas", n_measured)
+    t0 = time.perf_counter()
+    sched.run_until_settled()
+    dt = time.perf_counter() - t0
+    assert sched.metrics["scheduled"] == n_init + n_measured, sched.metrics
+    return n_measured / dt
+
+
+def run_sequential(n_nodes, n_init, n_measured):
+    from kubernetes_tpu.apiserver import ClusterStore
+    from kubernetes_tpu.scheduler import Scheduler
+
+    store = ClusterStore()
+    sched = Scheduler(store)
+    build_cluster(store, n_nodes)
+    make_pods(store, "init", n_init)
+    sched.run_until_settled()
+    make_pods(store, "meas", n_measured)
+    t0 = time.perf_counter()
+    sched.run_until_settled()
+    dt = time.perf_counter() - t0
+    assert sched.metrics["scheduled"] == n_init + n_measured, sched.metrics
+    return n_measured / dt
+
+
+def main():
+    n_nodes = int(os.environ.get("BENCH_NODES", 5000))
+    n_init = int(os.environ.get("BENCH_INIT_PODS", 1000))
+    n_measured = int(os.environ.get("BENCH_PODS", 1000))
+    n_seq = int(os.environ.get("BENCH_SEQ_PODS", 100))
+    batch = int(os.environ.get("BENCH_BATCH", 128))
+
+    tpu_tput = run_tpu(n_nodes, n_init, n_measured, batch)
+    seq_tput = run_sequential(n_nodes, min(100, n_init), n_seq)
+
+    print(
+        json.dumps(
+            {
+                "metric": "scheduling_throughput SchedulingBasic/5000Nodes",
+                "value": round(tpu_tput, 2),
+                "unit": "pods/s",
+                "vs_baseline": round(tpu_tput / seq_tput, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

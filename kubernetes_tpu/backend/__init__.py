@@ -1,0 +1,3 @@
+from .device_state import DeviceState, caps_for_cluster  # noqa: F401
+from .batch import build_schedule_batch_fn, schedule_batch  # noqa: F401
+from .tpu_scheduler import TPUScheduler  # noqa: F401

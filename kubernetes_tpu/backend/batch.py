@@ -1,0 +1,233 @@
+"""The batched scheduling step — this framework's flagship compiled program.
+
+One device call schedules a whole pod micro-batch against the node snapshot:
+
+  1. STATIC phase (once per batch): selector-VM evaluation + the filter masks
+     and score components that cannot change intra-batch (labels, taints,
+     affinity, images — node properties no pod commit can alter).
+  2. COMMIT phase: ``lax.scan`` over the batch in queue order. Each step
+     computes the *dynamic* predicates (resource fit, ports) against the
+     evolving carry, normalizes scores over that pod's feasible set, picks the
+     winner (masked argmax + seeded uniform tie-break), and commits the pod's
+     resources/ports to its node — the reference's assume (schedule_one.go:734)
+     replayed inside the compiled program, which is what makes a K-pod batch
+     conflict-free without host round-trips.
+
+The scan's per-step work is O(N·R); the expensive [P,N]-shaped work stays in
+the vectorized static phase. Sequential semantic parity: the winner for pod k
+is chosen against exactly the state the reference's serial loop would see.
+
+SPMD: the same program runs under ``shard_map`` with the node axis sharded
+across a mesh (parallel/mesh.py). ``axis_name`` threads the three reduction
+points through collectives — normalize-max (pmax), winner selection
+(pmax + argmin-of-axis tie-break), and valid-node count (psum). Per scan step
+that is a handful of scalar collectives over ICI — the P1/P7-style node-axis
+sharding of SURVEY.md §2.7/§5.7, far cheaper than resharding score matrices.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops import filters, scores
+from ..ops.schema import ExprTable, NodeTensors, PodBatch
+from ..ops.select import NEG_INF
+
+# default plugin weights on the batched path (default_plugins.go:32-51; the
+# spread/interpod components join in the sig-count extension)
+DEFAULT_WEIGHTS = {
+    "NodeResourcesBalancedAllocation": 1.0,
+    "ImageLocality": 1.0,
+    "NodeResourcesFit": 1.0,
+    "NodeAffinity": 2.0,
+    "TaintToleration": 3.0,
+}
+
+
+class BatchResult(NamedTuple):
+    node_idx: jax.Array      # [P] int32 chosen GLOBAL slot, -1 = unschedulable
+    best_score: jax.Array    # [P] float32
+    any_feasible: jax.Array  # [P] bool
+    static_masks: Dict[str, jax.Array]  # plugin name -> [P, N] (for diagnosis)
+    fit_ok: jax.Array        # [P, N] resource fit at decision time
+    ports_ok: jax.Array      # [P, N] port availability at decision time
+
+
+def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
+    """[P, W] uint32: each pod's wanted-port ids as a bitset (for commit)."""
+    P, MP = pb.port_ids.shape
+    word_idx = (pb.port_ids >> 5).astype(jnp.int32)
+    bit = jnp.where(pb.port_ids > 0, jnp.uint32(1) << (pb.port_ids & 31).astype(jnp.uint32), 0)
+    out = jnp.zeros((P, words), jnp.uint32)
+    # ids are deduplicated at encode time, so add == bitwise-or here
+    return out.at[jnp.arange(P)[:, None], word_idx].add(bit)
+
+
+def _gmax(x, axis_name):
+    return x if axis_name is None else lax.pmax(x, axis_name)
+
+
+def _gmin(x, axis_name):
+    return x if axis_name is None else lax.pmin(x, axis_name)
+
+
+def _gsum(x, axis_name):
+    return x if axis_name is None else lax.psum(x, axis_name)
+
+
+def _normalize(raw: jax.Array, feasible: jax.Array, reverse: bool, axis_name=None) -> jax.Array:
+    """DefaultNormalizeScore over one pod's (global) feasible set."""
+    masked = jnp.where(feasible, raw, 0.0)
+    mx = _gmax(jnp.max(masked), axis_name)
+    scaled = jnp.floor(raw * 100.0 / jnp.maximum(mx, 1.0))
+    if reverse:
+        return jnp.where(mx == 0, 100.0, 100.0 - scaled)
+    return jnp.where(mx == 0, 0.0, scaled)
+
+
+def schedule_batch_core(
+    pb: PodBatch,
+    et: ExprTable,
+    nt: NodeTensors,
+    key: jax.Array,
+    weights_key: Tuple[Tuple[str, float], ...],
+    axis_name: Optional[str] = None,
+) -> BatchResult:
+    """The traceable body; nt's node axis may be a shard (axis_name set)."""
+    weights = dict(weights_key)
+    N = nt.capacity  # local shard size under shard_map
+    if axis_name is None:
+        slot_offset = jnp.int32(0)
+    else:
+        slot_offset = (lax.axis_index(axis_name) * N).astype(jnp.int32)
+
+    # ---- static phase -----------------------------------------------------
+    expr_match = filters.eval_exprs(et, nt)
+    if axis_name is not None:
+        # OP_NODE_NAME compares against global slot ids: shift the local iota
+        n_idx = jnp.arange(N, dtype=jnp.int32)[None, :] + slot_offset
+        name_mask = (pb.node_name[:, None] == -1) | (pb.node_name[:, None] == n_idx)
+    else:
+        name_mask = filters.filter_node_name(pb, nt)
+    static_masks = {
+        "NodeUnschedulable": filters.filter_unschedulable(pb, nt),
+        "NodeName": name_mask,
+        "TaintToleration": filters.filter_taints(pb, nt),
+        "NodeAffinity": filters.filter_node_affinity(pb, et, nt, expr_match),
+    }
+    static_ok = nt.valid[None, :] & pb.valid[:, None]
+    for m in static_masks.values():
+        static_ok = static_ok & m
+
+    taint_raw = scores.score_taint_toleration(pb, nt)            # [P, N]
+    affinity_raw = scores.score_node_affinity(pb, et, nt, expr_match)
+    total_nodes = jnp.maximum(_gsum(jnp.sum(nt.valid), axis_name), 1)
+    image_score = scores.score_image_locality(pb, nt, total_nodes=total_nodes)
+
+    jitter = jax.random.uniform(key, (pb.capacity, N), jnp.float32, 0.0, 0.5)
+    if axis_name is not None:
+        # decorrelate jitter across shards
+        jitter = jax.random.uniform(
+            jax.random.fold_in(key, lax.axis_index(axis_name)),
+            (pb.capacity, N), jnp.float32, 0.0, 0.5,
+        )
+
+    # ---- commit phase -----------------------------------------------------
+    pod_bits = _pod_port_bits(pb, nt.port_bits.shape[1])
+    alloc_f = nt.allocatable.astype(jnp.float32)                  # [N, R]
+
+    def step(carry, xs):
+        req_dyn, nz_dyn, port_dyn = carry
+        (p_req, p_nz, p_static_ok, p_taint, p_aff, p_img, p_bits, p_jitter, p_valid) = xs
+
+        free = nt.allocatable - req_dyn                           # [N, R]
+        fit_ok = jnp.all((p_req[None, :] <= free) | (p_req[None, :] == 0), axis=-1)
+        conflict = jnp.any(port_dyn & p_bits[None, :], axis=-1)
+        ports_ok = ~conflict
+        feasible = p_static_ok & fit_ok & ports_ok
+
+        # resource scores against the evolving requested state
+        nz_req = nz_dyn.astype(jnp.float32) + p_nz[None, :].astype(jnp.float32)
+        cap0, cap1 = alloc_f[:, 0], alloc_f[:, 1]
+        r0, r1 = nz_req[:, 0], nz_req[:, 1]
+        la0 = jnp.where((cap0 == 0) | (r0 > cap0), 0.0, jnp.floor((cap0 - r0) * 100.0 / jnp.maximum(cap0, 1.0)))
+        la1 = jnp.where((cap1 == 0) | (r1 > cap1), 0.0, jnp.floor((cap1 - r1) * 100.0 / jnp.maximum(cap1, 1.0)))
+        least_alloc = jnp.floor((la0 + la1) / 2.0)
+        f0 = jnp.where(cap0 == 0, 1.0, jnp.minimum(1.0, r0 / jnp.maximum(cap0, 1.0)))
+        f1 = jnp.where(cap1 == 0, 1.0, jnp.minimum(1.0, r1 / jnp.maximum(cap1, 1.0)))
+        balanced = jnp.floor((1.0 - jnp.abs(f0 - f1) / 2.0) * 100.0)
+
+        total = (
+            weights["NodeResourcesFit"] * least_alloc
+            + weights["NodeResourcesBalancedAllocation"] * balanced
+            + weights["TaintToleration"] * _normalize(p_taint, feasible, True, axis_name)
+            + weights["NodeAffinity"] * _normalize(p_aff, feasible, False, axis_name)
+            + weights["ImageLocality"] * p_img
+        )
+        eff = jnp.where(feasible, total + p_jitter, NEG_INF)
+        local_idx = jnp.argmax(eff).astype(jnp.int32)
+        local_best = eff[local_idx]
+        any_feasible = _gmax(jnp.any(feasible), axis_name) & p_valid
+
+        if axis_name is None:
+            mine = jnp.bool_(True)
+            global_idx = local_idx
+            best = total[local_idx]
+        else:
+            global_best = _gmax(local_best, axis_name)
+            axis = lax.axis_index(axis_name).astype(jnp.int32)
+            winner_axis = _gmin(jnp.where(local_best >= global_best, axis, jnp.int32(2**30)), axis_name)
+            mine = axis == winner_axis
+            global_idx = _gsum(jnp.where(mine, local_idx + slot_offset, 0), axis_name).astype(jnp.int32)
+            best = _gsum(jnp.where(mine, total[local_idx], 0.0), axis_name)
+
+        commit = any_feasible & mine
+        req_dyn = req_dyn.at[local_idx].add(jnp.where(commit, p_req, 0))
+        nz_dyn = nz_dyn.at[local_idx].add(jnp.where(commit, p_nz, 0))
+        port_dyn = port_dyn.at[local_idx].set(
+            jnp.where(commit, port_dyn[local_idx] | p_bits, port_dyn[local_idx])
+        )
+        out_idx = jnp.where(any_feasible, global_idx, -1)
+        return (req_dyn, nz_dyn, port_dyn), (out_idx, best, any_feasible, fit_ok, ports_ok)
+
+    xs = (
+        pb.req, pb.nonzero_req, static_ok, taint_raw, affinity_raw, image_score,
+        pod_bits, jitter, pb.valid,
+    )
+    carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits)
+    _, (node_idx, best, any_feasible, fit_ok, ports_ok) = lax.scan(step, carry0, xs)
+
+    return BatchResult(
+        node_idx=node_idx,
+        best_score=best,
+        any_feasible=any_feasible,
+        static_masks=static_masks,
+        fit_ok=fit_ok,
+        ports_ok=ports_ok,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("weights_key",))
+def schedule_batch(
+    pb: PodBatch,
+    et: ExprTable,
+    nt: NodeTensors,
+    key: jax.Array,
+    weights_key: Tuple[Tuple[str, float], ...] = tuple(sorted(DEFAULT_WEIGHTS.items())),
+) -> BatchResult:
+    return schedule_batch_core(pb, et, nt, key, weights_key)
+
+
+def build_schedule_batch_fn(weights: Dict[str, float] = None):
+    """Bind plugin weights statically; returns fn(pb, et, nt, key) -> BatchResult."""
+    wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+
+    def fn(pb, et, nt, key):
+        return schedule_batch(pb, et, nt, key, weights_key=wk)
+
+    return fn

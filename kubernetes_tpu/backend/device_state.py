@@ -1,0 +1,152 @@
+"""Device-resident cluster mirror with generation-keyed delta uploads.
+
+The TPU analog of the incremental snapshot (cache.go:198): the host tracks the
+last-uploaded generation per node slot; ``sync`` encodes only dirty NodeInfos
+into row blocks and applies them with one batched scatter per field —
+the `dynamic_update_slice` pipeline of SURVEY.md §7 step 3.
+
+Capacity growth: encoders raise CapacityError when a vocab/axis overflows; the
+caller rebuilds DeviceState with grown Capacities and resyncs from scratch
+(recompilation policy: double the offending axis — bucketed static shapes).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..cache.snapshot import Snapshot
+from ..framework.types import NodeInfo
+from ..ops.encode import CapacityError, ClusterEncoder
+from ..ops.schema import Capacities, INT_NONE, NodeTensors
+
+_ROW_FIELDS = (
+    ("valid", bool), ("unschedulable", bool),
+    ("allocatable", np.int32), ("requested", np.int32), ("nonzero_requested", np.int32),
+    ("label_val", np.int32), ("label_num", np.int32),
+    ("taint_key", np.int32), ("taint_val", np.int32), ("taint_effect", np.int32),
+    ("port_bits", np.uint32), ("image_bits", np.uint32),
+)
+
+
+class DeviceState:
+    def __init__(self, caps: Capacities):
+        self.caps = caps
+        self.encoder = ClusterEncoder(caps)
+        self.nt = self._empty_tensors()
+        self._uploaded_gen: Dict[str, int] = {}   # node name -> generation on device
+        self._image_counts: Dict[str, int] = {}   # image -> num nodes (host truth)
+        self._image_sizes: Dict[str, int] = {}
+        self._node_images: Dict[str, frozenset] = {}
+        self.syncs = 0
+        self.rows_uploaded = 0
+
+    def _empty_tensors(self) -> NodeTensors:
+        c = self.caps
+        z = np.zeros
+        return NodeTensors(
+            valid=jnp.asarray(z(c.nodes, bool)),
+            unschedulable=jnp.asarray(z(c.nodes, bool)),
+            allocatable=jnp.asarray(z((c.nodes, c.resources), np.int32)),
+            requested=jnp.asarray(z((c.nodes, c.resources), np.int32)),
+            nonzero_requested=jnp.asarray(z((c.nodes, c.resources), np.int32)),
+            label_val=jnp.asarray(z((c.nodes, c.label_keys), np.int32)),
+            label_num=jnp.asarray(np.full((c.nodes, c.label_keys), INT_NONE, np.int32)),
+            taint_key=jnp.asarray(z((c.nodes, c.taints), np.int32)),
+            taint_val=jnp.asarray(z((c.nodes, c.taints), np.int32)),
+            taint_effect=jnp.asarray(z((c.nodes, c.taints), np.int32)),
+            port_bits=jnp.asarray(z((c.nodes, c.port_words), np.uint32)),
+            image_bits=jnp.asarray(z((c.nodes, c.image_words), np.uint32)),
+            image_sizes=jnp.asarray(z(c.images, np.int32)),
+            image_num_nodes=jnp.asarray(z(c.images, np.int32)),
+        )
+
+    # ------------------------------------------------------------------ sync
+
+    def sync(self, snapshot: Snapshot) -> int:
+        """Upload rows for nodes whose generation advanced; returns number of
+        rows uploaded. Raises CapacityError when the cluster outgrows caps."""
+        dirty: List[Tuple[int, NodeInfo]] = []
+        current = set()
+        images_changed = False
+        for name, ni in snapshot.node_info_map.items():
+            current.add(name)
+            if self._uploaded_gen.get(name) == ni.generation:
+                continue
+            slot = self.encoder.node_slot(name)
+            dirty.append((slot, ni))
+            self._uploaded_gen[name] = ni.generation
+            images_changed |= self._track_images(name, ni)
+        # removed nodes: zero their rows
+        removed = [n for n in self._uploaded_gen if n not in current]
+        for name in removed:
+            del self._uploaded_gen[name]
+            slot = self.encoder.release_node_slot(name)
+            if slot is not None:
+                dirty.append((slot, NodeInfo()))  # empty row: valid=False
+            images_changed |= self._track_images(name, None)
+
+        if not dirty:
+            return 0
+        slots = np.array([s for s, _ in dirty], np.int32)
+        rows = [self.encoder.encode_node_row(ni) for _, ni in dirty]
+        updates = {}
+        for field, dtype in _ROW_FIELDS:
+            updates[field] = np.stack([r[field] for r in rows]).astype(dtype)
+        nt = self.nt
+        new_fields = {f: getattr(nt, f).at[jnp.asarray(slots)].set(jnp.asarray(v)) for f, v in updates.items()}
+        if images_changed:
+            sizes = np.zeros(self.caps.images, np.int32)
+            counts = np.zeros(self.caps.images, np.int32)
+            for img, cnt in self._image_counts.items():
+                iid = self.encoder.image_id(img)
+                counts[iid] = cnt
+                sizes[iid] = min(self._image_sizes.get(img, 0), 2**31 - 1)
+            new_fields["image_sizes"] = jnp.asarray(sizes)
+            new_fields["image_num_nodes"] = jnp.asarray(counts)
+        else:
+            new_fields["image_sizes"] = nt.image_sizes
+            new_fields["image_num_nodes"] = nt.image_num_nodes
+        self.nt = NodeTensors(**new_fields)
+        self.syncs += 1
+        self.rows_uploaded += len(dirty)
+        return len(dirty)
+
+    def _track_images(self, name: str, ni: Optional[NodeInfo]) -> bool:
+        """Maintain global image num-node counts (first-seen size wins,
+        mirroring cache.addNodeImageStates). Returns True if vocab changed."""
+        old = self._node_images.get(name, frozenset())
+        new = frozenset(ni.image_states) if ni is not None else frozenset()
+        if old == new:
+            return False
+        for img in new - old:
+            self._image_counts[img] = self._image_counts.get(img, 0) + 1
+            if img not in self._image_sizes and ni is not None:
+                self._image_sizes[img] = ni.image_states[img]
+        for img in old - new:
+            c = self._image_counts.get(img, 0) - 1
+            if c <= 0:
+                self._image_counts.pop(img, None)
+            else:
+                self._image_counts[img] = c
+        if new:
+            self._node_images[name] = new
+        else:
+            self._node_images.pop(name, None)
+        return True
+
+    def slot_to_name(self) -> Dict[int, str]:
+        return {s: n for n, s in self.encoder.node_slots.items()}
+
+
+def caps_for_cluster(n_nodes: int, batch: int = 128) -> Capacities:
+    """Pick static capacities for a cluster size (node-count buckets 1k/5k/...;
+    hostname value vocab must cover every node)."""
+    nodes = 128
+    while nodes < n_nodes:
+        nodes *= 2
+    value_words = max(32, (nodes + 2 + 31) // 32)  # hostname vocab ≥ node count
+    return Capacities(nodes=nodes, pods=batch, value_words=value_words)

@@ -1,0 +1,251 @@
+"""TPUScheduler: the batched execution backend wired into the scheduler.
+
+Replaces the per-pod findNodesThatFitPod/prioritizeNodes middle of the cycle
+(schedule_one.go:364,:605) with one compiled device call per pod micro-batch;
+queue, cache, assume, bind, and failure handling are the same host machinery
+as the sequential path (the BASELINE.json north star, minus the gRPC hop —
+the control plane here is in-process Python rather than a Go sidecar peer).
+
+Flow per batch cycle:
+  1. drain up to `batch_size` pods from the queue in queue order;
+  2. update the cache snapshot; delta-sync the device mirror;
+  3. split batch-supported pods from fallback pods (features the kernel
+     doesn't cover yet go through the sequential oracle path — graceful
+     degradation, SURVEY.md §5.3 build mapping);
+  4. one `schedule_batch` call: static masks + in-scan sequential commit;
+  5. host: assume + bind winners in order; losers get reference-shaped
+     Diagnosis (first-failing-plugin per node, reconstructed from the masks
+     in filter config order) and re-queue with backoff.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..api.types import Pod
+from ..framework.interface import CycleState, Status
+from ..framework.types import Diagnosis, QueuedPodInfo
+from ..ops.encode import CapacityError
+from ..scheduler.scheduler import Scheduler
+from .batch import BatchResult, build_schedule_batch_fn
+from .device_state import DeviceState, caps_for_cluster
+
+# filter config order for failure attribution (default_plugins.go filter order)
+_ATTRIBUTION_ORDER = (
+    ("NodeUnschedulable", "node(s) were unschedulable"),
+    ("NodeName", "node(s) didn't match the requested node name"),
+    ("TaintToleration", "node(s) had untolerated taint"),
+    ("NodeAffinity", "node(s) didn't match Pod's node affinity/selector"),
+    ("NodePorts", "node(s) didn't have free ports for the requested pod ports"),
+    ("NodeResourcesFit", "Insufficient resources"),
+)
+
+
+class TPUScheduler(Scheduler):
+    def __init__(self, *args, batch_size: int = 128, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.batch_size = batch_size
+        self.device: Optional[DeviceState] = None
+        self.schedule_batch_fn = build_schedule_batch_fn()
+        self.batch_counter = 0
+        self.fallback_scheduled = 0
+        self.batch_scheduled = 0
+
+    # ------------------------------------------------------------- device mgmt
+
+    def _ensure_device(self) -> None:
+        n = max(self.cache.node_count(), 1)
+        if self.device is None:
+            self.device = DeviceState(caps_for_cluster(n, batch=self.batch_size))
+            self.device.sync(self.snapshot)
+        elif self.device.caps.nodes < n:
+            # preserve every previously-grown axis; only widen the node axis
+            # (and the hostname value vocab that must cover it)
+            import dataclasses
+
+            caps = self.device.caps
+            nodes = caps.nodes
+            while nodes < n:
+                nodes *= 2
+            caps = dataclasses.replace(
+                caps, nodes=nodes,
+                value_words=max(caps.value_words, (nodes + 2 + 31) // 32),
+            )
+            self.device = DeviceState(caps)
+            self.device.sync(self.snapshot)
+
+    # CapacityError.dimension → Capacities field(s) to double (exact names
+    # raised by ops/encode.py; "value vocab for 'key'" handled by prefix)
+    _GROW_FIELDS = {
+        "nodes": ("nodes",),
+        "pods": ("pods",),
+        "resources": ("resources",),
+        "label_keys": ("label_keys",),
+        "taints": ("taints",),
+        "tolerations": ("tolerations",),
+        "exprs": ("exprs",),
+        "sel_exprs": ("sel_exprs",),
+        "terms": ("terms",),
+        "term_exprs": ("term_exprs",),
+        "pref_terms": ("pref_terms",),
+        "ports": ("ports",),
+        "ports vocab": ("port_words",),
+        "image vocab": ("image_words", "images"),
+        "containers": ("containers",),
+    }
+
+    def _resync_grown(self, err: CapacityError) -> None:
+        """Grow exactly the offending capacity axis and rebuild the mirror."""
+        import dataclasses
+
+        caps = self.device.caps
+        fields = self._GROW_FIELDS.get(err.dimension)
+        if fields is None and err.dimension.startswith("value vocab"):
+            fields = ("value_words",)
+        if fields is None:
+            raise RuntimeError(f"unknown capacity dimension {err.dimension!r}") from err
+        updates = {}
+        for f in fields:
+            v = getattr(caps, f)
+            while v < err.needed:
+                v *= 2
+            updates[f] = v
+        self.device = DeviceState(dataclasses.replace(caps, **updates))
+        self.device.sync(self.snapshot)
+
+    # ------------------------------------------------------------- batch support
+
+    def batch_supported(self, pod: Pod) -> bool:
+        """Features the batched kernel covers today; the rest take the
+        sequential oracle path (config fallback knob, SURVEY.md §7)."""
+        if pod.spec.topology_spread_constraints:
+            return False
+        a = pod.spec.affinity
+        if a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None):
+            return False
+        # symmetric anti-affinity: existing pods with required anti-affinity
+        # can reject ANY incoming pod (interpodaffinity filtering.go:308) —
+        # until the sig-count kernel lands, such clusters stay sequential
+        if self.snapshot.have_pods_with_required_anti_affinity_list:
+            return False
+        return True
+
+    # ------------------------------------------------------------- the batch cycle
+
+    def schedule_batch_cycle(self) -> int:
+        """Schedule up to one micro-batch; returns pods processed.
+
+        Queue order is preserved across the batch/fallback split: pods are
+        walked in pop order, consecutive batch-supported pods accumulate into
+        one device call, and hitting a fallback pod first flushes the
+        accumulated batch — so a high-priority fallback pod never loses its
+        turn to lower-priority batched pods (reference strict-serial order)."""
+        self._periodic_housekeeping()
+        qps = self.queue.pop_batch(self.batch_size)
+        if not qps:
+            return 0
+        pod_cycle = self.queue.scheduling_cycle
+
+        buffer: List[QueuedPodInfo] = []
+        for qp in qps:
+            pod = self.store.get_pod(qp.pod.key())
+            if pod is None or pod.spec.node_name or not self._responsible_for(pod):
+                continue  # skipPodSchedule
+            qp.pod = pod
+            self.cache.update_snapshot(self.snapshot)
+            self._ensure_device()
+            if self.batch_supported(pod):
+                buffer.append(qp)
+                continue
+            self._flush_batch(buffer, pod_cycle)
+            buffer = []
+            self._schedule_fallback(qp, pod_cycle)
+        self._flush_batch(buffer, pod_cycle)
+        return len(qps)
+
+    def _flush_batch(self, batched: List[QueuedPodInfo], pod_cycle: int) -> None:
+        if not batched:
+            return
+        self.cache.update_snapshot(self.snapshot)
+        for _attempt in range(6):
+            try:
+                self.device.sync(self.snapshot)
+                pb, et = self.device.encoder.encode_pods([qp.pod for qp in batched])
+                break
+            except CapacityError as e:
+                self._resync_grown(e)
+        else:
+            for qp in batched:  # capacities refuse to converge
+                self._schedule_fallback(qp, pod_cycle)
+            return
+        self.batch_counter += 1
+        key = jax.random.PRNGKey(self.batch_counter)
+        result = self.schedule_batch_fn(pb, et, self.device.nt, key)
+        self._commit_batch(batched, result, pod_cycle)
+
+    def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult, pod_cycle: int) -> None:
+        node_idx = np.asarray(result.node_idx)
+        slot_names = self.device.slot_to_name()
+        masks = {k: np.asarray(v) for k, v in result.static_masks.items()}
+        masks["NodePorts"] = np.asarray(result.ports_ok)
+        masks["NodeResourcesFit"] = np.asarray(result.fit_ok)
+
+        for i, qp in enumerate(qps):
+            pod = qp.pod
+            fwk = self.framework_for_pod(pod)
+            self.metrics["schedule_attempts"] += 1
+            idx = int(node_idx[i])
+            if idx >= 0:
+                node_name = slot_names.get(idx)
+                if node_name is None:  # stale slot — should not happen
+                    self._fail(fwk, qp, Status.error(f"stale node slot {idx}"), pod_cycle)
+                    continue
+                state = CycleState()
+                fwk.run_pre_filter_plugins(state, pod)  # Reserve/Bind plugins may read it
+                self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle)
+                self.batch_scheduled += 1
+            else:
+                diagnosis = self._diagnose(i, masks, slot_names)
+                self._fail(fwk, qp, Status.unschedulable("no feasible node"), pod_cycle, diagnosis)
+
+    def _diagnose(self, i: int, masks: Dict[str, np.ndarray], slot_names: Dict[int, str]) -> Diagnosis:
+        """Reconstruct per-node first-failing plugin in filter config order so
+        failure messages and queue gating stay reference-shaped (SURVEY.md §8
+        'filter short-circuit semantics')."""
+        d = Diagnosis()
+        for slot, name in slot_names.items():
+            for plugin, reason in _ATTRIBUTION_ORDER:
+                m = masks.get(plugin)
+                if m is not None and not bool(m[i, slot]):
+                    d.node_to_status[name] = Status.unschedulable(reason).with_plugin(plugin)
+                    d.unschedulable_plugins.add(plugin)
+                    break
+        return d
+
+    def _fail(self, fwk, qp: QueuedPodInfo, status: Status, pod_cycle: int, diagnosis: Optional[Diagnosis] = None) -> None:
+        self._handle_scheduling_failure(fwk, CycleState(), qp, status, diagnosis or Diagnosis(), pod_cycle)
+
+    def _schedule_fallback(self, qp: QueuedPodInfo, pod_cycle: int) -> None:
+        """Sequential oracle path for pods the kernel doesn't cover."""
+        before = self.metrics["scheduled"]
+        self.schedule_one_pod(qp, pod_cycle)
+        if self.metrics["scheduled"] > before:
+            self.fallback_scheduled += 1
+
+    # ------------------------------------------------------------- driving
+
+    def run_until_settled(self, max_cycles: int = 100000, flush: bool = True) -> int:
+        cycles = 0
+        while cycles < max_cycles:
+            n = self.schedule_batch_cycle()
+            if n == 0:
+                if flush:
+                    self.queue.flush_backoff_completed()
+                    if self.queue.pending_pods()["active"] > 0:
+                        continue
+                break
+            cycles += n
+        return cycles

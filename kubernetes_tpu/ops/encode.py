@@ -397,6 +397,7 @@ class ClusterEncoder:
                 else:
                     wanted.append(self.port_id(ip, cp.protocol, cp.host_port))
                     wanted.append(self.port_id("0.0.0.0", cp.protocol, cp.host_port))
+            wanted = list(dict.fromkeys(wanted))  # dedupe (repeat hostPorts across containers)
             if len(wanted) > caps.ports:
                 raise CapacityError("ports", len(wanted), caps.ports)
             port_ids[p, : len(wanted)] = wanted

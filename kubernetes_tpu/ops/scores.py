@@ -103,13 +103,16 @@ _MIN_THRESHOLD = 23.0 * _MB
 _MAX_CONTAINER_THRESHOLD = 1000.0 * _MB
 
 
-def score_image_locality(pb: PodBatch, nt: NodeTensors) -> jax.Array:
-    """imagelocality: Σ_present size·numNodes/totalNodes, clamped+scaled."""
+def score_image_locality(pb: PodBatch, nt: NodeTensors, total_nodes=None) -> jax.Array:
+    """imagelocality: Σ_present size·numNodes/totalNodes, clamped+scaled.
+    ``total_nodes`` is injectable so the sharded path can psum it globally."""
     ids = pb.image_ids                                   # [P, C]
     word = nt.image_bits[:, ids >> 5]                    # [N, P, C]
     present = ((word >> (ids & 31).astype(jnp.uint32)) & 1).astype(jnp.float32)
     present = jnp.transpose(present, (1, 0, 2))          # [P, N, C]
-    total_nodes = jnp.maximum(jnp.sum(nt.valid), 1).astype(jnp.float32)
+    if total_nodes is None:
+        total_nodes = jnp.maximum(jnp.sum(nt.valid), 1)
+    total_nodes = jnp.asarray(total_nodes, jnp.float32)
     spread = nt.image_num_nodes[ids].astype(jnp.float32) / total_nodes  # [P, C]
     contrib = jnp.floor(nt.image_sizes[ids].astype(jnp.float32) * spread)
     sum_scores = jnp.sum(present * contrib[:, None, :], axis=-1)        # [P, N]

@@ -1,0 +1,1 @@
+from .mesh import make_node_mesh, make_sharded_schedule_fn, shard_node_tensors  # noqa: F401

@@ -1,0 +1,90 @@
+"""Node-axis sharding over a jax.sharding.Mesh.
+
+The reference's only hot-loop parallelism is a 16-goroutine chunked
+parallel-for over nodes (parallelize/parallelism.go:27); the TPU equivalent
+shards the node axis of the device mirror across the mesh and runs the SAME
+schedule_batch program under shard_map. Cross-device traffic per scan step is
+three scalar collectives (pmax of the best score, pmin of the winning axis
+index, psum of the winning global slot) riding ICI — the "per-shard
+filter+score+local-top-k, then tiny collective" pattern of SURVEY.md §5.7,
+not a resharding of any [P, N] matrix.
+
+Multi-slice/DCN (the 50k-node stretch) uses the same program over a mesh whose
+outer axis spans slices; nothing here is ICI-specific.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..backend.batch import DEFAULT_WEIGHTS, BatchResult, schedule_batch_core
+from ..ops.schema import ExprTable, NodeTensors, PodBatch
+
+AXIS = "nodes"
+
+# NodeTensors fields sharded on their node (first) axis; vocab-level arrays
+# (image sizes/spread) are replicated.
+_REPLICATED_NT_FIELDS = ("image_sizes", "image_num_nodes")
+
+
+def make_node_mesh(devices=None) -> Mesh:
+    import numpy as np
+
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(np.array(devices), (AXIS,))
+
+
+def _nt_specs() -> NodeTensors:
+    import dataclasses
+
+    fields = {}
+    for f in dataclasses.fields(NodeTensors):
+        fields[f.name] = P() if f.name in _REPLICATED_NT_FIELDS else P(AXIS)
+    return NodeTensors(**fields)
+
+
+def shard_node_tensors(nt: NodeTensors, mesh: Mesh) -> NodeTensors:
+    """Place a (host/global) NodeTensors onto the mesh, node axis sharded."""
+    import dataclasses
+
+    specs = _nt_specs()
+    out = {}
+    for f in dataclasses.fields(NodeTensors):
+        arr = getattr(nt, f.name)
+        out[f.name] = jax.device_put(arr, NamedSharding(mesh, getattr(specs, f.name)))
+    return NodeTensors(**out)
+
+
+def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = None):
+    """Compile schedule_batch over the mesh: node axis sharded, pods/exprs
+    replicated, results replicated (winner slots are global indices)."""
+    wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
+    import dataclasses
+
+    nt_spec = _nt_specs()
+    pb_spec = jax.tree_util.tree_map(lambda _: P(), PodBatch(**{
+        f.name: 0 for f in dataclasses.fields(PodBatch)
+    }))
+    et_spec = jax.tree_util.tree_map(lambda _: P(), ExprTable(op=0, key=0, val=0, bits=0))
+    out_spec = BatchResult(
+        node_idx=P(), best_score=P(), any_feasible=P(),
+        static_masks={
+            "NodeUnschedulable": P(None, AXIS), "NodeName": P(None, AXIS),
+            "TaintToleration": P(None, AXIS), "NodeAffinity": P(None, AXIS),
+        },
+        fit_ok=P(None, AXIS), ports_ok=P(None, AXIS),
+    )
+
+    body = functools.partial(schedule_batch_core, weights_key=wk, axis_name=AXIS)
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pb_spec, et_spec, nt_spec, P()),
+        out_specs=out_spec,
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -182,21 +182,30 @@ class Scheduler:
         if pod is None or pod.spec.node_name or not self._responsible_for(pod):
             return True  # skipPodSchedule (:285): deleted/bound meanwhile
         qp.pod = pod
+        self.schedule_one_pod(qp, self.queue.scheduling_cycle)
+        return True
+
+    def schedule_one_pod(self, qp: QueuedPodInfo, pod_cycle: int) -> None:
+        """Sequential scheduling of one pod: schedule_pod + failure handling +
+        assume/bind tail. Shared by schedule_one and the batch fallback path."""
+        pod = qp.pod
         fwk = self.framework_for_pod(pod)
         self.metrics["schedule_attempts"] += 1
-        pod_cycle = self.queue.scheduling_cycle
         state = CycleState()
-
         try:
             node_name = self.schedule_pod(fwk, state, pod)
         except FitError as fe:
             self._handle_scheduling_failure(fwk, state, qp, Status.unschedulable(*fe.args), fe.diagnosis, pod_cycle)
-            return True
+            return
         except Exception as e:  # noqa: BLE001 — cycle errors re-enqueue the pod
             self.metrics["errors"] += 1
             self._handle_scheduling_failure(fwk, state, qp, Status.error(str(e)), Diagnosis(), pod_cycle)
-            return True
+            return
+        self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle)
 
+    def assume_and_bind(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, pod: Pod, node_name: str, pod_cycle: int) -> None:
+        """The post-decision tail shared by the sequential and TPU-batched
+        paths: assume → Reserve → Permit → binding cycle."""
         # assume (schedule_one.go:734): next cycle sees this pod immediately;
         # the clone (with node_name set by assume_pod) is what every later
         # extension point receives, like the reference's assumedPod
@@ -211,15 +220,14 @@ class Scheduler:
             # park: stays assumed; binding resumes on allow_waiting_pod
             # (runtime/waiting_pods_map.go; WaitOnPermit schedule_one.go:199)
             self.waiting_pods[assumed.key()] = (fwk, state, assumed, node_name, pod_cycle)
-            return True
+            return
         if not status.is_success():
             fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
             self.cache.forget_pod(assumed)
             self._handle_scheduling_failure(fwk, state, qp, status, Diagnosis(), pod_cycle)
-            return True
+            return
 
         self._binding_cycle(fwk, state, qp, assumed, node_name, pod_cycle)
-        return True
 
     def allow_waiting_pod(self, pod_key: str) -> bool:
         """Approve a Permit-parked pod: continue its binding cycle."""

@@ -1,0 +1,185 @@
+"""Tests for the batched TPU backend: device delta sync, the schedule_batch
+kernel's sequential-commit semantics, and TPUScheduler end-to-end equivalence
+with the sequential oracle scheduler."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import LabelSelector
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler, DeviceState, caps_for_cluster
+from kubernetes_tpu.cache import Cache, Snapshot
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def mk_tpu_cluster(n_nodes=8, batch_size=16, **node_kw):
+    store = ClusterStore()
+    clock = FakeClock()
+    sched = TPUScheduler(store, now_fn=clock, batch_size=batch_size)
+    sched.clock = clock
+    for i in range(n_nodes):
+        store.create_node(
+            make_node(f"node-{i}")
+            .capacity({"cpu": node_kw.get("cpu", "4"), "memory": node_kw.get("mem", "8Gi"), "pods": node_kw.get("pods", 110)})
+            .label("zone", f"z{i % 2}").obj()
+        )
+    return store, sched
+
+
+def bound_pods(store):
+    return {k: p.spec.node_name for k, p in store.pods.items() if p.spec.node_name}
+
+
+class TestDeviceState:
+    def test_delta_sync_uploads_only_dirty(self):
+        cache = Cache()
+        for i in range(6):
+            cache.add_node(make_node(f"n{i}").capacity({"cpu": "4", "pods": 10}).obj())
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        dev = DeviceState(caps_for_cluster(6, batch=8))
+        assert dev.sync(snap) == 6
+        assert dev.sync(snap) == 0  # no changes
+        cache.assume_pod(make_pod("p").req({"cpu": "1"}).obj().clone(), "n3")
+        cache.update_snapshot(snap)
+        assert dev.sync(snap) == 1  # only n3 re-uploaded
+        slot = dev.encoder.node_slots["n3"]
+        assert int(np.asarray(dev.nt.requested)[slot, 0]) == 1000
+
+    def test_node_removal_invalidates_slot(self):
+        cache = Cache()
+        cache.add_node(make_node("n0").capacity({"cpu": "4", "pods": 10}).obj())
+        cache.add_node(make_node("n1").capacity({"cpu": "4", "pods": 10}).obj())
+        snap = Snapshot()
+        cache.update_snapshot(snap)
+        dev = DeviceState(caps_for_cluster(2, batch=8))
+        dev.sync(snap)
+        slot = dev.encoder.node_slots["n1"]
+        cache.remove_node("n1")
+        cache.update_snapshot(snap)
+        dev.sync(snap)
+        assert not bool(np.asarray(dev.nt.valid)[slot])
+
+
+class TestBatchKernelCommit:
+    def test_intra_batch_capacity_conflict_resolved(self):
+        # 1 node with room for exactly one pod; a batch of 3 identical pods:
+        # exactly one must win, on device, without host round-trips
+        store, sched = mk_tpu_cluster(1, cpu="2", batch_size=8)
+        for i in range(3):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "2"}).obj())
+        sched.run_until_settled()
+        assert len(bound_pods(store)) == 1
+        assert sched.batch_scheduled == 1
+
+    def test_intra_batch_port_conflict_resolved(self):
+        store, sched = mk_tpu_cluster(2, batch_size=8)
+        for i in range(3):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).host_port(8080).obj())
+        sched.run_until_settled()
+        b = bound_pods(store)
+        assert len(b) == 2  # one per node; third conflicts everywhere
+        assert len(set(b.values())) == 2
+
+    def test_batch_spreads_like_sequential(self):
+        store, sched = mk_tpu_cluster(4, batch_size=16)
+        for i in range(8):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+        sched.run_until_settled()
+        per_node = {}
+        for _k, n in bound_pods(store).items():
+            per_node[n] = per_node.get(n, 0) + 1
+        assert sorted(per_node.values()) == [2, 2, 2, 2]
+
+
+class TestTPUSchedulerE2E:
+    def test_mixed_workload_with_fallback(self):
+        store, sched = mk_tpu_cluster(4, batch_size=16)
+        sel = LabelSelector(match_labels={"app": "web"})
+        for i in range(6):
+            store.create_pod(make_pod(f"gen-{i}").req({"cpu": "250m"}).obj())
+        for i in range(4):
+            store.create_pod(  # spread pods take the sequential fallback path
+                make_pod(f"web-{i}").label("app", "web").req({"cpu": "100m"})
+                .spread_constraint(1, "zone", selector=sel).obj()
+            )
+        sched.run_until_settled()
+        assert len(bound_pods(store)) == 10
+        assert sched.batch_scheduled == 6
+        assert sched.fallback_scheduled == 4
+        zones = {}
+        for k, n in bound_pods(store).items():
+            if k.startswith("default/web"):
+                z = store.nodes[n].meta.labels["zone"]
+                zones[z] = zones.get(z, 0) + 1
+        assert zones == {"z0": 2, "z1": 2}
+
+    def test_unschedulable_diagnosis_and_reactivation(self):
+        store, sched = mk_tpu_cluster(2, cpu="2", batch_size=8)
+        store.create_pod(make_pod("big").req({"cpu": "16"}).obj())
+        sched.run_until_settled()
+        assert bound_pods(store) == {}
+        # diagnosis must gate reactivation on NodeResourcesFit events
+        assert sched.queue.pending_pods()["unschedulable"] == 1
+        store.create_node(make_node("xl").capacity({"cpu": "32", "memory": "64Gi", "pods": 10}).obj())
+        sched.clock.advance(10.1)
+        sched.run_until_settled()
+        assert bound_pods(store) == {"default/big": "xl"}
+
+    def test_taints_and_affinity_on_batch_path(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = TPUScheduler(store, now_fn=clock, batch_size=8)
+        sched.clock = clock
+        store.create_node(make_node("tainted").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                          .taint("dedicated", "gpu", "NoSchedule").label("zone", "z0").obj())
+        store.create_node(make_node("open").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                          .label("zone", "z1").obj())
+        store.create_pod(make_pod("normal").req({"cpu": "1"}).obj())
+        store.create_pod(make_pod("gpu-job").req({"cpu": "1"})
+                         .toleration(key="dedicated", operator="Equal", value="gpu", effect="NoSchedule")
+                         .node_affinity_in("zone", ["z0"]).obj())
+        sched.run_until_settled()
+        b = bound_pods(store)
+        assert b["default/normal"] == "open"
+        assert b["default/gpu-job"] == "tainted"
+        assert sched.fallback_scheduled == 0  # all on the batch path
+
+    def test_equivalence_with_sequential(self):
+        """Same cluster + workload through both schedulers: identical
+        feasibility outcomes and equally-optimal placements."""
+        def workload(store):
+            for i in range(12):
+                store.create_pod(make_pod(f"p{i}").req({"cpu": ["250m", "1", "2"][i % 3]}).obj())
+            store.create_pod(make_pod("huge").req({"cpu": "64"}).obj())
+
+        store_a = ClusterStore()
+        clock_a = FakeClock()
+        seq = Scheduler(store_a, now_fn=clock_a)
+        store_b, tpu = mk_tpu_cluster(4, batch_size=16)
+        for i in range(4):
+            store_a.create_node(make_node(f"node-{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 110})
+                                .label("zone", f"z{i % 2}").obj())
+        workload(store_a)
+        workload(store_b)
+        seq.run_until_settled()
+        tpu.run_until_settled()
+        a, b = bound_pods(store_a), bound_pods(store_b)
+        assert set(a) == set(b)  # same pods scheduled / unschedulable
+        # per-node load identical (placements may differ only within ties)
+        load_a = sorted(list(a.values()).count(f"node-{i}") for i in range(4))
+        load_b = sorted(list(b.values()).count(f"node-{i}") for i in range(4))
+        assert load_a == load_b
+
+    def test_capacity_growth_on_large_cluster(self):
+        store, sched = mk_tpu_cluster(4, batch_size=8)
+        for i in range(4, 200):  # outgrow the 128-slot default
+            store.create_node(make_node(f"node-{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 110})
+                              .label("zone", f"z{i % 2}").obj())
+        for i in range(20):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+        sched.run_until_settled()
+        assert len(bound_pods(store)) == 20
+        assert sched.device.caps.nodes >= 200
