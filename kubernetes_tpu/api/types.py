@@ -30,6 +30,7 @@ class ObjectMeta:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
+    deletion_timestamp: float = 0.0  # >0 ⇒ terminating (metav1 DeletionTimestamp)
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
@@ -279,6 +280,7 @@ class PodSpec:
     topology_spread_constraints: Tuple[TopologySpreadConstraint, ...] = ()
     priority: int = 0
     priority_class_name: str = ""
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never" (core/v1 PreemptionPolicy)
     scheduler_name: str = "default-scheduler"
     overhead: Dict[str, object] = field(default_factory=dict)
     volumes: Tuple[str, ...] = ()  # PVC names (volume subsystem modeled by claim name)
@@ -396,12 +398,60 @@ class PodDisruptionBudget:
     disruptions_allowed: int = 0
 
 
+# volume binding modes (storage/v1 StorageClass.VolumeBindingMode)
+BINDING_IMMEDIATE = "Immediate"
+BINDING_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+# access modes
+RWO = "ReadWriteOnce"
+RWX = "ReadWriteMany"
+ROX = "ReadOnlyMany"
+RWOP = "ReadWriteOncePod"
+
+
 @dataclass
 class PersistentVolumeClaim:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     storage_class: str = ""
     bound_pv: str = ""
     access_modes: Tuple[str, ...] = ()
+    requested_bytes: int = 0
+
+
+@dataclass
+class PersistentVolume:
+    """storage PV: capacity + node affinity via topology labels (the
+    reference keeps zone/region in PV labels; volumezone/volume_zone.go)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    capacity_bytes: int = 0
+    storage_class: str = ""
+    bound_pvc: str = ""  # claimRef as namespace/name
+    access_modes: Tuple[str, ...] = ()
+    # nodeAffinity reduced to required label matches (topology terms)
+    node_affinity: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+
+    def matches_node(self, node: "Node") -> bool:
+        for key, allowed in self.node_affinity.items():
+            if node.meta.labels.get(key) not in allowed:
+                return False
+        return True
+
+
+@dataclass
+class StorageClass:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    volume_binding_mode: str = BINDING_IMMEDIATE
+
+
+@dataclass
+class CSINode:
+    """storage/v1 CSINode: per-driver attachable volume limits
+    (nodevolumelimits/csi.go reads CSINode.Spec.Drivers[].Allocatable)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    drivers: Dict[str, int] = field(default_factory=dict)  # driver name -> max volumes
 
 
 @dataclass

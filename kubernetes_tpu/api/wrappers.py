@@ -77,6 +77,11 @@ class PodWrapper:
         self.pod.spec.node_name = name
         return self
 
+    def pvc(self, claim_name: str) -> "PodWrapper":
+        """Add a PVC-backed volume (testing/wrappers.go PVC)."""
+        self.pod.spec.volumes = self.pod.spec.volumes + (claim_name,)
+        return self
+
     def priority(self, p: int) -> "PodWrapper":
         self.pod.spec.priority = p
         return self

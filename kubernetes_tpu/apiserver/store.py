@@ -16,7 +16,18 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
-from ..api.types import Binding, Namespace, Node, Pod, PodDisruptionBudget, PriorityClass
+from ..api.types import (
+    Binding,
+    CSINode,
+    Namespace,
+    Node,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PodDisruptionBudget,
+    PriorityClass,
+    StorageClass,
+)
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -41,6 +52,10 @@ class ClusterStore:
         self.namespaces: Dict[str, Namespace] = {}
         self.pdbs: Dict[str, PodDisruptionBudget] = {}
         self.priority_classes: Dict[str, PriorityClass] = {}
+        self.pvs: Dict[str, PersistentVolume] = {}
+        self.pvcs: Dict[str, PersistentVolumeClaim] = {}
+        self.storage_classes: Dict[str, StorageClass] = {}
+        self.csinodes: Dict[str, CSINode] = {}
         self._handlers: Dict[str, List[Handler]] = {}
         self._rv = 0
 
@@ -151,7 +166,77 @@ class ClusterStore:
             self.pdbs[pdb.meta.key()] = pdb
         self._notify("PodDisruptionBudget", ADDED, None, pdb)
 
+    def list_pdbs(self) -> List[PodDisruptionBudget]:
+        with self._lock:
+            return list(self.pdbs.values())
+
     def create_priority_class(self, pc: PriorityClass) -> None:
         with self._lock:
             self.priority_classes[pc.meta.name] = pc
         self._notify("PriorityClass", ADDED, None, pc)
+
+    # ------------------------------------------------------------- storage kinds
+
+    def create_pv(self, pv: PersistentVolume) -> None:
+        with self._lock:
+            self._bump(pv)
+            self.pvs[pv.meta.name] = pv
+        self._notify("PersistentVolume", ADDED, None, pv)
+
+    def create_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        with self._lock:
+            self._bump(pvc)
+            self.pvcs[pvc.meta.key()] = pvc
+        self._notify("PersistentVolumeClaim", ADDED, None, pvc)
+
+    def create_storage_class(self, sc: StorageClass) -> None:
+        with self._lock:
+            self.storage_classes[sc.meta.name] = sc
+        self._notify("StorageClass", ADDED, None, sc)
+
+    def create_csinode(self, cn: CSINode) -> None:
+        with self._lock:
+            self.csinodes[cn.meta.name] = cn
+        self._notify("CSINode", ADDED, None, cn)
+
+    def get_pvc(self, key: str) -> Optional[PersistentVolumeClaim]:
+        with self._lock:
+            return self.pvcs.get(key)
+
+    def get_pv(self, name: str) -> Optional[PersistentVolume]:
+        with self._lock:
+            return self.pvs.get(name)
+
+    def list_pvs(self) -> List[PersistentVolume]:
+        with self._lock:
+            return list(self.pvs.values())
+
+    def get_storage_class(self, name: str) -> Optional[StorageClass]:
+        with self._lock:
+            return self.storage_classes.get(name)
+
+    def get_csinode(self, name: str) -> Optional[CSINode]:
+        with self._lock:
+            return self.csinodes.get(name)
+
+    def bind_pv(self, pv_name: str, pvc_key: str) -> None:
+        """PV controller's bind write: set claimRef + PVC.volumeName
+        transactionally (the PreBind path of volumebinding writes these)."""
+        with self._lock:
+            pv = self.pvs.get(pv_name)
+            pvc = self.pvcs.get(pvc_key)
+            if pv is None or pvc is None:
+                raise NotFound(f"{pv_name} / {pvc_key}")
+            if pv.bound_pvc and pv.bound_pvc != pvc_key:
+                raise Conflict(f"pv {pv_name} already bound to {pv.bound_pvc}")
+            old_pv, old_pvc = pv, pvc
+            import dataclasses as _dc
+
+            new_pv = _dc.replace(pv, bound_pvc=pvc_key)
+            new_pvc = _dc.replace(pvc, bound_pv=pv_name)
+            self._bump(new_pv)
+            self._bump(new_pvc)
+            self.pvs[pv_name] = new_pv
+            self.pvcs[pvc_key] = new_pvc
+        self._notify("PersistentVolume", MODIFIED, old_pv, new_pv)
+        self._notify("PersistentVolumeClaim", MODIFIED, old_pvc, new_pvc)

@@ -121,6 +121,8 @@ class TPUScheduler(Scheduler):
     def batch_supported(self, pod: Pod) -> bool:
         """Features the batched kernel covers today; the rest take the
         sequential oracle path (config fallback knob, SURVEY.md §7)."""
+        if pod.spec.volumes:
+            return False  # volume plugins stay on the host path (volume.py)
         if pod.spec.topology_spread_constraints:
             return False
         a = pod.spec.affinity

@@ -1,0 +1,31 @@
+"""Scheduler component-config API (KubeSchedulerConfiguration)."""
+
+from .types import (
+    API_VERSION,
+    ConfigError,
+    DEFAULT_SCHEDULER_NAME,
+    Extender,
+    KubeSchedulerConfiguration,
+    PluginEntry,
+    PluginSet,
+    Profile,
+    expand_profile,
+    load_config,
+    validate_config,
+)
+from .factory import scheduler_from_config
+
+__all__ = [
+    "API_VERSION",
+    "ConfigError",
+    "DEFAULT_SCHEDULER_NAME",
+    "Extender",
+    "KubeSchedulerConfiguration",
+    "PluginEntry",
+    "PluginSet",
+    "Profile",
+    "expand_profile",
+    "load_config",
+    "validate_config",
+    "scheduler_from_config",
+]

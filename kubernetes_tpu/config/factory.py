@@ -1,0 +1,53 @@
+"""Config → running Scheduler (cmd/kube-scheduler/app Setup analog:
+server.go:300 — decode config, build registries/profiles, construct)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..apiserver.store import ClusterStore
+from ..scheduler.extender import build_extenders
+from ..scheduler.scheduler import Scheduler
+from .types import KubeSchedulerConfiguration, expand_profile, load_config
+
+
+def scheduler_from_config(
+    store: ClusterStore,
+    cfg: Optional[KubeSchedulerConfiguration] = None,
+    raw: Optional[dict] = None,
+    registry=None,
+    out_of_tree_registry: Optional[dict] = None,
+    **scheduler_kwargs,
+) -> Scheduler:
+    """Build a Scheduler from a KubeSchedulerConfiguration (or its raw dict
+    form).  ``out_of_tree_registry`` merges extra plugin factories, the
+    app.WithPlugin hook (server.go:293)."""
+    if cfg is None:
+        cfg = load_config(raw)
+    if out_of_tree_registry:
+        from ..framework.registry import in_tree_registry
+
+        merged = in_tree_registry()
+        for name, factory in out_of_tree_registry.items():
+            if name in merged:
+                raise ValueError(f"plugin {name!r} already registered")
+            merged[name] = factory
+        registry = merged
+
+    profiles = {
+        p.scheduler_name: {
+            "plugin_config": expand_profile(p),
+            "plugin_args": p.plugin_config,
+            "registry": registry,
+        }
+        for p in cfg.profiles
+    }
+    return Scheduler(
+        store,
+        profiles=profiles,
+        percentage_of_nodes_to_score=cfg.percentage_of_nodes_to_score,
+        pod_initial_backoff=cfg.pod_initial_backoff_seconds,
+        pod_max_backoff=cfg.pod_max_backoff_seconds,
+        extenders=build_extenders(cfg.extenders),
+        **scheduler_kwargs,
+    )

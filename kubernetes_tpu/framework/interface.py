@@ -92,6 +92,7 @@ class CycleState:
         self.skip_filter_plugins: Set[str] = set()
         self.skip_score_plugins: Set[str] = set()
         self.record_plugin_metrics = False
+        self.prefilter_ran = False  # set by run_pre_filter_plugins
 
     def read(self, key: str):
         if key not in self._data:
@@ -110,6 +111,7 @@ class CycleState:
             cs._data[k] = v.clone() if hasattr(v, "clone") else v
         cs.skip_filter_plugins = set(self.skip_filter_plugins)
         cs.skip_score_plugins = set(self.skip_score_plugins)
+        cs.prefilter_ran = self.prefilter_ran
         return cs
 
 

@@ -1,0 +1,63 @@
+"""DefaultPreemption — the PostFilter plugin
+(plugins/defaultpreemption/default_preemption.go).
+
+PostFilter fires after a pod fails all Filters (schedule_one.go:104-122); it
+runs the preemption Evaluator and, on success, returns the node the pod is
+nominated to (the actual nomination + status write happens in the scheduler's
+failure handler, mirroring the reference's NominatingInfo flow).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional, Tuple
+
+from ..interface import CycleState, PostFilterPlugin, Status
+from ..preemption import Evaluator
+from . import names
+
+
+class DefaultPreemption(PostFilterPlugin):
+    def __init__(
+        self,
+        framework=None,
+        snapshot_fn=None,
+        pdb_lister=None,
+        min_candidate_nodes_percentage: int = 10,
+        min_candidate_nodes_absolute: int = 100,
+        seed: int = 0,
+    ):
+        # framework is attached lazily (the Framework builds plugins before
+        # itself exists) via set_framework in runtime wiring.
+        self._fwk = framework
+        self._snapshot_fn = snapshot_fn
+        self._pdb_lister = pdb_lister or (lambda: [])
+        self.min_pct = min_candidate_nodes_percentage
+        self.min_abs = min_candidate_nodes_absolute
+        self._rng = random.Random(seed)
+
+    def name(self) -> str:
+        return names.DEFAULT_PREEMPTION
+
+    def set_framework(self, fwk) -> None:
+        self._fwk = fwk
+
+    def post_filter(self, state: CycleState, pod, filtered_node_status_map) -> Tuple[Optional[str], Status]:
+        # The dry-run filters consume PreFilter CycleState. The sequential
+        # path always populated it (schedule_one.go ordering); the TPU batched
+        # path skips host-side PreFilter, so backfill it here.
+        if not state.prefilter_ran:
+            _, st = self._fwk.run_pre_filter_plugins(state, pod)
+            if not st.is_success():
+                return None, st
+        ev = Evaluator(
+            plugin_name=self.name(),
+            framework=self._fwk,
+            pdb_lister=self._pdb_lister,
+            state=state,
+            min_candidate_nodes_percentage=self.min_pct,
+            min_candidate_nodes_absolute=self.min_abs,
+            rng=self._rng,
+        )
+        node_infos = self._snapshot_fn() if self._snapshot_fn else []
+        return ev.preempt(pod, filtered_node_status_map, node_infos)

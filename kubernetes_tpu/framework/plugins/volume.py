@@ -1,0 +1,352 @@
+"""Volume plugins: VolumeZone, VolumeRestrictions, NodeVolumeLimits,
+VolumeBinding.
+
+Oracle implementations of the reference's volume plugin set
+(pkg/scheduler/framework/plugins/{volumezone,volumerestrictions,
+nodevolumelimits,volumebinding}); this framework's volume model reduces a
+pod's volumes to PVC names (api/types.py PodSpec.volumes), PVs carry topology
+as required label matches, and the PV controller is the store's ``bind_pv``.
+
+These stay on the host path permanently (SURVEY.md §7 hard-parts #6:
+VolumeBinding is stateful, API-writing, PreBind-heavy — off the hot loop).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ...api.types import (
+    BINDING_WAIT_FOR_FIRST_CONSUMER,
+    Node,
+    PersistentVolumeClaim,
+    Pod,
+    RWOP,
+)
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    OK,
+    PreBindPlugin,
+    PreFilterPlugin,
+    PreFilterResult,
+    ReservePlugin,
+    Status,
+)
+from ..types import ClusterEvent, NodeInfo
+from ..types import ADD, DELETE, NODE, PV, PVC, STORAGE_CLASS, CSI_NODE, UPDATE
+from . import names
+
+ERR_REASON_NOT_BOUND = "pod has unbound immediate PersistentVolumeClaims"
+ERR_REASON_PVC_NOT_FOUND = "persistentvolumeclaim not found"
+ERR_REASON_CONFLICT = "node(s) had volume node affinity conflict"
+ERR_REASON_RWOP = "pod uses a ReadWriteOncePod PVC already in use"
+ERR_REASON_LIMIT = "node(s) exceed max volume count"
+ERR_REASON_ZONE = "node(s) had no available volume zone"
+
+
+def _pod_pvcs(pod: Pod, store) -> Tuple[List[PersistentVolumeClaim], Optional[str]]:
+    """Resolve the pod's PVC names; (claims, missing-claim-name)."""
+    claims = []
+    for name in pod.spec.volumes:
+        pvc = store.get_pvc(f"{pod.meta.namespace}/{name}")
+        if pvc is None:
+            return [], name
+        claims.append(pvc)
+    return claims, None
+
+
+# ---------------------------------------------------------------------------
+# VolumeZone (volumezone/volume_zone.go)
+
+_ZONE_KEYS = (
+    "topology.kubernetes.io/zone",
+    "topology.kubernetes.io/region",
+    "failure-domain.beta.kubernetes.io/zone",
+    "failure-domain.beta.kubernetes.io/region",
+)
+
+
+class VolumeZone(FilterPlugin):
+    """Filter: every bound PV's zone/region labels must match the node's
+    (volume_zone.go:88 Filter)."""
+
+    def __init__(self, client=None):
+        self.client = client
+
+    def name(self) -> str:
+        return names.VOLUME_ZONE
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(STORAGE_CLASS, ADD, ""),
+            ClusterEvent(NODE, ADD | UPDATE, ""),
+            ClusterEvent(PVC, ADD, ""),
+            ClusterEvent(PV, ADD | UPDATE, ""),
+        ]
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if not pod.spec.volumes:
+            return OK
+        claims, missing = _pod_pvcs(pod, self.client)
+        if missing is not None:
+            return Status.unresolvable(ERR_REASON_PVC_NOT_FOUND)
+        node = node_info.node
+        for pvc in claims:
+            if not pvc.bound_pv:
+                continue  # unbound handled by VolumeBinding
+            pv = self.client.get_pv(pvc.bound_pv)
+            if pv is None:
+                continue
+            for key in _ZONE_KEYS:
+                pv_val = pv.meta.labels.get(key)
+                if pv_val is None:
+                    continue
+                # the reference allows __-separated multi-zone label values
+                allowed = set(pv_val.split("__"))
+                if node.meta.labels.get(key) not in allowed:
+                    return Status.unresolvable(ERR_REASON_ZONE)
+        return OK
+
+
+# ---------------------------------------------------------------------------
+# VolumeRestrictions (volumerestrictions/volume_restrictions.go)
+
+
+class VolumeRestrictions(PreFilterPlugin, FilterPlugin):
+    """ReadWriteOncePod exclusivity: a RWOP PVC used by any existing pod
+    blocks every node hosting that pod (volume_restrictions.go:150-217); the
+    legacy GCE-PD/EBS same-volume conflict reduces to 'two pods may not share
+    a PVC on one node unless its access mode allows it' in the PVC-name
+    volume model."""
+
+    STATE_KEY = "PreFilter/VolumeRestrictions"
+
+    def __init__(self, client=None, snapshot_fn=None):
+        self.client = client
+        self.snapshot_fn = snapshot_fn
+
+    def name(self) -> str:
+        return names.VOLUME_RESTRICTIONS
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(PVC, ADD | DELETE, ""),
+            ClusterEvent(NODE, ADD | UPDATE, ""),
+        ]
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        """RWOP exclusivity is cluster-wide and decided here: a RWOP claim in
+        use by ANY pod rejects at PreFilter with UnschedulableAndUnresolvable
+        (volume_restrictions.go:149-152 isReadWriteOncePodAccessModeConflict)."""
+        claims, missing = _pod_pvcs(pod, self.client)
+        if missing is not None:
+            return None, Status.unresolvable(ERR_REASON_PVC_NOT_FOUND)
+        rwop = {pvc.meta.key() for pvc in claims if RWOP in pvc.access_modes}
+        if rwop and self.snapshot_fn is not None:
+            for ni in self.snapshot_fn():
+                for key, count in ni.pvc_ref_counts.items():
+                    if key in rwop and count > 0:
+                        return None, Status.unresolvable(ERR_REASON_RWOP)
+        state.write(self.STATE_KEY, rwop)
+        return None, OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        """Per-node re-check covers pods assumed after PreFilter (the
+        preemption dry-run AddPod path)."""
+        try:
+            rwop = state.read(self.STATE_KEY)
+        except KeyError:
+            rwop = set()
+        if not rwop:
+            return OK
+        for key, count in node_info.pvc_ref_counts.items():
+            if key in rwop and count > 0:
+                return Status.unresolvable(ERR_REASON_RWOP)
+        return OK
+
+
+# ---------------------------------------------------------------------------
+# NodeVolumeLimits (nodevolumelimits/csi.go)
+
+
+class NodeVolumeLimits(FilterPlugin):
+    """Per-driver attachable-volume count limit from CSINode allocatable:
+    existing volumes on the node + the pod's new volumes must fit
+    (csi.go:220 Filter)."""
+
+    def __init__(self, client=None):
+        self.client = client
+
+    def name(self) -> str:
+        return names.NODE_VOLUME_LIMITS
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(CSI_NODE, ADD, ""),
+            ClusterEvent(PVC, ADD, ""),
+            ClusterEvent(PV, ADD, ""),
+        ]
+
+    def _driver_of(self, pvc: PersistentVolumeClaim) -> Optional[str]:
+        sc = self.client.get_storage_class(pvc.storage_class)
+        return sc.provisioner if sc else None
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        if not pod.spec.volumes:
+            return OK
+        csinode = self.client.get_csinode(node_info.node.meta.name)
+        if csinode is None or not csinode.drivers:
+            return OK  # no limits known for this node
+        claims, missing = _pod_pvcs(pod, self.client)
+        if missing is not None:
+            return Status.unresolvable(ERR_REASON_PVC_NOT_FOUND)
+
+        new_by_driver: Dict[str, set] = {}
+        for pvc in claims:
+            d = self._driver_of(pvc)
+            if d is not None and d in csinode.drivers:
+                new_by_driver.setdefault(d, set()).add(pvc.meta.key())
+        if not new_by_driver:
+            return OK
+
+        used_by_driver: Dict[str, set] = {}
+        for p in node_info.pods:
+            for vol in p.spec.volumes:
+                pvc = self.client.get_pvc(f"{p.meta.namespace}/{vol}")
+                if pvc is None:
+                    continue
+                d = self._driver_of(pvc)
+                if d is not None and d in csinode.drivers:
+                    used_by_driver.setdefault(d, set()).add(pvc.meta.key())
+
+        for driver, new_set in new_by_driver.items():
+            used = used_by_driver.get(driver, set())
+            if len(used | new_set) > csinode.drivers[driver]:
+                return Status.unschedulable(ERR_REASON_LIMIT)
+        return OK
+
+
+# ---------------------------------------------------------------------------
+# VolumeBinding (volumebinding/volume_binding.go)
+
+
+class _BindingState:
+    """Per-cycle state: claims to bind + per-node chosen PVs
+    (volume_binding.go stateData)."""
+
+    def __init__(self, bound, unbound_immediate, delayed):
+        self.bound = bound                        # already-bound PVCs
+        self.unbound_immediate = unbound_immediate
+        self.delayed = delayed                    # WaitForFirstConsumer claims
+        self.node_bindings: Dict[str, List[Tuple[str, str]]] = {}  # node -> [(pv, pvc)]
+
+    def clone(self):
+        c = _BindingState(self.bound, self.unbound_immediate, self.delayed)
+        c.node_bindings = {k: list(v) for k, v in self.node_bindings.items()}
+        return c
+
+
+class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin):
+    """Delayed (WaitForFirstConsumer) PV binding:
+
+    PreFilter partitions the pod's claims (volume_binding.go:168);
+    Filter finds matching PVs per node (binder.go FindPodVolumes);
+    Reserve assumes the chosen PV⇄PVC pairs (assume_cache.go analog);
+    PreBind writes the binds through the API and they take effect
+    immediately (the in-process store is its own PV controller).
+    """
+
+    STATE_KEY = "PreFilter/VolumeBinding"
+
+    def __init__(self, client=None):
+        self.client = client
+        self._assumed: Dict[str, List[Tuple[str, str]]] = {}  # pod key -> [(pv, pvc)]
+
+    def name(self) -> str:
+        return names.VOLUME_BINDING
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(PV, ADD | UPDATE, ""),
+            ClusterEvent(PVC, ADD | UPDATE, ""),
+            ClusterEvent(STORAGE_CLASS, ADD, ""),
+            ClusterEvent(NODE, ADD | UPDATE, ""),
+            ClusterEvent(CSI_NODE, ADD | UPDATE, ""),
+        ]
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        claims, missing = _pod_pvcs(pod, self.client)
+        if missing is not None:
+            return None, Status.unresolvable(f'{ERR_REASON_PVC_NOT_FOUND} "{missing}"')
+        bound, unbound_immediate, delayed = [], [], []
+        for pvc in claims:
+            if pvc.bound_pv:
+                bound.append(pvc)
+                continue
+            sc = self.client.get_storage_class(pvc.storage_class)
+            if sc is not None and sc.volume_binding_mode == BINDING_WAIT_FOR_FIRST_CONSUMER:
+                delayed.append(pvc)
+            else:
+                unbound_immediate.append(pvc)
+        if unbound_immediate:
+            # immediate-mode claims must already be bound (:207)
+            return None, Status.unresolvable(ERR_REASON_NOT_BOUND)
+        state.write(self.STATE_KEY, _BindingState(bound, unbound_immediate, delayed))
+        return None, OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        try:
+            s: _BindingState = state.read(self.STATE_KEY)
+        except KeyError:
+            return OK
+        node = node_info.node
+        # bound claims: PV node affinity must admit this node (:224 Filter)
+        for pvc in s.bound:
+            pv = self.client.get_pv(pvc.bound_pv)
+            if pv is not None and not pv.matches_node(node):
+                return Status.unresolvable(ERR_REASON_CONFLICT)
+        if not s.delayed:
+            return OK
+        # delayed claims: greedily match unbound PVs on this node (binder.go
+        # findMatchingVolumes — smallest fitting PV first)
+        chosen: List[Tuple[str, str]] = []
+        taken = set()
+        for pvc in s.delayed:
+            best = None
+            for pv in self.client.list_pvs():
+                if pv.bound_pvc or pv.meta.name in taken:
+                    continue
+                if pv.storage_class != pvc.storage_class:
+                    continue
+                if pvc.requested_bytes and pv.capacity_bytes < pvc.requested_bytes:
+                    continue
+                if not pv.matches_node(node):
+                    continue
+                if best is None or pv.capacity_bytes < best.capacity_bytes:
+                    best = pv
+            if best is None:
+                return Status.unschedulable("node(s) didn't find available persistent volumes to bind")
+            taken.add(best.meta.name)
+            chosen.append((best.meta.name, pvc.meta.key()))
+        s.node_bindings[node.meta.name] = chosen
+        return OK
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        try:
+            s: _BindingState = state.read(self.STATE_KEY)
+        except KeyError:
+            return OK
+        self._assumed[pod.key()] = s.node_bindings.get(node_name, [])
+        return OK
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        self._assumed.pop(pod.key(), None)
+
+    def pre_bind(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        bindings = self._assumed.pop(pod.key(), [])
+        for pv_name, pvc_key in bindings:
+            try:
+                self.client.bind_pv(pv_name, pvc_key)
+            except Exception as e:  # noqa: BLE001 — conflict: another pod took the PV
+                return Status.error(f"binding volumes: {e}")
+        return OK

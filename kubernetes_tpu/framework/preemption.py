@@ -1,0 +1,294 @@
+"""Preemption engine — the generic Evaluator behind the DefaultPreemption
+PostFilter plugin.
+
+Analog of pkg/scheduler/framework/preemption/preemption.go:
+  * Preempt (:138): eligibility check → find candidates (parallel dry-runs)
+    → select one node (5-criteria lexicographic) → prepare (delete victims,
+    clear lower nominations) → return the nominated node name.
+  * DryRunPreemption (:546): per candidate node, clone NodeInfo+CycleState,
+    remove lower-priority victims (via the PreFilter RemovePod extensions),
+    check the pod fits, then reprieve victims highest-priority-first —
+    PDB-non-violating pods get reprieved before PDB-violating ones
+    (defaultpreemption/default_preemption.go:226 selectVictimsOnNode).
+  * pickOneNodeForPreemption (:397): fewest PDB violations → lowest max
+    victim priority → smallest priority sum → fewest victims → earliest
+    highest-priority-victim start time → first in list.
+  * Candidate count limit: minCandidateNodesPercentage (10%) /
+    minCandidateNodesAbsolute (100), with a rotating offset for fairness
+    (:172 GetOffsetAndNumCandidates).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api.types import Pod, PodDisruptionBudget
+from . import interface as fw
+from .interface import CycleState, Status
+from .types import Diagnosis, NodeInfo
+
+POLICY_NEVER = "Never"
+
+
+class Candidate:
+    __slots__ = ("node_name", "victims", "num_pdb_violations")
+
+    def __init__(self, node_name: str, victims: List[Pod], num_pdb_violations: int):
+        self.node_name = node_name
+        self.victims = victims
+        self.num_pdb_violations = num_pdb_violations
+
+
+def more_important(a: Pod, b: Pod) -> bool:
+    """util.MoreImportantPod: higher priority, then earlier start time."""
+    if a.spec.priority != b.spec.priority:
+        return a.spec.priority > b.spec.priority
+    return a.status.start_time < b.status.start_time
+
+
+def pdbs_for_pod(pod: Pod, pdbs: Sequence[PodDisruptionBudget]) -> List[PodDisruptionBudget]:
+    return [
+        p
+        for p in pdbs
+        if p.meta.namespace == pod.meta.namespace
+        and p.selector is not None
+        and p.selector.matches(pod.meta.labels)
+    ]
+
+
+class Evaluator:
+    """One preemption attempt per unschedulable pod (Evaluator, :117)."""
+
+    def __init__(
+        self,
+        plugin_name: str,
+        framework,
+        pdb_lister,
+        state: CycleState,
+        min_candidate_nodes_percentage: int = 10,
+        min_candidate_nodes_absolute: int = 100,
+        rng: Optional[random.Random] = None,
+    ):
+        self.plugin_name = plugin_name
+        self.fwk = framework
+        self.pdb_lister = pdb_lister
+        self.state = state
+        self.min_pct = min_candidate_nodes_percentage
+        self.min_abs = min_candidate_nodes_absolute
+        self.rng = rng or random.Random(0)
+
+    # ------------------------------------------------------------- top level
+
+    def preempt(self, pod: Pod, status_map: Dict[str, Status], node_infos: List[NodeInfo]) -> Tuple[Optional[str], Status]:
+        """(:138) returns (nominated node name, status)."""
+        by_name = {ni.node.meta.name: ni for ni in node_infos if ni.node is not None}
+
+        if not self._pod_eligible_to_preempt_others(pod, by_name):
+            return None, Status.unschedulable("preemption is not helpful for scheduling")
+
+        candidates, diagnosis = self.find_candidates(pod, status_map, node_infos)
+        if not candidates:
+            # mirror FitError-style reporting for observability (:205)
+            return None, Status.unschedulable(
+                "preemption: 0/{} nodes are available".format(len(node_infos)),
+                *sorted(diagnosis),
+            )
+
+        best = self.select_candidate(candidates)
+        if best is None:
+            return None, Status.unschedulable("no candidate node for preemption")
+
+        status = self.prepare_candidate(best, pod)
+        if not status.is_success():
+            return None, status
+        return best.node_name, fw.OK
+
+    # ------------------------------------------------------------- eligibility
+
+    def _pod_eligible_to_preempt_others(self, pod: Pod, by_name: Dict[str, NodeInfo]) -> bool:
+        """PodEligibleToPreemptOthers (:319): Never-policy pods can't preempt;
+        a pod already nominated somewhere waits while a lower-priority victim
+        on that node is still terminating."""
+        if pod.spec.preemption_policy == POLICY_NEVER:
+            return False
+        nominated = pod.status.nominated_node_name
+        if nominated and nominated in by_name:
+            for p in by_name[nominated].pods:
+                if p.meta.deletion_timestamp > 0 and p.spec.priority < pod.spec.priority:
+                    return False
+        return True
+
+    # ------------------------------------------------------------- candidates
+
+    def _nodes_where_preemption_might_help(
+        self, node_infos: List[NodeInfo], status_map: Dict[str, Status]
+    ) -> List[NodeInfo]:
+        """(:363) skip nodes whose filter status was UnschedulableAndUnresolvable."""
+        out = []
+        for ni in node_infos:
+            if ni.node is None:
+                continue
+            st = status_map.get(ni.node.meta.name)
+            if st is not None and st.code == fw.UNSCHEDULABLE_AND_UNRESOLVABLE:
+                continue
+            out.append(ni)
+        return out
+
+    def _offset_and_num_candidates(self, num_nodes: int) -> Tuple[int, int]:
+        """(:172) rotate a random offset; candidate count = max(pct·N, abs)."""
+        n = num_nodes * self.min_pct // 100
+        if n < self.min_abs:
+            n = self.min_abs
+        if n > num_nodes:
+            n = num_nodes
+        return self.rng.randrange(num_nodes) if num_nodes else 0, n
+
+    def find_candidates(
+        self, pod: Pod, status_map: Dict[str, Status], node_infos: List[NodeInfo]
+    ) -> Tuple[List[Candidate], List[str]]:
+        potential = self._nodes_where_preemption_might_help(node_infos, status_map)
+        if not potential:
+            return [], ["no node is eligible for preemption"]
+        offset, num = self._offset_and_num_candidates(len(potential))
+        pdbs = list(self.pdb_lister() if callable(self.pdb_lister) else self.pdb_lister)
+
+        candidates: List[Candidate] = []
+        diagnosis: List[str] = []
+        for i in range(len(potential)):
+            ni = potential[(offset + i) % len(potential)]
+            victims, n_viol, ok = self.select_victims_on_node(pod, ni, pdbs)
+            if ok:
+                candidates.append(Candidate(ni.node.meta.name, victims, n_viol))
+                if len(candidates) >= num:
+                    break
+            else:
+                diagnosis.append(f"{ni.node.meta.name}: preemption would not make pod schedulable")
+        candidates = self._call_extenders(pod, candidates)
+        return candidates, diagnosis
+
+    def _call_extenders(self, pod: Pod, candidates: List[Candidate]) -> List[Candidate]:
+        """(:241) preemption-aware extenders may veto/trim the victim map;
+        ignorable extender errors drop the extender."""
+        extenders = [
+            e for e in self.fwk.handle_ctx.get("extenders", []) if e.supports_preemption() and e.is_interested(pod)
+        ]
+        if not extenders or not candidates:
+            return candidates
+        victims_by_node = {c.node_name: list(c.victims) for c in candidates}
+        by_node = {c.node_name: c for c in candidates}
+        for ext in extenders:
+            try:
+                victims_by_node = ext.process_preemption(pod, victims_by_node, None)
+            except Exception:  # noqa: BLE001
+                if ext.is_ignorable():
+                    continue
+                return []
+        return [
+            Candidate(n, v, by_node[n].num_pdb_violations)
+            for n, v in victims_by_node.items()
+            if n in by_node
+        ]
+
+    # ------------------------------------------------------------- dry run
+
+    def select_victims_on_node(
+        self, pod: Pod, node_info: NodeInfo, pdbs: Sequence[PodDisruptionBudget]
+    ) -> Tuple[List[Pod], int, bool]:
+        """selectVictimsOnNode (defaultpreemption/default_preemption.go:226).
+
+        Returns (victims sorted most-important-first, num PDB violations, ok).
+        """
+        ni = node_info.clone()
+        state = self.state.clone()
+
+        remove = [p for p in ni.pods if p.spec.priority < pod.spec.priority]
+        if not remove and not self._fits(state, pod, ni):
+            return [], 0, False
+        for victim in list(remove):
+            ni.remove_pod(victim)
+            self.fwk.run_remove_pod_extensions(state, pod, victim, ni)
+        if not self._fits(state, pod, ni):
+            return [], 0, False
+
+        violating, non_violating = [], []
+        for p in remove:
+            (violating if pdbs_for_pod(p, pdbs) else non_violating).append(p)
+        violating.sort(key=lambda p: (-p.spec.priority, p.status.start_time))
+        non_violating.sort(key=lambda p: (-p.spec.priority, p.status.start_time))
+
+        victims: List[Pod] = []
+        num_violating = 0
+
+        def reprieve(p: Pod) -> bool:
+            ni.add_pod(p)
+            self.fwk.run_add_pod_extensions(state, pod, p, ni)
+            if self._fits(state, pod, ni):
+                return True
+            ni.remove_pod(p)
+            self.fwk.run_remove_pod_extensions(state, pod, p, ni)
+            victims.append(p)
+            return False
+
+        for p in violating:
+            if not reprieve(p):
+                num_violating += 1
+        for p in non_violating:
+            reprieve(p)
+
+        victims.sort(key=lambda p: (-p.spec.priority, p.status.start_time))
+        return victims, num_violating, True
+
+    def _fits(self, state: CycleState, pod: Pod, ni: NodeInfo) -> bool:
+        return self.fwk.run_filter_plugins_with_nominated_pods(state, pod, ni).is_success()
+
+    # ------------------------------------------------------------- selection
+
+    def select_candidate(self, candidates: List[Candidate]) -> Optional[Candidate]:
+        """pickOneNodeForPreemption (:397), lexicographic on 5 criteria."""
+        if not candidates:
+            return None
+        if len(candidates) == 1:
+            return candidates[0]
+
+        def keys(c: Candidate):
+            if not c.victims:
+                # a no-victim candidate wins everything (:404)
+                return (0, -(1 << 62), -(1 << 62), 0, float("-inf"))
+            highest = max(p.spec.priority for p in c.victims)
+            total = sum(p.spec.priority for p in c.victims)
+            # earliest start time of the highest-priority victim (:466)
+            hp_start = min(
+                p.status.start_time for p in c.victims if p.spec.priority == highest
+            )
+            # later start = more recently started = preferred victim set
+            return (c.num_pdb_violations, highest, total, len(c.victims), -hp_start)
+
+        return min(candidates, key=keys)
+
+    # ------------------------------------------------------------- prepare
+
+    def prepare_candidate(self, c: Candidate, pod: Pod) -> Status:
+        """(:331) delete victims via the API; clear nominations of lower-
+        priority pods nominated to this node (they must re-evaluate)."""
+        client = self.fwk.handle_ctx.get("client")
+        metrics = self.fwk.handle_ctx.get("metrics")
+        if metrics is not None and c.victims:
+            metrics.preemption_victims.observe(len(c.victims))
+        for victim in c.victims:
+            if victim.meta.deletion_timestamp > 0:
+                continue  # already terminating
+            try:
+                client.delete_pod(victim.key())
+            except Exception as e:  # noqa: BLE001 — victim already gone is fine
+                if "NotFound" not in type(e).__name__:
+                    return Status.error(f"deleting victim {victim.key()}: {e}")
+        nominator = self.fwk.nominator
+        for p in list(nominator.nominated_pods_for_node(c.node_name)):
+            if p.spec.priority < pod.spec.priority:
+                nominator.delete_nominated_pod_if_exists(p)
+                try:
+                    client.update_pod_nominated_node(p.key(), "")
+                except Exception:  # noqa: BLE001 — pod vanished meanwhile
+                    pass
+        return fw.OK

@@ -13,11 +13,13 @@ from typing import Callable, Dict, List, Tuple
 from .plugins import names
 from .plugins.basic import NodeName, NodePorts, NodeUnschedulable, PrioritySort, TaintToleration
 from .plugins.defaultbinder import DefaultBinder
+from .plugins.defaultpreemption import DefaultPreemption
 from .plugins.imagelocality import ImageLocality
 from .plugins.interpodaffinity import InterPodAffinity
 from .plugins.nodeaffinity import NodeAffinity
 from .plugins.noderesources import BalancedAllocation, Fit
 from .plugins.podtopologyspread import PodTopologySpread
+from .plugins.volume import NodeVolumeLimits, VolumeBinding, VolumeRestrictions, VolumeZone
 
 Factory = Callable[[dict, dict], object]  # (handle_ctx, args) -> Plugin
 
@@ -50,6 +52,19 @@ def in_tree_registry() -> Dict[str, Factory]:
             hard_pod_affinity_weight=a.get("hard_pod_affinity_weight", 1),
         ),
         names.DEFAULT_BINDER: lambda h, a: DefaultBinder(client=h.get("client")),
+        names.VOLUME_ZONE: lambda h, a: VolumeZone(client=h.get("client")),
+        names.VOLUME_RESTRICTIONS: lambda h, a: VolumeRestrictions(
+            client=h.get("client"), snapshot_fn=h.get("snapshot_fn")
+        ),
+        names.NODE_VOLUME_LIMITS: lambda h, a: NodeVolumeLimits(client=h.get("client")),
+        names.VOLUME_BINDING: lambda h, a: VolumeBinding(client=h.get("client")),
+        names.DEFAULT_PREEMPTION: lambda h, a: DefaultPreemption(
+            snapshot_fn=h.get("snapshot_fn"),
+            pdb_lister=(h["client"].list_pdbs if h.get("client") is not None and hasattr(h["client"], "list_pdbs") else None),
+            min_candidate_nodes_percentage=a.get("min_candidate_nodes_percentage", 10),
+            min_candidate_nodes_absolute=a.get("min_candidate_nodes_absolute", 100),
+            seed=a.get("seed", 0),
+        ),
     }
 
 
@@ -60,8 +75,10 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.NODE_AFFINITY, 0),
         (names.NODE_PORTS, 0),
         (names.NODE_RESOURCES_FIT, 0),
+        (names.VOLUME_RESTRICTIONS, 0),
         (names.POD_TOPOLOGY_SPREAD, 0),
         (names.INTER_POD_AFFINITY, 0),
+        (names.VOLUME_BINDING, 0),
     ],
     "filter": [
         (names.NODE_UNSCHEDULABLE, 0),
@@ -70,6 +87,10 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.NODE_AFFINITY, 0),
         (names.NODE_PORTS, 0),
         (names.NODE_RESOURCES_FIT, 0),
+        (names.VOLUME_RESTRICTIONS, 0),
+        (names.NODE_VOLUME_LIMITS, 0),
+        (names.VOLUME_BINDING, 0),
+        (names.VOLUME_ZONE, 0),
         (names.POD_TOPOLOGY_SPREAD, 0),
         (names.INTER_POD_AFFINITY, 0),
     ],
@@ -90,9 +111,9 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.POD_TOPOLOGY_SPREAD, 2),
         (names.TAINT_TOLERATION, 3),
     ],
-    "reserve": [],
+    "reserve": [(names.VOLUME_BINDING, 0)],
     "permit": [],
-    "pre_bind": [],
+    "pre_bind": [(names.VOLUME_BINDING, 0)],
     "bind": [(names.DEFAULT_BINDER, 0)],
     "post_bind": [],
 }

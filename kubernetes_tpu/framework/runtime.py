@@ -46,6 +46,23 @@ class PodNominator:
         return self._by_node.get(node_name, [])
 
 
+# extension point -> the method a plugin must implement to join it (used to
+# gate MultiPoint-expanded config entries onto real implementations)
+_POINT_METHODS = {
+    "queue_sort": "less",
+    "pre_filter": "pre_filter",
+    "filter": "filter",
+    "post_filter": "post_filter",
+    "pre_score": "pre_score",
+    "score": "score_node",
+    "reserve": "reserve",
+    "permit": "permit",
+    "pre_bind": "pre_bind",
+    "bind": "bind",
+    "post_bind": "post_bind",
+}
+
+
 class Framework:
     """One profile's plugin set (profile/profile.go maps scheduler-name →
     one of these)."""
@@ -75,8 +92,17 @@ class Framework:
                     continue  # not-yet-implemented plugin in default config
                 if name not in self._instances:
                     self._instances[name] = factory(handle_ctx, args.get(name, {}))
+                method = _POINT_METHODS.get(point)
+                if method and not hasattr(self._instances[name], method):
+                    continue  # MultiPoint-expanded name; plugin doesn't do this point
                 lst.append((self._instances[name], weight))
             self.points[point] = lst
+
+        # late-bind plugins that need the framework itself (DefaultPreemption
+        # runs filters during its dry-runs)
+        for plugin in self._instances.values():
+            if hasattr(plugin, "set_framework"):
+                plugin.set_framework(self)
 
     def plugin(self, name: str):
         return self._instances.get(name)
@@ -111,6 +137,7 @@ class Framework:
     # --------------------------------------------------------------- prefilter
 
     def run_pre_filter_plugins(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        state.prefilter_ran = True
         result: Optional[PreFilterResult] = None
         for plugin, _w in self.points.get("pre_filter", []):
             r, status = plugin.pre_filter(state, pod)

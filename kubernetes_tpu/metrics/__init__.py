@@ -1,0 +1,14 @@
+"""Metrics: prometheus-style registry + the scheduler metric set."""
+
+from .registry import Counter, Gauge, Histogram, Registry, exponential_buckets
+from .scheduler_metrics import SchedulerMetrics, global_metrics
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Registry",
+    "exponential_buckets",
+    "SchedulerMetrics",
+    "global_metrics",
+]

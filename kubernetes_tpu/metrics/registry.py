@@ -1,0 +1,163 @@
+"""Minimal Prometheus-style metrics registry.
+
+Analog of staging/src/k8s.io/component-base/metrics (the legacyregistry
+pattern): counters, gauges, histograms with label vectors, exposition in
+Prometheus text format so a scheduler_perf-style metricsCollector can scrape
+by metric name (test/integration/scheduler_perf/util.go:204-238).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+LabelValues = Tuple[str, ...]
+
+
+def _fmt_labels(names: Sequence[str], values: LabelValues) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, labels: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._values: Dict[LabelValues, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, *labels: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._values[labels] = self._values.get(labels, 0.0) + value
+
+    def labels(self, *labels: str) -> float:
+        return self._values.get(labels, 0.0)
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        for lv, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, lv)} {v}")
+        return out
+
+    def reset(self) -> None:
+        self._values.clear()
+
+
+class Gauge(Counter):
+    def set(self, *labels: str, value: float = 0.0) -> None:
+        with self._lock:
+            self._values[labels] = value
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
+        for lv, v in sorted(self._values.items()):
+            out.append(f"{self.name}{_fmt_labels(self.label_names, lv)} {v}")
+        return out
+
+
+# the scheduler's latency buckets: exponential 1ms..~17s (metrics.go)
+def exponential_buckets(start: float, factor: float, count: int) -> List[float]:
+    return [start * factor**i for i in range(count)]
+
+
+DEFAULT_BUCKETS = exponential_buckets(0.001, 2, 15)
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, labels: Sequence[str] = (), buckets: Optional[List[float]] = None):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self.buckets = sorted(buckets or DEFAULT_BUCKETS)
+        self._counts: Dict[LabelValues, List[int]] = {}
+        self._sums: Dict[LabelValues, float] = {}
+        self._totals: Dict[LabelValues, int] = {}
+        self._lock = threading.Lock()
+
+    def observe(self, value: float, *labels: str) -> None:
+        with self._lock:
+            if labels not in self._counts:
+                self._counts[labels] = [0] * len(self.buckets)
+                self._sums[labels] = 0.0
+                self._totals[labels] = 0
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[labels][i] += 1
+            self._sums[labels] += value
+            self._totals[labels] += 1
+
+    def count(self, *labels: str) -> int:
+        return self._totals.get(labels, 0)
+
+    def sum(self, *labels: str) -> float:
+        return self._sums.get(labels, 0.0)
+
+    def percentile(self, q: float, *labels: str) -> float:
+        """Linear-interpolated percentile from bucket counts (scrape-side
+        estimate, like Prometheus histogram_quantile)."""
+        total = self._totals.get(labels, 0)
+        if total == 0:
+            return 0.0
+        target = q * total
+        counts = self._counts[labels]  # cumulative (le semantics)
+        for i, b in enumerate(self.buckets):
+            if counts[i] >= target:
+                in_bucket = counts[i] - (counts[i - 1] if i else 0)
+                below = counts[i - 1] if i else 0
+                if in_bucket == 0:
+                    return b
+                frac = (target - below) / in_bucket
+                lo = self.buckets[i - 1] if i else 0.0
+                return lo + frac * (b - lo)
+        return self.buckets[-1]
+
+    def collect(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        for lv in sorted(self._totals):
+            base = list(zip(self.label_names, lv))
+            for i, b in enumerate(self.buckets):
+                labels = _fmt_labels([*self.label_names, "le"], (*lv, repr(b)))
+                out.append(f"{self.name}_bucket{labels} {self._counts[lv][i]}")
+            labels = _fmt_labels([*self.label_names, "le"], (*lv, "+Inf"))
+            out.append(f"{self.name}_bucket{labels} {self._totals[lv]}")
+            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, lv)} {self._sums[lv]}")
+            out.append(f"{self.name}_count{_fmt_labels(self.label_names, lv)} {self._totals[lv]}")
+        return out
+
+    def reset(self) -> None:
+        self._counts.clear()
+        self._sums.clear()
+        self._totals.clear()
+
+
+class Registry:
+    """component-base/metrics legacyregistry analog."""
+
+    def __init__(self):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric):
+        with self._lock:
+            if metric.name in self._metrics:
+                return self._metrics[metric.name]
+            self._metrics[metric.name] = metric
+            return metric
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def expose(self) -> str:
+        """Prometheus text exposition (the /metrics endpoint body)."""
+        lines: List[str] = []
+        for name in sorted(self._metrics):
+            lines.extend(self._metrics[name].collect())
+        return "\n".join(lines) + "\n"
+
+    def reset(self) -> None:
+        for m in self._metrics.values():
+            m.reset()

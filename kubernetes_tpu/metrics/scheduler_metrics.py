@@ -1,0 +1,115 @@
+"""Scheduler metric set with the reference's metric names and labels
+(pkg/scheduler/metrics/metrics.go:42-176) so a scheduler_perf-style
+metricsCollector scrapes identically (SURVEY.md §5.5 build mapping)."""
+
+from __future__ import annotations
+
+import time
+from .registry import Counter, Gauge, Histogram, Registry
+
+SCHEDULER_SUBSYSTEM = "scheduler"
+
+# result labels (metrics.go)
+SCHEDULED = "scheduled"
+UNSCHEDULABLE = "unschedulable"
+ERROR = "error"
+
+
+class SchedulerMetrics:
+    def __init__(self, registry: Registry = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.schedule_attempts = r.register(Counter(
+            "scheduler_schedule_attempts_total",
+            "Number of attempts to schedule pods, by the result.",
+            ["result", "profile"],
+        ))
+        self.scheduling_attempt_duration = r.register(Histogram(
+            "scheduler_scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency (algorithm + binding).",
+            ["result", "profile"],
+        ))
+        self.scheduling_algorithm_duration = r.register(Histogram(
+            "scheduler_scheduling_algorithm_duration_seconds",
+            "Scheduling algorithm latency.",
+            ["profile"],
+        ))
+        self.framework_extension_point_duration = r.register(Histogram(
+            "scheduler_framework_extension_point_duration_seconds",
+            "Latency per extension point.",
+            ["extension_point", "status", "profile"],
+        ))
+        self.plugin_execution_duration = r.register(Histogram(
+            "scheduler_plugin_execution_duration_seconds",
+            "Plugin execution latency (sampled).",
+            ["plugin", "extension_point", "status"],
+        ))
+        self.pending_pods = r.register(Gauge(
+            "scheduler_pending_pods",
+            "Pending pods by queue (active|backoff|unschedulable|gated).",
+            ["queue"],
+        ))
+        self.queue_incoming_pods = r.register(Counter(
+            "scheduler_queue_incoming_pods_total",
+            "Pods added to queues by event and queue.",
+            ["queue", "event"],
+        ))
+        self.preemption_attempts = r.register(Counter(
+            "scheduler_preemption_attempts_total",
+            "Total preemption attempts in the cluster.",
+        ))
+        self.preemption_victims = r.register(Histogram(
+            "scheduler_preemption_victims",
+            "Number of selected preemption victims.",
+            buckets=[1, 2, 4, 8, 16, 32, 64],
+        ))
+        self.unschedulable_pods = r.register(Gauge(
+            "scheduler_unschedulable_pods",
+            "Unschedulable pods broken down by plugin.",
+            ["plugin", "profile"],
+        ))
+        self.cache_size = r.register(Gauge(
+            "scheduler_scheduler_cache_size",
+            "Scheduler cache entries (nodes|pods|assumed_pods).",
+            ["type"],
+        ))
+        self.goroutines = r.register(Gauge(
+            "scheduler_goroutines",
+            "Number of running goroutines split by work (device-step analog).",
+            ["work"],
+        ))
+        # TPU-path extensions (new metrics, framework-specific)
+        self.device_batch_duration = r.register(Histogram(
+            "scheduler_tpu_batch_duration_seconds",
+            "Device schedule_batch call latency.",
+            ["phase"],  # upload|compute|commit
+        ))
+        self.device_batch_size = r.register(Histogram(
+            "scheduler_tpu_batch_size",
+            "Pods per device batch.",
+            buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512],
+        ))
+
+    def observe_attempt(self, result: str, profile: str, duration_s: float) -> None:
+        self.schedule_attempts.inc(result, profile)
+        self.scheduling_attempt_duration.observe(duration_s, result, profile)
+
+    def sync_queue_gauges(self, pending: dict) -> None:
+        for q, n in pending.items():
+            self.pending_pods.set(q, value=n)
+
+    def sync_cache_gauges(self, nodes: int, pods: int, assumed: int) -> None:
+        self.cache_size.set("nodes", value=nodes)
+        self.cache_size.set("pods", value=pods)
+        self.cache_size.set("assumed_pods", value=assumed)
+
+
+_global = None
+
+
+def global_metrics() -> SchedulerMetrics:
+    """legacyregistry analog: one process-wide metric set."""
+    global _global
+    if _global is None:
+        _global = SchedulerMetrics()
+    return _global

@@ -1,0 +1,14 @@
+"""scheduler_perf analog: declarative workloads, throughput collection,
+DataItems JSON output (test/integration/scheduler_perf)."""
+
+from .harness import DataItem, Runner, ThroughputCollector, data_items_to_json, run_workload
+from .workloads import TEST_CASES
+
+__all__ = [
+    "DataItem",
+    "Runner",
+    "ThroughputCollector",
+    "data_items_to_json",
+    "run_workload",
+    "TEST_CASES",
+]

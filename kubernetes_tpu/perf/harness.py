@@ -1,0 +1,247 @@
+"""scheduler_perf — the declarative throughput/latency harness.
+
+Analog of test/integration/scheduler_perf: testCase × workload matrices from
+a YAML-ish config (plain dicts here; the file loader accepts JSON or YAML if
+available), ops createNodes/createPods/churn/barrier/sleep
+(scheduler_perf_test.go:253-518), a throughputCollector sampling
+scheduled-pod deltas at 1s granularity (util.go:284-329), and DataItems JSON
+output with the same schema (util.go:331-351) so results are directly
+comparable with the reference harness.
+
+The scheduler under test is either the sequential oracle path or the TPU
+batched path (``backend: tpu``) — the harness is the iso-measurement device
+for the ≥10× north star (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..api.wrappers import make_node, make_pod
+from ..apiserver.store import ClusterStore
+from ..config import load_config, scheduler_from_config
+
+
+@dataclass
+class DataItem:
+    """util.go:55 DataItem."""
+
+    data: Dict[str, float]
+    unit: str
+    labels: Dict[str, str] = field(default_factory=dict)
+
+
+def data_items_to_json(items: List[DataItem], version: str = "v1") -> str:
+    """util.go:165 dataItems2JSONFile schema."""
+    return json.dumps(
+        {
+            "version": version,
+            "dataItems": [
+                {"data": it.data, "unit": it.unit, "labels": it.labels} for it in items
+            ],
+        },
+        indent=2,
+    )
+
+
+class ThroughputCollector:
+    """util.go:284: samples scheduled-pod count each interval; pods/s series."""
+
+    def __init__(self, count_fn: Callable[[], int], interval: float = 1.0):
+        self.count_fn = count_fn
+        self.interval = interval
+        self.samples: List[float] = []
+        self._last_count = 0
+        self._last_t: Optional[float] = None
+
+    def start(self, now: float) -> None:
+        self._last_count = self.count_fn()
+        self._last_t = now
+
+    def maybe_sample(self, now: float) -> None:
+        if self._last_t is None:
+            self.start(now)
+            return
+        if now - self._last_t >= self.interval:
+            count = self.count_fn()
+            self.samples.append((count - self._last_count) / (now - self._last_t))
+            self._last_count = count
+            self._last_t = now
+
+    def finish(self, now: float) -> None:
+        if self._last_t is not None and now > self._last_t:
+            count = self.count_fn()
+            if count != self._last_count:
+                self.samples.append((count - self._last_count) / (now - self._last_t))
+
+    def summary(self) -> Dict[str, float]:
+        """SchedulingThroughput Average/Perc50/90/95/99 (util.go:331)."""
+        if not self.samples:
+            return {"Average": 0.0, "Perc50": 0.0, "Perc90": 0.0, "Perc95": 0.0, "Perc99": 0.0}
+        s = sorted(self.samples)
+
+        def pct(q: float) -> float:
+            i = min(len(s) - 1, max(0, int(q * len(s)) - 1))
+            return s[i]
+
+        return {
+            "Average": sum(s) / len(s),
+            "Perc50": pct(0.50),
+            "Perc90": pct(0.90),
+            "Perc95": pct(0.95),
+            "Perc99": pct(0.99),
+        }
+
+
+# ---------------------------------------------------------------------------
+# workload ops
+
+
+def _node_wrapper(i: int, params: dict):
+    nw = make_node(f"node-{i}").capacity(
+        params.get("capacity", {"cpu": "32", "memory": "128Gi", "pods": 110})
+    )
+    for k, v in (params.get("labels") or {}).items():
+        nw.label(k, str(v).format(i=i, zone=i % params.get("zones", 10)))
+    if params.get("zones"):
+        nw.label("topology.kubernetes.io/zone", f"zone-{i % params['zones']}")
+        nw.label("kubernetes.io/hostname", f"node-{i}")
+    return nw
+
+
+def _pod_wrapper(i: int, prefix: str, params: dict):
+    pw = make_pod(f"{prefix}-{i}").req(params.get("req", {"cpu": "900m", "memory": "2Gi"}))
+    for k, v in (params.get("labels") or {}).items():
+        pw.label(k, str(v).format(i=i))
+    if params.get("priority") is not None:
+        pw.priority(int(params["priority"]))
+    if params.get("spread_topology_key"):
+        from ..api.types import LabelSelector, TopologySpreadConstraint, DO_NOT_SCHEDULE
+
+        pw.label("spread-app", prefix)
+        pw.pod.spec.topology_spread_constraints = (
+            TopologySpreadConstraint(
+                max_skew=int(params.get("max_skew", 1)),
+                topology_key=params["spread_topology_key"],
+                when_unsatisfiable=DO_NOT_SCHEDULE,
+                label_selector=LabelSelector(match_labels={"spread-app": prefix}),
+            ),
+        )
+    return pw
+
+
+class Runner:
+    """runWorkload (scheduler_perf_test.go:623)."""
+
+    def __init__(self, scheduler_config: Optional[dict] = None, backend: str = "oracle",
+                 batch_size: int = 128, seed: int = 0):
+        self.store = ClusterStore()
+        self.backend = backend
+        cfg = load_config(scheduler_config)
+        if backend == "tpu":
+            from ..backend.tpu_scheduler import TPUScheduler
+
+            self.scheduler = TPUScheduler(self.store, batch_size=batch_size, seed=seed)
+        else:
+            self.scheduler = scheduler_from_config(self.store, cfg, seed=seed)
+        self.data_items: List[DataItem] = []
+        self._pod_counter = 0
+
+    # ---- ops ----
+
+    def create_nodes(self, count: int, **params) -> None:
+        for i in range(len(self.store.nodes), len(self.store.nodes) + count):
+            self.store.create_node(_node_wrapper(i, params).obj())
+
+    def create_pods(self, count: int, prefix: str = "pod", **params) -> None:
+        for _ in range(count):
+            self.store.create_pod(_pod_wrapper(self._pod_counter, prefix, params).obj())
+            self._pod_counter += 1
+
+    def barrier(self, timeout_s: float = 300.0) -> None:
+        """Wait (drive) until every pending pod has been attempted
+        (scheduler_perf_test.go:518 barrierOp)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            progressed = self.scheduler.run_until_settled()
+            if len(self.scheduler.queue) == 0:
+                return
+            if not progressed:
+                return  # only unschedulable pods remain
+        raise TimeoutError("barrier timed out")
+
+    def churn(self, count: int = 100, prefix: str = "churn") -> None:
+        """churnOp (:442): background create/delete during measurement."""
+        for i in range(count):
+            p = make_pod(f"{prefix}-{i}").req({"cpu": "1m"}).obj()
+            self.store.create_pod(p)
+            self.store.delete_pod(p.key())
+
+    # ---- measured phase ----
+
+    def measure(self, count: int, prefix: str = "measured", collector_interval: float = 1.0,
+                label: str = "SchedulingThroughput", churn_every: int = 0, **params) -> Dict[str, float]:
+        def scheduled_count():
+            return self.scheduler.metrics["scheduled"]
+
+        col = ThroughputCollector(scheduled_count, interval=collector_interval)
+        col.start(time.monotonic())
+        for _ in range(count):
+            self.store.create_pod(_pod_wrapper(self._pod_counter, prefix, params).obj())
+            self._pod_counter += 1
+        scheduled_before = scheduled_count()
+        target = scheduled_before + count
+        i = 0
+        while scheduled_count() < target:
+            if self.backend == "tpu":
+                progressed = self.scheduler.schedule_batch_cycle() > 0
+            else:
+                progressed = self.scheduler.schedule_one()
+            col.maybe_sample(time.monotonic())
+            if churn_every and i % churn_every == 0:
+                self.churn(1)
+            i += 1
+            if not progressed and scheduled_count() < target:
+                self.scheduler.queue.flush_backoff_completed()
+                if len(self.scheduler.queue) == 0:
+                    break  # some measured pods are genuinely unschedulable
+        col.finish(time.monotonic())
+        summary = col.summary()
+        self.data_items.append(DataItem(data=summary, unit="pods/s", labels={"Name": label}))
+        return summary
+
+    # ---- config-driven entry ----
+
+    def run_ops(self, ops: List[dict]) -> None:
+        """Declarative op list (the YAML workload form)."""
+        for op in ops:
+            kind = op["opcode"]
+            kwargs = {k: v for k, v in op.items() if k != "opcode"}
+            if kind == "createNodes":
+                self.create_nodes(**kwargs)
+            elif kind == "createPods":
+                self.create_pods(**kwargs)
+            elif kind == "measurePods":
+                self.measure(**kwargs)
+            elif kind == "barrier":
+                self.barrier(**kwargs)
+            elif kind == "churn":
+                self.churn(**kwargs)
+            elif kind == "sleep":
+                time.sleep(kwargs.get("seconds", 0))
+            else:
+                raise ValueError(f"unknown opcode {kind!r}")
+
+
+def run_workload(test_case: dict, backend: str = "oracle", **runner_kw) -> List[DataItem]:
+    """One testCase dict: {name, schedulerConfig?, ops: [...]}; returns its
+    DataItems (throughput + any scraped metrics)."""
+    r = Runner(scheduler_config=test_case.get("schedulerConfig"), backend=backend, **runner_kw)
+    r.run_ops(test_case["ops"])
+    for it in r.data_items:
+        it.labels.setdefault("TestCase", test_case.get("name", "unnamed"))
+        it.labels.setdefault("Backend", backend)
+    return r.data_items

@@ -1,0 +1,91 @@
+"""The workload matrix — transcription of the reference's canonical
+scheduler_perf cases (test/integration/scheduler_perf/config/
+performance-config.yaml) at the sizes BASELINE.md names.
+
+Sizes are parameterized so tests run the small variants and the bench the
+5000Nodes variants (performance-config.yaml:1-100 SchedulingBasic,
+:283-464 TopologySpreading/Preemption/Unschedulable)."""
+
+from __future__ import annotations
+
+
+def scheduling_basic(nodes=5000, init_pods=1000, measured=1000) -> dict:
+    return {
+        "name": f"SchedulingBasic/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init"},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "measured"},
+        ],
+    }
+
+
+def topology_spreading(nodes=5000, init_pods=5000, measured=2000) -> dict:
+    return {
+        "name": f"TopologySpreading/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init"},
+            {"opcode": "barrier"},
+            {
+                "opcode": "measurePods",
+                "count": measured,
+                "prefix": "spread",
+                "spread_topology_key": "topology.kubernetes.io/zone",
+            },
+        ],
+    }
+
+
+def unschedulable(nodes=5000, measured=2000) -> dict:
+    """Unschedulable pods stress the failure path (performance-config.yaml
+    Unschedulable): measured pods request impossible cpu."""
+    return {
+        "name": f"Unschedulable/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {
+                "opcode": "createPods",
+                "count": measured,
+                "prefix": "unsched",
+                "req": {"cpu": "512", "memory": "4Ti"},
+            },
+            {"opcode": "barrier"},
+        ],
+    }
+
+
+def preemption_basic(nodes=500, init_pods=2000, measured=500) -> dict:
+    return {
+        "name": f"PreemptionBasic/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes,
+             "capacity": {"cpu": "4", "memory": "16Gi", "pods": 32}},
+            {"opcode": "createPods", "count": init_pods, "prefix": "victim",
+             "req": {"cpu": "900m", "memory": "2Gi"}, "priority": 1},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "preemptor",
+             "req": {"cpu": "2", "memory": "4Gi"}, "priority": 100},
+        ],
+    }
+
+
+def scheduling_churn(nodes=1000, measured=1000) -> dict:
+    return {
+        "name": f"SchedulingWithChurn/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "measurePods", "count": measured, "prefix": "measured",
+             "churn_every": 10},
+        ],
+    }
+
+
+TEST_CASES = {
+    "SchedulingBasic": scheduling_basic,
+    "TopologySpreading": topology_spreading,
+    "Unschedulable": unschedulable,
+    "PreemptionBasic": preemption_basic,
+    "SchedulingWithChurn": scheduling_churn,
+}

@@ -36,8 +36,11 @@ from ..framework.types import (
     UPDATE_NODE_TAINT,
     ClusterEvent,
 )
+from ..metrics import SchedulerMetrics
 from ..queue import SchedulingQueue
 from ..queue import events as qevents
+from ..utils.events import EventRecorder, TYPE_NORMAL, TYPE_WARNING
+from ..utils.trace import Trace
 
 MIN_FEASIBLE_NODES_TO_FIND = 100           # schedule_one.go:52
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # :56
@@ -54,8 +57,15 @@ class Scheduler:
         pod_max_backoff: float = 10.0,
         assume_ttl: float = 30.0,
         now_fn=time.monotonic,
+        extenders=None,
+        metrics=None,
+        recorder=None,
     ):
         self.store = store
+        self.extenders = list(extenders or [])
+        self.smetrics = metrics if metrics is not None else SchedulerMetrics()
+        self.recorder = recorder if recorder is not None else EventRecorder()
+        self.trace_threshold_s = 0.1  # LogIfLong(100ms), schedule_one.go:313
         self.now_fn = now_fn
         self.cache = Cache(ttl=assume_ttl, now_fn=now_fn)
         self.snapshot = Snapshot()
@@ -76,6 +86,8 @@ class Scheduler:
             "snapshot_fn": lambda: self.snapshot.list(),
             "ns_labels_fn": store.ns_labels,
             "client": store,
+            "extenders": self.extenders,
+            "metrics": self.smetrics,
         }
         specs = profiles or {"default-scheduler": {}}
         self.profiles: Dict[str, Framework] = {}
@@ -108,7 +120,15 @@ class Scheduler:
     # ----------------------------------------------------------- event wiring
 
     def _add_all_event_handlers(self) -> None:
-        """eventhandlers.go:249 addAllEventHandlers."""
+        """eventhandlers.go:249 addAllEventHandlers.
+
+        Mirrors the informer's ListAndWatch contract (reflector.go:254): the
+        initial LIST replays objects that existed before the scheduler started
+        as ADD events, then the watch (handler registration) takes over."""
+        for node in list(self.store.nodes.values()):
+            self._on_node_event(ADDED, None, node)
+        for pod in list(self.store.pods.values()):
+            self._on_pod_event(ADDED, None, pod)
         self.store.add_event_handler("Pod", self._on_pod_event)
         self.store.add_event_handler("Node", self._on_node_event)
 
@@ -192,20 +212,26 @@ class Scheduler:
         fwk = self.framework_for_pod(pod)
         self.metrics["schedule_attempts"] += 1
         state = CycleState()
+        t0 = self.now_fn()
         try:
             node_name = self.schedule_pod(fwk, state, pod)
         except FitError as fe:
+            self.smetrics.observe_attempt("unschedulable", fwk.profile_name, self.now_fn() - t0)
             self._handle_scheduling_failure(fwk, state, qp, Status.unschedulable(*fe.args), fe.diagnosis, pod_cycle)
             return
         except Exception as e:  # noqa: BLE001 — cycle errors re-enqueue the pod
             self.metrics["errors"] += 1
+            self.smetrics.observe_attempt("error", fwk.profile_name, self.now_fn() - t0)
             self._handle_scheduling_failure(fwk, state, qp, Status.error(str(e)), Diagnosis(), pod_cycle)
             return
-        self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle)
+        self.smetrics.scheduling_algorithm_duration.observe(self.now_fn() - t0, fwk.profile_name)
+        self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle, t0=t0)
 
-    def assume_and_bind(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, pod: Pod, node_name: str, pod_cycle: int) -> None:
+    def assume_and_bind(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, pod: Pod, node_name: str, pod_cycle: int, t0: Optional[float] = None) -> None:
         """The post-decision tail shared by the sequential and TPU-batched
         paths: assume → Reserve → Permit → binding cycle."""
+        if t0 is None:
+            t0 = self.now_fn()
         # assume (schedule_one.go:734): next cycle sees this pod immediately;
         # the clone (with node_name set by assume_pod) is what every later
         # extension point receives, like the reference's assumedPod
@@ -219,7 +245,7 @@ class Scheduler:
         if status.code == fw.WAIT:
             # park: stays assumed; binding resumes on allow_waiting_pod
             # (runtime/waiting_pods_map.go; WaitOnPermit schedule_one.go:199)
-            self.waiting_pods[assumed.key()] = (fwk, state, assumed, node_name, pod_cycle)
+            self.waiting_pods[assumed.key()] = (fwk, state, assumed, node_name, pod_cycle, t0)
             return
         if not status.is_success():
             fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
@@ -227,22 +253,22 @@ class Scheduler:
             self._handle_scheduling_failure(fwk, state, qp, status, Diagnosis(), pod_cycle)
             return
 
-        self._binding_cycle(fwk, state, qp, assumed, node_name, pod_cycle)
+        self._binding_cycle(fwk, state, qp, assumed, node_name, pod_cycle, t0)
 
     def allow_waiting_pod(self, pod_key: str) -> bool:
         """Approve a Permit-parked pod: continue its binding cycle."""
         entry = self.waiting_pods.pop(pod_key, None)
         if entry is None:
             return False
-        fwk, state, assumed, node_name, pod_cycle = entry
-        self._binding_cycle(fwk, state, QueuedPodInfo(pod=assumed), assumed, node_name, pod_cycle)
+        fwk, state, assumed, node_name, pod_cycle, t0 = entry
+        self._binding_cycle(fwk, state, QueuedPodInfo(pod=assumed), assumed, node_name, pod_cycle, t0)
         return True
 
     def reject_waiting_pod(self, pod_key: str) -> bool:
         entry = self.waiting_pods.pop(pod_key, None)
         if entry is None:
             return False
-        fwk, state, assumed, node_name, pod_cycle = entry
+        fwk, state, assumed, node_name, pod_cycle, t0 = entry
         fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
         self.cache.forget_pod(assumed)
         self._handle_scheduling_failure(
@@ -266,11 +292,13 @@ class Scheduler:
             self._last_unsched_flush = now
             self.queue.flush_unschedulable_left_over()
 
-    def _binding_cycle(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, assumed: Pod, node_name: str, pod_cycle: int) -> None:
+    def _binding_cycle(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, assumed: Pod, node_name: str, pod_cycle: int, t0: Optional[float] = None) -> None:
         """(schedule_one.go:193) — synchronous here; the perf harness measures
         end-to-end anyway and the in-process store makes binds cheap."""
         status = fwk.run_pre_bind_plugins(state, assumed, node_name)
         if status.is_success():
+            status = self._extenders_binding(assumed, node_name)
+        if status is None:
             status = fwk.run_bind_plugins(state, assumed, node_name)
         if not status.is_success():
             fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
@@ -279,23 +307,64 @@ class Scheduler:
             return
         self.cache.finish_binding(assumed)
         self.metrics["scheduled"] += 1
+        self.smetrics.observe_attempt(
+            "scheduled", fwk.profile_name,
+            self.now_fn() - t0 if t0 is not None else 0.0,
+        )
+        self.recorder.eventf(
+            assumed.key(), TYPE_NORMAL, "Scheduled", "Binding",
+            f"Successfully assigned {assumed.key()} to {node_name}",
+        )
         fwk.run_post_bind_plugins(state, assumed, node_name)
+
+    def _extenders_binding(self, pod: Pod, node_name: str) -> Optional[Status]:
+        """(schedule_one.go:774) first interested binder extender wins; None
+        means no extender claimed the bind (fall through to bind plugins)."""
+        for ext in self.extenders:
+            if ext.is_binder() and ext.is_interested(pod):
+                try:
+                    ext.bind(pod, node_name)
+                    return Status()
+                except Exception as e:  # noqa: BLE001 — bind failure fails the cycle
+                    return Status.error(f"extender bind: {e}")
+        return None
 
     def schedule_pod(self, fwk: Framework, state: CycleState, pod: Pod) -> str:
         """(schedule_one.go:311) returns the chosen node name or raises FitError."""
+        trace = Trace("Scheduling", now_fn=self.now_fn, pod=pod.key())
         self.cache.update_snapshot(self.snapshot)
+        trace.step("Snapshotting scheduler cache and node infos done")
         all_nodes = self.snapshot.list()
         if not all_nodes:
             raise FitError(pod, 0, Diagnosis())
 
         feasible, diagnosis = self.find_nodes_that_fit_pod(fwk, state, pod, all_nodes)
+        trace.step("Computing predicates done")
         if not feasible:
+            trace.log_if_long(self.trace_threshold_s)
             raise FitError(pod, len(all_nodes), diagnosis)
         if len(feasible) == 1:
+            trace.log_if_long(self.trace_threshold_s)
             return feasible[0].node.meta.name
 
         fwk.run_pre_score_plugins(state, pod, [ni.node for ni in feasible])
         totals = fwk.run_score_plugins(state, pod, feasible)
+        trace.step("Prioritizing done")
+        trace.log_if_long(self.trace_threshold_s)
+        if self.extenders:
+            # prioritizeNodes (:662-691): extender scores are raw·weight added
+            # onto the plugin totals (extender max is 10, not 100)
+            nodes = [ni.node for ni in feasible]
+            for ext in self.extenders:
+                if not ext.is_interested(pod):
+                    continue
+                try:
+                    prios = ext.prioritize(pod, nodes)
+                except Exception:  # noqa: BLE001 — prioritize errors are ignored (:673)
+                    continue
+                for name, score in prios.items():
+                    if name in totals:
+                        totals[name] += score * ext.weight()
         return self._select_host(totals)
 
     def find_nodes_that_fit_pod(self, fwk: Framework, state: CycleState, pod: Pod, all_nodes) -> Tuple[List, Diagnosis]:
@@ -331,7 +400,34 @@ class Scheduler:
                 diagnosis.node_to_status[ni.node.meta.name] = st
                 diagnosis.unschedulable_plugins.add(st.plugin)
         self.next_start_node_index = (start + checked) % len(nodes) if nodes else 0
+        if feasible and self.extenders:
+            feasible = self._find_nodes_that_pass_extenders(pod, feasible, diagnosis)
         return feasible, diagnosis
+
+    def _find_nodes_that_pass_extenders(self, pod: Pod, feasible: List, diagnosis: Diagnosis) -> List:
+        """(schedule_one.go:547) run each interested extender's Filter verb;
+        ignorable extender failures drop the extender, not the cycle."""
+        from .extender import ExtenderError
+
+        by_name = {ni.node.meta.name: ni for ni in feasible}
+        nodes = [ni.node for ni in feasible]
+        for ext in self.extenders:
+            if not nodes:
+                break
+            if not ext.is_interested(pod):
+                continue
+            try:
+                nodes, failed, unresolvable = ext.filter(pod, nodes)
+            except ExtenderError:
+                if ext.is_ignorable():
+                    continue
+                raise
+            for name, reason in failed.items():
+                diagnosis.node_to_status[name] = Status.unschedulable(reason)
+            for name, reason in unresolvable.items():
+                # excluded from preemption candidates (preemption.go:363)
+                diagnosis.node_to_status[name] = Status.unresolvable(reason)
+        return [by_name[n.meta.name] for n in nodes]
 
     def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
         """Adaptive sampling (:525): 100% under 100 nodes; else
@@ -369,10 +465,17 @@ class Scheduler:
         nominated_node = ""
         if status.is_unschedulable():
             self.metrics["unschedulable"] += 1
+            for plugin in diagnosis.unschedulable_plugins:
+                self.smetrics.unschedulable_pods.set(plugin, fwk.profile_name, value=1)
             if diagnosis.node_to_status and fwk.points.get("post_filter"):
+                self.smetrics.preemption_attempts.inc()
                 nominated, pf_status = fwk.run_post_filter_plugins(state, pod, diagnosis.node_to_status)
                 if pf_status.is_success() and nominated:
                     nominated_node = nominated
+            self.recorder.eventf(
+                pod.key(), TYPE_WARNING, "FailedScheduling", "Scheduling",
+                "; ".join(status.reasons) or "unschedulable",
+            )
         if nominated_node:
             fwk.nominator.add_nominated_pod(pod, nominated_node)
             try:

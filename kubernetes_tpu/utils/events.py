@@ -1,0 +1,51 @@
+"""Event recorder — user-visible scheduling events.
+
+Analog of client-go tools/events (event_broadcaster.go:162 NewRecorder) with
+the series-deduplication the events API performs: repeated (object, reason,
+note) tuples within the dedup window increment a count instead of appending.
+The scheduler emits 'Scheduled' and 'FailedScheduling' exactly where the
+reference does (schedule_one.go:263 bind success, :292 skip, :843 failure).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+TYPE_NORMAL = "Normal"
+TYPE_WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    object_key: str
+    reason: str
+    note: str
+    type: str = TYPE_NORMAL
+    action: str = ""
+    count: int = 1
+    first_timestamp: float = field(default_factory=time.time)
+    last_timestamp: float = field(default_factory=time.time)
+
+
+class EventRecorder:
+    def __init__(self, dedup_window: float = 600.0, now_fn=time.time):
+        self.events: List[Event] = []
+        self._index: Dict[Tuple[str, str, str], int] = {}
+        self.dedup_window = dedup_window
+        self.now_fn = now_fn
+
+    def eventf(self, object_key: str, ev_type: str, reason: str, action: str, note: str) -> None:
+        key = (object_key, reason, note)
+        now = self.now_fn()
+        i = self._index.get(key)
+        if i is not None and now - self.events[i].last_timestamp < self.dedup_window:
+            self.events[i].count += 1
+            self.events[i].last_timestamp = now
+            return
+        self._index[key] = len(self.events)
+        self.events.append(Event(object_key, reason, note, ev_type, action, 1, now, now))
+
+    def for_object(self, object_key: str) -> List[Event]:
+        return [e for e in self.events if e.object_key == object_key]

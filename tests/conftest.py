@@ -6,8 +6,21 @@ xla_force_host_platform_device_count=8 per the driver's dryrun contract.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional: the ambient environment points JAX_PLATFORMS at the real TPU
+# (axon), but the test contract is an 8-device virtual CPU mesh.
+_platform = os.environ.get("KTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# A pytest plugin may have imported jax already (baking the ambient env into
+# jax.config); override programmatically — the backend itself initializes
+# lazily on first use, which is after conftest.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", _platform)
+except Exception:  # noqa: BLE001 — jax absent: nothing to force
+    pass

@@ -1,0 +1,211 @@
+"""Component-config (KubeSchedulerConfiguration) + extender protocol tests.
+
+Covers: decode/default/validate (apis/config/v1beta3), profile plugin-set
+merging incl. disable-'*' and MultiPoint, per-plugin args plumbing, multi-
+profile scheduling, and extender filter/prioritize/bind verbs
+(extender.go:247,:317,:359).
+"""
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.config import (
+    ConfigError,
+    load_config,
+    expand_profile,
+    scheduler_from_config,
+)
+from kubernetes_tpu.config.types import Extender as ExtenderConfig
+from kubernetes_tpu.scheduler.extender import CallableExtender
+
+
+def test_defaults():
+    cfg = load_config(None)
+    assert cfg.parallelism == 16
+    assert cfg.percentage_of_nodes_to_score == 0
+    assert cfg.pod_initial_backoff_seconds == 1.0
+    assert cfg.pod_max_backoff_seconds == 10.0
+    assert len(cfg.profiles) == 1
+    assert cfg.profiles[0].scheduler_name == "default-scheduler"
+
+
+def test_validation_errors():
+    with pytest.raises(ConfigError):
+        load_config({"parallelism": 0})
+    with pytest.raises(ConfigError):
+        load_config({"percentageOfNodesToScore": 101})
+    with pytest.raises(ConfigError):
+        load_config({"podMaxBackoffSeconds": 0.5})  # < initial 1.0
+    with pytest.raises(ConfigError):
+        load_config({"profiles": [{"schedulerName": "a"}, {"schedulerName": "a"}]})
+    with pytest.raises(ConfigError):
+        load_config({"apiVersion": "kubescheduler.config.k8s.io/v1beta1"})
+
+
+def test_profile_disable_and_enable():
+    cfg = load_config(
+        {
+            "profiles": [
+                {
+                    "schedulerName": "custom",
+                    "plugins": {
+                        "score": {
+                            "disabled": [{"name": "ImageLocality"}],
+                            "enabled": [{"name": "TaintToleration", "weight": 7}],
+                        },
+                        "filter": {"disabled": [{"name": "*"}]},
+                    },
+                }
+            ]
+        }
+    )
+    pc = expand_profile(cfg.profiles[0])
+    score = dict(pc["score"])
+    assert "ImageLocality" not in score
+    assert score["TaintToleration"] == 7  # re-enable overrides default weight 3
+    assert pc["filter"] == []
+    # untouched point keeps defaults
+    assert ("NodeResourcesFit", 0) in pc["pre_filter"]
+
+
+def test_plugin_args_reach_plugin():
+    cfg = load_config(
+        {
+            "profiles": [
+                {
+                    "schedulerName": "default-scheduler",
+                    "pluginConfig": [
+                        {"name": "NodeResourcesFit", "args": {"strategy": "MostAllocated"}}
+                    ],
+                }
+            ]
+        }
+    )
+    store = ClusterStore()
+    s = scheduler_from_config(store, cfg)
+    fit = s.profiles["default-scheduler"].plugin("NodeResourcesFit")
+    assert fit.strategy == "MostAllocated"
+
+
+def test_multi_profile_scheduling():
+    raw = {
+        "profiles": [
+            {"schedulerName": "default-scheduler"},
+            {
+                "schedulerName": "no-scoring",
+                "plugins": {"score": {"disabled": [{"name": "*"}]}},
+            },
+        ]
+    }
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    s = scheduler_from_config(store, raw=raw)
+    store.create_pod(make_pod("a").req({"cpu": "100m"}).obj())
+    p = make_pod("b").req({"cpu": "100m"}).obj()
+    p.spec.scheduler_name = "no-scoring"
+    store.create_pod(p)
+    q = make_pod("c").req({"cpu": "100m"}).obj()
+    q.spec.scheduler_name = "unknown-scheduler"  # not ours: must be ignored
+    store.create_pod(q)
+    s.run_until_settled()
+    assert store.get_pod("default/a").spec.node_name == "n1"
+    assert store.get_pod("default/b").spec.node_name == "n1"
+    assert store.get_pod("default/c").spec.node_name == ""
+
+
+def test_extender_filter_and_prioritize():
+    """Extender trims feasible set and its scores (×weight) shift the win."""
+    store = ClusterStore()
+    for i in range(3):
+        store.create_node(make_node(f"n{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+
+    def filt(pod, nodes):
+        keep = [n for n in nodes if n.meta.name != "n0"]
+        return keep, {"n0": "extender says no"}
+
+    def prio(pod, nodes):
+        return {n.meta.name: (10 if n.meta.name == "n2" else 0) for n in nodes}
+
+    ext = ExtenderConfig(instance=CallableExtender(filter_fn=filt, prioritize_fn=prio, weight=100))
+    s = scheduler_from_config(store, load_config(None))
+    s.extenders.extend(__import__("kubernetes_tpu.scheduler.extender", fromlist=["build_extenders"]).build_extenders([ext]))
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+    s.run_until_settled()
+    assert store.get_pod("default/p").spec.node_name == "n2"
+
+
+def test_extender_binder():
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    bound = {}
+
+    def bind(pod, node_name):
+        bound[pod.key()] = node_name
+        from kubernetes_tpu.api.types import Binding
+
+        store.bind(Binding(pod_key=pod.key(), node_name=node_name))
+
+    cfg = load_config(None)
+    cfg.extenders.append(ExtenderConfig(instance=CallableExtender(bind_fn=bind)))
+    s = scheduler_from_config(store, cfg)
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+    s.run_until_settled()
+    assert bound == {"default/p": "n1"}
+    assert store.get_pod("default/p").spec.node_name == "n1"
+
+
+def test_ignorable_extender_failure_is_tolerated():
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+
+    def bad_filter(pod, nodes):
+        from kubernetes_tpu.scheduler.extender import ExtenderError
+
+        raise ExtenderError("down")
+
+    cfg = load_config(None)
+    cfg.extenders.append(
+        ExtenderConfig(instance=CallableExtender(filter_fn=bad_filter, ignorable=True))
+    )
+    s = scheduler_from_config(store, cfg)
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+    s.run_until_settled()
+    assert store.get_pod("default/p").spec.node_name == "n1"
+
+
+def test_out_of_tree_plugin_registration():
+    """app.WithPlugin (server.go:293): out-of-tree factory merged into the
+    registry and enabled via profile config."""
+    calls = []
+
+    class VetoN1:
+        def name(self):
+            return "VetoN1"
+
+        def filter(self, state, pod, node_info):
+            calls.append(node_info.node.meta.name)
+            from kubernetes_tpu.framework.interface import OK, Status
+
+            if node_info.node.meta.name == "n1":
+                return Status.unschedulable("vetoed")
+            return OK
+
+    raw = {
+        "profiles": [
+            {
+                "schedulerName": "default-scheduler",
+                "plugins": {"filter": {"enabled": [{"name": "VetoN1"}]}},
+            }
+        ]
+    }
+    store = ClusterStore()
+    for i in range(1, 3):
+        store.create_node(make_node(f"n{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    s = scheduler_from_config(
+        store, raw=raw, out_of_tree_registry={"VetoN1": lambda h, a: VetoN1()}
+    )
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+    s.run_until_settled()
+    assert store.get_pod("default/p").spec.node_name == "n2"
+    assert calls  # plugin actually ran

@@ -1,0 +1,83 @@
+"""Metrics registry + scheduler metric names + events + trace tests
+(SURVEY.md §5.1/§5.5: identical metric names keep a scheduler_perf-style
+metricsCollector working; dedup in the event recorder; LogIfLong)."""
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.metrics import Histogram, Registry, SchedulerMetrics
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.utils.events import EventRecorder
+from kubernetes_tpu.utils.trace import Trace
+
+
+def test_metric_names_match_reference():
+    m = SchedulerMetrics()
+    exposition = m.registry.expose()
+    for name in (
+        "scheduler_schedule_attempts_total",
+        "scheduler_scheduling_attempt_duration_seconds",
+        "scheduler_scheduling_algorithm_duration_seconds",
+        "scheduler_framework_extension_point_duration_seconds",
+        "scheduler_plugin_execution_duration_seconds",
+        "scheduler_pending_pods",
+        "scheduler_queue_incoming_pods_total",
+        "scheduler_preemption_attempts_total",
+        "scheduler_preemption_victims",
+        "scheduler_unschedulable_pods",
+    ):
+        assert name in exposition, name
+
+
+def test_histogram_percentile_and_exposition():
+    h = Histogram("test_hist", "t", buckets=[0.001, 0.01, 0.1, 1.0])
+    for v in [0.005] * 90 + [0.5] * 10:
+        h.observe(v)
+    assert h.count() == 100
+    assert 0.001 < h.percentile(0.5) <= 0.01
+    assert 0.1 < h.percentile(0.99) <= 1.0
+    text = h.collect()
+    assert any("test_hist_bucket" in line for line in text)
+    assert any("+Inf" in line for line in text)
+
+
+def test_scheduler_emits_metrics_and_events():
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity({"cpu": "1", "memory": "4Gi", "pods": 10}).obj())
+    s = Scheduler(store)
+    store.create_pod(make_pod("ok").req({"cpu": "100m"}).obj())
+    store.create_pod(make_pod("huge").req({"cpu": "64"}).obj())
+    s.run_until_settled()
+
+    assert s.smetrics.schedule_attempts.labels("scheduled", "default-scheduler") == 1
+    assert s.smetrics.schedule_attempts.labels("unschedulable", "default-scheduler") >= 1
+    ev = s.recorder.for_object("default/ok")
+    assert any(e.reason == "Scheduled" for e in ev)
+    ev = s.recorder.for_object("default/huge")
+    assert any(e.reason == "FailedScheduling" for e in ev)
+
+
+def test_event_dedup():
+    clock = [0.0]
+    r = EventRecorder(now_fn=lambda: clock[0])
+    for _ in range(5):
+        r.eventf("default/p", "Warning", "FailedScheduling", "Scheduling", "no cpu")
+        clock[0] += 1
+    assert len(r.events) == 1
+    assert r.events[0].count == 5
+
+
+def test_trace_log_if_long():
+    clock = [0.0]
+
+    def now():
+        return clock[0]
+
+    t = Trace("Scheduling", now_fn=now, pod="default/p")
+    clock[0] = 0.05
+    t.step("predicates done")
+    clock[0] = 0.2
+    t.step("scoring done")
+    out = t.log_if_long(0.1)
+    assert out is not None and "predicates done" in out and "total=200.0ms" in out
+    t2 = Trace("Scheduling", now_fn=now)
+    assert t2.log_if_long(0.1) is None

@@ -1,0 +1,59 @@
+"""Perf harness tests at small sizes: ops run, throughput summary shape,
+DataItems JSON schema matches util.go:331 (SchedulingThroughput DataItem),
+both backends complete SchedulingBasic."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.perf import TEST_CASES, data_items_to_json, run_workload
+from kubernetes_tpu.perf.harness import ThroughputCollector
+
+
+def test_throughput_collector_sampling():
+    count = [0]
+    col = ThroughputCollector(lambda: count[0], interval=1.0)
+    t = 0.0
+    col.start(t)
+    for _ in range(5):
+        count[0] += 100
+        t += 1.0
+        col.maybe_sample(t)
+    s = col.summary()
+    assert abs(s["Average"] - 100.0) < 1e-6
+    assert s["Perc99"] >= s["Perc50"]
+
+
+def test_scheduling_basic_oracle():
+    tc = TEST_CASES["SchedulingBasic"](nodes=50, init_pods=20, measured=30)
+    items = run_workload(tc, backend="oracle")
+    assert len(items) == 1
+    assert items[0].unit == "pods/s"
+    assert items[0].labels["TestCase"] == "SchedulingBasic/50Nodes"
+    doc = json.loads(data_items_to_json(items))
+    assert doc["version"] == "v1"
+    assert "Average" in doc["dataItems"][0]["data"]
+
+
+def test_scheduling_basic_tpu_backend():
+    tc = TEST_CASES["SchedulingBasic"](nodes=32, init_pods=10, measured=20)
+    items = run_workload(tc, backend="tpu", batch_size=16)
+    assert items and items[0].unit == "pods/s"
+
+
+def test_preemption_workload():
+    tc = TEST_CASES["PreemptionBasic"](nodes=8, init_pods=24, measured=4)
+    items = run_workload(tc, backend="oracle")
+    assert items  # preemptors scheduled via evictions
+
+
+def test_unschedulable_workload_completes():
+    tc = TEST_CASES["Unschedulable"](nodes=16, measured=10)
+    items = run_workload(tc, backend="oracle")
+    assert items == [] or all(it.unit == "pods/s" for it in items)
+
+
+def test_churn_workload():
+    tc = TEST_CASES["SchedulingWithChurn"](nodes=16, measured=20)
+    items = run_workload(tc, backend="oracle")
+    assert items

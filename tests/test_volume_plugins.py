@@ -1,0 +1,175 @@
+"""Volume plugin tests: VolumeZone, VolumeRestrictions (RWOP), NodeVolumeLimits
+(CSINode limits), VolumeBinding (immediate-unbound, WaitForFirstConsumer
+match+reserve+prebind, PV node affinity)."""
+
+from kubernetes_tpu.api.types import (
+    BINDING_WAIT_FOR_FIRST_CONSUMER,
+    CSINode,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    RWOP,
+    StorageClass,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+
+def mk_store(n_nodes=2, zone=None):
+    store = ClusterStore()
+    for i in range(n_nodes):
+        nw = make_node(f"node-{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+        if zone:
+            nw.label("topology.kubernetes.io/zone", f"z{i}")
+        store.create_node(nw.obj())
+    return store
+
+
+def pvc(name, sc="", pv="", modes=(), ns="default"):
+    return PersistentVolumeClaim(
+        meta=ObjectMeta(name=name, namespace=ns),
+        storage_class=sc,
+        bound_pv=pv,
+        access_modes=tuple(modes),
+    )
+
+
+def test_volume_zone_filter():
+    store = mk_store(zone=True)
+    store.create_pv(PersistentVolume(
+        meta=ObjectMeta(name="pv-a", labels={"topology.kubernetes.io/zone": "z1"}),
+    ))
+    store.create_pvc(pvc("claim-a", pv="pv-a"))
+    s = Scheduler(store)
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).pvc("claim-a").obj())
+    s.run_until_settled()
+    assert store.get_pod("default/p").spec.node_name == "node-1"
+
+
+def test_rwop_exclusivity():
+    store = mk_store(n_nodes=1)
+    store.create_pv(PersistentVolume(meta=ObjectMeta(name="pv-excl"), bound_pvc="default/excl"))
+    store.create_pvc(pvc("excl", pv="pv-excl", modes=(RWOP,)))
+    s = Scheduler(store)
+    store.create_pod(make_pod("first").req({"cpu": "100m"}).pvc("excl").obj())
+    s.run_until_settled()
+    assert store.get_pod("default/first").spec.node_name == "node-0"
+    store.create_pod(make_pod("second").req({"cpu": "100m"}).pvc("excl").obj())
+    s.run_until_settled()
+    assert store.get_pod("default/second").spec.node_name == ""
+
+
+def test_node_volume_limits():
+    store = mk_store(n_nodes=1)
+    store.create_storage_class(StorageClass(meta=ObjectMeta(name="fast"), provisioner="csi.x"))
+    store.create_csinode(CSINode(meta=ObjectMeta(name="node-0"), drivers={"csi.x": 2}))
+    for i in range(3):
+        store.create_pv(PersistentVolume(meta=ObjectMeta(name=f"pv-{i}"), storage_class="fast", bound_pvc=f"default/c{i}"))
+        store.create_pvc(pvc(f"c{i}", sc="fast", pv=f"pv-{i}"))
+    s = Scheduler(store)
+    store.create_pod(make_pod("a").req({"cpu": "100m"}).pvc("c0").pvc("c1").obj())
+    s.run_until_settled()
+    assert store.get_pod("default/a").spec.node_name == "node-0"
+    store.create_pod(make_pod("b").req({"cpu": "100m"}).pvc("c2").obj())
+    s.run_until_settled()
+    assert store.get_pod("default/b").spec.node_name == ""
+
+
+def test_unbound_immediate_claim_blocks():
+    store = mk_store(n_nodes=1)
+    store.create_pvc(pvc("slow-claim"))  # no storage class => immediate, unbound
+    s = Scheduler(store)
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).pvc("slow-claim").obj())
+    s.run_until_settled()
+    assert store.get_pod("default/p").spec.node_name == ""
+
+
+def test_wait_for_first_consumer_binds_on_prebind():
+    store = mk_store(n_nodes=2, zone=True)
+    store.create_storage_class(StorageClass(
+        meta=ObjectMeta(name="wffc"), provisioner="csi.x",
+        volume_binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER,
+    ))
+    # one PV, only on node-1's zone
+    store.create_pv(PersistentVolume(
+        meta=ObjectMeta(name="pv-z1"),
+        storage_class="wffc",
+        capacity_bytes=10 << 30,
+        node_affinity={"topology.kubernetes.io/zone": ("z1",)},
+    ))
+    store.create_pvc(pvc("data", sc="wffc"))
+    s = Scheduler(store)
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).pvc("data").obj())
+    s.run_until_settled()
+    p = store.get_pod("default/p")
+    assert p.spec.node_name == "node-1"  # only node whose zone has a PV
+    assert store.get_pvc("default/data").bound_pv == "pv-z1"
+    assert store.get_pv("pv-z1").bound_pvc == "default/data"
+
+
+def test_bound_pv_node_affinity_conflict():
+    store = mk_store(n_nodes=2, zone=True)
+    store.create_pv(PersistentVolume(
+        meta=ObjectMeta(name="pv-pinned"),
+        node_affinity={"topology.kubernetes.io/zone": ("z0",)},
+        bound_pvc="default/pinned",
+    ))
+    store.create_pvc(pvc("pinned", pv="pv-pinned"))
+    s = Scheduler(store)
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).pvc("pinned").obj())
+    s.run_until_settled()
+    assert store.get_pod("default/p").spec.node_name == "node-0"
+
+
+def test_rwop_cluster_wide_at_prefilter():
+    """RWOP conflict rejects at PreFilter (UnschedulableAndUnresolvable) even
+    on nodes not hosting the conflicting pod (volume_restrictions.go:149)."""
+    store = mk_store(n_nodes=3)
+    store.create_pv(PersistentVolume(meta=ObjectMeta(name="pv-x"), bound_pvc="default/excl"))
+    store.create_pvc(pvc("excl", pv="pv-x", modes=(RWOP,)))
+    s = Scheduler(store)
+    store.create_pod(make_pod("first").req({"cpu": "100m"}).pvc("excl").obj())
+    s.run_until_settled()
+    store.create_pod(make_pod("second").req({"cpu": "100m"}).pvc("excl").obj())
+    s.run_until_settled()
+    second = store.get_pod("default/second")
+    assert second.spec.node_name == ""
+    # unresolvable ⇒ no preemption nomination either
+    assert second.status.nominated_node_name == ""
+
+
+def test_tpu_backend_routes_volume_pods_to_host_path():
+    """The batched kernel doesn't model volumes; PVC pods must take the
+    sequential fallback so VolumeBinding/Zone semantics hold."""
+    from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+    store = mk_store(n_nodes=2, zone=True)
+    store.create_pv(PersistentVolume(
+        meta=ObjectMeta(name="pv-a", labels={"topology.kubernetes.io/zone": "z1"}),
+    ))
+    store.create_pvc(pvc("claim-a", pv="pv-a"))
+    s = TPUScheduler(store, batch_size=8)
+    store.create_pod(make_pod("vp").req({"cpu": "100m"}).pvc("claim-a").obj())
+    store.create_pod(make_pod("plain").req({"cpu": "100m"}).obj())
+    s.run_until_settled()
+    assert store.get_pod("default/vp").spec.node_name == "node-1"  # zone matched
+    assert store.get_pod("default/plain").spec.node_name != ""
+    assert s.fallback_scheduled >= 1
+
+
+def test_smallest_fitting_pv_chosen():
+    store = mk_store(n_nodes=1)
+    store.create_storage_class(StorageClass(
+        meta=ObjectMeta(name="wffc"), provisioner="csi.x",
+        volume_binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER,
+    ))
+    store.create_pv(PersistentVolume(meta=ObjectMeta(name="big"), storage_class="wffc", capacity_bytes=100 << 30))
+    store.create_pv(PersistentVolume(meta=ObjectMeta(name="small"), storage_class="wffc", capacity_bytes=5 << 30))
+    c = pvc("data", sc="wffc")
+    c.requested_bytes = 1 << 30
+    store.create_pvc(c)
+    s = Scheduler(store)
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).pvc("data").obj())
+    s.run_until_settled()
+    assert store.get_pvc("default/data").bound_pv == "small"
