@@ -4,29 +4,33 @@ One device call schedules a whole pod micro-batch against the node snapshot:
 
   1. STATIC phase (once per batch): selector-VM evaluation + the filter masks
      and score components that cannot change intra-batch (labels, taints,
-     affinity, images — node properties no pod commit can alter).
+     affinity, images — node properties no pod commit can alter), plus the
+     existing-term domain tables for inter-pod affinity (ops/topology.py).
   2. COMMIT phase: ``lax.scan`` over the batch in queue order. Each step
-     computes the *dynamic* predicates (resource fit, ports) against the
-     evolving carry, normalizes scores over that pod's feasible set, picks the
-     winner (masked argmax + seeded uniform tie-break), and commits the pod's
-     resources/ports to its node — the reference's assume (schedule_one.go:734)
-     replayed inside the compiled program, which is what makes a K-pod batch
-     conflict-free without host round-trips.
+     computes the *dynamic* predicates (resource fit, ports, topology spread,
+     inter-pod affinity) against the evolving carry, normalizes scores over
+     that pod's feasible set, picks the winner (masked argmax + seeded uniform
+     tie-break), and commits the pod's resources/ports/pod-set memberships to
+     its node — the reference's assume (schedule_one.go:734) replayed inside
+     the compiled program, which is what makes a K-pod batch conflict-free
+     (including anti-affinity conflicts) without host round-trips.
 
-The scan's per-step work is O(N·R); the expensive [P,N]-shaped work stays in
-the vectorized static phase. Sequential semantic parity: the winner for pod k
-is chosen against exactly the state the reference's serial loop would see.
+The scan's per-step work is O(N·R + C·(N+Vd)); the expensive [P,N]-shaped work
+stays in the vectorized static phase. Sequential semantic parity: the winner
+for pod k is chosen against exactly the state the reference's serial loop
+would see.
 
 SPMD: the same program runs under ``shard_map`` with the node axis sharded
-across a mesh (parallel/mesh.py). ``axis_name`` threads the three reduction
-points through collectives — normalize-max (pmax), winner selection
-(pmax + argmin-of-axis tie-break), and valid-node count (psum). Per scan step
-that is a handful of scalar collectives over ICI — the P1/P7-style node-axis
-sharding of SURVEY.md §2.7/§5.7, far cheaper than resharding score matrices.
+across a mesh (parallel/mesh.py). ``axis_name`` threads the reduction points
+through collectives — normalize-max (pmax), winner selection (pmax +
+argmin-of-axis tie-break), valid-node count (psum), and the per-step segment
+tables (psum of small [C,Vd] partials) — the P1/P7-style node-axis sharding
+of SURVEY.md §2.7/§5.7.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Dict, NamedTuple, Optional, Tuple
 
@@ -34,18 +38,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops import filters, scores
-from ..ops.schema import ExprTable, NodeTensors, PodBatch
+from ..ops import filters, scores, topology
+from ..ops.topology import _gmax, _gmin, _gsum
+from ..ops.schema import ExprTable, NodeTensors, PodBatch, TopoBatch, TopoCounts
 from ..ops.select import NEG_INF
 
-# default plugin weights on the batched path (default_plugins.go:32-51; the
-# spread/interpod components join in the sig-count extension)
+# default plugin weights on the batched path (default_plugins.go:32-51)
 DEFAULT_WEIGHTS = {
     "NodeResourcesBalancedAllocation": 1.0,
     "ImageLocality": 1.0,
     "NodeResourcesFit": 1.0,
     "NodeAffinity": 2.0,
     "TaintToleration": 3.0,
+    "PodTopologySpread": 2.0,
+    "InterPodAffinity": 2.0,
 }
 
 
@@ -56,6 +62,8 @@ class BatchResult(NamedTuple):
     static_masks: Dict[str, jax.Array]  # plugin name -> [P, N] (for diagnosis)
     fit_ok: jax.Array        # [P, N] resource fit at decision time
     ports_ok: jax.Array      # [P, N] port availability at decision time
+    spread_ok: jax.Array     # [P, N] PodTopologySpread filter at decision time
+    ipa_ok: jax.Array        # [P, N] InterPodAffinity (all three checks)
 
 
 def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
@@ -66,18 +74,6 @@ def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
     out = jnp.zeros((P, words), jnp.uint32)
     # ids are deduplicated at encode time, so add == bitwise-or here
     return out.at[jnp.arange(P)[:, None], word_idx].add(bit)
-
-
-def _gmax(x, axis_name):
-    return x if axis_name is None else lax.pmax(x, axis_name)
-
-
-def _gmin(x, axis_name):
-    return x if axis_name is None else lax.pmin(x, axis_name)
-
-
-def _gsum(x, axis_name):
-    return x if axis_name is None else lax.psum(x, axis_name)
 
 
 def _normalize(raw: jax.Array, feasible: jax.Array, reverse: bool, axis_name=None) -> jax.Array:
@@ -94,11 +90,18 @@ def schedule_batch_core(
     pb: PodBatch,
     et: ExprTable,
     nt: NodeTensors,
+    tc: TopoCounts,
+    tb: TopoBatch,
     key: jax.Array,
     weights_key: Tuple[Tuple[str, float], ...],
+    topo_enabled: bool = True,
     axis_name: Optional[str] = None,
+    num_shards: int = 1,
 ) -> BatchResult:
-    """The traceable body; nt's node axis may be a shard (axis_name set)."""
+    """The traceable body; nt's node axis may be a shard (axis_name set).
+    ``topo_enabled`` is a trace-time flag: batches with no spread constraints,
+    no affinity terms and no registered count rows compile a program with the
+    whole topology path dead-code-eliminated (the common fast case)."""
     weights = dict(weights_key)
     N = nt.capacity  # local shard size under shard_map
     if axis_name is None:
@@ -129,27 +132,51 @@ def schedule_batch_core(
     total_nodes = jnp.maximum(_gsum(jnp.sum(nt.valid), axis_name), 1)
     image_score = scores.score_image_locality(pb, nt, total_nodes=total_nodes)
 
-    jitter = jax.random.uniform(key, (pb.capacity, N), jnp.float32, 0.0, 0.5)
-    if axis_name is not None:
-        # decorrelate jitter across shards
-        jitter = jax.random.uniform(
-            jax.random.fold_in(key, lax.axis_index(axis_name)),
-            (pb.capacity, N), jnp.float32, 0.0, 0.5,
+    vd = int(et.bits.shape[1]) * 32  # value-id domain capacity (per-key vocab)
+    if topo_enabled:
+        topo_static = topology.make_static(
+            tc.term_counts, tc.term_key, nt.label_val, nt.valid, vd, axis_name
         )
+
+    # tie-break jitter keyed by GLOBAL slot: every shard draws the same
+    # [P, N_global] table and slices its window, so the sharded program picks
+    # exactly the node the single-device program would (deterministic parity)
+    jitter_global = jax.random.uniform(
+        key, (pb.capacity, N * num_shards), jnp.float32, 0.0, 0.5)
+    if axis_name is None:
+        jitter = jitter_global
+    else:
+        jitter = lax.dynamic_slice_in_dim(jitter_global, slot_offset, N, axis=1)
 
     # ---- commit phase -----------------------------------------------------
     pod_bits = _pod_port_bits(pb, nt.port_bits.shape[1])
     alloc_f = nt.allocatable.astype(jnp.float32)                  # [N, R]
+    ones_pn = jnp.ones((N,), bool)
 
     def step(carry, xs):
-        req_dyn, nz_dyn, port_dyn = carry
-        (p_req, p_nz, p_static_ok, p_taint, p_aff, p_img, p_bits, p_jitter, p_valid) = xs
+        req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist = carry
+        row = xs["row"]
+        (p_req, p_nz, p_static_ok, p_affinity_ok, p_taint, p_aff, p_img, p_bits,
+         p_jitter, p_valid) = row
 
         free = nt.allocatable - req_dyn                           # [N, R]
         fit_ok = jnp.all((p_req[None, :] <= free) | (p_req[None, :] == 0), axis=-1)
         conflict = jnp.any(port_dyn & p_bits[None, :], axis=-1)
         ports_ok = ~conflict
-        feasible = p_static_ok & fit_ok & ports_ok
+
+        if topo_enabled:
+            tbx = xs["tb"]
+            spread_ok = topology.spread_filter(
+                tbx, sel_counts, nt.label_val, nt.valid, p_affinity_ok, vd, axis_name)
+            ipa_aff_ok, ipa_anti_ok, ipa_exist_ok, exist_at = topology.ipa_filter(
+                tbx, sel_counts, seg_exist, topo_static.dom_t, nt.label_val,
+                nt.valid, vd, axis_name)
+            ipa_ok = ipa_aff_ok & ipa_anti_ok & ipa_exist_ok
+        else:
+            spread_ok = ones_pn
+            ipa_ok = ones_pn
+
+        feasible = p_static_ok & fit_ok & ports_ok & spread_ok & ipa_ok
 
         # resource scores against the evolving requested state
         nz_req = nz_dyn.astype(jnp.float32) + p_nz[None, :].astype(jnp.float32)
@@ -169,6 +196,12 @@ def schedule_batch_core(
             + weights["NodeAffinity"] * _normalize(p_aff, feasible, False, axis_name)
             + weights["ImageLocality"] * p_img
         )
+        if topo_enabled:
+            total = total + weights["PodTopologySpread"] * topology.spread_score(
+                tbx, sel_counts, nt.label_val, nt.valid, p_affinity_ok, feasible, vd, axis_name)
+            total = total + weights["InterPodAffinity"] * topology.ipa_score(
+                tbx, sel_counts, exist_at, nt.label_val, nt.valid, feasible, vd, axis_name)
+
         eff = jnp.where(feasible, total + p_jitter, NEG_INF)
         local_idx = jnp.argmax(eff).astype(jnp.int32)
         local_best = eff[local_idx]
@@ -192,15 +225,28 @@ def schedule_batch_core(
         port_dyn = port_dyn.at[local_idx].set(
             jnp.where(commit, port_dyn[local_idx] | p_bits, port_dyn[local_idx])
         )
+        if topo_enabled:
+            sel_counts, seg_exist = topology.commit_update(
+                sel_counts, seg_exist, topo_static.dom_t, local_idx,
+                any_feasible, mine, tbx["pod_sig_mask"], tbx["pod_term_mask"], axis_name)
         out_idx = jnp.where(any_feasible, global_idx, -1)
-        return (req_dyn, nz_dyn, port_dyn), (out_idx, best, any_feasible, fit_ok, ports_ok)
+        return (req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist), (
+            out_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok,
+        )
 
-    xs = (
-        pb.req, pb.nonzero_req, static_ok, taint_raw, affinity_raw, image_score,
-        pod_bits, jitter, pb.valid,
+    rows = (
+        pb.req, pb.nonzero_req, static_ok, static_masks["NodeAffinity"], taint_raw,
+        affinity_raw, image_score, pod_bits, jitter, pb.valid,
     )
-    carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits)
-    _, (node_idx, best, any_feasible, fit_ok, ports_ok) = lax.scan(step, carry0, xs)
+    xs = {"row": rows}
+    if topo_enabled:
+        xs["tb"] = {f.name: getattr(tb, f.name) for f in dataclasses.fields(tb)}
+        seg_exist0 = topo_static.seg_exist0
+    else:
+        seg_exist0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
+    carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits, tc.sel_counts, seg_exist0)
+    _, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
+        step, carry0, xs)
 
     return BatchResult(
         node_idx=node_idx,
@@ -209,25 +255,32 @@ def schedule_batch_core(
         static_masks=static_masks,
         fit_ok=fit_ok,
         ports_ok=ports_ok,
+        spread_ok=spread_ok,
+        ipa_ok=ipa_ok,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("weights_key",))
+@functools.partial(jax.jit, static_argnames=("weights_key", "topo_enabled"))
 def schedule_batch(
     pb: PodBatch,
     et: ExprTable,
     nt: NodeTensors,
+    tc: TopoCounts,
+    tb: TopoBatch,
     key: jax.Array,
     weights_key: Tuple[Tuple[str, float], ...] = tuple(sorted(DEFAULT_WEIGHTS.items())),
+    topo_enabled: bool = True,
 ) -> BatchResult:
-    return schedule_batch_core(pb, et, nt, key, weights_key)
+    return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled)
 
 
 def build_schedule_batch_fn(weights: Dict[str, float] = None):
-    """Bind plugin weights statically; returns fn(pb, et, nt, key) -> BatchResult."""
+    """Bind plugin weights statically; returns
+    fn(pb, et, nt, tc, tb, key, topo_enabled=True) -> BatchResult."""
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
 
-    def fn(pb, et, nt, key):
-        return schedule_batch(pb, et, nt, key, weights_key=wk)
+    def fn(pb, et, nt, tc, tb, key, topo_enabled=True):
+        return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
+                              topo_enabled=topo_enabled)
 
     return fn

@@ -33,16 +33,33 @@ _ROW_FIELDS = (
 
 
 class DeviceState:
-    def __init__(self, caps: Capacities):
+    def __init__(self, caps: Capacities, ns_labels_fn=None):
+        from .sig_table import SigTable
+
         self.caps = caps
         self.encoder = ClusterEncoder(caps)
+        self.sig_table = SigTable(self.encoder, ns_labels_fn)
         self.nt = self._empty_tensors()
+        self._tc = None                           # cached device TopoCounts
+        self._tc_version = -1
         self._uploaded_gen: Dict[str, int] = {}   # node name -> generation on device
         self._image_counts: Dict[str, int] = {}   # image -> num nodes (host truth)
         self._image_sizes: Dict[str, int] = {}
         self._node_images: Dict[str, frozenset] = {}
         self.syncs = 0
         self.rows_uploaded = 0
+
+    @property
+    def tc(self):
+        """Device TopoCounts, re-uploaded only when the host truth changed."""
+        if self._tc is None or self._tc_version != self.sig_table.version:
+            self._tc = self.sig_table.topo_counts()
+            self._tc_version = self.sig_table.version
+        return self._tc
+
+    @property
+    def topo_enabled(self) -> bool:
+        return self.sig_table.n_sigs > 1 or self.sig_table.n_terms > 1
 
     def _empty_tensors(self) -> NodeTensors:
         c = self.caps
@@ -80,6 +97,7 @@ class DeviceState:
             dirty.append((slot, ni))
             self._uploaded_gen[name] = ni.generation
             images_changed |= self._track_images(name, ni)
+            self.sig_table.recount_node(slot, ni)
         # removed nodes: zero their rows
         removed = [n for n in self._uploaded_gen if n not in current]
         for name in removed:
@@ -87,6 +105,7 @@ class DeviceState:
             slot = self.encoder.release_node_slot(name)
             if slot is not None:
                 dirty.append((slot, NodeInfo()))  # empty row: valid=False
+                self.sig_table.recount_node(slot, None)
             images_changed |= self._track_images(name, None)
 
         if not dirty:

@@ -41,6 +41,8 @@ _ATTRIBUTION_ORDER = (
     ("NodeAffinity", "node(s) didn't match Pod's node affinity/selector"),
     ("NodePorts", "node(s) didn't have free ports for the requested pod ports"),
     ("NodeResourcesFit", "Insufficient resources"),
+    ("PodTopologySpread", "node(s) didn't match pod topology spread constraints"),
+    ("InterPodAffinity", "node(s) didn't match pod affinity/anti-affinity rules"),
 )
 
 
@@ -59,7 +61,8 @@ class TPUScheduler(Scheduler):
     def _ensure_device(self) -> None:
         n = max(self.cache.node_count(), 1)
         if self.device is None:
-            self.device = DeviceState(caps_for_cluster(n, batch=self.batch_size))
+            self.device = DeviceState(caps_for_cluster(n, batch=self.batch_size),
+                                      ns_labels_fn=self.store.ns_labels)
             self.device.sync(self.snapshot)
         elif self.device.caps.nodes < n:
             # preserve every previously-grown axis; only widen the node axis
@@ -74,7 +77,7 @@ class TPUScheduler(Scheduler):
                 caps, nodes=nodes,
                 value_words=max(caps.value_words, (nodes + 2 + 31) // 32),
             )
-            self.device = DeviceState(caps)
+            self.device = DeviceState(caps, ns_labels_fn=self.store.ns_labels)
             self.device.sync(self.snapshot)
 
     # CapacityError.dimension → Capacities field(s) to double (exact names
@@ -95,6 +98,11 @@ class TPUScheduler(Scheduler):
         "ports vocab": ("port_words",),
         "image vocab": ("image_words", "images"),
         "containers": ("containers",),
+        "sigs": ("sigs",),
+        "ex_terms": ("ex_terms",),
+        "spread_cons": ("spread_cons",),
+        "ipa_terms": ("ipa_terms",),
+        "ipa_pref": ("ipa_pref",),
     }
 
     def _resync_grown(self, err: CapacityError) -> None:
@@ -113,25 +121,20 @@ class TPUScheduler(Scheduler):
             while v < err.needed:
                 v *= 2
             updates[f] = v
-        self.device = DeviceState(dataclasses.replace(caps, **updates))
+        self.device = DeviceState(dataclasses.replace(caps, **updates),
+                                  ns_labels_fn=self.store.ns_labels)
         self.device.sync(self.snapshot)
 
     # ------------------------------------------------------------- batch support
 
     def batch_supported(self, pod: Pod) -> bool:
         """Features the batched kernel covers today; the rest take the
-        sequential oracle path (config fallback knob, SURVEY.md §7)."""
+        sequential oracle path (config fallback knob, SURVEY.md §7).
+        Topology spread and inter-pod affinity run on device via the
+        sig-count kernels (ops/topology.py); volume plugins stay on the host
+        path (volume.py — PreBind-heavy, off the hot loop per SURVEY.md §7
+        hard-part 6)."""
         if pod.spec.volumes:
-            return False  # volume plugins stay on the host path (volume.py)
-        if pod.spec.topology_spread_constraints:
-            return False
-        a = pod.spec.affinity
-        if a is not None and (a.pod_affinity is not None or a.pod_anti_affinity is not None):
-            return False
-        # symmetric anti-affinity: existing pods with required anti-affinity
-        # can reject ANY incoming pod (interpodaffinity filtering.go:308) —
-        # until the sig-count kernel lands, such clusters stay sequential
-        if self.snapshot.have_pods_with_required_anti_affinity_list:
             return False
         return True
 
@@ -172,10 +175,12 @@ class TPUScheduler(Scheduler):
         if not batched:
             return
         self.cache.update_snapshot(self.snapshot)
-        for _attempt in range(6):
+        for _attempt in range(8):
             try:
                 self.device.sync(self.snapshot)
-                pb, et = self.device.encoder.encode_pods([qp.pod for qp in batched])
+                pods = [qp.pod for qp in batched]
+                pb, et = self.device.encoder.encode_pods(pods)
+                tb = self.device.sig_table.encode_topo(pods)
                 break
             except CapacityError as e:
                 self._resync_grown(e)
@@ -185,7 +190,10 @@ class TPUScheduler(Scheduler):
             return
         self.batch_counter += 1
         key = jax.random.PRNGKey(self.batch_counter)
-        result = self.schedule_batch_fn(pb, et, self.device.nt, key)
+        result = self.schedule_batch_fn(
+            pb, et, self.device.nt, self.device.tc, tb, key,
+            topo_enabled=self.device.topo_enabled,
+        )
         self._commit_batch(batched, result, pod_cycle)
 
     def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult, pod_cycle: int) -> None:
@@ -194,6 +202,8 @@ class TPUScheduler(Scheduler):
         masks = {k: np.asarray(v) for k, v in result.static_masks.items()}
         masks["NodePorts"] = np.asarray(result.ports_ok)
         masks["NodeResourcesFit"] = np.asarray(result.fit_ok)
+        masks["PodTopologySpread"] = np.asarray(result.spread_ok)
+        masks["InterPodAffinity"] = np.asarray(result.ipa_ok)
 
         for i, qp in enumerate(qps):
             pod = qp.pod

@@ -135,6 +135,67 @@ class PodBatch:
         return self.valid.shape[0]
 
 
+@pytree_dataclass
+class TopoCounts:
+    """Device-resident pod-set count tables — the incremental tensorization of
+    the O(pods) scans in PodTopologySpread.PreFilter (filtering.go:238) and
+    InterPodAffinity.PreFilter (filtering.go:86-135).
+
+    ``sel_counts[s, n]`` = number of pods currently on node n matching
+    registered pod-set signature s (a (namespace-spec, label-selector) pair —
+    the unit both plugins count by). ``term_counts[t, n]`` = number of pods on
+    node n *carrying* registered (anti-)affinity term t (the symmetric
+    direction: existing pods' terms evaluated against the incoming pod).
+    Both are maintained host-side per node generation and updated in-scan as
+    batch pods commit."""
+
+    sel_counts: jax.Array   # [S, N] int32
+    term_counts: jax.Array  # [T, N] int32
+    term_key: jax.Array     # [T] int32 topology-key slot of term t (0 = unused row)
+
+
+@pytree_dataclass
+class TopoBatch:
+    """Per-batch compiled topology programs: spread constraints and
+    inter-pod-affinity terms of the batch pods, pointing into TopoCounts rows.
+    All index fields are 0 where invalid (row 0 of each table is a zero row)."""
+
+    # PodTopologySpread DoNotSchedule constraints (filter), [P, C]
+    sf_valid: jax.Array        # bool
+    sf_sig: jax.Array          # int32 sig row
+    sf_key: jax.Array          # int32 topology-key slot
+    sf_skew: jax.Array         # int32 maxSkew
+    sf_self: jax.Array         # bool: incoming pod matches the constraint selector
+    sf_min_domains: jax.Array  # int32, -1 = unset
+    # PodTopologySpread ScheduleAnyway constraints (score), [P, C]
+    ss_valid: jax.Array        # bool
+    ss_sig: jax.Array
+    ss_key: jax.Array
+    ss_skew: jax.Array
+    ss_hostname: jax.Array     # bool: topologyKey == kubernetes.io/hostname
+    ss_require_all: jax.Array  # [P] bool (pod-specified or non-system defaults)
+    # incoming pod's required pod-affinity terms, [P, A]
+    ia_valid: jax.Array
+    ia_sig: jax.Array
+    ia_key: jax.Array
+    ia_self_all: jax.Array     # [P] bool: pod matches ALL its own affinity terms
+    # incoming pod's required pod-anti-affinity terms, [P, A]
+    ianti_valid: jax.Array
+    ianti_sig: jax.Array
+    ianti_key: jax.Array
+    # incoming pod's preferred (anti-)affinity terms, [P, PT]
+    ip_valid: jax.Array
+    ip_sig: jax.Array
+    ip_key: jax.Array
+    ip_w: jax.Array            # int32 signed weight (negative = anti)
+    # existing-term interactions, [P, T]
+    term_filter_match: jax.Array  # bool: ANTI_REQ term t matches incoming pod p
+    term_score_w: jax.Array       # float32 symmetric score weight of term t for pod p
+    # commit updates (what a committing pod adds to the node it lands on)
+    pod_sig_mask: jax.Array    # [P, S] bool
+    pod_term_mask: jax.Array   # [P, T] bool
+
+
 @dataclasses.dataclass(frozen=True)
 class Capacities:
     """Static padding sizes; one compiled executable per Capacities value."""
@@ -156,6 +217,11 @@ class Capacities:
     image_words: int = 16     # Wimg
     images: int = 1 + 16 * 32  # Vimg (vocab capacity = image_words*32, +0 slot)
     containers: int = 4       # C per pod
+    sigs: int = 8             # S: registered pod-set signatures (row 0 reserved)
+    ex_terms: int = 8         # T: registered existing-pod terms (row 0 reserved)
+    spread_cons: int = 2      # C: topology-spread constraints per pod per kind
+    ipa_terms: int = 2        # A: required (anti-)affinity terms per pod
+    ipa_pref: int = 2         # PT: preferred terms per pod (both signs combined)
 
     def grow_nodes(self, n: int) -> "Capacities":
         cap = self.nodes

@@ -1,0 +1,232 @@
+"""Segment-reduction kernels for the two "hard" plugins (SURVEY.md §7 step 5):
+PodTopologySpread and InterPodAffinity on the batched device path.
+
+Topology domains are label-value-id buckets: a constraint/term's per-domain
+pod counts are one scatter-add of TopoCounts rows over ``label_val[:, key]``,
+and per-node reads are one gather back — the tensorization of the reference's
+``map[{topologyKey,value}]int`` bookkeeping (podtopologyspread/filtering.go:40
+preFilterState, interpodaffinity/filtering.go:155 topologyToMatchedTermCount).
+
+Everything here runs INSIDE the commit scan of backend/batch.py: counts evolve
+as batch pods commit, so pod k sees exactly the topology state the reference's
+serial loop would (anti-affinity violations within one batch are impossible by
+construction, SURVEY.md §7 hard-part 4).
+
+Sharding: scatters run over the local node shard, then one psum merges the
+per-shard segment tables; reads stay shard-local. seg_exist (existing-term
+domain counts) is replicated and updated on every shard via a psum'd
+commit-domain broadcast.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+INT_MAX = jnp.int32(2**31 - 1)
+
+
+def _gsum(x, axis_name):
+    return x if axis_name is None else lax.psum(x, axis_name)
+
+
+def _gmax(x, axis_name):
+    return x if axis_name is None else lax.pmax(x, axis_name)
+
+
+def _gmin(x, axis_name):
+    return x if axis_name is None else lax.pmin(x, axis_name)
+
+
+class TopoStatic(NamedTuple):
+    """Per-batch static context (node labels cannot change intra-batch)."""
+
+    dom_t: jax.Array      # [T, N] domain id of node n under term t's topology key
+    seg_exist0: jax.Array  # [T, Vd] per-domain counts of pods carrying term t
+
+
+def make_static(term_counts: jax.Array, term_key: jax.Array, label_val: jax.Array,
+                valid: jax.Array, vd: int, axis_name: Optional[str] = None) -> TopoStatic:
+    T = term_counts.shape[0]
+    dom_t = label_val[:, term_key].T                                  # [T, N]
+    add = jnp.where(valid[None, :] & (dom_t > 0), term_counts, 0)
+    t_iota = jnp.arange(T, dtype=jnp.int32)[:, None]
+    seg = jnp.zeros((T, vd), jnp.int32).at[t_iota, dom_t].add(add)
+    return TopoStatic(dom_t=dom_t, seg_exist0=_gsum(seg, axis_name))
+
+
+def _seg_counts(sig: jax.Array, key: jax.Array, sel_counts: jax.Array,
+                label_val: jax.Array, elig: jax.Array, vd: int, axis_name):
+    """Shared scatter: per-domain sums of sel_counts[sig] over eligible nodes.
+    sig/key [C]; elig [C, N] or [N]. Returns (dom [C,N], has_key [C,N],
+    seg [C,Vd] global, cnt_at [C,N])."""
+    C = sig.shape[0]
+    dom = label_val[:, key].T                                          # [C, N]
+    has_key = dom > 0
+    if elig.ndim == 1:
+        elig = jnp.broadcast_to(elig[None, :], dom.shape)
+    cnts = sel_counts[sig]                                             # [C, N]
+    # nodes lacking the topology key are never counted (the reference skips
+    # them: tv == None). Keeps segment column 0 empty so whole-table sums
+    # (the first-pod-in-cluster check) match the oracle.
+    add = jnp.where(elig & has_key, cnts, 0)
+    c_iota = jnp.arange(C, dtype=jnp.int32)[:, None]
+    seg = jnp.zeros((C, vd), jnp.int32).at[c_iota, dom].add(add)
+    seg = _gsum(seg, axis_name)
+    cnt_at = jnp.take_along_axis(seg, dom, axis=1)                     # [C, N]
+    return dom, has_key, seg, cnt_at
+
+
+# ----------------------------------------------------------------- filters
+
+
+def spread_filter(xs, sel_counts, label_val, valid, affinity_ok, vd, axis_name):
+    """PodTopologySpread Filter (filtering.go:335): per DoNotSchedule
+    constraint, matchNum + selfMatch − minMatchNum ≤ maxSkew over domains of
+    eligible nodes (nodes matching the pod's node affinity AND carrying every
+    constraint's topology key). Returns [N] bool."""
+    sf_valid, sf_sig, sf_key, sf_skew, sf_self, sf_min_dom = (
+        xs["sf_valid"], xs["sf_sig"], xs["sf_key"], xs["sf_skew"], xs["sf_self"], xs["sf_min_domains"],
+    )
+    C = sf_sig.shape[0]
+    dom = label_val[:, sf_key].T                                       # [C, N]
+    has_key = dom > 0
+    has_all = jnp.all(jnp.where(sf_valid[:, None], has_key, True), axis=0)   # [N]
+    elig = valid & affinity_ok & has_all
+    _, _, seg, cnt_at = _seg_counts(sf_sig, sf_key, sel_counts, label_val, elig, vd, axis_name)
+
+    c_iota = jnp.arange(C, dtype=jnp.int32)[:, None]
+    pres = jnp.zeros((C, vd), jnp.int32).at[c_iota, dom].add(
+        jnp.broadcast_to(elig[None, :], dom.shape).astype(jnp.int32))
+    pres = _gsum(pres, axis_name) > 0                                  # [C, Vd]
+    minm = jnp.min(jnp.where(pres, seg, INT_MAX), axis=1)              # [C]
+    any_pres = jnp.any(pres, axis=1)
+    minm = jnp.where(any_pres, minm, 0)
+    ndom = jnp.sum(pres, axis=1)
+    minm = jnp.where((sf_min_dom >= 0) & (ndom < sf_min_dom), 0, minm)
+
+    ok_c = has_key & (cnt_at + sf_self[:, None].astype(jnp.int32) - minm[:, None] <= sf_skew[:, None])
+    return jnp.all(jnp.where(sf_valid[:, None], ok_c, True), axis=0)
+
+
+def ipa_filter(xs, sel_counts, seg_exist, dom_t, label_val, valid, vd, axis_name):
+    """InterPodAffinity Filter's three checks (filtering.go:377-387).
+    Returns (aff_ok, anti_ok, exist_ok, exist_at) — exist_at [T, N] is the
+    per-node existing-term domain count matrix, reused by the score path."""
+    # 1. incoming pod's required affinity (+ first-pod-in-cluster case)
+    ia_valid, ia_sig, ia_key = xs["ia_valid"], xs["ia_sig"], xs["ia_key"]
+    _, has_key, seg, cnt_at = _seg_counts(ia_sig, ia_key, sel_counts, label_val, valid, vd, axis_name)
+    # reference counts only pods on nodes that carry the key (tv != None)
+    exist = cnt_at > 0
+    pods_exist = jnp.all(jnp.where(ia_valid[:, None], exist, True), axis=0)
+    all_keys = jnp.all(jnp.where(ia_valid[:, None], has_key, True), axis=0)
+    total = jnp.sum(jnp.where(ia_valid[:, None], seg, 0))
+    first_ok = (total == 0) & xs["ia_self_all"]
+    has_terms = jnp.any(ia_valid)
+    aff_ok = ~has_terms | (all_keys & (pods_exist | first_ok))
+
+    # 2. incoming pod's required anti-affinity
+    an_valid, an_sig, an_key = xs["ianti_valid"], xs["ianti_sig"], xs["ianti_key"]
+    _, an_has_key, _, an_cnt = _seg_counts(an_sig, an_key, sel_counts, label_val, valid, vd, axis_name)
+    viol = jnp.any(an_valid[:, None] & an_has_key & (an_cnt > 0), axis=0)
+    anti_ok = ~viol
+
+    # 3. existing pods' required anti-affinity vs the incoming pod
+    exist_at = jnp.where(dom_t > 0, jnp.take_along_axis(seg_exist, dom_t, axis=1), 0)  # [T, N]
+    viol_cnt = jnp.einsum("t,tn->n", xs["term_filter_match"].astype(jnp.int32), exist_at)
+    exist_ok = viol_cnt == 0
+    return aff_ok, anti_ok, exist_ok, exist_at
+
+
+# ----------------------------------------------------------------- scores
+
+
+def spread_score(xs, sel_counts, label_val, valid, affinity_ok, feasible, vd, axis_name):
+    """PodTopologySpread Score+Normalize (scoring.go:196-271). Returns [N]
+    normalized float scores (ignored/infeasible nodes 0)."""
+    ss_valid, ss_sig, ss_key, ss_skew, ss_host = (
+        xs["ss_valid"], xs["ss_sig"], xs["ss_key"], xs["ss_skew"], xs["ss_hostname"],
+    )
+    require_all = xs["ss_require_all"]
+    C = ss_sig.shape[0]
+    has_cons = jnp.any(ss_valid)
+
+    dom = label_val[:, ss_key].T                                       # [C, N]
+    has_key = dom > 0
+    has_all = jnp.all(jnp.where(ss_valid[:, None], has_key, True), axis=0)
+    ignored = require_all & ~has_all                                   # [N]
+    base = feasible & ~ignored
+
+    # domain sizes over filtered non-ignored nodes; hostname uses node count
+    c_iota = jnp.arange(C, dtype=jnp.int32)[:, None]
+    pres = jnp.zeros((C, vd), jnp.int32).at[c_iota, dom].add(
+        jnp.broadcast_to(base[None, :], dom.shape).astype(jnp.int32))
+    pres = _gsum(pres, axis_name) > 0
+    sz = jnp.sum(pres, axis=1)                                          # [C]
+    n_base = _gsum(jnp.sum(base.astype(jnp.int32)), axis_name)
+    sz = jnp.where(ss_host, n_base, sz)
+    w = jnp.log(sz.astype(jnp.float32) + 2.0)                           # [C]
+
+    # counts over eligible nodes (affinity match + require-all key rule)
+    elig = valid & affinity_ok & jnp.where(require_all, has_all, True)
+    _, _, _, cnt_at = _seg_counts(ss_sig, ss_key, sel_counts, label_val, elig, vd, axis_name)
+    cnt = jnp.where(ss_host[:, None], sel_counts[ss_sig], cnt_at).astype(jnp.float32)
+
+    contrib = jnp.where(
+        ss_valid[:, None] & has_key,
+        cnt * w[:, None] + (ss_skew[:, None].astype(jnp.float32) - 1.0),
+        0.0,
+    )
+    raw = jnp.floor(jnp.sum(contrib, axis=0) + 0.5)                     # math.Round, ≥0
+
+    mx = _gmax(jnp.max(jnp.where(base, raw, -jnp.inf)), axis_name)
+    mn = _gmin(jnp.min(jnp.where(base, raw, jnp.inf)), axis_name)
+    any_base = _gmax(jnp.any(base), axis_name)
+    norm = jnp.where(
+        mx == 0, 100.0, jnp.floor(100.0 * (mx + mn - raw) / jnp.maximum(mx, 1.0))
+    )
+    norm = jnp.where(ignored | ~any_base, 0.0, norm)
+    return jnp.where(has_cons, norm, 0.0)
+
+
+def ipa_score(xs, sel_counts, exist_at, label_val, valid, feasible, vd, axis_name):
+    """InterPodAffinity Score+Normalize (scoring.go): incoming preferred terms
+    vs existing pods + symmetric existing-term weights, normalized over the
+    feasible set with min/max floored/ceiled at 0. Returns [N] float."""
+    ip_valid, ip_sig, ip_key, ip_w = xs["ip_valid"], xs["ip_sig"], xs["ip_key"], xs["ip_w"]
+    _, has_key, _, cnt_at = _seg_counts(ip_sig, ip_key, sel_counts, label_val, valid, vd, axis_name)
+    pref = jnp.sum(
+        jnp.where(ip_valid[:, None] & has_key, ip_w[:, None].astype(jnp.float32) * cnt_at, 0.0),
+        axis=0,
+    )
+    sym = jnp.einsum("t,tn->n", xs["term_score_w"], exist_at.astype(jnp.float32))
+    raw = pref + sym
+
+    mx = jnp.maximum(_gmax(jnp.max(jnp.where(feasible, raw, -jnp.inf)), axis_name), 0.0)
+    mn = jnp.minimum(_gmin(jnp.min(jnp.where(feasible, raw, jnp.inf)), axis_name), 0.0)
+    diff = mx - mn
+    return jnp.where(diff > 0, jnp.floor(100.0 * (raw - mn) / jnp.maximum(diff, 1.0)), 0.0)
+
+
+# ----------------------------------------------------------------- commit
+
+
+def commit_update(sel_counts, seg_exist, dom_t, local_idx, commit, mine,
+                  pod_sig_mask, pod_term_mask, axis_name):
+    """Apply a committed pod's membership to the evolving count tables:
+    sel_counts[:, node] += pod_sig_mask on the owning shard; seg_exist gets the
+    pod's carried terms added at the winning node's domains on EVERY shard
+    (replicated table — the winner broadcasts its domain column via psum)."""
+    sel_counts = sel_counts.at[:, local_idx].add(
+        jnp.where(commit & mine, pod_sig_mask.astype(jnp.int32), 0)
+    )
+    dom_col = dom_t[:, local_idx]                                       # [T] local
+    if axis_name is not None:
+        dom_col = _gsum(jnp.where(mine, dom_col, 0), axis_name)
+    t_iota = jnp.arange(dom_col.shape[0], dtype=jnp.int32)
+    add = jnp.where(commit & (dom_col > 0), pod_term_mask.astype(jnp.int32), 0)
+    seg_exist = seg_exist.at[t_iota, dom_col].add(add)
+    return sel_counts, seg_exist

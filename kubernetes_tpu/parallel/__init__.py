@@ -1,1 +1,6 @@
-from .mesh import make_node_mesh, make_sharded_schedule_fn, shard_node_tensors  # noqa: F401
+from .mesh import (  # noqa: F401
+    make_node_mesh,
+    make_sharded_schedule_fn,
+    shard_node_tensors,
+    shard_topo_counts,
+)

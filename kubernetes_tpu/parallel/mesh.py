@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..backend.batch import DEFAULT_WEIGHTS, BatchResult, schedule_batch_core
-from ..ops.schema import ExprTable, NodeTensors, PodBatch
+from ..ops.schema import ExprTable, NodeTensors, PodBatch, TopoBatch, TopoCounts
 
 AXIS = "nodes"
 
@@ -60,7 +60,18 @@ def shard_node_tensors(nt: NodeTensors, mesh: Mesh) -> NodeTensors:
     return NodeTensors(**out)
 
 
-def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = None):
+def shard_topo_counts(tc: TopoCounts, mesh: Mesh) -> TopoCounts:
+    """Place TopoCounts onto the mesh: count matrices sharded on their node
+    (second) axis, the term-key vector replicated."""
+    return TopoCounts(
+        sel_counts=jax.device_put(tc.sel_counts, NamedSharding(mesh, P(None, AXIS))),
+        term_counts=jax.device_put(tc.term_counts, NamedSharding(mesh, P(None, AXIS))),
+        term_key=jax.device_put(tc.term_key, NamedSharding(mesh, P())),
+    )
+
+
+def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = None,
+                             topo_enabled: bool = True):
     """Compile schedule_batch over the mesh: node axis sharded, pods/exprs
     replicated, results replicated (winner slots are global indices)."""
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
@@ -71,6 +82,10 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
         f.name: 0 for f in dataclasses.fields(PodBatch)
     }))
     et_spec = jax.tree_util.tree_map(lambda _: P(), ExprTable(op=0, key=0, val=0, bits=0))
+    tc_spec = TopoCounts(sel_counts=P(None, AXIS), term_counts=P(None, AXIS), term_key=P())
+    tb_spec = jax.tree_util.tree_map(lambda _: P(), TopoBatch(**{
+        f.name: 0 for f in dataclasses.fields(TopoBatch)
+    }))
     out_spec = BatchResult(
         node_idx=P(), best_score=P(), any_feasible=P(),
         static_masks={
@@ -78,12 +93,15 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
             "TaintToleration": P(None, AXIS), "NodeAffinity": P(None, AXIS),
         },
         fit_ok=P(None, AXIS), ports_ok=P(None, AXIS),
+        spread_ok=P(None, AXIS), ipa_ok=P(None, AXIS),
     )
 
-    body = functools.partial(schedule_batch_core, weights_key=wk, axis_name=AXIS)
+    body = functools.partial(schedule_batch_core, weights_key=wk,
+                             topo_enabled=topo_enabled, axis_name=AXIS,
+                             num_shards=mesh.size)
     sharded = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(pb_spec, et_spec, nt_spec, P()),
+        in_specs=(pb_spec, et_spec, nt_spec, tc_spec, tb_spec, P()),
         out_specs=out_spec,
         check_vma=False,
     )

@@ -5,15 +5,22 @@ program on the same inputs."""
 import numpy as np
 import jax
 
+from kubernetes_tpu.api.types import LabelSelector
 from kubernetes_tpu.api.wrappers import make_node, make_pod
 from kubernetes_tpu.backend.batch import schedule_batch
+from kubernetes_tpu.backend.sig_table import SigTable
 from kubernetes_tpu.framework.types import NodeInfo
 from kubernetes_tpu.ops.encode import ClusterEncoder
 from kubernetes_tpu.ops.schema import Capacities
-from kubernetes_tpu.parallel import make_node_mesh, make_sharded_schedule_fn, shard_node_tensors
+from kubernetes_tpu.parallel import (
+    make_node_mesh,
+    make_sharded_schedule_fn,
+    shard_node_tensors,
+    shard_topo_counts,
+)
 
 
-def build_inputs(n_nodes=32, n_pods=8):
+def build_inputs(n_nodes=32, n_pods=8, topo=False):
     infos = []
     for i in range(n_nodes):
         nw = make_node(f"node-{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 20}).label("zone", f"z{i % 4}")
@@ -21,27 +28,34 @@ def build_inputs(n_nodes=32, n_pods=8):
             nw.taint("dedicated", "x", "NoSchedule")
         infos.append(NodeInfo(nw.obj()))
     enc = ClusterEncoder(Capacities(nodes=n_nodes, pods=n_pods, value_words=32))
+    sig = SigTable(enc)
     nt = enc.encode_snapshot(infos)
     pods = []
     for i in range(n_pods):
-        pw = make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+        pw = make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).label("app", f"a{i % 2}")
         if i % 3 == 0:
             pw.node_affinity_in("zone", [f"z{i % 4}"])
+        if topo:
+            pw.spread_constraint(1, "zone", selector=LabelSelector(match_labels={"app": f"a{i % 2}"}))
+            if i % 2 == 0:
+                pw.pod_affinity("zone", LabelSelector(match_labels={"app": "a1"}), anti=True)
         pods.append(pw.obj())
     pb, et = enc.encode_pods(pods)
-    return enc, nt, pb, et
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
+    return enc, nt, pb, et, tc, tb
 
 
 def test_sharded_matches_single_device():
     assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
-    enc, nt, pb, et = build_inputs()
+    enc, nt, pb, et, tc, tb = build_inputs()
     key = jax.random.PRNGKey(7)
-    single = schedule_batch(pb, et, nt, key)
+    single = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=False)
 
     mesh = make_node_mesh()
     nt_sharded = shard_node_tensors(nt, mesh)
-    fn = make_sharded_schedule_fn(mesh)
-    sharded = fn(pb, et, nt_sharded, key)
+    fn = make_sharded_schedule_fn(mesh, topo_enabled=False)
+    sharded = fn(pb, et, nt_sharded, shard_topo_counts(tc, mesh), tb, key)
 
     # feasibility identical; placements may differ only within score ties
     assert np.array_equal(np.asarray(single.any_feasible), np.asarray(sharded.any_feasible))
@@ -57,6 +71,29 @@ def test_sharded_matches_single_device():
                 assert np.asarray(m)[p, slot], name
 
 
+def test_sharded_topology_matches_single_device():
+    """Spread + anti-affinity kernels under shard_map: the sharded program's
+    feasibility, scores, and per-plugin masks must match single-device."""
+    enc, nt, pb, et, tc, tb = build_inputs(topo=True)
+    key = jax.random.PRNGKey(3)
+    single = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=True)
+
+    mesh = make_node_mesh()
+    fn = make_sharded_schedule_fn(mesh, topo_enabled=True)
+    sharded = fn(pb, et, shard_node_tensors(nt, mesh), shard_topo_counts(tc, mesh), tb, key)
+
+    # global-slot-keyed jitter makes the sharded program bit-identical in its
+    # decision sequence, so the evolving topology state matches step for step
+    assert np.array_equal(np.asarray(single.node_idx), np.asarray(sharded.node_idx))
+    assert np.array_equal(np.asarray(single.any_feasible), np.asarray(sharded.any_feasible))
+    np.testing.assert_allclose(
+        np.asarray(single.best_score), np.asarray(sharded.best_score), atol=1e-4
+    )
+    for name in ("spread_ok", "ipa_ok", "fit_ok", "ports_ok"):
+        s, m = np.asarray(getattr(single, name)), np.asarray(getattr(sharded, name))
+        assert np.array_equal(s, m), name
+
+
 def test_sharded_sequential_commit_respects_capacity():
     # a single 1-pod-capacity node lives on ONE shard; the whole batch fights
     # for it and exactly one pod must win globally
@@ -64,11 +101,47 @@ def test_sharded_sequential_commit_respects_capacity():
     for i in range(7):
         infos.append(NodeInfo(make_node(f"full-{i}").capacity({"cpu": "0", "memory": "0", "pods": 0}).obj()))
     enc = ClusterEncoder(Capacities(nodes=8, pods=4, value_words=32))
+    sig = SigTable(enc)
     nt = enc.encode_snapshot(infos)
-    pb, et = enc.encode_pods([make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)])
+    pods = [make_pod(f"p{i}").req({"cpu": "1"}).obj() for i in range(4)]
+    pb, et = enc.encode_pods(pods)
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
     mesh = make_node_mesh()
-    fn = make_sharded_schedule_fn(mesh)
-    res = fn(pb, et, shard_node_tensors(nt, mesh), jax.random.PRNGKey(0))
+    fn = make_sharded_schedule_fn(mesh, topo_enabled=False)
+    res = fn(pb, et, shard_node_tensors(nt, mesh), shard_topo_counts(tc, mesh), tb, jax.random.PRNGKey(0))
     idx = np.asarray(res.node_idx)
     assert (idx >= 0).sum() == 1
     assert idx[(idx >= 0)][0] == enc.node_slots["only"]
+
+
+def test_sharded_anti_affinity_cross_shard():
+    """A pod's committed anti-affinity term must block later batch pods from
+    the whole topology domain even when domain nodes live on OTHER shards."""
+    infos = []
+    for i in range(16):
+        infos.append(NodeInfo(
+            make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 10})
+            .label("zone", f"z{i % 2}").obj()))
+    enc = ClusterEncoder(Capacities(nodes=16, pods=4, value_words=32))
+    sig = SigTable(enc)
+    nt = enc.encode_snapshot(infos)
+    sel = LabelSelector(match_labels={"app": "x"})
+    pods = [
+        make_pod(f"p{i}").req({"cpu": "1"}).label("app", "x")
+        .pod_affinity("zone", sel, anti=True).obj()
+        for i in range(4)
+    ]
+    pb, et = enc.encode_pods(pods)
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
+    mesh = make_node_mesh()
+    fn = make_sharded_schedule_fn(mesh, topo_enabled=True)
+    res = fn(pb, et, shard_node_tensors(nt, mesh), shard_topo_counts(tc, mesh), tb, jax.random.PRNGKey(1))
+    idx = np.asarray(res.node_idx)
+    # 2 zones ⇒ exactly 2 of the 4 mutually-anti-affine pods can place,
+    # and they must land in different zones
+    placed = idx[idx >= 0]
+    assert len(placed) == 2, idx
+    zones = {int(i) % 2 for i in placed}
+    assert len(zones) == 2

@@ -1,0 +1,301 @@
+"""Oracle ↔ device-kernel parity for the two hard plugins:
+PodTopologySpread and InterPodAffinity (ops/topology.py sig-count kernels).
+
+Batch-of-1 calls isolate the kernels from intra-batch commit effects; the
+oracle plugins (pinned to reference semantics by tests/test_oracle_plugins.py)
+are ground truth. Intra-batch sequential semantics are covered by the e2e
+tests at the bottom (mutually-anti-affine pods, strict spread in one batch).
+"""
+
+import dataclasses
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import LabelSelector, SCHEDULE_ANYWAY
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.backend.sig_table import SigTable
+from kubernetes_tpu.framework.interface import CycleState, NodeScore
+from kubernetes_tpu.framework.plugins.interpodaffinity import InterPodAffinity
+from kubernetes_tpu.framework.plugins.podtopologyspread import PodTopologySpread
+from kubernetes_tpu.framework.types import NodeInfo
+from kubernetes_tpu.ops import filters, topology
+from kubernetes_tpu.ops.encode import ClusterEncoder
+from kubernetes_tpu.ops.schema import Capacities, TopoBatch
+
+ZONES = ["z0", "z1", "z2"]
+RACKS = ["r0", "r1", "r2", "r3"]
+APPS = ["web", "db", "cache"]
+
+CAPS = Capacities(nodes=16, pods=4, value_words=32, sigs=32, ex_terms=32,
+                  spread_cons=2, ipa_terms=2, ipa_pref=2, label_keys=16)
+
+
+def sel(app):
+    return LabelSelector(match_labels={"app": app})
+
+
+def random_cluster(rng, n_nodes=16):
+    infos = []
+    for i in range(n_nodes):
+        nw = (
+            make_node(f"node-{i}")
+            .capacity({"cpu": "64", "memory": "256Gi", "pods": 110})
+            .label("zone", rng.choice(ZONES))
+        )
+        if rng.random() < 0.8:
+            nw.label("rack", rng.choice(RACKS))
+        ni = NodeInfo(nw.obj())
+        for j in range(rng.randint(0, 3)):
+            pw = make_pod(f"ex-{i}-{j}").req({"cpu": "100m"}).label("app", rng.choice(APPS))
+            r = rng.random()
+            if r < 0.25:
+                pw.pod_affinity(rng.choice(["zone", "rack"]), sel(rng.choice(APPS)), anti=True)
+            elif r < 0.4:
+                pw.pod_affinity(rng.choice(["zone", "rack"]), sel(rng.choice(APPS)))
+            elif r < 0.5:
+                pw.preferred_pod_affinity(rng.randint(1, 50), "zone", sel(rng.choice(APPS)),
+                                          anti=rng.random() < 0.5)
+            ni.add_pod(pw.obj())
+        infos.append(ni)
+    return infos
+
+
+def random_topo_pod(rng, i):
+    pw = make_pod(f"pending-{i}").req({"cpu": "100m"}).label("app", rng.choice(APPS))
+    r = rng.random()
+    if r < 0.35:
+        pw.spread_constraint(rng.randint(1, 2), rng.choice(["zone", "rack"]),
+                             selector=sel(rng.choice(APPS)))
+        if rng.random() < 0.5:
+            pw.spread_constraint(rng.randint(1, 3), "zone",
+                                 when_unsatisfiable=SCHEDULE_ANYWAY,
+                                 selector=sel(rng.choice(APPS)))
+    elif r < 0.55:
+        pw.pod_affinity(rng.choice(["zone", "rack"]), sel(rng.choice(APPS)))
+    elif r < 0.75:
+        pw.pod_affinity(rng.choice(["zone", "rack"]), sel(rng.choice(APPS)), anti=True)
+    if rng.random() < 0.4:
+        pw.preferred_pod_affinity(rng.randint(1, 50), rng.choice(["zone", "rack"]),
+                                  sel(rng.choice(APPS)), anti=rng.random() < 0.5)
+    return pw.obj()
+
+
+def encode(infos, pod):
+    enc = ClusterEncoder(CAPS)
+    sig = SigTable(enc)
+    nt = enc.encode_snapshot(infos)
+    for ni in infos:
+        sig.recount_node(enc.node_slots[ni.node.meta.name], ni)
+    pb, et = enc.encode_pods([pod])
+    tb = sig.encode_topo([pod])
+    tc = sig.topo_counts()
+    return enc, sig, nt, pb, et, tc, tb
+
+
+def tb_row(tb: TopoBatch, p=0):
+    return {f.name: jnp.asarray(getattr(tb, f.name))[p] for f in dataclasses.fields(TopoBatch)}
+
+
+def oracle_filter_masks(infos, pod):
+    spread = PodTopologySpread(snapshot_fn=lambda: infos)
+    ipa = InterPodAffinity(snapshot_fn=lambda: infos, ns_labels_fn=lambda ns: {})
+    st_s, st_i = CycleState(), CycleState()
+    spread.pre_filter(st_s, pod)
+    ipa.pre_filter(st_i, pod)
+    m_spread = [spread.filter(st_s, pod, ni).is_success() for ni in infos]
+    m_ipa = [ipa.filter(st_i, pod, ni).is_success() for ni in infos]
+    return m_spread, m_ipa, (spread, st_s), (ipa, st_i)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+def test_topology_filter_parity(seed):
+    rng = random.Random(seed)
+    infos = random_cluster(rng)
+    for i in range(6):
+        pod = random_topo_pod(rng, i)
+        enc, sig, nt, pb, et, tc, tb = encode(infos, pod)
+        vd = CAPS.value_words * 32
+        affinity_ok = np.asarray(filters.filter_node_affinity(pb, et, nt))[0]
+        ts = topology.make_static(tc.term_counts, tc.term_key, nt.label_val, nt.valid, vd)
+        xs = tb_row(tb)
+        k_spread = np.asarray(topology.spread_filter(
+            xs, tc.sel_counts, nt.label_val, nt.valid, jnp.asarray(affinity_ok), vd, None))
+        aff_ok, anti_ok, exist_ok, _ = topology.ipa_filter(
+            xs, tc.sel_counts, ts.seg_exist0, ts.dom_t, nt.label_val, nt.valid, vd, None)
+        k_ipa = np.asarray(aff_ok & anti_ok & exist_ok)
+
+        m_spread, m_ipa, _, _ = oracle_filter_masks(infos, pod)
+        for ni, want_s, want_i in zip(infos, m_spread, m_ipa):
+            slot = enc.node_slots[ni.node.meta.name]
+            assert k_spread[slot] == want_s, (seed, i, ni.node.meta.name, "spread", pod.meta.name)
+            assert k_ipa[slot] == want_i, (seed, i, ni.node.meta.name, "ipa", pod.meta.name)
+
+
+@pytest.mark.parametrize("seed", [10, 11, 12, 13])
+def test_topology_score_parity(seed):
+    rng = random.Random(seed)
+    infos = random_cluster(rng)
+    for i in range(6):
+        pod = random_topo_pod(rng, i)
+        enc, sig, nt, pb, et, tc, tb = encode(infos, pod)
+        vd = CAPS.value_words * 32
+        affinity_ok = jnp.asarray(np.asarray(filters.filter_node_affinity(pb, et, nt))[0])
+        ts = topology.make_static(tc.term_counts, tc.term_key, nt.label_val, nt.valid, vd)
+        xs = tb_row(tb)
+
+        # feasible = nodes passing both oracle topology filters (capacity ample)
+        m_spread, m_ipa, (spread, st_s), (ipa, st_i) = oracle_filter_masks(infos, pod)
+        feasible = np.zeros(CAPS.nodes, bool)
+        slot_of = {ni.node.meta.name: enc.node_slots[ni.node.meta.name] for ni in infos}
+        for ni, fs, fi in zip(infos, m_spread, m_ipa):
+            feasible[slot_of[ni.node.meta.name]] = fs and fi
+        feas = [ni for ni in infos if feasible[slot_of[ni.node.meta.name]]]
+        if not feas:
+            continue
+
+        k_spread = np.asarray(topology.spread_score(
+            xs, tc.sel_counts, nt.label_val, nt.valid, affinity_ok, jnp.asarray(feasible), vd, None))
+        _, _, _, exist_at = topology.ipa_filter(
+            xs, tc.sel_counts, ts.seg_exist0, ts.dom_t, nt.label_val, nt.valid, vd, None)
+        k_ipa = np.asarray(topology.ipa_score(
+            xs, tc.sel_counts, exist_at, nt.label_val, nt.valid, jnp.asarray(feasible), vd, None))
+
+        # oracle scores over the feasible set
+        spread.pre_score(st_s, pod, [ni.node for ni in feas])
+        scores = []
+        for ni in feas:
+            s, _ = spread.score_node(st_s, pod, ni)
+            scores.append(NodeScore(ni.node.meta.name, s))
+        spread.normalize_score(st_s, pod, scores)
+        for sc in scores:
+            assert abs(k_spread[slot_of[sc.name]] - sc.score) <= 1, (
+                seed, i, sc.name, "spread", k_spread[slot_of[sc.name]], sc.score)
+
+        ipa.pre_score(st_i, pod, [ni.node for ni in feas])
+        scores = []
+        for ni in feas:
+            s, _ = ipa.score_node(st_i, pod, ni)
+            scores.append(NodeScore(ni.node.meta.name, s))
+        ipa.normalize_score(st_i, pod, scores)
+        for sc in scores:
+            assert abs(k_ipa[slot_of[sc.name]] - sc.score) <= 1, (
+                seed, i, sc.name, "ipa", k_ipa[slot_of[sc.name]], sc.score)
+
+
+# --------------------------------------------------------------------- e2e
+
+
+def mk_cluster(n_nodes, zones=4):
+    from kubernetes_tpu.apiserver import ClusterStore
+    from kubernetes_tpu.backend import TPUScheduler
+
+    store = ClusterStore()
+    sched = TPUScheduler(store, batch_size=16)
+    for i in range(n_nodes):
+        store.create_node(
+            make_node(f"node-{i}").capacity({"cpu": "16", "memory": "64Gi", "pods": 110})
+            .label("zone", f"z{i % zones}").obj())
+    return store, sched
+
+
+def bound(store):
+    return {k: p.spec.node_name for k, p in store.pods.items() if p.spec.node_name}
+
+
+def test_intra_batch_strict_spread():
+    """4 DoNotSchedule maxSkew=1 pods in ONE batch must land in 4 distinct
+    zones — the in-scan count commits make the batch sequential-equivalent."""
+    store, sched = mk_cluster(8, zones=4)
+    s = sel("web")
+    for i in range(4):
+        store.create_pod(make_pod(f"w{i}").label("app", "web").req({"cpu": "1"})
+                         .spread_constraint(1, "zone", selector=s).obj())
+    sched.run_until_settled()
+    b = bound(store)
+    assert len(b) == 4
+    zones = [store.nodes[n].meta.labels["zone"] for n in b.values()]
+    assert sorted(zones) == ["z0", "z1", "z2", "z3"]
+    assert sched.fallback_scheduled == 0
+
+
+def test_intra_batch_anti_affinity():
+    """Mutually anti-affine pods in one batch: one per zone, rest unschedulable."""
+    store, sched = mk_cluster(8, zones=2)
+    s = sel("db")
+    for i in range(4):
+        store.create_pod(make_pod(f"d{i}").label("app", "db").req({"cpu": "1"})
+                         .pod_affinity("zone", s, anti=True).obj())
+    sched.run_until_settled()
+    b = bound(store)
+    assert len(b) == 2
+    zones = {store.nodes[n].meta.labels["zone"] for n in b.values()}
+    assert zones == {"z0", "z1"}
+
+
+def test_required_affinity_colocates():
+    """Affinity pods follow the seed pod's zone; first-pod case admits the seed."""
+    store, sched = mk_cluster(6, zones=3)
+    s = sel("cache")
+    pods = [make_pod(f"c{i}").label("app", "cache").req({"cpu": "1"})
+            .pod_affinity("zone", s).obj() for i in range(3)]
+    for p in pods:
+        store.create_pod(p)
+    sched.run_until_settled()
+    b = bound(store)
+    assert len(b) == 3
+    zones = {store.nodes[n].meta.labels["zone"] for n in b.values()}
+    assert len(zones) == 1  # all co-located via self-affinity
+
+
+def test_first_pod_rule_ignores_keyless_nodes():
+    """Matching pods that live only on nodes WITHOUT the term's topology key
+    must not defeat the first-pod-in-cluster rule (the oracle never counts
+    them — interpodaffinity.py pre_filter skips keyless nodes)."""
+    infos = []
+    # keyless node hosting a matching pod
+    ni = NodeInfo(make_node("keyless").capacity({"cpu": "8", "memory": "16Gi", "pods": 10}).obj())
+    ni.add_pod(make_pod("ex").label("app", "cache").req({"cpu": "100m"}).obj())
+    infos.append(ni)
+    # keyed empty nodes
+    for i in range(3):
+        infos.append(NodeInfo(
+            make_node(f"keyed-{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 10})
+            .label("rack", f"r{i}").obj()))
+    pod = (make_pod("inc").label("app", "cache").req({"cpu": "1"})
+           .pod_affinity("rack", sel("cache")).obj())
+    enc, sig, nt, pb, et, tc, tb = encode(infos, pod)
+    vd = CAPS.value_words * 32
+    ts = topology.make_static(tc.term_counts, tc.term_key, nt.label_val, nt.valid, vd)
+    aff_ok, anti_ok, exist_ok, _ = topology.ipa_filter(
+        tb_row(tb), tc.sel_counts, ts.seg_exist0, ts.dom_t, nt.label_val, nt.valid, vd, None)
+    k_ipa = np.asarray(aff_ok & anti_ok & exist_ok)
+    m_spread, m_ipa, _, _ = oracle_filter_masks(infos, pod)
+    for ni, want in zip(infos, m_ipa):
+        slot = enc.node_slots[ni.node.meta.name]
+        assert k_ipa[slot] == want, (ni.node.meta.name, k_ipa[slot], want)
+    # the self-matching pod must be admitted on keyed nodes (first-pod rule)
+    assert any(k_ipa[enc.node_slots[f"keyed-{i}"]] for i in range(3))
+
+
+def test_existing_anti_affinity_blocks_incoming():
+    """An existing pod's required anti-affinity must repel matching incoming
+    pods from its whole zone (the symmetric check, filtering.go:308)."""
+    store, sched = mk_cluster(4, zones=2)
+    blocker = (make_pod("blocker").label("app", "web").req({"cpu": "1"})
+               .pod_affinity("zone", sel("web"), anti=True).obj())
+    store.create_pod(blocker)
+    sched.run_until_settled()
+    assert len(bound(store)) == 1
+    blocker_zone = store.nodes[bound(store)["default/blocker"]].meta.labels["zone"]
+
+    for i in range(2):
+        store.create_pod(make_pod(f"w{i}").label("app", "web").req({"cpu": "1"}).obj())
+    sched.run_until_settled()
+    b = bound(store)
+    assert len(b) == 3
+    for k, n in b.items():
+        if k != "default/blocker":
+            assert store.nodes[n].meta.labels["zone"] != blocker_zone, (k, n)

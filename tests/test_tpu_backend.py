@@ -101,14 +101,14 @@ class TestTPUSchedulerE2E:
         for i in range(6):
             store.create_pod(make_pod(f"gen-{i}").req({"cpu": "250m"}).obj())
         for i in range(4):
-            store.create_pod(  # spread pods take the sequential fallback path
+            store.create_pod(  # spread pods ride the device topology kernels
                 make_pod(f"web-{i}").label("app", "web").req({"cpu": "100m"})
                 .spread_constraint(1, "zone", selector=sel).obj()
             )
         sched.run_until_settled()
         assert len(bound_pods(store)) == 10
-        assert sched.batch_scheduled == 6
-        assert sched.fallback_scheduled == 4
+        assert sched.batch_scheduled == 10
+        assert sched.fallback_scheduled == 0
         zones = {}
         for k, n in bound_pods(store).items():
             if k.startswith("default/web"):
