@@ -22,6 +22,18 @@ from . import resource as resource_api
 # meta
 
 
+@dataclass(frozen=True)
+class OwnerReference:
+    """metav1.OwnerReference (kind + name + controller flag); drives both
+    SelectorSpread's owner lookup (helper/spread.go DefaultSelector) and the
+    garbage collector's ownership graph."""
+
+    kind: str = ""
+    name: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+
 @dataclass
 class ObjectMeta:
     name: str = ""
@@ -31,9 +43,17 @@ class ObjectMeta:
     annotations: Dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
     deletion_timestamp: float = 0.0  # >0 ⇒ terminating (metav1 DeletionTimestamp)
+    owner_references: Tuple["OwnerReference", ...] = ()
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
+
+    def controller_of(self) -> Optional["OwnerReference"]:
+        """metav1.GetControllerOf: the single ownerReference with controller=true."""
+        for ref in self.owner_references:
+            if ref.controller:
+                return ref
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -374,6 +394,26 @@ class Node:
         }
 
 
+# zone identity (component-helpers/node/topology/helpers.go GetZoneKey)
+LABEL_TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
+LABEL_TOPOLOGY_REGION = "topology.kubernetes.io/region"
+LABEL_FAILURE_DOMAIN_BETA_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_FAILURE_DOMAIN_BETA_REGION = "failure-domain.beta.kubernetes.io/region"
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+
+
+def get_zone_key(node: "Node") -> str:
+    """Unique per failure-zone id from node labels; '' when zoneless. Beta
+    labels take precedence; region and zone are joined with a NUL separator
+    (GetZoneKey, component-helpers/node/topology/helpers.go:30)."""
+    labels = node.meta.labels
+    zone = labels.get(LABEL_FAILURE_DOMAIN_BETA_ZONE, labels.get(LABEL_TOPOLOGY_ZONE, ""))
+    region = labels.get(LABEL_FAILURE_DOMAIN_BETA_REGION, labels.get(LABEL_TOPOLOGY_REGION, ""))
+    if not zone and not region:
+        return ""
+    return f"{region}:\x00:{zone}"
+
+
 # ---------------------------------------------------------------------------
 # misc cluster objects the scheduler reads
 
@@ -381,6 +421,66 @@ class Node:
 @dataclass
 class Namespace:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
+
+
+# ---------------------------------------------------------------------------
+# workload objects (core/v1 Service + ReplicationController, apps/v1
+# ReplicaSet + StatefulSet + Deployment + DaemonSet, batch/v1 Job) — consumed
+# by SelectorSpread's owner-selector lookup and the controller-manager loops.
+
+
+@dataclass
+class Service:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)  # spec.selector (map form)
+
+
+@dataclass
+class ReplicationController:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)  # spec.selector (map form)
+    replicas: int = 1
+    template: Optional["Pod"] = None
+
+
+@dataclass
+class ReplicaSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    replicas: int = 1
+    template: Optional["Pod"] = None
+
+
+@dataclass
+class StatefulSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    replicas: int = 1
+    template: Optional["Pod"] = None
+
+
+@dataclass
+class Deployment:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    replicas: int = 1
+    template: Optional["Pod"] = None
+
+
+@dataclass
+class DaemonSet:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    template: Optional["Pod"] = None
+
+
+@dataclass
+class Job:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    completions: int = 1
+    parallelism: int = 1
+    template: Optional["Pod"] = None
+    succeeded: int = 0
 
 
 @dataclass
@@ -428,6 +528,9 @@ class PersistentVolume:
     storage_class: str = ""
     bound_pvc: str = ""  # claimRef as namespace/name
     access_modes: Tuple[str, ...] = ()
+    # in-tree volume source kind for the non-CSI attach-limit filters
+    # (nodevolumelimits/non_csi.go): "ebs" | "gce-pd" | "azure-disk" | "cinder" | ""
+    volume_type: str = ""
     # nodeAffinity reduced to required label matches (topology terms)
     node_affinity: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
 

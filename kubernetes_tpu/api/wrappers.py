@@ -82,6 +82,15 @@ class PodWrapper:
         self.pod.spec.volumes = self.pod.spec.volumes + (claim_name,)
         return self
 
+    def owner(self, kind: str, name: str) -> "PodWrapper":
+        """Set the controller ownerReference (metav1.GetControllerOf)."""
+        from .types import OwnerReference
+
+        self.pod.meta.owner_references = self.pod.meta.owner_references + (
+            OwnerReference(kind=kind, name=name, controller=True),
+        )
+        return self
+
     def priority(self, p: int) -> "PodWrapper":
         self.pod.spec.priority = p
         return self

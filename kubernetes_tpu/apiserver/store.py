@@ -26,6 +26,10 @@ from ..api.types import (
     Pod,
     PodDisruptionBudget,
     PriorityClass,
+    ReplicaSet,
+    ReplicationController,
+    Service,
+    StatefulSet,
     StorageClass,
 )
 
@@ -56,6 +60,10 @@ class ClusterStore:
         self.pvcs: Dict[str, PersistentVolumeClaim] = {}
         self.storage_classes: Dict[str, StorageClass] = {}
         self.csinodes: Dict[str, CSINode] = {}
+        self.services: Dict[str, Service] = {}
+        self.replication_controllers: Dict[str, ReplicationController] = {}
+        self.replica_sets: Dict[str, ReplicaSet] = {}
+        self.stateful_sets: Dict[str, StatefulSet] = {}
         self._handlers: Dict[str, List[Handler]] = {}
         self._rv = 0
 
@@ -174,6 +182,49 @@ class ClusterStore:
         with self._lock:
             self.priority_classes[pc.meta.name] = pc
         self._notify("PriorityClass", ADDED, None, pc)
+
+    # ------------------------------------------------------------- workload kinds
+    # (SelectorSpread's owner lookup, helper/spread.go DefaultSelector)
+
+    def create_service(self, svc: Service) -> None:
+        with self._lock:
+            self._bump(svc)
+            self.services[svc.meta.key()] = svc
+        self._notify("Service", ADDED, None, svc)
+
+    def list_services(self, namespace: str) -> List[Service]:
+        with self._lock:
+            return [s for s in self.services.values() if s.meta.namespace == namespace]
+
+    def create_replication_controller(self, rc: ReplicationController) -> None:
+        with self._lock:
+            self._bump(rc)
+            self.replication_controllers[rc.meta.key()] = rc
+        self._notify("ReplicationController", ADDED, None, rc)
+
+    def get_replication_controller(self, key: str) -> Optional[ReplicationController]:
+        with self._lock:
+            return self.replication_controllers.get(key)
+
+    def create_replica_set(self, rs: ReplicaSet) -> None:
+        with self._lock:
+            self._bump(rs)
+            self.replica_sets[rs.meta.key()] = rs
+        self._notify("ReplicaSet", ADDED, None, rs)
+
+    def get_replica_set(self, key: str) -> Optional[ReplicaSet]:
+        with self._lock:
+            return self.replica_sets.get(key)
+
+    def create_stateful_set(self, ss: StatefulSet) -> None:
+        with self._lock:
+            self._bump(ss)
+            self.stateful_sets[ss.meta.key()] = ss
+        self._notify("StatefulSet", ADDED, None, ss)
+
+    def get_stateful_set(self, key: str) -> Optional[StatefulSet]:
+        with self._lock:
+            return self.stateful_sets.get(key)
 
     # ------------------------------------------------------------- storage kinds
 

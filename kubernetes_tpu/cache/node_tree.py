@@ -1,0 +1,69 @@
+"""Zone tree: zone → node names, with round-robin zone interleaving
+(internal/cache/node_tree.go:32 nodeTree).
+
+The snapshot's flat node list is materialized in this order so that
+scheduling (and its sampled early-exit window) naturally spreads pods
+across zones rather than filling one zone's nodes first.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+from ..api.types import Node, get_zone_key
+
+
+class NodeTree:
+    """Maintains per-zone node-name lists (node_tree.go:32); ``list()``
+    yields names zone-round-robin (node_tree.go updateNodesInTreeOrder)."""
+
+    def __init__(self):
+        self._zones: Dict[str, List[str]] = {}
+        self.num_nodes = 0
+
+    def add_node(self, node: Node) -> None:
+        zone = get_zone_key(node)
+        names = self._zones.setdefault(zone, [])
+        if node.meta.name in names:
+            return
+        names.append(node.meta.name)
+        self.num_nodes += 1
+
+    def remove_node(self, node: Node) -> None:
+        zone = get_zone_key(node)
+        names = self._zones.get(zone)
+        if names is None or node.meta.name not in names:
+            return
+        names.remove(node.meta.name)
+        if not names:
+            del self._zones[zone]
+        self.num_nodes -= 1
+
+    def update_node(self, old: Node, new: Node) -> None:
+        if get_zone_key(old) == get_zone_key(new):
+            return
+        self.remove_node(old)
+        self.add_node(new)
+
+    def list(self) -> List[str]:
+        """All node names, one per zone per round (node_tree.go list order)."""
+        out: List[str] = []
+        lists = list(self._zones.values())
+        i = 0
+        while len(out) < self.num_nodes:
+            for names in lists:
+                if i < len(names):
+                    out.append(names[i])
+            i += 1
+        return out
+
+
+def zone_interleaved(node_infos: Iterable) -> List:
+    """Order NodeInfos zone-round-robin — used by Snapshot.refresh_lists
+    (same visit order as nodeTree.list(), via a throwaway tree)."""
+    by_name = {}
+    tree = NodeTree()
+    for ni in node_infos:
+        by_name[ni.node.meta.name] = ni
+        tree.add_node(ni.node)
+    return [by_name[name] for name in tree.list()]

@@ -28,8 +28,14 @@ class Snapshot:
         return self.node_info_list
 
     def refresh_lists(self) -> None:
-        """Rebuild the flat + pruned lists from node_info_map."""
-        self.node_info_list = [ni for ni in self.node_info_map.values() if ni.node is not None]
+        """Rebuild the flat + pruned lists from node_info_map. The flat list
+        is zone-round-robin ordered (nodeTree order, node_tree.go:32) so the
+        sampled scheduling window spreads across zones."""
+        from .node_tree import zone_interleaved
+
+        self.node_info_list = zone_interleaved(
+            ni for ni in self.node_info_map.values() if ni.node is not None
+        )
         self.have_pods_with_affinity_list = [ni for ni in self.node_info_list if ni.pods_with_affinity]
         self.have_pods_with_required_anti_affinity_list = [
             ni for ni in self.node_info_list if ni.pods_with_required_anti_affinity

@@ -1,0 +1,138 @@
+"""SelectorSpread: spread pods of the same service/controller across nodes
+and zones (plugins/selectorspread/selector_spread.go; non-default, legacy —
+superseded by PodTopologySpread but kept for capability parity).
+
+Raw Score(node) = number of existing pods on the node matching the selector
+deduced from the pod's services + controller owner (selector_spread.go:84).
+NormalizeScore inverts per node and, when zone labels exist, blends in a
+zone-level inverse count with weight 2/3 (selector_spread.go:55,112-172).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ...api.types import LabelSelector, Pod, get_zone_key
+from ..interface import (
+    MAX_NODE_SCORE,
+    CycleState,
+    NodeScore,
+    OK,
+    PreScorePlugin,
+    ScoreExtensions,
+    ScorePlugin,
+    Status,
+)
+from ..types import NodeInfo
+from . import names
+
+ZONE_WEIGHTING = 2.0 / 3.0  # selector_spread.go:55
+
+
+def default_selector(pod: Pod, store) -> List[LabelSelector]:
+    """helper/spread.go DefaultSelector: union of label requirements from
+    services selecting the pod plus the pod's controller owner (RC/RS/SS).
+    Returned as a list of selectors that must ALL match (requirement AND)."""
+    sels: List[LabelSelector] = []
+    for svc in store.list_services(pod.meta.namespace):
+        if svc.selector and all(
+            pod.meta.labels.get(k) == v for k, v in svc.selector.items()
+        ):
+            sels.append(LabelSelector(match_labels=dict(svc.selector)))
+    owner = pod.meta.controller_of()
+    if owner is not None:
+        key = f"{pod.meta.namespace}/{owner.name}"
+        if owner.kind == "ReplicationController":
+            rc = store.get_replication_controller(key)
+            if rc is not None and rc.selector:
+                sels.append(LabelSelector(match_labels=dict(rc.selector)))
+        elif owner.kind == "ReplicaSet":
+            rs = store.get_replica_set(key)
+            if rs is not None and rs.selector is not None:
+                sels.append(rs.selector)
+        elif owner.kind == "StatefulSet":
+            ss = store.get_stateful_set(key)
+            if ss is not None and ss.selector is not None:
+                sels.append(ss.selector)
+    return sels
+
+
+class SelectorSpread(PreScorePlugin, ScorePlugin, ScoreExtensions):
+    """PreScore + Score + NormalizeScore (selector_spread.go:35)."""
+
+    STATE_KEY = "PreScore" + names.SELECTOR_SPREAD
+
+    def __init__(self, store=None, snapshot_fn=None):
+        self._store = store
+        self._snapshot_fn = snapshot_fn  # () -> List[NodeInfo] (all nodes)
+
+    def name(self) -> str:
+        return names.SELECTOR_SPREAD
+
+    @staticmethod
+    def _skip(pod: Pod) -> bool:
+        # explicit topologySpreadConstraints supersede this plugin
+        # (selector_spread.go:76 skipSelectorSpread)
+        return bool(pod.spec.topology_spread_constraints)
+
+    def pre_score(self, state: CycleState, pod: Pod, nodes) -> Status:
+        if self._skip(pod):
+            return OK
+        state.write(self.STATE_KEY, default_selector(pod, self._store))
+        return OK
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError  # runtime calls score_node with NodeInfo
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Tuple[int, Status]:
+        if self._skip(pod):
+            return 0, OK
+        selectors: List[LabelSelector] = state.read(self.STATE_KEY)
+        if not selectors:
+            return 0, OK
+        count = 0
+        for p in node_info.pods:
+            if (
+                p.meta.namespace == pod.meta.namespace
+                and p.meta.deletion_timestamp == 0.0
+                and all(s.matches(p.meta.labels) for s in selectors)
+            ):
+                count += 1
+        return count, OK
+
+    def score_extensions(self):
+        return self
+
+    def normalize_score(self, state: CycleState, pod: Pod, scores: List[NodeScore]) -> Status:
+        if self._skip(pod):
+            return OK
+        by_name: Dict[str, NodeInfo] = {
+            ni.node.meta.name: ni
+            for ni in (self._snapshot_fn() if self._snapshot_fn else [])
+            if ni.node is not None
+        }
+        counts_by_zone: Dict[str, int] = {}
+        max_by_node = 0
+        zone_of: Dict[str, str] = {}
+        for ns in scores:
+            max_by_node = max(max_by_node, ns.score)
+            ni = by_name.get(ns.name)
+            zone = get_zone_key(ni.node) if ni is not None else ""
+            zone_of[ns.name] = zone
+            if zone:
+                counts_by_zone[zone] = counts_by_zone.get(zone, 0) + ns.score
+        max_by_zone = max(counts_by_zone.values(), default=0)
+        have_zones = bool(counts_by_zone)
+        for ns in scores:
+            f = float(MAX_NODE_SCORE)
+            if max_by_node > 0:
+                f = MAX_NODE_SCORE * (max_by_node - ns.score) / float(max_by_node)
+            if have_zones:
+                zone = zone_of[ns.name]
+                if zone:
+                    zscore = float(MAX_NODE_SCORE)
+                    if max_by_zone > 0:
+                        zscore = MAX_NODE_SCORE * (max_by_zone - counts_by_zone[zone]) / float(max_by_zone)
+                    f = f * (1.0 - ZONE_WEIGHTING) + ZONE_WEIGHTING * zscore
+            ns.score = int(f)
+        return OK

@@ -350,3 +350,116 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
             except Exception as e:  # noqa: BLE001 — conflict: another pod took the PV
                 return Status.error(f"binding volumes: {e}")
         return OK
+
+
+# ---------------------------------------------------------------------------
+# Non-CSI attach limits: EBSLimits / GCEPDLimits / AzureDiskLimits /
+# CinderLimits (nodevolumelimits/non_csi.go)
+
+# per-type defaults (non_csi.go:45-51; pkg/volume/util/attach_limit.go:35,48)
+NON_CSI_DEFAULT_LIMITS = {
+    "ebs": 39,
+    "gce-pd": 16,
+    "azure-disk": 16,
+    "cinder": 256,
+}
+KUBE_MAX_PD_VOLS = "KUBE_MAX_PD_VOLS"  # env override (non_csi.go:66)
+
+
+class NonCSILimits(PreFilterPlugin, FilterPlugin):
+    """Count unique in-tree volumes of one cloud type (this framework models
+    them as PVs with ``volume_type``) used by the node's existing pods plus
+    the incoming pod; reject when over the node's attach limit
+    (non_csi.go:210 Filter). Limit precedence: node allocatable
+    ``attachable-volumes-<type>`` > $KUBE_MAX_PD_VOLS > per-type default
+    (non_csi.go:265-274,379). The incoming pod's typed PV set is resolved
+    once at PreFilter; per node, existing volumes come from the NodeInfo's
+    pvc_ref_counts index rather than re-walking every pod."""
+
+    def __init__(self, name: str, volume_type: str, client=None):
+        self._name = name
+        self.volume_type = volume_type
+        self.client = client
+
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def _state_key(self) -> str:
+        return "PreFilter" + self._name
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        return [
+            ClusterEvent(NODE, ADD, ""),
+            ClusterEvent(PVC, ADD, ""),
+            ClusterEvent(PV, ADD, ""),
+        ]
+
+    def _typed_pv_of_claim(self, pvc: PersistentVolumeClaim) -> Optional[str]:
+        pv = self.client.get_pv(pvc.bound_pv) if pvc.bound_pv else None
+        if pv is not None and pv.volume_type == self.volume_type:
+            return pv.meta.name
+        return None
+
+    def _max_volumes(self, node_info: NodeInfo) -> int:
+        import os as _os
+
+        alloc_key = f"attachable-volumes-{self.volume_type}"
+        from_node = node_info.node.status.allocatable.get(alloc_key)
+        if from_node is not None:
+            return int(from_node)
+        env = _os.environ.get(KUBE_MAX_PD_VOLS, "")
+        if env:
+            try:
+                v = int(env)
+                if v > 0:
+                    return v
+            except ValueError:
+                pass
+        return NON_CSI_DEFAULT_LIMITS[self.volume_type]
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        claims, missing = _pod_pvcs(pod, self.client)
+        if missing is not None:
+            return None, Status.unresolvable(ERR_REASON_PVC_NOT_FOUND)
+        new_vols = {
+            name for pvc in claims
+            if (name := self._typed_pv_of_claim(pvc)) is not None
+        }
+        state.write(self._state_key, new_vols)
+        return None, OK
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        try:
+            new_vols: set = state.read(self._state_key)
+        except KeyError:
+            return Status.error(f"reading {self._state_key!r} from cycleState")
+        if not new_vols:
+            return OK
+        existing = set()
+        for pvc_key in node_info.pvc_ref_counts:
+            pvc = self.client.get_pvc(pvc_key)
+            if pvc is None:
+                continue
+            name = self._typed_pv_of_claim(pvc)
+            if name is not None:
+                existing.add(name)
+        if len(existing | new_vols) > self._max_volumes(node_info):
+            return Status.unschedulable(ERR_REASON_LIMIT)
+        return OK
+
+
+def make_ebs_limits(client=None) -> NonCSILimits:
+    return NonCSILimits(names.EBS_LIMITS, "ebs", client)
+
+
+def make_gce_pd_limits(client=None) -> NonCSILimits:
+    return NonCSILimits(names.GCE_PD_LIMITS, "gce-pd", client)
+
+
+def make_azure_disk_limits(client=None) -> NonCSILimits:
+    return NonCSILimits(names.AZURE_DISK_LIMITS, "azure-disk", client)
+
+
+def make_cinder_limits(client=None) -> NonCSILimits:
+    return NonCSILimits(names.CINDER_LIMITS, "cinder", client)

@@ -19,7 +19,17 @@ from .plugins.interpodaffinity import InterPodAffinity
 from .plugins.nodeaffinity import NodeAffinity
 from .plugins.noderesources import BalancedAllocation, Fit
 from .plugins.podtopologyspread import PodTopologySpread
-from .plugins.volume import NodeVolumeLimits, VolumeBinding, VolumeRestrictions, VolumeZone
+from .plugins.selectorspread import SelectorSpread
+from .plugins.volume import (
+    NodeVolumeLimits,
+    VolumeBinding,
+    VolumeRestrictions,
+    VolumeZone,
+    make_azure_disk_limits,
+    make_cinder_limits,
+    make_ebs_limits,
+    make_gce_pd_limits,
+)
 
 Factory = Callable[[dict, dict], object]  # (handle_ctx, args) -> Plugin
 
@@ -57,6 +67,13 @@ def in_tree_registry() -> Dict[str, Factory]:
             client=h.get("client"), snapshot_fn=h.get("snapshot_fn")
         ),
         names.NODE_VOLUME_LIMITS: lambda h, a: NodeVolumeLimits(client=h.get("client")),
+        names.EBS_LIMITS: lambda h, a: make_ebs_limits(client=h.get("client")),
+        names.GCE_PD_LIMITS: lambda h, a: make_gce_pd_limits(client=h.get("client")),
+        names.AZURE_DISK_LIMITS: lambda h, a: make_azure_disk_limits(client=h.get("client")),
+        names.CINDER_LIMITS: lambda h, a: make_cinder_limits(client=h.get("client")),
+        names.SELECTOR_SPREAD: lambda h, a: SelectorSpread(
+            store=h.get("client"), snapshot_fn=h.get("snapshot_fn")
+        ),
         names.VOLUME_BINDING: lambda h, a: VolumeBinding(client=h.get("client")),
         names.DEFAULT_PREEMPTION: lambda h, a: DefaultPreemption(
             snapshot_fn=h.get("snapshot_fn"),

@@ -211,5 +211,14 @@ class SchedulingQueue:
             "unschedulable": len(self._unschedulable),
         }
 
+    def pending_pod_infos(self) -> List[QueuedPodInfo]:
+        """All queued pods across the three sub-queues (PendingPods, :530) —
+        the debugger/comparer's queue-side truth."""
+        return (
+            [e[2] for e in self._active]
+            + [e[2] for e in self._backoff]
+            + list(self._unschedulable.values())
+        )
+
     def __len__(self) -> int:
         return len(self._active) + len(self._backoff) + len(self._unschedulable)

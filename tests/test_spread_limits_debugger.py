@@ -1,0 +1,257 @@
+"""SelectorSpread, non-CSI volume limits, node tree, cache debugger."""
+
+import logging
+
+from kubernetes_tpu.api.types import (
+    LabelSelector,
+    Namespace,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    ReplicaSet,
+    Service,
+    get_zone_key,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.cache.cache import Cache
+from kubernetes_tpu.cache.debugger import CacheComparer, CacheDebugger
+from kubernetes_tpu.cache.node_tree import NodeTree, zone_interleaved
+from kubernetes_tpu.cache.snapshot import Snapshot
+from kubernetes_tpu.framework.interface import CycleState, NodeScore
+from kubernetes_tpu.framework.plugins.selectorspread import SelectorSpread, default_selector
+from kubernetes_tpu.framework.plugins.volume import make_ebs_limits
+from kubernetes_tpu.framework.types import NodeInfo
+from kubernetes_tpu.queue.scheduling_queue import SchedulingQueue
+
+
+def _store_with_service(selector):
+    store = ClusterStore()
+    store.create_namespace(Namespace())
+    store.create_service(Service(selector=selector))
+    return store
+
+
+class TestDefaultSelector:
+    def test_service_selector_collected(self):
+        store = _store_with_service({"app": "web"})
+        pod = make_pod("p").label("app", "web").obj()
+        sels = default_selector(pod, store)
+        assert len(sels) == 1
+        assert sels[0].matches({"app": "web", "x": "y"})
+
+    def test_non_matching_service_ignored(self):
+        store = _store_with_service({"app": "db"})
+        pod = make_pod("p").label("app", "web").obj()
+        assert default_selector(pod, store) == []
+
+    def test_replicaset_owner_selector(self):
+        store = _store_with_service({"app": "web"})
+        store.create_replica_set(
+            ReplicaSet(selector=LabelSelector(match_labels={"app": "web", "tier": "fe"}))
+        )
+        store.replica_sets["default/rs-1"] = ReplicaSet(
+            selector=LabelSelector(match_labels={"tier": "fe"})
+        )
+        pod = make_pod("p").label("app", "web").owner("ReplicaSet", "rs-1").obj()
+        sels = default_selector(pod, store)
+        assert len(sels) == 2
+
+
+class TestSelectorSpreadScoring:
+    def _make(self, store, snapshot):
+        return SelectorSpread(store=store, snapshot_fn=lambda: snapshot.list())
+
+    def _node_info(self, name, zone=None, pods=()):
+        nw = make_node(name)
+        if zone:
+            nw.label("topology.kubernetes.io/zone", zone)
+        ni = NodeInfo(nw.obj())
+        for p in pods:
+            ni.add_pod(p)
+        return ni
+
+    def test_score_counts_matching_pods(self):
+        store = _store_with_service({"app": "web"})
+        match = make_pod("m1").label("app", "web").obj()
+        other = make_pod("o1").label("app", "db").obj()
+        ni = self._node_info("n1", pods=[match, other])
+        snap = Snapshot()
+        pl = self._make(store, snap)
+        state = CycleState()
+        pod = make_pod("p").label("app", "web").obj()
+        pl.pre_score(state, pod, [])
+        raw, status = pl.score_node(state, pod, ni)
+        assert status.is_success() and raw == 1
+
+    def test_skip_when_pod_has_spread_constraints(self):
+        store = _store_with_service({"app": "web"})
+        pod = (
+            make_pod("p").label("app", "web")
+            .spread_constraint(1, "zone", when_unsatisfiable="ScheduleAnyway",
+                               selector=LabelSelector(match_labels={"app": "web"}))
+            .obj()
+        )
+        pl = self._make(store, Snapshot())
+        state = CycleState()
+        pl.pre_score(state, pod, [])
+        raw, status = pl.score_node(state, pod, self._node_info("n1"))
+        assert raw == 0 and status.is_success()
+
+    def test_normalize_inverts_and_blends_zones(self):
+        store = _store_with_service({"app": "web"})
+        pod = make_pod("p").label("app", "web").obj()
+        mk = lambda i: make_pod(f"m{i}").label("app", "web").obj()
+        # zone a: n1 has 3 matching pods; zone b: n2 has 1, n3 has 0
+        n1 = self._node_info("n1", zone="a", pods=[mk(1), mk(2), mk(3)])
+        n2 = self._node_info("n2", zone="b", pods=[mk(4)])
+        n3 = self._node_info("n3", zone="b")
+        snap = Snapshot()
+        for ni in (n1, n2, n3):
+            snap.node_info_map[ni.node.meta.name] = ni
+        snap.refresh_lists()
+        pl = self._make(store, snap)
+        state = CycleState()
+        pl.pre_score(state, pod, [])
+        scores = []
+        for ni in (n1, n2, n3):
+            raw, _ = pl.score_node(state, pod, ni)
+            scores.append(NodeScore(name=ni.node.meta.name, score=raw))
+        pl.normalize_score(state, pod, scores)
+        by = {s.name: s.score for s in scores}
+        # node score: n1=0 raw3/3, zone a count 3 = max → zone score 0 → 0
+        assert by["n1"] == 0
+        # n3 best: node inverse 100, zone b count 1 → zone 66 → blended > n2
+        assert by["n3"] > by["n2"] > by["n1"]
+
+    def test_zoneless_cluster_pure_node_spread(self):
+        store = _store_with_service({"app": "web"})
+        pod = make_pod("p").label("app", "web").obj()
+        n1 = self._node_info("n1", pods=[make_pod("m").label("app", "web").obj()])
+        n2 = self._node_info("n2")
+        snap = Snapshot()
+        for ni in (n1, n2):
+            snap.node_info_map[ni.node.meta.name] = ni
+        snap.refresh_lists()
+        pl = self._make(store, snap)
+        state = CycleState()
+        pl.pre_score(state, pod, [])
+        scores = [NodeScore(name="n1", score=1), NodeScore(name="n2", score=0)]
+        pl.normalize_score(state, pod, scores)
+        assert scores[0].score == 0 and scores[1].score == 100
+
+
+class TestNonCSILimits:
+    def _store(self, n_pvs):
+        store = ClusterStore()
+        for i in range(n_pvs):
+            store.create_pv(PersistentVolume(meta=ObjectMeta(name=f"pv-{i}"), volume_type="ebs"))
+            store.create_pvc(
+                PersistentVolumeClaim(meta=ObjectMeta(name=f"claim-{i}"), bound_pv=f"pv-{i}")
+            )
+        return store
+
+    def _run(self, pl, pod, ni):
+        state = CycleState()
+        _, st = pl.pre_filter(state, pod)
+        assert st.is_success()
+        return pl.filter(state, pod, ni)
+
+    def test_under_limit_ok(self):
+        store = self._store(2)
+        pl = make_ebs_limits(client=store)
+        pod = make_pod("p").pvc("claim-0").obj()
+        ni = NodeInfo(make_node("n1").obj())
+        assert self._run(pl, pod, ni).is_success()
+
+    def test_over_allocatable_limit_rejected(self):
+        store = self._store(3)
+        pl = make_ebs_limits(client=store)
+        node = make_node("n1").obj()
+        node.status.allocatable["attachable-volumes-ebs"] = 1
+        ni = NodeInfo(node)
+        existing = make_pod("e").pvc("claim-0").obj()
+        ni.add_pod(existing)
+        pod = make_pod("p").pvc("claim-1").obj()
+        status = self._run(pl, pod, ni)
+        assert not status.is_success()
+
+    def test_same_volume_shared_not_double_counted(self):
+        store = self._store(1)
+        pl = make_ebs_limits(client=store)
+        node = make_node("n1").obj()
+        node.status.allocatable["attachable-volumes-ebs"] = 1
+        ni = NodeInfo(node)
+        ni.add_pod(make_pod("e").pvc("claim-0").obj())
+        pod = make_pod("p").pvc("claim-0").obj()  # same PV: no extra attach
+        assert self._run(pl, pod, ni).is_success()
+
+
+class TestNodeTree:
+    def test_round_robin_across_zones(self):
+        tree = NodeTree()
+        nodes = []
+        for i in range(6):
+            n = make_node(f"n{i}").label("topology.kubernetes.io/zone", f"z{i % 2}").obj()
+            nodes.append(n)
+            tree.add_node(n)
+        order = tree.list()
+        assert len(order) == 6
+        zones = ["z0" if n in ("n0", "n2", "n4") else "z1" for n in order]
+        # alternating zones
+        assert zones[:4] == ["z0", "z1", "z0", "z1"]
+
+    def test_remove_and_update(self):
+        tree = NodeTree()
+        n = make_node("a").label("topology.kubernetes.io/zone", "z1").obj()
+        tree.add_node(n)
+        n2 = make_node("a").label("topology.kubernetes.io/zone", "z2").obj()
+        tree.update_node(n, n2)
+        assert tree.num_nodes == 1
+        tree.remove_node(n2)
+        assert tree.list() == []
+
+    def test_snapshot_zone_interleaved(self):
+        infos = []
+        for i in range(4):
+            n = make_node(f"n{i}").label("topology.kubernetes.io/zone", f"z{i // 2}").obj()
+            infos.append(NodeInfo(n))
+        out = zone_interleaved(infos)
+        zones = [get_zone_key(ni.node) for ni in out]
+        assert zones[0] != zones[1]  # interleaved, not grouped
+
+
+class TestCacheDebugger:
+    def _setup(self):
+        store = ClusterStore()
+        cache = Cache()
+        queue = SchedulingQueue()
+        return store, cache, queue
+
+    def test_in_sync(self):
+        store, cache, queue = self._setup()
+        node = make_node("n1").obj()
+        store.create_node(node)
+        cache.add_node(node)
+        pod = make_pod("p1").node("n1").obj()
+        store.pods[pod.meta.key()] = pod
+        cache.add_pod(pod)
+        assert CacheComparer(store, cache, queue).compare()
+
+    def test_drift_detected(self):
+        store, cache, queue = self._setup()
+        store.create_node(make_node("n1").obj())  # store-only node
+        comparer = CacheComparer(store, cache, queue)
+        missed, redundant = comparer.compare_nodes()
+        assert missed == ["n1"] and redundant == []
+        assert not comparer.compare()
+
+    def test_dumper_output(self, caplog):
+        store, cache, queue = self._setup()
+        node = make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        cache.add_node(node)
+        queue.add(make_pod("p1").obj())
+        dbg = CacheDebugger(store, cache, queue)
+        with caplog.at_level(logging.INFO):
+            text = dbg.dumper.dump_all()
+        assert "Node: n1" in text and "Pod: default/p1" in text
