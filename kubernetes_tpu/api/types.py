@@ -484,6 +484,19 @@ class Job:
 
 
 @dataclass
+class Lease:
+    """coordination.k8s.io/v1 Lease — the leader-election lock object
+    (tools/leaderelection/resourcelock LeaseLock)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: float = 15.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+    lease_transitions: int = 0
+
+
+@dataclass
 class PriorityClass:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     value: int = 0

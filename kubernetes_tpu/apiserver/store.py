@@ -14,11 +14,14 @@ The binding subresource (``bind``) mirrors BindingREST.Create
 from __future__ import annotations
 
 import threading
-from typing import Callable, Dict, List, Optional
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 from ..api.types import (
     Binding,
     CSINode,
+    Lease,
     Namespace,
     Node,
     PersistentVolume,
@@ -48,6 +51,59 @@ class NotFound(Exception):
     """404."""
 
 
+class Expired(Exception):
+    """410 Gone: requested watch resourceVersion fell off the journal —
+    the client must relist (etcd compaction / watch-cache overflow analog)."""
+
+
+@dataclass
+class WatchEvent:
+    """One event on a Watch stream (apimachinery pkg/watch/watch.go:29)."""
+
+    seq: int
+    type: str  # ADDED | MODIFIED | DELETED
+    object: object
+    old: Optional[object] = None
+
+
+class Watch:
+    """A watch channel: thread-safe event queue + stop
+    (watch.Interface; events pushed by the store's fan-out)."""
+
+    def __init__(self, kind: str, store: "ClusterStore"):
+        self.kind = kind
+        self._store = store
+        self._events: Deque[WatchEvent] = deque()
+        self._cond = threading.Condition()
+        self.stopped = False
+
+    def _push(self, ev: WatchEvent) -> None:
+        with self._cond:
+            if self.stopped:
+                return
+            self._events.append(ev)
+            self._cond.notify_all()
+
+    def next(self, timeout: float = 0.0) -> Optional[WatchEvent]:
+        """Next event or None (after timeout, or immediately when 0)."""
+        with self._cond:
+            if not self._events and timeout > 0:
+                self._cond.wait(timeout)
+            return self._events.popleft() if self._events else None
+
+    def drain(self) -> List[WatchEvent]:
+        with self._cond:
+            out = list(self._events)
+            self._events.clear()
+            return out
+
+    def stop(self) -> None:
+        with self._cond:
+            self.stopped = True
+            self._cond.notify_all()
+        self._store._stop_watch(self)
+
+
 class ClusterStore:
     def __init__(self):
         self._lock = threading.RLock()
@@ -64,19 +120,87 @@ class ClusterStore:
         self.replication_controllers: Dict[str, ReplicationController] = {}
         self.replica_sets: Dict[str, ReplicaSet] = {}
         self.stateful_sets: Dict[str, StatefulSet] = {}
+        self.leases: Dict[str, "Lease"] = {}
         self._handlers: Dict[str, List[Handler]] = {}
         self._rv = 0
+        # watch journal (the watch cache, cacher.go:227): bounded event log +
+        # live watcher fan-out; seq is the LIST/WATCH resourceVersion.
+        self._event_seq = 0
+        self._journal: List[Tuple[int, str, str, object, object]] = []
+        self._journal_capacity = 4096
+        self._watchers: Dict[str, List[Watch]] = {}
 
     def add_event_handler(self, kind: str, handler: Handler) -> None:
         self._handlers.setdefault(kind, []).append(handler)
 
     def _notify(self, kind: str, event: str, old, new) -> None:
+        with self._lock:
+            self._event_seq += 1
+            seq = self._event_seq
+            self._journal.append((seq, kind, event, old, new))
+            if len(self._journal) > self._journal_capacity:
+                del self._journal[: len(self._journal) - self._journal_capacity]
+            watchers = list(self._watchers.get(kind, []))
+        for w in watchers:
+            w._push(WatchEvent(seq=seq, type=event, old=old, object=new if new is not None else old))
         for h in self._handlers.get(kind, []):
             h(event, old, new)
 
     def _bump(self, obj) -> None:
         self._rv += 1
         obj.meta.resource_version = self._rv
+
+    # ------------------------------------------------------------- list+watch
+    # (the L2 watch-cache seam: storage/cacher/cacher.go:227 fan-out plus the
+    # LIST-with-resourceVersion the reflector resumes from, reflector.go:254)
+
+    def list_objects(self, kind: str) -> Tuple[List[object], int]:
+        """LIST: (objects, resourceVersion) — the reflector's initial sync."""
+        with self._lock:
+            m = self._kind_map(kind)
+            return list(m.values()), self._event_seq
+
+    def watch(self, kind: str, since: int) -> "Watch":
+        """WATCH from ``since`` (a seq returned by list_objects/WatchEvent).
+        Raises Expired when the journal no longer covers ``since`` — the
+        client must relist (reflector.go relist-on-410 path)."""
+        with self._lock:
+            oldest_covered = self._journal[0][0] - 1 if self._journal else self._event_seq
+            if since < oldest_covered:
+                raise Expired(f"resourceVersion {since} is too old (oldest {oldest_covered})")
+            backlog = [e for e in self._journal if e[0] > since and e[1] == kind]
+            w = Watch(kind=kind, store=self)
+            for seq, _k, event, old, new in backlog:
+                w._push(WatchEvent(seq=seq, type=event, old=old, object=new if new is not None else old))
+            self._watchers.setdefault(kind, []).append(w)
+            return w
+
+    def _stop_watch(self, w: "Watch") -> None:
+        with self._lock:
+            lst = self._watchers.get(w.kind, [])
+            if w in lst:
+                lst.remove(w)
+
+    def _kind_map(self, kind: str) -> Dict[str, object]:
+        try:
+            return {
+                "Pod": self.pods,
+                "Node": self.nodes,
+                "Namespace": self.namespaces,
+                "PodDisruptionBudget": self.pdbs,
+                "PriorityClass": self.priority_classes,
+                "PersistentVolume": self.pvs,
+                "PersistentVolumeClaim": self.pvcs,
+                "StorageClass": self.storage_classes,
+                "CSINode": self.csinodes,
+                "Service": self.services,
+                "ReplicationController": self.replication_controllers,
+                "ReplicaSet": self.replica_sets,
+                "StatefulSet": self.stateful_sets,
+                "Lease": self.leases,
+            }[kind]
+        except KeyError:
+            raise NotFound(f"unknown kind {kind!r}") from None
 
     # ------------------------------------------------------------- nodes
 
@@ -225,6 +349,37 @@ class ClusterStore:
     def get_stateful_set(self, key: str) -> Optional[StatefulSet]:
         with self._lock:
             return self.stateful_sets.get(key)
+
+    # ------------------------------------------------------------- leases
+    # (coordination.k8s.io; optimistic-concurrency update is the leader lock)
+
+    def get_lease(self, key: str) -> Optional["Lease"]:
+        with self._lock:
+            return self.leases.get(key)
+
+    def create_lease(self, lease: "Lease") -> None:
+        with self._lock:
+            if lease.meta.key() in self.leases:
+                raise Conflict(f"lease {lease.meta.key()} exists")
+            self._bump(lease)
+            self.leases[lease.meta.key()] = lease
+        self._notify("Lease", ADDED, None, lease)
+
+    def update_lease(self, lease: "Lease", expect_rv: int) -> None:
+        """Guarded update: fails unless the stored lease still has
+        ``expect_rv`` (GuaranteedUpdate's optimistic concurrency,
+        etcd3/store.go:328 — what makes leader election safe)."""
+        with self._lock:
+            old = self.leases.get(lease.meta.key())
+            if old is None:
+                raise NotFound(lease.meta.key())
+            if old.meta.resource_version != expect_rv:
+                raise Conflict(
+                    f"lease {lease.meta.key()}: rv {expect_rv} != {old.meta.resource_version}"
+                )
+            self._bump(lease)
+            self.leases[lease.meta.key()] = lease
+        self._notify("Lease", MODIFIED, old, lease)
 
     # ------------------------------------------------------------- storage kinds
 

@@ -1,0 +1,26 @@
+"""Client runtime (the L3 layer, staging/src/k8s.io/client-go):
+reflector + DeltaFIFO + shared informers, workqueue, leader election.
+
+Every component in this framework consumes cluster state through this layer
+(the reference's informer bus, SURVEY.md §2.5) rather than touching the
+store's maps directly: a Reflector LISTs then WATCHes one kind, feeds a
+DeltaFIFO, and a SharedIndexInformer pops deltas into an indexed local cache
+while fanning out to event handlers.
+"""
+
+from .delta_fifo import Delta, DeltaFIFO
+from .informer import SharedIndexInformer, SharedInformerFactory
+from .leaderelection import LeaderElector
+from .reflector import Reflector
+from .workqueue import RateLimitingQueue, parallelize_until
+
+__all__ = [
+    "Delta",
+    "DeltaFIFO",
+    "LeaderElector",
+    "RateLimitingQueue",
+    "Reflector",
+    "SharedIndexInformer",
+    "SharedInformerFactory",
+    "parallelize_until",
+]

@@ -60,8 +60,10 @@ class Scheduler:
         extenders=None,
         metrics=None,
         recorder=None,
+        informer_factory=None,
     ):
         self.store = store
+        self.informer_factory = informer_factory
         self.extenders = list(extenders or [])
         self.smetrics = metrics if metrics is not None else SchedulerMetrics()
         self.recorder = recorder if recorder is not None else EventRecorder()
@@ -122,9 +124,19 @@ class Scheduler:
     def _add_all_event_handlers(self) -> None:
         """eventhandlers.go:249 addAllEventHandlers.
 
-        Mirrors the informer's ListAndWatch contract (reflector.go:254): the
-        initial LIST replays objects that existed before the scheduler started
-        as ADD events, then the watch (handler registration) takes over."""
+        With an informer factory, events arrive through the shared-informer
+        bus (reflector → DeltaFIFO → fan-out) and the loop pumps it each
+        cycle. Without one, handlers sit directly on the store with the
+        initial LIST replayed as ADDs (same ListAndWatch contract,
+        reflector.go:254, minus the queueing)."""
+        if self.informer_factory is not None:
+            evmap = {"add": ADDED, "update": MODIFIED, "delete": DELETED}
+            pod_inf = self.informer_factory.informer_for("Pod")
+            node_inf = self.informer_factory.informer_for("Node")
+            pod_inf.add_event_handler(lambda e, old, new: self._on_pod_event(evmap[e], old, new))
+            node_inf.add_event_handler(lambda e, old, new: self._on_node_event(evmap[e], old, new))
+            self.informer_factory.wait_for_cache_sync()
+            return
         for node in list(self.store.nodes.values()):
             self._on_node_event(ADDED, None, node)
         for pod in list(self.store.pods.values()):
@@ -194,6 +206,8 @@ class Scheduler:
     def schedule_one(self) -> bool:
         """One scheduling cycle (schedule_one.go:66). Returns False when the
         active queue is empty."""
+        if self.informer_factory is not None:
+            self.informer_factory.pump()
         self._periodic_housekeeping()
         qp = self.queue.pop()
         if qp is None:

@@ -1,0 +1,251 @@
+"""Client runtime layer: watch journal, reflector, DeltaFIFO, informers,
+workqueue, leader election — and the scheduler driven through informers."""
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ADDED, ClusterStore, DELETED, Expired, MODIFIED
+from kubernetes_tpu.client import (
+    DeltaFIFO,
+    LeaderElector,
+    RateLimitingQueue,
+    Reflector,
+    SharedInformerFactory,
+    parallelize_until,
+)
+from kubernetes_tpu.client.delta_fifo import ADDED as D_ADDED, DELETED as D_DELETED, REPLACED
+from kubernetes_tpu.client.leaderelection import LeaderElectionConfig
+from kubernetes_tpu.client.workqueue import chunk_size_for
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+class TestWatch:
+    def test_watch_streams_events(self):
+        store = ClusterStore()
+        _, rv = store.list_objects("Pod")
+        w = store.watch("Pod", since=rv)
+        store.create_pod(make_pod("a").obj())
+        store.delete_pod("default/a")
+        evs = w.drain()
+        assert [e.type for e in evs] == [ADDED, DELETED]
+        w.stop()
+
+    def test_watch_backlog_from_journal(self):
+        store = ClusterStore()
+        store.create_pod(make_pod("a").obj())
+        w = store.watch("Pod", since=0)  # journal replay
+        evs = w.drain()
+        assert [e.type for e in evs] == [ADDED]
+        w.stop()
+
+    def test_watch_expired(self):
+        store = ClusterStore()
+        store._journal_capacity = 2
+        for i in range(5):
+            store.create_pod(make_pod(f"p{i}").obj())
+        with pytest.raises(Expired):
+            store.watch("Pod", since=1)
+
+    def test_watch_filters_kind(self):
+        store = ClusterStore()
+        _, rv = store.list_objects("Pod")
+        w = store.watch("Pod", since=rv)
+        store.create_node(make_node("n").obj())
+        store.create_pod(make_pod("a").obj())
+        evs = w.drain()
+        assert len(evs) == 1 and evs[0].object.meta.name == "a"
+        w.stop()
+
+
+class TestDeltaFIFO:
+    def _fifo(self, known=None):
+        return DeltaFIFO(lambda o: o.meta.key(), known_objects=known)
+
+    def test_accumulates_deltas_per_key(self):
+        f = self._fifo()
+        p = make_pod("a").obj()
+        f.add(p)
+        f.update(p)
+        deltas = f.pop()
+        assert [d.type for d in deltas] == [D_ADDED, "Updated"]
+        assert f.pop() is None
+
+    def test_replace_synthesizes_deletes(self):
+        known_keys = ["default/gone"]
+        f = self._fifo(known=lambda: known_keys)
+        f.replace([make_pod("kept").obj()])
+        types = {}
+        while (ds := f.pop()) is not None:
+            for d in ds:
+                key = d.object if isinstance(d.object, str) else d.object.meta.key()
+                types.setdefault(key, []).append(d.type)
+        assert types["default/kept"] == [REPLACED]
+        assert types["default/gone"] == [D_DELETED]
+
+    def test_has_synced_after_initial_pop(self):
+        f = self._fifo(known=lambda: [])
+        f.replace([make_pod("a").obj(), make_pod("b").obj()])
+        assert not f.has_synced()
+        f.pop(); f.pop()
+        assert f.has_synced()
+
+
+class TestReflectorInformer:
+    def test_reflector_list_then_watch(self):
+        store = ClusterStore()
+        store.create_pod(make_pod("pre").obj())
+        f = DeltaFIFO(lambda o: o.meta.key())
+        r = Reflector(store, "Pod", f)
+        r.list_and_establish_watch()
+        assert f.pop()[0].type == REPLACED  # pre-existing via LIST
+        store.create_pod(make_pod("post").obj())
+        assert r.step() == 1
+        assert f.pop()[0].type == D_ADDED
+
+    def test_informer_indexer_and_handlers(self):
+        store = ClusterStore()
+        store.create_node(make_node("n1").obj())
+        factory = SharedInformerFactory(store)
+        inf = factory.informer_for("Node")
+        events = []
+        inf.add_event_handler(lambda e, old, new: events.append((e, (new or old).meta.name)))
+        factory.start()
+        assert inf.get("n1") is not None
+        assert ("add", "n1") in events
+        store.create_node(make_node("n2").obj())
+        store.delete_node("n1")
+        factory.pump()
+        assert inf.get("n2") is not None and inf.get("n1") is None
+        assert ("delete", "n1") in events
+
+    def test_late_handler_gets_replay(self):
+        store = ClusterStore()
+        store.create_pod(make_pod("a").obj())
+        factory = SharedInformerFactory(store)
+        inf = factory.informer_for("Pod")
+        factory.start()
+        seen = []
+        inf.add_event_handler(lambda e, old, new: seen.append((e, new.meta.name)))
+        assert seen == [("add", "a")]
+
+    def test_informer_survives_journal_expiry(self):
+        store = ClusterStore()
+        factory = SharedInformerFactory(store)
+        inf = factory.informer_for("Pod")
+        factory.start()
+        store._journal_capacity = 4
+        # force the watch to lag: stop it, churn past capacity, then relist
+        inf.reflector._watch.stop()
+        inf.reflector._watch = None
+        for i in range(10):
+            store.create_pod(make_pod(f"p{i}").obj())
+        store.delete_pod("default/p0")
+        inf.reflector.relist()
+        inf.pump()
+        assert inf.get("default/p0") is None
+        assert inf.get("default/p9") is not None
+
+
+class TestWorkqueue:
+    def test_dedup(self):
+        q = RateLimitingQueue()
+        q.add("x"); q.add("x")
+        assert len(q) == 1
+        assert q.get() == "x"
+        assert q.get() is None
+
+    def test_readd_while_processing_requeues_on_done(self):
+        q = RateLimitingQueue()
+        q.add("x")
+        item = q.get()
+        q.add("x")  # arrives while processing
+        assert len(q) == 0
+        q.done(item)
+        assert q.get() == "x"
+
+    def test_rate_limited_backoff(self):
+        t = [0.0]
+        q = RateLimitingQueue(base_delay=1.0, now_fn=lambda: t[0])
+        q.add_rate_limited("x")
+        assert q.get() is None  # not ready yet
+        t[0] = 1.1
+        assert q.get() == "x"
+        q.done("x")
+        q.add_rate_limited("x")  # second failure: 2s
+        t[0] = 2.0
+        assert q.get() is None
+        t[0] = 3.2
+        assert q.get() == "x"
+        q.forget("x")
+        assert q.num_requeues("x") == 0
+
+    def test_parallelize_until_covers_all(self):
+        seen = []
+        parallelize_until(4, 100, lambda i: seen.append(i))
+        assert sorted(seen) == list(range(100))
+
+    def test_chunk_size(self):
+        assert chunk_size_for(100, 16) == 7  # min(10, 100/16+1=7)
+        assert chunk_size_for(1, 16) == 1
+
+
+class TestLeaderElection:
+    def test_acquire_renew_steal(self):
+        store = ClusterStore()
+        t = [0.0]
+        cfg_a = LeaderElectionConfig(identity="a", lease_duration=15.0)
+        cfg_b = LeaderElectionConfig(identity="b", lease_duration=15.0)
+        a = LeaderElector(store, cfg_a, now_fn=lambda: t[0])
+        b = LeaderElector(store, cfg_b, now_fn=lambda: t[0])
+        assert a.run_once() is True
+        assert b.run_once() is False  # lease held and fresh
+        t[0] = 10.0
+        assert a.run_once() is True  # renew
+        assert b.run_once() is False
+        t[0] = 30.0  # a's renew (t=10) + 15s expired
+        assert b.run_once() is True  # steal
+        assert store.get_lease("kube-system/kube-scheduler").lease_transitions == 1
+        assert a.run_once() is False  # a lost it
+
+    def test_callbacks(self):
+        store = ClusterStore()
+        t = [0.0]
+        calls = []
+        a = LeaderElector(store, LeaderElectionConfig(identity="a"),
+                          on_started_leading=lambda: calls.append("start"),
+                          on_stopped_leading=lambda: calls.append("stop"),
+                          now_fn=lambda: t[0])
+        b = LeaderElector(store, LeaderElectionConfig(identity="b"), now_fn=lambda: t[0])
+        a.run_once()
+        t[0] = 100.0
+        b.run_once()  # steals
+        a.run_once()  # notices
+        assert calls == ["start", "stop"]
+
+
+class TestSchedulerThroughInformers:
+    def test_e2e_with_informer_bus(self):
+        store = ClusterStore()
+        for i in range(5):
+            store.create_node(make_node(f"n{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        factory = SharedInformerFactory(store)
+        sched = Scheduler(store, informer_factory=factory)
+        for i in range(8):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        bound = [p for p in store.pods.values() if p.spec.node_name]
+        assert len(bound) == 8
+
+    def test_informer_scheduler_sees_node_added_later(self):
+        store = ClusterStore()
+        factory = SharedInformerFactory(store)
+        clock = FakeClock()
+        sched = Scheduler(store, informer_factory=factory, now_fn=clock)
+        store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        assert store.get_pod("default/p").spec.node_name == ""
+        store.create_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        clock.advance(10.1)  # past pod backoff
+        sched.run_until_settled()
+        assert store.get_pod("default/p").spec.node_name == "n1"
