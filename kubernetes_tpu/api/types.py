@@ -483,6 +483,21 @@ class Job:
     succeeded: int = 0
 
 
+@dataclass(frozen=True)
+class EndpointAddress:
+    pod_key: str = ""
+    node_name: str = ""
+
+
+@dataclass
+class Endpoints:
+    """core/v1 Endpoints — ready pod addresses backing a Service, maintained
+    by the endpoints controller and consumed by kube-proxy."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    addresses: Tuple[EndpointAddress, ...] = ()
+
+
 @dataclass
 class Lease:
     """coordination.k8s.io/v1 Lease — the leader-election lock object

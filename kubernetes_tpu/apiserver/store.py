@@ -121,6 +121,10 @@ class ClusterStore:
         self.replica_sets: Dict[str, ReplicaSet] = {}
         self.stateful_sets: Dict[str, StatefulSet] = {}
         self.leases: Dict[str, "Lease"] = {}
+        self.deployments: Dict[str, object] = {}
+        self.daemon_sets: Dict[str, object] = {}
+        self.jobs: Dict[str, object] = {}
+        self.endpoints: Dict[str, object] = {}
         self._handlers: Dict[str, List[Handler]] = {}
         self._rv = 0
         # watch journal (the watch cache, cacher.go:227): bounded event log +
@@ -133,16 +137,22 @@ class ClusterStore:
     def add_event_handler(self, kind: str, handler: Handler) -> None:
         self._handlers.setdefault(kind, []).append(handler)
 
-    def _notify(self, kind: str, event: str, old, new) -> None:
-        with self._lock:
-            self._event_seq += 1
-            seq = self._event_seq
-            self._journal.append((seq, kind, event, old, new))
-            if len(self._journal) > self._journal_capacity:
-                del self._journal[: len(self._journal) - self._journal_capacity]
-            watchers = list(self._watchers.get(kind, []))
-        for w in watchers:
+    def _journal_event(self, kind: str, event: str, old, new) -> None:
+        """Append to the watch journal + push to live watchers. MUST be
+        called inside the mutator's critical section so the journal order
+        matches the map mutation order (else concurrent writers could
+        journal ADDED/DELETED inverted and desync informer caches)."""
+        self._event_seq += 1
+        seq = self._event_seq
+        self._journal.append((seq, kind, event, old, new))
+        if len(self._journal) > self._journal_capacity:
+            del self._journal[: len(self._journal) - self._journal_capacity]
+        for w in self._watchers.get(kind, []):
             w._push(WatchEvent(seq=seq, type=event, old=old, object=new if new is not None else old))
+
+    def _notify(self, kind: str, event: str, old, new) -> None:
+        """Direct-handler fan-out, outside the lock (handlers may re-enter
+        the store); informers get their events from _journal_event."""
         for h in self._handlers.get(kind, []):
             h(event, old, new)
 
@@ -198,6 +208,10 @@ class ClusterStore:
                 "ReplicaSet": self.replica_sets,
                 "StatefulSet": self.stateful_sets,
                 "Lease": self.leases,
+                "Deployment": self.deployments,
+                "DaemonSet": self.daemon_sets,
+                "Job": self.jobs,
+                "Endpoints": self.endpoints,
             }[kind]
         except KeyError:
             raise NotFound(f"unknown kind {kind!r}") from None
@@ -208,6 +222,7 @@ class ClusterStore:
         with self._lock:
             self._bump(node)
             self.nodes[node.meta.name] = node
+            self._journal_event("Node", ADDED, None, node)
         self._notify("Node", ADDED, None, node)
 
     def update_node(self, node: Node) -> None:
@@ -217,11 +232,14 @@ class ClusterStore:
                 raise NotFound(node.meta.name)
             self._bump(node)
             self.nodes[node.meta.name] = node
+            self._journal_event("Node", MODIFIED, old, node)
         self._notify("Node", MODIFIED, old, node)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
             old = self.nodes.pop(name, None)
+            if old is not None:
+                self._journal_event("Node", DELETED, old, None)
         if old is not None:
             self._notify("Node", DELETED, old, None)
 
@@ -231,6 +249,7 @@ class ClusterStore:
         with self._lock:
             self._bump(pod)
             self.pods[pod.key()] = pod
+            self._journal_event("Pod", ADDED, None, pod)
         self._notify("Pod", ADDED, None, pod)
 
     def update_pod(self, pod: Pod) -> None:
@@ -240,11 +259,14 @@ class ClusterStore:
                 raise NotFound(pod.key())
             self._bump(pod)
             self.pods[pod.key()] = pod
+            self._journal_event("Pod", MODIFIED, old, pod)
         self._notify("Pod", MODIFIED, old, pod)
 
     def delete_pod(self, key: str) -> None:
         with self._lock:
             old = self.pods.pop(key, None)
+            if old is not None:
+                self._journal_event("Pod", DELETED, old, None)
         if old is not None:
             self._notify("Pod", DELETED, old, None)
 
@@ -266,6 +288,7 @@ class ClusterStore:
             new.status.phase = "Running"
             self._bump(new)
             self.pods[binding.pod_key] = new
+            self._journal_event("Pod", MODIFIED, old, new)
         self._notify("Pod", MODIFIED, old, new)
 
     def update_pod_nominated_node(self, key: str, node_name: str) -> None:
@@ -279,6 +302,7 @@ class ClusterStore:
             new.status.nominated_node_name = node_name
             self._bump(new)
             self.pods[key] = new
+            self._journal_event("Pod", MODIFIED, old, new)
         self._notify("Pod", MODIFIED, old, new)
 
     # ------------------------------------------------------------- misc kinds
@@ -286,6 +310,7 @@ class ClusterStore:
     def create_namespace(self, ns: Namespace) -> None:
         with self._lock:
             self.namespaces[ns.meta.name] = ns
+            self._journal_event("Namespace", ADDED, None, ns)
         self._notify("Namespace", ADDED, None, ns)
 
     def ns_labels(self, name: str) -> Dict[str, str]:
@@ -296,6 +321,7 @@ class ClusterStore:
     def create_pdb(self, pdb: PodDisruptionBudget) -> None:
         with self._lock:
             self.pdbs[pdb.meta.key()] = pdb
+            self._journal_event("PodDisruptionBudget", ADDED, None, pdb)
         self._notify("PodDisruptionBudget", ADDED, None, pdb)
 
     def list_pdbs(self) -> List[PodDisruptionBudget]:
@@ -305,7 +331,61 @@ class ClusterStore:
     def create_priority_class(self, pc: PriorityClass) -> None:
         with self._lock:
             self.priority_classes[pc.meta.name] = pc
+            self._journal_event("PriorityClass", ADDED, None, pc)
         self._notify("PriorityClass", ADDED, None, pc)
+
+    # ------------------------------------------------------------- generic CRUD
+    # (the registry's per-resource REST strategies, collapsed: pkg/registry)
+
+    CLUSTER_SCOPED_KINDS = {
+        "Node", "Namespace", "PersistentVolume", "StorageClass", "CSINode",
+        "PriorityClass",
+    }
+
+    def _key_of(self, kind: str, obj) -> str:
+        return obj.meta.name if kind in self.CLUSTER_SCOPED_KINDS else obj.meta.key()
+
+    def create_object(self, kind: str, obj) -> None:
+        m = self._kind_map(kind)
+        with self._lock:
+            key = self._key_of(kind, obj)
+            if key in m:
+                raise Conflict(f"{kind} {key} exists")
+            self._bump(obj)
+            m[key] = obj
+            self._journal_event(kind, ADDED, None, obj)
+        self._notify(kind, ADDED, None, obj)
+
+    def update_object(self, kind: str, obj) -> None:
+        m = self._kind_map(kind)
+        with self._lock:
+            key = self._key_of(kind, obj)
+            old = m.get(key)
+            if old is None:
+                raise NotFound(f"{kind} {key}")
+            self._bump(obj)
+            m[key] = obj
+            self._journal_event(kind, MODIFIED, old, obj)
+        self._notify(kind, MODIFIED, old, obj)
+
+    def delete_object(self, kind: str, key: str) -> None:
+        m = self._kind_map(kind)
+        with self._lock:
+            old = m.pop(key, None)
+            if old is not None:
+                self._journal_event(kind, DELETED, old, None)
+        if old is not None:
+            self._notify(kind, DELETED, old, None)
+
+    def get_object(self, kind: str, key: str):
+        with self._lock:
+            return self._kind_map(kind).get(key)
+
+    def snapshot_map(self, kind: str) -> Dict[str, object]:
+        """Copy of a kind's map under the lock — safe to iterate while other
+        threads mutate (controllers' level-scan reads)."""
+        with self._lock:
+            return dict(self._kind_map(kind))
 
     # ------------------------------------------------------------- workload kinds
     # (SelectorSpread's owner lookup, helper/spread.go DefaultSelector)
@@ -314,6 +394,7 @@ class ClusterStore:
         with self._lock:
             self._bump(svc)
             self.services[svc.meta.key()] = svc
+            self._journal_event("Service", ADDED, None, svc)
         self._notify("Service", ADDED, None, svc)
 
     def list_services(self, namespace: str) -> List[Service]:
@@ -324,6 +405,7 @@ class ClusterStore:
         with self._lock:
             self._bump(rc)
             self.replication_controllers[rc.meta.key()] = rc
+            self._journal_event("ReplicationController", ADDED, None, rc)
         self._notify("ReplicationController", ADDED, None, rc)
 
     def get_replication_controller(self, key: str) -> Optional[ReplicationController]:
@@ -334,6 +416,7 @@ class ClusterStore:
         with self._lock:
             self._bump(rs)
             self.replica_sets[rs.meta.key()] = rs
+            self._journal_event("ReplicaSet", ADDED, None, rs)
         self._notify("ReplicaSet", ADDED, None, rs)
 
     def get_replica_set(self, key: str) -> Optional[ReplicaSet]:
@@ -344,6 +427,7 @@ class ClusterStore:
         with self._lock:
             self._bump(ss)
             self.stateful_sets[ss.meta.key()] = ss
+            self._journal_event("StatefulSet", ADDED, None, ss)
         self._notify("StatefulSet", ADDED, None, ss)
 
     def get_stateful_set(self, key: str) -> Optional[StatefulSet]:
@@ -363,6 +447,7 @@ class ClusterStore:
                 raise Conflict(f"lease {lease.meta.key()} exists")
             self._bump(lease)
             self.leases[lease.meta.key()] = lease
+            self._journal_event("Lease", ADDED, None, lease)
         self._notify("Lease", ADDED, None, lease)
 
     def update_lease(self, lease: "Lease", expect_rv: int) -> None:
@@ -379,6 +464,7 @@ class ClusterStore:
                 )
             self._bump(lease)
             self.leases[lease.meta.key()] = lease
+            self._journal_event("Lease", MODIFIED, old, lease)
         self._notify("Lease", MODIFIED, old, lease)
 
     # ------------------------------------------------------------- storage kinds
@@ -387,22 +473,26 @@ class ClusterStore:
         with self._lock:
             self._bump(pv)
             self.pvs[pv.meta.name] = pv
+            self._journal_event("PersistentVolume", ADDED, None, pv)
         self._notify("PersistentVolume", ADDED, None, pv)
 
     def create_pvc(self, pvc: PersistentVolumeClaim) -> None:
         with self._lock:
             self._bump(pvc)
             self.pvcs[pvc.meta.key()] = pvc
+            self._journal_event("PersistentVolumeClaim", ADDED, None, pvc)
         self._notify("PersistentVolumeClaim", ADDED, None, pvc)
 
     def create_storage_class(self, sc: StorageClass) -> None:
         with self._lock:
             self.storage_classes[sc.meta.name] = sc
+            self._journal_event("StorageClass", ADDED, None, sc)
         self._notify("StorageClass", ADDED, None, sc)
 
     def create_csinode(self, cn: CSINode) -> None:
         with self._lock:
             self.csinodes[cn.meta.name] = cn
+            self._journal_event("CSINode", ADDED, None, cn)
         self._notify("CSINode", ADDED, None, cn)
 
     def get_pvc(self, key: str) -> Optional[PersistentVolumeClaim]:
@@ -444,5 +534,7 @@ class ClusterStore:
             self._bump(new_pvc)
             self.pvs[pv_name] = new_pv
             self.pvcs[pvc_key] = new_pvc
+            self._journal_event("PersistentVolume", MODIFIED, old_pv, new_pv)
+            self._journal_event("PersistentVolumeClaim", MODIFIED, old_pvc, new_pvc)
         self._notify("PersistentVolume", MODIFIED, old_pv, new_pv)
         self._notify("PersistentVolumeClaim", MODIFIED, old_pvc, new_pvc)

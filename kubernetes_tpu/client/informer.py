@@ -155,11 +155,6 @@ class SharedInformerFactory:
     """One informer per kind, shared by all consumers
     (informers/factory.go NewSharedInformerFactory)."""
 
-    # cluster-scoped kinds key by bare name; namespaced kinds by ns/name
-    CLUSTER_SCOPED = {
-        "Node", "Namespace", "PersistentVolume", "StorageClass", "CSINode",
-        "PriorityClass",
-    }
     KEY_FNS: Dict[str, Callable[[object], str]] = {}
 
     def __init__(self, store):
@@ -168,13 +163,17 @@ class SharedInformerFactory:
         self._lock = threading.RLock()
 
     def informer_for(self, kind: str, indexers: Optional[Dict[str, Indexer]] = None) -> SharedIndexInformer:
+        # keying must agree with the store's CRUD: cluster-scoped kinds by
+        # bare name, namespaced by ns/name (one shared set, the store's)
+        from ..apiserver.store import ClusterStore
+
         with self._lock:
             inf = self._informers.get(kind)
             if inf is None:
                 key_fn = self.KEY_FNS.get(
                     kind,
                     (lambda o: o.meta.name)
-                    if kind in self.CLUSTER_SCOPED
+                    if kind in ClusterStore.CLUSTER_SCOPED_KINDS
                     else (lambda o: o.meta.key()),
                 )
                 inf = SharedIndexInformer(self.store, kind, key_fn, indexers)

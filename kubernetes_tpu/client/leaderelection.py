@@ -42,6 +42,7 @@ class LeaderElector:
         self.on_stopped_leading = on_stopped_leading
         self.now_fn = now_fn
         self._leading = False
+        self._last_renew = 0.0
 
     @property
     def _key(self) -> str:
@@ -71,6 +72,7 @@ class LeaderElector:
                 self.store.create_lease(new)
             except Conflict:
                 return self._set_leading(False)
+            self._last_renew = now
             return self._set_leading(True)
 
         if lease.holder_identity != cfg.identity and not self._expired(lease):
@@ -85,6 +87,9 @@ class LeaderElector:
         new = _dc.replace(
             lease,
             holder_identity=cfg.identity,
+            # the acquirer's OWN duration, not the previous holder's
+            # (leaderelection.go writes LeaseDurationSeconds from config)
+            lease_duration_seconds=cfg.lease_duration,
             acquire_time=lease.acquire_time if lease.holder_identity == cfg.identity else now,
             renew_time=now,
             lease_transitions=transitions,
@@ -93,7 +98,12 @@ class LeaderElector:
         try:
             self.store.update_lease(new, expect_rv=lease.meta.resource_version)
         except (Conflict, NotFound):
+            # renew failed; give up leadership only past the renew deadline
+            # (leaderelection.go:275 renewLoop's RenewDeadline timeout)
+            if self._leading and now - self._last_renew < cfg.renew_deadline:
+                return True
             return self._set_leading(False)
+        self._last_renew = now
         return self._set_leading(True)
 
     def _set_leading(self, leading: bool) -> bool:

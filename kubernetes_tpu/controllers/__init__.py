@@ -1,0 +1,42 @@
+"""Controller manager (L4b): the reference's kube-controller-manager control
+loops (pkg/controller/*, registered by NewControllerInitializers,
+cmd/kube-controller-manager/app/controllermanager.go:412), re-expressed as
+informer-fed, workqueue-driven reconcilers over the in-process store.
+
+Each controller watches kinds through the shared informer bus, enqueues keys
+on a rate-limited workqueue, and reconciles level-triggered. The manager
+registers them initializer-style and pumps them (sync rounds) — the analog of
+each controller's N worker goroutines draining its queue.
+"""
+
+from .housekeeping import (
+    EndpointsController,
+    GarbageCollector,
+    NamespaceController,
+    PodGCController,
+    PVBinderController,
+)
+from .manager import ControllerManager
+from .nodelifecycle import NodeLifecycleController
+from .workloads import (
+    DaemonSetController,
+    DeploymentController,
+    JobController,
+    ReplicaSetController,
+    StatefulSetController,
+)
+
+__all__ = [
+    "ControllerManager",
+    "DaemonSetController",
+    "DeploymentController",
+    "EndpointsController",
+    "GarbageCollector",
+    "JobController",
+    "NamespaceController",
+    "NodeLifecycleController",
+    "PVBinderController",
+    "PodGCController",
+    "ReplicaSetController",
+    "StatefulSetController",
+]

@@ -1,0 +1,100 @@
+"""Node lifecycle controller (pkg/controller/nodelifecycle/
+node_lifecycle_controller.go:261; monitorNodeHealth :761).
+
+Failure detection: each node heartbeats a Lease in the node-lease namespace
+(kubelet side); when renew_time + grace passes, the node is marked NotReady
+and the NoExecute ``unreachable`` taint is applied; pods without a matching
+toleration are evicted (the taint manager, scheduler/taint-toleration then
+keeps new pods off). Recovery removes the taint and restores Ready.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import List, Optional
+
+from ..api.types import Lease, Node, TAINT_NO_EXECUTE, Taint
+from .base import Controller
+
+NODE_LEASE_NAMESPACE = "kube-node-lease"
+TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
+TAINT_NOT_READY = "node.kubernetes.io/not-ready"
+DEFAULT_GRACE_PERIOD = 40.0  # --node-monitor-grace-period default
+
+
+class NodeLifecycleController(Controller):
+    name = "nodelifecycle"
+    watch_kinds = ("Node", "Lease")
+
+    def __init__(self, store, factory, grace_period: float = DEFAULT_GRACE_PERIOD,
+                 now_fn=time.monotonic, evict: bool = True):
+        super().__init__(store, factory)
+        self.grace_period = grace_period
+        self.now_fn = now_fn
+        self.evict = evict
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "Node":
+            return [obj.meta.name]
+        if obj.meta.namespace == NODE_LEASE_NAMESPACE:
+            return [obj.meta.name]
+        return []
+
+    def monitor_node_health(self) -> None:
+        """Periodic full sweep (monitorNodeHealth is ticker-driven, :761) —
+        lease expiry produces no watch event, so health must be polled."""
+        for name in list(self.store.nodes):
+            self.queue.add(name)
+        self.sync_once()
+
+    def _lease_of(self, node_name: str) -> Optional[Lease]:
+        return self.store.get_lease(f"{NODE_LEASE_NAMESPACE}/{node_name}")
+
+    def reconcile(self, key: str) -> None:
+        node: Optional[Node] = self.store.nodes.get(key)
+        if node is None:
+            return
+        lease = self._lease_of(key)
+        healthy = (
+            lease is not None
+            and self.now_fn() - lease.renew_time <= self.grace_period
+        )
+        if lease is None:
+            # node never heartbeat (no kubelet): leave as created
+            return
+        if healthy and not node.status.ready:
+            self._set_health(node, ready=True)
+        elif not healthy and node.status.ready:
+            self._set_health(node, ready=False)
+            if self.evict:
+                self._evict_pods(key)
+        elif not healthy and self.evict:
+            self._evict_pods(key)
+
+    def _set_health(self, node: Node, ready: bool) -> None:
+        taints = tuple(
+            t for t in node.spec.taints
+            if t.key not in (TAINT_UNREACHABLE, TAINT_NOT_READY)
+        )
+        if not ready:
+            taints = taints + (Taint(key=TAINT_UNREACHABLE, effect=TAINT_NO_EXECUTE),)
+        new = node.clone() if hasattr(node, "clone") else dataclasses.replace(node)
+        new.meta = dataclasses.replace(node.meta)
+        new.spec = dataclasses.replace(node.spec, taints=taints)
+        new.status = dataclasses.replace(node.status, ready=ready)
+        self.store.update_node(new)
+
+    def _evict_pods(self, node_name: str) -> None:
+        """NoExecute taint manager: delete pods on the node lacking an
+        unreachable/not-ready toleration (taint_manager.go)."""
+        for pod in list(self.store.pods.values()):
+            if pod.spec.node_name != node_name:
+                continue
+            tolerated = any(
+                tol.key in (TAINT_UNREACHABLE, TAINT_NOT_READY, "")
+                and tol.effect in ("", TAINT_NO_EXECUTE)
+                for tol in pod.spec.tolerations
+            )
+            if not tolerated:
+                self.store.delete_pod(pod.meta.key())

@@ -1,0 +1,271 @@
+"""Workload controllers: ReplicaSet, Deployment, StatefulSet, DaemonSet, Job
+(pkg/controller/{replicaset,deployment,statefulset,daemon,job}).
+
+Capability-level reconcilers with the reference's core semantics: selector-
+matched, controller-owned pod management; Deployment delegates to a
+ReplicaSet; StatefulSet keeps ordinal-stable names and creates in order;
+DaemonSet places one pod per eligible node (scheduler still binds it);
+Job runs pods to ``completions`` with ``parallelism`` in flight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from ..api.types import (
+    DaemonSet,
+    Deployment,
+    Job,
+    LabelSelector,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    ReplicaSet,
+    StatefulSet,
+)
+from .base import Controller
+
+
+def _instantiate(template: Pod, name: str, namespace: str,
+                 owner_kind: str, owner_name: str, extra_labels=None) -> Pod:
+    pod = template.clone()
+    pod.meta = dataclasses.replace(
+        template.meta,
+        name=name,
+        namespace=namespace,
+        labels={**template.meta.labels, **(extra_labels or {})},
+        owner_references=(OwnerReference(kind=owner_kind, name=owner_name, controller=True),),
+        resource_version=0,
+    )
+    pod.spec.node_name = ""
+    pod.status.phase = "Pending"
+    return pod
+
+
+def _owned_pods(store, namespace: str, owner_kind: str, owner_name: str) -> List[Pod]:
+    out = []
+    for pod in store.snapshot_map("Pod").values():
+        if pod.meta.namespace != namespace:
+            continue
+        ref = pod.meta.controller_of()
+        if ref is not None and ref.kind == owner_kind and ref.name == owner_name:
+            out.append(pod)
+    return out
+
+
+class ReplicaSetController(Controller):
+    """Reconcile |owned pods| to spec.replicas (replica_set.go syncReplicaSet:
+    create missing with owner refs, delete surplus; terminating pods don't
+    count toward the active set)."""
+
+    name = "replicaset"
+    watch_kinds = ("ReplicaSet", "Pod")
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "ReplicaSet":
+            return [obj.meta.key()]
+        ref = obj.meta.controller_of()
+        if ref is not None and ref.kind == "ReplicaSet":
+            return [f"{obj.meta.namespace}/{ref.name}"]
+        return []
+
+    def reconcile(self, key: str) -> None:
+        rs: Optional[ReplicaSet] = self.store.get_replica_set(key)
+        if rs is None or rs.meta.deletion_timestamp:
+            return
+        pods = [p for p in _owned_pods(self.store, rs.meta.namespace, "ReplicaSet", rs.meta.name)
+                if not p.meta.deletion_timestamp]
+        diff = rs.replicas - len(pods)
+        if diff > 0:
+            used = {p.meta.name for p in pods}
+            i = 0
+            while diff > 0:
+                name = f"{rs.meta.name}-{i}"
+                i += 1
+                if name in used:
+                    continue
+                self.store.create_pod(
+                    _instantiate(rs.template or Pod(), name, rs.meta.namespace,
+                                 "ReplicaSet", rs.meta.name)
+                )
+                diff -= 1
+        elif diff < 0:
+            # prefer deleting unscheduled, then newest (controller_utils
+            # ActivePods sort, simplified)
+            pods.sort(key=lambda p: (bool(p.spec.node_name), -p.meta.resource_version))
+            for p in pods[: -rs.replicas] if rs.replicas else pods:
+                self.store.delete_pod(p.meta.key())
+
+
+class DeploymentController(Controller):
+    """Deployment → one ReplicaSet named <deploy>-<hash> (rollouts collapse
+    to re-pointing the RS template; deployment_controller.go syncDeployment)."""
+
+    name = "deployment"
+    watch_kinds = ("Deployment",)
+
+    def reconcile(self, key: str) -> None:
+        dep: Optional[Deployment] = self.store.get_object("Deployment", key)
+        if dep is None:
+            return
+        rs_name = f"{dep.meta.name}-rs"
+        rs_key = f"{dep.meta.namespace}/{rs_name}"
+        rs = self.store.get_replica_set(rs_key)
+        if rs is None:
+            self.store.create_replica_set(ReplicaSet(
+                meta=ObjectMeta(
+                    name=rs_name, namespace=dep.meta.namespace,
+                    owner_references=(OwnerReference(kind="Deployment", name=dep.meta.name, controller=True),),
+                ),
+                selector=dep.selector,
+                replicas=dep.replicas,
+                template=dep.template,
+            ))
+        elif rs.replicas != dep.replicas or rs.template is not dep.template:
+            new_rs = dataclasses.replace(rs, replicas=dep.replicas, template=dep.template)
+            new_rs.meta = dataclasses.replace(rs.meta)
+            self.store.update_object("ReplicaSet", new_rs)
+
+
+class StatefulSetController(Controller):
+    """Ordinal-stable pods <name>-0..N-1, created in order only when the
+    previous ordinal is running (stateful_set_control.go's monotonic scale-up),
+    scaled down from the top."""
+
+    name = "statefulset"
+    watch_kinds = ("StatefulSet", "Pod")
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "StatefulSet":
+            return [obj.meta.key()]
+        ref = obj.meta.controller_of()
+        if ref is not None and ref.kind == "StatefulSet":
+            return [f"{obj.meta.namespace}/{ref.name}"]
+        return []
+
+    def reconcile(self, key: str) -> None:
+        ss: Optional[StatefulSet] = self.store.get_stateful_set(key)
+        if ss is None:
+            return
+        existing = {p.meta.name: p for p in
+                    _owned_pods(self.store, ss.meta.namespace, "StatefulSet", ss.meta.name)}
+        # scale down from the highest ordinal
+        for i in range(ss.replicas, len(existing) + ss.replicas + 1):
+            name = f"{ss.meta.name}-{i}"
+            if name in existing:
+                self.store.delete_pod(f"{ss.meta.namespace}/{name}")
+        # scale up strictly in ordinal order; stop at the first not-yet-running
+        for i in range(ss.replicas):
+            name = f"{ss.meta.name}-{i}"
+            pod = existing.get(name)
+            if pod is None:
+                self.store.create_pod(
+                    _instantiate(ss.template or Pod(), name, ss.meta.namespace,
+                                 "StatefulSet", ss.meta.name)
+                )
+                return
+            if pod.status.phase != "Running":
+                return
+
+
+def _pin_to_node(pod: Pod, node_name: str) -> Pod:
+    """Pin via required nodeAffinity on metadata.name — how the reference's
+    daemonset controller targets nodes since scheduler-managed daemon pods
+    (daemon/util/daemonset_util.go ReplaceDaemonSetPodNodeNameNodeAffinity)."""
+    from ..api.types import Affinity, NodeAffinity, NodeSelector, NodeSelectorTerm
+
+    old = pod.spec.affinity  # shared with the template: build a fresh Affinity
+    pod.spec.affinity = Affinity(
+        node_affinity=NodeAffinity(
+            required=NodeSelector(terms=(NodeSelectorTerm(match_fields_name=node_name),))
+        ),
+        pod_affinity=old.pod_affinity if old else None,
+        pod_anti_affinity=old.pod_anti_affinity if old else None,
+    )
+    return pod
+
+
+class DaemonSetController(Controller):
+    """One pod per node (daemon/daemonset.go), each pinned by a
+    metadata.name nodeAffinity term so the scheduler still places it."""
+
+    name = "daemonset"
+    watch_kinds = ("DaemonSet", "Node", "Pod")
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "DaemonSet":
+            return [obj.meta.key()]
+        if kind == "Pod":
+            ref = obj.meta.controller_of()
+            if ref is not None and ref.kind == "DaemonSet":
+                return [f"{obj.meta.namespace}/{ref.name}"]
+            return []
+        # node events touch every daemonset
+        return [ds.meta.key() for ds in self.store.snapshot_map("DaemonSet").values()]
+
+    @staticmethod
+    def _pinned(pod: Pod) -> str:
+        aff = pod.spec.affinity
+        if aff is not None and aff.node_affinity is not None and aff.node_affinity.required:
+            for term in aff.node_affinity.required.terms:
+                if term.match_fields_name is not None:
+                    return term.match_fields_name
+        return pod.spec.node_name
+
+    def reconcile(self, key: str) -> None:
+        ds: Optional[DaemonSet] = self.store.get_object("DaemonSet", key)
+        if ds is None:
+            return
+        nodes = set(self.store.snapshot_map("Node"))
+        have = {}
+        for p in _owned_pods(self.store, ds.meta.namespace, "DaemonSet", ds.meta.name):
+            have[self._pinned(p)] = p
+        for node_name in sorted(nodes - set(have)):
+            pod = _instantiate(ds.template or Pod(), f"{ds.meta.name}-{node_name}",
+                               ds.meta.namespace, "DaemonSet", ds.meta.name)
+            self.store.create_pod(_pin_to_node(pod, node_name))
+        for pinned, p in have.items():
+            if pinned not in nodes:
+                self.store.delete_pod(p.meta.key())
+
+
+class JobController(Controller):
+    """Run pods until ``completions`` succeed, at most ``parallelism`` active
+    (job/job_controller.go syncJob, capability level)."""
+
+    name = "job"
+    watch_kinds = ("Job", "Pod")
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "Job":
+            return [obj.meta.key()]
+        ref = obj.meta.controller_of()
+        if ref is not None and ref.kind == "Job":
+            return [f"{obj.meta.namespace}/{ref.name}"]
+        return []
+
+    def reconcile(self, key: str) -> None:
+        job: Optional[Job] = self.store.get_object("Job", key)
+        if job is None:
+            return
+        pods = _owned_pods(self.store, job.meta.namespace, "Job", job.meta.name)
+        succeeded = sum(1 for p in pods if p.status.phase == "Succeeded")
+        active = [p for p in pods if p.status.phase in ("Pending", "Running")]
+        if succeeded != job.succeeded:
+            new_job = dataclasses.replace(job, succeeded=succeeded)
+            new_job.meta = dataclasses.replace(job.meta)
+            self.store.update_object("Job", new_job)
+            job = new_job
+        want_active = min(job.parallelism, job.completions - succeeded)
+        existing_names = {p.meta.name for p in pods}
+        i = 0
+        while len(active) < want_active:
+            name = f"{job.meta.name}-{i}"
+            i += 1
+            if name in existing_names:
+                continue
+            pod = _instantiate(job.template or Pod(), name, job.meta.namespace,
+                               "Job", job.meta.name)
+            self.store.create_pod(pod)
+            active.append(pod)

@@ -1,0 +1,368 @@
+"""Controller manager loops: workloads, node lifecycle, GC, namespace,
+endpoints, PV binder — and the full control plane (KCM + scheduler) together."""
+
+import dataclasses
+
+from kubernetes_tpu.api.types import (
+    BINDING_IMMEDIATE,
+    DaemonSet,
+    Deployment,
+    Job,
+    LabelSelector,
+    Lease,
+    Namespace,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    ReplicaSet,
+    Service,
+    StatefulSet,
+    StorageClass,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.nodelifecycle import (
+    NODE_LEASE_NAMESPACE,
+    TAINT_UNREACHABLE,
+)
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def make_manager(store, controllers=None, now_fn=None):
+    return ControllerManager(
+        store,
+        factory=SharedInformerFactory(store),
+        controllers=controllers,
+        now_fn=now_fn or FakeClock(),
+    )
+
+
+def pod_template(labels=None):
+    pw = make_pod("template").req({"cpu": "100m"})
+    for k, v in (labels or {}).items():
+        pw.label(k, v)
+    return pw.obj()
+
+
+class TestReplicaSet:
+    def test_scale_up_creates_owned_pods(self):
+        store = ClusterStore()
+        m = make_manager(store, ["replicaset"])
+        store.create_replica_set(ReplicaSet(
+            meta=ObjectMeta(name="web"),
+            selector=LabelSelector(match_labels={"app": "web"}),
+            replicas=3,
+            template=pod_template({"app": "web"}),
+        ))
+        m.settle()
+        pods = [p for p in store.pods.values()]
+        assert len(pods) == 3
+        assert all(p.meta.controller_of().name == "web" for p in pods)
+
+    def test_scale_down_prefers_unscheduled(self):
+        store = ClusterStore()
+        m = make_manager(store, ["replicaset"])
+        store.create_replica_set(ReplicaSet(
+            meta=ObjectMeta(name="web"), replicas=3, template=pod_template()))
+        m.settle()
+        # bind two of the three
+        keys = sorted(store.pods)
+        from kubernetes_tpu.api.types import Binding
+        store.bind(Binding(pod_key=keys[0], node_name="n1"))
+        store.bind(Binding(pod_key=keys[1], node_name="n1"))
+        rs = store.get_replica_set("default/web")
+        new_rs = dataclasses.replace(rs, replicas=2)
+        new_rs.meta = dataclasses.replace(rs.meta)
+        store.update_object("ReplicaSet", new_rs)
+        m.settle()
+        remaining = list(store.pods.values())
+        assert len(remaining) == 2
+        assert all(p.spec.node_name for p in remaining)  # unscheduled one went
+
+    def test_pod_deletion_restored(self):
+        store = ClusterStore()
+        m = make_manager(store, ["replicaset"])
+        store.create_replica_set(ReplicaSet(
+            meta=ObjectMeta(name="web"), replicas=2, template=pod_template()))
+        m.settle()
+        victim = next(iter(store.pods))
+        store.delete_pod(victim)
+        m.settle()
+        assert len(store.pods) == 2
+
+
+class TestDeploymentAndFriends:
+    def test_deployment_creates_replicaset(self):
+        store = ClusterStore()
+        m = make_manager(store, ["deployment", "replicaset"])
+        store.create_object("Deployment", Deployment(
+            meta=ObjectMeta(name="api"), replicas=2, template=pod_template()))
+        m.settle()
+        assert store.get_replica_set("default/api-rs") is not None
+        assert len(store.pods) == 2
+
+    def test_deployment_scale_propagates(self):
+        store = ClusterStore()
+        m = make_manager(store, ["deployment", "replicaset"])
+        dep = Deployment(meta=ObjectMeta(name="api"), replicas=1, template=pod_template())
+        store.create_object("Deployment", dep)
+        m.settle()
+        new = dataclasses.replace(dep, replicas=4)
+        new.meta = dataclasses.replace(dep.meta)
+        store.update_object("Deployment", new)
+        m.settle()
+        assert len(store.pods) == 4
+
+    def test_statefulset_ordered_creation(self):
+        store = ClusterStore()
+        m = make_manager(store, ["statefulset"])
+        store.create_stateful_set(StatefulSet(
+            meta=ObjectMeta(name="db"), replicas=3, template=pod_template()))
+        m.settle()
+        # only db-0 until it runs
+        assert sorted(p.meta.name for p in store.pods.values()) == ["db-0"]
+        p0 = store.get_pod("default/db-0").clone()
+        p0.status.phase = "Running"
+        store.update_pod(p0)
+        m.settle()
+        assert "db-1" in {p.meta.name for p in store.pods.values()}
+
+    def test_daemonset_one_pod_per_node(self):
+        store = ClusterStore()
+        for i in range(3):
+            store.create_node(make_node(f"n{i}").obj())
+        m = make_manager(store, ["daemonset"])
+        store.create_object("DaemonSet", DaemonSet(
+            meta=ObjectMeta(name="agent"), template=pod_template()))
+        m.settle()
+        assert len(store.pods) == 3
+        store.create_node(make_node("n3").obj())
+        m.settle()
+        assert len(store.pods) == 4
+        store.delete_node("n0")
+        m.settle()
+        assert len(store.pods) == 3
+
+    def test_job_runs_to_completion(self):
+        store = ClusterStore()
+        m = make_manager(store, ["job"])
+        store.create_object("Job", Job(
+            meta=ObjectMeta(name="batch"), completions=3, parallelism=2,
+            template=pod_template()))
+        m.settle()
+        assert len(store.pods) == 2  # parallelism cap
+        for key in list(store.pods):
+            p = store.get_pod(key).clone()
+            p.status.phase = "Succeeded"
+            store.update_pod(p)
+        m.settle()
+        job = store.get_object("Job", "default/batch")
+        assert job.succeeded == 2
+        # third pod created; finish it
+        active = [p for p in store.pods.values() if p.status.phase == "Pending"]
+        assert len(active) == 1
+        p = active[0].clone()
+        p.status.phase = "Succeeded"
+        store.update_pod(p)
+        m.settle()
+        assert store.get_object("Job", "default/batch").succeeded == 3
+
+
+class TestNodeLifecycle:
+    def test_missed_heartbeats_taint_and_evict(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        m = make_manager(store, ["nodelifecycle"], now_fn=clock)
+        store.create_node(make_node("n1").obj())
+        store.create_lease(Lease(
+            meta=ObjectMeta(name="n1", namespace=NODE_LEASE_NAMESPACE),
+            renew_time=clock(),
+        ))
+        store.create_pod(make_pod("victim").node("n1").obj())
+        store.pods["default/victim"].spec.node_name = "n1"
+        m.sync_round(monitor_nodes=True)
+        assert store.nodes["n1"].status.ready
+        clock.advance(60.0)  # past 40s grace
+        m.sync_round(monitor_nodes=True)
+        node = store.nodes["n1"]
+        assert not node.status.ready
+        assert any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+        assert store.get_pod("default/victim") is None  # evicted
+
+    def test_recovery_clears_taint(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        m = make_manager(store, ["nodelifecycle"], now_fn=clock)
+        store.create_node(make_node("n1").obj())
+        lease = Lease(meta=ObjectMeta(name="n1", namespace=NODE_LEASE_NAMESPACE),
+                      renew_time=clock())
+        store.create_lease(lease)
+        clock.advance(60.0)
+        m.sync_round(monitor_nodes=True)
+        assert not store.nodes["n1"].status.ready
+        stored = store.get_lease(f"{NODE_LEASE_NAMESPACE}/n1")
+        renewed = dataclasses.replace(stored, renew_time=clock())
+        renewed.meta = dataclasses.replace(stored.meta)
+        store.update_lease(renewed, expect_rv=stored.meta.resource_version)
+        m.sync_round(monitor_nodes=True)
+        node = store.nodes["n1"]
+        assert node.status.ready and not node.spec.taints
+
+
+class TestHousekeeping:
+    def test_podgc_orphaned(self):
+        store = ClusterStore()
+        m = make_manager(store, ["podgc"])
+        store.create_node(make_node("n1").obj())
+        store.create_pod(make_pod("p").obj())
+        store.pods["default/p"].spec.node_name = "ghost-node"
+        m.settle()
+        assert store.get_pod("default/p") is None
+
+    def test_gc_cascade_on_owner_delete(self):
+        store = ClusterStore()
+        m = make_manager(store, ["replicaset", "garbagecollector"])
+        store.create_replica_set(ReplicaSet(
+            meta=ObjectMeta(name="web"), replicas=2, template=pod_template()))
+        m.settle()
+        assert len(store.pods) == 2
+        store.delete_object("ReplicaSet", "default/web")
+        m.settle()
+        assert len(store.pods) == 0
+
+    def test_namespace_deletion_cascades(self):
+        store = ClusterStore()
+        m = make_manager(store, ["namespace"])
+        store.create_namespace(Namespace(meta=ObjectMeta(name="doomed")))
+        store.create_pod(make_pod("p", namespace="doomed").obj())
+        store.create_service(Service(meta=ObjectMeta(name="s", namespace="doomed")))
+        ns = store.namespaces["doomed"]
+        ns.meta.deletion_timestamp = 1.0
+        store._notify("Namespace", "MODIFIED", ns, ns)
+        m.settle()
+        assert store.get_pod("doomed/p") is None
+        assert "doomed/s" not in store.services
+        assert "doomed" not in store.namespaces
+
+    def test_endpoints_track_running_pods(self):
+        store = ClusterStore()
+        m = make_manager(store, ["endpoints"])
+        store.create_service(Service(meta=ObjectMeta(name="svc"), selector={"app": "web"}))
+        p = make_pod("p1").label("app", "web").obj()
+        store.create_pod(p)
+        m.settle()
+        eps = store.get_object("Endpoints", "default/svc")
+        assert eps is not None and eps.addresses == ()  # pod not Running
+        bound = store.get_pod("default/p1").clone()
+        bound.status.phase = "Running"
+        bound.spec.node_name = "n1"
+        store.update_pod(bound)
+        m.settle()
+        eps = store.get_object("Endpoints", "default/svc")
+        assert [a.pod_key for a in eps.addresses] == ["default/p1"]
+
+    def test_pv_binder_immediate(self):
+        store = ClusterStore()
+        m = make_manager(store, ["pvbinder"])
+        store.create_storage_class(StorageClass(
+            meta=ObjectMeta(name="fast"), volume_binding_mode=BINDING_IMMEDIATE))
+        store.create_pv(PersistentVolume(
+            meta=ObjectMeta(name="pv-big"), storage_class="fast", capacity_bytes=100))
+        store.create_pv(PersistentVolume(
+            meta=ObjectMeta(name="pv-small"), storage_class="fast", capacity_bytes=10))
+        store.create_pvc(PersistentVolumeClaim(
+            meta=ObjectMeta(name="claim"), storage_class="fast", requested_bytes=5))
+        m.settle()
+        pvc = store.get_pvc("default/claim")
+        assert pvc.bound_pv == "pv-small"  # smallest fit
+
+
+class TestControlPlaneTogether:
+    def test_deployment_to_bound_pods(self):
+        """Deployment → RS → pods → scheduler binds them: the full loop."""
+        store = ClusterStore()
+        clock = FakeClock()
+        m = make_manager(store, ["deployment", "replicaset"], now_fn=clock)
+        sched = Scheduler(store, now_fn=clock)
+        for i in range(4):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        store.create_object("Deployment", Deployment(
+            meta=ObjectMeta(name="api"), replicas=6, template=pod_template()))
+        m.settle()
+        sched.run_until_settled()
+        bound = [p for p in store.pods.values() if p.spec.node_name]
+        assert len(bound) == 6
+
+
+class TestReviewRegressions:
+    def test_endpoints_drop_pod_that_stops_matching(self):
+        """A pod whose labels stop matching must leave the Endpoints."""
+        store = ClusterStore()
+        m = make_manager(store, ["endpoints"])
+        store.create_service(Service(meta=ObjectMeta(name="svc"), selector={"app": "web"}))
+        p = make_pod("p1").label("app", "web").obj()
+        p.status.phase = "Running"
+        store.create_pod(p)
+        m.settle()
+        eps = store.get_object("Endpoints", "default/svc")
+        assert [a.pod_key for a in eps.addresses] == ["default/p1"]
+        relabeled = store.get_pod("default/p1").clone()
+        relabeled.meta.labels = {"app": "other"}
+        store.update_pod(relabeled)
+        m.settle()
+        eps = store.get_object("Endpoints", "default/svc")
+        assert eps.addresses == ()
+
+    def test_daemonset_recreates_deleted_pod(self):
+        store = ClusterStore()
+        store.create_node(make_node("n0").obj())
+        m = make_manager(store, ["daemonset"])
+        store.create_object("DaemonSet", DaemonSet(
+            meta=ObjectMeta(name="agent"), template=pod_template()))
+        m.settle()
+        assert len(store.pods) == 1
+        store.delete_pod(next(iter(store.pods)))
+        m.settle()  # pod event alone must re-level the daemonset
+        assert len(store.pods) == 1
+
+    def test_journal_order_matches_store_state_under_concurrency(self):
+        """ADDED/DELETED for one key must appear in mutation order even with
+        racing writers (journal append is inside the mutator's critical
+        section)."""
+        import threading
+
+        store = ClusterStore()
+        errors = []
+
+        def churn(idx):
+            try:
+                for i in range(200):
+                    key = f"p-{idx}-{i % 5}"
+                    store.create_pod(make_pod(key).obj())
+                    store.delete_pod(f"default/{key}")
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn, args=(t,)) for t in range(4)]
+        w = store.watch("Pod", since=0)
+        store._journal_capacity = 100000
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # replay: per key the stream must strictly alternate ADDED/DELETED
+        state = {}
+        for ev in w.drain():
+            key = ev.object.meta.key()
+            if ev.type == "ADDED":
+                assert state.get(key) != "present", f"double-add {key}"
+                state[key] = "present"
+            elif ev.type == "DELETED":
+                assert state.get(key) == "present", f"delete-before-add {key}"
+                state[key] = "absent"
+        assert all(v == "absent" for v in state.values())
