@@ -220,6 +220,8 @@ class ClusterStore:
 
     def create_node(self, node: Node) -> None:
         with self._lock:
+            if node.meta.name in self.nodes:
+                raise Conflict(f"node {node.meta.name} exists")
             self._bump(node)
             self.nodes[node.meta.name] = node
             self._journal_event("Node", ADDED, None, node)
@@ -247,6 +249,8 @@ class ClusterStore:
 
     def create_pod(self, pod: Pod) -> None:
         with self._lock:
+            if pod.key() in self.pods:
+                raise Conflict(f"pod {pod.key()} exists")
             self._bump(pod)
             self.pods[pod.key()] = pod
             self._journal_event("Pod", ADDED, None, pod)

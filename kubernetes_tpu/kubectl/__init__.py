@@ -1,0 +1,7 @@
+"""CLI (L5): the kubectl command surface over the in-process store
+(staging/src/k8s.io/kubectl/pkg/cmd/cmd.go:95 command tree).
+"""
+
+from .cli import kubectl
+
+__all__ = ["kubectl"]

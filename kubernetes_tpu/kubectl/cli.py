@@ -1,0 +1,248 @@
+"""kubectl over the ClusterStore (kubectl/pkg/cmd/cmd.go:95,250).
+
+Verbs: get, describe, create -f, apply -f, delete, scale, cordon/uncordon,
+taint. Input documents are YAML with the familiar shapes; the translator in
+``objects.py`` maps them onto this framework's API dataclasses.
+
+``kubectl(store, argv)`` returns the rendered output string — the CLI main
+wraps it with argv/stdout, tests call it directly.
+"""
+
+from __future__ import annotations
+
+import shlex
+from typing import List, Optional
+
+from ..apiserver.store import ClusterStore, NotFound
+from . import objects
+
+GETTABLE = {
+    "pods": "Pod", "pod": "Pod", "po": "Pod",
+    "nodes": "Node", "node": "Node", "no": "Node",
+    "services": "Service", "service": "Service", "svc": "Service",
+    "deployments": "Deployment", "deployment": "Deployment", "deploy": "Deployment",
+    "replicasets": "ReplicaSet", "replicaset": "ReplicaSet", "rs": "ReplicaSet",
+    "statefulsets": "StatefulSet", "statefulset": "StatefulSet", "sts": "StatefulSet",
+    "daemonsets": "DaemonSet", "daemonset": "DaemonSet", "ds": "DaemonSet",
+    "jobs": "Job", "job": "Job",
+    "namespaces": "Namespace", "namespace": "Namespace", "ns": "Namespace",
+    "endpoints": "Endpoints", "ep": "Endpoints",
+    "persistentvolumes": "PersistentVolume", "pv": "PersistentVolume",
+    "persistentvolumeclaims": "PersistentVolumeClaim", "pvc": "PersistentVolumeClaim",
+    "storageclasses": "StorageClass", "sc": "StorageClass",
+    "leases": "Lease", "lease": "Lease",
+    "priorityclasses": "PriorityClass", "pc": "PriorityClass",
+}
+
+
+def kubectl(store: ClusterStore, argv) -> str:
+    if isinstance(argv, str):
+        argv = shlex.split(argv)
+    if not argv:
+        return _usage()
+    verb, *rest = argv
+    handlers = {
+        "get": _get,
+        "describe": _describe,
+        "create": _create_or_apply,
+        "apply": _create_or_apply,
+        "delete": _delete,
+        "scale": _scale,
+        "cordon": _cordon,
+        "uncordon": _uncordon,
+    }
+    h = handlers.get(verb)
+    if h is None:
+        return _usage()
+    return h(store, rest, verb=verb)
+
+
+def _usage() -> str:
+    return ("usage: kubectl get|describe|create|apply|delete|scale|"
+            "cordon|uncordon ...")
+
+
+def _namespace(args: List[str]) -> str:
+    for i, a in enumerate(args):
+        if a in ("-n", "--namespace") and i + 1 < len(args):
+            return args[i + 1]
+        if a.startswith("--namespace=") or a.startswith("-n="):
+            return a.split("=", 1)[1]
+    return "default"
+
+
+def _positional(args: List[str]) -> List[str]:
+    out = []
+    skip = False
+    for a in args:
+        if skip:
+            skip = False
+            continue
+        if a in ("-n", "--namespace", "-f", "--filename", "--replicas"):
+            skip = True
+            continue
+        if a.startswith("-"):
+            continue
+        out.append(a)
+    return out
+
+
+def _get(store: ClusterStore, args: List[str], verb="get") -> str:
+    pos = _positional(args)
+    if not pos:
+        return "error: resource type required"
+    kind = GETTABLE.get(pos[0])
+    if kind is None:
+        return f"error: unknown resource type {pos[0]!r}"
+    ns = _namespace(args)
+    objs, _rv = store.list_objects(kind)
+    if kind not in ClusterStore.CLUSTER_SCOPED_KINDS:
+        objs = [o for o in objs if o.meta.namespace == ns]
+    if len(pos) > 1:
+        objs = [o for o in objs if o.meta.name == pos[1]]
+        if not objs:
+            return f'Error from server (NotFound): {pos[0]} "{pos[1]}" not found'
+    rows = [objects.columns_for(kind, o, store) for o in sorted(objs, key=lambda o: o.meta.name)]
+    header = objects.header_for(kind)
+    return _tabulate([header] + rows)
+
+
+def _tabulate(rows: List[List[str]]) -> str:
+    if len(rows) == 1:
+        return "No resources found."
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(rows[0]))]
+    return "\n".join(
+        "   ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+        for r in rows
+    )
+
+
+def _describe(store: ClusterStore, args: List[str], verb="describe") -> str:
+    pos = _positional(args)
+    if len(pos) < 2:
+        return "error: describe needs TYPE NAME"
+    kind = GETTABLE.get(pos[0])
+    if kind is None:
+        return f"error: unknown resource type {pos[0]!r}"
+    ns = _namespace(args)
+    key = pos[1] if kind in ClusterStore.CLUSTER_SCOPED_KINDS else f"{ns}/{pos[1]}"
+    obj = store.get_pod(key) if kind == "Pod" else store.get_object(kind, key)
+    if obj is None:
+        return f'Error from server (NotFound): {pos[0]} "{pos[1]}" not found'
+    return objects.describe(kind, obj, store)
+
+
+def _create_or_apply(store: ClusterStore, args: List[str], verb="create") -> str:
+    filename: Optional[str] = None
+    for i, a in enumerate(args):
+        if a in ("-f", "--filename") and i + 1 < len(args):
+            filename = args[i + 1]
+    if filename is None:
+        return f"error: {verb} requires -f FILENAME"
+    import yaml
+
+    with open(filename) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    out = []
+    for doc in docs:
+        kind, obj = objects.from_manifest(doc)
+        key = store._key_of(kind, obj)
+        existing = store.get_pod(key) if kind == "Pod" else store.get_object(kind, key)
+        exists = existing is not None
+        if exists and verb == "apply":
+            obj.meta.resource_version = 0
+            if kind == "Pod":
+                # server-side-apply-ish: the manifest does not own scheduling
+                # state — keep the binding and phase unless it pins a node
+                if not obj.spec.node_name:
+                    obj.spec.node_name = existing.spec.node_name
+                    obj.status = existing.clone().status
+                store.update_pod(obj)
+            else:
+                store.update_object(kind, obj)
+            out.append(f"{kind.lower()}/{obj.meta.name} configured")
+        elif exists:
+            out.append(f'Error from server (AlreadyExists): {kind.lower()} "{obj.meta.name}" already exists')
+        else:
+            if kind == "Pod":
+                store.create_pod(obj)
+            elif kind == "Node":
+                store.create_node(obj)
+            else:
+                store.create_object(kind, obj)
+            out.append(f"{kind.lower()}/{obj.meta.name} created")
+    return "\n".join(out)
+
+
+def _delete(store: ClusterStore, args: List[str], verb="delete") -> str:
+    pos = _positional(args)
+    if len(pos) < 2:
+        return "error: delete needs TYPE NAME"
+    kind = GETTABLE.get(pos[0])
+    if kind is None:
+        return f"error: unknown resource type {pos[0]!r}"
+    ns = _namespace(args)
+    key = pos[1] if kind in ClusterStore.CLUSTER_SCOPED_KINDS else f"{ns}/{pos[1]}"
+    if kind == "Pod":
+        if store.get_pod(key) is None:
+            return f'Error from server (NotFound): pods "{pos[1]}" not found'
+        store.delete_pod(key)
+    elif kind == "Node":
+        if key not in store.nodes:
+            return f'Error from server (NotFound): nodes "{pos[1]}" not found'
+        store.delete_node(key)
+    else:
+        if store.get_object(kind, key) is None:
+            return f'Error from server (NotFound): {pos[0]} "{pos[1]}" not found'
+        store.delete_object(kind, key)
+    return f'{kind.lower()} "{pos[1]}" deleted'
+
+
+def _scale(store: ClusterStore, args: List[str], verb="scale") -> str:
+    import dataclasses
+
+    replicas: Optional[int] = None
+    for i, a in enumerate(args):
+        if a == "--replicas" and i + 1 < len(args):
+            replicas = int(args[i + 1])
+        elif a.startswith("--replicas="):
+            replicas = int(a.split("=", 1)[1])
+    pos = _positional(args)
+    if replicas is None or len(pos) < 2:
+        return "error: scale needs TYPE NAME --replicas=N"
+    kind = GETTABLE.get(pos[0])
+    if kind not in ("Deployment", "ReplicaSet", "StatefulSet"):
+        return f"error: cannot scale {pos[0]}"
+    key = f"{_namespace(args)}/{pos[1]}"
+    obj = store.get_object(kind, key)
+    if obj is None:
+        return f'Error from server (NotFound): {pos[0]} "{pos[1]}" not found'
+    new = dataclasses.replace(obj, replicas=replicas)
+    new.meta = dataclasses.replace(obj.meta)
+    store.update_object(kind, new)
+    return f"{kind.lower()}/{pos[1]} scaled"
+
+
+def _set_unschedulable(store: ClusterStore, args: List[str], value: bool, verb: str) -> str:
+    import dataclasses
+
+    pos = _positional(args)
+    if not pos:
+        return f"error: {verb} needs NODE"
+    node = store.nodes.get(pos[0])
+    if node is None:
+        return f'Error from server (NotFound): nodes "{pos[0]}" not found'
+    new = dataclasses.replace(node)
+    new.meta = dataclasses.replace(node.meta)
+    new.spec = dataclasses.replace(node.spec, unschedulable=value)
+    store.update_node(new)
+    word = "cordoned" if value else "uncordoned"
+    return f"node/{pos[0]} {word}"
+
+
+def _cordon(store, args, verb="cordon"):
+    return _set_unschedulable(store, args, True, verb)
+
+
+def _uncordon(store, args, verb="uncordon"):
+    return _set_unschedulable(store, args, False, verb)

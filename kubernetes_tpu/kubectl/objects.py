@@ -1,0 +1,245 @@
+"""Manifest translation + printers for kubectl (the scheme/codec +
+cli-runtime printers role).
+
+``from_manifest`` accepts the familiar YAML shapes (apiVersion/kind/metadata/
+spec) and produces this framework's dataclasses; printers render the standard
+get columns and describe blocks.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..api.resource import parse_quantity
+from ..api.types import (
+    DaemonSet,
+    Deployment,
+    Job,
+    LabelSelector,
+    Requirement,
+    Namespace,
+    Node,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+    PriorityClass,
+    ReplicaSet,
+    Service,
+    StatefulSet,
+    StorageClass,
+    Toleration,
+)
+from ..api.wrappers import make_node, make_pod
+
+
+def _meta(doc: dict) -> ObjectMeta:
+    md = doc.get("metadata", {}) or {}
+    return ObjectMeta(
+        name=md.get("name", ""),
+        namespace=md.get("namespace", "default"),
+        labels=dict(md.get("labels", {}) or {}),
+        annotations=dict(md.get("annotations", {}) or {}),
+    )
+
+
+def _pod_from_spec(name: str, namespace: str, md: dict, spec: dict) -> Pod:
+    pw = make_pod(name, namespace)
+    for k, v in (md.get("labels") or {}).items():
+        pw.label(k, v)
+    for c in spec.get("containers", []) or []:
+        requests = ((c.get("resources") or {}).get("requests")) or {}
+        pw.container(c.get("image", ""), requests=requests or None)
+    if spec.get("nodeName"):
+        pw.node(spec["nodeName"])
+    if spec.get("priority") is not None:
+        pw.priority(int(spec["priority"]))
+    if spec.get("schedulerName"):
+        pw.scheduler_name(spec["schedulerName"])
+    if spec.get("nodeSelector"):
+        pw.node_selector(dict(spec["nodeSelector"]))
+    pod = pw.obj()
+    pod.meta.annotations = dict(md.get("annotations", {}) or {})
+    tolerations = []
+    for t in spec.get("tolerations", []) or []:
+        tolerations.append(Toleration(
+            key=t.get("key", ""), operator=t.get("operator", "Equal"),
+            value=t.get("value", ""), effect=t.get("effect", ""),
+        ))
+    if tolerations:
+        pod.spec.tolerations = tuple(tolerations)
+    return pod
+
+
+def _selector(doc: dict) -> LabelSelector:
+    sel = doc.get("selector") or {}
+    if "matchLabels" in sel or "matchExpressions" in sel:
+        exprs = tuple(
+            Requirement(
+                key=e.get("key", ""),
+                operator=e.get("operator", "In"),
+                values=tuple(e.get("values", []) or ()),
+            )
+            for e in (sel.get("matchExpressions") or [])
+        )
+        return LabelSelector(
+            match_labels=dict(sel.get("matchLabels", {}) or {}),
+            match_expressions=exprs,
+        )
+    return LabelSelector(match_labels=dict(sel))
+
+
+def _template(doc: dict, meta: ObjectMeta) -> Pod:
+    tpl = doc.get("template", {}) or {}
+    return _pod_from_spec(
+        "template", meta.namespace, tpl.get("metadata", {}) or {}, tpl.get("spec", {}) or {}
+    )
+
+
+def from_manifest(doc: dict) -> Tuple[str, object]:
+    kind = doc.get("kind", "")
+    meta = _meta(doc)
+    spec = doc.get("spec", {}) or {}
+    if kind == "Pod":
+        return kind, _pod_from_spec(meta.name, meta.namespace, doc.get("metadata", {}) or {}, spec)
+    if kind == "Node":
+        nw = make_node(meta.name)
+        for k, v in meta.labels.items():
+            nw.label(k, v)
+        cap = (doc.get("status", {}) or {}).get("capacity") or spec.get("capacity") or {}
+        if cap:
+            nw.capacity(dict(cap))
+        if spec.get("unschedulable"):
+            nw.unschedulable()
+        for t in spec.get("taints", []) or []:
+            nw.taint(t.get("key", ""), t.get("value", ""), t.get("effect", "NoSchedule"))
+        return kind, nw.obj()
+    if kind == "Service":
+        return kind, Service(meta=meta, selector=dict(spec.get("selector", {}) or {}))
+    if kind == "Deployment":
+        return kind, Deployment(meta=meta, selector=_selector(spec),
+                                replicas=int(spec.get("replicas", 1)),
+                                template=_template(spec, meta))
+    if kind == "ReplicaSet":
+        return kind, ReplicaSet(meta=meta, selector=_selector(spec),
+                                replicas=int(spec.get("replicas", 1)),
+                                template=_template(spec, meta))
+    if kind == "StatefulSet":
+        return kind, StatefulSet(meta=meta, selector=_selector(spec),
+                                 replicas=int(spec.get("replicas", 1)),
+                                 template=_template(spec, meta))
+    if kind == "DaemonSet":
+        return kind, DaemonSet(meta=meta, selector=_selector(spec),
+                               template=_template(spec, meta))
+    if kind == "Job":
+        return kind, Job(meta=meta, completions=int(spec.get("completions", 1)),
+                         parallelism=int(spec.get("parallelism", 1)),
+                         template=_template(spec, meta))
+    if kind == "Namespace":
+        return kind, Namespace(meta=meta)
+    if kind == "PriorityClass":
+        return kind, PriorityClass(meta=meta, value=int(doc.get("value", 0)))
+    if kind == "StorageClass":
+        return kind, StorageClass(
+            meta=meta, provisioner=doc.get("provisioner", ""),
+            volume_binding_mode=doc.get("volumeBindingMode", "Immediate"))
+    if kind == "PersistentVolume":
+        cap = (spec.get("capacity") or {}).get("storage", 0)
+        return kind, PersistentVolume(
+            meta=meta, capacity_bytes=int(parse_quantity(cap)),
+            storage_class=spec.get("storageClassName", ""))
+    if kind == "PersistentVolumeClaim":
+        req = (((spec.get("resources") or {}).get("requests")) or {}).get("storage", 0)
+        return kind, PersistentVolumeClaim(
+            meta=meta, storage_class=spec.get("storageClassName", ""),
+            requested_bytes=int(parse_quantity(req)))
+    raise ValueError(f"unsupported manifest kind {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# printers
+
+HEADERS: Dict[str, List[str]] = {
+    "Pod": ["NAME", "STATUS", "NODE"],
+    "Node": ["NAME", "STATUS", "TAINTS"],
+    "Service": ["NAME", "SELECTOR"],
+    "Deployment": ["NAME", "REPLICAS"],
+    "ReplicaSet": ["NAME", "REPLICAS"],
+    "StatefulSet": ["NAME", "REPLICAS"],
+    "DaemonSet": ["NAME"],
+    "Job": ["NAME", "COMPLETIONS"],
+    "Namespace": ["NAME", "STATUS"],
+    "Endpoints": ["NAME", "ENDPOINTS"],
+    "PersistentVolume": ["NAME", "CLAIM", "STORAGECLASS"],
+    "PersistentVolumeClaim": ["NAME", "VOLUME", "STORAGECLASS"],
+    "StorageClass": ["NAME", "BINDINGMODE"],
+    "Lease": ["NAME", "HOLDER"],
+    "PriorityClass": ["NAME", "VALUE"],
+}
+
+
+def header_for(kind: str) -> List[str]:
+    return HEADERS.get(kind, ["NAME"])
+
+
+def columns_for(kind: str, obj, store) -> List[str]:
+    if kind == "Pod":
+        return [obj.meta.name, obj.status.phase, obj.spec.node_name or "<none>"]
+    if kind == "Node":
+        status = "Ready" if obj.status.ready else "NotReady"
+        if obj.spec.unschedulable:
+            status += ",SchedulingDisabled"
+        taints = ",".join(f"{t.key}:{t.effect}" for t in obj.spec.taints) or "<none>"
+        return [obj.meta.name, status, taints]
+    if kind == "Service":
+        sel = ",".join(f"{k}={v}" for k, v in sorted(obj.selector.items())) or "<none>"
+        return [obj.meta.name, sel]
+    if kind in ("Deployment", "ReplicaSet", "StatefulSet"):
+        return [obj.meta.name, str(obj.replicas)]
+    if kind == "Job":
+        return [obj.meta.name, f"{obj.succeeded}/{obj.completions}"]
+    if kind == "Namespace":
+        return [obj.meta.name, "Terminating" if obj.meta.deletion_timestamp else "Active"]
+    if kind == "Endpoints":
+        return [obj.meta.name, ",".join(a.pod_key for a in obj.addresses) or "<none>"]
+    if kind == "PersistentVolume":
+        return [obj.meta.name, obj.bound_pvc or "<none>", obj.storage_class]
+    if kind == "PersistentVolumeClaim":
+        return [obj.meta.name, obj.bound_pv or "<none>", obj.storage_class]
+    if kind == "StorageClass":
+        return [obj.meta.name, obj.volume_binding_mode]
+    if kind == "Lease":
+        return [obj.meta.name, obj.holder_identity]
+    if kind == "PriorityClass":
+        return [obj.meta.name, str(obj.value)]
+    return [obj.meta.name]
+
+
+def describe(kind: str, obj, store) -> str:
+    lines = [f"Name:         {obj.meta.name}"]
+    if kind not in ("Node", "Namespace", "PersistentVolume", "StorageClass", "PriorityClass"):
+        lines.append(f"Namespace:    {obj.meta.namespace}")
+    if obj.meta.labels:
+        lines.append("Labels:       " + ",".join(f"{k}={v}" for k, v in sorted(obj.meta.labels.items())))
+    if kind == "Pod":
+        lines.append(f"Status:       {obj.status.phase}")
+        lines.append(f"Node:         {obj.spec.node_name or '<none>'}")
+        if obj.status.nominated_node_name:
+            lines.append(f"NominatedNodeName: {obj.status.nominated_node_name}")
+        req = obj.spec.requests if hasattr(obj.spec, "requests") else {}
+        if req:
+            lines.append(f"Requests:     {req}")
+    elif kind == "Node":
+        lines.append(f"Unschedulable: {obj.spec.unschedulable}")
+        lines.append(f"Ready:        {obj.status.ready}")
+        for t in obj.spec.taints:
+            lines.append(f"Taint:        {t.key}={t.value}:{t.effect}")
+        lines.append(f"Capacity:     {obj.status.capacity}")
+        pods = [p for p in store.snapshot_map("Pod").values()
+                if p.spec.node_name == obj.meta.name]
+        lines.append(f"Pods:         {len(pods)}")
+    elif kind in ("Deployment", "ReplicaSet", "StatefulSet"):
+        lines.append(f"Replicas:     {obj.replicas}")
+    elif kind == "Job":
+        lines.append(f"Completions:  {obj.succeeded}/{obj.completions}")
+    return "\n".join(lines)

@@ -1,0 +1,150 @@
+"""Hollow kubelet (pkg/kubemark/hollow_kubelet.go:65,95 + the kubelet
+control loop shape of pkg/kubelet/kubelet.go:1405 Run / :1987 syncLoop).
+
+Lifecycle per sync:
+- register: create/refresh the Node object (kubelet_node_status.go)
+- heartbeat: renew the node Lease (component-helpers lease controller) and
+  the NodeStatus every status period
+- syncLoop: pods bound to this node transition Pending → Running after a
+  configurable startup delay; pods annotated ``kubelet/terminates-after``
+  complete to Succeeded once run that long; deleted pods vanish immediately
+  (no graceful-termination window in the hollow runtime)
+- admission: pods bound beyond the node's ``pods`` allocatable are rejected
+  Failed, newest first — the hollow stand-in for eviction_manager.go
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Optional
+
+from ..api.types import Lease, Node, ObjectMeta, Pod
+from ..apiserver.store import ClusterStore, Conflict, NotFound
+from ..controllers.nodelifecycle import NODE_LEASE_NAMESPACE
+
+TERMINATES_AFTER_ANNOTATION = "kubelet/terminates-after"
+DEFAULT_LEASE_DURATION = 40.0
+DEFAULT_STARTUP_DELAY = 0.0
+
+
+class HollowKubelet:
+    def __init__(self, store: ClusterStore, node: Node,
+                 now_fn=time.monotonic,
+                 startup_delay: float = DEFAULT_STARTUP_DELAY,
+                 lease_duration: float = DEFAULT_LEASE_DURATION):
+        self.store = store
+        self.node_name = node.name()
+        self._node_template = node
+        self.now_fn = now_fn
+        self.startup_delay = startup_delay
+        self.lease_duration = lease_duration
+        self._started_at: Dict[str, float] = {}  # pod key → Running since
+        self.registered = False
+
+    # ------------------------------------------------------------ registration
+
+    def register(self) -> None:
+        """Create the Node object (kubelet_node_status.go registerWithAPIServer)."""
+        try:
+            self.store.create_node(self._node_template)
+        except Conflict:
+            pass
+        self.registered = True
+        self.heartbeat()
+
+    # ------------------------------------------------------------ heartbeats
+
+    @property
+    def _lease_key(self) -> str:
+        return f"{NODE_LEASE_NAMESPACE}/{self.node_name}"
+
+    def heartbeat(self) -> None:
+        """Renew the node Lease (the cheap 10s heartbeat the nodelifecycle
+        controller watches; NodeStatus stays on its slower period)."""
+        now = self.now_fn()
+        lease = self.store.get_lease(self._lease_key)
+        if lease is None:
+            self.store.create_lease(Lease(
+                meta=ObjectMeta(name=self.node_name, namespace=NODE_LEASE_NAMESPACE),
+                holder_identity=self.node_name,
+                lease_duration_seconds=self.lease_duration,
+                acquire_time=now,
+                renew_time=now,
+            ))
+            return
+        new = dataclasses.replace(lease, renew_time=now)
+        new.meta = dataclasses.replace(lease.meta)
+        try:
+            self.store.update_lease(new, expect_rv=lease.meta.resource_version)
+        except (Conflict, NotFound):
+            pass  # raced with another writer; next beat wins
+
+    # ------------------------------------------------------------ syncLoop
+
+    def _my_pods(self):
+        return [p for p in self.store.snapshot_map("Pod").values()
+                if p.spec.node_name == self.node_name]
+
+    def _allowed_pods(self) -> int:
+        node = self.store.nodes.get(self.node_name)
+        if node is None:
+            return 0
+        return int(node.status.allocatable.get("pods", 0) or 0)
+
+    def sync(self) -> int:
+        """One syncLoopIteration over this node's pods (kubelet.go:2061);
+        returns the number of pod status transitions written."""
+        if not self.registered:
+            self.register()
+        now = self.now_fn()
+        transitions = 0
+        my_pods = self._my_pods()
+        # admission: the pods-capacity over-commit rejects newest first
+        # (eviction_manager.go stand-in; scheduler normally prevents this)
+        allowed = self._allowed_pods()
+        if allowed and len([p for p in my_pods if p.status.phase in ("Pending", "Running")]) > allowed:
+            active = sorted(
+                (p for p in my_pods if p.status.phase in ("Pending", "Running")),
+                key=lambda p: p.meta.resource_version,
+            )
+            for pod in active[allowed:]:
+                self._set_phase(pod, "Failed")
+                transitions += 1
+            my_pods = self._my_pods()
+        for pod in my_pods:
+            key = pod.meta.key()
+            if pod.status.phase == "Pending":
+                started = self._started_at.setdefault(key, now)
+                if now - started >= self.startup_delay:
+                    self._set_phase(pod, "Running", start_time=now)
+                    transitions += 1
+            elif pod.status.phase == "Running":
+                self._started_at.setdefault(key, now)
+                ttl = pod.meta.annotations.get(TERMINATES_AFTER_ANNOTATION)
+                if ttl is not None and now - self._started_at[key] >= float(ttl):
+                    self._set_phase(pod, "Succeeded")
+                    transitions += 1
+        # forget state for pods that left the node
+        live = {p.meta.key() for p in self._my_pods()}
+        for key in list(self._started_at):
+            if key not in live:
+                del self._started_at[key]
+        return transitions
+
+    def _set_phase(self, pod: Pod, phase: str, start_time: Optional[float] = None) -> None:
+        new = pod.clone()
+        new.status.phase = phase
+        if start_time is not None and not new.status.start_time:
+            new.status.start_time = start_time
+        try:
+            self.store.update_pod(new)
+        except NotFound:
+            pass  # deleted mid-sync
+
+    def run_once(self) -> int:
+        """register + heartbeat + sync — one full kubelet tick."""
+        if not self.registered:
+            self.register()
+        self.heartbeat()
+        return self.sync()

@@ -1,0 +1,12 @@
+"""Data-plane proxy (L4d): the kube-proxy rules compiler.
+
+The reference's proxier (pkg/proxy/iptables/proxier.go:809 syncProxyRules)
+turns Services+Endpoints into kernel rules. Without a kernel to program,
+the same computation is kept: an incrementally-synced rule table mapping
+each service to its ready backends with round-robin selection — the part of
+kube-proxy that is logic rather than netlink.
+"""
+
+from .proxier import Proxier, ServiceRules
+
+__all__ = ["Proxier", "ServiceRules"]

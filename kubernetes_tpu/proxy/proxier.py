@@ -1,0 +1,93 @@
+"""Proxier: Services + Endpoints → per-service backend rules
+(pkg/proxy/iptables/proxier.go:809 syncProxyRules, minus netfilter).
+
+Tracks pending service/endpoints changes like the reference's
+ServiceChangeTracker/EndpointChangeTracker and rebuilds only affected
+services on sync. ``route()`` is the dataplane stand-in: deterministic
+round-robin over ready backends (the iptables statistic-mode jump chain).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class ServiceRules:
+    service_key: str
+    backends: Tuple[str, ...] = ()  # pod keys, stable order
+    _rr: itertools.cycle = field(default=None, repr=False, compare=False)
+
+    def __post_init__(self):
+        self._rr = itertools.cycle(self.backends) if self.backends else None
+
+
+class Proxier:
+    def __init__(self, store, factory=None):
+        self.store = store
+        self._lock = threading.Lock()
+        self.rules: Dict[str, ServiceRules] = {}
+        self._dirty: set = set()
+        self.full_syncs = 0
+        self.partial_syncs = 0
+        if factory is not None:
+            factory.informer_for("Service").add_event_handler(self._on_change)
+            factory.informer_for("Endpoints").add_event_handler(self._on_change)
+
+    # -- change tracking (ServiceChangeTracker analog)
+
+    def _on_change(self, event, old, new) -> None:
+        obj = new if new is not None else old
+        with self._lock:
+            self._dirty.add(obj.meta.key())
+
+    def mark_dirty(self, service_key: str) -> None:
+        with self._lock:
+            self._dirty.add(service_key)
+
+    # -- sync
+
+    def sync_proxy_rules(self, full: bool = False) -> int:
+        """Rebuild rules for dirty services (or all when ``full``); returns
+        services rebuilt (proxier.go:809's per-change rebuild)."""
+        with self._lock:
+            if full:
+                # union with known rules so deleted services get swept too
+                keys = set(self.store.snapshot_map("Service")) | set(self.rules)
+                self.full_syncs += 1
+            else:
+                keys = self._dirty
+                self.partial_syncs += 1
+            self._dirty = set()
+        services = self.store.snapshot_map("Service")
+        endpoints = self.store.snapshot_map("Endpoints")
+        n = 0
+        for key in keys:
+            n += 1
+            with self._lock:
+                if key not in services:
+                    self.rules.pop(key, None)
+                    continue
+                eps = endpoints.get(key)
+                backends = tuple(a.pod_key for a in eps.addresses) if eps else ()
+                self.rules[key] = ServiceRules(service_key=key, backends=backends)
+        return n
+
+    # -- dataplane stand-in
+
+    def route(self, service_key: str) -> Optional[str]:
+        """Pick the next backend pod for a service (round-robin — the
+        iptables probability-chain equivalent); None when no backends."""
+        with self._lock:
+            r = self.rules.get(service_key)
+            if r is None or r._rr is None:
+                return None
+            return next(r._rr)
+
+    def backends(self, service_key: str) -> List[str]:
+        with self._lock:
+            r = self.rules.get(service_key)
+            return list(r.backends) if r else []

@@ -1,0 +1,361 @@
+"""Hollow kubelet / kubemark, checkpoint manager, kube-proxy rules compiler,
+kubectl CLI."""
+
+import pytest
+
+from kubernetes_tpu.api.types import Deployment, ObjectMeta, Service
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.nodelifecycle import NODE_LEASE_NAMESPACE, TAINT_UNREACHABLE
+from kubernetes_tpu.kubectl import kubectl
+from kubernetes_tpu.kubelet import CheckpointManager, HollowCluster, HollowKubelet
+from kubernetes_tpu.kubelet.checkpoint import CorruptCheckpointError
+from kubernetes_tpu.kubelet.hollow import TERMINATES_AFTER_ANNOTATION
+from kubernetes_tpu.proxy import Proxier
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+class TestHollowKubelet:
+    def test_register_heartbeat_and_run_pods(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        k = HollowKubelet(store, make_node("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj(), now_fn=clock)
+        k.run_once()
+        assert "n1" in store.nodes
+        lease = store.get_lease(f"{NODE_LEASE_NAMESPACE}/n1")
+        assert lease is not None and lease.holder_identity == "n1"
+        # a bound pod goes Running
+        store.create_pod(make_pod("p1").obj())
+        from kubernetes_tpu.api.types import Binding
+        store.bind(Binding(pod_key="default/p1", node_name="n1"))
+        # store.bind sets Running already (binding shortcut); reset to Pending
+        p = store.get_pod("default/p1").clone()
+        p.status.phase = "Pending"
+        store.update_pod(p)
+        k.run_once()
+        assert store.get_pod("default/p1").status.phase == "Running"
+
+    def test_terminates_after_annotation(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        k = HollowKubelet(store, make_node("n1").obj(), now_fn=clock)
+        k.run_once()
+        pod = make_pod("job-pod").obj()
+        pod.meta.annotations[TERMINATES_AFTER_ANNOTATION] = "5"
+        pod.spec.node_name = "n1"
+        store.create_pod(pod)
+        k.run_once()
+        assert store.get_pod("default/job-pod").status.phase == "Running"
+        clock.advance(6.0)
+        k.run_once()
+        assert store.get_pod("default/job-pod").status.phase == "Succeeded"
+
+    def test_heartbeat_keeps_node_ready(self):
+        """kubelet heartbeats vs nodelifecycle: alive node stays Ready,
+        a stopped kubelet's node goes NotReady + tainted."""
+        store = ClusterStore()
+        clock = FakeClock()
+        alive = HollowKubelet(store, make_node("alive").obj(), now_fn=clock)
+        dead = HollowKubelet(store, make_node("dead").obj(), now_fn=clock)
+        alive.run_once()
+        dead.run_once()
+        m = ControllerManager(store, factory=SharedInformerFactory(store),
+                              controllers=["nodelifecycle"], now_fn=clock)
+        for _ in range(10):
+            clock.advance(10.0)
+            alive.run_once()  # dead stops heartbeating
+            m.sync_round(monitor_nodes=True)
+        assert store.nodes["alive"].status.ready
+        assert not store.nodes["dead"].status.ready
+        assert any(t.key == TAINT_UNREACHABLE for t in store.nodes["dead"].spec.taints)
+
+
+class TestKubemark:
+    def test_hollow_cluster_end_to_end(self):
+        """kubemark-style: scheduler + KCM + 50 hollow nodes running a
+        deployment to completion."""
+        store = ClusterStore()
+        clock = FakeClock()
+        cluster = HollowCluster(store, n_nodes=50, now_fn=clock)
+        cluster.register_all()
+        sched = Scheduler(store, now_fn=clock)
+        m = ControllerManager(store, factory=SharedInformerFactory(store),
+                              controllers=["deployment", "replicaset", "endpoints"],
+                              now_fn=clock)
+        store.create_service(Service(meta=ObjectMeta(name="web"), selector={"app": "web"}))
+        tmpl = make_pod("t").req({"cpu": "500m"}).label("app", "web").obj()
+        store.create_object("Deployment", Deployment(
+            meta=ObjectMeta(name="web"), replicas=200, template=tmpl))
+        for _ in range(10):
+            m.settle()
+            sched.run_until_settled()
+            cluster.tick()
+        running = [p for p in store.pods.values() if p.status.phase == "Running"]
+        assert len(running) == 200
+        nodes_used = {p.spec.node_name for p in running}
+        assert len(nodes_used) == 50  # spread over the fleet
+        m.settle()
+        eps = store.get_object("Endpoints", "default/web")
+        assert len(eps.addresses) == 200
+
+
+class TestCheckpointManager:
+    def test_roundtrip(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.create_checkpoint("devices", {"gpu": [0, 1]})
+        assert cm.get_checkpoint("devices") == {"gpu": [0, 1]}
+        assert cm.list_checkpoints() == ["devices"]
+        cm.remove_checkpoint("devices")
+        assert cm.get_checkpoint("devices") is None
+
+    def test_corruption_detected(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.create_checkpoint("state", {"a": 1})
+        path = tmp_path / "state"
+        doc = path.read_text().replace('\\"a\\": 1', '\\"a\\": 2')
+        path.write_text(doc.replace('"a\\": 1', '"a\\": 2'))
+        # direct tamper: swap payload digit
+        raw = path.read_text()
+        path.write_text(raw.replace("1", "7", 1))
+        with pytest.raises(CorruptCheckpointError):
+            cm.get_checkpoint("state")
+
+    def test_survives_restart(self, tmp_path):
+        CheckpointManager(str(tmp_path)).create_checkpoint("x", {"k": "v"})
+        assert CheckpointManager(str(tmp_path)).get_checkpoint("x") == {"k": "v"}
+
+
+class TestProxier:
+    def _cluster(self):
+        store = ClusterStore()
+        factory = SharedInformerFactory(store)
+        proxier = Proxier(store, factory=factory)
+        m = ControllerManager(store, factory=SharedInformerFactory(store),
+                              controllers=["endpoints"])
+        return store, factory, proxier, m
+
+    def test_rules_follow_endpoints(self):
+        store, factory, proxier, m = self._cluster()
+        store.create_service(Service(meta=ObjectMeta(name="svc"), selector={"app": "a"}))
+        for i in range(3):
+            p = make_pod(f"p{i}").label("app", "a").obj()
+            p.status.phase = "Running"
+            p.spec.node_name = "n1"
+            store.create_pod(p)
+        m.settle()
+        factory.pump()
+        proxier.sync_proxy_rules()
+        assert sorted(proxier.backends("default/svc")) == [
+            "default/p0", "default/p1", "default/p2"]
+        # round robin covers all backends
+        picks = {proxier.route("default/svc") for _ in range(3)}
+        assert picks == {"default/p0", "default/p1", "default/p2"}
+
+    def test_service_delete_clears_rules(self):
+        store, factory, proxier, m = self._cluster()
+        store.create_service(Service(meta=ObjectMeta(name="svc"), selector={"app": "a"}))
+        m.settle()
+        factory.pump()
+        proxier.sync_proxy_rules()
+        assert proxier.backends("default/svc") == []
+        store.delete_object("Service", "default/svc")
+        factory.pump()
+        proxier.sync_proxy_rules()
+        assert proxier.route("default/svc") is None
+
+
+class TestKubectl:
+    def _store(self):
+        store = ClusterStore()
+        store.create_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        store.create_pod(make_pod("web-1").label("app", "web").obj())
+        return store
+
+    def test_get_pods(self):
+        out = kubectl(self._store(), "get pods")
+        assert "NAME" in out and "web-1" in out and "Pending" in out
+
+    def test_get_single_not_found(self):
+        out = kubectl(self._store(), "get pods nope")
+        assert "NotFound" in out
+
+    def test_describe_node(self):
+        out = kubectl(self._store(), "describe node n1")
+        assert "Name:         n1" in out and "Ready:        True" in out
+
+    def test_create_apply_delete_roundtrip(self, tmp_path):
+        manifest = tmp_path / "deploy.yaml"
+        manifest.write_text("""
+apiVersion: apps/v1
+kind: Deployment
+metadata:
+  name: api
+spec:
+  replicas: 2
+  selector:
+    matchLabels: {app: api}
+  template:
+    metadata:
+      labels: {app: api}
+    spec:
+      containers:
+      - image: api:v1
+        resources:
+          requests: {cpu: 100m}
+---
+apiVersion: v1
+kind: Service
+metadata:
+  name: api
+spec:
+  selector: {app: api}
+""")
+        store = self._store()
+        out = kubectl(store, f"create -f {manifest}")
+        assert "deployment/api created" in out and "service/api created" in out
+        assert store.get_object("Deployment", "default/api").replicas == 2
+        out = kubectl(store, f"create -f {manifest}")
+        assert "AlreadyExists" in out
+        out = kubectl(store, f"apply -f {manifest}")
+        assert "configured" in out
+        out = kubectl(store, "delete deployment api")
+        assert 'deleted' in out
+        assert store.get_object("Deployment", "default/api") is None
+
+    def test_scale_and_cordon(self, tmp_path):
+        store = self._store()
+        manifest = tmp_path / "rs.yaml"
+        manifest.write_text("""
+kind: ReplicaSet
+metadata: {name: web}
+spec:
+  replicas: 1
+  selector: {app: web}
+  template:
+    spec: {containers: [{image: web}]}
+""")
+        kubectl(store, f"create -f {manifest}")
+        out = kubectl(store, "scale rs web --replicas=5")
+        assert "scaled" in out
+        assert store.get_replica_set("default/web").replicas == 5
+        out = kubectl(store, "cordon n1")
+        assert "cordoned" in out
+        assert store.nodes["n1"].spec.unschedulable
+        out = kubectl(store, "get nodes")
+        assert "SchedulingDisabled" in out
+        kubectl(store, "uncordon n1")
+        assert not store.nodes["n1"].spec.unschedulable
+
+    def test_kubectl_drives_scheduler(self, tmp_path):
+        """create -f pod manifest → scheduler binds → get shows the node."""
+        store = self._store()
+        sched = Scheduler(store)
+        manifest = tmp_path / "pod.yaml"
+        manifest.write_text("""
+kind: Pod
+metadata: {name: cli-pod}
+spec:
+  containers:
+  - image: app:v1
+    resources:
+      requests: {cpu: 200m}
+""")
+        kubectl(store, f"create -f {manifest}")
+        sched.run_until_settled()
+        out = kubectl(store, "get pods cli-pod")
+        assert "n1" in out
+
+
+class TestReviewRegressions:
+    def test_pv_quantities_parsed_with_suffixes(self, tmp_path):
+        store = ClusterStore()
+        m = tmp_path / "pv.yaml"
+        m.write_text("""
+kind: PersistentVolume
+metadata: {name: data}
+spec:
+  storageClassName: fast
+  capacity: {storage: 10Gi}
+---
+kind: PersistentVolumeClaim
+metadata: {name: claim}
+spec:
+  storageClassName: fast
+  resources:
+    requests: {storage: 5Gi}
+""")
+        kubectl(store, f"create -f {m}")
+        assert store.get_pv("data").capacity_bytes == 10 * 1024**3
+        assert store.get_pvc("default/claim").requested_bytes == 5 * 1024**3
+
+    def test_selector_match_expressions_preserved(self, tmp_path):
+        store = ClusterStore()
+        m = tmp_path / "rs.yaml"
+        m.write_text("""
+kind: ReplicaSet
+metadata: {name: web}
+spec:
+  replicas: 1
+  selector:
+    matchExpressions:
+    - {key: app, operator: In, values: [web]}
+  template:
+    spec: {containers: [{image: web}]}
+""")
+        kubectl(store, f"create -f {m}")
+        sel = store.get_replica_set("default/web").selector
+        assert sel.matches({"app": "web"}) and not sel.matches({"app": "db"})
+
+    def test_apply_preserves_binding(self, tmp_path):
+        store = ClusterStore()
+        store.create_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        sched = Scheduler(store)
+        m = tmp_path / "pod.yaml"
+        m.write_text("""
+kind: Pod
+metadata: {name: p}
+spec: {containers: [{image: a, resources: {requests: {cpu: 100m}}}]}
+""")
+        kubectl(store, f"create -f {m}")
+        sched.run_until_settled()
+        assert store.get_pod("default/p").spec.node_name == "n1"
+        kubectl(store, f"apply -f {m}")
+        pod = store.get_pod("default/p")
+        assert pod.spec.node_name == "n1" and pod.status.phase == "Running"
+
+    def test_proxier_full_sync_sweeps_deleted_service(self):
+        store = ClusterStore()
+        proxier = Proxier(store)  # no informers: full-sync path only
+        store.create_service(Service(meta=ObjectMeta(name="svc"), selector={}))
+        proxier.sync_proxy_rules(full=True)
+        assert "default/svc" in proxier.rules
+        store.delete_object("Service", "default/svc")
+        proxier.sync_proxy_rules(full=True)
+        assert "default/svc" not in proxier.rules
+
+    def test_kubelet_restart_does_not_clobber_node(self):
+        store = ClusterStore()
+        k = HollowKubelet(store, make_node("n1").obj())
+        k.run_once()
+        from kubernetes_tpu.kubectl import kubectl as kc
+        kc(store, "cordon n1")
+        k2 = HollowKubelet(store, make_node("n1").obj())  # restart
+        k2.run_once()
+        assert store.nodes["n1"].spec.unschedulable  # cordon survived
+
+    def test_hollow_admission_rejects_overcommit(self):
+        store = ClusterStore()
+        k = HollowKubelet(store, make_node("n1").capacity(
+            {"cpu": "64", "memory": "64Gi", "pods": 2}).obj())
+        k.run_once()
+        for i in range(4):
+            p = make_pod(f"p{i}").obj()
+            p.spec.node_name = "n1"
+            store.create_pod(p)
+        k.run_once()
+        phases = sorted(p.status.phase for p in store.pods.values())
+        assert phases.count("Failed") == 2 and phases.count("Running") == 2
