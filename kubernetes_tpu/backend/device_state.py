@@ -32,6 +32,29 @@ _ROW_FIELDS = (
 )
 
 
+def _apply_rows(nt: NodeTensors, slots: jax.Array, updates: dict,
+                image_sizes: jax.Array, image_num_nodes: jax.Array) -> NodeTensors:
+    """One fused scatter of all dirty rows into the node tensors, jitted.
+    Slot counts are bucketed by the caller so this compiles once per bucket,
+    not once per distinct dirty-row count (no donation: image_sizes may alias
+    a field of nt when the image vocab is unchanged)."""
+    new_fields = {f: getattr(nt, f).at[slots].set(updates[f]) for f in updates}
+    new_fields["image_sizes"] = image_sizes
+    new_fields["image_num_nodes"] = image_num_nodes
+    return NodeTensors(**new_fields)
+
+
+_apply_rows_jit = jax.jit(_apply_rows)
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    """Next power of two ≥ n (≥ floor) — the static-shape recompile guard."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
 class DeviceState:
     def __init__(self, caps: Capacities, ns_labels_fn=None):
         from .sig_table import SigTable
@@ -110,13 +133,21 @@ class DeviceState:
 
         if not dirty:
             return 0
-        slots = np.array([s for s, _ in dirty], np.int32)
+        # bucket-pad the row count to a power of two so the fused scatter
+        # compiles once per bucket; padding repeats row 0 (idempotent set)
+        n = len(dirty)
+        b = _bucket(n)
+        slots = np.empty(b, np.int32)
+        slots[:n] = [s for s, _ in dirty]
+        slots[n:] = slots[0]
         rows = [self.encoder.encode_node_row(ni) for _, ni in dirty]
         updates = {}
         for field, dtype in _ROW_FIELDS:
-            updates[field] = np.stack([r[field] for r in rows]).astype(dtype)
+            stacked = np.empty((b,) + np.shape(rows[0][field]), dtype)
+            stacked[:n] = np.stack([r[field] for r in rows]).astype(dtype)
+            stacked[n:] = stacked[0]
+            updates[field] = stacked
         nt = self.nt
-        new_fields = {f: getattr(nt, f).at[jnp.asarray(slots)].set(jnp.asarray(v)) for f, v in updates.items()}
         if images_changed:
             sizes = np.zeros(self.caps.images, np.int32)
             counts = np.zeros(self.caps.images, np.int32)
@@ -124,12 +155,13 @@ class DeviceState:
                 iid = self.encoder.image_id(img)
                 counts[iid] = cnt
                 sizes[iid] = min(self._image_sizes.get(img, 0), 2**31 - 1)
-            new_fields["image_sizes"] = jnp.asarray(sizes)
-            new_fields["image_num_nodes"] = jnp.asarray(counts)
+            image_sizes = jnp.asarray(sizes)
+            image_num_nodes = jnp.asarray(counts)
         else:
-            new_fields["image_sizes"] = nt.image_sizes
-            new_fields["image_num_nodes"] = nt.image_num_nodes
-        self.nt = NodeTensors(**new_fields)
+            image_sizes = nt.image_sizes
+            image_num_nodes = nt.image_num_nodes
+        self.nt = _apply_rows_jit(nt, jnp.asarray(slots), updates,
+                                  image_sizes, image_num_nodes)
         self.syncs += 1
         self.rows_uploaded += len(dirty)
         return len(dirty)

@@ -46,9 +46,30 @@ _ATTRIBUTION_ORDER = (
 )
 
 
+def _enable_compilation_cache() -> None:
+    """Persistent XLA compilation cache: the batched kernels compile once per
+    (bucket, batch) shape per machine, not per process — first-run warmup is
+    the dominant cost otherwise (§5.4: persist nothing beyond compiled-
+    executable caches)."""
+    import os
+
+    if getattr(_enable_compilation_cache, "_done", False):
+        return
+    _enable_compilation_cache._done = True
+    cache_dir = os.environ.get(
+        "KTPU_COMPILE_CACHE", os.path.expanduser("~/.cache/kubernetes_tpu_xla")
+    )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:  # noqa: BLE001 — older jax without the knob
+        pass
+
+
 class TPUScheduler(Scheduler):
     def __init__(self, *args, batch_size: int = 128, **kwargs):
         super().__init__(*args, **kwargs)
+        _enable_compilation_cache()
         self.batch_size = batch_size
         self.device: Optional[DeviceState] = None
         self.schedule_batch_fn = build_schedule_batch_fn()
@@ -155,18 +176,20 @@ class TPUScheduler(Scheduler):
         pod_cycle = self.queue.scheduling_cycle
 
         buffer: List[QueuedPodInfo] = []
+        self._ensure_device()
         for qp in qps:
             pod = self.store.get_pod(qp.pod.key())
             if pod is None or pod.spec.node_name or not self._responsible_for(pod):
                 continue  # skipPodSchedule
             qp.pod = pod
-            self.cache.update_snapshot(self.snapshot)
-            self._ensure_device()
             if self.batch_supported(pod):
                 buffer.append(qp)
                 continue
+            # fallback pod: flush what's queued first (strict pop order),
+            # then give the sequential path a fresh snapshot
             self._flush_batch(buffer, pod_cycle)
             buffer = []
+            self.cache.update_snapshot(self.snapshot)
             self._schedule_fallback(qp, pod_cycle)
         self._flush_batch(buffer, pod_cycle)
         return len(qps)
@@ -196,14 +219,31 @@ class TPUScheduler(Scheduler):
         )
         self._commit_batch(batched, result, pod_cycle)
 
-    def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult, pod_cycle: int) -> None:
-        node_idx = np.asarray(result.node_idx)
-        slot_names = self.device.slot_to_name()
+    @staticmethod
+    def _bind_path_needs_prefilter(fwk) -> bool:
+        """True when a non-default reserve/permit/pre-bind plugin is present
+        (out-of-tree plugins may require PreFilter cycle state)."""
+        for point in ("reserve", "permit", "pre_bind"):
+            for plugin, _w in fwk.points.get(point, []):
+                if plugin.name() != "VolumeBinding":
+                    return True
+        return False
+
+    def _materialize_masks(self, result: BatchResult) -> Dict[str, np.ndarray]:
+        """Pull the per-plugin feasibility masks to host — ONLY on failure
+        paths (each mask is a [batch, nodes] device→host transfer; the happy
+        path needs just node_idx)."""
         masks = {k: np.asarray(v) for k, v in result.static_masks.items()}
         masks["NodePorts"] = np.asarray(result.ports_ok)
         masks["NodeResourcesFit"] = np.asarray(result.fit_ok)
         masks["PodTopologySpread"] = np.asarray(result.spread_ok)
         masks["InterPodAffinity"] = np.asarray(result.ipa_ok)
+        return masks
+
+    def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult, pod_cycle: int) -> None:
+        node_idx = np.asarray(result.node_idx)
+        slot_names = self.device.slot_to_name()
+        masks: Optional[Dict[str, np.ndarray]] = None  # lazy: failures only
 
         for i, qp in enumerate(qps):
             pod = qp.pod
@@ -216,10 +256,17 @@ class TPUScheduler(Scheduler):
                     self._fail(fwk, qp, Status.error(f"stale node slot {idx}"), pod_cycle)
                     continue
                 state = CycleState()
-                fwk.run_pre_filter_plugins(state, pod)  # Reserve/Bind plugins may read it
+                # Reserve/Permit/PreBind plugins may read PreFilter state;
+                # with the default set only VolumeBinding does (and it
+                # tolerates absence), so skip the per-pod host prefilter for
+                # volume-less pods — it is pure overhead on the batch path
+                if pod.spec.volumes or self._bind_path_needs_prefilter(fwk):
+                    fwk.run_pre_filter_plugins(state, pod)
                 self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle)
                 self.batch_scheduled += 1
             else:
+                if masks is None:
+                    masks = self._materialize_masks(result)
                 diagnosis = self._diagnose(i, masks, slot_names)
                 self._fail(fwk, qp, Status.unschedulable("no feasible node"), pod_cycle, diagnosis)
 

@@ -19,22 +19,26 @@ class NodeTree:
 
     def __init__(self):
         self._zones: Dict[str, List[str]] = {}
+        self._members: set = set()  # O(1) membership; lists keep zone order
         self.num_nodes = 0
 
     def add_node(self, node: Node) -> None:
-        zone = get_zone_key(node)
-        names = self._zones.setdefault(zone, [])
-        if node.meta.name in names:
+        if node.meta.name in self._members:
             return
-        names.append(node.meta.name)
+        zone = get_zone_key(node)
+        self._zones.setdefault(zone, []).append(node.meta.name)
+        self._members.add(node.meta.name)
         self.num_nodes += 1
 
     def remove_node(self, node: Node) -> None:
+        if node.meta.name not in self._members:
+            return
         zone = get_zone_key(node)
         names = self._zones.get(zone)
         if names is None or node.meta.name not in names:
             return
         names.remove(node.meta.name)
+        self._members.discard(node.meta.name)
         if not names:
             del self._zones[zone]
         self.num_nodes -= 1
