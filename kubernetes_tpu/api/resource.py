@@ -26,6 +26,8 @@ import math
 import re
 from fractions import Fraction
 
+from ..native import canonical_native as _canonical_native
+
 # Resource names (subset of k8s.io/api/core/v1 const names).
 CPU = "cpu"
 MEMORY = "memory"
@@ -56,7 +58,10 @@ def parse_quantity(value) -> Fraction:
     if not m:
         raise ValueError(f"invalid quantity {value!r}")
     num, suffix = m.groups()
-    base = Fraction(num) if "." not in num else Fraction(num).limit_denominator(10**9)
+    # Fraction parses decimal strings exactly ("2.0000000001" included) —
+    # never limit_denominator here or this path and the native C++ parser
+    # would disagree on >9-fractional-digit quantities
+    base = Fraction(num)
     if suffix in _BINARY_SUFFIXES:
         return base * _BINARY_SUFFIXES[suffix]
     if suffix in _DECIMAL_SUFFIXES:
@@ -74,8 +79,25 @@ def int_value(value) -> int:
     return math.ceil(parse_quantity(value))
 
 
+def _native_cls(resource: str) -> int:
+    if resource == CPU:
+        return 1  # CLS_MILLI
+    if resource == MEMORY:
+        return 2  # CLS_KIB
+    if resource == EPHEMERAL_STORAGE or resource.startswith(HUGEPAGES_PREFIX):
+        return 3  # CLS_MIB
+    return 0  # CLS_COUNT
+
+
 def canonical(resource: str, value) -> int:
-    """Canonical int for the device tensors AND the scalar oracle. See module doc."""
+    """Canonical int for the device tensors AND the scalar oracle. See module
+    doc. String quantities go through the native C++ parser when built
+    (native/ktpu_quantity.cpp, same exact semantics); anything else — or a
+    native miss — takes the Fraction path."""
+    if isinstance(value, str):
+        r = _canonical_native(value, _native_cls(resource))
+        if r is not None:
+            return r
     if resource == CPU:
         return milli_value(value)
     if resource == MEMORY:

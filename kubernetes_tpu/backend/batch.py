@@ -38,10 +38,30 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops import filters, scores, topology
+from ..ops import filters, pallas_step, scores, topology
 from ..ops.topology import _gmax, _gmin, _gsum
 from ..ops.schema import ExprTable, NodeTensors, PodBatch, TopoBatch, TopoCounts
 from ..ops.select import NEG_INF
+
+
+def pallas_mode(nt: NodeTensors, axis_name, topo_enabled: bool) -> Optional[str]:
+    """'compiled' | 'interpret' | None. KTPU_PALLAS=0 disables, =interpret
+    forces the interpreter lowering (CPU tests of the kernel path). Read
+    OUTSIDE jit and passed in as a static argument — env changes must
+    retrace, not be swallowed by the jit cache."""
+    import os
+
+    flag = os.environ.get("KTPU_PALLAS", "auto")
+    if flag == "0":
+        return None
+    if not pallas_step.shapes_supported(
+        nt.capacity, nt.allocatable.shape[1], nt.port_bits.shape[1],
+        axis_name, topo_enabled,
+    ):
+        return None
+    if flag == "interpret":
+        return "interpret"
+    return "compiled" if pallas_step.compile_supported() else None
 
 # default plugin weights on the batched path (default_plugins.go:32-51)
 DEFAULT_WEIGHTS = {
@@ -97,6 +117,7 @@ def schedule_batch_core(
     topo_enabled: bool = True,
     axis_name: Optional[str] = None,
     num_shards: int = 1,
+    pallas: Optional[str] = None,
 ) -> BatchResult:
     """The traceable body; nt's node axis may be a shard (axis_name set).
     ``topo_enabled`` is a trace-time flag: batches with no spread constraints,
@@ -152,6 +173,51 @@ def schedule_batch_core(
     pod_bits = _pod_port_bits(pb, nt.port_bits.shape[1])
     alloc_f = nt.allocatable.astype(jnp.float32)                  # [N, R]
     ones_pn = jnp.ones((N,), bool)
+
+    if pallas is not None:
+        # fused Pallas step: the whole per-pod dynamic computation + commit
+        # in one VMEM-resident kernel (ops/pallas_step.py)
+        interp = pallas == "interpret"
+        alloc_t = nt.allocatable.T
+        wvec = jnp.asarray([[
+            weights["NodeResourcesFit"],
+            weights["NodeResourcesBalancedAllocation"],
+            weights["TaintToleration"],
+            weights["NodeAffinity"],
+            weights["ImageLocality"],
+            0.0, 0.0, 0.0,
+        ]], jnp.float32)
+
+        def pstep(carry, xs):
+            req_t, nz_t, port_t = carry
+            (p_req, p_nz, p_static_ok, _p_affok, p_taint, p_aff, p_img, p_bits,
+             p_jitter, p_valid) = xs["row"]
+            out = pallas_step.fused_step(
+                alloc_t, req_t, nz_t, port_t,
+                p_req[:, None], p_nz[:, None], p_bits[:, None],
+                p_static_ok[None, :], p_taint[None, :], p_aff[None, :],
+                p_img[None, :], p_jitter[None, :],
+                p_valid.astype(jnp.int32).reshape(1, 1), wvec,
+                interpret=interp,
+            )
+            req_t, nz_t, port_t, idx, best, anyf, fit, ports_ok = out
+            return (req_t, nz_t, port_t), (
+                idx[0, 0], best[0, 0], anyf[0, 0] > 0,
+                fit[0], ports_ok[0], ones_pn, ones_pn,
+            )
+
+        rows = (
+            pb.req, pb.nonzero_req, static_ok, static_masks["NodeAffinity"],
+            taint_raw, affinity_raw, image_score, pod_bits, jitter, pb.valid,
+        )
+        carry0 = (nt.requested.T, nt.nonzero_requested.T, nt.port_bits.T)
+        _, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
+            pstep, carry0, {"row": rows})
+        return BatchResult(
+            node_idx=node_idx, best_score=best, any_feasible=any_feasible,
+            static_masks=static_masks, fit_ok=fit_ok, ports_ok=ports_ok,
+            spread_ok=spread_ok, ipa_ok=ipa_ok,
+        )
 
     def step(carry, xs):
         req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist = carry
@@ -260,7 +326,7 @@ def schedule_batch_core(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("weights_key", "topo_enabled"))
+@functools.partial(jax.jit, static_argnames=("weights_key", "topo_enabled", "pallas"))
 def schedule_batch(
     pb: PodBatch,
     et: ExprTable,
@@ -270,8 +336,10 @@ def schedule_batch(
     key: jax.Array,
     weights_key: Tuple[Tuple[str, float], ...] = tuple(sorted(DEFAULT_WEIGHTS.items())),
     topo_enabled: bool = True,
+    pallas: Optional[str] = None,
 ) -> BatchResult:
-    return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled)
+    return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
+                               pallas=pallas)
 
 
 def build_schedule_batch_fn(weights: Dict[str, float] = None):
@@ -280,7 +348,8 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
 
     def fn(pb, et, nt, tc, tb, key, topo_enabled=True):
+        mode = pallas_mode(nt, None, topo_enabled)  # env read outside jit
         return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
-                              topo_enabled=topo_enabled)
+                              topo_enabled=topo_enabled, pallas=mode)
 
     return fn

@@ -1,0 +1,11 @@
+"""Native runtime components (C++, built on demand with g++).
+
+The compute path is JAX/XLA; the host runtime around it keeps its hot,
+allocation-free pieces in C++ loaded over ctypes, with pure-Python fallbacks
+when no toolchain is available. Currently: exact resource-quantity parsing
+(native/ktpu_quantity.cpp), the per-encode host hot spot.
+"""
+
+from .loader import canonical_native, native_available
+
+__all__ = ["canonical_native", "native_available"]

@@ -1,0 +1,97 @@
+"""Build-on-demand ctypes loader for the native helpers.
+
+The shared object is compiled from native/*.cpp into
+``native/build/libktpu.so`` the first time it is needed (and whenever the
+source is newer), with plain ``g++ -O2 -shared -fPIC`` — no pip, no
+setuptools. Every entry point has a pure-Python fallback, so a missing
+compiler degrades to the Fraction-based path, never to an error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "ktpu_quantity.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libktpu.so")
+_ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", _SO, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+        logger.info("native build unavailable (%s); using Python fallback", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        needs_build = (
+            not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+        )
+        if needs_build and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            lib.kt_canonical.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                         ctypes.POINTER(ctypes.c_longlong)]
+            lib.kt_canonical.restype = ctypes.c_int
+            lib.kt_version.restype = ctypes.c_longlong
+            if lib.kt_version() != _ABI_VERSION:
+                logger.warning("native ABI mismatch; rebuilding")
+                if not _build():
+                    return None
+                lib = ctypes.CDLL(_SO)
+            _lib = lib
+        except OSError as e:
+            logger.info("native load failed (%s); using Python fallback", e)
+            return None
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+# canonical classes — must match ktpu_quantity.cpp
+CLS_COUNT = 0
+CLS_MILLI = 1
+CLS_KIB = 2
+CLS_MIB = 3
+
+
+def canonical_native(value: str, cls: int) -> Optional[int]:
+    """Parse a quantity string to its canonical int via the native parser;
+    None when the native library is unavailable or the string is rejected
+    (caller falls back to the exact Python path)."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = ctypes.c_longlong(0)
+    rc = lib.kt_canonical(value.encode(), cls, ctypes.byref(out))
+    if rc != 0:
+        return None
+    return out.value

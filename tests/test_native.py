@@ -1,0 +1,81 @@
+"""Native C++ quantity parser: build, parity vs the Fraction oracle, fallback."""
+
+import math
+
+import pytest
+
+from kubernetes_tpu.api import resource as res
+from kubernetes_tpu.native import canonical_native, native_available
+from kubernetes_tpu.native.loader import CLS_COUNT, CLS_KIB, CLS_MIB, CLS_MILLI
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="no native toolchain in this environment"
+)
+
+# (string, resource) corpus spanning every suffix class and rounding edge
+CORPUS = [
+    ("0", "cpu"), ("1", "cpu"), ("2", "cpu"), ("100m", "cpu"), ("1500m", "cpu"),
+    ("0.1", "cpu"), ("0.5", "cpu"), ("2.5", "cpu"), ("0.001", "cpu"),
+    ("1n", "cpu"), ("999999999n", "cpu"), ("250u", "cpu"), ("3.14159", "cpu"),
+    ("1k", "cpu"), ("+2", "cpu"),
+    ("0", "memory"), ("128", "memory"), ("1Ki", "memory"), ("1500", "memory"),
+    ("1Mi", "memory"), ("32Gi", "memory"), ("1Ti", "memory"), ("2Pi", "memory"),
+    ("1.5Gi", "memory"), ("100M", "memory"), ("1G", "memory"), ("1023", "memory"),
+    ("1025", "memory"), ("0.5Ki", "memory"), ("123456789", "memory"),
+    ("10Gi", "ephemeral-storage"), ("1048577", "ephemeral-storage"),
+    ("2Mi", "hugepages-2Mi"), ("1Gi", "hugepages-1Gi"),
+    ("3", "pods"), ("110", "pods"), ("4", "example.com/gpu"),
+]
+
+
+def _python_canonical(resource, value):
+    """The Fraction oracle, bypassing the native fast path."""
+    if resource == res.CPU:
+        return res.milli_value(value)
+    if resource == res.MEMORY:
+        return math.ceil(res.parse_quantity(value) / 2**10)
+    if resource == res.EPHEMERAL_STORAGE or resource.startswith(res.HUGEPAGES_PREFIX):
+        return math.ceil(res.parse_quantity(value) / 2**20)
+    return res.int_value(value)
+
+
+class TestNativeParity:
+    @pytest.mark.parametrize("value,resource", CORPUS)
+    def test_matches_fraction_oracle(self, value, resource):
+        native = canonical_native(value, res._native_cls(resource))
+        assert native is not None, f"native rejected {value!r}"
+        assert native == _python_canonical(resource, value), (value, resource)
+
+    def test_canonical_uses_native(self):
+        # the public canonical() must agree with the oracle on strings
+        for value, resource in CORPUS:
+            assert res.canonical(resource, value) == _python_canonical(resource, value)
+
+    def test_invalid_strings_fall_through(self):
+        assert canonical_native("abc", CLS_COUNT) is None
+        assert canonical_native("1..2", CLS_MILLI) is None
+        assert canonical_native("", CLS_KIB) is None
+        assert canonical_native("1Xi", CLS_MIB) is None
+        with pytest.raises(ValueError):
+            res.canonical("cpu", "not-a-quantity")
+
+    def test_negative_and_whitespace(self):
+        assert canonical_native(" 100m ", CLS_MILLI) == 100
+        assert canonical_native("-1", CLS_MILLI) == -1000
+
+    def test_huge_values_rejected_not_wrapped(self):
+        # 19-digit integer part: error, not silent wrap
+        assert canonical_native("12345678901234567890", CLS_COUNT) is None
+
+    def test_speed_sanity(self):
+        import time
+
+        t0 = time.perf_counter()
+        for _ in range(2000):
+            canonical_native("1500m", CLS_MILLI)
+        native_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(2000):
+            res.milli_value("1500m")
+        python_dt = time.perf_counter() - t0
+        assert native_dt < python_dt  # the point of the exercise
