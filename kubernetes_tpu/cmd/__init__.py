@@ -1,0 +1,4 @@
+"""Component binaries (the cmd/* layer): scheduler and controller-manager
+entry points with the component-base serving surface (healthz/readyz/configz/
+metrics mux, leader election, feature gates).
+"""

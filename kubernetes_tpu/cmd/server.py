@@ -1,0 +1,211 @@
+"""Scheduler command (cmd/kube-scheduler/app/server.go).
+
+``Setup`` decodes KubeSchedulerConfiguration (v1beta2/v1beta3 YAML),
+builds the scheduler over a store, and wires the component-base serving
+surface: /healthz, /readyz, /configz, /metrics on one mux
+(server.go:146 Run installs the same endpoints), plus leader election
+(server.go:205-225) gating the scheduling loop.
+
+``main()`` is the binary: `python -m kubernetes_tpu.cmd.server --config f.yaml
+[--simulate nodes=N,pods=P]` — simulate mode stands in for a cluster the way
+kubemark hollow nodes do.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..apiserver.store import ClusterStore
+from ..client.informer import SharedInformerFactory
+from ..client.leaderelection import LeaderElectionConfig, LeaderElector
+from ..config.factory import scheduler_from_config
+from ..config.types import KubeSchedulerConfiguration, load_config
+from ..metrics.registry import Registry
+from ..utils.featuregate import DEFAULT_FEATURE_GATE
+
+
+class ComponentServer:
+    """healthz/readyz/configz/metrics mux shared by the component binaries
+    (component-base: healthz.InstallHandler + configz + legacyregistry)."""
+
+    def __init__(self, configz: dict, registry: Optional[Registry] = None,
+                 ready_fn=None, port: int = 0):
+        self.configz = configz
+        self.registry = registry
+        self.ready_fn = ready_fn or (lambda: True)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._respond(200, "ok", "text/plain")
+                elif self.path == "/readyz":
+                    ok = outer.ready_fn()
+                    self._respond(200 if ok else 500, "ok" if ok else "not ready", "text/plain")
+                elif self.path == "/configz":
+                    self._respond(200, json.dumps(outer.configz), "application/json")
+                elif self.path == "/metrics":
+                    text = outer.registry.expose() if outer.registry else ""
+                    self._respond(200, text, "text/plain; version=0.0.4")
+                else:
+                    self._respond(404, "not found", "text/plain")
+
+            def _respond(self, code, body, ctype):
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
+          raw: Optional[dict] = None, feature_gates: str = "",
+          use_informers: bool = True, tpu: bool = False, **kwargs):
+    """server.go:300 Setup: config + registries → a runnable scheduler."""
+    if feature_gates:
+        DEFAULT_FEATURE_GATE.set_from_string(feature_gates)
+    factory = SharedInformerFactory(store) if use_informers else None
+    if tpu and DEFAULT_FEATURE_GATE.enabled("TPUBatchedScheduling"):
+        from ..backend.tpu_scheduler import TPUScheduler
+
+        kwargs.setdefault("scheduler_cls", TPUScheduler)
+    sched = scheduler_from_config(
+        store, cfg=cfg, raw=raw, informer_factory=factory, **kwargs
+    )
+    return sched
+
+
+class SchedulerApp:
+    """The running binary: serving mux + leader-elected scheduling loop."""
+
+    def __init__(self, store: ClusterStore, raw_config: Optional[dict] = None,
+                 identity: str = "kube-scheduler-0", port: int = 0,
+                 feature_gates: str = "", tpu: bool = False):
+        self.cfg = load_config(raw_config)
+        self.store = store
+        self.sched = setup(store, cfg=self.cfg, feature_gates=feature_gates, tpu=tpu)
+        self.elector = LeaderElector(
+            store,
+            LeaderElectionConfig(
+                lock_name="kube-scheduler", identity=identity,
+                lease_duration=self.cfg.leader_elect_lease_duration,
+                renew_deadline=self.cfg.leader_elect_renew_deadline,
+                retry_period=self.cfg.leader_elect_retry_period,
+            ),
+        ) if self.cfg.leader_elect else None
+        self.server = ComponentServer(
+            configz={"kubescheduler.config.k8s.io": _configz_view(self.cfg)},
+            registry=getattr(self.sched.smetrics, "registry", None),
+            ready_fn=lambda: True,
+            port=port,
+        )
+        self._stop = threading.Event()
+
+    def tick(self) -> int:
+        """One leader-gated scheduling round; returns cycles run."""
+        if self.elector is not None and not self.elector.run_once():
+            return 0
+        return self.sched.run_until_settled()
+
+    def run(self, tick_interval: float = 0.05) -> threading.Thread:
+        self.server.start()
+
+        def _loop():
+            while not self._stop.is_set():
+                self.tick()
+                self._stop.wait(tick_interval)
+
+        t = threading.Thread(target=_loop, name="kube-scheduler", daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.stop()
+
+
+def _configz_view(cfg: KubeSchedulerConfiguration) -> dict:
+    return {
+        "apiVersion": cfg.api_version,
+        "parallelism": cfg.parallelism,
+        "percentageOfNodesToScore": cfg.percentage_of_nodes_to_score,
+        "podInitialBackoffSeconds": cfg.pod_initial_backoff_seconds,
+        "podMaxBackoffSeconds": cfg.pod_max_backoff_seconds,
+        "leaderElection": {"leaderElect": cfg.leader_elect},
+        "profiles": [p.scheduler_name for p in cfg.profiles],
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kube-scheduler")
+    parser.add_argument("--config", help="KubeSchedulerConfiguration YAML path")
+    parser.add_argument("--port", type=int, default=10259)
+    parser.add_argument("--feature-gates", default="")
+    parser.add_argument("--leader-elect", default=None, choices=["true", "false"])
+    parser.add_argument("--simulate", default="",
+                        help="nodes=N,pods=P: run against a synthetic cluster")
+    args = parser.parse_args(argv)
+
+    raw = None
+    if args.config:
+        import yaml
+
+        with open(args.config) as f:
+            raw = yaml.safe_load(f)
+    if args.leader_elect is not None:
+        raw = dict(raw or {})
+        raw.setdefault("leaderElection", {})["leaderElect"] = args.leader_elect == "true"
+
+    store = ClusterStore()
+    app = SchedulerApp(store, raw_config=raw, port=args.port,
+                       feature_gates=args.feature_gates)
+    if args.simulate:
+        from ..api.wrappers import make_node, make_pod
+
+        params = dict(kv.split("=") for kv in args.simulate.split(","))
+        for i in range(int(params.get("nodes", 100))):
+            store.create_node(make_node(f"node-{i}").capacity(
+                {"cpu": "8", "memory": "32Gi", "pods": 110}).obj())
+        for i in range(int(params.get("pods", 200))):
+            store.create_pod(make_pod(f"pod-{i}").req({"cpu": "100m", "memory": "256Mi"}).obj())
+    thread = app.run()
+    print(f"kube-scheduler serving on 127.0.0.1:{app.server.port} "
+          f"(healthz/readyz/configz/metrics); leaderElect={app.cfg.leader_elect}")
+    try:
+        while thread.is_alive():
+            time.sleep(1)
+            if args.simulate:
+                bound = sum(1 for p in store.pods.values() if p.spec.node_name)
+                if bound == len(store.pods):
+                    print(f"simulation complete: {bound} pods bound")
+                    break
+    except KeyboardInterrupt:
+        pass
+    app.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

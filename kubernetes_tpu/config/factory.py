@@ -17,6 +17,7 @@ def scheduler_from_config(
     raw: Optional[dict] = None,
     registry=None,
     out_of_tree_registry: Optional[dict] = None,
+    scheduler_cls=None,
     **scheduler_kwargs,
 ) -> Scheduler:
     """Build a Scheduler from a KubeSchedulerConfiguration (or its raw dict
@@ -42,7 +43,8 @@ def scheduler_from_config(
         }
         for p in cfg.profiles
     }
-    return Scheduler(
+    cls = scheduler_cls or Scheduler
+    return cls(
         store,
         profiles=profiles,
         percentage_of_nodes_to_score=cfg.percentage_of_nodes_to_score,

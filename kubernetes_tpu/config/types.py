@@ -17,6 +17,8 @@ from ..framework.interface import EXTENSION_POINTS
 from ..framework.registry import DEFAULT_PLUGINS
 
 API_VERSION = "kubescheduler.config.k8s.io/v1beta3"
+API_VERSION_V1BETA2 = "kubescheduler.config.k8s.io/v1beta2"
+SUPPORTED_VERSIONS = (API_VERSION, API_VERSION_V1BETA2)
 
 # name used when a profile doesn't set one (v1beta3/defaults.go)
 DEFAULT_SCHEDULER_NAME = "default-scheduler"
@@ -92,10 +94,48 @@ class KubeSchedulerConfiguration:
     pod_max_backoff_seconds: float = 10.0
     profiles: List[Profile] = field(default_factory=lambda: [Profile()])
     extenders: List[Extender] = field(default_factory=list)
+    api_version: str = API_VERSION
+    # leaderElection (component-base/config LeaderElectionConfiguration)
+    leader_elect: bool = True
+    leader_elect_lease_duration: float = 15.0
+    leader_elect_renew_deadline: float = 10.0
+    leader_elect_retry_period: float = 2.0
+    # clientConnection envelope (qps/burst; scheduler_perf uses 5000/5000)
+    client_qps: float = 50.0
+    client_burst: int = 100
 
 
 class ConfigError(ValueError):
     pass
+
+
+def _parse_duration(v) -> float:
+    """metav1.Duration string ('15s', '2m30s', '100ms') or number → seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    s = str(v).strip()
+    units = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+    total, num = 0.0, ""
+    i = 0
+    while i < len(s):
+        c = s[i]
+        if c.isdigit() or c == ".":
+            num += c
+            i += 1
+            continue
+        for u in ("ms", "h", "m", "s"):
+            if s.startswith(u, i):
+                if not num:
+                    raise ConfigError(f"invalid duration {v!r}")
+                total += float(num) * units[u]
+                num = ""
+                i += len(u)
+                break
+        else:
+            raise ConfigError(f"invalid duration {v!r}")
+    if num:  # bare number tail
+        total += float(num)
+    return total
 
 
 # ---------------------------------------------------------------------------
@@ -119,8 +159,25 @@ def load_config(raw: Optional[dict]) -> KubeSchedulerConfiguration:
     """Decode a config dict (the YAML object form), apply defaults, validate."""
     cfg = KubeSchedulerConfiguration()
     raw = raw or {}
-    if "apiVersion" in raw and raw["apiVersion"] != API_VERSION:
+    if "apiVersion" in raw and raw["apiVersion"] not in SUPPORTED_VERSIONS:
         raise ConfigError(f"unsupported apiVersion {raw['apiVersion']!r}")
+    # v1beta2 → internal conversion: same field surface for what this
+    # framework models; v1beta2 predates multiPoint, which simply won't
+    # appear in such configs (apis/config/v1beta2/conversion.go)
+    cfg.api_version = raw.get("apiVersion", API_VERSION)
+
+    le = raw.get("leaderElection") or {}
+    cfg.leader_elect = bool(le.get("leaderElect", cfg.leader_elect))
+    cfg.leader_elect_lease_duration = float(
+        _parse_duration(le.get("leaseDuration", cfg.leader_elect_lease_duration)))
+    cfg.leader_elect_renew_deadline = float(
+        _parse_duration(le.get("renewDeadline", cfg.leader_elect_renew_deadline)))
+    cfg.leader_elect_retry_period = float(
+        _parse_duration(le.get("retryPeriod", cfg.leader_elect_retry_period)))
+
+    cc = raw.get("clientConnection") or {}
+    cfg.client_qps = float(cc.get("qps", cfg.client_qps))
+    cfg.client_burst = int(cc.get("burst", cfg.client_burst))
     cfg.parallelism = int(raw.get("parallelism", cfg.parallelism))
     cfg.percentage_of_nodes_to_score = int(
         raw.get("percentageOfNodesToScore", cfg.percentage_of_nodes_to_score)

@@ -1,0 +1,156 @@
+"""Scheduler command server: config decode (v1beta2/v1beta3, durations,
+leader election), feature gates, healthz/readyz/configz/metrics mux, and the
+leader-gated loop."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.cmd.server import ComponentServer, SchedulerApp, setup
+from kubernetes_tpu.config.types import ConfigError, _parse_duration, load_config
+from kubernetes_tpu.utils.featuregate import FeatureGate, FeatureSpec
+
+
+class TestConfigVersions:
+    def test_v1beta3_accepted(self):
+        cfg = load_config({"apiVersion": "kubescheduler.config.k8s.io/v1beta3"})
+        assert cfg.api_version.endswith("v1beta3")
+
+    def test_v1beta2_accepted(self):
+        cfg = load_config({
+            "apiVersion": "kubescheduler.config.k8s.io/v1beta2",
+            "profiles": [{"schedulerName": "default-scheduler"}],
+        })
+        assert cfg.api_version.endswith("v1beta2")
+
+    def test_unknown_version_rejected(self):
+        with pytest.raises(ConfigError):
+            load_config({"apiVersion": "kubescheduler.config.k8s.io/v1alpha1"})
+
+    def test_leader_election_decoded(self):
+        cfg = load_config({
+            "leaderElection": {
+                "leaderElect": False,
+                "leaseDuration": "30s",
+                "renewDeadline": "20s",
+                "retryPeriod": "5s",
+            }
+        })
+        assert cfg.leader_elect is False
+        assert cfg.leader_elect_lease_duration == 30.0
+        assert cfg.leader_elect_renew_deadline == 20.0
+
+    def test_durations(self):
+        assert _parse_duration("15s") == 15.0
+        assert _parse_duration("2m30s") == 150.0
+        assert _parse_duration("100ms") == 0.1
+        assert _parse_duration("1h") == 3600.0
+        assert _parse_duration(7) == 7.0
+        with pytest.raises(ConfigError):
+            _parse_duration("3x")
+
+    def test_client_connection(self):
+        cfg = load_config({"clientConnection": {"qps": 5000, "burst": 5000}})
+        assert cfg.client_qps == 5000 and cfg.client_burst == 5000
+
+
+class TestFeatureGates:
+    def test_defaults_and_overrides(self):
+        fg = FeatureGate()
+        assert fg.enabled("TPUBatchedScheduling") is True
+        fg.set_from_string("TPUBatchedScheduling=false,ReadWriteOncePod=true")
+        assert fg.enabled("TPUBatchedScheduling") is False
+        assert fg.enabled("ReadWriteOncePod") is True
+
+    def test_locked_ga_feature(self):
+        fg = FeatureGate()
+        with pytest.raises(ValueError):
+            fg.set_from_map({"DefaultPodTopologySpread": False})
+
+    def test_unknown_feature(self):
+        fg = FeatureGate()
+        with pytest.raises(ValueError):
+            fg.set_from_string("NoSuchFeature=true")
+        with pytest.raises(KeyError):
+            fg.enabled("NoSuchFeature")
+
+    def test_add_custom(self):
+        fg = FeatureGate()
+        fg.add({"MyGate": FeatureSpec(False)})
+        assert fg.enabled("MyGate") is False
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+class TestComponentServer:
+    def test_mux_endpoints(self):
+        from kubernetes_tpu.metrics.registry import Registry, Counter
+
+        reg = Registry()
+        c = Counter("test_requests_total", "help")
+        reg.register(c)
+        c.inc()
+        srv = ComponentServer(configz={"a": {"b": 1}}, registry=reg)
+        port = srv.start()
+        try:
+            assert _get(port, "/healthz") == (200, "ok")
+            assert _get(port, "/readyz")[0] == 200
+            status, body = _get(port, "/configz")
+            assert status == 200 and json.loads(body) == {"a": {"b": 1}}
+            status, body = _get(port, "/metrics")
+            assert status == 200 and "test_requests_total 1" in body
+            try:
+                _get(port, "/nope")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            srv.stop()
+
+
+class TestSchedulerApp:
+    def test_app_schedules_and_serves(self):
+        store = ClusterStore()
+        for i in range(5):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        app = SchedulerApp(store, raw_config={
+            "apiVersion": "kubescheduler.config.k8s.io/v1beta3",
+            "leaderElection": {"leaderElect": True},
+        })
+        app.server.start()
+        try:
+            for i in range(10):
+                store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+            app.tick()
+            bound = [p for p in store.pods.values() if p.spec.node_name]
+            assert len(bound) == 10
+            # leader lease exists
+            assert store.get_lease("kube-system/kube-scheduler") is not None
+            status, body = _get(app.server.port, "/configz")
+            assert "kubescheduler.config.k8s.io" in body
+            status, body = _get(app.server.port, "/metrics")
+            assert status == 200
+        finally:
+            app.server.stop()
+
+    def test_standby_does_not_schedule(self):
+        store = ClusterStore()
+        store.create_node(make_node("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        leader = SchedulerApp(store, raw_config=None, identity="a")
+        standby = SchedulerApp(store, raw_config=None, identity="b")
+        store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+        assert leader.tick() > 0
+        assert standby.tick() == 0  # not the leader: loop gated
+
+    def test_setup_with_feature_gates(self):
+        store = ClusterStore()
+        sched = setup(store, raw=None, feature_gates="PodOverhead=false")
+        assert sched is not None
