@@ -67,10 +67,17 @@ def _enable_compilation_cache() -> None:
 
 
 class TPUScheduler(Scheduler):
-    def __init__(self, *args, batch_size: int = 128, **kwargs):
+    def __init__(self, *args, batch_size: int = 128, comparer_every_n: int = 0,
+                 **kwargs):
         super().__init__(*args, **kwargs)
         _enable_compilation_cache()
         self.batch_size = batch_size
+        # device/host comparer (SURVEY.md §5.2 mapping of the cache drift
+        # detector): every Nth device commit, re-check the placement with
+        # the scalar oracle filters; 0 disables
+        self.comparer_every_n = comparer_every_n
+        self.comparer_checks = 0
+        self.comparer_mismatches = 0
         self.device: Optional[DeviceState] = None
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
@@ -262,6 +269,9 @@ class TPUScheduler(Scheduler):
                 # volume-less pods — it is pure overhead on the batch path
                 if pod.spec.volumes or self._bind_path_needs_prefilter(fwk):
                     fwk.run_pre_filter_plugins(state, pod)
+                if (self.comparer_every_n
+                        and self.batch_scheduled % self.comparer_every_n == 0):
+                    self._compare_with_oracle(fwk, pod, node_name)
                 self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle)
                 self.batch_scheduled += 1
             else:
@@ -286,6 +296,31 @@ class TPUScheduler(Scheduler):
 
     def _fail(self, fwk, qp: QueuedPodInfo, status: Status, pod_cycle: int, diagnosis: Optional[Diagnosis] = None) -> None:
         self._handle_scheduling_failure(fwk, CycleState(), qp, status, diagnosis or Diagnosis(), pod_cycle)
+
+    def _compare_with_oracle(self, fwk, pod: Pod, node_name: str) -> None:
+        """Device/host comparer (§5.2): re-run the scalar oracle filters for
+        this pod against the CURRENT snapshot (which reflects all commits the
+        device saw before this pod, since assume updates the cache in commit
+        order) and flag placements the oracle rejects."""
+        import logging
+
+        self.cache.update_snapshot(self.snapshot)
+        ni = self.snapshot.get(node_name)
+        self.comparer_checks += 1
+        if ni is None or ni.node is None:
+            self.comparer_mismatches += 1
+            logging.getLogger(__name__).warning(
+                "comparer: device placed %s on unknown node %s", pod.key(), node_name)
+            return
+        state = CycleState()
+        _, status = fwk.run_pre_filter_plugins(state, pod)
+        if status.is_success():
+            status = fwk.run_filter_plugins(state, pod, ni)
+        if not status.is_success():
+            self.comparer_mismatches += 1
+            logging.getLogger(__name__).warning(
+                "comparer: oracle rejects device placement %s -> %s: %s",
+                pod.key(), node_name, status.message)
 
     def _schedule_fallback(self, qp: QueuedPodInfo, pod_cycle: int) -> None:
         """Sequential oracle path for pods the kernel doesn't cover."""

@@ -183,3 +183,35 @@ class TestTPUSchedulerE2E:
         sched.run_until_settled()
         assert len(bound_pods(store)) == 20
         assert sched.device.caps.nodes >= 200
+
+
+class TestDeviceHostComparer:
+    """SURVEY §5.2: sampled oracle recheck of device placements."""
+
+    def test_comparer_validates_placements(self):
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=16, comparer_every_n=1)
+        for i in range(8):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                .label("disk", "ssd" if i % 2 else "hdd").obj())
+        for i in range(20):
+            pw = make_pod(f"p{i}").req({"cpu": "200m", "memory": "512Mi"})
+            if i % 3 == 0:
+                pw.node_affinity_in("disk", ["ssd"])
+            store.create_pod(pw.obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 20
+        assert sched.comparer_checks >= 20
+        assert sched.comparer_mismatches == 0  # device and oracle agree
+
+    def test_comparer_off_by_default(self):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        sched = TPUScheduler(ClusterStore())
+        assert sched.comparer_every_n == 0
