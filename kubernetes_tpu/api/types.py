@@ -499,6 +499,17 @@ class Endpoints:
 
 
 @dataclass
+class ResourceQuota:
+    """core/v1 ResourceQuota: per-namespace hard caps on aggregate resource
+    requests + object counts; enforced by the admission chain, usage kept by
+    the quota controller. Canonical-int units (api/resource.py)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: Dict[str, int] = field(default_factory=dict)   # "pods", "requests.cpu" (milli), "requests.memory" (KiB)
+    used: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
 class Lease:
     """coordination.k8s.io/v1 Lease — the leader-election lock object
     (tools/leaderelection/resourcelock LeaseLock)."""

@@ -1,0 +1,126 @@
+"""Admission chain (apiserver pkg/admission + the kube-apiserver plugin
+order, pkg/kubeapiserver/options/plugins.go:64).
+
+Writes pass through mutating then validating admission before they touch the
+store maps. The in-tree plugins modeled (the scheduling-relevant subset):
+
+- NamespaceLifecycle: reject creates into a terminating/absent namespace
+- DefaultPriority (Priority admission): resolve priorityClassName → priority
+- ResourceQuota: reject pod creates that would exceed the namespace's quota
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api import resource as resource_api
+from ..api.types import Pod, ResourceQuota
+
+
+class AdmissionError(Exception):
+    """403: request denied by an admission plugin."""
+
+    def __init__(self, plugin: str, message: str):
+        super().__init__(f"admission denied by {plugin}: {message}")
+        self.plugin = plugin
+
+
+class AdmissionPlugin:
+    name = "plugin"
+
+    def admit(self, store, kind: str, obj) -> None:
+        """Mutating pass; may modify obj in place."""
+
+    def validate(self, store, kind: str, obj) -> None:
+        """Validating pass; raise AdmissionError to reject."""
+
+
+class NamespaceLifecycle(AdmissionPlugin):
+    """plugin/namespace/lifecycle: no creates into terminating namespaces.
+    An absent namespace is tolerated for the default namespace only (tests
+    and the reference's bootstrap both rely on lazily-created defaults)."""
+
+    name = "NamespaceLifecycle"
+
+    NAMESPACED_KINDS = ("Pod", "Service", "ReplicaSet", "StatefulSet",
+                        "Deployment", "DaemonSet", "Job")
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind not in self.NAMESPACED_KINDS:
+            return
+        ns = store.namespaces.get(obj.meta.namespace)
+        if ns is not None and ns.meta.deletion_timestamp:
+            raise AdmissionError(self.name,
+                                 f"namespace {obj.meta.namespace} is terminating")
+
+
+class DefaultPriority(AdmissionPlugin):
+    """plugin/pkg/admission/priority: resolve priorityClassName to the
+    numeric priority at create time (what the scheduler sorts on)."""
+
+    name = "Priority"
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        pod: Pod = obj
+        if pod.spec.priority_class_name and not pod.spec.priority:
+            pc = store.priority_classes.get(pod.spec.priority_class_name)
+            if pc is None:
+                raise AdmissionError(
+                    self.name, f"no PriorityClass {pod.spec.priority_class_name!r}")
+            pod.spec.priority = pc.value
+
+
+def pod_quota_usage(pod: Pod) -> dict:
+    """The quota dimensions a pod consumes (quota/v1/evaluator/core)."""
+    cpu = sum(resource_api.canonical("cpu", c.requests.get("cpu", 0))
+              for c in pod.spec.containers)
+    mem = sum(resource_api.canonical("memory", c.requests.get("memory", 0))
+              for c in pod.spec.containers)
+    return {"pods": 1, "requests.cpu": cpu, "requests.memory": mem}
+
+
+class ResourceQuotaAdmission(AdmissionPlugin):
+    """plugin/pkg/admission/resourcequota: a pod create must fit every
+    matching quota's remaining headroom; usage is charged synchronously
+    (the controller later reconciles drift from deletes)."""
+
+    name = "ResourceQuota"
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        usage = pod_quota_usage(obj)
+        for rq in store.resource_quotas.values():
+            if rq.meta.namespace != obj.meta.namespace:
+                continue
+            for dim, amount in usage.items():
+                if dim not in rq.hard:
+                    continue
+                if rq.used.get(dim, 0) + amount > rq.hard[dim]:
+                    raise AdmissionError(
+                        self.name,
+                        f"exceeded quota {rq.meta.name}: {dim} "
+                        f"used {rq.used.get(dim, 0)} + requested {amount} > hard {rq.hard[dim]}",
+                    )
+            for dim, amount in usage.items():
+                if dim in rq.hard:
+                    rq.used[dim] = rq.used.get(dim, 0) + amount
+
+
+def default_chain() -> List[AdmissionPlugin]:
+    """AllOrderedPlugins, reduced to the modeled set (plugins.go:64 order:
+    lifecycle → priority → ... → quota last)."""
+    return [NamespaceLifecycle(), DefaultPriority(), ResourceQuotaAdmission()]
+
+
+class AdmissionChain:
+    def __init__(self, plugins: Optional[List[AdmissionPlugin]] = None):
+        self.plugins = plugins if plugins is not None else default_chain()
+
+    def run(self, store, kind: str, obj) -> None:
+        for p in self.plugins:
+            p.admit(store, kind, obj)
+        for p in self.plugins:
+            p.validate(store, kind, obj)

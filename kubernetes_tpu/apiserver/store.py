@@ -121,6 +121,7 @@ class ClusterStore:
         self.replica_sets: Dict[str, ReplicaSet] = {}
         self.stateful_sets: Dict[str, StatefulSet] = {}
         self.leases: Dict[str, "Lease"] = {}
+        self.resource_quotas: Dict[str, object] = {}
         self.deployments: Dict[str, object] = {}
         self.daemon_sets: Dict[str, object] = {}
         self.jobs: Dict[str, object] = {}
@@ -133,6 +134,11 @@ class ClusterStore:
         self._journal: List[Tuple[int, str, str, object, object]] = []
         self._journal_capacity = 4096
         self._watchers: Dict[str, List[Watch]] = {}
+        # admission chain on the write path (config.go:806 handler chain's
+        # admission stage); None disables
+        from .admission import AdmissionChain
+
+        self.admission: Optional[AdmissionChain] = AdmissionChain()
 
     def add_event_handler(self, kind: str, handler: Handler) -> None:
         self._handlers.setdefault(kind, []).append(handler)
@@ -155,6 +161,10 @@ class ClusterStore:
         the store); informers get their events from _journal_event."""
         for h in self._handlers.get(kind, []):
             h(event, old, new)
+
+    def _admit(self, kind: str, obj) -> None:
+        if self.admission is not None:
+            self.admission.run(self, kind, obj)
 
     def _bump(self, obj) -> None:
         self._rv += 1
@@ -212,6 +222,7 @@ class ClusterStore:
                 "DaemonSet": self.daemon_sets,
                 "Job": self.jobs,
                 "Endpoints": self.endpoints,
+                "ResourceQuota": self.resource_quotas,
             }[kind]
         except KeyError:
             raise NotFound(f"unknown kind {kind!r}") from None
@@ -248,6 +259,7 @@ class ClusterStore:
     # ------------------------------------------------------------- pods
 
     def create_pod(self, pod: Pod) -> None:
+        self._admit("Pod", pod)
         with self._lock:
             if pod.key() in self.pods:
                 raise Conflict(f"pod {pod.key()} exists")
@@ -350,6 +362,7 @@ class ClusterStore:
         return obj.meta.name if kind in self.CLUSTER_SCOPED_KINDS else obj.meta.key()
 
     def create_object(self, kind: str, obj) -> None:
+        self._admit(kind, obj)
         m = self._kind_map(kind)
         with self._lock:
             key = self._key_of(kind, obj)

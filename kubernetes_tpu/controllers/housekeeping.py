@@ -219,3 +219,38 @@ class PVBinderController(Controller):
             return
         candidates.sort(key=lambda pv: (pv.capacity_bytes, pv.meta.name))
         self.store.bind_pv(candidates[0].meta.name, key)
+
+
+class ResourceQuotaController(Controller):
+    """resourcequota/resource_quota_controller.go: recompute each quota's
+    used vector from live pods — repairs the synchronous admission charges
+    after deletes/failures (level-driven full recount)."""
+
+    name = "resourcequota"
+    watch_kinds = ("ResourceQuota", "Pod")
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "ResourceQuota":
+            return [obj.meta.key()]
+        return [rq.meta.key()
+                for rq in self.store.snapshot_map("ResourceQuota").values()
+                if rq.meta.namespace == obj.meta.namespace]
+
+    def reconcile(self, key: str) -> None:
+        from ..apiserver.admission import pod_quota_usage
+
+        rq = self.store.get_object("ResourceQuota", key)
+        if rq is None:
+            return
+        used: dict = {}
+        for pod in self.store.snapshot_map("Pod").values():
+            if (pod.meta.namespace != rq.meta.namespace
+                    or pod.status.phase in ("Succeeded", "Failed")):
+                continue
+            for dim, amount in pod_quota_usage(pod).items():
+                used[dim] = used.get(dim, 0) + amount
+        tracked = {dim: used.get(dim, 0) for dim in rq.hard}
+        if tracked != rq.used:
+            new = dataclasses.replace(rq, used=tracked)
+            new.meta = dataclasses.replace(rq.meta)
+            self.store.update_object("ResourceQuota", new)

@@ -22,6 +22,7 @@ from .housekeeping import (
     NamespaceController,
     PodGCController,
     PVBinderController,
+    ResourceQuotaController,
 )
 from .nodelifecycle import NodeLifecycleController
 from .workloads import (
@@ -29,6 +30,7 @@ from .workloads import (
     DeploymentController,
     JobController,
     ReplicaSetController,
+    ReplicationControllerController,
     StatefulSetController,
 )
 
@@ -40,6 +42,7 @@ def new_controller_initializers() -> Dict[str, Initializer]:
     return {
         "deployment": lambda m: DeploymentController(m.store, m.factory),
         "replicaset": lambda m: ReplicaSetController(m.store, m.factory),
+        "replicationcontroller": lambda m: ReplicationControllerController(m.store, m.factory),
         "statefulset": lambda m: StatefulSetController(m.store, m.factory),
         "daemonset": lambda m: DaemonSetController(m.store, m.factory),
         "job": lambda m: JobController(m.store, m.factory),
@@ -51,6 +54,7 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         "namespace": lambda m: NamespaceController(m.store, m.factory),
         "endpoints": lambda m: EndpointsController(m.store, m.factory),
         "pvbinder": lambda m: PVBinderController(m.store, m.factory),
+        "resourcequota": lambda m: ResourceQuotaController(m.store, m.factory),
     }
 
 

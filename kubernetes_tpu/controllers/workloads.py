@@ -269,3 +269,44 @@ class JobController(Controller):
                                "Job", job.meta.name)
             self.store.create_pod(pod)
             active.append(pod)
+
+
+class ReplicationControllerController(Controller):
+    """pkg/controller/replication: the legacy map-selector twin of the
+    ReplicaSet controller (replica_set.go is shared by both upstream)."""
+
+    name = "replicationcontroller"
+    watch_kinds = ("ReplicationController", "Pod")
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "ReplicationController":
+            return [obj.meta.key()]
+        ref = obj.meta.controller_of()
+        if ref is not None and ref.kind == "ReplicationController":
+            return [f"{obj.meta.namespace}/{ref.name}"]
+        return []
+
+    def reconcile(self, key: str) -> None:
+        rc = self.store.get_replication_controller(key)
+        if rc is None or rc.meta.deletion_timestamp:
+            return
+        pods = [p for p in _owned_pods(self.store, rc.meta.namespace,
+                                       "ReplicationController", rc.meta.name)
+                if not p.meta.deletion_timestamp]
+        diff = rc.replicas - len(pods)
+        if diff > 0:
+            used = {p.meta.name for p in pods}
+            i = 0
+            while diff > 0:
+                name = f"{rc.meta.name}-{i}"
+                i += 1
+                if name in used:
+                    continue
+                self.store.create_pod(
+                    _instantiate(rc.template or Pod(), name, rc.meta.namespace,
+                                 "ReplicationController", rc.meta.name))
+                diff -= 1
+        elif diff < 0:
+            pods.sort(key=lambda p: (bool(p.spec.node_name), -p.meta.resource_version))
+            for p in pods[: -rc.replicas] if rc.replicas else pods:
+                self.store.delete_pod(p.meta.key())

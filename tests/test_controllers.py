@@ -366,3 +366,70 @@ class TestReviewRegressions:
                 assert state.get(key) == "present", f"delete-before-add {key}"
                 state[key] = "absent"
         assert all(v == "absent" for v in state.values())
+
+
+class TestAdmissionAndQuota:
+    def test_quota_rejects_over_limit(self):
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.apiserver.admission import AdmissionError
+
+        store = ClusterStore()
+        store.create_object("ResourceQuota", ResourceQuota(
+            meta=ObjectMeta(name="q"), hard={"pods": 2, "requests.cpu": 1000}))
+        store.create_pod(make_pod("a").req({"cpu": "400m"}).obj())
+        store.create_pod(make_pod("b").req({"cpu": "400m"}).obj())
+        import pytest as _pytest
+        with _pytest.raises(AdmissionError):  # pod count at 2/2
+            store.create_pod(make_pod("c").req({"cpu": "100m"}).obj())
+        rq = store.get_object("ResourceQuota", "default/q")
+        assert rq.used["pods"] == 2 and rq.used["requests.cpu"] == 800
+
+    def test_quota_controller_reconciles_after_delete(self):
+        from kubernetes_tpu.api.types import ResourceQuota
+
+        store = ClusterStore()
+        store.create_object("ResourceQuota", ResourceQuota(
+            meta=ObjectMeta(name="q"), hard={"pods": 5}))
+        store.create_pod(make_pod("a").obj())
+        store.create_pod(make_pod("b").obj())
+        store.delete_pod("default/a")
+        m = make_manager(store, ["resourcequota"])
+        m.settle()
+        rq = store.get_object("ResourceQuota", "default/q")
+        assert rq.used == {"pods": 1}
+        # headroom restored: a new pod admits
+        store.create_pod(make_pod("c").obj())
+
+    def test_priority_class_resolved_at_admission(self):
+        from kubernetes_tpu.api.types import PriorityClass
+
+        store = ClusterStore()
+        store.create_priority_class(PriorityClass(meta=ObjectMeta(name="high"), value=1000))
+        pod = make_pod("p").obj()
+        pod.spec.priority_class_name = "high"
+        store.create_pod(pod)
+        assert store.get_pod("default/p").spec.priority == 1000
+
+    def test_terminating_namespace_rejects_creates(self):
+        from kubernetes_tpu.apiserver.admission import AdmissionError
+
+        store = ClusterStore()
+        store.create_namespace(Namespace(meta=ObjectMeta(name="dying")))
+        store.namespaces["dying"].meta.deletion_timestamp = 1.0
+        import pytest as _pytest
+        with _pytest.raises(AdmissionError):
+            store.create_pod(make_pod("p", namespace="dying").obj())
+
+    def test_rc_controller(self):
+        from kubernetes_tpu.api.types import ReplicationController
+
+        store = ClusterStore()
+        m = make_manager(store, ["replicationcontroller"])
+        store.create_replication_controller(ReplicationController(
+            meta=ObjectMeta(name="old-school"), selector={"app": "x"},
+            replicas=3, template=pod_template({"app": "x"})))
+        m.settle()
+        assert len(store.pods) == 3
+        store.delete_pod(next(iter(store.pods)))
+        m.settle()
+        assert len(store.pods) == 3
