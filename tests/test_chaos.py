@@ -1,0 +1,120 @@
+"""Chaos & recovery (test/e2e/chaosmonkey + SURVEY §5.3 build mapping):
+disruption injected concurrently with scheduling; crash-only recovery —
+a restarted scheduler/device rebuilds from the store and continues.
+"""
+
+import numpy as np
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def _cluster(store, n=20, cap="8"):
+    for i in range(n):
+        store.create_node(make_node(f"n{i}").capacity(
+            {"cpu": cap, "memory": "16Gi", "pods": 30}).obj())
+
+
+class TestChurnDuringScheduling:
+    def test_node_churn_mid_workload(self):
+        """Nodes deleted and added while pods schedule: everything still
+        lands, nothing lands on a deleted node (chaosmonkey-style interleave)."""
+        store = ClusterStore()
+        clock = FakeClock()
+        _cluster(store, 20)
+        sched = Scheduler(store, now_fn=clock)
+        for wave in range(5):
+            for i in range(10):
+                store.create_pod(make_pod(f"w{wave}-p{i}").req({"cpu": "100m"}).obj())
+            # disrupt: drop one node, add a replacement
+            store.delete_node(f"n{wave}")
+            store.create_node(make_node(f"replacement-{wave}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+            clock.advance(11.0)
+            sched.run_until_settled()
+        live = set(store.nodes)
+        bound = [p for p in store.pods.values() if p.spec.node_name]
+        assert len(bound) == 50
+        orphans = [p for p in bound if p.spec.node_name not in live]
+        # pods bound to since-deleted nodes are PodGC's job, not the
+        # scheduler's: they must be from the deleted set only
+        assert all(p.spec.node_name.startswith("n") for p in orphans)
+
+    def test_podgc_cleans_after_node_loss(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        _cluster(store, 4)
+        sched = Scheduler(store, now_fn=clock)
+        for i in range(8):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        victims = {p.meta.key() for p in store.pods.values() if p.spec.node_name == "n0"}
+        store.delete_node("n0")
+        m = ControllerManager(store, factory=SharedInformerFactory(store),
+                              controllers=["podgc"], now_fn=clock)
+        m.settle()
+        for key in victims:
+            assert store.get_pod(key) is None
+
+
+class TestCrashOnlyRecovery:
+    def test_scheduler_restart_rebuilds_from_store(self):
+        """Crash-only: a brand-new Scheduler over the same store resumes
+        exactly where the old one stopped (informers relist, §5.3)."""
+        store = ClusterStore()
+        _cluster(store, 10)
+        s1 = Scheduler(store)
+        for i in range(10):
+            store.create_pod(make_pod(f"a{i}").req({"cpu": "100m"}).obj())
+        s1.run_until_settled()
+        del s1  # crash
+        for i in range(10):
+            store.create_pod(make_pod(f"b{i}").req({"cpu": "100m"}).obj())
+        s2 = Scheduler(store)
+        s2.run_until_settled()
+        bound = [p for p in store.pods.values() if p.spec.node_name]
+        assert len(bound) == 20
+
+    def test_device_restart_resyncs(self):
+        """The device mirror is a cache: dropping it mid-stream (sidecar
+        crash analog) forces a full-generation resync and scheduling
+        continues (§5.3: restartable mid-stream)."""
+        store = ClusterStore()
+        _cluster(store, 12)
+        sched = TPUScheduler(store, batch_size=8)
+        for i in range(10):
+            store.create_pod(make_pod(f"a{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 10
+        sched.device = None  # device process crash
+        for i in range(10):
+            store.create_pod(make_pod(f"b{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 20
+        # placements respect capacity after resync
+        per_node = {}
+        for p in store.pods.values():
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert all(v <= 30 for v in per_node.values())
+
+    def test_assumed_pods_expire_after_ttl(self):
+        """Assume-TTL sweep (cache.go:731): an assume never confirmed by a
+        bind event expires and the node's resources free up."""
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = Scheduler(store, now_fn=clock, assume_ttl=30.0)
+        store.create_node(make_node("n1").capacity(
+            {"cpu": "1", "memory": "2Gi", "pods": 5}).obj())
+        pod = make_pod("ghost").req({"cpu": "900m"}).obj()
+        sched.cache.assume_pod(pod, "n1")
+        sched.cache.finish_binding(pod)  # expiry clock starts at finishBinding
+        clock.advance(31.0)
+        expired = sched.cache.cleanup()
+        assert [p.meta.name for p in expired] == ["ghost"]
+        ni = sched.cache.nodes["n1"]
+        assert ni.requested.milli_cpu == 0
