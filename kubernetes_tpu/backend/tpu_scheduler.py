@@ -220,7 +220,7 @@ class TPUScheduler(Scheduler):
             return
         self.batch_counter += 1
         key = jax.random.PRNGKey(self.batch_counter)
-        result = self.schedule_batch_fn(
+        result = self._run_batch_fn(
             pb, et, self.device.nt, self.device.tc, tb, key,
             topo_enabled=self.device.topo_enabled,
         )
@@ -235,6 +235,25 @@ class TPUScheduler(Scheduler):
                 if plugin.name() != "VolumeBinding":
                     return True
         return False
+
+    def _run_batch_fn(self, *args, **kwargs) -> BatchResult:
+        """Run the compiled batch program; if the Pallas fused-step kernel
+        fails to compile/execute on this hardware, permanently disable it
+        for the process and retry on the plain XLA path (graceful
+        degradation, §5.3: the compute backend must never take the
+        scheduler down with it)."""
+        import logging
+        import os
+
+        try:
+            return self.schedule_batch_fn(*args, **kwargs)
+        except Exception:  # noqa: BLE001 — any lowering/runtime failure
+            if os.environ.get("KTPU_PALLAS", "auto") == "0":
+                raise  # already on the XLA path: a real error
+            logging.getLogger(__name__).exception(
+                "pallas step failed; disabling KTPU_PALLAS and retrying via XLA")
+            os.environ["KTPU_PALLAS"] = "0"
+            return self.schedule_batch_fn(*args, **kwargs)
 
     def _materialize_masks(self, result: BatchResult) -> Dict[str, np.ndarray]:
         """Pull the per-plugin feasibility masks to host — ONLY on failure
