@@ -77,6 +77,7 @@ class Evaluator:
         self.min_pct = min_candidate_nodes_percentage
         self.min_abs = min_candidate_nodes_absolute
         self.rng = rng or random.Random(0)
+        self.prescreen_skips = 0  # candidates rejected by the max-free bound
 
     # ------------------------------------------------------------- top level
 
@@ -144,6 +145,41 @@ class Evaluator:
             n = num_nodes
         return self.rng.randrange(num_nodes) if num_nodes else 0, n
 
+    @staticmethod
+    def _max_free_prescreen(pod: Pod, potential: List[NodeInfo]) -> List[bool]:
+        """Vectorized candidate pre-screen (the batched-tensor analog of
+        DryRunPreemption's first fit check): a node where the pod does not
+        fit even with EVERY lower-priority pod removed can never survive the
+        full dry run — pod removal cannot free more than their requests.
+        Exact for the resource dimension, conservative overall."""
+        from ..api import resource as resource_api
+
+        preq = pod.resource_request()
+        p_cpu = preq.get(resource_api.CPU, 0)
+        p_mem = preq.get(resource_api.MEMORY, 0)
+        p_eph = preq.get(resource_api.EPHEMERAL_STORAGE, 0)
+        out = []
+        for ni in potential:
+            free_cpu = ni.allocatable.milli_cpu - ni.requested.milli_cpu
+            free_mem = ni.allocatable.memory - ni.requested.memory
+            free_eph = ni.allocatable.ephemeral_storage - ni.requested.ephemeral_storage
+            n_lower = 0
+            for p in ni.pods:
+                if p.spec.priority < pod.spec.priority:
+                    r = p.resource_request()
+                    free_cpu += r.get(resource_api.CPU, 0)
+                    free_mem += r.get(resource_api.MEMORY, 0)
+                    free_eph += r.get(resource_api.EPHEMERAL_STORAGE, 0)
+                    n_lower += 1
+            pods_free = ni.allocatable.allowed_pod_number - len(ni.pods) + n_lower
+            out.append(
+                p_cpu <= free_cpu
+                and p_mem <= free_mem
+                and p_eph <= free_eph
+                and pods_free >= 1
+            )
+        return out
+
     def find_candidates(
         self, pod: Pod, status_map: Dict[str, Status], node_infos: List[NodeInfo]
     ) -> Tuple[List[Candidate], List[str]]:
@@ -152,11 +188,16 @@ class Evaluator:
             return [], ["no node is eligible for preemption"]
         offset, num = self._offset_and_num_candidates(len(potential))
         pdbs = list(self.pdb_lister() if callable(self.pdb_lister) else self.pdb_lister)
+        feasible_bound = self._max_free_prescreen(pod, potential)
 
         candidates: List[Candidate] = []
         diagnosis: List[str] = []
         for i in range(len(potential)):
-            ni = potential[(offset + i) % len(potential)]
+            k = (offset + i) % len(potential)
+            ni = potential[k]
+            if not feasible_bound[k]:
+                self.prescreen_skips += 1
+                continue
             victims, n_viol, ok = self.select_victims_on_node(pod, ni, pdbs)
             if ok:
                 candidates.append(Candidate(ni.node.meta.name, victims, n_viol))

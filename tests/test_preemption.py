@@ -201,3 +201,60 @@ def test_nominated_node_cleared_for_lower_priority():
     if top.spec.node_name != "node-0":
         assert top.status.nominated_node_name == "node-0"
         assert mid is None or mid.status.nominated_node_name == "" or mid.spec.node_name
+
+
+class TestPrescreen:
+    """The max-free candidate pre-screen must never change outcomes, only
+    skip provably hopeless nodes."""
+
+    def test_hopeless_nodes_skipped_same_result(self):
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+        store = ClusterStore()
+        sched = Scheduler(store)
+        # n-full: high-priority pods fill it (nothing reclaimable);
+        # n-soft: low-priority pods fill it (preemptable)
+        store.create_node(make_node("n-full").capacity({"cpu": "2", "memory": "4Gi", "pods": 5}).obj())
+        store.create_node(make_node("n-soft").capacity({"cpu": "2", "memory": "4Gi", "pods": 5}).obj())
+        for i in range(2):
+            hi = make_pod(f"hi-{i}").req({"cpu": "900m"}).priority(1000).node("n-full").obj()
+            store.create_pod(hi)
+            store.pods[hi.key()].spec.node_name = "n-full"
+            lo = make_pod(f"lo-{i}").req({"cpu": "900m"}).priority(1).obj()
+            store.create_pod(lo)
+            store.pods[lo.key()].spec.node_name = "n-soft"
+        sched = Scheduler(store)  # rebuild: sees the fixed placements
+        # preemptor at priority 500: can evict lo-* on n-soft but not hi-*
+        store.create_pod(make_pod("preemptor").req({"cpu": "1800m"}).priority(500).obj())
+        sched.run_until_settled()
+        p = store.get_pod("default/preemptor")
+        assert (p.status.nominated_node_name or p.spec.node_name) == "n-soft"
+        # the evaluator provably skipped n-full (hi-priority only)
+        # (prescreen counter lives on the per-attempt evaluator; assert via
+        # outcome: victims were the lo pods)
+        assert store.get_pod("default/lo-0") is None
+        assert store.get_pod("default/hi-0") is not None
+
+    def test_prescreen_counts_skips(self):
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+        from kubernetes_tpu.framework.preemption import Evaluator
+        from kubernetes_tpu.framework.types import NodeInfo
+
+        # node with tiny capacity entirely used by HIGHER-priority pods:
+        # provably hopeless for the preemptor
+        full = NodeInfo(make_node("full").capacity({"cpu": "1", "memory": "1Gi", "pods": 2}).obj())
+        p_high = make_pod("hp").req({"cpu": "900m"}).priority(100).obj()
+        p_high.spec.node_name = "full"
+        full.add_pod(p_high)
+        preemptor = make_pod("pre").req({"cpu": "800m"}).priority(50).obj()
+        mask = Evaluator._max_free_prescreen(preemptor, [full])
+        assert mask == [False]
+        # same node but victim at LOWER priority: reclaimable
+        soft = NodeInfo(make_node("soft").capacity({"cpu": "1", "memory": "1Gi", "pods": 2}).obj())
+        p_low = make_pod("lp").req({"cpu": "900m"}).priority(1).obj()
+        p_low.spec.node_name = "soft"
+        soft.add_pod(p_low)
+        mask = Evaluator._max_free_prescreen(preemptor, [soft])
+        assert mask == [True]
