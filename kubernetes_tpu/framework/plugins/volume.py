@@ -254,13 +254,20 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
     Reserve assumes the chosen PV⇄PVC pairs (assume_cache.go analog);
     PreBind writes the binds through the API and they take effect
     immediately (the in-process store is its own PV controller).
+    Score (behind the VolumeCapacityPriority gate) prefers nodes whose
+    matched PVs are utilized most fully (volume_binding.go:296 + scorer.go).
     """
 
     STATE_KEY = "PreFilter/VolumeBinding"
 
-    def __init__(self, client=None):
+    def __init__(self, client=None, volume_capacity_priority: bool = None):
         self.client = client
         self._assumed: Dict[str, List[Tuple[str, str]]] = {}  # pod key -> [(pv, pvc)]
+        if volume_capacity_priority is None:
+            from ...utils.featuregate import DEFAULT_FEATURE_GATE
+
+            volume_capacity_priority = DEFAULT_FEATURE_GATE.enabled("VolumeCapacityPriority")
+        self.volume_capacity_priority = volume_capacity_priority
 
     def name(self) -> str:
         return names.VOLUME_BINDING
@@ -330,6 +337,34 @@ class VolumeBinding(PreFilterPlugin, FilterPlugin, ReservePlugin, PreBindPlugin)
             chosen.append((best.meta.name, pvc.meta.key()))
         s.node_bindings[node.meta.name] = chosen
         return OK
+
+    def score(self, state: CycleState, pod: Pod, node_name: str):
+        raise NotImplementedError  # runtime calls score_node with NodeInfo
+
+    def score_node(self, state: CycleState, pod: Pod, node_info: NodeInfo):
+        """Line-shaped utilization score over the node's chosen PVs
+        (scorer.go buildScorerFunction, default shape: 0%→0 .. 100%→100).
+        Feature-gated; 0 when off or no delayed claims (volume_binding.go:296)."""
+        if not self.volume_capacity_priority:
+            return 0, OK
+        try:
+            s: _BindingState = state.read(self.STATE_KEY)
+        except KeyError:
+            return 0, OK
+        bindings = s.node_bindings.get(node_info.node.meta.name, [])
+        if not bindings:
+            return 0, OK
+        total = 0.0
+        for pv_name, pvc_key in bindings:
+            pv = self.client.get_pv(pv_name)
+            pvc = self.client.get_pvc(pvc_key)
+            if pv is None or pvc is None or pv.capacity_bytes == 0:
+                continue
+            total += 100.0 * pvc.requested_bytes / pv.capacity_bytes
+        return int(total / len(bindings)), OK
+
+    def score_extensions(self):
+        return None
 
     def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         try:

@@ -173,3 +173,55 @@ def test_smallest_fitting_pv_chosen():
     store.create_pod(make_pod("p").req({"cpu": "100m"}).pvc("data").obj())
     s.run_until_settled()
     assert store.get_pvc("default/data").bound_pv == "small"
+
+
+class TestVolumeCapacityPriority:
+    def test_score_prefers_tighter_fit(self):
+        from kubernetes_tpu.api.types import (
+            BINDING_WAIT_FOR_FIRST_CONSUMER, ObjectMeta, PersistentVolume,
+            PersistentVolumeClaim, StorageClass,
+        )
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.framework.interface import CycleState
+        from kubernetes_tpu.framework.plugins.volume import VolumeBinding
+        from kubernetes_tpu.framework.types import NodeInfo
+
+        store = ClusterStore()
+        store.create_storage_class(StorageClass(
+            meta=ObjectMeta(name="wffc"),
+            volume_binding_mode=BINDING_WAIT_FOR_FIRST_CONSUMER))
+        # n1 has a tight 10GiB PV, n2 a loose 100GiB PV
+        store.create_pv(PersistentVolume(
+            meta=ObjectMeta(name="pv-tight"), storage_class="wffc",
+            capacity_bytes=10 * 2**30, node_affinity={"host": ("n1",)}))
+        store.create_pv(PersistentVolume(
+            meta=ObjectMeta(name="pv-loose"), storage_class="wffc",
+            capacity_bytes=100 * 2**30, node_affinity={"host": ("n2",)}))
+        store.create_pvc(PersistentVolumeClaim(
+            meta=ObjectMeta(name="claim"), storage_class="wffc",
+            requested_bytes=9 * 2**30))
+        pl = VolumeBinding(client=store, volume_capacity_priority=True)
+        pod = make_pod("p").pvc("claim").obj()
+        state = CycleState()
+        _, st = pl.pre_filter(state, pod)
+        assert st.is_success()
+        n1 = NodeInfo(make_node("n1").label("host", "n1").obj())
+        n2 = NodeInfo(make_node("n2").label("host", "n2").obj())
+        assert pl.filter(state, pod, n1).is_success()
+        assert pl.filter(state, pod, n2).is_success()
+        s1, _ = pl.score_node(state, pod, n1)
+        s2, _ = pl.score_node(state, pod, n2)
+        assert s1 == 90 and s2 == 9  # tight fit wins
+
+    def test_score_zero_when_gated_off(self):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.framework.interface import CycleState
+        from kubernetes_tpu.framework.plugins.volume import VolumeBinding
+        from kubernetes_tpu.framework.types import NodeInfo
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+
+        pl = VolumeBinding(client=ClusterStore(), volume_capacity_priority=False)
+        score, st = pl.score_node(CycleState(), make_pod("p").obj(),
+                                  NodeInfo(make_node("n").obj()))
+        assert score == 0 and st.is_success()
