@@ -79,6 +79,7 @@ class TPUScheduler(Scheduler):
         self.comparer_checks = 0
         self.comparer_mismatches = 0
         self.device: Optional[DeviceState] = None
+        self._batchable_cache: Dict[str, bool] = {}
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
         self.fallback_scheduled = 0
@@ -164,7 +165,28 @@ class TPUScheduler(Scheduler):
         hard-part 6)."""
         if pod.spec.volumes:
             return False
-        return True
+        # a non-default plugin set would diverge from the compiled program's
+        # semantics: only batch pods whose profile IS the default set
+        return self._framework_batchable(self.framework_for_pod(pod))
+
+    def _framework_batchable(self, fwk) -> bool:
+        """True iff the profile's filter/score plugin sets and weights match
+        what the compiled batch program implements (the default set). Custom
+        profiles fall back to the sequential oracle path wholesale."""
+        cached = self._batchable_cache.get(fwk.profile_name)
+        if cached is not None:
+            return cached
+        from ..framework.registry import DEFAULT_PLUGINS
+
+        ok = True
+        for point in ("pre_filter", "filter", "pre_score", "score"):
+            have = [(p.name(), w) for p, w in fwk.points.get(point, [])]
+            want = list(DEFAULT_PLUGINS.get(point, []))
+            if have != want:
+                ok = False
+                break
+        self._batchable_cache[fwk.profile_name] = ok
+        return ok
 
     # ------------------------------------------------------------- the batch cycle
 

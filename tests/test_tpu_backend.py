@@ -215,3 +215,38 @@ class TestDeviceHostComparer:
 
         sched = TPUScheduler(ClusterStore())
         assert sched.comparer_every_n == 0
+
+
+class TestCustomProfileFallsBack:
+    def test_non_default_profile_uses_oracle_path(self):
+        """A profile whose plugin set differs from the compiled program must
+        schedule via the sequential path (semantics over speed)."""
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+        from kubernetes_tpu.config.factory import scheduler_from_config
+
+        raw = {"profiles": [{
+            "schedulerName": "default-scheduler",
+            "plugins": {"score": {"disabled": [{"name": "*"}],
+                                   "enabled": [{"name": "NodeResourcesFit", "weight": 5}]}},
+        }]}
+        store = ClusterStore()
+        sched = scheduler_from_config(store, raw=raw, scheduler_cls=TPUScheduler)
+        store.create_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        assert store.get_pod("default/p").spec.node_name == "n1"
+        assert sched.fallback_scheduled == 1 and sched.batch_scheduled == 0
+
+    def test_default_profile_batches(self):
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        sched = TPUScheduler(store)
+        store.create_node(make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        assert sched.batch_scheduled == 1 and sched.fallback_scheduled == 0
