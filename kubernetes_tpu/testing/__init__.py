@@ -1,0 +1,7 @@
+"""Test infrastructure (the client-go fake clientset + reaction-hook role,
+kubernetes/fake/clientset_generated.go + testing/fixture.go).
+"""
+
+from .reactors import ReactionError, with_reactors
+
+__all__ = ["ReactionError", "with_reactors"]

@@ -1,0 +1,76 @@
+"""Reaction hooks (fake-clientset analog) + error-path scheduling, and a
+larger-scale smoke (20k nodes) for the capacity-growth path."""
+
+from kubernetes_tpu.api.types import Binding
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore, Conflict
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing import with_reactors
+from kubernetes_tpu.testing.reactors import raise_
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+class TestReactors:
+    def test_observe_calls(self):
+        store = ClusterStore()
+        tracker = with_reactors(store)
+        store.create_node(make_node("n1").obj())
+        store.create_pod(make_pod("p").obj())
+        verbs = [v for v, _ in tracker.calls]
+        assert verbs == ["create_node", "create_pod"]
+
+    def test_injected_bind_conflict_requeues(self):
+        """A bind that 409s must roll back the assume and retry — the
+        MakeDefaultErrorFunc path (scheduler.go:352) exercised via reactor."""
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = Scheduler(store, now_fn=clock)
+        tracker = with_reactors(store)
+        store.create_node(make_node("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        fail_once = {"left": 1}
+
+        def bind_conflict(verb, args):
+            if fail_once["left"]:
+                fail_once["left"] -= 1
+                raise_(Conflict("simulated bind 409"))
+            return False
+
+        tracker.prepend("bind", bind_conflict)
+        store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        assert store.get_pod("default/p").spec.node_name == ""  # first try failed
+        # error-path pods sit in unschedulableQ until the leftover flush
+        # (5min, scheduling_queue.go:463) or a cluster event
+        clock.advance(301.0)
+        sched.run_until_settled()
+        assert store.get_pod("default/p").spec.node_name == "n1"  # retried
+        # cache didn't leak the failed assume
+        assert sched.cache.nodes["n1"].requested.milli_cpu == 100
+
+    def test_swallowed_call(self):
+        store = ClusterStore()
+        tracker = with_reactors(store)
+        tracker.prepend("create_pod", lambda v, a: True)  # drop silently
+        store.create_pod(make_pod("ghost").obj())
+        assert store.get_pod("default/ghost") is None
+
+
+class TestScale:
+    def test_20k_nodes_capacity_growth(self):
+        """The TPU mirror grows node capacity by doubling; 20k nodes force
+        several growth resyncs and scheduling still works."""
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=64)
+        for i in range(20000):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 32}).obj())
+        for i in range(100):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 100
+        assert sched.device.caps.nodes >= 20000
+        nodes_used = {p.spec.node_name for p in store.pods.values()}
+        assert len(nodes_used) == 100  # least-allocated spreads on empty fleet
