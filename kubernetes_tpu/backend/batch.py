@@ -84,6 +84,12 @@ class BatchResult(NamedTuple):
     ports_ok: jax.Array      # [P, N] port availability at decision time
     spread_ok: jax.Array     # [P, N] PodTopologySpread filter at decision time
     ipa_ok: jax.Array        # [P, N] InterPodAffinity (all three checks)
+    # the scan's evolved carry: the post-batch dynamic node state. The host
+    # adopts these (DeviceState.adopt_commits) so the next sync uploads
+    # nothing for commit-only changes.
+    final_requested: Optional[jax.Array] = None      # [N, R] int32
+    final_nonzero: Optional[jax.Array] = None        # [N, R] int32
+    final_ports: Optional[jax.Array] = None          # [N, W] uint32
 
 
 def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
@@ -211,12 +217,14 @@ def schedule_batch_core(
             taint_raw, affinity_raw, image_score, pod_bits, jitter, pb.valid,
         )
         carry0 = (nt.requested.T, nt.nonzero_requested.T, nt.port_bits.T)
-        _, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
+        (f_req_t, f_nz_t, f_port_t), (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
             pstep, carry0, {"row": rows})
         return BatchResult(
             node_idx=node_idx, best_score=best, any_feasible=any_feasible,
             static_masks=static_masks, fit_ok=fit_ok, ports_ok=ports_ok,
             spread_ok=spread_ok, ipa_ok=ipa_ok,
+            final_requested=f_req_t.T, final_nonzero=f_nz_t.T,
+            final_ports=f_port_t.T,
         )
 
     def step(carry, xs):
@@ -311,8 +319,9 @@ def schedule_batch_core(
     else:
         seg_exist0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
     carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits, tc.sel_counts, seg_exist0)
-    _, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
+    final_carry, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
         step, carry0, xs)
+    f_req, f_nz, f_port, _sel, _seg = final_carry
 
     return BatchResult(
         node_idx=node_idx,
@@ -323,6 +332,9 @@ def schedule_batch_core(
         ports_ok=ports_ok,
         spread_ok=spread_ok,
         ipa_ok=ipa_ok,
+        final_requested=f_req,
+        final_nonzero=f_nz,
+        final_ports=f_port,
     )
 
 

@@ -71,6 +71,19 @@ class DeviceState:
         self._node_images: Dict[str, frozenset] = {}
         self.syncs = 0
         self.rows_uploaded = 0
+        self.rows_elided = 0
+        # host-side mirror of the device row content: lets sync skip rows
+        # whose re-encoded content already matches the device (in particular
+        # rows whose only change was an adopted batch commit). Initialized to
+        # the empty-row encoding, matching _empty_tensors (label_num is
+        # INT_NONE-filled, not zero).
+        empty_row = self.encoder.encode_node_row(NodeInfo())
+        self._mirror: Dict[str, np.ndarray] = {
+            field: np.broadcast_to(
+                np.asarray(empty_row[field], dtype), (caps.nodes,) + np.shape(empty_row[field])
+            ).copy()
+            for field, dtype in _ROW_FIELDS
+        }
 
     @property
     def tc(self):
@@ -133,14 +146,34 @@ class DeviceState:
 
         if not dirty:
             return 0
+        # content-diff against the mirror: a row whose re-encoded content
+        # already matches the device (e.g. its only change was an adopted
+        # batch commit) needs no upload
+        changed: List[Tuple[int, dict]] = []
+        for slot, ni in dirty:
+            row = self.encoder.encode_node_row(ni)
+            if all(
+                np.array_equal(np.asarray(row[f], dtype), self._mirror[f][slot])
+                for f, dtype in _ROW_FIELDS
+            ):
+                self.rows_elided += 1
+                continue
+            for f, dtype in _ROW_FIELDS:
+                self._mirror[f][slot] = np.asarray(row[f], dtype)
+            changed.append((slot, row))
+        if not changed and not images_changed:
+            return 0
+        if not changed:
+            # vocab-level image arrays changed but no rows did: reuse slot 0
+            changed = [(0, {f: self._mirror[f][0] for f, _ in _ROW_FIELDS})]
         # bucket-pad the row count to a power of two so the fused scatter
         # compiles once per bucket; padding repeats row 0 (idempotent set)
-        n = len(dirty)
+        n = len(changed)
         b = _bucket(n)
         slots = np.empty(b, np.int32)
-        slots[:n] = [s for s, _ in dirty]
+        slots[:n] = [s for s, _ in changed]
         slots[n:] = slots[0]
-        rows = [self.encoder.encode_node_row(ni) for _, ni in dirty]
+        rows = [r for _, r in changed]
         updates = {}
         for field, dtype in _ROW_FIELDS:
             stacked = np.empty((b,) + np.shape(rows[0][field]), dtype)
@@ -163,8 +196,35 @@ class DeviceState:
         self.nt = _apply_rows_jit(nt, jnp.asarray(slots), updates,
                                   image_sizes, image_num_nodes)
         self.syncs += 1
-        self.rows_uploaded += len(dirty)
-        return len(dirty)
+        self.rows_uploaded += n
+        return n
+
+    def adopt_commits(self, result, pb, node_idx: np.ndarray) -> None:
+        """Adopt the batch program's evolved dynamic state as the new device
+        truth and advance the mirror by the same per-slot adds, so the next
+        sync's content diff elides every row whose only change was this
+        batch's commits (the delta-upload saving of returning the carry)."""
+        import dataclasses as _dc
+
+        if result.final_requested is None:
+            return
+        self.nt = _dc.replace(
+            self.nt,
+            requested=result.final_requested,
+            nonzero_requested=result.final_nonzero,
+            port_bits=result.final_ports,
+        )
+        req = np.asarray(pb.req)
+        nz = np.asarray(pb.nonzero_req)
+        port_ids = np.asarray(pb.port_ids)
+        for i, slot in enumerate(node_idx):
+            if slot < 0:
+                continue
+            self._mirror["requested"][slot] += req[i]
+            self._mirror["nonzero_requested"][slot] += nz[i]
+            for pid in port_ids[i]:
+                if pid > 0:
+                    self._mirror["port_bits"][slot, pid >> 5] |= np.uint32(1) << np.uint32(pid & 31)
 
     def _track_images(self, name: str, ni: Optional[NodeInfo]) -> bool:
         """Maintain global image num-node counts (first-seen size wins,

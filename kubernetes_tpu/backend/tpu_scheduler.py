@@ -244,6 +244,7 @@ class TPUScheduler(Scheduler):
         key = jax.random.PRNGKey(self.batch_counter)
         result = self._run_batch_fn(
             pb, et, self.device.nt, self.device.tc, tb, key,
+            pb_for_adopt=pb,
             topo_enabled=self.device.topo_enabled,
         )
         self._commit_batch(batched, result, pod_cycle)
@@ -258,24 +259,28 @@ class TPUScheduler(Scheduler):
                     return True
         return False
 
-    def _run_batch_fn(self, *args, **kwargs) -> BatchResult:
+    def _run_batch_fn(self, *args, pb_for_adopt=None, **kwargs) -> BatchResult:
         """Run the compiled batch program; if the Pallas fused-step kernel
         fails to compile/execute on this hardware, permanently disable it
         for the process and retry on the plain XLA path (graceful
         degradation, §5.3: the compute backend must never take the
-        scheduler down with it)."""
+        scheduler down with it). On success, the program's evolved dynamic
+        state is adopted so the next sync elides commit-only row uploads."""
         import logging
         import os
 
         try:
-            return self.schedule_batch_fn(*args, **kwargs)
+            result = self.schedule_batch_fn(*args, **kwargs)
         except Exception:  # noqa: BLE001 — any lowering/runtime failure
             if os.environ.get("KTPU_PALLAS", "auto") == "0":
                 raise  # already on the XLA path: a real error
             logging.getLogger(__name__).exception(
                 "pallas step failed; disabling KTPU_PALLAS and retrying via XLA")
             os.environ["KTPU_PALLAS"] = "0"
-            return self.schedule_batch_fn(*args, **kwargs)
+            result = self.schedule_batch_fn(*args, **kwargs)
+        if pb_for_adopt is not None:
+            self.device.adopt_commits(result, pb_for_adopt, np.asarray(result.node_idx))
+        return result
 
     def _materialize_masks(self, result: BatchResult) -> Dict[str, np.ndarray]:
         """Pull the per-plugin feasibility masks to host — ONLY on failure

@@ -250,3 +250,54 @@ class TestCustomProfileFallsBack:
         store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
         sched.run_until_settled()
         assert sched.batch_scheduled == 1 and sched.fallback_scheduled == 0
+
+
+class TestCommitAdoption:
+    def test_commit_only_rows_elided(self):
+        """After a batch, the device adopts its own commits: the next sync
+        uploads nothing for rows whose only change was those commits."""
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=16)
+        for i in range(8):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 20}).obj())
+        for i in range(8):
+            store.create_pod(make_pod(f"a{i}").req({"cpu": "200m", "memory": "256Mi"}).obj())
+        sched.run_until_settled()
+        uploaded_first = sched.device.rows_uploaded
+        # second wave: the only prior-row changes are adopted commits
+        for i in range(8):
+            store.create_pod(make_pod(f"b{i}").req({"cpu": "200m", "memory": "256Mi"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 16
+        assert sched.device.rows_elided >= 8  # commit-only rows skipped
+        # and the second wave uploaded no rows at all (nothing else changed)
+        assert sched.device.rows_uploaded == uploaded_first
+
+    def test_adoption_survives_external_node_update(self):
+        """A real node change after adoption still uploads (content diff)."""
+        import dataclasses
+
+        from kubernetes_tpu.api.wrappers import make_node, make_pod
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=8)
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 20}).obj())
+        store.create_pod(make_pod("a").req({"cpu": "200m"}).obj())
+        sched.run_until_settled()
+        before = sched.device.rows_uploaded
+        node = store.nodes["n0"]
+        new = dataclasses.replace(node)
+        new.meta = dataclasses.replace(node.meta, labels={**node.meta.labels, "new": "label"})
+        store.update_node(new)
+        store.create_pod(make_pod("b").req({"cpu": "200m"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 2
+        assert sched.device.rows_uploaded > before  # label change uploaded
