@@ -20,7 +20,40 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
+
+
+def _probe_platform(timeout_s: float | None = None) -> str:
+    """Decide which jax platform this process should use, WITHOUT initializing
+    the backend in-process first (a failed/hung init poisons the process).
+
+    Probes the ambient platform (the axon TPU tunnel, if configured) in a
+    subprocess with a timeout — round 1 showed backend init can either raise
+    (BENCH_r01 rc=1) or hang (MULTICHIP_r01 rc=124).  Retries once, then falls
+    back to CPU.  Returns the platform label for the JSON line:
+    the real backend name, or "cpu-fallback" when the ambient platform died.
+    """
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu"
+    # Explicit non-cpu platform or auto-selection: probe in a subprocess —
+    # either can hang on a broken tunnel.
+    probe = "import jax; jax.devices(); print(jax.default_backend())"
+    for _attempt in range(2):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+        except subprocess.TimeoutExpired:
+            pass
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu-fallback"
 
 
 def build_cluster(store, n_nodes):
@@ -90,19 +123,54 @@ def main():
     n_seq = int(os.environ.get("BENCH_SEQ_PODS", 100))
     batch = int(os.environ.get("BENCH_BATCH", 128))
 
-    tpu_tput = run_tpu(n_nodes, n_init, n_measured, batch)
-    seq_tput = run_sequential(n_nodes, min(100, n_init), n_seq)
+    platform = _probe_platform()
+    if platform.startswith("cpu"):
+        # Env alone does not stick on relay-tunneled hosts (the platform
+        # registration hook can override it); force the config directly.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
 
-    print(
-        json.dumps(
-            {
-                "metric": "scheduling_throughput SchedulingBasic/5000Nodes",
-                "value": round(tpu_tput, 2),
-                "unit": "pods/s",
-                "vs_baseline": round(tpu_tput / seq_tput, 2),
-            }
-        )
-    )
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001
+            pass
+    record = {
+        "metric": "scheduling_throughput SchedulingBasic/5000Nodes",
+        "value": 0.0,
+        "unit": "pods/s",
+        "vs_baseline": 0.0,
+        "platform": platform,
+        # The sequential path is this repo's Python oracle scheduler, NOT the
+        # Go kube-scheduler (no Go toolchain in this image) — it is roughly an
+        # order of magnitude slower than the Go scheduler it stands in for.
+        "baseline": "python-oracle",
+    }
+    try:
+        tpu_tput = run_tpu(n_nodes, n_init, n_measured, batch)
+        seq_tput = run_sequential(n_nodes, min(100, n_init), n_seq)
+        record["value"] = round(tpu_tput, 2)
+        record["vs_baseline"] = round(tpu_tput / seq_tput, 2)
+    except Exception as exc:  # noqa: BLE001 — a number must always be emitted
+        if not platform.startswith("cpu"):
+            # Backend died mid-run (probe passed but the tunnel dropped):
+            # rerun the whole measurement on CPU in a fresh process. CPU runs
+            # never re-enter this branch, so the chain is depth-1; the timeout
+            # bounds a wedged child (the JSON contract must hold regardless).
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            try:
+                out = subprocess.run(
+                    [sys.executable, __file__], capture_output=True, text=True,
+                    env=env, timeout=float(os.environ.get("BENCH_RERUN_TIMEOUT", "900")),
+                )
+                line = (out.stdout.strip().splitlines() or [""])[-1]
+                rerun = json.loads(line)
+                rerun["platform"] = "cpu-fallback"
+                print(json.dumps(rerun))
+                return
+            except (subprocess.SubprocessError, json.JSONDecodeError, TypeError):
+                pass
+        record["error"] = f"{type(exc).__name__}: {exc}"[:300]
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

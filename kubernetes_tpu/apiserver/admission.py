@@ -11,7 +11,7 @@ store maps. The in-tree plugins modeled (the scheduling-relevant subset):
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from ..api import resource as resource_api
 from ..api.types import Pod, ResourceQuota
@@ -32,13 +32,23 @@ class AdmissionPlugin:
         """Mutating pass; may modify obj in place."""
 
     def validate(self, store, kind: str, obj) -> None:
-        """Validating pass; raise AdmissionError to reject."""
+        """Validating pass; raise AdmissionError to reject. Must be free of
+        store-state side effects — it runs outside the store lock and before
+        the duplicate-key check."""
+
+    def charge(self, store, kind: str, obj) -> Optional[Callable[[], None]]:
+        """Stateful admission step, run under the store lock immediately
+        before the object is inserted (after the duplicate-key check), so a
+        failed create never leaves residue. Returns an undo callable (or
+        None); raise AdmissionError to reject."""
+        return None
 
 
 class NamespaceLifecycle(AdmissionPlugin):
-    """plugin/namespace/lifecycle: no creates into terminating namespaces.
-    An absent namespace is tolerated for the default namespace only (tests
-    and the reference's bootstrap both rely on lazily-created defaults)."""
+    """plugin/namespace/lifecycle: no creates into terminating or absent
+    namespaces. An absent namespace is tolerated for the default namespace
+    only (the reference bootstraps ``default`` at startup; we model that as
+    lazy tolerance rather than pre-seeding every test store)."""
 
     name = "NamespaceLifecycle"
 
@@ -49,7 +59,12 @@ class NamespaceLifecycle(AdmissionPlugin):
         if kind not in self.NAMESPACED_KINDS:
             return
         ns = store.namespaces.get(obj.meta.namespace)
-        if ns is not None and ns.meta.deletion_timestamp:
+        if ns is None:
+            if obj.meta.namespace != "default":
+                raise AdmissionError(
+                    self.name, f"namespace {obj.meta.namespace!r} not found")
+            return
+        if ns.meta.deletion_timestamp:
             raise AdmissionError(self.name,
                                  f"namespace {obj.meta.namespace} is terminating")
 
@@ -83,30 +98,58 @@ def pod_quota_usage(pod: Pod) -> dict:
 
 class ResourceQuotaAdmission(AdmissionPlugin):
     """plugin/pkg/admission/resourcequota: a pod create must fit every
-    matching quota's remaining headroom; usage is charged synchronously
-    (the controller later reconciles drift from deletes)."""
+    matching quota's remaining headroom. The check+charge runs atomically in
+    ``charge()`` under the store lock after the duplicate-key check — usage is
+    updated only when the write will succeed, and rolled back if a later step
+    fails (mirrors the reference, where usage moves only on successful
+    writes; the controller reconciles drift from deletes)."""
 
     name = "ResourceQuota"
 
+    def _matching(self, store, obj):
+        return [rq for rq in store.resource_quotas.values()
+                if rq.meta.namespace == obj.meta.namespace]
+
+    def _check(self, rq: ResourceQuota, usage: dict) -> None:
+        for dim, amount in usage.items():
+            if dim not in rq.hard:
+                continue
+            if rq.used.get(dim, 0) + amount > rq.hard[dim]:
+                raise AdmissionError(
+                    self.name,
+                    f"exceeded quota {rq.meta.name}: {dim} "
+                    f"used {rq.used.get(dim, 0)} + requested {amount} > hard {rq.hard[dim]}",
+                )
+
     def validate(self, store, kind: str, obj) -> None:
+        # Advisory read-only fast-fail; the authoritative check is charge().
         if kind != "Pod":
             return
         usage = pod_quota_usage(obj)
-        for rq in store.resource_quotas.values():
-            if rq.meta.namespace != obj.meta.namespace:
-                continue
-            for dim, amount in usage.items():
-                if dim not in rq.hard:
-                    continue
-                if rq.used.get(dim, 0) + amount > rq.hard[dim]:
-                    raise AdmissionError(
-                        self.name,
-                        f"exceeded quota {rq.meta.name}: {dim} "
-                        f"used {rq.used.get(dim, 0)} + requested {amount} > hard {rq.hard[dim]}",
-                    )
+        for rq in self._matching(store, obj):
+            self._check(rq, usage)
+
+    def charge(self, store, kind: str, obj) -> Optional[Callable[[], None]]:
+        if kind != "Pod":
+            return None
+        usage = pod_quota_usage(obj)
+        quotas = self._matching(store, obj)
+        # Check ALL matching quotas before charging ANY, so a later quota's
+        # rejection never strands charges on an earlier one.
+        for rq in quotas:
+            self._check(rq, usage)
+        for rq in quotas:
             for dim, amount in usage.items():
                 if dim in rq.hard:
                     rq.used[dim] = rq.used.get(dim, 0) + amount
+
+        def undo() -> None:
+            for rq in quotas:
+                for dim, amount in usage.items():
+                    if dim in rq.hard:
+                        rq.used[dim] = rq.used.get(dim, 0) - amount
+
+        return undo
 
 
 def default_chain() -> List[AdmissionPlugin]:
@@ -124,3 +167,23 @@ class AdmissionChain:
             p.admit(store, kind, obj)
         for p in self.plugins:
             p.validate(store, kind, obj)
+
+    def charge(self, store, kind: str, obj) -> Callable[[], None]:
+        """Run every plugin's stateful charge step (under the store lock);
+        returns a combined undo. If any plugin rejects, charges already made
+        by earlier plugins are rolled back before the error propagates."""
+        undos: List[Callable[[], None]] = []
+
+        def undo_all() -> None:
+            for u in reversed(undos):
+                u()
+
+        for p in self.plugins:
+            try:
+                u = p.charge(store, kind, obj)
+            except AdmissionError:
+                undo_all()
+                raise
+            if u is not None:
+                undos.append(u)
+        return undo_all

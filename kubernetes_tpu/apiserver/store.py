@@ -263,9 +263,19 @@ class ClusterStore:
         with self._lock:
             if pod.key() in self.pods:
                 raise Conflict(f"pod {pod.key()} exists")
-            self._bump(pod)
-            self.pods[pod.key()] = pod
-            self._journal_event("Pod", ADDED, None, pod)
+            # Stateful admission (quota charge) runs atomically with the
+            # insert, after the duplicate-key check — a failed create can
+            # never strand usage (ADVICE r1: check-then-charge race).
+            undo_charge = (self.admission.charge(self, "Pod", pod)
+                           if self.admission is not None else None)
+            try:
+                self._bump(pod)
+                self.pods[pod.key()] = pod
+                self._journal_event("Pod", ADDED, None, pod)
+            except BaseException:
+                if undo_charge is not None:
+                    undo_charge()
+                raise
         self._notify("Pod", ADDED, None, pod)
 
     def update_pod(self, pod: Pod) -> None:

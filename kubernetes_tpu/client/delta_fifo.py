@@ -81,7 +81,13 @@ class DeltaFIFO:
             for obj in objects:
                 keys.add(self._key_of(obj))
                 self._queue_action(REPLACED, obj)
-            known = self._known() if self._known is not None else list(self._items.keys())
+            # Union of the consumer store's keys AND keys with queued un-popped
+            # deltas: a key whose Added is still queued but which is absent
+            # from the relist would otherwise never get a tombstone, leaving a
+            # deleted object in the informer cache until the next relist
+            # (client-go's Replace scans queued items for exactly this case).
+            known = set(self._known()) if self._known is not None else set()
+            known.update(self._items.keys())
             for key in known:
                 if key not in keys:
                     # deleted while we were disconnected; tombstone carries

@@ -53,7 +53,14 @@ int kt_canonical(const char* s, int cls, long long* out) {
                 if (in_frac) frac_digits++;
             } else if (!in_frac) {
                 return 2; // integer part too large to represent
-            } // extra fractional digits beyond 18 sig: truncated (ceil below keeps bound)
+            } else if (c != '0') {
+                // A nonzero fractional digit beyond 18 significant digits
+                // cannot be represented; silently dropping it can under-shoot
+                // the exact ceiling by far more than 1 ulp for large suffixes
+                // (e.g. Ei on cpu). Signal failure so the caller falls back to
+                // the exact Fraction path in api/resource.py.
+                return 7;
+            } // trailing fractional zeros beyond 18 sig digits: exactly representable
         } else if (c == '.') {
             if (in_frac) return 3;
             in_frac = 1;

@@ -83,6 +83,17 @@ class TestDeltaFIFO:
         assert types["default/kept"] == [REPLACED]
         assert types["default/gone"] == [D_DELETED]
 
+    def test_replace_tombstones_queued_unknown_keys(self):
+        # ADVICE r1 (low): a key with a queued, un-popped Added that is absent
+        # from the relist must still get a Deleted tombstone even though the
+        # consumer's store (known_objects) has never seen it.
+        f = self._fifo(known=lambda: [])
+        f.add(make_pod("flash").obj())  # never popped
+        f.replace([])                   # relist: object already gone
+        deltas = f.pop()
+        assert [d.type for d in deltas] == [D_ADDED, D_DELETED]
+        assert f.pop() is None
+
     def test_has_synced_after_initial_pop(self):
         f = self._fifo(known=lambda: [])
         f.replace([make_pod("a").obj(), make_pod("b").obj()])

@@ -400,6 +400,62 @@ class TestAdmissionAndQuota:
         # headroom restored: a new pod admits
         store.create_pod(make_pod("c").obj())
 
+    def test_failed_duplicate_create_does_not_charge_quota(self):
+        # ADVICE r1 (medium): charge must be atomic with the insert — a
+        # Conflict on duplicate key must leave usage untouched.
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.apiserver.store import Conflict
+
+        store = ClusterStore()
+        store.create_object("ResourceQuota", ResourceQuota(
+            meta=ObjectMeta(name="q"), hard={"pods": 10}))
+        store.create_pod(make_pod("a").obj())
+        import pytest as _pytest
+        for _ in range(2):
+            with _pytest.raises(Conflict):
+                store.create_pod(make_pod("a").obj())
+        rq = store.get_object("ResourceQuota", "default/q")
+        assert rq.used["pods"] == 1
+
+    def test_later_quota_rejection_rolls_back_earlier_quota(self):
+        # Drive charge() directly: the advisory validate() would reject first
+        # on the create path, leaving the rollback branch uncovered.
+        from kubernetes_tpu.api.types import ResourceQuota
+        from kubernetes_tpu.apiserver.admission import (
+            AdmissionChain, AdmissionError, ResourceQuotaAdmission)
+
+        store = ClusterStore()
+        store.admission = None  # quotas below are checked via charge() only
+        store.create_object("ResourceQuota", ResourceQuota(
+            meta=ObjectMeta(name="roomy"), hard={"pods": 10}))
+        store.create_object("ResourceQuota", ResourceQuota(
+            meta=ObjectMeta(name="tight"), hard={"requests.cpu": 100}))
+        chain = AdmissionChain([ResourceQuotaAdmission()])
+        import pytest as _pytest
+        with _pytest.raises(AdmissionError):
+            chain.charge(store, "Pod", make_pod("big").req({"cpu": "2"}).obj())
+        assert store.get_object("ResourceQuota", "default/roomy").used.get("pods", 0) == 0
+        # a fitting pod charges both quotas, and undo removes both charges
+        undo = chain.charge(store, "Pod", make_pod("ok").req({"cpu": "50m"}).obj())
+        assert store.get_object("ResourceQuota", "default/roomy").used["pods"] == 1
+        assert store.get_object("ResourceQuota", "default/tight").used["requests.cpu"] == 50
+        undo()
+        assert store.get_object("ResourceQuota", "default/roomy").used["pods"] == 0
+        assert store.get_object("ResourceQuota", "default/tight").used["requests.cpu"] == 0
+
+    def test_absent_namespace_rejects_creates_except_default(self):
+        # ADVICE r1 (low): the reference rejects creates into nonexistent
+        # namespaces; only the bootstrap 'default' namespace is lazy here.
+        from kubernetes_tpu.apiserver.admission import AdmissionError
+
+        store = ClusterStore()
+        import pytest as _pytest
+        with _pytest.raises(AdmissionError):
+            store.create_pod(make_pod("p", namespace="typo-ns").obj())
+        store.create_pod(make_pod("p").obj())  # default: tolerated
+        store.create_namespace(Namespace(meta=ObjectMeta(name="real")))
+        store.create_pod(make_pod("p2", namespace="real").obj())
+
     def test_priority_class_resolved_at_admission(self):
         from kubernetes_tpu.api.types import PriorityClass
 

@@ -59,6 +59,18 @@ class TestNativeParity:
         with pytest.raises(ValueError):
             res.canonical("cpu", "not-a-quantity")
 
+    def test_deep_fractional_tail_falls_back_to_exact_path(self):
+        # ADVICE r1 (low): nonzero fractional digits beyond 18 significant
+        # digits must NOT be silently truncated (the ceil would undershoot);
+        # the native parser signals failure and canonical() goes exact.
+        deep = "1.0000000000000000001"  # 19 sig digits, nonzero tail
+        assert canonical_native(deep, CLS_COUNT) is None
+        assert res.canonical("pods", deep) == 2       # exact ceil
+        assert res.canonical("cpu", deep) == 1001     # 1000.0...1m -> ceil
+        # trailing ZERO tail is exactly representable: native may keep it
+        zeros = "1.0000000000000000000"
+        assert res.canonical("pods", zeros) == 1
+
     def test_negative_and_whitespace(self):
         assert canonical_native(" 100m ", CLS_MILLI) == 100
         assert canonical_native("-1", CLS_MILLI) == -1000
