@@ -82,6 +82,7 @@ class TPUScheduler(Scheduler):
         self._batchable_cache: Dict[str, bool] = {}
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
+        self._batch_t0 = 0.0
         self.fallback_scheduled = 0
         self.batch_scheduled = 0
 
@@ -202,6 +203,10 @@ class TPUScheduler(Scheduler):
         qps = self.queue.pop_batch(self.batch_size)
         if not qps:
             return 0
+        # Attempt-latency clock for every pod in this batch: pop → commit.
+        # Batching trades per-pod latency for throughput; the p99 of this
+        # histogram is the iso-latency evidence BASELINE.md demands.
+        self._batch_t0 = self.now_fn()
         pod_cycle = self.queue.scheduling_cycle
 
         buffer: List[QueuedPodInfo] = []
@@ -226,10 +231,12 @@ class TPUScheduler(Scheduler):
     def _flush_batch(self, batched: List[QueuedPodInfo], pod_cycle: int) -> None:
         if not batched:
             return
+        t0 = self.now_fn()
         self.cache.update_snapshot(self.snapshot)
         for _attempt in range(8):
             try:
                 self.device.sync(self.snapshot)
+                t_sync = self.now_fn()
                 pods = [qp.pod for qp in batched]
                 pb, et = self.device.encoder.encode_pods(pods)
                 tb = self.device.sig_table.encode_topo(pods)
@@ -240,6 +247,7 @@ class TPUScheduler(Scheduler):
             for qp in batched:  # capacities refuse to converge
                 self._schedule_fallback(qp, pod_cycle)
             return
+        t_enc = self.now_fn()
         self.batch_counter += 1
         key = jax.random.PRNGKey(self.batch_counter)
         result = self._run_batch_fn(
@@ -247,7 +255,15 @@ class TPUScheduler(Scheduler):
             pb_for_adopt=pb,
             topo_enabled=self.device.topo_enabled,
         )
+        t_compute = self.now_fn()
         self._commit_batch(batched, result, pod_cycle)
+        t_commit = self.now_fn()
+        dur = self.smetrics.device_batch_duration
+        dur.observe(t_sync - t0, "upload")
+        dur.observe(t_enc - t_sync, "encode")
+        dur.observe(t_compute - t_enc, "compute")
+        dur.observe(t_commit - t_compute, "commit")
+        self.smetrics.device_batch_size.observe(len(batched))
 
     @staticmethod
     def _bind_path_needs_prefilter(fwk) -> bool:
@@ -307,6 +323,8 @@ class TPUScheduler(Scheduler):
                 node_name = slot_names.get(idx)
                 if node_name is None:  # stale slot — should not happen
                     self._fail(fwk, qp, Status.error(f"stale node slot {idx}"), pod_cycle)
+                    self.smetrics.observe_attempt(
+                        "error", fwk.profile_name, self.now_fn() - self._batch_t0)
                     continue
                 state = CycleState()
                 # Reserve/Permit/PreBind plugins may read PreFilter state;
@@ -318,13 +336,18 @@ class TPUScheduler(Scheduler):
                 if (self.comparer_every_n
                         and self.batch_scheduled % self.comparer_every_n == 0):
                     self._compare_with_oracle(fwk, pod, node_name)
-                self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle)
+                # t0 = batch pop time: the binding cycle observes the
+                # scheduled-attempt duration (pop → bind) exactly once.
+                self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle,
+                                     t0=self._batch_t0)
                 self.batch_scheduled += 1
             else:
                 if masks is None:
                     masks = self._materialize_masks(result)
                 diagnosis = self._diagnose(i, masks, slot_names)
                 self._fail(fwk, qp, Status.unschedulable("no feasible node"), pod_cycle, diagnosis)
+                self.smetrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, self.now_fn() - self._batch_t0)
 
     def _diagnose(self, i: int, masks: Dict[str, np.ndarray], slot_names: Dict[int, str]) -> Diagnosis:
         """Reconstruct per-node first-failing plugin in filter config order so
@@ -391,7 +414,8 @@ class TPUScheduler(Scheduler):
         cycles = 0
         no_progress = 0
         while cycles < max_cycles:
-            before = self.metrics["scheduled"]
+            before_sched = self.metrics["scheduled"]
+            before_unsched = self.queue.pending_pods()["unschedulable"]
             n = self.schedule_batch_cycle()
             if n == 0:
                 if flush:
@@ -403,7 +427,14 @@ class TPUScheduler(Scheduler):
                         continue
                 break
             cycles += n
-            if self.metrics["scheduled"] > before:
+            pending = self.queue.pending_pods()
+            # Progress = placements OR pods newly parked unschedulable (they
+            # stay parked until an external event; failure-draining a batch
+            # IS progress toward settling). Only cycles that neither place
+            # nor park — a pod flapping straight back into activeQ — pay the
+            # wait and count toward the bound.
+            if (self.metrics["scheduled"] > before_sched
+                    or pending["unschedulable"] > before_unsched):
                 no_progress = 0
             else:
                 no_progress += 1

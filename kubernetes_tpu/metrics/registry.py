@@ -99,12 +99,39 @@ class Histogram:
     def percentile(self, q: float, *labels: str) -> float:
         """Linear-interpolated percentile from bucket counts (scrape-side
         estimate, like Prometheus histogram_quantile)."""
-        total = self._totals.get(labels, 0)
-        if total == 0:
+        with self._lock:
+            counts = list(self._counts.get(labels, ()))
+            total = self._totals.get(labels, 0)
+        return self._interp(q, counts, total)
+
+    def snapshot(self, *labels: str):
+        """Opaque phase marker for ``percentile_since`` — lets a harness
+        report percentiles over just a measured phase (scrape-side delta,
+        like two Prometheus scrapes around the phase)."""
+        with self._lock:
+            return (list(self._counts.get(labels, ())), self._totals.get(labels, 0))
+
+    def percentile_since(self, snap, q: float, *labels: str) -> float:
+        prev_counts, prev_total = snap
+        with self._lock:
+            counts_now = list(self._counts.get(labels, ()))
+            total_now = self._totals.get(labels, 0)
+        if not counts_now:
+            return 0.0
+        if not prev_counts:
+            prev_counts = [0] * len(counts_now)
+        counts = [a - b for a, b in zip(counts_now, prev_counts)]
+        return self._interp(q, counts, total_now - prev_total)
+
+    def count_since(self, snap, *labels: str) -> int:
+        with self._lock:
+            return self._totals.get(labels, 0) - snap[1]
+
+    def _interp(self, q: float, counts, total: int) -> float:
+        if total <= 0 or not counts:
             return 0.0
         target = q * total
-        counts = self._counts[labels]  # cumulative (le semantics)
-        for i, b in enumerate(self.buckets):
+        for i, b in enumerate(self.buckets):  # counts are cumulative (le)
             if counts[i] >= target:
                 in_bucket = counts[i] - (counts[i - 1] if i else 0)
                 below = counts[i - 1] if i else 0

@@ -26,6 +26,7 @@ CapacityError when a static capacity is exceeded — callers re-encode with
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -71,6 +72,37 @@ class CapacityError(Exception):
         super().__init__(f"capacity exceeded: {dimension} needs {needed} > {capacity}")
 
 
+_NEVER = "__never__"  # expr-key sentinel: term matches nothing
+
+
+@dataclass
+class _PodTemplate:
+    """Builder-independent encode of one pod-spec shape.
+
+    Thousands of workload pods share a handful of spec shapes (the
+    scheduler_perf pod templates), so the expensive per-pod work — quantity
+    canonicalization, toleration/selector/affinity compilation — is done once
+    per shape. Expr *keys* (not batch-local slots) are stored; they are
+    re-interned into each batch's ExprTable, which dedups by key. Vocab ids
+    inside keys/arrays are append-only and therefore stable for the life of
+    the encoder (growth rebuilds the encoder, resetting this cache)."""
+
+    priority: int
+    req: np.ndarray
+    nzreq: np.ndarray
+    tol_key: np.ndarray
+    tol_val: np.ndarray
+    tol_op: np.ndarray
+    tol_effect: np.ndarray
+    tol_prefer: np.ndarray
+    tolerates_unsched: bool
+    sel_keys: Tuple
+    term_keys: Tuple            # ((expr_key | _NEVER, ...), ...)
+    pref_terms: Tuple           # ((weight, (expr_key | _NEVER, ...)), ...)
+    port_wanted: Tuple[int, ...]
+    n_containers: int
+
+
 class ClusterEncoder:
     def __init__(self, caps: Capacities):
         self.caps = caps
@@ -81,6 +113,8 @@ class ClusterEncoder:
         self.scalar_vocab = Vocab("scalar-resources")
         self.node_slots: Dict[str, int] = {}          # node name -> slot
         self._free_slots: List[int] = []
+        self._pod_templates: Dict[Tuple, _PodTemplate] = {}
+        self._template_cap = 4096                     # runaway-shape guard
 
     # ------------------------------------------------------------- vocab plumbing
 
@@ -286,8 +320,151 @@ class ClusterEncoder:
 
     # ------------------------------------------------------------- pod batch
 
+    def _pod_sig(self, pod: Pod) -> Optional[Tuple]:
+        """Hashable signature of every spec field the template encodes, or
+        None when the pod is uncacheable (matchFields terms embed the current
+        node-slot mapping, which churns)."""
+        spec = pod.spec
+        a = spec.affinity
+        terms: Sequence = ()
+        prefs: Sequence = ()
+        if a and a.node_affinity:
+            if a.node_affinity.required:
+                terms = a.node_affinity.required.terms
+            prefs = tuple(a.node_affinity.preferred)
+        for t in terms:
+            if t.match_fields_name is not None:
+                return None
+        for wt in prefs:
+            if wt.preference.match_fields_name is not None:
+                return None
+
+        def reqs(c):
+            return tuple(sorted((r, str(q)) for r, q in c.requests.items()))
+
+        def exprs(term):
+            return tuple((r.key, r.operator, tuple(r.values))
+                         for r in term.match_expressions)
+
+        try:
+            return (
+                tuple(reqs(c) for c in spec.containers),
+                tuple(reqs(c) for c in spec.init_containers),
+                tuple(sorted((r, str(q)) for r, q in spec.overhead.items())),
+                spec.priority,
+                tuple((t.key, t.operator, t.value, t.effect) for t in spec.tolerations),
+                tuple(spec.node_selector.items()),
+                tuple(exprs(t) for t in terms),
+                tuple((wt.weight, exprs(wt.preference)) for wt in prefs),
+                tuple((cp.host_ip, cp.protocol, cp.host_port) for cp in pod.host_ports()),
+                len(spec.containers),
+            )
+        except TypeError:  # unhashable field value: just skip caching
+            return None
+
+    def _build_template(self, pod: Pod) -> _PodTemplate:
+        caps = self.caps
+        kb = _KeyBuilder()
+
+        r = pod.resource_request()
+        r[resource_api.PODS] = 1
+        nz = nonzero_request(pod.resource_request())
+        nz[resource_api.PODS] = 1
+
+        tols = pod.spec.tolerations
+        if len(tols) > caps.tolerations:
+            raise CapacityError("tolerations", len(tols), caps.tolerations)
+        tol_key = np.zeros(caps.tolerations, np.int32)
+        tol_val = np.zeros(caps.tolerations, np.int32)
+        tol_op = np.zeros(caps.tolerations, np.int32)
+        tol_effect = np.zeros(caps.tolerations, np.int32)
+        tol_prefer = np.zeros(caps.tolerations, bool)
+        for i, t in enumerate(tols):
+            tol_key[i] = self.key_slot(t.key) if t.key else 0
+            tol_op[i] = schema.TOL_EXISTS if t.operator == TOLERATION_OP_EXISTS else schema.TOL_EQUAL
+            if t.key and tol_op[i] == schema.TOL_EQUAL:
+                tol_val[i] = self.value_id(t.key, t.value)
+            tol_effect[i] = _EFFECT_CODE[t.effect]
+            tol_prefer[i] = t.effect in ("", TAINT_PREFER_NO_SCHEDULE)
+
+        # nodeSelector map → AND of single-value IN exprs
+        sel = list(pod.spec.node_selector.items())
+        if len(sel) > caps.sel_exprs:
+            raise CapacityError("sel_exprs", len(sel), caps.sel_exprs)
+        sel_keys = tuple(
+            self._expr_from_requirement(Requirement(k, IN, (v,)), kb) for k, v in sel)
+
+        def term_key_row(term):
+            n_exprs = len(term.match_expressions) + (term.match_fields_name is not None)
+            if n_exprs > caps.term_exprs:
+                raise CapacityError("term_exprs", n_exprs, caps.term_exprs)
+            if not term.match_expressions and term.match_fields_name is None:
+                # empty term matches nothing (nodeaffinity semantics)
+                return (kb.never_slot(),)
+            row = [self._expr_from_requirement(r_, kb) for r_ in term.match_expressions]
+            if term.match_fields_name is not None:
+                tgt = self.node_slots.get(term.match_fields_name, -2)
+                row.append((schema.OP_NODE_NAME, 0, tgt, frozenset()))
+            return tuple(row)
+
+        a = pod.spec.affinity
+        terms: Sequence = ()
+        if a and a.node_affinity and a.node_affinity.required:
+            terms = a.node_affinity.required.terms
+        if len(terms) > caps.terms:
+            raise CapacityError("terms", len(terms), caps.terms)
+        term_keys = tuple(term_key_row(t) for t in terms)
+
+        prefs = tuple(a.node_affinity.preferred) if a and a.node_affinity else ()
+        if len(prefs) > caps.pref_terms:
+            raise CapacityError("pref_terms", len(prefs), caps.pref_terms)
+        pref_terms = tuple((wt.weight, term_key_row(wt.preference)) for wt in prefs)
+
+        # host ports: specific IP wants (ip,…) OR (0.0.0.0,…); wildcard wants ("*",…)
+        wanted: List[int] = []
+        for cp in pod.host_ports():
+            ip = cp.host_ip or "0.0.0.0"
+            if ip == "0.0.0.0":
+                wanted.append(self.port_id("*", cp.protocol, cp.host_port))
+            else:
+                wanted.append(self.port_id(ip, cp.protocol, cp.host_port))
+                wanted.append(self.port_id("0.0.0.0", cp.protocol, cp.host_port))
+        wanted = list(dict.fromkeys(wanted))  # dedupe (repeat hostPorts across containers)
+        if len(wanted) > caps.ports:
+            raise CapacityError("ports", len(wanted), caps.ports)
+        if len(pod.spec.containers) > caps.containers:
+            raise CapacityError("containers", len(pod.spec.containers), caps.containers)
+
+        return _PodTemplate(
+            priority=pod.spec.priority,
+            req=self.resource_vec(r),
+            nzreq=self.resource_vec(nz),
+            tol_key=tol_key, tol_val=tol_val, tol_op=tol_op,
+            tol_effect=tol_effect, tol_prefer=tol_prefer,
+            tolerates_unsched=any(t.tolerates(_UNSCHEDULABLE_TAINT) for t in tols),
+            sel_keys=sel_keys,
+            term_keys=term_keys,
+            pref_terms=pref_terms,
+            port_wanted=tuple(wanted),
+            n_containers=len(pod.spec.containers),
+        )
+
+    def _template_for(self, pod: Pod) -> _PodTemplate:
+        sig = self._pod_sig(pod)
+        if sig is None:
+            return self._build_template(pod)
+        tmpl = self._pod_templates.get(sig)
+        if tmpl is None:
+            tmpl = self._build_template(pod)
+            if len(self._pod_templates) >= self._template_cap:
+                self._pod_templates.clear()
+            self._pod_templates[sig] = tmpl
+        return tmpl
+
     def encode_pods(self, pods: Sequence[Pod]) -> Tuple["schema.PodBatch", "schema.ExprTable"]:
         import jax.numpy as jnp
+
+        from ..framework.plugins.imagelocality import normalized_image_name
 
         caps = self.caps
         P = caps.pods
@@ -316,100 +493,36 @@ class ClusterEncoder:
         num_containers = np.zeros(P, np.int32)
 
         for p, pod in enumerate(pods):
+            tmpl = self._template_for(pod)
             valid[p] = True
-            priority[p] = pod.spec.priority
-            r = pod.resource_request()
-            r[resource_api.PODS] = 1
-            req[p] = self.resource_vec(r)
-            nz = nonzero_request(pod.resource_request())
-            nz[resource_api.PODS] = 1
-            nzreq[p] = self.resource_vec(nz)
+            priority[p] = tmpl.priority
+            req[p] = tmpl.req
+            nzreq[p] = tmpl.nzreq
+            tol_key[p] = tmpl.tol_key
+            tol_val[p] = tmpl.tol_val
+            tol_op[p] = tmpl.tol_op
+            tol_effect[p] = tmpl.tol_effect
+            tol_prefer[p] = tmpl.tol_prefer
+            tolerates_unsched[p] = tmpl.tolerates_unsched
+            for i, k in enumerate(tmpl.sel_keys):
+                sel_idx[p, i] = builder.slot(k)
+            for t_i, keys in enumerate(tmpl.term_keys):
+                term_valid[p, t_i] = True
+                for e_i, k in enumerate(keys):
+                    term_idx[p, t_i, e_i] = builder.slot(k)
+            for t_i, (w, keys) in enumerate(tmpl.pref_terms):
+                pref_weight[p, t_i] = w
+                for e_i, k in enumerate(keys):
+                    pref_idx[p, t_i, e_i] = builder.slot(k)
+            port_ids[p, : len(tmpl.port_wanted)] = tmpl.port_wanted
+            num_containers[p] = tmpl.n_containers
+            # per-pod (never cached): node-slot binding + image-vocab lookup
+            # (slots churn with nodes; the image vocab grows as nodes report)
             if pod.spec.node_name:
                 node_name[p] = self.node_slots.get(pod.spec.node_name, -2)  # -2: unknown ⇒ never matches
-
-            tols = pod.spec.tolerations
-            if len(tols) > caps.tolerations:
-                raise CapacityError("tolerations", len(tols), caps.tolerations)
-            for i, t in enumerate(tols):
-                tol_key[p, i] = self.key_slot(t.key) if t.key else 0
-                tol_op[p, i] = schema.TOL_EXISTS if t.operator == TOLERATION_OP_EXISTS else schema.TOL_EQUAL
-                if t.key and tol_op[p, i] == schema.TOL_EQUAL:
-                    tol_val[p, i] = self.value_id(t.key, t.value)
-                tol_effect[p, i] = _EFFECT_CODE[t.effect]
-                tol_prefer[p, i] = t.effect in ("", TAINT_PREFER_NO_SCHEDULE)
-            tolerates_unsched[p] = any(t.tolerates(_UNSCHEDULABLE_TAINT) for t in tols)
-
-            # nodeSelector map → AND of single-value IN exprs
-            sel = list(pod.spec.node_selector.items())
-            if len(sel) > caps.sel_exprs:
-                raise CapacityError("sel_exprs", len(sel), caps.sel_exprs)
-            for i, (k, v) in enumerate(sel):
-                sel_idx[p, i] = self._expr_from_requirement(Requirement(k, IN, (v,)), builder)
-
-            # required node affinity terms
-            a = pod.spec.affinity
-            terms = ()
-            if a and a.node_affinity and a.node_affinity.required:
-                terms = a.node_affinity.required.terms
-            if len(terms) > caps.terms:
-                raise CapacityError("terms", len(terms), caps.terms)
-            for t_i, term in enumerate(terms):
-                n_exprs = len(term.match_expressions) + (term.match_fields_name is not None)
-                if n_exprs > caps.term_exprs:
-                    raise CapacityError("term_exprs", n_exprs, caps.term_exprs)
-                term_valid[p, t_i] = True
-                e_i = 0
-                if not term.match_expressions and term.match_fields_name is None:
-                    # empty term matches nothing (nodeaffinity semantics)
-                    term_idx[p, t_i, 0] = builder.never_slot()
-                    continue
-                for r_ in term.match_expressions:
-                    term_idx[p, t_i, e_i] = self._expr_from_requirement(r_, builder)
-                    e_i += 1
-                if term.match_fields_name is not None:
-                    tgt = self.node_slots.get(term.match_fields_name, -2)
-                    term_idx[p, t_i, e_i] = builder.slot((schema.OP_NODE_NAME, 0, tgt, frozenset()))
-
-            # preferred node affinity
-            prefs = list(a.node_affinity.preferred) if a and a.node_affinity else []
-            if len(prefs) > caps.pref_terms:
-                raise CapacityError("pref_terms", len(prefs), caps.pref_terms)
-            for t_i, wterm in enumerate(prefs):
-                pref_weight[p, t_i] = wterm.weight
-                term = wterm.preference
-                if not term.match_expressions and term.match_fields_name is None:
-                    pref_idx[p, t_i, 0] = builder.never_slot()
-                    continue
-                e_i = 0
-                for r_ in term.match_expressions:
-                    pref_idx[p, t_i, e_i] = self._expr_from_requirement(r_, builder)
-                    e_i += 1
-                if term.match_fields_name is not None:
-                    tgt = self.node_slots.get(term.match_fields_name, -2)
-                    pref_idx[p, t_i, e_i] = builder.slot((schema.OP_NODE_NAME, 0, tgt, frozenset()))
-
-            # host ports: specific IP wants (ip,…) OR (0.0.0.0,…); wildcard wants ("*",…)
-            wanted: List[int] = []
-            for cp in pod.host_ports():
-                ip = cp.host_ip or "0.0.0.0"
-                if ip == "0.0.0.0":
-                    wanted.append(self.port_id("*", cp.protocol, cp.host_port))
-                else:
-                    wanted.append(self.port_id(ip, cp.protocol, cp.host_port))
-                    wanted.append(self.port_id("0.0.0.0", cp.protocol, cp.host_port))
-            wanted = list(dict.fromkeys(wanted))  # dedupe (repeat hostPorts across containers)
-            if len(wanted) > caps.ports:
-                raise CapacityError("ports", len(wanted), caps.ports)
-            port_ids[p, : len(wanted)] = wanted
-
-            # container images (lookup only: an image on no node scores 0)
-            from ..framework.plugins.imagelocality import normalized_image_name
-
-            imgs = [self.image_vocab.lookup(normalized_image_name(c.image)) for c in pod.spec.containers]
-            if len(imgs) > caps.containers:
-                raise CapacityError("containers", len(imgs), caps.containers)
+            imgs = [self.image_vocab.lookup(normalized_image_name(c.image))
+                    for c in pod.spec.containers]
             image_ids[p, : len(imgs)] = imgs
-            num_containers[p] = len(pod.spec.containers)
 
         batch = schema.PodBatch(
             valid=jnp.asarray(valid),
@@ -433,6 +546,19 @@ class ClusterEncoder:
             num_containers=jnp.asarray(num_containers),
         )
         return batch, builder.table()
+
+
+class _KeyBuilder:
+    """Builder shim for template construction: returns expr KEYS, deferring
+    slot interning to the per-batch _ExprBuilder."""
+
+    @staticmethod
+    def slot(key: Tuple) -> Tuple:
+        return key
+
+    @staticmethod
+    def never_slot() -> Tuple:
+        return (schema.OP_IN, 0, 0, frozenset())
 
 
 class _ExprBuilder:

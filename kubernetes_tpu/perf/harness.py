@@ -118,6 +118,19 @@ def _pod_wrapper(i: int, prefix: str, params: dict):
         pw.label(k, str(v).format(i=i))
     if params.get("priority") is not None:
         pw.priority(int(params["priority"]))
+    if params.get("pod_affinity_labels"):
+        # pod-with-pod-(anti-)affinity.yaml shape: the pod carries the labels
+        # its own required (anti-)affinity term selects on.
+        from ..api.types import LabelSelector
+
+        match = dict(params["pod_affinity_labels"])
+        for k, v in match.items():
+            pw.label(k, v)
+        pw.pod_affinity(
+            params.get("pod_affinity_key", "kubernetes.io/hostname"),
+            LabelSelector(match_labels=match),
+            anti=bool(params.get("anti")),
+        )
     if params.get("spread_topology_key"):
         from ..api.types import LabelSelector, TopologySpreadConstraint, DO_NOT_SCHEDULE
 
@@ -187,6 +200,14 @@ class Runner:
         def scheduled_count():
             return self.scheduler.metrics["scheduled"]
 
+        # Attempt-latency percentiles over just the measured phase
+        # (scrape-delta around the phase, like metricsCollector in util.go).
+        from ..config.types import DEFAULT_SCHEDULER_NAME
+
+        hist = self.scheduler.smetrics.scheduling_attempt_duration
+        profile = DEFAULT_SCHEDULER_NAME
+        lat_snaps = {res: hist.snapshot(res, profile)
+                     for res in ("scheduled", "unschedulable")}
         col = ThroughputCollector(scheduled_count, interval=collector_interval)
         col.start(time.monotonic())
         for _ in range(count):
@@ -211,6 +232,18 @@ class Runner:
         col.finish(time.monotonic())
         summary = col.summary()
         self.data_items.append(DataItem(data=summary, unit="pods/s", labels={"Name": label}))
+        for res, snap in lat_snaps.items():
+            if hist.count_since(snap, res, profile) == 0:
+                continue
+            self.data_items.append(DataItem(
+                data={
+                    "Perc50": hist.percentile_since(snap, 0.50, res, profile),
+                    "Perc90": hist.percentile_since(snap, 0.90, res, profile),
+                    "Perc99": hist.percentile_since(snap, 0.99, res, profile),
+                },
+                unit="s",
+                labels={"Name": "scheduling_attempt_duration_seconds", "result": res},
+            ))
         return summary
 
     # ---- config-driven entry ----

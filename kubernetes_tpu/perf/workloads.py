@@ -38,6 +38,49 @@ def topology_spreading(nodes=5000, init_pods=5000, measured=2000) -> dict:
     }
 
 
+def scheduling_pod_anti_affinity(nodes=5000, init_pods=1000, measured=1000) -> dict:
+    """performance-config.yaml:23-50 SchedulingPodAntiAffinity: every pod
+    carries color=green and a required anti-affinity to color=green on the
+    hostname topology — each node accepts at most one such pod."""
+    pod = {
+        "req": {"cpu": "100m", "memory": "500Mi"},
+        "pod_affinity_key": "kubernetes.io/hostname",
+        "pod_affinity_labels": {"color": "green"},
+        "anti": True,
+    }
+    return {
+        "name": f"SchedulingPodAntiAffinity/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "anti", **pod},
+        ],
+    }
+
+
+def scheduling_pod_affinity(nodes=5000, init_pods=5000, measured=1000) -> dict:
+    """performance-config.yaml:168-198 SchedulingPodAffinity: all nodes share
+    one zone; pods carry color=blue and required affinity to color=blue on
+    the zone key (co-location in the single shared domain)."""
+    pod = {
+        "req": {"cpu": "100m", "memory": "500Mi"},
+        "pod_affinity_key": "topology.kubernetes.io/zone",
+        "pod_affinity_labels": {"color": "blue"},
+    }
+    return {
+        "name": f"SchedulingPodAffinity/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes,
+             "labels": {"topology.kubernetes.io/zone": "zone1",
+                        "kubernetes.io/hostname": "node-{i}"}},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "aff", **pod},
+        ],
+    }
+
+
 def unschedulable(nodes=5000, measured=2000) -> dict:
     """Unschedulable pods stress the failure path (performance-config.yaml
     Unschedulable): measured pods request impossible cpu."""
@@ -84,6 +127,8 @@ def scheduling_churn(nodes=1000, measured=1000) -> dict:
 
 TEST_CASES = {
     "SchedulingBasic": scheduling_basic,
+    "SchedulingPodAntiAffinity": scheduling_pod_anti_affinity,
+    "SchedulingPodAffinity": scheduling_pod_affinity,
     "TopologySpreading": topology_spreading,
     "Unschedulable": unschedulable,
     "PreemptionBasic": preemption_basic,

@@ -1,0 +1,21 @@
+"""Platform pinning for entrypoints.
+
+On relay-tunneled TPU hosts the platform-registration hook can override the
+``JAX_PLATFORMS`` environment variable, so pinning requires BOTH the env var
+(read at import) and ``jax.config.update`` (wins for the lazily-initialized
+backend). Call before any jax array op has run.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — older config name; env var still applies
+        pass

@@ -47,6 +47,7 @@ def test_bench_emits_json_under_broken_platform():
     env = _broken_ambient_env(
         BENCH_NODES="64", BENCH_INIT_PODS="8", BENCH_PODS="8",
         BENCH_SEQ_PODS="4", BENCH_BATCH="8", BENCH_PROBE_TIMEOUT="10",
+        BENCH_MATRIX="0",  # matrix rows run at full reference sizes
     )
     proc = subprocess.run(
         [sys.executable, "bench.py"], cwd=REPO, env=env,

@@ -224,3 +224,30 @@ def test_select_host_infeasible():
     idx, _, ok = select.select_host(total, feasible, jax.random.PRNGKey(0))
     assert int(idx[0]) == -1 and not bool(ok[0])
     assert int(idx[1]) == 3 and bool(ok[1])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_encode_template_cache_parity(seed):
+    """Cold-cache and warm-cache encodes of the same pods must be
+    byte-identical (the template cache only skips recomputation)."""
+    import dataclasses
+
+    from kubernetes_tpu.ops.encode import ClusterEncoder
+    from kubernetes_tpu.ops.schema import Capacities
+
+    rng = random.Random(seed)
+    infos = random_cluster(rng, 24)
+    pods = random_pods(rng, 32, 24)
+
+    enc = ClusterEncoder(Capacities(nodes=32, pods=32, value_words=32))
+    enc.encode_snapshot(infos)
+    cold_b, cold_t = enc.encode_pods(pods)
+    assert enc._pod_templates  # shapes were cached
+    warm_b, warm_t = enc.encode_pods(pods)
+
+    for f in dataclasses.fields(cold_b):
+        a, b = getattr(cold_b, f.name), getattr(warm_b, f.name)
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f.name
+    for f in dataclasses.fields(cold_t):
+        a, b = getattr(cold_t, f.name), getattr(warm_t, f.name)
+        assert np.array_equal(np.asarray(a), np.asarray(b)), f.name

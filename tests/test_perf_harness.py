@@ -27,18 +27,44 @@ def test_throughput_collector_sampling():
 def test_scheduling_basic_oracle():
     tc = TEST_CASES["SchedulingBasic"](nodes=50, init_pods=20, measured=30)
     items = run_workload(tc, backend="oracle")
-    assert len(items) == 1
-    assert items[0].unit == "pods/s"
-    assert items[0].labels["TestCase"] == "SchedulingBasic/50Nodes"
+    tput = [it for it in items if it.labels["Name"] == "SchedulingThroughput"]
+    assert len(tput) == 1
+    assert tput[0].unit == "pods/s"
+    assert tput[0].labels["TestCase"] == "SchedulingBasic/50Nodes"
     doc = json.loads(data_items_to_json(items))
     assert doc["version"] == "v1"
     assert "Average" in doc["dataItems"][0]["data"]
+    # measured-phase attempt-latency percentiles ride along (util.go:204
+    # metricsCollector analog)
+    lat = [it for it in items
+           if it.labels["Name"] == "scheduling_attempt_duration_seconds"
+           and it.labels["result"] == "scheduled"]
+    assert len(lat) == 1 and lat[0].unit == "s"
+    assert lat[0].data["Perc99"] >= lat[0].data["Perc50"] > 0
 
 
 def test_scheduling_basic_tpu_backend():
     tc = TEST_CASES["SchedulingBasic"](nodes=32, init_pods=10, measured=20)
     items = run_workload(tc, backend="tpu", batch_size=16)
     assert items and items[0].unit == "pods/s"
+    lat = [it for it in items
+           if it.labels["Name"] == "scheduling_attempt_duration_seconds"
+           and it.labels["result"] == "scheduled"]
+    assert len(lat) == 1 and lat[0].data["Perc99"] > 0  # batch path observes
+
+
+def test_pod_anti_affinity_workload_tpu():
+    tc = TEST_CASES["SchedulingPodAntiAffinity"](nodes=24, init_pods=8, measured=12)
+    items = run_workload(tc, backend="tpu", batch_size=8)
+    tput = [it for it in items if it.labels["Name"] == "SchedulingThroughput"]
+    assert tput and tput[0].data["Average"] > 0
+
+
+def test_pod_affinity_workload_tpu():
+    tc = TEST_CASES["SchedulingPodAffinity"](nodes=16, init_pods=6, measured=8)
+    items = run_workload(tc, backend="tpu", batch_size=8)
+    tput = [it for it in items if it.labels["Name"] == "SchedulingThroughput"]
+    assert tput and tput[0].data["Average"] > 0
 
 
 def test_preemption_workload():
@@ -50,7 +76,7 @@ def test_preemption_workload():
 def test_unschedulable_workload_completes():
     tc = TEST_CASES["Unschedulable"](nodes=16, measured=10)
     items = run_workload(tc, backend="oracle")
-    assert items == [] or all(it.unit == "pods/s" for it in items)
+    assert all(it.unit in ("pods/s", "s") for it in items)
 
 
 def test_churn_workload():
