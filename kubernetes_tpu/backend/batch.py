@@ -86,10 +86,15 @@ class BatchResult(NamedTuple):
     ipa_ok: jax.Array        # [P, N] InterPodAffinity (all three checks)
     # the scan's evolved carry: the post-batch dynamic node state. The host
     # adopts these (DeviceState.adopt_commits) so the next sync uploads
-    # nothing for commit-only changes.
+    # nothing for commit-only changes — and the async pipeline dispatches
+    # batch k+1 directly on them (still-unmaterialized device futures) while
+    # the host commits batch k.
     final_requested: Optional[jax.Array] = None      # [N, R] int32
     final_nonzero: Optional[jax.Array] = None        # [N, R] int32
     final_ports: Optional[jax.Array] = None          # [N, W] uint32
+    # evolved topology carry (None on the pallas / topo-disabled paths)
+    final_sel_counts: Optional[jax.Array] = None     # same shape as tc.sel_counts
+    final_seg_exist: Optional[jax.Array] = None      # [T, Vd] int32
 
 
 def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
@@ -124,6 +129,7 @@ def schedule_batch_core(
     axis_name: Optional[str] = None,
     num_shards: int = 1,
     pallas: Optional[str] = None,
+    topo_carry: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> BatchResult:
     """The traceable body; nt's node axis may be a shard (axis_name set).
     ``topo_enabled`` is a trace-time flag: batches with no spread constraints,
@@ -318,10 +324,11 @@ def schedule_batch_core(
         seg_exist0 = topo_static.seg_exist0
     else:
         seg_exist0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
-    carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits, tc.sel_counts, seg_exist0)
+    sel0, seg0 = (tc.sel_counts, seg_exist0) if topo_carry is None else topo_carry
+    carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits, sel0, seg0)
     final_carry, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
         step, carry0, xs)
-    f_req, f_nz, f_port, _sel, _seg = final_carry
+    f_req, f_nz, f_port, f_sel, f_seg = final_carry
 
     return BatchResult(
         node_idx=node_idx,
@@ -335,6 +342,8 @@ def schedule_batch_core(
         final_requested=f_req,
         final_nonzero=f_nz,
         final_ports=f_port,
+        final_sel_counts=f_sel,
+        final_seg_exist=f_seg,
     )
 
 
@@ -349,19 +358,22 @@ def schedule_batch(
     weights_key: Tuple[Tuple[str, float], ...] = tuple(sorted(DEFAULT_WEIGHTS.items())),
     topo_enabled: bool = True,
     pallas: Optional[str] = None,
+    topo_carry: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> BatchResult:
     return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
-                               pallas=pallas)
+                               pallas=pallas, topo_carry=topo_carry)
 
 
 def build_schedule_batch_fn(weights: Dict[str, float] = None):
     """Bind plugin weights statically; returns
-    fn(pb, et, nt, tc, tb, key, topo_enabled=True) -> BatchResult."""
+    fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None)
+    -> BatchResult."""
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
 
-    def fn(pb, et, nt, tc, tb, key, topo_enabled=True):
+    def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None):
         mode = pallas_mode(nt, None, topo_enabled)  # env read outside jit
         return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
-                              topo_enabled=topo_enabled, pallas=mode)
+                              topo_enabled=topo_enabled, pallas=mode,
+                              topo_carry=topo_carry)
 
     return fn

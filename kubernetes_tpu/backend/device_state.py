@@ -199,11 +199,21 @@ class DeviceState:
         self.rows_uploaded += n
         return n
 
-    def adopt_commits(self, result, pb, node_idx: np.ndarray) -> None:
+    def has_dirty(self, snapshot: Snapshot) -> bool:
+        """Cheap generation-only probe: would sync() find any dirty or
+        removed node? In the async pipeline, any dirtiness at dispatch time
+        is by construction an EXTERNAL change (the in-flight batch's commits
+        are not in the cache yet), which breaks the device-carry chain."""
+        for name, ni in snapshot.node_info_map.items():
+            if self._uploaded_gen.get(name) != ni.generation:
+                return True
+        return any(n not in snapshot.node_info_map for n in self._uploaded_gen)
+
+    def adopt_device(self, result) -> None:
         """Adopt the batch program's evolved dynamic state as the new device
-        truth and advance the mirror by the same per-slot adds, so the next
-        sync's content diff elides every row whose only change was this
-        batch's commits (the delta-upload saving of returning the carry)."""
+        truth. The arrays may still be unmaterialized futures — this never
+        blocks, which is what lets the pipeline dispatch the next batch while
+        the host commits this one."""
         import dataclasses as _dc
 
         if result.final_requested is None:
@@ -214,6 +224,14 @@ class DeviceState:
             nonzero_requested=result.final_nonzero,
             port_bits=result.final_ports,
         )
+
+    def adopt_commits(self, result, pb, node_idx: np.ndarray) -> None:
+        """Advance the host mirror by the batch's per-slot adds, so the next
+        sync's content diff elides every row whose only change was this
+        batch's commits (the delta-upload saving of returning the carry).
+        Call adopt_device() first (or together, for the synchronous path)."""
+        if result.final_requested is None:
+            return
         req = np.asarray(pb.req)
         nz = np.asarray(pb.nonzero_req)
         port_ids = np.asarray(pb.port_ids)

@@ -85,6 +85,14 @@ class TPUScheduler(Scheduler):
         self._batch_t0 = 0.0
         self.fallback_scheduled = 0
         self.batch_scheduled = 0
+        # async pipeline (SURVEY §2.7 P3 analog): at most one dispatched
+        # batch in flight; its host commit overlaps the next batch's device
+        # compute. KTPU_PIPELINE=0 forces the synchronous path.
+        import os
+
+        self._pipeline_enabled = os.environ.get("KTPU_PIPELINE", "1") != "0"
+        self._inflight: Optional[_Inflight] = None
+        self.pipelined_batches = 0
 
     # ------------------------------------------------------------- device mgmt
 
