@@ -303,6 +303,11 @@ class TPUScheduler(Scheduler):
             os.environ["KTPU_PALLAS"] = "0"
             result = self.schedule_batch_fn(*args, **kwargs)
         if pb_for_adopt is not None:
+            # both halves of the adopt, in order: device arrays first (never
+            # blocks — futures), then the host mirror that makes the next
+            # sync's content diff elide commit-only rows. Missing either one
+            # leaves device and mirror divergent (r2's stale-device bug).
+            self.device.adopt_device(result)
             self.device.adopt_commits(result, pb_for_adopt, np.asarray(result.node_idx))
         return result
 

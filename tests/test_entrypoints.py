@@ -22,8 +22,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def _broken_ambient_env(**extra):
     env = dict(os.environ)
     # Simulate the driver's ambient env: a platform selection that cannot
-    # initialize on this machine, and no virtual-device forcing.
-    env["JAX_PLATFORMS"] = "tpu"
+    # initialize on this machine, and no virtual-device forcing. "cuda" is
+    # guaranteed absent in this image (r2 used "tpu", which stopped being
+    # broken the moment the relay came back up), and the axon sitecustomize
+    # must come off PYTHONPATH — it force-registers the relay platform no
+    # matter what JAX_PLATFORMS says.
+    env["JAX_PLATFORMS"] = "cuda"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    )
     env.pop("XLA_FLAGS", None)
     env.pop("KTPU_TEST_PLATFORM", None)
     env.update(extra)
