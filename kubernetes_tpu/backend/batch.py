@@ -36,6 +36,7 @@ from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..ops import filters, pallas_step, scores, topology
@@ -84,6 +85,11 @@ class BatchResult(NamedTuple):
     ports_ok: jax.Array      # [P, N] port availability at decision time
     spread_ok: jax.Array     # [P, N] PodTopologySpread filter at decision time
     ipa_ok: jax.Array        # [P, N] InterPodAffinity (all three checks)
+    # [P, N] int8: 0 = feasible, else 1-based index into the filter config
+    # order (tpu_scheduler._ATTRIBUTION_ORDER) of the first failing plugin.
+    # Diagnosis on the host is ONE device→host read of this array instead of
+    # eight mask reads — each read is a full relay round-trip on this TPU.
+    first_fail: jax.Array
     # the scan's evolved carry: the post-batch dynamic node state. The host
     # adopts these (DeviceState.adopt_commits) so the next sync uploads
     # nothing for commit-only changes — and the async pipeline dispatches
@@ -101,7 +107,10 @@ def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
     """[P, W] uint32: each pod's wanted-port ids as a bitset (for commit)."""
     P, MP = pb.port_ids.shape
     word_idx = (pb.port_ids >> 5).astype(jnp.int32)
-    bit = jnp.where(pb.port_ids > 0, jnp.uint32(1) << (pb.port_ids & 31).astype(jnp.uint32), 0)
+    # np.uint32, not jnp.uint32: an in-trace jax scalar becomes a captured
+    # device-buffer constant, which the axon relay re-fetches every loop
+    # iteration (see ops/select.py NEG_INF note)
+    bit = jnp.where(pb.port_ids > 0, np.uint32(1) << (pb.port_ids & 31).astype(jnp.uint32), 0)
     out = jnp.zeros((P, words), jnp.uint32)
     # ids are deduplicated at encode time, so add == bitwise-or here
     return out.at[jnp.arange(P)[:, None], word_idx].add(bit)
@@ -137,8 +146,14 @@ def schedule_batch_core(
     whole topology path dead-code-eliminated (the common fast case)."""
     weights = dict(weights_key)
     N = nt.capacity  # local shard size under shard_map
+    if key.ndim == 0:
+        # scalar seed: derive the key in-program. The eager host-side
+        # jax.random.PRNGKey costs two relay round-trips per batch once the
+        # session has synchronized (see ops/select.py NEG_INF note); a
+        # traced derivation is free.
+        key = jax.random.PRNGKey(key)
     if axis_name is None:
-        slot_offset = jnp.int32(0)
+        slot_offset = np.int32(0)
     else:
         slot_offset = (lax.axis_index(axis_name) * N).astype(jnp.int32)
 
@@ -159,6 +174,14 @@ def schedule_batch_core(
     static_ok = nt.valid[None, :] & pb.valid[:, None]
     for m in static_masks.values():
         static_ok = static_ok & m
+
+    # static half of the first-failing-plugin table (ids follow the filter
+    # config order in tpu_scheduler._ATTRIBUTION_ORDER; 0 = passes). Reverse
+    # assignment order makes the earliest failing plugin win.
+    static_ff = jnp.zeros(static_ok.shape, jnp.int8)
+    for sid, name in ((4, "NodeAffinity"), (3, "TaintToleration"),
+                      (2, "NodeName"), (1, "NodeUnschedulable")):
+        static_ff = jnp.where(~static_masks[name], np.int8(sid), static_ff)
 
     taint_raw = scores.score_taint_toleration(pb, nt)            # [P, N]
     affinity_raw = scores.score_node_affinity(pb, et, nt, expr_match)
@@ -191,7 +214,7 @@ def schedule_batch_core(
         # in one VMEM-resident kernel (ops/pallas_step.py)
         interp = pallas == "interpret"
         alloc_t = nt.allocatable.T
-        wvec = jnp.asarray([[
+        wvec = np.asarray([[
             weights["NodeResourcesFit"],
             weights["NodeResourcesBalancedAllocation"],
             weights["TaintToleration"],
@@ -203,7 +226,7 @@ def schedule_batch_core(
         def pstep(carry, xs):
             req_t, nz_t, port_t = carry
             (p_req, p_nz, p_static_ok, _p_affok, p_taint, p_aff, p_img, p_bits,
-             p_jitter, p_valid) = xs["row"]
+             p_jitter, p_valid, p_sff) = xs["row"]
             out = pallas_step.fused_step(
                 alloc_t, req_t, nz_t, port_t,
                 p_req[:, None], p_nz[:, None], p_bits[:, None],
@@ -213,22 +236,26 @@ def schedule_batch_core(
                 interpret=interp,
             )
             req_t, nz_t, port_t, idx, best, anyf, fit, ports_ok = out
+            ff = p_sff
+            ff = jnp.where((ff == 0) & ~ports_ok[0], np.int8(5), ff)
+            ff = jnp.where((ff == 0) & ~fit[0], np.int8(6), ff)
             return (req_t, nz_t, port_t), (
                 idx[0, 0], best[0, 0], anyf[0, 0] > 0,
-                fit[0], ports_ok[0], ones_pn, ones_pn,
+                fit[0], ports_ok[0], ones_pn, ones_pn, ff,
             )
 
         rows = (
             pb.req, pb.nonzero_req, static_ok, static_masks["NodeAffinity"],
             taint_raw, affinity_raw, image_score, pod_bits, jitter, pb.valid,
+            static_ff,
         )
         carry0 = (nt.requested.T, nt.nonzero_requested.T, nt.port_bits.T)
-        (f_req_t, f_nz_t, f_port_t), (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
+        (f_req_t, f_nz_t, f_port_t), (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok, first_fail) = lax.scan(
             pstep, carry0, {"row": rows})
         return BatchResult(
             node_idx=node_idx, best_score=best, any_feasible=any_feasible,
             static_masks=static_masks, fit_ok=fit_ok, ports_ok=ports_ok,
-            spread_ok=spread_ok, ipa_ok=ipa_ok,
+            spread_ok=spread_ok, ipa_ok=ipa_ok, first_fail=first_fail,
             final_requested=f_req_t.T, final_nonzero=f_nz_t.T,
             final_ports=f_port_t.T,
         )
@@ -237,7 +264,7 @@ def schedule_batch_core(
         req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist = carry
         row = xs["row"]
         (p_req, p_nz, p_static_ok, p_affinity_ok, p_taint, p_aff, p_img, p_bits,
-         p_jitter, p_valid) = row
+         p_jitter, p_valid, p_sff) = row
 
         free = nt.allocatable - req_dyn                           # [N, R]
         fit_ok = jnp.all((p_req[None, :] <= free) | (p_req[None, :] == 0), axis=-1)
@@ -288,13 +315,13 @@ def schedule_batch_core(
         any_feasible = _gmax(jnp.any(feasible), axis_name) & p_valid
 
         if axis_name is None:
-            mine = jnp.bool_(True)
+            mine = np.True_
             global_idx = local_idx
             best = total[local_idx]
         else:
             global_best = _gmax(local_best, axis_name)
             axis = lax.axis_index(axis_name).astype(jnp.int32)
-            winner_axis = _gmin(jnp.where(local_best >= global_best, axis, jnp.int32(2**30)), axis_name)
+            winner_axis = _gmin(jnp.where(local_best >= global_best, axis, np.int32(2**30)), axis_name)
             mine = axis == winner_axis
             global_idx = _gsum(jnp.where(mine, local_idx + slot_offset, 0), axis_name).astype(jnp.int32)
             best = _gsum(jnp.where(mine, total[local_idx], 0.0), axis_name)
@@ -310,13 +337,19 @@ def schedule_batch_core(
                 sel_counts, seg_exist, topo_static.dom_t, local_idx,
                 any_feasible, mine, tbx["pod_sig_mask"], tbx["pod_term_mask"], axis_name)
         out_idx = jnp.where(any_feasible, global_idx, -1)
+        ff = p_sff
+        ff = jnp.where((ff == 0) & ~ports_ok, np.int8(5), ff)
+        ff = jnp.where((ff == 0) & ~fit_ok, np.int8(6), ff)
+        if topo_enabled:
+            ff = jnp.where((ff == 0) & ~spread_ok, np.int8(7), ff)
+            ff = jnp.where((ff == 0) & ~ipa_ok, np.int8(8), ff)
         return (req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist), (
-            out_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok,
+            out_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok, ff,
         )
 
     rows = (
         pb.req, pb.nonzero_req, static_ok, static_masks["NodeAffinity"], taint_raw,
-        affinity_raw, image_score, pod_bits, jitter, pb.valid,
+        affinity_raw, image_score, pod_bits, jitter, pb.valid, static_ff,
     )
     xs = {"row": rows}
     if topo_enabled:
@@ -326,7 +359,7 @@ def schedule_batch_core(
         seg_exist0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
     sel0, seg0 = (tc.sel_counts, seg_exist0) if topo_carry is None else topo_carry
     carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits, sel0, seg0)
-    final_carry, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok) = lax.scan(
+    final_carry, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok, first_fail) = lax.scan(
         step, carry0, xs)
     f_req, f_nz, f_port, f_sel, f_seg = final_carry
 
@@ -339,6 +372,7 @@ def schedule_batch_core(
         ports_ok=ports_ok,
         spread_ok=spread_ok,
         ipa_ok=ipa_ok,
+        first_fail=first_fail,
         final_requested=f_req,
         final_nonzero=f_nz,
         final_ports=f_port,

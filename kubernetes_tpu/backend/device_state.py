@@ -199,6 +199,50 @@ class DeviceState:
         self.rows_uploaded += n
         return n
 
+    def reconcile(self, snapshot: Snapshot) -> int:
+        """Elide-only sync for the pipelined steady state: refresh
+        ``_uploaded_gen`` for dirty rows whose re-encoded content already
+        equals the mirror — i.e. rows whose only change was an adopted batch
+        commit. Rows that would need a REAL upload are left dirty on
+        purpose: at reconcile time the device may already carry the NEXT
+        dispatched batch's adopted state, and scattering host rows into it
+        would erase in-flight commits (device/host divergence the content
+        diff then elides forever). Leaving them dirty makes the next
+        ``has_dirty`` probe break the carry chain, and the safe drain+sync
+        path repairs everything. Returns the number of rows left dirty."""
+        left = 0
+        current = set()
+        for name, ni in snapshot.node_info_map.items():
+            current.add(name)
+            if self._uploaded_gen.get(name) == ni.generation:
+                continue
+            if name not in self._uploaded_gen:
+                left += 1  # new node: needs a real upload
+                continue
+            if self._node_images.get(name, frozenset()) != frozenset(ni.image_states):
+                left += 1  # image vocab change: needs a real upload
+                continue
+            slot = self.encoder.node_slots.get(name)
+            if slot is None:
+                left += 1
+                continue
+            try:
+                row = self.encoder.encode_node_row(ni)
+            except CapacityError:
+                left += 1
+                continue
+            if all(
+                np.array_equal(np.asarray(row[f], dtype), self._mirror[f][slot])
+                for f, dtype in _ROW_FIELDS
+            ):
+                self._uploaded_gen[name] = ni.generation
+                self.rows_elided += 1
+                self.sig_table.recount_node(slot, ni)
+            else:
+                left += 1
+        left += sum(1 for n in self._uploaded_gen if n not in current)  # removals
+        return left
+
     def has_dirty(self, snapshot: Snapshot) -> bool:
         """Cheap generation-only probe: would sync() find any dirty or
         removed node? In the async pipeline, any dirtiness at dispatch time
@@ -225,16 +269,21 @@ class DeviceState:
             port_bits=result.final_ports,
         )
 
-    def adopt_commits(self, result, pb, node_idx: np.ndarray) -> None:
+    def adopt_commits(self, result, host_pb: dict, node_idx: np.ndarray) -> None:
         """Advance the host mirror by the batch's per-slot adds, so the next
         sync's content diff elides every row whose only change was this
         batch's commits (the delta-upload saving of returning the carry).
-        Call adopt_device() first (or together, for the synchronous path)."""
+
+        ``host_pb`` is the encoder's host-side copy of the pod batch
+        (ClusterEncoder.last_host_pb) — reading the device PodBatch back
+        would cost a relay round-trip per array. Runs at COMMIT time (the
+        mirror only matters before the next sync, which a drain precedes);
+        adopt_device runs at dispatch time and never blocks."""
         if result.final_requested is None:
             return
-        req = np.asarray(pb.req)
-        nz = np.asarray(pb.nonzero_req)
-        port_ids = np.asarray(pb.port_ids)
+        req = host_pb["req"]
+        nz = host_pb["nonzero_req"]
+        port_ids = host_pb["port_ids"]
         for i, slot in enumerate(node_idx):
             if slot < 0:
                 continue

@@ -20,6 +20,7 @@ Flow per batch cycle:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -44,6 +45,19 @@ _ATTRIBUTION_ORDER = (
     ("PodTopologySpread", "node(s) didn't match pod topology spread constraints"),
     ("InterPodAffinity", "node(s) didn't match pod affinity/anti-affinity rules"),
 )
+
+
+@dataclasses.dataclass
+class _Inflight:
+    """One dispatched-but-uncommitted batch (SURVEY §2.7 P3: the device
+    computes batch k+1 while the host commits batch k). The result's arrays
+    are unmaterialized device futures until the commit touches them."""
+
+    qps: List[QueuedPodInfo]
+    result: BatchResult
+    pod_cycle: int
+    t0: float  # batch pop time — the attempt-latency clock
+    host_pb: dict  # encoder's host copy of req/nonzero_req/port_ids
 
 
 def _enable_compilation_cache() -> None:
@@ -82,9 +96,12 @@ class TPUScheduler(Scheduler):
         self._batchable_cache: Dict[str, bool] = {}
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
-        self._batch_t0 = 0.0
         self.fallback_scheduled = 0
         self.batch_scheduled = 0
+        # run_until_settled sets this when it gives up with pods still
+        # pending (ADVICE r2: harness consumers must be able to distinguish
+        # settled from abandoned)
+        self.settle_abandoned = False
         # async pipeline (SURVEY §2.7 P3 analog): at most one dispatched
         # batch in flight; its host commit overlaps the next batch's device
         # compute. KTPU_PIPELINE=0 forces the synchronous path.
@@ -105,8 +122,10 @@ class TPUScheduler(Scheduler):
         elif self.device.caps.nodes < n:
             # preserve every previously-grown axis; only widen the node axis
             # (and the hostname value vocab that must cover it)
-            import dataclasses
-
+            self._drain_inflight()  # old-device results must commit first
+            if self.device is None:  # the drain's commit killed the device
+                self._ensure_device()
+                return
             caps = self.device.caps
             nodes = caps.nodes
             while nodes < n:
@@ -145,8 +164,10 @@ class TPUScheduler(Scheduler):
 
     def _resync_grown(self, err: CapacityError) -> None:
         """Grow exactly the offending capacity axis and rebuild the mirror."""
-        import dataclasses
-
+        self._drain_inflight()
+        if self.device is None:  # the drain's commit killed the device
+            self._ensure_device()
+            return
         caps = self.device.caps
         fields = self._GROW_FIELDS.get(err.dimension)
         if fields is None and err.dimension.startswith("value vocab"):
@@ -210,11 +231,14 @@ class TPUScheduler(Scheduler):
         self._periodic_housekeeping()
         qps = self.queue.pop_batch(self.batch_size)
         if not qps:
+            # nothing new to overlap with: land the in-flight batch so its
+            # failures requeue before the caller judges settlement
+            self._drain_inflight()
             return 0
         # Attempt-latency clock for every pod in this batch: pop → commit.
         # Batching trades per-pod latency for throughput; the p99 of this
         # histogram is the iso-latency evidence BASELINE.md demands.
-        self._batch_t0 = self.now_fn()
+        t_pop = self.now_fn()
         pod_cycle = self.queue.scheduling_cycle
 
         buffer: List[QueuedPodInfo] = []
@@ -227,51 +251,154 @@ class TPUScheduler(Scheduler):
             if self.batch_supported(pod):
                 buffer.append(qp)
                 continue
-            # fallback pod: flush what's queued first (strict pop order),
-            # then give the sequential path a fresh snapshot
-            self._flush_batch(buffer, pod_cycle)
+            # fallback pod: flush what's queued first (strict pop order) and
+            # land it, then give the sequential path a fresh snapshot
+            self._flush_batch(buffer, pod_cycle, t_pop)
             buffer = []
+            self._drain_inflight()
             self.cache.update_snapshot(self.snapshot)
             self._schedule_fallback(qp, pod_cycle)
-        self._flush_batch(buffer, pod_cycle)
+        self._flush_batch(buffer, pod_cycle, t_pop)
         return len(qps)
 
-    def _flush_batch(self, batched: List[QueuedPodInfo], pod_cycle: int) -> None:
+    def _flush_batch(self, batched: List[QueuedPodInfo], pod_cycle: int,
+                     t_pop: Optional[float] = None) -> None:
         if not batched:
             return
         t0 = self.now_fn()
-        self.cache.update_snapshot(self.snapshot)
-        for _attempt in range(8):
-            try:
-                self.device.sync(self.snapshot)
-                t_sync = self.now_fn()
-                pods = [qp.pod for qp in batched]
-                pb, et = self.device.encoder.encode_pods(pods)
-                tb = self.device.sig_table.encode_topo(pods)
-                break
-            except CapacityError as e:
-                self._resync_grown(e)
+        t_pop = t_pop if t_pop is not None else t0
+        enc = self._try_pipelined_encode(batched)
+        if enc is not None:
+            pb, et, tb = enc
+            t_sync = t0  # nothing to upload: the in-flight carry IS the state
         else:
-            for qp in batched:  # capacities refuse to converge
-                self._schedule_fallback(qp, pod_cycle)
-            return
+            self._drain_inflight()
+            self._ensure_device()  # the drain's commit may have killed it
+            self.cache.update_snapshot(self.snapshot)
+            for _attempt in range(8):
+                try:
+                    self.device.sync(self.snapshot)
+                    t_sync = self.now_fn()
+                    pods = [qp.pod for qp in batched]
+                    pb, et = self.device.encoder.encode_pods(pods)
+                    tb = self.device.sig_table.encode_topo(pods)
+                    break
+                except CapacityError as e:
+                    self._resync_grown(e)
+            else:
+                for qp in batched:  # capacities refuse to converge
+                    self._schedule_fallback(qp, pod_cycle)
+                return
         t_enc = self.now_fn()
         self.batch_counter += 1
-        key = jax.random.PRNGKey(self.batch_counter)
+        # scalar seed, not an eager jax.random.PRNGKey: the key derivation is
+        # traced into the program (an eager PRNGKey costs two relay
+        # round-trips per batch once the session has synchronized)
+        key = np.int32(self.batch_counter)
+        host_pb = self.device.encoder.last_host_pb
+        prev = self._inflight
+        # cross-batch topology carry: batch k+1 starts from batch k's evolved
+        # sel_counts/seg_exist instead of the (stale, pre-k) host tables.
+        # Only valid on the pipelined path — after a drain the host recounts
+        # and device.tc is the truth again (prev is None then).
+        carry = None
+        if prev is not None and prev.result.final_sel_counts is not None:
+            carry = (prev.result.final_sel_counts, prev.result.final_seg_exist)
         result = self._run_batch_fn(
             pb, et, self.device.nt, self.device.tc, tb, key,
-            pb_for_adopt=pb,
+            adopt=True,
             topo_enabled=self.device.topo_enabled,
+            topo_carry=carry,
         )
-        t_compute = self.now_fn()
-        self._commit_batch(batched, result, pod_cycle)
-        t_commit = self.now_fn()
+        t_dispatch = self.now_fn()
+        try:
+            # stage the one host-read early: by commit time the transfer has
+            # ridden along with the execution instead of paying its own
+            # round-trip
+            result.node_idx.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — optional fast path only
+            pass
+        self._inflight = _Inflight(batched, result, pod_cycle, t_pop, host_pb)
+        if prev is not None:
+            # the host commit of batch k overlaps the device compute of k+1
+            self.pipelined_batches += 1
+            self._commit_inflight(prev)
         dur = self.smetrics.device_batch_duration
         dur.observe(t_sync - t0, "upload")
         dur.observe(t_enc - t_sync, "encode")
-        dur.observe(t_compute - t_enc, "compute")
-        dur.observe(t_commit - t_compute, "commit")
+        dur.observe(t_dispatch - t_enc, "compute")
         self.smetrics.device_batch_size.observe(len(batched))
+        if not self._pipeline_enabled:
+            self._drain_inflight()
+
+    def _try_pipelined_encode(self, batched: List[QueuedPodInfo]):
+        """Encode the next batch for dispatch directly on the in-flight
+        batch's adopted device carry — legal only when (a) nothing external
+        touched the cluster since the in-flight dispatch and (b) encoding
+        registers no new signature/term (a fresh row is backfilled from host
+        counts that cannot see the in-flight commits). Returns (pb, et, tb)
+        or None to take the drain+sync path."""
+        if not self._pipeline_enabled or self._inflight is None or self.device is None:
+            return None
+        self.cache.update_snapshot(self.snapshot)
+        if self.device.has_dirty(self.snapshot):
+            return None  # external change breaks the device-carry chain
+        st = self.device.sig_table
+        vocab0 = (st.n_sigs, st.n_terms)
+        try:
+            pods = [qp.pod for qp in batched]
+            pb, et = self.device.encoder.encode_pods(pods)
+            tb = st.encode_topo(pods)
+        except CapacityError:
+            return None  # grow via the drain+sync path (idempotent re-encode)
+        if (st.n_sigs, st.n_terms) != vocab0:
+            return None
+        return pb, et, tb
+
+    def _drain_inflight(self) -> None:
+        prev, self._inflight = self._inflight, None
+        if prev is not None:
+            self._commit_inflight(prev)
+
+    def _commit_inflight(self, fl: _Inflight) -> None:
+        """Land one dispatched batch on the host. The np.asarray(node_idx)
+        is the ONE device sync of the batch cycle (it waits for the remote
+        execution; everything else is async dispatch). A device failure at
+        materialization (e.g. the TPU relay dropping mid-flight) fails the
+        whole batch back to the queue and rebuilds the device from the host
+        cache — crash-only, §5.3."""
+        t0 = self.now_fn()
+        try:
+            node_idx = np.asarray(fl.result.node_idx)
+            self.device.adopt_commits(fl.result, fl.host_pb, node_idx)
+            self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0, node_idx)
+            # reconcile: the commits above advanced node generations; the
+            # ELIDE-ONLY reconcile refreshes _uploaded_gen for rows whose
+            # content matches the adopted mirror, so the next
+            # _try_pipelined_encode keeps the carry chain instead of
+            # breaking it every batch. Rows needing a real upload (external
+            # change, host-rejected commit repair) stay dirty → chain break
+            # → safe drain+sync. A host-rejected pod's phantom topology
+            # commit can thus survive in the carry for exactly one already-
+            # dispatched batch (conservative direction: nodes look MORE
+            # occupied), after which the break resyncs from host truth.
+            if self.device is not None:
+                self.cache.update_snapshot(self.snapshot)
+                self.device.reconcile(self.snapshot)
+        except Exception as exc:  # noqa: BLE001 — backend death must not kill us
+            import logging
+
+            logging.getLogger(__name__).exception("batch commit failed; requeueing")
+            self.device = None  # full rebuild + resync on next _ensure_device
+            # anything dispatched after fl was computed on the dead device;
+            # its futures are poison too — fail it back alongside fl
+            stale, self._inflight = self._inflight, None
+            for batch in (fl, stale) if stale is not None else (fl,):
+                for qp in batch.qps:
+                    fwk = self.framework_for_pod(qp.pod)
+                    self._fail(fwk, qp, Status.error(f"device batch failed: {exc}"),
+                               batch.pod_cycle)
+        self.smetrics.device_batch_duration.observe(self.now_fn() - t0, "commit")
 
     @staticmethod
     def _bind_path_needs_prefilter(fwk) -> bool:
@@ -283,13 +410,17 @@ class TPUScheduler(Scheduler):
                     return True
         return False
 
-    def _run_batch_fn(self, *args, pb_for_adopt=None, **kwargs) -> BatchResult:
-        """Run the compiled batch program; if the Pallas fused-step kernel
-        fails to compile/execute on this hardware, permanently disable it
-        for the process and retry on the plain XLA path (graceful
-        degradation, §5.3: the compute backend must never take the
-        scheduler down with it). On success, the program's evolved dynamic
-        state is adopted so the next sync elides commit-only row uploads."""
+    def _run_batch_fn(self, *args, adopt=False, **kwargs) -> BatchResult:
+        """Dispatch the compiled batch program (async — nothing here blocks);
+        if the Pallas fused-step kernel fails to compile on this hardware,
+        permanently disable it for the process and retry on the plain XLA
+        path (graceful degradation, §5.3: the compute backend must never take
+        the scheduler down with it). With ``adopt``, the program's evolved
+        device arrays (still futures) become the device truth immediately;
+        the HOST mirror advances later, at commit time, when node_idx is
+        materialized anyway (adopt_commits in _commit_inflight — reading
+        node_idx here would force a device sync per dispatch and serialize
+        the pipeline)."""
         import logging
         import os
 
@@ -302,30 +433,17 @@ class TPUScheduler(Scheduler):
                 "pallas step failed; disabling KTPU_PALLAS and retrying via XLA")
             os.environ["KTPU_PALLAS"] = "0"
             result = self.schedule_batch_fn(*args, **kwargs)
-        if pb_for_adopt is not None:
-            # both halves of the adopt, in order: device arrays first (never
-            # blocks — futures), then the host mirror that makes the next
-            # sync's content diff elide commit-only rows. Missing either one
-            # leaves device and mirror divergent (r2's stale-device bug).
+        if adopt:
             self.device.adopt_device(result)
-            self.device.adopt_commits(result, pb_for_adopt, np.asarray(result.node_idx))
         return result
 
-    def _materialize_masks(self, result: BatchResult) -> Dict[str, np.ndarray]:
-        """Pull the per-plugin feasibility masks to host — ONLY on failure
-        paths (each mask is a [batch, nodes] device→host transfer; the happy
-        path needs just node_idx)."""
-        masks = {k: np.asarray(v) for k, v in result.static_masks.items()}
-        masks["NodePorts"] = np.asarray(result.ports_ok)
-        masks["NodeResourcesFit"] = np.asarray(result.fit_ok)
-        masks["PodTopologySpread"] = np.asarray(result.spread_ok)
-        masks["InterPodAffinity"] = np.asarray(result.ipa_ok)
-        return masks
-
-    def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult, pod_cycle: int) -> None:
-        node_idx = np.asarray(result.node_idx)
+    def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult,
+                      pod_cycle: int, t0: float,
+                      node_idx: Optional[np.ndarray] = None) -> None:
+        if node_idx is None:
+            node_idx = np.asarray(result.node_idx)
         slot_names = self.device.slot_to_name()
-        masks: Optional[Dict[str, np.ndarray]] = None  # lazy: failures only
+        ff: Optional[np.ndarray] = None  # lazy single read: failures only
 
         for i, qp in enumerate(qps):
             pod = qp.pod
@@ -337,7 +455,7 @@ class TPUScheduler(Scheduler):
                 if node_name is None:  # stale slot — should not happen
                     self._fail(fwk, qp, Status.error(f"stale node slot {idx}"), pod_cycle)
                     self.smetrics.observe_attempt(
-                        "error", fwk.profile_name, self.now_fn() - self._batch_t0)
+                        "error", fwk.profile_name, self.now_fn() - t0)
                     continue
                 state = CycleState()
                 # Reserve/Permit/PreBind plugins may read PreFilter state;
@@ -351,29 +469,41 @@ class TPUScheduler(Scheduler):
                     self._compare_with_oracle(fwk, pod, node_name)
                 # t0 = batch pop time: the binding cycle observes the
                 # scheduled-attempt duration (pop → bind) exactly once.
+                before_sched = self.metrics["scheduled"]
+                before_wait = len(self.waiting_pods)
                 self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle,
-                                     t0=self._batch_t0)
-                self.batch_scheduled += 1
+                                     t0=t0)
+                if (self.metrics["scheduled"] == before_sched
+                        and len(self.waiting_pods) == before_wait):
+                    # host rejected what the device already adopted (assume/
+                    # reserve/bind failure): invalidate the row's uploaded
+                    # generation so the next sync re-encodes it from host
+                    # truth and the content diff repairs the device copy
+                    self.device._uploaded_gen.pop(node_name, None)
+                else:
+                    self.batch_scheduled += 1
             else:
-                if masks is None:
-                    masks = self._materialize_masks(result)
-                diagnosis = self._diagnose(i, masks, slot_names)
+                if ff is None:
+                    # one [P, N] int8 read covers diagnosis for the whole
+                    # batch (vs 8 separate mask transfers)
+                    ff = np.asarray(result.first_fail)
+                diagnosis = self._diagnose(ff[i], slot_names)
                 self._fail(fwk, qp, Status.unschedulable("no feasible node"), pod_cycle, diagnosis)
                 self.smetrics.observe_attempt(
-                    "unschedulable", fwk.profile_name, self.now_fn() - self._batch_t0)
+                    "unschedulable", fwk.profile_name, self.now_fn() - t0)
 
-    def _diagnose(self, i: int, masks: Dict[str, np.ndarray], slot_names: Dict[int, str]) -> Diagnosis:
-        """Reconstruct per-node first-failing plugin in filter config order so
-        failure messages and queue gating stay reference-shaped (SURVEY.md §8
-        'filter short-circuit semantics')."""
+    def _diagnose(self, ff_row: np.ndarray, slot_names: Dict[int, str]) -> Diagnosis:
+        """Per-node first-failing plugin in filter config order, read straight
+        from the device-computed first_fail ids, so failure messages and queue
+        gating stay reference-shaped (SURVEY.md §8 'filter short-circuit
+        semantics')."""
         d = Diagnosis()
         for slot, name in slot_names.items():
-            for plugin, reason in _ATTRIBUTION_ORDER:
-                m = masks.get(plugin)
-                if m is not None and not bool(m[i, slot]):
-                    d.node_to_status[name] = Status.unschedulable(reason).with_plugin(plugin)
-                    d.unschedulable_plugins.add(plugin)
-                    break
+            fid = int(ff_row[slot])
+            if fid > 0:
+                plugin, reason = _ATTRIBUTION_ORDER[fid - 1]
+                d.node_to_status[name] = Status.unschedulable(reason).with_plugin(plugin)
+                d.unschedulable_plugins.add(plugin)
         return d
 
     def _fail(self, fwk, qp: QueuedPodInfo, status: Status, pod_cycle: int, diagnosis: Optional[Diagnosis] = None) -> None:
@@ -426,6 +556,7 @@ class TPUScheduler(Scheduler):
 
         cycles = 0
         no_progress = 0
+        self.settle_abandoned = False
         while cycles < max_cycles:
             before_sched = self.metrics["scheduled"]
             before_unsched = self.queue.pending_pods()["unschedulable"]
@@ -436,6 +567,7 @@ class TPUScheduler(Scheduler):
                     if self.queue.pending_pods()["active"] > 0:
                         no_progress += 1
                         if no_progress > max_no_progress:
+                            self._abandon_settle()
                             break
                         continue
                 break
@@ -452,6 +584,20 @@ class TPUScheduler(Scheduler):
             else:
                 no_progress += 1
                 if no_progress > max_no_progress:
+                    self._abandon_settle()
                     break
                 _time.sleep(idle_wait * min(no_progress, 10))
+        self._drain_inflight()
         return cycles
+
+    def _abandon_settle(self) -> None:
+        """Mark and log a no-progress early exit so callers (perf Runner,
+        bench) can tell a settled queue from an abandoned one instead of
+        silently reporting numbers over a partial workload."""
+        import logging
+
+        self.settle_abandoned = True
+        self.metrics["settle_abandoned"] = self.metrics.get("settle_abandoned", 0) + 1
+        logging.getLogger(__name__).warning(
+            "run_until_settled: no progress after bound; %s pods still pending",
+            self.queue.pending_pods())

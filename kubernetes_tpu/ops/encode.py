@@ -524,6 +524,10 @@ class ClusterEncoder:
                     for c in pod.spec.containers]
             image_ids[p, : len(imgs)] = imgs
 
+        # host copies of the commit-relevant arrays: DeviceState.adopt_commits
+        # advances its host mirror from these without a device→host read of
+        # the PodBatch (each read is a relay round-trip on this TPU)
+        self.last_host_pb = {"req": req, "nonzero_req": nzreq, "port_ids": port_ids}
         batch = schema.PodBatch(
             valid=jnp.asarray(valid),
             priority=jnp.asarray(priority),

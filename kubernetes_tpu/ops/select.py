@@ -13,7 +13,12 @@ from typing import Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-NEG_INF = jnp.float32(-(2.0**30))
+# Python literal, NOT jnp.float32(...): a module-level jax scalar is a
+# device buffer that gets closure-captured into every jitted program using
+# it, and the axon TPU relay re-fetches captured buffer constants on every
+# while-loop iteration — one such scalar inside the commit scan measured
+# ~2000x slower (68ms vs 0.03ms per batch). Literals lower to HLO constants.
+NEG_INF = -(2.0**30)
 
 
 def weighted_total(scores: Dict[str, jax.Array], weights: Dict[str, float]) -> jax.Array:

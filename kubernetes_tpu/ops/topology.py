@@ -26,7 +26,10 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-INT_MAX = jnp.int32(2**31 - 1)
+# Python literal, not jnp.int32(...): module-level jax scalars become
+# captured device-buffer constants, which the axon relay re-fetches every
+# scan iteration (see ops/select.py NEG_INF note).
+INT_MAX = 2**31 - 1
 
 
 def _gsum(x, axis_name):

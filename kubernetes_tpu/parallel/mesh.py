@@ -94,6 +94,7 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
         },
         fit_ok=P(None, AXIS), ports_ok=P(None, AXIS),
         spread_ok=P(None, AXIS), ipa_ok=P(None, AXIS),
+        first_fail=P(None, AXIS),
         final_requested=P(AXIS), final_nonzero=P(AXIS), final_ports=P(AXIS),
         # evolved topo carry: sel_counts is node-sharded on its second axis
         # like tc.sel_counts; seg_exist is replicated — commit_update applies

@@ -1,0 +1,185 @@
+"""Async batch pipeline (SURVEY §2.7 P3): batch k+1 dispatches on batch k's
+adopted device carry while the host commits batch k. These tests prove the
+overlap actually happens and that it never changes placements."""
+
+import os
+
+import pytest
+
+from kubernetes_tpu.api.types import LabelSelector
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler
+from kubernetes_tpu.scheduler import Scheduler
+
+
+def _bound(store):
+    objs, _rv = store.list_objects("Pod")
+    return {p.meta.name: p.spec.node_name for p in objs if p.spec.node_name}
+
+
+def _run(pipeline: bool, build):
+    os.environ["KTPU_PIPELINE"] = "1" if pipeline else "0"
+    try:
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=4, comparer_every_n=1)
+        build(store)
+        sched.run_until_settled()
+        return store, sched
+    finally:
+        os.environ.pop("KTPU_PIPELINE", None)
+
+
+def _basic_cluster(store):
+    for i in range(8):
+        store.create_node(
+            make_node(f"n{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+            .label("zone", f"z{i % 2}").obj())
+    for i in range(20):
+        store.create_pod(make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+
+
+def test_pipeline_overlaps_and_matches_synchronous():
+    store_p, sched_p = _run(True, _basic_cluster)
+    store_s, sched_s = _run(False, _basic_cluster)
+    assert sched_p.metrics["scheduled"] == 20
+    # overlap evidence: at least one batch was dispatched on the carry
+    assert sched_p.pipelined_batches > 0
+    assert sched_s.pipelined_batches == 0
+    # same decisions (deterministic batch numbering keys the tie-break PRNG)
+    assert _bound(store_p) == _bound(store_s)
+    assert sched_p.comparer_mismatches == 0
+
+
+def test_pipeline_capacity_respected_across_batches():
+    """The r2 stale-device failure mode, now across PIPELINED batches: a
+    1-slot cluster must admit exactly one pod even when later batches are
+    dispatched before the first batch's host commit."""
+    def build(store):
+        store.create_node(
+            make_node("only").capacity({"cpu": "2", "memory": "4Gi", "pods": 1}).obj())
+        for i in range(9):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+
+    store, sched = _run(True, build)
+    assert sched.metrics["scheduled"] == 1
+    assert len(_bound(store)) == 1
+    assert sched.comparer_mismatches == 0
+
+
+def test_pipeline_topo_carry_across_batches():
+    """Anti-affinity committed in batch k must be visible to batch k+1 even
+    though k+1 is dispatched BEFORE k's host commit (the sel_counts/seg_exist
+    carry chain — without it, k+1 would read the stale pre-k host tables)."""
+    def build(store):
+        for i in range(8):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 10})
+                .label("zone", f"z{i % 2}").obj())
+        sel = LabelSelector(match_labels={"app": "x"})
+        for i in range(8):
+            store.create_pod(
+                make_pod(f"p{i}").req({"cpu": "1"}).label("app", "x")
+                .pod_affinity("zone", sel, anti=True).obj())
+
+    store, sched = _run(True, build)
+    bound = _bound(store)
+    # 2 zones ⇒ exactly 2 of the 8 mutually-anti-affine pods can place
+    assert len(bound) == 2, bound
+    zones = {int(n[1:]) % 2 for n in bound.values()}
+    assert zones == {0, 1}
+    assert sched.comparer_mismatches == 0
+
+
+def test_pipeline_chain_breaks_on_external_change():
+    """A node created between cycles makes has_dirty trip: the chain must
+    break (drain + resync) and the new node must become schedulable."""
+    os.environ["KTPU_PIPELINE"] = "1"
+    try:
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=4, comparer_every_n=1)
+        store.create_node(
+            make_node("small").capacity({"cpu": "4", "memory": "8Gi", "pods": 4}).obj())
+        for i in range(4):
+            store.create_pod(make_pod(f"a{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 4
+
+        # external change: a big node appears; the next pods must see it
+        store.create_node(
+            make_node("big").capacity({"cpu": "64", "memory": "128Gi", "pods": 100}).obj())
+        for i in range(8):
+            store.create_pod(make_pod(f"b{i}").req({"cpu": "2", "memory": "2Gi"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 12
+        bound = _bound(store)
+        assert sum(1 for n in bound.values() if n == "big") == 8
+        assert sched.comparer_mismatches == 0
+    finally:
+        os.environ.pop("KTPU_PIPELINE", None)
+
+
+def test_reconcile_elides_matching_rows_and_leaves_divergent_dirty():
+    """DeviceState.reconcile must refresh generations ONLY for rows whose
+    content matches the mirror (adopted commits); divergent rows must stay
+    dirty so the pipelined chain breaks instead of scattering host rows
+    into an adopted-ahead carry (code-review r3 finding)."""
+    from kubernetes_tpu.backend.device_state import DeviceState, caps_for_cluster
+    from kubernetes_tpu.cache.cache import Cache
+    from kubernetes_tpu.cache.snapshot import Snapshot
+
+    cache = Cache()
+    snap = Snapshot()
+    nodes = {}
+    for i in range(3):
+        n = make_node(f"n{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        nodes[n.meta.name] = n
+        cache.add_node(n)
+    cache.update_snapshot(snap)
+    dev = DeviceState(caps_for_cluster(3))
+    dev.sync(snap)
+    assert not dev.has_dirty(snap)
+
+    # bump n0's generation WITHOUT changing content: reconcile elides it
+    cache.update_node(nodes["n0"])
+    cache.update_snapshot(snap)
+    assert dev.has_dirty(snap)
+    left = dev.reconcile(snap)
+    assert left == 0
+    assert not dev.has_dirty(snap)
+
+    # change n1's content (labels): reconcile must leave it dirty
+    n1 = make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).label("zone", "z9").obj()
+    cache.update_node(n1)
+    cache.update_snapshot(snap)
+    left = dev.reconcile(snap)
+    assert left == 1
+    assert dev.has_dirty(snap)
+    # the full sync then repairs it
+    dev.sync(snap)
+    assert not dev.has_dirty(snap)
+
+
+def test_pipeline_equivalence_with_heterogeneous_batches():
+    """Mixed spread + affinity + plain pods across several batches: pipelined
+    and synchronous runs must produce identical placements."""
+    def build(store):
+        for i in range(12):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 20})
+                .label("zone", f"z{i % 3}").label("disk", "ssd" if i % 2 else "hdd").obj())
+        sel = LabelSelector(match_labels={"app": "web"})
+        for i in range(18):
+            pw = make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+            if i % 3 == 0:
+                pw.label("app", "web").spread_constraint(1, "zone", selector=sel)
+            if i % 4 == 0:
+                pw.node_affinity_in("disk", ["ssd"])
+            store.create_pod(pw.obj())
+
+    store_p, sched_p = _run(True, build)
+    store_s, sched_s = _run(False, build)
+    assert sched_p.pipelined_batches > 0
+    assert _bound(store_p) == _bound(store_s)
+    assert sched_p.metrics["scheduled"] == sched_s.metrics["scheduled"]
+    assert sched_p.comparer_mismatches == 0
