@@ -47,6 +47,44 @@ _ATTRIBUTION_ORDER = (
 )
 
 
+class BatchSizer:
+    """Deadline-based batch cutting (SURVEY §7 hard-part 7: iso-p99 needs
+    the batch size bounded by a latency budget, not just throughput).
+
+    A pod's pop→commit latency spans ~2 pipeline cycles (its own batch's
+    dispatch cycle + the next cycle, where its commit lands). Cycle time is
+    modeled as ``a + b·B`` (fixed relay round-trip + per-pod encode/commit
+    cost), both estimated by EMA from observed cycles; the target batch is
+    the largest B with ``2·(a + b·B) ≤ deadline``. Under light load the
+    queue pops less than the target anyway; under heavy load this trades
+    peak throughput for a bounded p99. ``deadline_s=0`` disables cutting."""
+
+    def __init__(self, max_batch: int, deadline_s: float, min_batch: int = 16):
+        self.max_batch = max_batch
+        self.min_batch = min(min_batch, max_batch)
+        self.deadline_s = deadline_s
+        self._a = 0.040  # fixed per-cycle seed: one relay RTT
+        self._b = 0.0003  # per-pod seed: ~0.3 ms encode+commit
+        self._alpha = 0.3
+
+    def update(self, batch_size: int, cycle_s: float) -> None:
+        if batch_size <= 0:
+            return
+        # decompose the observation using the current fixed-cost estimate
+        b_obs = max(cycle_s - self._a, 0.0) / batch_size
+        a_obs = max(cycle_s - self._b * batch_size, 0.0)
+        self._b += self._alpha * (b_obs - self._b)
+        self._a += self._alpha * (a_obs - self._a)
+
+    def target(self) -> int:
+        if not self.deadline_s:
+            return self.max_batch
+        budget = self.deadline_s / 2.0 - self._a
+        if budget <= 0 or self._b <= 0:
+            return self.min_batch
+        return max(self.min_batch, min(self.max_batch, int(budget / self._b)))
+
+
 @dataclasses.dataclass
 class _Inflight:
     """One dispatched-but-uncommitted batch (SURVEY §2.7 P3: the device
@@ -82,10 +120,15 @@ def _enable_compilation_cache() -> None:
 
 class TPUScheduler(Scheduler):
     def __init__(self, *args, batch_size: int = 128, comparer_every_n: int = 0,
-                 **kwargs):
+                 batch_deadline_ms: Optional[float] = None, **kwargs):
         super().__init__(*args, **kwargs)
+        import os
+
         _enable_compilation_cache()
         self.batch_size = batch_size
+        if batch_deadline_ms is None:
+            batch_deadline_ms = float(os.environ.get("KTPU_BATCH_DEADLINE_MS", "0"))
+        self.sizer = BatchSizer(batch_size, batch_deadline_ms / 1000.0)
         # device/host comparer (SURVEY.md §5.2 mapping of the cache drift
         # detector): every Nth device commit, re-check the placement with
         # the scalar oracle filters; 0 disables
@@ -105,8 +148,6 @@ class TPUScheduler(Scheduler):
         # async pipeline (SURVEY §2.7 P3 analog): at most one dispatched
         # batch in flight; its host commit overlaps the next batch's device
         # compute. KTPU_PIPELINE=0 forces the synchronous path.
-        import os
-
         self._pipeline_enabled = os.environ.get("KTPU_PIPELINE", "1") != "0"
         self._inflight: Optional[_Inflight] = None
         self.pipelined_batches = 0
@@ -229,7 +270,7 @@ class TPUScheduler(Scheduler):
         accumulated batch — so a high-priority fallback pod never loses its
         turn to lower-priority batched pods (reference strict-serial order)."""
         self._periodic_housekeeping()
-        qps = self.queue.pop_batch(self.batch_size)
+        qps = self.queue.pop_batch(self.sizer.target())
         if not qps:
             # nothing new to overlap with: land the in-flight batch so its
             # failures requeue before the caller judges settlement
@@ -319,9 +360,11 @@ class TPUScheduler(Scheduler):
         except Exception:  # noqa: BLE001 — optional fast path only
             pass
         self._inflight = _Inflight(batched, result, pod_cycle, t_pop, host_pb)
+        committed = 0
         if prev is not None:
             # the host commit of batch k overlaps the device compute of k+1
             self.pipelined_batches += 1
+            committed = len(prev.qps)
             self._commit_inflight(prev)
         dur = self.smetrics.device_batch_duration
         dur.observe(t_sync - t0, "upload")
@@ -329,7 +372,12 @@ class TPUScheduler(Scheduler):
         dur.observe(t_dispatch - t_enc, "compute")
         self.smetrics.device_batch_size.observe(len(batched))
         if not self._pipeline_enabled:
+            committed = len(batched)
             self._drain_inflight()
+        # the cycle span includes the PREVIOUS batch's commit: attribute the
+        # per-pod slope to whichever batch dominated it, so a 1-pod flush
+        # that landed a 512-pod commit doesn't blow up the estimate
+        self.sizer.update(max(len(batched), committed), self.now_fn() - t0)
 
     def _try_pipelined_encode(self, batched: List[QueuedPodInfo]):
         """Encode the next batch for dispatch directly on the in-flight

@@ -160,6 +160,54 @@ def test_reconcile_elides_matching_rows_and_leaves_divergent_dirty():
     assert not dev.has_dirty(snap)
 
 
+def test_batch_sizer_deadline_controller():
+    """BatchSizer: 2·(a + b·B) ≤ deadline, clamped to [min, max], from EMA
+    estimates of fixed (RTT) and per-pod cycle cost."""
+    from kubernetes_tpu.backend.tpu_scheduler import BatchSizer
+
+    s = BatchSizer(max_batch=512, deadline_s=0.0)
+    assert s.target() == 512  # disabled: always max
+
+    s = BatchSizer(max_batch=512, deadline_s=0.3)
+    # feed consistent observations: a=40ms fixed, b=0.4ms/pod
+    for _ in range(30):
+        s.update(128, 0.040 + 0.0004 * 128)
+        s.update(256, 0.040 + 0.0004 * 256)
+    t = s.target()
+    # budget = 150ms - a(~40ms) = ~110ms; /0.4ms ≈ ~275
+    assert 180 <= t <= 400, t
+    # latency spike → smaller batches
+    for _ in range(30):
+        s.update(t, 0.100 + 0.002 * t)
+    assert s.target() < t
+    # tiny deadline → clamps to min
+    s2 = BatchSizer(max_batch=512, deadline_s=0.01)
+    for _ in range(10):
+        s2.update(64, 0.05 + 0.001 * 64)
+    assert s2.target() == s2.min_batch
+
+
+def test_deadline_bounds_pop_size_end_to_end():
+    """With a deadline set, the scheduler pops bounded batches but still
+    schedules everything correctly."""
+    os.environ["KTPU_BATCH_DEADLINE_MS"] = "120"
+    try:
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=256)
+        for i in range(16):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "16", "memory": "32Gi", "pods": 40}).obj())
+        for i in range(300):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "500m", "memory": "512Mi"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 300
+        # the controller must have actually cut below the configured max —
+        # a 120ms deadline cannot fit a full 256-pod double cycle
+        assert sched.sizer.target() < 256, sched.sizer.target()
+    finally:
+        os.environ.pop("KTPU_BATCH_DEADLINE_MS", None)
+
+
 def test_pipeline_equivalence_with_heterogeneous_batches():
     """Mixed spread + affinity + plain pods across several batches: pipelined
     and synchronous runs must produce identical placements."""
