@@ -101,6 +101,8 @@ class BatchResult(NamedTuple):
     # evolved topology carry (None on the pallas / topo-disabled paths)
     final_sel_counts: Optional[jax.Array] = None     # same shape as tc.sel_counts
     final_seg_exist: Optional[jax.Array] = None      # [T, Vd] int32
+    # evolved priority-class table (preemption screen input), None on pallas
+    final_class_req: Optional[jax.Array] = None      # [N, C, R] int32
 
 
 def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
@@ -363,6 +365,19 @@ def schedule_batch_core(
         step, carry0, xs)
     f_req, f_nz, f_port, f_sel, f_seg = final_carry
 
+    # evolve the priority-class table by the batch's commits in ONE post-scan
+    # scatter (no carry needed — nothing in-scan reads it); under shard_map
+    # each shard scatters only the winners inside its slot window
+    committed = node_idx >= 0
+    if axis_name is None:
+        in_window = committed
+        local_commit = jnp.where(committed, node_idx, 0)
+    else:
+        in_window = committed & (node_idx >= slot_offset) & (node_idx < slot_offset + N)
+        local_commit = jnp.where(in_window, node_idx - slot_offset, 0)
+    f_class = nt.class_req.at[local_commit, pb.prio_class].add(
+        jnp.where(in_window[:, None], pb.req, 0))
+
     return BatchResult(
         node_idx=node_idx,
         best_score=best,
@@ -378,6 +393,7 @@ def schedule_batch_core(
         final_ports=f_port,
         final_sel_counts=f_sel,
         final_seg_exist=f_seg,
+        final_class_req=f_class,
     )
 
 

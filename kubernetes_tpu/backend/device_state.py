@@ -28,12 +28,13 @@ _ROW_FIELDS = (
     ("allocatable", np.int32), ("requested", np.int32), ("nonzero_requested", np.int32),
     ("label_val", np.int32), ("label_num", np.int32),
     ("taint_key", np.int32), ("taint_val", np.int32), ("taint_effect", np.int32),
-    ("port_bits", np.uint32), ("image_bits", np.uint32),
+    ("port_bits", np.uint32), ("image_bits", np.uint32), ("class_req", np.int32),
 )
 
 
 def _apply_rows(nt: NodeTensors, slots: jax.Array, updates: dict,
-                image_sizes: jax.Array, image_num_nodes: jax.Array) -> NodeTensors:
+                image_sizes: jax.Array, image_num_nodes: jax.Array,
+                class_prio: jax.Array) -> NodeTensors:
     """One fused scatter of all dirty rows into the node tensors, jitted.
     Slot counts are bucketed by the caller so this compiles once per bucket,
     not once per distinct dirty-row count (no donation: image_sizes may alias
@@ -41,6 +42,7 @@ def _apply_rows(nt: NodeTensors, slots: jax.Array, updates: dict,
     new_fields = {f: getattr(nt, f).at[slots].set(updates[f]) for f in updates}
     new_fields["image_sizes"] = image_sizes
     new_fields["image_num_nodes"] = image_num_nodes
+    new_fields["class_prio"] = class_prio
     return NodeTensors(**new_fields)
 
 
@@ -63,6 +65,7 @@ class DeviceState:
         self.encoder = ClusterEncoder(caps)
         self.sig_table = SigTable(self.encoder, ns_labels_fn)
         self.nt = self._empty_tensors()
+        self._n_prio = len(self.encoder.prio_vocab)  # uploaded class_prio size
         self._tc = None                           # cached device TopoCounts
         self._tc_version = -1
         self._uploaded_gen: Dict[str, int] = {}   # node name -> generation on device
@@ -115,13 +118,28 @@ class DeviceState:
             image_bits=jnp.asarray(z((c.nodes, c.image_words), np.uint32)),
             image_sizes=jnp.asarray(z(c.images, np.int32)),
             image_num_nodes=jnp.asarray(z(c.images, np.int32)),
+            class_req=jnp.asarray(z((c.nodes, c.prio_classes, c.resources), np.int32)),
+            class_prio=jnp.asarray(self.encoder.class_prio_array()),
         )
 
     # ------------------------------------------------------------------ sync
 
+    def _refresh_class_prio(self) -> None:
+        """Upload the priority-class vocab whenever it grew — independent of
+        row changes (class_req content usually reaches the device via batch
+        ADOPTION, so row uploads may be elided forever while the vocab
+        array would stay stale at INT_MAX = nothing-evictable)."""
+        if self._n_prio != len(self.encoder.prio_vocab):
+            import dataclasses as _dc
+
+            self._n_prio = len(self.encoder.prio_vocab)
+            self.nt = _dc.replace(
+                self.nt, class_prio=jnp.asarray(self.encoder.class_prio_array()))
+
     def sync(self, snapshot: Snapshot) -> int:
         """Upload rows for nodes whose generation advanced; returns number of
         rows uploaded. Raises CapacityError when the cluster outgrows caps."""
+        self._refresh_class_prio()
         dirty: List[Tuple[int, NodeInfo]] = []
         current = set()
         images_changed = False
@@ -194,7 +212,7 @@ class DeviceState:
             image_sizes = nt.image_sizes
             image_num_nodes = nt.image_num_nodes
         self.nt = _apply_rows_jit(nt, jnp.asarray(slots), updates,
-                                  image_sizes, image_num_nodes)
+                                  image_sizes, image_num_nodes, nt.class_prio)
         self.syncs += 1
         self.rows_uploaded += n
         return n
@@ -210,6 +228,7 @@ class DeviceState:
         diff then elides forever). Leaving them dirty makes the next
         ``has_dirty`` probe break the carry chain, and the safe drain+sync
         path repairs everything. Returns the number of rows left dirty."""
+        self._refresh_class_prio()
         left = 0
         current = set()
         for name, ni in snapshot.node_info_map.items():
@@ -262,12 +281,14 @@ class DeviceState:
 
         if result.final_requested is None:
             return
-        self.nt = _dc.replace(
-            self.nt,
+        updates = dict(
             requested=result.final_requested,
             nonzero_requested=result.final_nonzero,
             port_bits=result.final_ports,
         )
+        if result.final_class_req is not None:
+            updates["class_req"] = result.final_class_req
+        self.nt = _dc.replace(self.nt, **updates)
 
     def adopt_commits(self, result, host_pb: dict, node_idx: np.ndarray) -> None:
         """Advance the host mirror by the batch's per-slot adds, so the next
@@ -284,11 +305,17 @@ class DeviceState:
         req = host_pb["req"]
         nz = host_pb["nonzero_req"]
         port_ids = host_pb["port_ids"]
+        # mirror only what the device evolved: the pallas path returns no
+        # final_class_req, so the device class table is refreshed by row
+        # upload instead of adoption there
+        prio_class = host_pb.get("prio_class") if result.final_class_req is not None else None
         for i, slot in enumerate(node_idx):
             if slot < 0:
                 continue
             self._mirror["requested"][slot] += req[i]
             self._mirror["nonzero_requested"][slot] += nz[i]
+            if prio_class is not None:
+                self._mirror["class_req"][slot, prio_class[i]] += req[i]
             for pid in port_ids[i]:
                 if pid > 0:
                     self._mirror["port_bits"][slot, pid >> 5] |= np.uint32(1) << np.uint32(pid & 31)

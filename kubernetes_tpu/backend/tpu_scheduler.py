@@ -96,6 +96,7 @@ class _Inflight:
     pod_cycle: int
     t0: float  # batch pop time — the attempt-latency clock
     host_pb: dict  # encoder's host copy of req/nonzero_req/port_ids
+    pb: object = None  # device PodBatch — preemption screen input on failures
 
 
 def _enable_compilation_cache() -> None:
@@ -201,6 +202,7 @@ class TPUScheduler(Scheduler):
         "spread_cons": ("spread_cons",),
         "ipa_terms": ("ipa_terms",),
         "ipa_pref": ("ipa_pref",),
+        "prio_classes": ("prio_classes",),
     }
 
     def _resync_grown(self, err: CapacityError) -> None:
@@ -359,7 +361,7 @@ class TPUScheduler(Scheduler):
             result.node_idx.copy_to_host_async()
         except Exception:  # noqa: BLE001 — optional fast path only
             pass
-        self._inflight = _Inflight(batched, result, pod_cycle, t_pop, host_pb)
+        self._inflight = _Inflight(batched, result, pod_cycle, t_pop, host_pb, pb)
         committed = 0
         if prev is not None:
             # the host commit of batch k overlaps the device compute of k+1
@@ -419,7 +421,8 @@ class TPUScheduler(Scheduler):
         try:
             node_idx = np.asarray(fl.result.node_idx)
             self.device.adopt_commits(fl.result, fl.host_pb, node_idx)
-            self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0, node_idx)
+            self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0, node_idx,
+                               pb=fl.pb)
             # reconcile: the commits above advanced node generations; the
             # ELIDE-ONLY reconcile refreshes _uploaded_gen for rows whose
             # content matches the adopted mirror, so the next
@@ -487,11 +490,37 @@ class TPUScheduler(Scheduler):
 
     def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult,
                       pod_cycle: int, t0: float,
-                      node_idx: Optional[np.ndarray] = None) -> None:
+                      node_idx: Optional[np.ndarray] = None,
+                      pb=None) -> None:
         if node_idx is None:
             node_idx = np.asarray(result.node_idx)
         slot_names = self.device.slot_to_name()
         ff: Optional[np.ndarray] = None  # lazy single read: failures only
+
+        # device preemption screen+rank, ONE call for every failed pod in the
+        # batch (the batched analog of DryRunPreemption's parallel fan-out;
+        # runs against the current device state, which may already include
+        # the next dispatched batch's adopted commits — conservative, and the
+        # host verifies the chosen candidate exactly before acting)
+        preempt_hints = None
+        if pb is not None and any(
+            int(node_idx[i]) < 0 for i in range(len(qps))
+        ) and self._preemption_wired():
+            try:
+                from ..ops.preempt import preempt_screen
+
+                # a priority class first seen this cycle is still INT_MAX on
+                # device (= never evictable) unless refreshed now
+                self.device._refresh_class_prio()
+                pres = preempt_screen(pb, self.device.nt, result.static_masks)
+                screen = np.asarray(pres.screen)
+                best = np.asarray(pres.best)
+                slot_of = dict(self.device.encoder.node_slots)
+                preempt_hints = (screen, best, slot_of)
+            except Exception:  # noqa: BLE001 — hints are an optimization only
+                import logging
+
+                logging.getLogger(__name__).exception("preempt screen failed")
 
         for i, qp in enumerate(qps):
             pod = qp.pod
@@ -536,7 +565,16 @@ class TPUScheduler(Scheduler):
                     # batch (vs 8 separate mask transfers)
                     ff = np.asarray(result.first_fail)
                 diagnosis = self._diagnose(ff[i], slot_names)
-                self._fail(fwk, qp, Status.unschedulable("no feasible node"), pod_cycle, diagnosis)
+                state = CycleState()
+                if preempt_hints is not None:
+                    from ..framework.plugins.defaultpreemption import DefaultPreemption
+
+                    screen, best, slot_of = preempt_hints
+                    best_name = slot_names.get(int(best[i])) if best[i] >= 0 else None
+                    state.write(DefaultPreemption.HINTS_KEY,
+                                (screen[i], slot_of, best_name))
+                self._fail(fwk, qp, Status.unschedulable("no feasible node"), pod_cycle,
+                           diagnosis, state=state)
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
 
@@ -554,8 +592,20 @@ class TPUScheduler(Scheduler):
                 d.unschedulable_plugins.add(plugin)
         return d
 
-    def _fail(self, fwk, qp: QueuedPodInfo, status: Status, pod_cycle: int, diagnosis: Optional[Diagnosis] = None) -> None:
-        self._handle_scheduling_failure(fwk, CycleState(), qp, status, diagnosis or Diagnosis(), pod_cycle)
+    def _fail(self, fwk, qp: QueuedPodInfo, status: Status, pod_cycle: int,
+              diagnosis: Optional[Diagnosis] = None,
+              state: Optional[CycleState] = None) -> None:
+        self._handle_scheduling_failure(fwk, state or CycleState(), qp, status,
+                                        diagnosis or Diagnosis(), pod_cycle)
+
+    def _preemption_wired(self) -> bool:
+        """True when any profile runs a PostFilter (screen computation is
+        wasted otherwise)."""
+        cached = getattr(self, "_preempt_wired", None)
+        if cached is None:
+            cached = any(f.points.get("post_filter") for f in self.profiles.values())
+            self._preempt_wired = cached
+        return cached
 
     def _compare_with_oracle(self, fwk, pod: Pod, node_name: str) -> None:
         """Device/host comparer (§5.2): re-run the scalar oracle filters for

@@ -42,6 +42,11 @@ class DefaultPreemption(PostFilterPlugin):
     def set_framework(self, fwk) -> None:
         self._fwk = fwk
 
+    # CycleState key the TPU batch path uses to hand over device-computed
+    # preemption hints: (screen_row np[N] bool, slot_of {name: slot},
+    # best_name Optional[str])
+    HINTS_KEY = "ktpu.preempt.hints"
+
     def post_filter(self, state: CycleState, pod, filtered_node_status_map) -> Tuple[Optional[str], Status]:
         # The dry-run filters consume PreFilter CycleState. The sequential
         # path always populated it (schedule_one.go ordering); the TPU batched
@@ -50,6 +55,21 @@ class DefaultPreemption(PostFilterPlugin):
             _, st = self._fwk.run_pre_filter_plugins(state, pod)
             if not st.is_success():
                 return None, st
+        screen_fn = None
+        preferred = None
+        try:
+            screen_row, slot_of, best_name = state.read(self.HINTS_KEY)
+        except KeyError:
+            pass
+        else:
+            def screen_fn(name, _row=screen_row, _slots=slot_of):
+                slot = _slots.get(name)
+                return True if slot is None else bool(_row[slot])
+            # the device ranking ignores PDB-violation minimization
+            # (pickOneNode criterion 1): with PDBs present, keep only the
+            # screen (exact prescreen semantics) and let the host rank
+            if not list(self._pdb_lister()):
+                preferred = best_name
         ev = Evaluator(
             plugin_name=self.name(),
             framework=self._fwk,
@@ -58,6 +78,8 @@ class DefaultPreemption(PostFilterPlugin):
             min_candidate_nodes_percentage=self.min_pct,
             min_candidate_nodes_absolute=self.min_abs,
             rng=self._rng,
+            screen_fn=screen_fn,
+            preferred_node=preferred,
         )
         node_infos = self._snapshot_fn() if self._snapshot_fn else []
         return ev.preempt(pod, filtered_node_status_map, node_infos)

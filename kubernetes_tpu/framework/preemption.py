@@ -69,6 +69,8 @@ class Evaluator:
         min_candidate_nodes_percentage: int = 10,
         min_candidate_nodes_absolute: int = 100,
         rng: Optional[random.Random] = None,
+        screen_fn=None,
+        preferred_node: Optional[str] = None,
     ):
         self.plugin_name = plugin_name
         self.fwk = framework
@@ -78,6 +80,11 @@ class Evaluator:
         self.min_abs = min_candidate_nodes_absolute
         self.rng = rng or random.Random(0)
         self.prescreen_skips = 0  # candidates rejected by the max-free bound
+        # device-computed hints (ops/preempt.py): screen_fn(name) -> bool
+        # replaces the host _max_free_prescreen; preferred_node is the
+        # device's top-ranked candidate, verified EXACTLY before use
+        self.screen_fn = screen_fn
+        self.preferred_node = preferred_node
 
     # ------------------------------------------------------------- top level
 
@@ -87,6 +94,22 @@ class Evaluator:
 
         if not self._pod_eligible_to_preempt_others(pod, by_name):
             return None, Status.unschedulable("preemption is not helpful for scheduling")
+
+        # device-proposed candidate: run the EXACT victim selection on just
+        # that node; only on verification failure pay the full candidate scan
+        # ("device proposes, host verifies" — VERDICT r2 next-step 7)
+        if self.preferred_node is not None and self.preferred_node in by_name:
+            pdbs = list(self.pdb_lister() if callable(self.pdb_lister) else self.pdb_lister)
+            victims, n_viol, ok = self.select_victims_on_node(
+                pod, by_name[self.preferred_node], pdbs)
+            if ok:
+                cand = Candidate(self.preferred_node, victims, n_viol)
+                cands = self._call_extenders(pod, [cand])
+                if cands:
+                    status = self.prepare_candidate(cands[0], pod)
+                    if not status.is_success():
+                        return None, status
+                    return cands[0].node_name, fw.OK
 
         candidates, diagnosis = self.find_candidates(pod, status_map, node_infos)
         if not candidates:
@@ -188,7 +211,10 @@ class Evaluator:
             return [], ["no node is eligible for preemption"]
         offset, num = self._offset_and_num_candidates(len(potential))
         pdbs = list(self.pdb_lister() if callable(self.pdb_lister) else self.pdb_lister)
-        feasible_bound = self._max_free_prescreen(pod, potential)
+        if self.screen_fn is not None:
+            feasible_bound = [self.screen_fn(ni.node.meta.name) for ni in potential]
+        else:
+            feasible_bound = self._max_free_prescreen(pod, potential)
 
         candidates: List[Candidate] = []
         diagnosis: List[str] = []

@@ -111,6 +111,10 @@ class ClusterEncoder:
         self.port_vocab = Vocab("ports")              # (ip|'*', proto, port) -> id
         self.image_vocab = Vocab("images")
         self.scalar_vocab = Vocab("scalar-resources")
+        # priority-class vocab (batched preemption): distinct pod priority
+        # values -> class id; id 0 reserved (class_prio INT_MAX = never
+        # evictable padding)
+        self.prio_vocab: Dict[int, int] = {}
         self.node_slots: Dict[str, int] = {}          # node name -> slot
         self._free_slots: List[int] = []
         self._pod_templates: Dict[Tuple, _PodTemplate] = {}
@@ -160,6 +164,23 @@ class ClusterEncoder:
         if iid >= self.caps.images:
             raise CapacityError("image vocab", iid + 1, self.caps.images)
         return iid
+
+    def prio_class_id(self, priority: int) -> int:
+        cid = self.prio_vocab.get(priority)
+        if cid is None:
+            cid = len(self.prio_vocab) + 1  # 0 reserved
+            if cid >= self.caps.prio_classes:
+                raise CapacityError("prio_classes", cid + 1, self.caps.prio_classes)
+            self.prio_vocab[priority] = cid
+        return cid
+
+    def class_prio_array(self) -> np.ndarray:
+        """[C] int32: priority value per class id; reserved/unused rows get
+        INT_MAX so `class_prio < pod_priority` is never true for them."""
+        arr = np.full(self.caps.prio_classes, 2**31 - 1, np.int32)
+        for prio, cid in self.prio_vocab.items():
+            arr[cid] = prio
+        return arr
 
     def node_slot(self, name: str) -> int:
         slot = self.node_slots.get(name)
@@ -241,6 +262,15 @@ class ClusterEncoder:
             iid = self.image_id(name)
             ibits[iid >> 5] |= np.uint32(1 << (iid & 31))
         row["image_bits"] = ibits
+
+        # priority-class-bucketed request sums (batched preemption screen);
+        # per-pod request vectors come from the template cache — this runs on
+        # the sync/reconcile hot path for every dirty row
+        creq = np.zeros((caps.prio_classes, caps.resources), np.int32)
+        for p in ni.pods:
+            cid = self.prio_class_id(p.spec.priority)
+            creq[cid] += self._template_for(p).req
+        row["class_req"] = creq
         return row
 
     def image_vocab_arrays(self, node_infos: Sequence[NodeInfo]) -> Tuple[np.ndarray, np.ndarray]:
@@ -291,6 +321,8 @@ class ClusterEncoder:
             image_bits=jnp.asarray(stack("image_bits", np.uint32, (caps.image_words,))),
             image_sizes=jnp.asarray(sizes),
             image_num_nodes=jnp.asarray(num_nodes),
+            class_req=jnp.asarray(stack("class_req", np.int32, (caps.prio_classes, caps.resources))),
+            class_prio=jnp.asarray(self.class_prio_array()),
         )
         return nt
 
@@ -527,10 +559,15 @@ class ClusterEncoder:
         # host copies of the commit-relevant arrays: DeviceState.adopt_commits
         # advances its host mirror from these without a device→host read of
         # the PodBatch (each read is a relay round-trip on this TPU)
-        self.last_host_pb = {"req": req, "nonzero_req": nzreq, "port_ids": port_ids}
+        prio_class = np.zeros(P, np.int32)
+        for p, pod in enumerate(pods):
+            prio_class[p] = self.prio_class_id(pod.spec.priority)
+        self.last_host_pb = {"req": req, "nonzero_req": nzreq,
+                             "port_ids": port_ids, "prio_class": prio_class}
         batch = schema.PodBatch(
             valid=jnp.asarray(valid),
             priority=jnp.asarray(priority),
+            prio_class=jnp.asarray(prio_class),
             req=jnp.asarray(req),
             nonzero_req=jnp.asarray(nzreq),
             node_name=jnp.asarray(node_name),

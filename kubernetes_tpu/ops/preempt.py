@@ -1,0 +1,129 @@
+"""Batched preemption screen + candidate ranking on device.
+
+The reference's second hot loop is ``DryRunPreemption`` — a parallel per-node
+simulation that removes lower-priority victims and re-runs the Filters
+(preemption.go:546, defaultpreemption/default_preemption.go:226). The device
+equivalent buckets each node's pods by priority class (NodeTensors.class_req)
+and computes, per (pending pod, node):
+
+  * ``k_needed`` — the minimal number of priority classes (ascending priority
+    order) whose full eviction makes the pod fit: a prefix-sum fit check,
+    exact for the resource/pod-count dimensions;
+  * a candidate ranking key approximating ``pickOneNodeForPreemption``
+    (preemption.go:397): no-victims first, then lowest max victim priority,
+    then smallest victim-priority sum, then fewest victims.
+
+The host then runs the EXACT victim selection (reprieve order, filters) only
+on the device-chosen candidate, falling back to the ranked screen mask when
+verification fails — the "device proposes, host verifies" contract.
+
+Documented divergences from the reference (host verify bounds their effect):
+  * victims are whole priority classes in the screen; the host reprieve still
+    trims to the minimal set on the chosen node;
+  * PDB-violation counts are not modeled on device — clusters WITH PDBs take
+    the host path wholesale (defaultpreemption.py gates on the PDB lister);
+  * the earliest-start-time tiebreak (criterion 5) is not modeled.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .schema import COL_PODS, NodeTensors, PodBatch
+
+_BIG = np.float32(1e18)
+
+
+class PreemptResult(NamedTuple):
+    screen: jax.Array     # [P, N] bool — pod could fit after evicting lower-prio classes
+    best: jax.Array       # [P] int32 — top-ranked candidate slot (-1 = none)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def preempt_screen(pb: PodBatch, nt: NodeTensors, static_masks) -> PreemptResult:
+    """``static_masks``: the batch's static filter masks [P,N] (unschedulable,
+    node name, taints, affinity) — eviction cannot fix those, matching
+    nodesWherePreemptionMightHelp's skip of unresolvable nodes. ANDed here,
+    inside the jit (eager ops each cost a relay round-trip)."""
+    static_ok = pb.valid[:, None] & nt.valid[None, :]
+    for m in static_masks.values():
+        static_ok = static_ok & m
+    alloc = nt.allocatable          # [N, R]
+    req = nt.requested              # [N, R]
+    class_req = nt.class_req        # [N, C, R]
+    class_prio = nt.class_prio      # [C]
+    P = pb.capacity
+    N, C, R = class_req.shape
+
+    order = jnp.argsort(class_prio)                      # ascending priority
+    cprio = class_prio[order]                            # [C]
+    creq = jnp.take(class_req, order, axis=1)            # [N, C, R]
+    cum = jnp.cumsum(creq, axis=1)                       # [N, C, R]
+
+    # deficit per resource: how much must be freed for pod p on node n
+    deficit = pb.req[:, None, :] - (alloc - req)[None, :, :]      # [P, N, R]
+
+    # classes-needed per resource: deficit ≤ 0 → 0; else 1 + index of the
+    # first prefix whose cumulative freed amount covers the deficit (= 1 +
+    # count of insufficient prefixes). Loop over the small static R axis to
+    # keep intermediates [P, N, C].
+    k_needed = jnp.zeros((P, N), jnp.int32)
+    for r in range(R):
+        d = deficit[:, :, r]
+        cnt = jnp.sum(cum[None, :, :, r] < d[:, :, None], axis=-1).astype(jnp.int32)
+        k_r = jnp.where(d > 0, cnt + 1, 0)
+        k_needed = jnp.maximum(k_needed, k_r)
+
+    # eligible prefix length per pod: classes with priority < pod priority
+    # (a PREFIX of the ascending order)
+    elig = jnp.sum(cprio[None, :] < pb.priority[:, None], axis=-1)  # [P]
+    viable = (k_needed <= elig[:, None]) & static_ok & nt.valid[None, :]
+
+    # ranking stats from the evicted prefix (k = k_needed)
+    cum_cnt = jnp.cumsum(creq[..., COL_PODS], axis=1)              # [N, C]
+    cum_psum = jnp.cumsum(
+        creq[..., COL_PODS].astype(jnp.float32) * cprio[None, :].astype(jnp.float32),
+        axis=1)                                                     # [N, C]
+    k = k_needed  # [P, N]
+    k_idx = jnp.clip(k - 1, 0, C - 1)
+    victims = jnp.where(k > 0, jnp.take_along_axis(
+        jnp.broadcast_to(cum_cnt[None], (P, N, C)), k_idx[:, :, None], axis=2)[:, :, 0], 0)
+    psum = jnp.where(k > 0, jnp.take_along_axis(
+        jnp.broadcast_to(cum_psum[None], (P, N, C)), k_idx[:, :, None], axis=2)[:, :, 0], 0.0)
+    maxprio = jnp.where(k > 0, cprio[k_idx].astype(jnp.float32), -_BIG)
+
+    # staged masked argmin = exact lexicographic selection over
+    # (max victim priority, victim priority sum, victim count) — pickOneNode
+    # criteria 2-4; criterion 1 (PDBs) is host-gated, criterion 5 (start
+    # time) not modeled. float32 packing would lose the low-order criteria.
+    def _pick(mask_row, keys):
+        for key_row in keys:
+            masked = jnp.where(mask_row, key_row, _BIG)
+            mask_row = mask_row & (masked == jnp.min(masked))
+        return jnp.argmax(mask_row).astype(jnp.int32)
+
+    # greedy claim diversification: pods in the same failed batch must not
+    # all converge on the identical best node (the host nominates them one
+    # by one, and a node already claimed by an earlier preemptor fails the
+    # later pods' exact verification, pushing them onto the slow full scan).
+    # Each pod prefers unclaimed viable nodes; claimed ones remain a
+    # fallback when nothing else is viable.
+    victims_f = victims.astype(jnp.float32)
+
+    def claim_step(claimed, xs):
+        v_row, mp_row, ps_row, vc_row = xs
+        prefer = v_row & ~claimed
+        row = jnp.where(jnp.any(prefer), prefer, v_row)
+        idx = _pick(row, (mp_row, ps_row, vc_row))
+        ok = jnp.any(v_row)
+        claimed = claimed | (jnp.arange(N) == idx) & ok
+        return claimed, jnp.where(ok, idx, -1)
+
+    _, best = jax.lax.scan(
+        claim_step, jnp.zeros((N,), bool), (viable, maxprio, psum, victims_f))
+    return PreemptResult(screen=viable, best=best)

@@ -86,6 +86,12 @@ class NodeTensors:
     image_bits: jax.Array     # [N, Wimg] uint32 bitset over the image vocab
     image_sizes: jax.Array    # [Vimg] int32 bytes (vocab-level, not per node)
     image_num_nodes: jax.Array  # [Vimg] int32 (ImageStateSummary.NumNodes)
+    # priority-class-bucketed requested sums: the device side of batched
+    # preemption (preemption.go:546 DryRunPreemption's fit check becomes a
+    # prefix-sum over classes sorted by priority). class 0 is reserved
+    # padding with class_prio INT_MAX (never evictable).
+    class_req: jax.Array      # [N, C, R] int32 requested by pods of class c
+    class_prio: jax.Array     # [C] int32 priority value of class c (vocab)
 
     @property
     def capacity(self) -> int:
@@ -110,6 +116,7 @@ class PodBatch:
 
     valid: jax.Array        # [P] bool
     priority: jax.Array     # [P] int32
+    prio_class: jax.Array   # [P] int32 priority-class vocab id (preemption)
     req: jax.Array          # [P, R] int32 (filter-path request; col PODS == 1)
     nonzero_req: jax.Array  # [P, R] int32 (scoring-path request)
     node_name: jax.Array    # [P] int32 target slot or -1 (pod.spec.nodeName)
@@ -222,6 +229,7 @@ class Capacities:
     spread_cons: int = 2      # C: topology-spread constraints per pod per kind
     ipa_terms: int = 2        # A: required (anti-)affinity terms per pod
     ipa_pref: int = 2         # PT: preferred terms per pod (both signs combined)
+    prio_classes: int = 32    # distinct pod priority values (+ reserved row 0)
 
     def grow_nodes(self, n: int) -> "Capacities":
         cap = self.nodes

@@ -28,8 +28,8 @@ from ..ops.schema import ExprTable, NodeTensors, PodBatch, TopoBatch, TopoCounts
 AXIS = "nodes"
 
 # NodeTensors fields sharded on their node (first) axis; vocab-level arrays
-# (image sizes/spread) are replicated.
-_REPLICATED_NT_FIELDS = ("image_sizes", "image_num_nodes")
+# (image sizes/spread, priority-class vocab) are replicated.
+_REPLICATED_NT_FIELDS = ("image_sizes", "image_num_nodes", "class_prio")
 
 
 def make_node_mesh(devices=None) -> Mesh:
@@ -101,6 +101,7 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
         # every update through a psum'd broadcast so all shards evolve the
         # same [T, Vd] table (ops/topology.py commit_update)
         final_sel_counts=P(None, AXIS), final_seg_exist=P(),
+        final_class_req=P(AXIS),
     )
 
     body = functools.partial(schedule_batch_core, weights_key=wk,
