@@ -203,6 +203,29 @@ def run_matrix_child(name: str) -> None:
     print(json.dumps(entry))
 
 
+def run_wire(n_nodes=1000, n_init=200, n_measured=500):
+    """Transport-inclusive row: the batched device service behind a real
+    localhost HTTP socket (SURVEY §5.8 hop 6) — the serialization + wire
+    cost the in-process number does not pay."""
+    entry = {"transport": "wire"}
+    try:
+        from kubernetes_tpu.perf.harness import run_workload
+        from kubernetes_tpu.perf.workloads import scheduling_basic
+
+        items = run_workload(
+            scheduling_basic(nodes=n_nodes, init_pods=n_init, measured=n_measured),
+            backend="wire")
+        for it in items:
+            if it.labels.get("Name") == "SchedulingThroughput":
+                entry["pods_per_s"] = round(it.data["Average"], 2)
+            elif (it.labels.get("Name") == "scheduling_attempt_duration_seconds"
+                  and it.labels.get("result") == "scheduled"):
+                entry["attempt_p99_s"] = round(it.data["Perc99"], 4)
+    except Exception as exc:  # noqa: BLE001 — a bad row must not kill the bench
+        entry["error"] = f"{type(exc).__name__}: {exc}"[:200]
+    return entry
+
+
 def run_sequential(n_nodes, n_init, n_measured):
     from kubernetes_tpu.apiserver import ClusterStore
     from kubernetes_tpu.scheduler import Scheduler
@@ -263,6 +286,8 @@ def main():
         record["batch_phase_ms"] = phases
         record["baseline_pods_per_s"] = round(seq_tput, 2)
         record.update(evidence)
+        if os.environ.get("BENCH_WIRE", "1") != "0":
+            record["wire"] = run_wire(min(n_nodes, 1000))
         if os.environ.get("BENCH_MATRIX", "1") != "0":
             record["workloads"] = run_matrix(budget_deadline, platform)
     except Exception as exc:  # noqa: BLE001 — a number must always be emitted

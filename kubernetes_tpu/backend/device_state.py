@@ -33,8 +33,7 @@ _ROW_FIELDS = (
 
 
 def _apply_rows(nt: NodeTensors, slots: jax.Array, updates: dict,
-                image_sizes: jax.Array, image_num_nodes: jax.Array,
-                class_prio: jax.Array) -> NodeTensors:
+                image_sizes: jax.Array, image_num_nodes: jax.Array) -> NodeTensors:
     """One fused scatter of all dirty rows into the node tensors, jitted.
     Slot counts are bucketed by the caller so this compiles once per bucket,
     not once per distinct dirty-row count (no donation: image_sizes may alias
@@ -42,7 +41,7 @@ def _apply_rows(nt: NodeTensors, slots: jax.Array, updates: dict,
     new_fields = {f: getattr(nt, f).at[slots].set(updates[f]) for f in updates}
     new_fields["image_sizes"] = image_sizes
     new_fields["image_num_nodes"] = image_num_nodes
-    new_fields["class_prio"] = class_prio
+    new_fields["class_prio"] = nt.class_prio
     return NodeTensors(**new_fields)
 
 
@@ -212,7 +211,7 @@ class DeviceState:
             image_sizes = nt.image_sizes
             image_num_nodes = nt.image_num_nodes
         self.nt = _apply_rows_jit(nt, jnp.asarray(slots), updates,
-                                  image_sizes, image_num_nodes, nt.class_prio)
+                                  image_sizes, image_num_nodes)
         self.syncs += 1
         self.rows_uploaded += n
         return n

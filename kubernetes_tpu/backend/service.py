@@ -1,0 +1,355 @@
+"""The batched device service seam (SURVEY §5.8 hop 6).
+
+The reference's only out-of-process scheduling extension is the per-pod JSON
+extender webhook (extender.go:42,247) — one HTTP POST per pod per extender,
+which is exactly its performance failure. This service batches and adds
+state: the control plane streams generation-keyed node deltas
+(``ApplyDeltas``) and submits whole pod micro-batches (``ScheduleBatch``);
+the device side keeps the encoded mirror across calls, so steady-state
+requests carry only dirty rows and the pod batch.
+
+Three pieces:
+  * ``DeviceService`` — transport-agnostic server core owning a DeviceState
+    and the compiled batch program; the hot path mirrors TPUScheduler's
+    device half (delta sync, capacity growth, adopt-on-dispatch).
+  * ``serve``/``DeviceServiceHTTP`` — stdlib HTTP/JSON binding on localhost
+    (the in-process path stays the fast mode; this seam exists to measure
+    and bound the serialization/transport cost the reference pays at
+    QPS-5000, scheduler_perf util.go:86-90).
+  * ``WireScheduler`` — a Scheduler whose filter/score middle goes over the
+    wire; queue/cache/assume/bind/failure handling stay the same host
+    machinery (the north-star seam: the control plane does not know whether
+    the backend is in-process or remote).
+
+Wire envelope: {"apiVersion": "ktpu/v1", ...}; objects use api/codec.py.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..api.codec import from_wire, to_wire
+from ..api.types import Node, Pod
+from ..framework.types import Diagnosis, NodeInfo, QueuedPodInfo
+from ..framework.interface import CycleState, Status
+from ..ops.encode import CapacityError
+from ..scheduler.scheduler import Scheduler
+from .batch import build_schedule_batch_fn
+from .device_state import DeviceState, caps_for_cluster
+from .tpu_scheduler import _ATTRIBUTION_ORDER, TPUScheduler
+
+API_VERSION = "ktpu/v1"
+
+
+class DeviceService:
+    """Server core: node mirror + device state + one compiled batch program."""
+
+    def __init__(self, batch_size: int = 512):
+        self.batch_size = batch_size
+        self.infos: Dict[str, NodeInfo] = {}
+        self.snap = SimpleNamespace(node_info_map=self.infos)
+        self.device: Optional[DeviceState] = None
+        self.schedule_batch_fn = build_schedule_batch_fn()
+        self.batch_counter = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- deltas
+
+    def apply_deltas(self, req: dict) -> dict:
+        with self._lock:
+            if req.get("full"):
+                self.infos.clear()
+                self.device = None
+            for e in req.get("nodes", ()):
+                node = from_wire(Node, e["node"])
+                ni = NodeInfo(node)
+                for pw in e.get("pods", ()):
+                    ni.add_pod(from_wire(Pod, pw))
+                ni.generation = e.get("gen", ni.generation)
+                self.infos[node.meta.name] = ni
+            for name in req.get("removed", ()):
+                self.infos.pop(name, None)
+            self._sync()
+            return {"apiVersion": API_VERSION, "nodes": len(self.infos)}
+
+    def _ensure_device(self) -> None:
+        import dataclasses
+
+        n = max(len(self.infos), 1)
+        if self.device is None:
+            self.device = DeviceState(caps_for_cluster(n, batch=self.batch_size))
+        elif self.device.caps.nodes < n:
+            caps = self.device.caps
+            nodes = caps.nodes
+            while nodes < n:
+                nodes *= 2
+            self.device = DeviceState(dataclasses.replace(
+                caps, nodes=nodes,
+                value_words=max(caps.value_words, (nodes + 2 + 31) // 32)))
+
+    def _sync(self) -> None:
+        self._ensure_device()
+        for _attempt in range(8):
+            try:
+                self.device.sync(self.snap)
+                return
+            except CapacityError as e:
+                self._grow(e)
+        raise RuntimeError("device capacities refuse to converge")
+
+    def _grow(self, err: CapacityError) -> None:
+        import dataclasses
+
+        caps = self.device.caps
+        fields = TPUScheduler._GROW_FIELDS.get(err.dimension)
+        if fields is None and err.dimension.startswith("value vocab"):
+            fields = ("value_words",)
+        if fields is None:
+            raise RuntimeError(f"unknown capacity dimension {err.dimension!r}") from err
+        updates = {}
+        for f in fields:
+            v = getattr(caps, f)
+            while v < err.needed:
+                v *= 2
+            updates[f] = v
+        self.device = DeviceState(dataclasses.replace(caps, **updates))
+
+    # ------------------------------------------------------------- schedule
+
+    def schedule_batch(self, req: dict) -> dict:
+        pods = [from_wire(Pod, pw) for pw in req.get("pods", ())]
+        with self._lock:
+            self._ensure_device()
+            for _attempt in range(8):
+                try:
+                    self.device.sync(self.snap)
+                    pb, et = self.device.encoder.encode_pods(pods)
+                    tb = self.device.sig_table.encode_topo(pods)
+                    break
+                except CapacityError as e:
+                    self._grow(e)
+            else:
+                raise RuntimeError("device capacities refuse to converge")
+            host_pb = self.device.encoder.last_host_pb
+            self.batch_counter += 1
+            result = self.schedule_batch_fn(
+                pb, et, self.device.nt, self.device.tc, tb,
+                np.int32(self.batch_counter),
+                topo_enabled=self.device.topo_enabled)
+            node_idx = np.asarray(result.node_idx)
+            # adopt exactly like the in-process path: the client will assume
+            # these placements; its next delta push re-encodes any row the
+            # host view disagrees on and the content diff repairs it
+            self.device.adopt_device(result)
+            self.device.adopt_commits(result, host_pb, node_idx)
+            slot_names = self.device.slot_to_name()
+            ff = None
+            results: List[dict] = []
+            for i in range(len(pods)):
+                idx = int(node_idx[i])
+                if idx >= 0 and idx in slot_names:
+                    results.append({"nodeName": slot_names[idx]})
+                    continue
+                if ff is None:
+                    ff = np.asarray(result.first_fail)
+                plugins = sorted({int(v) for v in ff[i] if v > 0})
+                statuses = {}
+                for slot, name in slot_names.items():
+                    fid = int(ff[i][slot])
+                    if fid > 0 and len(statuses) < 64:  # payload-bounded sample
+                        statuses[name] = _ATTRIBUTION_ORDER[fid - 1][0]
+                results.append({
+                    "nodeName": None,
+                    "unschedulablePlugins": [
+                        _ATTRIBUTION_ORDER[fid - 1][0] for fid in plugins],
+                    "statuses": statuses,
+                })
+        return {"apiVersion": API_VERSION, "results": results}
+
+
+# ---------------------------------------------------------------- transport
+
+
+class _Handler(BaseHTTPRequestHandler):
+    service: DeviceService = None  # set by serve()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def do_POST(self):  # noqa: N802 — stdlib naming
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        try:
+            if self.path == "/v1/applyDeltas":
+                out = self.service.apply_deltas(body)
+            elif self.path == "/v1/scheduleBatch":
+                out = self.service.schedule_batch(body)
+            else:
+                self.send_error(404)
+                return
+        except Exception as exc:  # noqa: BLE001 — wire errors must be JSON
+            payload = json.dumps({"error": f"{type(exc).__name__}: {exc}"}).encode()
+            self.send_response(500)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return
+        payload = json.dumps(out).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+def serve(service: DeviceService, port: int = 0):
+    """Start the HTTP binding on localhost; returns (server, port). The
+    caller owns shutdown (server.shutdown())."""
+    handler = type("BoundHandler", (_Handler,), {"service": service})
+    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, server.server_address[1]
+
+
+class WireClient:
+    def __init__(self, endpoint: str):
+        self.endpoint = endpoint.rstrip("/")
+
+    def _post(self, path: str, payload: dict) -> dict:
+        req = urllib.request.Request(
+            self.endpoint + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        if "error" in out:
+            raise RuntimeError(out["error"])
+        return out
+
+    def apply_deltas(self, payload: dict) -> dict:
+        return self._post("/v1/applyDeltas", payload)
+
+    def schedule_batch(self, payload: dict) -> dict:
+        return self._post("/v1/scheduleBatch", payload)
+
+
+# ---------------------------------------------------------------- scheduler
+
+
+class WireScheduler(Scheduler):
+    """Control plane driving the device service over the wire: the batched
+    analog of the HTTP extender, with the same host machinery around it as
+    TPUScheduler (queue order, assume/bind, failure handling + backoff)."""
+
+    def __init__(self, *args, endpoint: str, batch_size: int = 256, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.client = WireClient(endpoint)
+        self.batch_size = batch_size
+        self._sent_gens: Dict[str, int] = {}
+        self.settle_abandoned = False
+
+    def _wire_supported(self, pod: Pod) -> bool:
+        return not pod.spec.volumes
+
+    def _push_deltas(self) -> None:
+        self.cache.update_snapshot(self.snapshot)
+        entries = []
+        current = self.snapshot.node_info_map
+        removed = [n for n in self._sent_gens if n not in current]
+        for name, ni in current.items():
+            if self._sent_gens.get(name) == ni.generation or ni.node is None:
+                continue
+            entries.append({
+                "gen": ni.generation,
+                "node": to_wire(ni.node),
+                "pods": [to_wire(p) for p in ni.pods],
+            })
+            self._sent_gens[name] = ni.generation
+        for n in removed:
+            del self._sent_gens[n]
+        if entries or removed:
+            self.client.apply_deltas(
+                {"apiVersion": API_VERSION, "nodes": entries, "removed": removed})
+
+    def schedule_batch_cycle(self) -> int:
+        self._periodic_housekeeping()
+        qps = self.queue.pop_batch(self.batch_size)
+        if not qps:
+            return 0
+        t0 = self.now_fn()
+        pod_cycle = self.queue.scheduling_cycle
+        batch: List[QueuedPodInfo] = []
+        for qp in qps:
+            pod = self.store.get_pod(qp.pod.key())
+            if pod is None or pod.spec.node_name or not self._responsible_for(pod):
+                continue
+            qp.pod = pod
+            if self._wire_supported(pod):
+                batch.append(qp)
+            else:
+                self.cache.update_snapshot(self.snapshot)
+                self.schedule_one_pod(qp, pod_cycle)
+        if not batch:
+            return len(qps)
+        self._push_deltas()
+        res = self.client.schedule_batch(
+            {"apiVersion": API_VERSION,
+             "pods": [to_wire(qp.pod) for qp in batch]})
+        for qp, r in zip(batch, res["results"]):
+            fwk = self.framework_for_pod(qp.pod)
+            self.metrics["schedule_attempts"] += 1
+            node_name = r.get("nodeName")
+            if node_name:
+                self.assume_and_bind(fwk, CycleState(), qp, qp.pod, node_name,
+                                     pod_cycle, t0=t0)
+            else:
+                d = Diagnosis()
+                for name, plugin in (r.get("statuses") or {}).items():
+                    reason = dict(_ATTRIBUTION_ORDER).get(plugin, "unschedulable")
+                    d.node_to_status[name] = Status.unschedulable(reason).with_plugin(plugin)
+                d.unschedulable_plugins.update(r.get("unschedulablePlugins") or ())
+                self._handle_scheduling_failure(
+                    fwk, CycleState(), qp, Status.unschedulable("no feasible node"),
+                    d, pod_cycle)
+                self.smetrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, self.now_fn() - t0)
+        return len(qps)
+
+    def run_until_settled(self, max_cycles: int = 100000, flush: bool = True,
+                          max_no_progress: int = 200) -> int:
+        cycles = 0
+        no_progress = 0
+        self.settle_abandoned = False
+        while cycles < max_cycles:
+            before = self.metrics["scheduled"]
+            before_unsched = self.queue.pending_pods()["unschedulable"]
+            n = self.schedule_batch_cycle()
+            if n == 0:
+                if flush:
+                    self.queue.flush_backoff_completed()
+                    if self.queue.pending_pods()["active"] > 0:
+                        no_progress += 1
+                        if no_progress > max_no_progress:
+                            self.settle_abandoned = True
+                            break
+                        continue
+                break
+            cycles += n
+            pending = self.queue.pending_pods()
+            if (self.metrics["scheduled"] > before
+                    or pending["unschedulable"] > before_unsched):
+                no_progress = 0
+            else:
+                no_progress += 1
+                if no_progress > max_no_progress:
+                    self.settle_abandoned = True
+                    break
+        return cycles

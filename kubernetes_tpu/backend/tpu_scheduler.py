@@ -66,10 +66,12 @@ class BatchSizer:
         self._a = 0.040  # fixed per-cycle seed: one relay RTT
         self._b = 0.0003  # per-pod seed: ~0.3 ms encode+commit
         self._alpha = 0.3
+        self.updates = 0
 
     def update(self, batch_size: int, cycle_s: float) -> None:
         if batch_size <= 0:
             return
+        self.updates += 1
         # decompose the observation using the current fixed-cost estimate
         b_obs = max(cycle_s - self._a, 0.0) / batch_size
         a_obs = max(cycle_s - self._b * batch_size, 0.0)
@@ -512,7 +514,10 @@ class TPUScheduler(Scheduler):
                 # a priority class first seen this cycle is still INT_MAX on
                 # device (= never evictable) unless refreshed now
                 self.device._refresh_class_prio()
-                pres = preempt_screen(pb, self.device.nt, result.static_masks)
+                failed = np.zeros(pb.capacity, bool)
+                failed[:len(qps)] = node_idx[:len(qps)] < 0
+                pres = preempt_screen(pb, self.device.nt, result.static_masks,
+                                      failed)
                 screen = np.asarray(pres.screen)
                 best = np.asarray(pres.best)
                 slot_of = dict(self.device.encoder.node_slots)

@@ -45,7 +45,8 @@ class PreemptResult(NamedTuple):
 
 
 @functools.partial(jax.jit, static_argnames=())
-def preempt_screen(pb: PodBatch, nt: NodeTensors, static_masks) -> PreemptResult:
+def preempt_screen(pb: PodBatch, nt: NodeTensors, static_masks,
+                   failed: jax.Array) -> PreemptResult:
     """``static_masks``: the batch's static filter masks [P,N] (unschedulable,
     node name, taints, affinity) — eviction cannot fix those, matching
     nodesWherePreemptionMightHelp's skip of unresolvable nodes. ANDed here,
@@ -111,19 +112,22 @@ def preempt_screen(pb: PodBatch, nt: NodeTensors, static_masks) -> PreemptResult
     # all converge on the identical best node (the host nominates them one
     # by one, and a node already claimed by an earlier preemptor fails the
     # later pods' exact verification, pushing them onto the slow full scan).
-    # Each pod prefers unclaimed viable nodes; claimed ones remain a
-    # fallback when nothing else is viable.
+    # Each FAILED pod prefers unclaimed viable nodes; claimed ones remain a
+    # fallback when nothing else is viable. Scheduled pods neither claim nor
+    # consume hints (their rows would only steer real preemptors away from
+    # their cheapest victims).
     victims_f = victims.astype(jnp.float32)
 
     def claim_step(claimed, xs):
-        v_row, mp_row, ps_row, vc_row = xs
+        v_row, mp_row, ps_row, vc_row, is_failed = xs
         prefer = v_row & ~claimed
         row = jnp.where(jnp.any(prefer), prefer, v_row)
         idx = _pick(row, (mp_row, ps_row, vc_row))
-        ok = jnp.any(v_row)
+        ok = jnp.any(v_row) & is_failed
         claimed = claimed | (jnp.arange(N) == idx) & ok
         return claimed, jnp.where(ok, idx, -1)
 
     _, best = jax.lax.scan(
-        claim_step, jnp.zeros((N,), bool), (viable, maxprio, psum, victims_f))
+        claim_step, jnp.zeros((N,), bool),
+        (viable, maxprio, psum, victims_f, failed))
     return PreemptResult(screen=viable, best=best)

@@ -158,10 +158,28 @@ class Runner:
             from ..backend.tpu_scheduler import TPUScheduler
 
             self.scheduler = TPUScheduler(self.store, batch_size=batch_size, seed=seed)
+        elif backend == "wire":
+            # transport-inclusive mode: the batched device service behind a
+            # real localhost HTTP socket (SURVEY §5.8 hop 6)
+            from ..backend.service import DeviceService, WireScheduler, serve
+
+            self._service = DeviceService(batch_size=batch_size)
+            self._server, port = serve(self._service)
+            self.scheduler = WireScheduler(
+                self.store, endpoint=f"http://127.0.0.1:{port}",
+                batch_size=batch_size, seed=seed)
         else:
             self.scheduler = scheduler_from_config(self.store, cfg, seed=seed)
         self.data_items: List[DataItem] = []
         self._pod_counter = 0
+
+    def close(self) -> None:
+        """Release backend resources (the wire backend's HTTP server thread
+        and device service — serve()'s contract: the caller owns shutdown)."""
+        server = getattr(self, "_server", None)
+        if server is not None:
+            server.shutdown()
+            self._server = None
 
     # ---- ops ----
 
@@ -217,7 +235,7 @@ class Runner:
         target = scheduled_before + count
         i = 0
         while scheduled_count() < target:
-            if self.backend == "tpu":
+            if self.backend in ("tpu", "wire"):
                 progressed = self.scheduler.schedule_batch_cycle() > 0
             else:
                 progressed = self.scheduler.schedule_one()
@@ -273,7 +291,10 @@ def run_workload(test_case: dict, backend: str = "oracle", **runner_kw) -> List[
     """One testCase dict: {name, schedulerConfig?, ops: [...]}; returns its
     DataItems (throughput + any scraped metrics)."""
     r = Runner(scheduler_config=test_case.get("schedulerConfig"), backend=backend, **runner_kw)
-    r.run_ops(test_case["ops"])
+    try:
+        r.run_ops(test_case["ops"])
+    finally:
+        r.close()
     for it in r.data_items:
         it.labels.setdefault("TestCase", test_case.get("name", "unnamed"))
         it.labels.setdefault("Backend", backend)

@@ -134,7 +134,9 @@ def test_screen_matches_host_prescreen():
     pods = [make_pod("claim").req({"cpu": "1", "memory": "2Gi"}).priority(1000).obj()]
     pb, et = sched.device.encoder.encode_pods(pods)
     masks = {}  # no static obstacles in this scenario
-    res = preempt_screen(pb, sched.device.nt, masks)
+    failed = np.zeros(pb.capacity, bool)
+    failed[0] = True
+    res = preempt_screen(pb, sched.device.nt, masks, failed)
     screen = np.asarray(res.screen)[0]
     slot_of = dict(sched.device.encoder.node_slots)
 

@@ -1,0 +1,131 @@
+"""The batched device service over a real localhost socket (SURVEY §5.8
+hop 6): codec round-trips, e2e scheduling through HTTP, and parity with the
+in-process batch path."""
+
+import numpy as np
+
+from kubernetes_tpu.api.codec import from_wire, to_wire
+from kubernetes_tpu.api.types import LabelSelector, Node, Pod
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler
+from kubernetes_tpu.backend.service import DeviceService, WireScheduler, serve
+
+
+def _bound(store):
+    objs, _rv = store.list_objects("Pod")
+    return {p.meta.name: p.spec.node_name for p in objs if p.spec.node_name}
+
+
+def test_codec_roundtrip_pod_and_node():
+    pod = (make_pod("p0").req({"cpu": "1500m", "memory": "2Gi"})
+           .label("app", "web").priority(100)
+           .node_affinity_in("disk", ["ssd"])
+           .spread_constraint(1, "zone", selector=LabelSelector(match_labels={"app": "web"}))
+           .pod_affinity("zone", LabelSelector(match_labels={"app": "web"}), anti=True)
+           .toleration("dedicated", "gpu", "NoSchedule")
+           .obj())
+    p2 = from_wire(Pod, to_wire(pod))
+    assert p2.meta.name == "p0" and p2.meta.labels == {"app": "web"}
+    assert p2.spec.priority == 100
+    assert p2.resource_request() == pod.resource_request()
+    assert len(p2.spec.topology_spread_constraints) == 1
+    assert p2.spec.topology_spread_constraints[0].label_selector.matches({"app": "web"})
+    assert p2.spec.tolerations == pod.spec.tolerations
+    assert to_wire(p2) == to_wire(pod)
+
+    node = (make_node("n0").capacity({"cpu": "8", "memory": "16Gi", "pods": 100})
+            .label("zone", "z1").taint("dedicated", "gpu", "NoSchedule").obj())
+    n2 = from_wire(Node, to_wire(node))
+    assert n2.meta.name == "n0"
+    assert n2.spec.taints == node.spec.taints
+    assert to_wire(n2) == to_wire(node)
+
+
+def test_wire_scheduler_end_to_end():
+    service = DeviceService(batch_size=32)
+    server, port = serve(service)
+    try:
+        store = ClusterStore()
+        sched = WireScheduler(store, endpoint=f"http://127.0.0.1:{port}", batch_size=8)
+        for i in range(4):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "4", "memory": "8Gi", "pods": 10})
+                .label("zone", f"z{i % 2}").obj())
+        for i in range(12):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 12
+        bound = _bound(store)
+        per_node = {}
+        for n in bound.values():
+            per_node[n] = per_node.get(n, 0) + 1
+        assert all(v <= 4 for v in per_node.values()), per_node  # 4cpu / 1cpu
+    finally:
+        server.shutdown()
+
+
+def test_wire_unschedulable_and_recovery():
+    """Pods that do not fit fail with plugin attribution over the wire, park
+    unschedulable, and get scheduled after a node appears."""
+    service = DeviceService(batch_size=32)
+    server, port = serve(service)
+    try:
+        store = ClusterStore()
+        sched = WireScheduler(store, endpoint=f"http://127.0.0.1:{port}", batch_size=8)
+        store.create_node(
+            make_node("small").capacity({"cpu": "1", "memory": "2Gi", "pods": 10}).obj())
+        store.create_pod(make_pod("big").req({"cpu": "4", "memory": "4Gi"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 0
+        assert sched.queue.pending_pods()["unschedulable"] == 1
+        store.create_node(
+            make_node("large").capacity({"cpu": "8", "memory": "16Gi", "pods": 10}).obj())
+        # the reactivated pod sits out its backoff (1s) first
+        import time
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and _bound(store).get("big") != "large":
+            time.sleep(0.2)
+            sched.run_until_settled()
+        assert _bound(store).get("big") == "large"
+    finally:
+        server.shutdown()
+
+
+def test_wire_matches_in_process_placements():
+    """Same workload over the socket and in-process: identical placements
+    (same program, same batch numbering, same tie-break seeds)."""
+    def build(store):
+        for i in range(6):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 20})
+                .label("zone", f"z{i % 3}").obj())
+        for i in range(15):
+            pw = make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"})
+            if i % 3 == 0:
+                pw.label("app", "web").spread_constraint(
+                    1, "zone", selector=LabelSelector(match_labels={"app": "web"}))
+            store.create_pod(pw.obj())
+
+    service = DeviceService(batch_size=32)
+    server, port = serve(service)
+    try:
+        store_w = ClusterStore()
+        sched_w = WireScheduler(store_w, endpoint=f"http://127.0.0.1:{port}", batch_size=8)
+        build(store_w)
+        sched_w.run_until_settled()
+
+        import os
+        os.environ["KTPU_PIPELINE"] = "0"
+        try:
+            store_l = ClusterStore()
+            sched_l = TPUScheduler(store_l, batch_size=8)
+            build(store_l)
+            sched_l.run_until_settled()
+        finally:
+            os.environ.pop("KTPU_PIPELINE", None)
+
+        assert sched_w.metrics["scheduled"] == sched_l.metrics["scheduled"] == 15
+        assert _bound(store_w) == _bound(store_l)
+    finally:
+        server.shutdown()
