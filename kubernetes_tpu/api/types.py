@@ -235,6 +235,9 @@ class Toleration:
     operator: str = TOLERATION_OP_EQUAL
     value: str = ""
     effect: str = ""
+    # None = tolerate forever; N = the NoExecute taint manager evicts after
+    # N seconds (core/v1 Toleration.TolerationSeconds)
+    toleration_seconds: Optional[int] = None
 
     def tolerates(self, taint: Taint) -> bool:
         if self.effect and self.effect != taint.effect:
@@ -530,11 +533,38 @@ class PriorityClass:
 
 @dataclass
 class PodDisruptionBudget:
-    """policy/v1 PDB, consumed by preemption (preemption.go:397 criteria)."""
+    """policy/v1 PDB: spec (minAvailable/maxUnavailable, int or "N%") and the
+    status the disruption controller maintains (disruption.go updatePdbStatus),
+    consumed by preemption (preemption.go:397 criteria)."""
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Optional[LabelSelector] = None
+    # spec — None means unset; exactly one of the two is normally set
+    min_available: Optional[object] = None    # int or "N%"
+    max_unavailable: Optional[object] = None  # int or "N%"
+    # status
     disruptions_allowed: int = 0
+    current_healthy: int = 0
+    desired_healthy: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
+class LimitRangeItem:
+    """core/v1 LimitRangeItem (the Container type is what admission
+    applies; plugin/pkg/admission/limitranger)."""
+
+    type: str = "Container"
+    default: Dict[str, object] = field(default_factory=dict)          # limits
+    default_request: Dict[str, object] = field(default_factory=dict)  # requests
+    max: Dict[str, object] = field(default_factory=dict)
+    min: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class LimitRange:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    limits: Tuple[LimitRangeItem, ...] = ()
 
 
 # volume binding modes (storage/v1 StorageClass.VolumeBindingMode)

@@ -152,10 +152,131 @@ class ResourceQuotaAdmission(AdmissionPlugin):
         return undo
 
 
+class LimitRanger(AdmissionPlugin):
+    """plugin/pkg/admission/limitranger: apply the namespace's LimitRange
+    Container defaults to unset requests/limits, then validate against
+    min/max. Runs before quota so defaulted requests are what quota sees
+    (plugins.go:64 ordering)."""
+
+    name = "LimitRanger"
+
+    def _ranges(self, store, ns: str):
+        return [lr for lr in store.limit_ranges.values()
+                if lr.meta.namespace == ns]
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        pod: Pod = obj
+        for lr in self._ranges(store, pod.meta.namespace):
+            for item in lr.limits:
+                if item.type != "Container":
+                    continue
+                for c in pod.spec.containers:
+                    for r, q in item.default_request.items():
+                        c.requests.setdefault(r, q)
+                    for r, q in item.default.items():
+                        c.limits.setdefault(r, q)
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        pod: Pod = obj
+        for lr in self._ranges(store, pod.meta.namespace):
+            for item in lr.limits:
+                if item.type != "Container":
+                    continue
+                for c in pod.spec.containers:
+                    for r, q in item.max.items():
+                        req = c.requests.get(r)
+                        if req is not None and (
+                            resource_api.canonical(r, req) > resource_api.canonical(r, q)
+                        ):
+                            raise AdmissionError(
+                                self.name,
+                                f"container {c.name!r} {r} request {req} exceeds max {q}")
+                    for r, q in item.min.items():
+                        req = c.requests.get(r)
+                        if req is not None and (
+                            resource_api.canonical(r, req) < resource_api.canonical(r, q)
+                        ):
+                            raise AdmissionError(
+                                self.name,
+                                f"container {c.name!r} {r} request {req} below min {q}")
+
+
+# default NoExecute toleration window (defaulttolerationseconds/admission.go)
+DEFAULT_TOLERATION_SECONDS = 300
+NOT_READY_TAINT = "node.kubernetes.io/not-ready"
+UNREACHABLE_TAINT = "node.kubernetes.io/unreachable"
+
+
+class DefaultTolerationSeconds(AdmissionPlugin):
+    """plugin/pkg/admission/defaulttolerationseconds: every pod gets
+    NoExecute tolerations for not-ready/unreachable (bounded eviction delay)
+    unless it already tolerates them."""
+
+    name = "DefaultTolerationSeconds"
+
+    def admit(self, store, kind: str, obj) -> None:
+        from ..api.types import TOLERATION_OP_EXISTS, Taint, Toleration
+
+        if kind != "Pod":
+            return
+        pod: Pod = obj
+        extra = []
+        for key in (NOT_READY_TAINT, UNREACHABLE_TAINT):
+            taint = Taint(key=key, effect="NoExecute")
+            if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+                extra.append(Toleration(
+                    key=key, operator=TOLERATION_OP_EXISTS, effect="NoExecute",
+                    toleration_seconds=DEFAULT_TOLERATION_SECONDS))
+        if extra:
+            pod.spec.tolerations = tuple(pod.spec.tolerations) + tuple(extra)
+
+
+class PodNodeSelector(AdmissionPlugin):
+    """plugin/pkg/admission/podnodeselector: merge the namespace's
+    ``scheduler.alpha.kubernetes.io/node-selector`` annotation into the
+    pod's nodeSelector; conflicts reject the pod."""
+
+    name = "PodNodeSelector"
+    ANNOTATION = "scheduler.alpha.kubernetes.io/node-selector"
+
+    @staticmethod
+    def _parse(ann: str) -> dict:
+        out = {}
+        for part in ann.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            k, _, v = part.partition("=")
+            out[k.strip()] = v.strip()
+        return out
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        pod: Pod = obj
+        ns = store.namespaces.get(pod.meta.namespace)
+        ann = ns.meta.annotations.get(self.ANNOTATION) if ns is not None else None
+        if not ann:
+            return
+        for k, v in self._parse(ann).items():
+            cur = pod.spec.node_selector.get(k)
+            if cur is not None and cur != v:
+                raise AdmissionError(
+                    self.name,
+                    f"pod node selector {k}={cur} conflicts with namespace selector {k}={v}")
+            pod.spec.node_selector[k] = v
+
+
 def default_chain() -> List[AdmissionPlugin]:
     """AllOrderedPlugins, reduced to the modeled set (plugins.go:64 order:
-    lifecycle → priority → ... → quota last)."""
-    return [NamespaceLifecycle(), DefaultPriority(), ResourceQuotaAdmission()]
+    lifecycle → node selector → priority → tolerations → limits →
+    ... → quota last)."""
+    return [NamespaceLifecycle(), PodNodeSelector(), DefaultPriority(),
+            DefaultTolerationSeconds(), LimitRanger(), ResourceQuotaAdmission()]
 
 
 class AdmissionChain:

@@ -122,6 +122,7 @@ class ClusterStore:
         self.stateful_sets: Dict[str, StatefulSet] = {}
         self.leases: Dict[str, "Lease"] = {}
         self.resource_quotas: Dict[str, object] = {}
+        self.limit_ranges: Dict[str, object] = {}
         self.deployments: Dict[str, object] = {}
         self.daemon_sets: Dict[str, object] = {}
         self.jobs: Dict[str, object] = {}
@@ -223,6 +224,7 @@ class ClusterStore:
                 "Job": self.jobs,
                 "Endpoints": self.endpoints,
                 "ResourceQuota": self.resource_quotas,
+                "LimitRange": self.limit_ranges,
             }[kind]
         except KeyError:
             raise NotFound(f"unknown kind {kind!r}") from None
@@ -372,6 +374,11 @@ class ClusterStore:
         return obj.meta.name if kind in self.CLUSTER_SCOPED_KINDS else obj.meta.key()
 
     def create_object(self, kind: str, obj) -> None:
+        if kind == "Pod":
+            # Pods must take the full admission path (atomic quota charge
+            # under the lock); two create paths with divergent semantics was
+            # ADVICE r2 low #3
+            return self.create_pod(obj)
         self._admit(kind, obj)
         m = self._kind_map(kind)
         with self._lock:

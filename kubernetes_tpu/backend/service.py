@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from types import SimpleNamespace
@@ -55,6 +56,7 @@ class DeviceService:
         self.batch_size = batch_size
         self.infos: Dict[str, NodeInfo] = {}
         self.snap = SimpleNamespace(node_info_map=self.infos)
+        self.ns_labels: Dict[str, Dict[str, str]] = {}
         self.device: Optional[DeviceState] = None
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
@@ -76,6 +78,10 @@ class DeviceService:
                 self.infos[node.meta.name] = ni
             for name in req.get("removed", ()):
                 self.infos.pop(name, None)
+            # namespace labels ride along so namespaceSelector terms match
+            # identically to the in-process path (sig_table ns_labels_fn)
+            for ns, labels in (req.get("namespaces") or {}).items():
+                self.ns_labels[ns] = dict(labels)
             self._sync()
             return {"apiVersion": API_VERSION, "nodes": len(self.infos)}
 
@@ -83,8 +89,10 @@ class DeviceService:
         import dataclasses
 
         n = max(len(self.infos), 1)
+        ns_fn = lambda ns: self.ns_labels.get(ns, {})  # noqa: E731
         if self.device is None:
-            self.device = DeviceState(caps_for_cluster(n, batch=self.batch_size))
+            self.device = DeviceState(caps_for_cluster(n, batch=self.batch_size),
+                                      ns_labels_fn=ns_fn)
         elif self.device.caps.nodes < n:
             caps = self.device.caps
             nodes = caps.nodes
@@ -92,7 +100,8 @@ class DeviceService:
                 nodes *= 2
             self.device = DeviceState(dataclasses.replace(
                 caps, nodes=nodes,
-                value_words=max(caps.value_words, (nodes + 2 + 31) // 32)))
+                value_words=max(caps.value_words, (nodes + 2 + 31) // 32)),
+                ns_labels_fn=ns_fn)
 
     def _sync(self) -> None:
         self._ensure_device()
@@ -119,7 +128,9 @@ class DeviceService:
             while v < err.needed:
                 v *= 2
             updates[f] = v
-        self.device = DeviceState(dataclasses.replace(caps, **updates))
+        self.device = DeviceState(
+            dataclasses.replace(caps, **updates),
+            ns_labels_fn=lambda ns: self.ns_labels.get(ns, {}))
 
     # ------------------------------------------------------------- schedule
 
@@ -159,16 +170,20 @@ class DeviceService:
                     continue
                 if ff is None:
                     ff = np.asarray(result.first_fail)
-                plugins = sorted({int(v) for v in ff[i] if v > 0})
+                # REAL slots only — padding slots fail the fit check and
+                # would pollute the plugin attribution (queue gating)
+                plugins = set()
                 statuses = {}
                 for slot, name in slot_names.items():
                     fid = int(ff[i][slot])
-                    if fid > 0 and len(statuses) < 64:  # payload-bounded sample
-                        statuses[name] = _ATTRIBUTION_ORDER[fid - 1][0]
+                    if fid > 0:
+                        plugins.add(fid)
+                        if len(statuses) < 64:  # payload-bounded sample
+                            statuses[name] = _ATTRIBUTION_ORDER[fid - 1][0]
                 results.append({
                     "nodeName": None,
                     "unschedulablePlugins": [
-                        _ATTRIBUTION_ORDER[fid - 1][0] for fid in plugins],
+                        _ATTRIBUTION_ORDER[fid - 1][0] for fid in sorted(plugins)],
                     "statuses": statuses,
                 })
         return {"apiVersion": API_VERSION, "results": results}
@@ -228,8 +243,16 @@ class WireClient:
         req = urllib.request.Request(
             self.endpoint + path, data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=120) as resp:
-            out = json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # surface the handler's JSON diagnostic, not the bare status line
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:  # noqa: BLE001
+                detail = ""
+            raise RuntimeError(f"device service {e.code}: {detail}") from e
         if "error" in out:
             raise RuntimeError(out["error"])
         return out
@@ -254,10 +277,28 @@ class WireScheduler(Scheduler):
         self.client = WireClient(endpoint)
         self.batch_size = batch_size
         self._sent_gens: Dict[str, int] = {}
+        self._sent_ns: Dict[str, dict] = {}
+        self._batchable_cache: Dict[str, bool] = {}
         self.settle_abandoned = False
 
     def _wire_supported(self, pod: Pod) -> bool:
-        return not pod.spec.volumes
+        """Same gating as TPUScheduler.batch_supported: the service runs the
+        compiled DEFAULT plugin set — volume pods and custom profiles take
+        the local sequential path."""
+        if pod.spec.volumes:
+            return False
+        fwk = self.framework_for_pod(pod)
+        cached = self._batchable_cache.get(fwk.profile_name)
+        if cached is None:
+            from ..framework.registry import DEFAULT_PLUGINS
+
+            cached = all(
+                [(p.name(), w) for p, w in fwk.points.get(point, [])]
+                == list(DEFAULT_PLUGINS.get(point, []))
+                for point in ("pre_filter", "filter", "pre_score", "score")
+            )
+            self._batchable_cache[fwk.profile_name] = cached
+        return cached
 
     def _push_deltas(self) -> None:
         self.cache.update_snapshot(self.snapshot)
@@ -275,9 +316,16 @@ class WireScheduler(Scheduler):
             self._sent_gens[name] = ni.generation
         for n in removed:
             del self._sent_gens[n]
-        if entries or removed:
+        namespaces = {}
+        for ns, obj in self.store.namespaces.items():
+            labels = dict(obj.meta.labels)
+            if self._sent_ns.get(ns) != labels:
+                namespaces[ns] = labels
+                self._sent_ns[ns] = labels
+        if entries or removed or namespaces:
             self.client.apply_deltas(
-                {"apiVersion": API_VERSION, "nodes": entries, "removed": removed})
+                {"apiVersion": API_VERSION, "nodes": entries,
+                 "removed": removed, "namespaces": namespaces})
 
     def schedule_batch_cycle(self) -> int:
         self._periodic_housekeeping()
@@ -286,19 +334,27 @@ class WireScheduler(Scheduler):
             return 0
         t0 = self.now_fn()
         pod_cycle = self.queue.scheduling_cycle
-        batch: List[QueuedPodInfo] = []
+        buffer: List[QueuedPodInfo] = []
         for qp in qps:
             pod = self.store.get_pod(qp.pod.key())
             if pod is None or pod.spec.node_name or not self._responsible_for(pod):
                 continue
             qp.pod = pod
             if self._wire_supported(pod):
-                batch.append(qp)
-            else:
-                self.cache.update_snapshot(self.snapshot)
-                self.schedule_one_pod(qp, pod_cycle)
+                buffer.append(qp)
+                continue
+            # strict pop order: flush the wire batch before a fallback pod so
+            # a lower-priority local pod never jumps a batched one
+            self._flush_wire(buffer, pod_cycle, t0)
+            buffer = []
+            self.cache.update_snapshot(self.snapshot)
+            self.schedule_one_pod(qp, pod_cycle)
+        self._flush_wire(buffer, pod_cycle, t0)
+        return len(qps)
+
+    def _flush_wire(self, batch: List[QueuedPodInfo], pod_cycle: int, t0: float) -> None:
         if not batch:
-            return len(qps)
+            return
         self._push_deltas()
         res = self.client.schedule_batch(
             {"apiVersion": API_VERSION,
@@ -321,35 +377,11 @@ class WireScheduler(Scheduler):
                     d, pod_cycle)
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
-        return len(qps)
 
     def run_until_settled(self, max_cycles: int = 100000, flush: bool = True,
-                          max_no_progress: int = 200) -> int:
-        cycles = 0
-        no_progress = 0
-        self.settle_abandoned = False
-        while cycles < max_cycles:
-            before = self.metrics["scheduled"]
-            before_unsched = self.queue.pending_pods()["unschedulable"]
-            n = self.schedule_batch_cycle()
-            if n == 0:
-                if flush:
-                    self.queue.flush_backoff_completed()
-                    if self.queue.pending_pods()["active"] > 0:
-                        no_progress += 1
-                        if no_progress > max_no_progress:
-                            self.settle_abandoned = True
-                            break
-                        continue
-                break
-            cycles += n
-            pending = self.queue.pending_pods()
-            if (self.metrics["scheduled"] > before
-                    or pending["unschedulable"] > before_unsched):
-                no_progress = 0
-            else:
-                no_progress += 1
-                if no_progress > max_no_progress:
-                    self.settle_abandoned = True
-                    break
-        return cycles
+                          idle_wait: float = 0.005, max_no_progress: int = 200) -> int:
+        # the shared batched settle loop (Scheduler.run_batched_until_settled),
+        # incl. the idle-wait backoff for flapping pods
+        return self.run_batched_until_settled(
+            max_cycles=max_cycles, flush=flush, idle_wait=idle_wait,
+            max_no_progress=max_no_progress)

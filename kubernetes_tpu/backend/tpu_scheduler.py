@@ -648,59 +648,10 @@ class TPUScheduler(Scheduler):
 
     def run_until_settled(self, max_cycles: int = 100000, flush: bool = True,
                           idle_wait: float = 0.005, max_no_progress: int = 200) -> int:
-        """Drive cycles until the queue settles.
-
-        The reference blocks on ``Pop``; this loop instead waits briefly and
-        bounds consecutive no-placement iterations, so a pod that flaps
-        between queues (fails, re-enters activeQ with a lapsed backoff, fails
-        again) cannot turn this into a hot spin (VERDICT r1 weak #7).
-        """
-        import time as _time
-
-        cycles = 0
-        no_progress = 0
-        self.settle_abandoned = False
-        while cycles < max_cycles:
-            before_sched = self.metrics["scheduled"]
-            before_unsched = self.queue.pending_pods()["unschedulable"]
-            n = self.schedule_batch_cycle()
-            if n == 0:
-                if flush:
-                    self.queue.flush_backoff_completed()
-                    if self.queue.pending_pods()["active"] > 0:
-                        no_progress += 1
-                        if no_progress > max_no_progress:
-                            self._abandon_settle()
-                            break
-                        continue
-                break
-            cycles += n
-            pending = self.queue.pending_pods()
-            # Progress = placements OR pods newly parked unschedulable (they
-            # stay parked until an external event; failure-draining a batch
-            # IS progress toward settling). Only cycles that neither place
-            # nor park — a pod flapping straight back into activeQ — pay the
-            # wait and count toward the bound.
-            if (self.metrics["scheduled"] > before_sched
-                    or pending["unschedulable"] > before_unsched):
-                no_progress = 0
-            else:
-                no_progress += 1
-                if no_progress > max_no_progress:
-                    self._abandon_settle()
-                    break
-                _time.sleep(idle_wait * min(no_progress, 10))
+        """Drive cycles until the queue settles (the shared batched loop,
+        Scheduler.run_batched_until_settled), then land any in-flight batch."""
+        cycles = self.run_batched_until_settled(
+            max_cycles=max_cycles, flush=flush, idle_wait=idle_wait,
+            max_no_progress=max_no_progress)
         self._drain_inflight()
         return cycles
-
-    def _abandon_settle(self) -> None:
-        """Mark and log a no-progress early exit so callers (perf Runner,
-        bench) can tell a settled queue from an abandoned one instead of
-        silently reporting numbers over a partial workload."""
-        import logging
-
-        self.settle_abandoned = True
-        self.metrics["settle_abandoned"] = self.metrics.get("settle_abandoned", 0) + 1
-        logging.getLogger(__name__).warning(
-            "run_until_settled: no progress after bound; %s pods still pending",
-            self.queue.pending_pods())

@@ -24,6 +24,7 @@ from .housekeeping import (
     PVBinderController,
     ResourceQuotaController,
 )
+from .disruption import DisruptionController
 from .nodelifecycle import NodeLifecycleController
 from .workloads import (
     DaemonSetController,
@@ -55,6 +56,7 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         "endpoints": lambda m: EndpointsController(m.store, m.factory),
         "pvbinder": lambda m: PVBinderController(m.store, m.factory),
         "resourcequota": lambda m: ResourceQuotaController(m.store, m.factory),
+        "disruption": lambda m: DisruptionController(m.store, m.factory),
     }
 
 

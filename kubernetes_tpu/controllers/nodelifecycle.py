@@ -33,6 +33,7 @@ class NodeLifecycleController(Controller):
         self.grace_period = grace_period
         self.now_fn = now_fn
         self.evict = evict
+        self._not_ready_since: dict = {}  # node -> when it went unhealthy
 
     def keys_for(self, kind: str, obj, event: str) -> List[str]:
         if kind == "Node":
@@ -64,12 +65,15 @@ class NodeLifecycleController(Controller):
             # node never heartbeat (no kubelet): leave as created
             return
         if healthy and not node.status.ready:
+            self._not_ready_since.pop(key, None)
             self._set_health(node, ready=True)
         elif not healthy and node.status.ready:
+            self._not_ready_since.setdefault(key, self.now_fn())
             self._set_health(node, ready=False)
             if self.evict:
                 self._evict_pods(key)
         elif not healthy and self.evict:
+            self._not_ready_since.setdefault(key, self.now_fn())
             self._evict_pods(key)
 
     def _set_health(self, node: Node, ready: bool) -> None:
@@ -86,15 +90,26 @@ class NodeLifecycleController(Controller):
         self.store.update_node(new)
 
     def _evict_pods(self, node_name: str) -> None:
-        """NoExecute taint manager: delete pods on the node lacking an
-        unreachable/not-ready toleration (taint_manager.go)."""
+        """NoExecute taint manager (taint_manager.go): pods with no matching
+        toleration go immediately; pods whose matching tolerations all carry
+        a finite tolerationSeconds go after the minimum window (the
+        DefaultTolerationSeconds admission default is 300s); an unbounded
+        matching toleration keeps the pod forever."""
+        since = self._not_ready_since.get(node_name)
+        now = self.now_fn()
         for pod in list(self.store.pods.values()):
             if pod.spec.node_name != node_name:
                 continue
-            tolerated = any(
-                tol.key in (TAINT_UNREACHABLE, TAINT_NOT_READY, "")
+            matching = [
+                tol for tol in pod.spec.tolerations
+                if tol.key in (TAINT_UNREACHABLE, TAINT_NOT_READY, "")
                 and tol.effect in ("", TAINT_NO_EXECUTE)
-                for tol in pod.spec.tolerations
-            )
-            if not tolerated:
+            ]
+            if not matching:
+                self.store.delete_pod(pod.meta.key())
+                continue
+            windows = [t.toleration_seconds for t in matching]
+            if None in windows:
+                continue  # unbounded toleration
+            if since is not None and now - since > min(windows):
                 self.store.delete_pod(pod.meta.key())

@@ -278,9 +278,23 @@ class Evaluator:
         if not self._fits(state, pod, ni):
             return [], 0, False
 
+        # filterPodsWithPDBViolation (defaultpreemption): a pod violates iff
+        # any matching PDB has no remaining disruption budget — budgets are
+        # the controller-maintained live disruptionsAllowed, consumed as
+        # earlier victims claim them
         violating, non_violating = [], []
+        consumed: Dict[str, int] = {}
         for p in remove:
-            (violating if pdbs_for_pod(p, pdbs) else non_violating).append(p)
+            matching = pdbs_for_pod(p, pdbs)
+            is_viol = any(
+                pdb.disruptions_allowed - consumed.get(pdb.meta.key(), 0) <= 0
+                for pdb in matching
+            )
+            if not is_viol:
+                for pdb in matching:
+                    k = pdb.meta.key()
+                    consumed[k] = consumed.get(k, 0) + 1
+            (violating if is_viol else non_violating).append(p)
         violating.sort(key=lambda p: (-p.spec.priority, p.status.start_time))
         non_violating.sort(key=lambda p: (-p.spec.priority, p.status.start_time))
 

@@ -179,6 +179,7 @@ class Runner:
         server = getattr(self, "_server", None)
         if server is not None:
             server.shutdown()
+            server.server_close()  # release the listening socket fd
             self._server = None
 
     # ---- ops ----

@@ -190,6 +190,11 @@ class TestNodeLifecycle:
         node = store.nodes["n1"]
         assert not node.status.ready
         assert any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+        # the DefaultTolerationSeconds admission default (300s) keeps the pod
+        # through the bounded window, then the taint manager evicts
+        assert store.get_pod("default/victim") is not None
+        clock.advance(301.0)
+        m.sync_round(monitor_nodes=True)
         assert store.get_pod("default/victim") is None  # evicted
 
     def test_recovery_clears_taint(self):

@@ -1,0 +1,182 @@
+"""Disruption controller (live PDB status) and the round-3 admission
+plugins: LimitRanger, DefaultTolerationSeconds, PodNodeSelector — plus
+preemption consuming controller-maintained disruption budgets."""
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    LabelSelector,
+    LimitRange,
+    LimitRangeItem,
+    Namespace,
+    ObjectMeta,
+    PodDisruptionBudget,
+    Taint,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.admission import AdmissionError
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def make_manager(store, controllers=None):
+    return ControllerManager(store, factory=SharedInformerFactory(store),
+                             controllers=controllers, now_fn=FakeClock())
+
+
+def _pdb(name="pdb", min_available=None, max_unavailable=None, labels=None):
+    return PodDisruptionBudget(
+        meta=ObjectMeta(name=name),
+        selector=LabelSelector(match_labels=labels or {"app": "web"}),
+        min_available=min_available, max_unavailable=max_unavailable)
+
+
+class TestDisruptionController:
+    def test_status_from_min_available(self):
+        store = ClusterStore()
+        m = make_manager(store, ["disruption"])
+        store.create_object("PodDisruptionBudget", _pdb(min_available=3))
+        for i in range(5):
+            store.create_pod(
+                make_pod(f"w{i}").req({"cpu": "100m"}).label("app", "web")
+                .node(f"n{i}").obj())
+        m.settle()
+        pdb = next(iter(store.pdbs.values()))
+        assert pdb.expected_pods == 5
+        assert pdb.current_healthy == 5
+        assert pdb.desired_healthy == 3
+        assert pdb.disruptions_allowed == 2
+
+    def test_status_tracks_pod_deletes_and_percentages(self):
+        store = ClusterStore()
+        m = make_manager(store, ["disruption"])
+        store.create_object("PodDisruptionBudget", _pdb(max_unavailable="50%"))
+        for i in range(4):
+            store.create_pod(
+                make_pod(f"w{i}").req({"cpu": "100m"}).label("app", "web")
+                .node(f"n{i}").obj())
+        m.settle()
+        pdb = next(iter(store.pdbs.values()))
+        assert pdb.expected_pods == 4
+        assert pdb.desired_healthy == 2  # 4 - ceil(50% of 4)
+        assert pdb.disruptions_allowed == 2
+        store.delete_pod("default/w0")
+        m.settle()
+        pdb = next(iter(store.pdbs.values()))  # status writes clone the PDB
+        assert pdb.expected_pods == 3
+        assert pdb.desired_healthy == 1  # 3 - ceil(1.5)
+        assert pdb.disruptions_allowed == 2
+
+    def test_unbound_pods_not_healthy(self):
+        store = ClusterStore()
+        m = make_manager(store, ["disruption"])
+        store.create_object("PodDisruptionBudget", _pdb(min_available=1))
+        store.create_pod(make_pod("pending").req({"cpu": "100m"}).label("app", "web").obj())
+        m.settle()
+        pdb = next(iter(store.pdbs.values()))
+        assert pdb.expected_pods == 1
+        assert pdb.current_healthy == 0
+        assert pdb.disruptions_allowed == 0
+
+
+class TestAdmissionPlugins:
+    def test_limit_ranger_defaults_then_quota_sees_them(self):
+        store = ClusterStore()
+        store.create_object("LimitRange", LimitRange(
+            meta=ObjectMeta(name="lr"),
+            limits=(LimitRangeItem(
+                default_request={"cpu": "200m", "memory": "256Mi"},
+                max={"cpu": "1"}),)))
+        store.create_pod(make_pod("defaulted").obj())
+        p = store.get_pod("default/defaulted")
+        assert p.spec.containers[0].requests["cpu"] == "200m"
+        assert p.resource_request()["cpu"] == 200
+
+    def test_limit_ranger_rejects_over_max(self):
+        store = ClusterStore()
+        store.create_object("LimitRange", LimitRange(
+            meta=ObjectMeta(name="lr"),
+            limits=(LimitRangeItem(max={"cpu": "1"}),)))
+        with pytest.raises(AdmissionError, match="exceeds max"):
+            store.create_pod(make_pod("big").req({"cpu": "2"}).obj())
+
+    def test_default_toleration_seconds(self):
+        store = ClusterStore()
+        store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+        p = store.get_pod("default/p")
+        assert any(
+            t.tolerates(Taint(key="node.kubernetes.io/not-ready", effect="NoExecute"))
+            for t in p.spec.tolerations)
+        assert any(
+            t.tolerates(Taint(key="node.kubernetes.io/unreachable", effect="NoExecute"))
+            for t in p.spec.tolerations)
+
+    def test_pod_node_selector_merge_and_conflict(self):
+        store = ClusterStore()
+        store.create_namespace(Namespace(meta=ObjectMeta(
+            name="team-a",
+            annotations={"scheduler.alpha.kubernetes.io/node-selector": "tier=gold"})))
+        pw = make_pod("p").req({"cpu": "100m"})
+        pod = pw.obj()
+        pod.meta.namespace = "team-a"
+        store.create_pod(pod)
+        assert store.get_pod("team-a/p").spec.node_selector["tier"] == "gold"
+
+        bad = make_pod("q").req({"cpu": "100m"}).obj()
+        bad.meta.namespace = "team-a"
+        bad.spec.node_selector["tier"] = "bronze"
+        with pytest.raises(AdmissionError, match="conflicts"):
+            store.create_pod(bad)
+
+    def test_quota_charged_via_create_object(self):
+        """ADVICE r2 low #3: create_object('Pod', ...) must charge quota like
+        create_pod."""
+        from kubernetes_tpu.api.types import ResourceQuota
+
+        store = ClusterStore()
+        store.create_object("ResourceQuota", ResourceQuota(
+            meta=ObjectMeta(name="rq"), hard={"pods": 1}))
+        store.create_object("Pod", make_pod("one").req({"cpu": "100m"}).obj())
+        with pytest.raises(AdmissionError, match="exceeded quota"):
+            store.create_object("Pod", make_pod("two").req({"cpu": "100m"}).obj())
+
+
+class TestPreemptionWithLiveBudgets:
+    def test_preemption_prefers_node_with_disruption_budget(self):
+        """Two preemption candidates; victims on one are PDB-protected with
+        zero remaining budget, the other's PDB still has headroom — the
+        5-criteria selection must prefer the budgeted node (criterion 1)."""
+        from kubernetes_tpu.scheduler import Scheduler
+
+        store = ClusterStore()
+        m = make_manager(store, ["disruption"])
+        sched = Scheduler(store)
+        for name in ("tight", "roomy"):
+            store.create_node(
+                make_node(name).capacity({"cpu": "2", "memory": "4Gi", "pods": 10}).obj())
+        # tight: victim protected by a zero-budget PDB (minAvailable = count)
+        store.create_object("PodDisruptionBudget", _pdb(
+            "pdb-tight", min_available=1, labels={"group": "tight"}))
+        # roomy: PDB with slack
+        store.create_object("PodDisruptionBudget", _pdb(
+            "pdb-roomy", min_available=0, labels={"group": "roomy"}))
+        v1 = make_pod("v-tight").req({"cpu": "1500m"}).label("group", "tight").priority(0).obj()
+        v1.spec.node_name = "tight"
+        store.create_pod(v1)
+        v2 = make_pod("v-roomy").req({"cpu": "1500m"}).label("group", "roomy").priority(0).obj()
+        v2.spec.node_name = "roomy"
+        store.create_pod(v2)
+        m.settle()
+        assert store.pdbs["default/pdb-tight"].disruptions_allowed == 0
+        assert store.pdbs["default/pdb-roomy"].disruptions_allowed == 1
+
+        store.create_pod(
+            make_pod("preemptor").req({"cpu": "1500m"}).priority(100).obj())
+        sched.run_until_settled()
+        objs, _ = store.list_objects("Pod")
+        names = {p.meta.name for p in objs}
+        # the roomy victim was evicted; the protected one survived
+        assert "v-tight" in names
+        assert "v-roomy" not in names
