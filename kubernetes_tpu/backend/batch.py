@@ -103,6 +103,8 @@ class BatchResult(NamedTuple):
     final_seg_exist: Optional[jax.Array] = None      # [T, Vd] int32
     # evolved priority-class table (preemption screen input), None on pallas
     final_class_req: Optional[jax.Array] = None      # [N, C, R] int32
+    # evolved adaptive-sampling rotation start (None when sampling disabled)
+    final_sample_start: Optional[jax.Array] = None   # [] int32
 
 
 def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
@@ -141,6 +143,8 @@ def schedule_batch_core(
     num_shards: int = 1,
     pallas: Optional[str] = None,
     topo_carry: Optional[Tuple[jax.Array, jax.Array]] = None,
+    sample_k: Optional[jax.Array] = None,
+    sample_start: Optional[jax.Array] = None,
 ) -> BatchResult:
     """The traceable body; nt's node axis may be a shard (axis_name set).
     ``topo_enabled`` is a trace-time flag: batches with no spread constraints,
@@ -213,7 +217,10 @@ def schedule_batch_core(
 
     if pallas is not None:
         # fused Pallas step: the whole per-pod dynamic computation + commit
-        # in one VMEM-resident kernel (ops/pallas_step.py)
+        # in one VMEM-resident kernel (ops/pallas_step.py). No sampling
+        # emulation here — returning full-evaluation results as if sampled
+        # would silently drop the rotation carry.
+        assert sample_k is None, "pallas path has no sampling emulation"
         interp = pallas == "interpret"
         alloc_t = nt.allocatable.T
         wvec = np.asarray([[
@@ -263,7 +270,7 @@ def schedule_batch_core(
         )
 
     def step(carry, xs):
-        req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist = carry
+        req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist, samp_start = carry
         row = xs["row"]
         (p_req, p_nz, p_static_ok, p_affinity_ok, p_taint, p_aff, p_img, p_bits,
          p_jitter, p_valid, p_sff) = row
@@ -286,6 +293,27 @@ def schedule_batch_core(
             ipa_ok = ones_pn
 
         feasible = p_static_ok & fit_ok & ports_ok & spread_ok & ipa_ok
+
+        if sample_k is not None:
+            # adaptive-sampling emulation (schedule_one.go:525-545 +
+            # nextStartNodeIndex rotation :475-478): only the first K
+            # feasible nodes in rotated slot order are eligible; the start
+            # rotates past every examined node, exactly like the host's
+            # early-exit loop. The reference iterates its snapshot list;
+            # the device iterates slots — same-distribution sampling with a
+            # different (documented) node order.
+            iota_n = jnp.arange(N, dtype=jnp.int32)
+            perm = (samp_start + iota_n) % N          # rotated order -> slot
+            f_rot = jnp.take(feasible, perm)
+            c = jnp.cumsum(f_rot.astype(jnp.int32))
+            elig_rot = f_rot & (c <= sample_k)
+            eligible = jnp.zeros_like(feasible).at[perm].set(elig_rot)
+            reached = jnp.any(c >= sample_k)
+            kth_pos = jnp.argmax(c >= sample_k).astype(jnp.int32)
+            processed = jnp.where(reached, kth_pos + 1, np.int32(N))
+            # invalid pods examine nothing (no rotation burn)
+            samp_start = jnp.where(p_valid, (samp_start + processed) % N, samp_start)
+            feasible = feasible & eligible
 
         # resource scores against the evolving requested state
         nz_req = nz_dyn.astype(jnp.float32) + p_nz[None, :].astype(jnp.float32)
@@ -345,7 +373,7 @@ def schedule_batch_core(
         if topo_enabled:
             ff = jnp.where((ff == 0) & ~spread_ok, np.int8(7), ff)
             ff = jnp.where((ff == 0) & ~ipa_ok, np.int8(8), ff)
-        return (req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist), (
+        return (req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist, samp_start), (
             out_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok, ff,
         )
 
@@ -360,10 +388,12 @@ def schedule_batch_core(
     else:
         seg_exist0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
     sel0, seg0 = (tc.sel_counts, seg_exist0) if topo_carry is None else topo_carry
-    carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits, sel0, seg0)
+    start0 = (jnp.asarray(sample_start, jnp.int32) if sample_start is not None
+              else jnp.zeros((), jnp.int32))
+    carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits, sel0, seg0, start0)
     final_carry, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok, first_fail) = lax.scan(
         step, carry0, xs)
-    f_req, f_nz, f_port, f_sel, f_seg = final_carry
+    f_req, f_nz, f_port, f_sel, f_seg, f_start = final_carry
 
     # evolve the priority-class table by the batch's commits in ONE post-scan
     # scatter (no carry needed — nothing in-scan reads it); under shard_map
@@ -394,6 +424,7 @@ def schedule_batch_core(
         final_sel_counts=f_sel,
         final_seg_exist=f_seg,
         final_class_req=f_class,
+        final_sample_start=f_start if sample_k is not None else None,
     )
 
 
@@ -409,21 +440,27 @@ def schedule_batch(
     topo_enabled: bool = True,
     pallas: Optional[str] = None,
     topo_carry: Optional[Tuple[jax.Array, jax.Array]] = None,
+    sample_k: Optional[jax.Array] = None,
+    sample_start: Optional[jax.Array] = None,
 ) -> BatchResult:
     return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
-                               pallas=pallas, topo_carry=topo_carry)
+                               pallas=pallas, topo_carry=topo_carry,
+                               sample_k=sample_k, sample_start=sample_start)
 
 
 def build_schedule_batch_fn(weights: Dict[str, float] = None):
     """Bind plugin weights statically; returns
-    fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None)
-    -> BatchResult."""
+    fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
+    sample_k=None, sample_start=None) -> BatchResult."""
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
 
-    def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None):
-        mode = pallas_mode(nt, None, topo_enabled)  # env read outside jit
+    def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
+           sample_k=None, sample_start=None):
+        # the pallas fused step has no sampling emulation yet
+        mode = None if sample_k is not None else pallas_mode(nt, None, topo_enabled)
         return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
                               topo_enabled=topo_enabled, pallas=mode,
-                              topo_carry=topo_carry)
+                              topo_carry=topo_carry, sample_k=sample_k,
+                              sample_start=sample_start)
 
     return fn

@@ -52,14 +52,17 @@ API_VERSION = "ktpu/v1"
 class DeviceService:
     """Server core: node mirror + device state + one compiled batch program."""
 
-    def __init__(self, batch_size: int = 512):
+    def __init__(self, batch_size: int = 512,
+                 percentage_of_nodes_to_score: int = 0):
         self.batch_size = batch_size
+        self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.infos: Dict[str, NodeInfo] = {}
         self.snap = SimpleNamespace(node_info_map=self.infos)
         self.ns_labels: Dict[str, Dict[str, str]] = {}
         self.device: Optional[DeviceState] = None
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
+        self._start_carry = None  # adaptive-sampling rotation (device scalar)
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------- deltas
@@ -150,10 +153,25 @@ class DeviceService:
                 raise RuntimeError("device capacities refuse to converge")
             host_pb = self.device.encoder.last_host_pb
             self.batch_counter += 1
+            # adaptive sampling parity with the in-process batched path
+            from ..scheduler.scheduler import num_feasible_nodes_to_find
+
+            n_valid = len(self.infos)
+            k = num_feasible_nodes_to_find(n_valid, self.percentage_of_nodes_to_score)
+            if k < n_valid:
+                sample_k = np.int32(k)
+                sample_start = (self._start_carry if self._start_carry is not None
+                                else np.int32(0))
+            else:
+                sample_k = None
+                sample_start = None
             result = self.schedule_batch_fn(
                 pb, et, self.device.nt, self.device.tc, tb,
                 np.int32(self.batch_counter),
-                topo_enabled=self.device.topo_enabled)
+                topo_enabled=self.device.topo_enabled,
+                sample_k=sample_k, sample_start=sample_start)
+            if result.final_sample_start is not None:
+                self._start_carry = result.final_sample_start
             node_idx = np.asarray(result.node_idx)
             # adopt exactly like the in-process path: the client will assume
             # these placements; its next delta push re-encodes any row the

@@ -148,6 +148,9 @@ class TPUScheduler(Scheduler):
         # pending (ADVICE r2: harness consumers must be able to distinguish
         # settled from abandoned)
         self.settle_abandoned = False
+        # adaptive-sampling rotation start: a device scalar chained from the
+        # previous batch's evolved carry (schedule_one.go:475 rotation)
+        self._start_carry = None
         # async pipeline (SURVEY §2.7 P3 analog): at most one dispatched
         # batch in flight; its host commit overlaps the next batch's device
         # compute. KTPU_PIPELINE=0 forces the synchronous path.
@@ -349,12 +352,31 @@ class TPUScheduler(Scheduler):
         carry = None
         if prev is not None and prev.result.final_sel_counts is not None:
             carry = (prev.result.final_sel_counts, prev.result.final_seg_exist)
+        # adaptive sampling (percentageOfNodesToScore parity): only when the
+        # knob actually restricts — k == n means full evaluation and the
+        # plain (pallas-capable) program
+        n_valid = self.cache.node_count()
+        k = self.num_feasible_nodes_to_find(n_valid)
+        if k < n_valid:
+            sample_k = np.int32(k)
+            sample_start = (self._start_carry if self._start_carry is not None
+                            else np.int32(0))
+        else:
+            sample_k = None
+            sample_start = None
         result = self._run_batch_fn(
             pb, et, self.device.nt, self.device.tc, tb, key,
             adopt=True,
             topo_enabled=self.device.topo_enabled,
             topo_carry=carry,
+            sample_k=sample_k,
+            sample_start=sample_start,
         )
+        if result.final_sample_start is not None:
+            # keep the rotation index across unsampled batches too (the
+            # reference's nextStartNodeIndex persists across attempts) —
+            # only sampled batches advance it
+            self._start_carry = result.final_sample_start
         t_dispatch = self.now_fn()
         try:
             # stage the one host-read early: by commit time the transfer has
@@ -443,6 +465,7 @@ class TPUScheduler(Scheduler):
 
             logging.getLogger(__name__).exception("batch commit failed; requeueing")
             self.device = None  # full rebuild + resync on next _ensure_device
+            self._start_carry = None  # dead-backend future
             # anything dispatched after fl was computed on the dead device;
             # its futures are poison too — fail it back alongside fl
             stale, self._inflight = self._inflight, None

@@ -444,19 +444,8 @@ class Scheduler:
         return [by_name[n.meta.name] for n in nodes]
 
     def num_feasible_nodes_to_find(self, num_all_nodes: int) -> int:
-        """Adaptive sampling (:525): 100% under 100 nodes; else
-        percentageOfNodesToScore or adaptive 50 − N/125, floored at 5%."""
-        if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or self.percentage_of_nodes_to_score >= 100:
-            return num_all_nodes
-        pct = self.percentage_of_nodes_to_score
-        if pct == 0:
-            pct = int(50 - num_all_nodes / 125)
-            if pct < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
-                pct = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
-        num = num_all_nodes * pct // 100
-        if num < MIN_FEASIBLE_NODES_TO_FIND:
-            return MIN_FEASIBLE_NODES_TO_FIND
-        return num
+        return num_feasible_nodes_to_find(num_all_nodes,
+                                          self.percentage_of_nodes_to_score)
 
     def _select_host(self, totals: Dict[str, int]) -> str:
         """(schedule_one.go:709) argmax + reservoir uniform tie-break."""
@@ -576,3 +565,20 @@ class Scheduler:
         logging.getLogger(__name__).warning(
             "run_until_settled: no progress after bound; %s pods still pending",
             self.queue.pending_pods())
+
+
+def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int = 0) -> int:
+    """Adaptive sampling (:525): 100% under 100 nodes; else
+    percentageOfNodesToScore or adaptive 50 − N/125, floored at 5%. Shared
+    by the sequential, batched, and wire-service paths."""
+    if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND or percentage >= 100:
+        return num_all_nodes
+    pct = percentage
+    if pct == 0:
+        pct = int(50 - num_all_nodes / 125)
+        if pct < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+            pct = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+    num = num_all_nodes * pct // 100
+    if num < MIN_FEASIBLE_NODES_TO_FIND:
+        return MIN_FEASIBLE_NODES_TO_FIND
+    return num
