@@ -145,12 +145,25 @@ def schedule_batch_core(
     topo_carry: Optional[Tuple[jax.Array, jax.Array]] = None,
     sample_k: Optional[jax.Array] = None,
     sample_start: Optional[jax.Array] = None,
+    topo_mode: Optional[str] = None,
+    vd_override: Optional[int] = None,
+    host_key: int = 0,
 ) -> BatchResult:
     """The traceable body; nt's node axis may be a shard (axis_name set).
     ``topo_enabled`` is a trace-time flag: batches with no spread constraints,
     no affinity terms and no registered count rows compile a program with the
-    whole topology path dead-code-eliminated (the common fast case)."""
+    whole topology path dead-code-eliminated (the common fast case).
+
+    ``topo_mode``: None derives from topo_enabled ("general"/"off").
+    "host" = every involved topology key is kubernetes.io/hostname — the
+    per-step segment scatters collapse to per-node count reads
+    (ops/topology.py hostname fast path); the seg_exist carry slot then
+    holds the per-node term-count table [T, N]. ``vd_override`` shrinks the
+    general path's domain axis to the involved keys' actual vocab size."""
     weights = dict(weights_key)
+    if topo_mode is None:
+        topo_mode = "general" if topo_enabled else "off"
+    topo_enabled = topo_mode != "off"
     N = nt.capacity  # local shard size under shard_map
     if key.ndim == 0:
         # scalar seed: derive the key in-program. The eager host-side
@@ -194,11 +207,15 @@ def schedule_batch_core(
     total_nodes = jnp.maximum(_gsum(jnp.sum(nt.valid), axis_name), 1)
     image_score = scores.score_image_locality(pb, nt, total_nodes=total_nodes)
 
-    vd = int(et.bits.shape[1]) * 32  # value-id domain capacity (per-key vocab)
-    if topo_enabled:
+    # value-id domain capacity: the involved keys' vocab size when the
+    # caller computed it, else the full per-key vocab padding
+    vd = vd_override if vd_override else int(et.bits.shape[1]) * 32
+    if topo_mode == "general":
         topo_static = topology.make_static(
             tc.term_counts, tc.term_key, nt.label_val, nt.valid, vd, axis_name
         )
+    elif topo_mode == "host":
+        hostkey_ok = nt.label_val[:, host_key] > 0  # [N] node has a hostname
 
     # tie-break jitter keyed by GLOBAL slot: every shard draws the same
     # [P, N_global] table and slices its window, so the sharded program picks
@@ -280,7 +297,14 @@ def schedule_batch_core(
         conflict = jnp.any(port_dyn & p_bits[None, :], axis=-1)
         ports_ok = ~conflict
 
-        if topo_enabled:
+        if topo_mode == "host":
+            tbx = xs["tb"]
+            spread_ok = topology.spread_filter_host(
+                tbx, sel_counts, hostkey_ok, nt.valid, p_affinity_ok, axis_name)
+            ipa_aff_ok, ipa_anti_ok, ipa_exist_ok, exist_at = topology.ipa_filter_host(
+                tbx, sel_counts, seg_exist, hostkey_ok, nt.valid, axis_name)
+            ipa_ok = ipa_aff_ok & ipa_anti_ok & ipa_exist_ok
+        elif topo_enabled:
             tbx = xs["tb"]
             spread_ok = topology.spread_filter(
                 tbx, sel_counts, nt.label_val, nt.valid, p_affinity_ok, vd, axis_name)
@@ -333,7 +357,12 @@ def schedule_batch_core(
             + weights["NodeAffinity"] * _normalize(p_aff, feasible, False, axis_name)
             + weights["ImageLocality"] * p_img
         )
-        if topo_enabled:
+        if topo_mode == "host":
+            total = total + weights["PodTopologySpread"] * topology.spread_score_host(
+                tbx, sel_counts, hostkey_ok, nt.valid, p_affinity_ok, feasible, axis_name)
+            total = total + weights["InterPodAffinity"] * topology.ipa_score_host(
+                tbx, sel_counts, exist_at, hostkey_ok, feasible, axis_name)
+        elif topo_enabled:
             total = total + weights["PodTopologySpread"] * topology.spread_score(
                 tbx, sel_counts, nt.label_val, nt.valid, p_affinity_ok, feasible, vd, axis_name)
             total = total + weights["InterPodAffinity"] * topology.ipa_score(
@@ -362,7 +391,11 @@ def schedule_batch_core(
         port_dyn = port_dyn.at[local_idx].set(
             jnp.where(commit, port_dyn[local_idx] | p_bits, port_dyn[local_idx])
         )
-        if topo_enabled:
+        if topo_mode == "host":
+            sel_counts, seg_exist = topology.commit_update_host(
+                sel_counts, seg_exist, local_idx, any_feasible, mine,
+                tbx["pod_sig_mask"], tbx["pod_term_mask"])
+        elif topo_enabled:
             sel_counts, seg_exist = topology.commit_update(
                 sel_counts, seg_exist, topo_static.dom_t, local_idx,
                 any_feasible, mine, tbx["pod_sig_mask"], tbx["pod_term_mask"], axis_name)
@@ -382,7 +415,10 @@ def schedule_batch_core(
         affinity_raw, image_score, pod_bits, jitter, pb.valid, static_ff,
     )
     xs = {"row": rows}
-    if topo_enabled:
+    if topo_mode == "host":
+        xs["tb"] = {f.name: getattr(tb, f.name) for f in dataclasses.fields(tb)}
+        seg_exist0 = tc.term_counts  # [T, N]: per-node term counts ARE the carry
+    elif topo_enabled:
         xs["tb"] = {f.name: getattr(tb, f.name) for f in dataclasses.fields(tb)}
         seg_exist0 = topo_static.seg_exist0
     else:
@@ -428,7 +464,8 @@ def schedule_batch_core(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("weights_key", "topo_enabled", "pallas"))
+@functools.partial(jax.jit, static_argnames=(
+    "weights_key", "topo_enabled", "pallas", "topo_mode", "vd_override", "host_key"))
 def schedule_batch(
     pb: PodBatch,
     et: ExprTable,
@@ -442,25 +479,33 @@ def schedule_batch(
     topo_carry: Optional[Tuple[jax.Array, jax.Array]] = None,
     sample_k: Optional[jax.Array] = None,
     sample_start: Optional[jax.Array] = None,
+    topo_mode: Optional[str] = None,
+    vd_override: Optional[int] = None,
+    host_key: int = 0,
 ) -> BatchResult:
     return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
                                pallas=pallas, topo_carry=topo_carry,
-                               sample_k=sample_k, sample_start=sample_start)
+                               sample_k=sample_k, sample_start=sample_start,
+                               topo_mode=topo_mode, vd_override=vd_override,
+                               host_key=host_key)
 
 
 def build_schedule_batch_fn(weights: Dict[str, float] = None):
     """Bind plugin weights statically; returns
     fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
-    sample_k=None, sample_start=None) -> BatchResult."""
+    sample_k=None, sample_start=None, topo_mode=None, vd_override=None,
+    host_key=0) -> BatchResult."""
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
 
     def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
-           sample_k=None, sample_start=None):
+           sample_k=None, sample_start=None, topo_mode=None, vd_override=None,
+           host_key=0):
         # the pallas fused step has no sampling emulation yet
         mode = None if sample_k is not None else pallas_mode(nt, None, topo_enabled)
         return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
                               topo_enabled=topo_enabled, pallas=mode,
                               topo_carry=topo_carry, sample_k=sample_k,
-                              sample_start=sample_start)
+                              sample_start=sample_start, topo_mode=topo_mode,
+                              vd_override=vd_override, host_key=host_key)
 
     return fn

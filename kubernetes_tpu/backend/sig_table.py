@@ -366,6 +366,29 @@ class SigTable:
             out["pod_sig_mask"][p] = self.pod_sig_mask(pod)
             out["pod_term_mask"][p] = self.pod_term_mask(pod)
 
+        # topology-mode summary for the compiled-program selection: which
+        # key slots this batch (plus every REGISTERED existing term — they
+        # participate in every batch) touches, and the domain capacity a
+        # compact segment axis would need for the non-hostname ones
+        host_slot = self.encoder.key_slot(HOSTNAME_KEY)
+        involved = set(int(k) for k in self.term_key_slots[1:self.n_terms])
+        for fld in ("sf_key", "ss_key", "ia_key", "ianti_key", "ip_key"):
+            v_fld = fld.replace("_key", "_valid")
+            involved.update(np.unique(out[fld][out[v_fld]]).tolist())
+        involved.discard(0)
+        others = involved - {host_slot}
+        # domain capacity the GENERAL path needs: every involved key's vocab
+        # — including hostname when involved (a mixed batch, or the
+        # duplicate-hostname fallback, aggregates hostname domains too)
+        vd_needed = 1
+        for ks in involved:
+            vv = self.encoder.value_vocabs.get(ks)
+            if vv is not None:
+                vd_needed = max(vd_needed, len(vv))
+        self.last_topo_summary = {
+            "hostname_only": bool(involved) and not others,
+            "vd_needed": vd_needed,
+        }
         return TopoBatch(**{k: jnp.asarray(v) for k, v in out.items()})
 
     def term_match_rows(self, pod: Pod, hard_pod_affinity_weight: int = 1,

@@ -99,6 +99,7 @@ class _Inflight:
     t0: float  # batch pop time — the attempt-latency clock
     host_pb: dict  # encoder's host copy of req/nonzero_req/port_ids
     pb: object = None  # device PodBatch — preemption screen input on failures
+    mode_info: tuple = ()  # (topo_mode, vd_bucket, host_key): carry-shape id
 
 
 def _enable_compilation_cache() -> None:
@@ -234,6 +235,33 @@ class TPUScheduler(Scheduler):
 
     # ------------------------------------------------------------- batch support
 
+    def _topo_mode_info(self) -> tuple:
+        """(topo_mode, vd_bucket, host_key) for the CURRENT sig-table state +
+        last-encoded batch: selects the hostname fast path or a compact
+        domain axis (ops/topology.py). Also the carry-shape identity the
+        pipelined chain must match on."""
+        if not self.device.topo_enabled:
+            return ("off", None, 0)
+        summary = getattr(self.device.sig_table, "last_topo_summary", None)
+        if summary is None:
+            return ("general", None, 0)
+        if summary["hostname_only"]:
+            from ..framework.plugins.podtopologyspread import HOSTNAME_KEY
+
+            host_slot = self.device.encoder.key_slot(HOSTNAME_KEY)
+            # the fast path treats every node as its own domain — only valid
+            # when hostname label values are actually node-unique (a
+            # --hostname-override collision must fall back to the general
+            # domain-aggregating path)
+            valid = self.device._mirror["valid"]
+            vals = self.device._mirror["label_val"][valid, host_slot]
+            if len(np.unique(vals)) == len(vals):
+                return ("host", None, host_slot)
+        vd = 64
+        while vd < summary["vd_needed"]:
+            vd *= 2
+        return ("general", vd, 0)
+
     def batch_supported(self, pod: Pod) -> bool:
         """Features the batched kernel covers today; the rest take the
         sequential oracle path (config fallback knob, SURVEY.md §7).
@@ -364,6 +392,8 @@ class TPUScheduler(Scheduler):
         else:
             sample_k = None
             sample_start = None
+        mode_info = self._topo_mode_info()
+        topo_mode, vd_bucket, host_key = mode_info
         result = self._run_batch_fn(
             pb, et, self.device.nt, self.device.tc, tb, key,
             adopt=True,
@@ -371,6 +401,9 @@ class TPUScheduler(Scheduler):
             topo_carry=carry,
             sample_k=sample_k,
             sample_start=sample_start,
+            topo_mode=topo_mode,
+            vd_override=vd_bucket,
+            host_key=host_key,
         )
         if result.final_sample_start is not None:
             # keep the rotation index across unsampled batches too (the
@@ -385,7 +418,8 @@ class TPUScheduler(Scheduler):
             result.node_idx.copy_to_host_async()
         except Exception:  # noqa: BLE001 — optional fast path only
             pass
-        self._inflight = _Inflight(batched, result, pod_cycle, t_pop, host_pb, pb)
+        self._inflight = _Inflight(batched, result, pod_cycle, t_pop, host_pb, pb,
+                                   mode_info)
         committed = 0
         if prev is not None:
             # the host commit of batch k overlaps the device compute of k+1
@@ -426,6 +460,10 @@ class TPUScheduler(Scheduler):
         except CapacityError:
             return None  # grow via the drain+sync path (idempotent re-encode)
         if (st.n_sigs, st.n_terms) != vocab0:
+            return None
+        if self._topo_mode_info() != self._inflight.mode_info:
+            # the carry shapes (seg_exist vs term_cnt, vd bucket) differ —
+            # land the in-flight batch and restart the chain on host truth
             return None
         return pb, et, tb
 

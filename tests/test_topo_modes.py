@@ -1,0 +1,127 @@
+"""Topology program modes: the hostname fast path and the compact-domain
+general path must decide identically to the full-domain general program."""
+
+import jax
+import numpy as np
+
+from kubernetes_tpu.api.types import LabelSelector
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler
+from kubernetes_tpu.backend.batch import schedule_batch
+from kubernetes_tpu.backend.sig_table import SigTable
+from kubernetes_tpu.framework.types import NodeInfo
+from kubernetes_tpu.ops.encode import ClusterEncoder
+from kubernetes_tpu.ops.schema import Capacities
+
+
+def _hostname_inputs(n_nodes=16, n_pods=6):
+    """Mutually anti-affine + self-spread pods on the hostname topology."""
+    infos = []
+    for i in range(n_nodes):
+        nw = make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 10})
+        infos.append(NodeInfo(nw.obj()))
+    enc = ClusterEncoder(Capacities(nodes=n_nodes, pods=n_pods, value_words=32))
+    sig = SigTable(enc)
+    nt = enc.encode_snapshot(infos)
+    sel = LabelSelector(match_labels={"app": "x"})
+    pods = []
+    for i in range(n_pods):
+        pw = (make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).label("app", "x")
+              .spread_constraint(2, "kubernetes.io/hostname", selector=sel))
+        if i % 2 == 0:
+            pw.pod_affinity("kubernetes.io/hostname", sel, anti=True)
+        pods.append(pw.obj())
+    pb, et = enc.encode_pods(pods)
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
+    host_slot = enc.key_slot("kubernetes.io/hostname")
+    return pb, et, nt, tc, tb, host_slot
+
+
+def test_host_mode_matches_general_mode():
+    pb, et, nt, tc, tb, host_slot = _hostname_inputs()
+    key = jax.random.PRNGKey(5)
+    gen = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=True,
+                         topo_mode="general")
+    host = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=True,
+                          topo_mode="host", host_key=host_slot)
+    assert np.array_equal(np.asarray(gen.node_idx), np.asarray(host.node_idx))
+    assert np.array_equal(np.asarray(gen.any_feasible), np.asarray(host.any_feasible))
+    for name in ("spread_ok", "ipa_ok", "first_fail"):
+        assert np.array_equal(np.asarray(getattr(gen, name)),
+                              np.asarray(getattr(host, name))), name
+    np.testing.assert_allclose(np.asarray(gen.best_score),
+                               np.asarray(host.best_score), atol=1e-4)
+    assert np.array_equal(np.asarray(gen.final_sel_counts),
+                          np.asarray(host.final_sel_counts))
+
+
+def test_vd_override_matches_full_domain():
+    """Zone-key spread with a compact 64-domain axis must equal the full
+    per-key-vocab axis."""
+    n_nodes, n_pods = 16, 6
+    infos = [NodeInfo(make_node(f"n{i}")
+                      .capacity({"cpu": "8", "memory": "16Gi", "pods": 10})
+                      .label("zone", f"z{i % 4}").obj())
+             for i in range(n_nodes)]
+    enc = ClusterEncoder(Capacities(nodes=n_nodes, pods=n_pods, value_words=32))
+    sig = SigTable(enc)
+    nt = enc.encode_snapshot(infos)
+    sel = LabelSelector(match_labels={"app": "s"})
+    pods = [make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).label("app", "s")
+            .spread_constraint(1, "zone", selector=sel).obj() for i in range(n_pods)]
+    pb, et = enc.encode_pods(pods)
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
+    key = jax.random.PRNGKey(9)
+    full = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=True)
+    compact = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=True,
+                             vd_override=64)
+    assert np.array_equal(np.asarray(full.node_idx), np.asarray(compact.node_idx))
+    for name in ("spread_ok", "ipa_ok", "any_feasible"):
+        assert np.array_equal(np.asarray(getattr(full, name)),
+                              np.asarray(getattr(compact, name))), name
+
+
+def test_duplicate_hostname_falls_back_to_general():
+    """Two nodes sharing a hostname label: the scheduler must refuse the
+    fast path, and required anti-affinity must block BOTH nodes."""
+    store = ClusterStore()
+    sched = TPUScheduler(store, batch_size=4)
+    twin_a = make_node("twin-a").capacity({"cpu": "8", "memory": "16Gi", "pods": 10}).obj()
+    twin_b = make_node("twin-b").capacity({"cpu": "8", "memory": "16Gi", "pods": 10}).obj()
+    # both claim the same hostname (hostname-override collision)
+    twin_a.meta.labels["kubernetes.io/hostname"] = "shared"
+    twin_b.meta.labels["kubernetes.io/hostname"] = "shared"
+    store.create_node(twin_a)
+    store.create_node(twin_b)
+    sel = LabelSelector(match_labels={"app": "x"})
+    for i in range(3):
+        store.create_pod(
+            make_pod(f"p{i}").req({"cpu": "1"}).label("app", "x")
+            .pod_affinity("kubernetes.io/hostname", sel, anti=True).obj())
+    sched.run_until_settled()
+    assert sched._topo_mode_info()[0] == "general"
+    objs, _ = store.list_objects("Pod")
+    bound = [p for p in objs if p.spec.node_name]
+    # one shared hostname domain ⇒ exactly ONE of the anti-affine pods places
+    assert len(bound) == 1, [(p.meta.name, p.spec.node_name) for p in objs]
+
+
+def test_scheduler_selects_host_mode_for_unique_hostnames():
+    store = ClusterStore()
+    sched = TPUScheduler(store, batch_size=4)
+    for i in range(4):
+        store.create_node(
+            make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 10}).obj())
+    sel = LabelSelector(match_labels={"app": "x"})
+    for i in range(6):
+        store.create_pod(
+            make_pod(f"p{i}").req({"cpu": "1"}).label("app", "x")
+            .pod_affinity("kubernetes.io/hostname", sel, anti=True).obj())
+    sched.run_until_settled()
+    assert sched._topo_mode_info()[0] == "host"
+    objs, _ = store.list_objects("Pod")
+    bound = {p.spec.node_name for p in objs if p.spec.node_name}
+    assert len(bound) == 4  # one per node, 2 pods unschedulable
