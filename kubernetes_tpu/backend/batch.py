@@ -331,7 +331,9 @@ def schedule_batch_core(
             f_rot = jnp.take(feasible, perm)
             c = jnp.cumsum(f_rot.astype(jnp.int32))
             elig_rot = f_rot & (c <= sample_k)
-            eligible = jnp.zeros_like(feasible).at[perm].set(elig_rot)
+            # scatter-back of a rotation == gather by the inverse rotation
+            # (a per-step scatter costs ~200µs on TPU; a gather fuses)
+            eligible = jnp.take(elig_rot, (iota_n - samp_start) % N)
             reached = jnp.any(c >= sample_k)
             kth_pos = jnp.argmax(c >= sample_k).astype(jnp.int32)
             processed = jnp.where(reached, kth_pos + 1, np.int32(N))

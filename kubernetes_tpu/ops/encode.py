@@ -119,6 +119,11 @@ class ClusterEncoder:
         self._free_slots: List[int] = []
         self._pod_templates: Dict[Tuple, _PodTemplate] = {}
         self._template_cap = 4096                     # runaway-shape guard
+        # node-STATIC row fields (labels/taints/images/allocatable) keyed by
+        # (name, resourceVersion): only pod-dependent fields re-encode when a
+        # row is dirty from commits alone — the reconcile hot path re-encodes
+        # every committed row each batch
+        self._static_rows: Dict[str, Tuple[int, Dict[str, np.ndarray]]] = {}
 
     # ------------------------------------------------------------- vocab plumbing
 
@@ -197,6 +202,7 @@ class ClusterEncoder:
 
     def release_node_slot(self, name: str) -> Optional[int]:
         slot = self.node_slots.pop(name, None)
+        self._static_rows.pop(name, None)
         if slot is not None:
             self._free_slots.append(slot)
         return slot
@@ -211,20 +217,16 @@ class ClusterEncoder:
 
     # ------------------------------------------------------------- node rows
 
-    def encode_node_row(self, ni: NodeInfo) -> Dict[str, np.ndarray]:
-        """One NodeTensors row (no slot assignment here)."""
+    def _encode_static_fields(self, ni: NodeInfo) -> Dict[str, np.ndarray]:
+        """Row fields derived from the Node OBJECT alone (labels, taints,
+        images, allocatable) — cacheable by (name, resourceVersion) since
+        pod commits never change them."""
         caps = self.caps
         node = ni.node
         row: Dict[str, np.ndarray] = {}
         row["valid"] = np.array(node is not None)
         row["unschedulable"] = np.array(bool(node and node.spec.unschedulable))
         row["allocatable"] = self.resource_vec(ni.allocatable.as_map())
-        req = ni.requested.as_map()
-        req[resource_api.PODS] = len(ni.pods)
-        row["requested"] = self.resource_vec(req)
-        nzreq = ni.non_zero_requested.as_map()
-        nzreq[resource_api.PODS] = len(ni.pods)
-        row["nonzero_requested"] = self.resource_vec(nzreq)
 
         label_val = np.zeros(caps.label_keys, np.int32)
         label_num = np.full(caps.label_keys, INT_NONE, np.int32)
@@ -251,22 +253,51 @@ class ClusterEncoder:
             teff[i] = _EFFECT_CODE[t.effect]
         row["taint_key"], row["taint_val"], row["taint_effect"] = tkey, tval, teff
 
-        pbits = np.zeros(caps.port_words, np.uint32)
-        for (ip, proto, port) in ni.used_ports:
-            for pid in (self.port_id(ip, proto, port), self.port_id("*", proto, port)):
-                pbits[pid >> 5] |= np.uint32(1 << (pid & 31))
-        row["port_bits"] = pbits
-
         ibits = np.zeros(caps.image_words, np.uint32)
         for name in ni.image_states:
             iid = self.image_id(name)
             ibits[iid >> 5] |= np.uint32(1 << (iid & 31))
         row["image_bits"] = ibits
+        return row
+
+    def encode_node_row(self, ni: NodeInfo) -> Dict[str, np.ndarray]:
+        """One NodeTensors row (no slot assignment here)."""
+        node = ni.node
+        static = None
+        if node is not None:
+            key = node.meta.name
+            # keyed by OBJECT IDENTITY with the reference held (so the id
+            # can never be recycled while cached): any replaced Node object
+            # re-encodes, store-bumped or not
+            cached = self._static_rows.get(key)
+            if cached is not None and cached[0] is node:
+                static = cached[1]
+            else:
+                static = self._encode_static_fields(ni)
+                for arr in static.values():
+                    arr.flags.writeable = False  # aliased into rows: freeze
+                self._static_rows[key] = (node, static)
+        else:
+            static = self._encode_static_fields(ni)
+        row: Dict[str, np.ndarray] = dict(static)
+
+        req = ni.requested.as_map()
+        req[resource_api.PODS] = len(ni.pods)
+        row["requested"] = self.resource_vec(req)
+        nzreq = ni.non_zero_requested.as_map()
+        nzreq[resource_api.PODS] = len(ni.pods)
+        row["nonzero_requested"] = self.resource_vec(nzreq)
+
+        pbits = np.zeros(self.caps.port_words, np.uint32)
+        for (ip, proto, port) in ni.used_ports:
+            for pid in (self.port_id(ip, proto, port), self.port_id("*", proto, port)):
+                pbits[pid >> 5] |= np.uint32(1 << (pid & 31))
+        row["port_bits"] = pbits
 
         # priority-class-bucketed request sums (batched preemption screen);
         # per-pod request vectors come from the template cache — this runs on
         # the sync/reconcile hot path for every dirty row
-        creq = np.zeros((caps.prio_classes, caps.resources), np.int32)
+        creq = np.zeros((self.caps.prio_classes, self.caps.resources), np.int32)
         for p in ni.pods:
             cid = self.prio_class_id(p.spec.priority)
             creq[cid] += self._template_for(p).req
