@@ -1,0 +1,282 @@
+"""HTTP REST + watch front for the ClusterStore (the L2 seam).
+
+The reference's apiserver serves typed REST over HTTPS with LIST/WATCH
+streaming (staging/src/k8s.io/apiserver pkg/endpoints; watch cache
+cacher.go:227). This module is that surface for the in-process store:
+reference-shaped paths, JSON bodies through the reflection codec
+(api/codec.py), resourceVersion LIST/WATCH semantics with 410 Gone, and the
+pods/{name}/binding subresource the scheduler writes through
+(registry/core/pod/storage/storage.go:169).
+
+  GET    /api/v1/nodes                       LIST (cluster-scoped)
+  GET    /api/v1/namespaces/{ns}/pods        LIST (namespaced)
+  GET    .../pods?watch=1&resourceVersion=N  WATCH (JSON-lines stream)
+  GET    .../pods/{name}                     GET
+  POST   .../pods                            CREATE (admission chain runs)
+  PUT    .../pods/{name}                     UPDATE
+  DELETE .../pods/{name}                     DELETE
+  POST   .../pods/{name}/binding             BIND
+
+No authn/authz/APF — the reference's handler-chain middleware is out of the
+north-star scope (SURVEY §2.4 lists it as environment here).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api import types as api_types
+from ..api.codec import from_wire, to_wire
+from ..api.types import Binding
+from .admission import AdmissionError
+from .store import ClusterStore, Conflict, Expired, NotFound
+
+# (group-path-prefix, plural) -> kind; plural -> python type via api.types
+RESOURCES = {
+    ("api/v1", "pods"): "Pod",
+    ("api/v1", "nodes"): "Node",
+    ("api/v1", "namespaces"): "Namespace",
+    ("api/v1", "services"): "Service",
+    ("api/v1", "endpoints"): "Endpoints",
+    ("api/v1", "replicationcontrollers"): "ReplicationController",
+    ("api/v1", "persistentvolumes"): "PersistentVolume",
+    ("api/v1", "persistentvolumeclaims"): "PersistentVolumeClaim",
+    ("api/v1", "resourcequotas"): "ResourceQuota",
+    ("api/v1", "limitranges"): "LimitRange",
+    ("apis/apps/v1", "deployments"): "Deployment",
+    ("apis/apps/v1", "replicasets"): "ReplicaSet",
+    ("apis/apps/v1", "statefulsets"): "StatefulSet",
+    ("apis/apps/v1", "daemonsets"): "DaemonSet",
+    ("apis/batch/v1", "jobs"): "Job",
+    ("apis/policy/v1", "poddisruptionbudgets"): "PodDisruptionBudget",
+    ("apis/scheduling.k8s.io/v1", "priorityclasses"): "PriorityClass",
+    ("apis/storage.k8s.io/v1", "storageclasses"): "StorageClass",
+    ("apis/storage.k8s.io/v1", "csinodes"): "CSINode",
+    ("apis/coordination.k8s.io/v1", "leases"): "Lease",
+}
+
+_KIND_TYPES = {kind: getattr(api_types, kind) for (_g, _p), kind in RESOURCES.items()}
+
+
+def _route(path: str) -> Optional[Tuple[str, str, Optional[str], Optional[str], Optional[str]]]:
+    """path -> (group, kind, namespace, name, subresource) or None."""
+    parts = [p for p in path.split("/") if p]
+    for (group, plural), kind in RESOURCES.items():
+        gparts = group.split("/")
+        if parts[:len(gparts)] != gparts:
+            continue
+        rest = parts[len(gparts):]
+        ns = None
+        # "namespaces/{ns}/{plural}/..." is a namespaced-resource path;
+        # "namespaces" / "namespaces/{name}" address Namespace objects
+        if len(rest) >= 3 and rest[0] == "namespaces":
+            ns = rest[1]
+            rest = rest[2:]
+        if not rest or rest[0] != plural:
+            continue
+        name = rest[1] if len(rest) > 1 else None
+        sub = rest[2] if len(rest) > 2 else None
+        return group, kind, ns, name, sub
+    return None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: ClusterStore = None  # bound by serve_api()
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):
+        pass
+
+    # ------------------------------------------------------------- helpers
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, reason: str, message: str) -> None:
+        # metav1.Status shape
+        self._send_json(code, {
+            "kind": "Status", "apiVersion": "v1", "status": "Failure",
+            "reason": reason, "message": message, "code": code,
+        })
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(n) or b"{}")
+
+    def _obj_wire(self, kind: str, obj) -> dict:
+        d = to_wire(obj)
+        d["kind"] = kind
+        return d
+
+    def _match(self, kind: str, ns: Optional[str], obj) -> bool:
+        return ns is None or kind in self.store.CLUSTER_SCOPED_KINDS \
+            or obj.meta.namespace == ns
+
+    # ------------------------------------------------------------- verbs
+
+    def do_GET(self):  # noqa: N802
+        url = urlparse(self.path)
+        r = _route(url.path)
+        if r is None:
+            return self._error(404, "NotFound", f"unknown path {url.path}")
+        _g, kind, ns, name, _sub = r
+        q = parse_qs(url.query)
+        if name is None and q.get("watch", ["0"])[0] in ("1", "true"):
+            rv_raw = q.get("resourceVersion", [None])[0]
+            if rv_raw is None:
+                # unset = "from current state" (reference semantics): never
+                # 410, no backlog replay — long-lived servers trim the
+                # journal, and an rv-less watch must still establish
+                _objs, since = self.store.list_objects(kind)
+            else:
+                try:
+                    since = int(rv_raw)
+                except ValueError:
+                    return self._error(400, "BadRequest",
+                                       f"invalid resourceVersion {rv_raw!r}")
+            return self._watch(kind, ns, since)
+        if name is None:
+            objs, rv = self.store.list_objects(kind)
+            items = [self._obj_wire(kind, o) for o in objs if self._match(kind, ns, o)]
+            return self._send_json(200, {
+                "kind": f"{kind}List", "apiVersion": "v1",
+                "metadata": {"resourceVersion": str(rv)}, "items": items,
+            })
+        key = name if kind in self.store.CLUSTER_SCOPED_KINDS else f"{ns}/{name}"
+        obj = self.store.get_object(kind, key)
+        if obj is None or not self._match(kind, ns, obj):
+            return self._error(404, "NotFound", f"{kind} {key} not found")
+        return self._send_json(200, self._obj_wire(kind, obj))
+
+    def _watch(self, kind: str, ns: Optional[str], since: int) -> None:
+        try:
+            w = self.store.watch(kind, since)
+        except Expired as e:
+            return self._error(410, "Expired", str(e))
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while True:
+                ev = w.next(timeout=0.5)
+                if ev is None:
+                    if self.server.__shutdown_request__:
+                        break
+                    continue
+                obj = ev.object
+                if not self._match(kind, ns, obj):
+                    continue
+                line = json.dumps({
+                    "type": ev.type,
+                    "object": self._obj_wire(kind, obj),
+                    "resourceVersion": str(ev.seq),
+                }).encode() + b"\n"
+                self.wfile.write(hex(len(line))[2:].encode() + b"\r\n" + line + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            w.stop()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+    def do_POST(self):  # noqa: N802
+        body = self._body()  # drain FIRST: keep-alive sockets must not carry leftovers
+        r = _route(urlparse(self.path).path)
+        if r is None:
+            return self._error(404, "NotFound", "unknown path")
+        _g, kind, ns, name, sub = r
+        if kind == "Pod" and name is not None and sub == "binding":
+            # BindingREST.Create (storage.go:169)
+            target = body.get("target", {}).get("name", "")
+            if not target:
+                return self._error(400, "BadRequest", "binding target.name is required")
+            try:
+                self.store.bind(Binding(pod_key=f"{ns}/{name}", node_name=target))
+            except NotFound as e:
+                return self._error(404, "NotFound", str(e))
+            except Conflict as e:
+                return self._error(409, "Conflict", str(e))
+            return self._send_json(201, {"kind": "Status", "status": "Success"})
+        if name is not None:
+            return self._error(405, "MethodNotAllowed", "POST to a named resource")
+        try:
+            obj = from_wire(_KIND_TYPES[kind], body)
+        except Exception as e:  # noqa: BLE001 — malformed body is a 400
+            return self._error(400, "BadRequest", f"decode: {e}")
+        if ns is not None and kind not in self.store.CLUSTER_SCOPED_KINDS:
+            obj.meta.namespace = ns
+        try:
+            self.store.create_object(kind, obj)
+        except Conflict as e:
+            return self._error(409, "AlreadyExists", str(e))
+        except AdmissionError as e:
+            return self._error(403, "Forbidden", str(e))
+        return self._send_json(201, self._obj_wire(kind, obj))
+
+    def do_PUT(self):  # noqa: N802
+        body = self._body()  # drain first (keep-alive)
+        r = _route(urlparse(self.path).path)
+        if r is None or r[3] is None:
+            return self._error(404, "NotFound", "unknown path")
+        _g, kind, ns, name, _sub = r
+        try:
+            obj = from_wire(_KIND_TYPES[kind], body)
+        except Exception as e:  # noqa: BLE001
+            return self._error(400, "BadRequest", f"decode: {e}")
+        if obj.meta.name and obj.meta.name != name:
+            return self._error(400, "BadRequest",
+                               f"body name {obj.meta.name!r} != URL name {name!r}")
+        obj.meta.name = name
+        if ns is not None and kind not in self.store.CLUSTER_SCOPED_KINDS:
+            obj.meta.namespace = ns
+        try:
+            self.store.update_object(kind, obj)
+        except NotFound as e:
+            return self._error(404, "NotFound", str(e))
+        return self._send_json(200, self._obj_wire(kind, obj))
+
+    def do_DELETE(self):  # noqa: N802
+        r = _route(urlparse(self.path).path)
+        if r is None or r[3] is None:
+            return self._error(404, "NotFound", "unknown path")
+        _g, kind, ns, name, _sub = r
+        key = name if kind in self.store.CLUSTER_SCOPED_KINDS else f"{ns}/{name}"
+        if kind == "Pod":
+            try:
+                self.store.delete_pod(key)
+            except NotFound as e:
+                return self._error(404, "NotFound", str(e))
+        else:
+            if self.store.get_object(kind, key) is None:
+                return self._error(404, "NotFound", f"{kind} {key} not found")
+            self.store.delete_object(kind, key)
+        return self._send_json(200, {"kind": "Status", "status": "Success"})
+
+
+def serve_api(store: ClusterStore, port: int = 0):
+    """Serve the REST+watch API on localhost; returns (server, port)."""
+    handler = type("BoundAPIHandler", (_Handler,), {"store": store})
+    server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    server.__shutdown_request__ = False
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, server.server_address[1]
+
+
+def shutdown_api(server) -> None:
+    server.__shutdown_request__ = True
+    server.shutdown()
+    server.server_close()

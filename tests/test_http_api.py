@@ -1,0 +1,164 @@
+"""The HTTP REST + watch apiserver front (L2): reference-shaped paths, JSON
+round-trips through the codec, resourceVersion watch semantics, the binding
+subresource, and a scheduler driving a store that is also served over HTTP."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.http import serve_api, shutdown_api
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler
+
+
+@pytest.fixture()
+def api():
+    store = ClusterStore()
+    server, port = serve_api(store)
+    yield store, f"http://127.0.0.1:{port}"
+    shutdown_api(server)
+
+
+def _req(url, method="GET", body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_crud_and_list(api):
+    store, base = api
+    # create a node cluster-scoped
+    code, out = _req(f"{base}/api/v1/nodes", "POST", {
+        "meta": {"name": "n1"},
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"},
+                   "capacity": {"cpu": "4", "memory": "8Gi", "pods": "10"},
+                   "ready": True},
+    })
+    assert code == 201, out
+    assert store.nodes["n1"].status.ready
+
+    # create a pod namespaced
+    code, out = _req(f"{base}/api/v1/namespaces/default/pods", "POST", {
+        "meta": {"name": "p1"},
+        "spec": {"containers": [{"name": "c", "requests": {"cpu": "1"}}]},
+    })
+    assert code == 201, out
+    assert store.get_pod("default/p1") is not None
+
+    # GET named + LIST
+    code, pod = _req(f"{base}/api/v1/namespaces/default/pods/p1")
+    assert code == 200 and pod["meta"]["name"] == "p1"
+    code, lst = _req(f"{base}/api/v1/namespaces/default/pods")
+    assert code == 200 and lst["kind"] == "PodList" and len(lst["items"]) == 1
+
+    # namespace filtering
+    code, lst = _req(f"{base}/api/v1/namespaces/other/pods")
+    assert code == 200 and lst["items"] == []
+
+    # DELETE
+    code, _ = _req(f"{base}/api/v1/namespaces/default/pods/p1", "DELETE")
+    assert code == 200
+    assert store.get_pod("default/p1") is None
+
+    # 404s and 409s
+    code, st = _req(f"{base}/api/v1/namespaces/default/pods/nope")
+    assert code == 404 and st["reason"] == "NotFound"
+    _req(f"{base}/api/v1/nodes", "POST", {"meta": {"name": "n1"}})
+    code, st = _req(f"{base}/api/v1/nodes", "POST", {"meta": {"name": "n1"}})
+    assert code == 409
+
+
+def test_binding_subresource_and_admission(api):
+    store, base = api
+    _req(f"{base}/api/v1/nodes", "POST", {
+        "meta": {"name": "n1"},
+        "status": {"allocatable": {"cpu": "4", "memory": "8Gi", "pods": "10"},
+                   "capacity": {"cpu": "4", "memory": "8Gi", "pods": "10"},
+                   "ready": True}})
+    _req(f"{base}/api/v1/namespaces/default/pods", "POST", {
+        "meta": {"name": "p1"},
+        "spec": {"containers": [{"name": "c", "requests": {"cpu": "1"}}]}})
+    code, _ = _req(f"{base}/api/v1/namespaces/default/pods/p1/binding", "POST",
+                   {"target": {"name": "n1"}})
+    assert code == 201
+    assert store.get_pod("default/p1").spec.node_name == "n1"
+    # double bind conflicts (BindingREST semantics)
+    code, st = _req(f"{base}/api/v1/namespaces/default/pods/p1/binding", "POST",
+                    {"target": {"name": "n1"}})
+    assert code == 409
+
+    # admission runs over HTTP: creating into an absent namespace is denied
+    code, st = _req(f"{base}/api/v1/namespaces/ghost/pods", "POST", {
+        "meta": {"name": "px"},
+        "spec": {"containers": [{"name": "c", "requests": {"cpu": "1"}}]}})
+    assert code == 403, st
+
+
+def test_watch_streams_events(api):
+    store, base = api
+    code, lst = _req(f"{base}/api/v1/namespaces/default/pods")
+    rv = lst["metadata"]["resourceVersion"]
+    events = []
+    done = threading.Event()
+
+    def watcher():
+        req = urllib.request.Request(
+            f"{base}/api/v1/namespaces/default/pods?watch=1&resourceVersion={rv}")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            for line in resp:
+                ev = json.loads(line)
+                events.append((ev["type"], ev["object"]["meta"]["name"]))
+                if len(events) >= 2:
+                    break
+        done.set()
+
+    t = threading.Thread(target=watcher, daemon=True)
+    t.start()
+    store.create_pod(make_pod("w1").req({"cpu": "1"}).obj())
+    store.delete_pod("default/w1")
+    assert done.wait(10), events
+    assert events[0] == ("ADDED", "w1")
+    assert events[1][0] == "DELETED"
+
+
+def test_watch_410_on_expired_rv(api):
+    store, base = api
+    for i in range(20):
+        store.create_pod(make_pod(f"x{i}").req({"cpu": "1m"}).obj())
+    code, st = _req(f"{base}/api/v1/namespaces/default/pods?watch=1&resourceVersion=-5000")
+    # -5000 predates the journal → reference 410 Gone semantics
+    assert code == 410 or st.get("reason") == "Expired"
+
+
+def test_apps_group_and_scheduler_coexistence(api):
+    store, base = api
+    code, _ = _req(f"{base}/apis/apps/v1/namespaces/default/deployments", "POST", {
+        "meta": {"name": "web"}, "replicas": 2})
+    assert code == 201
+    code, lst = _req(f"{base}/apis/apps/v1/namespaces/default/deployments")
+    assert len(lst["items"]) == 1
+
+    # a scheduler on the same store schedules pods created over HTTP
+    sched = TPUScheduler(store, batch_size=8)
+    _req(f"{base}/api/v1/nodes", "POST", {
+        "meta": {"name": "n1"},
+        "status": {"allocatable": {"cpu": "8", "memory": "16Gi", "pods": "20"},
+                   "capacity": {"cpu": "8", "memory": "16Gi", "pods": "20"},
+                   "ready": True}})
+    for i in range(4):
+        _req(f"{base}/api/v1/namespaces/default/pods", "POST", {
+            "meta": {"name": f"job-{i}"},
+            "spec": {"containers": [{"name": "c", "requests": {"cpu": "1", "memory": "1Gi"}}]}})
+    sched.run_until_settled()
+    code, lst = _req(f"{base}/api/v1/namespaces/default/pods")
+    bound = [p for p in lst["items"] if p["spec"].get("node_name")]
+    assert len(bound) == 4
