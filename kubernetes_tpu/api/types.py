@@ -486,6 +486,31 @@ class Job:
     succeeded: int = 0
 
 
+@dataclass
+class CronJob:
+    """batch/v1 CronJob: spawns Jobs on a 5-field cron schedule
+    (pkg/controller/cronjob)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    schedule: str = "* * * * *"
+    template: Optional["Pod"] = None  # the spawned Job's pod template
+    completions: int = 1
+    parallelism: int = 1
+    suspend: bool = False
+    last_schedule_minute: int = -1  # epoch-minute of the last firing
+
+
+@dataclass
+class VolumeAttachment:
+    """storage/v1 VolumeAttachment: a PV attached to a node, maintained by
+    the attach/detach controller (pkg/controller/volume/attachdetach)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    pv_name: str = ""
+    node_name: str = ""
+    attached: bool = True
+
+
 @dataclass(frozen=True)
 class EndpointAddress:
     pod_key: str = ""
@@ -498,6 +523,17 @@ class Endpoints:
     by the endpoints controller and consumed by kube-proxy."""
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
+    addresses: Tuple[EndpointAddress, ...] = ()
+
+
+@dataclass
+class EndpointSlice:
+    """discovery.k8s.io/v1 EndpointSlice — the scalable sharded form of
+    Endpoints (≤ max-endpoints addresses per slice), maintained by the
+    endpointslice controller."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    service: str = ""  # owning Service key
     addresses: Tuple[EndpointAddress, ...] = ()
 
 

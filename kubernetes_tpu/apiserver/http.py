@@ -52,6 +52,9 @@ RESOURCES = {
     ("apis/apps/v1", "statefulsets"): "StatefulSet",
     ("apis/apps/v1", "daemonsets"): "DaemonSet",
     ("apis/batch/v1", "jobs"): "Job",
+    ("apis/batch/v1", "cronjobs"): "CronJob",
+    ("apis/discovery.k8s.io/v1", "endpointslices"): "EndpointSlice",
+    ("apis/storage.k8s.io/v1", "volumeattachments"): "VolumeAttachment",
     ("apis/policy/v1", "poddisruptionbudgets"): "PodDisruptionBudget",
     ("apis/scheduling.k8s.io/v1", "priorityclasses"): "PriorityClass",
     ("apis/storage.k8s.io/v1", "storageclasses"): "StorageClass",
@@ -249,6 +252,7 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send_json(200, self._obj_wire(kind, obj))
 
     def do_DELETE(self):  # noqa: N802
+        self._body()  # drain DeleteOptions bodies (keep-alive invariant)
         r = _route(urlparse(self.path).path)
         if r is None or r[3] is None:
             return self._error(404, "NotFound", "unknown path")

@@ -123,6 +123,9 @@ class ClusterStore:
         self.leases: Dict[str, "Lease"] = {}
         self.resource_quotas: Dict[str, object] = {}
         self.limit_ranges: Dict[str, object] = {}
+        self.cron_jobs: Dict[str, object] = {}
+        self.endpoint_slices: Dict[str, object] = {}
+        self.volume_attachments: Dict[str, object] = {}
         self.deployments: Dict[str, object] = {}
         self.daemon_sets: Dict[str, object] = {}
         self.jobs: Dict[str, object] = {}
@@ -225,6 +228,9 @@ class ClusterStore:
                 "Endpoints": self.endpoints,
                 "ResourceQuota": self.resource_quotas,
                 "LimitRange": self.limit_ranges,
+                "CronJob": self.cron_jobs,
+                "EndpointSlice": self.endpoint_slices,
+                "VolumeAttachment": self.volume_attachments,
             }[kind]
         except KeyError:
             raise NotFound(f"unknown kind {kind!r}") from None
@@ -367,7 +373,7 @@ class ClusterStore:
 
     CLUSTER_SCOPED_KINDS = {
         "Node", "Namespace", "PersistentVolume", "StorageClass", "CSINode",
-        "PriorityClass",
+        "PriorityClass", "VolumeAttachment",
     }
 
     def _key_of(self, kind: str, obj) -> str:

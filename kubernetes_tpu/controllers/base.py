@@ -53,6 +53,10 @@ class Controller:
     def reconcile(self, key: str) -> None:
         raise NotImplementedError
 
+    def tick(self) -> None:
+        """Time-driven hook, called once per manager sync round (the
+        reference's interval syncAll pattern). Default: nothing."""
+
     # -- driving
 
     @staticmethod

@@ -140,6 +140,30 @@ class NamespaceController(Controller):
         self.store.delete_object("Namespace", key)
 
 
+def service_keys_for_pod(store, pod) -> List[str]:
+    """Services whose selector matches the pod (shared by the Endpoints and
+    EndpointSlice controllers' pod→service fan-out)."""
+    return [
+        svc.meta.key()
+        for svc in store.snapshot_map("Service").values()
+        if svc.meta.namespace == pod.meta.namespace and svc.selector
+        and all(pod.meta.labels.get(k) == v for k, v in svc.selector.items())
+    ]
+
+
+def ready_addresses(store, svc) -> tuple:
+    """The Service's ready (Running, selector-matched) pod addresses in
+    name order — the address set both endpoint controllers publish."""
+    return tuple(
+        EndpointAddress(pod_key=p.meta.key(), node_name=p.spec.node_name)
+        for p in sorted(store.snapshot_map("Pod").values(), key=lambda p: p.meta.name)
+        if p.meta.namespace == svc.meta.namespace
+        and p.status.phase == "Running"
+        and svc.selector
+        and all(p.meta.labels.get(k) == v for k, v in svc.selector.items())
+    )
+
+
 class EndpointsController(Controller):
     """endpoint/endpoints_controller.go: Endpoints object per Service listing
     the Running, selector-matched pods' (pod, node) addresses."""
@@ -150,26 +174,14 @@ class EndpointsController(Controller):
     def keys_for(self, kind: str, obj, event: str) -> List[str]:
         if kind == "Service":
             return [obj.meta.key()]
-        return [
-            svc.meta.key()
-            for svc in self.store.snapshot_map("Service").values()
-            if svc.meta.namespace == obj.meta.namespace and svc.selector
-            and all(obj.meta.labels.get(k) == v for k, v in svc.selector.items())
-        ]
+        return service_keys_for_pod(self.store, obj)
 
     def reconcile(self, key: str) -> None:
         svc: Optional[Service] = self.store.services.get(key)
         if svc is None:
             self.store.delete_object("Endpoints", key)
             return
-        addrs = tuple(
-            EndpointAddress(pod_key=p.meta.key(), node_name=p.spec.node_name)
-            for p in sorted(self.store.snapshot_map("Pod").values(), key=lambda p: p.meta.name)
-            if p.meta.namespace == svc.meta.namespace
-            and p.status.phase == "Running"
-            and svc.selector
-            and all(p.meta.labels.get(k) == v for k, v in svc.selector.items())
-        )
+        addrs = ready_addresses(self.store, svc)
         existing = self.store.get_object("Endpoints", key)
         if existing is None:
             self.store.create_object("Endpoints", Endpoints(

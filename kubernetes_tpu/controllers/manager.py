@@ -25,6 +25,12 @@ from .housekeeping import (
     ResourceQuotaController,
 )
 from .disruption import DisruptionController
+from .extras import (
+    AttachDetachController,
+    CronJobController,
+    EndpointSliceController,
+    TTLController,
+)
 from .nodelifecycle import NodeLifecycleController
 from .workloads import (
     DaemonSetController,
@@ -57,6 +63,15 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         "pvbinder": lambda m: PVBinderController(m.store, m.factory),
         "resourcequota": lambda m: ResourceQuotaController(m.store, m.factory),
         "disruption": lambda m: DisruptionController(m.store, m.factory),
+        "ttl": lambda m: TTLController(m.store, m.factory),
+        "endpointslice": lambda m: EndpointSliceController(m.store, m.factory),
+        # cron needs WALL time (schedules name hours/days); the manager's
+        # monotonic default is duration-only — pass it through only when the
+        # caller overrode it (tests' FakeClock)
+        "cronjob": lambda m: CronJobController(
+            m.store, m.factory,
+            now_fn=m.now_fn if m.now_fn is not time.monotonic else time.time),
+        "attachdetach": lambda m: AttachDetachController(m.store, m.factory),
     }
 
 
@@ -95,6 +110,12 @@ class ControllerManager:
         for c in self.controllers.values():
             if monitor_nodes and isinstance(c, NodeLifecycleController):
                 c.monitor_node_health()
+            try:
+                c.tick()  # time-driven hook; a bad object must not halt the round
+            except Exception:  # noqa: BLE001
+                import logging
+
+                logging.getLogger(__name__).exception("%s: tick failed", c.name)
             n += c.sync_once()
         return n
 

@@ -1,0 +1,128 @@
+"""Round-3 controllers: ttl, endpointslice, cronjob, attachdetach."""
+
+from kubernetes_tpu.api.types import (
+    CronJob,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Service,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers import ControllerManager
+from kubernetes_tpu.controllers.extras import TTL_ANNOTATION, cron_matches
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def make_manager(store, controllers, now_fn=None):
+    return ControllerManager(store, factory=SharedInformerFactory(store),
+                             controllers=controllers, now_fn=now_fn or FakeClock())
+
+
+class TestTTL:
+    def test_annotation_tracks_cluster_size_tier(self):
+        store = ClusterStore()
+        m = make_manager(store, ["ttl"])
+        for i in range(5):
+            store.create_node(make_node(f"n{i}").obj())
+        m.settle()
+        assert store.nodes["n0"].meta.annotations[TTL_ANNOTATION] == "0"
+        for i in range(5, 120):
+            store.create_node(make_node(f"n{i}").obj())
+        m.settle()
+        # >100 nodes → 15s tier, applied to every node incl. early ones
+        assert store.nodes["n0"].meta.annotations[TTL_ANNOTATION] == "15"
+        assert store.nodes["n119"].meta.annotations[TTL_ANNOTATION] == "15"
+
+
+class TestEndpointSlice:
+    def test_slices_shard_and_track_pods(self):
+        store = ClusterStore()
+        m = make_manager(store, ["endpointslice"])
+        store.create_object("Service", Service(
+            meta=ObjectMeta(name="web"), selector={"app": "web"}))
+        for i in range(150):
+            p = make_pod(f"w{i}").req({"cpu": "1m"}).label("app", "web").node("n1").obj()
+            p.status.phase = "Running"
+            store.create_pod(p)
+        m.settle()
+        slices = [s for s in store.endpoint_slices.values()
+                  if s.service == "default/web"]
+        assert len(slices) == 2  # 150 / 100-per-slice
+        total = sum(len(s.addresses) for s in slices)
+        assert total == 150
+        # pod removal re-shards
+        store.delete_pod("default/w0")
+        m.settle()
+        total = sum(len(s.addresses) for s in store.endpoint_slices.values())
+        assert total == 149
+        # service deletion removes slices
+        store.delete_object("Service", "default/web")
+        m.settle()
+        assert not store.endpoint_slices
+
+
+class TestCronJob:
+    def test_cron_matches(self):
+        assert cron_matches("* * * * *", 0)
+        assert cron_matches("*/5 * * * *", 300)       # minute 5
+        assert not cron_matches("*/5 * * * *", 60)    # minute 1
+        assert cron_matches("0 0 * * *", 0)           # midnight
+        assert not cron_matches("0 1 * * *", 0)
+        assert cron_matches("0-30 * * * *", 60 * 20)
+
+    def test_spawns_jobs_on_schedule(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        m = make_manager(store, ["cronjob", "job"], now_fn=clock)
+        # drive the clock to the next */5 minute boundary first
+        now = clock()
+        clock.advance((300 - now % 300) % 300)
+        minute = int(clock() // 60)
+        store.create_object("CronJob", CronJob(
+            meta=ObjectMeta(name="tick"), schedule="*/5 * * * *",
+            template=make_pod("t").req({"cpu": "1m"}).obj()))
+        m.settle()
+        jobs = list(store.jobs.values())
+        assert len(jobs) == 1
+        assert jobs[0].meta.name == f"tick-{minute}"
+        # same minute: no duplicate
+        m.settle()
+        assert len(store.jobs) == 1
+        # next */5 boundary: second firing (job controller spawns pods too)
+        clock.advance(300)
+        m.settle()
+        assert len(store.jobs) == 2
+        # non-matching minute: nothing
+        clock.advance(60)
+        m.settle()
+        assert len(store.jobs) == 2
+        # suspend stops firing
+        cj = store.cron_jobs["default/tick"]
+        cj.suspend = True
+        clock.advance(240)
+        m.settle()
+        assert len(store.jobs) == 2
+
+
+class TestAttachDetach:
+    def test_attach_and_detach_follow_pod_lifecycle(self):
+        store = ClusterStore()
+        m = make_manager(store, ["attachdetach"])
+        store.create_object("PersistentVolume", PersistentVolume(
+            meta=ObjectMeta(name="pv1"), capacity_bytes=1 << 30, bound_pvc="default/claim1"))
+        store.create_object("PersistentVolumeClaim", PersistentVolumeClaim(
+            meta=ObjectMeta(name="claim1"), bound_pv="pv1"))
+        pod = make_pod("user").req({"cpu": "1m"}).obj()
+        pod.spec.volumes = ("claim1",)
+        pod.spec.node_name = "n1"
+        store.create_pod(pod)
+        m.settle()
+        assert "pv1^n1" in store.volume_attachments
+        va = store.volume_attachments["pv1^n1"]
+        assert va.pv_name == "pv1" and va.node_name == "n1" and va.attached
+
+        store.delete_pod("default/user")
+        m.settle()
+        assert not store.volume_attachments
