@@ -324,11 +324,12 @@ def commit_update_host(sel_counts, term_cnt, local_idx, commit, mine,
                        pod_sig_mask, pod_term_mask):
     """Hostname-mode commit: both tables are [*, N] and take a single-column
     add at the winning node — no domain broadcast needed (each shard owns
-    its columns)."""
-    sel_counts = sel_counts.at[:, local_idx].add(
-        jnp.where(commit & mine, pod_sig_mask.astype(jnp.int32), 0))
-    term_cnt = term_cnt.at[:, local_idx].add(
-        jnp.where(commit & mine, pod_term_mask.astype(jnp.int32), 0))
+    its columns). One-hot elementwise instead of scatters (per-step scatter
+    overhead, see _seg_sum)."""
+    col = ((jnp.arange(sel_counts.shape[1], dtype=jnp.int32) == local_idx)
+           & commit & mine).astype(jnp.int32)                           # [N]
+    sel_counts = sel_counts + pod_sig_mask.astype(jnp.int32)[:, None] * col[None, :]
+    term_cnt = term_cnt + pod_term_mask.astype(jnp.int32)[:, None] * col[None, :]
     return sel_counts, term_cnt
 
 
@@ -338,9 +339,9 @@ def commit_update(sel_counts, seg_exist, dom_t, local_idx, commit, mine,
     sel_counts[:, node] += pod_sig_mask on the owning shard; seg_exist gets the
     pod's carried terms added at the winning node's domains on EVERY shard
     (replicated table — the winner broadcasts its domain column via psum)."""
-    sel_counts = sel_counts.at[:, local_idx].add(
-        jnp.where(commit & mine, pod_sig_mask.astype(jnp.int32), 0)
-    )
+    col = ((jnp.arange(sel_counts.shape[1], dtype=jnp.int32) == local_idx)
+           & commit & mine).astype(jnp.int32)                           # [N]
+    sel_counts = sel_counts + pod_sig_mask.astype(jnp.int32)[:, None] * col[None, :]
     dom_col = dom_t[:, local_idx]                                       # [T] local
     if axis_name is not None:
         dom_col = _gsum(jnp.where(mine, dom_col, 0), axis_name)
