@@ -1,0 +1,161 @@
+"""kubectl over HTTP: a ClusterStore-shaped adapter speaking to the REST
+apiserver front (apiserver/http.py), so the CLI drives a remote control
+plane exactly like the reference kubectl drives kube-apiserver.
+
+    kubectl(RemoteStore("http://127.0.0.1:6443"), ["get", "pods"])
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as api_types
+from ..api.codec import from_wire, to_wire
+from ..apiserver.http import RESOURCES
+from ..apiserver.store import ClusterStore, Conflict, NotFound
+
+# kind -> (group path, plural)
+_PATHS = {kind: (group, plural) for (group, plural), kind in RESOURCES.items()}
+# the one scoping truth (silent drift here would mis-route URLs)
+_CLUSTER_SCOPED = ClusterStore.CLUSTER_SCOPED_KINDS
+
+
+class RemoteStore:
+    """The subset of the ClusterStore surface kubectl/cli.py touches,
+    served over the wire."""
+
+    CLUSTER_SCOPED_KINDS = _CLUSTER_SCOPED
+
+    def __init__(self, server: str):
+        self.server = server.rstrip("/")
+
+    # ------------------------------------------------------------- transport
+
+    def _url(self, kind: str, namespace: Optional[str], name: Optional[str]) -> str:
+        group, plural = _PATHS[kind]
+        parts = [self.server, group]
+        if namespace is not None and kind not in _CLUSTER_SCOPED:
+            parts += ["namespaces", namespace]
+        parts.append(plural)
+        if name is not None:
+            parts.append(name)
+        return "/".join(parts)
+
+    def _req(self, method: str, url: str, body: Optional[dict] = None) -> Tuple[int, dict]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method,
+                                     headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def _split(self, kind: str, key: str) -> Tuple[Optional[str], str]:
+        if kind in _CLUSTER_SCOPED or "/" not in key:
+            return None, key
+        ns, name = key.split("/", 1)
+        return ns, name
+
+    def _raise(self, code: int, out: dict) -> None:
+        msg = out.get("message", "")
+        if code == 404:
+            raise NotFound(msg)
+        if code == 409:
+            raise Conflict(msg)
+        raise RuntimeError(f"apiserver {code}: {msg}")
+
+    # ------------------------------------------------------------- verbs
+
+    def list_objects(self, kind: str) -> Tuple[List[object], int]:
+        code, out = self._req("GET", self._url(kind, None, None))
+        if code != 200:
+            self._raise(code, out)
+        cls = getattr(api_types, kind)
+        objs = [from_wire(cls, item) for item in out["items"]]
+        return objs, int(out["metadata"]["resourceVersion"])
+
+    def get_object(self, kind: str, key: str):
+        ns, name = self._split(kind, key)
+        code, out = self._req("GET", self._url(kind, ns or "default", name))
+        if code == 404:
+            return None
+        if code != 200:
+            self._raise(code, out)
+        return from_wire(getattr(api_types, kind), out)
+
+    def get_pod(self, key: str):
+        return self.get_object("Pod", key)
+
+    def get_node(self, name: str):
+        return self.get_object("Node", name)
+
+    def snapshot_map(self, kind: str) -> Dict[str, object]:
+        objs, _rv = self.list_objects(kind)
+        return {self._key_of(kind, o): o for o in objs}
+
+    class _NodeView:
+        """Dict-like node accessor: point lookups are single GETs (cordon /
+        delete checks must not LIST-and-decode a 50k-node cluster)."""
+
+        def __init__(self, rs: "RemoteStore"):
+            self._rs = rs
+
+        def get(self, name: str, default=None):
+            obj = self._rs.get_object("Node", name)
+            return obj if obj is not None else default
+
+        def __contains__(self, name: str) -> bool:
+            return self.get(name) is not None
+
+        def __getitem__(self, name: str):
+            obj = self.get(name)
+            if obj is None:
+                raise KeyError(name)
+            return obj
+
+        def values(self):
+            return self._rs.list_objects("Node")[0]
+
+        def __iter__(self):
+            return iter(n.meta.name for n in self.values())
+
+        def __len__(self):
+            return len(self.values())
+
+    @property
+    def nodes(self) -> "_NodeView":
+        return RemoteStore._NodeView(self)
+
+    def _key_of(self, kind: str, obj) -> str:
+        return obj.meta.name if kind in _CLUSTER_SCOPED else obj.meta.key()
+
+    def create_object(self, kind: str, obj) -> None:
+        ns = None if kind in _CLUSTER_SCOPED else obj.meta.namespace
+        code, out = self._req("POST", self._url(kind, ns, None), to_wire(obj))
+        if code not in (200, 201):
+            self._raise(code, out)
+
+    create_pod = lambda self, obj: self.create_object("Pod", obj)  # noqa: E731
+    create_node = lambda self, obj: self.create_object("Node", obj)  # noqa: E731
+
+    def update_object(self, kind: str, obj) -> None:
+        ns, name = self._split(kind, self._key_of(kind, obj))
+        code, out = self._req("PUT", self._url(kind, ns or "default", name), to_wire(obj))
+        if code != 200:
+            self._raise(code, out)
+
+    update_pod = lambda self, obj: self.update_object("Pod", obj)  # noqa: E731
+    update_node = lambda self, obj: self.update_object("Node", obj)  # noqa: E731
+
+    def delete_object(self, kind: str, key: str) -> None:
+        ns, name = self._split(kind, key)
+        code, out = self._req("DELETE", self._url(kind, ns or "default", name))
+        if code not in (200, 404):
+            self._raise(code, out)
+
+    delete_pod = lambda self, key: self.delete_object("Pod", key)  # noqa: E731
+    delete_node = lambda self, name: self.delete_object("Node", name)  # noqa: E731

@@ -1,0 +1,70 @@
+"""kubectl driving the REST apiserver over HTTP via RemoteStore — the
+reference's CLI→apiserver seam."""
+
+import pytest
+
+from kubernetes_tpu.apiserver.http import serve_api, shutdown_api
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.kubectl.cli import kubectl
+from kubernetes_tpu.kubectl.remote import RemoteStore
+
+
+@pytest.fixture()
+def remote():
+    store = ClusterStore()
+    server, port = serve_api(store)
+    yield store, RemoteStore(f"http://127.0.0.1:{port}")
+    shutdown_api(server)
+
+
+NODE_YAML = """
+kind: Node
+metadata:
+  name: n1
+status:
+  capacity: {cpu: "4", memory: 8Gi, pods: "10"}
+"""
+
+POD_YAML = """
+kind: Pod
+metadata:
+  name: p1
+spec:
+  containers:
+    - name: c
+      resources:
+        requests: {cpu: 500m, memory: 1Gi}
+"""
+
+
+def test_kubectl_crud_over_http(remote, tmp_path):
+    store, rs = remote
+    nf = tmp_path / "node.yaml"
+    nf.write_text(NODE_YAML)
+    pf = tmp_path / "pod.yaml"
+    pf.write_text(POD_YAML)
+
+    out = kubectl(rs, ["create", "-f", str(nf)])
+    assert "created" in out
+    assert "n1" in store.nodes  # landed in the real store via HTTP
+
+    out = kubectl(rs, ["create", "-f", str(pf)])
+    assert "created" in out
+    assert store.get_pod("default/p1") is not None
+
+    out = kubectl(rs, ["get", "pods"])
+    assert "p1" in out
+    out = kubectl(rs, ["get", "nodes"])
+    assert "n1" in out
+    out = kubectl(rs, ["describe", "pod", "p1"])
+    assert "p1" in out
+    out = kubectl(rs, ["describe", "node", "n1"])
+    assert "n1" in out
+
+    out = kubectl(rs, ["cordon", "n1"])
+    assert store.nodes["n1"].spec.unschedulable
+    out = kubectl(rs, ["uncordon", "n1"])
+    assert not store.nodes["n1"].spec.unschedulable
+
+    out = kubectl(rs, ["delete", "pod", "p1"])
+    assert store.get_pod("default/p1") is None
