@@ -50,6 +50,9 @@ def kubectl(store: ClusterStore, argv) -> str:
         "scale": _scale,
         "cordon": _cordon,
         "uncordon": _uncordon,
+        "taint": _taint,
+        "label": _label,
+        "drain": _drain,
     }
     h = handlers.get(verb)
     if h is None:
@@ -59,7 +62,7 @@ def kubectl(store: ClusterStore, argv) -> str:
 
 def _usage() -> str:
     return ("usage: kubectl get|describe|create|apply|delete|scale|"
-            "cordon|uncordon ...")
+            "cordon|uncordon|taint|label|drain ...")
 
 
 def _namespace(args: List[str]) -> str:
@@ -246,3 +249,83 @@ def _cordon(store, args, verb="cordon"):
 
 def _uncordon(store, args, verb="uncordon"):
     return _set_unschedulable(store, args, False, verb)
+
+
+def _taint(store, args, verb="taint"):
+    """kubectl taint nodes NODE key=value:Effect | key:Effect- (remove)."""
+    import dataclasses
+
+    from ..api.types import Taint
+
+    pos = _positional(args)
+    if len(pos) < 3 or pos[0] not in ("node", "nodes"):
+        return "error: taint nodes NODE KEY=VAL:EFFECT[-]"
+    node = store.nodes.get(pos[1])
+    if node is None:
+        return f'Error from server (NotFound): nodes "{pos[1]}" not found'
+    spec = pos[2]
+    remove = spec.endswith("-")
+    spec = spec.rstrip("-")
+    kv, _, effect = spec.partition(":")
+    key, _, value = kv.partition("=")
+    taints = [t for t in node.spec.taints if t.key != key]
+    if not remove:
+        if not effect:
+            return "error: taint effect required (NoSchedule|PreferNoSchedule|NoExecute)"
+        taints.append(Taint(key=key, value=value, effect=effect))
+    new = dataclasses.replace(node)
+    new.meta = dataclasses.replace(node.meta)
+    new.spec = dataclasses.replace(node.spec, taints=tuple(taints))
+    store.update_node(new)
+    return f"node/{pos[1]} {'untainted' if remove else 'tainted'}"
+
+
+def _label(store, args, verb="label"):
+    """kubectl label TYPE NAME key=value | key- (remove)."""
+    import dataclasses
+
+    pos = _positional(args)
+    if len(pos) < 3:
+        return "error: label TYPE NAME KEY=VAL[-]"
+    kind = GETTABLE.get(pos[0]) or GETTABLE.get(pos[0] + "s")
+    if kind is None:
+        return f"error: unknown resource type {pos[0]!r}"
+    ns = _namespace(args)
+    key_ = pos[1] if kind in ClusterStore.CLUSTER_SCOPED_KINDS else f"{ns}/{pos[1]}"
+    obj = store.get_pod(key_) if kind == "Pod" else store.get_object(kind, key_)
+    if obj is None:
+        return f'Error from server (NotFound): {pos[0]} "{pos[1]}" not found'
+    labels = dict(obj.meta.labels)
+    for spec in pos[2:]:
+        if spec.endswith("-"):
+            labels.pop(spec[:-1], None)
+        else:
+            k, _, v = spec.partition("=")
+            labels[k] = v
+    new = dataclasses.replace(obj)
+    new.meta = dataclasses.replace(obj.meta, labels=labels)
+    if kind == "Pod":
+        store.update_pod(new)
+    elif kind == "Node":
+        store.update_node(new)
+    else:
+        store.update_object(kind, new)
+    return f"{pos[0]}/{pos[1]} labeled"
+
+
+def _drain(store, args, verb="drain"):
+    """kubectl drain NODE: cordon + evict every pod bound to it (the
+    capability-level drain: no grace periods; PDBs are the disruption
+    controller's concern)."""
+    pos = _positional(args)
+    if not pos:
+        return "error: drain needs NODE"
+    out = _cordon(store, [pos[0]])
+    if out.startswith("Error"):
+        return out
+    evicted = []
+    for pod in list(store.snapshot_map("Pod").values()):
+        if pod.spec.node_name == pos[0]:
+            store.delete_pod(pod.meta.key())
+            evicted.append(pod.meta.name)
+    return f"node/{pos[0]} drained ({len(evicted)} pods evicted)"

@@ -66,5 +66,19 @@ def test_kubectl_crud_over_http(remote, tmp_path):
     out = kubectl(rs, ["uncordon", "n1"])
     assert not store.nodes["n1"].spec.unschedulable
 
-    out = kubectl(rs, ["delete", "pod", "p1"])
+    out = kubectl(rs, ["taint", "nodes", "n1", "dedicated=gpu:NoSchedule"])
+    assert "tainted" in out
+    assert store.nodes["n1"].spec.taints[0].key == "dedicated"
+    out = kubectl(rs, ["taint", "nodes", "n1", "dedicated:NoSchedule-"])
+    assert store.nodes["n1"].spec.taints == ()
+
+    out = kubectl(rs, ["label", "node", "n1", "tier=gold"])
+    assert store.nodes["n1"].meta.labels["tier"] == "gold"
+
+    # drain: cordon + evict the bound pod, all over the wire
+    from kubernetes_tpu.api.types import Binding
+    store.bind(Binding(pod_key="default/p1", node_name="n1"))
+    out = kubectl(rs, ["drain", "n1"])
+    assert "drained (1 pods evicted)" in out
     assert store.get_pod("default/p1") is None
+    assert store.nodes["n1"].spec.unschedulable
