@@ -152,6 +152,12 @@ class TPUScheduler(Scheduler):
         # adaptive-sampling rotation start: a device scalar chained from the
         # previous batch's evolved carry (schedule_one.go:475 rotation)
         self._start_carry = None
+        # §5.1 profiling: KTPU_PROFILE_DIR=<dir> captures a JAX profiler
+        # trace of the first KTPU_PROFILE_BATCHES (default 4) batch cycles —
+        # the per-cycle XLA trace-dump analog of scheduler_perf -cpuprofile
+        self._profile_dir = os.environ.get("KTPU_PROFILE_DIR", "")
+        self._profile_batches = int(os.environ.get("KTPU_PROFILE_BATCHES", "4"))
+        self._profiling = False
         # async pipeline (SURVEY §2.7 P3 analog): at most one dispatched
         # batch in flight; its host commit overlaps the next batch's device
         # compute. KTPU_PIPELINE=0 forces the synchronous path.
@@ -337,10 +343,30 @@ class TPUScheduler(Scheduler):
         self._flush_batch(buffer, pod_cycle, t_pop)
         return len(qps)
 
+    def _maybe_profile(self) -> None:
+        """Start/stop a JAX profiler capture window over the first N batch
+        cycles when KTPU_PROFILE_DIR is set (view with xprof/tensorboard)."""
+        if not self._profile_dir:
+            return
+        if not self._profiling and self.batch_counter == 0:
+            try:
+                jax.profiler.start_trace(self._profile_dir)
+                self._profiling = True
+            except Exception:  # noqa: BLE001 — profiling must never break scheduling
+                self._profile_dir = ""
+        elif self._profiling and self.batch_counter >= self._profile_batches:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+            self._profiling = False
+            self._profile_dir = ""
+
     def _flush_batch(self, batched: List[QueuedPodInfo], pod_cycle: int,
                      t_pop: Optional[float] = None) -> None:
         if not batched:
             return
+        self._maybe_profile()
         t0 = self.now_fn()
         t_pop = t_pop if t_pop is not None else t0
         enc = self._try_pipelined_encode(batched)
@@ -715,4 +741,11 @@ class TPUScheduler(Scheduler):
             max_cycles=max_cycles, flush=flush, idle_wait=idle_wait,
             max_no_progress=max_no_progress)
         self._drain_inflight()
+        if self._profiling:  # fewer batches than the window: flush the trace
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+            self._profiling = False
+            self._profile_dir = ""
         return cycles
