@@ -468,6 +468,11 @@ class Deployment:
     selector: Optional[LabelSelector] = None
     replicas: int = 1
     template: Optional["Pod"] = None
+    # rollout strategy (apps/v1 DeploymentStrategy): RollingUpdate honors the
+    # surge/unavailable windows; Recreate tears the old RS down first
+    strategy: str = "RollingUpdate"
+    max_surge: int = 1
+    max_unavailable: int = 1
 
 
 @dataclass

@@ -98,34 +98,184 @@ class ReplicaSetController(Controller):
                 self.store.delete_pod(p.meta.key())
 
 
+def _template_hash(template) -> str:
+    """Stable short pod-template hash (the reference's pod-template-hash
+    label that names per-revision ReplicaSets)."""
+    import hashlib
+    import json
+
+    from ..api.codec import to_wire
+
+    blob = json.dumps(to_wire(template) if template is not None else {},
+                      sort_keys=True)
+    return hashlib.md5(blob.encode()).hexdigest()[:8]
+
+
+def _pod_available(p: Pod) -> bool:
+    """Running counts; a bound-but-Pending pod counts in scheduler-only
+    environments (no kubelet to flip the phase). Failed/Succeeded never do —
+    node_name survives termination."""
+    return (p.status.phase == "Running"
+            or (p.status.phase == "Pending" and bool(p.spec.node_name)))
+
+
 class DeploymentController(Controller):
-    """Deployment → one ReplicaSet named <deploy>-<hash> (rollouts collapse
-    to re-pointing the RS template; deployment_controller.go syncDeployment)."""
+    """Deployment → per-revision ReplicaSets named <deploy>-<templatehash>;
+    RollingUpdate walks the surge/unavailable windows
+    (deployment_controller.go syncDeployment + rolling.go reconcileNew/
+    OldReplicaSets), Recreate tears old revisions to zero first."""
 
     name = "deployment"
-    watch_kinds = ("Deployment",)
+    watch_kinds = ("Deployment", "ReplicaSet", "Pod")
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "Deployment":
+            return [obj.meta.key()]
+        if kind == "ReplicaSet":
+            dref = obj.meta.controller_of()
+            if dref is not None and dref.kind == "Deployment":
+                return [f"{obj.meta.namespace}/{dref.name}"]
+            return []
+        # pod → owning RS → owning Deployment (ready counts gate the rollout)
+        ref = obj.meta.controller_of()
+        if ref is None or ref.kind != "ReplicaSet":
+            return []
+        rs = self.store.get_replica_set(f"{obj.meta.namespace}/{ref.name}")
+        if rs is None:
+            return []
+        dref = rs.meta.controller_of()
+        if dref is None or dref.kind != "Deployment":
+            return []
+        return [f"{obj.meta.namespace}/{dref.name}"]
+
+    def _owned_replica_sets(self, dep: Deployment) -> List[ReplicaSet]:
+        out = []
+        for rs in self.store.snapshot_map("ReplicaSet").values():
+            if rs.meta.namespace != dep.meta.namespace:
+                continue
+            ref = rs.meta.controller_of()
+            if ref is not None and ref.kind == "Deployment" and ref.name == dep.meta.name:
+                out.append(rs)
+        return out
+
+    def _set_replicas(self, rs: ReplicaSet, n: int) -> None:
+        if rs.replicas == n:
+            return
+        new_rs = dataclasses.replace(rs, replicas=n)
+        new_rs.meta = dataclasses.replace(rs.meta)
+        self.store.update_object("ReplicaSet", new_rs)
+        rs.replicas = n  # keep the local view current within this reconcile
+
+    def _pods_by_rs(self, dep: Deployment):
+        """ONE snapshot scan → {rs name: (alive, available)} counts (reconcile
+        would otherwise rescan the pod map per RS per metric)."""
+        counts: dict = {}
+        for p in self.store.snapshot_map("Pod").values():
+            if p.meta.namespace != dep.meta.namespace:
+                continue
+            ref = p.meta.controller_of()
+            if ref is None or ref.kind != "ReplicaSet":
+                continue
+            alive = p.status.phase in ("Pending", "Running")
+            avail = _pod_available(p)
+            a, v = counts.get(ref.name, (0, 0))
+            counts[ref.name] = (a + (1 if alive else 0), v + (1 if avail else 0))
+        return counts
 
     def reconcile(self, key: str) -> None:
         dep: Optional[Deployment] = self.store.get_object("Deployment", key)
         if dep is None:
             return
-        rs_name = f"{dep.meta.name}-rs"
-        rs_key = f"{dep.meta.namespace}/{rs_name}"
-        rs = self.store.get_replica_set(rs_key)
-        if rs is None:
-            self.store.create_replica_set(ReplicaSet(
+        # apps/v1 validation rejects surge=0 + unavailable=0 at admission (a
+        # rollout could never progress); clamp the same way here
+        max_surge = dep.max_surge
+        max_unavailable = dep.max_unavailable
+        if max_surge == 0 and max_unavailable == 0:
+            max_unavailable = 1
+        want_hash = _template_hash(dep.template)
+        new_name = f"{dep.meta.name}-{want_hash}"
+        owned = self._owned_replica_sets(dep)
+        new_rs = next((rs for rs in owned if rs.meta.name == new_name), None)
+        olds = [rs for rs in owned if rs.meta.name != new_name]
+        counts = self._pods_by_rs(dep)
+
+        def alive(rs):
+            return counts.get(rs.meta.name, (0, 0))[0]
+
+        def avail(rs):
+            return counts.get(rs.meta.name, (0, 0))[1]
+
+        if new_rs is None:
+            # Recreate waits for the old revision to fully terminate before
+            # the new one exists (deployment/recreate.go)
+            if olds and dep.strategy == "Recreate":
+                for rs in olds:
+                    self._set_replicas(rs, 0)
+                if any(alive(rs) > 0 for rs in olds):
+                    return
+            initial = dep.replicas
+            if olds:  # RollingUpdate: new revision starts inside the surge
+                total = sum(alive(rs) for rs in olds)
+                initial = max(0, min(dep.replicas,
+                                     dep.replicas + max_surge - total))
+            new_rs = ReplicaSet(
                 meta=ObjectMeta(
-                    name=rs_name, namespace=dep.meta.namespace,
-                    owner_references=(OwnerReference(kind="Deployment", name=dep.meta.name, controller=True),),
+                    name=new_name, namespace=dep.meta.namespace,
+                    owner_references=(OwnerReference(
+                        kind="Deployment", name=dep.meta.name, controller=True),),
                 ),
                 selector=dep.selector,
-                replicas=dep.replicas,
+                replicas=initial,
                 template=dep.template,
-            ))
-        elif rs.replicas != dep.replicas or rs.template is not dep.template:
-            new_rs = dataclasses.replace(rs, replicas=dep.replicas, template=dep.template)
-            new_rs.meta = dataclasses.replace(rs.meta)
-            self.store.update_object("ReplicaSet", new_rs)
+            )
+            self.store.create_replica_set(new_rs)
+            # fall through: with max_surge=0 the new RS starts at 0 replicas
+            # and only the old-RS scale-down below can open headroom — an
+            # early return here would stall the rollout forever
+
+        if not olds:
+            self._set_replicas(new_rs, dep.replicas)
+            return
+
+        if dep.strategy == "Recreate":
+            for rs in olds:
+                self._set_replicas(rs, 0)
+            if all(alive(rs) == 0 for rs in olds):
+                self._set_replicas(new_rs, dep.replicas)
+                for rs in olds:
+                    self.store.delete_object("ReplicaSet", rs.meta.key())
+            return
+
+        # RollingUpdate (rolling.go): scale new up within the surge window,
+        # old down within the availability window. Counts must cover work the
+        # RS controller hasn't materialized yet: a scaled-up RS whose pods
+        # aren't created counts its replicas (else the surge is allocated
+        # twice), and a scaled-down RS whose pods aren't deleted yet has
+        # those removals charged against the availability budget (else the
+        # window is spent twice).
+        def intended(rs):
+            return max(alive(rs), rs.replicas)
+
+        total_pods = intended(new_rs) + sum(intended(rs) for rs in olds)
+        available = avail(new_rs) + sum(avail(rs) for rs in olds)
+        inflight_removals = sum(max(0, alive(rs) - rs.replicas) for rs in olds)
+        max_total = dep.replicas + max_surge
+        min_available = dep.replicas - max_unavailable
+
+        headroom = max_total - total_pods
+        if headroom > 0 and new_rs.replicas < dep.replicas:
+            self._set_replicas(new_rs, min(dep.replicas, new_rs.replicas + headroom))
+        can_remove = available - min_available - inflight_removals
+        for rs in sorted(olds, key=lambda r: r.meta.name):
+            if can_remove <= 0:
+                break
+            down = min(rs.replicas, can_remove)
+            if down > 0:
+                self._set_replicas(rs, rs.replicas - down)
+                can_remove -= down
+        for rs in olds:
+            if rs.replicas == 0 and alive(rs) == 0:
+                self.store.delete_object("ReplicaSet", rs.meta.key())
 
 
 class StatefulSetController(Controller):

@@ -117,9 +117,14 @@ def from_manifest(doc: dict) -> Tuple[str, object]:
     if kind == "Service":
         return kind, Service(meta=meta, selector=dict(spec.get("selector", {}) or {}))
     if kind == "Deployment":
+        strategy = spec.get("strategy", {}) or {}
+        rolling = strategy.get("rollingUpdate", {}) or {}
         return kind, Deployment(meta=meta, selector=_selector(spec),
                                 replicas=int(spec.get("replicas", 1)),
-                                template=_template(spec, meta))
+                                template=_template(spec, meta),
+                                strategy=strategy.get("type", "RollingUpdate"),
+                                max_surge=int(rolling.get("maxSurge", 1)),
+                                max_unavailable=int(rolling.get("maxUnavailable", 1)))
     if kind == "ReplicaSet":
         return kind, ReplicaSet(meta=meta, selector=_selector(spec),
                                 replicas=int(spec.get("replicas", 1)),

@@ -101,7 +101,10 @@ class TestDeploymentAndFriends:
         store.create_object("Deployment", Deployment(
             meta=ObjectMeta(name="api"), replicas=2, template=pod_template()))
         m.settle()
-        assert store.get_replica_set("default/api-rs") is not None
+        # per-revision RS named <deploy>-<templatehash>
+        rss = [rs for rs in store.replica_sets.values()
+               if rs.meta.name.startswith("api-")]
+        assert len(rss) == 1
         assert len(store.pods) == 2
 
     def test_deployment_scale_propagates(self):
@@ -115,6 +118,101 @@ class TestDeploymentAndFriends:
         store.update_object("Deployment", new)
         m.settle()
         assert len(store.pods) == 4
+
+    def _mark_running(self, store):
+        for p in list(store.pods.values()):
+            if p.status.phase == "Pending":
+                new = dataclasses.replace(p)
+                new.meta = dataclasses.replace(p.meta)
+                new.status = dataclasses.replace(p.status, phase="Running")
+                new.spec = p.spec
+                store.update_pod(new)
+
+    def test_rolling_update_respects_windows(self):
+        store = ClusterStore()
+        m = make_manager(store, ["deployment", "replicaset"])
+        dep = Deployment(meta=ObjectMeta(name="api"), replicas=4,
+                         template=pod_template({"v": "1"}),
+                         max_surge=1, max_unavailable=1)
+        store.create_object("Deployment", dep)
+        m.settle()
+        self._mark_running(store)
+        m.settle()
+        assert len(store.pods) == 4
+
+        new = dataclasses.replace(dep, template=pod_template({"v": "2"}))
+        new.meta = dataclasses.replace(dep.meta)
+        store.update_object("Deployment", new)
+        # drive the rollout stepwise, checking the windows at every step
+        for _ in range(30):
+            m.settle()
+            pods = list(store.pods.values())
+            alive = [p for p in pods if p.status.phase in ("Pending", "Running")]
+            running = [p for p in pods if p.status.phase == "Running"]
+            assert len(alive) <= 4 + 1, len(alive)       # maxSurge window
+            assert len(running) >= 4 - 1, len(running)   # maxUnavailable window
+            self._mark_running(store)
+            rss = [rs for rs in store.replica_sets.values()
+                   if rs.meta.name.startswith("api-")]
+            if (len(rss) == 1
+                    and all(p.meta.labels.get("v") == "2" for p in store.pods.values())
+                    and len(store.pods) == 4):
+                break
+        assert len(store.pods) == 4
+        assert all(p.meta.labels.get("v") == "2" for p in store.pods.values())
+        assert len([rs for rs in store.replica_sets.values()
+                    if rs.meta.name.startswith("api-")]) == 1  # old revision GC'd
+
+    def test_rolling_update_zero_surge_progresses(self):
+        """maxSurge=0: the new revision can only grow as the old shrinks —
+        the rollout must still complete (regression: early-return after
+        creating the 0-replica new RS stalled forever)."""
+        store = ClusterStore()
+        m = make_manager(store, ["deployment", "replicaset"])
+        dep = Deployment(meta=ObjectMeta(name="api"), replicas=3,
+                         template=pod_template({"v": "1"}),
+                         max_surge=0, max_unavailable=1)
+        store.create_object("Deployment", dep)
+        m.settle()
+        self._mark_running(store)
+        m.settle()
+        new = dataclasses.replace(dep, template=pod_template({"v": "2"}))
+        new.meta = dataclasses.replace(dep.meta)
+        store.update_object("Deployment", new)
+        for _ in range(30):
+            m.settle()
+            alive = [p for p in store.pods.values()
+                     if p.status.phase in ("Pending", "Running")]
+            assert len(alive) <= 3  # surge window: never above replicas
+            self._mark_running(store)
+            if (len(store.pods) == 3
+                    and all(p.meta.labels.get("v") == "2" for p in store.pods.values())):
+                break
+        assert all(p.meta.labels.get("v") == "2" for p in store.pods.values())
+
+    def test_recreate_strategy_tears_down_first(self):
+        store = ClusterStore()
+        m = make_manager(store, ["deployment", "replicaset"])
+        dep = Deployment(meta=ObjectMeta(name="api"), replicas=2,
+                         template=pod_template({"v": "1"}), strategy="Recreate")
+        store.create_object("Deployment", dep)
+        m.settle()
+        self._mark_running(store)
+        new = dataclasses.replace(dep, template=pod_template({"v": "2"}))
+        new.meta = dataclasses.replace(dep.meta)
+        store.update_object("Deployment", new)
+        for _ in range(20):
+            m.settle()
+            pods = list(store.pods.values())
+            # never both revisions alive at once under Recreate
+            versions = {p.meta.labels.get("v") for p in pods
+                        if p.status.phase in ("Pending", "Running")}
+            assert versions in (set(), {"1"}, {"2"}), versions
+            self._mark_running(store)
+            if (len(store.pods) == 2
+                    and all(p.meta.labels.get("v") == "2" for p in store.pods.values())):
+                break
+        assert all(p.meta.labels.get("v") == "2" for p in store.pods.values())
 
     def test_statefulset_ordered_creation(self):
         store = ClusterStore()
