@@ -489,6 +489,15 @@ class Job:
     parallelism: int = 1
     template: Optional["Pod"] = None
     succeeded: int = 0
+    # failure policy (job_controller.go syncJob): stop retrying after
+    # backoffLimit pod failures; kill the job past activeDeadlineSeconds
+    backoff_limit: int = 6
+    active_deadline_seconds: Optional[int] = None
+    failed: int = 0
+    # "" | "Complete" | "Failed" (+ failure reason in failed_reason)
+    condition: str = ""
+    failed_reason: str = ""
+    start_time: float = 0.0
 
 
 @dataclass

@@ -52,7 +52,7 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         "replicationcontroller": lambda m: ReplicationControllerController(m.store, m.factory),
         "statefulset": lambda m: StatefulSetController(m.store, m.factory),
         "daemonset": lambda m: DaemonSetController(m.store, m.factory),
-        "job": lambda m: JobController(m.store, m.factory),
+        "job": lambda m: JobController(m.store, m.factory, now_fn=m.now_fn),
         "nodelifecycle": lambda m: NodeLifecycleController(
             m.store, m.factory, now_fn=m.now_fn
         ),

@@ -381,11 +381,18 @@ class DaemonSetController(Controller):
 
 
 class JobController(Controller):
-    """Run pods until ``completions`` succeed, at most ``parallelism`` active
-    (job/job_controller.go syncJob, capability level)."""
+    """Run pods until ``completions`` succeed, at most ``parallelism`` active;
+    give up after ``backoffLimit`` failures or ``activeDeadlineSeconds``
+    (job/job_controller.go syncJob)."""
 
     name = "job"
     watch_kinds = ("Job", "Pod")
+
+    def __init__(self, store, factory, now_fn=None):
+        super().__init__(store, factory)
+        import time as _time
+
+        self.now_fn = now_fn or _time.monotonic
 
     def keys_for(self, kind: str, obj, event: str) -> List[str]:
         if kind == "Job":
@@ -395,18 +402,51 @@ class JobController(Controller):
             return [f"{obj.meta.namespace}/{ref.name}"]
         return []
 
+    def tick(self) -> None:
+        """Deadline enforcement needs time, not events."""
+        now = self.now_fn()
+        for key, job in self.store.snapshot_map("Job").items():
+            if (not job.condition and job.active_deadline_seconds is not None
+                    and job.start_time
+                    and now - job.start_time > job.active_deadline_seconds):
+                self.queue.add(key)
+
+    def _update(self, job: Job, **changes) -> Job:
+        new_job = dataclasses.replace(job, **changes)
+        new_job.meta = dataclasses.replace(job.meta)
+        self.store.update_object("Job", new_job)
+        return new_job
+
+    def _fail_job(self, job: Job, pods, reason: str) -> None:
+        for p in pods:
+            if p.status.phase in ("Pending", "Running"):
+                self.store.delete_pod(p.meta.key())
+        self._update(job, condition="Failed", failed_reason=reason)
+
     def reconcile(self, key: str) -> None:
         job: Optional[Job] = self.store.get_object("Job", key)
         if job is None:
             return
+        if not job.start_time:
+            job = self._update(job, start_time=self.now_fn())
         pods = _owned_pods(self.store, job.meta.namespace, "Job", job.meta.name)
         succeeded = sum(1 for p in pods if p.status.phase == "Succeeded")
+        failed = sum(1 for p in pods if p.status.phase == "Failed")
         active = [p for p in pods if p.status.phase in ("Pending", "Running")]
-        if succeeded != job.succeeded:
-            new_job = dataclasses.replace(job, succeeded=succeeded)
-            new_job.meta = dataclasses.replace(job.meta)
-            self.store.update_object("Job", new_job)
-            job = new_job
+        if succeeded != job.succeeded or failed != job.failed:
+            job = self._update(job, succeeded=succeeded, failed=failed)
+        if job.condition:
+            return  # terminal
+        if (job.active_deadline_seconds is not None and job.start_time
+                and self.now_fn() - job.start_time > job.active_deadline_seconds):
+            self._fail_job(job, pods, "DeadlineExceeded")
+            return
+        if failed > job.backoff_limit:
+            self._fail_job(job, pods, "BackoffLimitExceeded")
+            return
+        if succeeded >= job.completions:
+            self._update(job, condition="Complete")
+            return
         want_active = min(job.parallelism, job.completions - succeeded)
         existing_names = {p.meta.name for p in pods}
         i = 0
@@ -415,6 +455,9 @@ class JobController(Controller):
             i += 1
             if name in existing_names:
                 continue
+            # retries reuse fresh names past the failed ordinals
+            if i > job.completions + failed + 8:
+                break
             pod = _instantiate(job.template or Pod(), name, job.meta.namespace,
                                "Job", job.meta.name)
             self.store.create_pod(pod)

@@ -106,6 +106,82 @@ class TestCronJob:
         assert len(store.jobs) == 2
 
 
+class TestJobFailurePolicy:
+    def _fail_pending(self, store, n):
+        import dataclasses
+
+        failed = 0
+        for p in list(store.pods.values()):
+            if failed >= n:
+                break
+            if p.status.phase == "Pending":
+                new = dataclasses.replace(p)
+                new.meta = dataclasses.replace(p.meta)
+                new.status = dataclasses.replace(p.status, phase="Failed")
+                store.update_pod(new)
+                failed += 1
+
+    def test_backoff_limit_fails_job(self):
+        from kubernetes_tpu.api.types import Job, ObjectMeta
+
+        store = ClusterStore()
+        m = make_manager(store, ["job"])
+        store.create_object("Job", Job(
+            meta=ObjectMeta(name="flaky"), completions=1, parallelism=1,
+            backoff_limit=2, template=make_pod("t").req({"cpu": "1m"}).obj()))
+        for _ in range(6):
+            m.settle()
+            self._fail_pending(store, 1)
+        m.settle()
+        job = store.get_object("Job", "default/flaky")
+        assert job.condition == "Failed"
+        assert job.failed_reason == "BackoffLimitExceeded"
+        assert job.failed > 2
+        # terminal: no new pods spawn
+        alive = [p for p in store.pods.values()
+                 if p.status.phase in ("Pending", "Running")]
+        assert not alive
+
+    def test_active_deadline(self):
+        from kubernetes_tpu.api.types import Job, ObjectMeta
+
+        store = ClusterStore()
+        clock = FakeClock()
+        m = make_manager(store, ["job"], now_fn=clock)
+        store.create_object("Job", Job(
+            meta=ObjectMeta(name="slow"), completions=1, parallelism=1,
+            active_deadline_seconds=30,
+            template=make_pod("t").req({"cpu": "1m"}).obj()))
+        m.settle()
+        assert store.get_object("Job", "default/slow").condition == ""
+        clock.advance(31)
+        m.settle()
+        job = store.get_object("Job", "default/slow")
+        assert job.condition == "Failed"
+        assert job.failed_reason == "DeadlineExceeded"
+
+    def test_completion_sets_condition(self):
+        import dataclasses
+
+        from kubernetes_tpu.api.types import Job, ObjectMeta
+
+        store = ClusterStore()
+        m = make_manager(store, ["job"])
+        store.create_object("Job", Job(
+            meta=ObjectMeta(name="ok"), completions=2, parallelism=2,
+            template=make_pod("t").req({"cpu": "1m"}).obj()))
+        m.settle()
+        for p in list(store.pods.values()):
+            new = dataclasses.replace(p)
+            new.meta = dataclasses.replace(p.meta)
+            new.status = dataclasses.replace(p.status, phase="Succeeded")
+            store.update_pod(new)
+        m.settle()
+        job = store.get_object("Job", "default/ok")
+        assert job.condition == "Complete"
+        assert job.succeeded == 2
+
+
 class TestAttachDetach:
     def test_attach_and_detach_follow_pod_lifecycle(self):
         store = ClusterStore()
