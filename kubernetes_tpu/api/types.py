@@ -284,12 +284,26 @@ class ContainerPort:
 
 
 @dataclass
+class SecurityContext:
+    """core/v1 SecurityContext, reduced to the fields Pod Security admission
+    levels check (policy/pkg/api + pod-security-admission checks)."""
+
+    privileged: Optional[bool] = None
+    allow_privilege_escalation: Optional[bool] = None
+    run_as_non_root: Optional[bool] = None
+    run_as_user: Optional[int] = None
+    capabilities_add: Tuple[str, ...] = ()
+    capabilities_drop: Tuple[str, ...] = ()
+
+
+@dataclass
 class Container:
     name: str = ""
     image: str = ""
     requests: Dict[str, object] = field(default_factory=dict)  # resource -> quantity
     limits: Dict[str, object] = field(default_factory=dict)
     ports: Tuple[ContainerPort, ...] = ()
+    security_context: Optional[SecurityContext] = None
 
 
 @dataclass
@@ -307,6 +321,11 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
     overhead: Dict[str, object] = field(default_factory=dict)
     volumes: Tuple[str, ...] = ()  # PVC names (volume subsystem modeled by claim name)
+    service_account_name: str = ""
+    host_network: bool = False
+    host_pid: bool = False
+    host_ipc: bool = False
+    security_context: Optional[SecurityContext] = None  # pod-level defaults
 
 
 @dataclass
@@ -665,6 +684,22 @@ class StorageClass:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     provisioner: str = ""
     volume_binding_mode: str = BINDING_IMMEDIATE
+    allow_volume_expansion: bool = False  # PVC resize gate (pvcresize admission)
+
+
+# the default-class marker the DefaultStorageClass admission plugin reads
+# (plugin/pkg/admission/storage/storageclass/setdefault)
+ANNOTATION_DEFAULT_STORAGE_CLASS = "storageclass.kubernetes.io/is-default-class"
+
+
+@dataclass
+class ServiceAccount:
+    """core/v1 ServiceAccount (the identity object the serviceaccount
+    admission plugin defaults onto pods and the serviceaccount controller
+    maintains per namespace)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    automount_service_account_token: bool = True
 
 
 @dataclass

@@ -11,10 +11,11 @@ store maps. The in-tree plugins modeled (the scheduling-relevant subset):
 
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+import dataclasses
+from typing import Callable, List, Optional, Tuple
 
 from ..api import resource as resource_api
-from ..api.types import Pod, ResourceQuota
+from ..api.types import ObjectMeta, Pod, ResourceQuota
 
 
 class AdmissionError(Exception):
@@ -35,6 +36,12 @@ class AdmissionPlugin:
         """Validating pass; raise AdmissionError to reject. Must be free of
         store-state side effects — it runs outside the store lock and before
         the duplicate-key check."""
+
+    def admit_update(self, store, kind: str, old, obj) -> None:
+        """Mutating pass for updates (operation=UPDATE attributes)."""
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        """Validating pass for updates; raise AdmissionError to reject."""
 
     def charge(self, store, kind: str, obj) -> Optional[Callable[[], None]]:
         """Stateful admission step, run under the store lock immediately
@@ -271,12 +278,405 @@ class PodNodeSelector(AdmissionPlugin):
             pod.spec.node_selector[k] = v
 
 
+class TaintNodesByCondition(AdmissionPlugin):
+    """plugin/pkg/admission/nodetaint: a node that registers not-Ready gets
+    the ``node.kubernetes.io/not-ready`` NoSchedule taint at create time;
+    the node lifecycle controller removes it when the node reports Ready.
+    (The reference taints every new node unconditionally and relies on the
+    controller to lift it within a heartbeat; we taint exactly the nodes
+    whose initial status is not Ready — same steady state without requiring
+    a controller tick between create and first scheduling cycle.)"""
+
+    name = "TaintNodesByCondition"
+
+    def admit(self, store, kind: str, obj) -> None:
+        from ..api.types import Taint
+
+        if kind != "Node":
+            return
+        node = obj
+        if node.status.ready:
+            return
+        if any(t.key == NOT_READY_TAINT and t.effect == "NoSchedule"
+               for t in node.spec.taints):
+            return
+        node.spec.taints = tuple(node.spec.taints) + (
+            Taint(key=NOT_READY_TAINT, effect="NoSchedule"),)
+
+
+class ServiceAccountAdmission(AdmissionPlugin):
+    """plugin/pkg/admission/serviceaccount: default the pod's
+    serviceAccountName to ``default`` and require that it exists. The
+    per-namespace ``default`` ServiceAccount is tolerated as absent (the
+    serviceaccount controller creates it lazily; requiring it would couple
+    every pod create to a controller tick)."""
+
+    name = "ServiceAccount"
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        if not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        sa_name = obj.spec.service_account_name
+        if sa_name == "default":
+            return
+        key = f"{obj.meta.namespace}/{sa_name}"
+        if key not in store.service_accounts:
+            raise AdmissionError(
+                self.name, f"service account {key!r} not found")
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        self.validate(store, kind, obj)
+
+
+# pod-security.kubernetes.io/enforce levels (pod-security-admission/api)
+PS_PRIVILEGED = "privileged"
+PS_BASELINE = "baseline"
+PS_RESTRICTED = "restricted"
+PS_ENFORCE_LABEL = "pod-security.kubernetes.io/enforce"
+
+
+class PodSecurity(AdmissionPlugin):
+    """plugin/pkg/admission/podsecurity: enforce the namespace's Pod
+    Security Standards level (the ``pod-security.kubernetes.io/enforce``
+    namespace label). Modeled checks per level:
+
+    - baseline: no hostNetwork/hostPID/hostIPC, no privileged containers,
+      no non-default capability adds beyond the baseline allowlist
+    - restricted: baseline + runAsNonRoot required + privilege escalation
+      must be explicitly disallowed + capabilities must drop ALL (adding
+      back only NET_BIND_SERVICE)
+    """
+
+    name = "PodSecurity"
+
+    _BASELINE_CAPS = {"AUDIT_WRITE", "CHOWN", "DAC_OVERRIDE", "FOWNER",
+                      "FSETID", "KILL", "MKNOD", "NET_BIND_SERVICE",
+                      "SETFCAP", "SETGID", "SETPCAP", "SETUID", "SYS_CHROOT"}
+
+    def _level(self, store, ns_name: str) -> str:
+        ns = store.namespaces.get(ns_name)
+        if ns is None:
+            return PS_PRIVILEGED
+        return ns.meta.labels.get(PS_ENFORCE_LABEL, PS_PRIVILEGED)
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        level = self._level(store, obj.meta.namespace)
+        if level == PS_PRIVILEGED:
+            return
+        spec = obj.spec
+        if spec.host_network or spec.host_pid or spec.host_ipc:
+            raise AdmissionError(
+                self.name, f"host namespaces are not allowed at level {level}")
+        pod_sc = spec.security_context
+        for c in list(spec.containers) + list(spec.init_containers):
+            sc = c.security_context
+            if sc is not None:
+                if sc.privileged:
+                    raise AdmissionError(
+                        self.name,
+                        f"privileged container {c.name!r} not allowed at level {level}")
+                extra = set(sc.capabilities_add) - self._BASELINE_CAPS
+                if extra:
+                    raise AdmissionError(
+                        self.name,
+                        f"container {c.name!r} adds forbidden capabilities {sorted(extra)}")
+            if level == PS_RESTRICTED:
+                run_as_non_root = None
+                if sc is not None and sc.run_as_non_root is not None:
+                    run_as_non_root = sc.run_as_non_root
+                elif pod_sc is not None and pod_sc.run_as_non_root is not None:
+                    run_as_non_root = pod_sc.run_as_non_root
+                if not run_as_non_root:
+                    raise AdmissionError(
+                        self.name,
+                        f"container {c.name!r} must set runAsNonRoot at level restricted")
+                if sc is None or sc.allow_privilege_escalation is not False:
+                    raise AdmissionError(
+                        self.name,
+                        f"container {c.name!r} must set allowPrivilegeEscalation: "
+                        "false at level restricted")
+                if sc.capabilities_add and set(sc.capabilities_add) != {"NET_BIND_SERVICE"}:
+                    raise AdmissionError(
+                        self.name,
+                        f"container {c.name!r} may only add NET_BIND_SERVICE at "
+                        "level restricted")
+                if "ALL" not in sc.capabilities_drop:
+                    raise AdmissionError(
+                        self.name,
+                        f"container {c.name!r} must drop ALL capabilities at "
+                        "level restricted")
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        self.validate(store, kind, obj)
+
+
+class NodeRestriction(AdmissionPlugin):
+    """plugin/pkg/admission/noderestriction: a kubelet identity
+    (``system:node:<name>``) may only write its own Node object, pods bound
+    to itself, and its own Lease. Other users are unrestricted."""
+
+    name = "NodeRestriction"
+
+    @staticmethod
+    def _node_of(user: str) -> Optional[str]:
+        return user[len("system:node:"):] if user.startswith("system:node:") else None
+
+    def _check(self, store, kind: str, obj, old=None) -> None:
+        me = self._node_of(store.request_user())
+        if me is None:
+            return
+        if kind == "Node":
+            if obj.meta.name != me:
+                raise AdmissionError(
+                    self.name, f"node {me!r} may not modify node {obj.meta.name!r}")
+        elif kind == "Pod":
+            target = obj.spec.node_name or (old.spec.node_name if old is not None else "")
+            if target != me:
+                raise AdmissionError(
+                    self.name, f"node {me!r} may only write pods bound to itself")
+        elif kind == "Lease":
+            if obj.meta.name != me:
+                raise AdmissionError(
+                    self.name, f"node {me!r} may not write lease {obj.meta.name!r}")
+
+    def validate(self, store, kind: str, obj) -> None:
+        self._check(store, kind, obj)
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        self._check(store, kind, obj, old)
+
+
+class DefaultStorageClass(AdmissionPlugin):
+    """plugin/pkg/admission/storage/storageclass/setdefault: a PVC created
+    without a storage class gets the cluster default (the StorageClass
+    carrying the is-default-class annotation)."""
+
+    name = "DefaultStorageClass"
+
+    def admit(self, store, kind: str, obj) -> None:
+        from ..api.types import ANNOTATION_DEFAULT_STORAGE_CLASS
+
+        if kind != "PersistentVolumeClaim" or obj.storage_class:
+            return
+        for sc in store.storage_classes.values():
+            if sc.meta.annotations.get(ANNOTATION_DEFAULT_STORAGE_CLASS) == "true":
+                obj.storage_class = sc.meta.name
+                return
+
+
+class PersistentVolumeClaimResize(AdmissionPlugin):
+    """plugin/pkg/admission/storage/persistentvolume/resize: growing a bound
+    PVC requires its StorageClass to allow volume expansion; shrinking is
+    never allowed."""
+
+    name = "PersistentVolumeClaimResize"
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        if kind != "PersistentVolumeClaim" or old is None:
+            return
+        if obj.requested_bytes < old.requested_bytes:
+            raise AdmissionError(self.name, "persistent volume claims cannot shrink")
+        if obj.requested_bytes > old.requested_bytes:
+            sc = store.storage_classes.get(old.storage_class)
+            if sc is None or not sc.allow_volume_expansion:
+                raise AdmissionError(
+                    self.name,
+                    f"storage class {old.storage_class!r} does not allow volume expansion")
+
+
+class OwnerReferencesPermissionEnforcement(AdmissionPlugin):
+    """plugin/pkg/admission/gc: setting blockOwnerDeletion on an owner
+    reference requires permission to update the owner's finalizers
+    (checked through the store's authorizer when one is configured)."""
+
+    name = "OwnerReferencesPermissionEnforcement"
+
+    def _check(self, store, obj, old=None) -> None:
+        if store.authorizer is None:
+            return
+        refs = getattr(obj.meta, "owner_references", ()) or ()
+        old_blocking = set()
+        if old is not None:
+            old_blocking = {(r.kind, r.name) for r in
+                            (getattr(old.meta, "owner_references", ()) or ())
+                            if getattr(r, "block_owner_deletion", False)}
+        for r in refs:
+            if not getattr(r, "block_owner_deletion", False):
+                continue
+            if (r.kind, r.name) in old_blocking:
+                continue  # pre-existing blocks are not re-checked
+            user = store.request_user()
+            if not store.authorizer.allowed(user, "update", r.kind, r.name,
+                                            subresource="finalizers"):
+                raise AdmissionError(
+                    self.name,
+                    f"user {user!r} may not set blockOwnerDeletion on "
+                    f"{r.kind}/{r.name} (cannot update finalizers)")
+
+    def validate(self, store, kind: str, obj) -> None:
+        self._check(store, obj)
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        self._check(store, obj, old)
+
+
+@dataclasses.dataclass
+class WebhookConfiguration:
+    """admissionregistration.k8s.io webhook configuration, reduced: a kind
+    filter plus either an in-process callable or a localhost URL speaking
+    AdmissionReview-shaped JSON (apiserver pkg/admission/plugin/webhook)."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    kinds: Tuple[str, ...] = ()          # () = all kinds
+    namespaces: Tuple[str, ...] = ()     # () = all namespaces
+    handler: Optional[Callable] = None   # (review: dict) -> dict
+    url: str = ""                        # http://127.0.0.1:PORT/... alternative
+    failure_policy: str = "Fail"         # or "Ignore"
+
+    def matches(self, kind: str, obj) -> bool:
+        if self.kinds and kind not in self.kinds:
+            return False
+        if self.namespaces:
+            ns = getattr(obj.meta, "namespace", "")
+            if ns not in self.namespaces:
+                return False
+        return True
+
+
+def _call_webhook(cfg: WebhookConfiguration, review: dict) -> dict:
+    if cfg.handler is not None:
+        return cfg.handler(review)
+    import json
+    import urllib.request
+
+    from ..api.codec import to_wire
+
+    wire = dict(review, object=to_wire(review["object"]))
+    req = urllib.request.Request(
+        cfg.url, data=json.dumps(wire).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=5.0) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _apply_patch(obj, patch: List[dict]) -> None:
+    """JSON-patch subset (add/replace/remove on /-separated paths) applied to
+    the typed object: {"op": "replace", "path": "/spec/priority", "value": 7}.
+    Intermediate segments may cross dicts; a malformed patch rejects the
+    request as an AdmissionError rather than escaping as a raw attribute
+    error."""
+    for p in patch:
+        parts = [s for s in p.get("path", "").split("/") if s]
+        if not parts:
+            continue
+        try:
+            target = obj
+            for attr in parts[:-1]:
+                target = target[attr] if isinstance(target, dict) else getattr(target, attr)
+            leaf = parts[-1]
+            op = p.get("op", "replace")
+            if op == "remove":
+                if isinstance(target, dict):
+                    target.pop(leaf, None)
+                else:
+                    setattr(target, leaf, None)
+            elif op in ("add", "replace"):
+                if isinstance(target, dict):
+                    target[leaf] = p.get("value")
+                else:
+                    setattr(target, leaf, p.get("value"))
+            else:
+                raise ValueError(f"unsupported op {op!r}")
+        except Exception as exc:  # noqa: BLE001 — malformed webhook patch
+            raise AdmissionError(
+                "MutatingAdmissionWebhook",
+                f"invalid patch {p.get('op', 'replace')} {p.get('path')!r}: {exc}",
+            ) from exc
+
+
+class MutatingAdmissionWebhook(AdmissionPlugin):
+    """MutatingAdmissionWebhook: dispatch matching webhook configurations
+    registered as MutatingWebhookConfiguration objects; their patches are
+    applied to the object before validation."""
+
+    name = "MutatingAdmissionWebhook"
+    _configs_attr = "mutating_webhooks"
+    _mutating = True
+
+    def _dispatch(self, store, kind: str, obj, operation: str, old=None) -> None:
+        for cfg in list(getattr(store, self._configs_attr).values()):
+            if not isinstance(cfg, WebhookConfiguration) or not cfg.matches(kind, obj):
+                continue
+            review = {
+                "kind": kind,
+                "operation": operation,
+                "name": getattr(obj.meta, "name", ""),
+                "namespace": getattr(obj.meta, "namespace", ""),
+                "object": obj,
+            }
+            try:
+                resp = _call_webhook(cfg, review)
+            except Exception as exc:  # noqa: BLE001 — webhook transport failure
+                if cfg.failure_policy == "Ignore":
+                    continue
+                raise AdmissionError(self.name, f"webhook call failed: {exc}") from exc
+            if not resp.get("allowed", True):
+                raise AdmissionError(
+                    self.name, resp.get("message", "denied by webhook"))
+            if self._mutating and resp.get("patch"):
+                _apply_patch(obj, resp["patch"])
+
+    def admit(self, store, kind: str, obj) -> None:
+        self._dispatch(store, kind, obj, "CREATE")
+
+    def admit_update(self, store, kind: str, old, obj) -> None:
+        self._dispatch(store, kind, obj, "UPDATE", old)
+
+
+class ValidatingAdmissionWebhook(MutatingAdmissionWebhook):
+    """ValidatingAdmissionWebhook: same dispatch, validating phase, no
+    patches applied (runs after every mutating plugin, plugins.go order)."""
+
+    name = "ValidatingAdmissionWebhook"
+    _configs_attr = "validating_webhooks"
+    _mutating = False
+
+    def admit(self, store, kind: str, obj) -> None:  # move to validate phase
+        pass
+
+    def admit_update(self, store, kind: str, old, obj) -> None:
+        pass
+
+    def validate(self, store, kind: str, obj) -> None:
+        self._dispatch(store, kind, obj, "CREATE")
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        self._dispatch(store, kind, obj, "UPDATE", old)
+
+
 def default_chain() -> List[AdmissionPlugin]:
-    """AllOrderedPlugins, reduced to the modeled set (plugins.go:64 order:
-    lifecycle → node selector → priority → tolerations → limits →
-    ... → quota last)."""
-    return [NamespaceLifecycle(), PodNodeSelector(), DefaultPriority(),
-            DefaultTolerationSeconds(), LimitRanger(), ResourceQuotaAdmission()]
+    """AllOrderedPlugins (plugins.go:64), reduced to the modeled set and kept
+    in the reference's relative order: NamespaceLifecycle → LimitRanger →
+    ServiceAccount → NodeRestriction → TaintNodesByCondition → PodSecurity →
+    PodNodeSelector → Priority → DefaultTolerationSeconds →
+    DefaultStorageClass → PersistentVolumeClaimResize →
+    OwnerReferencesPermissionEnforcement → MutatingAdmissionWebhook →
+    ValidatingAdmissionWebhook → ResourceQuota (always last)."""
+    return [NamespaceLifecycle(), LimitRanger(), ServiceAccountAdmission(),
+            NodeRestriction(), TaintNodesByCondition(), PodSecurity(),
+            PodNodeSelector(), DefaultPriority(), DefaultTolerationSeconds(),
+            DefaultStorageClass(), PersistentVolumeClaimResize(),
+            OwnerReferencesPermissionEnforcement(),
+            MutatingAdmissionWebhook(), ValidatingAdmissionWebhook(),
+            ResourceQuotaAdmission()]
 
 
 class AdmissionChain:
@@ -288,6 +688,12 @@ class AdmissionChain:
             p.admit(store, kind, obj)
         for p in self.plugins:
             p.validate(store, kind, obj)
+
+    def run_update(self, store, kind: str, old, obj) -> None:
+        for p in self.plugins:
+            p.admit_update(store, kind, old, obj)
+        for p in self.plugins:
+            p.validate_update(store, kind, old, obj)
 
     def charge(self, store, kind: str, obj) -> Callable[[], None]:
         """Run every plugin's stateful charge step (under the store lock);

@@ -130,6 +130,16 @@ class ClusterStore:
         self.daemon_sets: Dict[str, object] = {}
         self.jobs: Dict[str, object] = {}
         self.endpoints: Dict[str, object] = {}
+        self.service_accounts: Dict[str, object] = {}
+        self.mutating_webhooks: Dict[str, object] = {}
+        self.validating_webhooks: Dict[str, object] = {}
+        # per-thread request identity (the authn layer's user info, set by
+        # the HTTP front from the authenticated request; NodeRestriction and
+        # OwnerReferencesPermissionEnforcement read it)
+        self._request_user = threading.local()
+        # authorizer hook (authz.Authorizer-shaped: allowed(user, verb,
+        # kind, name) -> bool); None = authorization disabled
+        self.authorizer = None
         self._handlers: Dict[str, List[Handler]] = {}
         self._rv = 0
         # watch journal (the watch cache, cacher.go:227): bounded event log +
@@ -169,6 +179,53 @@ class ClusterStore:
     def _admit(self, kind: str, obj) -> None:
         if self.admission is not None:
             self.admission.run(self, kind, obj)
+
+    def _admit_update(self, kind: str, old, obj) -> None:
+        if self.admission is not None:
+            self.admission.run_update(self, kind, old, obj)
+
+    def _guarded_update(self, kind: str, obj, lookup, commit) -> None:
+        """Admission-checked update with optimistic concurrency against the
+        admission snapshot: validate_update runs OUTSIDE the lock (webhooks
+        may do IO), then the locked commit only lands if the stored object is
+        still the one admission validated against — otherwise re-validate
+        against the new truth and retry (GuaranteedUpdate's retry loop,
+        etcd3/store.go:328; closes the validate-then-write race on e.g. the
+        PVC shrink check)."""
+        for _ in range(16):
+            with self._lock:
+                old = lookup()
+            self._admit_update(kind, old, obj)
+            with self._lock:
+                if lookup() is old:
+                    commit(old)
+                    return
+        raise Conflict(f"{kind} {self._key_of(kind, obj)}: too many concurrent updates")
+
+    # -------------------------------------------------------------- request user
+    # (the authn seam: the HTTP front authenticates and pins the user for the
+    # duration of the request; in-process callers are "system:admin")
+
+    def request_user(self) -> str:
+        return getattr(self._request_user, "name", "") or "system:admin"
+
+    def set_request_user(self, name: str) -> None:
+        self._request_user.name = name
+
+    def as_user(self, name: str):
+        """Context manager: run store writes as ``name`` on this thread."""
+        store = self
+
+        class _Ctx:
+            def __enter__(self):
+                self._prev = getattr(store._request_user, "name", "")
+                store._request_user.name = name
+
+            def __exit__(self, *exc):
+                store._request_user.name = self._prev
+                return False
+
+        return _Ctx()
 
     def _bump(self, obj) -> None:
         self._rv += 1
@@ -231,6 +288,9 @@ class ClusterStore:
                 "CronJob": self.cron_jobs,
                 "EndpointSlice": self.endpoint_slices,
                 "VolumeAttachment": self.volume_attachments,
+                "ServiceAccount": self.service_accounts,
+                "MutatingWebhookConfiguration": self.mutating_webhooks,
+                "ValidatingWebhookConfiguration": self.validating_webhooks,
             }[kind]
         except KeyError:
             raise NotFound(f"unknown kind {kind!r}") from None
@@ -238,6 +298,7 @@ class ClusterStore:
     # ------------------------------------------------------------- nodes
 
     def create_node(self, node: Node) -> None:
+        self._admit("Node", node)
         with self._lock:
             if node.meta.name in self.nodes:
                 raise Conflict(f"node {node.meta.name} exists")
@@ -247,14 +308,19 @@ class ClusterStore:
         self._notify("Node", ADDED, None, node)
 
     def update_node(self, node: Node) -> None:
-        with self._lock:
-            old = self.nodes.get(node.meta.name)
+        seen = []
+
+        def commit(old):
             if old is None:
                 raise NotFound(node.meta.name)
             self._bump(node)
             self.nodes[node.meta.name] = node
             self._journal_event("Node", MODIFIED, old, node)
-        self._notify("Node", MODIFIED, old, node)
+            seen.append(old)
+
+        self._guarded_update("Node", node, lambda: self.nodes.get(node.meta.name),
+                             commit)
+        self._notify("Node", MODIFIED, seen[0], node)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
@@ -287,14 +353,18 @@ class ClusterStore:
         self._notify("Pod", ADDED, None, pod)
 
     def update_pod(self, pod: Pod) -> None:
-        with self._lock:
-            old = self.pods.get(pod.key())
+        seen = []
+
+        def commit(old):
             if old is None:
                 raise NotFound(pod.key())
             self._bump(pod)
             self.pods[pod.key()] = pod
             self._journal_event("Pod", MODIFIED, old, pod)
-        self._notify("Pod", MODIFIED, old, pod)
+            seen.append(old)
+
+        self._guarded_update("Pod", pod, lambda: self.pods.get(pod.key()), commit)
+        self._notify("Pod", MODIFIED, seen[0], pod)
 
     def delete_pod(self, key: str) -> None:
         with self._lock:
@@ -374,6 +444,7 @@ class ClusterStore:
     CLUSTER_SCOPED_KINDS = {
         "Node", "Namespace", "PersistentVolume", "StorageClass", "CSINode",
         "PriorityClass", "VolumeAttachment",
+        "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
     }
 
     def _key_of(self, kind: str, obj) -> str:
@@ -398,15 +469,19 @@ class ClusterStore:
 
     def update_object(self, kind: str, obj) -> None:
         m = self._kind_map(kind)
-        with self._lock:
-            key = self._key_of(kind, obj)
-            old = m.get(key)
+        key = self._key_of(kind, obj)
+        seen = []
+
+        def commit(old):
             if old is None:
                 raise NotFound(f"{kind} {key}")
             self._bump(obj)
             m[key] = obj
             self._journal_event(kind, MODIFIED, old, obj)
-        self._notify(kind, MODIFIED, old, obj)
+            seen.append(old)
+
+        self._guarded_update(kind, obj, lambda: m.get(key), commit)
+        self._notify(kind, MODIFIED, seen[0], obj)
 
     def delete_object(self, kind: str, key: str) -> None:
         m = self._kind_map(kind)
@@ -482,6 +557,7 @@ class ClusterStore:
             return self.leases.get(key)
 
     def create_lease(self, lease: "Lease") -> None:
+        self._admit("Lease", lease)
         with self._lock:
             if lease.meta.key() in self.leases:
                 raise Conflict(f"lease {lease.meta.key()} exists")
@@ -494,6 +570,7 @@ class ClusterStore:
         """Guarded update: fails unless the stored lease still has
         ``expect_rv`` (GuaranteedUpdate's optimistic concurrency,
         etcd3/store.go:328 — what makes leader election safe)."""
+        self._admit_update("Lease", self.leases.get(lease.meta.key()), lease)
         with self._lock:
             old = self.leases.get(lease.meta.key())
             if old is None:
@@ -517,6 +594,7 @@ class ClusterStore:
         self._notify("PersistentVolume", ADDED, None, pv)
 
     def create_pvc(self, pvc: PersistentVolumeClaim) -> None:
+        self._admit("PersistentVolumeClaim", pvc)
         with self._lock:
             self._bump(pvc)
             self.pvcs[pvc.meta.key()] = pvc

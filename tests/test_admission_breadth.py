@@ -1,0 +1,309 @@
+"""Round-3 admission breadth: ServiceAccount, PodSecurity, NodeRestriction,
+TaintNodesByCondition, DefaultStorageClass, PersistentVolumeClaimResize,
+OwnerReferencesPermissionEnforcement, and webhook admission — the modeled
+subset of AllOrderedPlugins (pkg/kubeapiserver/options/plugins.go:64)."""
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    ANNOTATION_DEFAULT_STORAGE_CLASS,
+    Lease,
+    Namespace,
+    ObjectMeta,
+    OwnerReference,
+    PersistentVolumeClaim,
+    SecurityContext,
+    ServiceAccount,
+    StorageClass,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.admission import (
+    PS_ENFORCE_LABEL,
+    AdmissionError,
+    WebhookConfiguration,
+)
+from kubernetes_tpu.apiserver.store import ClusterStore
+
+
+def _ns(name, labels=None):
+    return Namespace(meta=ObjectMeta(name=name, labels=labels or {}))
+
+
+class TestServiceAccountAdmission:
+    def test_defaults_to_default_sa(self):
+        store = ClusterStore()
+        pod = make_pod("p").req({"cpu": "100m"}).obj()
+        store.create_pod(pod)
+        assert pod.spec.service_account_name == "default"
+
+    def test_missing_named_sa_rejected(self):
+        store = ClusterStore()
+        pod = make_pod("p").req({"cpu": "100m"}).obj()
+        pod.spec.service_account_name = "builder"
+        with pytest.raises(AdmissionError, match="service account"):
+            store.create_pod(pod)
+
+    def test_existing_named_sa_accepted(self):
+        store = ClusterStore()
+        store.create_object(
+            "ServiceAccount", ServiceAccount(meta=ObjectMeta(name="builder")))
+        pod = make_pod("p").req({"cpu": "100m"}).obj()
+        pod.spec.service_account_name = "builder"
+        store.create_pod(pod)
+        assert store.get_pod(pod.key()) is not None
+
+
+class TestPodSecurity:
+    def _store(self, level):
+        store = ClusterStore()
+        store.create_namespace(_ns("secure", {PS_ENFORCE_LABEL: level}))
+        return store
+
+    def test_privileged_level_allows_hostnetwork(self):
+        store = ClusterStore()
+        store.create_namespace(_ns("open"))
+        pod = make_pod("p", namespace="open").req({"cpu": "1"}).obj()
+        pod.spec.host_network = True
+        store.create_pod(pod)  # no enforcement label → privileged
+
+    def test_baseline_rejects_host_namespaces_and_privileged(self):
+        store = self._store("baseline")
+        pod = make_pod("p", namespace="secure").req({"cpu": "1"}).obj()
+        pod.spec.host_pid = True
+        with pytest.raises(AdmissionError, match="host namespaces"):
+            store.create_pod(pod)
+        pod2 = make_pod("p2", namespace="secure").req({"cpu": "1"}).obj()
+        pod2.spec.containers[0].security_context = SecurityContext(privileged=True)
+        with pytest.raises(AdmissionError, match="privileged"):
+            store.create_pod(pod2)
+
+    def test_restricted_requires_non_root_and_no_escalation(self):
+        store = self._store("restricted")
+        pod = make_pod("p", namespace="secure").req({"cpu": "1"}).obj()
+        with pytest.raises(AdmissionError, match="runAsNonRoot"):
+            store.create_pod(pod)
+        ok = make_pod("ok", namespace="secure").req({"cpu": "1"}).obj()
+        ok.spec.containers[0].security_context = SecurityContext(
+            run_as_non_root=True, allow_privilege_escalation=False,
+            capabilities_drop=("ALL",))
+        store.create_pod(ok)
+        assert store.get_pod(ok.key()) is not None
+
+    def test_restricted_enforced_on_update_too(self):
+        store = self._store("restricted")
+        ok = make_pod("ok", namespace="secure").req({"cpu": "1"}).obj()
+        ok.spec.containers[0].security_context = SecurityContext(
+            run_as_non_root=True, allow_privilege_escalation=False,
+            capabilities_drop=("ALL",))
+        store.create_pod(ok)
+        evil = ok.clone()
+        evil.spec.host_network = True
+        with pytest.raises(AdmissionError, match="host namespaces"):
+            store.update_pod(evil)
+
+
+class TestNodeRestriction:
+    def test_kubelet_may_update_own_node_only(self):
+        store = ClusterStore()
+        store.create_node(make_node("n1").capacity({"cpu": "4"}).obj())
+        store.create_node(make_node("n2").capacity({"cpu": "4"}).obj())
+        with store.as_user("system:node:n1"):
+            n1 = store.nodes["n1"]
+            store.update_node(n1)  # own node: allowed
+            with pytest.raises(AdmissionError, match="may not modify"):
+                store.update_node(store.nodes["n2"])
+
+    def test_kubelet_pod_writes_scoped_to_itself(self):
+        store = ClusterStore()
+        with store.as_user("system:node:n1"):
+            mirror = make_pod("mirror").req({"cpu": "1"}).node("n1").obj()
+            store.create_pod(mirror)
+            other = make_pod("other").req({"cpu": "1"}).node("n2").obj()
+            with pytest.raises(AdmissionError, match="bound to itself"):
+                store.create_pod(other)
+
+    def test_kubelet_lease_scoped(self):
+        store = ClusterStore()
+        with store.as_user("system:node:n1"):
+            store.create_lease(Lease(meta=ObjectMeta(
+                name="n1", namespace="kube-node-lease")))
+            with pytest.raises(AdmissionError, match="lease"):
+                store.create_lease(Lease(meta=ObjectMeta(
+                    name="n2", namespace="kube-node-lease")))
+
+    def test_ordinary_user_unrestricted(self):
+        store = ClusterStore()
+        store.create_node(make_node("n1").capacity({"cpu": "4"}).obj())
+        store.update_node(store.nodes["n1"])  # system:admin
+
+
+class TestTaintNodesByCondition:
+    def test_not_ready_node_tainted_on_create(self):
+        store = ClusterStore()
+        node = make_node("cold").capacity({"cpu": "4"}).obj()
+        node.status.ready = False
+        store.create_node(node)
+        assert any(t.key == "node.kubernetes.io/not-ready"
+                   and t.effect == "NoSchedule" for t in node.spec.taints)
+
+    def test_ready_node_untouched(self):
+        store = ClusterStore()
+        node = make_node("warm").capacity({"cpu": "4"}).obj()
+        store.create_node(node)
+        assert not node.spec.taints
+
+
+class TestStorageAdmission:
+    def test_default_storage_class_applied(self):
+        store = ClusterStore()
+        store.create_storage_class(StorageClass(
+            meta=ObjectMeta(name="standard",
+                            annotations={ANNOTATION_DEFAULT_STORAGE_CLASS: "true"})))
+        store.create_storage_class(StorageClass(meta=ObjectMeta(name="other")))
+        pvc = PersistentVolumeClaim(meta=ObjectMeta(name="data"))
+        store.create_pvc(pvc)
+        assert pvc.storage_class == "standard"
+
+    def test_explicit_class_kept(self):
+        store = ClusterStore()
+        store.create_storage_class(StorageClass(
+            meta=ObjectMeta(name="standard",
+                            annotations={ANNOTATION_DEFAULT_STORAGE_CLASS: "true"})))
+        pvc = PersistentVolumeClaim(meta=ObjectMeta(name="data"),
+                                    storage_class="fast")
+        store.create_pvc(pvc)
+        assert pvc.storage_class == "fast"
+
+    def test_pvc_resize_requires_expandable_class(self):
+        store = ClusterStore()
+        store.create_storage_class(StorageClass(meta=ObjectMeta(name="rigid")))
+        store.create_storage_class(StorageClass(
+            meta=ObjectMeta(name="elastic"), allow_volume_expansion=True))
+        pvc = PersistentVolumeClaim(meta=ObjectMeta(name="a"),
+                                    storage_class="rigid", requested_bytes=100)
+        store.create_pvc(pvc)
+        grown = PersistentVolumeClaim(meta=ObjectMeta(name="a"),
+                                      storage_class="rigid", requested_bytes=200)
+        with pytest.raises(AdmissionError, match="expansion"):
+            store.update_object("PersistentVolumeClaim", grown)
+        pvc2 = PersistentVolumeClaim(meta=ObjectMeta(name="b"),
+                                     storage_class="elastic", requested_bytes=100)
+        store.create_pvc(pvc2)
+        store.update_object("PersistentVolumeClaim", PersistentVolumeClaim(
+            meta=ObjectMeta(name="b"), storage_class="elastic", requested_bytes=200))
+        shrunk = PersistentVolumeClaim(meta=ObjectMeta(name="b"),
+                                       storage_class="elastic", requested_bytes=50)
+        with pytest.raises(AdmissionError, match="shrink"):
+            store.update_object("PersistentVolumeClaim", shrunk)
+
+
+class TestOwnerReferencesPermissionEnforcement:
+    class _DenyAll:
+        def allowed(self, user, verb, kind, name, subresource=""):
+            return False
+
+    class _AllowAll:
+        def allowed(self, user, verb, kind, name, subresource=""):
+            return True
+
+    def test_block_owner_deletion_needs_finalizer_permission(self):
+        store = ClusterStore()
+        store.authorizer = self._DenyAll()
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        pod.meta.owner_references = (OwnerReference(
+            kind="ReplicaSet", name="rs", controller=True,
+            block_owner_deletion=True),)
+        with pytest.raises(AdmissionError, match="blockOwnerDeletion"):
+            store.create_pod(pod)
+        store.authorizer = self._AllowAll()
+        store.create_pod(pod)
+
+    def test_no_authorizer_no_enforcement(self):
+        store = ClusterStore()
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        pod.meta.owner_references = (OwnerReference(
+            kind="ReplicaSet", name="rs", block_owner_deletion=True),)
+        store.create_pod(pod)
+
+
+class TestWebhookAdmission:
+    def test_mutating_webhook_patches_priority(self):
+        store = ClusterStore()
+
+        def bump_priority(review):
+            assert review["kind"] == "Pod"
+            return {"allowed": True,
+                    "patch": [{"op": "replace", "path": "/spec/priority",
+                               "value": 7}]}
+
+        store.create_object("MutatingWebhookConfiguration", WebhookConfiguration(
+            meta=ObjectMeta(name="bumper"), kinds=("Pod",),
+            handler=bump_priority))
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        store.create_pod(pod)
+        assert store.get_pod(pod.key()).spec.priority == 7
+
+    def test_validating_webhook_denies(self):
+        store = ClusterStore()
+        store.create_object("ValidatingWebhookConfiguration", WebhookConfiguration(
+            meta=ObjectMeta(name="gate"), kinds=("Pod",),
+            handler=lambda review: {"allowed": False, "message": "not today"}))
+        with pytest.raises(AdmissionError, match="not today"):
+            store.create_pod(make_pod("p").req({"cpu": "1"}).obj())
+
+    def test_failure_policy_ignore_tolerates_broken_webhook(self):
+        store = ClusterStore()
+
+        def broken(review):
+            raise RuntimeError("down")
+
+        store.create_object("ValidatingWebhookConfiguration", WebhookConfiguration(
+            meta=ObjectMeta(name="flaky"), kinds=("Pod",), handler=broken,
+            failure_policy="Ignore"))
+        store.create_pod(make_pod("p").req({"cpu": "1"}).obj())
+
+    def test_failure_policy_fail_rejects(self):
+        store = ClusterStore()
+
+        def broken(review):
+            raise RuntimeError("down")
+
+        store.create_object("ValidatingWebhookConfiguration", WebhookConfiguration(
+            meta=ObjectMeta(name="strict"), kinds=("Pod",), handler=broken))
+        with pytest.raises(AdmissionError, match="webhook call failed"):
+            store.create_pod(make_pod("p").req({"cpu": "1"}).obj())
+
+    def test_webhook_over_http(self):
+        import json
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+        import threading
+
+        class H(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers["Content-Length"])
+                review = json.loads(self.rfile.read(n))
+                body = json.dumps({
+                    "allowed": review["name"] != "bad"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = HTTPServer(("127.0.0.1", 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            store = ClusterStore()
+            store.create_object(
+                "ValidatingWebhookConfiguration", WebhookConfiguration(
+                    meta=ObjectMeta(name="remote"), kinds=("Pod",),
+                    url=f"http://127.0.0.1:{srv.server_address[1]}/validate"))
+            store.create_pod(make_pod("good").req({"cpu": "1"}).obj())
+            with pytest.raises(AdmissionError):
+                store.create_pod(make_pod("bad").req({"cpu": "1"}).obj())
+        finally:
+            srv.shutdown()
