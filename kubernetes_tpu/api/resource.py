@@ -89,15 +89,31 @@ def _native_cls(resource: str) -> int:
     return 0  # CLS_COUNT
 
 
+_canonical_memo: dict = {}
+
+
 def canonical(resource: str, value) -> int:
     """Canonical int for the device tensors AND the scalar oracle. See module
     doc. String quantities go through the native C++ parser when built
     (native/ktpu_quantity.cpp, same exact semantics); anything else — or a
-    native miss — takes the Fraction path."""
+    native miss — takes the Fraction path. String results are memoized:
+    workloads reuse a handful of quantity strings ("500m", "2Gi") across
+    thousands of pods and this sits on the add_pod/encode hot path."""
     if isinstance(value, str):
-        r = _canonical_native(value, _native_cls(resource))
+        key = (resource, value)
+        r = _canonical_memo.get(key)
         if r is not None:
             return r
+        r = _canonical_native(value, _native_cls(resource))
+        if r is None:
+            r = _canonical_py(resource, value)
+        if len(_canonical_memo) < 1 << 20:
+            _canonical_memo[key] = r
+        return r
+    return _canonical_py(resource, value)
+
+
+def _canonical_py(resource: str, value) -> int:
     if resource == CPU:
         return milli_value(value)
     if resource == MEMORY:

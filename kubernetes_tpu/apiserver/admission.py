@@ -319,6 +319,16 @@ class ServiceAccountAdmission(AdmissionPlugin):
         if not obj.spec.service_account_name:
             obj.spec.service_account_name = "default"
 
+    def admit_update(self, store, kind: str, old, obj) -> None:
+        if kind != "Pod":
+            return
+        if not obj.spec.service_account_name:
+            # inherit the stored pod's SA (an apply that omits the field must
+            # not strip the identity); fall back to the default
+            obj.spec.service_account_name = (
+                old.spec.service_account_name if old is not None else ""
+            ) or "default"
+
     def validate(self, store, kind: str, obj) -> None:
         if kind != "Pod":
             return
@@ -331,7 +341,12 @@ class ServiceAccountAdmission(AdmissionPlugin):
                 self.name, f"service account {key!r} not found")
 
     def validate_update(self, store, kind: str, old, obj) -> None:
-        self.validate(store, kind, obj)
+        # the reference checks SA existence only on CREATE; re-checking an
+        # unchanged identity would brick status updates of running pods
+        # after their SA is deleted
+        if (kind == "Pod" and old is not None
+                and obj.spec.service_account_name != old.spec.service_account_name):
+            self.validate(store, kind, obj)
 
 
 # pod-security.kubernetes.io/enforce levels (pod-security-admission/api)
@@ -415,6 +430,11 @@ class PodSecurity(AdmissionPlugin):
                         "level restricted")
 
     def validate_update(self, store, kind: str, old, obj) -> None:
+        # status-subresource exemption (upstream pod-security only gates
+        # security-relevant spec changes): a pod whose spec is unchanged must
+        # keep updating even after its namespace's enforce level tightens
+        if kind == "Pod" and old is not None and obj.spec == old.spec:
+            return
         self.validate(store, kind, obj)
 
 
@@ -624,6 +644,8 @@ class MutatingAdmissionWebhook(AdmissionPlugin):
             }
             try:
                 resp = _call_webhook(cfg, review)
+                if not isinstance(resp, dict):
+                    raise TypeError(f"webhook returned {type(resp).__name__}, not a dict")
             except Exception as exc:  # noqa: BLE001 — webhook transport failure
                 if cfg.failure_policy == "Ignore":
                     continue

@@ -184,14 +184,14 @@ class ClusterStore:
         if self.admission is not None:
             self.admission.run_update(self, kind, old, obj)
 
-    def _guarded_update(self, kind: str, obj, lookup, commit) -> None:
+    def _guarded_update(self, kind: str, obj, lookup, commit):
         """Admission-checked update with optimistic concurrency against the
         admission snapshot: validate_update runs OUTSIDE the lock (webhooks
         may do IO), then the locked commit only lands if the stored object is
         still the one admission validated against — otherwise re-validate
         against the new truth and retry (GuaranteedUpdate's retry loop,
         etcd3/store.go:328; closes the validate-then-write race on e.g. the
-        PVC shrink check)."""
+        PVC shrink check). Returns the replaced object."""
         for _ in range(16):
             with self._lock:
                 old = lookup()
@@ -199,7 +199,7 @@ class ClusterStore:
             with self._lock:
                 if lookup() is old:
                     commit(old)
-                    return
+                    return old
         raise Conflict(f"{kind} {self._key_of(kind, obj)}: too many concurrent updates")
 
     # -------------------------------------------------------------- request user
@@ -308,19 +308,16 @@ class ClusterStore:
         self._notify("Node", ADDED, None, node)
 
     def update_node(self, node: Node) -> None:
-        seen = []
-
         def commit(old):
             if old is None:
                 raise NotFound(node.meta.name)
             self._bump(node)
             self.nodes[node.meta.name] = node
             self._journal_event("Node", MODIFIED, old, node)
-            seen.append(old)
 
-        self._guarded_update("Node", node, lambda: self.nodes.get(node.meta.name),
-                             commit)
-        self._notify("Node", MODIFIED, seen[0], node)
+        old = self._guarded_update("Node", node,
+                                   lambda: self.nodes.get(node.meta.name), commit)
+        self._notify("Node", MODIFIED, old, node)
 
     def delete_node(self, name: str) -> None:
         with self._lock:
@@ -353,18 +350,16 @@ class ClusterStore:
         self._notify("Pod", ADDED, None, pod)
 
     def update_pod(self, pod: Pod) -> None:
-        seen = []
-
         def commit(old):
             if old is None:
                 raise NotFound(pod.key())
             self._bump(pod)
             self.pods[pod.key()] = pod
             self._journal_event("Pod", MODIFIED, old, pod)
-            seen.append(old)
 
-        self._guarded_update("Pod", pod, lambda: self.pods.get(pod.key()), commit)
-        self._notify("Pod", MODIFIED, seen[0], pod)
+        old = self._guarded_update("Pod", pod, lambda: self.pods.get(pod.key()),
+                                   commit)
+        self._notify("Pod", MODIFIED, old, pod)
 
     def delete_pod(self, key: str) -> None:
         with self._lock:
@@ -470,7 +465,6 @@ class ClusterStore:
     def update_object(self, kind: str, obj) -> None:
         m = self._kind_map(kind)
         key = self._key_of(kind, obj)
-        seen = []
 
         def commit(old):
             if old is None:
@@ -478,10 +472,9 @@ class ClusterStore:
             self._bump(obj)
             m[key] = obj
             self._journal_event(kind, MODIFIED, old, obj)
-            seen.append(old)
 
-        self._guarded_update(kind, obj, lambda: m.get(key), commit)
-        self._notify(kind, MODIFIED, seen[0], obj)
+        old = self._guarded_update(kind, obj, lambda: m.get(key), commit)
+        self._notify(kind, MODIFIED, old, obj)
 
     def delete_object(self, kind: str, key: str) -> None:
         m = self._kind_map(kind)

@@ -86,6 +86,11 @@ class DeviceState:
             ).copy()
             for field, dtype in _ROW_FIELDS
         }
+        # Node OBJECT identity at the last mirror write per node name: while
+        # unchanged, the row's static fields (labels/taints/allocatable/
+        # images) cannot differ from the mirror, so reconcile only needs to
+        # compare the pod-commit-dynamic fields
+        self._mirror_node: Dict[str, object] = {}
 
     @property
     def tc(self):
@@ -155,6 +160,7 @@ class DeviceState:
         removed = [n for n in self._uploaded_gen if n not in current]
         for name in removed:
             del self._uploaded_gen[name]
+            self._mirror_node.pop(name, None)
             slot = self.encoder.release_node_slot(name)
             if slot is not None:
                 dirty.append((slot, NodeInfo()))  # empty row: valid=False
@@ -169,6 +175,8 @@ class DeviceState:
         changed: List[Tuple[int, dict]] = []
         for slot, ni in dirty:
             row = self.encoder.encode_node_row(ni)
+            if ni.node is not None:
+                self._mirror_node[ni.node.meta.name] = ni.node
             if all(
                 np.array_equal(np.asarray(row[f], dtype), self._mirror[f][slot])
                 for f, dtype in _ROW_FIELDS
@@ -230,12 +238,18 @@ class DeviceState:
         self._refresh_class_prio()
         left = 0
         current = set()
+        mirror = self._mirror
+        req_m, nz_m = mirror["requested"], mirror["nonzero_requested"]
+        ports_m, creq_m = mirror["port_bits"], mirror["class_req"]
         for name, ni in snapshot.node_info_map.items():
             current.add(name)
             if self._uploaded_gen.get(name) == ni.generation:
                 continue
             if name not in self._uploaded_gen:
                 left += 1  # new node: needs a real upload
+                continue
+            if ni.node is not self._mirror_node.get(name):
+                left += 1  # node OBJECT replaced: static fields may differ
                 continue
             if self._node_images.get(name, frozenset()) != frozenset(ni.image_states):
                 left += 1  # image vocab change: needs a real upload
@@ -245,14 +259,16 @@ class DeviceState:
                 left += 1
                 continue
             try:
-                row = self.encoder.encode_node_row(ni)
+                # static fields are pinned by the identity check above; only
+                # the pod-commit-dynamic fields can have moved
+                row = self.encoder.encode_dynamic_fields(ni)
             except CapacityError:
                 left += 1
                 continue
-            if all(
-                np.array_equal(np.asarray(row[f], dtype), self._mirror[f][slot])
-                for f, dtype in _ROW_FIELDS
-            ):
+            if (np.array_equal(row["requested"], req_m[slot])
+                    and np.array_equal(row["nonzero_requested"], nz_m[slot])
+                    and np.array_equal(row["port_bits"], ports_m[slot])
+                    and np.array_equal(row["class_req"], creq_m[slot])):
                 self._uploaded_gen[name] = ni.generation
                 self.rows_elided += 1
                 self.sig_table.recount_node(slot, ni)

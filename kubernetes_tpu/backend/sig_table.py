@@ -159,18 +159,29 @@ class SigTable:
     # ---------------------------------------------------------------- counting
 
     @staticmethod
-    def _pod_term_keys(pod: Pod) -> FrozenSet[TermKey]:
-        cached = pod.__dict__.get("_sig_term_keys")
+    def _pod_terms(pod: Pod):
+        """Per-pod (klass, term) list, cached on the pod object — term
+        extraction walks the affinity tree and sits on the recount hot path
+        (pod clones share spec, so the clone inherits the cache)."""
+        cached = pod.__dict__.get("_sig_terms_all")
         if cached is None:
-            keys = []
+            cached = []
             for klass, terms in (
                 (AFF_REQ, required_affinity_terms(pod)),
                 (ANTI_REQ, required_anti_affinity_terms(pod)),
                 (AFF_PREF, preferred_affinity_terms(pod)),
                 (ANTI_PREF, preferred_anti_affinity_terms(pod)),
             ):
-                keys.extend(term_key_of(t, klass) for t in terms)
-            cached = frozenset(keys)
+                cached.extend((klass, t) for t in terms)
+            pod.__dict__["_sig_terms_all"] = cached
+        return cached
+
+    @classmethod
+    def _pod_term_keys(cls, pod: Pod) -> FrozenSet[TermKey]:
+        cached = pod.__dict__.get("_sig_term_keys")
+        if cached is None:
+            cached = frozenset(
+                term_key_of(t, klass) for klass, t in cls._pod_terms(pod))
             pod.__dict__["_sig_term_keys"] = cached
         return cached
 
@@ -183,14 +194,8 @@ class SigTable:
         # register every term carried by this node's pods BEFORE counting, so
         # existing pods' anti-affinity is never invisible to the batch kernel
         for p in pods:
-            for klass, terms in (
-                (AFF_REQ, required_affinity_terms(p)),
-                (ANTI_REQ, required_anti_affinity_terms(p)),
-                (AFF_PREF, preferred_affinity_terms(p)),
-                (ANTI_PREF, preferred_anti_affinity_terms(p)),
-            ):
-                for t in terms:
-                    self.term_id(t, klass)
+            for klass, t in self._pod_terms(p):
+                self.term_id(t, klass)
         old_sel = self.sel_counts[:, slot].copy()
         old_term = self.term_counts[:, slot].copy()
         self.sel_counts[:, slot] = 0

@@ -120,6 +120,10 @@ class NodeInfo:
         self.requested = Resource()
         self.non_zero_requested = Resource()
         self.allocatable = Resource()
+        # priority-bucketed request sums (incl. a synthetic "pods" count per
+        # bucket): incremental source for the device class_req rows (batched
+        # preemption screen) so encode never rescans ni.pods
+        self.prio_requested: Dict[int, Dict[str, int]] = {}
         self.pvc_ref_counts: Dict[str, int] = {}
         self.image_states: Dict[str, int] = {}  # image name -> size bytes
         self.generation = next_generation()
@@ -159,6 +163,11 @@ class NodeInfo:
         self.requested.allowed_pod_number = 0  # pods tracked via len(self.pods)
         self.non_zero_requested.add(nonzero_request(req))
         self.non_zero_requested.allowed_pod_number = 0
+        bucket = self.prio_requested.setdefault(pod.spec.priority, {})
+        for r, v in req.items():
+            if r != resource_api.PODS:  # pods tracked as the +1 below
+                bucket[r] = bucket.get(r, 0) + v
+        bucket[resource_api.PODS] = bucket.get(resource_api.PODS, 0) + 1
         for p in pod.host_ports():
             self.used_ports.add((p.host_ip or "0.0.0.0", p.protocol, p.host_port))
         for claim in pod.spec.volumes:
@@ -180,6 +189,14 @@ class NodeInfo:
         req = pod.resource_request()
         self.requested.add(req, sign=-1)
         self.non_zero_requested.add(nonzero_request(req), sign=-1)
+        bucket = self.prio_requested.get(pod.spec.priority)
+        if bucket is not None:
+            for r, v in req.items():
+                if r != resource_api.PODS:
+                    bucket[r] = bucket.get(r, 0) - v
+            bucket[resource_api.PODS] = bucket.get(resource_api.PODS, 0) - 1
+            if bucket[resource_api.PODS] <= 0:
+                del self.prio_requested[pod.spec.priority]
         for p in pod.host_ports():
             self.used_ports.discard((p.host_ip or "0.0.0.0", p.protocol, p.host_port))
         for claim in pod.spec.volumes:
@@ -202,6 +219,7 @@ class NodeInfo:
         ni.requested = self.requested.clone()
         ni.non_zero_requested = self.non_zero_requested.clone()
         ni.allocatable = self.allocatable.clone()
+        ni.prio_requested = {p: dict(b) for p, b in self.prio_requested.items()}
         ni.pvc_ref_counts = dict(self.pvc_ref_counts)
         ni.image_states = dict(self.image_states)
         ni.generation = self.generation

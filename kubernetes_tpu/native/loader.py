@@ -42,6 +42,8 @@ def _build() -> bool:
 
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
+    if _lib is not None or _tried:  # lock-free fast path: set-once fields
+        return _lib
     with _lock:
         if _lib is not None or _tried:
             return _lib

@@ -260,6 +260,33 @@ class ClusterEncoder:
         row["image_bits"] = ibits
         return row
 
+    def encode_dynamic_fields(self, ni: NodeInfo) -> Dict[str, np.ndarray]:
+        """Row fields that pod commits change (requested/nonzero/ports/
+        class_req) — the reconcile hot path re-encodes ONLY these."""
+        row: Dict[str, np.ndarray] = {}
+        req = ni.requested.as_map()
+        req[resource_api.PODS] = len(ni.pods)
+        row["requested"] = self.resource_vec(req)
+        nzreq = ni.non_zero_requested.as_map()
+        nzreq[resource_api.PODS] = len(ni.pods)
+        row["nonzero_requested"] = self.resource_vec(nzreq)
+
+        pbits = np.zeros(self.caps.port_words, np.uint32)
+        for (ip, proto, port) in ni.used_ports:
+            for pid in (self.port_id(ip, proto, port), self.port_id("*", proto, port)):
+                pbits[pid >> 5] |= np.uint32(1 << (pid & 31))
+        row["port_bits"] = pbits
+
+        # priority-class-bucketed request sums (batched preemption screen),
+        # from NodeInfo's incremental buckets — O(distinct priorities), not
+        # O(pods on node) (this runs per dirty row on sync AND reconcile)
+        creq = np.zeros((self.caps.prio_classes, self.caps.resources), np.int32)
+        for prio, bucket in ni.prio_requested.items():
+            cid = self.prio_class_id(prio)
+            creq[cid] += self.resource_vec(bucket)
+        row["class_req"] = creq
+        return row
+
     def encode_node_row(self, ni: NodeInfo) -> Dict[str, np.ndarray]:
         """One NodeTensors row (no slot assignment here)."""
         node = ni.node
@@ -280,28 +307,7 @@ class ClusterEncoder:
         else:
             static = self._encode_static_fields(ni)
         row: Dict[str, np.ndarray] = dict(static)
-
-        req = ni.requested.as_map()
-        req[resource_api.PODS] = len(ni.pods)
-        row["requested"] = self.resource_vec(req)
-        nzreq = ni.non_zero_requested.as_map()
-        nzreq[resource_api.PODS] = len(ni.pods)
-        row["nonzero_requested"] = self.resource_vec(nzreq)
-
-        pbits = np.zeros(self.caps.port_words, np.uint32)
-        for (ip, proto, port) in ni.used_ports:
-            for pid in (self.port_id(ip, proto, port), self.port_id("*", proto, port)):
-                pbits[pid >> 5] |= np.uint32(1 << (pid & 31))
-        row["port_bits"] = pbits
-
-        # priority-class-bucketed request sums (batched preemption screen);
-        # per-pod request vectors come from the template cache — this runs on
-        # the sync/reconcile hot path for every dirty row
-        creq = np.zeros((self.caps.prio_classes, self.caps.resources), np.int32)
-        for p in ni.pods:
-            cid = self.prio_class_id(p.spec.priority)
-            creq[cid] += self._template_for(p).req
-        row["class_req"] = creq
+        row.update(self.encode_dynamic_fields(ni))
         return row
 
     def image_vocab_arrays(self, node_infos: Sequence[NodeInfo]) -> Tuple[np.ndarray, np.ndarray]:
@@ -429,7 +435,7 @@ class ClusterEncoder:
         caps = self.caps
         kb = _KeyBuilder()
 
-        r = pod.resource_request()
+        r = dict(pod.resource_request())  # copy: resource_request() is cached
         r[resource_api.PODS] = 1
         nz = nonzero_request(pod.resource_request())
         nz[resource_api.PODS] = 1

@@ -43,6 +43,21 @@ class TestServiceAccountAdmission:
         with pytest.raises(AdmissionError, match="service account"):
             store.create_pod(pod)
 
+    def test_pod_updates_survive_sa_deletion(self):
+        # SA existence is a CREATE check: deleting the SA must not brick
+        # status updates of the running pods that reference it
+        store = ClusterStore()
+        store.create_object(
+            "ServiceAccount", ServiceAccount(meta=ObjectMeta(name="builder")))
+        pod = make_pod("p").req({"cpu": "100m"}).obj()
+        pod.spec.service_account_name = "builder"
+        store.create_pod(pod)
+        store.delete_object("ServiceAccount", "default/builder")
+        up = store.get_pod(pod.key()).clone()
+        up.status.phase = "Succeeded"
+        store.update_pod(up)  # must not raise
+        assert store.get_pod(pod.key()).status.phase == "Succeeded"
+
     def test_existing_named_sa_accepted(self):
         store = ClusterStore()
         store.create_object(
@@ -88,6 +103,22 @@ class TestPodSecurity:
             capabilities_drop=("ALL",))
         store.create_pod(ok)
         assert store.get_pod(ok.key()) is not None
+
+    def test_status_update_survives_level_tightening(self):
+        # upstream exempts the status subresource: a pod admitted before the
+        # namespace's enforce level tightened must keep updating (kubelet
+        # phase writes) as long as its spec is unchanged
+        store = ClusterStore()
+        store.create_namespace(_ns("late"))
+        pod = make_pod("p", namespace="late").req({"cpu": "1"}).obj()
+        pod.spec.host_network = True
+        store.create_pod(pod)
+        ns = store.namespaces["late"]
+        ns.meta.labels[PS_ENFORCE_LABEL] = "restricted"
+        phase_up = store.get_pod(pod.key()).clone()
+        phase_up.status.phase = "Succeeded"
+        store.update_pod(phase_up)  # must not raise
+        assert store.get_pod(pod.key()).status.phase == "Succeeded"
 
     def test_restricted_enforced_on_update_too(self):
         store = self._store("restricted")
