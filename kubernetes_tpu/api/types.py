@@ -44,6 +44,10 @@ class ObjectMeta:
     resource_version: int = 0
     deletion_timestamp: float = 0.0  # >0 ⇒ terminating (metav1 DeletionTimestamp)
     owner_references: Tuple["OwnerReference", ...] = ()
+    # metav1 Finalizers: a delete with finalizers present only marks the
+    # object terminating; removal happens when the last finalizer is cleared
+    # (the pvc/pv-protection controllers' mechanism)
+    finalizers: Tuple[str, ...] = ()
 
     def key(self) -> str:
         return f"{self.namespace}/{self.name}"
@@ -321,6 +325,9 @@ class PodSpec:
     scheduler_name: str = "default-scheduler"
     overhead: Dict[str, object] = field(default_factory=dict)
     volumes: Tuple[str, ...] = ()  # PVC names (volume subsystem modeled by claim name)
+    # generic ephemeral volume names: the ephemeral-volume controller creates
+    # a PVC "<pod>-<name>" per entry, owned by the pod
+    ephemeral_claims: Tuple[str, ...] = ()
     service_account_name: str = ""
     host_network: bool = False
     host_pid: bool = False
@@ -405,6 +412,7 @@ class ContainerImage:
 class NodeSpec:
     unschedulable: bool = False
     taints: Tuple[Taint, ...] = ()
+    pod_cidr: str = ""  # allocated by the nodeipam controller
 
 
 @dataclass
@@ -531,6 +539,9 @@ class Job:
     condition: str = ""
     failed_reason: str = ""
     start_time: float = 0.0
+    completion_time: float = 0.0  # set when condition turns terminal
+    # ttl-after-finished controller: delete this long after completion
+    ttl_seconds_after_finished: Optional[int] = None
 
 
 @dataclass
@@ -714,6 +725,33 @@ class ServiceAccount:
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     automount_service_account_token: bool = True
+
+
+@dataclass
+class ConfigMap:
+    """core/v1 ConfigMap (the root-ca-cert-publisher controller's target)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class HorizontalPodAutoscaler:
+    """autoscaling/v2-shaped HPA, reduced to a cpu-utilization target over a
+    scale-target workload (pkg/controller/podautoscaler). The metrics-API
+    seam is ``ClusterStore.pod_metrics`` (pod key → milli-cpu usage)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    target_kind: str = "Deployment"   # scaleTargetRef
+    target_name: str = ""
+    min_replicas: int = 1
+    max_replicas: int = 10
+    # target average utilization: usage / per-pod cpu request, in percent
+    target_cpu_utilization: int = 80
+    # status
+    current_replicas: int = 0
+    desired_replicas: int = 0
+    last_scale_time: float = 0.0
 
 
 @dataclass

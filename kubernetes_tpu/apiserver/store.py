@@ -133,6 +133,11 @@ class ClusterStore:
         self.service_accounts: Dict[str, object] = {}
         self.mutating_webhooks: Dict[str, object] = {}
         self.validating_webhooks: Dict[str, object] = {}
+        self.config_maps: Dict[str, object] = {}
+        self.hpas: Dict[str, object] = {}
+        # metrics-API stand-in (metrics.k8s.io): pod key -> milli-cpu usage,
+        # fed by the hollow kubelet / tests, read by the HPA controller
+        self.pod_metrics: Dict[str, int] = {}
         # per-thread request identity (the authn layer's user info, set by
         # the HTTP front from the authenticated request; NodeRestriction and
         # OwnerReferencesPermissionEnforcement read it)
@@ -291,6 +296,8 @@ class ClusterStore:
                 "ServiceAccount": self.service_accounts,
                 "MutatingWebhookConfiguration": self.mutating_webhooks,
                 "ValidatingWebhookConfiguration": self.validating_webhooks,
+                "ConfigMap": self.config_maps,
+                "HorizontalPodAutoscaler": self.hpas,
             }[kind]
         except KeyError:
             raise NotFound(f"unknown kind {kind!r}") from None
@@ -469,19 +476,52 @@ class ClusterStore:
         def commit(old):
             if old is None:
                 raise NotFound(f"{kind} {key}")
+            if obj.meta.deletion_timestamp and not obj.meta.finalizers:
+                # last finalizer cleared on a terminating object: the update
+                # completes the delete (registry deleteCollection semantics)
+                m.pop(key, None)
+                self._journal_event(kind, DELETED, old, None)
+                commit.deleted = True
+                return
             self._bump(obj)
             m[key] = obj
             self._journal_event(kind, MODIFIED, old, obj)
 
+        commit.deleted = False
         old = self._guarded_update(kind, obj, lambda: m.get(key), commit)
-        self._notify(kind, MODIFIED, old, obj)
+        if commit.deleted:
+            self._notify(kind, DELETED, old, None)
+        else:
+            self._notify(kind, MODIFIED, old, obj)
 
     def delete_object(self, kind: str, key: str) -> None:
         m = self._kind_map(kind)
         with self._lock:
-            old = m.pop(key, None)
-            if old is not None:
-                self._journal_event(kind, DELETED, old, None)
+            cur = m.get(key)
+            if cur is not None and getattr(cur.meta, "finalizers", ()):
+                # finalizer gate (apiserver registry BeforeDelete): mark
+                # terminating; actual removal happens when the last
+                # finalizer is cleared via update_object
+                if not cur.meta.deletion_timestamp:
+                    import dataclasses as _dc
+                    import time as _time
+
+                    marked = _dc.replace(cur)
+                    marked.meta = _dc.replace(
+                        cur.meta, deletion_timestamp=_time.time())
+                    self._bump(marked)
+                    m[key] = marked
+                    self._journal_event(kind, MODIFIED, cur, marked)
+                else:
+                    marked = None
+                old = None
+            else:
+                marked = None
+                old = m.pop(key, None)
+                if old is not None:
+                    self._journal_event(kind, DELETED, old, None)
+        if marked is not None:
+            self._notify(kind, MODIFIED, cur, marked)
         if old is not None:
             self._notify(kind, DELETED, old, None)
 

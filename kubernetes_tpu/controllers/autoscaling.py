@@ -1,0 +1,126 @@
+"""horizontalpodautoscaling (pkg/controller/podautoscaler): scale a target
+workload by observed cpu utilization.
+
+The metrics API (metrics.k8s.io, normally served by metrics-server) is
+modeled as ``ClusterStore.pod_metrics`` — pod key → milli-cpu usage — fed by
+the hollow kubelet or tests. The scale subresource is modeled as writing the
+target workload's ``replicas`` field directly (Deployment/ReplicaSet/
+StatefulSet/ReplicationController all carry one).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional
+
+from ..api import resource as resource_api
+from ..api.types import HorizontalPodAutoscaler
+from ..apiserver.store import Conflict
+from .base import Controller
+from .workloads import _owned_pods
+
+# scale-down stabilization: skip shrinks within this window of the last scale
+# (podautoscaler's downscaleStabilisationWindow, default 5min)
+DOWNSCALE_STABILIZATION_S = 300.0
+# tolerance band around the target ratio (podautoscaler tolerance, 10%)
+TOLERANCE = 0.1
+
+
+class HorizontalPodAutoscalerController(Controller):
+    name = "horizontalpodautoscaling"
+    watch_kinds = ("HorizontalPodAutoscaler",)
+
+    def __init__(self, store, factory, now_fn=None):
+        import time as _time
+
+        super().__init__(store, factory)
+        self.now_fn = now_fn or _time.monotonic
+
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        return [obj.meta.key()]
+
+    def tick(self) -> None:
+        # metrics change without API events: re-evaluate every HPA per round
+        # (the reference's 15s resync loop)
+        for key in self.store.snapshot_map("HorizontalPodAutoscaler"):
+            self.queue.add(key)
+
+    def _utilization(self, pods):
+        """(mean usage/request percent, measured-pod count) over pods with
+        metrics+requests (replica_calculator.go GetResourceReplicas — the
+        scale basis is the number of pods actually measured, so a scale-up
+        that hasn't materialized pods yet doesn't compound)."""
+        ratios = []
+        for p in pods:
+            usage = self.store.pod_metrics.get(p.meta.key())
+            if usage is None:
+                continue
+            request = p.resource_request().get(resource_api.CPU, 0)
+            if request <= 0:
+                continue
+            ratios.append(100.0 * usage / request)
+        if not ratios:
+            return None, 0
+        return sum(ratios) / len(ratios), len(ratios)
+
+    def reconcile(self, key: str) -> None:
+        hpa: Optional[HorizontalPodAutoscaler] = self.store.get_object(
+            "HorizontalPodAutoscaler", key)
+        if hpa is None or not hpa.target_name:
+            return
+        target_key = f"{hpa.meta.namespace}/{hpa.target_name}"
+        target = self.store.get_object(hpa.target_kind, target_key)
+        if target is None:
+            return
+        if hpa.target_kind == "Deployment":
+            # pods hang off the deployment's ReplicaSets, one hop down
+            pods = []
+            for rs in self.store.snapshot_map("ReplicaSet").values():
+                ref = rs.meta.controller_of()
+                if (rs.meta.namespace == hpa.meta.namespace and ref is not None
+                        and ref.kind == "Deployment" and ref.name == hpa.target_name):
+                    pods.extend(_owned_pods(self.store, hpa.meta.namespace,
+                                            "ReplicaSet", rs.meta.name))
+        else:
+            pods = _owned_pods(self.store, hpa.meta.namespace, hpa.target_kind,
+                               hpa.target_name)
+        live = [p for p in pods if p.status.phase in ("Pending", "Running")]
+        current = target.replicas
+        util, measured = self._utilization(live)
+        if util is None:
+            desired = current  # no metrics: hold
+        else:
+            ratio = util / max(hpa.target_cpu_utilization, 1)
+            if abs(ratio - 1.0) <= TOLERANCE:
+                desired = current
+            elif ratio > 1.0:
+                # over target can only scale UP: pods without metrics must
+                # not shrink an overloaded workload (missing-metrics pods
+                # are treated conservatively, replica_calculator.go)
+                desired = max(current, math.ceil(measured * ratio))
+            else:
+                desired = min(current, math.ceil(measured * ratio))
+        desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
+        now = self.now_fn()
+        if desired < current and hpa.last_scale_time and (
+                now - hpa.last_scale_time < DOWNSCALE_STABILIZATION_S):
+            desired = current  # stabilization window
+        if desired != current:
+            new_target = dataclasses.replace(target, replicas=desired)
+            new_target.meta = dataclasses.replace(target.meta)
+            try:
+                self.store.update_object(hpa.target_kind, new_target)
+            except Conflict:
+                self.queue.add(key)
+                return
+        if (hpa.current_replicas != current or hpa.desired_replicas != desired
+                or desired != current):
+            new = dataclasses.replace(
+                hpa, current_replicas=desired, desired_replicas=desired,
+                last_scale_time=now if desired != current else hpa.last_scale_time)
+            new.meta = dataclasses.replace(hpa.meta)
+            try:
+                self.store.update_object("HorizontalPodAutoscaler", new)
+            except Conflict:
+                self.queue.add(key)

@@ -79,11 +79,20 @@ class EndpointSliceController(Controller):
             return [obj.meta.key()]
         return service_keys_for_pod(self.store, obj)
 
+    # slices owned by the mirroring controller are not this controller's
+    # (endpointslice controller skips managed-by != itself)
+    MIRROR_LABEL = "endpointslice.kubernetes.io/managed-by"
+
     def reconcile(self, key: str) -> None:
         svc: Optional[Service] = self.store.services.get(key)
         existing = {k: s for k, s in self.store.snapshot_map("EndpointSlice").items()
-                    if s.service == key}
+                    if s.service == key and not s.meta.labels.get(self.MIRROR_LABEL)}
         if svc is None:
+            for k in existing:
+                self.store.delete_object("EndpointSlice", k)
+            return
+        if not svc.selector:
+            # selector-less services are the mirroring controller's domain
             for k in existing:
                 self.store.delete_object("EndpointSlice", k)
             return

@@ -63,9 +63,11 @@ class GarbageCollector(Controller):
     orphan check; no finalizer machinery)."""
 
     name = "garbagecollector"
-    watch_kinds = ("Pod", "ReplicaSet", "StatefulSet", "Job", "Deployment", "DaemonSet")
+    watch_kinds = ("Pod", "ReplicaSet", "StatefulSet", "Job", "Deployment",
+                   "DaemonSet", "PersistentVolumeClaim")
 
-    DEPENDENT_KINDS = ("Pod", "ReplicaSet", "StatefulSet", "Job")
+    DEPENDENT_KINDS = ("Pod", "ReplicaSet", "StatefulSet", "Job",
+                       "PersistentVolumeClaim")
 
     def keys_for(self, kind: str, obj, event: str) -> List[str]:
         if event == "delete":
@@ -91,6 +93,7 @@ class GarbageCollector(Controller):
             "Deployment": lambda k: self.store.get_object("Deployment", k),
             "DaemonSet": lambda k: self.store.get_object("DaemonSet", k),
             "Job": lambda k: self.store.get_object("Job", k),
+            "Pod": self.store.get_pod,  # ephemeral PVCs are pod-owned
         }
         fn = lookups.get(kind)
         if fn is None:
@@ -118,10 +121,21 @@ class NamespaceController(Controller):
     contents (pods + workload objects + services) deleted, then is removed."""
 
     name = "namespace"
-    watch_kinds = ("Namespace",)
+    watch_kinds = ("Namespace", "PersistentVolumeClaim")
 
     def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        if kind == "PersistentVolumeClaim":
+            # a finalizer-protected PVC completing its delete may be the
+            # last thing holding a terminating namespace open
+            return [obj.meta.namespace] if event == "delete" else []
         return [obj.meta.name]
+
+    # namespaced kinds swept besides pods + workloads (the deletion
+    # discovery the reference does dynamically per API group)
+    SWEEP_KINDS = ("Service", "Endpoints", "EndpointSlice", "ServiceAccount",
+                   "ConfigMap", "HorizontalPodAutoscaler", "ResourceQuota",
+                   "LimitRange", "PodDisruptionBudget", "PersistentVolumeClaim",
+                   "CronJob")
 
     def reconcile(self, key: str) -> None:
         ns: Optional[Namespace] = self.store.namespaces.get(key)
@@ -134,9 +148,16 @@ class NamespaceController(Controller):
             for obj_key, obj in self.store.snapshot_map(kind).items():
                 if obj.meta.namespace == key:
                     self.store.delete_object(kind, obj_key)
-        for svc_key, svc in self.store.snapshot_map("Service").items():
-            if svc.meta.namespace == key:
-                self.store.delete_object("Service", svc_key)
+        for kind in self.SWEEP_KINDS:
+            for obj_key, obj in self.store.snapshot_map(kind).items():
+                if obj.meta.namespace == key:
+                    self.store.delete_object(kind, obj_key)
+        # finalizer-gated objects (protected PVCs) may survive the sweep as
+        # terminating: the namespace stays terminating until their deletes
+        # complete (keys_for maps PVC deletions back here)
+        if any(o.meta.namespace == key
+               for o in self.store.snapshot_map("PersistentVolumeClaim").values()):
+            return
         self.store.delete_object("Namespace", key)
 
 
@@ -176,16 +197,24 @@ class EndpointsController(Controller):
             return [obj.meta.key()]
         return service_keys_for_pod(self.store, obj)
 
+    MANAGED_LABEL = "endpoints.kubernetes.io/managed-by"
+
     def reconcile(self, key: str) -> None:
         svc: Optional[Service] = self.store.services.get(key)
-        if svc is None:
-            self.store.delete_object("Endpoints", key)
-            return
-        addrs = ready_addresses(self.store, svc)
         existing = self.store.get_object("Endpoints", key)
+        if svc is None:
+            # delete only controller-managed Endpoints; user-managed ones
+            # (selector-less services) are the mirroring controller's input
+            if existing is not None and existing.meta.labels.get(self.MANAGED_LABEL):
+                self.store.delete_object("Endpoints", key)
+            return
+        if not svc.selector:
+            return  # selector-less services keep their user-managed Endpoints
+        addrs = ready_addresses(self.store, svc)
         if existing is None:
             self.store.create_object("Endpoints", Endpoints(
-                meta=ObjectMeta(name=svc.meta.name, namespace=svc.meta.namespace),
+                meta=ObjectMeta(name=svc.meta.name, namespace=svc.meta.namespace,
+                                labels={self.MANAGED_LABEL: "endpoint-controller"}),
                 addresses=addrs,
             ))
         elif existing.addresses != addrs:

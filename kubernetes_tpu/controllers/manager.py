@@ -24,6 +24,17 @@ from .housekeeping import (
     PVBinderController,
     ResourceQuotaController,
 )
+from .autoscaling import HorizontalPodAutoscalerController
+from .auxiliary import (
+    EndpointSliceMirroringController,
+    EphemeralVolumeController,
+    NodeIpamController,
+    PVCProtectionController,
+    PVProtectionController,
+    RootCACertPublisher,
+    ServiceAccountController,
+    TTLAfterFinishedController,
+)
 from .disruption import DisruptionController
 from .extras import (
     AttachDetachController,
@@ -72,6 +83,18 @@ def new_controller_initializers() -> Dict[str, Initializer]:
             m.store, m.factory,
             now_fn=m.now_fn if m.now_fn is not time.monotonic else time.time),
         "attachdetach": lambda m: AttachDetachController(m.store, m.factory),
+        "serviceaccount": lambda m: ServiceAccountController(m.store, m.factory),
+        "root-ca-cert-publisher": lambda m: RootCACertPublisher(m.store, m.factory),
+        "ttlafterfinished": lambda m: TTLAfterFinishedController(
+            m.store, m.factory, now_fn=m.now_fn),
+        "pvcprotection": lambda m: PVCProtectionController(m.store, m.factory),
+        "pvprotection": lambda m: PVProtectionController(m.store, m.factory),
+        "nodeipam": lambda m: NodeIpamController(m.store, m.factory),
+        "endpointslicemirroring": lambda m: EndpointSliceMirroringController(
+            m.store, m.factory),
+        "ephemeral-volume": lambda m: EphemeralVolumeController(m.store, m.factory),
+        "horizontalpodautoscaling": lambda m: HorizontalPodAutoscalerController(
+            m.store, m.factory, now_fn=m.now_fn),
     }
 
 

@@ -421,7 +421,8 @@ class JobController(Controller):
         for p in pods:
             if p.status.phase in ("Pending", "Running"):
                 self.store.delete_pod(p.meta.key())
-        self._update(job, condition="Failed", failed_reason=reason)
+        self._update(job, condition="Failed", failed_reason=reason,
+                     completion_time=self.now_fn())
 
     def reconcile(self, key: str) -> None:
         job: Optional[Job] = self.store.get_object("Job", key)
@@ -445,7 +446,8 @@ class JobController(Controller):
             self._fail_job(job, pods, "BackoffLimitExceeded")
             return
         if succeeded >= job.completions:
-            self._update(job, condition="Complete")
+            self._update(job, condition="Complete",
+                         completion_time=self.now_fn())
             return
         want_active = min(job.parallelism, job.completions - succeeded)
         existing_names = {p.meta.name for p in pods}
