@@ -1,0 +1,278 @@
+"""Authn / authz / flow-control middleware for the HTTP apiserver front —
+the reference's DefaultBuildHandlerChain stages (apiserver pkg/server/
+config.go:806: authentication → authorization, flowcontrol APF in
+pkg/util/flowcontrol), reduced to the shapes this framework needs:
+
+- Authenticator: bearer-token map + authenticating-proxy headers
+  (X-Remote-User / X-Remote-Group) + optional anonymous.
+- RBACAuthorizer: ClusterRole/ClusterRoleBinding objects from the store
+  (data-driven, like rbac.authorization.k8s.io), with system:masters bypass.
+  Also satisfies the ``store.authorizer`` seam used by admission
+  (OwnerReferencesPermissionEnforcement).
+- FlowController: API Priority & Fairness analog — priority levels with
+  concurrency limits and bounded queues; a full queue rejects (HTTP 429),
+  matching APF's reject-when-queue-full behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.types import ObjectMeta
+
+ANONYMOUS = "system:anonymous"
+GROUP_UNAUTHENTICATED = "system:unauthenticated"
+GROUP_AUTHENTICATED = "system:authenticated"
+GROUP_MASTERS = "system:masters"
+
+
+@dataclasses.dataclass(frozen=True)
+class UserInfo:
+    name: str
+    groups: Tuple[str, ...] = ()
+
+
+class AuthenticationError(Exception):
+    """401: credentials presented and rejected."""
+
+
+class Authenticator:
+    """Union authenticator (apiserver pkg/authentication): bearer tokens,
+    authenticating-proxy headers, then anonymous."""
+
+    def __init__(self, tokens: Optional[Dict[str, UserInfo]] = None,
+                 allow_anonymous: bool = True,
+                 trust_proxy_headers: bool = True):
+        self.tokens = tokens or {}
+        self.allow_anonymous = allow_anonymous
+        self.trust_proxy_headers = trust_proxy_headers
+
+    def authenticate(self, headers) -> UserInfo:
+        authz = headers.get("Authorization", "")
+        if authz.startswith("Bearer "):
+            token = authz[len("Bearer "):].strip()
+            user = self.tokens.get(token)
+            if user is None:
+                raise AuthenticationError("invalid bearer token")
+            return UserInfo(user.name, tuple(user.groups) + (GROUP_AUTHENTICATED,))
+        if self.trust_proxy_headers:
+            name = headers.get("X-Remote-User", "")
+            if name:
+                groups = tuple(
+                    g.strip() for g in headers.get("X-Remote-Group", "").split(",")
+                    if g.strip())
+                return UserInfo(name, groups + (GROUP_AUTHENTICATED,))
+        if self.allow_anonymous:
+            return UserInfo(ANONYMOUS, (GROUP_UNAUTHENTICATED,))
+        raise AuthenticationError("no credentials")
+
+
+# --------------------------------------------------------------------- RBAC
+
+@dataclasses.dataclass
+class PolicyRule:
+    """rbac/v1 PolicyRule (verbs × resources × resourceNames; '*' wildcards)."""
+
+    verbs: Tuple[str, ...] = ("*",)
+    resources: Tuple[str, ...] = ("*",)       # kind names, e.g. "Pod"
+    resource_names: Tuple[str, ...] = ()      # () = any
+    subresources: Tuple[str, ...] = ("*",)    # e.g. "binding", "finalizers"
+
+    def matches(self, verb: str, kind: str, name: str, subresource: str) -> bool:
+        if "*" not in self.verbs and verb not in self.verbs:
+            return False
+        if "*" not in self.resources and kind not in self.resources:
+            return False
+        if self.resource_names and name not in self.resource_names:
+            return False
+        if subresource and "*" not in self.subresources \
+                and subresource not in self.subresources:
+            return False
+        return True
+
+
+@dataclasses.dataclass
+class ClusterRole:
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    rules: Tuple[PolicyRule, ...] = ()
+
+
+@dataclasses.dataclass
+class ClusterRoleBinding:
+    """rbac/v1 ClusterRoleBinding: subjects are "user:NAME" or "group:NAME"."""
+
+    meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
+    role: str = ""                    # ClusterRole name
+    subjects: Tuple[str, ...] = ()
+
+
+class RBACAuthorizer:
+    """Data-driven RBAC over the store's ClusterRole/ClusterRoleBinding maps
+    (plugin/pkg/auth/authorizer/rbac). system:masters always passes."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def _user_matches(self, subject: str, user: str, groups: Tuple[str, ...]) -> bool:
+        if subject.startswith("user:"):
+            return subject[5:] == user
+        if subject.startswith("group:"):
+            return subject[6:] in groups
+        return subject == user  # bare subject = user name
+
+    def allowed_for(self, user: str, groups: Tuple[str, ...], verb: str,
+                    kind: str, name: str = "", subresource: str = "") -> bool:
+        if GROUP_MASTERS in groups:
+            return True
+        for b in self.store.cluster_role_bindings.values():
+            if not any(self._user_matches(s, user, groups) for s in b.subjects):
+                continue
+            role = self.store.cluster_roles.get(b.role)
+            if role is None:
+                continue
+            for rule in role.rules:
+                if rule.matches(verb, kind, name, subresource):
+                    return True
+        return False
+
+    def allowed(self, user: str, verb: str, kind: str, name: str = "",
+                subresource: str = "") -> bool:
+        """store.authorizer seam (admission's blockOwnerDeletion check)."""
+        return self.allowed_for(user, (), verb, kind, name, subresource)
+
+
+# ---------------------------------------------------------------------- APF
+
+@dataclasses.dataclass
+class PriorityLevel:
+    """flowcontrol/v1beta2 PriorityLevelConfiguration, reduced: concurrency
+    shares become an absolute in-flight limit; a full queue rejects."""
+
+    name: str
+    concurrency: int = 4
+    queue_length: int = 16
+    exempt: bool = False
+
+
+@dataclasses.dataclass
+class FlowSchema:
+    """Maps (user, groups, verb) to a priority level, first match wins
+    (flowcontrol FlowSchema matchingPrecedence order)."""
+
+    name: str
+    level: str
+    users: Tuple[str, ...] = ()      # () = any
+    groups: Tuple[str, ...] = ()
+    verbs: Tuple[str, ...] = ()
+
+    def matches(self, user: str, groups: Tuple[str, ...], verb: str) -> bool:
+        if self.users and user not in self.users:
+            return False
+        if self.groups and not (set(self.groups) & set(groups)):
+            return False
+        if self.verbs and verb not in self.verbs:
+            return False
+        return True
+
+
+def default_flow_config() -> Tuple[List[PriorityLevel], List[FlowSchema]]:
+    """The reference's suggested configuration, reduced
+    (apf bootstrap configuration: exempt, system, workload-high,
+    global-default, catch-all)."""
+    levels = [
+        PriorityLevel("exempt", exempt=True),
+        PriorityLevel("system", concurrency=16, queue_length=64),
+        PriorityLevel("workload-high", concurrency=8, queue_length=32),
+        PriorityLevel("global-default", concurrency=4, queue_length=16),
+        PriorityLevel("catch-all", concurrency=2, queue_length=0),
+    ]
+    schemas = [
+        FlowSchema("exempt", "exempt", groups=(GROUP_MASTERS,)),
+        FlowSchema("system-nodes", "system", groups=("system:nodes",)),
+        FlowSchema("system-components", "system",
+                   users=("system:kube-scheduler", "system:kube-controller-manager")),
+        FlowSchema("watches", "exempt", verbs=("watch",)),  # long-lived streams
+        FlowSchema("global-default", "global-default",
+                   groups=(GROUP_AUTHENTICATED,)),
+        FlowSchema("catch-all", "catch-all"),
+    ]
+    return levels, schemas
+
+
+class FlowController:
+    """In-flight concurrency control per priority level. ``dispatch`` returns
+    a release callable, or None when the level's queue is full (→ 429).
+    Waiting requests block up to ``wait_timeout`` for a slot (the queueing
+    behavior APF models with fair queuing, collapsed to FIFO)."""
+
+    def __init__(self, levels: Optional[List[PriorityLevel]] = None,
+                 schemas: Optional[List[FlowSchema]] = None,
+                 wait_timeout: float = 5.0):
+        if levels is None or schemas is None:
+            levels, schemas = default_flow_config()
+        self.levels = {l.name: l for l in levels}
+        self.schemas = schemas
+        self.wait_timeout = wait_timeout
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._in_flight: Dict[str, int] = {l: 0 for l in self.levels}
+        self._queued: Dict[str, int] = {l: 0 for l in self.levels}
+        self.rejected_total: Dict[str, int] = {l: 0 for l in self.levels}
+        self.dispatched_total: Dict[str, int] = {l: 0 for l in self.levels}
+
+    def classify(self, user: str, groups: Tuple[str, ...], verb: str) -> str:
+        for s in self.schemas:
+            if s.matches(user, groups, verb) and s.level in self.levels:
+                return s.level
+        # unmatched traffic takes the LAST (lowest-priority, catch-all)
+        # level — never fail open into an exempt level
+        return list(self.levels)[-1]
+
+    def dispatch(self, user: str, groups: Tuple[str, ...], verb: str
+                 ) -> Optional[Callable[[], None]]:
+        level_name = self.classify(user, groups, verb)
+        level = self.levels[level_name]
+        if level.exempt:
+            self.dispatched_total[level_name] += 1
+            return lambda: None
+        deadline = None
+        with self._cond:
+            if self._in_flight[level_name] >= level.concurrency:
+                if self._queued[level_name] >= level.queue_length:
+                    self.rejected_total[level_name] += 1
+                    return None
+                self._queued[level_name] += 1
+                import time as _time
+
+                deadline = _time.monotonic() + self.wait_timeout
+                try:
+                    while self._in_flight[level_name] >= level.concurrency:
+                        remaining = deadline - _time.monotonic()
+                        if remaining <= 0 or not self._cond.wait(remaining):
+                            if self._in_flight[level_name] < level.concurrency:
+                                break
+                            self.rejected_total[level_name] += 1
+                            return None
+                finally:
+                    self._queued[level_name] -= 1
+            self._in_flight[level_name] += 1
+            self.dispatched_total[level_name] += 1
+
+        def release() -> None:
+            with self._cond:
+                self._in_flight[level_name] -= 1
+                self._cond.notify_all()
+
+        return release
+
+
+@dataclasses.dataclass
+class AuthConfig:
+    """The middleware bundle serve_api accepts; every field optional —
+    None disables that stage (matching the previous open server)."""
+
+    authenticator: Optional[Authenticator] = None
+    authorizer: Optional[RBACAuthorizer] = None
+    flow: Optional[FlowController] = None

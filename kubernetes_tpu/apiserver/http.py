@@ -17,8 +17,14 @@ pods/{name}/binding subresource the scheduler writes through
   DELETE .../pods/{name}                     DELETE
   POST   .../pods/{name}/binding             BIND
 
-No authn/authz/APF — the reference's handler-chain middleware is out of the
-north-star scope (SURVEY §2.4 lists it as environment here).
+The handler chain (config.go:806 DefaultBuildHandlerChain) runs
+authentication → flow control (APF) → authorization when serve_api is given
+an AuthConfig (apiserver/auth.py): bearer tokens / proxy headers resolve the
+user (401 on bad credentials), the FlowController bounds per-priority-level
+in-flight requests (429 when a level's queue is full), and the RBAC
+authorizer gates verb×kind (403). All three stages are optional — a bare
+serve_api() is the previous open server. The resolved user is pinned on the
+store for the request (NodeRestriction admission reads it).
 """
 
 from __future__ import annotations
@@ -89,10 +95,70 @@ def _route(path: str) -> Optional[Tuple[str, str, Optional[str], Optional[str], 
 
 class _Handler(BaseHTTPRequestHandler):
     store: ClusterStore = None  # bound by serve_api()
+    auth = None                 # Optional[AuthConfig], bound by serve_api()
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args):
         pass
+
+    # ------------------------------------------------- handler-chain middleware
+
+    _VERB_BY_METHOD = {"POST": "create", "PUT": "update", "DELETE": "delete"}
+
+    def _request_verb(self) -> str:
+        if self.command == "GET":
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            if q.get("watch", ["0"])[0] in ("1", "true"):
+                return "watch"
+            r = _route(url.path)
+            return "get" if (r is not None and r[3] is not None) else "list"
+        return self._VERB_BY_METHOD.get(self.command, "get")
+
+    def _gate(self):
+        """authn → flow control → authz. Returns a release callable to run
+        when the request finishes, or None if a response was already sent.
+        Gate failures close the connection: the request body may be undrained
+        on the socket, which would corrupt keep-alive reuse."""
+        from .auth import AuthenticationError
+
+        verb = self._request_verb()
+        user_name, groups = "system:admin", ()
+        cfg = self.auth
+        if cfg is not None and cfg.authenticator is not None:
+            try:
+                user = cfg.authenticator.authenticate(self.headers)
+            except AuthenticationError as e:
+                self.close_connection = True
+                self._error(401, "Unauthorized", str(e))
+                return None
+            user_name, groups = user.name, user.groups
+        elif self.headers.get("X-Remote-User"):
+            # no authenticator configured: trust the proxy header so the
+            # NodeRestriction admission seam still sees kubelet identities
+            user_name = self.headers["X-Remote-User"]
+        self.store.set_request_user(user_name)
+        release = lambda: None  # noqa: E731
+        if cfg is not None and cfg.flow is not None:
+            release = cfg.flow.dispatch(user_name, groups, verb)
+            if release is None:
+                self.close_connection = True
+                self._error(429, "TooManyRequests",
+                            "request rejected by priority-and-fairness")
+                return None
+        if cfg is not None and cfg.authorizer is not None:
+            r = _route(urlparse(self.path).path)
+            kind = r[1] if r is not None else ""
+            name = r[3] or "" if r is not None else ""
+            sub = r[4] or "" if r is not None else ""
+            if not cfg.authorizer.allowed_for(user_name, groups, verb, kind,
+                                              name, sub):
+                release()
+                self.close_connection = True
+                self._error(403, "Forbidden",
+                            f"user {user_name!r} cannot {verb} {kind}")
+                return None
+        return release
 
     # ------------------------------------------------------------- helpers
 
@@ -127,6 +193,15 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------- verbs
 
     def do_GET(self):  # noqa: N802
+        release = self._gate()
+        if release is None:
+            return
+        try:
+            return self._serve_get()
+        finally:
+            release()
+
+    def _serve_get(self):
         url = urlparse(self.path)
         r = _route(url.path)
         if r is None:
@@ -196,6 +271,15 @@ class _Handler(BaseHTTPRequestHandler):
                 pass
 
     def do_POST(self):  # noqa: N802
+        release = self._gate()
+        if release is None:
+            return
+        try:
+            return self._serve_post()
+        finally:
+            release()
+
+    def _serve_post(self):
         body = self._body()  # drain FIRST: keep-alive sockets must not carry leftovers
         r = _route(urlparse(self.path).path)
         if r is None:
@@ -230,6 +314,15 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send_json(201, self._obj_wire(kind, obj))
 
     def do_PUT(self):  # noqa: N802
+        release = self._gate()
+        if release is None:
+            return
+        try:
+            return self._serve_put()
+        finally:
+            release()
+
+    def _serve_put(self):
         body = self._body()  # drain first (keep-alive)
         r = _route(urlparse(self.path).path)
         if r is None or r[3] is None:
@@ -249,9 +342,22 @@ class _Handler(BaseHTTPRequestHandler):
             self.store.update_object(kind, obj)
         except NotFound as e:
             return self._error(404, "NotFound", str(e))
+        except Conflict as e:
+            return self._error(409, "Conflict", str(e))
+        except AdmissionError as e:
+            return self._error(403, "Forbidden", str(e))
         return self._send_json(200, self._obj_wire(kind, obj))
 
     def do_DELETE(self):  # noqa: N802
+        release = self._gate()
+        if release is None:
+            return
+        try:
+            return self._serve_delete()
+        finally:
+            release()
+
+    def _serve_delete(self):
         self._body()  # drain DeleteOptions bodies (keep-alive invariant)
         r = _route(urlparse(self.path).path)
         if r is None or r[3] is None:
@@ -270,9 +376,11 @@ class _Handler(BaseHTTPRequestHandler):
         return self._send_json(200, {"kind": "Status", "status": "Success"})
 
 
-def serve_api(store: ClusterStore, port: int = 0):
-    """Serve the REST+watch API on localhost; returns (server, port)."""
-    handler = type("BoundAPIHandler", (_Handler,), {"store": store})
+def serve_api(store: ClusterStore, port: int = 0, auth=None):
+    """Serve the REST+watch API on localhost; returns (server, port).
+    ``auth`` is an optional apiserver.auth.AuthConfig enabling the
+    authn/flow-control/authz handler chain."""
+    handler = type("BoundAPIHandler", (_Handler,), {"store": store, "auth": auth})
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
     server.__shutdown_request__ = False
     t = threading.Thread(target=server.serve_forever, daemon=True)

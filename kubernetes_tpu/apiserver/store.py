@@ -135,6 +135,8 @@ class ClusterStore:
         self.validating_webhooks: Dict[str, object] = {}
         self.config_maps: Dict[str, object] = {}
         self.hpas: Dict[str, object] = {}
+        self.cluster_roles: Dict[str, object] = {}
+        self.cluster_role_bindings: Dict[str, object] = {}
         # metrics-API stand-in (metrics.k8s.io): pod key -> milli-cpu usage,
         # fed by the hollow kubelet / tests, read by the HPA controller
         self.pod_metrics: Dict[str, int] = {}
@@ -298,6 +300,8 @@ class ClusterStore:
                 "ValidatingWebhookConfiguration": self.validating_webhooks,
                 "ConfigMap": self.config_maps,
                 "HorizontalPodAutoscaler": self.hpas,
+                "ClusterRole": self.cluster_roles,
+                "ClusterRoleBinding": self.cluster_role_bindings,
             }[kind]
         except KeyError:
             raise NotFound(f"unknown kind {kind!r}") from None
@@ -447,6 +451,7 @@ class ClusterStore:
         "Node", "Namespace", "PersistentVolume", "StorageClass", "CSINode",
         "PriorityClass", "VolumeAttachment",
         "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
+        "ClusterRole", "ClusterRoleBinding",
     }
 
     def _key_of(self, kind: str, obj) -> str:
@@ -476,6 +481,13 @@ class ClusterStore:
         def commit(old):
             if old is None:
                 raise NotFound(f"{kind} {key}")
+            # deletionTimestamp is SERVER-owned (metav1 semantics): an update
+            # can neither delete a live object nor resurrect a terminating
+            # one — only delete_object sets the marker. Exception: kinds our
+            # controllers mark terminating in-process (Namespace) keep the
+            # client value.
+            if kind != "Namespace":
+                obj.meta.deletion_timestamp = old.meta.deletion_timestamp
             if obj.meta.deletion_timestamp and not obj.meta.finalizers:
                 # last finalizer cleared on a terminating object: the update
                 # completes the delete (registry deleteCollection semantics)

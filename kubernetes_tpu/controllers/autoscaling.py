@@ -31,20 +31,32 @@ class HorizontalPodAutoscalerController(Controller):
     name = "horizontalpodautoscaling"
     watch_kinds = ("HorizontalPodAutoscaler",)
 
+    def keys_for(self, kind: str, obj, event: str) -> List[str]:
+        return [obj.meta.key()]
+
     def __init__(self, store, factory, now_fn=None):
         import time as _time
 
         super().__init__(store, factory)
         self.now_fn = now_fn or _time.monotonic
-
-    def keys_for(self, kind: str, obj, event: str) -> List[str]:
-        return [obj.meta.key()]
+        self._last_seen: dict = {}   # hpa key -> input fingerprint
+        self._held_until: dict = {}  # hpa key -> when a held scale-down re-evaluates
 
     def tick(self) -> None:
-        # metrics change without API events: re-evaluate every HPA per round
-        # (the reference's 15s resync loop)
-        for key in self.store.snapshot_map("HorizontalPodAutoscaler"):
-            self.queue.add(key)
+        # metrics change without API events: re-evaluate an HPA when its
+        # INPUTS changed (metrics / target replicas) — an unconditional
+        # re-enqueue would keep settle() from ever converging
+        for key, hpa in self.store.snapshot_map("HorizontalPodAutoscaler").items():
+            target = self.store.get_object(
+                hpa.target_kind, f"{hpa.meta.namespace}/{hpa.target_name}")
+            fp = (target.replicas if target is not None else -1,
+                  tuple(sorted(self.store.pod_metrics.items())))
+            if self._last_seen.get(key) != fp:
+                self._last_seen[key] = fp
+                self.queue.add(key)
+            elif key in self._held_until and self.now_fn() >= self._held_until[key]:
+                del self._held_until[key]  # stabilization window expired
+                self.queue.add(key)
 
     def _utilization(self, pods):
         """(mean usage/request percent, measured-pod count) over pods with
@@ -100,12 +112,20 @@ class HorizontalPodAutoscalerController(Controller):
                 # are treated conservatively, replica_calculator.go)
                 desired = max(current, math.ceil(measured * ratio))
             else:
-                desired = min(current, math.ceil(measured * ratio))
+                # conservative scale-down: each unmeasured pod is assumed to
+                # run AT target (counts 1:1), so missing metrics alone never
+                # shrink the workload (replica_calculator.go missing-pods
+                # assumption on scale-down)
+                unmeasured = max(0, len(live) - measured)
+                desired = min(current, math.ceil(measured * ratio) + unmeasured)
         desired = max(hpa.min_replicas, min(hpa.max_replicas, desired))
         now = self.now_fn()
         if desired < current and hpa.last_scale_time and (
                 now - hpa.last_scale_time < DOWNSCALE_STABILIZATION_S):
-            desired = current  # stabilization window
+            # stabilization window: hold, and have tick() re-evaluate once
+            # the window expires (time is an input the fingerprint can't see)
+            self._held_until[key] = hpa.last_scale_time + DOWNSCALE_STABILIZATION_S
+            desired = current
         if desired != current:
             new_target = dataclasses.replace(target, replicas=desired)
             new_target.meta = dataclasses.replace(target.meta)
@@ -114,10 +134,11 @@ class HorizontalPodAutoscalerController(Controller):
             except Conflict:
                 self.queue.add(key)
                 return
-        if (hpa.current_replicas != current or hpa.desired_replicas != desired
+        observed = len(live)  # status reflects what exists, not what's wanted
+        if (hpa.current_replicas != observed or hpa.desired_replicas != desired
                 or desired != current):
             new = dataclasses.replace(
-                hpa, current_replicas=desired, desired_replicas=desired,
+                hpa, current_replicas=observed, desired_replicas=desired,
                 last_scale_time=now if desired != current else hpa.last_scale_time)
             new.meta = dataclasses.replace(hpa.meta)
             try:
