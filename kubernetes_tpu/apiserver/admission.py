@@ -534,8 +534,16 @@ class OwnerReferencesPermissionEnforcement(AdmissionPlugin):
             if (r.kind, r.name) in old_blocking:
                 continue  # pre-existing blocks are not re-checked
             user = store.request_user()
-            if not store.authorizer.allowed(user, "update", r.kind, r.name,
-                                            subresource="finalizers"):
+            # prefer the group-aware check when the authorizer offers one
+            # (RBAC group bindings + system:masters must count here too)
+            check = getattr(store.authorizer, "allowed_for", None)
+            if check is not None:
+                ok = check(user, store.request_groups(), "update", r.kind,
+                           r.name, subresource="finalizers")
+            else:
+                ok = store.authorizer.allowed(user, "update", r.kind, r.name,
+                                              subresource="finalizers")
+            if not ok:
                 raise AdmissionError(
                     self.name,
                     f"user {user!r} may not set blockOwnerDeletion on "

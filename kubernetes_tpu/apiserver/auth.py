@@ -44,9 +44,16 @@ class Authenticator:
 
     def __init__(self, tokens: Optional[Dict[str, UserInfo]] = None,
                  allow_anonymous: bool = True,
-                 trust_proxy_headers: bool = True):
+                 trust_proxy_headers: Optional[bool] = None):
         self.tokens = tokens or {}
         self.allow_anonymous = allow_anonymous
+        # X-Remote-* headers assert an identity the server cannot verify —
+        # the reference only trusts them from a cert-verified front proxy.
+        # Default: trust them ONLY when no token auth is configured (the
+        # trusted-sidecar topology); with tokens present, an explicit opt-in
+        # is required, else any client could spoof system:masters.
+        if trust_proxy_headers is None:
+            trust_proxy_headers = not self.tokens
         self.trust_proxy_headers = trust_proxy_headers
 
     def authenticate(self, headers) -> UserInfo:

@@ -137,7 +137,7 @@ class _Handler(BaseHTTPRequestHandler):
             # no authenticator configured: trust the proxy header so the
             # NodeRestriction admission seam still sees kubelet identities
             user_name = self.headers["X-Remote-User"]
-        self.store.set_request_user(user_name)
+        self.store.set_request_user(user_name, groups)
         release = lambda: None  # noqa: E731
         if cfg is not None and cfg.flow is not None:
             release = cfg.flow.dispatch(user_name, groups, verb)
@@ -185,6 +185,27 @@ class _Handler(BaseHTTPRequestHandler):
         d = to_wire(obj)
         d["kind"] = kind
         return d
+
+    def _decode_body(self, kind: str, body: dict):
+        """Two wire dialects on the write path: a body carrying apiVersion +
+        metadata is a REFERENCE-shaped manifest decoded through the
+        versioned scheme (api/scheme.py); otherwise it is this framework's
+        snake_case reflection format."""
+        if "apiVersion" in body and "metadata" in body:
+            from ..api.scheme import SchemeError, default_scheme
+
+            scheme = default_scheme()
+            try:
+                obj = scheme.decode(dict(body, kind=body.get("kind") or kind))
+            except SchemeError:
+                obj = None  # not a registered external version: reflection format
+            if obj is not None:
+                if not isinstance(obj, _KIND_TYPES[kind]):
+                    raise ValueError(
+                        f"body kind {type(obj).__name__} does not match "
+                        f"path resource {kind}")
+                return obj
+        return from_wire(_KIND_TYPES[kind], body)
 
     def _match(self, kind: str, ns: Optional[str], obj) -> bool:
         return ns is None or kind in self.store.CLUSTER_SCOPED_KINDS \
@@ -300,7 +321,7 @@ class _Handler(BaseHTTPRequestHandler):
         if name is not None:
             return self._error(405, "MethodNotAllowed", "POST to a named resource")
         try:
-            obj = from_wire(_KIND_TYPES[kind], body)
+            obj = self._decode_body(kind, body)
         except Exception as e:  # noqa: BLE001 — malformed body is a 400
             return self._error(400, "BadRequest", f"decode: {e}")
         if ns is not None and kind not in self.store.CLUSTER_SCOPED_KINDS:
@@ -329,7 +350,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(404, "NotFound", "unknown path")
         _g, kind, ns, name, _sub = r
         try:
-            obj = from_wire(_KIND_TYPES[kind], body)
+            obj = self._decode_body(kind, body)
         except Exception as e:  # noqa: BLE001
             return self._error(400, "BadRequest", f"decode: {e}")
         if obj.meta.name and obj.meta.name != name:
@@ -381,6 +402,10 @@ def serve_api(store: ClusterStore, port: int = 0, auth=None):
     ``auth`` is an optional apiserver.auth.AuthConfig enabling the
     authn/flow-control/authz handler chain."""
     handler = type("BoundAPIHandler", (_Handler,), {"store": store, "auth": auth})
+    if auth is not None and auth.authorizer is not None and store.authorizer is None:
+        # the admission seam (OwnerReferencesPermissionEnforcement) shares
+        # the HTTP layer's authorizer
+        store.authorizer = auth.authorizer
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
     server.__shutdown_request__ = False
     t = threading.Thread(target=server.serve_forever, daemon=True)

@@ -216,8 +216,12 @@ class ClusterStore:
     def request_user(self) -> str:
         return getattr(self._request_user, "name", "") or "system:admin"
 
-    def set_request_user(self, name: str) -> None:
+    def request_groups(self) -> tuple:
+        return getattr(self._request_user, "groups", ())
+
+    def set_request_user(self, name: str, groups: tuple = ()) -> None:
         self._request_user.name = name
+        self._request_user.groups = tuple(groups)
 
     def as_user(self, name: str):
         """Context manager: run store writes as ``name`` on this thread."""

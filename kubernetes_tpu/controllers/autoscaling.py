@@ -44,13 +44,20 @@ class HorizontalPodAutoscalerController(Controller):
 
     def tick(self) -> None:
         # metrics change without API events: re-evaluate an HPA when its
-        # INPUTS changed (metrics / target replicas) — an unconditional
-        # re-enqueue would keep settle() from ever converging
-        for key, hpa in self.store.snapshot_map("HorizontalPodAutoscaler").items():
+        # INPUTS changed (metrics / target replicas / the live pod set) —
+        # an unconditional re-enqueue would keep settle() from converging
+        hpas = self.store.snapshot_map("HorizontalPodAutoscaler")
+        for stale in set(self._last_seen) - set(hpas):
+            self._last_seen.pop(stale, None)  # deleted HPAs: no leak
+            self._held_until.pop(stale, None)
+        pods_fp = tuple(sorted(
+            (p.meta.key(), p.status.phase)
+            for p in self.store.snapshot_map("Pod").values()))
+        for key, hpa in hpas.items():
             target = self.store.get_object(
                 hpa.target_kind, f"{hpa.meta.namespace}/{hpa.target_name}")
             fp = (target.replicas if target is not None else -1,
-                  tuple(sorted(self.store.pod_metrics.items())))
+                  tuple(sorted(self.store.pod_metrics.items())), pods_fp)
             if self._last_seen.get(key) != fp:
                 self._last_seen[key] = fp
                 self.queue.add(key)

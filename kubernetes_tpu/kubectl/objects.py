@@ -101,7 +101,12 @@ def from_manifest(doc: dict) -> Tuple[str, object]:
     meta = _meta(doc)
     spec = doc.get("spec", {}) or {}
     if kind == "Pod":
-        return kind, _pod_from_spec(meta.name, meta.namespace, doc.get("metadata", {}) or {}, spec)
+        # full-fidelity core/v1 decode (affinity, spread, security context,
+        # ephemeral volumes) through the scheme (api/scheme.py)
+        from ..api import corev1
+        from ..api.scheme import default_scheme
+
+        return kind, default_scheme().default(corev1.pod_from(doc))
     if kind == "Node":
         nw = make_node(meta.name)
         for k, v in meta.labels.items():

@@ -1,0 +1,264 @@
+"""runtime.Scheme analog + core/v1 wire codecs (apimachinery
+pkg/runtime/scheme.go:46, serializer/): reference-shaped camelCase
+manifests decode to internal dataclasses, internal objects encode back,
+defaulters run on decode, unknown GVKs error."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.api import corev1
+from kubernetes_tpu.api.scheme import (
+    GroupVersionKind,
+    Scheme,
+    SchemeError,
+    default_scheme,
+)
+from kubernetes_tpu.api.types import Pod
+
+POD_MANIFEST = {
+    "apiVersion": "v1",
+    "kind": "Pod",
+    "metadata": {
+        "name": "web-0", "namespace": "prod",
+        "labels": {"app": "web"},
+        "annotations": {"team": "infra"},
+    },
+    "spec": {
+        "containers": [{
+            "name": "app", "image": "nginx:1.25",
+            "resources": {"requests": {"cpu": "500m", "memory": "1Gi"},
+                          "limits": {"cpu": "1", "memory": "2Gi"}},
+            "ports": [{"hostPort": 8080, "containerPort": 80, "protocol": "TCP"}],
+            "securityContext": {"runAsNonRoot": True,
+                                "allowPrivilegeEscalation": False,
+                                "capabilities": {"drop": ["ALL"]}},
+        }],
+        "nodeSelector": {"disktype": "ssd"},
+        "priorityClassName": "high",
+        "schedulerName": "default-scheduler",
+        "serviceAccountName": "web",
+        "tolerations": [{"key": "dedicated", "operator": "Equal",
+                         "value": "web", "effect": "NoSchedule"}],
+        "topologySpreadConstraints": [{
+            "maxSkew": 1, "topologyKey": "topology.kubernetes.io/zone",
+            "whenUnsatisfiable": "DoNotSchedule",
+            "labelSelector": {"matchLabels": {"app": "web"}},
+        }],
+        "affinity": {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{
+                        "matchExpressions": [{"key": "zone", "operator": "In",
+                                              "values": ["z1", "z2"]}]}]},
+                "preferredDuringSchedulingIgnoredDuringExecution": [{
+                    "weight": 10,
+                    "preference": {"matchExpressions": [
+                        {"key": "disk", "operator": "In", "values": ["ssd"]}]}}],
+            },
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "labelSelector": {"matchLabels": {"app": "web"}},
+                    "topologyKey": "kubernetes.io/hostname"}],
+            },
+        },
+        "volumes": [
+            {"name": "data", "persistentVolumeClaim": {"claimName": "web-data"}},
+            {"name": "scratch", "ephemeral": {"volumeClaimTemplate": {}}},
+        ],
+    },
+}
+
+
+class TestPodRoundTrip:
+    def test_decode_full_manifest(self):
+        pod = default_scheme().decode(json.dumps(POD_MANIFEST))
+        assert isinstance(pod, Pod)
+        assert pod.meta.name == "web-0" and pod.meta.namespace == "prod"
+        c = pod.spec.containers[0]
+        assert c.requests["cpu"] == "500m" and c.limits["memory"] == "2Gi"
+        assert c.ports[0].host_port == 8080
+        assert c.security_context.run_as_non_root is True
+        assert "ALL" in c.security_context.capabilities_drop
+        assert pod.spec.node_selector == {"disktype": "ssd"}
+        assert pod.spec.priority_class_name == "high"
+        assert pod.spec.service_account_name == "web"
+        assert pod.spec.tolerations[0].key == "dedicated"
+        tsc = pod.spec.topology_spread_constraints[0]
+        assert tsc.max_skew == 1 and tsc.label_selector.match_labels == {"app": "web"}
+        na = pod.spec.affinity.node_affinity
+        assert na.required.terms[0].match_expressions[0].values == ("z1", "z2")
+        assert na.preferred[0].weight == 10
+        anti = pod.spec.affinity.pod_anti_affinity
+        assert anti.required[0].topology_key == "kubernetes.io/hostname"
+        assert pod.spec.volumes == ("web-data",)
+        assert pod.spec.ephemeral_claims == ("scratch",)
+
+    def test_encode_round_trip(self):
+        scheme = default_scheme()
+        pod = scheme.decode(json.dumps(POD_MANIFEST))
+        wire = scheme.encode(pod)
+        assert wire["apiVersion"] == "v1" and wire["kind"] == "Pod"
+        pod2 = scheme.decode(json.dumps(wire))
+        assert pod2.spec.node_selector == pod.spec.node_selector
+        assert pod2.spec.tolerations == pod.spec.tolerations
+        assert pod2.spec.topology_spread_constraints == \
+            pod.spec.topology_spread_constraints
+        assert corev1.affinity_to(pod2.spec.affinity) == \
+            corev1.affinity_to(pod.spec.affinity)
+        assert pod2.spec.volumes == pod.spec.volumes
+
+    def test_defaulter_limits_become_requests(self):
+        doc = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p"},
+               "spec": {"containers": [{
+                   "name": "c", "image": "x",
+                   "resources": {"limits": {"cpu": "2"}}}]}}
+        pod = default_scheme().decode(json.dumps(doc))
+        assert pod.spec.containers[0].requests["cpu"] == "2"
+        assert pod.resource_request()["cpu"] == 2000
+
+
+class TestNodeRoundTrip:
+    def test_node_manifest(self):
+        doc = {"apiVersion": "v1", "kind": "Node",
+               "metadata": {"name": "n1", "labels": {"zone": "z1"}},
+               "spec": {"taints": [{"key": "gpu", "effect": "NoSchedule"}],
+                        "podCIDR": "10.0.3.0/24"},
+               "status": {"capacity": {"cpu": "8", "memory": "32Gi"},
+                          "allocatable": {"cpu": "7500m", "memory": "30Gi"},
+                          "conditions": [{"type": "Ready", "status": "True"}],
+                          "images": [{"names": ["nginx:1.25"],
+                                      "sizeBytes": 1000000}]}}
+        node = default_scheme().decode(json.dumps(doc))
+        assert node.spec.taints[0].key == "gpu"
+        assert node.spec.pod_cidr == "10.0.3.0/24"
+        assert node.status.allocatable["cpu"] == "7500m"
+        assert node.status.images[0].size_bytes == 1000000
+        wire = default_scheme().encode(node)
+        node2 = default_scheme().decode(json.dumps(wire))
+        assert node2.status.allocatable == node.status.allocatable
+        assert node2.spec.taints == node.spec.taints
+
+    def test_not_ready_condition(self):
+        doc = {"apiVersion": "v1", "kind": "Node", "metadata": {"name": "n"},
+               "status": {"conditions": [{"type": "Ready", "status": "False"}]}}
+        node = default_scheme().decode(json.dumps(doc))
+        assert node.status.ready is False
+
+
+class TestOtherKinds:
+    def test_pdb_and_priority_class(self):
+        scheme = default_scheme()
+        pdb = scheme.decode(json.dumps({
+            "apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
+            "metadata": {"name": "pdb"},
+            "spec": {"minAvailable": "50%",
+                     "selector": {"matchLabels": {"app": "web"}}}}))
+        assert pdb.min_available == "50%"
+        pc = scheme.decode(json.dumps({
+            "apiVersion": "scheduling.k8s.io/v1", "kind": "PriorityClass",
+            "metadata": {"name": "high"}, "value": 1000}))
+        assert pc.value == 1000
+
+    def test_deployment_with_template(self):
+        dep = default_scheme().decode(json.dumps({
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "namespace": "prod"},
+            "spec": {"replicas": 3,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {
+                         "metadata": {"labels": {"app": "web"}},
+                         "spec": {"containers": [{
+                             "name": "c", "image": "nginx",
+                             "resources": {"requests": {"cpu": "100m"}}}]}},
+                     "strategy": {"type": "RollingUpdate",
+                                  "rollingUpdate": {"maxSurge": 2,
+                                                    "maxUnavailable": 0}}}}))
+        assert dep.replicas == 3 and dep.max_surge == 2 and dep.max_unavailable == 0
+        assert dep.template.meta.labels == {"app": "web"}
+        assert dep.template.spec.containers[0].requests["cpu"] == "100m"
+
+    def test_hpa_v2(self):
+        hpa = default_scheme().decode(json.dumps({
+            "apiVersion": "autoscaling/v2", "kind": "HorizontalPodAutoscaler",
+            "metadata": {"name": "web"},
+            "spec": {"scaleTargetRef": {"kind": "Deployment", "name": "web"},
+                     "minReplicas": 2, "maxReplicas": 20,
+                     "metrics": [{"type": "Resource", "resource": {
+                         "name": "cpu",
+                         "target": {"type": "Utilization",
+                                    "averageUtilization": 60}}}]}}))
+        assert hpa.max_replicas == 20 and hpa.target_cpu_utilization == 60
+
+
+class TestSchemeMachinery:
+    def test_unknown_gvk_errors(self):
+        with pytest.raises(SchemeError, match="no kind registered"):
+            default_scheme().decode(json.dumps(
+                {"apiVersion": "example.com/v1", "kind": "Widget"}))
+
+    def test_missing_kind_errors(self):
+        with pytest.raises(SchemeError, match="missing kind"):
+            default_scheme().decode(json.dumps({"apiVersion": "v1"}))
+
+    def test_encode_wrong_type_errors(self):
+        with pytest.raises(SchemeError):
+            default_scheme().encode(
+                Pod(), GroupVersionKind("", "v1", "Node"))
+
+    def test_custom_registration(self):
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Widget:
+            name: str = ""
+
+        s = Scheme()
+        gvk = GroupVersionKind("example.com", "v1", "Widget")
+        s.add_known_type(gvk, Widget,
+                         lambda d: Widget(name=d.get("spec", {}).get("name", "")),
+                         lambda w: {"spec": {"name": w.name}})
+        s.add_defaulter(Widget, lambda w: setattr(
+            w, "name", w.name or "unnamed"))
+        w = s.decode(json.dumps({"apiVersion": "example.com/v1",
+                                 "kind": "Widget", "spec": {}}))
+        assert w.name == "unnamed"
+        assert s.encode(w)["spec"]["name"] == "unnamed"
+
+
+class TestHTTPManifestIngestion:
+    def test_post_k8s_manifest_over_http(self):
+        import urllib.request
+
+        from kubernetes_tpu.apiserver.http import serve_api, shutdown_api
+        from kubernetes_tpu.apiserver.store import ClusterStore
+
+        store = ClusterStore()
+        server, port = serve_api(store)
+        try:
+            body = json.dumps(POD_MANIFEST).encode()
+            # the manifest names namespace prod; create it first
+            from kubernetes_tpu.api.types import Namespace, ObjectMeta
+
+            store.create_namespace(Namespace(meta=ObjectMeta(name="prod")))
+            from kubernetes_tpu.api.types import PriorityClass
+
+            store.create_priority_class(PriorityClass(
+                meta=ObjectMeta(name="high"), value=1000))
+            store.create_object("ServiceAccount", __import__(
+                "kubernetes_tpu.api.types", fromlist=["ServiceAccount"]
+            ).ServiceAccount(meta=ObjectMeta(name="web", namespace="prod")))
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/api/v1/namespaces/prod/pods",
+                data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                assert resp.status == 201
+            pod = store.get_pod("prod/web-0")
+            assert pod is not None
+            assert pod.spec.affinity.node_affinity.required is not None
+            assert pod.spec.topology_spread_constraints[0].topology_key == \
+                "topology.kubernetes.io/zone"
+        finally:
+            shutdown_api(server)
