@@ -203,18 +203,19 @@ def run_matrix_child(name: str) -> None:
     print(json.dumps(entry))
 
 
-def run_wire(n_nodes=1000, n_init=200, n_measured=500):
+def run_wire(n_nodes=1000, n_init=200, n_measured=500, backend="wire"):
     """Transport-inclusive row: the batched device service behind a real
-    localhost HTTP socket (SURVEY §5.8 hop 6) — the serialization + wire
-    cost the in-process number does not pay."""
-    entry = {"transport": "wire"}
+    localhost socket (SURVEY §5.8 hop 6) — the serialization + wire cost the
+    in-process number does not pay. backend="wire" is HTTP/JSON;
+    backend="grpc" is the hardened gRPC + template-dedup transport."""
+    entry = {"transport": backend}
     try:
         from kubernetes_tpu.perf.harness import run_workload
         from kubernetes_tpu.perf.workloads import scheduling_basic
 
         items = run_workload(
             scheduling_basic(nodes=n_nodes, init_pods=n_init, measured=n_measured),
-            backend="wire")
+            backend=backend)
         for it in items:
             if it.labels.get("Name") == "SchedulingThroughput":
                 entry["pods_per_s"] = round(it.data["Average"], 2)
@@ -330,6 +331,7 @@ def main():
             record["pallas_hw"] = run_pallas_check()
         if os.environ.get("BENCH_WIRE", "1") != "0":
             record["wire"] = run_wire(min(n_nodes, 1000))
+            record["wire_grpc"] = run_wire(min(n_nodes, 1000), backend="grpc")
         if os.environ.get("BENCH_MATRIX", "1") != "0":
             record["workloads"] = run_matrix(budget_deadline, platform)
     except Exception as exc:  # noqa: BLE001 — a number must always be emitted

@@ -448,10 +448,6 @@ def node_to(node: t.Node) -> dict:
 # ------------------------------------------------------------- other kinds
 
 
-def _simple(kind_builder, kind_encoder):
-    return kind_builder, kind_encoder
-
-
 def namespace_from(doc: dict) -> t.Namespace:
     return t.Namespace(meta=meta_from(doc.get("metadata") or {}))
 
@@ -569,19 +565,34 @@ def _pod_template_to(tpl: Optional[t.Pod]) -> Optional[dict]:
             "spec": d["spec"]}
 
 
+def _int_or_percent(v, total: int, default: int, round_up: bool) -> int:
+    """metav1 IntOrString resolution (intstr.GetScaledValueFromIntOrPercent):
+    "25%" scales against ``total`` (surge rounds up, unavailable down)."""
+    if v is None:
+        return default
+    if isinstance(v, str) and v.endswith("%"):
+        import math
+
+        frac = int(v[:-1]) * total / 100.0
+        return math.ceil(frac) if round_up else math.floor(frac)
+    return int(v)
+
+
 def deployment_from(doc: dict) -> t.Deployment:
     spec = doc.get("spec") or {}
     strategy = spec.get("strategy") or {}
     rolling = strategy.get("rollingUpdate") or {}
     meta = meta_from(doc.get("metadata") or {})
+    replicas = int(spec.get("replicas") or 1)
     return t.Deployment(
         meta=meta,
         selector=label_selector_from(spec.get("selector")),
-        replicas=int(spec.get("replicas", 1)),
+        replicas=replicas,
         template=_pod_template_from(spec.get("template"), meta.namespace),
         strategy=strategy.get("type", "RollingUpdate"),
-        max_surge=int(rolling.get("maxSurge", 1)),
-        max_unavailable=int(rolling.get("maxUnavailable", 1)),
+        max_surge=_int_or_percent(rolling.get("maxSurge"), replicas, 1, True),
+        max_unavailable=_int_or_percent(rolling.get("maxUnavailable"),
+                                        replicas, 1, False),
     )
 
 

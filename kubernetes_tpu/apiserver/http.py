@@ -192,19 +192,18 @@ class _Handler(BaseHTTPRequestHandler):
         versioned scheme (api/scheme.py); otherwise it is this framework's
         snake_case reflection format."""
         if "apiVersion" in body and "metadata" in body:
-            from ..api.scheme import SchemeError, default_scheme
+            # a manifest-shaped body MUST decode through the scheme: an
+            # unregistered apiVersion is a clear 400, never a silent
+            # fall-through to the reflection decoder (which would turn
+            # camelCase keys into a default-valued object)
+            from ..api.scheme import default_scheme
 
-            scheme = default_scheme()
-            try:
-                obj = scheme.decode(dict(body, kind=body.get("kind") or kind))
-            except SchemeError:
-                obj = None  # not a registered external version: reflection format
-            if obj is not None:
-                if not isinstance(obj, _KIND_TYPES[kind]):
-                    raise ValueError(
-                        f"body kind {type(obj).__name__} does not match "
-                        f"path resource {kind}")
-                return obj
+            obj = default_scheme().decode(dict(body, kind=body.get("kind") or kind))
+            if not isinstance(obj, _KIND_TYPES[kind]):
+                raise ValueError(
+                    f"body kind {type(obj).__name__} does not match "
+                    f"path resource {kind}")
+            return obj
         return from_wire(_KIND_TYPES[kind], body)
 
     def _match(self, kind: str, ns: Optional[str], obj) -> bool:
@@ -402,11 +401,15 @@ def serve_api(store: ClusterStore, port: int = 0, auth=None):
     ``auth`` is an optional apiserver.auth.AuthConfig enabling the
     authn/flow-control/authz handler chain."""
     handler = type("BoundAPIHandler", (_Handler,), {"store": store, "auth": auth})
+    installed_authorizer = False
     if auth is not None and auth.authorizer is not None and store.authorizer is None:
         # the admission seam (OwnerReferencesPermissionEnforcement) shares
-        # the HTTP layer's authorizer
+        # the HTTP layer's authorizer; shutdown_api removes it again so a
+        # later server on the same store doesn't inherit stale policy
         store.authorizer = auth.authorizer
+        installed_authorizer = True
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    server.__ktpu_installed_authorizer__ = (store if installed_authorizer else None)
     server.__shutdown_request__ = False
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
@@ -415,5 +418,8 @@ def serve_api(store: ClusterStore, port: int = 0, auth=None):
 
 def shutdown_api(server) -> None:
     server.__shutdown_request__ = True
+    store = getattr(server, "__ktpu_installed_authorizer__", None)
+    if store is not None:
+        store.authorizer = None
     server.shutdown()
     server.server_close()

@@ -223,17 +223,21 @@ class ClusterStore:
         self._request_user.name = name
         self._request_user.groups = tuple(groups)
 
-    def as_user(self, name: str):
-        """Context manager: run store writes as ``name`` on this thread."""
+    def as_user(self, name: str, groups: tuple = ()):
+        """Context manager: run store writes as ``name`` (+ groups) on this
+        thread; the previous identity INCLUDING groups is restored on exit
+        (a stale group set must never leak into an impersonated context)."""
         store = self
 
         class _Ctx:
             def __enter__(self):
-                self._prev = getattr(store._request_user, "name", "")
+                self._prev = (getattr(store._request_user, "name", ""),
+                              getattr(store._request_user, "groups", ()))
                 store._request_user.name = name
+                store._request_user.groups = tuple(groups)
 
             def __exit__(self, *exc):
-                store._request_user.name = self._prev
+                store._request_user.name, store._request_user.groups = self._prev
                 return False
 
         return _Ctx()

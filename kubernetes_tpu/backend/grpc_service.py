@@ -1,0 +1,229 @@
+"""gRPC binding of the batched device service (SURVEY §5.8 hop 6).
+
+Hardened transport per ROADMAP round-3 item 5: real gRPC framing (HTTP/2,
+protobuf messages generated from native/ktpu_device.proto), pod-template
+deduplication on ScheduleBatch (the QPS-5000 workloads reuse a handful of
+pod shapes, so the steady-state request is one template table + name refs
+instead of N full pod objects), and device-computed preemption hints
+riding back with unschedulable results.
+
+grpc service stubs are not generated (grpc_tools is absent from the image);
+the server registers generic method handlers and the client uses
+channel.unary_unary — functionally identical to protoc-gen-grpc output.
+Messages compile on demand: `protoc --python_out` into native/build at
+first import (protoc is in the image; the output is cached by mtime).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_PROTO_DIR = os.path.join(_REPO_ROOT, "native")
+_PROTO = os.path.join(_PROTO_DIR, "ktpu_device.proto")
+_BUILD_DIR = os.path.join(_PROTO_DIR, "build")
+_PB2 = os.path.join(_BUILD_DIR, "ktpu_device_pb2.py")
+
+_pb2 = None
+_pb2_lock = threading.Lock()
+
+SERVICE = "ktpu.v1.Device"
+
+
+def pb2():
+    """Import (building if stale) the generated protobuf module."""
+    global _pb2
+    if _pb2 is not None:
+        return _pb2
+    with _pb2_lock:
+        if _pb2 is not None:
+            return _pb2
+        if (not os.path.exists(_PB2)
+                or os.path.getmtime(_PB2) < os.path.getmtime(_PROTO)):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            subprocess.run(
+                ["protoc", f"--python_out={_BUILD_DIR}", "-I", _PROTO_DIR, _PROTO],
+                check=True, capture_output=True, timeout=60)
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location("ktpu_device_pb2", _PB2)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _pb2 = mod
+        return _pb2
+
+
+# ----------------------------------------------------------- dict <-> proto
+# (the transport speaks backend/service.py's dict payloads at both ends, so
+# DeviceService and WireScheduler stay transport-agnostic)
+
+
+def _deltas_to_proto(payload: dict):
+    p = pb2()
+    req = p.ApplyDeltasRequest(full=bool(payload.get("full")))
+    for e in payload.get("nodes", ()):
+        req.nodes.append(p.NodeDelta(
+            node_json=json.dumps(e["node"]).encode(),
+            pod_json=[json.dumps(pw).encode() for pw in e.get("pods", ())],
+            gen=int(e.get("gen", 0))))
+    req.removed.extend(payload.get("removed", ()))
+    for ns, labels in (payload.get("namespaces") or {}).items():
+        req.namespaces[ns] = json.dumps(labels).encode()
+    return req
+
+
+def _deltas_from_proto(req) -> dict:
+    return {
+        "full": req.full,
+        "nodes": [{
+            "node": json.loads(e.node_json),
+            "pods": [json.loads(b) for b in e.pod_json],
+            "gen": e.gen,
+        } for e in req.nodes],
+        "removed": list(req.removed),
+        "namespaces": {ns: json.loads(b) for ns, b in req.namespaces.items()},
+    }
+
+
+def _batch_to_proto(payload: dict):
+    """Template-dedup encode: per pod, strip the only per-pod fields (name/
+    uid) out of the wire dict; identical remainders share one table entry."""
+    p = pb2()
+    req = p.ScheduleBatchRequest()
+    table: Dict[bytes, int] = {}
+    for pw in payload.get("pods", ()):
+        meta = dict(pw.get("meta") or {})
+        name = meta.pop("name", "")
+        uid = meta.pop("uid", "")
+        namespace = meta.get("namespace", "default")
+        tmpl = json.dumps(dict(pw, meta=meta), sort_keys=True).encode()
+        idx = table.get(tmpl)
+        if idx is None:
+            idx = len(req.templates)
+            table[tmpl] = idx
+            req.templates.append(tmpl)
+        req.pods.append(p.PodRef(template=idx, name=name,
+                                 namespace=namespace, uid=uid))
+    return req
+
+
+def _batch_from_proto(req) -> dict:
+    templates = [json.loads(t) for t in req.templates]
+    pods = []
+    for ref in req.pods:
+        tmpl = templates[ref.template]
+        meta = dict(tmpl.get("meta") or {})
+        meta["name"] = ref.name
+        meta["namespace"] = ref.namespace or meta.get("namespace", "default")
+        if ref.uid:
+            meta["uid"] = ref.uid
+        pods.append(dict(tmpl, meta=meta))
+    return {"pods": pods}
+
+
+def _results_to_proto(out: dict):
+    p = pb2()
+    resp = p.ScheduleBatchResponse()
+    for r in out.get("results", ()):
+        pr = p.PodResult(node_name=r.get("nodeName") or "")
+        if not pr.node_name:
+            pr.unschedulable_plugins.extend(r.get("unschedulablePlugins") or ())
+            pr.statuses_json = json.dumps(r.get("statuses") or {}).encode()
+            hint = r.get("preempt")
+            if hint:
+                if hint.get("candidates") is None:
+                    pr.preempt.truncated = True
+                else:
+                    pr.preempt.candidates.extend(hint["candidates"])
+                pr.preempt.best = hint.get("best") or ""
+        resp.results.append(pr)
+    return resp
+
+
+def _results_from_proto(resp) -> dict:
+    results = []
+    for pr in resp.results:
+        if pr.node_name:
+            results.append({"nodeName": pr.node_name})
+            continue
+        r = {
+            "nodeName": None,
+            "unschedulablePlugins": list(pr.unschedulable_plugins),
+            "statuses": json.loads(pr.statuses_json) if pr.statuses_json else {},
+        }
+        if pr.HasField("preempt"):
+            r["preempt"] = {
+                "candidates": (None if pr.preempt.truncated
+                               else list(pr.preempt.candidates)),
+                "best": pr.preempt.best or None,
+            }
+        results.append(r)
+    return {"results": results}
+
+
+# ------------------------------------------------------------------ server
+
+
+def serve_grpc(service, port: int = 0):
+    """Bind a DeviceService to a localhost gRPC server; returns
+    (server, port). Generic handlers stand in for generated service stubs."""
+    import grpc
+    from concurrent import futures
+
+    p = pb2()
+
+    def apply_deltas(request, _ctx):
+        out = service.apply_deltas(_deltas_from_proto(request))
+        return p.ApplyDeltasResponse(nodes=int(out.get("nodes", 0)))
+
+    def schedule_batch(request, _ctx):
+        return _results_to_proto(service.schedule_batch(_batch_from_proto(request)))
+
+    handlers = grpc.method_handlers_generic_handler(SERVICE, {
+        "ApplyDeltas": grpc.unary_unary_rpc_method_handler(
+            apply_deltas,
+            request_deserializer=p.ApplyDeltasRequest.FromString,
+            response_serializer=p.ApplyDeltasResponse.SerializeToString),
+        "ScheduleBatch": grpc.unary_unary_rpc_method_handler(
+            schedule_batch,
+            request_deserializer=p.ScheduleBatchRequest.FromString,
+            response_serializer=p.ScheduleBatchResponse.SerializeToString),
+    })
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+    server.add_generic_rpc_handlers((handlers,))
+    bound = server.add_insecure_port(f"127.0.0.1:{port}")
+    server.start()
+    return server, bound
+
+
+class GrpcClient:
+    """Drop-in for service.WireClient over gRPC: same dict payloads."""
+
+    def __init__(self, endpoint: str):
+        import grpc
+
+        p = pb2()
+        self._channel = grpc.insecure_channel(endpoint)
+        self._apply = self._channel.unary_unary(
+            f"/{SERVICE}/ApplyDeltas",
+            request_serializer=p.ApplyDeltasRequest.SerializeToString,
+            response_deserializer=p.ApplyDeltasResponse.FromString)
+        self._schedule = self._channel.unary_unary(
+            f"/{SERVICE}/ScheduleBatch",
+            request_serializer=p.ScheduleBatchRequest.SerializeToString,
+            response_deserializer=p.ScheduleBatchResponse.FromString)
+
+    def apply_deltas(self, payload: dict) -> dict:
+        resp = self._apply(_deltas_to_proto(payload), timeout=120)
+        return {"nodes": resp.nodes}
+
+    def schedule_batch(self, payload: dict) -> dict:
+        return _results_from_proto(
+            self._schedule(_batch_to_proto(payload), timeout=120))
+
+    def close(self) -> None:
+        self._channel.close()

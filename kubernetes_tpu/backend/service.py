@@ -179,6 +179,23 @@ class DeviceService:
             self.device.adopt_device(result)
             self.device.adopt_commits(result, host_pb, node_idx)
             slot_names = self.device.slot_to_name()
+            # device preemption screen for the batch's failures (ROADMAP
+            # wire-hardening: hints ride back with unschedulable results so
+            # the client's PostFilter skips hopeless candidates)
+            screen = best = None
+            if any(int(node_idx[i]) < 0 for i in range(len(pods))):
+                try:
+                    from ..ops.preempt import preempt_screen
+
+                    self.device._refresh_class_prio()
+                    failed = np.zeros(pb.capacity, bool)
+                    failed[:len(pods)] = node_idx[:len(pods)] < 0
+                    pres = preempt_screen(pb, self.device.nt,
+                                          result.static_masks, failed)
+                    screen = np.asarray(pres.screen)
+                    best = np.asarray(pres.best)
+                except Exception:  # noqa: BLE001 — hints are optional
+                    screen = best = None
             ff = None
             results: List[dict] = []
             for i in range(len(pods)):
@@ -198,12 +215,28 @@ class DeviceService:
                         plugins.add(fid)
                         if len(statuses) < 64:  # payload-bounded sample
                             statuses[name] = _ATTRIBUTION_ORDER[fid - 1][0]
-                results.append({
+                r = {
                     "nodeName": None,
                     "unschedulablePlugins": [
                         _ATTRIBUTION_ORDER[fid - 1][0] for fid in sorted(plugins)],
                     "statuses": statuses,
-                })
+                }
+                if screen is not None:
+                    all_cands = [name for slot, name in slot_names.items()
+                                 if bool(screen[i][slot])]
+                    best_name = (slot_names.get(int(best[i]))
+                                 if best is not None and best[i] >= 0 else None)
+                    if len(all_cands) <= 1024:
+                        # an exact screen only: a truncated candidate list
+                        # would wrongly mark the dropped nodes hopeless
+                        # (defaultpreemption treats the screen as exact)
+                        r["preempt"] = {"candidates": all_cands,
+                                        "best": best_name}
+                    elif best_name is not None:
+                        # too many candidates to ship: the ranked best alone
+                        # still helps (preferred-node fast path)
+                        r["preempt"] = {"candidates": None, "best": best_name}
+                results.append(r)
         return {"apiVersion": API_VERSION, "results": results}
 
 
@@ -290,9 +323,15 @@ class WireScheduler(Scheduler):
     analog of the HTTP extender, with the same host machinery around it as
     TPUScheduler (queue order, assume/bind, failure handling + backoff)."""
 
-    def __init__(self, *args, endpoint: str, batch_size: int = 256, **kwargs):
+    def __init__(self, *args, endpoint: str, batch_size: int = 256,
+                 transport: str = "http", **kwargs):
         super().__init__(*args, **kwargs)
-        self.client = WireClient(endpoint)
+        if transport == "grpc":
+            from .grpc_service import GrpcClient
+
+            self.client = GrpcClient(endpoint)
+        else:
+            self.client = WireClient(endpoint)
         self.batch_size = batch_size
         self._sent_gens: Dict[str, int] = {}
         self._sent_ns: Dict[str, dict] = {}
@@ -377,6 +416,8 @@ class WireScheduler(Scheduler):
         res = self.client.schedule_batch(
             {"apiVersion": API_VERSION,
              "pods": [to_wire(qp.pod) for qp in batch]})
+        # hint-screen scaffolding, shared by every failed pod in the batch
+        hint_names = hint_slot_of = None
         for qp, r in zip(batch, res["results"]):
             fwk = self.framework_for_pod(qp.pod)
             self.metrics["schedule_attempts"] += 1
@@ -390,8 +431,31 @@ class WireScheduler(Scheduler):
                     reason = dict(_ATTRIBUTION_ORDER).get(plugin, "unschedulable")
                     d.node_to_status[name] = Status.unschedulable(reason).with_plugin(plugin)
                 d.unschedulable_plugins.update(r.get("unschedulablePlugins") or ())
+                state = CycleState()
+                hint = r.get("preempt")
+                if hint is not None:
+                    # rebuild the screen over OUR node names: candidates the
+                    # service listed pass, every other known node fails,
+                    # unknown (post-snapshot) nodes stay permissive. A None
+                    # candidate list means the service truncated (screen
+                    # inexact): pass everything and keep only the ranked
+                    # best as the preferred-node fast path.
+                    from ..framework.plugins.defaultpreemption import DefaultPreemption
+
+                    if hint_slot_of is None:  # loop-invariant: build once
+                        hint_names = list(self._sent_gens)
+                        hint_slot_of = {n: i for i, n in enumerate(hint_names)}
+                    if hint.get("candidates") is None:
+                        row = np.ones(len(hint_names), bool)
+                    else:
+                        row = np.zeros(len(hint_names), bool)
+                        for n in hint["candidates"]:
+                            if n in hint_slot_of:
+                                row[hint_slot_of[n]] = True
+                    state.write(DefaultPreemption.HINTS_KEY,
+                                (row, hint_slot_of, hint.get("best")))
                 self._handle_scheduling_failure(
-                    fwk, CycleState(), qp, Status.unschedulable("no feasible node"),
+                    fwk, state, qp, Status.unschedulable("no feasible node"),
                     d, pod_cycle)
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)

@@ -42,22 +42,41 @@ class HorizontalPodAutoscalerController(Controller):
         self._last_seen: dict = {}   # hpa key -> input fingerprint
         self._held_until: dict = {}  # hpa key -> when a held scale-down re-evaluates
 
+    def _target_pods(self, hpa):
+        """The pods backing the scale target (Deployment targets go through
+        their ReplicaSets, one hop down)."""
+        if hpa.target_kind == "Deployment":
+            pods = []
+            for rs in self.store.snapshot_map("ReplicaSet").values():
+                ref = rs.meta.controller_of()
+                if (rs.meta.namespace == hpa.meta.namespace and ref is not None
+                        and ref.kind == "Deployment" and ref.name == hpa.target_name):
+                    pods.extend(_owned_pods(self.store, hpa.meta.namespace,
+                                            "ReplicaSet", rs.meta.name))
+            return pods
+        return _owned_pods(self.store, hpa.meta.namespace, hpa.target_kind,
+                           hpa.target_name)
+
     def tick(self) -> None:
-        # metrics change without API events: re-evaluate an HPA when its
-        # INPUTS changed (metrics / target replicas / the live pod set) —
-        # an unconditional re-enqueue would keep settle() from converging
+        # metrics change without API events: re-evaluate an HPA when ITS
+        # inputs changed (metrics, target replicas, its own pods' phases) —
+        # an unconditional re-enqueue would keep settle() from converging,
+        # and a cluster-wide fingerprint would re-run every HPA on any
+        # unrelated pod churn
         hpas = self.store.snapshot_map("HorizontalPodAutoscaler")
         for stale in set(self._last_seen) - set(hpas):
             self._last_seen.pop(stale, None)  # deleted HPAs: no leak
             self._held_until.pop(stale, None)
-        pods_fp = tuple(sorted(
-            (p.meta.key(), p.status.phase)
-            for p in self.store.snapshot_map("Pod").values()))
+        if not hpas:
+            return
         for key, hpa in hpas.items():
             target = self.store.get_object(
                 hpa.target_kind, f"{hpa.meta.namespace}/{hpa.target_name}")
+            pods = self._target_pods(hpa)
             fp = (target.replicas if target is not None else -1,
-                  tuple(sorted(self.store.pod_metrics.items())), pods_fp)
+                  tuple(sorted((p.meta.key(), p.status.phase,
+                                self.store.pod_metrics.get(p.meta.key()))
+                               for p in pods)))
             if self._last_seen.get(key) != fp:
                 self._last_seen[key] = fp
                 self.queue.add(key)
@@ -92,18 +111,7 @@ class HorizontalPodAutoscalerController(Controller):
         target = self.store.get_object(hpa.target_kind, target_key)
         if target is None:
             return
-        if hpa.target_kind == "Deployment":
-            # pods hang off the deployment's ReplicaSets, one hop down
-            pods = []
-            for rs in self.store.snapshot_map("ReplicaSet").values():
-                ref = rs.meta.controller_of()
-                if (rs.meta.namespace == hpa.meta.namespace and ref is not None
-                        and ref.kind == "Deployment" and ref.name == hpa.target_name):
-                    pods.extend(_owned_pods(self.store, hpa.meta.namespace,
-                                            "ReplicaSet", rs.meta.name))
-        else:
-            pods = _owned_pods(self.store, hpa.meta.namespace, hpa.target_kind,
-                               hpa.target_name)
+        pods = self._target_pods(hpa)
         live = [p for p in pods if p.status.phase in ("Pending", "Running")]
         current = target.replicas
         util, measured = self._utilization(live)

@@ -168,6 +168,18 @@ class Runner:
             self.scheduler = WireScheduler(
                 self.store, endpoint=f"http://127.0.0.1:{port}",
                 batch_size=batch_size, seed=seed)
+        elif backend == "grpc":
+            # the hardened transport: gRPC framing + template-deduped pod
+            # batches (backend/grpc_service.py)
+            from ..backend.grpc_service import serve_grpc
+            from ..backend.service import DeviceService, WireScheduler
+
+            self._service = DeviceService(batch_size=batch_size)
+            self._server, port = serve_grpc(self._service)
+            self._grpc = True
+            self.scheduler = WireScheduler(
+                self.store, endpoint=f"127.0.0.1:{port}",
+                batch_size=batch_size, seed=seed, transport="grpc")
         else:
             self.scheduler = scheduler_from_config(self.store, cfg, seed=seed)
         self.data_items: List[DataItem] = []
@@ -178,8 +190,11 @@ class Runner:
         and device service — serve()'s contract: the caller owns shutdown)."""
         server = getattr(self, "_server", None)
         if server is not None:
-            server.shutdown()
-            server.server_close()  # release the listening socket fd
+            if getattr(self, "_grpc", False):
+                server.stop(0)
+            else:
+                server.shutdown()
+                server.server_close()  # release the listening socket fd
             self._server = None
 
     # ---- ops ----

@@ -1,0 +1,139 @@
+"""gRPC binding of the batched device service: proto round-trips, pod
+template dedup on the wire, e2e scheduling over a real gRPC channel, and
+preemption hints riding back with failures (ROADMAP wire hardening)."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.codec import to_wire
+from kubernetes_tpu.api.types import PriorityClass, ObjectMeta
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend.grpc_service import (
+    GrpcClient,
+    _batch_from_proto,
+    _batch_to_proto,
+    pb2,
+    serve_grpc,
+)
+from kubernetes_tpu.backend.service import DeviceService, WireScheduler
+
+
+def _bound(store):
+    objs, _rv = store.list_objects("Pod")
+    return {p.meta.name: p.spec.node_name for p in objs if p.spec.node_name}
+
+
+class TestProtoCodec:
+    def test_template_dedup(self):
+        pods = [make_pod(f"p{i}").req({"cpu": "500m"}).label("app", "web").obj()
+                for i in range(50)]
+        payload = {"pods": [to_wire(p) for p in pods]}
+        req = _batch_to_proto(payload)
+        assert len(req.templates) == 1  # 50 identical shapes -> one template
+        assert len(req.pods) == 50
+        back = _batch_from_proto(req)
+        assert [p["meta"]["name"] for p in back["pods"]] == \
+            [f"p{i}" for i in range(50)]
+        assert back["pods"][0]["spec"] == payload["pods"][0]["spec"]
+
+    def test_distinct_shapes_distinct_templates(self):
+        pods = [make_pod("a").req({"cpu": "1"}).obj(),
+                make_pod("b").req({"cpu": "2"}).obj(),
+                make_pod("c").req({"cpu": "1"}).obj()]
+        req = _batch_to_proto({"pods": [to_wire(p) for p in pods]})
+        assert len(req.templates) == 2
+
+    def test_wire_size_shrinks(self):
+        import json
+
+        pods = [make_pod(f"p{i}").req({"cpu": "500m", "memory": "1Gi"})
+                .label("app", "web").obj() for i in range(256)]
+        payload = {"pods": [to_wire(p) for p in pods]}
+        json_size = len(json.dumps(payload).encode())
+        proto_size = len(_batch_to_proto(payload).SerializeToString())
+        assert proto_size < json_size / 5  # template dedup + binary framing
+
+
+class TestGrpcEndToEnd:
+    def test_schedule_over_grpc(self):
+        service = DeviceService(batch_size=32)
+        server, port = serve_grpc(service)
+        try:
+            store = ClusterStore()
+            sched = WireScheduler(store, endpoint=f"127.0.0.1:{port}",
+                                  batch_size=8, transport="grpc")
+            for i in range(4):
+                store.create_node(
+                    make_node(f"n{i}")
+                    .capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+            for i in range(12):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m", "memory": "512Mi"}).obj())
+            sched.run_until_settled()
+            bound = _bound(store)
+            assert len(bound) == 12
+            assert set(bound.values()) <= {f"n{i}" for i in range(4)}
+        finally:
+            server.stop(0)
+
+    def test_unschedulable_carries_preempt_hints(self):
+        service = DeviceService(batch_size=16)
+        server, port = serve_grpc(service)
+        try:
+            store = ClusterStore()
+            store.create_priority_class(PriorityClass(
+                meta=ObjectMeta(name="high"), value=1000))
+            sched = WireScheduler(store, endpoint=f"127.0.0.1:{port}",
+                                  batch_size=8, transport="grpc")
+            store.create_node(
+                make_node("n0").capacity({"cpu": "2", "memory": "4Gi", "pods": 10}).obj())
+            # fill the node with a low-priority pod
+            store.create_pod(make_pod("low").req({"cpu": "1800m"}).obj())
+            sched.run_until_settled()
+            assert _bound(store).get("low") == "n0"
+            # a high-priority pod that does not fit -> preemption via hints
+            hi = make_pod("hi").req({"cpu": "1500m"}).obj()
+            hi.spec.priority = 1000
+            store.create_pod(hi)
+            sched.run_until_settled()
+            bound = _bound(store)
+            assert bound.get("hi") == "n0", bound
+            # the victim was deleted or requeued unbound
+            assert store.get_pod("default/low") is None \
+                or not store.get_pod("default/low").spec.node_name
+        finally:
+            server.stop(0)
+
+    def test_grpc_matches_http_placements(self):
+        from kubernetes_tpu.backend.service import serve
+
+        def run(transport):
+            service = DeviceService(batch_size=32)
+            if transport == "grpc":
+                server, port = serve_grpc(service)
+                endpoint = f"127.0.0.1:{port}"
+            else:
+                server, port = serve(service)
+                endpoint = f"http://127.0.0.1:{port}"
+            try:
+                store = ClusterStore()
+                sched = WireScheduler(store, endpoint=endpoint, batch_size=16,
+                                      transport=transport)
+                for i in range(6):
+                    store.create_node(
+                        make_node(f"n{i}")
+                        .capacity({"cpu": "8", "memory": "16Gi", "pods": 20})
+                        .label("zone", f"z{i % 2}").obj())
+                for i in range(24):
+                    store.create_pod(
+                        make_pod(f"p{i}").req({"cpu": "900m", "memory": "1Gi"}).obj())
+                sched.run_until_settled()
+                return _bound(store)
+            finally:
+                if transport == "grpc":
+                    server.stop(0)
+                else:
+                    server.shutdown()
+
+        assert run("grpc") == run("http")
