@@ -39,39 +39,11 @@ def _probe_platform(timeout_s: float | None = None) -> tuple[str, dict]:
     back to CPU.  Returns (platform label, probe diagnostic) — the diagnostic
     documents per round whether the chip was reachable (VERDICT r2 missing #1).
     """
-    if timeout_s is None:
-        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return "cpu", {"outcome": "forced-cpu"}
-    # Explicit non-cpu platform or auto-selection: probe in a subprocess —
-    # either can hang on a broken tunnel.
-    probe = "import jax; jax.devices(); print(jax.default_backend())"
-    diag: dict = {}
-    for attempt in range(2):
-        t0 = time.perf_counter()
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", probe],
-                capture_output=True, text=True, timeout=timeout_s,
-            )
-            if out.returncode != 0:
-                outcome = f"rc={out.returncode}"
-            elif not out.stdout.strip():
-                outcome = "empty-stdout"
-            else:
-                outcome = "ok"
-            diag = {"outcome": outcome,
-                    "duration_s": round(time.perf_counter() - t0, 2),
-                    "attempt": attempt}
-            if out.returncode != 0:
-                diag["error_tail"] = out.stderr.strip()[-300:]
-            if outcome == "ok":
-                return out.stdout.strip().splitlines()[-1], diag
-        except subprocess.TimeoutExpired:
-            diag = {"outcome": "timeout", "duration_s": round(time.perf_counter() - t0, 2),
-                    "attempt": attempt}
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    return "cpu-fallback", diag
+    # shared implementation: kubernetes_tpu/utils/relay.py (the relay
+    # diagnostics seam); this wrapper only keeps bench.py's public name
+    from kubernetes_tpu.utils.relay import probe_platform
+
+    return probe_platform(timeout_s)
 
 
 def build_cluster(store, n_nodes):

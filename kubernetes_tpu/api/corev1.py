@@ -583,7 +583,8 @@ def deployment_from(doc: dict) -> t.Deployment:
     strategy = spec.get("strategy") or {}
     rolling = strategy.get("rollingUpdate") or {}
     meta = meta_from(doc.get("metadata") or {})
-    replicas = int(spec.get("replicas") or 1)
+    r = spec.get("replicas")
+    replicas = 1 if r is None else int(r)  # explicit 0 = scale-to-zero
     return t.Deployment(
         meta=meta,
         selector=label_selector_from(spec.get("selector")),

@@ -401,25 +401,39 @@ def serve_api(store: ClusterStore, port: int = 0, auth=None):
     ``auth`` is an optional apiserver.auth.AuthConfig enabling the
     authn/flow-control/authz handler chain."""
     handler = type("BoundAPIHandler", (_Handler,), {"store": store, "auth": auth})
-    installed_authorizer = False
-    if auth is not None and auth.authorizer is not None and store.authorizer is None:
+    authz_member = False
+    if auth is not None and auth.authorizer is not None:
         # the admission seam (OwnerReferencesPermissionEnforcement) shares
-        # the HTTP layer's authorizer; shutdown_api removes it again so a
-        # later server on the same store doesn't inherit stale policy
-        store.authorizer = auth.authorizer
-        installed_authorizer = True
+        # the HTTP layer's authorizer; refcounted so the LAST authz-enabled
+        # server on a store clears it on shutdown (no stale policy, and no
+        # clearing out from under a still-live sibling server)
+        with _AUTHZ_LOCK:
+            if store.authorizer is None:
+                store.authorizer = auth.authorizer
+            _AUTHZ_INSTALLS[id(store)] = _AUTHZ_INSTALLS.get(id(store), 0) + 1
+            authz_member = True
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
-    server.__ktpu_installed_authorizer__ = (store if installed_authorizer else None)
+    server.__ktpu_installed_authorizer__ = (store if authz_member else None)
     server.__shutdown_request__ = False
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server, server.server_address[1]
 
 
+_AUTHZ_INSTALLS: dict = {}  # id(store) -> live install count
+_AUTHZ_LOCK = threading.Lock()
+
+
 def shutdown_api(server) -> None:
     server.__shutdown_request__ = True
     store = getattr(server, "__ktpu_installed_authorizer__", None)
     if store is not None:
-        store.authorizer = None
+        with _AUTHZ_LOCK:
+            n = _AUTHZ_INSTALLS.get(id(store), 1) - 1
+            if n <= 0:
+                _AUTHZ_INSTALLS.pop(id(store), None)
+                store.authorizer = None  # last installer clears the seam
+            else:
+                _AUTHZ_INSTALLS[id(store)] = n
     server.shutdown()
     server.server_close()

@@ -507,6 +507,9 @@ class TPUScheduler(Scheduler):
         cache — crash-only, §5.3."""
         t0 = self.now_fn()
         try:
+            from ..utils import relay
+
+            relay.count_sync("commit-read")  # THE one blocking read per batch
             node_idx = np.asarray(fl.result.node_idx)
             self.device.adopt_commits(fl.result, fl.host_pb, node_idx)
             self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0, node_idx,
@@ -605,6 +608,9 @@ class TPUScheduler(Scheduler):
                 failed[:len(qps)] = node_idx[:len(qps)] < 0
                 pres = preempt_screen(pb, self.device.nt, result.static_masks,
                                       failed)
+                from ..utils import relay
+
+                relay.count_sync("preempt-read")
                 screen = np.asarray(pres.screen)
                 best = np.asarray(pres.best)
                 slot_of = dict(self.device.encoder.node_slots)
@@ -655,6 +661,9 @@ class TPUScheduler(Scheduler):
                 if ff is None:
                     # one [P, N] int8 read covers diagnosis for the whole
                     # batch (vs 8 separate mask transfers)
+                    from ..utils import relay
+
+                    relay.count_sync("diagnosis-read")
                     ff = np.asarray(result.first_fail)
                 diagnosis = self._diagnose(ff[i], slot_names)
                 state = CycleState()

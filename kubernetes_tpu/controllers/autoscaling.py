@@ -41,6 +41,7 @@ class HorizontalPodAutoscalerController(Controller):
         self.now_fn = now_fn or _time.monotonic
         self._last_seen: dict = {}   # hpa key -> input fingerprint
         self._held_until: dict = {}  # hpa key -> when a held scale-down re-evaluates
+        self._tick_pods: dict = {}   # hpa key -> pods computed by tick (reused once)
 
     def _target_pods(self, hpa):
         """The pods backing the scale target (Deployment targets go through
@@ -79,6 +80,7 @@ class HorizontalPodAutoscalerController(Controller):
                                for p in pods)))
             if self._last_seen.get(key) != fp:
                 self._last_seen[key] = fp
+                self._tick_pods[key] = pods  # reconcile reuses this scan
                 self.queue.add(key)
             elif key in self._held_until and self.now_fn() >= self._held_until[key]:
                 del self._held_until[key]  # stabilization window expired
@@ -111,7 +113,9 @@ class HorizontalPodAutoscalerController(Controller):
         target = self.store.get_object(hpa.target_kind, target_key)
         if target is None:
             return
-        pods = self._target_pods(hpa)
+        pods = self._tick_pods.pop(key, None)
+        if pods is None:  # event-driven enqueue: compute fresh
+            pods = self._target_pods(hpa)
         live = [p for p in pods if p.status.phase in ("Pending", "Running")]
         current = target.replicas
         util, measured = self._utilization(live)

@@ -188,6 +188,9 @@ class Runner:
     def close(self) -> None:
         """Release backend resources (the wire backend's HTTP server thread
         and device service — serve()'s contract: the caller owns shutdown)."""
+        client = getattr(getattr(self, "scheduler", None), "client", None)
+        if client is not None and hasattr(client, "close"):
+            client.close()  # gRPC channel owns background threads/fds
         server = getattr(self, "_server", None)
         if server is not None:
             if getattr(self, "_grpc", False):

@@ -1,0 +1,84 @@
+"""Relay diagnostics: device-transfer accounting + platform probing.
+
+The axon TPU tunnel makes every host<->device materialization a network
+round-trip, so the batch path's contract is ONE blocking device read per
+batch cycle (the node_idx materialization at commit; ROADMAP r3 'kill
+per-execution relay syncs'). This module gives that invariant a seam:
+hot-path code reports materializations through count_sync(), and tests wrap
+a workload in track() to assert the per-batch budget — the §5.2 drift-
+detector pattern applied to transfer regressions.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import subprocess
+import sys
+import threading
+import time
+from collections import Counter
+from typing import Optional, Tuple
+
+_local = threading.local()
+
+
+def count_sync(tag: str) -> None:
+    """Record one blocking device materialization on this thread (no-op
+    unless inside track())."""
+    c = getattr(_local, "counter", None)
+    if c is not None:
+        c[tag] += 1
+
+
+@contextlib.contextmanager
+def track():
+    """Collect sync counts on this thread: ``with track() as c: ...`` —
+    ``c`` is a Counter of tag -> materializations."""
+    prev = getattr(_local, "counter", None)
+    c: Counter = Counter()
+    _local.counter = c
+    try:
+        yield c
+    finally:
+        _local.counter = prev
+
+
+def probe_platform(timeout_s: Optional[float] = None) -> Tuple[str, dict]:
+    """Subprocess-probe the ambient jax platform WITHOUT initializing the
+    backend in-process (a wedged axon relay hangs or raises on init — the
+    probe documents reachability per run; bench.py's per-round evidence).
+    Returns (platform-or-"cpu-fallback", diagnostic dict)."""
+    import os
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return "cpu", {"outcome": "forced-cpu"}
+    probe = "import jax; jax.devices(); print(jax.default_backend())"
+    diag: dict = {}
+    for attempt in range(2):
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            if out.returncode != 0:
+                outcome = f"rc={out.returncode}"
+            elif not out.stdout.strip():
+                outcome = "empty-stdout"
+            else:
+                outcome = "ok"
+            diag = {"outcome": outcome,
+                    "duration_s": round(time.perf_counter() - t0, 2),
+                    "attempt": attempt}
+            if out.returncode != 0:
+                diag["error_tail"] = out.stderr.strip()[-300:]
+            if outcome == "ok":
+                return out.stdout.strip().splitlines()[-1], diag
+        except subprocess.TimeoutExpired:
+            diag = {"outcome": "timeout",
+                    "duration_s": round(time.perf_counter() - t0, 2),
+                    "attempt": attempt}
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    return "cpu-fallback", diag

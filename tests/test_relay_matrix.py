@@ -1,0 +1,75 @@
+"""Relay diagnostics (one blocking device read per batch cycle) and the
+full-matrix perf CLI (ROADMAP r3 infra items 9/10)."""
+
+import json
+import os
+import subprocess
+import sys
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler
+from kubernetes_tpu.utils import relay
+
+
+class TestOneSyncInvariant:
+    def test_feasible_batches_cost_one_read_each(self):
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=64)
+        for i in range(32):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi",
+                                             "pods": 50}).obj())
+        with relay.track() as counts:
+            for i in range(128):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "100m", "memory": "128Mi"}).obj())
+            sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 128
+        batches = sched.batch_counter
+        assert batches > 0
+        # THE invariant: one commit-read per dispatched batch, nothing else
+        assert counts["commit-read"] == batches, (dict(counts), batches)
+        assert counts.get("diagnosis-read", 0) == 0  # no failures
+        assert counts.get("preempt-read", 0) == 0
+
+    def test_failures_add_bounded_reads(self):
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=32)
+        store.create_node(
+            make_node("n0").capacity({"cpu": "1", "memory": "1Gi", "pods": 4}).obj())
+        with relay.track() as counts:
+            for i in range(8):
+                store.create_pod(make_pod(f"big{i}").req({"cpu": "4"}).obj())
+            sched.run_until_settled(max_no_progress=3)
+        # diagnosis adds at most ONE extra read per batch that saw a failure
+        assert counts.get("diagnosis-read", 0) <= sched.batch_counter
+
+    def test_track_is_scoped(self):
+        relay.count_sync("outside")  # no active tracker: must be a no-op
+        with relay.track() as c:
+            relay.count_sync("inside")
+        assert dict(c) == {"inside": 1}
+
+
+class TestPerfMatrixCLI:
+    def test_matrix_smoke(self, tmp_path):
+        out = tmp_path / "artifacts"
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+        r = subprocess.run(
+            [sys.executable, "-m", "kubernetes_tpu.perf",
+             "--backend", "tpu", "--scale", "0.02", "--out", str(out),
+             "--cases", "SchedulingBasic,Unschedulable"],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd="/root/repo")
+        assert r.returncode == 0, r.stderr[-2000:]
+        basic = json.loads((out / "SchedulingBasic.json").read_text())
+        names = [it["labels"].get("Name") for it in basic["dataItems"]]
+        assert "SchedulingThroughput" in names
+        summary = json.loads((out / "summary.json").read_text())
+        assert summary["failures"] == 0 and summary["cases"] == 2
+
+    def test_probe_platform_forced_cpu(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        platform, diag = relay.probe_platform()
+        assert platform == "cpu" and diag["outcome"] == "forced-cpu"
