@@ -1,0 +1,148 @@
+"""Speculative decode (vectorized decide/repair rounds) vs the sequential
+scan: EXACT placement parity — the prefix-stability acceptance must
+reproduce the scan's per-pod choices, not just the same load shape."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler
+from kubernetes_tpu.backend.batch import build_schedule_batch_fn
+
+
+def _mk_inputs(n_nodes, pods, batch):
+    store = ClusterStore()
+    sched = TPUScheduler(store, batch_size=batch)
+    for i in range(n_nodes):
+        store.create_node(
+            make_node(f"n{i}")
+            .capacity({"cpu": ["4", "8", "16"][i % 3], "memory": "16Gi",
+                       "pods": 20})
+            .label("zone", f"z{i % 3}").obj())
+    sched._ensure_device()
+    sched.cache.update_snapshot(sched.snapshot)
+    sched.device.sync(sched.snapshot)
+    pb, et = sched.device.encoder.encode_pods(pods)
+    tb = sched.device.sig_table.encode_topo(pods)
+    return sched, pb, et, tb
+
+
+def _run(monkeypatch, flag, n_nodes, pods, batch):
+    monkeypatch.setenv("KTPU_SPEC", flag)
+    monkeypatch.setenv("KTPU_PALLAS", "0")
+    sched, pb, et, tb = _mk_inputs(n_nodes, pods, batch)
+    fn = build_schedule_batch_fn()
+    r = fn(pb, et, sched.device.nt, sched.device.tc, tb, np.int32(7),
+           topo_enabled=False)
+    return (np.asarray(r.node_idx), np.asarray(r.any_feasible),
+            np.asarray(r.final_requested), np.asarray(r.first_fail),
+            np.asarray(r.final_class_req))
+
+
+class TestExactParity:
+    def _check(self, monkeypatch, pods, n_nodes=24, batch=32):
+        idx_a, anyf_a, req_a, ff_a, cls_a = _run(
+            monkeypatch, "0", n_nodes, pods, batch)
+        idx_b, anyf_b, req_b, ff_b, cls_b = _run(
+            monkeypatch, "1", n_nodes, pods, batch)
+        np.testing.assert_array_equal(idx_a, idx_b)
+        np.testing.assert_array_equal(anyf_a, anyf_b)
+        np.testing.assert_array_equal(req_a, req_b)
+        np.testing.assert_array_equal(cls_a, cls_b)
+        # failure diagnosis must match for unschedulable pods (first_fail
+        # drives the scheduler's per-node failure attribution)
+        failed = ~anyf_a & (idx_a == -1)
+        np.testing.assert_array_equal(ff_a[failed], ff_b[failed])
+
+    def test_uniform_pods(self, monkeypatch):
+        pods = [make_pod(f"p{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
+                for i in range(24)]
+        self._check(monkeypatch, pods)
+
+    def test_mixed_sizes_with_conflicts(self, monkeypatch):
+        # big pods force intra-batch capacity conflicts -> multiple rounds
+        pods = [make_pod(f"p{i}").req(
+            {"cpu": ["3500m", "7", "300m"][i % 3], "memory": "2Gi"}).obj()
+            for i in range(30)]
+        self._check(monkeypatch, pods)
+
+    def test_unschedulable_pods(self, monkeypatch):
+        pods = [make_pod(f"p{i}").req({"cpu": "500m"}).obj() for i in range(6)]
+        pods.append(make_pod("huge").req({"cpu": "64"}).obj())
+        pods.append(make_pod("huge2").req({"cpu": "64"}).obj())
+        idx_a, anyf_a, *_ = _run(monkeypatch, "0", 8, pods, 16)
+        idx_b, anyf_b, *_ = _run(monkeypatch, "1", 8, pods, 16)
+        np.testing.assert_array_equal(idx_a, idx_b)
+        np.testing.assert_array_equal(anyf_a, anyf_b)
+        assert idx_a[6] == -1 and idx_a[7] == -1
+
+    def test_host_ports_conflict(self, monkeypatch):
+        pods = [make_pod(f"p{i}").req({"cpu": "100m"}).host_port(8080).obj()
+                for i in range(6)]
+        self._check(monkeypatch, pods, n_nodes=4, batch=8)
+
+    def test_priorities_and_selectors(self, monkeypatch):
+        pods = []
+        for i in range(20):
+            pw = make_pod(f"p{i}").req({"cpu": "800m"}).priority(i % 4)
+            if i % 5 == 0:
+                pw.node_selector({"zone": "z1"})
+            if i % 7 == 0:
+                pw.preferred_node_affinity(5, "zone", ["z2"])
+            pods.append(pw.obj())
+        self._check(monkeypatch, pods)
+
+    def test_normalization_coupling_near_capacity(self, monkeypatch):
+        # the stability hazard: preferred-affinity max nodes fill up mid
+        # round, shrinking later pods' feasible sets and rescaling every
+        # normalized score — the exact-mix check must keep parity
+        pods = []
+        for i in range(24):
+            pw = make_pod(f"p{i}").req({"cpu": "3500m"})  # ~1 pod per 4-cpu node
+            pw.preferred_node_affinity(10, "zone", ["z0"])
+            pw.preferred_node_affinity(3, "zone", ["z1"])
+            pods.append(pw.obj())
+        self._check(monkeypatch, pods, n_nodes=12, batch=32)
+
+    def test_interleaved_failures_and_commits(self, monkeypatch):
+        # failing pods interleaved between winners exercise the fail-before-
+        # first-winner prefix rule
+        pods = []
+        for i in range(16):
+            if i % 3 == 2:
+                pods.append(make_pod(f"big{i}").req({"cpu": "64"}).obj())
+            else:
+                pods.append(make_pod(f"p{i}").req({"cpu": "900m"}).obj())
+        self._check(monkeypatch, pods, n_nodes=6, batch=16)
+
+    def test_one_slot_node_capacity_conflict(self, monkeypatch):
+        # the flagship conflict case: 3 identical pods, one 1-pod node
+        store = ClusterStore()
+        monkeypatch.setenv("KTPU_SPEC", "1")
+        monkeypatch.setenv("KTPU_PALLAS", "0")
+        sched = TPUScheduler(store, batch_size=8)
+        store.create_node(make_node("only").capacity(
+            {"cpu": "1", "memory": "2Gi", "pods": 10}).obj())
+        for i in range(3):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "900m"}).obj())
+        sched.run_until_settled(max_no_progress=3)
+        bound = [p for p in store.pods.values() if p.spec.node_name]
+        assert len(bound) == 1
+
+
+class TestEndToEndForcedSpec:
+    def test_full_scheduler_with_spec_decode(self, monkeypatch):
+        monkeypatch.setenv("KTPU_SPEC", "1")
+        monkeypatch.setenv("KTPU_PALLAS", "0")
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=64)
+        for i in range(16):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+        for i in range(200):
+            store.create_pod(make_pod(f"p{i}").req(
+                {"cpu": "500m", "memory": "512Mi"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 200
+        assert sched.comparer_mismatches == 0
