@@ -120,14 +120,38 @@ def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
     return out.at[jnp.arange(P)[:, None], word_idx].add(bit)
 
 
-def _normalize(raw: jax.Array, feasible: jax.Array, reverse: bool, axis_name=None) -> jax.Array:
-    """DefaultNormalizeScore over one pod's (global) feasible set."""
+def _normalize(raw: jax.Array, feasible: jax.Array, reverse: bool,
+               axis_name=None, axis=None) -> jax.Array:
+    """DefaultNormalizeScore over a pod's (global) feasible set. ``axis``
+    batches it: per-row max instead of the global one (the speculative
+    path's [P, N] form) — ONE implementation for both programs, whose
+    outputs must match bit for bit."""
     masked = jnp.where(feasible, raw, 0.0)
-    mx = _gmax(jnp.max(masked), axis_name)
+    if axis is None:
+        mx = _gmax(jnp.max(masked), axis_name)
+    else:
+        mx = jnp.max(masked, axis=axis, keepdims=True)
     scaled = jnp.floor(raw * 100.0 / jnp.maximum(mx, 1.0))
     if reverse:
         return jnp.where(mx == 0, 100.0, 100.0 - scaled)
     return jnp.where(mx == 0, 0.0, scaled)
+
+
+def _resource_scores(alloc2: jax.Array, nz_total: jax.Array):
+    """(LeastAllocated, BalancedAllocation) over the first two resource
+    columns — shared by the scan step ([N, 2] inputs) and the speculative
+    rounds ([P, N, 2] via broadcasting); formulas per SURVEY §8."""
+    cap0, cap1 = alloc2[..., 0], alloc2[..., 1]
+    r0, r1 = nz_total[..., 0], nz_total[..., 1]
+    la0 = jnp.where((cap0 == 0) | (r0 > cap0), 0.0,
+                    jnp.floor((cap0 - r0) * 100.0 / jnp.maximum(cap0, 1.0)))
+    la1 = jnp.where((cap1 == 0) | (r1 > cap1), 0.0,
+                    jnp.floor((cap1 - r1) * 100.0 / jnp.maximum(cap1, 1.0)))
+    least_alloc = jnp.floor((la0 + la1) / 2.0)
+    f0 = jnp.where(cap0 == 0, 1.0, jnp.minimum(1.0, r0 / jnp.maximum(cap0, 1.0)))
+    f1 = jnp.where(cap1 == 0, 1.0, jnp.minimum(1.0, r1 / jnp.maximum(cap1, 1.0)))
+    balanced = jnp.floor((1.0 - jnp.abs(f0 - f1) / 2.0) * 100.0)
+    return least_alloc, balanced
 
 
 def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
@@ -177,28 +201,17 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         ports = ~conflict
         nz = nz_dyn[None, :, :2].astype(jnp.float32) \
             + pb.nonzero_req[:, None, :2].astype(jnp.float32)    # [P, N, 2]
-        cap0, cap1 = alloc_f[None, :, 0], alloc_f[None, :, 1]
-        r0, r1 = nz[:, :, 0], nz[:, :, 1]
-        la0 = jnp.where((cap0 == 0) | (r0 > cap0), 0.0,
-                        jnp.floor((cap0 - r0) * 100.0 / jnp.maximum(cap0, 1.0)))
-        la1 = jnp.where((cap1 == 0) | (r1 > cap1), 0.0,
-                        jnp.floor((cap1 - r1) * 100.0 / jnp.maximum(cap1, 1.0)))
-        least_alloc = jnp.floor((la0 + la1) / 2.0)
-        f0 = jnp.where(cap0 == 0, 1.0, jnp.minimum(1.0, r0 / jnp.maximum(cap0, 1.0)))
-        f1 = jnp.where(cap1 == 0, 1.0, jnp.minimum(1.0, r1 / jnp.maximum(cap1, 1.0)))
-        balanced = jnp.floor((1.0 - jnp.abs(f0 - f1) / 2.0) * 100.0)
+        least_alloc, balanced = _resource_scores(alloc_f[None, :, :2], nz)
         return fit, ports, least_alloc, balanced
 
     def assemble(fit, ports, least_alloc, balanced, active):
         """(eff incl. jitter+nominated boost, feasible, total) from the
         components — per-pod DefaultNormalizeScore over the feasible set."""
         feasible = static_ok & fit & ports & active[:, None]
-        t_max = jnp.max(jnp.where(feasible, taint_raw, 0.0), axis=1, keepdims=True)
-        t_scaled = jnp.floor(taint_raw * 100.0 / jnp.maximum(t_max, 1.0))
-        taint_n = jnp.where(t_max == 0, 100.0, 100.0 - t_scaled)
-        a_max = jnp.max(jnp.where(feasible, affinity_raw, 0.0), axis=1, keepdims=True)
-        a_scaled = jnp.floor(affinity_raw * 100.0 / jnp.maximum(a_max, 1.0))
-        aff_n = jnp.where(a_max == 0, 0.0, a_scaled)
+        taint_n = _normalize(jnp.broadcast_to(taint_raw, feasible.shape),
+                             feasible, True, axis=1)
+        aff_n = _normalize(jnp.broadcast_to(affinity_raw, feasible.shape),
+                           feasible, False, axis=1)
         total = (w_fit * least_alloc + w_bal * balanced + w_taint * taint_n
                  + w_aff * aff_n + w_img * image_score)
         eff = jnp.where(feasible, total + jitter + is_nom * np.float32(1e7),
@@ -214,9 +227,6 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         any_f = jnp.any(feasible, axis=1)                       # [P]
         choice = jnp.argmax(eff, axis=1).astype(jnp.int32)      # [P]
         failing = active & ~any_f
-        ff = static_ff
-        ff = jnp.where((ff == 0) & ~ports, np.int8(5), ff)
-        ff = jnp.where((ff == 0) & ~fit, np.int8(6), ff)
 
         # ---- tentative winners: lowest pod index per chosen node
         contender = active & any_f
@@ -242,11 +252,19 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         fit2, ports2, la2, bal2 = components(
             req_dyn + d_req, nz_dyn + d_nz, port_dyn | d_ports)
         rival = committed_any[None, :] & (win[None, :] < iota_p[:, None])
-        eff_mix, _feas_mix, _tot_mix = assemble(
-            jnp.where(rival, fit2, fit), jnp.where(rival, ports2, ports),
+        fit_mix = jnp.where(rival, fit2, fit)
+        ports_mix = jnp.where(rival, ports2, ports)
+        eff_mix, _feas_mix, tot_mix = assemble(
+            fit_mix, ports_mix,
             jnp.where(rival, la2, la), jnp.where(rival, bal2, bal), active)
         choice_mix = jnp.argmax(eff_mix, axis=1).astype(jnp.int32)
         unstable = accepted & (choice_mix != choice)
+        # decision-time rows for the outputs: mixed values ARE each pod's
+        # sequential view (for failing pods rival is empty, so mix ==
+        # round-start — exact either way)
+        ff_mix = static_ff
+        ff_mix = jnp.where((ff_mix == 0) & ~ports_mix, np.int8(5), ff_mix)
+        ff_mix = jnp.where((ff_mix == 0) & ~fit_mix, np.int8(6), ff_mix)
 
         # ---- strict prefix finalization: a pod may finalize only when every
         # lower-index active pod finalizes too, so each finalized pod's
@@ -277,12 +295,12 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         final = accepted | failing
         out_idx = jnp.where(accepted, choice, out_idx)
         best = jnp.where(final,
-                         jnp.take_along_axis(total, choice[:, None], 1)[:, 0],
+                         jnp.take_along_axis(tot_mix, choice[:, None], 1)[:, 0],
                          best)
         anyf_out = jnp.where(final, accepted, anyf_out)
-        fit_out = jnp.where(final[:, None], fit, fit_out)
-        ports_out = jnp.where(final[:, None], ports, ports_out)
-        ff_out = jnp.where(final[:, None], ff, ff_out)
+        fit_out = jnp.where(final[:, None], fit_mix, fit_out)
+        ports_out = jnp.where(final[:, None], ports_mix, ports_out)
+        ff_out = jnp.where(final[:, None], ff_mix, ff_out)
         done = done | final
         progressed = jnp.any(final)
         return (req_dyn, nz_dyn, port_dyn, done, out_idx, best, anyf_out,
@@ -551,16 +569,10 @@ def schedule_batch_core(
                 eligible = eligible | (iota_n + slot_offset == p_nom)
             feasible = feasible & eligible
 
-        # resource scores against the evolving requested state
+        # resource scores against the evolving requested state (shared
+        # formula with the speculative path: _resource_scores)
         nz_req = nz_dyn.astype(jnp.float32) + p_nz[None, :].astype(jnp.float32)
-        cap0, cap1 = alloc_f[:, 0], alloc_f[:, 1]
-        r0, r1 = nz_req[:, 0], nz_req[:, 1]
-        la0 = jnp.where((cap0 == 0) | (r0 > cap0), 0.0, jnp.floor((cap0 - r0) * 100.0 / jnp.maximum(cap0, 1.0)))
-        la1 = jnp.where((cap1 == 0) | (r1 > cap1), 0.0, jnp.floor((cap1 - r1) * 100.0 / jnp.maximum(cap1, 1.0)))
-        least_alloc = jnp.floor((la0 + la1) / 2.0)
-        f0 = jnp.where(cap0 == 0, 1.0, jnp.minimum(1.0, r0 / jnp.maximum(cap0, 1.0)))
-        f1 = jnp.where(cap1 == 0, 1.0, jnp.minimum(1.0, r1 / jnp.maximum(cap1, 1.0)))
-        balanced = jnp.floor((1.0 - jnp.abs(f0 - f1) / 2.0) * 100.0)
+        least_alloc, balanced = _resource_scores(alloc_f[:, :2], nz_req[:, :2])
 
         total = (
             weights["NodeResourcesFit"] * least_alloc

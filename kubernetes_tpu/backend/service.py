@@ -153,11 +153,17 @@ class DeviceService:
                 raise RuntimeError("device capacities refuse to converge")
             host_pb = self.device.encoder.last_host_pb
             self.batch_counter += 1
-            # adaptive sampling parity with the in-process batched path
+            # sampling parity with the in-process batched path: explicit
+            # percentage → exact rotating-window emulation; adaptive (0) →
+            # full-batch evaluation (the tpu_scheduler._flush_batch rule)
             from ..scheduler.scheduler import num_feasible_nodes_to_find
 
             n_valid = len(self.infos)
-            k = num_feasible_nodes_to_find(n_valid, self.percentage_of_nodes_to_score)
+            if self.percentage_of_nodes_to_score:
+                k = num_feasible_nodes_to_find(n_valid,
+                                               self.percentage_of_nodes_to_score)
+            else:
+                k = n_valid
             if k < n_valid:
                 sample_k = np.int32(k)
                 sample_start = (self._start_carry if self._start_carry is not None

@@ -406,11 +406,22 @@ class TPUScheduler(Scheduler):
         carry = None
         if prev is not None and prev.result.final_sel_counts is not None:
             carry = (prev.result.final_sel_counts, prev.result.final_seg_exist)
-        # adaptive sampling (percentageOfNodesToScore parity): only when the
-        # knob actually restricts — k == n means full evaluation and the
-        # plain (pallas-capable) program
+        # percentageOfNodesToScore: an EXPLICIT percentage gets the exact
+        # rotating-window emulation (schedule_one.go:525-545 parity). The
+        # adaptive default (0) runs FULL-batch evaluation instead — the
+        # reference's adaptive mode exists to bound per-cycle CPU time by
+        # examining fewer nodes, but on TPU the masked full evaluation is
+        # cheaper than the emulated early-exit (SURVEY §2.7 P2: "full-batch
+        # masked evaluation (cheaper on TPU than early exit); keep the knob
+        # for semantic parity") and it unlocks the speculative-decode
+        # program. This is the documented divergence SURVEY §7 hard-part 3
+        # allows; set percentageOfNodesToScore explicitly to restore the
+        # reference's sampled node-subset semantics.
         n_valid = self.cache.node_count()
-        k = self.num_feasible_nodes_to_find(n_valid)
+        if self.percentage_of_nodes_to_score:
+            k = self.num_feasible_nodes_to_find(n_valid)
+        else:
+            k = n_valid
         if k < n_valid:
             sample_k = np.int32(k)
             sample_start = (self._start_carry if self._start_carry is not None

@@ -289,11 +289,15 @@ def test_adaptive_sampling_scheduler_equivalence_small_cluster():
     assert sched_a.metrics["scheduled"] == 20
 
 
-def test_adaptive_sampling_spreads_on_large_cluster():
-    """At 150 nodes the adaptive default restricts to K=100: the batch path
-    must still place everything, with the comparer confirming validity."""
+def test_explicit_sampling_spreads_on_large_cluster():
+    """An EXPLICIT percentageOfNodesToScore gets the exact rotating-window
+    emulation (the adaptive default now runs full-batch evaluation — the
+    SURVEY §2.7 P2 divergence): at 150 nodes / 66% the window restricts to
+    K=100 and the batch path must still place everything, with the comparer
+    confirming validity."""
     store = ClusterStore()
-    sched = TPUScheduler(store, batch_size=16, comparer_every_n=4)
+    sched = TPUScheduler(store, batch_size=16, comparer_every_n=4,
+                         percentage_of_nodes_to_score=66)
     for i in range(150):
         store.create_node(
             make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 20}).obj())

@@ -50,10 +50,11 @@ class TestExactParity:
         np.testing.assert_array_equal(anyf_a, anyf_b)
         np.testing.assert_array_equal(req_a, req_b)
         np.testing.assert_array_equal(cls_a, cls_b)
-        # failure diagnosis must match for unschedulable pods (first_fail
-        # drives the scheduler's per-node failure attribution)
-        failed = ~anyf_a & (idx_a == -1)
-        np.testing.assert_array_equal(ff_a[failed], ff_b[failed])
+        # decision-time rows must match for every VALID pod — failures
+        # (first_fail drives per-node failure attribution) AND winners
+        # (mixed components are each pod's exact sequential view)
+        valid = (idx_a >= 0) | ~anyf_a
+        np.testing.assert_array_equal(ff_a[valid], ff_b[valid])
 
     def test_uniform_pods(self, monkeypatch):
         pods = [make_pod(f"p{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
