@@ -117,9 +117,8 @@ class Scheme:
         if not isinstance(obj, internal_type):
             raise SchemeError(
                 f"{gvk} encodes {internal_type.__name__}, got {type(obj).__name__}")
-        out = to_external(obj)
-        out["apiVersion"] = gvk.api_version
-        out["kind"] = gvk.kind
+        out = {"apiVersion": gvk.api_version, "kind": gvk.kind}
+        out.update(to_external(obj))
         return out
 
     def encode_json(self, obj: object,

@@ -218,9 +218,17 @@ class DeploymentController(Controller):
                 total = sum(alive(rs) for rs in olds)
                 initial = max(0, min(dep.replicas,
                                      dep.replicas + max_surge - total))
+            # revision annotation: 1 + the highest existing revision (the
+            # deployment controller's MaxRevision bookkeeping; kubectl
+            # rollout history/status reads it)
+            next_rev = 1 + max(
+                (int(rs.meta.annotations.get(
+                    "deployment.kubernetes.io/revision", 0) or 0)
+                 for rs in olds), default=0)
             new_rs = ReplicaSet(
                 meta=ObjectMeta(
                     name=new_name, namespace=dep.meta.namespace,
+                    annotations={"deployment.kubernetes.io/revision": str(next_rev)},
                     owner_references=(OwnerReference(
                         kind="Deployment", name=dep.meta.name, controller=True),),
                 ),

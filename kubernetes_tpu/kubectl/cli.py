@@ -32,6 +32,13 @@ GETTABLE = {
     "storageclasses": "StorageClass", "sc": "StorageClass",
     "leases": "Lease", "lease": "Lease",
     "priorityclasses": "PriorityClass", "pc": "PriorityClass",
+    "horizontalpodautoscalers": "HorizontalPodAutoscaler", "hpa": "HorizontalPodAutoscaler",
+    "configmaps": "ConfigMap", "configmap": "ConfigMap", "cm": "ConfigMap",
+    "serviceaccounts": "ServiceAccount", "serviceaccount": "ServiceAccount",
+    "sa": "ServiceAccount",
+    "poddisruptionbudgets": "PodDisruptionBudget", "pdb": "PodDisruptionBudget",
+    "cronjobs": "CronJob", "cronjob": "CronJob", "cj": "CronJob",
+    "clusterroles": "ClusterRole", "clusterrolebindings": "ClusterRoleBinding",
 }
 
 
@@ -53,6 +60,9 @@ def kubectl(store: ClusterStore, argv) -> str:
         "taint": _taint,
         "label": _label,
         "drain": _drain,
+        "top": _top,
+        "auth": _auth,
+        "rollout": _rollout,
     }
     h = handlers.get(verb)
     if h is None:
@@ -62,7 +72,7 @@ def kubectl(store: ClusterStore, argv) -> str:
 
 def _usage() -> str:
     return ("usage: kubectl get|describe|create|apply|delete|scale|"
-            "cordon|uncordon|taint|label|drain ...")
+            "cordon|uncordon|taint|label|drain|top|auth|rollout ...")
 
 
 def _namespace(args: List[str]) -> str:
@@ -81,7 +91,8 @@ def _positional(args: List[str]) -> List[str]:
         if skip:
             skip = False
             continue
-        if a in ("-n", "--namespace", "-f", "--filename", "--replicas"):
+        if a in ("-n", "--namespace", "-f", "--filename", "--replicas",
+                 "-o", "--output", "--as"):
             skip = True
             continue
         if a.startswith("-"):
@@ -105,9 +116,44 @@ def _get(store: ClusterStore, args: List[str], verb="get") -> str:
         objs = [o for o in objs if o.meta.name == pos[1]]
         if not objs:
             return f'Error from server (NotFound): {pos[0]} "{pos[1]}" not found'
+    output = _flag_value(args, "-o", "--output")
+    if output in ("yaml", "json"):
+        # versioned encode through the scheme (kubectl get -o yaml parity);
+        # kinds without a registered external version use the reflection
+        # codec with an explicit kind marker
+        import json as _json
+
+        import yaml as _yaml
+
+        from ..api.codec import to_wire
+        from ..api.scheme import SchemeError, default_scheme
+
+        scheme = default_scheme()
+        docs = []
+        for o in sorted(objs, key=lambda o: o.meta.name):
+            try:
+                docs.append(scheme.encode(o))
+            except SchemeError:
+                docs.append(dict(to_wire(o), kind=kind))
+        if not docs:
+            return "No resources found."
+        if output == "json":
+            payload = docs[0] if len(docs) == 1 else {"kind": "List", "items": docs}
+            return _json.dumps(payload, indent=2)
+        return _yaml.safe_dump_all(docs, sort_keys=False).rstrip()
     rows = [objects.columns_for(kind, o, store) for o in sorted(objs, key=lambda o: o.meta.name)]
     header = objects.header_for(kind)
     return _tabulate([header] + rows)
+
+
+def _flag_value(args: List[str], *names) -> Optional[str]:
+    for i, a in enumerate(args):
+        if a in names and i + 1 < len(args):
+            return args[i + 1]
+        for n in names:
+            if a.startswith(n + "="):
+                return a.split("=", 1)[1]
+    return None
 
 
 def _tabulate(rows: List[List[str]]) -> str:
@@ -329,3 +375,101 @@ def _drain(store, args, verb="drain"):
             store.delete_pod(pod.meta.key())
             evicted.append(pod.meta.name)
     return f"node/{pos[0]} drained ({len(evicted)} pods evicted)"
+
+
+def _top(store, args, verb="top"):
+    """kubectl top pods|nodes: usage from the metrics seam
+    (store.pod_metrics; the metrics-server stand-in)."""
+    pos = _positional(args)
+    if not pos or pos[0] not in ("pods", "pod", "po", "nodes", "node", "no"):
+        return "error: top needs pods|nodes"
+    ns = _namespace(args)
+    if pos[0] in ("pods", "pod", "po"):
+        rows = [["NAME", "CPU(cores)"]]
+        for key, milli in sorted(store.pod_metrics.items()):
+            pod = store.get_pod(key)
+            if pod is None or pod.meta.namespace != ns:
+                continue
+            rows.append([pod.meta.name, f"{milli}m"])
+        return _tabulate(rows)
+    # nodes: aggregate bound pods' usage per node
+    per_node = {}
+    for key, milli in store.pod_metrics.items():
+        pod = store.get_pod(key)
+        if pod is not None and pod.spec.node_name:
+            per_node[pod.spec.node_name] = per_node.get(pod.spec.node_name, 0) + milli
+    rows = [["NAME", "CPU(cores)", "CPU%"]]
+    for name in sorted(store.nodes):
+        node = store.nodes[name]
+        used = per_node.get(name, 0)
+        cap = node.allocatable_canonical().get("cpu", 0)
+        pct = f"{100 * used // cap}%" if cap else "<unknown>"
+        rows.append([name, f"{used}m", pct])
+    return _tabulate(rows)
+
+
+def _auth(store, args, verb="auth"):
+    """kubectl auth can-i VERB RESOURCE [--as USER]: answers from the
+    store's RBAC authorizer (apiserver/auth.py)."""
+    pos = _positional(args)
+    if len(pos) < 3 or pos[0] != "can-i":
+        return "error: auth can-i VERB RESOURCE"
+    as_user = _flag_value(args, "--as")
+    if as_user:
+        user, groups = as_user, ()
+    else:
+        user = store.request_user()
+        groups = store.request_groups() or (
+            ("system:masters",) if user == "system:admin" else ())
+    kind = GETTABLE.get(pos[2], pos[2])
+    authorizer = store.authorizer
+    if authorizer is None:
+        return "yes (no authorizer configured)"
+    check = getattr(authorizer, "allowed_for", None)
+    if check is not None:
+        ok = check(user, groups, pos[1], kind)
+    else:
+        ok = authorizer.allowed(user, pos[1], kind)
+    return "yes" if ok else "no"
+
+
+def _rollout(store, args, verb="rollout"):
+    """kubectl rollout status|history deployment NAME (the revision-tracked
+    ReplicaSets the deployment controller maintains)."""
+    pos = _positional(args)
+    if len(pos) < 3 or pos[0] not in ("status", "history"):
+        return "error: rollout status|history deployment NAME"
+    if GETTABLE.get(pos[1]) != "Deployment":
+        return "error: rollout supports deployments"
+    ns = _namespace(args)
+    dep = store.get_object("Deployment", f"{ns}/{pos[2]}")
+    if dep is None:
+        return f'Error from server (NotFound): deployment "{pos[2]}" not found'
+    revisions = []
+    for rs in store.snapshot_map("ReplicaSet").values():
+        ref = rs.meta.controller_of()
+        if (rs.meta.namespace == ns and ref is not None
+                and ref.kind == "Deployment" and ref.name == pos[2]):
+            rev = rs.meta.annotations.get("deployment.kubernetes.io/revision", "?")
+            revisions.append((rev, rs))
+    revisions.sort(key=lambda t: int(t[0]) if str(t[0]).isdigit() else -1)
+    if pos[0] == "history":
+        rows = [["REVISION", "REPLICASET", "REPLICAS"]]
+        for rev, rs in revisions:
+            rows.append([str(rev), rs.meta.name, str(rs.replicas)])
+        return _tabulate(rows)
+    # status: ready when the NEWEST revision's live pods cover spec.replicas
+    # (a mid-rollout deployment with old-revision pods is still waiting)
+    newest = revisions[-1][1].meta.name if revisions else None
+    ready = 0
+    for p in store.snapshot_map("Pod").values():
+        if p.meta.namespace != ns or p.status.phase not in ("Pending", "Running"):
+            continue
+        ref = p.meta.controller_of()
+        if (ref is not None and ref.kind == "ReplicaSet" and ref.name == newest
+                and p.spec.node_name):
+            ready += 1
+    if ready >= dep.replicas:
+        return f'deployment "{pos[2]}" successfully rolled out'
+    return (f'Waiting for deployment "{pos[2]}" rollout to finish: '
+            f'{ready} of {dep.replicas} updated replicas are available...')

@@ -1,0 +1,132 @@
+"""Round-3 kubectl verb breadth: -o yaml/json through the versioned scheme,
+top (metrics seam), auth can-i (RBAC), rollout status/history."""
+
+import json
+
+import yaml
+
+from kubernetes_tpu.api.types import Deployment, ObjectMeta, OwnerReference, ReplicaSet
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.auth import ClusterRole, ClusterRoleBinding, PolicyRule, RBACAuthorizer
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.kubectl.cli import kubectl
+
+
+def _cluster():
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity(
+        {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    pod = make_pod("web").req({"cpu": "500m"}).label("app", "web").obj()
+    store.create_pod(pod)
+    return store
+
+
+class TestOutputFormats:
+    def test_get_pod_o_yaml_is_versioned_manifest(self):
+        store = _cluster()
+        out = kubectl(store, "get pods web -o yaml")
+        doc = yaml.safe_load(out)
+        assert doc["apiVersion"] == "v1" and doc["kind"] == "Pod"
+        assert doc["metadata"]["name"] == "web"
+        assert doc["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "500m"
+
+    def test_get_o_json_list(self):
+        store = _cluster()
+        store.create_pod(make_pod("web2").req({"cpu": "100m"}).obj())
+        doc = json.loads(kubectl(store, "get pods -o json"))
+        assert doc["kind"] == "List" and len(doc["items"]) == 2
+
+    def test_yaml_round_trips_through_apply(self, tmp_path):
+        store = _cluster()
+        out = kubectl(store, "get pods web -o yaml")
+        f = tmp_path / "pod.yaml"
+        f.write_text(out.replace("name: web", "name: web-copy"))
+        store2 = ClusterStore()
+        msg = kubectl(store2, f"create -f {f}")
+        assert "created" in msg
+        assert store2.get_pod("default/web-copy") is not None
+
+
+class TestTop:
+    def test_top_pods_and_nodes(self):
+        store = _cluster()
+        store.pod_metrics["default/web"] = 250
+        # bind the pod so node aggregation sees it
+        from kubernetes_tpu.api.types import Binding
+
+        store.bind(Binding(pod_key="default/web", node_name="n1"))
+        pods_out = kubectl(store, "top pods")
+        assert "web" in pods_out and "250m" in pods_out
+        nodes_out = kubectl(store, "top nodes")
+        assert "n1" in nodes_out and "250m" in nodes_out and "6%" in nodes_out
+
+
+class TestAuthCanI:
+    def test_can_i_against_rbac(self):
+        store = ClusterStore()
+        store.create_object("ClusterRole", ClusterRole(
+            meta=ObjectMeta(name="reader"),
+            rules=(PolicyRule(verbs=("get", "list"), resources=("Pod",)),)))
+        store.create_object("ClusterRoleBinding", ClusterRoleBinding(
+            meta=ObjectMeta(name="rb"), role="reader", subjects=("user:alice",)))
+        store.authorizer = RBACAuthorizer(store)
+        assert kubectl(store, "auth can-i list pods --as alice") == "yes"
+        assert kubectl(store, "auth can-i delete pods --as alice") == "no"
+        assert kubectl(store, "auth can-i delete nodes") == "yes"  # admin/masters
+
+
+class TestRollout:
+    def _deployment(self, store):
+        store.create_object("Deployment", Deployment(
+            meta=ObjectMeta(name="web"), replicas=2))
+        store.create_object("ReplicaSet", ReplicaSet(
+            meta=ObjectMeta(
+                name="web-1", annotations={"deployment.kubernetes.io/revision": "1"},
+                owner_references=(OwnerReference(
+                    kind="Deployment", name="web", controller=True),)),
+            replicas=2))
+        for i in range(2):
+            p = make_pod(f"web-{i}").req({"cpu": "100m"}).obj()
+            p.meta.owner_references = (OwnerReference(
+                kind="ReplicaSet", name="web-1", controller=True),)
+            store.create_pod(p)
+
+    def test_status_waits_then_succeeds(self):
+        store = ClusterStore()
+        self._deployment(store)
+        out = kubectl(store, "rollout status deployment web")
+        assert "Waiting" in out
+        from kubernetes_tpu.api.types import Binding
+
+        store.create_node(make_node("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        store.bind(Binding(pod_key="default/web-0", node_name="n1"))
+        store.bind(Binding(pod_key="default/web-1", node_name="n1"))
+        out = kubectl(store, "rollout status deployment web")
+        assert "successfully rolled out" in out
+
+    def test_history_lists_revisions(self):
+        store = ClusterStore()
+        self._deployment(store)
+        out = kubectl(store, "rollout history deployment web")
+        assert "REVISION" in out and "web-1" in out
+
+    def test_status_waits_on_new_revision(self):
+        # mid-rollout: old-revision pods bound, new revision empty -> waiting
+        store = ClusterStore()
+        self._deployment(store)
+        store.create_node(make_node("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        from kubernetes_tpu.api.types import Binding
+
+        store.bind(Binding(pod_key="default/web-0", node_name="n1"))
+        store.bind(Binding(pod_key="default/web-1", node_name="n1"))
+        store.create_object("ReplicaSet", ReplicaSet(
+            meta=ObjectMeta(
+                name="web-2",
+                annotations={"deployment.kubernetes.io/revision": "2"},
+                owner_references=(OwnerReference(
+                    kind="Deployment", name="web", controller=True),)),
+            replicas=2))
+        out = kubectl(store, "rollout status deployment web")
+        assert "Waiting" in out
