@@ -366,24 +366,31 @@ class TPUScheduler(Scheduler):
                      t_pop: Optional[float] = None) -> None:
         if not batched:
             return
+        from ..utils import tracing
+
         self._maybe_profile()
         t0 = self.now_fn()
         t_pop = t_pop if t_pop is not None else t0
-        enc = self._try_pipelined_encode(batched)
+        with tracing.span("device.encode.pipelined", batch=len(batched)):
+            enc = self._try_pipelined_encode(batched)
         if enc is not None:
             pb, et, tb = enc
             t_sync = t0  # nothing to upload: the in-flight carry IS the state
         else:
+            # the drain lands the PREVIOUS batch (its commit spans are its
+            # own); only sync+encode below belong to THIS batch's spans
             self._drain_inflight()
             self._ensure_device()  # the drain's commit may have killed it
             self.cache.update_snapshot(self.snapshot)
             for _attempt in range(8):
                 try:
-                    self.device.sync(self.snapshot)
+                    with tracing.span("device.sync"):
+                        self.device.sync(self.snapshot)
                     t_sync = self.now_fn()
                     pods = [qp.pod for qp in batched]
-                    pb, et = self.device.encoder.encode_pods(pods)
-                    tb = self.device.sig_table.encode_topo(pods)
+                    with tracing.span("device.encode", batch=len(batched)):
+                        pb, et = self.device.encoder.encode_pods(pods)
+                        tb = self.device.sig_table.encode_topo(pods)
                     break
                 except CapacityError as e:
                     self._resync_grown(e)
@@ -431,17 +438,18 @@ class TPUScheduler(Scheduler):
             sample_start = None
         mode_info = self._topo_mode_info()
         topo_mode, vd_bucket, host_key = mode_info
-        result = self._run_batch_fn(
-            pb, et, self.device.nt, self.device.tc, tb, key,
-            adopt=True,
-            topo_enabled=self.device.topo_enabled,
-            topo_carry=carry,
-            sample_k=sample_k,
-            sample_start=sample_start,
-            topo_mode=topo_mode,
-            vd_override=vd_bucket,
-            host_key=host_key,
-        )
+        with tracing.span("device.dispatch", topo=topo_mode):
+            result = self._run_batch_fn(
+                pb, et, self.device.nt, self.device.tc, tb, key,
+                adopt=True,
+                topo_enabled=self.device.topo_enabled,
+                topo_carry=carry,
+                sample_k=sample_k,
+                sample_start=sample_start,
+                topo_mode=topo_mode,
+                vd_override=vd_bucket,
+                host_key=host_key,
+            )
         if result.final_sample_start is not None:
             # keep the rotation index across unsampled batches too (the
             # reference's nextStartNodeIndex persists across attempts) —
@@ -516,15 +524,19 @@ class TPUScheduler(Scheduler):
         materialization (e.g. the TPU relay dropping mid-flight) fails the
         whole batch back to the queue and rebuilds the device from the host
         cache — crash-only, §5.3."""
+        from ..utils import tracing
+
         t0 = self.now_fn()
         try:
             from ..utils import relay
 
             relay.count_sync("commit-read")  # THE one blocking read per batch
-            node_idx = np.asarray(fl.result.node_idx)
+            with tracing.span("device.commit.wait", batch=len(fl.qps)):
+                node_idx = np.asarray(fl.result.node_idx)
             self.device.adopt_commits(fl.result, fl.host_pb, node_idx)
-            self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0, node_idx,
-                               pb=fl.pb)
+            with tracing.span("host.commit", batch=len(fl.qps)):
+                self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0,
+                                   node_idx, pb=fl.pb)
             # reconcile: the commits above advanced node generations; the
             # ELIDE-ONLY reconcile refreshes _uploaded_gen for rows whose
             # content matches the adopted mirror, so the next

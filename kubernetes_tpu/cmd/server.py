@@ -84,6 +84,9 @@ def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
           raw: Optional[dict] = None, feature_gates: str = "",
           use_informers: bool = True, tpu: bool = False, **kwargs):
     """server.go:300 Setup: config + registries → a runnable scheduler."""
+    from ..utils.tracing import maybe_enable_from_env
+
+    maybe_enable_from_env()  # KTPU_TRACE_FILE: OTLP-shaped span export (§5.1)
     if feature_gates:
         DEFAULT_FEATURE_GATE.set_from_string(feature_gates)
     factory = SharedInformerFactory(store) if use_informers else None

@@ -345,6 +345,12 @@ class Scheduler:
 
     def schedule_pod(self, fwk: Framework, state: CycleState, pod: Pod) -> str:
         """(schedule_one.go:311) returns the chosen node name or raises FitError."""
+        from ..utils import tracing
+
+        with tracing.span("scheduling.cycle", pod=pod.key()):
+            return self._schedule_pod_traced(fwk, state, pod)
+
+    def _schedule_pod_traced(self, fwk: Framework, state: CycleState, pod: Pod) -> str:
         trace = Trace("Scheduling", now_fn=self.now_fn, pod=pod.key())
         self.cache.update_snapshot(self.snapshot)
         trace.step("Snapshotting scheduler cache and node infos done")

@@ -1,0 +1,160 @@
+"""OTel-style span tracing (SURVEY §5.1: component-base/traces/utils.go
+NewProvider — the OTLP exporter seam, re-expressed without an OTLP
+endpoint in this image).
+
+A process-global tracer (None = disabled, the default: the disabled check
+is one global read on the hot path). Spans nest per-thread; finished spans
+go to the exporter — in-memory for tests, JSON-lines for offline analysis
+(OTLP-shaped dicts: traceId/spanId/parentSpanId/name/start/end/attributes,
+loadable into any OTLP-compatible viewer).
+
+    tracing.enable(JsonFileExporter("/tmp/spans.jsonl"))
+    with tracing.span("scheduling.cycle", pod="ns/p"):
+        with tracing.span("device.dispatch"):
+            ...
+
+The scheduler wraps its cycle phases (snapshot/filter/score on the
+sequential path; sync/encode/dispatch/commit on the batch path), giving the
+per-phase latency attribution the reference gets from utiltrace +
+APIServerTracing spans."""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+import uuid
+from typing import List, Optional
+
+_tracer: Optional["Tracer"] = None
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start", "end",
+                 "attributes")
+
+    def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
+                 attributes: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_id = parent_id
+        self.start = time.time_ns()
+        self.end = 0
+        self.attributes = attributes
+
+    def to_otlp(self) -> dict:
+        return {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentSpanId": self.parent_id or "",
+            "name": self.name,
+            "startTimeUnixNano": self.start,
+            "endTimeUnixNano": self.end,
+            "attributes": [
+                {"key": k, "value": {"stringValue": str(v)}}
+                for k, v in self.attributes.items()
+            ],
+        }
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end - self.start) / 1e9
+
+
+class InMemoryExporter:
+    def __init__(self):
+        self.spans: List[Span] = []
+
+    def export(self, span: Span) -> None:
+        self.spans.append(span)
+
+    def by_name(self, name: str) -> List[Span]:
+        return [s for s in self.spans if s.name == name]
+
+
+class JsonFileExporter:
+    """One OTLP-shaped JSON object per line."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "a")
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._f.write(json.dumps(span.to_otlp()) + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class Tracer:
+    def __init__(self, exporter):
+        self.exporter = exporter
+        self._local = threading.local()
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attributes):
+        stack = self._stack()
+        if stack:
+            trace_id, parent_id = stack[-1].trace_id, stack[-1].span_id
+        else:
+            trace_id, parent_id = uuid.uuid4().hex, None
+        s = Span(name, trace_id, parent_id, attributes)
+        stack.append(s)
+        try:
+            yield s
+        finally:
+            s.end = time.time_ns()
+            stack.pop()
+            try:
+                self.exporter.export(s)
+            except Exception:  # noqa: BLE001 — tracing must never fail the
+                pass           # operation it instruments (a full disk would
+                               # otherwise read as device death upstream)
+
+
+def enable(exporter=None) -> "Tracer":
+    """Install the process tracer (None exporter = in-memory)."""
+    global _tracer
+    _tracer = Tracer(exporter or InMemoryExporter())
+    return _tracer
+
+
+def disable() -> None:
+    global _tracer
+    _tracer = None
+
+
+def get() -> Optional[Tracer]:
+    return _tracer
+
+
+@contextlib.contextmanager
+def span(name: str, **attributes):
+    """No-op when tracing is disabled (one global read)."""
+    t = _tracer
+    if t is None:
+        yield None
+    else:
+        with t.span(name, **attributes) as s:
+            yield s
+
+
+def maybe_enable_from_env() -> None:
+    """KTPU_TRACE_FILE=<path> turns on JSON-lines span export (the
+    --tracing-config-file analog of the cmd binaries)."""
+    path = os.environ.get("KTPU_TRACE_FILE")
+    if path and _tracer is None:
+        enable(JsonFileExporter(path))

@@ -1,0 +1,76 @@
+"""OTel-style span tracing (SURVEY §5.1): nesting, OTLP shape, JSON export,
+and the scheduler's cycle-phase spans on both paths."""
+
+import json
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.utils import tracing
+
+
+class TestTracer:
+    def teardown_method(self):
+        tracing.disable()
+
+    def test_nesting_and_otlp_shape(self):
+        tracer = tracing.enable()
+        with tracing.span("parent", cluster="test") as parent:
+            with tracing.span("child") as child:
+                pass
+        exp = tracer.exporter
+        assert [s.name for s in exp.spans] == ["child", "parent"]
+        c, p = exp.spans
+        assert c.trace_id == p.trace_id and c.parent_id == p.span_id
+        otlp = p.to_otlp()
+        assert otlp["name"] == "parent" and otlp["parentSpanId"] == ""
+        assert {"key": "cluster", "value": {"stringValue": "test"}} in otlp["attributes"]
+        assert c.duration_s >= 0
+
+    def test_disabled_is_noop(self):
+        with tracing.span("nothing") as s:
+            assert s is None
+
+    def test_json_file_exporter(self, tmp_path):
+        path = str(tmp_path / "spans.jsonl")
+        tracing.enable(tracing.JsonFileExporter(path))
+        with tracing.span("one"):
+            pass
+        line = json.loads(open(path).read().strip())
+        assert line["name"] == "one" and line["endTimeUnixNano"] > 0
+
+    def test_env_enable(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KTPU_TRACE_FILE", str(tmp_path / "t.jsonl"))
+        tracing.maybe_enable_from_env()
+        assert tracing.get() is not None
+
+
+class TestSchedulerSpans:
+    def teardown_method(self):
+        tracing.disable()
+
+    def test_sequential_cycle_span(self):
+        tracer = tracing.enable()
+        store = ClusterStore()
+        sched = Scheduler(store)
+        store.create_node(make_node("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        cycles = tracer.exporter.by_name("scheduling.cycle")
+        assert cycles and cycles[0].attributes["pod"] == "default/p"
+
+    def test_batch_phase_spans(self):
+        tracer = tracing.enable()
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=8)
+        for i in range(4):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        for i in range(6):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        names = {s.name for s in tracer.exporter.spans}
+        assert {"device.encode", "device.dispatch", "device.commit.wait",
+                "host.commit"} <= names
