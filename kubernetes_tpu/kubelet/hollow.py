@@ -32,7 +32,8 @@ class HollowKubelet:
     def __init__(self, store: ClusterStore, node: Node,
                  now_fn=time.monotonic,
                  startup_delay: float = DEFAULT_STARTUP_DELAY,
-                 lease_duration: float = DEFAULT_LEASE_DURATION):
+                 lease_duration: float = DEFAULT_LEASE_DURATION,
+                 runtime=None):
         self.store = store
         self.node_name = node.name()
         self._node_template = node
@@ -41,6 +42,12 @@ class HollowKubelet:
         self.lease_duration = lease_duration
         self._started_at: Dict[str, float] = {}  # pod key → Running since
         self.registered = False
+        # CRI runtime (kubelet/cri.py FakeRuntimeService or CRIClient):
+        # when present, syncPod materializes pod state through RunPodSandbox/
+        # CreateContainer/StartContainer and teardown through StopPodSandbox/
+        # RemovePodSandbox (kubelet.go:1502 syncPod's runtime calls)
+        self.runtime = runtime
+        self._sandbox_of: Dict[str, str] = {}  # pod key → sandbox id
 
     # ------------------------------------------------------------ registration
 
@@ -109,6 +116,7 @@ class HollowKubelet:
                 key=lambda p: p.meta.resource_version,
             )
             for pod in active[allowed:]:
+                self._runtime_remove(pod.meta.key())  # evicted: tear down
                 self._set_phase(pod, "Failed")
                 transitions += 1
             my_pods = self._my_pods()
@@ -117,20 +125,64 @@ class HollowKubelet:
             if pod.status.phase == "Pending":
                 started = self._started_at.setdefault(key, now)
                 if now - started >= self.startup_delay:
+                    self._runtime_start(pod)
                     self._set_phase(pod, "Running", start_time=now)
                     transitions += 1
             elif pod.status.phase == "Running":
                 self._started_at.setdefault(key, now)
+                if self.runtime is not None and key not in self._sandbox_of:
+                    # bound pods arrive already Running (the binding
+                    # subresource sets the phase); reconcile the runtime to
+                    # match — the PLEG relist-and-repair direction
+                    self._runtime_start(pod)
                 ttl = pod.meta.annotations.get(TERMINATES_AFTER_ANNOTATION)
                 if ttl is not None and now - self._started_at[key] >= float(ttl):
+                    self._runtime_stop(key)
                     self._set_phase(pod, "Succeeded")
                     transitions += 1
-        # forget state for pods that left the node
+        # forget state for pods that left the node; their sandboxes are
+        # removed (the PLEG relist + garbage path, pleg/generic.go)
         live = {p.meta.key() for p in self._my_pods()}
         for key in list(self._started_at):
             if key not in live:
                 del self._started_at[key]
+                self._runtime_remove(key)
         return transitions
+
+    # ---------------------------------------------------------- CRI syncPod
+
+    def _runtime_start(self, pod: Pod) -> None:
+        """syncPod's create path: sandbox up, containers created+started."""
+        if self.runtime is None:
+            return
+        sid = self.runtime.run_pod_sandbox({
+            "name": pod.meta.name, "namespace": pod.meta.namespace,
+            "uid": pod.meta.uid, "labels": dict(pod.meta.labels)})
+        self._sandbox_of[pod.meta.key()] = sid
+        for c in pod.spec.containers:
+            cid = self.runtime.create_container(
+                sid, {"name": c.name, "image": c.image})
+            self.runtime.start_container(cid)
+
+    def _runtime_stop(self, pod_key: str) -> None:
+        """Graceful completion: containers stop first (exit 0 — a Succeeded
+        pod's containers must not read as SIGKILLed), then the sandbox."""
+        if self.runtime is None:
+            return
+        sid = self._sandbox_of.get(pod_key)
+        if sid is not None:
+            for c in self.runtime.list_containers(sid):
+                if c["state"] == "CONTAINER_RUNNING":
+                    self.runtime.stop_container(c["id"])
+            self.runtime.stop_pod_sandbox(sid)
+
+    def _runtime_remove(self, pod_key: str) -> None:
+        if self.runtime is None:
+            return
+        sid = self._sandbox_of.pop(pod_key, None)
+        if sid is not None:
+            self.runtime.stop_pod_sandbox(sid)
+            self.runtime.remove_pod_sandbox(sid)
 
     def _set_phase(self, pod: Pod, phase: str, start_time: Optional[float] = None) -> None:
         new = pod.clone()

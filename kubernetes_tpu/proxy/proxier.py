@@ -91,3 +91,40 @@ class Proxier:
         with self._lock:
             r = self.rules.get(service_key)
             return list(r.backends) if r else []
+
+    # -- iptables-save rendering
+
+    def render_iptables(self) -> str:
+        """The rules as iptables-save text — the wire format syncProxyRules
+        writes through iptables-restore (proxier.go:809 builds exactly these
+        KUBE-SERVICES/KUBE-SVC-*/KUBE-SEP-* chains with statistic-mode
+        random jumps). No netfilter here; the text is the contract."""
+        import hashlib
+
+        def chain_hash(kind: str, key: str) -> str:
+            return f"KUBE-{kind}-{hashlib.sha256(key.encode()).hexdigest()[:16].upper()}"
+
+        lines = ["*nat", ":KUBE-SERVICES - [0:0]"]
+        chains, rules = [], []
+        with self._lock:
+            snapshot = sorted(self.rules.items())
+        for key, r in snapshot:
+            svc_chain = chain_hash("SVC", key)
+            chains.append(f":{svc_chain} - [0:0]")
+            rules.append(
+                f'-A KUBE-SERVICES -m comment --comment "{key}" -j {svc_chain}')
+            n = len(r.backends)
+            for i, backend in enumerate(r.backends):
+                sep_chain = chain_hash("SEP", f"{key}/{backend}")
+                chains.append(f":{sep_chain} - [0:0]")
+                if i < n - 1:
+                    prob = 1.0 / (n - i)
+                    rules.append(
+                        f"-A {svc_chain} -m statistic --mode random "
+                        f"--probability {prob:.10f} -j {sep_chain}")
+                else:
+                    rules.append(f"-A {svc_chain} -j {sep_chain}")
+                rules.append(
+                    f'-A {sep_chain} -m comment --comment "{backend}" '
+                    f"-j DNAT --to-destination {backend}")
+        return "\n".join(lines + chains + rules + ["COMMIT", ""])

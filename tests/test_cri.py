@@ -1,0 +1,166 @@
+"""CRI seam (cri-api v1 api.proto, reduced): the fake runtime's state
+machines, the real gRPC binding, the kubelet driving pod lifecycle through
+it, and the kube-proxy iptables-save rendering."""
+
+from kubernetes_tpu.api.types import Binding, Endpoints, EndpointAddress, ObjectMeta, Service
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.kubelet.cri import CRIClient, FakeRuntimeService, serve_cri
+from kubernetes_tpu.kubelet.hollow import HollowKubelet
+from kubernetes_tpu.proxy.proxier import Proxier
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+class TestFakeRuntime:
+    def test_sandbox_container_lifecycle(self):
+        rt = FakeRuntimeService()
+        sid = rt.run_pod_sandbox({"name": "web", "namespace": "prod"})
+        assert rt.pod_sandbox_status(sid)["state"] == "SANDBOX_READY"
+        cid = rt.create_container(sid, {"name": "app", "image": "nginx:1.25"})
+        assert rt.container_status(cid)["state"] == "CONTAINER_CREATED"
+        rt.start_container(cid)
+        assert rt.container_status(cid)["state"] == "CONTAINER_RUNNING"
+        assert any(i["repo_tags"] == ["nginx:1.25"] for i in rt.list_images())
+        rt.stop_pod_sandbox(sid)
+        assert rt.container_status(cid)["state"] == "CONTAINER_EXITED"
+        assert rt.container_status(cid)["exit_code"] == 137
+        rt.remove_pod_sandbox(sid)
+        assert rt.list_pod_sandbox() == [] and rt.list_containers() == []
+
+    def test_graceful_stop_exit_zero(self):
+        rt = FakeRuntimeService()
+        sid = rt.run_pod_sandbox({"name": "p", "namespace": "default"})
+        cid = rt.create_container(sid, {"name": "c", "image": "x"})
+        rt.start_container(cid)
+        rt.stop_container(cid)
+        c = rt.container_status(cid)
+        assert c["state"] == "CONTAINER_EXITED" and c["exit_code"] == 0
+
+
+class TestCRIOverGrpc:
+    def test_full_lifecycle_over_the_wire(self):
+        rt = FakeRuntimeService()
+        server, port = serve_cri(rt)
+        try:
+            client = CRIClient(f"127.0.0.1:{port}")
+            v = client.version()
+            assert v["runtime_name"] == "ktpu-hollow"
+            sid = client.run_pod_sandbox({"name": "web", "namespace": "prod"})
+            cid = client.create_container(sid, {"name": "app", "image": "nginx"})
+            client.start_container(cid)
+            assert client.list_containers(sid)[0]["state"] == "CONTAINER_RUNNING"
+            assert client.list_pod_sandbox()[0]["config"]["name"] == "web"
+            client.stop_pod_sandbox(sid)
+            client.remove_pod_sandbox(sid)
+            assert client.list_pod_sandbox() == []
+            client.close()
+        finally:
+            server.stop(0)
+
+
+class TestKubeletOverCRI:
+    def test_pod_lifecycle_materializes_in_runtime(self):
+        clock = FakeClock()
+        store = ClusterStore()
+        rt = FakeRuntimeService(now_fn=clock)
+        node = make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        kubelet = HollowKubelet(store, node, now_fn=clock, runtime=rt)
+        kubelet.run_once()
+        pod = make_pod("web").req({"cpu": "100m"}).obj()
+        pod.meta.annotations["kubelet/terminates-after"] = "5"
+        store.create_pod(pod)
+        store.bind(Binding(pod_key="default/web", node_name="n1"))
+        kubelet.run_once()
+        assert store.get_pod("default/web").status.phase == "Running"
+        assert rt.list_pod_sandbox()[0]["config"]["name"] == "web"
+        assert rt.list_containers()[0]["state"] == "CONTAINER_RUNNING"
+        clock.advance(6)
+        kubelet.run_once()
+        assert store.get_pod("default/web").status.phase == "Succeeded"
+        assert rt.list_containers()[0]["state"] == "CONTAINER_EXITED"
+        # pod deleted -> sandbox garbage-collected
+        store.delete_pod("default/web")
+        kubelet.run_once()
+        assert rt.list_pod_sandbox() == []
+
+    def test_ttl_completion_exits_zero(self):
+        # Succeeded pods' containers must read exit 0 (graceful), not 137
+        clock = FakeClock()
+        store = ClusterStore()
+        rt = FakeRuntimeService(now_fn=clock)
+        node = make_node("n1").capacity({"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        kubelet = HollowKubelet(store, node, now_fn=clock, runtime=rt)
+        kubelet.run_once()
+        pod = make_pod("job").req({"cpu": "100m"}).obj()
+        pod.meta.annotations["kubelet/terminates-after"] = "3"
+        store.create_pod(pod)
+        store.bind(Binding(pod_key="default/job", node_name="n1"))
+        kubelet.run_once()
+        clock.advance(4)
+        kubelet.run_once()
+        assert store.get_pod("default/job").status.phase == "Succeeded"
+        c = rt.list_containers()[0]
+        assert c["state"] == "CONTAINER_EXITED" and c["exit_code"] == 0
+
+    def test_evicted_pod_sandbox_torn_down(self):
+        clock = FakeClock()
+        store = ClusterStore()
+        rt = FakeRuntimeService(now_fn=clock)
+        node = make_node("n1").capacity({"cpu": "8", "memory": "8Gi", "pods": 1}).obj()
+        kubelet = HollowKubelet(store, node, now_fn=clock, runtime=rt)
+        kubelet.run_once()
+        for name in ("a", "b"):
+            store.create_pod(make_pod(name).req({"cpu": "100m"}).obj())
+            store.bind(Binding(pod_key=f"default/{name}", node_name="n1"))
+        kubelet.run_once()
+        kubelet.run_once()
+        phases = {p.meta.name: p.status.phase for p in store.pods.values()}
+        assert "Failed" in phases.values()
+        # exactly one sandbox remains (the surviving pod's)
+        assert len(rt.list_pod_sandbox()) == 1
+
+    def test_kubelet_over_grpc_runtime(self):
+        clock = FakeClock()
+        store = ClusterStore()
+        rt = FakeRuntimeService(now_fn=clock)
+        server, port = serve_cri(rt)
+        try:
+            client = CRIClient(f"127.0.0.1:{port}")
+            node = make_node("n1").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+            kubelet = HollowKubelet(store, node, now_fn=clock, runtime=client)
+            kubelet.run_once()
+            store.create_pod(make_pod("w").req({"cpu": "100m"}).obj())
+            store.bind(Binding(pod_key="default/w", node_name="n1"))
+            kubelet.run_once()
+            assert store.get_pod("default/w").status.phase == "Running"
+            # the state landed in the REMOTE runtime, over real gRPC
+            assert rt.list_containers()[0]["state"] == "CONTAINER_RUNNING"
+            assert "RunPodSandbox" in rt.calls and "StartContainer" in rt.calls
+            client.close()
+        finally:
+            server.stop(0)
+
+
+class TestIptablesRendering:
+    def test_chains_and_probabilities(self):
+        store = ClusterStore()
+        store.create_service(Service(meta=ObjectMeta(name="web"),
+                                     selector={"app": "web"}))
+        store.create_object("Endpoints", Endpoints(
+            meta=ObjectMeta(name="web"),
+            addresses=(EndpointAddress(pod_key="default/p1", node_name="n1"),
+                       EndpointAddress(pod_key="default/p2", node_name="n2"),
+                       EndpointAddress(pod_key="default/p3", node_name="n3"))))
+        proxier = Proxier(store)
+        proxier.mark_dirty("default/web")
+        proxier.sync_proxy_rules()
+        text = proxier.render_iptables()
+        assert text.startswith("*nat")
+        assert text.rstrip().endswith("COMMIT")
+        assert "-j KUBE-SVC-" in text
+        # 3 backends: first jump at p=1/3, second at 1/2, last unconditional
+        assert "--probability 0.3333333333" in text
+        assert "--probability 0.5000000000" in text
+        assert text.count("KUBE-SEP-") >= 6  # 3 chains declared + 3 jumps
+        assert '--comment "default/p1"' in text
