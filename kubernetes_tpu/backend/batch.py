@@ -40,9 +40,15 @@ import numpy as np
 from jax import lax
 
 from ..ops import filters, pallas_step, scores, topology
-from ..ops.topology import _gmax, _gmin, _gsum
+from ..ops.topology import INT_MAX, _gmax, _gmin, _gsum
 from ..ops.schema import ExprTable, NodeTensors, PodBatch, TopoBatch, TopoCounts
 from ..ops.select import NEG_INF
+
+
+def _tb_dict(tb: TopoBatch) -> dict:
+    """TopoBatch as the field dict the compiled programs consume (one
+    definition shared by the scan xs and the speculative host path)."""
+    return {f.name: getattr(tb, f.name) for f in dataclasses.fields(tb)}
 
 
 def pallas_mode(nt: NodeTensors, axis_name, topo_enabled: bool) -> Optional[str]:
@@ -156,7 +162,7 @@ def _resource_scores(alloc2: jax.Array, nz_total: jax.Array):
 
 def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
                       affinity_raw, image_score, pod_bits, jitter,
-                      sel0, seg0) -> BatchResult:
+                      sel0, seg0, host=None) -> BatchResult:
     """Speculative decode for non-topology batches (ROADMAP r3 perf 2).
 
     The scan commits one pod per step — P dependent steps whose per-step
@@ -178,7 +184,14 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
     next round's first active pod always finalizes (it wins its node by
     index-minimality and has no earlier rivals), so each round retires ≥1
     pod and the while_loop terminates in ≤P rounds (typically ~P/(first-
-    conflict index) rounds: distinct jitter spreads identical pods)."""
+    conflict index) rounds: distinct jitter spreads identical pods).
+
+    ``host`` (optional) extends the rounds to the HOSTNAME topology fast
+    path (ops/topology.py *_host): every topology table is [*, N] node-
+    local there, so the same rival-mix trick yields each pod's exact
+    sequential view of spread/inter-pod-affinity state. Keys: the TopoBatch
+    field dict, hostkey_ok [N], affinity_ok [P, N] (the NodeAffinity static
+    mask the spread filter's eligibility uses)."""
     P = pb.capacity
     N = nt.capacity
     alloc = nt.allocatable
@@ -191,6 +204,138 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
     w_taint = np.float32(weights["TaintToleration"])
     w_aff = np.float32(weights["NodeAffinity"])
     w_img = np.float32(weights["ImageLocality"])
+    w_spread = np.float32(weights["PodTopologySpread"])
+    w_ipa = np.float32(weights["InterPodAffinity"])
+    if host is not None:
+        tbx, hostkey_ok, affinity_ok = (
+            host["tb"], host["hostkey_ok"], host["affinity_ok"])
+        sig_mask_f = tbx["pod_sig_mask"].astype(jnp.int32)      # [P, S]
+        term_mask_f = tbx["pod_term_mask"].astype(jnp.int32)    # [P, T]
+        hk_f = hostkey_ok.astype(jnp.int32)                     # [N]
+
+    def topo_eval(sel_view, term_view, rival, active):
+        """Host-mode spread/IPA filters from a (possibly per-pod mixed)
+        view: sel_view/term_view = (base [S|T, N], round-delta [S|T, N]);
+        rival [P, N] selects where the delta applies (None = base only)."""
+        sel_base, sel_d = sel_view
+        term_base, term_d = term_view
+
+        def mixed(table_base, table_d, rows):
+            # [P, C, N]: per-pod gathered counts with rival-local deltas
+            base = table_base[rows]                              # [P, C, N]
+            if rival is None:
+                return base
+            return base + table_d[rows] * rival[:, None, :]
+
+        valid_n = nt.valid
+        # ---- spread filter (topology.spread_filter_host)
+        elig = valid_n[None, :] & affinity_ok & hostkey_ok[None, :] \
+            & active[:, None]                                    # [P, N]
+        # NOTE: the scan's elig has no `active` term — it is per-pod anyway;
+        # masking by active only skips work for done pods (their rows are
+        # never read) and keeps reductions well-defined.
+        cnt_sf = mixed(sel_base, sel_d, tbx["sf_sig"])           # [P, C, N]
+        minm = jnp.min(jnp.where(elig[:, None, :], cnt_sf, INT_MAX), axis=2)
+        ndom = jnp.sum(elig.astype(jnp.int32), axis=1)           # [P]
+        any_pres = ndom > 0
+        minm = jnp.where(any_pres[:, None], minm, 0)
+        minm = jnp.where((tbx["sf_min_domains"] >= 0)
+                         & (ndom[:, None] < tbx["sf_min_domains"]), 0, minm)
+        ok_c = hostkey_ok[None, None, :] & (
+            cnt_sf + tbx["sf_self"][:, :, None].astype(jnp.int32)
+            - minm[:, :, None] <= tbx["sf_skew"][:, :, None])
+        spread_ok = jnp.all(
+            jnp.where(tbx["sf_valid"][:, :, None], ok_c, True), axis=1)
+
+        # ---- IPA filter (topology.ipa_filter_host)
+        cnt_ia = mixed(sel_base, sel_d, tbx["ia_sig"])           # [P, A, N]
+        exist = hostkey_ok[None, None, :] & (cnt_ia > 0)
+        ia_valid = tbx["ia_valid"]
+        pods_exist = jnp.all(
+            jnp.where(ia_valid[:, :, None], exist, True), axis=1)
+        all_keys = jnp.all(
+            jnp.where(ia_valid[:, :, None], hostkey_ok[None, None, :], True),
+            axis=1)
+        tot_mask = (ia_valid[:, :, None] & valid_n[None, None, :]
+                    & hostkey_ok[None, None, :])
+        total = jnp.sum(jnp.where(tot_mask, cnt_ia, 0), axis=(1, 2))  # [P]
+        first_ok = (total == 0) & tbx["ia_self_all"]
+        has_terms = jnp.any(ia_valid, axis=1)
+        aff_ok = (~has_terms[:, None]) | (
+            all_keys & (pods_exist | first_ok[:, None]))
+        cnt_an = mixed(sel_base, sel_d, tbx["ianti_sig"])        # [P, A, N]
+        viol = jnp.any(tbx["ianti_valid"][:, :, None]
+                       & hostkey_ok[None, None, :] & (cnt_an > 0), axis=1)
+        anti_ok = ~viol
+        # existing-term anti check: [P,T]x[T,N] matmuls keep the [P,T,N]
+        # tensor virtual (T can be large)
+        m = tbx["term_filter_match"].astype(jnp.int32)           # [P, T]
+        viol_cnt = m @ (term_base * hk_f[None, :])
+        if rival is not None:
+            viol_cnt = viol_cnt + (m @ (term_d * hk_f[None, :])) * rival
+        exist_ok = viol_cnt == 0
+        ipa_ok = aff_ok & anti_ok & exist_ok
+        return spread_ok, ipa_ok
+
+    def topo_scores(sel_view, term_view, rival, feasible):
+        """Host-mode spread/IPA scores (topology.spread_score_host /
+        ipa_score_host) against the same view, normalized per pod over its
+        feasible set."""
+        sel_base, sel_d = sel_view
+        term_base, term_d = term_view
+
+        def mixed(rows):
+            base = sel_base[rows]
+            if rival is None:
+                return base
+            return base + sel_d[rows] * rival[:, None, :]
+
+        # spread score
+        ignored = tbx["ss_require_all"][:, None] & ~hostkey_ok[None, :]
+        base_mask = feasible & ~ignored                          # [P, N]
+        n_base = jnp.sum(base_mask.astype(jnp.int32), axis=1)    # [P]
+        w = jnp.log(n_base.astype(jnp.float32) + 2.0)[:, None]   # [P, 1]
+        cnt_ss = mixed(tbx["ss_sig"]).astype(jnp.float32)        # [P, C, N]
+        contrib = jnp.where(
+            tbx["ss_valid"][:, :, None] & hostkey_ok[None, None, :],
+            cnt_ss * w[:, :, None]
+            + (tbx["ss_skew"][:, :, None].astype(jnp.float32) - 1.0),
+            0.0)
+        raw = jnp.floor(jnp.sum(contrib, axis=1) + 0.5)          # [P, N]
+        mx = jnp.max(jnp.where(base_mask, raw, -jnp.inf), axis=1, keepdims=True)
+        mn = jnp.min(jnp.where(base_mask, raw, jnp.inf), axis=1, keepdims=True)
+        any_base = jnp.any(base_mask, axis=1, keepdims=True)
+        norm = jnp.where(mx == 0, 100.0,
+                         jnp.floor(100.0 * (mx + mn - raw) / jnp.maximum(mx, 1.0)))
+        norm = jnp.where(ignored | ~any_base, 0.0, norm)
+        has_cons = jnp.any(tbx["ss_valid"], axis=1)[:, None]
+        spread_score = jnp.where(has_cons, norm, 0.0)
+
+        # IPA score
+        cnt_ip = mixed(tbx["ip_sig"]).astype(jnp.float32)        # [P, PT, N]
+        pref = jnp.sum(
+            jnp.where(tbx["ip_valid"][:, :, None] & hostkey_ok[None, None, :],
+                      tbx["ip_w"][:, :, None].astype(jnp.float32) * cnt_ip,
+                      0.0),
+            axis=1)                                              # [P, N]
+        tsw = tbx["term_score_w"]                                # [P, T] f32
+        hk_ff = hk_f.astype(jnp.float32)
+        sym = tsw @ (term_base.astype(jnp.float32) * hk_ff[None, :])
+        if rival is not None:
+            sym = sym + (tsw @ (term_d.astype(jnp.float32)
+                                * hk_ff[None, :])) * rival
+        raw_ip = pref + sym
+        mx_ip = jnp.maximum(
+            jnp.max(jnp.where(feasible, raw_ip, -jnp.inf), axis=1, keepdims=True),
+            0.0)
+        mn_ip = jnp.minimum(
+            jnp.min(jnp.where(feasible, raw_ip, jnp.inf), axis=1, keepdims=True),
+            0.0)
+        diff = mx_ip - mn_ip
+        ipa_score = jnp.where(
+            diff > 0, jnp.floor(100.0 * (raw_ip - mn_ip) / jnp.maximum(diff, 1.0)),
+            0.0)
+        return spread_score, ipa_score
 
     def components(req_dyn, nz_dyn, port_dyn):
         """State-dependent per-(pod,node) pieces: (fit, ports, la, balanced)."""
@@ -204,26 +349,40 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         least_alloc, balanced = _resource_scores(alloc_f[None, :, :2], nz)
         return fit, ports, least_alloc, balanced
 
-    def assemble(fit, ports, least_alloc, balanced, active):
-        """(eff incl. jitter+nominated boost, feasible, total) from the
-        components — per-pod DefaultNormalizeScore over the feasible set."""
+    def assemble(fit, ports, least_alloc, balanced, active,
+                 sel_view=None, term_view=None, rival=None):
+        """(eff incl. jitter+nominated boost, feasible, total, spread_ok,
+        ipa_ok) from the components — per-pod DefaultNormalizeScore over the
+        feasible set; host mode adds the topology filters to feasibility and
+        the topology scores to the total (same order as the scan step)."""
         feasible = static_ok & fit & ports & active[:, None]
+        if host is not None:
+            spread_ok, ipa_ok = topo_eval(sel_view, term_view, rival, active)
+            feasible = feasible & spread_ok & ipa_ok
+        else:
+            spread_ok = ipa_ok = None
         taint_n = _normalize(jnp.broadcast_to(taint_raw, feasible.shape),
                              feasible, True, axis=1)
         aff_n = _normalize(jnp.broadcast_to(affinity_raw, feasible.shape),
                            feasible, False, axis=1)
         total = (w_fit * least_alloc + w_bal * balanced + w_taint * taint_n
                  + w_aff * aff_n + w_img * image_score)
+        if host is not None:
+            sp_s, ip_s = topo_scores(sel_view, term_view, rival, feasible)
+            total = total + w_spread * sp_s + w_ipa * ip_s
         eff = jnp.where(feasible, total + jitter + is_nom * np.float32(1e7),
                         NEG_INF)
-        return eff, feasible, total
+        return eff, feasible, total, spread_ok, ipa_ok
 
     def body(carry):
-        (req_dyn, nz_dyn, port_dyn, done, out_idx, best, anyf_out,
-         fit_out, ports_out, ff_out, _progress) = carry
+        (req_dyn, nz_dyn, port_dyn, sel_dyn, term_dyn, done, out_idx, best,
+         anyf_out, fit_out, ports_out, spread_out, ipa_out, ff_out,
+         _progress) = carry
         active = ~done & pb.valid
         fit, ports, la, bal = components(req_dyn, nz_dyn, port_dyn)
-        eff, feasible, total = assemble(fit, ports, la, bal, active)
+        eff, feasible, total, _sp, _ip = assemble(
+            fit, ports, la, bal, active,
+            sel_view=(sel_dyn, None), term_view=(term_dyn, None))
         any_f = jnp.any(feasible, axis=1)                       # [P]
         choice = jnp.argmax(eff, axis=1).astype(jnp.int32)      # [P]
         failing = active & ~any_f
@@ -237,12 +396,13 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         # ---- exact stability: rebuild each winner i's SEQUENTIAL view.
         # The only nodes whose state differs at i's sequential turn are the
         # RIVALS (nodes committed this round by winners j<i, each carrying
-        # exactly its own delta — picks are distinct). Mixing post-commit
-        # components on rival nodes with round-start components elsewhere,
-        # then re-running the per-pod normalization (whose max couples every
-        # node's score to the feasible SET), reproduces the scan's exact eff
-        # surface for pod i; the winner finalizes only if its argmax is
-        # unmoved.
+        # exactly its own delta — picks are distinct; in host mode the
+        # topology tables are node-local too, so the same rival masking
+        # covers sel_counts/term_counts). Mixing post-commit components on
+        # rival nodes with round-start components elsewhere, then re-running
+        # the per-pod normalization (whose max couples every node's score to
+        # the feasible SET), reproduces the scan's exact eff surface for pod
+        # i; the winner finalizes only if its argmax is unmoved.
         onehot = (iota_n[None, :] == choice[:, None]) & accepted[:, None]  # [P,N]
         d_req = jnp.sum(onehot[:, :, None] * pb.req[:, None, :], axis=0)
         d_nz = jnp.sum(onehot[:, :, None] * pb.nonzero_req[:, None, :], axis=0)
@@ -252,19 +412,36 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         fit2, ports2, la2, bal2 = components(
             req_dyn + d_req, nz_dyn + d_nz, port_dyn | d_ports)
         rival = committed_any[None, :] & (win[None, :] < iota_p[:, None])
+        if host is not None:
+            onehot_i = onehot.astype(jnp.int32)
+            csig = jnp.einsum("ps,pn->sn", sig_mask_f, onehot_i)
+            cterm = jnp.einsum("pt,pn->tn", term_mask_f, onehot_i)
+        else:
+            csig = cterm = None
         fit_mix = jnp.where(rival, fit2, fit)
         ports_mix = jnp.where(rival, ports2, ports)
-        eff_mix, _feas_mix, tot_mix = assemble(
+        eff_mix, feas_mix, tot_mix, sp_mix, ip_mix = assemble(
             fit_mix, ports_mix,
-            jnp.where(rival, la2, la), jnp.where(rival, bal2, bal), active)
+            jnp.where(rival, la2, la), jnp.where(rival, bal2, bal), active,
+            sel_view=(sel_dyn, csig), term_view=(term_dyn, cterm),
+            rival=rival.astype(jnp.int32) if host is not None else None)
         choice_mix = jnp.argmax(eff_mix, axis=1).astype(jnp.int32)
-        unstable = accepted & (choice_mix != choice)
+        chosen_feas_mix = jnp.take_along_axis(feas_mix, choice[:, None], 1)[:, 0]
+        # ~chosen_feas_mix guards the degenerate all-infeasible mix (IPA's
+        # first-pod rule can flip globally): argmax over an all-NEG_INF row
+        # returns 0, which would read as "stable" for a pod whose round-
+        # start choice was slot 0. An infeasible-in-mix winner defers and
+        # re-evaluates (usually failing) next round.
+        unstable = accepted & ((choice_mix != choice) | ~chosen_feas_mix)
         # decision-time rows for the outputs: mixed values ARE each pod's
         # sequential view (for failing pods rival is empty, so mix ==
         # round-start — exact either way)
         ff_mix = static_ff
         ff_mix = jnp.where((ff_mix == 0) & ~ports_mix, np.int8(5), ff_mix)
         ff_mix = jnp.where((ff_mix == 0) & ~fit_mix, np.int8(6), ff_mix)
+        if host is not None:
+            ff_mix = jnp.where((ff_mix == 0) & ~sp_mix, np.int8(7), ff_mix)
+            ff_mix = jnp.where((ff_mix == 0) & ~ip_mix, np.int8(8), ff_mix)
 
         # ---- strict prefix finalization: a pod may finalize only when every
         # lower-index active pod finalizes too, so each finalized pod's
@@ -292,6 +469,10 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         port_dyn = port_dyn | jnp.sum(
             jnp.where(onehot[:, :, None], pod_bits[:, None, :], 0),
             axis=0).astype(jnp.uint32)
+        if host is not None:
+            onehot_i = onehot.astype(jnp.int32)
+            sel_dyn = sel_dyn + jnp.einsum("ps,pn->sn", sig_mask_f, onehot_i)
+            term_dyn = term_dyn + jnp.einsum("pt,pn->tn", term_mask_f, onehot_i)
         final = accepted | failing
         out_idx = jnp.where(accepted, choice, out_idx)
         best = jnp.where(final,
@@ -300,29 +481,36 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         anyf_out = jnp.where(final, accepted, anyf_out)
         fit_out = jnp.where(final[:, None], fit_mix, fit_out)
         ports_out = jnp.where(final[:, None], ports_mix, ports_out)
+        if host is not None:
+            spread_out = jnp.where(final[:, None], sp_mix, spread_out)
+            ipa_out = jnp.where(final[:, None], ip_mix, ipa_out)
         ff_out = jnp.where(final[:, None], ff_mix, ff_out)
         done = done | final
         progressed = jnp.any(final)
-        return (req_dyn, nz_dyn, port_dyn, done, out_idx, best, anyf_out,
-                fit_out, ports_out, ff_out, progressed)
+        return (req_dyn, nz_dyn, port_dyn, sel_dyn, term_dyn, done, out_idx,
+                best, anyf_out, fit_out, ports_out, spread_out, ipa_out,
+                ff_out, progressed)
 
     def cond(carry):
-        done, progressed = carry[3], carry[10]
+        done, progressed = carry[5], carry[14]
         return jnp.any(~done & pb.valid) & progressed
 
     ones_pn = jnp.ones((P, N), bool)
     init = (
         nt.requested, nt.nonzero_requested, nt.port_bits,
+        sel0, seg0,                               # topo tables (host mode)
         ~pb.valid,                                # invalid pods start done
         jnp.full((P,), -1, jnp.int32),            # out_idx
         jnp.zeros((P,), jnp.float32),             # best
         jnp.zeros((P,), bool),                    # any_feasible
         ones_pn, ones_pn,                         # fit_out, ports_out
+        ones_pn, ones_pn,                         # spread_out, ipa_out
         static_ff,                                # ff_out
         np.True_,
     )
-    (f_req, f_nz, f_port, _done, node_idx, best, anyf,
-     fit_out, ports_out, ff_out, _p) = lax.while_loop(cond, body, init)
+    (f_req, f_nz, f_port, f_sel, f_term, _done, node_idx, best, anyf,
+     fit_out, ports_out, spread_out, ipa_out, ff_out, _p) = lax.while_loop(
+        cond, body, init)
 
     committed = node_idx >= 0
     local_commit = jnp.where(committed, node_idx, 0)
@@ -331,9 +519,9 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
     return BatchResult(
         node_idx=node_idx, best_score=best, any_feasible=anyf,
         static_masks={}, fit_ok=fit_out, ports_ok=ports_out,
-        spread_ok=ones_pn, ipa_ok=ones_pn, first_fail=ff_out,
+        spread_ok=spread_out, ipa_ok=ipa_out, first_fail=ff_out,
         final_requested=f_req, final_nonzero=f_nz, final_ports=f_port,
-        final_sel_counts=sel0, final_seg_exist=seg0, final_class_req=f_class,
+        final_sel_counts=f_sel, final_seg_exist=f_term, final_class_req=f_class,
     )
 
 
@@ -442,15 +630,26 @@ def schedule_batch_core(
 
     if spec_decode:
         # vectorized decide/repair rounds instead of the P-step scan —
-        # single-shard, non-topology, unsampled batches only (the gate is
-        # build_schedule_batch_fn's; sequential parity proven per-round by
-        # the prefix-stability acceptance)
-        assert topo_mode == "off" and sample_k is None and axis_name is None
-        seg0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
+        # single-shard unsampled batches, topology off or on the hostname
+        # fast path (node-local tables); sequential parity proven per-round
+        # by the prefix-stability acceptance
+        assert topo_mode in ("off", "host") and sample_k is None \
+            and axis_name is None
+        if topo_mode == "host":
+            seg0 = tc.term_counts                      # [T, N] per-node counts
+            host_args = {
+                "tb": _tb_dict(tb),
+                "hostkey_ok": hostkey_ok,
+                "affinity_ok": static_masks["NodeAffinity"],
+            }
+        else:
+            seg0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
+            host_args = None
         sel0_, seg0_ = (tc.sel_counts, seg0) if topo_carry is None else topo_carry
         result = _speculative_core(
             pb, nt, weights, static_ok, static_ff, taint_raw,
-            affinity_raw, image_score, pod_bits, jitter, sel0_, seg0_)
+            affinity_raw, image_score, pod_bits, jitter, sel0_, seg0_,
+            host=host_args)
         return result._replace(static_masks=static_masks)
 
     if pallas is not None:
@@ -650,10 +849,10 @@ def schedule_batch_core(
     )
     xs = {"row": rows}
     if topo_mode == "host":
-        xs["tb"] = {f.name: getattr(tb, f.name) for f in dataclasses.fields(tb)}
+        xs["tb"] = _tb_dict(tb)
         seg_exist0 = tc.term_counts  # [T, N]: per-node term counts ARE the carry
     elif topo_enabled:
-        xs["tb"] = {f.name: getattr(tb, f.name) for f in dataclasses.fields(tb)}
+        xs["tb"] = _tb_dict(tb)
         seg_exist0 = topo_static.seg_exist0
     else:
         seg_exist0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
@@ -727,11 +926,13 @@ def schedule_batch(
 
 
 def spec_decode_eligible(topo_enabled: bool, sample_k, topo_mode) -> bool:
-    """Speculative decode covers the single-shard non-topology unsampled
-    program. KTPU_SPEC=1 forces it, =0 forces the scan; auto enables it on
-    accelerators only — the rounds trade ~10x more memory traffic for ~100x
-    fewer dependent steps, a win on HBM (TPU) and a loss on host RAM
-    (measured 2.2x slower on CPU, where the scan's step latency is cheap)."""
+    """Speculative decode covers the single-shard unsampled program with
+    topology off or on the hostname fast path (node-local tables — the
+    general domain-aggregating mode stays on the scan). KTPU_SPEC=1 forces
+    it, =0 forces the scan; auto enables it on accelerators only — the
+    rounds trade ~10x more memory traffic for ~100x fewer dependent steps,
+    a win on HBM (TPU) and a loss on host RAM (measured 2.2x slower on CPU,
+    where the scan's step latency is cheap)."""
     import os
 
     flag = os.environ.get("KTPU_SPEC", "auto")
@@ -739,7 +940,7 @@ def spec_decode_eligible(topo_enabled: bool, sample_k, topo_mode) -> bool:
         return False
     mode = topo_mode if topo_mode is not None else (
         "general" if topo_enabled else "off")
-    if mode != "off" or sample_k is not None:
+    if mode not in ("off", "host") or sample_k is not None:
         return False
     if flag == "auto":
         import jax

@@ -132,6 +132,121 @@ class TestExactParity:
         assert len(bound) == 1
 
 
+def _run_sched(monkeypatch, flag, build_workload, n_nodes=12, batch=16):
+    """Full TPUScheduler run under KTPU_SPEC=flag; returns {pod: node}."""
+    monkeypatch.setenv("KTPU_SPEC", flag)
+    monkeypatch.setenv("KTPU_PALLAS", "0")
+    store = ClusterStore()
+    sched = TPUScheduler(store, batch_size=batch, comparer_every_n=1)
+    for i in range(n_nodes):
+        store.create_node(
+            make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 20})
+            .label("zone", f"z{i % 3}").obj())
+    build_workload(store)
+    sched.run_until_settled(max_no_progress=5)
+    assert sched.comparer_mismatches == 0
+    return {p.meta.name: p.spec.node_name for p in store.pods.values()}
+
+
+class TestHostModeTopologyParity:
+    """Hostname-topology batches (the host fast path) through the
+    speculative rounds: placements must match the scan exactly, with the
+    oracle comparer checking every placement on both runs."""
+
+    def _check(self, monkeypatch, build_workload, **kw):
+        a = _run_sched(monkeypatch, "0", build_workload, **kw)
+        b = _run_sched(monkeypatch, "1", build_workload, **kw)
+        assert a == b
+
+    def test_hostname_spread(self, monkeypatch):
+        from kubernetes_tpu.api.types import LabelSelector
+
+        def workload(store):
+            for i in range(20):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).label("app", "web")
+                    .spread_constraint(
+                        1, "kubernetes.io/hostname",
+                        selector=LabelSelector(match_labels={"app": "web"}))
+                    .obj())
+
+        self._check(monkeypatch, workload)
+
+    def test_hostname_anti_affinity(self, monkeypatch):
+        from kubernetes_tpu.api.types import LabelSelector
+
+        def workload(store):
+            for i in range(14):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).label("app", "db")
+                    .pod_affinity("kubernetes.io/hostname",
+                                  LabelSelector(match_labels={"app": "db"}),
+                                  anti=True)
+                    .obj())
+
+        self._check(monkeypatch, workload, n_nodes=10)
+
+    def test_hostname_anti_affinity_overflow_unschedulable(self, monkeypatch):
+        # more exclusive pods than nodes: the tail must fail identically
+        from kubernetes_tpu.api.types import LabelSelector
+
+        def workload(store):
+            for i in range(8):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "100m"}).label("app", "x")
+                    .pod_affinity("kubernetes.io/hostname",
+                                  LabelSelector(match_labels={"app": "x"}),
+                                  anti=True)
+                    .obj())
+
+        a = _run_sched(monkeypatch, "0", workload, n_nodes=5, batch=8)
+        b = _run_sched(monkeypatch, "1", workload, n_nodes=5, batch=8)
+        assert a == b
+        assert sum(1 for v in a.values() if v) == 5  # one per node
+
+    def test_required_self_affinity_first_pod_rule(self, monkeypatch):
+        # IPA's first-pod rule (total==0 & self-match ⇒ feasible anywhere)
+        # flips globally once the first pod lands: a mid-round winner's
+        # mixed view can collapse to all-infeasible — the stability check's
+        # chosen-feasibility guard must defer it, keeping scan parity
+        from kubernetes_tpu.api.types import LabelSelector
+
+        def workload(store):
+            for i in range(10):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).label("app", "herd")
+                    .pod_affinity("kubernetes.io/hostname",
+                                  LabelSelector(match_labels={"app": "herd"}))
+                    .obj())
+
+        a = _run_sched(monkeypatch, "0", workload, n_nodes=6, batch=16)
+        b = _run_sched(monkeypatch, "1", workload, n_nodes=6, batch=16)
+        assert a == b
+        # required colocation on hostname: everyone lands on ONE node
+        nodes = {v for v in a.values() if v}
+        assert len(nodes) == 1
+
+    def test_mixed_spread_affinity_priorities(self, monkeypatch):
+        from kubernetes_tpu.api.types import LabelSelector, SCHEDULE_ANYWAY
+
+        def workload(store):
+            for i in range(24):
+                pw = (make_pod(f"p{i}").req({"cpu": ["250m", "1"][i % 2]})
+                      .label("app", f"svc{i % 2}").priority(i % 3))
+                if i % 2 == 0:
+                    pw.spread_constraint(
+                        2, "kubernetes.io/hostname",
+                        when_unsatisfiable=SCHEDULE_ANYWAY,
+                        selector=LabelSelector(match_labels={"app": "svc0"}))
+                else:
+                    pw.preferred_pod_affinity(
+                        10, "kubernetes.io/hostname",
+                        LabelSelector(match_labels={"app": "svc1"}))
+                store.create_pod(pw.obj())
+
+        self._check(monkeypatch, workload)
+
+
 class TestEndToEndForcedSpec:
     def test_full_scheduler_with_spec_decode(self, monkeypatch):
         monkeypatch.setenv("KTPU_SPEC", "1")
