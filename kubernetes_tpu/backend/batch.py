@@ -162,7 +162,7 @@ def _resource_scores(alloc2: jax.Array, nz_total: jax.Array):
 
 def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
                       affinity_raw, image_score, pod_bits, jitter,
-                      sel0, seg0, host=None) -> BatchResult:
+                      sel0, seg0, host=None, gen=None) -> BatchResult:
     """Speculative decode for non-topology batches (ROADMAP r3 perf 2).
 
     The scan commits one pod per step — P dependent steps whose per-step
@@ -191,7 +191,20 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
     local there, so the same rival-mix trick yields each pod's exact
     sequential view of spread/inter-pod-affinity state. Keys: the TopoBatch
     field dict, hostkey_ok [N], affinity_ok [P, N] (the NodeAffinity static
-    mask the spread filter's eligibility uses)."""
+    mask the spread filter's eligibility uses).
+
+    ``gen`` (optional, exclusive with ``host``) extends them to the GENERAL
+    domain-aggregating mode: sel_counts stays node-local (rival-mix), and
+    the domain segment sums recompute per pod from the mixed counts
+    (vmapped segment sums over small [P, C, Vd] tables), so every
+    sel-derived quantity is each pod's exact sequential view. The
+    seg_exist table ([T, Vd], domain-level) cannot be rival-mixed; instead
+    a winner whose view could be touched by an earlier winner's TERM commit
+    (the committing pod carries a term that interacts with this pod —
+    rare: intra-batch anti-affinity/symmetric-score coupling) is
+    conservatively DEFERRED to the next round, where the committed tables
+    are ground truth. Keys: tb dict, affinity_ok, vd, dom_t [T, N],
+    label_val [N, L], valid [N]."""
     P = pb.capacity
     N = nt.capacity
     alloc = nt.allocatable
@@ -206,12 +219,65 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
     w_img = np.float32(weights["ImageLocality"])
     w_spread = np.float32(weights["PodTopologySpread"])
     w_ipa = np.float32(weights["InterPodAffinity"])
+    def _mix_gather(base_table, delta_table, rows, rival):
+        """Per-pod gathered counts with this round's earlier-winner column
+        deltas applied on rival nodes — THE rival-mix formula, defined once
+        for the host filters/scores and the gen segment paths."""
+        base = base_table[rows]                                  # [P, C, N]
+        if rival is None:
+            return base
+        return base + delta_table[rows] * rival[:, None, :]
+
+    def _spread_norm(raw, base_mask, ignored, has_cons):
+        """Spread score normalization (scoring.go:232-271), shared by the
+        host and general batched paths (must stay bit-identical)."""
+        mx = jnp.max(jnp.where(base_mask, raw, -jnp.inf), axis=1, keepdims=True)
+        mn = jnp.min(jnp.where(base_mask, raw, jnp.inf), axis=1, keepdims=True)
+        any_base = jnp.any(base_mask, axis=1, keepdims=True)
+        norm = jnp.where(mx == 0, 100.0,
+                         jnp.floor(100.0 * (mx + mn - raw) / jnp.maximum(mx, 1.0)))
+        norm = jnp.where(ignored | ~any_base, 0.0, norm)
+        return jnp.where(has_cons, norm, 0.0)
+
+    def _ipa_norm(raw, feasible):
+        """IPA score normalization (clamped min/max), shared likewise."""
+        mx = jnp.maximum(
+            jnp.max(jnp.where(feasible, raw, -jnp.inf), axis=1, keepdims=True),
+            0.0)
+        mn = jnp.minimum(
+            jnp.min(jnp.where(feasible, raw, jnp.inf), axis=1, keepdims=True),
+            0.0)
+        diff = mx - mn
+        return jnp.where(
+            diff > 0, jnp.floor(100.0 * (raw - mn) / jnp.maximum(diff, 1.0)), 0.0)
+
     if host is not None:
         tbx, hostkey_ok, affinity_ok = (
             host["tb"], host["hostkey_ok"], host["affinity_ok"])
         sig_mask_f = tbx["pod_sig_mask"].astype(jnp.int32)      # [P, S]
         term_mask_f = tbx["pod_term_mask"].astype(jnp.int32)    # [P, T]
         hk_f = hostkey_ok.astype(jnp.int32)                     # [N]
+    if gen is not None:
+        tbx, affinity_ok = gen["tb"], gen["affinity_ok"]
+        vd = gen["vd"]
+        dom_t = gen["dom_t"]                                    # [T, N]
+        label_val = gen["label_val"]                            # [N, L]
+        valid_n = gen["valid"]                                  # [N]
+        sig_mask_f = tbx["pod_sig_mask"].astype(jnp.int32)      # [P, S]
+        term_mask_f = tbx["pod_term_mask"].astype(jnp.int32)    # [P, T]
+
+        def _dom_of(keys):
+            # [P, C, N]: domain id of node n under each constraint's key
+            return label_val.T[keys]                            # gather rows
+
+        def _seg_pc(values, dom):
+            """[P, C, N] values segment-summed by [P, C, N] domain ids →
+            [P, C, Vd] (the per-pod batched _seg_sum)."""
+            seg = jax.vmap(jax.vmap(
+                lambda v, d: jax.ops.segment_sum(v, d, num_segments=vd)))(
+                    values, dom)
+            return seg
+
 
     def topo_eval(sel_view, term_view, rival, active):
         """Host-mode spread/IPA filters from a (possibly per-pod mixed)
@@ -220,12 +286,6 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         sel_base, sel_d = sel_view
         term_base, term_d = term_view
 
-        def mixed(table_base, table_d, rows):
-            # [P, C, N]: per-pod gathered counts with rival-local deltas
-            base = table_base[rows]                              # [P, C, N]
-            if rival is None:
-                return base
-            return base + table_d[rows] * rival[:, None, :]
 
         valid_n = nt.valid
         # ---- spread filter (topology.spread_filter_host)
@@ -234,7 +294,7 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         # NOTE: the scan's elig has no `active` term — it is per-pod anyway;
         # masking by active only skips work for done pods (their rows are
         # never read) and keeps reductions well-defined.
-        cnt_sf = mixed(sel_base, sel_d, tbx["sf_sig"])           # [P, C, N]
+        cnt_sf = _mix_gather(sel_base, sel_d, tbx["sf_sig"], rival)           # [P, C, N]
         minm = jnp.min(jnp.where(elig[:, None, :], cnt_sf, INT_MAX), axis=2)
         ndom = jnp.sum(elig.astype(jnp.int32), axis=1)           # [P]
         any_pres = ndom > 0
@@ -248,7 +308,7 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
             jnp.where(tbx["sf_valid"][:, :, None], ok_c, True), axis=1)
 
         # ---- IPA filter (topology.ipa_filter_host)
-        cnt_ia = mixed(sel_base, sel_d, tbx["ia_sig"])           # [P, A, N]
+        cnt_ia = _mix_gather(sel_base, sel_d, tbx["ia_sig"], rival)           # [P, A, N]
         exist = hostkey_ok[None, None, :] & (cnt_ia > 0)
         ia_valid = tbx["ia_valid"]
         pods_exist = jnp.all(
@@ -263,7 +323,7 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         has_terms = jnp.any(ia_valid, axis=1)
         aff_ok = (~has_terms[:, None]) | (
             all_keys & (pods_exist | first_ok[:, None]))
-        cnt_an = mixed(sel_base, sel_d, tbx["ianti_sig"])        # [P, A, N]
+        cnt_an = _mix_gather(sel_base, sel_d, tbx["ianti_sig"], rival)        # [P, A, N]
         viol = jnp.any(tbx["ianti_valid"][:, :, None]
                        & hostkey_ok[None, None, :] & (cnt_an > 0), axis=1)
         anti_ok = ~viol
@@ -284,35 +344,24 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         sel_base, sel_d = sel_view
         term_base, term_d = term_view
 
-        def mixed(rows):
-            base = sel_base[rows]
-            if rival is None:
-                return base
-            return base + sel_d[rows] * rival[:, None, :]
 
         # spread score
         ignored = tbx["ss_require_all"][:, None] & ~hostkey_ok[None, :]
         base_mask = feasible & ~ignored                          # [P, N]
         n_base = jnp.sum(base_mask.astype(jnp.int32), axis=1)    # [P]
         w = jnp.log(n_base.astype(jnp.float32) + 2.0)[:, None]   # [P, 1]
-        cnt_ss = mixed(tbx["ss_sig"]).astype(jnp.float32)        # [P, C, N]
+        cnt_ss = _mix_gather(sel_base, sel_d, tbx["ss_sig"], rival).astype(jnp.float32)        # [P, C, N]
         contrib = jnp.where(
             tbx["ss_valid"][:, :, None] & hostkey_ok[None, None, :],
             cnt_ss * w[:, :, None]
             + (tbx["ss_skew"][:, :, None].astype(jnp.float32) - 1.0),
             0.0)
         raw = jnp.floor(jnp.sum(contrib, axis=1) + 0.5)          # [P, N]
-        mx = jnp.max(jnp.where(base_mask, raw, -jnp.inf), axis=1, keepdims=True)
-        mn = jnp.min(jnp.where(base_mask, raw, jnp.inf), axis=1, keepdims=True)
-        any_base = jnp.any(base_mask, axis=1, keepdims=True)
-        norm = jnp.where(mx == 0, 100.0,
-                         jnp.floor(100.0 * (mx + mn - raw) / jnp.maximum(mx, 1.0)))
-        norm = jnp.where(ignored | ~any_base, 0.0, norm)
-        has_cons = jnp.any(tbx["ss_valid"], axis=1)[:, None]
-        spread_score = jnp.where(has_cons, norm, 0.0)
+        spread_score = _spread_norm(
+            raw, base_mask, ignored, jnp.any(tbx["ss_valid"], axis=1)[:, None])
 
         # IPA score
-        cnt_ip = mixed(tbx["ip_sig"]).astype(jnp.float32)        # [P, PT, N]
+        cnt_ip = _mix_gather(sel_base, sel_d, tbx["ip_sig"], rival).astype(jnp.float32)        # [P, PT, N]
         pref = jnp.sum(
             jnp.where(tbx["ip_valid"][:, :, None] & hostkey_ok[None, None, :],
                       tbx["ip_w"][:, :, None].astype(jnp.float32) * cnt_ip,
@@ -325,17 +374,131 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
             sym = sym + (tsw @ (term_d.astype(jnp.float32)
                                 * hk_ff[None, :])) * rival
         raw_ip = pref + sym
-        mx_ip = jnp.maximum(
-            jnp.max(jnp.where(feasible, raw_ip, -jnp.inf), axis=1, keepdims=True),
+        return spread_score, _ipa_norm(raw_ip, feasible)
+
+    def topo_eval_gen(sel_view, seg_base, rival, active):
+        """General-mode spread/IPA filters, batched over pods: every
+        sel-derived quantity recomputes from the (rival-mixed) per-pod
+        counts, matching topology.spread_filter/ipa_filter exactly. The
+        seg_exist check (existing pods' anti-affinity vs the incoming pod)
+        is evaluated against the ROUND-START table; rounds where that could
+        diverge defer the affected winners (term-interaction deferral in
+        body())."""
+        sel_base, sel_d = sel_view
+
+        # ---- spread filter (topology.spread_filter)
+        dom_sf = _dom_of(tbx["sf_key"])                          # [P, C, N]
+        has_key = dom_sf > 0
+        has_all = jnp.all(jnp.where(tbx["sf_valid"][:, :, None], has_key, True),
+                          axis=1)                                # [P, N]
+        elig = valid_n[None, :] & affinity_ok & has_all & active[:, None]
+        cnts = _mix_gather(sel_base, sel_d, tbx["sf_sig"], rival)
+        add = jnp.where(elig[:, None, :] & has_key, cnts, 0)
+        seg = _seg_pc(add, dom_sf)                               # [P, C, Vd]
+        pres = _seg_pc(jnp.broadcast_to(
+            elig[:, None, :], dom_sf.shape).astype(jnp.int32), dom_sf) > 0
+        minm = jnp.min(jnp.where(pres, seg, INT_MAX), axis=2)    # [P, C]
+        any_pres = jnp.any(pres, axis=2)
+        minm = jnp.where(any_pres, minm, 0)
+        ndom = jnp.sum(pres.astype(jnp.int32), axis=2)
+        minm = jnp.where((tbx["sf_min_domains"] >= 0)
+                         & (ndom < tbx["sf_min_domains"]), 0, minm)
+        cnt_at = jnp.take_along_axis(seg, dom_sf, axis=2)        # [P, C, N]
+        ok_c = has_key & (cnt_at + tbx["sf_self"][:, :, None].astype(jnp.int32)
+                          - minm[:, :, None] <= tbx["sf_skew"][:, :, None])
+        spread_ok = jnp.all(
+            jnp.where(tbx["sf_valid"][:, :, None], ok_c, True), axis=1)
+
+        # ---- IPA filter checks 1+2 (topology.ipa_filter)
+        dom_ia = _dom_of(tbx["ia_key"])
+        ia_has_key = dom_ia > 0
+        ia_valid = tbx["ia_valid"]
+        cnts_ia = _mix_gather(sel_base, sel_d, tbx["ia_sig"], rival)
+        add_ia = jnp.where(valid_n[None, None, :] & ia_has_key, cnts_ia, 0)
+        seg_ia = _seg_pc(add_ia, dom_ia)                         # [P, A, Vd]
+        cnt_at_ia = jnp.take_along_axis(seg_ia, dom_ia, axis=2)
+        exist = cnt_at_ia > 0
+        pods_exist = jnp.all(jnp.where(ia_valid[:, :, None], exist, True), axis=1)
+        all_keys = jnp.all(jnp.where(ia_valid[:, :, None], ia_has_key, True),
+                           axis=1)
+        total = jnp.sum(jnp.where(ia_valid[:, :, None], seg_ia, 0), axis=(1, 2))
+        first_ok = (total == 0) & tbx["ia_self_all"]
+        has_terms = jnp.any(ia_valid, axis=1)
+        aff_ok = (~has_terms[:, None]) | (
+            all_keys & (pods_exist | first_ok[:, None]))
+
+        dom_an = _dom_of(tbx["ianti_key"])
+        an_has_key = dom_an > 0
+        cnts_an = _mix_gather(sel_base, sel_d, tbx["ianti_sig"], rival)
+        add_an = jnp.where(valid_n[None, None, :] & an_has_key, cnts_an, 0)
+        seg_an = _seg_pc(add_an, dom_an)
+        an_cnt = jnp.take_along_axis(seg_an, dom_an, axis=2)
+        viol = jnp.any(tbx["ianti_valid"][:, :, None] & an_has_key
+                       & (an_cnt > 0), axis=1)
+        anti_ok = ~viol
+
+        # ---- IPA check 3 against the ROUND-START seg_exist (deferral
+        # covers the divergence window)
+        exist_at = jnp.where(dom_t > 0,
+                             jnp.take_along_axis(seg_base, dom_t, axis=1), 0)  # [T,N]
+        m = tbx["term_filter_match"].astype(jnp.int32)           # [P, T]
+        viol_cnt = m @ exist_at
+        exist_ok = viol_cnt == 0
+        ipa_ok = aff_ok & anti_ok & exist_ok
+        return spread_ok, ipa_ok, exist_at
+
+    def topo_scores_gen(sel_view, exist_at, rival, feasible):
+        """General-mode spread/IPA scores (topology.spread_score/ipa_score),
+        batched; the symmetric existing-term score uses the round-start
+        exist_at (deferral covers divergence)."""
+        sel_base, sel_d = sel_view
+
+        # spread score
+        dom_ss = _dom_of(tbx["ss_key"])                          # [P, C, N]
+        has_key = dom_ss > 0
+        ss_valid = tbx["ss_valid"]
+        has_all = jnp.all(jnp.where(ss_valid[:, :, None], has_key, True), axis=1)
+        require_all = tbx["ss_require_all"][:, None]             # [P, 1]
+        ignored = require_all & ~has_all                         # [P, N]
+        base_mask = feasible & ~ignored
+        pres = _seg_pc(jnp.broadcast_to(
+            base_mask[:, None, :], dom_ss.shape).astype(jnp.int32), dom_ss) > 0
+        sz = jnp.sum(pres.astype(jnp.int32), axis=2)             # [P, C]
+        n_base = jnp.sum(base_mask.astype(jnp.int32), axis=1)    # [P]
+        sz = jnp.where(tbx["ss_hostname"], n_base[:, None], sz)
+        w = jnp.log(sz.astype(jnp.float32) + 2.0)                # [P, C]
+        elig = (valid_n[None, :] & affinity_ok
+                & jnp.where(require_all, has_all, True))         # [P, N]
+        cnts = _mix_gather(sel_base, sel_d, tbx["ss_sig"], rival)
+        add = jnp.where(elig[:, None, :] & has_key, cnts, 0)
+        seg = _seg_pc(add, dom_ss)
+        cnt_at = jnp.take_along_axis(seg, dom_ss, axis=2)
+        cnt = jnp.where(tbx["ss_hostname"][:, :, None], cnts, cnt_at) \
+            .astype(jnp.float32)
+        contrib = jnp.where(
+            ss_valid[:, :, None] & has_key,
+            cnt * w[:, :, None]
+            + (tbx["ss_skew"][:, :, None].astype(jnp.float32) - 1.0),
             0.0)
-        mn_ip = jnp.minimum(
-            jnp.min(jnp.where(feasible, raw_ip, jnp.inf), axis=1, keepdims=True),
-            0.0)
-        diff = mx_ip - mn_ip
-        ipa_score = jnp.where(
-            diff > 0, jnp.floor(100.0 * (raw_ip - mn_ip) / jnp.maximum(diff, 1.0)),
-            0.0)
-        return spread_score, ipa_score
+        raw = jnp.floor(jnp.sum(contrib, axis=1) + 0.5)          # [P, N]
+        spread_score = _spread_norm(
+            raw, base_mask, ignored, jnp.any(ss_valid, axis=1)[:, None])
+
+        # IPA score
+        dom_ip = _dom_of(tbx["ip_key"])
+        ip_has_key = dom_ip > 0
+        cnts_ip = _mix_gather(sel_base, sel_d, tbx["ip_sig"], rival)
+        add_ip = jnp.where(valid_n[None, None, :] & ip_has_key, cnts_ip, 0)
+        seg_ip = _seg_pc(add_ip, dom_ip)
+        cnt_at_ip = jnp.take_along_axis(seg_ip, dom_ip, axis=2).astype(jnp.float32)
+        pref = jnp.sum(
+            jnp.where(tbx["ip_valid"][:, :, None] & ip_has_key,
+                      tbx["ip_w"][:, :, None].astype(jnp.float32) * cnt_at_ip,
+                      0.0),
+            axis=1)
+        sym = tbx["term_score_w"] @ exist_at.astype(jnp.float32)  # [P, N]
+        raw_ip = pref + sym
+        return spread_score, _ipa_norm(raw_ip, feasible)
 
     def components(req_dyn, nz_dyn, port_dyn):
         """State-dependent per-(pod,node) pieces: (fit, ports, la, balanced)."""
@@ -356,8 +519,13 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         feasible set; host mode adds the topology filters to feasibility and
         the topology scores to the total (same order as the scan step)."""
         feasible = static_ok & fit & ports & active[:, None]
+        exist_at = None
         if host is not None:
             spread_ok, ipa_ok = topo_eval(sel_view, term_view, rival, active)
+            feasible = feasible & spread_ok & ipa_ok
+        elif gen is not None:
+            spread_ok, ipa_ok, exist_at = topo_eval_gen(
+                sel_view, term_view[0], rival, active)
             feasible = feasible & spread_ok & ipa_ok
         else:
             spread_ok = ipa_ok = None
@@ -369,6 +537,9 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
                  + w_aff * aff_n + w_img * image_score)
         if host is not None:
             sp_s, ip_s = topo_scores(sel_view, term_view, rival, feasible)
+            total = total + w_spread * sp_s + w_ipa * ip_s
+        elif gen is not None:
+            sp_s, ip_s = topo_scores_gen(sel_view, exist_at, rival, feasible)
             total = total + w_spread * sp_s + w_ipa * ip_s
         eff = jnp.where(feasible, total + jitter + is_nom * np.float32(1e7),
                         NEG_INF)
@@ -412,10 +583,12 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         fit2, ports2, la2, bal2 = components(
             req_dyn + d_req, nz_dyn + d_nz, port_dyn | d_ports)
         rival = committed_any[None, :] & (win[None, :] < iota_p[:, None])
-        if host is not None:
+        topo_on = host is not None or gen is not None
+        if topo_on:
             onehot_i = onehot.astype(jnp.int32)
             csig = jnp.einsum("ps,pn->sn", sig_mask_f, onehot_i)
-            cterm = jnp.einsum("pt,pn->tn", term_mask_f, onehot_i)
+            cterm = (jnp.einsum("pt,pn->tn", term_mask_f, onehot_i)
+                     if host is not None else None)
         else:
             csig = cterm = None
         fit_mix = jnp.where(rival, fit2, fit)
@@ -424,7 +597,7 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
             fit_mix, ports_mix,
             jnp.where(rival, la2, la), jnp.where(rival, bal2, bal), active,
             sel_view=(sel_dyn, csig), term_view=(term_dyn, cterm),
-            rival=rival.astype(jnp.int32) if host is not None else None)
+            rival=rival.astype(jnp.int32) if topo_on else None)
         choice_mix = jnp.argmax(eff_mix, axis=1).astype(jnp.int32)
         chosen_feas_mix = jnp.take_along_axis(feas_mix, choice[:, None], 1)[:, 0]
         # ~chosen_feas_mix guards the degenerate all-infeasible mix (IPA's
@@ -433,13 +606,32 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         # start choice was slot 0. An infeasible-in-mix winner defers and
         # re-evaluates (usually failing) next round.
         unstable = accepted & ((choice_mix != choice) | ~chosen_feas_mix)
+        if gen is not None:
+            # seg_exist deferral: the mixed view evaluates existing-term
+            # state against the ROUND-START table, so a winner i whose
+            # filters/scores could be touched by an earlier winner j's TERM
+            # commit must wait a round. add_term[t, j] = does accepted j's
+            # commit add term t at a keyed domain; interaction = pod i's
+            # anti-match or symmetric-score weight on that term.
+            dcol = jnp.take_along_axis(
+                dom_t, jnp.broadcast_to(choice[None, :], (dom_t.shape[0], P)),
+                axis=1)                                          # [T, P]
+            add_term = (term_mask_f.T * (dcol > 0)
+                        * accepted[None, :].astype(jnp.int32))   # [T, P]
+            m_int = tbx["term_filter_match"].astype(jnp.int32)   # [P, T]
+            w_abs = jnp.abs(tbx["term_score_w"])                 # [P, T]
+            interacts = ((m_int @ add_term) > 0) | (
+                (w_abs @ add_term.astype(jnp.float32)) > 0)      # [P(i), P(j)]
+            j_lt_i = iota_p[None, :] < iota_p[:, None]
+            deferred = jnp.any(interacts & j_lt_i, axis=1)
+            unstable = unstable | (accepted & deferred)
         # decision-time rows for the outputs: mixed values ARE each pod's
         # sequential view (for failing pods rival is empty, so mix ==
         # round-start — exact either way)
         ff_mix = static_ff
         ff_mix = jnp.where((ff_mix == 0) & ~ports_mix, np.int8(5), ff_mix)
         ff_mix = jnp.where((ff_mix == 0) & ~fit_mix, np.int8(6), ff_mix)
-        if host is not None:
+        if host is not None or gen is not None:
             ff_mix = jnp.where((ff_mix == 0) & ~sp_mix, np.int8(7), ff_mix)
             ff_mix = jnp.where((ff_mix == 0) & ~ip_mix, np.int8(8), ff_mix)
 
@@ -473,6 +665,18 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
             onehot_i = onehot.astype(jnp.int32)
             sel_dyn = sel_dyn + jnp.einsum("ps,pn->sn", sig_mask_f, onehot_i)
             term_dyn = term_dyn + jnp.einsum("pt,pn->tn", term_mask_f, onehot_i)
+        elif gen is not None:
+            onehot_i = onehot.astype(jnp.int32)
+            sel_dyn = sel_dyn + jnp.einsum("ps,pn->sn", sig_mask_f, onehot_i)
+            # seg_exist: each finalized pod's terms land at its node's
+            # domains (topology.commit_update's dom_col scatter, batched)
+            T = dom_t.shape[0]
+            dcol_f = jnp.take_along_axis(
+                dom_t, jnp.broadcast_to(choice[None, :], (T, P)), axis=1)  # [T,P]
+            add_f = (term_mask_f.T * (dcol_f > 0)
+                     * accepted[None, :].astype(jnp.int32))      # [T, P]
+            t_iota = jnp.arange(T, dtype=jnp.int32)[:, None]
+            term_dyn = term_dyn.at[t_iota, dcol_f].add(add_f)
         final = accepted | failing
         out_idx = jnp.where(accepted, choice, out_idx)
         best = jnp.where(final,
@@ -481,7 +685,7 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         anyf_out = jnp.where(final, accepted, anyf_out)
         fit_out = jnp.where(final[:, None], fit_mix, fit_out)
         ports_out = jnp.where(final[:, None], ports_mix, ports_out)
-        if host is not None:
+        if host is not None or gen is not None:
             spread_out = jnp.where(final[:, None], sp_mix, spread_out)
             ipa_out = jnp.where(final[:, None], ip_mix, ipa_out)
         ff_out = jnp.where(final[:, None], ff_mix, ff_out)
@@ -630,11 +834,11 @@ def schedule_batch_core(
 
     if spec_decode:
         # vectorized decide/repair rounds instead of the P-step scan —
-        # single-shard unsampled batches, topology off or on the hostname
-        # fast path (node-local tables); sequential parity proven per-round
-        # by the prefix-stability acceptance
-        assert topo_mode in ("off", "host") and sample_k is None \
+        # single-shard unsampled batches in every topology mode; sequential
+        # parity proven per-round by the prefix-stability acceptance
+        assert topo_mode in ("off", "host", "general") and sample_k is None \
             and axis_name is None
+        host_args = gen_args = None
         if topo_mode == "host":
             seg0 = tc.term_counts                      # [T, N] per-node counts
             host_args = {
@@ -642,14 +846,23 @@ def schedule_batch_core(
                 "hostkey_ok": hostkey_ok,
                 "affinity_ok": static_masks["NodeAffinity"],
             }
+        elif topo_mode == "general":
+            seg0 = topo_static.seg_exist0              # [T, Vd] domain counts
+            gen_args = {
+                "tb": _tb_dict(tb),
+                "affinity_ok": static_masks["NodeAffinity"],
+                "vd": vd,
+                "dom_t": topo_static.dom_t,
+                "label_val": nt.label_val,
+                "valid": nt.valid,
+            }
         else:
             seg0 = jnp.zeros((tc.term_counts.shape[0], 1), jnp.int32)
-            host_args = None
         sel0_, seg0_ = (tc.sel_counts, seg0) if topo_carry is None else topo_carry
         result = _speculative_core(
             pb, nt, weights, static_ok, static_ff, taint_raw,
             affinity_raw, image_score, pod_bits, jitter, sel0_, seg0_,
-            host=host_args)
+            host=host_args, gen=gen_args)
         return result._replace(static_masks=static_masks)
 
     if pallas is not None:
@@ -925,22 +1138,20 @@ def schedule_batch(
                                host_key=host_key, spec_decode=spec_decode)
 
 
-def spec_decode_eligible(topo_enabled: bool, sample_k, topo_mode) -> bool:
-    """Speculative decode covers the single-shard unsampled program with
-    topology off or on the hostname fast path (node-local tables — the
-    general domain-aggregating mode stays on the scan). KTPU_SPEC=1 forces
-    it, =0 forces the scan; auto enables it on accelerators only — the
-    rounds trade ~10x more memory traffic for ~100x fewer dependent steps,
-    a win on HBM (TPU) and a loss on host RAM (measured 2.2x slower on CPU,
-    where the scan's step latency is cheap)."""
+def spec_decode_eligible(sample_k) -> bool:
+    """Speculative decode covers every single-shard unsampled program
+    (topology off, hostname fast path, and the general domain-aggregating
+    mode); only sampling forces the scan. KTPU_SPEC=1 forces it, =0 forces
+    the scan; auto enables it on accelerators only — the rounds trade ~10x
+    more memory traffic for ~100x fewer dependent steps, a win on HBM (TPU)
+    and a loss on host RAM (measured 2.2x slower on CPU, where the scan's
+    step latency is cheap)."""
     import os
 
     flag = os.environ.get("KTPU_SPEC", "auto")
     if flag == "0":
         return False
-    mode = topo_mode if topo_mode is not None else (
-        "general" if topo_enabled else "off")
-    if mode not in ("off", "host") or sample_k is not None:
+    if sample_k is not None:
         return False
     if flag == "auto":
         import jax
@@ -959,7 +1170,7 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
     def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
            sample_k=None, sample_start=None, topo_mode=None, vd_override=None,
            host_key=0):
-        spec = spec_decode_eligible(topo_enabled, sample_k, topo_mode)
+        spec = spec_decode_eligible(sample_k)
         # the pallas fused step has no sampling emulation yet; the
         # speculative path replaces it where both apply (fewer device steps)
         mode = (None if (sample_k is not None or spec)

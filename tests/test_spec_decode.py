@@ -247,6 +247,102 @@ class TestHostModeTopologyParity:
         self._check(monkeypatch, workload)
 
 
+class TestGeneralModeTopologyParity:
+    """Zone-keyed (general domain-aggregating) topology through the
+    speculative rounds: exact scan parity, all placements oracle-checked."""
+
+    def _check(self, monkeypatch, build_workload, **kw):
+        a = _run_sched(monkeypatch, "0", build_workload, **kw)
+        b = _run_sched(monkeypatch, "1", build_workload, **kw)
+        assert a == b
+
+    def test_zone_spread_do_not_schedule(self, monkeypatch):
+        from kubernetes_tpu.api.types import LabelSelector
+
+        def workload(store):
+            for i in range(18):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).label("app", "web")
+                    .spread_constraint(
+                        1, "zone",
+                        selector=LabelSelector(match_labels={"app": "web"}))
+                    .obj())
+
+        self._check(monkeypatch, workload)
+
+    def test_zone_anti_affinity(self, monkeypatch):
+        from kubernetes_tpu.api.types import LabelSelector
+
+        def workload(store):
+            # 3 zones: only 3 of 5 exclusive pods can place
+            for i in range(5):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "250m"}).label("app", "zdb")
+                    .pod_affinity("zone",
+                                  LabelSelector(match_labels={"app": "zdb"}),
+                                  anti=True)
+                    .obj())
+
+        a = _run_sched(monkeypatch, "0", workload, n_nodes=9, batch=8)
+        b = _run_sched(monkeypatch, "1", workload, n_nodes=9, batch=8)
+        assert a == b
+        assert sum(1 for v in a.values() if v) == 3
+
+    def test_zone_required_affinity_colocates(self, monkeypatch):
+        from kubernetes_tpu.api.types import LabelSelector
+
+        def workload(store):
+            for i in range(9):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).label("app", "herd")
+                    .pod_affinity("zone",
+                                  LabelSelector(match_labels={"app": "herd"}))
+                    .obj())
+
+        a = _run_sched(monkeypatch, "0", workload, n_nodes=9, batch=16)
+        b = _run_sched(monkeypatch, "1", workload, n_nodes=9, batch=16)
+        assert a == b
+        zones = {int(v[1:]) % 3 for v in a.values() if v}
+        assert len(zones) == 1  # required zone colocation
+
+    def test_mixed_zone_spread_preferred_affinity(self, monkeypatch):
+        from kubernetes_tpu.api.types import LabelSelector, SCHEDULE_ANYWAY
+
+        def workload(store):
+            for i in range(24):
+                pw = (make_pod(f"p{i}").req({"cpu": ["250m", "1"][i % 2]})
+                      .label("app", f"svc{i % 2}"))
+                if i % 2 == 0:
+                    pw.spread_constraint(
+                        2, "zone", when_unsatisfiable=SCHEDULE_ANYWAY,
+                        selector=LabelSelector(match_labels={"app": "svc0"}))
+                else:
+                    pw.preferred_pod_affinity(
+                        10, "zone", LabelSelector(match_labels={"app": "svc1"}))
+                store.create_pod(pw.obj())
+
+        self._check(monkeypatch, workload)
+
+    def test_zone_spread_min_domains_and_self_anti(self, monkeypatch):
+        from kubernetes_tpu.api.types import LabelSelector
+
+        def workload(store):
+            # spread + anti-affinity interactions across one batch
+            for i in range(12):
+                pw = (make_pod(f"p{i}").req({"cpu": "500m"})
+                      .label("app", "mix"))
+                pw.spread_constraint(
+                    1, "zone",
+                    selector=LabelSelector(match_labels={"app": "mix"}))
+                if i % 4 == 0:
+                    pw.pod_affinity("zone",
+                                    LabelSelector(match_labels={"app": "mix"}),
+                                    anti=True)
+                store.create_pod(pw.obj())
+
+        self._check(monkeypatch, workload)
+
+
 class TestEndToEndForcedSpec:
     def test_full_scheduler_with_spec_decode(self, monkeypatch):
         monkeypatch.setenv("KTPU_SPEC", "1")
