@@ -328,6 +328,13 @@ class PodSpec:
     # generic ephemeral volume names: the ephemeral-volume controller creates
     # a PVC "<pod>-<name>" per entry, owned by the pod
     ephemeral_claims: Tuple[str, ...] = ()
+    # secret/configMap volume sources by object name (core/v1 Volume
+    # SecretVolumeSource/ConfigMapVolumeSource). These need no binding and
+    # never gate scheduling (the SchedulingSecrets perf row measures exactly
+    # that); the kubelet mounts them and the node authorizer limits kubelet
+    # reads to objects referenced by pods bound to that node.
+    secret_volumes: Tuple[str, ...] = ()
+    config_map_volumes: Tuple[str, ...] = ()
     service_account_name: str = ""
     host_network: bool = False
     host_pid: bool = False
@@ -373,6 +380,14 @@ class Pod:
             total[r] = total.get(r, 0) + resource_api.canonical(r, q)
         self.__dict__["_req_cache"] = total
         return total
+
+    def invalidate_request_cache(self) -> None:
+        """Drop the cached resource_request(). Must be called by anything
+        that mutates container requests/limits after creation (LimitRanger
+        defaulting, mutating-webhook patches) — clones share the cache, so a
+        stale entry would silently feed the scheduler and quota accounting
+        (ADVICE r3)."""
+        self.__dict__.pop("_req_cache", None)
 
     def host_ports(self) -> Tuple[ContainerPort, ...]:
         return tuple(
@@ -733,6 +748,18 @@ class ConfigMap:
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Secret:
+    """core/v1 Secret (staging/src/k8s.io/api/core/v1/types.go Secret):
+    the SchedulingSecrets perf workload mounts these, the serviceaccount
+    controller mints token secrets, and NodeRestriction gates kubelet reads
+    to secrets referenced by pods bound to that node."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    type: str = "Opaque"
+    data: Dict[str, str] = field(default_factory=dict)  # values base64 by convention
 
 
 @dataclass

@@ -175,15 +175,20 @@ class LimitRanger(AdmissionPlugin):
         if kind != "Pod":
             return
         pod: Pod = obj
+        mutated = False
         for lr in self._ranges(store, pod.meta.namespace):
             for item in lr.limits:
                 if item.type != "Container":
                     continue
                 for c in pod.spec.containers:
                     for r, q in item.default_request.items():
-                        c.requests.setdefault(r, q)
+                        if r not in c.requests:
+                            c.requests[r] = q
+                            mutated = True
                     for r, q in item.default.items():
                         c.limits.setdefault(r, q)
+        if mutated:
+            pod.invalidate_request_cache()
 
     def validate(self, store, kind: str, obj) -> None:
         if kind != "Pod":
@@ -623,6 +628,8 @@ def _apply_patch(obj, patch: List[dict]) -> None:
                     setattr(target, leaf, p.get("value"))
             else:
                 raise ValueError(f"unsupported op {op!r}")
+        except AdmissionError:
+            raise
         except Exception as exc:  # noqa: BLE001 — malformed webhook patch
             raise AdmissionError(
                 "MutatingAdmissionWebhook",
@@ -663,6 +670,11 @@ class MutatingAdmissionWebhook(AdmissionPlugin):
                     self.name, resp.get("message", "denied by webhook"))
             if self._mutating and resp.get("patch"):
                 _apply_patch(obj, resp["patch"])
+                if hasattr(obj, "invalidate_request_cache"):
+                    # the patch may have touched container requests/limits;
+                    # a stale cached resource_request would feed the
+                    # scheduler and quota silently (ADVICE r3)
+                    obj.invalidate_request_cache()
 
     def admit(self, store, kind: str, obj) -> None:
         self._dispatch(store, kind, obj, "CREATE")

@@ -92,7 +92,10 @@ class PolicyRule:
             return False
         if "*" not in self.resources and kind not in self.resources:
             return False
-        if self.resource_names and name not in self.resource_names:
+        if self.resource_names and name not in self.resource_names \
+                and name.rsplit("/", 1)[-1] not in self.resource_names:
+            # rbac resourceNames are bare object names; callers may pass the
+            # namespace-qualified store key (the node-authorizer contract)
             return False
         if subresource and "*" not in self.subresources \
                 and subresource not in self.subresources:
@@ -147,6 +150,87 @@ class RBACAuthorizer:
     def allowed(self, user: str, verb: str, kind: str, name: str = "",
                 subresource: str = "") -> bool:
         """store.authorizer seam (admission's blockOwnerDeletion check)."""
+        return self.allowed_for(user, (), verb, kind, name, subresource)
+
+
+class NodeAuthorizer:
+    """Graph-based node authorizer (plugin/pkg/auth/authorizer/node
+    node_authorizer.go): a kubelet identity (``system:node:<name>``) may
+    read a Secret/ConfigMap/PVC only when some pod BOUND TO THAT NODE
+    references it, and may touch its own Node/Lease and pods bound to
+    itself. Non-node users delegate to the wrapped authorizer (RBAC)."""
+
+    _GRAPH_KINDS = {"Secret", "ConfigMap", "PersistentVolumeClaim"}
+    _READ_VERBS = {"get", "list", "watch"}
+
+    def __init__(self, store, delegate=None):
+        self.store = store
+        self.delegate = delegate
+
+    @staticmethod
+    def _node_of(user: str):
+        return user[len("system:node:"):] if user.startswith("system:node:") else None
+
+    # kinds a kubelet may READ freely (the informer surfaces a node agent
+    # list/watches); everything else is default-deny for node identities
+    _OPEN_READ_KINDS = {"Node", "Pod", "Service", "Endpoints", "EndpointSlice",
+                        "Namespace", "Lease", "StorageClass", "CSINode",
+                        "PersistentVolume", "RuntimeClass"}
+
+    def _referenced_on_node(self, kind: str, name: str, node: str) -> bool:
+        # name must be the fully-qualified store key ("ns/name"): a bare
+        # name would let a node read the same-named object in ANY namespace
+        if "/" not in name:
+            return False
+        for pod in self.store.pods.values():
+            if pod.spec.node_name != node:
+                continue
+            ns = pod.meta.namespace
+            if kind == "Secret":
+                refs = pod.spec.secret_volumes
+            elif kind == "ConfigMap":
+                refs = pod.spec.config_map_volumes
+            else:  # PersistentVolumeClaim
+                refs = pod.spec.volumes
+            if any(f"{ns}/{r}" == name for r in refs):
+                return True
+        return False
+
+    def allowed_for(self, user: str, groups: Tuple[str, ...], verb: str,
+                    kind: str, name: str = "", subresource: str = "") -> bool:
+        node = self._node_of(user)
+        if node is None:
+            return (self.delegate.allowed_for(user, groups, verb, kind, name,
+                                              subresource)
+                    if self.delegate is not None else False)
+        if kind in self._GRAPH_KINDS:
+            return (verb in self._READ_VERBS
+                    and bool(name) and self._referenced_on_node(kind, name, node))
+        if kind in ("Node", "Lease"):
+            # own object only for writes; reads are unrestricted (kubelets
+            # watch the node corpus for their own object updates)
+            if verb in self._READ_VERBS:
+                return True
+            return name in ("", node)
+        if kind == "Pod":
+            if verb in self._READ_VERBS:
+                return True
+            # writes only against pods already bound to this node (status
+            # updates, deletes on eviction) — enforced here as well as by
+            # NodeRestriction admission, since the two are configured
+            # independently (node_authorizer.go does the same)
+            pod = self.store.pods.get(name)
+            return pod is not None and pod.spec.node_name == node
+        if kind == "Event":
+            return verb == "create"
+        if verb in self._READ_VERBS and kind in self._OPEN_READ_KINDS:
+            return True
+        # default-deny: a kubelet identity gets nothing else (in particular
+        # no RBAC/webhook/workload writes — node_authorizer.go fails closed)
+        return False
+
+    def allowed(self, user: str, verb: str, kind: str, name: str = "",
+                subresource: str = "") -> bool:
         return self.allowed_for(user, (), verb, kind, name, subresource)
 
 
@@ -233,9 +317,13 @@ class FlowController:
         for s in self.schemas:
             if s.matches(user, groups, verb) and s.level in self.levels:
                 return s.level
-        # unmatched traffic takes the LAST (lowest-priority, catch-all)
-        # level — never fail open into an exempt level
-        return list(self.levels)[-1]
+        # unmatched traffic takes the LAST non-exempt (lowest-priority,
+        # catch-all) level — never fail open into an exempt level, even
+        # with a custom level list whose last entry happens to be exempt
+        for name in reversed(self.levels):
+            if not self.levels[name].exempt:
+                return name
+        return next(iter(self.levels))  # all-exempt config: nothing to guard
 
     def dispatch(self, user: str, groups: Tuple[str, ...], verb: str
                  ) -> Optional[Callable[[], None]]:

@@ -53,6 +53,9 @@ RESOURCES = {
     ("api/v1", "persistentvolumeclaims"): "PersistentVolumeClaim",
     ("api/v1", "resourcequotas"): "ResourceQuota",
     ("api/v1", "limitranges"): "LimitRange",
+    ("api/v1", "configmaps"): "ConfigMap",
+    ("api/v1", "secrets"): "Secret",
+    ("api/v1", "serviceaccounts"): "ServiceAccount",
     ("apis/apps/v1", "deployments"): "Deployment",
     ("apis/apps/v1", "replicasets"): "ReplicaSet",
     ("apis/apps/v1", "statefulsets"): "StatefulSet",
@@ -133,9 +136,19 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(401, "Unauthorized", str(e))
                 return None
             user_name, groups = user.name, user.groups
+        elif cfg is not None and cfg.authorizer is not None:
+            # authorization without authentication: unauthenticated traffic
+            # is ANONYMOUS, never the admin default (everyone-is-admin) and
+            # never a spoofable X-Remote-User header — asserting an identity
+            # against an active authorizer requires an Authenticator that
+            # opted into proxy-header trust.
+            from .auth import ANONYMOUS, GROUP_UNAUTHENTICATED
+
+            user_name, groups = ANONYMOUS, (GROUP_UNAUTHENTICATED,)
         elif self.headers.get("X-Remote-User"):
-            # no authenticator configured: trust the proxy header so the
-            # NodeRestriction admission seam still sees kubelet identities
+            # no authenticator and no authorizer (open server): trust the
+            # proxy header so the NodeRestriction admission seam still sees
+            # kubelet identities
             user_name = self.headers["X-Remote-User"]
         self.store.set_request_user(user_name, groups)
         release = lambda: None  # noqa: E731
@@ -151,6 +164,12 @@ class _Handler(BaseHTTPRequestHandler):
             kind = r[1] if r is not None else ""
             name = r[3] or "" if r is not None else ""
             sub = r[4] or "" if r is not None else ""
+            if r is not None and name and r[2] is not None \
+                    and kind not in self.store.CLUSTER_SCOPED_KINDS:
+                # namespaced objects authorize by their store key — a bare
+                # name would collapse same-named objects across namespaces
+                # (the NodeAuthorizer graph check depends on this)
+                name = f"{r[2]}/{name}"
             if not cfg.authorizer.allowed_for(user_name, groups, verb, kind,
                                               name, sub):
                 release()
@@ -404,14 +423,21 @@ def serve_api(store: ClusterStore, port: int = 0, auth=None):
     authz_member = False
     if auth is not None and auth.authorizer is not None:
         # the admission seam (OwnerReferencesPermissionEnforcement) shares
-        # the HTTP layer's authorizer; refcounted so the LAST authz-enabled
-        # server on a store clears it on shutdown (no stale policy, and no
-        # clearing out from under a still-live sibling server)
+        # the HTTP layer's authorizer; refcounted ON THE STORE so the LAST
+        # authz-enabled server clears it on shutdown (no stale policy, no
+        # clearing out from under a still-live sibling server, and no
+        # touching an authorizer the caller installed manually — servers
+        # only join the refcount when serve_api itself performed or shares
+        # the install)
         with _AUTHZ_LOCK:
+            count = getattr(store, "_authz_install_count", 0)
             if store.authorizer is None:
                 store.authorizer = auth.authorizer
-            _AUTHZ_INSTALLS[id(store)] = _AUTHZ_INSTALLS.get(id(store), 0) + 1
-            authz_member = True
+                store._authz_install_count = count + 1
+                authz_member = True
+            elif count > 0:  # a sibling serve_api installed it: share it
+                store._authz_install_count = count + 1
+                authz_member = True
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
     server.__ktpu_installed_authorizer__ = (store if authz_member else None)
     server.__shutdown_request__ = False
@@ -420,7 +446,6 @@ def serve_api(store: ClusterStore, port: int = 0, auth=None):
     return server, server.server_address[1]
 
 
-_AUTHZ_INSTALLS: dict = {}  # id(store) -> live install count
 _AUTHZ_LOCK = threading.Lock()
 
 
@@ -429,11 +454,9 @@ def shutdown_api(server) -> None:
     store = getattr(server, "__ktpu_installed_authorizer__", None)
     if store is not None:
         with _AUTHZ_LOCK:
-            n = _AUTHZ_INSTALLS.get(id(store), 1) - 1
+            n = getattr(store, "_authz_install_count", 1) - 1
+            store._authz_install_count = max(n, 0)
             if n <= 0:
-                _AUTHZ_INSTALLS.pop(id(store), None)
                 store.authorizer = None  # last installer clears the seam
-            else:
-                _AUTHZ_INSTALLS[id(store)] = n
     server.shutdown()
     server.server_close()

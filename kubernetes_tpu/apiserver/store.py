@@ -134,6 +134,7 @@ class ClusterStore:
         self.mutating_webhooks: Dict[str, object] = {}
         self.validating_webhooks: Dict[str, object] = {}
         self.config_maps: Dict[str, object] = {}
+        self.secrets: Dict[str, object] = {}
         self.hpas: Dict[str, object] = {}
         self.cluster_roles: Dict[str, object] = {}
         self.cluster_role_bindings: Dict[str, object] = {}
@@ -307,6 +308,7 @@ class ClusterStore:
                 "MutatingWebhookConfiguration": self.mutating_webhooks,
                 "ValidatingWebhookConfiguration": self.validating_webhooks,
                 "ConfigMap": self.config_maps,
+                "Secret": self.secrets,
                 "HorizontalPodAutoscaler": self.hpas,
                 "ClusterRole": self.cluster_roles,
                 "ClusterRoleBinding": self.cluster_role_bindings,

@@ -34,6 +34,7 @@ GETTABLE = {
     "priorityclasses": "PriorityClass", "pc": "PriorityClass",
     "horizontalpodautoscalers": "HorizontalPodAutoscaler", "hpa": "HorizontalPodAutoscaler",
     "configmaps": "ConfigMap", "configmap": "ConfigMap", "cm": "ConfigMap",
+    "secrets": "Secret", "secret": "Secret",
     "serviceaccounts": "ServiceAccount", "serviceaccount": "ServiceAccount",
     "sa": "ServiceAccount",
     "poddisruptionbudgets": "PodDisruptionBudget", "pdb": "PodDisruptionBudget",

@@ -131,6 +131,24 @@ def _pod_wrapper(i: int, prefix: str, params: dict):
             LabelSelector(match_labels=match),
             anti=bool(params.get("anti")),
         )
+    if params.get("preferred_affinity_labels"):
+        # pod-with-preferred-pod-(anti-)affinity.yaml shape: a weighted
+        # preferred term selecting the pod's own label on hostname
+        from ..api.types import LabelSelector
+
+        match = dict(params["preferred_affinity_labels"])
+        for k, v in match.items():
+            pw.label(k, v)
+        pw.preferred_pod_affinity(
+            int(params.get("weight", 1)),
+            params.get("pod_affinity_key", "kubernetes.io/hostname"),
+            LabelSelector(match_labels=match),
+            anti=bool(params.get("anti")),
+        )
+    if params.get("secret_volume"):
+        # pod-with-secret-volume.yaml: mounts need no binding and never
+        # gate scheduling; the row measures the codec/admission cost only
+        pw.pod.spec.secret_volumes = (str(params["secret_volume"]),)
     if params.get("spread_topology_key"):
         from ..api.types import LabelSelector, TopologySpreadConstraint, DO_NOT_SCHEDULE
 
@@ -203,12 +221,64 @@ class Runner:
     # ---- ops ----
 
     def create_nodes(self, count: int, **params) -> None:
+        from ..api.types import CSINode, ObjectMeta
+
+        csi_driver = params.pop("csi_driver", None)
+        csi_count = int(params.pop("csi_count", 39))
         for i in range(len(self.store.nodes), len(self.store.nodes) + count):
-            self.store.create_node(_node_wrapper(i, params).obj())
+            node = _node_wrapper(i, params).obj()
+            self.store.create_node(node)
+            if csi_driver:
+                # nodeAllocatableStrategy.csiNodeAllocatable
+                # (performance-config.yaml:142-148): per-node CSINode with
+                # the driver's attachable-volume limit
+                self.store.create_csinode(CSINode(
+                    meta=ObjectMeta(name=node.meta.name),
+                    drivers={csi_driver: csi_count}))
+
+    def _make_pod(self, prefix: str, params: dict):
+        """One pod plus any per-pod side objects (pre-bound PV/PVC pairs,
+        the shared Secret) — the persistentVolumeTemplatePath /
+        defaultPodTemplatePath machinery of the reference harness."""
+        pw = _pod_wrapper(self._pod_counter, prefix, params)
+        if params.get("secret_volume"):
+            name = str(params["secret_volume"])
+            ns = pw.pod.meta.namespace
+            if self.store.get_object("Secret", f"{ns}/{name}") is None:
+                from ..api.types import ObjectMeta, Secret
+
+                self.store.create_object("Secret", Secret(
+                    meta=ObjectMeta(name=name, namespace=ns)))
+        pvc_params = params.get("pvc")
+        if pvc_params:
+            # pv-aws.yaml / pv-csi.yaml + pvc.yaml per measured pod, pre-bound
+            # (the reference's StartFakePVController completes the binding;
+            # here the pair is created already bound, the same steady state)
+            from ..api.types import ObjectMeta, PersistentVolume, PersistentVolumeClaim
+
+            i = self._pod_counter
+            ns = pw.pod.meta.namespace
+            pv_name, pvc_name = f"pv-{prefix}-{i}", f"pvc-{prefix}-{i}"
+            self.store.create_pv(PersistentVolume(
+                meta=ObjectMeta(name=pv_name),
+                capacity_bytes=1 << 30,
+                bound_pvc=f"{ns}/{pvc_name}",
+                access_modes=("ReadOnlyMany",),
+                volume_type=str(pvc_params.get("volume_type", "")),
+            ))
+            self.store.create_pvc(PersistentVolumeClaim(
+                meta=ObjectMeta(name=pvc_name, namespace=ns,
+                                annotations={"pv.kubernetes.io/bind-completed": "true"}),
+                bound_pv=pv_name,
+                access_modes=("ReadOnlyMany",),
+                requested_bytes=1 << 30,
+            ))
+            pw.pvc(pvc_name)
+        return pw.obj()
 
     def create_pods(self, count: int, prefix: str = "pod", **params) -> None:
         for _ in range(count):
-            self.store.create_pod(_pod_wrapper(self._pod_counter, prefix, params).obj())
+            self.store.create_pod(self._make_pod(prefix, params))
             self._pod_counter += 1
 
     def barrier(self, timeout_s: float = 300.0) -> None:
@@ -248,13 +318,13 @@ class Runner:
         col = ThroughputCollector(scheduled_count, interval=collector_interval)
         col.start(time.monotonic())
         for _ in range(count):
-            self.store.create_pod(_pod_wrapper(self._pod_counter, prefix, params).obj())
+            self.store.create_pod(self._make_pod(prefix, params))
             self._pod_counter += 1
         scheduled_before = scheduled_count()
         target = scheduled_before + count
         i = 0
         while scheduled_count() < target:
-            if self.backend in ("tpu", "wire"):
+            if self.backend in ("tpu", "wire", "grpc"):
                 progressed = self.scheduler.schedule_batch_cycle() > 0
             else:
                 progressed = self.scheduler.schedule_one()

@@ -81,20 +81,141 @@ def scheduling_pod_affinity(nodes=5000, init_pods=5000, measured=1000) -> dict:
     }
 
 
-def unschedulable(nodes=5000, measured=2000) -> dict:
-    """Unschedulable pods stress the failure path (performance-config.yaml
-    Unschedulable): measured pods request impossible cpu."""
+def unschedulable(nodes=5000, init_pods=200, measured=2000) -> dict:
+    """performance-config.yaml:437-463 Unschedulable: init pods request
+    impossible cpu and clog the queue (skipWaitToCompletion — no barrier);
+    the MEASURED pods are default-shaped, so the row reports schedulable
+    throughput while the failure path churns alongside."""
     return {
         "name": f"Unschedulable/{nodes}Nodes",
         "ops": [
             {"opcode": "createNodes", "count": nodes, "zones": 10},
             {
                 "opcode": "createPods",
-                "count": measured,
+                "count": init_pods,
                 "prefix": "unsched",
                 "req": {"cpu": "512", "memory": "4Ti"},
             },
+            {"opcode": "measurePods", "count": measured, "prefix": "measured"},
+        ],
+    }
+
+
+def scheduling_secrets(nodes=5000, init_pods=5000, measured=1000) -> dict:
+    """performance-config.yaml:52-72 SchedulingSecrets: every pod mounts a
+    secret volume (pod-with-secret-volume.yaml). Secret volumes need no
+    binding, so the row isolates the cost of the volume-bearing codec path
+    staying on the batched pipeline."""
+    pod = {"req": {"cpu": "100m", "memory": "500Mi"}, "secret_volume": "secret"}
+    return {
+        "name": f"SchedulingSecrets/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
             {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "sec", **pod},
+        ],
+    }
+
+
+def scheduling_intree_pvs(nodes=5000, init_pods=5000, measured=1000) -> dict:
+    """performance-config.yaml:74-97 SchedulingInTreePVs: each pod claims a
+    pre-bound in-tree (EBS) PV/PVC pair (pv-aws.yaml + pvc.yaml). PVC pods
+    take the host sequential path here (VolumeBinding is PreBind-heavy,
+    SURVEY §7 hard-part 6) — this row is the honest price of that fallback."""
+    pod = {"req": {"cpu": "100m", "memory": "500Mi"}, "pvc": {"volume_type": "ebs"}}
+    return {
+        "name": f"SchedulingInTreePVs/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "pv", **pod},
+        ],
+    }
+
+
+def scheduling_csi_pvs(nodes=5000, init_pods=5000, measured=1000) -> dict:
+    """performance-config.yaml:136-166 SchedulingCSIPVs: nodes carry a
+    CSINode attachable-volume limit (39, the EBS default) and pods claim
+    pre-bound CSI PVs — exercises the CSI volume-limits filter on the host
+    path."""
+    pod = {"req": {"cpu": "100m", "memory": "500Mi"}, "pvc": {"volume_type": ""}}
+    return {
+        "name": f"SchedulingCSIPVs/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10,
+             "csi_driver": "ebs.csi.aws.com", "csi_count": 39},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "csi", **pod},
+        ],
+    }
+
+
+def scheduling_preferred_pod_affinity(nodes=5000, init_pods=5000, measured=1000) -> dict:
+    """performance-config.yaml:199-226 SchedulingPreferredPodAffinity: pods
+    carry color=red and a weight-1 PREFERRED affinity to color=red on the
+    hostname topology (scoring load, no filter restriction)."""
+    pod = {
+        "req": {"cpu": "100m", "memory": "500Mi"},
+        "preferred_affinity_labels": {"color": "red"},
+    }
+    return {
+        "name": f"SchedulingPreferredPodAffinity/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "pref", **pod},
+        ],
+    }
+
+
+def scheduling_preferred_pod_anti_affinity(nodes=5000, init_pods=5000,
+                                           measured=1000) -> dict:
+    """performance-config.yaml:228-255: the anti flavor (spread by score)."""
+    pod = {
+        "req": {"cpu": "100m", "memory": "500Mi"},
+        "preferred_affinity_labels": {"color": "yellow"},
+        "anti": True,
+    }
+    return {
+        "name": f"SchedulingPreferredPodAntiAffinity/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "panti", **pod},
+        ],
+    }
+
+
+def mixed_scheduling_base_pod(nodes=5000, init_pods=2000, measured=1000) -> dict:
+    """performance-config.yaml:337-380 MixedSchedulingBasePod: one shared
+    zone; init waves of base, required (anti-)affinity, and preferred
+    (anti-)affinity pods, then measured base pods against that mixed
+    standing population."""
+    node_labels = {"topology.kubernetes.io/zone": "zone1",
+                   "kubernetes.io/hostname": "node-{i}"}
+    base = {"req": {"cpu": "100m", "memory": "500Mi"}}
+    return {
+        "name": f"MixedSchedulingBasePod/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "labels": node_labels},
+            {"opcode": "createPods", "count": init_pods, "prefix": "base", **base},
+            {"opcode": "createPods", "count": init_pods, "prefix": "aff", **base,
+             "pod_affinity_key": "kubernetes.io/hostname",
+             "pod_affinity_labels": {"color": "blue"}},
+            {"opcode": "createPods", "count": init_pods, "prefix": "anti", **base,
+             "pod_affinity_key": "kubernetes.io/hostname",
+             "pod_affinity_labels": {"color": "green"}, "anti": True},
+            {"opcode": "createPods", "count": init_pods, "prefix": "paff", **base,
+             "preferred_affinity_labels": {"color": "red"}},
+            {"opcode": "createPods", "count": init_pods, "prefix": "panti", **base,
+             "preferred_affinity_labels": {"color": "yellow"}, "anti": True},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "measured", **base},
         ],
     }
 
@@ -135,6 +256,12 @@ TEST_CASES = {
     "SchedulingBasic": scheduling_basic,
     "SchedulingPodAntiAffinity": scheduling_pod_anti_affinity,
     "SchedulingPodAffinity": scheduling_pod_affinity,
+    "SchedulingPreferredPodAffinity": scheduling_preferred_pod_affinity,
+    "SchedulingPreferredPodAntiAffinity": scheduling_preferred_pod_anti_affinity,
+    "SchedulingSecrets": scheduling_secrets,
+    "SchedulingInTreePVs": scheduling_intree_pvs,
+    "SchedulingCSIPVs": scheduling_csi_pvs,
+    "MixedSchedulingBasePod": mixed_scheduling_base_pod,
     "TopologySpreading": topology_spreading,
     "Unschedulable": unschedulable,
     "PreemptionBasic": preemption_basic,

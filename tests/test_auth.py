@@ -12,6 +12,7 @@ from kubernetes_tpu.api.types import ObjectMeta
 from kubernetes_tpu.api.wrappers import make_node, make_pod
 from kubernetes_tpu.apiserver.auth import (
     AuthConfig,
+    GROUP_MASTERS,
     Authenticator,
     AuthenticationError,
     ClusterRole,
@@ -242,3 +243,57 @@ class TestHandlerChainE2E:
             assert e.value.code == 403
         finally:
             shutdown_api(server)
+
+
+class TestNodeAuthorizer:
+    """Graph-based node authorizer (plugin/pkg/auth/authorizer/node):
+    kubelet reads of Secret/ConfigMap gated on a pod bound to that node
+    referencing the object."""
+
+    def _store(self):
+        from kubernetes_tpu.api.types import Secret
+
+        store = ClusterStore()
+        store.create_node(make_node("n1").capacity({"cpu": "8"}).obj())
+        store.create_node(make_node("n2").capacity({"cpu": "8"}).obj())
+        store.create_object("Secret", Secret(meta=ObjectMeta(name="db-creds")))
+        pod = make_pod("web").obj()
+        pod.spec.secret_volumes = ("db-creds",)
+        pod.spec.node_name = "n1"
+        store.create_pod(pod)
+        return store
+
+    def test_kubelet_reads_referenced_secret_only(self):
+        from kubernetes_tpu.apiserver.auth import NodeAuthorizer
+
+        store = self._store()
+        authz = NodeAuthorizer(store)
+        assert authz.allowed_for("system:node:n1", (), "get", "Secret",
+                                 "default/db-creds")
+        # n2 has no pod referencing it
+        assert not authz.allowed_for("system:node:n2", (), "get", "Secret",
+                                     "default/db-creds")
+        # unreferenced secret denied even on the right node
+        assert not authz.allowed_for("system:node:n1", (), "get", "Secret",
+                                     "default/other")
+        # writes never pass the graph rule
+        assert not authz.allowed_for("system:node:n1", (), "update", "Secret",
+                                     "default/db-creds")
+
+    def test_node_writes_own_object_only(self):
+        from kubernetes_tpu.apiserver.auth import NodeAuthorizer
+
+        authz = NodeAuthorizer(self._store())
+        assert authz.allowed_for("system:node:n1", (), "update", "Node", "n1")
+        assert not authz.allowed_for("system:node:n1", (), "update", "Node", "n2")
+        assert authz.allowed_for("system:node:n1", (), "get", "Node", "n2")
+
+    def test_non_node_users_delegate(self):
+        from kubernetes_tpu.apiserver.auth import NodeAuthorizer, RBACAuthorizer
+
+        store = self._store()
+        authz = NodeAuthorizer(store, delegate=RBACAuthorizer(store))
+        # no bindings: denied via RBAC delegate, not via node rules
+        assert not authz.allowed_for("alice", (), "get", "Secret", "default/db-creds")
+        assert authz.allowed_for("root", (GROUP_MASTERS,), "get", "Secret",
+                                 "default/db-creds")
