@@ -1,0 +1,126 @@
+"""Out-of-tree scheduler plugin registration — the ``app.WithPlugin``
+analog (reference: cmd/kube-scheduler/app/server.go:293).
+
+The reference lets vendors ship plugins outside the kubernetes tree:
+
+    command := app.NewSchedulerCommand(
+        app.WithPlugin("ZoneWeight", zoneweight.New),
+    )
+
+and enable them per profile in KubeSchedulerConfiguration. This framework's
+equivalent is the ``out_of_tree_registry`` argument of
+``kubernetes_tpu.config.scheduler_from_config``: a ``{name: factory}`` map
+merged with the in-tree registry (config/factory.py; a name collision with
+an in-tree plugin raises). The factory signature matches the in-tree ones:
+
+    factory(handle_ctx, args) -> Plugin instance
+
+* ``handle_ctx`` is the framework Handle (snapshot/listers/client seams);
+  most out-of-tree plugins only need ``args``.
+* ``args`` is the profile's pluginConfig args block for this plugin,
+  already decoded to a plain dict.
+
+The plugin below implements two extension points (Filter + Score) the way
+an in-tree plugin does; enable it through a config profile, including
+MultiPoint shorthand. Pods scheduled through a profile carrying a
+non-default plugin set take the host (sequential) path automatically —
+TPUScheduler only batches profiles whose compiled program matches the
+default set (backend/tpu_scheduler.py _framework_batchable), so out-of-tree
+plugins are always honored.
+
+Run me:  python examples/out_of_tree_plugin.py
+"""
+
+from __future__ import annotations
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.config import scheduler_from_config
+from kubernetes_tpu.framework.interface import (
+    FilterPlugin,
+    ScorePlugin,
+    Status,
+)
+
+
+class ZoneWeight(FilterPlugin, ScorePlugin):
+    """Filter out forbidden zones; score the rest by configured weights.
+
+    Args (pluginConfig):
+        forbidden: [zone, ...]        zones no pod may land in
+        weights:   {zone: 0..100}     preference per zone (default 50)
+    """
+
+    NAME = "ZoneWeight"
+    ZONE_LABEL = "zone"
+
+    def __init__(self, handle, args: dict):
+        self.handle = handle
+        self.forbidden = set(args.get("forbidden", ()))
+        self.weights = dict(args.get("weights", {}))
+
+    def name(self) -> str:
+        return self.NAME
+
+    # -- Filter extension point
+    def filter(self, state, pod, node_info) -> Status:
+        zone = node_info.node.meta.labels.get(self.ZONE_LABEL, "")
+        if zone in self.forbidden:
+            return Status.unschedulable(
+                f"zone {zone!r} is forbidden").with_plugin(self.NAME)
+        return Status()
+
+    # -- Score extension point (the runtime calls score_node with the
+    #    NodeInfo and expects ``(raw_score, Status)``)
+    def score(self, state, pod, node_name):
+        raise NotImplementedError  # the runtime drives score_node
+
+    def score_node(self, state, pod, node_info):
+        zone = node_info.node.meta.labels.get(self.ZONE_LABEL, "")
+        return int(self.weights.get(zone, 50)), Status()
+
+
+def main() -> None:
+    store = ClusterStore()
+    for i in range(6):
+        store.create_node(
+            make_node(f"node-{i}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": 20})
+            .label("zone", f"z{i % 3}")
+            .obj())
+
+    # KubeSchedulerConfiguration (raw v1beta3-shaped dict): enable the
+    # plugin on a dedicated profile; z2 forbidden, z1 preferred
+    raw = {
+        "apiVersion": "kubescheduler.config.k8s.io/v1beta3",
+        "kind": "KubeSchedulerConfiguration",
+        "profiles": [{
+            "schedulerName": "zoned-scheduler",
+            "plugins": {
+                "filter": {"enabled": [{"name": ZoneWeight.NAME}]},
+                "score": {"enabled": [{"name": ZoneWeight.NAME, "weight": 5}]},
+            },
+            "pluginConfig": [{
+                "name": ZoneWeight.NAME,
+                "args": {"forbidden": ["z2"], "weights": {"z1": 100, "z0": 10}},
+            }],
+        }],
+    }
+    sched = scheduler_from_config(
+        store, raw=raw,
+        out_of_tree_registry={ZoneWeight.NAME: ZoneWeight},
+    )
+
+    for i in range(4):
+        pw = make_pod(f"pod-{i}").req({"cpu": "500m", "memory": "512Mi"})
+        pw.scheduler_name("zoned-scheduler")
+        store.create_pod(pw.obj())
+    sched.run_until_settled()
+
+    for key, pod in store.pods.items():
+        node = store.nodes[pod.spec.node_name]
+        print(f"{key} -> {pod.spec.node_name} (zone {node.meta.labels['zone']})")
+
+
+if __name__ == "__main__":
+    main()

@@ -347,6 +347,8 @@ class PodStatus:
     phase: str = "Pending"
     nominated_node_name: str = ""
     start_time: float = 0.0
+    reason: str = ""   # machine-readable phase reason, e.g. "Evicted"
+    message: str = ""  # human-readable detail
 
 
 @dataclass
@@ -436,6 +438,12 @@ class NodeStatus:
     allocatable: Dict[str, object] = field(default_factory=dict)
     images: Tuple[ContainerImage, ...] = ()
     ready: bool = True
+    # pressure conditions (core/v1 NodeConditionType MemoryPressure/
+    # DiskPressure/PIDPressure), set by the kubelet eviction manager; the
+    # nodelifecycle controller mirrors them as NoSchedule taints
+    memory_pressure: bool = False
+    disk_pressure: bool = False
+    pid_pressure: bool = False
 
 
 @dataclass

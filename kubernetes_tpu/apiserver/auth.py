@@ -182,7 +182,9 @@ class NodeAuthorizer:
         # name would let a node read the same-named object in ANY namespace
         if "/" not in name:
             return False
-        for pod in self.store.pods.values():
+        with self.store._lock:  # threaded API server: pods map is shared
+            pods = list(self.store.pods.values())
+        for pod in pods:
             if pod.spec.node_name != node:
                 continue
             ns = pod.meta.namespace
@@ -208,10 +210,12 @@ class NodeAuthorizer:
                     and bool(name) and self._referenced_on_node(kind, name, node))
         if kind in ("Node", "Lease"):
             # own object only for writes; reads are unrestricted (kubelets
-            # watch the node corpus for their own object updates)
+            # watch the node corpus for their own object updates). Lease
+            # names arrive namespace-qualified ("kube-node-lease/<node>")
+            # from the HTTP gate — compare the bare object name.
             if verb in self._READ_VERBS:
                 return True
-            return name in ("", node)
+            return name.rsplit("/", 1)[-1] in ("", node)
         if kind == "Pod":
             if verb in self._READ_VERBS:
                 return True

@@ -161,6 +161,10 @@ class ClusterStore:
         from .admission import AdmissionChain
 
         self.admission: Optional[AdmissionChain] = AdmissionChain()
+        # durable-store seam (apiserver/wal.py attach_wal): when set, every
+        # journaled mutation also lands in the write-ahead log — the etcd
+        # WAL role (etcd3/store.go:72); None = memory-only (the default)
+        self._wal = None
 
     def add_event_handler(self, kind: str, handler: Handler) -> None:
         self._handlers.setdefault(kind, []).append(handler)
@@ -175,6 +179,10 @@ class ClusterStore:
         self._journal.append((seq, kind, event, old, new))
         if len(self._journal) > self._journal_capacity:
             del self._journal[: len(self._journal) - self._journal_capacity]
+        if self._wal is not None:
+            obj = new if new is not None else None
+            key = self._key_of(kind, new if new is not None else old)
+            self._wal.append(seq, kind, event, key, obj)
         for w in self._watchers.get(kind, []):
             w._push(WatchEvent(seq=seq, type=event, old=old, object=new if new is not None else old))
 
@@ -278,9 +286,13 @@ class ClusterStore:
             if w in lst:
                 lst.remove(w)
 
-    def _kind_map(self, kind: str) -> Dict[str, object]:
-        try:
-            return {
+    @property
+    def KINDS(self):
+        """Every kind the store persists (the WAL snapshot's catalog)."""
+        return tuple(self._kind_maps())
+
+    def _kind_maps(self) -> Dict[str, Dict[str, object]]:
+        return {
                 "Pod": self.pods,
                 "Node": self.nodes,
                 "Namespace": self.namespaces,
@@ -312,7 +324,11 @@ class ClusterStore:
                 "HorizontalPodAutoscaler": self.hpas,
                 "ClusterRole": self.cluster_roles,
                 "ClusterRoleBinding": self.cluster_role_bindings,
-            }[kind]
+            }
+
+    def _kind_map(self, kind: str) -> Dict[str, object]:
+        try:
+            return self._kind_maps()[kind]
         except KeyError:
             raise NotFound(f"unknown kind {kind!r}") from None
 

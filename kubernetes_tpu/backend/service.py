@@ -155,15 +155,19 @@ class DeviceService:
             self.batch_counter += 1
             # sampling parity with the in-process batched path: explicit
             # percentage → exact rotating-window emulation; adaptive (0) →
-            # full-batch evaluation (the tpu_scheduler._flush_batch rule)
+            # full batch on accelerators, reference adaptive sample on CPU
+            # (the tpu_scheduler._flush_batch rule)
             from ..scheduler.scheduler import num_feasible_nodes_to_find
+            from .tpu_scheduler import _default_full_batch
 
             n_valid = len(self.infos)
             if self.percentage_of_nodes_to_score:
                 k = num_feasible_nodes_to_find(n_valid,
                                                self.percentage_of_nodes_to_score)
-            else:
+            elif _default_full_batch():
                 k = n_valid
+            else:
+                k = num_feasible_nodes_to_find(n_valid, 0)
             if k < n_valid:
                 sample_k = np.int32(k)
                 sample_start = (self._start_carry if self._start_carry is not None

@@ -257,7 +257,7 @@ class SigTable:
         )
 
     def encode_topo(self, pods: List[Pod], hard_pod_affinity_weight: int = 1,
-                    ignore_preferred: bool = False):
+                    ignore_preferred: bool = False, capacity=None):
         """Compile a pod batch's topology programs → TopoBatch.
 
         Two passes: first register every signature/term the batch introduces
@@ -270,9 +270,12 @@ class SigTable:
         from ..ops.schema import TopoBatch
 
         caps = self.caps
-        P = caps.pods
-        if len(pods) > P:
-            raise CapacityError("pods", len(pods), P)
+        # pad to a smaller pod bucket when asked (must match encode_pods —
+        # the compiled program's step count is the padded size)
+        P = caps.pods if capacity is None else min(int(capacity), caps.pods)
+        if len(pods) > caps.pods:
+            raise CapacityError("pods", len(pods), caps.pods)
+        assert len(pods) <= P, "bucket smaller than the batch"
 
         # ---- pass 1: registration
         for pod in pods:

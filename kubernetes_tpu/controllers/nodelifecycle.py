@@ -20,7 +20,18 @@ from .base import Controller
 NODE_LEASE_NAMESPACE = "kube-node-lease"
 TAINT_UNREACHABLE = "node.kubernetes.io/unreachable"
 TAINT_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_MEMORY_PRESSURE = "node.kubernetes.io/memory-pressure"
+TAINT_DISK_PRESSURE = "node.kubernetes.io/disk-pressure"
+TAINT_PID_PRESSURE = "node.kubernetes.io/pid-pressure"
 DEFAULT_GRACE_PERIOD = 40.0  # --node-monitor-grace-period default
+
+# pressure condition attribute -> mirrored NoSchedule taint
+# (node_lifecycle_controller.go nodeConditionToTaintKeyStatusMap)
+_PRESSURE_TAINTS = (
+    ("memory_pressure", TAINT_MEMORY_PRESSURE),
+    ("disk_pressure", TAINT_DISK_PRESSURE),
+    ("pid_pressure", TAINT_PID_PRESSURE),
+)
 
 
 class NodeLifecycleController(Controller):
@@ -56,6 +67,8 @@ class NodeLifecycleController(Controller):
         node: Optional[Node] = self.store.nodes.get(key)
         if node is None:
             return
+        self._sync_pressure_taints(node)
+        node = self.store.nodes.get(key) or node  # taint write bumped it
         lease = self._lease_of(key)
         healthy = (
             lease is not None
@@ -75,6 +88,26 @@ class NodeLifecycleController(Controller):
         elif not healthy and self.evict:
             self._not_ready_since.setdefault(key, self.now_fn())
             self._evict_pods(key)
+
+    def _sync_pressure_taints(self, node: Node) -> None:
+        """Mirror the kubelet-reported pressure conditions as NoSchedule
+        taints (node_lifecycle_controller.go doNoScheduleTaintingPass):
+        TaintToleration then keeps new pods off pressured nodes while the
+        eviction manager reclaims."""
+        want = {taint_key: bool(getattr(node.status, attr))
+                for attr, taint_key in _PRESSURE_TAINTS}
+        have = {t.key for t in node.spec.taints}
+        if all((k in have) == v for k, v in want.items()):
+            return
+        taints = tuple(t for t in node.spec.taints
+                       if t.key not in want or want[t.key])
+        for k, v in want.items():
+            if v and k not in have:
+                taints = taints + (Taint(key=k, effect="NoSchedule"),)
+        new = node.clone() if hasattr(node, "clone") else dataclasses.replace(node)
+        new.meta = dataclasses.replace(node.meta)
+        new.spec = dataclasses.replace(node.spec, taints=taints)
+        self.store.update_node(new)
 
     def _set_health(self, node: Node, ready: bool) -> None:
         taints = tuple(

@@ -74,11 +74,16 @@ class ReplicaSetController(Controller):
         rs: Optional[ReplicaSet] = self.store.get_replica_set(key)
         if rs is None or rs.meta.deletion_timestamp:
             return
-        pods = [p for p in _owned_pods(self.store, rs.meta.namespace, "ReplicaSet", rs.meta.name)
-                if not p.meta.deletion_timestamp]
+        owned = [p for p in _owned_pods(self.store, rs.meta.namespace, "ReplicaSet", rs.meta.name)
+                 if not p.meta.deletion_timestamp]
+        # FilterActivePods (pkg/controller/controller_utils.go:922): a
+        # Succeeded/Failed pod (e.g. evicted by the kubelet) no longer
+        # counts toward the replica set — it must be replaced
+        pods = [p for p in owned if p.status.phase not in ("Succeeded", "Failed")]
         diff = rs.replicas - len(pods)
         if diff > 0:
-            used = {p.meta.name for p in pods}
+            # terminal pods still hold their names: never reuse one
+            used = {p.meta.name for p in owned}
             i = 0
             while diff > 0:
                 name = f"{rs.meta.name}-{i}"

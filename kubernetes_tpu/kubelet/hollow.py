@@ -48,6 +48,17 @@ class HollowKubelet:
         # RemovePodSandbox (kubelet.go:1502 syncPod's runtime calls)
         self.runtime = runtime
         self._sandbox_of: Dict[str, str] = {}  # pod key → sandbox id
+        # PLEG (pleg/generic.go): relists the runtime and emits lifecycle
+        # events; syncLoopIteration consumes them to repair pods whose
+        # containers changed state underneath the kubelet (crashes, runtime
+        # restarts). Only meaningful with a runtime attached.
+        from .pleg import GenericPLEG
+
+        self.pleg = GenericPLEG(runtime, now_fn=now_fn) if runtime is not None else None
+        self.pleg_restarts = 0  # containers restarted off PLEG died events
+        # eviction manager seam (kubelet/eviction.py EvictionManager):
+        # attach via attach_eviction_manager(); run_once drives it
+        self.eviction_manager = None
 
     # ------------------------------------------------------------ registration
 
@@ -194,9 +205,47 @@ class HollowKubelet:
         except NotFound:
             pass  # deleted mid-sync
 
+    # ------------------------------------------------------------- PLEG loop
+
+    def _process_pleg_events(self) -> int:
+        """syncLoopIteration's plegCh arm (kubelet.go:2061): a ContainerDied
+        for a pod that should be Running is repaired per restartPolicy
+        (Always — the default; hollow pods carry no explicit policy)."""
+        if self.pleg is None:
+            return 0
+        from .pleg import CONTAINER_DIED
+
+        repaired = 0
+        for ev in self.pleg.relist():
+            if ev.type != CONTAINER_DIED:
+                continue
+            pod = self.store.get_pod(ev.pod_key)
+            if pod is None or pod.status.phase != "Running":
+                continue  # deletion teardown or completed pod: expected death
+            sid = self._sandbox_of.get(ev.pod_key)
+            if sid is None:
+                continue
+            status = self.runtime.container_status(ev.data)
+            if status is not None and status["state"] == "CONTAINER_EXITED":
+                self.runtime.remove_container(ev.data)
+                cid = self.runtime.create_container(
+                    sid, {"name": status.get("name", "c"),
+                          "image": status.get("image", "")})
+                self.runtime.start_container(cid)
+                repaired += 1
+                self.pleg_restarts += 1
+        return repaired
+
+    def attach_eviction_manager(self, mgr) -> None:
+        self.eviction_manager = mgr
+
     def run_once(self) -> int:
-        """register + heartbeat + sync — one full kubelet tick."""
+        """register + heartbeat + PLEG relist + eviction pass + sync —
+        one full kubelet tick."""
         if not self.registered:
             self.register()
         self.heartbeat()
+        self._process_pleg_events()
+        if self.eviction_manager is not None:
+            self.eviction_manager.synchronize()
         return self.sync()

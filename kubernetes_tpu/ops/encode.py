@@ -530,15 +530,21 @@ class ClusterEncoder:
             self._pod_templates[sig] = tmpl
         return tmpl
 
-    def encode_pods(self, pods: Sequence[Pod]) -> Tuple["schema.PodBatch", "schema.ExprTable"]:
+    def encode_pods(self, pods: Sequence[Pod], capacity: Optional[int] = None
+                    ) -> Tuple["schema.PodBatch", "schema.ExprTable"]:
+        """``capacity`` pads the pod axis to a smaller bucket than caps.pods:
+        the compiled program's step count (and the speculative rounds' [P,N]
+        width) is the PADDED size, so deadline-cut batches must compile at a
+        matching bucket or they pay the full-capacity program anyway."""
         import jax.numpy as jnp
 
         from ..framework.plugins.imagelocality import normalized_image_name
 
         caps = self.caps
-        P = caps.pods
-        if len(pods) > P:
-            raise CapacityError("pods", len(pods), P)
+        P = caps.pods if capacity is None else min(int(capacity), caps.pods)
+        if len(pods) > caps.pods:
+            raise CapacityError("pods", len(pods), caps.pods)
+        assert len(pods) <= P, "bucket smaller than the batch"
         builder = _ExprBuilder(caps)
 
         valid = np.zeros(P, bool)

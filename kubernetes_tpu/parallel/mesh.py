@@ -71,9 +71,16 @@ def shard_topo_counts(tc: TopoCounts, mesh: Mesh) -> TopoCounts:
 
 
 def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = None,
-                             topo_enabled: bool = True):
+                             topo_enabled: bool = True,
+                             spec_decode: bool = False):
     """Compile schedule_batch over the mesh: node axis sharded, pods/exprs
-    replicated, results replicated (winner slots are global indices)."""
+    replicated, results replicated (winner slots are global indices).
+
+    ``spec_decode`` runs the speculative decide/repair rounds instead of the
+    P-step scan — supported under sharding for the topology-off program
+    (the headline shape); topology batches keep the scan on a mesh."""
+    assert not (spec_decode and topo_enabled), \
+        "sharded speculative decode requires topo_enabled=False"
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     import dataclasses
 
@@ -106,7 +113,7 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
 
     body = functools.partial(schedule_batch_core, weights_key=wk,
                              topo_enabled=topo_enabled, axis_name=AXIS,
-                             num_shards=mesh.size)
+                             num_shards=mesh.size, spec_decode=spec_decode)
     sharded = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pb_spec, et_spec, nt_spec, tc_spec, tb_spec, P()),

@@ -1,0 +1,214 @@
+"""Kubelet node internals (VERDICT r3 item 7): PLEG event stream over the
+CRI journal, and the eviction manager's pressure-signal loop — evict lowest
+value first, report node conditions, scheduler reroutes replacements."""
+
+import pytest
+
+from kubernetes_tpu.api.types import ObjectMeta, Pod, PodSpec, Container
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.kubelet.cri import FakeRuntimeService
+from kubernetes_tpu.kubelet.eviction import (
+    SIGNAL_MEMORY_AVAILABLE,
+    EvictionManager,
+    PodStats,
+)
+from kubernetes_tpu.kubelet.hollow import HollowKubelet
+from kubernetes_tpu.kubelet.pleg import (
+    CONTAINER_DIED,
+    CONTAINER_REMOVED,
+    CONTAINER_STARTED,
+    GenericPLEG,
+)
+
+
+def _node(name, mem="8Gi"):
+    return make_node(name).capacity({"cpu": "8", "memory": mem, "pods": 20}).obj()
+
+
+class TestPLEG:
+    def test_event_stream_started_died_removed(self):
+        rt = FakeRuntimeService()
+        pleg = GenericPLEG(rt)
+        assert pleg.relist() == []  # empty runtime: no events
+
+        sid = rt.run_pod_sandbox({"name": "web", "namespace": "default", "uid": "u1"})
+        cid = rt.create_container(sid, {"name": "c", "image": "pause"})
+        rt.start_container(cid)
+        events = pleg.relist()
+        assert [e.type for e in events] == [CONTAINER_STARTED]
+        assert events[0].pod_key == "default/web"
+        assert events[0].data == cid
+
+        rt.stop_container(cid)
+        events = pleg.relist()
+        assert [e.type for e in events] == [CONTAINER_DIED]
+
+        rt.remove_container(cid)
+        events = pleg.relist()
+        assert [e.type for e in events] == [CONTAINER_REMOVED]
+
+        # steady state: no spurious events
+        assert pleg.relist() == []
+
+    def test_healthy_tracks_relist_age(self):
+        clock = [0.0]
+        pleg = GenericPLEG(FakeRuntimeService(), now_fn=lambda: clock[0])
+        pleg.relist()
+        assert pleg.healthy()
+        clock[0] += 1000.0  # beyond the 3-minute relist threshold
+        assert not pleg.healthy()
+
+    def test_kubelet_restarts_crashed_container(self):
+        """kubelet.go:2061 plegCh arm: a container that dies underneath the
+        kubelet (crash) is restarted per restartPolicy Always."""
+        store = ClusterStore()
+        rt = FakeRuntimeService()
+        kubelet = HollowKubelet(store, _node("n1"), runtime=rt)
+        kubelet.register()
+        pod = make_pod("web").req({"cpu": "1"}).obj()
+        pod.spec.node_name = "n1"
+        store.create_pod(pod)
+        kubelet.run_once()  # pod Running, container up
+        kubelet.run_once()  # PLEG observes the started container
+        [c] = [c for c in rt.containers.values()
+               if c["state"] == "CONTAINER_RUNNING"]
+        rt.stop_container(c["id"])  # crash, not kubelet-initiated
+        kubelet.run_once()
+        assert kubelet.pleg_restarts == 1
+        running = [c for c in rt.containers.values()
+                   if c["state"] == "CONTAINER_RUNNING"]
+        assert len(running) == 1  # replacement container is up
+        assert running[0]["id"] != c["id"]
+
+
+class TestEvictionManager:
+    def _pressured_setup(self):
+        store = ClusterStore()
+        store.create_node(_node("n1"))
+        signals = {SIGNAL_MEMORY_AVAILABLE: 1 << 30}  # 1Gi free: healthy
+        usage = {}
+
+        mgr = EvictionManager(
+            store, "n1",
+            stats_fn=lambda: dict(signals),
+            pod_stats_fn=lambda key: usage.get(key, PodStats()),
+            pressure_transition_period=30.0,
+            now_fn=lambda: clock[0])
+        clock = [0.0]
+        return store, signals, usage, mgr, clock
+
+    def test_no_pressure_no_eviction(self):
+        store, signals, usage, mgr, clock = self._pressured_setup()
+        p = make_pod("a").req({"cpu": "1"}).obj()
+        p.spec.node_name = "n1"
+        store.create_pod(p)
+        assert mgr.synchronize() is None
+        assert not store.nodes["n1"].status.memory_pressure
+
+    def test_evicts_lowest_priority_first_and_sets_condition(self):
+        store, signals, usage, mgr, clock = self._pressured_setup()
+        for name, prio, mem in (("low", 0, 100 << 20),
+                                ("high", 100, 200 << 20)):
+            p = make_pod(name).req({"cpu": "1", "memory": "64Mi"}).priority(prio).obj()
+            p.spec.node_name = "n1"
+            p.status.phase = "Running"
+            store.create_pod(p)
+            usage[f"default/{name}"] = PodStats(memory_bytes=mem)
+        signals[SIGNAL_MEMORY_AVAILABLE] = 50 << 20  # below the 100Mi threshold
+        evicted = mgr.synchronize()
+        # both exceed request; lower priority goes first despite lower usage
+        assert evicted == "default/low"
+        pod = store.get_pod("default/low")
+        assert pod.status.phase == "Failed"
+        assert pod.status.reason == "Evicted"
+        assert store.nodes["n1"].status.memory_pressure
+        # one eviction per pass (the next observation must see the relief)
+        assert store.get_pod("default/high").status.phase == "Running"
+
+    def test_exceeds_request_outranks_priority(self):
+        store, signals, usage, mgr, clock = self._pressured_setup()
+        # high-priority pod EXCEEDS its request; low-priority pod within
+        for name, prio, req, mem in (("greedy", 100, "64Mi", 500 << 20),
+                                     ("frugal", 0, "1Gi", 10 << 20)):
+            p = make_pod(name).req({"cpu": "1", "memory": req}).priority(prio).obj()
+            p.spec.node_name = "n1"
+            p.status.phase = "Running"
+            store.create_pod(p)
+            usage[f"default/{name}"] = PodStats(memory_bytes=mem)
+        signals[SIGNAL_MEMORY_AVAILABLE] = 50 << 20
+        assert mgr.synchronize() == "default/greedy"
+
+    def test_condition_clears_after_transition_period(self):
+        store, signals, usage, mgr, clock = self._pressured_setup()
+        signals[SIGNAL_MEMORY_AVAILABLE] = 50 << 20
+        mgr.synchronize()
+        assert store.nodes["n1"].status.memory_pressure
+        signals[SIGNAL_MEMORY_AVAILABLE] = 4 << 30  # pressure relieved
+        mgr.synchronize()
+        # anti-flap: condition holds through the transition period
+        assert store.nodes["n1"].status.memory_pressure
+        clock[0] += 31.0
+        mgr.synchronize()
+        assert not store.nodes["n1"].status.memory_pressure
+
+
+class TestEvictionEndToEnd:
+    def test_pressured_node_evicts_and_scheduler_reroutes(self):
+        """VERDICT r3 item 7 'done' criterion: a pressured node evicts its
+        lowest-priority pod, the nodelifecycle controller mirrors the
+        pressure condition as a NoSchedule taint, the ReplicaSet controller
+        replaces the Failed pod, and the scheduler lands the replacement on
+        the healthy node."""
+        from kubernetes_tpu.api.types import ReplicaSet, LabelSelector
+        from kubernetes_tpu.client.informer import SharedInformerFactory
+        from kubernetes_tpu.controllers.manager import ControllerManager
+        from kubernetes_tpu.controllers.nodelifecycle import TAINT_MEMORY_PRESSURE
+        from kubernetes_tpu.scheduler import Scheduler
+
+        store = ClusterStore()
+        store.create_node(_node("pressured"))
+        store.create_node(_node("healthy"))
+        sched = Scheduler(store)
+        mgr_ctl = ControllerManager(
+            store, factory=SharedInformerFactory(store),
+            controllers=["replicaset", "nodelifecycle"])
+
+        template = Pod(
+            meta=ObjectMeta(name="web", labels={"app": "web"}),
+            spec=PodSpec(containers=[
+                Container(name="c", requests={"cpu": "1", "memory": "64Mi"})]),
+        )
+        store.create_replica_set(ReplicaSet(
+            meta=ObjectMeta(name="web"), replicas=2,
+            selector=LabelSelector(match_labels={"app": "web"}),
+            template=template))
+        mgr_ctl.settle()
+        sched.run_until_settled()
+        pods = [p for p in store.pods.values() if p.status.phase != "Failed"]
+        assert len(pods) == 2 and all(p.spec.node_name for p in pods)
+        before_keys = {p.meta.key() for p in pods}
+
+        # pressure the node one of them landed on
+        victim_node = pods[0].spec.node_name
+        signals = {SIGNAL_MEMORY_AVAILABLE: 10 << 20}
+        ev_mgr = EvictionManager(store, victim_node, stats_fn=lambda: dict(signals))
+        evicted_key = ev_mgr.synchronize()
+        assert evicted_key is not None
+        assert store.get_pod(evicted_key).status.reason == "Evicted"
+        assert store.nodes[victim_node].status.memory_pressure
+
+        # nodelifecycle mirrors the condition as a NoSchedule taint; the
+        # ReplicaSet controller replaces the Failed pod
+        mgr_ctl.settle()
+        taints = {t.key for t in store.nodes[victim_node].spec.taints}
+        assert TAINT_MEMORY_PRESSURE in taints
+        sched.run_until_settled()
+        fresh = [p for p in store.pods.values()
+                 if p.status.phase != "Failed" and p.spec.node_name]
+        assert len(fresh) == 2
+        for p in fresh:
+            if p.meta.key() in before_keys:
+                continue  # the survivor, bound before the pressure
+            assert p.spec.node_name != victim_node, \
+                f"replacement landed on the pressured node {victim_node}"

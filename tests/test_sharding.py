@@ -166,3 +166,63 @@ def test_sharded_anti_affinity_cross_shard():
     assert len(placed) == 2, idx
     zones = {int(i) % 2 for i in placed}
     assert len(zones) == 2
+
+
+def test_sharded_spec_decode_matches_scan(monkeypatch):
+    """Sharded SPECULATIVE decode (VERDICT r4 item 6): the decide/repair
+    rounds under shard_map must reproduce the single-device SCAN's
+    placements exactly — same winners, same feasibility, same scores — on
+    the topology-off program (the flagship headline shape)."""
+    monkeypatch.setenv("KTPU_SPEC", "1")
+    enc, nt, pb, et, tc, tb = build_inputs(n_nodes=48, n_pods=16)
+    key = jax.random.PRNGKey(11)
+    # single-device sequential scan = ground truth
+    scan = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=False,
+                          spec_decode=False)
+
+    mesh = make_node_mesh()
+    nt_sharded = shard_node_tensors(nt, mesh)
+    fn = make_sharded_schedule_fn(mesh, topo_enabled=False, spec_decode=True)
+    spec = fn(pb, et, nt_sharded, shard_topo_counts(tc, mesh), tb, key)
+
+    assert np.array_equal(np.asarray(scan.node_idx), np.asarray(spec.node_idx)), (
+        np.asarray(scan.node_idx), np.asarray(spec.node_idx))
+    assert np.array_equal(np.asarray(scan.any_feasible),
+                          np.asarray(spec.any_feasible))
+    np.testing.assert_allclose(np.asarray(scan.best_score),
+                               np.asarray(spec.best_score), atol=1e-4)
+    # evolved node state identical (concatenate the shards' windows)
+    np.testing.assert_array_equal(np.asarray(scan.final_requested),
+                                  np.asarray(spec.final_requested))
+    np.testing.assert_array_equal(np.asarray(scan.final_ports),
+                                  np.asarray(spec.final_ports))
+
+
+def test_sharded_spec_decode_capacity_conflicts(monkeypatch):
+    """Intra-batch capacity conflicts under sharded spec decode: 16 pods
+    that each nearly fill a node, 8 tight nodes — rounds must serialize
+    correctly (prefix rule) and the losers must fail exactly as the scan
+    says."""
+    monkeypatch.setenv("KTPU_SPEC", "1")
+    infos = []
+    for i in range(8):
+        infos.append(NodeInfo(
+            make_node(f"n{i}").capacity({"cpu": "2", "memory": "4Gi", "pods": 3}).obj()))
+    enc = ClusterEncoder(Capacities(nodes=8, pods=16, value_words=32))
+    sig = SigTable(enc)
+    nt = enc.encode_snapshot(infos)
+    pods = [make_pod(f"p{i}").req({"cpu": "1500m", "memory": "1Gi"}).obj()
+            for i in range(16)]
+    pb, et = enc.encode_pods(pods)
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
+    key = jax.random.PRNGKey(5)
+    scan = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=False,
+                          spec_decode=False)
+    mesh = make_node_mesh()
+    fn = make_sharded_schedule_fn(mesh, topo_enabled=False, spec_decode=True)
+    spec = fn(pb, et, shard_node_tensors(nt, mesh), shard_topo_counts(tc, mesh),
+              tb, key)
+    assert np.array_equal(np.asarray(scan.node_idx), np.asarray(spec.node_idx))
+    # exactly 8 place (one per node), 8 fail
+    assert int((np.asarray(spec.node_idx) >= 0).sum()) == 8

@@ -1,0 +1,90 @@
+"""Durable store stand-in (VERDICT r3 item 8): WAL + snapshot restore for
+ClusterStore — the crash-only recovery story must survive a real process
+restart, not just an informer relist against a store that never died."""
+
+import os
+
+from kubernetes_tpu.api.types import ObjectMeta, Secret
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.apiserver.wal import attach_wal, restore
+
+
+def _cluster(store, nodes=4):
+    for i in range(nodes):
+        store.create_node(
+            make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 20})
+            .label("zone", f"z{i % 2}").obj())
+
+
+class TestWAL:
+    def test_roundtrip_objects_and_deletes(self, tmp_path):
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        attach_wal(store, path)
+        _cluster(store)
+        store.create_pod(make_pod("keep").req({"cpu": "1"}).obj())
+        store.create_pod(make_pod("gone").req({"cpu": "1"}).obj())
+        store.delete_pod("default/gone")
+        store.create_object("Secret", Secret(meta=ObjectMeta(name="s1")))
+
+        restored = restore(path)
+        assert set(restored.nodes) == {"n0", "n1", "n2", "n3"}
+        assert set(restored.pods) == {"default/keep"}
+        assert "default/s1" in restored.secrets
+        # resourceVersions monotonic across the restart: a new write must
+        # not reuse a pre-crash rv (watch resume correctness)
+        rv_before = restored._rv
+        restored.create_pod(make_pod("after").req({"cpu": "1"}).obj())
+        assert restored.get_pod("default/after").meta.resource_version > rv_before
+
+    def test_snapshot_compaction(self, tmp_path):
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        wal = attach_wal(store, path)
+        _cluster(store, nodes=2)
+        for i in range(20):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        wal.snapshot(store)
+        assert os.path.getsize(path) == 0  # log truncated into the snapshot
+        # post-snapshot writes land in the fresh log
+        store.create_pod(make_pod("tail").req({"cpu": "100m"}).obj())
+        restored = restore(path)
+        assert len(restored.pods) == 21
+        assert "default/tail" in restored.pods
+
+    def test_crash_mid_workload_scheduling_resumes(self, tmp_path):
+        """The chaos criterion: kill the store mid-workload, restore from
+        WAL, informers relist, scheduling resumes, no lost bindings."""
+        from kubernetes_tpu.backend import TPUScheduler
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        attach_wal(store, path)
+        _cluster(store, nodes=6)
+        sched = TPUScheduler(store, batch_size=16)
+        for i in range(12):
+            store.create_pod(make_pod(f"pre{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+        sched.run_until_settled()
+        bound_before = {k: p.spec.node_name for k, p in store.pods.items()
+                        if p.spec.node_name}
+        assert len(bound_before) == 12
+        # a batch of pods lands in the store but is NOT yet scheduled when
+        # the process dies
+        for i in range(8):
+            store.create_pod(make_pod(f"mid{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+        del store, sched  # the crash: nothing from the old process survives
+
+        restored = restore(path)
+        # no lost bindings
+        for key, node in bound_before.items():
+            assert restored.get_pod(key).spec.node_name == node, key
+        # a fresh scheduler (informers relist against the restored store)
+        # picks up the unfinished work
+        sched2 = TPUScheduler(restored, batch_size=16)
+        sched2.run_until_settled()
+        assert all(p.spec.node_name for p in restored.pods.values())
+        # and keeps scheduling new arrivals
+        restored.create_pod(make_pod("post").req({"cpu": "1", "memory": "1Gi"}).obj())
+        sched2.run_until_settled()
+        assert restored.get_pod("default/post").spec.node_name
