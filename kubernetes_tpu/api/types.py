@@ -42,6 +42,7 @@ class ObjectMeta:
     labels: Dict[str, str] = field(default_factory=dict)
     annotations: Dict[str, str] = field(default_factory=dict)
     resource_version: int = 0
+    creation_timestamp: float = 0.0  # set by the store on create (metav1)
     deletion_timestamp: float = 0.0  # >0 ⇒ terminating (metav1 DeletionTimestamp)
     owner_references: Tuple["OwnerReference", ...] = ()
     # metav1 Finalizers: a delete with finalizers present only marks the
@@ -308,6 +309,7 @@ class Container:
     limits: Dict[str, object] = field(default_factory=dict)
     ports: Tuple[ContainerPort, ...] = ()
     security_context: Optional[SecurityContext] = None
+    image_pull_policy: str = ""  # "" = kubelet default (IfNotPresent)
 
 
 @dataclass
@@ -340,6 +342,7 @@ class PodSpec:
     host_pid: bool = False
     host_ipc: bool = False
     security_context: Optional[SecurityContext] = None  # pod-level defaults
+    runtime_class_name: str = ""  # node.k8s.io RuntimeClass (overhead source)
 
 
 @dataclass
@@ -500,6 +503,7 @@ class Namespace:
 class Service:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Dict[str, str] = field(default_factory=dict)  # spec.selector (map form)
+    external_ips: Tuple[str, ...] = ()  # spec.externalIPs (DenyServiceExternalIPs)
 
 
 @dataclass
@@ -768,6 +772,45 @@ class Secret:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     type: str = "Opaque"
     data: Dict[str, str] = field(default_factory=dict)  # values base64 by convention
+
+
+# bootstrap token secret type (cluster-bootstrap/token/api: the kubeadm
+# join-token family the bootstrapsigner/tokencleaner controllers manage)
+SECRET_TYPE_BOOTSTRAP_TOKEN = "bootstrap.kubernetes.io/token"
+
+
+@dataclass
+class RuntimeClass:
+    """node.k8s.io/v1 RuntimeClass: handler selection + pod overhead; the
+    RuntimeClass admission plugin defaults spec.overhead from it."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    handler: str = ""
+    overhead: Dict[str, object] = field(default_factory=dict)  # resource -> quantity
+    # scheduling constraints merged onto pods using this class
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    tolerations: Tuple[Toleration, ...] = ()
+
+
+@dataclass
+class CertificateSigningRequest:
+    """certificates.k8s.io/v1 CSR, reduced to the control-flow surface the
+    csrapproving/csrsigning/csrcleaner controllers drive (the x509/crypto
+    layer is environment — what matters for parity is the approve → sign →
+    clean lifecycle over the API)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    signer_name: str = ""            # e.g. kubernetes.io/kube-apiserver-client-kubelet
+    username: str = ""               # requesting identity
+    groups: Tuple[str, ...] = ()
+    usages: Tuple[str, ...] = ()     # "client auth" | "server auth" | ...
+    request: str = ""                # the CSR blob (opaque here)
+    # status
+    approved: bool = False
+    denied: bool = False
+    approval_reason: str = ""
+    certificate: str = ""            # issued by the signing controller
+    issued_at: float = 0.0
 
 
 @dataclass

@@ -704,6 +704,293 @@ class ValidatingAdmissionWebhook(MutatingAdmissionWebhook):
         self._dispatch(store, kind, obj, "UPDATE", old)
 
 
+class NamespaceAutoProvision(AdmissionPlugin):
+    """plugin/pkg/admission/namespace/autoprovision (default-off): create
+    the namespace on first use instead of rejecting."""
+
+    name = "NamespaceAutoProvision"
+
+    def admit(self, store, kind: str, obj) -> None:
+        ns = getattr(getattr(obj, "meta", None), "namespace", "")
+        if not ns or kind in store.CLUSTER_SCOPED_KINDS or kind == "Namespace":
+            return
+        if ns not in store.namespaces:  # the map is keyed by name
+            from ..api.types import Namespace, ObjectMeta
+
+            store.create_namespace(Namespace(meta=ObjectMeta(name=ns)))
+
+
+class NamespaceExists(AdmissionPlugin):
+    """plugin/pkg/admission/namespace/exists (default-off): reject objects
+    in namespaces that don't exist (lifecycle covers the terminating case)."""
+
+    name = "NamespaceExists"
+
+    def validate(self, store, kind: str, obj) -> None:
+        ns = getattr(getattr(obj, "meta", None), "namespace", "")
+        if not ns or kind in store.CLUSTER_SCOPED_KINDS or kind == "Namespace":
+            return
+        if ns == "default" or ns == "kube-system":
+            return  # always-present namespaces
+        if ns not in store.namespaces:  # the map is keyed by name
+            raise AdmissionError(self.name, f"namespace {ns!r} does not exist")
+
+
+class SecurityContextDeny(AdmissionPlugin):
+    """plugin/pkg/admission/securitycontext/scdeny (default-off): reject
+    pods that set privileged/user/group security context fields."""
+
+    name = "SecurityContextDeny"
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        contexts = [obj.spec.security_context] + [
+            c.security_context for c in list(obj.spec.containers)
+            + list(obj.spec.init_containers)]
+        for sc in contexts:
+            if sc is None:
+                continue
+            if getattr(sc, "privileged", False) \
+                    or getattr(sc, "run_as_user", None) is not None:
+                # `is not None`, NOT truthiness: runAsUser 0 (root) is
+                # exactly the request this plugin exists to reject
+                raise AdmissionError(
+                    self.name, "pod sets a forbidden securityContext field")
+
+
+class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
+    """plugin/pkg/admission/antiaffinity (default-off): required pod
+    anti-affinity may only use the hostname topology key."""
+
+    name = "LimitPodHardAntiAffinityTopology"
+    _HOSTNAME = "kubernetes.io/hostname"
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "Pod" or obj.spec.affinity is None:
+            return
+        anti = obj.spec.affinity.pod_anti_affinity
+        for term in (anti.required if anti is not None else ()):
+            if term.topology_key != self._HOSTNAME:
+                raise AdmissionError(
+                    self.name,
+                    f"required pod anti-affinity topologyKey "
+                    f"{term.topology_key!r} must be {self._HOSTNAME}")
+
+
+class AlwaysPullImages(AdmissionPlugin):
+    """plugin/pkg/admission/alwayspullimages (default-off): force
+    imagePullPolicy=Always so credentials are re-checked per node."""
+
+    name = "AlwaysPullImages"
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            c.image_pull_policy = "Always"
+
+
+class ExtendedResourceToleration(AdmissionPlugin):
+    """plugin/pkg/admission/extendedresourcetoleration (default-off): pods
+    requesting extended resources get matching tolerations automatically."""
+
+    name = "ExtendedResourceToleration"
+    _STANDARD = {"cpu", "memory", "ephemeral-storage", "pods"}
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind != "Pod":
+            return
+        from ..api.types import Toleration
+
+        extended = set()
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            for res in list(c.requests) + list(c.limits):
+                if res not in self._STANDARD and "/" in res:
+                    extended.add(res)
+        have = {t.key for t in obj.spec.tolerations}
+        add = tuple(
+            Toleration(key=res, operator="Exists", effect="NoSchedule")
+            for res in sorted(extended) if res not in have)
+        if add:
+            obj.spec.tolerations = tuple(obj.spec.tolerations) + add
+
+
+class StorageObjectInUseProtection(AdmissionPlugin):
+    """plugin/pkg/admission/storage/storageobjectinuseprotection: add the
+    protection finalizers the pvc/pv-protection controllers manage."""
+
+    name = "StorageObjectInUseProtection"
+    PVC_FINALIZER = "kubernetes.io/pvc-protection"
+    PV_FINALIZER = "kubernetes.io/pv-protection"
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind == "PersistentVolumeClaim":
+            if self.PVC_FINALIZER not in obj.meta.finalizers:
+                obj.meta.finalizers = tuple(obj.meta.finalizers) + (self.PVC_FINALIZER,)
+        elif kind == "PersistentVolume":
+            if self.PV_FINALIZER not in obj.meta.finalizers:
+                obj.meta.finalizers = tuple(obj.meta.finalizers) + (self.PV_FINALIZER,)
+
+
+class RuntimeClassAdmission(AdmissionPlugin):
+    """plugin/pkg/admission/runtimeclass: default spec.overhead (and merge
+    scheduling constraints) from the pod's RuntimeClass."""
+
+    name = "RuntimeClass"
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind != "Pod" or not obj.spec.runtime_class_name:
+            return
+        rc = getattr(store, "runtime_classes", {}).get(obj.spec.runtime_class_name)
+        if rc is None:
+            raise AdmissionError(
+                self.name,
+                f"RuntimeClass {obj.spec.runtime_class_name!r} not found")
+        if rc.overhead and not obj.spec.overhead:
+            obj.spec.overhead = dict(rc.overhead)
+            obj.invalidate_request_cache()
+        if rc.node_selector:
+            merged = dict(rc.node_selector)
+            merged.update(obj.spec.node_selector)
+            obj.spec.node_selector = merged
+        if rc.tolerations:
+            have = {(t.key, t.effect) for t in obj.spec.tolerations}
+            obj.spec.tolerations = tuple(obj.spec.tolerations) + tuple(
+                t for t in rc.tolerations if (t.key, t.effect) not in have)
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "Pod" or not obj.spec.runtime_class_name:
+            return
+        rc = getattr(store, "runtime_classes", {}).get(obj.spec.runtime_class_name)
+        if rc is not None and rc.overhead and obj.spec.overhead != rc.overhead:
+            # admit() defaulted an EMPTY overhead; anything still different
+            # means the client asserted its own value — reject (the
+            # reference rejects any pod whose overhead differs)
+            raise AdmissionError(self.name, "pod overhead must match RuntimeClass")
+
+
+def _signer_authorized(store, verb: str, signer: str, subresource: str) -> bool:
+    """Authorize a CSR state transition against the store's authorizer with
+    the REQUEST's full identity (user + groups — allowed() would drop the
+    groups and defeat the system:masters bypass). No authorizer = open."""
+    authz = getattr(store, "authorizer", None)
+    if authz is None:
+        return True
+    user = store.request_user()
+    groups = store.request_groups()
+    if hasattr(authz, "allowed_for"):
+        return authz.allowed_for(user, groups, verb,
+                                 "CertificateSigningRequest", signer,
+                                 subresource=subresource)
+    return authz.allowed(user, verb, "CertificateSigningRequest", signer,
+                         subresource=subresource)
+
+
+class CertificateApproval(AdmissionPlugin):
+    """plugin/pkg/admission/certificates/approval: flipping a CSR to
+    approved/denied requires authorization on the signer (the approve
+    subresource verb)."""
+
+    name = "CertificateApproval"
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        if kind != "CertificateSigningRequest" or old is None:
+            return
+        if (obj.approved, obj.denied) == (old.approved, old.denied):
+            return
+        if not _signer_authorized(store, "approve", obj.signer_name, "approval"):
+            raise AdmissionError(
+                self.name, f"user {store.request_user()!r} may not approve "
+                f"CSRs for signer {obj.signer_name!r}")
+
+
+class CertificateSigning(AdmissionPlugin):
+    """plugin/pkg/admission/certificates/signing: populating the issued
+    certificate requires authorization on the signer (the sign verb)."""
+
+    name = "CertificateSigning"
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        if kind != "CertificateSigningRequest" or old is None:
+            return
+        if obj.certificate == old.certificate:
+            return
+        if not _signer_authorized(store, "sign", obj.signer_name, "status"):
+            raise AdmissionError(
+                self.name, f"user {store.request_user()!r} may not sign "
+                f"CSRs for signer {obj.signer_name!r}")
+
+
+class CertificateSubjectRestriction(AdmissionPlugin):
+    """plugin/pkg/admission/certificates/subjectrestriction: reject
+    kube-apiserver-client CSRs for the system:masters group."""
+
+    name = "CertificateSubjectRestriction"
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "CertificateSigningRequest":
+            return
+        if obj.signer_name == "kubernetes.io/kube-apiserver-client" \
+                and "system:masters" in obj.groups:
+            raise AdmissionError(
+                self.name,
+                "CSRs for system:masters are not allowed through this signer")
+
+
+class DenyServiceExternalIPs(AdmissionPlugin):
+    """plugin/pkg/admission/denyserviceexternalips: externalIPs are a
+    traffic-interception hazard; new ones are rejected outright."""
+
+    name = "DenyServiceExternalIPs"
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind == "Service" and getattr(obj, "external_ips", ()):
+            raise AdmissionError(self.name, "externalIPs are not allowed")
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        if kind != "Service":
+            return
+        new_ips = set(getattr(obj, "external_ips", ()))
+        old_ips = set(getattr(old, "external_ips", ()) if old is not None else ())
+        if new_ips - old_ips:
+            raise AdmissionError(self.name, "may not add externalIPs")
+
+
+class AlwaysAdmit(AdmissionPlugin):
+    """plugin/pkg/admission/admit (default-off, deprecated no-op)."""
+
+    name = "AlwaysAdmit"
+
+
+class AlwaysDeny(AdmissionPlugin):
+    """plugin/pkg/admission/deny (default-off): reject everything."""
+
+    name = "AlwaysDeny"
+
+    def validate(self, store, kind: str, obj) -> None:
+        raise AdmissionError(self.name, "admission denied by AlwaysDeny")
+
+
+def all_ordered_plugins() -> List[AdmissionPlugin]:
+    """The full AllOrderedPlugins roster (plugins.go:64) in reference
+    order — incl. the default-OFF plugins a config may enable."""
+    return [AlwaysAdmit(), NamespaceAutoProvision(), NamespaceLifecycle(),
+            NamespaceExists(), SecurityContextDeny(),
+            LimitPodHardAntiAffinityTopology(), LimitRanger(),
+            ServiceAccountAdmission(), NodeRestriction(),
+            TaintNodesByCondition(), AlwaysPullImages(), PodSecurity(),
+            PodNodeSelector(), DefaultPriority(), DefaultTolerationSeconds(),
+            ExtendedResourceToleration(), DefaultStorageClass(),
+            StorageObjectInUseProtection(),
+            OwnerReferencesPermissionEnforcement(),
+            PersistentVolumeClaimResize(), RuntimeClassAdmission(),
+            CertificateApproval(), CertificateSigning(),
+            CertificateSubjectRestriction(), DenyServiceExternalIPs(),
+            MutatingAdmissionWebhook(), ValidatingAdmissionWebhook(),
+            ResourceQuotaAdmission(), AlwaysDeny()]
+
+
 def default_chain() -> List[AdmissionPlugin]:
     """AllOrderedPlugins (plugins.go:64), reduced to the modeled set and kept
     in the reference's relative order: NamespaceLifecycle → LimitRanger →
@@ -715,8 +1002,14 @@ def default_chain() -> List[AdmissionPlugin]:
     return [NamespaceLifecycle(), LimitRanger(), ServiceAccountAdmission(),
             NodeRestriction(), TaintNodesByCondition(), PodSecurity(),
             PodNodeSelector(), DefaultPriority(), DefaultTolerationSeconds(),
-            DefaultStorageClass(), PersistentVolumeClaimResize(),
-            OwnerReferencesPermissionEnforcement(),
+            DefaultStorageClass(), StorageObjectInUseProtection(),
+            PersistentVolumeClaimResize(),
+            OwnerReferencesPermissionEnforcement(), RuntimeClassAdmission(),
+            CertificateApproval(), CertificateSigning(),
+            CertificateSubjectRestriction(),
+            # DenyServiceExternalIPs is default-OFF upstream
+            # (DefaultOffAdmissionPlugins) — available via
+            # all_ordered_plugins(), not enabled here
             MutatingAdmissionWebhook(), ValidatingAdmissionWebhook(),
             ResourceQuotaAdmission()]
 

@@ -107,6 +107,10 @@ class PolicyRule:
 class ClusterRole:
     meta: ObjectMeta = dataclasses.field(default_factory=ObjectMeta)
     rules: Tuple[PolicyRule, ...] = ()
+    # rbac/v1 AggregationRule, reduced to label-selector match dicts: when
+    # set, the clusterrole-aggregation controller overwrites ``rules`` with
+    # the union of every matching ClusterRole's rules
+    aggregation_selectors: Tuple[Dict[str, str], ...] = ()
 
 
 @dataclasses.dataclass

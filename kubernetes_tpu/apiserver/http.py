@@ -38,7 +38,13 @@ from urllib.parse import parse_qs, urlparse
 from ..api import types as api_types
 from ..api.codec import from_wire, to_wire
 from ..api.types import Binding
+from ..api.validation import ValidationError
 from .admission import AdmissionError
+
+# protobuf content negotiation (api/protobuf.py; the reference's
+# application/vnd.kubernetes.protobuf serializer seam)
+_PROTO_CT = "application/vnd.kubernetes.protobuf"
+_PROTO_BODY_KEY = "__ktpu_protobuf_body__"
 from .store import ClusterStore, Conflict, Expired, NotFound
 
 # (group-path-prefix, plural) -> kind; plural -> python type via api.types
@@ -69,6 +75,9 @@ RESOURCES = {
     ("apis/storage.k8s.io/v1", "storageclasses"): "StorageClass",
     ("apis/storage.k8s.io/v1", "csinodes"): "CSINode",
     ("apis/coordination.k8s.io/v1", "leases"): "Lease",
+    ("apis/certificates.k8s.io/v1", "certificatesigningrequests"):
+        "CertificateSigningRequest",
+    ("apis/node.k8s.io/v1", "runtimeclasses"): "RuntimeClass",
 }
 
 _KIND_TYPES = {kind: getattr(api_types, kind) for (_g, _p), kind in RESOURCES.items()}
@@ -198,7 +207,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _body(self) -> dict:
         n = int(self.headers.get("Content-Length", 0))
-        return json.loads(self.rfile.read(n) or b"{}")
+        raw = self.rfile.read(n)
+        # content negotiation (runtime/serializer/protobuf): a protobuf
+        # body rides through _decode_body via the raw-bytes marker
+        if _PROTO_CT in (self.headers.get("Content-Type") or ""):
+            return {_PROTO_BODY_KEY: raw}
+        return json.loads(raw or b"{}")
+
+    def _wants_proto(self) -> bool:
+        return _PROTO_CT in (self.headers.get("Accept") or "")
+
+    def _send_proto(self, code: int, payload: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", _PROTO_CT)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
 
     def _obj_wire(self, kind: str, obj) -> dict:
         d = to_wire(obj)
@@ -206,10 +230,18 @@ class _Handler(BaseHTTPRequestHandler):
         return d
 
     def _decode_body(self, kind: str, body: dict):
-        """Two wire dialects on the write path: a body carrying apiVersion +
-        metadata is a REFERENCE-shaped manifest decoded through the
-        versioned scheme (api/scheme.py); otherwise it is this framework's
-        snake_case reflection format."""
+        """Three wire dialects on the write path: protobuf (magic-prefixed
+        KObject bytes via content negotiation), a REFERENCE-shaped manifest
+        (apiVersion + metadata) through the versioned scheme
+        (api/scheme.py), else this framework's snake_case reflection
+        format."""
+        if _PROTO_BODY_KEY in body:
+            from ..api.protobuf import decode_object
+
+            got_kind, obj = decode_object(body[_PROTO_BODY_KEY], kind)
+            if got_kind != kind:
+                raise ValueError(f"protobuf body is a {got_kind}, not {kind}")
+            return obj
         if "apiVersion" in body and "metadata" in body:
             # a manifest-shaped body MUST decode through the scheme: an
             # unregistered apiVersion is a clear 400, never a silent
@@ -263,15 +295,24 @@ class _Handler(BaseHTTPRequestHandler):
             return self._watch(kind, ns, since)
         if name is None:
             objs, rv = self.store.list_objects(kind)
-            items = [self._obj_wire(kind, o) for o in objs if self._match(kind, ns, o)]
+            matched = [o for o in objs if self._match(kind, ns, o)]
+            if self._wants_proto():
+                from ..api.protobuf import encode_list
+
+                return self._send_proto(200, encode_list(kind, matched, rv))
             return self._send_json(200, {
                 "kind": f"{kind}List", "apiVersion": "v1",
-                "metadata": {"resourceVersion": str(rv)}, "items": items,
+                "metadata": {"resourceVersion": str(rv)},
+                "items": [self._obj_wire(kind, o) for o in matched],
             })
         key = name if kind in self.store.CLUSTER_SCOPED_KINDS else f"{ns}/{name}"
         obj = self.store.get_object(kind, key)
         if obj is None or not self._match(kind, ns, obj):
             return self._error(404, "NotFound", f"{kind} {key} not found")
+        if self._wants_proto():
+            from ..api.protobuf import encode_object
+
+            return self._send_proto(200, encode_object(kind, obj))
         return self._send_json(200, self._obj_wire(kind, obj))
 
     def _watch(self, kind: str, ns: Optional[str], since: int) -> None:
@@ -350,6 +391,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(409, "AlreadyExists", str(e))
         except AdmissionError as e:
             return self._error(403, "Forbidden", str(e))
+        except ValidationError as e:
+            return self._error(422, "Invalid", str(e))
         return self._send_json(201, self._obj_wire(kind, obj))
 
     def do_PUT(self):  # noqa: N802
@@ -385,6 +428,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(409, "Conflict", str(e))
         except AdmissionError as e:
             return self._error(403, "Forbidden", str(e))
+        except ValidationError as e:
+            return self._error(422, "Invalid", str(e))
         return self._send_json(200, self._obj_wire(kind, obj))
 
     def do_DELETE(self):  # noqa: N802

@@ -135,6 +135,8 @@ class ClusterStore:
         self.validating_webhooks: Dict[str, object] = {}
         self.config_maps: Dict[str, object] = {}
         self.secrets: Dict[str, object] = {}
+        self.csrs: Dict[str, object] = {}
+        self.runtime_classes: Dict[str, object] = {}
         self.hpas: Dict[str, object] = {}
         self.cluster_roles: Dict[str, object] = {}
         self.cluster_role_bindings: Dict[str, object] = {}
@@ -165,6 +167,9 @@ class ClusterStore:
         # journaled mutation also lands in the write-ahead log — the etcd
         # WAL role (etcd3/store.go:72); None = memory-only (the default)
         self._wal = None
+        # field validation on the write path (api/validation.py, the
+        # strategy.Validate position); False disables for raw-object tests
+        self.validation_enabled = True
 
     def add_event_handler(self, kind: str, handler: Handler) -> None:
         self._handlers.setdefault(kind, []).append(handler)
@@ -195,10 +200,20 @@ class ClusterStore:
     def _admit(self, kind: str, obj) -> None:
         if self.admission is not None:
             self.admission.run(self, kind, obj)
+        if self.validation_enabled:
+            # strategy.Validate position: field validation AFTER admission
+            # defaulting (registry strategies, pkg/registry/core/pod/strategy.go)
+            from ..api import validation
+
+            validation.validate(kind, obj)
 
     def _admit_update(self, kind: str, old, obj) -> None:
         if self.admission is not None:
             self.admission.run_update(self, kind, old, obj)
+        if self.validation_enabled:
+            from ..api import validation
+
+            validation.validate_update(kind, old, obj)
 
     def _guarded_update(self, kind: str, obj, lookup, commit):
         """Admission-checked update with optimistic concurrency against the
@@ -254,6 +269,10 @@ class ClusterStore:
     def _bump(self, obj) -> None:
         self._rv += 1
         obj.meta.resource_version = self._rv
+        if not obj.meta.creation_timestamp:
+            import time as _time
+
+            obj.meta.creation_timestamp = _time.time()
 
     # ------------------------------------------------------------- list+watch
     # (the L2 watch-cache seam: storage/cacher/cacher.go:227 fan-out plus the
@@ -321,6 +340,8 @@ class ClusterStore:
                 "ValidatingWebhookConfiguration": self.validating_webhooks,
                 "ConfigMap": self.config_maps,
                 "Secret": self.secrets,
+                "CertificateSigningRequest": self.csrs,
+                "RuntimeClass": self.runtime_classes,
                 "HorizontalPodAutoscaler": self.hpas,
                 "ClusterRole": self.cluster_roles,
                 "ClusterRoleBinding": self.cluster_role_bindings,
@@ -477,7 +498,8 @@ class ClusterStore:
         "Node", "Namespace", "PersistentVolume", "StorageClass", "CSINode",
         "PriorityClass", "VolumeAttachment",
         "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
-        "ClusterRole", "ClusterRoleBinding",
+        "ClusterRole", "ClusterRoleBinding", "CertificateSigningRequest",
+        "RuntimeClass",
     }
 
     def _key_of(self, kind: str, obj) -> str:
@@ -577,6 +599,7 @@ class ClusterStore:
     # (SelectorSpread's owner lookup, helper/spread.go DefaultSelector)
 
     def create_service(self, svc: Service) -> None:
+        self._admit("Service", svc)
         with self._lock:
             self._bump(svc)
             self.services[svc.meta.key()] = svc
@@ -658,6 +681,7 @@ class ClusterStore:
     # ------------------------------------------------------------- storage kinds
 
     def create_pv(self, pv: PersistentVolume) -> None:
+        self._admit("PersistentVolume", pv)
         with self._lock:
             self._bump(pv)
             self.pvs[pv.meta.name] = pv

@@ -35,6 +35,15 @@ from .auxiliary import (
     ServiceAccountController,
     TTLAfterFinishedController,
 )
+from .certificates import (
+    BootstrapSignerController,
+    ClusterRoleAggregationController,
+    CSRApprovingController,
+    CSRCleanerController,
+    CSRSigningController,
+    PVExpanderController,
+    TokenCleanerController,
+)
 from .disruption import DisruptionController
 from .extras import (
     AttachDetachController,
@@ -53,6 +62,13 @@ from .workloads import (
 )
 
 Initializer = Callable[["ControllerManager"], Controller]
+
+
+def _wall_now(m):
+    """Wall-clock selection for controllers whose schedules/expirations name
+    absolute times: the manager's monotonic default is duration-only, so use
+    wall time unless the caller overrode now_fn (tests' FakeClock)."""
+    return m.now_fn if m.now_fn is not time.monotonic else time.time
 
 
 def new_controller_initializers() -> Dict[str, Initializer]:
@@ -79,9 +95,8 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         # cron needs WALL time (schedules name hours/days); the manager's
         # monotonic default is duration-only — pass it through only when the
         # caller overrode it (tests' FakeClock)
-        "cronjob": lambda m: CronJobController(
-            m.store, m.factory,
-            now_fn=m.now_fn if m.now_fn is not time.monotonic else time.time),
+        "cronjob": lambda m: CronJobController(m.store, m.factory,
+                                               now_fn=_wall_now(m)),
         "attachdetach": lambda m: AttachDetachController(m.store, m.factory),
         "serviceaccount": lambda m: ServiceAccountController(m.store, m.factory),
         "root-ca-cert-publisher": lambda m: RootCACertPublisher(m.store, m.factory),
@@ -95,6 +110,19 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         "ephemeral-volume": lambda m: EphemeralVolumeController(m.store, m.factory),
         "horizontalpodautoscaling": lambda m: HorizontalPodAutoscalerController(
             m.store, m.factory, now_fn=m.now_fn),
+        # certificate/security loops (controllermanager.go:412 tail)
+        "csrapproving": lambda m: CSRApprovingController(m.store, m.factory),
+        "csrsigning": lambda m: CSRSigningController(
+            m.store, m.factory, now_fn=_wall_now(m)),
+        "csrcleaner": lambda m: CSRCleanerController(
+            m.store, m.factory, now_fn=_wall_now(m)),
+        "clusterrole-aggregation": lambda m: ClusterRoleAggregationController(
+            m.store, m.factory),
+        "tokencleaner": lambda m: TokenCleanerController(
+            m.store, m.factory, now_fn=_wall_now(m)),
+        "bootstrapsigner": lambda m: BootstrapSignerController(m.store, m.factory),
+        "persistentvolume-expander": lambda m: PVExpanderController(
+            m.store, m.factory),
     }
 
 

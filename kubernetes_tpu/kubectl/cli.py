@@ -35,6 +35,8 @@ GETTABLE = {
     "horizontalpodautoscalers": "HorizontalPodAutoscaler", "hpa": "HorizontalPodAutoscaler",
     "configmaps": "ConfigMap", "configmap": "ConfigMap", "cm": "ConfigMap",
     "secrets": "Secret", "secret": "Secret",
+    "certificatesigningrequests": "CertificateSigningRequest",
+    "csr": "CertificateSigningRequest",
     "serviceaccounts": "ServiceAccount", "serviceaccount": "ServiceAccount",
     "sa": "ServiceAccount",
     "poddisruptionbudgets": "PodDisruptionBudget", "pdb": "PodDisruptionBudget",

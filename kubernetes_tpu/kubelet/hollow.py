@@ -59,6 +59,9 @@ class HollowKubelet:
         # eviction manager seam (kubelet/eviction.py EvictionManager):
         # attach via attach_eviction_manager(); run_once drives it
         self.eviction_manager = None
+        # resource-manager seam (kubelet/cm.py TopologyManager over
+        # CPU/Device managers): admission gate at Pending→Running
+        self.topology_manager = None
 
     # ------------------------------------------------------------ registration
 
@@ -136,6 +139,9 @@ class HollowKubelet:
             if pod.status.phase == "Pending":
                 started = self._started_at.setdefault(key, now)
                 if now - started >= self.startup_delay:
+                    if not self._cm_admit(pod):
+                        transitions += 1
+                        continue
                     self._runtime_start(pod)
                     self._set_phase(pod, "Running", start_time=now)
                     transitions += 1
@@ -158,7 +164,31 @@ class HollowKubelet:
             if key not in live:
                 del self._started_at[key]
                 self._runtime_remove(key)
+                if self.topology_manager is not None:
+                    self.topology_manager.release(key)
         return transitions
+
+    def _cm_admit(self, pod: Pod) -> bool:
+        """Resource-manager admission (cm/topologymanager scope Admit): a
+        hint-rejected pod fails with the TopologyAffinityError reason —
+        the reference's UnexpectedAdmissionError path."""
+        if self.topology_manager is None:
+            return True
+        from .cm import TopologyAffinityError
+
+        try:
+            self.topology_manager.admit(pod)
+            return True
+        except TopologyAffinityError as e:
+            new = pod.clone()
+            new.status.phase = "Failed"
+            new.status.reason = "TopologyAffinityError"
+            new.status.message = str(e)
+            try:
+                self.store.update_pod(new)
+            except Exception:  # noqa: BLE001 — deleted mid-sync
+                pass
+            return False
 
     # ---------------------------------------------------------- CRI syncPod
 

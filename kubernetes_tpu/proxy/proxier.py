@@ -128,3 +128,33 @@ class Proxier:
                     f'-A {sep_chain} -m comment --comment "{backend}" '
                     f"-j DNAT --to-destination {backend}")
         return "\n".join(lines + chains + rules + ["COMMIT", ""])
+
+    def render_ipvs(self) -> str:
+        """The rules in ipvsadm-save form — the ipvs proxier's dataplane
+        contract (pkg/proxy/ipvs/proxier.go syncProxyRules: one virtual
+        server per service with round-robin scheduling, one real server
+        per ready endpoint). Virtual addresses are the service keys bound
+        to the kube-ipvs0 dummy interface in the reference; here the key
+        names the virtual service the way --to-destination names the
+        backend in the iptables text."""
+        lines = []
+        with self._lock:
+            snapshot = sorted(self.rules.items())
+        for key, r in snapshot:
+            lines.append(f"-A -t {key} -s rr")
+            for backend in r.backends:
+                lines.append(f"-a -t {key} -r {backend} -m -w 1")
+        return "\n".join(lines + [""])
+
+    def stale_conntrack_entries(self, before: Dict[str, Tuple[str, ...]]
+                                ) -> List[str]:
+        """conntrack cleanup targets (pkg/proxy/conntrack.go): backends that
+        disappeared from a service since ``before`` must have their
+        established UDP flows flushed, or traffic keeps hitting the dead
+        endpoint. Returns the backend identities to flush."""
+        stale = []
+        with self._lock:
+            for key, old_backends in before.items():
+                now = set(self.rules[key].backends) if key in self.rules else set()
+                stale += [b for b in old_backends if b not in now]
+        return stale

@@ -1,0 +1,175 @@
+"""Admission breadth toward AllOrderedPlugins (plugins.go:64): the plugins
+added in round 4 — RuntimeClass defaulting, certificate gating, external-IP
+denial, in-use protection finalizers, plus the default-off family."""
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    CertificateSigningRequest,
+    LabelSelector,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    RuntimeClass,
+    Service,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.admission import (
+    AdmissionChain,
+    AdmissionError,
+    AlwaysDeny,
+    ExtendedResourceToleration,
+    LimitPodHardAntiAffinityTopology,
+    NamespaceAutoProvision,
+    all_ordered_plugins,
+    default_chain,
+)
+from kubernetes_tpu.apiserver.auth import RBACAuthorizer
+from kubernetes_tpu.apiserver.store import ClusterStore
+
+
+class TestRuntimeClassAdmission:
+    def test_overhead_defaulted_from_runtime_class(self):
+        store = ClusterStore()
+        store.create_object("RuntimeClass", RuntimeClass(
+            meta=ObjectMeta(name="gvisor"), handler="runsc",
+            overhead={"cpu": "250m", "memory": "64Mi"},
+            node_selector={"sandbox": "gvisor"}))
+        pod = make_pod("sandboxed").req({"cpu": "1"}).obj()
+        pod.spec.runtime_class_name = "gvisor"
+        store.create_pod(pod)
+        stored = store.get_pod("default/sandboxed")
+        assert stored.spec.overhead == {"cpu": "250m", "memory": "64Mi"}
+        assert stored.spec.node_selector["sandbox"] == "gvisor"
+        # overhead feeds the scheduler's resource request
+        assert stored.resource_request()["cpu"] == 1250
+
+    def test_unknown_runtime_class_rejected(self):
+        store = ClusterStore()
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        pod.spec.runtime_class_name = "missing"
+        with pytest.raises(AdmissionError, match="not found"):
+            store.create_pod(pod)
+
+
+class TestCertificateAdmission:
+    def _csr(self, **kw):
+        defaults = dict(meta=ObjectMeta(name="c1"),
+                        signer_name="kubernetes.io/kube-apiserver-client",
+                        username="alice", usages=("client auth",))
+        defaults.update(kw)
+        return CertificateSigningRequest(**defaults)
+
+    def test_subject_restriction_blocks_masters(self):
+        store = ClusterStore()
+        with pytest.raises(AdmissionError, match="system:masters"):
+            store.create_object("CertificateSigningRequest",
+                                self._csr(groups=("system:masters",)))
+
+    def test_approval_requires_authorization(self):
+        store = ClusterStore()
+        store.authorizer = RBACAuthorizer(store)  # no bindings: deny-all
+        store.create_object("CertificateSigningRequest", self._csr())
+        import dataclasses
+
+        csr = store.csrs["c1"]
+        new = dataclasses.replace(csr, approved=True)
+        new.meta = dataclasses.replace(csr.meta)
+        with store.as_user("mallory"):
+            with pytest.raises(AdmissionError, match="may not approve"):
+                store.update_object("CertificateSigningRequest", new)
+        # system:masters passes via RBAC bypass
+        with store.as_user("root", ("system:masters",)):
+            store.update_object("CertificateSigningRequest", new)
+        assert store.csrs["c1"].approved
+
+
+class TestServiceExternalIPs:
+    def test_external_ips_rejected_when_enabled(self):
+        # default-OFF upstream (DefaultOffAdmissionPlugins): enable explicitly
+        from kubernetes_tpu.apiserver.admission import DenyServiceExternalIPs
+
+        store = ClusterStore()
+        store.admission = AdmissionChain(
+            plugins=default_chain() + [DenyServiceExternalIPs()])
+        with pytest.raises(AdmissionError, match="externalIPs"):
+            store.create_service(Service(meta=ObjectMeta(name="svc"),
+                                         external_ips=("10.0.0.1",)))
+
+    def test_default_chain_allows_external_ips(self):
+        # reference default behavior: the plugin is off
+        store = ClusterStore()
+        store.create_service(Service(meta=ObjectMeta(name="svc"),
+                                     external_ips=("10.0.0.1",)))
+
+    def test_plain_service_fine(self):
+        store = ClusterStore()
+        store.create_service(Service(meta=ObjectMeta(name="svc")))
+
+
+class TestStorageProtectionFinalizers:
+    def test_pvc_and_pv_get_finalizers(self):
+        store = ClusterStore()
+        store.create_pvc(PersistentVolumeClaim(meta=ObjectMeta(name="c")))
+        store.create_pv(PersistentVolume(meta=ObjectMeta(name="v")))
+        assert "kubernetes.io/pvc-protection" in store.pvcs["default/c"].meta.finalizers
+        assert "kubernetes.io/pv-protection" in store.pvs["v"].meta.finalizers
+
+
+class TestDefaultOffFamily:
+    def test_hard_anti_affinity_topology_limited(self):
+        chain = AdmissionChain(plugins=[LimitPodHardAntiAffinityTopology()])
+        store = ClusterStore()
+        pod = make_pod("p").req({"cpu": "1"}).pod_affinity(
+            "topology.kubernetes.io/zone",
+            LabelSelector(match_labels={"a": "b"}), anti=True).obj()
+        with pytest.raises(AdmissionError, match="must be kubernetes.io/hostname"):
+            chain.run(store, "Pod", pod)
+
+    def test_namespace_autoprovision_creates(self):
+        store = ClusterStore()
+        store.admission = AdmissionChain(
+            plugins=[NamespaceAutoProvision()] + default_chain())
+        pod = make_pod("p", namespace="brand-new").req({"cpu": "1"}).obj()
+        store.create_pod(pod)
+        assert any(n.meta.name == "brand-new" for n in store.namespaces.values())
+
+    def test_extended_resource_toleration(self):
+        chain = AdmissionChain(plugins=[ExtendedResourceToleration()])
+        store = ClusterStore()
+        pod = make_pod("gpu").req({"cpu": "1", "example.com/gpu": "2"}).obj()
+        chain.run(store, "Pod", pod)
+        assert any(t.key == "example.com/gpu" and t.operator == "Exists"
+                   for t in pod.spec.tolerations)
+
+    def test_always_deny(self):
+        chain = AdmissionChain(plugins=[AlwaysDeny()])
+        with pytest.raises(AdmissionError):
+            chain.run(ClusterStore(), "Pod", make_pod("p").obj())
+
+    def test_all_ordered_roster_instantiates(self):
+        names = [p.name for p in all_ordered_plugins()]
+        assert len(names) == len(set(names)) == 29
+        assert names[0] == "AlwaysAdmit" and names[-1] == "AlwaysDeny"
+
+    def test_security_context_deny_catches_root_uid_zero(self):
+        from kubernetes_tpu.api.types import SecurityContext
+        from kubernetes_tpu.apiserver.admission import SecurityContextDeny
+
+        chain = AdmissionChain(plugins=[SecurityContextDeny()])
+        pod = make_pod("root").req({"cpu": "1"}).obj()
+        pod.spec.security_context = SecurityContext(run_as_user=0)
+        with pytest.raises(AdmissionError):
+            chain.run(ClusterStore(), "Pod", pod)
+
+    def test_runtime_class_overhead_mismatch_rejected(self):
+        from kubernetes_tpu.api.types import RuntimeClass as RC
+
+        store = ClusterStore()
+        store.create_object("RuntimeClass", RC(
+            meta=ObjectMeta(name="gvisor"), overhead={"cpu": "100m"}))
+        pod = make_pod("lie").req({"cpu": "1"}).obj()
+        pod.spec.runtime_class_name = "gvisor"
+        pod.spec.overhead = {"cpu": "999"}  # asserts its own overhead
+        with pytest.raises(AdmissionError, match="overhead must match"):
+            store.create_pod(pod)

@@ -1,0 +1,192 @@
+"""Certificate/security control loops (controllermanager.go:412 tail —
+the last missing initializers): CSR approve→sign→clean lifecycle,
+clusterrole aggregation, bootstrap token cleaner/signer, PV expander."""
+
+import dataclasses
+
+from kubernetes_tpu.api.types import (
+    SECRET_TYPE_BOOTSTRAP_TOKEN,
+    CertificateSigningRequest,
+    ConfigMap,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Secret,
+    StorageClass,
+)
+from kubernetes_tpu.apiserver.auth import ClusterRole, PolicyRule
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.certificates import KUBELET_CLIENT_SIGNER
+from kubernetes_tpu.controllers.manager import ControllerManager
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def make_manager(store, controllers, now_fn=None):
+    return ControllerManager(store, factory=SharedInformerFactory(store),
+                             controllers=controllers,
+                             now_fn=now_fn or FakeClock())
+
+
+def _csr(name="node-csr", signer=KUBELET_CLIENT_SIGNER,
+         username="system:node:n1", usages=("client auth",), **kw):
+    return CertificateSigningRequest(
+        meta=ObjectMeta(name=name), signer_name=signer, username=username,
+        usages=tuple(usages), request="blob", **kw)
+
+
+class TestCSRChain:
+    def test_kubelet_client_csr_approved_and_signed(self):
+        store = ClusterStore()
+        m = make_manager(store, ["csrapproving", "csrsigning"])
+        store.create_object("CertificateSigningRequest", _csr())
+        m.settle()
+        csr = store.csrs["node-csr"]
+        assert csr.approved and "AutoApproved" in csr.approval_reason
+        assert csr.certificate.startswith("-----BEGIN CERTIFICATE-----")
+
+    def test_non_node_csr_not_auto_approved(self):
+        store = ClusterStore()
+        m = make_manager(store, ["csrapproving", "csrsigning"])
+        store.create_object("CertificateSigningRequest",
+                            _csr(name="user-csr", username="alice", groups=()))
+        m.settle()
+        csr = store.csrs["user-csr"]
+        assert not csr.approved and not csr.certificate
+
+    def test_denied_csr_never_signed(self):
+        store = ClusterStore()
+        m = make_manager(store, ["csrsigning"])
+        store.create_object("CertificateSigningRequest",
+                            _csr(name="bad", approved=True, denied=True))
+        m.settle()
+        assert not store.csrs["bad"].certificate
+
+    def test_cleaner_drops_stale_pending_and_old_issued(self):
+        store = ClusterStore()
+        clock = FakeClock(10_000.0)
+        m = make_manager(store, ["csrcleaner"], now_fn=clock)
+        pending = _csr(name="stale-pending")
+        store.create_object("CertificateSigningRequest", pending)
+        store.csrs["stale-pending"].meta.creation_timestamp = 100.0  # old
+        issued = _csr(name="old-issued", approved=True,
+                      certificate="cert", issued_at=100.0)
+        store.create_object("CertificateSigningRequest", issued)
+        fresh = _csr(name="fresh")
+        store.create_object("CertificateSigningRequest", fresh)
+        store.csrs["fresh"].meta.creation_timestamp = clock()  # just created
+        clock.t = 10_000.0 + 90_000.0  # beyond the 24h issued TTL
+        store.csrs["fresh"].meta.creation_timestamp = clock() - 10.0
+        m.settle()
+        assert "stale-pending" not in store.csrs
+        assert "old-issued" not in store.csrs
+        assert "fresh" in store.csrs
+
+
+class TestClusterRoleAggregation:
+    def test_rules_union_from_matching_roles(self):
+        store = ClusterStore()
+        m = make_manager(store, ["clusterrole-aggregation"])
+        store.create_object("ClusterRole", ClusterRole(
+            meta=ObjectMeta(name="view-pods",
+                            labels={"rbac.example.com/aggregate-to-view": "true"}),
+            rules=(PolicyRule(verbs=("get", "list"), resources=("Pod",)),)))
+        store.create_object("ClusterRole", ClusterRole(
+            meta=ObjectMeta(name="view-services",
+                            labels={"rbac.example.com/aggregate-to-view": "true"}),
+            rules=(PolicyRule(verbs=("get",), resources=("Service",)),)))
+        store.create_object("ClusterRole", ClusterRole(
+            meta=ObjectMeta(name="view"),
+            aggregation_selectors=({"rbac.example.com/aggregate-to-view": "true"},)))
+        m.settle()
+        view = store.cluster_roles["view"]
+        resources = {r for rule in view.rules for r in rule.resources}
+        assert resources == {"Pod", "Service"}
+
+    def test_new_matching_role_feeds_aggregate(self):
+        store = ClusterStore()
+        m = make_manager(store, ["clusterrole-aggregation"])
+        store.create_object("ClusterRole", ClusterRole(
+            meta=ObjectMeta(name="edit"),
+            aggregation_selectors=({"aggregate-to-edit": "true"},)))
+        m.settle()
+        assert store.cluster_roles["edit"].rules == ()
+        store.create_object("ClusterRole", ClusterRole(
+            meta=ObjectMeta(name="edit-jobs", labels={"aggregate-to-edit": "true"}),
+            rules=(PolicyRule(verbs=("*",), resources=("Job",)),)))
+        m.settle()
+        assert any("Job" in r.resources for r in store.cluster_roles["edit"].rules)
+
+
+class TestBootstrapTokens:
+    def test_token_cleaner_deletes_expired(self):
+        store = ClusterStore()
+        clock = FakeClock(5000.0)
+        m = make_manager(store, ["tokencleaner"], now_fn=clock)
+        store.create_object("Secret", Secret(
+            meta=ObjectMeta(name="bootstrap-token-old", namespace="kube-system"),
+            type=SECRET_TYPE_BOOTSTRAP_TOKEN,
+            data={"token-id": "old", "expiration": "4000"}))
+        store.create_object("Secret", Secret(
+            meta=ObjectMeta(name="bootstrap-token-live", namespace="kube-system"),
+            type=SECRET_TYPE_BOOTSTRAP_TOKEN,
+            data={"token-id": "live", "expiration": "9000"}))
+        m.settle()
+        assert "kube-system/bootstrap-token-old" not in store.secrets
+        assert "kube-system/bootstrap-token-live" in store.secrets
+
+    def test_bootstrapsigner_signs_cluster_info(self):
+        store = ClusterStore()
+        m = make_manager(store, ["bootstrapsigner"])
+        store.create_object("ConfigMap", ConfigMap(
+            meta=ObjectMeta(name="cluster-info", namespace="kube-system"),
+            data={"kubeconfig": "apiVersion: v1\nclusters: []\n"}))
+        store.create_object("Secret", Secret(
+            meta=ObjectMeta(name="bootstrap-token-ab12", namespace="kube-system"),
+            type=SECRET_TYPE_BOOTSTRAP_TOKEN,
+            data={"token-id": "ab12", "token-secret": "s3cr3t"}))
+        m.settle()
+        cm = store.config_maps["kube-system/cluster-info"]
+        assert "jws-kubeconfig-ab12" in cm.data
+        # token deleted → signature removed
+        store.delete_object("Secret", "kube-system/bootstrap-token-ab12")
+        m.settle()
+        cm = store.config_maps["kube-system/cluster-info"]
+        assert "jws-kubeconfig-ab12" not in cm.data
+
+
+class TestPVExpander:
+    def test_pv_grows_when_class_allows(self):
+        store = ClusterStore()
+        m = make_manager(store, ["persistentvolume-expander"])
+        store.create_storage_class(StorageClass(
+            meta=ObjectMeta(name="fast"), allow_volume_expansion=True))
+        store.create_pv(PersistentVolume(
+            meta=ObjectMeta(name="pv1"), capacity_bytes=1 << 30,
+            storage_class="fast", bound_pvc="default/c1"))
+        store.create_pvc(PersistentVolumeClaim(
+            meta=ObjectMeta(name="c1"), storage_class="fast",
+            bound_pv="pv1", requested_bytes=2 << 30))
+        m.settle()
+        assert store.pvs["pv1"].capacity_bytes == 2 << 30
+
+    def test_no_growth_without_expansion_flag(self):
+        store = ClusterStore()
+        m = make_manager(store, ["persistentvolume-expander"])
+        store.create_storage_class(StorageClass(
+            meta=ObjectMeta(name="rigid"), allow_volume_expansion=False))
+        store.create_pv(PersistentVolume(
+            meta=ObjectMeta(name="pv1"), capacity_bytes=1 << 30,
+            storage_class="rigid", bound_pvc="default/c1"))
+        store.create_pvc(PersistentVolumeClaim(
+            meta=ObjectMeta(name="c1"), storage_class="rigid",
+            bound_pv="pv1", requested_bytes=2 << 30))
+        m.settle()
+        assert store.pvs["pv1"].capacity_bytes == 1 << 30

@@ -164,3 +164,33 @@ class TestIptablesRendering:
         assert "--probability 0.5000000000" in text
         assert text.count("KUBE-SEP-") >= 6  # 3 chains declared + 3 jumps
         assert '--comment "default/p1"' in text
+
+
+def test_render_ipvs_and_conntrack_cleanup():
+    """ipvs proxier mode (pkg/proxy/ipvs): ipvsadm-save text with one rr
+    virtual server per service + conntrack stale-flow targets when a
+    backend disappears."""
+    from kubernetes_tpu.api.types import EndpointAddress, Endpoints, ObjectMeta, Service
+
+    store = ClusterStore()
+    store.create_service(Service(meta=ObjectMeta(name="web"),
+                                 selector={"app": "web"}))
+    store.create_object("Endpoints", Endpoints(
+        meta=ObjectMeta(name="web"),
+        addresses=(EndpointAddress(pod_key="default/p1", node_name="n1"),
+                   EndpointAddress(pod_key="default/p2", node_name="n2"))))
+    proxier = Proxier(store)
+    proxier.mark_dirty("default/web")
+    proxier.sync_proxy_rules()
+    text = proxier.render_ipvs()
+    assert "-A -t default/web -s rr" in text
+    assert "-a -t default/web -r default/p1 -m -w 1" in text
+    assert "-a -t default/web -r default/p2 -m -w 1" in text
+
+    before = {"default/web": tuple(proxier.backends("default/web"))}
+    store.update_object("Endpoints", Endpoints(
+        meta=ObjectMeta(name="web"),
+        addresses=(EndpointAddress(pod_key="default/p1", node_name="n1"),)))
+    proxier.mark_dirty("default/web")
+    proxier.sync_proxy_rules()
+    assert proxier.stale_conntrack_entries(before) == ["default/p2"]

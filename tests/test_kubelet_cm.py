@@ -1,0 +1,178 @@
+"""Kubelet resource managers (pkg/kubelet/cm/): static CPU policy,
+device-plugin allocation with checkpoints, topology-manager hint merge."""
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_pod
+from kubernetes_tpu.kubelet.checkpoint import CheckpointManager
+from kubernetes_tpu.kubelet.cm import (
+    POLICY_BEST_EFFORT,
+    POLICY_NONE,
+    POLICY_SINGLE_NUMA,
+    CPUManager,
+    DeviceManager,
+    TopologyAffinityError,
+    TopologyManager,
+)
+
+
+def _guaranteed(name, cores):
+    pw = make_pod(name)
+    pw.pod.spec.containers[0].requests = {"cpu": str(cores)}
+    pw.pod.spec.containers[0].limits = {"cpu": str(cores)}
+    return pw.obj()
+
+
+class TestCPUManager:
+    def test_exclusive_cores_for_guaranteed_integer(self, tmp_path):
+        cm = CPUManager(CheckpointManager(str(tmp_path)), cores_per_numa=(4, 4))
+        cores = cm.allocate(_guaranteed("g", 2))
+        assert len(cores) == 2
+        # burstable pod (requests != limits): shared pool, no exclusives
+        pw = make_pod("b")
+        pw.pod.spec.containers[0].requests = {"cpu": "2"}
+        pw.pod.spec.containers[0].limits = {"cpu": "4"}
+        assert cm.allocate(pw.obj()) == []
+        # fractional guaranteed: shared pool
+        pw2 = make_pod("f").req({"cpu": "1500m"})
+        pw2.pod.spec.containers[0].limits = {"cpu": "1500m"}
+        assert cm.allocate(pw2.obj()) == []
+
+    def test_assignments_survive_restart_via_checkpoint(self, tmp_path):
+        ckpt_dir = str(tmp_path)
+        cm = CPUManager(CheckpointManager(ckpt_dir), cores_per_numa=(4,))
+        cores = cm.allocate(_guaranteed("g", 2))
+        # "restart": a fresh manager over the same checkpoint dir
+        cm2 = CPUManager(CheckpointManager(ckpt_dir), cores_per_numa=(4,))
+        assert cm2.assignments["default/g"] == cores
+        # the restored assignment blocks double-allocation of those cores
+        with pytest.raises(TopologyAffinityError):
+            cm2.allocate(_guaranteed("big", 3))
+        cm2.release("default/g")
+        assert cm2.allocate(_guaranteed("big", 3))
+
+    def test_hints_prefer_single_numa(self, tmp_path):
+        cm = CPUManager(CheckpointManager(str(tmp_path)), cores_per_numa=(2, 4))
+        hints = cm.topology_hints(_guaranteed("g", 3))
+        assert hints == [h for h in hints if h.numa_nodes == (1,)] \
+            or any(h.numa_nodes == (1,) and h.preferred for h in hints)
+
+
+class TestDeviceManager:
+    def test_allocate_and_checkpoint(self, tmp_path):
+        dm = DeviceManager(CheckpointManager(str(tmp_path)))
+        dm.register_plugin("example.com/gpu", {"gpu0": 0, "gpu1": 0, "gpu2": 1})
+        pod = make_pod("g").req({"cpu": "1", "example.com/gpu": "2"}).obj()
+        alloc = dm.allocate(pod)
+        assert len(alloc["example.com/gpu"]) == 2
+        dm2 = DeviceManager(CheckpointManager(str(tmp_path)))
+        dm2.register_plugin("example.com/gpu", {"gpu0": 0, "gpu1": 0, "gpu2": 1})
+        assert dm2.allocations["default/g"] == alloc
+        # only one device left
+        pod2 = make_pod("h").req({"cpu": "1", "example.com/gpu": "2"}).obj()
+        with pytest.raises(TopologyAffinityError):
+            dm2.allocate(pod2)
+
+
+class TestTopologyManager:
+    def _managers(self, tmp_path):
+        cm = CPUManager(CheckpointManager(str(tmp_path / "c")), cores_per_numa=(4, 4))
+        dm = DeviceManager(CheckpointManager(str(tmp_path / "d")))
+        dm.register_plugin("example.com/gpu", {"gpu0": 0, "gpu1": 1})
+        return cm, dm
+
+    def test_single_numa_aligns_cpu_and_device(self, tmp_path):
+        cm, dm = self._managers(tmp_path)
+        tm = TopologyManager(POLICY_SINGLE_NUMA, providers=[cm, dm])
+        pod = make_pod("aligned")
+        pod.pod.spec.containers[0].requests = {"cpu": "2", "example.com/gpu": "1"}
+        pod.pod.spec.containers[0].limits = {"cpu": "2", "example.com/gpu": "1"}
+        hint = tm.admit(pod.obj())
+        assert len(hint.numa_nodes) == 1
+        numa = hint.numa_nodes[0]
+        cores = cm.assignments["default/aligned"]
+        assert all(cm.numa_of[c] == numa for c in cores)
+        [gpu] = dm.allocations["default/aligned"]["example.com/gpu"]
+        assert dm.registry["example.com/gpu"][gpu] == numa
+
+    def test_single_numa_rejects_unalignable(self, tmp_path):
+        cm, dm = self._managers(tmp_path)
+        tm = TopologyManager(POLICY_SINGLE_NUMA, providers=[cm, dm])
+        pod = make_pod("wide")
+        # 5 cores cannot fit one NUMA node (4+4 split)
+        pod.pod.spec.containers[0].requests = {"cpu": "5", "example.com/gpu": "1"}
+        pod.pod.spec.containers[0].limits = {"cpu": "5", "example.com/gpu": "1"}
+        with pytest.raises(TopologyAffinityError):
+            tm.admit(pod.obj())
+
+    def test_best_effort_admits_unaligned(self, tmp_path):
+        cm, dm = self._managers(tmp_path)
+        tm = TopologyManager(POLICY_BEST_EFFORT, providers=[cm, dm])
+        pod = make_pod("wide")
+        pod.pod.spec.containers[0].requests = {"cpu": "5"}
+        pod.pod.spec.containers[0].limits = {"cpu": "5"}
+        tm.admit(pod.obj())  # no raise
+        assert len(cm.assignments["default/wide"]) == 5
+
+    def test_none_policy_skips_hints(self, tmp_path):
+        cm, dm = self._managers(tmp_path)
+        tm = TopologyManager(POLICY_NONE, providers=[cm, dm])
+        assert tm.admit(_guaranteed("g", 2)) is None
+        assert len(cm.assignments["default/g"]) == 2
+
+    def test_release_frees_all_providers(self, tmp_path):
+        cm, dm = self._managers(tmp_path)
+        tm = TopologyManager(POLICY_BEST_EFFORT, providers=[cm, dm])
+        pod = make_pod("r")
+        pod.pod.spec.containers[0].requests = {"cpu": "2", "example.com/gpu": "1"}
+        pod.pod.spec.containers[0].limits = {"cpu": "2", "example.com/gpu": "1"}
+        tm.admit(pod.obj())
+        tm.release("default/r")
+        assert "default/r" not in cm.assignments
+        assert "default/r" not in dm.allocations
+
+
+class TestKubeletIntegration:
+    def test_topology_rejection_fails_pod(self, tmp_path):
+        from kubernetes_tpu.api.wrappers import make_node
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+        store = ClusterStore()
+        node = make_node("n1").capacity({"cpu": "8", "memory": "16Gi",
+                                         "pods": 10}).obj()
+        kubelet = HollowKubelet(store, node)
+        cm = CPUManager(CheckpointManager(str(tmp_path)), cores_per_numa=(2, 2))
+        kubelet.topology_manager = TopologyManager(POLICY_SINGLE_NUMA,
+                                                   providers=[cm])
+        ok = _guaranteed("fits", 2)
+        ok.spec.node_name = "n1"
+        store.create_pod(ok)
+        wide = _guaranteed("toowide", 3)  # 3 cores never fit one 2-core node
+        wide.spec.node_name = "n1"
+        store.create_pod(wide)
+        kubelet.run_once()
+        assert store.get_pod("default/fits").status.phase == "Running"
+        rejected = store.get_pod("default/toowide")
+        assert rejected.status.phase == "Failed"
+        assert rejected.status.reason == "TopologyAffinityError"
+        # cores released when the failed pod is deleted
+        store.delete_pod("default/toowide")
+        store.delete_pod("default/fits")
+        kubelet.run_once()
+        assert cm.assignments == {}
+
+
+def test_admit_rolls_back_earlier_providers_on_failure(tmp_path):
+    """A later provider's rejection must release what earlier providers
+    persisted — a Failed pod stays in the store and would pin cores."""
+    cm = CPUManager(CheckpointManager(str(tmp_path / "c")), cores_per_numa=(4,))
+    dm = DeviceManager(CheckpointManager(str(tmp_path / "d")))
+    dm.register_plugin("example.com/gpu", {})  # no devices at all
+    tm = TopologyManager(POLICY_NONE, providers=[cm, dm])
+    pod = make_pod("leaky")
+    pod.pod.spec.containers[0].requests = {"cpu": "2", "example.com/gpu": "1"}
+    pod.pod.spec.containers[0].limits = {"cpu": "2", "example.com/gpu": "1"}
+    with pytest.raises(TopologyAffinityError):
+        tm.admit(pod.obj())
+    assert cm.assignments == {}  # rolled back, not leaked

@@ -260,7 +260,8 @@ kind: Pod
 metadata: {name: cli-pod}
 spec:
   containers:
-  - image: app:v1
+  - name: app
+    image: app:v1
     resources:
       requests: {cpu: 200m}
 """)
@@ -318,7 +319,7 @@ spec:
         m.write_text("""
 kind: Pod
 metadata: {name: p}
-spec: {containers: [{image: a, resources: {requests: {cpu: 100m}}}]}
+spec: {containers: [{name: a, image: a, resources: {requests: {cpu: 100m}}}]}
 """)
         kubectl(store, f"create -f {m}")
         sched.run_until_settled()

@@ -27,8 +27,8 @@ from kubernetes_tpu.queue.scheduling_queue import SchedulingQueue
 
 def _store_with_service(selector):
     store = ClusterStore()
-    store.create_namespace(Namespace())
-    store.create_service(Service(selector=selector))
+    store.create_namespace(Namespace(meta=ObjectMeta(name="default")))
+    store.create_service(Service(meta=ObjectMeta(name="svc"), selector=selector))
     return store
 
 
