@@ -793,6 +793,35 @@ class RuntimeClass:
 
 
 @dataclass
+class IngressClass:
+    """networking.k8s.io/v1 IngressClass; the is-default-class annotation
+    marks the cluster default (DefaultIngressClass admission)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    controller: str = ""
+
+
+ANNOTATION_DEFAULT_INGRESS_CLASS = "ingressclass.kubernetes.io/is-default-class"
+
+
+@dataclass
+class IngressRule:
+    host: str = ""
+    service_name: str = ""  # backend service (reduced single-backend form)
+    service_port: int = 0
+
+
+@dataclass
+class Ingress:
+    """networking.k8s.io/v1 Ingress, reduced to class selection + host→
+    service rules (the DefaultIngressClass admission surface)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    ingress_class_name: str = ""
+    rules: Tuple[IngressRule, ...] = ()
+
+
+@dataclass
 class CertificateSigningRequest:
     """certificates.k8s.io/v1 CSR, reduced to the control-flow surface the
     csrapproving/csrsigning/csrcleaner controllers drive (the x509/crypto

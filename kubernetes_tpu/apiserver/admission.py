@@ -957,6 +957,28 @@ class DenyServiceExternalIPs(AdmissionPlugin):
             raise AdmissionError(self.name, "may not add externalIPs")
 
 
+class DefaultIngressClass(AdmissionPlugin):
+    """plugin/pkg/admission/network/defaultingressclass: an Ingress created
+    without ingressClassName gets the cluster default (the IngressClass
+    carrying the is-default-class annotation); two marked defaults reject."""
+
+    name = "DefaultIngressClass"
+
+    def admit(self, store, kind: str, obj) -> None:
+        if kind != "Ingress" or obj.ingress_class_name:
+            return
+        from ..api.types import ANNOTATION_DEFAULT_INGRESS_CLASS
+
+        defaults = [ic for ic in getattr(store, "ingress_classes", {}).values()
+                    if ic.meta.annotations.get(ANNOTATION_DEFAULT_INGRESS_CLASS)
+                    == "true"]
+        if len(defaults) > 1:
+            raise AdmissionError(
+                self.name, "multiple IngressClasses marked as default")
+        if defaults:
+            obj.ingress_class_name = defaults[0].meta.name
+
+
 class AlwaysAdmit(AdmissionPlugin):
     """plugin/pkg/admission/admit (default-off, deprecated no-op)."""
 
@@ -986,7 +1008,8 @@ def all_ordered_plugins() -> List[AdmissionPlugin]:
             OwnerReferencesPermissionEnforcement(),
             PersistentVolumeClaimResize(), RuntimeClassAdmission(),
             CertificateApproval(), CertificateSigning(),
-            CertificateSubjectRestriction(), DenyServiceExternalIPs(),
+            CertificateSubjectRestriction(), DefaultIngressClass(),
+            DenyServiceExternalIPs(),
             MutatingAdmissionWebhook(), ValidatingAdmissionWebhook(),
             ResourceQuotaAdmission(), AlwaysDeny()]
 
@@ -1006,7 +1029,7 @@ def default_chain() -> List[AdmissionPlugin]:
             PersistentVolumeClaimResize(),
             OwnerReferencesPermissionEnforcement(), RuntimeClassAdmission(),
             CertificateApproval(), CertificateSigning(),
-            CertificateSubjectRestriction(),
+            CertificateSubjectRestriction(), DefaultIngressClass(),
             # DenyServiceExternalIPs is default-OFF upstream
             # (DefaultOffAdmissionPlugins) — available via
             # all_ordered_plugins(), not enabled here

@@ -78,6 +78,8 @@ RESOURCES = {
     ("apis/certificates.k8s.io/v1", "certificatesigningrequests"):
         "CertificateSigningRequest",
     ("apis/node.k8s.io/v1", "runtimeclasses"): "RuntimeClass",
+    ("apis/networking.k8s.io/v1", "ingresses"): "Ingress",
+    ("apis/networking.k8s.io/v1", "ingressclasses"): "IngressClass",
 }
 
 _KIND_TYPES = {kind: getattr(api_types, kind) for (_g, _p), kind in RESOURCES.items()}

@@ -137,6 +137,8 @@ class ClusterStore:
         self.secrets: Dict[str, object] = {}
         self.csrs: Dict[str, object] = {}
         self.runtime_classes: Dict[str, object] = {}
+        self.ingresses: Dict[str, object] = {}
+        self.ingress_classes: Dict[str, object] = {}
         self.hpas: Dict[str, object] = {}
         self.cluster_roles: Dict[str, object] = {}
         self.cluster_role_bindings: Dict[str, object] = {}
@@ -342,6 +344,8 @@ class ClusterStore:
                 "Secret": self.secrets,
                 "CertificateSigningRequest": self.csrs,
                 "RuntimeClass": self.runtime_classes,
+                "Ingress": self.ingresses,
+                "IngressClass": self.ingress_classes,
                 "HorizontalPodAutoscaler": self.hpas,
                 "ClusterRole": self.cluster_roles,
                 "ClusterRoleBinding": self.cluster_role_bindings,
@@ -499,7 +503,7 @@ class ClusterStore:
         "PriorityClass", "VolumeAttachment",
         "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
         "ClusterRole", "ClusterRoleBinding", "CertificateSigningRequest",
-        "RuntimeClass",
+        "RuntimeClass", "IngressClass",
     }
 
     def _key_of(self, kind: str, obj) -> str:

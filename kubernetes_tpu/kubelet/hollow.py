@@ -62,6 +62,9 @@ class HollowKubelet:
         # resource-manager seam (kubelet/cm.py TopologyManager over
         # CPU/Device managers): admission gate at Pending→Running
         self.topology_manager = None
+        # volume-manager seam (kubelet/volume_manager.py): PVC mounts gate
+        # the Pending→Running transition (WaitForAttachAndMount)
+        self.volume_manager = None
 
     # ------------------------------------------------------------ registration
 
@@ -139,6 +142,9 @@ class HollowKubelet:
             if pod.status.phase == "Pending":
                 started = self._started_at.setdefault(key, now)
                 if now - started >= self.startup_delay:
+                    if (self.volume_manager is not None and pod.spec.volumes
+                            and not self.volume_manager.wait_for_attach_and_mount(pod)):
+                        continue  # volumes not attached+mounted yet: retry next sync
                     if not self._cm_admit(pod):
                         transitions += 1
                         continue
@@ -166,6 +172,8 @@ class HollowKubelet:
                 self._runtime_remove(key)
                 if self.topology_manager is not None:
                     self.topology_manager.release(key)
+        if self.volume_manager is not None:
+            self.volume_manager.reconcile()  # unmount departed pods' volumes
         return transitions
 
     def _cm_admit(self, pod: Pod) -> bool:

@@ -149,7 +149,7 @@ class TestDefaultOffFamily:
 
     def test_all_ordered_roster_instantiates(self):
         names = [p.name for p in all_ordered_plugins()]
-        assert len(names) == len(set(names)) == 29
+        assert len(names) == len(set(names)) == 30
         assert names[0] == "AlwaysAdmit" and names[-1] == "AlwaysDeny"
 
     def test_security_context_deny_catches_root_uid_zero(self):
@@ -173,3 +173,49 @@ class TestDefaultOffFamily:
         pod.spec.overhead = {"cpu": "999"}  # asserts its own overhead
         with pytest.raises(AdmissionError, match="overhead must match"):
             store.create_pod(pod)
+
+
+class TestDefaultIngressClass:
+    def test_defaulted_from_marked_class(self):
+        from kubernetes_tpu.api.types import (
+            ANNOTATION_DEFAULT_INGRESS_CLASS,
+            Ingress,
+            IngressClass,
+        )
+
+        store = ClusterStore()
+        store.create_object("IngressClass", IngressClass(
+            meta=ObjectMeta(name="nginx",
+                            annotations={ANNOTATION_DEFAULT_INGRESS_CLASS: "true"})))
+        store.create_object("Ingress", Ingress(meta=ObjectMeta(name="web")))
+        assert store.ingresses["default/web"].ingress_class_name == "nginx"
+
+    def test_explicit_class_kept(self):
+        from kubernetes_tpu.api.types import (
+            ANNOTATION_DEFAULT_INGRESS_CLASS,
+            Ingress,
+            IngressClass,
+        )
+
+        store = ClusterStore()
+        store.create_object("IngressClass", IngressClass(
+            meta=ObjectMeta(name="nginx",
+                            annotations={ANNOTATION_DEFAULT_INGRESS_CLASS: "true"})))
+        store.create_object("Ingress", Ingress(
+            meta=ObjectMeta(name="web"), ingress_class_name="haproxy"))
+        assert store.ingresses["default/web"].ingress_class_name == "haproxy"
+
+    def test_two_defaults_rejected(self):
+        from kubernetes_tpu.api.types import (
+            ANNOTATION_DEFAULT_INGRESS_CLASS,
+            Ingress,
+            IngressClass,
+        )
+
+        store = ClusterStore()
+        for n in ("a", "b"):
+            store.create_object("IngressClass", IngressClass(
+                meta=ObjectMeta(name=n,
+                                annotations={ANNOTATION_DEFAULT_INGRESS_CLASS: "true"})))
+        with pytest.raises(AdmissionError, match="multiple IngressClasses"):
+            store.create_object("Ingress", Ingress(meta=ObjectMeta(name="web")))

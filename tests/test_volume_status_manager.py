@@ -1,0 +1,110 @@
+"""Kubelet volume manager + status manager (pkg/kubelet/volumemanager,
+pkg/kubelet/status): attach-gated mounts, unmount on pod departure,
+no-op-suppressed status writes."""
+
+from kubernetes_tpu.api.types import (
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PodStatus,
+    VolumeAttachment,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.kubelet.volume_manager import StatusManager, VolumeManager
+
+
+def _store_with_claim():
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity({"cpu": "8"}).obj())
+    store.create_pv(PersistentVolume(meta=ObjectMeta(name="pv1"),
+                                     capacity_bytes=1 << 30,
+                                     bound_pvc="default/c1"))
+    store.create_pvc(PersistentVolumeClaim(meta=ObjectMeta(name="c1"),
+                                           bound_pv="pv1"))
+    pod = make_pod("db").req({"cpu": "1"}).pvc("c1").obj()
+    pod.spec.node_name = "n1"
+    store.create_pod(pod)
+    return store, store.get_pod("default/db")
+
+
+class TestVolumeManager:
+    def test_mount_gated_on_attachment(self):
+        store, pod = _store_with_claim()
+        vm = VolumeManager(store, "n1")
+        assert not vm.wait_for_attach_and_mount(pod)  # not attached yet
+        store.create_object("VolumeAttachment", VolumeAttachment(
+            meta=ObjectMeta(name="va1"), pv_name="pv1", node_name="n1"))
+        assert vm.wait_for_attach_and_mount(pod)
+        assert vm.mounts_total == 1
+
+    def test_unmount_when_pod_leaves(self):
+        store, pod = _store_with_claim()
+        vm = VolumeManager(store, "n1", require_attach=False)
+        assert vm.wait_for_attach_and_mount(pod)
+        store.delete_pod("default/db")
+        vm.reconcile()
+        assert vm.mounted == set()
+        assert vm.unmounts_total == 1
+
+    def test_attachment_on_other_node_does_not_count(self):
+        store, pod = _store_with_claim()
+        vm = VolumeManager(store, "n1")
+        store.create_object("VolumeAttachment", VolumeAttachment(
+            meta=ObjectMeta(name="va1"), pv_name="pv1", node_name="other"))
+        assert not vm.wait_for_attach_and_mount(pod)
+
+
+class TestStatusManager:
+    def test_noop_updates_suppressed(self):
+        store = ClusterStore()
+        store.create_pod(make_pod("w").req({"cpu": "1"}).obj())
+        pod = store.get_pod("default/w")
+        sm = StatusManager(store)
+        sm.set_pod_status(pod, PodStatus(phase="Running"))
+        sm.set_pod_status(pod, PodStatus(phase="Running"))  # duplicate
+        assert sm.sync() == 1
+        assert sm.api_writes == 1
+        assert store.get_pod("default/w").status.phase == "Running"
+        assert sm.sync() == 0  # already synced
+
+    def test_distinct_statuses_each_written_once(self):
+        store = ClusterStore()
+        store.create_pod(make_pod("w").req({"cpu": "1"}).obj())
+        pod = store.get_pod("default/w")
+        sm = StatusManager(store)
+        sm.set_pod_status(pod, PodStatus(phase="Running"))
+        sm.sync()
+        sm.set_pod_status(pod, PodStatus(phase="Failed", reason="Evicted"))
+        assert sm.sync() == 1
+        got = store.get_pod("default/w").status
+        assert (got.phase, got.reason) == ("Failed", "Evicted")
+
+    def test_deleted_pod_entry_cleaned(self):
+        store = ClusterStore()
+        store.create_pod(make_pod("w").req({"cpu": "1"}).obj())
+        pod = store.get_pod("default/w")
+        sm = StatusManager(store)
+        sm.set_pod_status(pod, PodStatus(phase="Running"))
+        store.delete_pod("default/w")
+        assert sm.sync() == 0
+        assert sm._versions == {}
+
+
+class TestKubeletVolumeGate:
+    def test_pod_waits_for_attachment_then_runs(self):
+        from kubernetes_tpu.kubelet.hollow import HollowKubelet
+
+        store, pod = _store_with_claim()
+        kubelet = HollowKubelet(store, store.nodes["n1"])
+        kubelet.volume_manager = VolumeManager(store, "n1")
+        kubelet.run_once()
+        assert store.get_pod("default/db").status.phase == "Pending"  # gated
+        store.create_object("VolumeAttachment", VolumeAttachment(
+            meta=ObjectMeta(name="va1"), pv_name="pv1", node_name="n1"))
+        kubelet.run_once()
+        assert store.get_pod("default/db").status.phase == "Running"
+        # pod deletion unmounts
+        store.delete_pod("default/db")
+        kubelet.run_once()
+        assert kubelet.volume_manager.mounted == set()
