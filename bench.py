@@ -151,7 +151,9 @@ def run_matrix(budget_deadline, platform):
         try:
             p = subprocess.run(
                 [sys.executable, __file__], env=env, capture_output=True,
-                text=True, timeout=min(remaining, 900),
+                text=True,
+                timeout=min(remaining,
+                            float(os.environ.get("BENCH_ROW_TIMEOUT", "1200"))),
             )
             lines = p.stdout.strip().splitlines()
             try:

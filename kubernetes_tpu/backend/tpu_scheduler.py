@@ -836,21 +836,46 @@ class TPUScheduler(Scheduler):
                 "comparer: oracle rejects device placement %s -> %s: %s",
                 pod.key(), node_name, status.message)
 
-    def warm_buckets(self) -> int:
+    def warm_buckets(self, sample_pods=None) -> int:
         """Precompile the batch program at every sizer bucket for the
         CURRENT device/topo configuration (both the fresh and the
         pipelined-carry trace variants). Deadline-cut batches switch pod
         buckets at runtime; without warmup the first batch at each bucket
         pays a multi-second jit compile inside the measured window, which
         poisons both the latency histogram and the sizer's model. Returns
-        the number of (bucket, variant) programs compiled/hit in cache."""
+        the number of (bucket, variant) programs compiled/hit in cache.
+
+        ``sample_pods``: pods shaped like the INCOMING workload (not yet in
+        the store). Encoding them registers their topology signatures/terms
+        first, so the warmed programs are the topo-mode variants the real
+        batches will run — without a sample, a cluster whose first spread/
+        affinity pods arrive in the measured window would warm the
+        topology-off program and compile the topo one mid-measure."""
         from ..api.wrappers import make_pod
 
         self._drain_inflight()
         self._ensure_device()
         self.cache.update_snapshot(self.snapshot)
         self.device.sync(self.snapshot)
-        pod = make_pod("__bucket_warm__").req({"cpu": "1m"}).obj()
+        if sample_pods:
+            pods_for_warm = list(sample_pods)
+        else:
+            pods_for_warm = [make_pod("__bucket_warm__").req({"cpu": "1m"}).obj()]
+        # registration pass: encoding the sample grows the sig/term tables
+        # FIRST, so the topo-mode decision below matches what the real
+        # batches will select (capacity growth retried like _flush_batch)
+        for _attempt in range(8):
+            try:
+                self.device.encoder.encode_pods(
+                    pods_for_warm,
+                    capacity=self.sizer.bucket_for(len(pods_for_warm)))
+                self.device.sig_table.encode_topo(
+                    pods_for_warm,
+                    capacity=self.sizer.bucket_for(len(pods_for_warm)))
+                break
+            except CapacityError as e:
+                self._resync_grown(e)
+        self.device.sync(self.snapshot)  # refresh counts for new sigs
         n_valid = self.cache.node_count()
         if self.percentage_of_nodes_to_score or not _default_full_batch():
             k = self.num_feasible_nodes_to_find(n_valid)
@@ -865,8 +890,10 @@ class TPUScheduler(Scheduler):
         for bucket in sorted({self.sizer.bucket_for(b)
                               for b in self.sizer._ladder()}):
             try:
-                pb, et = self.device.encoder.encode_pods([pod], capacity=bucket)
-                tb = self.device.sig_table.encode_topo([pod], capacity=bucket)
+                pb, et = self.device.encoder.encode_pods(pods_for_warm,
+                                                         capacity=bucket)
+                tb = self.device.sig_table.encode_topo(pods_for_warm,
+                                                       capacity=bucket)
             except CapacityError:
                 continue
             common = dict(adopt=False, topo_enabled=self.device.topo_enabled,

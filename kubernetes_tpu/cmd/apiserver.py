@@ -52,6 +52,12 @@ def build_auth(args, store):
                 tokens[token] = UserInfo(user, groups)
     authenticator = Authenticator(tokens=tokens) if tokens else None
     modes = [m.strip() for m in (args.authorization_mode or "").split(",") if m.strip()]
+    unknown = [m for m in modes if m not in ("Node", "RBAC")]
+    if unknown:
+        # fail startup like the reference binary — a typo'd mode silently
+        # ignored would leave the server wide open behind an authz banner
+        raise SystemExit(
+            f"--authorization-mode: unknown mode(s) {unknown}; supported: Node, RBAC")
     authorizer = None
     if "RBAC" in modes:
         authorizer = RBACAuthorizer(store)

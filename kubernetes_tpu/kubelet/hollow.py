@@ -124,6 +124,8 @@ class HollowKubelet:
         now = self.now_fn()
         transitions = 0
         my_pods = self._my_pods()
+        if self.volume_manager is not None:
+            self.volume_manager.reconcile()  # once per tick; gates read cheaply
         # admission: the pods-capacity over-commit rejects newest first
         # (eviction_manager.go stand-in; scheduler normally prevents this)
         allowed = self._allowed_pods()
@@ -143,7 +145,8 @@ class HollowKubelet:
                 started = self._started_at.setdefault(key, now)
                 if now - started >= self.startup_delay:
                     if (self.volume_manager is not None and pod.spec.volumes
-                            and not self.volume_manager.wait_for_attach_and_mount(pod)):
+                            and not self.volume_manager.wait_for_attach_and_mount(
+                                pod, reconcile=False)):
                         continue  # volumes not attached+mounted yet: retry next sync
                     if not self._cm_admit(pod):
                         transitions += 1
@@ -172,8 +175,6 @@ class HollowKubelet:
                 self._runtime_remove(key)
                 if self.topology_manager is not None:
                     self.topology_manager.release(key)
-        if self.volume_manager is not None:
-            self.volume_manager.reconcile()  # unmount departed pods' volumes
         return transitions
 
     def _cm_admit(self, pod: Pod) -> bool:

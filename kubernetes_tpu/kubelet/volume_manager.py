@@ -77,11 +77,15 @@ class VolumeManager:
                 changes += 1
         return changes
 
-    def wait_for_attach_and_mount(self, pod) -> bool:
+    def wait_for_attach_and_mount(self, pod, reconcile: bool = True) -> bool:
         """volume_manager.go:368 WaitForAttachAndMount, non-blocking form:
         True when every volume of ``pod`` is mounted (the syncLoop's
-        run-gate; the caller retries next sync instead of blocking)."""
-        self.reconcile()
+        run-gate; the caller retries next sync instead of blocking).
+        ``reconcile=False`` makes this a pure read of the mounted set —
+        the syncLoop reconciles ONCE per tick and gates each pod cheaply
+        (a per-pod reconcile would be O(pending x pods x attachments))."""
+        if reconcile:
+            self.reconcile()
         key = pod.meta.key()
         return all((key, claim) in self.mounted for claim in pod.spec.volumes)
 

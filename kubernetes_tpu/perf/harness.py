@@ -315,6 +315,17 @@ class Runner:
         profile = DEFAULT_SCHEDULER_NAME
         lat_snaps = {res: hist.snapshot(res, profile)
                      for res in ("scheduled", "unschedulable")}
+        # compile every deadline-cutting pod bucket OUTSIDE the measured
+        # window (the headline bench does the same): without this the first
+        # batch at each bucket pays a multi-second jit compile inside the
+        # measurement and the sizer's latency model collapses. The sample
+        # pod carries the MEASURED workload's shape (spread constraints,
+        # affinity terms), so the warmed programs are the topology-mode
+        # variants the real batches will actually run.
+        warm = getattr(self.scheduler, "warm_buckets", None)
+        if warm is not None:
+            sample = _pod_wrapper(10 ** 9, prefix, params).obj()  # never stored
+            warm(sample_pods=[sample])
         col = ThroughputCollector(scheduled_count, interval=collector_interval)
         col.start(time.monotonic())
         for _ in range(count):

@@ -214,7 +214,9 @@ def mixed_scheduling_base_pod(nodes=5000, init_pods=2000, measured=1000) -> dict
              "preferred_affinity_labels": {"color": "red"}},
             {"opcode": "createPods", "count": init_pods, "prefix": "panti", **base,
              "preferred_affinity_labels": {"color": "yellow"}, "anti": True},
-            {"opcode": "barrier"},
+            # 5 waves x init_pods with affinity/anti/preferred shapes take
+            # well past the default 300s barrier on the CPU fallback
+            {"opcode": "barrier", "timeout_s": 1800.0},
             {"opcode": "measurePods", "count": measured, "prefix": "measured", **base},
         ],
     }
