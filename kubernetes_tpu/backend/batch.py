@@ -218,11 +218,15 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
     # The rounds shard exactly like the scan: node-axis state is local, the
     # per-pod [P] decision vectors (choice/accepted/prefix cut) are made
     # globally consistent through elementwise pmax/pmin/psum, so every shard
-    # runs the same number of rounds and finalizes the same prefix. Topology
-    # modes stay single-shard for now (host/gen rival-mix tables are
-    # node-local but their deferral logic is not yet axis-aware).
-    assert axis_name is None or (host is None and gen is None), \
-        "sharded speculative decode covers the topology-off mode only"
+    # runs the same number of rounds and finalizes the same prefix. The
+    # HOSTNAME topology mode shards too — its tables are [*, N] node-local,
+    # so rival-mixing is shard-local and only the per-pod reductions
+    # (spread min-match, IPA totals, score normalization) psum/pmax across
+    # shards. The general domain-aggregating mode keeps the scan on a mesh
+    # (its segment tables are domain-global).
+    assert axis_name is None or gen is None, \
+        "sharded speculative decode covers the off and hostname topology " \
+        "modes (the general domain-aggregating mode keeps the scan on a mesh)"
     if slot_offset is None:
         slot_offset = np.int32(0)
     shard_axis = (lax.axis_index(axis_name).astype(jnp.int32)
@@ -282,10 +286,13 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
 
     def _spread_norm(raw, base_mask, ignored, has_cons):
         """Spread score normalization (scoring.go:232-271), shared by the
-        host and general batched paths (must stay bit-identical)."""
-        mx = jnp.max(jnp.where(base_mask, raw, -jnp.inf), axis=1, keepdims=True)
-        mn = jnp.min(jnp.where(base_mask, raw, jnp.inf), axis=1, keepdims=True)
-        any_base = jnp.any(base_mask, axis=1, keepdims=True)
+        host and general batched paths (must stay bit-identical). Under
+        shard_map the per-pod max/min reduce over the GLOBAL node axis."""
+        mx = _gmax(jnp.max(jnp.where(base_mask, raw, -jnp.inf),
+                           axis=1, keepdims=True), axis_name)
+        mn = _gmin(jnp.min(jnp.where(base_mask, raw, jnp.inf),
+                           axis=1, keepdims=True), axis_name)
+        any_base = _gany_pods(jnp.any(base_mask, axis=1, keepdims=True))
         norm = jnp.where(mx == 0, 100.0,
                          jnp.floor(100.0 * (mx + mn - raw) / jnp.maximum(mx, 1.0)))
         norm = jnp.where(ignored | ~any_base, 0.0, norm)
@@ -294,10 +301,12 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
     def _ipa_norm(raw, feasible):
         """IPA score normalization (clamped min/max), shared likewise."""
         mx = jnp.maximum(
-            jnp.max(jnp.where(feasible, raw, -jnp.inf), axis=1, keepdims=True),
+            _gmax(jnp.max(jnp.where(feasible, raw, -jnp.inf),
+                          axis=1, keepdims=True), axis_name),
             0.0)
         mn = jnp.minimum(
-            jnp.min(jnp.where(feasible, raw, jnp.inf), axis=1, keepdims=True),
+            _gmin(jnp.min(jnp.where(feasible, raw, jnp.inf),
+                          axis=1, keepdims=True), axis_name),
             0.0)
         diff = mx - mn
         return jnp.where(
@@ -347,8 +356,10 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         # masking by active only skips work for done pods (their rows are
         # never read) and keeps reductions well-defined.
         cnt_sf = _mix_gather(sel_base, sel_d, tbx["sf_sig"], rival)           # [P, C, N]
-        minm = jnp.min(jnp.where(elig[:, None, :], cnt_sf, INT_MAX), axis=2)
-        ndom = jnp.sum(elig.astype(jnp.int32), axis=1)           # [P]
+        # global reductions over the (possibly sharded) node axis
+        minm = _gmin(jnp.min(jnp.where(elig[:, None, :], cnt_sf, INT_MAX),
+                             axis=2), axis_name)
+        ndom = _gsum(jnp.sum(elig.astype(jnp.int32), axis=1), axis_name)  # [P]
         any_pres = ndom > 0
         minm = jnp.where(any_pres[:, None], minm, 0)
         minm = jnp.where((tbx["sf_min_domains"] >= 0)
@@ -370,7 +381,8 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
             axis=1)
         tot_mask = (ia_valid[:, :, None] & valid_n[None, None, :]
                     & hostkey_ok[None, None, :])
-        total = jnp.sum(jnp.where(tot_mask, cnt_ia, 0), axis=(1, 2))  # [P]
+        total = _gsum(jnp.sum(jnp.where(tot_mask, cnt_ia, 0), axis=(1, 2)),
+                      axis_name)  # [P], global over shards
         first_ok = (total == 0) & tbx["ia_self_all"]
         has_terms = jnp.any(ia_valid, axis=1)
         aff_ok = (~has_terms[:, None]) | (
@@ -400,7 +412,8 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         # spread score
         ignored = tbx["ss_require_all"][:, None] & ~hostkey_ok[None, :]
         base_mask = feasible & ~ignored                          # [P, N]
-        n_base = jnp.sum(base_mask.astype(jnp.int32), axis=1)    # [P]
+        n_base = _gsum(jnp.sum(base_mask.astype(jnp.int32), axis=1),
+                       axis_name)                                # [P] global
         w = jnp.log(n_base.astype(jnp.float32) + 2.0)[:, None]   # [P, 1]
         cnt_ss = _mix_gather(sel_base, sel_d, tbx["ss_sig"], rival).astype(jnp.float32)        # [P, C, N]
         contrib = jnp.where(
@@ -909,8 +922,8 @@ def schedule_batch_core(
         # real mesh); sequential parity proven per-round by the
         # prefix-stability acceptance
         assert topo_mode in ("off", "host", "general") and sample_k is None
-        assert axis_name is None or topo_mode == "off", \
-            "sharded speculative decode covers the topology-off mode"
+        assert axis_name is None or topo_mode in ("off", "host"), \
+            "sharded speculative decode covers the off and hostname modes"
         host_args = gen_args = None
         if topo_mode == "host":
             seg0 = tc.term_counts                      # [T, N] per-node counts

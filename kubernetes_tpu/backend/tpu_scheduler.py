@@ -875,6 +875,12 @@ class TPUScheduler(Scheduler):
                 break
             except CapacityError as e:
                 self._resync_grown(e)
+        else:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "warm_buckets: capacities refused to converge for the "
+                "sample; warming with unregistered topology (degraded)")
         self.device.sync(self.snapshot)  # refresh counts for new sigs
         n_valid = self.cache.node_count()
         if self.percentage_of_nodes_to_score or not _default_full_batch():
@@ -889,10 +895,13 @@ class TPUScheduler(Scheduler):
         timings = []  # (bucket, warm execution seconds)
         for bucket in sorted({self.sizer.bucket_for(b)
                               for b in self.sizer._ladder()}):
+            # a sample larger than the bucket truncates rather than skipping:
+            # small buckets are exactly the ones deadline cuts switch to
+            warm_slice = pods_for_warm[:bucket]
             try:
-                pb, et = self.device.encoder.encode_pods(pods_for_warm,
+                pb, et = self.device.encoder.encode_pods(warm_slice,
                                                          capacity=bucket)
-                tb = self.device.sig_table.encode_topo(pods_for_warm,
+                tb = self.device.sig_table.encode_topo(warm_slice,
                                                        capacity=bucket)
             except CapacityError:
                 continue

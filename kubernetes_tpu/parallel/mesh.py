@@ -72,15 +72,22 @@ def shard_topo_counts(tc: TopoCounts, mesh: Mesh) -> TopoCounts:
 
 def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = None,
                              topo_enabled: bool = True,
-                             spec_decode: bool = False):
+                             spec_decode: bool = False,
+                             topo_mode: Optional[str] = None,
+                             host_key: int = 0):
     """Compile schedule_batch over the mesh: node axis sharded, pods/exprs
     replicated, results replicated (winner slots are global indices).
 
     ``spec_decode`` runs the speculative decide/repair rounds instead of the
-    P-step scan — supported under sharding for the topology-off program
-    (the headline shape); topology batches keep the scan on a mesh."""
-    assert not (spec_decode and topo_enabled), \
-        "sharded speculative decode requires topo_enabled=False"
+    P-step scan — supported under sharding for the topology-off program AND
+    the hostname fast path (``topo_mode="host"`` + the hostname label's
+    ``host_key`` slot); the general domain-aggregating mode keeps the scan.
+    In host mode the seg_exist carry slot holds the node-sharded [T, N]
+    per-node term counts, so its out_spec shards with the node axis."""
+    if topo_mode is None:
+        topo_mode = "general" if topo_enabled else "off"
+    assert not (spec_decode and topo_mode == "general"), \
+        "sharded speculative decode covers the off and hostname modes"
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     import dataclasses
 
@@ -104,16 +111,19 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
         first_fail=P(None, AXIS),
         final_requested=P(AXIS), final_nonzero=P(AXIS), final_ports=P(AXIS),
         # evolved topo carry: sel_counts is node-sharded on its second axis
-        # like tc.sel_counts; seg_exist is replicated — commit_update applies
-        # every update through a psum'd broadcast so all shards evolve the
-        # same [T, Vd] table (ops/topology.py commit_update)
-        final_sel_counts=P(None, AXIS), final_seg_exist=P(),
+        # like tc.sel_counts. seg_exist depends on the mode: general mode
+        # evolves a replicated [T, Vd] domain table (commit_update psums
+        # every update so all shards agree); HOST mode's carry slot holds
+        # the per-node [T, N] term counts — node-sharded like sel_counts.
+        final_sel_counts=P(None, AXIS),
+        final_seg_exist=P(None, AXIS) if topo_mode == "host" else P(),
         final_class_req=P(AXIS),
     )
 
     body = functools.partial(schedule_batch_core, weights_key=wk,
                              topo_enabled=topo_enabled, axis_name=AXIS,
-                             num_shards=mesh.size, spec_decode=spec_decode)
+                             num_shards=mesh.size, spec_decode=spec_decode,
+                             topo_mode=topo_mode, host_key=host_key)
     sharded = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pb_spec, et_spec, nt_spec, tc_spec, tb_spec, P()),

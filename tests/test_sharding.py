@@ -226,3 +226,68 @@ def test_sharded_spec_decode_capacity_conflicts(monkeypatch):
     assert np.array_equal(np.asarray(scan.node_idx), np.asarray(spec.node_idx))
     # exactly 8 place (one per node), 8 fail
     assert int((np.asarray(spec.node_idx) >= 0).sum()) == 8
+
+
+def _hostname_topo_inputs(n_nodes=32, n_pods=16):
+    """Cluster with node-unique hostname labels + pods carrying hostname-key
+    spread and required anti-affinity — the hostname fast-path shapes."""
+    from kubernetes_tpu.framework.plugins.podtopologyspread import HOSTNAME_KEY
+
+    infos = []
+    for i in range(n_nodes):
+        infos.append(NodeInfo(
+            make_node(f"node-{i}")
+            .capacity({"cpu": "8", "memory": "16Gi", "pods": 20})
+            .label(HOSTNAME_KEY, f"node-{i}")
+            .obj()))
+    enc = ClusterEncoder(Capacities(nodes=n_nodes, pods=n_pods, value_words=32))
+    sig = SigTable(enc)
+    nt = enc.encode_snapshot(infos)
+    sel = LabelSelector(match_labels={"app": "web"})
+    pods = []
+    for i in range(n_pods):
+        pw = make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).label("app", "web")
+        pw.spread_constraint(1, HOSTNAME_KEY, selector=sel)
+        if i % 2 == 0:
+            pw.pod_affinity(HOSTNAME_KEY,
+                            LabelSelector(match_labels={"app": "web"}), anti=True)
+        pods.append(pw.obj())
+    pb, et = enc.encode_pods(pods)
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
+    host_key = enc.key_slot(HOSTNAME_KEY)
+    return enc, nt, pb, et, tc, tb, host_key
+
+
+def test_sharded_spec_decode_hostname_mode_matches_scan(monkeypatch):
+    """Sharded speculative decode on the HOSTNAME topology fast path: the
+    decide/repair rounds under shard_map must match the single-device scan
+    exactly on spread + intra-batch anti-affinity workloads."""
+    monkeypatch.setenv("KTPU_SPEC", "1")
+    enc, nt, pb, et, tc, tb, host_key = _hostname_topo_inputs()
+    key = jax.random.PRNGKey(13)
+    scan = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=True,
+                          topo_mode="host", host_key=host_key,
+                          spec_decode=False)
+
+    mesh = make_node_mesh()
+    fn = make_sharded_schedule_fn(mesh, topo_enabled=True, spec_decode=True,
+                                  topo_mode="host", host_key=host_key)
+    spec = fn(pb, et, shard_node_tensors(nt, mesh),
+              shard_topo_counts(tc, mesh), tb, key)
+
+    assert np.array_equal(np.asarray(scan.node_idx), np.asarray(spec.node_idx)), (
+        np.asarray(scan.node_idx), np.asarray(spec.node_idx))
+    assert np.array_equal(np.asarray(scan.any_feasible),
+                          np.asarray(spec.any_feasible))
+    np.testing.assert_allclose(np.asarray(scan.best_score),
+                               np.asarray(spec.best_score), atol=1e-4)
+    # evolved topology carries identical (host mode: [S,N] sel + [T,N] term)
+    np.testing.assert_array_equal(np.asarray(scan.final_sel_counts),
+                                  np.asarray(spec.final_sel_counts))
+    np.testing.assert_array_equal(np.asarray(scan.final_seg_exist),
+                                  np.asarray(spec.final_seg_exist))
+    # anti-affinity honored: no two anti pods share a node
+    idx = np.asarray(spec.node_idx)
+    anti = [idx[i] for i in range(16) if i % 2 == 0 and idx[i] >= 0]
+    assert len(anti) == len(set(anti))
