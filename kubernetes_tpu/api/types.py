@@ -780,6 +780,25 @@ SECRET_TYPE_BOOTSTRAP_TOKEN = "bootstrap.kubernetes.io/token"
 
 
 @dataclass
+class Event:
+    """core/v1 Event (the user-visible record kubectl get events shows):
+    involved object + reason/note with series counting. The in-process
+    EventRecorder (utils/events.py) persists these through the store when
+    wired with one (events/event_broadcaster.go writes through the Events
+    API the same way)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: str = ""  # "ns/name" of the object the event is about
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"       # Normal | Warning
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+    reporting_controller: str = ""
+
+
+@dataclass
 class RuntimeClass:
     """node.k8s.io/v1 RuntimeClass: handler selection + pod overhead; the
     RuntimeClass admission plugin defaults spec.overhead from it."""

@@ -957,6 +957,39 @@ class DenyServiceExternalIPs(AdmissionPlugin):
             raise AdmissionError(self.name, "may not add externalIPs")
 
 
+class EventRateLimit(AdmissionPlugin):
+    """plugin/pkg/admission/eventratelimit (default-off): token-bucket
+    limits on Event API writes per namespace, so a crash-looping component
+    cannot flood the store (the reference's Namespace-type limit)."""
+
+    name = "EventRateLimit"
+
+    def __init__(self, qps: float = 50.0, burst: int = 100, now_fn=None):
+        import time as _time
+
+        self.qps = qps
+        self.burst = burst
+        self.now_fn = now_fn or _time.monotonic
+        self._buckets: Dict[str, Tuple[float, float]] = {}  # ns -> (tokens, last)
+
+    def validate(self, store, kind: str, obj) -> None:
+        if kind != "Event":
+            return
+        ns = obj.meta.namespace
+        now = self.now_fn()
+        tokens, last = self._buckets.get(ns, (float(self.burst), now))
+        tokens = min(float(self.burst), tokens + (now - last) * self.qps)
+        if tokens < 1.0:
+            raise AdmissionError(
+                self.name, f"event rate limit exceeded for namespace {ns!r}")
+        self._buckets[ns] = (tokens - 1.0, now)
+
+    def validate_update(self, store, kind: str, old, obj) -> None:
+        # series count bumps consume the same budget (the reference limits
+        # all Event requests, not just creates)
+        self.validate(store, kind, obj)
+
+
 class DefaultIngressClass(AdmissionPlugin):
     """plugin/pkg/admission/network/defaultingressclass: an Ingress created
     without ingressClassName gets the cluster default (the IngressClass
@@ -1003,7 +1036,7 @@ def all_ordered_plugins() -> List[AdmissionPlugin]:
             ServiceAccountAdmission(), NodeRestriction(),
             TaintNodesByCondition(), AlwaysPullImages(), PodSecurity(),
             PodNodeSelector(), DefaultPriority(), DefaultTolerationSeconds(),
-            ExtendedResourceToleration(), DefaultStorageClass(),
+            EventRateLimit(), ExtendedResourceToleration(), DefaultStorageClass(),
             StorageObjectInUseProtection(),
             OwnerReferencesPermissionEnforcement(),
             PersistentVolumeClaimResize(), RuntimeClassAdmission(),

@@ -80,6 +80,7 @@ RESOURCES = {
     ("apis/node.k8s.io/v1", "runtimeclasses"): "RuntimeClass",
     ("apis/networking.k8s.io/v1", "ingresses"): "Ingress",
     ("apis/networking.k8s.io/v1", "ingressclasses"): "IngressClass",
+    ("api/v1", "events"): "Event",
 }
 
 _KIND_TYPES = {kind: getattr(api_types, kind) for (_g, _p), kind in RESOURCES.items()}

@@ -139,6 +139,7 @@ class ClusterStore:
         self.runtime_classes: Dict[str, object] = {}
         self.ingresses: Dict[str, object] = {}
         self.ingress_classes: Dict[str, object] = {}
+        self.events: Dict[str, object] = {}
         self.hpas: Dict[str, object] = {}
         self.cluster_roles: Dict[str, object] = {}
         self.cluster_role_bindings: Dict[str, object] = {}
@@ -346,6 +347,7 @@ class ClusterStore:
                 "RuntimeClass": self.runtime_classes,
                 "Ingress": self.ingresses,
                 "IngressClass": self.ingress_classes,
+                "Event": self.events,
                 "HorizontalPodAutoscaler": self.hpas,
                 "ClusterRole": self.cluster_roles,
                 "ClusterRoleBinding": self.cluster_role_bindings,

@@ -224,9 +224,9 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
     # (spread min-match, IPA totals, score normalization) psum/pmax across
     # shards. The general domain-aggregating mode keeps the scan on a mesh
     # (its segment tables are domain-global).
-    assert axis_name is None or gen is None, \
-        "sharded speculative decode covers the off and hostname topology " \
-        "modes (the general domain-aggregating mode keeps the scan on a mesh)"
+    # every topology mode shards: node-axis state is local; domain tables
+    # psum to a replicated global view (_seg_pc); per-pod decisions are
+    # made globally consistent below
     if slot_offset is None:
         slot_offset = np.int32(0)
     shard_axis = (lax.axis_index(axis_name).astype(jnp.int32)
@@ -245,6 +245,20 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
             return local_vals
         return _gsum(jnp.where(mine, local_vals, jnp.zeros((), dtype)),
                      axis_name)
+
+    def _gdom_of_choice(dom_table, local_choice, mine):
+        """[T, P]: domain id of each pod's CHOSEN node. dom_table's node
+        axis is shard-local, so the owner shard gathers and the result
+        psums to every shard (the general mode's term-commit scatter and
+        deferral matrices must see the same global domains everywhere)."""
+        T = dom_table.shape[0]
+        local = jnp.take_along_axis(
+            dom_table,
+            jnp.broadcast_to(local_choice[None, :], (T, local_choice.shape[0])),
+            axis=1)
+        if axis_name is None:
+            return local
+        return _gsum(jnp.where(mine[None, :], local, 0), axis_name)
 
     def _global_argmax(eff):
         """Per-pod argmax over the GLOBAL node axis: (choice in global slot
@@ -333,11 +347,13 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
 
         def _seg_pc(values, dom):
             """[P, C, N] values segment-summed by [P, C, N] domain ids →
-            [P, C, Vd] (the per-pod batched _seg_sum)."""
+            [P, C, Vd] (the per-pod batched _seg_sum). Under shard_map the
+            node axis is a local slice, so the per-domain sums psum across
+            shards — the result is the GLOBAL domain table, replicated."""
             seg = jax.vmap(jax.vmap(
                 lambda v, d: jax.ops.segment_sum(v, d, num_segments=vd)))(
                     values, dom)
-            return seg
+            return _gsum(seg, axis_name)
 
 
     def topo_eval(sel_view, term_view, rival, active):
@@ -529,7 +545,8 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         pres = _seg_pc(jnp.broadcast_to(
             base_mask[:, None, :], dom_ss.shape).astype(jnp.int32), dom_ss) > 0
         sz = jnp.sum(pres.astype(jnp.int32), axis=2)             # [P, C]
-        n_base = jnp.sum(base_mask.astype(jnp.int32), axis=1)    # [P]
+        n_base = _gsum(jnp.sum(base_mask.astype(jnp.int32), axis=1),
+                       axis_name)                                # [P] global
         sz = jnp.where(tbx["ss_hostname"], n_base[:, None], sz)
         w = jnp.log(sz.astype(jnp.float32) + 2.0)                # [P, C]
         elig = (valid_n[None, :] & affinity_ok
@@ -686,9 +703,7 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
             # commit must wait a round. add_term[t, j] = does accepted j's
             # commit add term t at a keyed domain; interaction = pod i's
             # anti-match or symmetric-score weight on that term.
-            dcol = jnp.take_along_axis(
-                dom_t, jnp.broadcast_to(choice[None, :], (dom_t.shape[0], P)),
-                axis=1)                                          # [T, P]
+            dcol = _gdom_of_choice(dom_t, local_choice, mine)    # [T, P]
             add_term = (term_mask_f.T * (dcol > 0)
                         * accepted[None, :].astype(jnp.int32))   # [T, P]
             m_int = tbx["term_filter_match"].astype(jnp.int32)   # [P, T]
@@ -745,12 +760,14 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
             # seg_exist: each finalized pod's terms land at its node's
             # domains (topology.commit_update's dom_col scatter, batched)
             T = dom_t.shape[0]
-            dcol_f = jnp.take_along_axis(
-                dom_t, jnp.broadcast_to(choice[None, :], (T, P)), axis=1)  # [T,P]
-            add_f = (term_mask_f.T * (dcol_f > 0)
+            # the [T, Vd] seg table is REPLICATED: every shard must apply
+            # the identical scatter. Reuse the deferral block's dcol — its
+            # inputs (local_choice, mine) are unchanged, and recomputing
+            # would pay the [T, P] gather + cross-shard psum twice per round
+            add_f = (term_mask_f.T * (dcol > 0)
                      * accepted[None, :].astype(jnp.int32))      # [T, P]
             t_iota = jnp.arange(T, dtype=jnp.int32)[:, None]
-            term_dyn = term_dyn.at[t_iota, dcol_f].add(add_f)
+            term_dyn = term_dyn.at[t_iota, dcol].add(add_f)
         final = accepted | failing
         out_idx = jnp.where(accepted, choice, out_idx)
         best_sel = _gpick(
@@ -922,8 +939,6 @@ def schedule_batch_core(
         # real mesh); sequential parity proven per-round by the
         # prefix-stability acceptance
         assert topo_mode in ("off", "host", "general") and sample_k is None
-        assert axis_name is None or topo_mode in ("off", "host"), \
-            "sharded speculative decode covers the off and hostname modes"
         host_args = gen_args = None
         if topo_mode == "host":
             seg0 = tc.term_counts                      # [T, N] per-node counts

@@ -37,6 +37,10 @@ GETTABLE = {
     "secrets": "Secret", "secret": "Secret",
     "certificatesigningrequests": "CertificateSigningRequest",
     "csr": "CertificateSigningRequest",
+    "runtimeclasses": "RuntimeClass", "runtimeclass": "RuntimeClass",
+    "ingresses": "Ingress", "ingress": "Ingress", "ing": "Ingress",
+    "ingressclasses": "IngressClass", "ingressclass": "IngressClass",
+    "events": "Event", "event": "Event", "ev": "Event",
     "serviceaccounts": "ServiceAccount", "serviceaccount": "ServiceAccount",
     "sa": "ServiceAccount",
     "poddisruptionbudgets": "PodDisruptionBudget", "pdb": "PodDisruptionBudget",

@@ -189,10 +189,19 @@ HEADERS: Dict[str, List[str]] = {
 
 
 def header_for(kind: str) -> List[str]:
+    if kind == "Event":
+        return ["LAST SEEN", "TYPE", "REASON", "OBJECT", "MESSAGE"]
     return HEADERS.get(kind, ["NAME"])
 
 
 def columns_for(kind: str, obj, store) -> List[str]:
+    if kind == "Event":
+        import time as _t
+
+        age = max(0, int(_t.time() - (obj.last_timestamp or 0)))
+        last = f"{age}s" if obj.last_timestamp else "<unknown>"
+        msg = obj.message if obj.count <= 1 else f"{obj.message} (x{obj.count})"
+        return [last, obj.type, obj.reason, obj.involved_object, msg]
     if kind == "Pod":
         return [obj.meta.name, obj.status.phase, obj.spec.node_name or "<none>"]
     if kind == "Node":

@@ -27,12 +27,29 @@ def default_node(i: int) -> Node:
 class HollowCluster:
     def __init__(self, store: ClusterStore, n_nodes: int,
                  node_fn: Callable[[int], Node] = default_node,
-                 now_fn=time.monotonic, startup_delay: float = 0.0):
+                 now_fn=time.monotonic, startup_delay: float = 0.0,
+                 with_runtime: bool = False,
+                 with_volume_manager: bool = False):
+        """``with_runtime``: each hollow kubelet gets its own
+        FakeRuntimeService + PLEG (the hollow-node.go injected-CRI mode);
+        ``with_volume_manager``: PVC mounts gate Pending→Running (attach
+        treated as instant — kubemark has no attachdetach controller)."""
         self.store = store
-        self.kubelets: List[HollowKubelet] = [
-            HollowKubelet(store, node_fn(i), now_fn=now_fn, startup_delay=startup_delay)
-            for i in range(n_nodes)
-        ]
+        self.kubelets: List[HollowKubelet] = []
+        for i in range(n_nodes):
+            runtime = None
+            if with_runtime:
+                from .cri import FakeRuntimeService
+
+                runtime = FakeRuntimeService(now_fn=now_fn)
+            k = HollowKubelet(store, node_fn(i), now_fn=now_fn,
+                              startup_delay=startup_delay, runtime=runtime)
+            if with_volume_manager:
+                from .volume_manager import VolumeManager
+
+                k.volume_manager = VolumeManager(store, k.node_name,
+                                                 require_attach=False)
+            self.kubelets.append(k)
 
     def register_all(self) -> None:
         for k in self.kubelets:

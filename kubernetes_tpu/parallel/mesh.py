@@ -74,20 +74,22 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
                              topo_enabled: bool = True,
                              spec_decode: bool = False,
                              topo_mode: Optional[str] = None,
-                             host_key: int = 0):
+                             host_key: int = 0,
+                             vd_override: Optional[int] = None):
     """Compile schedule_batch over the mesh: node axis sharded, pods/exprs
     replicated, results replicated (winner slots are global indices).
 
     ``spec_decode`` runs the speculative decide/repair rounds instead of the
-    P-step scan — supported under sharding for the topology-off program AND
-    the hostname fast path (``topo_mode="host"`` + the hostname label's
-    ``host_key`` slot); the general domain-aggregating mode keeps the scan.
-    In host mode the seg_exist carry slot holds the node-sharded [T, N]
-    per-node term counts, so its out_spec shards with the node axis."""
+    P-step scan — supported under sharding for EVERY topology mode:
+    topology-off, the hostname fast path (``topo_mode="host"`` + the
+    hostname label's ``host_key`` slot), and the general domain-aggregating
+    mode (``vd_override`` bounds the domain axis). In host mode the
+    seg_exist carry slot holds the node-sharded [T, N] per-node term
+    counts, so its out_spec shards with the node axis; the general mode's
+    [T, Vd] domain table stays replicated (every shard applies identical
+    psum'd updates)."""
     if topo_mode is None:
         topo_mode = "general" if topo_enabled else "off"
-    assert not (spec_decode and topo_mode == "general"), \
-        "sharded speculative decode covers the off and hostname modes"
     wk = tuple(sorted((weights or DEFAULT_WEIGHTS).items()))
     import dataclasses
 
@@ -123,7 +125,8 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
     body = functools.partial(schedule_batch_core, weights_key=wk,
                              topo_enabled=topo_enabled, axis_name=AXIS,
                              num_shards=mesh.size, spec_decode=spec_decode,
-                             topo_mode=topo_mode, host_key=host_key)
+                             topo_mode=topo_mode, host_key=host_key,
+                             vd_override=vd_override)
     sharded = jax.shard_map(
         body, mesh=mesh,
         in_specs=(pb_spec, et_spec, nt_spec, tc_spec, tb_spec, P()),

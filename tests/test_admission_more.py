@@ -149,7 +149,7 @@ class TestDefaultOffFamily:
 
     def test_all_ordered_roster_instantiates(self):
         names = [p.name for p in all_ordered_plugins()]
-        assert len(names) == len(set(names)) == 30
+        assert len(names) == len(set(names)) == 31
         assert names[0] == "AlwaysAdmit" and names[-1] == "AlwaysDeny"
 
     def test_security_context_deny_catches_root_uid_zero(self):
@@ -219,3 +219,38 @@ class TestDefaultIngressClass:
                                 annotations={ANNOTATION_DEFAULT_INGRESS_CLASS: "true"})))
         with pytest.raises(AdmissionError, match="multiple IngressClasses"):
             store.create_object("Ingress", Ingress(meta=ObjectMeta(name="web")))
+
+
+class TestEventsThroughStore:
+    def test_recorder_persists_and_dedups(self):
+        from kubernetes_tpu.utils.events import EventRecorder
+
+        store = ClusterStore()
+        rec = EventRecorder(store=store, reporting_controller="default-scheduler")
+        rec.eventf("default/web", "Warning", "FailedScheduling", "Scheduling",
+                   "no feasible node")
+        rec.eventf("default/web", "Warning", "FailedScheduling", "Scheduling",
+                   "no feasible node")  # series bump, not a second object
+        events = list(store.events.values())
+        assert len(events) == 1
+        assert events[0].count == 2
+        assert events[0].involved_object == "default/web"
+        from kubernetes_tpu.kubectl.cli import kubectl
+
+        out = kubectl(store, "get events")
+        assert "FailedScheduling" in out and "(x2)" in out
+
+    def test_event_rate_limit(self):
+        from kubernetes_tpu.api.types import Event as APIEvent
+        from kubernetes_tpu.apiserver.admission import EventRateLimit
+
+        clock = [0.0]
+        plugin = EventRateLimit(qps=1.0, burst=2, now_fn=lambda: clock[0])
+        chain = AdmissionChain(plugins=[plugin])
+        store = ClusterStore()
+        for i in range(2):
+            chain.run(store, "Event", APIEvent(meta=ObjectMeta(name=f"e{i}")))
+        with pytest.raises(AdmissionError, match="rate limit"):
+            chain.run(store, "Event", APIEvent(meta=ObjectMeta(name="e3")))
+        clock[0] += 2.0  # refill
+        chain.run(store, "Event", APIEvent(meta=ObjectMeta(name="e4")))

@@ -360,3 +360,35 @@ spec: {containers: [{name: a, image: a, resources: {requests: {cpu: 100m}}}]}
         k.run_once()
         phases = sorted(p.status.phase for p in store.pods.values())
         assert phases.count("Failed") == 2 and phases.count("Running") == 2
+
+
+def test_hollow_cluster_with_runtime_and_volumes():
+    """kubemark modes: per-kubelet fake CRI + PLEG, and instant-attach
+    volume manager gating PVC pods."""
+    from kubernetes_tpu.api.types import ObjectMeta, PersistentVolume, PersistentVolumeClaim
+    from kubernetes_tpu.kubelet.kubemark import HollowCluster
+
+    store = ClusterStore()
+    cluster = HollowCluster(store, n_nodes=4, with_runtime=True,
+                            with_volume_manager=True)
+    cluster.register_all()
+    store.create_pv(PersistentVolume(meta=ObjectMeta(name="pv1"),
+                                     capacity_bytes=1 << 30,
+                                     bound_pvc="default/c1"))
+    store.create_pvc(PersistentVolumeClaim(meta=ObjectMeta(name="c1"),
+                                           bound_pv="pv1"))
+    plain = make_pod("plain").req({"cpu": "1"}).obj()
+    plain.spec.node_name = "hollow-node-0"
+    store.create_pod(plain)
+    claimed = make_pod("claimed").req({"cpu": "1"}).pvc("c1").obj()
+    claimed.spec.node_name = "hollow-node-1"
+    store.create_pod(claimed)
+    cluster.settle()
+    assert store.get_pod("default/plain").status.phase == "Running"
+    assert store.get_pod("default/claimed").status.phase == "Running"
+    # the runtime really materialized sandboxes + containers
+    k0 = cluster.kubelet_for("hollow-node-0")
+    assert k0.runtime is not None
+    assert any(c["state"] == "CONTAINER_RUNNING"
+               for c in k0.runtime.containers.values())
+    assert k0.pleg is not None and k0.pleg.healthy()

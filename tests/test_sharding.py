@@ -291,3 +291,35 @@ def test_sharded_spec_decode_hostname_mode_matches_scan(monkeypatch):
     idx = np.asarray(spec.node_idx)
     anti = [idx[i] for i in range(16) if i % 2 == 0 and idx[i] >= 0]
     assert len(anti) == len(set(anti))
+
+
+def test_sharded_spec_decode_general_mode_matches_scan(monkeypatch):
+    """Sharded speculative decode on the GENERAL domain-aggregating mode
+    (zone-keyed spread + inter-pod affinity: several nodes per domain, so
+    the segment tables psum to a replicated global view and the term
+    commits scatter identically on every shard) — exact parity with the
+    single-device scan."""
+    monkeypatch.setenv("KTPU_SPEC", "1")
+    enc, nt, pb, et, tc, tb = build_inputs(n_nodes=48, n_pods=16, topo=True)
+    key = jax.random.PRNGKey(21)
+    scan = schedule_batch(pb, et, nt, tc, tb, key, topo_enabled=True,
+                          spec_decode=False)
+
+    mesh = make_node_mesh()
+    fn = make_sharded_schedule_fn(mesh, topo_enabled=True, spec_decode=True,
+                                  topo_mode="general")
+    spec = fn(pb, et, shard_node_tensors(nt, mesh),
+              shard_topo_counts(tc, mesh), tb, key)
+
+    assert np.array_equal(np.asarray(scan.node_idx), np.asarray(spec.node_idx)), (
+        np.asarray(scan.node_idx), np.asarray(spec.node_idx))
+    assert np.array_equal(np.asarray(scan.any_feasible),
+                          np.asarray(spec.any_feasible))
+    np.testing.assert_allclose(np.asarray(scan.best_score),
+                               np.asarray(spec.best_score), atol=1e-4)
+    # evolved carries identical: node-sharded sel counts + the replicated
+    # [T, Vd] domain table every shard must agree on
+    np.testing.assert_array_equal(np.asarray(scan.final_sel_counts),
+                                  np.asarray(spec.final_sel_counts))
+    np.testing.assert_array_equal(np.asarray(scan.final_seg_exist),
+                                  np.asarray(spec.final_seg_exist))

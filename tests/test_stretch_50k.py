@@ -71,3 +71,34 @@ def test_50k_slots_sharded_program():
     assert (idx < n_nodes).all()
     # distinct winners: 64 pods over 50k empty nodes never need to share
     assert len(set(int(i) for i in idx)) == 64
+
+
+@pytest.mark.slow
+def test_50k_slots_sharded_speculative_decode():
+    """The flagship SPECULATIVE program at 65536 slots over the 8-device
+    mesh: the decide/repair rounds must match the sharded scan at stretch
+    scale (BASELINE config 5's node-axis long-context analog)."""
+    assert len(jax.devices()) == 8
+    n_nodes, cap = 50000, 65536
+    infos = [
+        NodeInfo(make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 32}).obj())
+        for i in range(n_nodes)
+    ]
+    enc = ClusterEncoder(Capacities(
+        nodes=cap, pods=64, value_words=(cap + 34) // 32))
+    sig = SigTable(enc)
+    nt = enc.encode_snapshot(infos)
+    pods = [make_pod(f"p{i}").req({"cpu": "2", "memory": "2Gi"}).obj() for i in range(64)]
+    pb, et = enc.encode_pods(pods)
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
+
+    mesh = make_node_mesh()
+    nts = shard_node_tensors(nt, mesh)
+    tcs = shard_topo_counts(tc, mesh)
+    key = jax.random.PRNGKey(7)
+    scan = make_sharded_schedule_fn(mesh, topo_enabled=False)(
+        pb, et, nts, tcs, tb, key)
+    spec = make_sharded_schedule_fn(mesh, topo_enabled=False, spec_decode=True)(
+        pb, et, nts, tcs, tb, key)
+    assert np.array_equal(np.asarray(scan.node_idx), np.asarray(spec.node_idx))
