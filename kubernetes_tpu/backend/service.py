@@ -195,13 +195,12 @@ class DeviceService:
             screen = best = None
             if any(int(node_idx[i]) < 0 for i in range(len(pods))):
                 try:
-                    from ..ops.preempt import preempt_screen
+                    from ..ops.preempt import screen_prefix
 
                     self.device._refresh_class_prio()
-                    failed = np.zeros(pb.capacity, bool)
-                    failed[:len(pods)] = node_idx[:len(pods)] < 0
-                    pres = preempt_screen(pb, self.device.nt,
-                                          result.static_masks, failed)
+                    pres = screen_prefix(pb, self.device.nt,
+                                         result.static_masks,
+                                         node_idx[:len(pods)] < 0)
                     screen = np.asarray(pres.screen)
                     best = np.asarray(pres.best)
                 except Exception:  # noqa: BLE001 — hints are optional

@@ -702,15 +702,13 @@ class TPUScheduler(Scheduler):
             int(node_idx[i]) < 0 for i in range(len(qps))
         ) and self._preemption_wired():
             try:
-                from ..ops.preempt import preempt_screen
+                from ..ops.preempt import screen_prefix
 
                 # a priority class first seen this cycle is still INT_MAX on
                 # device (= never evictable) unless refreshed now
                 self.device._refresh_class_prio()
-                failed = np.zeros(pb.capacity, bool)
-                failed[:len(qps)] = node_idx[:len(qps)] < 0
-                pres = preempt_screen(pb, self.device.nt, result.static_masks,
-                                      failed)
+                pres = screen_prefix(pb, self.device.nt, result.static_masks,
+                                     node_idx[:len(qps)] < 0)
                 from ..utils import relay
 
                 relay.count_sync("preempt-read")
@@ -931,6 +929,20 @@ class TPUScheduler(Scheduler):
                     **common)
                 np.asarray(res3.node_idx)
                 warmed += 1
+            if self._preemption_wired() and res.static_masks:
+                # failure-path program: the preemption screen compiles on
+                # the first batch with failures — a workload whose failures
+                # only appear mid-measure (Unschedulable) would pay it
+                # inside the window otherwise
+                try:
+                    from ..ops.preempt import screen_prefix
+
+                    pres = screen_prefix(pb, self.device.nt, res.static_masks,
+                                         np.ones(len(warm_slice), bool))
+                    np.asarray(pres.best)
+                    warmed += 1
+                except Exception:  # noqa: BLE001 — warm-only optimization
+                    pass
         self._calibrate_sizer(timings)
         return warmed
 

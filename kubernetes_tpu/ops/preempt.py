@@ -45,6 +45,18 @@ class PreemptResult(NamedTuple):
 
 
 @functools.partial(jax.jit, static_argnames=())
+def screen_prefix(pb, nt, static_masks, failed_prefix):
+    """Pad an [n]-bool per-pod failure prefix to pb.capacity and run the
+    screen — the ONE construction every caller (batch commit, wire service,
+    bucket warmup) must share, so a signature or mask-convention change
+    lands everywhere at once."""
+    import numpy as _np
+
+    failed = _np.zeros(pb.capacity, bool)
+    failed[: len(failed_prefix)] = failed_prefix
+    return preempt_screen(pb, nt, static_masks, failed)
+
+
 def preempt_screen(pb: PodBatch, nt: NodeTensors, static_masks,
                    failed: jax.Array) -> PreemptResult:
     """``static_masks``: the batch's static filter masks [P,N] (unschedulable,

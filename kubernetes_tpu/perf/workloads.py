@@ -204,8 +204,13 @@ def mixed_scheduling_base_pod(nodes=5000, init_pods=2000, measured=1000) -> dict
         "ops": [
             {"opcode": "createNodes", "count": nodes, "labels": node_labels},
             {"opcode": "createPods", "count": init_pods, "prefix": "base", **base},
+            # required affinity rides the ZONE key (pod-with-pod-affinity.yaml
+            # topologyKey: topology.kubernetes.io/zone; every node is zone1) —
+            # on the hostname key the wave deadlocks once the first blue
+            # node fills (only blue-hosting nodes are feasible, exactly as
+            # in the reference semantics)
             {"opcode": "createPods", "count": init_pods, "prefix": "aff", **base,
-             "pod_affinity_key": "kubernetes.io/hostname",
+             "pod_affinity_key": "topology.kubernetes.io/zone",
              "pod_affinity_labels": {"color": "blue"}},
             {"opcode": "createPods", "count": init_pods, "prefix": "anti", **base,
              "pod_affinity_key": "kubernetes.io/hostname",
