@@ -165,7 +165,8 @@ def _resource_scores(alloc2: jax.Array, nz_total: jax.Array):
 def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
                       affinity_raw, image_score, pod_bits, jitter,
                       sel0, seg0, host=None, gen=None,
-                      axis_name=None, slot_offset=None) -> BatchResult:
+                      axis_name=None, slot_offset=None,
+                      ports_enabled: bool = True) -> BatchResult:
     """Speculative decode for non-topology batches (ROADMAP r3 perf 2).
 
     The scan commits one pod per step — P dependent steps whose per-step
@@ -587,8 +588,15 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         free = alloc[None, :, :] - req_dyn[None, :, :]          # broadcast [P]
         fit = jnp.all((pb.req[:, None, :] <= free) | (pb.req[:, None, :] == 0),
                       axis=-1)                                   # [P, N]
-        conflict = jnp.any(port_dyn[None, :, :] & pod_bits[:, None, :], axis=-1)
-        ports = ~conflict
+        if ports_enabled:
+            conflict = jnp.any(port_dyn[None, :, :] & pod_bits[:, None, :],
+                               axis=-1)
+            ports = ~conflict
+        else:
+            # no pod in the batch wants a host port: the [P, N, W] conflict
+            # tensor (the single largest intermediate in the round) is a
+            # constant — skip it at trace time
+            ports = jnp.ones(fit.shape, bool)
         nz = nz_dyn[None, :, :2].astype(jnp.float32) \
             + pb.nonzero_req[:, None, :2].astype(jnp.float32)    # [P, N, 2]
         least_alloc, balanced = _resource_scores(alloc_f[None, :, :2], nz)
@@ -667,10 +675,15 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         d_req = jnp.sum(onehot[:, :, None] * pb.req[:, None, :], axis=0)
         d_nz = jnp.sum(onehot[:, :, None] * pb.nonzero_req[:, None, :], axis=0)
         committed_any = jnp.any(onehot, axis=0)                  # [N]
-        d_ports = jnp.sum(jnp.where(onehot[:, :, None], pod_bits[:, None, :], 0),
-                          axis=0).astype(jnp.uint32)
+        if ports_enabled:
+            d_ports = jnp.sum(
+                jnp.where(onehot[:, :, None], pod_bits[:, None, :], 0),
+                axis=0).astype(jnp.uint32)
+            port_mixed = port_dyn | d_ports
+        else:
+            port_mixed = port_dyn
         fit2, ports2, la2, bal2 = components(
-            req_dyn + d_req, nz_dyn + d_nz, port_dyn | d_ports)
+            req_dyn + d_req, nz_dyn + d_nz, port_mixed)
         rival = committed_any[None, :] & (win[None, :] < iota_p[:, None])
         topo_on = host is not None or gen is not None
         if topo_on:
@@ -747,9 +760,10 @@ def _speculative_core(pb, nt, weights, static_ok, static_ff, taint_raw,
         req_dyn = req_dyn + jnp.sum(onehot[:, :, None] * pb.req[:, None, :], axis=0)
         nz_dyn = nz_dyn + jnp.sum(onehot[:, :, None] * pb.nonzero_req[:, None, :],
                                   axis=0)
-        port_dyn = port_dyn | jnp.sum(
-            jnp.where(onehot[:, :, None], pod_bits[:, None, :], 0),
-            axis=0).astype(jnp.uint32)
+        if ports_enabled:
+            port_dyn = port_dyn | jnp.sum(
+                jnp.where(onehot[:, :, None], pod_bits[:, None, :], 0),
+                axis=0).astype(jnp.uint32)
         if host is not None:
             onehot_i = onehot.astype(jnp.int32)
             sel_dyn = sel_dyn + jnp.einsum("ps,pn->sn", sig_mask_f, onehot_i)
@@ -847,6 +861,7 @@ def schedule_batch_core(
     vd_override: Optional[int] = None,
     host_key: int = 0,
     spec_decode: bool = False,
+    ports_enabled: bool = True,
 ) -> BatchResult:
     """The traceable body; nt's node axis may be a shard (axis_name set).
     ``topo_enabled`` is a trace-time flag: batches with no spread constraints,
@@ -964,7 +979,8 @@ def schedule_batch_core(
             pb, nt, weights, static_ok, static_ff, taint_raw,
             affinity_raw, image_score, pod_bits, jitter, sel0_, seg0_,
             host=host_args, gen=gen_args,
-            axis_name=axis_name, slot_offset=slot_offset)
+            axis_name=axis_name, slot_offset=slot_offset,
+            ports_enabled=ports_enabled)
         return result._replace(static_masks=static_masks)
 
     if pallas is not None:
@@ -1022,15 +1038,26 @@ def schedule_batch_core(
         )
 
     def step(carry, xs):
-        req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist, samp_start = carry
+        # free_dyn = allocatable - requested is carried directly (the sub
+        # would otherwise be a full [N, R] pass per step) and the fit test
+        # folds the `req == 0 always fits` rule into a per-pod sentinel
+        # (p_req_gate), halving the fit chain's [N, R] passes. The nonzero-
+        # requested carry holds only the two scored columns; the full [N, R]
+        # tensor is rebuilt in ONE post-scan scatter (like f_class below).
+        free_dyn, nz2_dyn, port_dyn, sel_counts, seg_exist, samp_start = carry
         row = xs["row"]
-        (p_req, p_nz, p_static_ok, p_affinity_ok, p_taint, p_aff, p_img, p_bits,
-         p_jitter, p_valid, p_sff, p_nom) = row
+        (p_req, p_req_gate, p_nz, p_static_ok, p_affinity_ok, p_taint, p_aff,
+         p_img, p_bits, p_jitter, p_valid, p_sff, p_nom) = row
 
-        free = nt.allocatable - req_dyn                           # [N, R]
-        fit_ok = jnp.all((p_req[None, :] <= free) | (p_req[None, :] == 0), axis=-1)
-        conflict = jnp.any(port_dyn & p_bits[None, :], axis=-1)
-        ports_ok = ~conflict
+        fit_ok = jnp.all(free_dyn >= p_req_gate[None, :], axis=-1)
+        if ports_enabled:
+            conflict = jnp.any(port_dyn & p_bits[None, :], axis=-1)
+            ports_ok = ~conflict
+        else:
+            # no pod in the batch wants a host port: skip the [N, Wport]
+            # conflict pass AND the carry update below — the port carry then
+            # passes through the scan unchanged (aliased, zero traffic)
+            ports_ok = ones_pn
 
         if topo_mode == "host":
             tbx = xs["tb"]
@@ -1085,8 +1112,8 @@ def schedule_batch_core(
 
         # resource scores against the evolving requested state (shared
         # formula with the speculative path: _resource_scores)
-        nz_req = nz_dyn.astype(jnp.float32) + p_nz[None, :].astype(jnp.float32)
-        least_alloc, balanced = _resource_scores(alloc_f[:, :2], nz_req[:, :2])
+        nz_req = (nz2_dyn + p_nz[None, :2]).astype(jnp.float32)
+        least_alloc, balanced = _resource_scores(alloc_f[:, :2], nz_req)
 
         total = (
             weights["NodeResourcesFit"] * least_alloc
@@ -1135,9 +1162,11 @@ def schedule_batch_core(
         # scatter costs ~200µs of fixed overhead per scan step on this TPU,
         # while the [N,·] masked adds fuse into the surrounding step
         onehot_n = (jnp.arange(N, dtype=jnp.int32) == local_idx) & commit  # [N]
-        req_dyn = req_dyn + onehot_n[:, None] * p_req[None, :]
-        nz_dyn = nz_dyn + onehot_n[:, None] * p_nz[None, :]
-        port_dyn = jnp.where(onehot_n[:, None], port_dyn | p_bits[None, :], port_dyn)
+        free_dyn = free_dyn - onehot_n[:, None] * p_req[None, :]
+        nz2_dyn = nz2_dyn + onehot_n[:, None] * p_nz[None, :2]
+        if ports_enabled:
+            port_dyn = jnp.where(onehot_n[:, None], port_dyn | p_bits[None, :],
+                                 port_dyn)
         if topo_mode == "host":
             sel_counts, seg_exist = topology.commit_update_host(
                 sel_counts, seg_exist, local_idx, any_feasible, mine,
@@ -1153,14 +1182,16 @@ def schedule_batch_core(
         if topo_enabled:
             ff = jnp.where((ff == 0) & ~spread_ok, np.int8(7), ff)
             ff = jnp.where((ff == 0) & ~ipa_ok, np.int8(8), ff)
-        return (req_dyn, nz_dyn, port_dyn, sel_counts, seg_exist, samp_start), (
+        return (free_dyn, nz2_dyn, port_dyn, sel_counts, seg_exist, samp_start), (
             out_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok, ff,
         )
 
+    # `req == 0 always fits` as a sentinel so fit is one compare+reduce
+    req_gate = jnp.where(pb.req == 0, jnp.int32(-(2 ** 30)), pb.req)
     rows = (
-        pb.req, pb.nonzero_req, static_ok, static_masks["NodeAffinity"], taint_raw,
-        affinity_raw, image_score, pod_bits, jitter, pb.valid, static_ff,
-        pb.nominated,
+        pb.req, req_gate, pb.nonzero_req, static_ok, static_masks["NodeAffinity"],
+        taint_raw, affinity_raw, image_score, pod_bits, jitter, pb.valid,
+        static_ff, pb.nominated,
     )
     xs = {"row": rows}
     if topo_mode == "host":
@@ -1174,14 +1205,18 @@ def schedule_batch_core(
     sel0, seg0 = (tc.sel_counts, seg_exist0) if topo_carry is None else topo_carry
     start0 = (jnp.asarray(sample_start, jnp.int32) if sample_start is not None
               else jnp.zeros((), jnp.int32))
-    carry0 = (nt.requested, nt.nonzero_requested, nt.port_bits, sel0, seg0, start0)
+    carry0 = (nt.allocatable - nt.requested, nt.nonzero_requested[:, :2],
+              nt.port_bits, sel0, seg0, start0)
     final_carry, (node_idx, best, any_feasible, fit_ok, ports_ok, spread_ok, ipa_ok, first_fail) = lax.scan(
         step, carry0, xs)
-    f_req, f_nz, f_port, f_sel, f_seg, f_start = final_carry
+    f_free, f_nz2, f_port, f_sel, f_seg, f_start = final_carry
+    f_req = nt.allocatable - f_free
 
     # evolve the priority-class table by the batch's commits in ONE post-scan
     # scatter (no carry needed — nothing in-scan reads it); under shard_map
-    # each shard scatters only the winners inside its slot window
+    # each shard scatters only the winners inside its slot window. The full
+    # [N, R] nonzero-requested tensor is rebuilt the same way — in-scan only
+    # the two scored columns are carried.
     committed = node_idx >= 0
     if axis_name is None:
         in_window = committed
@@ -1191,6 +1226,8 @@ def schedule_batch_core(
         local_commit = jnp.where(in_window, node_idx - slot_offset, 0)
     f_class = nt.class_req.at[local_commit, pb.prio_class].add(
         jnp.where(in_window[:, None], pb.req, 0))
+    f_nz = nt.nonzero_requested.at[local_commit].add(
+        jnp.where(in_window[:, None], pb.nonzero_req, 0))
 
     return BatchResult(
         node_idx=node_idx,
@@ -1214,7 +1251,7 @@ def schedule_batch_core(
 
 @functools.partial(jax.jit, static_argnames=(
     "weights_key", "topo_enabled", "pallas", "topo_mode", "vd_override",
-    "host_key", "spec_decode"))
+    "host_key", "spec_decode", "ports_enabled"))
 def schedule_batch(
     pb: PodBatch,
     et: ExprTable,
@@ -1232,12 +1269,14 @@ def schedule_batch(
     vd_override: Optional[int] = None,
     host_key: int = 0,
     spec_decode: bool = False,
+    ports_enabled: bool = True,
 ) -> BatchResult:
     return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
                                pallas=pallas, topo_carry=topo_carry,
                                sample_k=sample_k, sample_start=sample_start,
                                topo_mode=topo_mode, vd_override=vd_override,
-                               host_key=host_key, spec_decode=spec_decode)
+                               host_key=host_key, spec_decode=spec_decode,
+                               ports_enabled=ports_enabled)
 
 
 def spec_decode_eligible(sample_k) -> bool:
@@ -1271,7 +1310,7 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
 
     def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
            sample_k=None, sample_start=None, topo_mode=None, vd_override=None,
-           host_key=0):
+           host_key=0, ports_enabled=True):
         spec = spec_decode_eligible(sample_k)
         # the pallas fused step has no sampling emulation yet; the
         # speculative path replaces it where both apply (fewer device steps)
@@ -1282,6 +1321,6 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
                               topo_carry=topo_carry, sample_k=sample_k,
                               sample_start=sample_start, topo_mode=topo_mode,
                               vd_override=vd_override, host_key=host_key,
-                              spec_decode=spec)
+                              spec_decode=spec, ports_enabled=ports_enabled)
 
     return fn

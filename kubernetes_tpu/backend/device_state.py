@@ -365,8 +365,8 @@ class DeviceState:
 def caps_for_cluster(n_nodes: int, batch: int = 128) -> Capacities:
     """Pick static capacities for a cluster size (node-count buckets 1k/5k/...;
     hostname value vocab must cover every node)."""
-    nodes = 128
-    while nodes < n_nodes:
-        nodes *= 2
+    from ..ops.schema import round_node_capacity
+
+    nodes = round_node_capacity(n_nodes)
     value_words = max(32, (nodes + 2 + 31) // 32)  # hostname vocab ≥ node count
     return Capacities(nodes=nodes, pods=batch, value_words=value_words)

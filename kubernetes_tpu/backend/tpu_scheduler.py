@@ -524,6 +524,7 @@ class TPUScheduler(Scheduler):
                 topo_mode=topo_mode,
                 vd_override=vd_bucket,
                 host_key=host_key,
+                ports_enabled=self.device.encoder.last_has_ports,
             )
         if result.final_sample_start is not None:
             # keep the rotation index across unsampled batches too (the
@@ -606,11 +607,17 @@ class TPUScheduler(Scheduler):
 
             relay.count_sync("commit-read")  # THE one blocking read per batch
             with tracing.span("device.commit.wait", batch=len(fl.qps)):
+                t_wait0 = self.now_fn()
                 node_idx = np.asarray(fl.result.node_idx)
+                self.smetrics.device_batch_duration.observe(
+                    self.now_fn() - t_wait0, "commit_wait")
             self.device.adopt_commits(fl.result, fl.host_pb, node_idx)
             with tracing.span("host.commit", batch=len(fl.qps)):
+                t_host0 = self.now_fn()
                 self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0,
                                    node_idx, pb=fl.pb)
+                self.smetrics.device_batch_duration.observe(
+                    self.now_fn() - t_host0, "commit_host")
             # reconcile: the commits above advanced node generations; the
             # ELIDE-ONLY reconcile refreshes _uploaded_gen for rows whose
             # content matches the adopted mirror, so the next
@@ -622,8 +629,11 @@ class TPUScheduler(Scheduler):
             # dispatched batch (conservative direction: nodes look MORE
             # occupied), after which the break resyncs from host truth.
             if self.device is not None:
+                t_rec0 = self.now_fn()
                 self.cache.update_snapshot(self.snapshot)
                 self.device.reconcile(self.snapshot)
+                self.smetrics.device_batch_duration.observe(
+                    self.now_fn() - t_rec0, "commit_reconcile")
         except Exception as exc:  # noqa: BLE001 — backend death must not kill us
             import logging
 
@@ -906,10 +916,19 @@ class TPUScheduler(Scheduler):
             common = dict(adopt=False, topo_enabled=self.device.topo_enabled,
                           sample_k=sample_k, sample_start=sample_start,
                           topo_mode=topo_mode, vd_override=vd_bucket,
-                          host_key=host_key)
+                          host_key=host_key,
+                          ports_enabled=self.device.encoder.last_has_ports)
             res = self._run_batch_fn(pb, et, self.device.nt, self.device.tc,
                                      tb, np.int32(0), topo_carry=None, **common)
             np.asarray(res.node_idx)  # land compile + first execution
+            # ports_enabled is a static argname → two executables per bucket.
+            # Warm the variant the sample did NOT exercise too, so a batch
+            # whose port-bearing mix differs from the warm sample doesn't
+            # compile inside the measured window.
+            other = dict(common, ports_enabled=not common["ports_enabled"])
+            res_o = self._run_batch_fn(pb, et, self.device.nt, self.device.tc,
+                                       tb, np.int32(0), topo_carry=None, **other)
+            np.asarray(res_o.node_idx)
             warmed += 1
             # time a clean second execution: the calibration sample
             t0 = self.now_fn()

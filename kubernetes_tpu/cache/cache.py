@@ -200,10 +200,16 @@ class Cache:
         generation; O(changes) not O(nodes) (cache.go:198's generation-ordered
         list, realized as a dirty set). A snapshot older than the dirty-set
         horizon (e.g. a brand-new Snapshot) gets a full resync."""
+        from ..api.types import get_zone_key
+
         with self._lock:
             max_gen = snapshot.generation
             changed = False
             full = snapshot.generation < self._horizon()
+            # structural = node-set membership or a zone changed → the
+            # snapshot's cached interleave order must be rebuilt; pod-only
+            # churn (the batch commit path) keeps it (snapshot.py refresh_lists)
+            structural = full
             names = self.nodes.keys() if full else (self._dirty | self._removed)
             for name in names:
                 ni = self.nodes.get(name)
@@ -211,8 +217,14 @@ class Cache:
                     if name in snapshot.node_info_map:
                         del snapshot.node_info_map[name]
                         changed = True
+                        structural = True
                     continue
                 if ni.generation > snapshot.generation:
+                    if not structural:
+                        prev_zone = snapshot._zone_of.get(name)
+                        if (ni.node is None or prev_zone is None
+                                or get_zone_key(ni.node) != prev_zone):
+                            structural = True
                     snapshot.node_info_map[name] = ni.clone()
                     max_gen = max(max_gen, ni.generation)
                     changed = True
@@ -225,7 +237,7 @@ class Cache:
             self._removed.clear()
             self._sync_generation = max_gen
             if changed:
-                snapshot.refresh_lists()
+                snapshot.refresh_lists(structural=structural)
             snapshot.generation = max_gen
         return snapshot
 

@@ -118,6 +118,7 @@ class ClusterEncoder:
         self.node_slots: Dict[str, int] = {}          # node name -> slot
         self._free_slots: List[int] = []
         self._pod_templates: Dict[Tuple, _PodTemplate] = {}
+        self.last_has_ports = False                   # set by encode_pods
         self._template_cap = 4096                     # runaway-shape guard
         # node-STATIC row fields (labels/taints/images/allocatable) keyed by
         # (name, resourceVersion): only pod-dependent fields re-encode when a
@@ -610,6 +611,10 @@ class ClusterEncoder:
             prio_class[p] = self.prio_class_id(pod.spec.priority)
         self.last_host_pb = {"req": req, "nonzero_req": nzreq,
                              "port_ids": port_ids, "prio_class": prio_class}
+        # trace-time ports gate: when NO pod in the batch wants a host port,
+        # the dispatched program skips the [N, Wport] conflict pass and the
+        # port-carry update entirely (batch.py ports_enabled)
+        self.last_has_ports = bool(port_ids.any())
         batch = schema.PodBatch(
             valid=jnp.asarray(valid),
             priority=jnp.asarray(priority),

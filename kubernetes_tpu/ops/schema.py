@@ -204,6 +204,21 @@ class TopoBatch:
     pod_term_mask: jax.Array   # [P, T] bool
 
 
+def round_node_capacity(n: int, floor: int = 128) -> int:
+    """Node-axis padding bucket: powers of two up to 1024, then multiples of
+    1024. Pow2 all the way up wastes real bandwidth — every per-step tensor
+    in the batch scan is [N,·], so padding 5000 nodes to 8192 paid +64%
+    memory traffic per scheduling step; 5120 pays +2.4%. Multiples of 1024
+    keep the lane/sublane tiling XLA wants on TPU (and change nothing on
+    CPU), while still bucketing growth so the executable cache stays small."""
+    cap = max(128, floor)
+    while cap < n and cap < 1024:
+        cap *= 2
+    if cap < n:
+        cap = ((n + 1023) // 1024) * 1024
+    return cap
+
+
 @dataclasses.dataclass(frozen=True)
 class Capacities:
     """Static padding sizes; one compiled executable per Capacities value."""
@@ -233,7 +248,4 @@ class Capacities:
     prio_classes: int = 32    # distinct pod priority values (+ reserved row 0)
 
     def grow_nodes(self, n: int) -> "Capacities":
-        cap = self.nodes
-        while cap < n:
-            cap *= 2
-        return dataclasses.replace(self, nodes=cap)
+        return dataclasses.replace(self, nodes=round_node_capacity(n, self.nodes))
