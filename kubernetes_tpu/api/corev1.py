@@ -492,14 +492,63 @@ def pdb_to(pdb: t.PodDisruptionBudget) -> dict:
 
 def service_from(doc: dict) -> t.Service:
     spec = doc.get("spec") or {}
-    return t.Service(meta=meta_from(doc.get("metadata") or {}),
-                     selector=dict(spec.get("selector") or {}))
+    def _int_port(v) -> int:
+        # named (string) targetPorts are resolved against container ports in
+        # the reference; this model is int-only — degrade to 0, don't crash
+        try:
+            return int(v or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    ports = tuple(
+        t.ServicePort(
+            name=p.get("name", ""), protocol=p.get("protocol", "TCP"),
+            port=_int_port(p.get("port")),
+            target_port=_int_port(p.get("targetPort", p.get("port", 0))),
+            node_port=_int_port(p.get("nodePort")),
+        )
+        for p in spec.get("ports") or ()
+    )
+    affinity_cfg = ((spec.get("sessionAffinityConfig") or {}).get("clientIP")
+                    or {})
+    cluster_ip = spec.get("clusterIP", "")
+    return t.Service(
+        meta=meta_from(doc.get("metadata") or {}),
+        selector=dict(spec.get("selector") or {}),
+        external_ips=tuple(spec.get("externalIPs") or ()),
+        type=spec.get("type", "ClusterIP"),
+        headless=cluster_ip == "None",
+        cluster_ip="" if cluster_ip == "None" else cluster_ip,
+        ports=ports,
+        session_affinity=spec.get("sessionAffinity", "None"),
+        session_affinity_timeout_s=int(affinity_cfg.get("timeoutSeconds", 10800)),
+    )
 
 
 def service_to(svc: t.Service) -> dict:
     spec: dict = {}
     if svc.selector:
         spec["selector"] = dict(svc.selector)
+    if svc.external_ips:
+        spec["externalIPs"] = list(svc.external_ips)
+    if svc.type != "ClusterIP":
+        spec["type"] = svc.type
+    if svc.headless:
+        spec["clusterIP"] = "None"  # explicit headless marker round-trips
+    elif svc.cluster_ip:
+        spec["clusterIP"] = svc.cluster_ip
+    if svc.ports:
+        spec["ports"] = [
+            {k: v for k, v in (
+                ("name", p.name), ("protocol", p.protocol), ("port", p.port),
+                ("targetPort", p.target_port), ("nodePort", p.node_port),
+            ) if v not in ("", 0) or k == "port"}
+            for p in svc.ports
+        ]
+    if svc.session_affinity != "None":
+        spec["sessionAffinity"] = svc.session_affinity
+        spec["sessionAffinityConfig"] = {
+            "clientIP": {"timeoutSeconds": svc.session_affinity_timeout_s}}
     return {"metadata": meta_to(svc.meta), "spec": spec}
 
 

@@ -500,10 +500,29 @@ class Namespace:
 
 
 @dataclass
+class ServicePort:
+    """core/v1 ServicePort (types.go ServicePort): one exposed port of a
+    Service; node_port is populated for NodePort/LoadBalancer services."""
+
+    name: str = ""
+    protocol: str = "TCP"
+    port: int = 0          # the ClusterIP-facing port
+    target_port: int = 0   # backend pod port (int form only)
+    node_port: int = 0     # 0 = not a NodePort
+
+
+@dataclass
 class Service:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Dict[str, str] = field(default_factory=dict)  # spec.selector (map form)
     external_ips: Tuple[str, ...] = ()  # spec.externalIPs (DenyServiceExternalIPs)
+    # kube-proxy surface (pkg/proxy/iptables + ipvs proxiers)
+    type: str = "ClusterIP"            # ClusterIP | NodePort | LoadBalancer
+    cluster_ip: str = ""               # virtual IP ("" = none allocated)
+    headless: bool = False             # wire form clusterIP: "None"
+    ports: Tuple[ServicePort, ...] = ()
+    session_affinity: str = "None"     # None | ClientIP
+    session_affinity_timeout_s: int = 10800  # ClientIPConfig.TimeoutSeconds default
 
 
 @dataclass

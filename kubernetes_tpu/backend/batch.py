@@ -879,12 +879,9 @@ def schedule_batch_core(
         topo_mode = "general" if topo_enabled else "off"
     topo_enabled = topo_mode != "off"
     N = nt.capacity  # local shard size under shard_map
-    if key.ndim == 0:
-        # scalar seed: derive the key in-program. The eager host-side
-        # jax.random.PRNGKey costs two relay round-trips per batch once the
-        # session has synchronized (see ops/select.py NEG_INF note); a
-        # traced derivation is free.
-        key = jax.random.PRNGKey(key)
+    # (the `key` arg is retained for signature stability; the tie-break
+    # jitter is a seeded hash now — see ops/tiebreak.py — so no PRNG key is
+    # derived in-program anymore)
     if axis_name is None:
         slot_offset = np.int32(0)
     else:
@@ -931,15 +928,14 @@ def schedule_batch_core(
     elif topo_mode == "host":
         hostkey_ok = nt.label_val[:, host_key] > 0  # [N] node has a hostname
 
-    # tie-break jitter keyed by GLOBAL slot: every shard draws the same
-    # [P, N_global] table and slices its window, so the sharded program picks
-    # exactly the node the single-device program would (deterministic parity)
-    jitter_global = jax.random.uniform(
-        key, (pb.capacity, N * num_shards), jnp.float32, 0.0, 0.5)
-    if axis_name is None:
-        jitter = jitter_global
-    else:
-        jitter = lax.dynamic_slice_in_dim(jitter_global, slot_offset, N, axis=1)
+    # seeded tie-break jitter (SURVEY §8; replaces the threefry uniform draw,
+    # which was the single most expensive block of the program on CPU): a
+    # per-(pod-seed, node-NAME-hash) integer hash, identical to the oracle's
+    # _select_host key (ops/tiebreak.py). Name-keyed values are the same on
+    # every shard layout, so sharded-vs-single-device parity is automatic.
+    from ..ops.tiebreak import jitter_table
+
+    jitter = jitter_table(pb.tie_seed, nt.name_hash)
 
     # ---- commit phase -----------------------------------------------------
     pod_bits = _pod_port_bits(pb, nt.port_bits.shape[1])

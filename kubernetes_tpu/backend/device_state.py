@@ -29,6 +29,7 @@ _ROW_FIELDS = (
     ("label_val", np.int32), ("label_num", np.int32),
     ("taint_key", np.int32), ("taint_val", np.int32), ("taint_effect", np.int32),
     ("port_bits", np.uint32), ("image_bits", np.uint32), ("class_req", np.int32),
+    ("name_hash", np.uint32),
 )
 
 
@@ -91,6 +92,13 @@ class DeviceState:
         # images) cannot differ from the mirror, so reconcile only needs to
         # compare the pod-commit-dynamic fields
         self._mirror_node: Dict[str, object] = {}
+        # O(changes) reconcile/has_dirty: names this device previously left
+        # dirty, and the snapshot structure version it last fully walked.
+        # While the structure version is unchanged, only changed_names ∪
+        # _recon_pending can possibly be gen-stale — the full-N walk is
+        # reserved for membership/zone changes (snapshot.py changed_names).
+        self._recon_pending: set = set()
+        self._seen_struct: int = -1
 
     @property
     def tc(self):
@@ -124,6 +132,7 @@ class DeviceState:
             image_num_nodes=jnp.asarray(z(c.images, np.int32)),
             class_req=jnp.asarray(z((c.nodes, c.prio_classes, c.resources), np.int32)),
             class_prio=jnp.asarray(self.encoder.class_prio_array()),
+            name_hash=jnp.asarray(z(c.nodes, np.uint32)),
         )
 
     # ------------------------------------------------------------------ sync
@@ -166,6 +175,13 @@ class DeviceState:
                 dirty.append((slot, NodeInfo()))  # empty row: valid=False
                 self.sig_table.recount_node(slot, None)
             images_changed |= self._track_images(name, None)
+
+        # the full walk leaves every gen aligned: reset the O(changes) probes.
+        # Duck-typed snapshots (wire service, test shims) may lack the
+        # bookkeeping fields; they always take the full-walk paths.
+        self._seen_struct = getattr(snapshot, "structure_version", -1)
+        self._recon_pending.clear()
+        getattr(snapshot, "changed_names", set()).clear()
 
         if not dirty:
             return 0
@@ -237,26 +253,40 @@ class DeviceState:
         path repairs everything. Returns the number of rows left dirty."""
         self._refresh_class_prio()
         left = 0
-        current = set()
         mirror = self._mirror
         req_m, nz_m = mirror["requested"], mirror["nonzero_requested"]
         ports_m, creq_m = mirror["port_bits"], mirror["class_req"]
-        for name, ni in snapshot.node_info_map.items():
-            current.add(name)
+        if getattr(snapshot, "structure_version", None) == self._seen_struct:
+            # membership/zones unchanged since the last full walk: only the
+            # names update_snapshot re-cloned (plus rows we previously left
+            # dirty) can be gen-stale — O(changes), not O(nodes)
+            names = snapshot.changed_names | self._recon_pending
+            items = [(n, snapshot.node_info_map[n]) for n in names
+                     if n in snapshot.node_info_map]
+            check_removals = False
+        else:
+            items = list(snapshot.node_info_map.items())
+            check_removals = True
+        pending = set()
+        for name, ni in items:
             if self._uploaded_gen.get(name) == ni.generation:
                 continue
             if name not in self._uploaded_gen:
                 left += 1  # new node: needs a real upload
+                pending.add(name)
                 continue
             if ni.node is not self._mirror_node.get(name):
                 left += 1  # node OBJECT replaced: static fields may differ
+                pending.add(name)
                 continue
             if self._node_images.get(name, frozenset()) != frozenset(ni.image_states):
                 left += 1  # image vocab change: needs a real upload
+                pending.add(name)
                 continue
             slot = self.encoder.node_slots.get(name)
             if slot is None:
                 left += 1
+                pending.add(name)
                 continue
             try:
                 # static fields are pinned by the identity check above; only
@@ -264,6 +294,7 @@ class DeviceState:
                 row = self.encoder.encode_dynamic_fields(ni)
             except CapacityError:
                 left += 1
+                pending.add(name)
                 continue
             if (np.array_equal(row["requested"], req_m[slot])
                     and np.array_equal(row["nonzero_requested"], nz_m[slot])
@@ -274,18 +305,33 @@ class DeviceState:
                 self.sig_table.recount_node(slot, ni)
             else:
                 left += 1
-        left += sum(1 for n in self._uploaded_gen if n not in current)  # removals
+                pending.add(name)
+        if check_removals:
+            removed = [n for n in self._uploaded_gen
+                       if n not in snapshot.node_info_map]
+            left += len(removed)
+            pending.update(removed)
+            self._seen_struct = getattr(snapshot, "structure_version", -1)
+        self._recon_pending = pending
+        getattr(snapshot, "changed_names", set()).clear()
         return left
 
     def has_dirty(self, snapshot: Snapshot) -> bool:
         """Cheap generation-only probe: would sync() find any dirty or
         removed node? In the async pipeline, any dirtiness at dispatch time
         is by construction an EXTERNAL change (the in-flight batch's commits
-        are not in the cache yet), which breaks the device-carry chain."""
-        for name, ni in snapshot.node_info_map.items():
-            if self._uploaded_gen.get(name) != ni.generation:
+        are not in the cache yet), which breaks the device-carry chain.
+        O(changes): while the snapshot's structure version is the one this
+        device last fully walked, only changed/pending names can be stale;
+        a structure change conservatively reports dirty (the drain+sync it
+        triggers realigns the version)."""
+        if getattr(snapshot, "structure_version", None) != self._seen_struct:
+            return True
+        for name in snapshot.changed_names | self._recon_pending:
+            ni = snapshot.node_info_map.get(name)
+            if ni is None or self._uploaded_gen.get(name) != ni.generation:
                 return True
-        return any(n not in snapshot.node_info_map for n in self._uploaded_gen)
+        return False
 
     def adopt_device(self, result) -> None:
         """Adopt the batch program's evolved dynamic state as the new device

@@ -108,6 +108,7 @@ def _batch_to_proto(payload: dict):
             req.templates.append(tmpl)
         req.pods.append(p.PodRef(template=idx, name=name,
                                  namespace=namespace, uid=uid))
+    req.tie_seeds.extend(int(s) for s in payload.get("tieSeeds", ()))
     return req
 
 
@@ -122,7 +123,10 @@ def _batch_from_proto(req) -> dict:
         if ref.uid:
             meta["uid"] = ref.uid
         pods.append(dict(tmpl, meta=meta))
-    return {"pods": pods}
+    out = {"pods": pods}
+    if req.tie_seeds:
+        out["tieSeeds"] = list(req.tie_seeds)
+    return out
 
 
 def _results_to_proto(out: dict):

@@ -57,7 +57,13 @@ class DeviceService:
         self.batch_size = batch_size
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.infos: Dict[str, NodeInfo] = {}
-        self.snap = SimpleNamespace(node_info_map=self.infos)
+        # duck-typed Snapshot: the wire service mirrors nodes wholesale per
+        # delta, so every sync is a "structure changed" full walk — the
+        # changed_names/structure_version fields exist only to satisfy
+        # DeviceState's O(changes) bookkeeping (a fresh version each sync
+        # forces the full path, which is correct here)
+        self.snap = SimpleNamespace(node_info_map=self.infos,
+                                    changed_names=set(), structure_version=0)
         self.ns_labels: Dict[str, Dict[str, str]] = {}
         self.device: Optional[DeviceState] = None
         self.schedule_batch_fn = build_schedule_batch_fn()
@@ -139,12 +145,14 @@ class DeviceService:
 
     def schedule_batch(self, req: dict) -> dict:
         pods = [from_wire(Pod, pw) for pw in req.get("pods", ())]
+        tie_seeds = req.get("tieSeeds") or None
         with self._lock:
             self._ensure_device()
             for _attempt in range(8):
                 try:
                     self.device.sync(self.snap)
-                    pb, et = self.device.encoder.encode_pods(pods)
+                    pb, et = self.device.encoder.encode_pods(
+                        pods, tie_seeds=tie_seeds)
                     tb = self.device.sig_table.encode_topo(pods)
                     break
                 except CapacityError as e:
@@ -422,9 +430,12 @@ class WireScheduler(Scheduler):
         if not batch:
             return
         self._push_deltas()
+        from ..ops.tiebreak import seeds_for
+
         res = self.client.schedule_batch(
             {"apiVersion": API_VERSION,
-             "pods": [to_wire(qp.pod) for qp in batch]})
+             "pods": [to_wire(qp.pod) for qp in batch],
+             "tieSeeds": [int(s) for s in seeds_for(batch)]})
         # hint-screen scaffolding, shared by every failed pod in the batch
         hint_names = hint_slot_of = None
         for qp, r in zip(batch, res["results"]):

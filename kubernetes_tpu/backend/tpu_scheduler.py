@@ -458,8 +458,11 @@ class TPUScheduler(Scheduler):
                     t_sync = self.now_fn()
                     pods = [qp.pod for qp in batched]
                     bucket = self.sizer.bucket_for(len(pods))
+                    from ..ops.tiebreak import seeds_for
+
                     with tracing.span("device.encode", batch=len(batched)):
-                        pb, et = self.device.encoder.encode_pods(pods, capacity=bucket)
+                        pb, et = self.device.encoder.encode_pods(
+                            pods, capacity=bucket, tie_seeds=seeds_for(batched))
                         tb = self.device.sig_table.encode_topo(pods, capacity=bucket)
                     break
                 except CapacityError as e:
@@ -573,9 +576,12 @@ class TPUScheduler(Scheduler):
         st = self.device.sig_table
         vocab0 = (st.n_sigs, st.n_terms)
         try:
+            from ..ops.tiebreak import seeds_for
+
             pods = [qp.pod for qp in batched]
             bucket = self.sizer.bucket_for(len(pods))
-            pb, et = self.device.encoder.encode_pods(pods, capacity=bucket)
+            pb, et = self.device.encoder.encode_pods(
+                pods, capacity=bucket, tie_seeds=seeds_for(batched))
             tb = st.encode_topo(pods, capacity=bucket)
         except CapacityError:
             return None  # grow via the drain+sync path (idempotent re-encode)

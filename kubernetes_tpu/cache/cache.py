@@ -200,8 +200,6 @@ class Cache:
         generation; O(changes) not O(nodes) (cache.go:198's generation-ordered
         list, realized as a dirty set). A snapshot older than the dirty-set
         horizon (e.g. a brand-new Snapshot) gets a full resync."""
-        from ..api.types import get_zone_key
-
         with self._lock:
             max_gen = snapshot.generation
             changed = False
@@ -210,34 +208,39 @@ class Cache:
             # snapshot's cached interleave order must be rebuilt; pod-only
             # churn (the batch commit path) keeps it (snapshot.py refresh_lists)
             structural = full
+            batch_changed = set()
             names = self.nodes.keys() if full else (self._dirty | self._removed)
             for name in names:
                 ni = self.nodes.get(name)
                 if ni is None:
                     if name in snapshot.node_info_map:
                         del snapshot.node_info_map[name]
+                        snapshot.changed_names.add(name)
+                        batch_changed.add(name)
                         changed = True
                         structural = True
                     continue
                 if ni.generation > snapshot.generation:
-                    if not structural:
-                        prev_zone = snapshot._zone_of.get(name)
-                        if (ni.node is None or prev_zone is None
-                                or get_zone_key(ni.node) != prev_zone):
-                            structural = True
+                    if not structural and snapshot.order_affected_by(name, ni.node):
+                        structural = True
                     snapshot.node_info_map[name] = ni.clone()
+                    snapshot.changed_names.add(name)
+                    batch_changed.add(name)
                     max_gen = max(max_gen, ni.generation)
                     changed = True
             if full:
                 stale = [n for n in snapshot.node_info_map if n not in self.nodes]
                 for n in stale:
                     del snapshot.node_info_map[n]
+                    snapshot.changed_names.add(n)
+                    batch_changed.add(n)
                     changed = True
             self._dirty.clear()
             self._removed.clear()
             self._sync_generation = max_gen
             if changed:
-                snapshot.refresh_lists(structural=structural)
+                snapshot.refresh_lists(structural=structural,
+                                       changed_names=batch_changed)
             snapshot.generation = max_gen
         return snapshot
 

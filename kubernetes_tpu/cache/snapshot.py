@@ -1,8 +1,11 @@
 """Per-cycle immutable snapshot (internal/cache/snapshot.go:29).
 
 Holds cloned NodeInfos keyed by name plus the flat list and the pruned
-secondary lists the affinity plugins iterate (have_pods_with_affinity,
-have_pods_with_required_anti_affinity, used PVC set).
+secondary lists (have_pods_with_affinity, have_pods_with_required_anti_affinity,
+used PVC set). The pruned lists are computed LAZILY: the batched commit path
+refreshes the snapshot once per batch and never reads them, so eager rebuilds
+were pure O(N) overhead per batch; a property rebuilds them on first access
+after a refresh (the oracle path's per-cycle access pattern is unchanged).
 """
 
 from __future__ import annotations
@@ -16,50 +19,103 @@ class Snapshot:
     def __init__(self):
         self.node_info_map: Dict[str, NodeInfo] = {}
         self.node_info_list: List[NodeInfo] = []
-        self.have_pods_with_affinity_list: List[NodeInfo] = []
-        self.have_pods_with_required_anti_affinity_list: List[NodeInfo] = []
-        self.used_pvc_set: Set[str] = set()
         self.generation: int = 0
         # zone-interleave order cache: the interleaved ORDER depends only on
         # (name, zone) membership, not on pod contents — pod-only churn (the
         # per-batch commit path) reuses it instead of rebuilding a throwaway
         # NodeTree over every node (was 50ms+/batch at 5k nodes)
         self._order: List[str] = []
+        self._pos: Dict[str, int] = {}
         self._zone_of: Dict[str, str] = {}
+        self._pruned_stale = True
+        self._affinity_list: List[NodeInfo] = []
+        self._anti_affinity_list: List[NodeInfo] = []
+        self._used_pvc: Set[str] = set()
+        # device-sync bookkeeping (backend/device_state.py): names whose
+        # NodeInfo was re-cloned/deleted since the device last consumed them,
+        # and a version that bumps on any membership/zone change — lets
+        # reconcile/has_dirty probe O(changes) instead of O(nodes)
+        self.changed_names: Set[str] = set()
+        self.structure_version: int = 0
 
     def get(self, name: str) -> Optional[NodeInfo]:
         return self.node_info_map.get(name)
 
+    def order_affected_by(self, name: str, node) -> bool:
+        """Would replacing ``name``'s NodeInfo (whose .node is ``node``)
+        change the cached interleave order? True for new names, removals
+        (node None), and zone changes — the one place the order invariant
+        lives (cache.update_snapshot consults this instead of re-deriving
+        the membership/zone rule)."""
+        from ..api.types import get_zone_key
+
+        prev_zone = self._zone_of.get(name)
+        return (node is None or prev_zone is None
+                or get_zone_key(node) != prev_zone)
+
     def list(self) -> List[NodeInfo]:
         return self.node_info_list
 
-    def refresh_lists(self, structural: bool = True) -> None:
-        """Rebuild the flat + pruned lists from node_info_map. The flat list
-        is zone-round-robin ordered (nodeTree order, node_tree.go:32) so the
+    # ---- pruned lists (snapshot.go:49-58), rebuilt on demand ----------------
+
+    def _rebuild_pruned(self) -> None:
+        self._affinity_list = [ni for ni in self.node_info_list if ni.pods_with_affinity]
+        self._anti_affinity_list = [
+            ni for ni in self.node_info_list if ni.pods_with_required_anti_affinity
+        ]
+        self._used_pvc = {k for ni in self.node_info_list for k in ni.pvc_ref_counts}
+        self._pruned_stale = False
+
+    @property
+    def have_pods_with_affinity_list(self) -> List[NodeInfo]:
+        if self._pruned_stale:
+            self._rebuild_pruned()
+        return self._affinity_list
+
+    @property
+    def have_pods_with_required_anti_affinity_list(self) -> List[NodeInfo]:
+        if self._pruned_stale:
+            self._rebuild_pruned()
+        return self._anti_affinity_list
+
+    @property
+    def used_pvc_set(self) -> Set[str]:
+        if self._pruned_stale:
+            self._rebuild_pruned()
+        return self._used_pvc
+
+    def refresh_lists(self, structural: bool = True,
+                      changed_names: Optional[Set[str]] = None) -> None:
+        """Rebuild the flat list from node_info_map. The flat list is
+        zone-round-robin ordered (nodeTree order, node_tree.go:32) so the
         sampled scheduling window spreads across zones.
 
         ``structural=False`` is the caller's promise that no node was added,
         removed, or re-zoned since the last refresh (only pod contents
-        changed) — the cached interleave order is reused and only the list
-        pointers + pruned lists are rebuilt (O(N) dict lookups, not an O(N)
-        tree rebuild with per-node zone-label extraction)."""
+        changed): the cached interleave order is kept, and with
+        ``changed_names`` the refresh patches only those positions —
+        O(changes), not O(nodes)."""
         from ..api.types import get_zone_key
 
         if structural or not self._order:
+            self.structure_version += 1
             from .node_tree import zone_interleaved
 
             self.node_info_list = zone_interleaved(
                 ni for ni in self.node_info_map.values() if ni.node is not None
             )
             self._order = [ni.node.meta.name for ni in self.node_info_list]
+            self._pos = {name: i for i, name in enumerate(self._order)}
             self._zone_of = {
                 ni.node.meta.name: get_zone_key(ni.node) for ni in self.node_info_list
             }
+        elif changed_names is not None:
+            lst, m, pos = self.node_info_list, self.node_info_map, self._pos
+            for name in changed_names:
+                i = pos.get(name)
+                if i is not None:
+                    lst[i] = m[name]
         else:
             m = self.node_info_map
             self.node_info_list = [m[name] for name in self._order]
-        self.have_pods_with_affinity_list = [ni for ni in self.node_info_list if ni.pods_with_affinity]
-        self.have_pods_with_required_anti_affinity_list = [
-            ni for ni in self.node_info_list if ni.pods_with_required_anti_affinity
-        ]
-        self.used_pvc_set = {k for ni in self.node_info_list for k in ni.pvc_ref_counts}
+        self._pruned_stale = True

@@ -228,6 +228,10 @@ class ClusterEncoder:
         row["valid"] = np.array(node is not None)
         row["unschedulable"] = np.array(bool(node and node.spec.unschedulable))
         row["allocatable"] = self.resource_vec(ni.allocatable.as_map())
+        from .tiebreak import name_hash as _name_hash
+
+        row["name_hash"] = np.array(
+            _name_hash(node.meta.name) if node is not None else 0, np.uint32)
 
         label_val = np.zeros(caps.label_keys, np.int32)
         label_num = np.full(caps.label_keys, INT_NONE, np.int32)
@@ -361,6 +365,7 @@ class ClusterEncoder:
             image_num_nodes=jnp.asarray(num_nodes),
             class_req=jnp.asarray(stack("class_req", np.int32, (caps.prio_classes, caps.resources))),
             class_prio=jnp.asarray(self.class_prio_array()),
+            name_hash=jnp.asarray(stack("name_hash", np.uint32, ())),
         )
         return nt
 
@@ -531,7 +536,8 @@ class ClusterEncoder:
             self._pod_templates[sig] = tmpl
         return tmpl
 
-    def encode_pods(self, pods: Sequence[Pod], capacity: Optional[int] = None
+    def encode_pods(self, pods: Sequence[Pod], capacity: Optional[int] = None,
+                    tie_seeds: Optional[Sequence[int]] = None,
                     ) -> Tuple["schema.PodBatch", "schema.ExprTable"]:
         """``capacity`` pads the pod axis to a smaller bucket than caps.pods:
         the compiled program's step count (and the speculative rounds' [P,N]
@@ -609,6 +615,14 @@ class ClusterEncoder:
         prio_class = np.zeros(P, np.int32)
         for p, pod in enumerate(pods):
             prio_class[p] = self.prio_class_id(pod.spec.priority)
+        from .tiebreak import pod_seed
+
+        tie_seed = np.zeros(P, np.uint32)
+        if tie_seeds is not None:
+            tie_seed[: len(tie_seeds)] = np.asarray(tie_seeds, np.uint32)[:P]
+        else:
+            for p, pod in enumerate(pods):
+                tie_seed[p] = pod_seed(pod.key(), 0)
         self.last_host_pb = {"req": req, "nonzero_req": nzreq,
                              "port_ids": port_ids, "prio_class": prio_class}
         # trace-time ports gate: when NO pod in the batch wants a host port,
@@ -637,6 +651,7 @@ class ClusterEncoder:
             port_ids=jnp.asarray(port_ids),
             image_ids=jnp.asarray(image_ids),
             num_containers=jnp.asarray(num_containers),
+            tie_seed=jnp.asarray(tie_seed),
         )
         return batch, builder.table()
 

@@ -92,6 +92,7 @@ class NodeTensors:
     # padding with class_prio INT_MAX (never evictable).
     class_req: jax.Array      # [N, C, R] int32 requested by pods of class c
     class_prio: jax.Array     # [C] int32 priority value of class c (vocab)
+    name_hash: jax.Array      # [N] uint32 fnv1a(node name) — seeded tie-break
 
     @property
     def capacity(self) -> int:
@@ -137,6 +138,7 @@ class PodBatch:
     port_ids: jax.Array     # [P, MP] int32 wanted-port vocab ids (0 = empty)
     image_ids: jax.Array    # [P, C] int32 container image vocab ids (0 = empty)
     num_containers: jax.Array  # [P] int32
+    tie_seed: jax.Array     # [P] uint32 per-(pod, attempt) tie-break seed
 
     @property
     def capacity(self) -> int:

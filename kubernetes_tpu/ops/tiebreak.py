@@ -1,0 +1,112 @@
+"""Deterministic seeded tie-break, shared bit-for-bit by the Python oracle
+and the device batch programs (SURVEY §8; the reference's reservoir uniform
+tie-break is pkg/scheduler/schedule_one.go:709-730).
+
+The reference breaks score ties with an unseeded uniform draw, which makes
+exact-replay parity between two schedulers unmeasurable. Here both paths
+derive the SAME per-(pod, attempt, node) 32-bit key:
+
+    key(p, n) = mix32(pod_seed(pod_key, attempts) ^ fnv1a32(node_name))
+
+and pick the tied node with the LARGEST key — a uniform choice over the tie
+set (mix32 is a bijective avalanche permutation), but reproducible. The
+device adds the same key, scaled into [0, 0.5), onto each node's score as
+jitter: for exactly-tied scores argmax-by-jitter == max-by-key, so the
+batched path and the oracle land the same node.
+
+This also replaces the jax.random threefry draw of a [P, N] uniform table —
+~40 u32 rounds per element and the single most expensive block of the batch
+program on CPU — with an 8-pass integer hash. Node keys hash the node NAME
+(not the slot), so values are identical across shard layouts and topology
+modes (sharded-vs-single-device parity is automatic).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+_FNV_OFFSET = np.uint32(2166136261)
+_FNV_PRIME = np.uint32(16777619)
+_GOLDEN = np.uint32(0x9E3779B9)
+_M1 = np.uint32(0x85EBCA6B)
+_M2 = np.uint32(0xC2B2AE35)
+
+# jitter strictly below 0.5: integer plugin scores differ by ≥ 1, so the
+# tie-break can never flip a non-tie (same bound the old uniform draw used)
+JITTER_SCALE = np.float32(0.5 / (1 << 24))
+
+
+def fnv1a32(s: str) -> np.uint32:
+    """FNV-1a over the UTF-8 bytes — stable across processes (unlike hash())."""
+    h = int(_FNV_OFFSET)
+    prime = int(_FNV_PRIME)
+    for b in s.encode("utf-8"):
+        h = ((h ^ b) * prime) & 0xFFFFFFFF
+    return np.uint32(h)
+
+
+def mix32(x):
+    """Murmur3 finalizer (avalanche bijection) — scalar or ndarray. Scalars
+    run in masked Python ints (numpy warns on intended u32 wraparound)."""
+    if np.ndim(x) == 0:
+        v = int(x) & 0xFFFFFFFF
+        v ^= v >> 16
+        v = (v * int(_M1)) & 0xFFFFFFFF
+        v ^= v >> 13
+        v = (v * int(_M2)) & 0xFFFFFFFF
+        v ^= v >> 16
+        return np.uint32(v)
+    x = x.astype(np.uint32)
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint32(16))
+        x = x * _M1
+        x = x ^ (x >> np.uint32(13))
+        x = x * _M2
+        x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def pod_seed(pod_key: str, attempts: int = 0) -> np.uint32:
+    """Per-(pod, scheduling attempt) seed: fresh tie-break draw each retry,
+    exactly reproducible by anyone holding (pod key, attempt count)."""
+    return mix32(int(fnv1a32(pod_key)) ^ ((attempts * int(_GOLDEN)) & 0xFFFFFFFF))
+
+
+def name_hash(node_name: str) -> np.uint32:
+    return fnv1a32(node_name)
+
+
+def tie_key(seed: np.uint32, node_name_hash: np.uint32) -> int:
+    """Oracle-side scalar: the tied node with the largest key wins."""
+    return int(mix32(np.uint32(seed) ^ np.uint32(node_name_hash)))
+
+
+def jitter_table(tie_seed, node_name_hash):
+    """Device-side [P, N] float32 jitter in [0, 0.5): monotone in tie_key, so
+    score-tied argmax == oracle's max-by-key. jnp in, jnp out.
+
+    Precision bound: only the top 24 hash bits survive the float32 mantissa,
+    and adding jitter onto a score total of magnitude ~10² leaves ~14-16
+    effective bits — among a K-node pure-tie set the device argmax can
+    disagree with the oracle's full-32-bit max with probability ≈ K/2¹⁶
+    (≈ 7% at K = 5000, < 0.5% at K ≤ 256). That bounds exact-replay
+    agreement below 100% on degenerate all-identical clusters; acceptable
+    against the ≥ 90% target (SURVEY §8), and the argmax-equivalence metric
+    is unaffected (any max-scoring node is equivalent)."""
+    import jax.numpy as jnp
+
+    x = tie_seed[:, None].astype(jnp.uint32) ^ node_name_hash[None, :].astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(_M1)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(_M2)
+    x = x ^ (x >> 16)
+    return (x >> 8).astype(jnp.float32) * JITTER_SCALE
+
+
+def seeds_for(qps) -> Optional[np.ndarray]:
+    """[len(qps)] uint32 seed vector from QueuedPodInfos (key + attempts)."""
+    return np.asarray([pod_seed(qp.pod.key(), qp.attempts) for qp in qps],
+                      np.uint32)

@@ -1,16 +1,25 @@
-"""Proxier: Services + Endpoints → per-service backend rules
-(pkg/proxy/iptables/proxier.go:809 syncProxyRules, minus netfilter).
+"""Proxier: Services + Endpoints → per-service dataplane rules.
 
-Tracks pending service/endpoints changes like the reference's
-ServiceChangeTracker/EndpointChangeTracker and rebuilds only affected
-services on sync. ``route()`` is the dataplane stand-in: deterministic
-round-robin over ready backends (the iptables statistic-mode jump chain).
+The iptables mode mirrors pkg/proxy/iptables/proxier.go:809 syncProxyRules
+(change-tracked rebuilds, KUBE-SERVICES/KUBE-SVC/KUBE-SEP/KUBE-NODEPORTS/
+KUBE-MARK-MASQ chains, statistic-mode random jumps, `-m recent` session
+affinity); the ipvs mode mirrors pkg/proxy/ipvs/proxier.go (one virtual
+server per (clusterIP, port) and per nodePort, rr scheduler, `-p` persistence
+for ClientIP affinity). No netfilter here — ``route*()`` is the dataplane
+stand-in and the render functions are the wire-format contract, diff-tested
+against recorded fixtures.
+
+Conntrack stand-in (pkg/proxy/conntrack/cleanup.go): established flows are
+tracked per (service, client); when an endpoint disappears from a service,
+its flows and affinity entries are flushed so traffic stops hitting the dead
+backend.
 """
 
 from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -19,6 +28,11 @@ from typing import Dict, List, Optional, Tuple
 class ServiceRules:
     service_key: str
     backends: Tuple[str, ...] = ()  # pod keys, stable order
+    cluster_ip: str = ""
+    svc_type: str = "ClusterIP"
+    ports: Tuple = ()               # api.types.ServicePort
+    session_affinity: str = "None"
+    affinity_timeout_s: int = 10800
     _rr: itertools.cycle = field(default=None, repr=False, compare=False)
 
     def __post_init__(self):
@@ -26,13 +40,30 @@ class ServiceRules:
 
 
 class Proxier:
-    def __init__(self, store, factory=None):
+    def __init__(self, store, factory=None, mode: str = "iptables",
+                 now_fn=time.monotonic):
+        assert mode in ("iptables", "ipvs")
         self.store = store
+        self.mode = mode
+        self.now_fn = now_fn
         self._lock = threading.Lock()
         self.rules: Dict[str, ServiceRules] = {}
         self._dirty: set = set()
         self.full_syncs = 0
         self.partial_syncs = 0
+        # secondary indexes (proxier.go serviceMap keyed by ServicePortName),
+        # plus per-service reverse indexes so a per-service rebuild drops
+        # exactly its own entries — O(own), not a scan of every service's
+        self._by_cluster_ip: Dict[Tuple[str, int], str] = {}  # (ip, port) -> svc key
+        self._by_node_port: Dict[int, str] = {}               # nodePort -> svc key
+        self._svc_index_keys: Dict[str, List] = {}   # svc key -> [(idx, key), ...]
+        self._svc_clients: Dict[str, set] = {}       # svc key -> {client ips}
+        # session affinity (the `-m recent` / ipvs `-p` stand-in):
+        # (svc key, client) -> (backend, stamped-at)
+        self._affinity: Dict[Tuple[str, str], Tuple[str, float]] = {}
+        # established flows per (svc key, client) -> backend (conntrack table)
+        self._flows: Dict[Tuple[str, str], str] = {}
+        self.conntrack_flushed: List[str] = []  # flushed backend identities (evidence)
         if factory is not None:
             factory.informer_for("Service").add_event_handler(self._on_change)
             factory.informer_for("Endpoints").add_event_handler(self._on_change)
@@ -52,7 +83,9 @@ class Proxier:
 
     def sync_proxy_rules(self, full: bool = False) -> int:
         """Rebuild rules for dirty services (or all when ``full``); returns
-        services rebuilt (proxier.go:809's per-change rebuild)."""
+        services rebuilt (proxier.go:809's per-change rebuild). Endpoints
+        that vanished get their conntrack flows + affinity entries flushed
+        (conntrack.CleanStaleEntries)."""
         with self._lock:
             if full:
                 # union with known rules so deleted services get swept too
@@ -68,24 +101,104 @@ class Proxier:
         for key in keys:
             n += 1
             with self._lock:
+                old = self.rules.get(key)
+                old_backends = set(old.backends) if old else set()
                 if key not in services:
-                    self.rules.pop(key, None)
+                    self._drop_service_locked(key)
+                    if old_backends:
+                        self._flush_stale_locked(key, old_backends)
                     continue
+                svc = services[key]
                 eps = endpoints.get(key)
                 backends = tuple(a.pod_key for a in eps.addresses) if eps else ()
-                self.rules[key] = ServiceRules(service_key=key, backends=backends)
+                rules = ServiceRules(
+                    service_key=key, backends=backends,
+                    cluster_ip=getattr(svc, "cluster_ip", ""),
+                    svc_type=getattr(svc, "type", "ClusterIP"),
+                    ports=tuple(getattr(svc, "ports", ()) or ()),
+                    session_affinity=getattr(svc, "session_affinity", "None"),
+                    affinity_timeout_s=getattr(svc, "session_affinity_timeout_s",
+                                               10800),
+                )
+                self._drop_service_locked(key, keep_state=True)
+                self.rules[key] = rules
+                rev = self._svc_index_keys.setdefault(key, [])
+                for p in rules.ports:
+                    if rules.cluster_ip and p.port:
+                        self._by_cluster_ip[(rules.cluster_ip, p.port)] = key
+                        rev.append((self._by_cluster_ip, (rules.cluster_ip, p.port)))
+                    if rules.svc_type in ("NodePort", "LoadBalancer") and p.node_port:
+                        self._by_node_port[p.node_port] = key
+                        rev.append((self._by_node_port, p.node_port))
+                gone = old_backends - set(backends)
+                if gone:
+                    self._flush_stale_locked(key, gone)
         return n
+
+    def _drop_service_locked(self, key: str, keep_state: bool = False) -> None:
+        self.rules.pop(key, None)
+        for idx, k in self._svc_index_keys.pop(key, ()):
+            if idx.get(k) == key:
+                del idx[k]
+        if not keep_state:
+            for client in self._svc_clients.pop(key, ()):
+                self._affinity.pop((key, client), None)
+                self._flows.pop((key, client), None)
+
+    def _flush_stale_locked(self, key: str, gone_backends: set) -> None:
+        """Flush conntrack flows + affinity stuck on removed endpoints."""
+        for client in list(self._svc_clients.get(key, ())):
+            flow = self._flows.get((key, client))
+            if flow in gone_backends:
+                del self._flows[(key, client)]
+                self.conntrack_flushed.append(flow)
+            entry = self._affinity.get((key, client))
+            if entry is not None and entry[0] in gone_backends:
+                del self._affinity[(key, client)]
 
     # -- dataplane stand-in
 
-    def route(self, service_key: str) -> Optional[str]:
-        """Pick the next backend pod for a service (round-robin — the
-        iptables probability-chain equivalent); None when no backends."""
+    def route(self, service_key: str, client_ip: Optional[str] = None,
+              now: Optional[float] = None) -> Optional[str]:
+        """Pick the backend pod for a service. Without a client, plain
+        round-robin (the statistic-mode chain). With a client and ClientIP
+        session affinity, the sticky entry wins while fresh and its backend
+        is still serving (`-m recent --rcheck --seconds <timeout>`)."""
+        now = self.now_fn() if now is None else now
         with self._lock:
             r = self.rules.get(service_key)
             if r is None or r._rr is None:
                 return None
-            return next(r._rr)
+            if client_ip is not None and r.session_affinity == "ClientIP":
+                entry = self._affinity.get((service_key, client_ip))
+                if entry is not None:
+                    backend, stamped = entry
+                    if backend in r.backends and now - stamped <= r.affinity_timeout_s:
+                        self._affinity[(service_key, client_ip)] = (backend, now)
+                        self._flows[(service_key, client_ip)] = backend
+                        self._svc_clients.setdefault(service_key, set()).add(client_ip)
+                        return backend
+            backend = next(r._rr)
+            if client_ip is not None:
+                if r.session_affinity == "ClientIP":
+                    self._affinity[(service_key, client_ip)] = (backend, now)
+                self._flows[(service_key, client_ip)] = backend
+                self._svc_clients.setdefault(service_key, set()).add(client_ip)
+            return backend
+
+    def route_cluster_ip(self, ip: str, port: int,
+                         client_ip: Optional[str] = None) -> Optional[str]:
+        """ClusterIP virtual-address dispatch (KUBE-SERVICES -d ip --dport)."""
+        with self._lock:
+            key = self._by_cluster_ip.get((ip, port))
+        return self.route(key, client_ip) if key else None
+
+    def route_node_port(self, node_port: int,
+                        client_ip: Optional[str] = None) -> Optional[str]:
+        """NodePort dispatch (KUBE-NODEPORTS --dport)."""
+        with self._lock:
+            key = self._by_node_port.get(node_port)
+        return self.route(key, client_ip) if key else None
 
     def backends(self, service_key: str) -> List[str]:
         with self._lock:
@@ -97,26 +210,57 @@ class Proxier:
     def render_iptables(self) -> str:
         """The rules as iptables-save text — the wire format syncProxyRules
         writes through iptables-restore (proxier.go:809 builds exactly these
-        KUBE-SERVICES/KUBE-SVC-*/KUBE-SEP-* chains with statistic-mode
-        random jumps). No netfilter here; the text is the contract."""
+        KUBE-SERVICES/KUBE-SVC-*/KUBE-SEP-*/KUBE-NODEPORTS chains with
+        statistic-mode random jumps; ClientIP affinity adds `-m recent`
+        rcheck/set pairs). No netfilter here; the text is the contract."""
         import hashlib
 
         def chain_hash(kind: str, key: str) -> str:
             return f"KUBE-{kind}-{hashlib.sha256(key.encode()).hexdigest()[:16].upper()}"
 
-        lines = ["*nat", ":KUBE-SERVICES - [0:0]"]
+        lines = ["*nat", ":KUBE-SERVICES - [0:0]", ":KUBE-NODEPORTS - [0:0]",
+                 ":KUBE-MARK-MASQ - [0:0]"]
         chains, rules = [], []
+        rules.append("-A KUBE-MARK-MASQ -j MARK --set-xmark 0x4000/0x4000")
+        rules.append("-A KUBE-SERVICES -m addrtype --dst-type LOCAL "
+                     "-j KUBE-NODEPORTS")
         with self._lock:
             snapshot = sorted(self.rules.items())
         for key, r in snapshot:
             svc_chain = chain_hash("SVC", key)
             chains.append(f":{svc_chain} - [0:0]")
-            rules.append(
-                f'-A KUBE-SERVICES -m comment --comment "{key}" -j {svc_chain}')
+            if r.cluster_ip and r.ports:
+                for p in r.ports:
+                    proto = p.protocol.lower()
+                    rules.append(
+                        f"-A KUBE-SERVICES -d {r.cluster_ip}/32 -p {proto} "
+                        f"-m {proto} --dport {p.port} -m comment "
+                        f'--comment "{key}:{p.name or p.port} cluster IP" '
+                        f"-j {svc_chain}")
+                    if r.svc_type in ("NodePort", "LoadBalancer") and p.node_port:
+                        rules.append(
+                            f"-A KUBE-NODEPORTS -p {proto} -m {proto} "
+                            f"--dport {p.node_port} -m comment "
+                            f'--comment "{key}:{p.name or p.port}" '
+                            f"-j KUBE-MARK-MASQ")
+                        rules.append(
+                            f"-A KUBE-NODEPORTS -p {proto} -m {proto} "
+                            f"--dport {p.node_port} -j {svc_chain}")
+            else:
+                rules.append(
+                    f'-A KUBE-SERVICES -m comment --comment "{key}" -j {svc_chain}')
             n = len(r.backends)
-            for i, backend in enumerate(r.backends):
+            affinity = r.session_affinity == "ClientIP"
+            for backend in r.backends:
                 sep_chain = chain_hash("SEP", f"{key}/{backend}")
                 chains.append(f":{sep_chain} - [0:0]")
+                if affinity:
+                    rules.append(
+                        f"-A {svc_chain} -m recent --name {sep_chain} "
+                        f"--rcheck --seconds {r.affinity_timeout_s} "
+                        f"--reap -j {sep_chain}")
+            for i, backend in enumerate(r.backends):
+                sep_chain = chain_hash("SEP", f"{key}/{backend}")
                 if i < n - 1:
                     prob = 1.0 / (n - i)
                     rules.append(
@@ -124,6 +268,9 @@ class Proxier:
                         f"--probability {prob:.10f} -j {sep_chain}")
                 else:
                     rules.append(f"-A {svc_chain} -j {sep_chain}")
+                if affinity:
+                    rules.append(
+                        f"-A {sep_chain} -m recent --name {sep_chain} --set")
                 rules.append(
                     f'-A {sep_chain} -m comment --comment "{backend}" '
                     f"-j DNAT --to-destination {backend}")
@@ -131,19 +278,31 @@ class Proxier:
 
     def render_ipvs(self) -> str:
         """The rules in ipvsadm-save form — the ipvs proxier's dataplane
-        contract (pkg/proxy/ipvs/proxier.go syncProxyRules: one virtual
-        server per service with round-robin scheduling, one real server
-        per ready endpoint). Virtual addresses are the service keys bound
-        to the kube-ipvs0 dummy interface in the reference; here the key
-        names the virtual service the way --to-destination names the
-        backend in the iptables text."""
+        contract (pkg/proxy/ipvs/proxier.go syncProxyRules): one virtual
+        server per (clusterIP, port) and per nodePort, rr scheduling, one
+        real server per ready endpoint; ClientIP affinity maps to `-p
+        <timeout>` persistence on the virtual server."""
         lines = []
         with self._lock:
             snapshot = sorted(self.rules.items())
         for key, r in snapshot:
-            lines.append(f"-A -t {key} -s rr")
-            for backend in r.backends:
-                lines.append(f"-a -t {key} -r {backend} -m -w 1")
+            persist = (f" -p {r.affinity_timeout_s}"
+                       if r.session_affinity == "ClientIP" else "")
+            vservers = []
+            if r.cluster_ip and r.ports:
+                for p in r.ports:
+                    vservers.append(
+                        (f"{r.cluster_ip}:{p.port}", p.protocol.lower()))
+                    if r.svc_type in ("NodePort", "LoadBalancer") and p.node_port:
+                        vservers.append(
+                            (f"nodeport:{p.node_port}", p.protocol.lower()))
+            else:
+                vservers.append((key, "tcp"))
+            for vaddr, proto in vservers:
+                flag = "-u" if proto == "udp" else "-t"
+                lines.append(f"-A {flag} {vaddr} -s rr{persist}")
+                for backend in r.backends:
+                    lines.append(f"-a {flag} {vaddr} -r {backend} -m -w 1")
         return "\n".join(lines + [""])
 
     def stale_conntrack_entries(self, before: Dict[str, Tuple[str, ...]]

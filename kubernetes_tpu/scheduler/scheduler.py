@@ -228,7 +228,7 @@ class Scheduler:
         state = CycleState()
         t0 = self.now_fn()
         try:
-            node_name = self.schedule_pod(fwk, state, pod)
+            node_name = self.schedule_pod(fwk, state, pod, attempts=qp.attempts)
         except FitError as fe:
             self.smetrics.observe_attempt("unschedulable", fwk.profile_name, self.now_fn() - t0)
             self._handle_scheduling_failure(fwk, state, qp, Status.unschedulable(*fe.args), fe.diagnosis, pod_cycle)
@@ -343,14 +343,16 @@ class Scheduler:
                     return Status.error(f"extender bind: {e}")
         return None
 
-    def schedule_pod(self, fwk: Framework, state: CycleState, pod: Pod) -> str:
+    def schedule_pod(self, fwk: Framework, state: CycleState, pod: Pod,
+                     attempts: int = 0) -> str:
         """(schedule_one.go:311) returns the chosen node name or raises FitError."""
         from ..utils import tracing
 
         with tracing.span("scheduling.cycle", pod=pod.key()):
-            return self._schedule_pod_traced(fwk, state, pod)
+            return self._schedule_pod_traced(fwk, state, pod, attempts)
 
-    def _schedule_pod_traced(self, fwk: Framework, state: CycleState, pod: Pod) -> str:
+    def _schedule_pod_traced(self, fwk: Framework, state: CycleState, pod: Pod,
+                             attempts: int = 0) -> str:
         trace = Trace("Scheduling", now_fn=self.now_fn, pod=pod.key())
         self.cache.update_snapshot(self.snapshot)
         trace.step("Snapshotting scheduler cache and node infos done")
@@ -385,7 +387,7 @@ class Scheduler:
                 for name, score in prios.items():
                     if name in totals:
                         totals[name] += score * ext.weight()
-        return self._select_host(totals)
+        return self._select_host(totals, pod=pod, attempts=attempts)
 
     def find_nodes_that_fit_pod(self, fwk: Framework, state: CycleState, pod: Pod, all_nodes) -> Tuple[List, Diagnosis]:
         """(schedule_one.go:364) PreFilter → (restricted) node list → filters
@@ -465,10 +467,30 @@ class Scheduler:
         return num_feasible_nodes_to_find(num_all_nodes,
                                           self.percentage_of_nodes_to_score)
 
-    def _select_host(self, totals: Dict[str, int]) -> str:
-        """(schedule_one.go:709) argmax + reservoir uniform tie-break."""
+    def _select_host(self, totals: Dict[str, int], pod: Optional[Pod] = None,
+                     attempts: int = 0) -> str:
+        """(schedule_one.go:709) argmax + uniform tie-break. The reference's
+        reservoir draw is unseeded; here the tie set is broken by the seeded
+        per-(pod, attempt, node-name) hash the device batch program also uses
+        (ops/tiebreak.py, SURVEY §8) — same uniform choice, but exactly
+        replayable. Without a pod (legacy callers), falls back to the
+        seeded-RNG reservoir."""
         best_score = None
         winner = None
+        if pod is not None:
+            from ..ops.tiebreak import name_hash, pod_seed, tie_key
+
+            seed = pod_seed(pod.key(), attempts)
+            best_key = -1
+            for name, score in totals.items():
+                if best_score is None or score > best_score:
+                    best_score, winner = score, name
+                    best_key = tie_key(seed, name_hash(name))
+                elif score == best_score:
+                    k = tie_key(seed, name_hash(name))
+                    if k > best_key:
+                        winner, best_key = name, k
+            return winner
         cnt = 0
         for name, score in totals.items():
             if best_score is None or score > best_score:
