@@ -862,8 +862,16 @@ def schedule_batch_core(
     host_key: int = 0,
     spec_decode: bool = False,
     ports_enabled: bool = True,
+    extra_mask: Optional[jax.Array] = None,
 ) -> BatchResult:
     """The traceable body; nt's node axis may be a shard (axis_name set).
+
+    ``extra_mask`` (optional [P, N] bool) is a host-computed static
+    feasibility pre-pass ANDed into the static filter phase — today the
+    volume-bindability screen (ops/volume_mask.py). Attributed as
+    "VolumeBinding" in the first-fail table (id 9); the reference would
+    blame an earlier plugin when e.g. ports ALSO fail on the same node —
+    a documented attribution-precision divergence, not a placement one.
     ``topo_enabled`` is a trace-time flag: batches with no spread constraints,
     no affinity terms and no registered count rows compile a program with the
     whole topology path dead-code-eliminated (the common fast case).
@@ -904,11 +912,15 @@ def schedule_batch_core(
     static_ok = nt.valid[None, :] & pb.valid[:, None]
     for m in static_masks.values():
         static_ok = static_ok & m
+    if extra_mask is not None:
+        static_ok = static_ok & extra_mask
 
     # static half of the first-failing-plugin table (ids follow the filter
     # config order in tpu_scheduler._ATTRIBUTION_ORDER; 0 = passes). Reverse
     # assignment order makes the earliest failing plugin win.
     static_ff = jnp.zeros(static_ok.shape, jnp.int8)
+    if extra_mask is not None:
+        static_ff = jnp.where(~extra_mask, np.int8(9), static_ff)
     for sid, name in ((4, "NodeAffinity"), (3, "TaintToleration"),
                       (2, "NodeName"), (1, "NodeUnschedulable")):
         static_ff = jnp.where(~static_masks[name], np.int8(sid), static_ff)
@@ -1266,13 +1278,15 @@ def schedule_batch(
     host_key: int = 0,
     spec_decode: bool = False,
     ports_enabled: bool = True,
+    extra_mask: Optional[jax.Array] = None,
 ) -> BatchResult:
     return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
                                pallas=pallas, topo_carry=topo_carry,
                                sample_k=sample_k, sample_start=sample_start,
                                topo_mode=topo_mode, vd_override=vd_override,
                                host_key=host_key, spec_decode=spec_decode,
-                               ports_enabled=ports_enabled)
+                               ports_enabled=ports_enabled,
+                               extra_mask=extra_mask)
 
 
 def spec_decode_eligible(sample_k) -> bool:
@@ -1306,17 +1320,20 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
 
     def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
            sample_k=None, sample_start=None, topo_mode=None, vd_override=None,
-           host_key=0, ports_enabled=True):
+           host_key=0, ports_enabled=True, extra_mask=None):
         spec = spec_decode_eligible(sample_k)
         # the pallas fused step has no sampling emulation yet; the
-        # speculative path replaces it where both apply (fewer device steps)
-        mode = (None if (sample_k is not None or spec)
+        # speculative path replaces it where both apply (fewer device steps).
+        # The fused kernel has no extra-mask input either — a volume batch
+        # takes the XLA path.
+        mode = (None if (sample_k is not None or spec or extra_mask is not None)
                 else pallas_mode(nt, None, topo_enabled))
         return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
                               topo_enabled=topo_enabled, pallas=mode,
                               topo_carry=topo_carry, sample_k=sample_k,
                               sample_start=sample_start, topo_mode=topo_mode,
                               vd_override=vd_override, host_key=host_key,
-                              spec_decode=spec, ports_enabled=ports_enabled)
+                              spec_decode=spec, ports_enabled=ports_enabled,
+                              extra_mask=extra_mask)
 
     return fn

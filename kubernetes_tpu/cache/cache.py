@@ -223,6 +223,9 @@ class Cache:
                 if ni.generation > snapshot.generation:
                     if not structural and snapshot.order_affected_by(name, ni.node):
                         structural = True
+                    prev = snapshot.node_info_map.get(name)
+                    if prev is None or prev.node is not ni.node:
+                        snapshot.node_object_version += 1
                     snapshot.node_info_map[name] = ni.clone()
                     snapshot.changed_names.add(name)
                     batch_changed.add(name)

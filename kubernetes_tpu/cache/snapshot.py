@@ -37,6 +37,10 @@ class Snapshot:
         # reconcile/has_dirty probe O(changes) instead of O(nodes)
         self.changed_names: Set[str] = set()
         self.structure_version: int = 0
+        # bumps whenever a re-cloned NodeInfo carries a DIFFERENT Node object
+        # (labels/taints/allocatable may have changed) — consumers caching
+        # label-derived indexes (ops/volume_mask.py) key on it
+        self.node_object_version: int = 0
 
     def get(self, name: str) -> Optional[NodeInfo]:
         return self.node_info_map.get(name)

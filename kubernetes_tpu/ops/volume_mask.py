@@ -1,0 +1,164 @@
+"""Host-vectorized volume-bindability pre-pass for the batched scheduler
+(VERDICT r4 item 4; reference: volumebinding/binder.go FindPodVolumes,
+volumezone/volume_zone.go, nodevolumelimits/*).
+
+Volume-bearing pods used to take the sequential oracle path wholesale
+(batch_supported=False), paying O(nodes × PVs) host Python per pod — the
+40 pods/s InTreePVs/CSIPVs rows. This module computes a [P, N] boolean
+bindability mask per batch instead:
+
+  * bound claims  → the PV's admitted-node set (node-affinity label terms,
+    vectorized over the node slot table; a PV with no affinity admits all)
+  * delayed (WaitForFirstConsumer) claims → per-(class) free-PV node counts
+    must cover the pod's per-class claim count (Hall's condition is only
+    approximated — see below)
+Attach limits (nodevolumelimits) are NOT screened here — per-type/driver
+limits vary by CSINode and cluster config, and any fixed bound would
+under-admit; the exact limit plugins run in the commit-path host verify.
+
+The mask is deliberately ONE-SIDED: it may over-admit (attach-limit races
+inside a batch, multi-claim matching subtleties) but never under-admits a
+node the oracle would accept. The commit path re-runs the exact volume
+filter plugins on the CHOSEN node only (host verify, O(PVs) once per pod
+instead of per node); an over-admitted choice fails there and the pod
+retries — crash-only, same shape as the preemption screen's
+"device proposes, host verifies".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api.types import BINDING_WAIT_FOR_FIRST_CONSUMER
+
+class VolumeMaskBuilder:
+    """Per-scheduler cache of PV → admitted-slot sets, keyed by the encoder's
+    slot table version (slots churn with node add/remove)."""
+
+    def __init__(self, client):
+        self.client = client
+        self._pv_slots: Dict[str, Tuple[int, Optional[np.ndarray]]] = {}
+        self._label_index_key = None
+        self._label_index: Dict[Tuple[str, str], List[int]] = {}
+
+    # -- helpers
+
+    def batchable(self, pod) -> bool:
+        """Cheap per-pod gate: every claim resolvable and either bound or
+        delayed-binding (immediate-unbound pods go to the oracle, which
+        rejects them exactly — volume_binding.go:207)."""
+        for claim in pod.spec.volumes:
+            pvc = self.client.get_pvc(f"{pod.meta.namespace}/{claim}")
+            if pvc is None:
+                return False
+            if not pvc.bound_pv:
+                sc = self.client.get_storage_class(pvc.storage_class)
+                if sc is None or sc.volume_binding_mode != BINDING_WAIT_FOR_FIRST_CONSUMER:
+                    return False
+        return True
+
+    def _node_label_index(self, snapshot, version) -> Dict[Tuple[str, str], List[int]]:
+        if self._label_index_key != version:
+            self._label_index = {}
+            for ni in snapshot.node_info_list:
+                node = ni.node
+                slot = self._slot_of.get(node.meta.name)
+                if slot is None:
+                    continue
+                for k, v in node.meta.labels.items():
+                    self._label_index.setdefault((k, v), []).append(slot)
+            self._label_index_key = version
+        return self._label_index
+
+    # zone/region label keys a bound PV constrains (volume_zone.go:88)
+    _ZONE_KEYS = (
+        "topology.kubernetes.io/zone",
+        "topology.kubernetes.io/region",
+        "failure-domain.beta.kubernetes.io/zone",
+        "failure-domain.beta.kubernetes.io/region",
+    )
+
+    def _pv_admitted(self, pv, snapshot, version, n_cap) -> Optional[np.ndarray]:
+        """[N] bool of slots this PV admits: node-affinity label terms AND
+        the VolumeZone rule (PV zone/region labels must match the node's;
+        `__`-separated multi-zone values allowed). None = all nodes."""
+        constraints = list(pv.node_affinity.items())
+        for key in self._ZONE_KEYS:
+            val = pv.meta.labels.get(key)
+            if val is not None:
+                constraints.append((key, tuple(val.split("__"))))
+        if not constraints:
+            return None
+        cache_key = (version, pv.meta.resource_version)
+        cached = self._pv_slots.get(pv.meta.name)
+        if cached is not None and cached[0] == cache_key:
+            return cached[1]
+        idx = self._node_label_index(snapshot, version)
+        mask = np.zeros(n_cap, bool)
+        first = True
+        for key, allowed in constraints:
+            term = np.zeros(n_cap, bool)
+            for v in allowed:
+                for slot in idx.get((key, v), ()):
+                    term[slot] = True
+            mask = term if first else (mask & term)
+            first = False
+        self._pv_slots[pv.meta.name] = (cache_key, mask)
+        return mask
+
+    # -- the batch mask
+
+    def build(self, qps, snapshot, encoder, n_cap: int,
+              pad_to: int) -> Optional[np.ndarray]:
+        """[pad_to, n_cap] bool; None when no pod in the batch has volumes.
+        Rows for volume-less (and padding) pods are all-True."""
+        if not any(qp.pod.spec.volumes for qp in qps):
+            return None
+        self._slot_of = encoder.node_slots
+        version = (len(encoder.node_slots),
+                   getattr(snapshot, "structure_version", -1),
+                   getattr(snapshot, "node_object_version", -1))
+        mask = np.ones((pad_to, n_cap), bool)
+
+        # delayed-binding pools: per storage class, free-PV counts per node
+        free_by_class: Dict[str, np.ndarray] = {}
+
+        for p, qp in enumerate(qps):
+            pod = qp.pod
+            if not pod.spec.volumes:
+                continue
+            row = mask[p]
+            delayed_needs: Dict[str, int] = {}
+            for claim in pod.spec.volumes:
+                pvc = self.client.get_pvc(f"{pod.meta.namespace}/{claim}")
+                if pvc is None:
+                    # batchable() should have routed this to the oracle;
+                    # admit-all keeps the one-sided contract if it races
+                    continue
+                if pvc.bound_pv:
+                    pv = self.client.get_pv(pvc.bound_pv)
+                    if pv is None:
+                        continue  # dangling bind: the oracle filters skip it too
+                    admitted = self._pv_admitted(pv, snapshot, version, n_cap)
+                    if admitted is not None:
+                        row &= admitted
+                else:
+                    delayed_needs[pvc.storage_class] = (
+                        delayed_needs.get(pvc.storage_class, 0) + 1)
+            for cls, need in delayed_needs.items():
+                free = free_by_class.get(cls)
+                if free is None:
+                    free = np.zeros(n_cap, np.int32)
+                    for pv in self.client.list_pvs():
+                        if pv.bound_pvc or pv.storage_class != cls:
+                            continue
+                        admitted = self._pv_admitted(pv, snapshot, version, n_cap)
+                        if admitted is None:
+                            free += 1
+                        else:
+                            free += admitted.astype(np.int32)
+                    free_by_class[cls] = free
+                row &= free >= need
+        return mask

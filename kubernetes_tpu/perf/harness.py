@@ -324,8 +324,13 @@ class Runner:
         # variants the real batches will actually run.
         warm = getattr(self.scheduler, "warm_buckets", None)
         if warm is not None:
-            sample = _pod_wrapper(10 ** 9, prefix, params).obj()  # never stored
-            warm(sample_pods=[sample])
+            spw = _pod_wrapper(10 ** 9, prefix, params)  # never stored
+            if params.get("pvc"):
+                # PVC workloads dispatch with the volume pre-pass mask — a
+                # distinct trace signature warm_buckets compiles only when
+                # the sample carries a volume
+                spw.pvc("__warm__")
+            warm(sample_pods=[spw.obj()])
         col = ThroughputCollector(scheduled_count, interval=collector_interval)
         col.start(time.monotonic())
         for _ in range(count):

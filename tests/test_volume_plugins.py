@@ -139,9 +139,10 @@ def test_rwop_cluster_wide_at_prefilter():
     assert second.status.nominated_node_name == ""
 
 
-def test_tpu_backend_routes_volume_pods_to_host_path():
-    """The batched kernel doesn't model volumes; PVC pods must take the
-    sequential fallback so VolumeBinding/Zone semantics hold."""
+def test_tpu_backend_batches_volume_pods_with_mask():
+    """PVC pods ride the batched path (ops/volume_mask.py pre-pass + exact
+    host verify of the chosen node) — VolumeZone semantics hold WITHOUT the
+    sequential fallback (VERDICT r4 item 4)."""
     from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
 
     store = mk_store(n_nodes=2, zone=True)
@@ -155,7 +156,20 @@ def test_tpu_backend_routes_volume_pods_to_host_path():
     s.run_until_settled()
     assert store.get_pod("default/vp").spec.node_name == "node-1"  # zone matched
     assert store.get_pod("default/plain").spec.node_name != ""
-    assert s.fallback_scheduled >= 1
+    assert s.fallback_scheduled == 0  # the mask kept it on the batch path
+
+
+def test_tpu_backend_unscreenable_volume_pod_falls_back():
+    """A pod whose claim the mask can't screen (missing PVC) keeps the
+    sequential fallback path."""
+    from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+    store = mk_store(n_nodes=2, zone=True)
+    s = TPUScheduler(store, batch_size=8)
+    store.create_pod(make_pod("ghost").req({"cpu": "100m"}).pvc("nope").obj())
+    s.run_until_settled(max_cycles=20, flush=True)
+    ghost = store.get_pod("default/ghost")
+    assert ghost.spec.node_name == ""  # unresolvable claim never binds
 
 
 def test_smallest_fitting_pv_chosen():
