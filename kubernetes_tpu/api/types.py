@@ -489,6 +489,34 @@ def get_zone_key(node: "Node") -> str:
 
 
 @dataclass
+class CustomResourceDefinition:
+    """apiextensions.k8s.io/v1 CustomResourceDefinition, reduced to the
+    registration surface the dynamic-kind store path consumes
+    (staging/src/k8s.io/apiextensions-apiserver/pkg/apis/apiextensions/v1):
+    group + names + served version + scope. The schema/conversion machinery
+    is out of scope — custom objects carry free-form spec dicts."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)  # name = plural.group
+    group: str = ""
+    version: str = "v1"
+    kind: str = ""
+    plural: str = ""
+    namespaced: bool = True
+
+
+@dataclass
+class CustomResource:
+    """A dynamic-kind object: typed meta + free-form spec/status payloads
+    (the unstructured.Unstructured analog)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    api_version: str = ""
+    kind: str = ""
+    spec: Dict[str, object] = field(default_factory=dict)
+    status: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
 class Namespace:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
 

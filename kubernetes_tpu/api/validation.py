@@ -208,20 +208,112 @@ def _validate_spread_constraints(constraints, path) -> List[str]:
             errs.append(f"{p}.topologyKey: duplicate constraint "
                         f"{{{c.topology_key}, {c.when_unsatisfiable}}}")
         seen.add(dup)
+        # validateMinDomains: ≥ 1, and only with DoNotSchedule
+        md = getattr(c, "min_domains", None)
+        if md is not None:
+            if md < 1:
+                errs.append(f"{p}.minDomains: {md} must be greater than 0")
+            if c.when_unsatisfiable != "DoNotSchedule":
+                errs.append(f"{p}.minDomains: can only be specified when "
+                            "whenUnsatisfiable is DoNotSchedule")
+        errs += _validate_label_selector(getattr(c, "label_selector", None),
+                                        f"{p}.labelSelector")
+    return errs
+
+
+_SELECTOR_SET_OPS = {"In", "NotIn"}
+_SELECTOR_EXIST_OPS = {"Exists", "DoesNotExist"}
+_SELECTOR_NUM_OPS = {"Gt", "Lt"}
+
+
+def _validate_requirement(req, path, node: bool) -> List[str]:
+    """ValidateLabelSelectorRequirement / ValidateNodeSelectorRequirement:
+    operator domain; In/NotIn need ≥1 value; Exists/DoesNotExist forbid
+    values; node-only Gt/Lt need exactly one integer value."""
+    errs = [f"{path}.key: {m}" for m in is_qualified_name(req.key)] if req.key \
+        else [f"{path}.key: key is required"]
+    op = req.operator
+    allowed = _SELECTOR_SET_OPS | _SELECTOR_EXIST_OPS | (
+        _SELECTOR_NUM_OPS if node else set())
+    if op not in allowed:
+        errs.append(f"{path}.operator: {op!r} is not a valid operator")
+        return errs
+    if op in _SELECTOR_SET_OPS and not req.values:
+        errs.append(f"{path}.values: must be specified when operator is {op}")
+    if op in _SELECTOR_EXIST_OPS and req.values:
+        errs.append(f"{path}.values: may not be specified when operator is {op}")
+    if op in _SELECTOR_NUM_OPS:
+        if len(req.values) != 1:
+            errs.append(f"{path}.values: must have a single element for {op}")
+        else:
+            try:
+                int(req.values[0])
+            except ValueError:
+                errs.append(f"{path}.values[0]: {req.values[0]!r} must be an integer")
+    return errs
+
+
+def _validate_label_selector(sel, path) -> List[str]:
+    """ValidateLabelSelector (metav1 validation)."""
+    if sel is None:
+        return []
+    errs = validate_labels(sel.match_labels, f"{path}.matchLabels")
+    for i, req in enumerate(sel.match_expressions or ()):
+        errs += _validate_requirement(req, f"{path}.matchExpressions[{i}]",
+                                      node=False)
+    return errs
+
+
+def _validate_pod_affinity_term(term, path) -> List[str]:
+    """validatePodAffinityTerm (validation.go:3280): topologyKey required,
+    selector shapes valid, namespace names valid."""
+    errs = []
+    if not term.topology_key:
+        errs.append(f"{path}.topologyKey: can not be empty")
+    errs += _validate_label_selector(term.label_selector, f"{path}.labelSelector")
+    errs += _validate_label_selector(term.namespace_selector,
+                                     f"{path}.namespaceSelector")
+    for i, ns in enumerate(term.namespaces or ()):
+        if not is_dns1123_label(ns):
+            errs.append(f"{path}.namespaces[{i}]: {ns!r} must be a DNS label")
     return errs
 
 
 def _validate_affinity(affinity, path) -> List[str]:
-    """validateAffinity: preferred term weights in 1-100."""
+    """validateAffinity (validation.go:3236): node selector terms' expression
+    shape, pod (anti-)affinity term shape, preferred weights in 1-100."""
     errs = []
     if affinity is None:
         return errs
-    for attr in ("preferred_node_terms", "preferred_pod_affinity",
-                 "preferred_pod_anti_affinity"):
-        for i, term in enumerate(getattr(affinity, attr, ()) or ()):
-            w = getattr(term, "weight", 1)
-            if not (1 <= w <= 100):
-                errs.append(f"{path}.{attr}[{i}].weight: {w} must be in 1-100")
+    na = affinity.node_affinity
+    if na is not None:
+        base = f"{path}.nodeAffinity"
+        if na.required is not None:
+            for ti, term in enumerate(na.required.terms or ()):
+                tp = f"{base}.required.nodeSelectorTerms[{ti}]"
+                for ei, req in enumerate(term.match_expressions or ()):
+                    errs += _validate_requirement(
+                        req, f"{tp}.matchExpressions[{ei}]", node=True)
+        for pi, pref in enumerate(na.preferred or ()):
+            pp = f"{base}.preferred[{pi}]"
+            if not (1 <= pref.weight <= 100):
+                errs.append(f"{pp}.weight: {pref.weight} must be in the range 1-100")
+            for ei, req in enumerate(pref.preference.match_expressions or ()):
+                errs += _validate_requirement(
+                    req, f"{pp}.preference.matchExpressions[{ei}]", node=True)
+    for attr, key in (("pod_affinity", "podAffinity"),
+                      ("pod_anti_affinity", "podAntiAffinity")):
+        pa = getattr(affinity, attr)
+        if pa is None:
+            continue
+        base = f"{path}.{key}"
+        for ti, term in enumerate(pa.required or ()):
+            errs += _validate_pod_affinity_term(term, f"{base}.required[{ti}]")
+        for ti, wt in enumerate(pa.preferred or ()):
+            tp = f"{base}.preferred[{ti}]"
+            if not (1 <= wt.weight <= 100):
+                errs.append(f"{tp}.weight: {wt.weight} must be in the range 1-100")
+            errs += _validate_pod_affinity_term(wt.term, f"{tp}.podAffinityTerm")
     return errs
 
 
@@ -238,6 +330,19 @@ def validate_pod(pod) -> List[str]:
         if c.name in main:
             errs.append(f"spec.initContainers[{i}].name: duplicates a "
                         f"container name {c.name!r}")
+    # AccumulateUniqueHostPorts (validation.go:3003): a (hostIP, protocol,
+    # hostPort) triple may appear at most once across the pod's containers
+    seen_hp = set()
+    for ci, c in enumerate(spec.containers or ()):
+        for pi, port in enumerate(getattr(c, "ports", ()) or ()):
+            hp = getattr(port, "host_port", 0)
+            if not hp:
+                continue
+            key = (getattr(port, "host_ip", ""), getattr(port, "protocol", "TCP"), hp)
+            if key in seen_hp:
+                errs.append(f"spec.containers[{ci}].ports[{pi}].hostPort: "
+                            f"duplicate host port {key}")
+            seen_hp.add(key)
     errs += _validate_tolerations(spec.tolerations, "spec.tolerations")
     errs += _validate_spread_constraints(
         spec.topology_spread_constraints, "spec.topologySpreadConstraints")
@@ -278,6 +383,7 @@ def validate_pod_update(old, new) -> List[str]:
 def validate_node(node) -> List[str]:
     """ValidateNode (validation.go:5022): meta + taint domains + capacity."""
     errs = validate_object_meta(node.meta, requires_namespace=False)
+    seen_taints = set()
     for i, t in enumerate(node.spec.taints or ()):
         p = f"spec.taints[{i}]"
         if not t.key:
@@ -287,6 +393,13 @@ def validate_node(node) -> List[str]:
         if t.effect not in VALID_TAINT_EFFECTS:
             errs.append(f"{p}.effect: {t.effect!r} must be one of "
                         f"{sorted(VALID_TAINT_EFFECTS)}")
+        if t.value and _LABEL_VALUE.match(t.value) is None:
+            errs.append(f"{p}.value: {t.value!r} is not a valid taint value")
+        # validateNodeTaints: duplicate (key, effect) pairs rejected
+        pair = (t.key, t.effect)
+        if pair in seen_taints:
+            errs.append(f"{p}: duplicate taint {pair}")
+        seen_taints.add(pair)
     for res, q in (node.status.capacity or {}).items():
         try:
             if resource_api.canonical(res, q) < 0:

@@ -80,6 +80,8 @@ RESOURCES = {
     ("apis/node.k8s.io/v1", "runtimeclasses"): "RuntimeClass",
     ("apis/networking.k8s.io/v1", "ingresses"): "Ingress",
     ("apis/networking.k8s.io/v1", "ingressclasses"): "IngressClass",
+    ("apis/apiextensions.k8s.io/v1", "customresourcedefinitions"):
+        "CustomResourceDefinition",
     ("api/v1", "events"): "Event",
 }
 
@@ -113,6 +115,28 @@ class _Handler(BaseHTTPRequestHandler):
     auth = None                 # Optional[AuthConfig], bound by serve_api()
     protocol_version = "HTTP/1.1"
 
+    def _resolve(self, path: str):
+        """Static route table first, then registered CRDs (the
+        apiextensions customresource_handler.go dynamic path)."""
+        r = _route(path)
+        if r is not None:
+            return r
+        parts = [p for p in path.split("/") if p]
+        if len(parts) >= 4 and parts[0] == "apis":
+            group, version = parts[1], parts[2]
+            rest = parts[3:]
+            ns = None
+            if len(rest) >= 3 and rest[0] == "namespaces":
+                ns = rest[1]
+                rest = rest[2:]
+            if rest:
+                crd = self.store.crd_for_plural(group, rest[0])
+                if crd is not None and crd.version == version:
+                    name = rest[1] if len(rest) > 1 else None
+                    sub = rest[2] if len(rest) > 2 else None
+                    return (f"apis/{group}/{version}", crd.kind, ns, name, sub)
+        return None
+
     def log_message(self, *args):
         pass
 
@@ -126,7 +150,7 @@ class _Handler(BaseHTTPRequestHandler):
             q = parse_qs(url.query)
             if q.get("watch", ["0"])[0] in ("1", "true"):
                 return "watch"
-            r = _route(url.path)
+            r = self._resolve(url.path)
             return "get" if (r is not None and r[3] is not None) else "list"
         return self._VERB_BY_METHOD.get(self.command, "get")
 
@@ -172,7 +196,7 @@ class _Handler(BaseHTTPRequestHandler):
                             "request rejected by priority-and-fairness")
                 return None
         if cfg is not None and cfg.authorizer is not None:
-            r = _route(urlparse(self.path).path)
+            r = self._resolve(urlparse(self.path).path)
             kind = r[1] if r is not None else ""
             name = r[3] or "" if r is not None else ""
             sub = r[4] or "" if r is not None else ""
@@ -245,6 +269,39 @@ class _Handler(BaseHTTPRequestHandler):
             if got_kind != kind:
                 raise ValueError(f"protobuf body is a {got_kind}, not {kind}")
             return obj
+        if kind == "CustomResourceDefinition" and "metadata" in body:
+            # apiextensions manifest: registration fields live at the top
+            # level of the reduced CRD model
+            from ..api.corev1 import meta_from
+            from ..api.types import CustomResourceDefinition
+
+            spec = body.get("spec") or {}
+            names = spec.get("names") or {}
+            versions = spec.get("versions") or ()
+            version = (versions[0].get("name", "v1") if versions
+                       else body.get("version", "v1"))
+            return CustomResourceDefinition(
+                meta=meta_from(body.get("metadata") or {}),
+                group=spec.get("group", body.get("group", "")),
+                version=version,
+                kind=names.get("kind", body.get("kind_", "")),
+                plural=names.get("plural", body.get("plural", "")),
+                namespaced=(spec.get("scope", "Namespaced") == "Namespaced"
+                            if "scope" in spec
+                            else bool(body.get("namespaced", True))),
+            )
+        if kind not in _KIND_TYPES:
+            # dynamic (CRD-served) kind: manifest-shaped body → CustomResource
+            from ..api.corev1 import meta_from
+            from ..api.types import CustomResource
+
+            return CustomResource(
+                meta=meta_from(body.get("metadata") or {}),
+                api_version=body.get("apiVersion", ""),
+                kind=body.get("kind", kind),
+                spec=dict(body.get("spec") or {}),
+                status=dict(body.get("status") or {}),
+            )
         if "apiVersion" in body and "metadata" in body:
             # a manifest-shaped body MUST decode through the scheme: an
             # unregistered apiVersion is a clear 400, never a silent
@@ -260,9 +317,12 @@ class _Handler(BaseHTTPRequestHandler):
             return obj
         return from_wire(_KIND_TYPES[kind], body)
 
+    def _cluster_scoped(self, kind: str) -> bool:
+        return self.store.is_cluster_scoped(kind)
+
     def _match(self, kind: str, ns: Optional[str], obj) -> bool:
-        return ns is None or kind in self.store.CLUSTER_SCOPED_KINDS \
-            or obj.meta.namespace == ns
+        return (ns is None or self._cluster_scoped(kind)
+                or obj.meta.namespace == ns)
 
     # ------------------------------------------------------------- verbs
 
@@ -277,7 +337,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_get(self):
         url = urlparse(self.path)
-        r = _route(url.path)
+        r = self._resolve(url.path)
         if r is None:
             return self._error(404, "NotFound", f"unknown path {url.path}")
         _g, kind, ns, name, _sub = r
@@ -308,7 +368,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "metadata": {"resourceVersion": str(rv)},
                 "items": [self._obj_wire(kind, o) for o in matched],
             })
-        key = name if kind in self.store.CLUSTER_SCOPED_KINDS else f"{ns}/{name}"
+        key = name if self._cluster_scoped(kind) else f"{ns}/{name}"
         obj = self.store.get_object(kind, key)
         if obj is None or not self._match(kind, ns, obj):
             return self._error(404, "NotFound", f"{kind} {key} not found")
@@ -364,7 +424,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_post(self):
         body = self._body()  # drain FIRST: keep-alive sockets must not carry leftovers
-        r = _route(urlparse(self.path).path)
+        r = self._resolve(urlparse(self.path).path)
         if r is None:
             return self._error(404, "NotFound", "unknown path")
         _g, kind, ns, name, sub = r
@@ -386,7 +446,7 @@ class _Handler(BaseHTTPRequestHandler):
             obj = self._decode_body(kind, body)
         except Exception as e:  # noqa: BLE001 — malformed body is a 400
             return self._error(400, "BadRequest", f"decode: {e}")
-        if ns is not None and kind not in self.store.CLUSTER_SCOPED_KINDS:
+        if ns is not None and not self._cluster_scoped(kind):
             obj.meta.namespace = ns
         try:
             self.store.create_object(kind, obj)
@@ -409,7 +469,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_put(self):
         body = self._body()  # drain first (keep-alive)
-        r = _route(urlparse(self.path).path)
+        r = self._resolve(urlparse(self.path).path)
         if r is None or r[3] is None:
             return self._error(404, "NotFound", "unknown path")
         _g, kind, ns, name, _sub = r
@@ -421,7 +481,7 @@ class _Handler(BaseHTTPRequestHandler):
             return self._error(400, "BadRequest",
                                f"body name {obj.meta.name!r} != URL name {name!r}")
         obj.meta.name = name
-        if ns is not None and kind not in self.store.CLUSTER_SCOPED_KINDS:
+        if ns is not None and not self._cluster_scoped(kind):
             obj.meta.namespace = ns
         try:
             self.store.update_object(kind, obj)
@@ -446,11 +506,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_delete(self):
         self._body()  # drain DeleteOptions bodies (keep-alive invariant)
-        r = _route(urlparse(self.path).path)
+        r = self._resolve(urlparse(self.path).path)
         if r is None or r[3] is None:
             return self._error(404, "NotFound", "unknown path")
         _g, kind, ns, name, _sub = r
-        key = name if kind in self.store.CLUSTER_SCOPED_KINDS else f"{ns}/{name}"
+        key = name if self._cluster_scoped(kind) else f"{ns}/{name}"
         if kind == "Pod":
             try:
                 self.store.delete_pod(key)

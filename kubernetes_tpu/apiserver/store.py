@@ -143,6 +143,12 @@ class ClusterStore:
         self.hpas: Dict[str, object] = {}
         self.cluster_roles: Dict[str, object] = {}
         self.cluster_role_bindings: Dict[str, object] = {}
+        # apiextensions (VERDICT r4 item 10): registered CRDs + one dynamic
+        # kind map per served kind — plugin-requested GVKs get real objects,
+        # journaled watches and informers through the same generic machinery
+        self.crds: Dict[str, object] = {}
+        self._custom_kinds: Dict[str, Dict[str, object]] = {}
+        self._custom_scope: Dict[str, bool] = {}  # kind -> namespaced
         # metrics-API stand-in (metrics.k8s.io): pod key -> milli-cpu usage,
         # fed by the hollow kubelet / tests, read by the HPA controller
         self.pod_metrics: Dict[str, int] = {}
@@ -351,6 +357,8 @@ class ClusterStore:
                 "HorizontalPodAutoscaler": self.hpas,
                 "ClusterRole": self.cluster_roles,
                 "ClusterRoleBinding": self.cluster_role_bindings,
+                "CustomResourceDefinition": self.crds,
+                **self._custom_kinds,
             }
 
     def _kind_map(self, kind: str) -> Dict[str, object]:
@@ -508,10 +516,47 @@ class ClusterStore:
         "RuntimeClass", "IngressClass",
     }
 
+    def is_cluster_scoped(self, kind: str) -> bool:
+        """The one scope rule (consumed by _key_of and the HTTP front)."""
+        if kind in self.CLUSTER_SCOPED_KINDS or kind == "CustomResourceDefinition":
+            return True
+        return kind in self._custom_scope and not self._custom_scope[kind]
+
     def _key_of(self, kind: str, obj) -> str:
-        return obj.meta.name if kind in self.CLUSTER_SCOPED_KINDS else obj.meta.key()
+        return obj.meta.name if self.is_cluster_scoped(kind) else obj.meta.key()
+
+    # -------------------------------------------------------- dynamic kinds
+
+    def create_crd(self, crd) -> None:
+        """Register a dynamic kind (apiextensions customresource_handler.go's
+        discovery/registration step, minus schema validation): after this,
+        the generic create/update/delete/list/watch machinery — and thus
+        reflectors, informers and the scheduler's dynamic event handlers —
+        serve the new kind exactly like a built-in."""
+        with self._lock:
+            name = crd.meta.name or f"{crd.plural}.{crd.group}"
+            crd.meta.name = name
+            if crd.kind in self._kind_maps():
+                raise Conflict(f"kind {crd.kind!r} already served")
+            self._bump(crd)
+            self.crds[name] = crd
+            self._custom_kinds[crd.kind] = {}
+            self._custom_scope[crd.kind] = bool(crd.namespaced)
+            self._journal_event("CustomResourceDefinition", ADDED, None, crd)
+        self._notify("CustomResourceDefinition", ADDED, None, crd)
+
+    def crd_for_plural(self, group: str, plural: str):
+        with self._lock:
+            for crd in self.crds.values():
+                if crd.group == group and crd.plural == plural:
+                    return crd
+        return None
 
     def create_object(self, kind: str, obj) -> None:
+        if kind == "CustomResourceDefinition":
+            # full registration (kind map + scope), not a bare map insert —
+            # a half-registered CRD would 404/crash custom-kind requests
+            return self.create_crd(obj)
         if kind == "Pod":
             # Pods must take the full admission path (atomic quota charge
             # under the lock); two create paths with divergent semantics was

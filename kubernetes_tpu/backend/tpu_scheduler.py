@@ -760,25 +760,39 @@ class TPUScheduler(Scheduler):
         if pb is not None and any(
             int(node_idx[i]) < 0 for i in range(len(qps))
         ) and self._preemption_wired():
-            try:
-                from ..ops.preempt import screen_prefix
+            # cluster-level futility shortcut: when no assigned pod anywhere
+            # has lower priority than a failed pod, eviction cannot help —
+            # synthesize an all-false screen instead of running the device
+            # screen program (it builds [P,N,C,R]-scale intermediates; at 5k
+            # nodes on CPU fallback that one execution dominated the
+            # Unschedulable row's p99)
+            min_prio = self.cache.min_pod_priority()
+            failed_prios = [qp.pod.spec.priority for i, qp in enumerate(qps)
+                            if int(node_idx[i]) < 0]
+            if min_prio is None or all(p <= min_prio for p in failed_prios):
+                screen = np.zeros((len(qps), self.device.caps.nodes), bool)
+                best = np.full(len(qps), -1, np.int32)
+                preempt_hints = (screen, best, dict(self.device.encoder.node_slots))
+            if preempt_hints is None:
+                try:
+                    from ..ops.preempt import screen_prefix
 
-                # a priority class first seen this cycle is still INT_MAX on
-                # device (= never evictable) unless refreshed now
-                self.device._refresh_class_prio()
-                pres = screen_prefix(pb, self.device.nt, result.static_masks,
-                                     node_idx[:len(qps)] < 0)
-                from ..utils import relay
+                    # a priority class first seen this cycle is still INT_MAX
+                    # on device (= never evictable) unless refreshed now
+                    self.device._refresh_class_prio()
+                    pres = screen_prefix(pb, self.device.nt, result.static_masks,
+                                         node_idx[:len(qps)] < 0)
+                    from ..utils import relay
 
-                relay.count_sync("preempt-read")
-                screen = np.asarray(pres.screen)
-                best = np.asarray(pres.best)
-                slot_of = dict(self.device.encoder.node_slots)
-                preempt_hints = (screen, best, slot_of)
-            except Exception:  # noqa: BLE001 — hints are an optimization only
-                import logging
+                    relay.count_sync("preempt-read")
+                    screen = np.asarray(pres.screen)
+                    best = np.asarray(pres.best)
+                    slot_of = dict(self.device.encoder.node_slots)
+                    preempt_hints = (screen, best, slot_of)
+                except Exception:  # noqa: BLE001 — hints are an optimization only
+                    import logging
 
-                logging.getLogger(__name__).exception("preempt screen failed")
+                    logging.getLogger(__name__).exception("preempt screen failed")
 
         for i, qp in enumerate(qps):
             pod = qp.pod
@@ -865,18 +879,29 @@ class TPUScheduler(Scheduler):
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
 
+    # one immutable Status per attribution id, shared across every node and
+    # every diagnosis — building 5k fresh Status objects per failed pod was
+    # ~15ms of the Unschedulable row's tail
+    _SHARED_STATUSES = tuple(
+        Status.unschedulable(reason).with_plugin(plugin)
+        for plugin, reason in _ATTRIBUTION_ORDER)
+
     def _diagnose(self, ff_row: np.ndarray, slot_names: Dict[int, str]) -> Diagnosis:
         """Per-node first-failing plugin in filter config order, read straight
         from the device-computed first_fail ids, so failure messages and queue
         gating stay reference-shaped (SURVEY.md §8 'filter short-circuit
-        semantics')."""
+        semantics'). Vectorized: one nonzero pass over the row, shared Status
+        instances per plugin id."""
         d = Diagnosis()
-        for slot, name in slot_names.items():
-            fid = int(ff_row[slot])
-            if fid > 0:
-                plugin, reason = _ATTRIBUTION_ORDER[fid - 1]
-                d.node_to_status[name] = Status.unschedulable(reason).with_plugin(plugin)
-                d.unschedulable_plugins.add(plugin)
+        failing = np.nonzero(ff_row)[0]
+        statuses = self._SHARED_STATUSES
+        for slot in failing:
+            name = slot_names.get(int(slot))
+            if name is None:
+                continue
+            st = statuses[int(ff_row[slot]) - 1]
+            d.node_to_status[name] = st
+            d.unschedulable_plugins.add(st.plugin)
         return d
 
     def _fail(self, fwk, qp: QueuedPodInfo, status: Status, pod_cycle: int,
@@ -1014,6 +1039,16 @@ class TPUScheduler(Scheduler):
                                            topo_carry=None,
                                            **dict(common, extra_mask=vm))
                 np.asarray(res_v.node_idx)
+                if res_v.final_sel_counts is not None:
+                    # the pipelined steady state runs mask+carry — warm that
+                    # trace too (PreemptionPVs compiled it mid-measure)
+                    res_vc = self._run_batch_fn(
+                        pb, et, self.device.nt, self.device.tc, tb,
+                        np.int32(0),
+                        topo_carry=(res_v.final_sel_counts,
+                                    res_v.final_seg_exist),
+                        **dict(common, extra_mask=vm))
+                    np.asarray(res_vc.node_idx)
             warmed += 1
             # time a clean second execution: the calibration sample
             t0 = self.now_fn()

@@ -53,6 +53,11 @@ class Cache:
         self._dirty: set = set()
         self._removed: set = set()
         self._sync_generation = 0
+        # priority histogram over pods assigned to nodes: lets the batched
+        # preemption path prove "no evictable victim exists anywhere" in
+        # O(1) instead of dry-running candidates (preemption.go:319's
+        # eligibility is per-pod; this is the cluster-level shortcut)
+        self._prio_counts: Dict[int, int] = {}
 
     # ------------------------------------------------------------- pods
 
@@ -182,11 +187,19 @@ class Cache:
             self._node_info(node_name).add_pod(pod)
             self._dirty.add(node_name)
             self._removed.discard(node_name)
+            prio = pod.spec.priority
+            self._prio_counts[prio] = self._prio_counts.get(prio, 0) + 1
 
     def _remove_pod_from_node(self, pod: Pod, node_name: str) -> None:
         ni = self.nodes.get(node_name)
         if ni is not None:
             ni.remove_pod(pod)
+            prio = pod.spec.priority
+            left = self._prio_counts.get(prio, 0) - 1
+            if left > 0:
+                self._prio_counts[prio] = left
+            else:
+                self._prio_counts.pop(prio, None)
             self._dirty.add(node_name)
             if ni.node is None and not ni.pods:
                 self.nodes.pop(node_name, None)
@@ -256,6 +269,13 @@ class Cache:
         the TPU backend's delta-upload worklist."""
         with self._lock:
             return [n for n, ni in self.nodes.items() if ni.generation > since_generation]
+
+    def min_pod_priority(self) -> Optional[int]:
+        """Lowest priority among pods currently assigned to nodes; None when
+        no pod is assigned. A pending pod with priority <= this value cannot
+        have preemption victims anywhere."""
+        with self._lock:
+            return min(self._prio_counts) if self._prio_counts else None
 
     def node_count(self) -> int:
         with self._lock:

@@ -62,6 +62,19 @@ class DefaultPreemption(PostFilterPlugin):
         except KeyError:
             pass
         else:
+            node_infos_now = self._snapshot_fn() if self._snapshot_fn else []
+            if not screen_row.any() and all(
+                ni.node is None or ni.node.meta.name in slot_of
+                for ni in node_infos_now
+            ):
+                # the screen proved no node can be freed AND it covers every
+                # snapshot node (a node added after the device encode has no
+                # slot and must still be dry-run): skip the per-node walk —
+                # preemption.go:205's '0 nodes' outcome at O(1)
+                return None, Status.unschedulable(
+                    "preemption: 0/{} nodes are available".format(
+                        len(node_infos_now)))
+
             def screen_fn(name, _row=screen_row, _slots=slot_of):
                 slot = _slots.get(name)
                 return True if slot is None else bool(_row[slot])

@@ -113,7 +113,39 @@ def _node_wrapper(i: int, params: dict):
 
 
 def _pod_wrapper(i: int, prefix: str, params: dict):
-    pw = make_pod(f"{prefix}-{i}").req(params.get("req", {"cpu": "900m", "memory": "2Gi"}))
+    pw = make_pod(f"{prefix}-{i}",
+                  namespace=str(params.get("namespace", "default")))
+    pw.req(params.get("req", {"cpu": "900m", "memory": "2Gi"}))
+    if params.get("node_affinity_in"):
+        # pod-with-node-affinity.yaml: required NodeAffinity In terms
+        for key, values in dict(params["node_affinity_in"]).items():
+            pw.node_affinity_in(key, list(values))
+    if params.get("ns_selector_anti_affinity"):
+        # pod-anti-affinity-ns-selector.yaml: required anti-affinity whose
+        # term matches the pod's own label across namespaces selected by a
+        # namespaceSelector
+        from ..api.types import (Affinity, LabelSelector, PodAffinityTerm,
+                                 PodAntiAffinity, WeightedPodAffinityTerm)
+
+        cfg = dict(params["ns_selector_anti_affinity"])
+        match = dict(cfg.get("match_labels", {"color": "green"}))
+        for k, v in match.items():
+            pw.label(k, v)
+        term = PodAffinityTerm(
+            label_selector=LabelSelector(match_labels=match),
+            topology_key=cfg.get("topology_key", "kubernetes.io/hostname"),
+            namespace_selector=LabelSelector(
+                match_labels=dict(cfg.get("ns_labels", {"team": "devops"}))),
+        )
+        aff = pw.pod.spec.affinity or Affinity()
+        if cfg.get("preferred"):
+            paa = PodAntiAffinity(preferred=(
+                WeightedPodAffinityTerm(weight=int(cfg.get("weight", 1)),
+                                        term=term),))
+        else:
+            paa = PodAntiAffinity(required=(term,))
+        aff.pod_anti_affinity = paa
+        pw.pod.spec.affinity = aff
     for k, v in (params.get("labels") or {}).items():
         pw.label(k, str(v).format(i=i))
     if params.get("priority") is not None:
@@ -150,14 +182,17 @@ def _pod_wrapper(i: int, prefix: str, params: dict):
         # gate scheduling; the row measures the codec/admission cost only
         pw.pod.spec.secret_volumes = (str(params["secret_volume"]),)
     if params.get("spread_topology_key"):
-        from ..api.types import LabelSelector, TopologySpreadConstraint, DO_NOT_SCHEDULE
+        from ..api.types import (LabelSelector, TopologySpreadConstraint,
+                                 DO_NOT_SCHEDULE, SCHEDULE_ANYWAY)
 
         pw.label("spread-app", prefix)
+        when = (SCHEDULE_ANYWAY if params.get("spread_preferred")
+                else DO_NOT_SCHEDULE)
         pw.pod.spec.topology_spread_constraints = (
             TopologySpreadConstraint(
                 max_skew=int(params.get("max_skew", 1)),
                 topology_key=params["spread_topology_key"],
-                when_unsatisfiable=DO_NOT_SCHEDULE,
+                when_unsatisfiable=when,
                 label_selector=LabelSelector(match_labels={"spread-app": prefix}),
             ),
         )
@@ -281,6 +316,17 @@ class Runner:
             self.store.create_pod(self._make_pod(prefix, params))
             self._pod_counter += 1
 
+    def create_namespaces(self, count: int, prefix: str = "ns",
+                          labels: Optional[dict] = None) -> None:
+        """createNamespaces op (namespace-with-labels.yaml): labeled
+        namespaces for namespaceSelector affinity terms."""
+        from ..api.types import Namespace, ObjectMeta
+
+        for i in range(count):
+            self.store.create_namespace(Namespace(
+                meta=ObjectMeta(name=f"{prefix}-{i}", namespace="",
+                                labels=dict(labels or {}))))
+
     def barrier(self, timeout_s: float = 300.0) -> None:
         """Wait (drive) until every pending pod has been attempted
         (scheduler_perf_test.go:518 barrierOp)."""
@@ -382,6 +428,8 @@ class Runner:
                 self.create_pods(**kwargs)
             elif kind == "measurePods":
                 self.measure(**kwargs)
+            elif kind == "createNamespaces":
+                self.create_namespaces(**kwargs)
             elif kind == "barrier":
                 self.barrier(**kwargs)
             elif kind == "churn":

@@ -259,6 +259,111 @@ def scheduling_churn(nodes=1000, measured=1000) -> dict:
     }
 
 
+def scheduling_node_affinity(nodes=5000, init_pods=5000, measured=1000) -> dict:
+    """performance-config.yaml:257-281 SchedulingNodeAffinity: nodes all in
+    zone1 (labelNodePrepareStrategy); every pod requires zone In [zone1,
+    zone2] (pod-with-node-affinity.yaml)."""
+    pod = {"req": {"cpu": "100m", "memory": "500Mi"},
+           "node_affinity_in": {"topology.kubernetes.io/zone": ["zone-0", "zone-1"]}}
+    return {
+        "name": f"SchedulingNodeAffinity/{nodes}Nodes",
+        "ops": [
+            # zones=2 → every node in zone-0/zone-1, both admitted by the terms
+            {"opcode": "createNodes", "count": nodes, "zones": 2},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "na", **pod},
+        ],
+    }
+
+
+def preferred_topology_spreading(nodes=5000, init_pods=5000, measured=2000) -> dict:
+    """performance-config.yaml:310-335 PreferredTopologySpreading:
+    ScheduleAnyway constraints (pod-with-preferred-topology-spreading.yaml,
+    maxSkew 5) — pure Score-path spreading."""
+    spread = {"req": {"cpu": "100m", "memory": "500Mi"},
+              "spread_topology_key": "topology.kubernetes.io/zone",
+              "spread_preferred": True, "max_skew": 5}
+    return {
+        "name": f"PreferredTopologySpreading/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 3},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init"},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "pspread", **spread},
+        ],
+    }
+
+
+def migrated_intree_pvs(nodes=5000, init_pods=5000, measured=1000) -> dict:
+    """performance-config.yaml:98-134 MigratedInTreePVs: in-tree EBS pairs
+    evaluated through the CSI migration path (CSI limits instead of the
+    in-tree counter). Shape-identical to InTreePVs here; the volume_type
+    marks the claims as migrated EBS."""
+    pod = {"req": {"cpu": "100m", "memory": "500Mi"},
+           "pvc": {"volume_type": "ebs", "migrated": True}}
+    return {
+        "name": f"MigratedInTreePVs/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init"},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "mpv", **pod},
+        ],
+    }
+
+
+def preemption_pvs(nodes=500, init_pods=2000, measured=500) -> dict:
+    """performance-config.yaml:409-435 PreemptionPVs: PreemptionBasic with a
+    pre-bound PV/PVC pair per preemptor (pv-aws.yaml + pvc.yaml)."""
+    return {
+        "name": f"PreemptionPVs/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes,
+             "capacity": {"cpu": "4", "memory": "16Gi", "pods": 32}},
+            {"opcode": "createPods", "count": init_pods, "prefix": "victim",
+             "req": {"cpu": "900m", "memory": "2Gi"}, "priority": 1},
+            {"opcode": "createPods", "count": 8, "prefix": "warm",
+             "req": {"cpu": "2", "memory": "4Gi"}, "priority": 100,
+             "pvc": {"volume_type": "ebs"}},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "preemptor",
+             "req": {"cpu": "2", "memory": "4Gi"}, "priority": 100,
+             "pvc": {"volume_type": "ebs"}},
+        ],
+    }
+
+
+def required_anti_affinity_ns_selector(nodes=5000, init_namespaces=100,
+                                       init_pods_per_ns=40, measured=1000) -> dict:
+    """performance-config.yaml:492-525
+    SchedulingRequiredPodAntiAffinityWithNSSelector: labeled namespaces,
+    40 init pods in each, measured pods in their own namespace carrying a
+    required anti-affinity whose namespaceSelector spans the labeled set."""
+    anti = {"req": {"cpu": "100m", "memory": "500Mi"},
+            "ns_selector_anti_affinity": {
+                "match_labels": {"color": "green"},
+                "topology_key": "kubernetes.io/hostname",
+                "ns_labels": {"team": "devops"}}}
+    ops = [
+        {"opcode": "createNodes", "count": nodes, "zones": 10},
+        {"opcode": "createNamespaces", "count": init_namespaces,
+         "prefix": "init-ns", "labels": {"team": "devops"}},
+        {"opcode": "createNamespaces", "count": 1, "prefix": "measure-ns",
+         "labels": {"team": "devops"}},
+    ]
+    for n in range(init_namespaces):
+        ops.append({"opcode": "createPods", "count": init_pods_per_ns,
+                    "prefix": f"init{n}", "namespace": f"init-ns-{n}", **anti})
+    ops += [
+        {"opcode": "barrier"},
+        {"opcode": "measurePods", "count": measured, "prefix": "m",
+         "namespace": "measure-ns-0", **anti},
+    ]
+    return {"name": f"SchedulingRequiredPodAntiAffinityWithNSSelector/{nodes}Nodes",
+            "ops": ops}
+
+
 TEST_CASES = {
     "SchedulingBasic": scheduling_basic,
     "SchedulingPodAntiAffinity": scheduling_pod_anti_affinity,
@@ -273,4 +378,9 @@ TEST_CASES = {
     "Unschedulable": unschedulable,
     "PreemptionBasic": preemption_basic,
     "SchedulingWithChurn": scheduling_churn,
+    "SchedulingNodeAffinity": scheduling_node_affinity,
+    "PreferredTopologySpreading": preferred_topology_spreading,
+    "MigratedInTreePVs": migrated_intree_pvs,
+    "PreemptionPVs": preemption_pvs,
+    "SchedulingRequiredPodAntiAffinityWithNSSelector": required_anti_affinity_ns_selector,
 }

@@ -48,6 +48,12 @@ class SchedulingQueue:
         self.max_backoff = max_backoff
         self.unschedulable_timeout = unschedulable_timeout
         self.cluster_event_map = cluster_event_map or {}
+        # (event, failed-plugin-set) -> bool memo: a bind fires POD_ADD into
+        # move_all for EVERY unschedulable pod; distinct plugin sets are few,
+        # so the O(|event map|) scan runs once per (event, set), not per pod
+        # per bind (was 3.2M ClusterEvent.match calls in the Unschedulable
+        # workload's measured window)
+        self._event_match_memo: Dict[tuple, bool] = {}
         self.now_fn = now_fn
 
         self._counter = itertools.count()  # FIFO tie-break inside heaps
@@ -164,12 +170,16 @@ class SchedulingQueue:
     def _pod_matches_event(self, qp: QueuedPodInfo, event: ClusterEvent) -> bool:
         if event.is_wildcard():
             return True
-        for registered, plugins in self.cluster_event_map.items():
-            if registered.match(event) and (
-                not qp.unschedulable_plugins or plugins & qp.unschedulable_plugins
-            ):
-                return True
-        return False
+        failed = frozenset(qp.unschedulable_plugins)
+        memo_key = (event.resource, event.action_type, event.label, failed)
+        hit = self._event_match_memo.get(memo_key)
+        if hit is None:
+            hit = any(
+                registered.match(event)
+                and (not failed or plugins & failed)
+                for registered, plugins in self.cluster_event_map.items())
+            self._event_match_memo[memo_key] = hit
+        return hit
 
     def _requeue(self, qp: QueuedPodInfo) -> None:
         """Moved pods land in backoffQ unless their backoff already lapsed."""

