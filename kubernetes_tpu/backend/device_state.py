@@ -405,7 +405,10 @@ class DeviceState:
         return True
 
     def slot_to_name(self) -> Dict[int, str]:
-        return {s: n for n, s in self.encoder.node_slots.items()}
+        """LIVE reverse map (maintained by the encoder) — rebuilding a
+        5k-entry dict per commit was a fixed ~2ms/batch. Callers read only;
+        anyone needing a stable copy must dict() it."""
+        return self.encoder.slot_names
 
 
 def caps_for_cluster(n_nodes: int, batch: int = 128) -> Capacities:

@@ -116,6 +116,7 @@ class ClusterEncoder:
         # evictable padding)
         self.prio_vocab: Dict[int, int] = {}
         self.node_slots: Dict[str, int] = {}          # node name -> slot
+        self.slot_names: Dict[int, str] = {}          # live reverse map
         self._free_slots: List[int] = []
         self._pod_templates: Dict[Tuple, _PodTemplate] = {}
         self.last_has_ports = False                   # set by encode_pods
@@ -199,12 +200,14 @@ class ClusterEncoder:
             if slot >= self.caps.nodes:
                 raise CapacityError("nodes", slot + 1, self.caps.nodes)
             self.node_slots[name] = slot
+            self.slot_names[slot] = name
         return slot
 
     def release_node_slot(self, name: str) -> Optional[int]:
         slot = self.node_slots.pop(name, None)
         self._static_rows.pop(name, None)
         if slot is not None:
+            self.slot_names.pop(slot, None)
             self._free_slots.append(slot)
         return slot
 
