@@ -527,6 +527,13 @@ class ClusterStore:
 
     # -------------------------------------------------------- dynamic kinds
 
+    def _register_crd_kind(self, crd) -> None:
+        """Kind-map registration half of create_crd — also used by WAL
+        restore, where CRD objects re-enter through the raw kind map and
+        must re-register their served kinds before any custom object."""
+        self._custom_kinds.setdefault(crd.kind, {})
+        self._custom_scope[crd.kind] = bool(crd.namespaced)
+
     def create_crd(self, crd) -> None:
         """Register a dynamic kind (apiextensions customresource_handler.go's
         discovery/registration step, minus schema validation): after this,
@@ -540,8 +547,7 @@ class ClusterStore:
                 raise Conflict(f"kind {crd.kind!r} already served")
             self._bump(crd)
             self.crds[name] = crd
-            self._custom_kinds[crd.kind] = {}
-            self._custom_scope[crd.kind] = bool(crd.namespaced)
+            self._register_crd_kind(crd)
             self._journal_event("CustomResourceDefinition", ADDED, None, crd)
         self._notify("CustomResourceDefinition", ADDED, None, crd)
 

@@ -141,6 +141,8 @@ def restore(path: str, store_factory=None):
                 for line in f:
                     rec = json.loads(line)
                     obj = from_wire(_resolve_type(rec["type"]), rec["obj"])
+                    if rec["kind"] == "CustomResourceDefinition":
+                        store._register_crd_kind(obj)
                     store._kind_map(rec["kind"])[rec["key"]] = obj
         if os.path.exists(path):
             with open(path, encoding="utf-8") as f:
@@ -153,6 +155,8 @@ def restore(path: str, store_factory=None):
                         m.pop(rec["key"], None)
                     else:
                         obj = from_wire(_resolve_type(rec["type"]), rec["obj"])
+                        if rec["kind"] == "CustomResourceDefinition":
+                            store._register_crd_kind(obj)
                         m[rec["key"]] = obj
                         max_rv = max(max_rv, int(rec.get("rv", 0) or 0))
                     max_seq = max(max_seq, int(rec.get("seq", 0) or 0))
