@@ -732,6 +732,39 @@ def _default_pod(pod: t.Pod) -> None:
             c.requests.setdefault(r, q)
 
 
+def api_service_from(doc: dict) -> t.APIService:
+    spec = doc.get("spec") or {}
+    svc_ref = spec.get("service") or {}
+    endpoint = doc.get("service_endpoint", "")
+    if not endpoint and svc_ref:
+        # apiregistration's ServiceReference (ns/name/port) reduced to a
+        # host:port the plain-HTTP proxy can dial
+        endpoint = f"{svc_ref.get('name', '')}:{svc_ref.get('port', 443)}"
+    return t.APIService(
+        meta=meta_from(doc.get("metadata") or {}),
+        group=spec.get("group", doc.get("group", "")),
+        version=spec.get("version", doc.get("version", "v1")),
+        service_endpoint=endpoint,
+        insecure_skip_tls_verify=bool(
+            spec.get("insecureSkipTLSVerify",
+                     doc.get("insecure_skip_tls_verify", True))),
+        group_priority_minimum=int(
+            spec.get("groupPriorityMinimum",
+                     doc.get("group_priority_minimum", 1000))),
+        version_priority=int(
+            spec.get("versionPriority", doc.get("version_priority", 15))),
+    )
+
+
+def api_service_to(svc: t.APIService) -> dict:
+    return {"metadata": meta_to(svc.meta),
+            "spec": {"group": svc.group, "version": svc.version,
+                     "insecureSkipTLSVerify": svc.insecure_skip_tls_verify,
+                     "groupPriorityMinimum": svc.group_priority_minimum,
+                     "versionPriority": svc.version_priority},
+            "service_endpoint": svc.service_endpoint}
+
+
 def register(scheme: Scheme) -> None:
     """Register every modeled external version (AddToScheme analog)."""
     core = [
@@ -760,4 +793,7 @@ def register(scheme: Scheme) -> None:
     scheme.add_known_type(
         GroupVersionKind("autoscaling", "v2", "HorizontalPodAutoscaler"),
         t.HorizontalPodAutoscaler, hpa_from, hpa_to)
+    scheme.add_known_type(
+        GroupVersionKind("apiregistration.k8s.io", "v1", "APIService"),
+        t.APIService, api_service_from, api_service_to)
     scheme.add_defaulter(t.Pod, _default_pod)

@@ -517,6 +517,23 @@ class CustomResource:
 
 
 @dataclass
+class APIService:
+    """apiregistration.k8s.io/v1 APIService, reduced to the aggregation
+    surface (kube-aggregator apis/apiregistration/v1/types.go): which
+    group/version is served and where to proxy it. Local services (no
+    endpoint) mean "served by this apiserver" — the built-in groups."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)  # name = version.group
+    group: str = ""
+    version: str = "v1"
+    # backend endpoint ("host:port" or full URL); "" = local (built-in)
+    service_endpoint: str = ""
+    insecure_skip_tls_verify: bool = True
+    group_priority_minimum: int = 1000
+    version_priority: int = 15
+
+
+@dataclass
 class Namespace:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
 

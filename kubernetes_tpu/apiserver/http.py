@@ -82,6 +82,7 @@ RESOURCES = {
     ("apis/networking.k8s.io/v1", "ingressclasses"): "IngressClass",
     ("apis/apiextensions.k8s.io/v1", "customresourcedefinitions"):
         "CustomResourceDefinition",
+    ("apis/apiregistration.k8s.io/v1", "apiservices"): "APIService",
     ("api/v1", "events"): "Event",
 }
 
@@ -114,6 +115,57 @@ class _Handler(BaseHTTPRequestHandler):
     store: ClusterStore = None  # bound by serve_api()
     auth = None                 # Optional[AuthConfig], bound by serve_api()
     protocol_version = "HTTP/1.1"
+
+    def _maybe_aggregate(self, path: str, body_doc=None) -> bool:
+        """kube-aggregator arm: when no built-in or CRD route claims an
+        /apis/{group}/{version} path but a non-local APIService does, proxy
+        the request verbatim to its backend and relay the response
+        (kube-aggregator pkg/apiserver/handler_proxy.go, minus TLS/auth
+        forwarding). Returns True when the request was proxied."""
+        parts = [p for p in path.split("/") if p]
+        if len(parts) < 3 or parts[0] != "apis":
+            return False
+        svc = self.store.api_service_for(parts[1], parts[2])
+        if svc is None:
+            return False
+        import urllib.error
+        import urllib.request
+
+        endpoint = svc.service_endpoint
+        if "://" not in endpoint:
+            endpoint = f"http://{endpoint}"
+        target = endpoint.rstrip("/") + self.path
+        body = None
+        if body_doc is not None:
+            body = json.dumps(body_doc).encode()
+        else:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            if length:
+                body = self.rfile.read(length)
+        req = urllib.request.Request(
+            target, data=body, method=self.command,
+            headers={k: v for k, v in self.headers.items()
+                     if k.lower() in ("content-type", "accept")})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = resp.read()
+                self.send_response(resp.status)
+                self.send_header("Content-Type",
+                                 resp.headers.get("Content-Type",
+                                                  "application/json"))
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            self.send_response(e.code)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+        except OSError as e:
+            self._error(503, "ServiceUnavailable",
+                        f"aggregated apiserver {svc.meta.name}: {e}")
+        return True
 
     def _resolve(self, path: str):
         """Static route table first, then registered CRDs (the
@@ -339,6 +391,8 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         r = self._resolve(url.path)
         if r is None:
+            if self._maybe_aggregate(url.path):
+                return
             return self._error(404, "NotFound", f"unknown path {url.path}")
         _g, kind, ns, name, _sub = r
         q = parse_qs(url.query)
@@ -426,6 +480,8 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._body()  # drain FIRST: keep-alive sockets must not carry leftovers
         r = self._resolve(urlparse(self.path).path)
         if r is None:
+            if self._maybe_aggregate(urlparse(self.path).path, body_doc=body):
+                return
             return self._error(404, "NotFound", "unknown path")
         _g, kind, ns, name, sub = r
         if kind == "Pod" and name is not None and sub == "binding":
@@ -471,6 +527,9 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._body()  # drain first (keep-alive)
         r = self._resolve(urlparse(self.path).path)
         if r is None or r[3] is None:
+            if r is None and self._maybe_aggregate(
+                    urlparse(self.path).path, body_doc=body):
+                return
             return self._error(404, "NotFound", "unknown path")
         _g, kind, ns, name, _sub = r
         try:
@@ -508,6 +567,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._body()  # drain DeleteOptions bodies (keep-alive invariant)
         r = self._resolve(urlparse(self.path).path)
         if r is None or r[3] is None:
+            if r is None and self._maybe_aggregate(urlparse(self.path).path):
+                return
             return self._error(404, "NotFound", "unknown path")
         _g, kind, ns, name, _sub = r
         key = name if self._cluster_scoped(kind) else f"{ns}/{name}"

@@ -147,6 +147,8 @@ class ClusterStore:
         # kind map per served kind — plugin-requested GVKs get real objects,
         # journaled watches and informers through the same generic machinery
         self.crds: Dict[str, object] = {}
+        # kube-aggregator registrations: (group, version) -> APIService
+        self.api_services: Dict[str, object] = {}
         self._custom_kinds: Dict[str, Dict[str, object]] = {}
         self._custom_scope: Dict[str, bool] = {}  # kind -> namespaced
         # metrics-API stand-in (metrics.k8s.io): pod key -> milli-cpu usage,
@@ -358,6 +360,7 @@ class ClusterStore:
                 "ClusterRole": self.cluster_roles,
                 "ClusterRoleBinding": self.cluster_role_bindings,
                 "CustomResourceDefinition": self.crds,
+                "APIService": self.api_services,
                 **self._custom_kinds,
             }
 
@@ -518,7 +521,8 @@ class ClusterStore:
 
     def is_cluster_scoped(self, kind: str) -> bool:
         """The one scope rule (consumed by _key_of and the HTTP front)."""
-        if kind in self.CLUSTER_SCOPED_KINDS or kind == "CustomResourceDefinition":
+        if kind in self.CLUSTER_SCOPED_KINDS or kind in (
+                "CustomResourceDefinition", "APIService"):
             return True
         return kind in self._custom_scope and not self._custom_scope[kind]
 
@@ -550,6 +554,16 @@ class ClusterStore:
             self._register_crd_kind(crd)
             self._journal_event("CustomResourceDefinition", ADDED, None, crd)
         self._notify("CustomResourceDefinition", ADDED, None, crd)
+
+    def api_service_for(self, group: str, version: str):
+        """The aggregation lookup: a non-local APIService claiming this
+        group/version (kube-aggregator handler.go ServeHTTP)."""
+        with self._lock:
+            for svc in self.api_services.values():
+                if (svc.group == group and svc.version == version
+                        and svc.service_endpoint):
+                    return svc
+        return None
 
     def crd_for_plural(self, group: str, plural: str):
         with self._lock:

@@ -1,0 +1,98 @@
+"""API aggregation (kube-aggregator, SURVEY §2.6): a non-local APIService
+claiming /apis/{group}/{version} makes the apiserver proxy those requests to
+its backend apiserver verbatim and relay the response
+(kube-aggregator pkg/apiserver/handler_proxy.go, reduced: plain HTTP).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+from kubernetes_tpu.api.types import (
+    APIService, CustomResourceDefinition, CustomResource, ObjectMeta,
+)
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.apiserver.http import serve_api
+
+
+def _backend_with_metrics_group():
+    """A second (aggregated) apiserver serving metrics.k8s.io/v1beta1 via a
+    CRD-backed kind — the metrics-server shape."""
+    store = ClusterStore()
+    store.create_crd(CustomResourceDefinition(
+        meta=ObjectMeta(name="nodemetrics.metrics.k8s.io", namespace=""),
+        group="metrics.k8s.io", version="v1beta1", kind="NodeMetrics",
+        plural="nodemetrics", namespaced=False))
+    server, port = serve_api(store)
+    return store, server, port
+
+
+def test_apiservice_proxies_group_to_backend():
+    backend_store, backend, bport = _backend_with_metrics_group()
+    front_store = ClusterStore()
+    front, fport = serve_api(front_store)
+    try:
+        front_store.create_object("APIService", APIService(
+            meta=ObjectMeta(name="v1beta1.metrics.k8s.io", namespace=""),
+            group="metrics.k8s.io", version="v1beta1",
+            service_endpoint=f"127.0.0.1:{bport}"))
+        base = f"http://127.0.0.1:{fport}"
+        # POST through the FRONT apiserver lands on the backend
+        body = json.dumps({
+            "apiVersion": "metrics.k8s.io/v1beta1", "kind": "NodeMetrics",
+            "metadata": {"name": "node-1"}, "spec": {"cpu": "250m"},
+        }).encode()
+        req = urllib.request.Request(
+            f"{base}/apis/metrics.k8s.io/v1beta1/nodemetrics", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req) as resp:
+            assert resp.status in (200, 201)
+        assert backend_store.get_object("NodeMetrics", "node-1") is not None
+        # GET through the front reads the backend's object
+        with urllib.request.urlopen(
+                f"{base}/apis/metrics.k8s.io/v1beta1/nodemetrics/node-1") as resp:
+            doc = json.loads(resp.read())
+        assert doc["spec"]["cpu"] == "250m"
+        # unclaimed group still 404s at the front
+        try:
+            urllib.request.urlopen(f"{base}/apis/unclaimed.io/v1/things")
+            raise AssertionError("expected 404")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        front.shutdown()
+        backend.shutdown()
+
+
+def test_dead_backend_is_503_not_hang():
+    front_store = ClusterStore()
+    front, fport = serve_api(front_store)
+    try:
+        front_store.create_object("APIService", APIService(
+            meta=ObjectMeta(name="v1.dead.io", namespace=""),
+            group="dead.io", version="v1",
+            service_endpoint="127.0.0.1:1"))  # nothing listens there
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{fport}/apis/dead.io/v1/things", timeout=40)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+    finally:
+        front.shutdown()
+
+
+def test_local_groups_not_shadowed():
+    """Built-in and CRD routes win before aggregation is consulted."""
+    store = ClusterStore()
+    server, port = serve_api(store)
+    try:
+        store.create_object("APIService", APIService(
+            meta=ObjectMeta(name="v1.apps", namespace=""),
+            group="apps", version="v1",
+            service_endpoint="127.0.0.1:1"))  # would 503 if consulted
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/apis/apps/v1/deployments") as resp:
+            assert resp.status == 200  # served locally
+    finally:
+        server.shutdown()
