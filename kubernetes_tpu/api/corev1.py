@@ -300,6 +300,13 @@ def pod_from(doc: dict) -> t.Pod:
             label_selector=label_selector_from(c.get("labelSelector")),
             min_domains=c.get("minDomains"))
         for c in (spec.get("topologySpreadConstraints") or ()))
+    resource_claims = tuple(
+        t.PodResourceClaim(
+            name=rc.get("name", ""),
+            claim_name=(rc.get("source") or {}).get("resourceClaimName", ""),
+            template_name=(rc.get("source") or {}).get(
+                "resourceClaimTemplateName", ""))
+        for rc in (spec.get("resourceClaims") or ()))
     pod_spec = t.PodSpec(
         containers=[_container_from(c) for c in (spec.get("containers") or ())],
         init_containers=[_container_from(c) for c in (spec.get("initContainers") or ())],
@@ -315,6 +322,7 @@ def pod_from(doc: dict) -> t.Pod:
         overhead=dict(spec.get("overhead") or {}),
         volumes=tuple(volumes),
         ephemeral_claims=tuple(ephemeral),
+        resource_claims=resource_claims,
         service_account_name=spec.get("serviceAccountName", ""),
         host_network=bool(spec.get("hostNetwork", False)),
         host_pid=bool(spec.get("hostPID", False)),
@@ -374,6 +382,12 @@ def pod_to(pod: t.Pod) -> dict:
     vols += [{"name": name, "ephemeral": {}} for name in pod.spec.ephemeral_claims]
     if vols:
         spec["volumes"] = vols
+    if pod.spec.resource_claims:
+        spec["resourceClaims"] = [
+            {"name": rc.name,
+             "source": ({"resourceClaimName": rc.claim_name} if rc.claim_name
+                        else {"resourceClaimTemplateName": rc.template_name})}
+            for rc in pod.spec.resource_claims]
     if pod.spec.service_account_name:
         spec["serviceAccountName"] = pod.spec.service_account_name
     for attr, key in (("host_network", "hostNetwork"), ("host_pid", "hostPID"),
@@ -418,6 +432,7 @@ def node_from(doc: dict) -> t.Node:
                                  size_bytes=int(i.get("sizeBytes", 0)))
                 for i in (status.get("images") or ())),
             ready=ready,
+            device_attributes=dict(status.get("deviceAttributes") or {}),
         ),
     )
 
@@ -442,6 +457,8 @@ def node_to(node: t.Node) -> dict:
     if node.status.images:
         status["images"] = [{"names": list(i.names), "sizeBytes": i.size_bytes}
                             for i in node.status.images]
+    if node.status.device_attributes:
+        status["deviceAttributes"] = dict(node.status.device_attributes)
     return {"metadata": meta_to(node.meta), "spec": spec, "status": status}
 
 
@@ -765,6 +782,88 @@ def api_service_to(svc: t.APIService) -> dict:
             "service_endpoint": svc.service_endpoint}
 
 
+# ------------------------------------------------- resource.k8s.io/v1alpha2
+
+
+def resource_class_from(doc: dict) -> t.ResourceClass:
+    return t.ResourceClass(
+        meta=meta_from(doc.get("metadata") or {}),
+        driver_name=doc.get("driverName", ""),
+        selectors=dict(doc.get("selectors") or {}))
+
+
+def resource_class_to(rc: t.ResourceClass) -> dict:
+    out: dict = {"metadata": meta_to(rc.meta)}
+    if rc.driver_name:
+        out["driverName"] = rc.driver_name
+    if rc.selectors:
+        out["selectors"] = dict(rc.selectors)
+    return out
+
+
+def resource_claim_from(doc: dict) -> t.ResourceClaim:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    return t.ResourceClaim(
+        meta=meta_from(doc.get("metadata") or {}),
+        resource_class_name=spec.get("resourceClassName", ""),
+        selectors=dict(spec.get("selectors") or {}),
+        allocated_node=(status.get("allocation") or {}).get("nodeName", ""),
+        reserved_for=tuple(status.get("reservedFor") or ()))
+
+
+def resource_claim_to(claim: t.ResourceClaim) -> dict:
+    spec: dict = {}
+    if claim.resource_class_name:
+        spec["resourceClassName"] = claim.resource_class_name
+    if claim.selectors:
+        spec["selectors"] = dict(claim.selectors)
+    status: dict = {}
+    if claim.allocated_node:
+        status["allocation"] = {"nodeName": claim.allocated_node}
+    if claim.reserved_for:
+        status["reservedFor"] = list(claim.reserved_for)
+    out: dict = {"metadata": meta_to(claim.meta), "spec": spec}
+    if status:
+        out["status"] = status
+    return out
+
+
+def resource_claim_template_from(doc: dict) -> t.ResourceClaimTemplate:
+    spec = doc.get("spec") or {}
+    return t.ResourceClaimTemplate(
+        meta=meta_from(doc.get("metadata") or {}),
+        resource_class_name=spec.get("resourceClassName", ""),
+        selectors=dict(spec.get("selectors") or {}))
+
+
+def resource_claim_template_to(tmpl: t.ResourceClaimTemplate) -> dict:
+    spec: dict = {}
+    if tmpl.resource_class_name:
+        spec["resourceClassName"] = tmpl.resource_class_name
+    if tmpl.selectors:
+        spec["selectors"] = dict(tmpl.selectors)
+    return {"metadata": meta_to(tmpl.meta), "spec": spec}
+
+
+def pod_scheduling_context_from(doc: dict) -> t.PodSchedulingContext:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    return t.PodSchedulingContext(
+        meta=meta_from(doc.get("metadata") or {}),
+        selected_node=spec.get("selectedNode", status.get("selectedNode", "")),
+        potential_nodes=tuple(spec.get("potentialNodes") or ()))
+
+
+def pod_scheduling_context_to(ctx: t.PodSchedulingContext) -> dict:
+    spec: dict = {}
+    if ctx.selected_node:
+        spec["selectedNode"] = ctx.selected_node
+    if ctx.potential_nodes:
+        spec["potentialNodes"] = list(ctx.potential_nodes)
+    return {"metadata": meta_to(ctx.meta), "spec": spec}
+
+
 def register(scheme: Scheme) -> None:
     """Register every modeled external version (AddToScheme analog)."""
     core = [
@@ -796,4 +895,17 @@ def register(scheme: Scheme) -> None:
     scheme.add_known_type(
         GroupVersionKind("apiregistration.k8s.io", "v1", "APIService"),
         t.APIService, api_service_from, api_service_to)
+    for kind, typ, dec, enc in (
+        ("ResourceClass", t.ResourceClass,
+         resource_class_from, resource_class_to),
+        ("ResourceClaim", t.ResourceClaim,
+         resource_claim_from, resource_claim_to),
+        ("ResourceClaimTemplate", t.ResourceClaimTemplate,
+         resource_claim_template_from, resource_claim_template_to),
+        ("PodSchedulingContext", t.PodSchedulingContext,
+         pod_scheduling_context_from, pod_scheduling_context_to),
+    ):
+        scheme.add_known_type(
+            GroupVersionKind("resource.k8s.io", "v1alpha2", kind),
+            typ, dec, enc)
     scheme.add_defaulter(t.Pod, _default_pod)

@@ -337,12 +337,30 @@ class PodSpec:
     # reads to objects referenced by pods bound to that node.
     secret_volumes: Tuple[str, ...] = ()
     config_map_volumes: Tuple[str, ...] = ()
+    # resource.k8s.io claims consumed by this pod (core/v1
+    # PodSpec.ResourceClaims); the DynamicResources plugin gates scheduling
+    # on them and the resourceclaim controller materializes template entries
+    resource_claims: Tuple["PodResourceClaim", ...] = ()
     service_account_name: str = ""
     host_network: bool = False
     host_pid: bool = False
     host_ipc: bool = False
     security_context: Optional[SecurityContext] = None  # pod-level defaults
     runtime_class_name: str = ""  # node.k8s.io RuntimeClass (overhead source)
+
+
+@dataclass(frozen=True)
+class PodResourceClaim:
+    """core/v1 PodResourceClaim (pod.spec.resourceClaims[]): names one
+    resource.k8s.io claim the pod consumes. Exactly one source is set:
+    ``claim_name`` references an existing ResourceClaim directly;
+    ``template_name`` names a ResourceClaimTemplate the resourceclaim
+    controller materializes as ``<pod>-<name>`` (the generic-ephemeral-volume
+    naming scheme, reused)."""
+
+    name: str = ""
+    claim_name: str = ""
+    template_name: str = ""
 
 
 @dataclass
@@ -447,6 +465,12 @@ class NodeStatus:
     memory_pressure: bool = False
     disk_pressure: bool = False
     pid_pressure: bool = False
+    # node-published device slice (resource.k8s.io structured parameters):
+    # the per-node attribute map a DRA driver's kubelet plugin publishes
+    # (the NodeResourceSlice object collapsed onto NodeStatus, like
+    # allocatable). Values are ints or strings; selectors in
+    # ResourceClass/ResourceClaim match against these (api/dra.py).
+    device_attributes: Dict[str, object] = field(default_factory=dict)
 
 
 @dataclass
@@ -960,3 +984,65 @@ class Binding:
 
     pod_key: str = ""
     node_name: str = ""
+
+
+# ---------------------------------------------------------------------------
+# resource.k8s.io (Dynamic Resource Allocation, structured parameters)
+#
+# The DRA surface reduced to typed attribute selectors instead of opaque
+# driver blobs: a selector map is ``attribute key -> expression`` (e.g.
+# {"tpu.dev/cores": ">=4", "tpu.dev/gen": "v5"}; api/dra.py parses and
+# evaluates them against NodeStatus.device_attributes). Allocation is
+# node-level: a claim allocates to one node and any number of pods on that
+# node may reserve it (per-device inventory is out of scope — attributes
+# describe the node's device class, not individual devices).
+
+
+@dataclass
+class ResourceClass:
+    """resource.k8s.io ResourceClass (cluster-scoped): driver identity plus
+    the class-level structured-parameter selectors every claim of this class
+    inherits."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    driver_name: str = ""
+    selectors: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class ResourceClaim:
+    """resource.k8s.io ResourceClaim (namespaced): a request for devices
+    matching the class + claim selectors, plus the allocation status the
+    scheduler's DynamicResources plugin maintains (Reserve writes
+    ``allocated_node``; pods consuming the claim appear in
+    ``reserved_for``)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    resource_class_name: str = ""
+    selectors: Dict[str, object] = field(default_factory=dict)
+    # status
+    allocated_node: str = ""            # "" = unallocated
+    reserved_for: Tuple[str, ...] = ()  # pod keys consuming the claim
+
+
+@dataclass
+class ResourceClaimTemplate:
+    """resource.k8s.io ResourceClaimTemplate (namespaced): the spec the
+    resourceclaim controller stamps out as a pod-owned ResourceClaim for
+    every pod.spec.resourceClaims entry that references it."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    resource_class_name: str = ""
+    selectors: Dict[str, object] = field(default_factory=dict)
+
+
+@dataclass
+class PodSchedulingContext:
+    """resource.k8s.io PodSchedulingContext (namespaced; name = pod name):
+    the scheduler⇄driver negotiation object — here the scheduler's PostBind
+    persists the selected node (the driver side is in-process, so
+    potential_nodes stays informational)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    selected_node: str = ""
+    potential_nodes: Tuple[str, ...] = ()

@@ -442,14 +442,15 @@ def validate_namespace(ns) -> List[str]:
 
 _CLUSTER_SCOPED_META_ONLY = (
     "PersistentVolume", "StorageClass", "CSINode", "ClusterRole",
-    "ClusterRoleBinding",
+    "ClusterRoleBinding", "ResourceClass",
 )
 _NAMESPACED_META_ONLY = (
     "PersistentVolumeClaim", "ConfigMap", "Secret", "ServiceAccount",
     "ReplicaSet", "ReplicationController", "StatefulSet", "Deployment",
     "DaemonSet", "Job", "CronJob", "Endpoints", "EndpointSlice", "Lease",
     "PodDisruptionBudget", "ResourceQuota", "LimitRange",
-    "HorizontalPodAutoscaler",
+    "HorizontalPodAutoscaler", "ResourceClaim", "ResourceClaimTemplate",
+    "PodSchedulingContext",
 )
 
 

@@ -82,6 +82,19 @@ class PodWrapper:
         self.pod.spec.volumes = self.pod.spec.volumes + (claim_name,)
         return self
 
+    def resource_claim(self, name: str, claim_name: str = "",
+                       template_name: str = "") -> "PodWrapper":
+        """Add a pod.spec.resourceClaims entry (resource.k8s.io DRA):
+        either a direct claim reference or a template reference the
+        resourceclaim controller materializes as ``<pod>-<name>``."""
+        from .types import PodResourceClaim
+
+        self.pod.spec.resource_claims = self.pod.spec.resource_claims + (
+            PodResourceClaim(name=name, claim_name=claim_name,
+                             template_name=template_name),
+        )
+        return self
+
     def owner(self, kind: str, name: str) -> "PodWrapper":
         """Set the controller ownerReference (metav1.GetControllerOf)."""
         from .types import OwnerReference
@@ -228,6 +241,12 @@ class NodeWrapper:
         self.node_.status.images = self.node_.status.images + (
             ContainerImage(names=(name,), size_bytes=size_bytes),
         )
+        return self
+
+    def device_attrs(self, attrs: Dict[str, object]) -> "NodeWrapper":
+        """Publish a device slice (NodeStatus.device_attributes): the
+        attribute map resource.k8s.io selectors match against."""
+        self.node_.status.device_attributes.update(attrs)
         return self
 
 

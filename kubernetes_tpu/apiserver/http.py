@@ -80,6 +80,12 @@ RESOURCES = {
     ("apis/node.k8s.io/v1", "runtimeclasses"): "RuntimeClass",
     ("apis/networking.k8s.io/v1", "ingresses"): "Ingress",
     ("apis/networking.k8s.io/v1", "ingressclasses"): "IngressClass",
+    ("apis/resource.k8s.io/v1alpha2", "resourceclasses"): "ResourceClass",
+    ("apis/resource.k8s.io/v1alpha2", "resourceclaims"): "ResourceClaim",
+    ("apis/resource.k8s.io/v1alpha2", "resourceclaimtemplates"):
+        "ResourceClaimTemplate",
+    ("apis/resource.k8s.io/v1alpha2", "podschedulingcontexts"):
+        "PodSchedulingContext",
     ("apis/apiextensions.k8s.io/v1", "customresourcedefinitions"):
         "CustomResourceDefinition",
     ("apis/apiregistration.k8s.io/v1", "apiservices"): "APIService",

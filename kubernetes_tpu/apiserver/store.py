@@ -143,6 +143,14 @@ class ClusterStore:
         self.hpas: Dict[str, object] = {}
         self.cluster_roles: Dict[str, object] = {}
         self.cluster_role_bindings: Dict[str, object] = {}
+        # resource.k8s.io (Dynamic Resource Allocation): class catalog,
+        # claims (allocation status written by the scheduler's Reserve/
+        # PostBind), templates the resourceclaim controller stamps out, and
+        # the scheduler⇄driver negotiation objects
+        self.resource_classes: Dict[str, object] = {}
+        self.resource_claims: Dict[str, object] = {}
+        self.resource_claim_templates: Dict[str, object] = {}
+        self.pod_scheduling_contexts: Dict[str, object] = {}
         # apiextensions (VERDICT r4 item 10): registered CRDs + one dynamic
         # kind map per served kind — plugin-requested GVKs get real objects,
         # journaled watches and informers through the same generic machinery
@@ -359,6 +367,10 @@ class ClusterStore:
                 "HorizontalPodAutoscaler": self.hpas,
                 "ClusterRole": self.cluster_roles,
                 "ClusterRoleBinding": self.cluster_role_bindings,
+                "ResourceClass": self.resource_classes,
+                "ResourceClaim": self.resource_claims,
+                "ResourceClaimTemplate": self.resource_claim_templates,
+                "PodSchedulingContext": self.pod_scheduling_contexts,
                 "CustomResourceDefinition": self.crds,
                 "APIService": self.api_services,
                 **self._custom_kinds,
@@ -516,7 +528,7 @@ class ClusterStore:
         "PriorityClass", "VolumeAttachment",
         "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
         "ClusterRole", "ClusterRoleBinding", "CertificateSigningRequest",
-        "RuntimeClass", "IngressClass",
+        "RuntimeClass", "IngressClass", "ResourceClass",
     }
 
     def is_cluster_scoped(self, kind: str) -> bool:
@@ -822,3 +834,49 @@ class ClusterStore:
             self._journal_event("PersistentVolumeClaim", MODIFIED, old_pvc, new_pvc)
         self._notify("PersistentVolume", MODIFIED, old_pv, new_pv)
         self._notify("PersistentVolumeClaim", MODIFIED, old_pvc, new_pvc)
+
+    # ------------------------------------------------------------- resource.k8s.io
+
+    def allocate_claim(self, claim_key: str, node_name: str, pod_key: str) -> None:
+        """Allocate a ResourceClaim to a node and reserve it for a pod,
+        transactionally (the scheduler's Reserve write; claim_controller.go
+        allocation + reservedFor semantics). A claim already allocated to a
+        DIFFERENT node raises Conflict — the caller unreserves and retries."""
+        with self._lock:
+            claim = self.resource_claims.get(claim_key)
+            if claim is None:
+                raise NotFound(claim_key)
+            if claim.allocated_node and claim.allocated_node != node_name:
+                raise Conflict(
+                    f"claim {claim_key} already allocated to {claim.allocated_node}")
+            old = claim
+            import dataclasses as _dc
+
+            reserved = old.reserved_for
+            if pod_key not in reserved:
+                reserved = reserved + (pod_key,)
+            new = _dc.replace(old, allocated_node=node_name, reserved_for=reserved)
+            self._bump(new)
+            self.resource_claims[claim_key] = new
+            self._journal_event("ResourceClaim", MODIFIED, old, new)
+        self._notify("ResourceClaim", MODIFIED, old, new)
+
+    def release_claim(self, claim_key: str, pod_key: str) -> None:
+        """Drop one pod's reservation; the last reservation leaving also
+        deallocates (the in-process stand-in for the driver's deallocate —
+        node-level allocations have nothing else to free)."""
+        with self._lock:
+            claim = self.resource_claims.get(claim_key)
+            if claim is None or pod_key not in claim.reserved_for:
+                return
+            old = claim
+            import dataclasses as _dc
+
+            reserved = tuple(k for k in old.reserved_for if k != pod_key)
+            new = _dc.replace(
+                old, reserved_for=reserved,
+                allocated_node=old.allocated_node if reserved else "")
+            self._bump(new)
+            self.resource_claims[claim_key] = new
+            self._journal_event("ResourceClaim", MODIFIED, old, new)
+        self._notify("ResourceClaim", MODIFIED, old, new)

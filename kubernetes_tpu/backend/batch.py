@@ -70,6 +70,49 @@ def pallas_mode(nt: NodeTensors, axis_name, topo_enabled: bool) -> Optional[str]
         return "interpret"
     return "compiled" if pallas_step.compile_supported() else None
 
+# ---------------------------------------------------------------------------
+# DRA claim-feasibility mask (resource.k8s.io structured parameters)
+#
+# One vmapped predicate over the pod axis: every pod row carries its merged
+# class+claim selectors as (key column, op, operand kind, operand) int32
+# quadruples; the node axis carries the device-attribute table DeviceState
+# syncs from node-published slices ([N, A] kind/value cells). The semantics
+# are api/dra.py's DeviceSelector.matches, evaluated for all (pod, node)
+# pairs in one device call — claim-bearing pods stay on the batched path
+# instead of falling back to the sequential oracle.
+
+from ..api.dra import OP_EQ, OP_GE, OP_GT, OP_LE, OP_LT, OP_NE  # noqa: E402
+
+
+@jax.jit
+def claim_feasibility_mask(sel_key: jax.Array, sel_op: jax.Array,
+                           sel_kind: jax.Array, sel_val: jax.Array,
+                           attr_kind: jax.Array, attr_val: jax.Array) -> jax.Array:
+    """[P, N] bool: node attribute table satisfies every selector of each pod.
+
+    sel_* : [P, S] int32 selector rows, op == -1 padding (always matches);
+    attr_kind/attr_val : [N, A] device-attribute cells (kind 0 = absent,
+    1 = int, 2 = interned string id). Single source of truth for the
+    predicate: api/dra.py (host) — this is its vectorized transcription."""
+
+    def one_pod(keys, ops, okind, oval):
+        ak = attr_kind[:, keys]                      # [N, S]
+        av = attr_val[:, keys]
+        present = ak > 0
+        same = present & (ak == okind[None, :])
+        num = present & (ak == 1) & (okind[None, :] == 1)
+        ov = oval[None, :]
+        ok = jnp.where(ops[None, :] == OP_EQ, same & (av == ov), False)
+        ok = jnp.where(ops[None, :] == OP_NE, same & (av != ov), ok)
+        ok = jnp.where(ops[None, :] == OP_GE, num & (av >= ov), ok)
+        ok = jnp.where(ops[None, :] == OP_GT, num & (av > ov), ok)
+        ok = jnp.where(ops[None, :] == OP_LE, num & (av <= ov), ok)
+        ok = jnp.where(ops[None, :] == OP_LT, num & (av < ov), ok)
+        return jnp.all(jnp.where(ops[None, :] >= 0, ok, True), axis=1)  # [N]
+
+    return jax.vmap(one_pod)(sel_key, sel_op, sel_kind, sel_val)
+
+
 # default plugin weights on the batched path (default_plugins.go:32-51)
 DEFAULT_WEIGHTS = {
     "NodeResourcesBalancedAllocation": 1.0,
@@ -863,6 +906,7 @@ def schedule_batch_core(
     spec_decode: bool = False,
     ports_enabled: bool = True,
     extra_mask: Optional[jax.Array] = None,
+    dra_mask: Optional[jax.Array] = None,
 ) -> BatchResult:
     """The traceable body; nt's node axis may be a shard (axis_name set).
 
@@ -872,6 +916,11 @@ def schedule_batch_core(
     "VolumeBinding" in the first-fail table (id 9); the reference would
     blame an earlier plugin when e.g. ports ALSO fail on the same node —
     a documented attribution-precision divergence, not a placement one.
+    ``dra_mask`` (optional [P, N] bool) is the claim-feasibility screen
+    (claim_feasibility_mask above — usually a still-unmaterialized device
+    array), attributed as "DynamicResources" (id 10); claims allocate at
+    node granularity, so the mask is exact per batch and the host Reserve
+    re-verifies allocation at commit.
     ``topo_enabled`` is a trace-time flag: batches with no spread constraints,
     no affinity terms and no registered count rows compile a program with the
     whole topology path dead-code-eliminated (the common fast case).
@@ -914,11 +963,15 @@ def schedule_batch_core(
         static_ok = static_ok & m
     if extra_mask is not None:
         static_ok = static_ok & extra_mask
+    if dra_mask is not None:
+        static_ok = static_ok & dra_mask
 
     # static half of the first-failing-plugin table (ids follow the filter
     # config order in tpu_scheduler._ATTRIBUTION_ORDER; 0 = passes). Reverse
     # assignment order makes the earliest failing plugin win.
     static_ff = jnp.zeros(static_ok.shape, jnp.int8)
+    if dra_mask is not None:
+        static_ff = jnp.where(~dra_mask, np.int8(10), static_ff)
     if extra_mask is not None:
         static_ff = jnp.where(~extra_mask, np.int8(9), static_ff)
     for sid, name in ((4, "NodeAffinity"), (3, "TaintToleration"),
@@ -1279,6 +1332,7 @@ def schedule_batch(
     spec_decode: bool = False,
     ports_enabled: bool = True,
     extra_mask: Optional[jax.Array] = None,
+    dra_mask: Optional[jax.Array] = None,
 ) -> BatchResult:
     return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
                                pallas=pallas, topo_carry=topo_carry,
@@ -1286,7 +1340,7 @@ def schedule_batch(
                                topo_mode=topo_mode, vd_override=vd_override,
                                host_key=host_key, spec_decode=spec_decode,
                                ports_enabled=ports_enabled,
-                               extra_mask=extra_mask)
+                               extra_mask=extra_mask, dra_mask=dra_mask)
 
 
 def spec_decode_eligible(sample_k) -> bool:
@@ -1320,13 +1374,14 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
 
     def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
            sample_k=None, sample_start=None, topo_mode=None, vd_override=None,
-           host_key=0, ports_enabled=True, extra_mask=None):
+           host_key=0, ports_enabled=True, extra_mask=None, dra_mask=None):
         spec = spec_decode_eligible(sample_k)
         # the pallas fused step has no sampling emulation yet; the
         # speculative path replaces it where both apply (fewer device steps).
-        # The fused kernel has no extra-mask input either — a volume batch
-        # takes the XLA path.
-        mode = (None if (sample_k is not None or spec or extra_mask is not None)
+        # The fused kernel has no extra-mask/dra-mask input either — volume
+        # and claim batches take the XLA path.
+        mode = (None if (sample_k is not None or spec or extra_mask is not None
+                         or dra_mask is not None)
                 else pallas_mode(nt, None, topo_enabled))
         return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
                               topo_enabled=topo_enabled, pallas=mode,
@@ -1334,6 +1389,6 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
                               sample_start=sample_start, topo_mode=topo_mode,
                               vd_override=vd_override, host_key=host_key,
                               spec_decode=spec, ports_enabled=ports_enabled,
-                              extra_mask=extra_mask)
+                              extra_mask=extra_mask, dra_mask=dra_mask)
 
     return fn

@@ -1,0 +1,110 @@
+"""Batched claim-feasibility pre-pass for resource.k8s.io claims.
+
+The DRA analog of ops/volume_mask.py, but exact rather than one-sided:
+claims allocate at NODE granularity (api/types.py ResourceClass), so a
+pod's claim feasibility is a static per-batch predicate — merged
+class+claim selectors against the node-published device-attribute table
+DeviceState keeps on device. This builder encodes each pod's selectors into
+int32 rows and dispatches ONE vmapped device call
+(backend/batch.py claim_feasibility_mask); the result joins the batch
+program's static filter phase as ``dra_mask`` (first-fail id 10,
+"DynamicResources").
+
+What stays host-side: claims already allocated pin the pod to the allocated
+node (a host-built restriction row — slot lookup needs the encoder map),
+and the commit path's Reserve re-verifies allocation exactly, so an
+intra-batch race on a shared claim fails at Reserve and retries against the
+updated allocation instead of double-allocating.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import dra
+
+
+def _bucket(n: int, floor: int = 4) -> int:
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class ClaimMaskBuilder:
+    def __init__(self, client):
+        self.client = client
+
+    # -- per-pod gate
+
+    def batchable(self, pod) -> bool:
+        """Cheap gate: every referenced ResourceClaim exists and its class
+        resolves. Missing claims go to the sequential oracle, whose
+        PreFilter records the proper UnschedulableAndUnresolvable status
+        (and the ResourceClaim cluster event reactivates the pod)."""
+        for _name, claim_key in dra.claim_refs_for_pod(pod):
+            claim = self.client.get_object("ResourceClaim", claim_key)
+            if claim is None:
+                return False
+            _sels, err = dra.selectors_for_claim(self.client, claim)
+            if err:
+                return False
+        return True
+
+    # -- the batch mask
+
+    def build(self, qps, device, pad_to: int):
+        """[pad_to, device.caps.nodes] bool DEVICE array, or None when no
+        pod in the batch carries claims. Rows for claim-less (and padding)
+        pods are all-True; selector encoding registers attribute keys and
+        string operands in the device vocab first, so the kernel sees the
+        post-growth table."""
+        if not any(qp.pod.spec.resource_claims for qp in qps):
+            return None
+        n_cap = device.caps.nodes
+        per_pod: List[List[dra.DeviceSelector]] = []
+        restrict: Optional[np.ndarray] = None
+        for p, qp in enumerate(qps):
+            pod = qp.pod
+            sels: List[dra.DeviceSelector] = []
+            for _name, claim_key in dra.claim_refs_for_pod(pod):
+                claim = self.client.get_object("ResourceClaim", claim_key)
+                if claim is None:
+                    continue  # raced with deletion: commit-time PreFilter owns it
+                merged, err = dra.selectors_for_claim(self.client, claim)
+                if err:
+                    continue  # class vanished mid-batch: same commit-time story
+                sels.extend(merged)
+                if claim.allocated_node:
+                    if restrict is None:
+                        restrict = np.ones((pad_to, n_cap), bool)
+                    slot = device.encoder.node_slots.get(claim.allocated_node)
+                    row = np.zeros(n_cap, bool)
+                    if slot is not None:
+                        row[slot] = True
+                    restrict[p] &= row
+            per_pod.append(sels)
+        s_cap = _bucket(max((len(s) for s in per_pod), default=1))
+        sel_key = np.zeros((pad_to, s_cap), np.int32)
+        sel_op = np.full((pad_to, s_cap), -1, np.int32)   # -1 = padding
+        sel_kind = np.zeros((pad_to, s_cap), np.int32)
+        sel_val = np.zeros((pad_to, s_cap), np.int32)
+        for p, sels in enumerate(per_pod):
+            for s, sel in enumerate(sels):
+                sel_key[p, s] = device.attr_slot(sel.key)
+                sel_op[p, s] = sel.op
+                sel_kind[p, s] = sel.operand_kind
+                sel_val[p, s] = (sel.operand if sel.operand_kind == dra.KIND_INT
+                                 else device.attr_value_id(sel.operand))
+        import jax.numpy as jnp
+
+        from .batch import claim_feasibility_mask
+
+        mask = claim_feasibility_mask(
+            jnp.asarray(sel_key), jnp.asarray(sel_op), jnp.asarray(sel_kind),
+            jnp.asarray(sel_val), device.attr_kind, device.attr_val)
+        if restrict is not None:
+            mask = mask & jnp.asarray(restrict)
+        return mask

@@ -92,6 +92,25 @@ class DeviceState:
         # images) cannot differ from the mirror, so reconcile only needs to
         # compare the pod-commit-dynamic fields
         self._mirror_node: Dict[str, object] = {}
+        # --- device-attribute table (resource.k8s.io DRA) -----------------
+        # [nodes, A] kind/value cells synced from node-published device
+        # slices (NodeStatus.device_attributes): kind 0 = absent, 1 = int,
+        # 2 = interned string id. Kept OUTSIDE NodeTensors on purpose —
+        # attributes are static per node object (no batch commit ever
+        # touches them), so they need none of the mirror/adoption machinery;
+        # the claim-feasibility kernel (backend/batch.py) reads them
+        # directly. The attribute-key axis grows by doubling (bucketed
+        # static shapes, same policy as Capacities).
+        self.attr_slots: Dict[str, int] = {}   # attribute key -> column
+        self.attr_val_ids: Dict[str, int] = {} # string value vocab (ids from 1)
+        self._attr_cols = 8
+        self._attr_kind_m = np.zeros((caps.nodes, self._attr_cols), np.int32)
+        self._attr_val_m = np.zeros((caps.nodes, self._attr_cols), np.int32)
+        # jnp.array (copying), never asarray: the host mirror keeps mutating
+        # and a zero-copy alias would silently corrupt the device view
+        self.attr_kind = jnp.array(self._attr_kind_m)
+        self.attr_val = jnp.array(self._attr_val_m)
+        self._node_attrs: Dict[str, dict] = {}  # name -> last-synced mapping
         # O(changes) reconcile/has_dirty: names this device previously left
         # dirty, and the snapshot structure version it last fully walked.
         # While the structure version is unchanged, only changed_names ∪
@@ -135,6 +154,79 @@ class DeviceState:
             name_hash=jnp.asarray(z(c.nodes, np.uint32)),
         )
 
+    # ------------------------------------------------------- device attributes
+
+    def attr_slot(self, key: str) -> int:
+        """Column for an attribute key, registering (and growing the axis by
+        doubling) on first sight. Selector encoding registers keys too, so a
+        selector on a never-published key gets a real, all-absent column."""
+        slot = self.attr_slots.get(key)
+        if slot is None:
+            slot = len(self.attr_slots)
+            self.attr_slots[key] = slot
+            while slot >= self._attr_cols:
+                self._grow_attr_cols()
+        return slot
+
+    def _grow_attr_cols(self) -> None:
+        cols = self._attr_cols * 2
+        pad = ((0, 0), (0, cols - self._attr_cols))
+        self._attr_kind_m = np.pad(self._attr_kind_m, pad)
+        self._attr_val_m = np.pad(self._attr_val_m, pad)
+        self._attr_cols = cols
+        self.attr_kind = jnp.array(self._attr_kind_m)
+        self.attr_val = jnp.array(self._attr_val_m)
+
+    def attr_value_id(self, value: str) -> int:
+        """Interned id for a string attribute value (shared by node rows and
+        selector operands — string equality becomes id equality)."""
+        vid = self.attr_val_ids.get(value)
+        if vid is None:
+            vid = len(self.attr_val_ids) + 1
+            self.attr_val_ids[value] = vid
+        return vid
+
+    def _track_attrs(self, name: str, ni: Optional[NodeInfo], slot: int,
+                     pending: Dict[int, dict]) -> None:
+        """Record a dirty node's published attribute map for upload (called
+        from sync's dirty walk — attribute changes always ride a node-object
+        change, so the generation probe covers them)."""
+        node = ni.node if ni is not None else None
+        attrs = (dict(getattr(node.status, "device_attributes", None) or {})
+                 if node is not None else {})
+        if self._node_attrs.get(name, {}) == attrs:
+            return
+        if attrs:
+            self._node_attrs[name] = attrs
+        else:
+            self._node_attrs.pop(name, None)
+        for key in attrs:
+            self.attr_slot(key)  # register first: rows encode after growth
+        pending[slot] = attrs
+
+    def _upload_attrs(self, pending: Dict[int, dict]) -> None:
+        if not pending:
+            return
+        from ..api import dra as dra_api
+
+        for slot, attrs in pending.items():
+            krow = np.zeros(self._attr_cols, np.int32)
+            vrow = np.zeros(self._attr_cols, np.int32)
+            for key, raw in attrs.items():
+                kind, val = dra_api.attr_kind_val(raw)
+                if kind == dra_api.KIND_ABSENT:
+                    continue
+                col = self.attr_slot(key)
+                krow[col] = kind
+                vrow[col] = val if kind == dra_api.KIND_INT else self.attr_value_id(val)
+            self._attr_kind_m[slot] = krow
+            self._attr_val_m[slot] = vrow
+        # full re-upload, not a scatter: attribute maps change only with
+        # node-object churn (rare), and [N, A] int32 is small next to the
+        # row tensors — not worth a third scatter program
+        self.attr_kind = jnp.array(self._attr_kind_m)
+        self.attr_val = jnp.array(self._attr_val_m)
+
     # ------------------------------------------------------------------ sync
 
     def _refresh_class_prio(self) -> None:
@@ -156,6 +248,7 @@ class DeviceState:
         dirty: List[Tuple[int, NodeInfo]] = []
         current = set()
         images_changed = False
+        attr_pending: Dict[int, dict] = {}
         for name, ni in snapshot.node_info_map.items():
             current.add(name)
             if self._uploaded_gen.get(name) == ni.generation:
@@ -164,6 +257,7 @@ class DeviceState:
             dirty.append((slot, ni))
             self._uploaded_gen[name] = ni.generation
             images_changed |= self._track_images(name, ni)
+            self._track_attrs(name, ni, slot, attr_pending)
             self.sig_table.recount_node(slot, ni)
         # removed nodes: zero their rows
         removed = [n for n in self._uploaded_gen if n not in current]
@@ -174,7 +268,13 @@ class DeviceState:
             if slot is not None:
                 dirty.append((slot, NodeInfo()))  # empty row: valid=False
                 self.sig_table.recount_node(slot, None)
+                self._track_attrs(name, None, slot, attr_pending)
+            else:
+                self._node_attrs.pop(name, None)
             images_changed |= self._track_images(name, None)
+        # device-attribute table upload happens even when every row upload
+        # below gets content-elided (attrs live outside the row mirror)
+        self._upload_attrs(attr_pending)
 
         # the full walk leaves every gen aligned: reset the O(changes) probes.
         # Duck-typed snapshots (wire service, test shims) may lack the

@@ -357,9 +357,10 @@ class WireScheduler(Scheduler):
 
     def _wire_supported(self, pod: Pod) -> bool:
         """Same gating as TPUScheduler.batch_supported: the service runs the
-        compiled DEFAULT plugin set — volume pods and custom profiles take
-        the local sequential path."""
-        if pod.spec.volumes:
+        compiled DEFAULT plugin set — volume pods, resource.k8s.io claim
+        pods (the wire protocol carries no dra_mask yet), and custom
+        profiles take the local sequential path."""
+        if pod.spec.volumes or pod.spec.resource_claims:
             return False
         fwk = self.framework_for_pod(pod)
         cached = self._batchable_cache.get(fwk.profile_name)

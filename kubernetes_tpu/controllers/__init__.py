@@ -18,6 +18,7 @@ from .housekeeping import (
 )
 from .manager import ControllerManager
 from .nodelifecycle import NodeLifecycleController
+from .resourceclaim import ResourceClaimController
 from .workloads import (
     DaemonSetController,
     DeploymentController,
@@ -38,5 +39,6 @@ __all__ = [
     "PVBinderController",
     "PodGCController",
     "ReplicaSetController",
+    "ResourceClaimController",
     "StatefulSetController",
 ]

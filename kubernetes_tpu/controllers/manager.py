@@ -52,6 +52,7 @@ from .extras import (
     TTLController,
 )
 from .nodelifecycle import NodeLifecycleController
+from .resourceclaim import ResourceClaimController
 from .workloads import (
     DaemonSetController,
     DeploymentController,
@@ -108,6 +109,7 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         "endpointslicemirroring": lambda m: EndpointSliceMirroringController(
             m.store, m.factory),
         "ephemeral-volume": lambda m: EphemeralVolumeController(m.store, m.factory),
+        "resourceclaim": lambda m: ResourceClaimController(m.store, m.factory),
         "horizontalpodautoscaling": lambda m: HorizontalPodAutoscalerController(
             m.store, m.factory, now_fn=m.now_fn),
         # certificate/security loops (controllermanager.go:412 tail)

@@ -1,0 +1,201 @@
+"""DynamicResources plugin — resource.k8s.io claims gate scheduling.
+
+Oracle implementation of the reference's dynamicresources plugin
+(pkg/scheduler/framework/plugins/dynamicresources, the structured-parameters
+shape): PreFilter resolves the pod's claims and fails fast when one is
+missing; Filter checks the node's published device attributes against the
+merged class+claim selectors (api/dra.py — the SAME predicate the TPU
+batched claim-feasibility mask computes); Reserve allocates each claim to
+the chosen node through the store (rolled back by Unreserve); PostBind
+persists the PodSchedulingContext selected-node status.
+
+Allocation is node-level (see api/types.py ResourceClass): claims carry no
+per-device inventory, so intra-batch claim contention reduces to the
+allocated-node restriction — which is why the batched path can screen claims
+with a STATIC per-batch mask and verify exactly at Reserve time.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ...api import dra
+from ...api.types import Pod
+from ...apiserver.store import Conflict, NotFound
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    OK,
+    PostBindPlugin,
+    PreFilterPlugin,
+    PreFilterResult,
+    ReservePlugin,
+    Status,
+)
+from ..types import (
+    ADD,
+    ALL,
+    ClusterEvent,
+    NODE,
+    NodeInfo,
+    RESOURCE_CLAIM,
+    RESOURCE_CLASS,
+    UPDATE,
+)
+from . import names
+
+ERR_REASON_MISSING_CLAIM = "waiting for resource claim to be created"
+ERR_REASON_CANNOT_ALLOCATE = "cannot allocate all claims"
+
+
+class _ClaimState:
+    """PreFilter → Filter/Reserve state: [(claim key, claim, selectors)]."""
+
+    __slots__ = ("claims", "allocated")
+
+    def __init__(self, claims):
+        self.claims = claims
+        self.allocated: List[str] = []  # claim keys this pod reserved
+
+    def clone(self) -> "_ClaimState":
+        cs = _ClaimState(self.claims)
+        cs.allocated = list(self.allocated)
+        return cs
+
+
+class DynamicResources(PreFilterPlugin, FilterPlugin, ReservePlugin, PostBindPlugin):
+    STATE_KEY = "PreFilter/DynamicResources"
+
+    def __init__(self, client=None, metrics=None):
+        self.client = client
+        self.metrics = metrics
+
+    def _count(self, result: str) -> None:
+        if self.metrics is not None:
+            self.metrics.dra_claim_allocations.inc(result)
+
+    def name(self) -> str:
+        return names.DYNAMIC_RESOURCES
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        # claim/class churn (the resourceclaim controller materializing a
+        # template, a deallocation) and node attribute publication must
+        # reactivate pods this plugin failed
+        return [
+            ClusterEvent(RESOURCE_CLAIM, ALL, "ResourceClaimChange"),
+            ClusterEvent(RESOURCE_CLASS, ADD | UPDATE, "ResourceClassChange"),
+            ClusterEvent(NODE, ADD | UPDATE, ""),
+        ]
+
+    # ----------------------------------------------------------- prefilter
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        refs = dra.claim_refs_for_pod(pod)
+        if not refs:
+            return None, OK
+        claims = []
+        for entry_name, claim_key in refs:
+            claim = self.client.get_object("ResourceClaim", claim_key)
+            if claim is None:
+                # the resourceclaim controller has not materialized the
+                # template yet (or the claim was deleted): unresolvable — a
+                # ResourceClaim event reactivates the pod (dynamicresources
+                # PreFilter's "claim not found" path)
+                return None, Status.unresolvable(
+                    f'{ERR_REASON_MISSING_CLAIM} "{entry_name}"')
+            selectors, err = dra.selectors_for_claim(self.client, claim)
+            if err:
+                return None, Status.unresolvable(err)
+            claims.append((claim_key, claim, selectors))
+        state.write(self.STATE_KEY, _ClaimState(claims))
+        # claims already allocated pin the pod to their node (PreFilter's
+        # node-restriction shortcut)
+        nodes = None
+        for _key, claim, _sels in claims:
+            if claim.allocated_node:
+                cur = {claim.allocated_node}
+                nodes = cur if nodes is None else nodes & cur
+        if nodes is not None:
+            return PreFilterResult(nodes), OK
+        return None, OK
+
+    # -------------------------------------------------------------- filter
+
+    def filter(self, state: CycleState, pod: Pod, node_info: NodeInfo) -> Status:
+        try:
+            s: _ClaimState = state.read(self.STATE_KEY)
+        except KeyError:
+            return OK
+        node = node_info.node
+        if node is None:
+            return Status.unschedulable(ERR_REASON_CANNOT_ALLOCATE)
+        attrs = node.status.device_attributes
+        for _key, claim, selectors in s.claims:
+            if claim.allocated_node and claim.allocated_node != node.meta.name:
+                return Status.unschedulable(ERR_REASON_CANNOT_ALLOCATE)
+            for sel in selectors:
+                if not sel.matches(attrs):
+                    return Status.unschedulable(ERR_REASON_CANNOT_ALLOCATE)
+        return OK
+
+    # ------------------------------------------------------------- reserve
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        try:
+            s: _ClaimState = state.read(self.STATE_KEY)
+        except KeyError:
+            return OK
+        s.allocated = []
+        pod_key = pod.key()
+        for claim_key, _claim, _sels in s.claims:
+            try:
+                self.client.allocate_claim(claim_key, node_name, pod_key)
+            except (Conflict, NotFound):
+                # raced with another allocation (or the claim vanished):
+                # roll back what this pod took and retry the cycle
+                self._count("conflict")
+                self.unreserve(state, pod, node_name)
+                return Status.unschedulable(ERR_REASON_CANNOT_ALLOCATE)
+            self._count("allocated")
+            s.allocated.append(claim_key)
+        return OK
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        try:
+            s: _ClaimState = state.read(self.STATE_KEY)
+        except KeyError:
+            return
+        pod_key = pod.key()
+        for claim_key in s.allocated:
+            self.client.release_claim(claim_key, pod_key)
+            self._count("released")
+        s.allocated = []
+
+    # ------------------------------------------------------------ postbind
+
+    def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        if not pod.spec.resource_claims:
+            return
+        import dataclasses as _dc
+
+        from ...api.types import ObjectMeta, OwnerReference, PodSchedulingContext
+
+        key = pod.key()
+        existing = self.client.get_object("PodSchedulingContext", key)
+        try:
+            if existing is None:
+                # pod-owned: the resourceclaim controller's pod GC (and the
+                # ownership graph) reap it with the pod — no leaked contexts
+                self.client.create_object("PodSchedulingContext", PodSchedulingContext(
+                    meta=ObjectMeta(name=pod.meta.name,
+                                    namespace=pod.meta.namespace,
+                                    owner_references=(OwnerReference(
+                                        kind="Pod", name=pod.meta.name,
+                                        controller=True),)),
+                    selected_node=node_name))
+            elif existing.selected_node != node_name:
+                self.client.update_object(
+                    "PodSchedulingContext",
+                    _dc.replace(existing, selected_node=node_name))
+        except Conflict:
+            pass  # concurrent writer; the status is already current

@@ -14,6 +14,7 @@ from .plugins import names
 from .plugins.basic import NodeName, NodePorts, NodeUnschedulable, PrioritySort, TaintToleration
 from .plugins.defaultbinder import DefaultBinder
 from .plugins.defaultpreemption import DefaultPreemption
+from .plugins.dynamicresources import DynamicResources
 from .plugins.imagelocality import ImageLocality
 from .plugins.interpodaffinity import InterPodAffinity
 from .plugins.nodeaffinity import NodeAffinity
@@ -75,6 +76,8 @@ def in_tree_registry() -> Dict[str, Factory]:
             store=h.get("client"), snapshot_fn=h.get("snapshot_fn")
         ),
         names.VOLUME_BINDING: lambda h, a: VolumeBinding(client=h.get("client")),
+        names.DYNAMIC_RESOURCES: lambda h, a: DynamicResources(
+            client=h.get("client"), metrics=h.get("metrics")),
         names.DEFAULT_PREEMPTION: lambda h, a: DefaultPreemption(
             snapshot_fn=h.get("snapshot_fn"),
             pdb_lister=(h["client"].list_pdbs if h.get("client") is not None and hasattr(h["client"], "list_pdbs") else None),
@@ -96,6 +99,7 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.POD_TOPOLOGY_SPREAD, 0),
         (names.INTER_POD_AFFINITY, 0),
         (names.VOLUME_BINDING, 0),
+        (names.DYNAMIC_RESOURCES, 0),
     ],
     "filter": [
         (names.NODE_UNSCHEDULABLE, 0),
@@ -110,6 +114,7 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.VOLUME_ZONE, 0),
         (names.POD_TOPOLOGY_SPREAD, 0),
         (names.INTER_POD_AFFINITY, 0),
+        (names.DYNAMIC_RESOURCES, 0),
     ],
     "post_filter": [(names.DEFAULT_PREEMPTION, 0)],
     "pre_score": [
@@ -128,9 +133,9 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.POD_TOPOLOGY_SPREAD, 2),
         (names.TAINT_TOLERATION, 3),
     ],
-    "reserve": [(names.VOLUME_BINDING, 0)],
+    "reserve": [(names.VOLUME_BINDING, 0), (names.DYNAMIC_RESOURCES, 0)],
     "permit": [],
     "pre_bind": [(names.VOLUME_BINDING, 0)],
     "bind": [(names.DEFAULT_BINDER, 0)],
-    "post_bind": [],
+    "post_bind": [(names.DYNAMIC_RESOURCES, 0)],
 }

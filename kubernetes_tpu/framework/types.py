@@ -277,6 +277,9 @@ PVC = GVK("PersistentVolumeClaim")
 PV = GVK("PersistentVolume")
 STORAGE_CLASS = GVK("StorageClass")
 CSI_NODE = GVK("CSINode")
+RESOURCE_CLAIM = GVK("ResourceClaim")
+RESOURCE_CLASS = GVK("ResourceClass")
+POD_SCHEDULING_CONTEXT = GVK("PodSchedulingContext")
 WILDCARD = GVK("*")
 
 

@@ -46,6 +46,12 @@ GETTABLE = {
     "poddisruptionbudgets": "PodDisruptionBudget", "pdb": "PodDisruptionBudget",
     "cronjobs": "CronJob", "cronjob": "CronJob", "cj": "CronJob",
     "clusterroles": "ClusterRole", "clusterrolebindings": "ClusterRoleBinding",
+    "resourceclasses": "ResourceClass", "resourceclass": "ResourceClass",
+    "resourceclaims": "ResourceClaim", "resourceclaim": "ResourceClaim",
+    "resourceclaimtemplates": "ResourceClaimTemplate",
+    "resourceclaimtemplate": "ResourceClaimTemplate",
+    "podschedulingcontexts": "PodSchedulingContext",
+    "podschedulingcontext": "PodSchedulingContext",
 }
 
 

@@ -89,6 +89,13 @@ class SchedulerMetrics:
             "Pods per device batch.",
             buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512],
         ))
+        # resource.k8s.io (DRA): claim allocation outcomes at Reserve time
+        # (allocated|conflict) and Unreserve rollbacks (released)
+        self.dra_claim_allocations = r.register(Counter(
+            "scheduler_dynamic_resources_claim_allocations_total",
+            "ResourceClaim allocation outcomes by result.",
+            ["result"],
+        ))
 
     def observe_attempt(self, result: str, profile: str, duration_s: float) -> None:
         self.schedule_attempts.inc(result, profile)

@@ -109,6 +109,13 @@ def _node_wrapper(i: int, params: dict):
     if params.get("zones"):
         nw.label("topology.kubernetes.io/zone", f"zone-{i % params['zones']}")
         nw.label("kubernetes.io/hostname", f"node-{i}")
+    if params.get("device_attributes"):
+        # node-published device slice (resource.k8s.io): list values vary
+        # per node (value[i % len]) so workloads can shape the feasible set
+        attrs = {}
+        for k, v in dict(params["device_attributes"]).items():
+            attrs[k] = v[i % len(v)] if isinstance(v, (list, tuple)) else v
+        nw.device_attrs(attrs)
     return nw
 
 
@@ -181,6 +188,11 @@ def _pod_wrapper(i: int, prefix: str, params: dict):
         # pod-with-secret-volume.yaml: mounts need no binding and never
         # gate scheduling; the row measures the codec/admission cost only
         pw.pod.spec.secret_volumes = (str(params["secret_volume"]),)
+    for claim in params.get("claims") or ():
+        # resource.k8s.io claim template reference; the resourceclaim
+        # controller (pumped by the Runner) materializes the claim object
+        pw.resource_claim(str(claim.get("name", "claim")),
+                          template_name=str(claim.get("template", "template")))
     if params.get("spread_topology_key"):
         from ..api.types import (LabelSelector, TopologySpreadConstraint,
                                  DO_NOT_SCHEDULE, SCHEDULE_ANYWAY)
@@ -237,6 +249,12 @@ class Runner:
             self.scheduler = scheduler_from_config(self.store, cfg, seed=seed)
         self.data_items: List[DataItem] = []
         self._pod_counter = 0
+        # resource.k8s.io side-car loop: the resourceclaim controller that
+        # materializes template claims, created lazily on the first DRA
+        # workload op and pumped by barrier/measure (the reference harness
+        # runs the full controller-manager; only this loop gates scheduling)
+        self._dra_controller = None
+        self._dra_factory = None
 
     def close(self) -> None:
         """Release backend resources (the wire backend's HTTP server thread
@@ -271,11 +289,49 @@ class Runner:
                     meta=ObjectMeta(name=node.meta.name),
                     drivers={csi_driver: csi_count}))
 
+    def _ensure_dra(self, claims, namespace: str) -> None:
+        """Create the shared ResourceClass/ResourceClaimTemplate objects a
+        claims param references, and start the resourceclaim controller."""
+        from ..api.types import ObjectMeta, ResourceClass, ResourceClaimTemplate
+
+        if self._dra_controller is None:
+            from ..client.informer import SharedInformerFactory
+            from ..controllers.resourceclaim import ResourceClaimController
+
+            self._dra_factory = SharedInformerFactory(self.store)
+            self._dra_controller = ResourceClaimController(
+                self.store, self._dra_factory)
+            self._dra_factory.wait_for_cache_sync()
+        for cfg in claims:
+            cls_name = str(cfg.get("class", "example.com/device"))
+            if self.store.get_object("ResourceClass", cls_name) is None:
+                self.store.create_object("ResourceClass", ResourceClass(
+                    meta=ObjectMeta(name=cls_name, namespace=""),
+                    driver_name=cls_name,
+                    selectors=dict(cfg.get("class_selectors") or {})))
+            tmpl_name = str(cfg.get("template", "template"))
+            if self.store.get_object(
+                    "ResourceClaimTemplate", f"{namespace}/{tmpl_name}") is None:
+                self.store.create_object(
+                    "ResourceClaimTemplate", ResourceClaimTemplate(
+                        meta=ObjectMeta(name=tmpl_name, namespace=namespace),
+                        resource_class_name=cls_name,
+                        selectors=dict(cfg.get("selectors") or {})))
+
+    def _pump_dra(self) -> None:
+        """One resourceclaim controller round (claims materialize before the
+        scheduler's next look at their pods)."""
+        if self._dra_controller is not None:
+            self._dra_factory.pump()
+            self._dra_controller.sync_once()
+
     def _make_pod(self, prefix: str, params: dict):
         """One pod plus any per-pod side objects (pre-bound PV/PVC pairs,
         the shared Secret) — the persistentVolumeTemplatePath /
         defaultPodTemplatePath machinery of the reference harness."""
         pw = _pod_wrapper(self._pod_counter, prefix, params)
+        if params.get("claims"):
+            self._ensure_dra(params["claims"], pw.pod.meta.namespace)
         if params.get("secret_volume"):
             name = str(params["secret_volume"])
             ns = pw.pod.meta.namespace
@@ -315,6 +371,7 @@ class Runner:
         for _ in range(count):
             self.store.create_pod(self._make_pod(prefix, params))
             self._pod_counter += 1
+        self._pump_dra()
 
     def create_namespaces(self, count: int, prefix: str = "ns",
                           labels: Optional[dict] = None) -> None:
@@ -332,6 +389,7 @@ class Runner:
         (scheduler_perf_test.go:518 barrierOp)."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
+            self._pump_dra()
             progressed = self.scheduler.run_until_settled()
             if len(self.scheduler.queue) == 0:
                 return
@@ -382,6 +440,7 @@ class Runner:
         for _ in range(count):
             self.store.create_pod(self._make_pod(prefix, params))
             self._pod_counter += 1
+        self._pump_dra()
         scheduled_before = scheduled_count()
         target = scheduled_before + count
         i = 0

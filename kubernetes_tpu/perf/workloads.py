@@ -227,6 +227,37 @@ def mixed_scheduling_base_pod(nodes=5000, init_pods=2000, measured=1000) -> dict
     }
 
 
+def scheduling_dra(nodes=5000, init_pods=1000, measured=1000) -> dict:
+    """SchedulingDRA — the BASELINE stretch-config shape (full default
+    plugin set + DRA structured-parameter claims): nodes publish device
+    slices (NodeStatus.device_attributes, varied so only the v5 subset is
+    feasible), pods carry claim templates the resourceclaim controller
+    materializes, and the DynamicResources plugin gates placement. On the
+    tpu backend the claims ride the batched claim-feasibility mask
+    (backend/claim_mask.py) — the row measures that path staying off the
+    sequential fallback."""
+    pod = {
+        "req": {"cpu": "100m", "memory": "500Mi"},
+        "claims": [{"name": "accel", "template": "tpu-claim",
+                    "class": "tpu.example.com",
+                    "class_selectors": {"tpu.dev/gen": "v5"},
+                    "selectors": {"tpu.dev/cores": ">=8"}}],
+    }
+    return {
+        "name": f"SchedulingDRA/{nodes}Nodes",
+        "ops": [
+            # list-valued attributes vary per node (i % len): 3 of 4 nodes
+            # publish gen v5, all publish >=8 cores — claims filter to 75%
+            {"opcode": "createNodes", "count": nodes, "zones": 10,
+             "device_attributes": {"tpu.dev/cores": [8, 16],
+                                   "tpu.dev/gen": ["v5", "v5", "v4", "v5"]}},
+            {"opcode": "createPods", "count": init_pods, "prefix": "init", **pod},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured, "prefix": "dra", **pod},
+        ],
+    }
+
+
 def preemption_basic(nodes=500, init_pods=2000, measured=500) -> dict:
     return {
         "name": f"PreemptionBasic/{nodes}Nodes",
@@ -373,6 +404,7 @@ TEST_CASES = {
     "SchedulingSecrets": scheduling_secrets,
     "SchedulingInTreePVs": scheduling_intree_pvs,
     "SchedulingCSIPVs": scheduling_csi_pvs,
+    "SchedulingDRA": scheduling_dra,
     "MixedSchedulingBasePod": mixed_scheduling_base_pod,
     "TopologySpreading": topology_spreading,
     "Unschedulable": unschedulable,

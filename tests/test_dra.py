@@ -1,0 +1,541 @@
+"""Dynamic Resource Allocation: resource.k8s.io kinds, the resourceclaim
+controller, the DynamicResources plugin, and the TPU batched
+claim-feasibility mask (oracle↔kernel parity + no-fallback acceptance)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api import dra
+from kubernetes_tpu.api.types import (
+    ObjectMeta,
+    PodSchedulingContext,
+    ResourceClaim,
+    ResourceClaimTemplate,
+    ResourceClass,
+)
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.client.informer import SharedInformerFactory
+from kubernetes_tpu.controllers.resourceclaim import ResourceClaimController
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+
+def drive_until(sched, store, pod_key, timeout_s=8.0):
+    """Drive a scheduler through backoff-gated retries (real-clock backoff)
+    until the pod binds or the timeout passes."""
+    import time
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if store.get_pod(pod_key).spec.node_name:
+            return
+        time.sleep(0.02)
+        sched.queue.flush_backoff_completed()
+        sched.run_until_settled()
+
+
+def mk_store(n_nodes=4, attrs_fn=None):
+    store = ClusterStore()
+    for i in range(n_nodes):
+        nw = make_node(f"node-{i}").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 32})
+        if attrs_fn is not None:
+            nw.device_attrs(attrs_fn(i))
+        store.create_node(nw.obj())
+    return store
+
+
+def tpu_attrs(i):
+    return {"tpu.dev/cores": 8 if i % 2 else 2,
+            "tpu.dev/gen": "v5" if i % 2 else "v4"}
+
+
+def add_class(store, name="tpu.example.com", selectors=None):
+    store.create_object("ResourceClass", ResourceClass(
+        meta=ObjectMeta(name=name, namespace=""), driver_name=name,
+        selectors=dict(selectors or {})))
+
+
+def add_claim(store, name, cls="tpu.example.com", selectors=None, ns="default"):
+    store.create_object("ResourceClaim", ResourceClaim(
+        meta=ObjectMeta(name=name, namespace=ns),
+        resource_class_name=cls, selectors=dict(selectors or {})))
+
+
+# ---------------------------------------------------------------------------
+# selector model
+
+
+class TestSelectors:
+    def test_parse_ops(self):
+        assert dra.parse_selector("k", ">=4").op == dra.OP_GE
+        assert dra.parse_selector("k", "<=4").op == dra.OP_LE
+        assert dra.parse_selector("k", ">4").op == dra.OP_GT
+        assert dra.parse_selector("k", "<4").op == dra.OP_LT
+        assert dra.parse_selector("k", "!=v5").op == dra.OP_NE
+        assert dra.parse_selector("k", "==v5").op == dra.OP_EQ
+        bare = dra.parse_selector("k", "v5")
+        assert bare.op == dra.OP_EQ and bare.operand == "v5"
+        num = dra.parse_selector("k", 4)
+        assert num.operand_kind == dra.KIND_INT and num.operand == 4
+
+    def test_match_semantics(self):
+        attrs = {"cores": 8, "gen": "v5"}
+        assert dra.parse_selector("cores", ">=4").matches(attrs)
+        assert not dra.parse_selector("cores", ">8").matches(attrs)
+        assert dra.parse_selector("gen", "v5").matches(attrs)
+        assert not dra.parse_selector("gen", "!=v5").matches(attrs)
+        assert dra.parse_selector("gen", "!=v4").matches(attrs)
+        # absent attribute never matches, any operator
+        assert not dra.parse_selector("missing", "!=x").matches(attrs)
+        # type mismatch: ordering op on a string attr
+        assert not dra.parse_selector("gen", ">=4").matches(attrs)
+        # int/string equality never crosses types
+        assert not dra.parse_selector("cores", "8x").matches(attrs)
+
+
+# ---------------------------------------------------------------------------
+# WAL round-trip (satellite: every new kind must survive snapshot/restore,
+# including an allocated claim's status — the 47c55c3 lesson)
+
+
+class TestWALRoundTrip:
+    def test_all_four_kinds_and_allocated_status(self, tmp_path):
+        from kubernetes_tpu.apiserver.wal import attach_wal, restore
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        attach_wal(store, path)
+        add_class(store, selectors={"tpu.dev/gen": "v5"})
+        store.create_object("ResourceClaimTemplate", ResourceClaimTemplate(
+            meta=ObjectMeta(name="tmpl"), resource_class_name="tpu.example.com",
+            selectors={"tpu.dev/cores": ">=4"}))
+        add_claim(store, "c1", selectors={"tpu.dev/cores": ">=4"})
+        store.allocate_claim("default/c1", "node-7", "default/p1")
+        store.create_object("PodSchedulingContext", PodSchedulingContext(
+            meta=ObjectMeta(name="p1"), selected_node="node-7",
+            potential_nodes=("node-7", "node-8")))
+
+        restored = restore(path)
+        rc = restored.get_object("ResourceClass", "tpu.example.com")
+        assert rc.selectors == {"tpu.dev/gen": "v5"}
+        tmpl = restored.get_object("ResourceClaimTemplate", "default/tmpl")
+        assert tmpl.resource_class_name == "tpu.example.com"
+        assert tmpl.selectors == {"tpu.dev/cores": ">=4"}
+        claim = restored.get_object("ResourceClaim", "default/c1")
+        assert claim.allocated_node == "node-7"
+        assert claim.reserved_for == ("default/p1",)
+        ctx = restored.get_object("PodSchedulingContext", "default/p1")
+        assert ctx.selected_node == "node-7"
+        assert ctx.potential_nodes == ("node-7", "node-8")
+
+    def test_snapshot_compaction_covers_dra_kinds(self, tmp_path):
+        from kubernetes_tpu.apiserver.wal import attach_wal, restore
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        wal = attach_wal(store, path)
+        add_class(store)
+        add_claim(store, "c1")
+        wal.snapshot(store)  # kinds must survive via the snapshot alone
+        restored = restore(path)
+        assert restored.get_object("ResourceClass", "tpu.example.com") is not None
+        assert restored.get_object("ResourceClaim", "default/c1") is not None
+
+    def test_scheme_wire_roundtrip(self):
+        from kubernetes_tpu.api.scheme import default_scheme
+
+        scheme = default_scheme()
+        claim = ResourceClaim(
+            meta=ObjectMeta(name="c", namespace="ns1"),
+            resource_class_name="tpu.example.com",
+            selectors={"tpu.dev/cores": ">=4"},
+            allocated_node="n3", reserved_for=("ns1/p",))
+        doc = scheme.encode(claim)
+        assert doc["apiVersion"] == "resource.k8s.io/v1alpha2"
+        back = scheme.decode(doc)
+        assert back.resource_class_name == "tpu.example.com"
+        assert back.allocated_node == "n3"
+        assert back.reserved_for == ("ns1/p",)
+
+
+# ---------------------------------------------------------------------------
+# resourceclaim controller
+
+
+def mk_controller(store):
+    factory = SharedInformerFactory(store)
+    ctrl = ResourceClaimController(store, factory)
+    factory.wait_for_cache_sync()
+    return factory, ctrl
+
+
+def pump(factory, ctrl, rounds=3):
+    for _ in range(rounds):
+        factory.pump()
+        ctrl.sync_once()
+
+
+class TestResourceClaimController:
+    def test_materializes_template_claims(self):
+        store = mk_store()
+        add_class(store)
+        store.create_object("ResourceClaimTemplate", ResourceClaimTemplate(
+            meta=ObjectMeta(name="tmpl"), resource_class_name="tpu.example.com",
+            selectors={"tpu.dev/cores": ">=4"}))
+        factory, ctrl = mk_controller(store)
+        store.create_pod(
+            make_pod("p").req({"cpu": "1"})
+            .resource_claim("dev", template_name="tmpl").obj())
+        pump(factory, ctrl)
+        claim = store.get_object("ResourceClaim", "default/p-dev")
+        assert claim is not None
+        assert claim.resource_class_name == "tpu.example.com"
+        assert claim.selectors == {"tpu.dev/cores": ">=4"}
+        owner = claim.meta.controller_of()
+        assert owner.kind == "Pod" and owner.name == "p"
+
+    def test_missing_template_requeues_and_emits_event(self):
+        """Satellite: a pod referencing a not-yet-existing template must NOT
+        wedge the controller — Warning event + rate-limited requeue, then
+        success once the template appears."""
+        store = mk_store()
+        add_class(store)
+        factory, ctrl = mk_controller(store)
+        store.create_pod(
+            make_pod("early").req({"cpu": "1"})
+            .resource_claim("dev", template_name="late-tmpl").obj())
+        pump(factory, ctrl, rounds=2)
+        assert store.get_object("ResourceClaim", "default/early-dev") is None
+        events = [e for e in ctrl.recorder.events
+                  if e.reason == "FailedResourceClaimCreation"]
+        assert events and "late-tmpl" in events[0].note
+        # the key is in backoff, not dropped: template arrives -> claim lands
+        store.create_object("ResourceClaimTemplate", ResourceClaimTemplate(
+            meta=ObjectMeta(name="late-tmpl"),
+            resource_class_name="tpu.example.com"))
+        ctrl.queue.flush_waiting()
+        pump(factory, ctrl)
+        assert store.get_object("ResourceClaim", "default/early-dev") is not None
+
+    def test_pod_delete_gcs_claims_and_reservations(self):
+        store = mk_store()
+        add_class(store)
+        store.create_object("ResourceClaimTemplate", ResourceClaimTemplate(
+            meta=ObjectMeta(name="tmpl"), resource_class_name="tpu.example.com"))
+        factory, ctrl = mk_controller(store)
+        store.create_pod(
+            make_pod("p").req({"cpu": "1"})
+            .resource_claim("dev", template_name="tmpl").obj())
+        # a second, user-managed claim this pod merely reserves
+        add_claim(store, "shared")
+        pump(factory, ctrl)
+        store.allocate_claim("default/shared", "node-1", "default/p")
+        store.create_object("PodSchedulingContext", PodSchedulingContext(
+            meta=ObjectMeta(name="p"), selected_node="node-1"))
+        store.delete_pod("default/p")
+        pump(factory, ctrl)
+        assert store.get_object("ResourceClaim", "default/p-dev") is None
+        shared = store.get_object("ResourceClaim", "default/shared")
+        assert shared.reserved_for == ()
+        assert shared.allocated_node == ""  # last reservation deallocates
+        # the pod's PodSchedulingContext is reaped too (no leaked contexts)
+        assert store.get_object("PodSchedulingContext", "default/p") is None
+
+
+# ---------------------------------------------------------------------------
+# DynamicResources plugin on the sequential oracle path
+
+
+class TestDynamicResourcesOracle:
+    def test_filters_to_matching_nodes_and_allocates(self):
+        store = mk_store(attrs_fn=tpu_attrs)
+        add_class(store, selectors={"tpu.dev/gen": "v5"})
+        add_claim(store, "c1", selectors={"tpu.dev/cores": ">=4"})
+        s = Scheduler(store)
+        store.create_pod(make_pod("p").req({"cpu": "100m"})
+                         .resource_claim("dev", claim_name="c1").obj())
+        s.run_until_settled()
+        pod = store.get_pod("default/p")
+        assert pod.spec.node_name in ("node-1", "node-3")
+        claim = store.get_object("ResourceClaim", "default/c1")
+        assert claim.allocated_node == pod.spec.node_name
+        assert claim.reserved_for == (pod.key(),)
+        ctx = store.get_object("PodSchedulingContext", "default/p")
+        assert ctx is not None and ctx.selected_node == pod.spec.node_name
+
+    def test_missing_claim_parks_until_created(self):
+        store = mk_store(attrs_fn=tpu_attrs)
+        add_class(store)
+        s = Scheduler(store, pod_initial_backoff=0.02, pod_max_backoff=0.1)
+        store.create_pod(make_pod("p").req({"cpu": "100m"})
+                         .resource_claim("dev", claim_name="ghost").obj())
+        s.run_until_settled()
+        assert store.get_pod("default/p").spec.node_name == ""
+        # unresolvable: parked, no preemption nomination
+        assert store.get_pod("default/p").status.nominated_node_name == ""
+        # claim creation fires the dynamic ResourceClaim event -> reactivated
+        add_claim(store, "ghost")
+        drive_until(s, store, "default/p")
+        assert store.get_pod("default/p").spec.node_name != ""
+
+    def test_allocated_claim_pins_second_consumer(self):
+        store = mk_store(attrs_fn=tpu_attrs)
+        add_class(store)
+        add_claim(store, "shared", selectors={"tpu.dev/cores": ">=4"})
+        s = Scheduler(store)
+        store.create_pod(make_pod("p1").req({"cpu": "100m"})
+                         .resource_claim("dev", claim_name="shared").obj())
+        s.run_until_settled()
+        first_node = store.get_pod("default/p1").spec.node_name
+        store.create_pod(make_pod("p2").req({"cpu": "100m"})
+                         .resource_claim("dev", claim_name="shared").obj())
+        s.run_until_settled()
+        assert store.get_pod("default/p2").spec.node_name == first_node
+        claim = store.get_object("ResourceClaim", "default/shared")
+        assert set(claim.reserved_for) == {"default/p1", "default/p2"}
+
+    def test_unschedulable_when_no_node_matches(self):
+        store = mk_store(attrs_fn=lambda i: {"tpu.dev/gen": "v4"})
+        add_class(store, selectors={"tpu.dev/gen": "v5"})
+        add_claim(store, "c1")
+        s = Scheduler(store)
+        store.create_pod(make_pod("p").req({"cpu": "100m"})
+                         .resource_claim("dev", claim_name="c1").obj())
+        s.run_until_settled()
+        assert store.get_pod("default/p").spec.node_name == ""
+        claim = store.get_object("ResourceClaim", "default/c1")
+        assert claim.allocated_node == "" and claim.reserved_for == ()
+
+
+# ---------------------------------------------------------------------------
+# batched kernel parity
+
+
+ATTR_KEYS = ["tpu.dev/cores", "tpu.dev/gen", "tpu.dev/mem", "vendor.io/x"]
+STR_VALS = ["v4", "v5", "v5e", "a"]
+
+
+def random_attrs(rng):
+    attrs = {}
+    for k in ATTR_KEYS:
+        r = rng.random()
+        if r < 0.3:
+            continue  # absent
+        if r < 0.7:
+            attrs[k] = rng.randint(0, 16)
+        else:
+            attrs[k] = rng.choice(STR_VALS)
+    return attrs
+
+
+def random_selectors(rng):
+    sels = {}
+    for k in rng.sample(ATTR_KEYS, rng.randint(0, 3)):
+        op = rng.choice([">=", ">", "<=", "<", "==", "!=", ""])
+        if op in ("==", "!=", "") and rng.random() < 0.5:
+            sels[k] = op + rng.choice(STR_VALS)
+        else:
+            sels[k] = op + str(rng.randint(0, 16))
+    return sels
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_claim_mask_kernel_parity(seed):
+    """claim_feasibility_mask must equal DeviceSelector.matches for every
+    (pod, node) pair on randomized attribute tables and selector mixes."""
+    from kubernetes_tpu.backend.claim_mask import ClaimMaskBuilder
+    from kubernetes_tpu.backend.device_state import DeviceState, caps_for_cluster
+    from kubernetes_tpu.cache import Cache, Snapshot
+
+    rng = random.Random(seed)
+    n_nodes, n_pods = 12, 8
+    store = ClusterStore()
+    node_attrs = {}
+    for i in range(n_nodes):
+        attrs = random_attrs(rng)
+        node_attrs[f"node-{i}"] = attrs
+        store.create_node(make_node(f"node-{i}").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 32}).device_attrs(attrs).obj())
+    add_class(store, selectors={})
+    cache = Cache()
+    for node in store.nodes.values():
+        cache.add_node(node)
+    snapshot = Snapshot()
+    cache.update_snapshot(snapshot)
+    device = DeviceState(caps_for_cluster(n_nodes, batch=n_pods))
+    device.sync(snapshot)
+
+    class QP:  # QueuedPodInfo stand-in: builder only reads .pod
+        def __init__(self, pod):
+            self.pod = pod
+
+    qps, expected_sels = [], []
+    for p in range(n_pods):
+        sels = random_selectors(rng)
+        add_claim(store, f"c{p}", selectors=sels)
+        pod = (make_pod(f"p{p}").req({"cpu": "100m"})
+               .resource_claim("dev", claim_name=f"c{p}").obj())
+        qps.append(QP(pod))
+        expected_sels.append(dra.parse_selectors(sels))
+
+    mask = np.asarray(ClaimMaskBuilder(store).build(qps, device, pad_to=n_pods))
+    for p in range(n_pods):
+        for i in range(n_nodes):
+            slot = device.encoder.node_slots[f"node-{i}"]
+            want = all(s.matches(node_attrs[f"node-{i}"])
+                       for s in expected_sels[p])
+            assert bool(mask[p, slot]) == want, (
+                f"seed={seed} pod={p} node={i}: kernel={bool(mask[p, slot])} "
+                f"oracle={want} sels={expected_sels[p]} "
+                f"attrs={node_attrs[f'node-{i}']}")
+
+
+# ---------------------------------------------------------------------------
+# acceptance: batched path parity + no fallback
+
+
+def build_dra_cluster(store, n_nodes=8):
+    for i in range(n_nodes):
+        store.create_node(
+            make_node(f"node-{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 32})
+            .device_attrs(tpu_attrs(i)).obj())
+    add_class(store, selectors={"tpu.dev/gen": "v5"})
+
+
+def dra_workload(store, n_claim=6, n_plain=6):
+    for i in range(n_claim):
+        add_claim(store, f"c{i}", selectors={"tpu.dev/cores": ">=4"})
+        store.create_pod(make_pod(f"claim-{i}").req({"cpu": "200m", "memory": "256Mi"})
+                         .resource_claim("dev", claim_name=f"c{i}").obj())
+        store.create_pod(make_pod(f"plain-{i}").req({"cpu": "200m", "memory": "256Mi"}).obj())
+
+
+class TestBatchedParity:
+    def test_tpu_matches_oracle_and_stays_batched(self):
+        """Acceptance: identical pod→node assignments AND identical claim
+        allocations between the sequential oracle and the TPU batched path,
+        with claim-bearing pods NOT routed to the sequential fallback."""
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store_o, store_t = ClusterStore(), ClusterStore()
+        for st in (store_o, store_t):
+            build_dra_cluster(st)
+        oracle = Scheduler(store_o)
+        tpu = TPUScheduler(store_t, batch_size=16)
+        for st in (store_o, store_t):
+            dra_workload(st)
+        oracle.run_until_settled()
+        tpu.run_until_settled()
+
+        placed_o = {k: p.spec.node_name for k, p in store_o.pods.items()}
+        placed_t = {k: p.spec.node_name for k, p in store_t.pods.items()}
+        assert placed_o == placed_t
+        assert all(placed_t.values())  # everything landed
+        claims_o = {k: (c.allocated_node, c.reserved_for)
+                    for k, c in store_o.resource_claims.items()}
+        claims_t = {k: (c.allocated_node, c.reserved_for)
+                    for k, c in store_t.resource_claims.items()}
+        assert claims_o == claims_t
+        # claim-bearing pods rode the batch (backend counters)
+        assert tpu.fallback_scheduled == 0
+        assert tpu.batch_scheduled == len(placed_t)
+        # every claim allocation counted (one claim per claim pod)
+        n_claims = len(store_t.resource_claims)
+        assert tpu.smetrics.dra_claim_allocations.labels("allocated") == n_claims
+        assert tpu.smetrics.dra_claim_allocations.labels("released") == 0
+
+    def test_diagnosis_attributes_dynamic_resources(self):
+        """Satellite: batch-loser Diagnosis blames DynamicResources with the
+        'cannot allocate all claims' message, not a later plugin."""
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        for i in range(4):
+            store.create_node(
+                make_node(f"node-{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 32})
+                .device_attrs({"tpu.dev/gen": "v4"}).obj())
+        add_class(store, selectors={"tpu.dev/gen": "v5"})
+        add_claim(store, "c1")
+        s = TPUScheduler(store, batch_size=8)
+        store.create_pod(make_pod("p").req({"cpu": "100m"})
+                         .resource_claim("dev", claim_name="c1").obj())
+        s.run_until_settled(max_cycles=50)
+        assert store.get_pod("default/p").spec.node_name == ""
+        qp = s.queue._unschedulable.get("default/p")
+        assert qp is not None
+        assert "DynamicResources" in qp.unschedulable_plugins
+
+    def test_shared_claim_batch_converges_to_one_node(self):
+        """Two pods sharing one unallocated claim in the same batch: the
+        first Reserve allocates, the second lands on the same node (same
+        batch or after a Reserve-conflict retry)."""
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        build_dra_cluster(store)
+        add_claim(store, "shared", selectors={"tpu.dev/cores": ">=4"})
+        s = TPUScheduler(store, batch_size=8,
+                         pod_initial_backoff=0.02, pod_max_backoff=0.1)
+        for i in range(2):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"})
+                             .resource_claim("dev", claim_name="shared").obj())
+        s.run_until_settled()
+        drive_until(s, store, "default/p1")  # Reserve-conflict retry backoff
+        n0 = store.get_pod("default/p0").spec.node_name
+        n1 = store.get_pod("default/p1").spec.node_name
+        assert n0 and n0 == n1
+        claim = store.get_object("ResourceClaim", "default/shared")
+        assert claim.allocated_node == n0
+        assert set(claim.reserved_for) == {"default/p0", "default/p1"}
+
+    def test_unmaterialized_claim_falls_back_then_batches(self):
+        """A pod whose template claim hasn't materialized keeps the oracle
+        path (batchable gate); once the controller catches up the next pod
+        batches."""
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        build_dra_cluster(store)
+        store.create_object("ResourceClaimTemplate", ResourceClaimTemplate(
+            meta=ObjectMeta(name="tmpl"), resource_class_name="tpu.example.com"))
+        factory, ctrl = mk_controller(store)
+        s = TPUScheduler(store, batch_size=8,
+                         pod_initial_backoff=0.02, pod_max_backoff=0.1)
+        store.create_pod(make_pod("p").req({"cpu": "100m"})
+                         .resource_claim("dev", template_name="tmpl").obj())
+        s.run_until_settled(max_cycles=30)
+        assert store.get_pod("default/p").spec.node_name == ""  # parked
+        pump(factory, ctrl)  # controller materializes default/p-dev
+        assert store.get_object("ResourceClaim", "default/p-dev") is not None
+        drive_until(s, store, "default/p")
+        assert store.get_pod("default/p").spec.node_name != ""
+
+
+# ---------------------------------------------------------------------------
+# perf harness workload
+
+
+class TestSchedulingDRAWorkload:
+    @pytest.mark.parametrize("backend", ["oracle", "tpu"])
+    def test_small_variant_runs(self, backend):
+        from kubernetes_tpu.perf import TEST_CASES, run_workload
+
+        tc = TEST_CASES["SchedulingDRA"](nodes=16, init_pods=6, measured=8)
+        items = run_workload(tc, backend=backend)
+        tput = next(it for it in items
+                    if it.labels.get("Name") == "SchedulingThroughput")
+        assert tput.data["Average"] > 0
+
+    @pytest.mark.slow
+    def test_large_variant(self):
+        """The stretch-shaped variant (kept out of tier-1: slow)."""
+        from kubernetes_tpu.perf import TEST_CASES, run_workload
+
+        tc = TEST_CASES["SchedulingDRA"]()  # 5000 nodes, reference size
+        items = run_workload(tc, backend="tpu")
+        tput = next(it for it in items
+                    if it.labels.get("Name") == "SchedulingThroughput")
+        assert tput.data["Average"] > 0
