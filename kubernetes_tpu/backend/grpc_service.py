@@ -73,11 +73,12 @@ def _deltas_to_proto(payload: dict):
     req.removed.extend(payload.get("removed", ()))
     for ns, labels in (payload.get("namespaces") or {}).items():
         req.namespaces[ns] = json.dumps(labels).encode()
+    req.traceparent = payload.get("traceparent") or ""
     return req
 
 
 def _deltas_from_proto(req) -> dict:
-    return {
+    out = {
         "full": req.full,
         "nodes": [{
             "node": json.loads(e.node_json),
@@ -87,6 +88,9 @@ def _deltas_from_proto(req) -> dict:
         "removed": list(req.removed),
         "namespaces": {ns: json.loads(b) for ns, b in req.namespaces.items()},
     }
+    if req.traceparent:
+        out["traceparent"] = req.traceparent
+    return out
 
 
 def _batch_to_proto(payload: dict):
@@ -109,6 +113,7 @@ def _batch_to_proto(payload: dict):
         req.pods.append(p.PodRef(template=idx, name=name,
                                  namespace=namespace, uid=uid))
     req.tie_seeds.extend(int(s) for s in payload.get("tieSeeds", ()))
+    req.traceparent = payload.get("traceparent") or ""
     return req
 
 
@@ -126,6 +131,8 @@ def _batch_from_proto(req) -> dict:
     out = {"pods": pods}
     if req.tie_seeds:
         out["tieSeeds"] = list(req.tie_seeds)
+    if req.traceparent:
+        out["traceparent"] = req.traceparent
     return out
 
 

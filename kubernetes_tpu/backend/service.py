@@ -42,6 +42,7 @@ from ..framework.types import Diagnosis, NodeInfo, QueuedPodInfo
 from ..framework.interface import CycleState, Status
 from ..ops.encode import CapacityError
 from ..scheduler.scheduler import Scheduler
+from ..utils import tracing
 from .batch import build_schedule_batch_fn
 from .device_state import DeviceState, caps_for_cluster
 from .tpu_scheduler import _ATTRIBUTION_ORDER, TPUScheduler
@@ -74,6 +75,15 @@ class DeviceService:
     # ------------------------------------------------------------- deltas
 
     def apply_deltas(self, req: dict) -> dict:
+        # server half of W3C-traceparent propagation: the delta sync parents
+        # under the client's scheduling.cycle span (no-op, one global read,
+        # when tracing is disabled)
+        with tracing.span_from_remote(req.get("traceparent"),
+                                      "device.apply_deltas",
+                                      nodes=len(req.get("nodes", ()))):
+            return self._apply_deltas_traced(req)
+
+    def _apply_deltas_traced(self, req: dict) -> dict:
         with self._lock:
             if req.get("full"):
                 self.infos.clear()
@@ -116,7 +126,8 @@ class DeviceService:
         self._ensure_device()
         for _attempt in range(8):
             try:
-                self.device.sync(self.snap)
+                with tracing.span("device.sync"):
+                    self.device.sync(self.snap)
                 return
             except CapacityError as e:
                 self._grow(e)
@@ -146,14 +157,25 @@ class DeviceService:
     def schedule_batch(self, req: dict) -> dict:
         pods = [from_wire(Pod, pw) for pw in req.get("pods", ())]
         tie_seeds = req.get("tieSeeds") or None
+        # parent the whole server-side batch under the client's
+        # scheduling.cycle span (W3C traceparent riding the request dict):
+        # one trace then covers scheduler pop → wire → device commit
+        with tracing.span_from_remote(req.get("traceparent"),
+                                      "device.schedule_batch",
+                                      batch=len(pods)):
+            return self._schedule_batch_traced(pods, tie_seeds)
+
+    def _schedule_batch_traced(self, pods: List[Pod], tie_seeds) -> dict:
         with self._lock:
             self._ensure_device()
             for _attempt in range(8):
                 try:
-                    self.device.sync(self.snap)
-                    pb, et = self.device.encoder.encode_pods(
-                        pods, tie_seeds=tie_seeds)
-                    tb = self.device.sig_table.encode_topo(pods)
+                    with tracing.span("device.sync"):
+                        self.device.sync(self.snap)
+                    with tracing.span("device.encode", batch=len(pods)):
+                        pb, et = self.device.encoder.encode_pods(
+                            pods, tie_seeds=tie_seeds)
+                        tb = self.device.sig_table.encode_topo(pods)
                     break
                 except CapacityError as e:
                     self._grow(e)
@@ -183,19 +205,21 @@ class DeviceService:
             else:
                 sample_k = None
                 sample_start = None
-            result = self.schedule_batch_fn(
-                pb, et, self.device.nt, self.device.tc, tb,
-                np.int32(self.batch_counter),
-                topo_enabled=self.device.topo_enabled,
-                sample_k=sample_k, sample_start=sample_start)
+            with tracing.span("device.dispatch", batch=len(pods)):
+                result = self.schedule_batch_fn(
+                    pb, et, self.device.nt, self.device.tc, tb,
+                    np.int32(self.batch_counter),
+                    topo_enabled=self.device.topo_enabled,
+                    sample_k=sample_k, sample_start=sample_start)
             if result.final_sample_start is not None:
                 self._start_carry = result.final_sample_start
-            node_idx = np.asarray(result.node_idx)
             # adopt exactly like the in-process path: the client will assume
             # these placements; its next delta push re-encodes any row the
             # host view disagrees on and the content diff repairs it
-            self.device.adopt_device(result)
-            self.device.adopt_commits(result, host_pb, node_idx)
+            with tracing.span("device.commit", batch=len(pods)):
+                node_idx = np.asarray(result.node_idx)  # THE blocking read
+                self.device.adopt_device(result)
+                self.device.adopt_commits(result, host_pb, node_idx)
             slot_names = self.device.slot_to_name()
             # device preemption screen for the batch's failures (ROADMAP
             # wire-hardening: hints ride back with unschedulable results so
@@ -398,9 +422,12 @@ class WireScheduler(Scheduler):
                 namespaces[ns] = labels
                 self._sent_ns[ns] = labels
         if entries or removed or namespaces:
-            self.client.apply_deltas(
-                {"apiVersion": API_VERSION, "nodes": entries,
-                 "removed": removed, "namespaces": namespaces})
+            payload = {"apiVersion": API_VERSION, "nodes": entries,
+                       "removed": removed, "namespaces": namespaces}
+            tp = tracing.format_traceparent()
+            if tp:
+                payload["traceparent"] = tp
+            self.client.apply_deltas(payload)
 
     def schedule_batch_cycle(self) -> int:
         self._periodic_housekeeping()
@@ -430,13 +457,24 @@ class WireScheduler(Scheduler):
     def _flush_wire(self, batch: List[QueuedPodInfo], pod_cycle: int, t0: float) -> None:
         if not batch:
             return
+        # one scheduling.cycle span per wire batch: the traceparent injected
+        # below makes the server's device.sync/encode/dispatch/commit spans
+        # children of this span — a single trace from pop to device commit
+        with tracing.span("scheduling.cycle", batch=len(batch),
+                          transport=type(self.client).__name__):
+            self._flush_wire_traced(batch, pod_cycle, t0)
+
+    def _flush_wire_traced(self, batch: List[QueuedPodInfo], pod_cycle: int, t0: float) -> None:
         self._push_deltas()
         from ..ops.tiebreak import seeds_for
 
-        res = self.client.schedule_batch(
-            {"apiVersion": API_VERSION,
-             "pods": [to_wire(qp.pod) for qp in batch],
-             "tieSeeds": [int(s) for s in seeds_for(batch)]})
+        payload = {"apiVersion": API_VERSION,
+                   "pods": [to_wire(qp.pod) for qp in batch],
+                   "tieSeeds": [int(s) for s in seeds_for(batch)]}
+        tp = tracing.format_traceparent()
+        if tp:
+            payload["traceparent"] = tp
+        res = self.client.schedule_batch(payload)
         # hint-screen scaffolding, shared by every failed pod in the batch
         hint_names = hint_slot_of = None
         for qp, r in zip(batch, res["results"]):
@@ -444,15 +482,15 @@ class WireScheduler(Scheduler):
             self.metrics["schedule_attempts"] += 1
             node_name = r.get("nodeName")
             if node_name:
-                self.assume_and_bind(fwk, CycleState(), qp, qp.pod, node_name,
-                                     pod_cycle, t0=t0)
+                self.assume_and_bind(fwk, self._new_cycle_state(), qp, qp.pod,
+                                     node_name, pod_cycle, t0=t0)
             else:
                 d = Diagnosis()
                 for name, plugin in (r.get("statuses") or {}).items():
                     reason = dict(_ATTRIBUTION_ORDER).get(plugin, "unschedulable")
                     d.node_to_status[name] = Status.unschedulable(reason).with_plugin(plugin)
                 d.unschedulable_plugins.update(r.get("unschedulablePlugins") or ())
-                state = CycleState()
+                state = self._new_cycle_state()
                 hint = r.get("preempt")
                 if hint is not None:
                     # rebuild the screen over OUR node names: candidates the

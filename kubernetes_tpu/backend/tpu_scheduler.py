@@ -498,6 +498,16 @@ class TPUScheduler(Scheduler):
             return
         from ..utils import tracing
 
+        # one scheduling.cycle span per in-process batch: the device.* phase
+        # spans below (and the overlapped commit of the PREVIOUS batch, which
+        # lands inside this cycle by pipelining design) parent under it
+        with tracing.span("scheduling.cycle", batch=len(batched)):
+            self._flush_batch_traced(batched, pod_cycle, t_pop)
+
+    def _flush_batch_traced(self, batched: List[QueuedPodInfo], pod_cycle: int,
+                            t_pop: Optional[float] = None) -> None:
+        from ..utils import tracing
+
         self._maybe_profile()
         t0 = self.now_fn()
         t_pop = t_pop if t_pop is not None else t0
@@ -862,7 +872,7 @@ class TPUScheduler(Scheduler):
                     self.smetrics.observe_attempt(
                         "error", fwk.profile_name, self.now_fn() - t0)
                     continue
-                state = CycleState()
+                state = self._new_cycle_state()
                 # Reserve/Permit/PreBind plugins may read PreFilter state;
                 # with the default set only VolumeBinding/DynamicResources
                 # do (both tolerate absence), so skip the per-pod host
@@ -926,7 +936,7 @@ class TPUScheduler(Scheduler):
                     relay.count_sync("diagnosis-read")
                     ff = np.asarray(result.first_fail)
                 diagnosis = self._diagnose(ff[i], slot_names)
-                state = CycleState()
+                state = self._new_cycle_state()
                 if preempt_hints is not None:
                     from ..framework.plugins.defaultpreemption import DefaultPreemption
 

@@ -23,7 +23,7 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..api.types import Node, Pod
 from ..framework.types import NodeInfo, next_generation
@@ -280,3 +280,10 @@ class Cache:
     def node_count(self) -> int:
         with self._lock:
             return sum(1 for ni in self.nodes.values() if ni.node is not None)
+
+    def stats(self) -> Tuple[int, int, int]:
+        """(nodes, pods, assumed_pods) — the scheduler_cache_size gauge feed
+        and the /debug/cache counts (cache.go:96 Dump's totals)."""
+        with self._lock:
+            nodes = sum(1 for ni in self.nodes.values() if ni.node is not None)
+            return nodes, len(self.pod_states), len(self._assumed)

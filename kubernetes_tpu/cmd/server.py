@@ -30,14 +30,18 @@ from ..utils.featuregate import DEFAULT_FEATURE_GATE
 
 
 class ComponentServer:
-    """healthz/readyz/configz/metrics mux shared by the component binaries
-    (component-base: healthz.InstallHandler + configz + legacyregistry)."""
+    """healthz/readyz/configz/metrics/debug mux shared by the component
+    binaries (component-base: healthz.InstallHandler + configz +
+    legacyregistry + the /debug introspection family)."""
 
     def __init__(self, configz: dict, registry: Optional[Registry] = None,
-                 ready_fn=None, port: int = 0):
+                 ready_fn=None, port: int = 0, debug: Optional[dict] = None):
         self.configz = configz
         self.registry = registry
         self.ready_fn = ready_fn or (lambda: True)
+        # /debug/<name> → zero-arg callable returning a JSON-serializable
+        # body (build_debug_handlers wires the scheduler's set)
+        self.debug = debug or {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -45,16 +49,34 @@ class ComponentServer:
                 pass
 
             def do_GET(self):
-                if self.path == "/healthz":
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
                     self._respond(200, "ok", "text/plain")
-                elif self.path == "/readyz":
+                elif path == "/readyz":
                     ok = outer.ready_fn()
                     self._respond(200 if ok else 500, "ok" if ok else "not ready", "text/plain")
-                elif self.path == "/configz":
+                elif path == "/configz":
                     self._respond(200, json.dumps(outer.configz), "application/json")
-                elif self.path == "/metrics":
+                elif path == "/metrics":
                     text = outer.registry.expose() if outer.registry else ""
                     self._respond(200, text, "text/plain; version=0.0.4")
+                elif path == "/debug" or path == "/debug/":
+                    self._respond(200, json.dumps(
+                        {"endpoints": sorted("/debug/" + n for n in outer.debug)}),
+                        "application/json")
+                elif path.startswith("/debug/"):
+                    fn = outer.debug.get(path[len("/debug/"):])
+                    if fn is None:
+                        self._respond(404, "not found", "text/plain")
+                        return
+                    try:
+                        body = json.dumps(fn(), default=str)
+                    except Exception as exc:  # noqa: BLE001 — debug must not kill serving
+                        self._respond(500, json.dumps(
+                            {"error": f"{type(exc).__name__}: {exc}"}),
+                            "application/json")
+                        return
+                    self._respond(200, body, "application/json")
                 else:
                     self._respond(404, "not found", "text/plain")
 
@@ -78,6 +100,68 @@ class ComponentServer:
     def stop(self) -> None:
         self.httpd.shutdown()
         self.httpd.server_close()
+
+
+def build_debug_handlers(sched) -> dict:
+    """The /debug endpoint family over a live scheduler (SURVEY §5.2's
+    SIGUSR2 comparer/dumper, but always-on and JSON over the serving mux):
+
+      /debug/queue        active/backoff/unschedulable dump
+      /debug/cache        comparer drift report + node/pod/assumed counts
+      /debug/devicestate  DeviceState capacities, sig-table occupancy,
+                          batch-sizer model (TPU/batched schedulers only)
+      /debug/spans        tail of the in-memory span exporter
+    """
+    from ..cache.debugger import CacheComparer
+    from ..utils import tracing
+
+    def queue_dump():
+        return sched.queue.dump()
+
+    def cache_dump():
+        comparer = CacheComparer(sched.store, sched.cache, sched.queue)
+        missed_n, redundant_n = comparer.compare_nodes()
+        missed_p, redundant_p = comparer.compare_pods()
+        nodes, pods, assumed = sched.cache.stats()
+        return {
+            "nodes": nodes, "pods": pods, "assumedPods": assumed,
+            "inSync": not (missed_n or redundant_n or missed_p or redundant_p),
+            "missedNodes": missed_n, "redundantNodes": redundant_n,
+            "missedPods": missed_p, "redundantPods": redundant_p,
+        }
+
+    def device_dump():
+        import dataclasses
+
+        device = getattr(sched, "device", None)
+        if device is None:
+            return {"enabled": False}
+        out = {
+            "enabled": True,
+            "caps": dataclasses.asdict(device.caps),
+            "sigTable": {"nSigs": device.sig_table.n_sigs,
+                         "nTerms": device.sig_table.n_terms},
+            "topoEnabled": bool(device.topo_enabled),
+            "nodesMirrored": len(device.encoder.node_slots),
+            "batchCounter": getattr(sched, "batch_counter", 0),
+            "pipelinedBatches": getattr(sched, "pipelined_batches", 0),
+            "fallbackScheduled": getattr(sched, "fallback_scheduled", 0),
+            "batchScheduled": getattr(sched, "batch_scheduled", 0),
+        }
+        sizer = getattr(sched, "sizer", None)
+        if sizer is not None:
+            out["batchSizer"] = {
+                "a": sizer._a, "b": sizer._b, "updates": sizer.updates,
+                "deadlineS": sizer.deadline_s, "target": sizer.target(),
+                "maxBatch": sizer.max_batch,
+            }
+        return out
+
+    def spans_dump():
+        return [s.to_otlp() for s in tracing.tail(256)]
+
+    return {"queue": queue_dump, "cache": cache_dump,
+            "devicestate": device_dump, "spans": spans_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
@@ -123,6 +207,7 @@ class SchedulerApp:
             registry=getattr(self.sched.smetrics, "registry", None),
             ready_fn=lambda: True,
             port=port,
+            debug=build_debug_handlers(self.sched),
         )
         self._stop = threading.Event()
 

@@ -111,6 +111,7 @@ class CycleState:
             cs._data[k] = v.clone() if hasattr(v, "clone") else v
         cs.skip_filter_plugins = set(self.skip_filter_plugins)
         cs.skip_score_plugins = set(self.skip_score_plugins)
+        cs.record_plugin_metrics = self.record_plugin_metrics
         cs.prefilter_ran = self.prefilter_ran
         return cs
 

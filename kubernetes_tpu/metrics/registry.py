@@ -8,6 +8,7 @@ by metric name (test/integration/scheduler_perf/util.go:204-238).
 
 from __future__ import annotations
 
+import bisect
 import math
 import threading
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
@@ -15,10 +16,17 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 LabelValues = Tuple[str, ...]
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format escaping (exposition format spec: backslash,
+    double-quote, and line feed must be escaped inside label values)."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _fmt_labels(names: Sequence[str], values: LabelValues) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    inner = ",".join(f'{n}="{_escape_label_value(v)}"'
+                     for n, v in zip(names, values))
     return "{" + inner + "}"
 
 
@@ -37,10 +45,15 @@ class Counter:
     def labels(self, *labels: str) -> float:
         return self._values.get(labels, 0.0)
 
+    def label_sets(self) -> List[LabelValues]:
+        with self._lock:
+            return list(self._values)
+
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
-        for lv, v in sorted(self._values.items()):
-            out.append(f"{self.name}{_fmt_labels(self.label_names, lv)} {v}")
+        with self._lock:  # /metrics scrapes race the scheduling thread's inc
+            for lv, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(self.label_names, lv)} {v}")
         return out
 
     def reset(self) -> None:
@@ -54,8 +67,9 @@ class Gauge(Counter):
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} gauge"]
-        for lv, v in sorted(self._values.items()):
-            out.append(f"{self.name}{_fmt_labels(self.label_names, lv)} {v}")
+        with self._lock:
+            for lv, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(self.label_names, lv)} {v}")
         return out
 
 
@@ -79,19 +93,30 @@ class Histogram:
         self._lock = threading.Lock()
 
     def observe(self, value: float, *labels: str) -> None:
+        """O(log buckets): counts are stored PER-BUCKET (non-cumulative) and
+        cumulated on the read paths — observe sits on the scheduling hot
+        path (extension-point timing per examined node), a linear cumulative
+        write loop per sample was a measurable slice of the oracle cycle."""
         with self._lock:
-            if labels not in self._counts:
-                self._counts[labels] = [0] * len(self.buckets)
+            counts = self._counts.get(labels)
+            if counts is None:
+                counts = self._counts[labels] = [0] * len(self.buckets)
                 self._sums[labels] = 0.0
                 self._totals[labels] = 0
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    self._counts[labels][i] += 1
+            i = bisect.bisect_left(self.buckets, value)
+            if i < len(counts):
+                counts[i] += 1
             self._sums[labels] += value
             self._totals[labels] += 1
 
     def count(self, *labels: str) -> int:
         return self._totals.get(labels, 0)
+
+    def label_sets(self) -> List[LabelValues]:
+        """Every label-value combination observed so far (the scrape-side
+        iteration surface for a metricsCollector)."""
+        with self._lock:
+            return list(self._totals)
 
     def sum(self, *labels: str) -> float:
         return self._sums.get(labels, 0.0)
@@ -128,13 +153,16 @@ class Histogram:
             return self._totals.get(labels, 0) - snap[1]
 
     def _interp(self, q: float, counts, total: int) -> float:
+        """counts are per-bucket (non-cumulative); cumulate while scanning."""
         if total <= 0 or not counts:
             return 0.0
         target = q * total
-        for i, b in enumerate(self.buckets):  # counts are cumulative (le)
-            if counts[i] >= target:
-                in_bucket = counts[i] - (counts[i - 1] if i else 0)
-                below = counts[i - 1] if i else 0
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            below = cum
+            cum += counts[i]
+            if cum >= target:
+                in_bucket = counts[i]
                 if in_bucket == 0:
                     return b
                 frac = (target - below) / in_bucket
@@ -144,15 +172,20 @@ class Histogram:
 
     def collect(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
-        for lv in sorted(self._totals):
-            base = list(zip(self.label_names, lv))
-            for i, b in enumerate(self.buckets):
-                labels = _fmt_labels([*self.label_names, "le"], (*lv, repr(b)))
-                out.append(f"{self.name}_bucket{labels} {self._counts[lv][i]}")
-            labels = _fmt_labels([*self.label_names, "le"], (*lv, "+Inf"))
-            out.append(f"{self.name}_bucket{labels} {self._totals[lv]}")
-            out.append(f"{self.name}_sum{_fmt_labels(self.label_names, lv)} {self._sums[lv]}")
-            out.append(f"{self.name}_count{_fmt_labels(self.label_names, lv)} {self._totals[lv]}")
+        # under the lock: a scrape racing observe() could otherwise hit a
+        # mid-insert dict or emit +Inf (from _totals) below the last finite
+        # cumulative bucket — exactly the invariant the exposition test checks
+        with self._lock:
+            for lv in sorted(self._totals):
+                cum = 0
+                for i, b in enumerate(self.buckets):  # exposition is cumulative
+                    cum += self._counts[lv][i]
+                    labels = _fmt_labels([*self.label_names, "le"], (*lv, repr(b)))
+                    out.append(f"{self.name}_bucket{labels} {cum}")
+                labels = _fmt_labels([*self.label_names, "le"], (*lv, "+Inf"))
+                out.append(f"{self.name}_bucket{labels} {self._totals[lv]}")
+                out.append(f"{self.name}_sum{_fmt_labels(self.label_names, lv)} {self._sums[lv]}")
+                out.append(f"{self.name}_count{_fmt_labels(self.label_names, lv)} {self._totals[lv]}")
         return out
 
     def reset(self) -> None:

@@ -4,7 +4,10 @@ metricsCollector scrapes identically (SURVEY.md §5.5 build mapping)."""
 
 from __future__ import annotations
 
+import threading
 import time
+from typing import Dict, FrozenSet, Iterable, Tuple
+
 from .registry import Counter, Gauge, Histogram, Registry
 
 SCHEDULER_SUBSYSTEM = "scheduler"
@@ -97,9 +100,51 @@ class SchedulerMetrics:
             ["result"],
         ))
 
+        # unschedulable_pods bookkeeping: gauge value = number of pods
+        # CURRENTLY unschedulable attributed to each (plugin, profile); a
+        # pod's attribution is replaced on every failed attempt and removed
+        # when it schedules or is deleted (the reference decrements via
+        # its pending-pods recorder; a bare set(1) never comes back down)
+        self._unsched_lock = threading.Lock()
+        self._unsched_pods: Dict[str, Tuple[str, FrozenSet[str]]] = {}
+        self._unsched_counts: Dict[Tuple[str, str], int] = {}
+
     def observe_attempt(self, result: str, profile: str, duration_s: float) -> None:
         self.schedule_attempts.inc(result, profile)
         self.scheduling_attempt_duration.observe(duration_s, result, profile)
+
+    def mark_unschedulable(self, pod_key: str, profile: str,
+                           plugins: Iterable[str]) -> None:
+        """Attribute ``pod_key``'s unschedulability to ``plugins``,
+        replacing any previous attribution for the pod."""
+        with self._unsched_lock:
+            self._clear_unschedulable_locked(pod_key)
+            ps = frozenset(p for p in plugins if p)
+            if not ps:
+                return
+            self._unsched_pods[pod_key] = (profile, ps)
+            for p in ps:
+                k = (p, profile)
+                n = self._unsched_counts.get(k, 0) + 1
+                self._unsched_counts[k] = n
+                self.unschedulable_pods.set(p, profile, value=n)
+
+    def clear_unschedulable(self, pod_key: str) -> None:
+        """Drop the pod's attribution (it scheduled, was deleted, or was
+        bound by someone else)."""
+        with self._unsched_lock:
+            self._clear_unschedulable_locked(pod_key)
+
+    def _clear_unschedulable_locked(self, pod_key: str) -> None:
+        prev = self._unsched_pods.pop(pod_key, None)
+        if prev is None:
+            return
+        profile, ps = prev
+        for p in ps:
+            k = (p, profile)
+            n = max(self._unsched_counts.get(k, 0) - 1, 0)
+            self._unsched_counts[k] = n
+            self.unschedulable_pods.set(p, profile, value=n)
 
     def sync_queue_gauges(self, pending: dict) -> None:
         for q, n in pending.items():

@@ -47,6 +47,64 @@ def data_items_to_json(items: List[DataItem], version: str = "v1") -> str:
     )
 
 
+# the default scrape set of the metricsCollector below: per-phase latency
+# attribution (extension points + plugins + batch phases + algorithm time)
+# riding along with every measured workload, scheduler_perf-style
+DEFAULT_COLLECTED_METRICS = (
+    "scheduler_framework_extension_point_duration_seconds",
+    "scheduler_plugin_execution_duration_seconds",
+    "scheduler_scheduling_algorithm_duration_seconds",
+    "scheduler_tpu_batch_duration_seconds",
+)
+
+
+class MetricsCollector:
+    """scheduler_perf's metricsCollector (util.go:204-238): scrape-delta
+    percentiles over a configurable histogram list. ``start()`` snapshots
+    every labelset before the measured phase; ``collect()`` emits one
+    DataItem per (metric, labelset) that saw samples during the phase —
+    labelsets first observed mid-phase delta against zero."""
+
+    def __init__(self, registry, metric_names=DEFAULT_COLLECTED_METRICS):
+        self.registry = registry
+        self.names = list(metric_names)
+        self._snaps: Dict[tuple, object] = {}
+
+    def _histograms(self):
+        for name in self.names:
+            h = self.registry.get(name)
+            if h is not None and hasattr(h, "percentile_since"):
+                yield name, h
+
+    def start(self) -> None:
+        self._snaps.clear()
+        for name, h in self._histograms():
+            for lv in h.label_sets():
+                self._snaps[(name, lv)] = h.snapshot(*lv)
+
+    def collect(self) -> List["DataItem"]:
+        items: List[DataItem] = []
+        for name, h in self._histograms():
+            short = name[len("scheduler_"):] if name.startswith("scheduler_") else name
+            unit = "s" if name.endswith("_seconds") else ""
+            for lv in h.label_sets():
+                snap = self._snaps.get((name, lv), ([], 0))
+                n = h.count_since(snap, *lv)
+                if n == 0:
+                    continue
+                items.append(DataItem(
+                    data={
+                        "Perc50": h.percentile_since(snap, 0.50, *lv),
+                        "Perc90": h.percentile_since(snap, 0.90, *lv),
+                        "Perc99": h.percentile_since(snap, 0.99, *lv),
+                        "Count": float(n),
+                    },
+                    unit=unit,
+                    labels={"Name": short, **dict(zip(h.label_names, lv))},
+                ))
+        return items
+
+
 class ThroughputCollector:
     """util.go:284: samples scheduled-pod count each interval; pods/s series."""
 
@@ -215,9 +273,14 @@ class Runner:
     """runWorkload (scheduler_perf_test.go:623)."""
 
     def __init__(self, scheduler_config: Optional[dict] = None, backend: str = "oracle",
-                 batch_size: int = 128, seed: int = 0):
+                 batch_size: int = 128, seed: int = 0,
+                 collect_metrics: Optional[List[str]] = None):
         self.store = ClusterStore()
         self.backend = backend
+        # metricsCollector scrape list (None = the default per-phase set;
+        # pass an empty list to disable the extra DataItems)
+        self.collect_metrics = (DEFAULT_COLLECTED_METRICS
+                                if collect_metrics is None else collect_metrics)
         cfg = load_config(scheduler_config)
         if backend == "tpu":
             from ..backend.tpu_scheduler import TPUScheduler
@@ -435,6 +498,9 @@ class Runner:
                 # the sample carries a volume
                 spw.pvc("__warm__")
             warm(sample_pods=[spw.obj()])
+        mcol = MetricsCollector(self.scheduler.smetrics.registry,
+                                self.collect_metrics)
+        mcol.start()
         col = ThroughputCollector(scheduled_count, interval=collector_interval)
         col.start(time.monotonic())
         for _ in range(count):
@@ -472,6 +538,11 @@ class Runner:
                 unit="s",
                 labels={"Name": "scheduling_attempt_duration_seconds", "result": res},
             ))
+        # per-phase percentiles over the measured window (extension points,
+        # plugins, batch phases) — new DataItems with their own Name labels,
+        # so headline consumers filtering on SchedulingThroughput /
+        # scheduling_attempt_duration_seconds are untouched
+        self.data_items.extend(mcol.collect())
         return summary
 
     # ---- config-driven entry ----

@@ -41,6 +41,7 @@ class SchedulingQueue:
         unschedulable_timeout: float = DEFAULT_UNSCHEDULABLE_TIMEOUT,
         cluster_event_map: Optional[Dict[ClusterEvent, Set[str]]] = None,
         now_fn=time.monotonic,
+        metrics=None,
     ):
         # default QueueSort: priority desc then FIFO (PrioritySort)
         self.less_key = less_key or (lambda qp: (-qp.pod.spec.priority, qp.timestamp))
@@ -55,6 +56,10 @@ class SchedulingQueue:
         # workload's measured window)
         self._event_match_memo: Dict[tuple, bool] = {}
         self.now_fn = now_fn
+        # SchedulerMetrics (or None): queue_incoming_pods counters on every
+        # transition + pending_pods gauge sync (metrics.go:120-134; both were
+        # registered-but-dead before the queue owned them)
+        self._metrics = metrics
 
         self._counter = itertools.count()  # FIFO tie-break inside heaps
         self._active: List[Tuple[object, int, QueuedPodInfo]] = []
@@ -75,26 +80,40 @@ class SchedulingQueue:
                 return self.max_backoff
         return d
 
-    def _push_active(self, qp: QueuedPodInfo) -> None:
+    def _push_active(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:
         key = qp.pod.key()
         if key in self._in_queue:
             return
         heapq.heappush(self._active, (self.less_key(qp), next(self._counter), qp))
         self._in_queue.add(key)
+        self._record_incoming("active", event)
 
-    def _push_backoff(self, qp: QueuedPodInfo) -> None:
+    def _push_backoff(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:
         key = qp.pod.key()
         if key in self._in_queue:
             return
         expiry = qp.timestamp + self._backoff_duration(qp)
         heapq.heappush(self._backoff, (expiry, next(self._counter), qp))
         self._in_queue.add(key)
+        self._record_incoming("backoff", event)
+
+    def _record_incoming(self, queue: str, event: Optional[str]) -> None:
+        if self._metrics is not None and event is not None:
+            self._metrics.queue_incoming_pods.inc(queue, event)
+
+    def _sync_gauges(self) -> None:
+        """pending_pods gauge ← the three sub-queue sizes (SchedulerQueue
+        Incoming/Pending recorders; cheap enough to run per transition)."""
+        if self._metrics is not None:
+            self._metrics.sync_queue_gauges(self.pending_pods())
 
     # ------------------------------------------------------------- API
 
     def add(self, pod: Pod) -> None:
         """New unscheduled pod (informer add) → activeQ (:300)."""
-        self._push_active(QueuedPodInfo(pod=pod, timestamp=self.now_fn()))
+        self._push_active(QueuedPodInfo(pod=pod, timestamp=self.now_fn()),
+                          event="PodAdd")
+        self._sync_gauges()
 
     def update(self, old: Optional[Pod], new: Pod) -> None:
         """Pod update may make an unschedulable pod schedulable again (:525);
@@ -106,7 +125,8 @@ class SchedulingQueue:
         qp = self._unschedulable.pop(key, None)
         if qp is not None:
             qp.pod = new
-            self._push_backoff(qp)
+            self._push_backoff(qp, event="PodUpdate")
+            self._sync_gauges()
         else:
             self.add(new)
 
@@ -119,10 +139,17 @@ class SchedulingQueue:
             heapq.heapify(self._active)
             self._backoff = [e for e in self._backoff if e[2].pod.key() != key]
             heapq.heapify(self._backoff)
+        self._sync_gauges()
 
     def pop(self) -> Optional[QueuedPodInfo]:
         """Next pod to schedule, or None (non-blocking; the reference blocks,
         :484 — the loop idles instead). Bumps attempts + scheduling_cycle."""
+        qp = self._pop_unsynced()
+        if qp is not None:
+            self._sync_gauges()
+        return qp
+
+    def _pop_unsynced(self) -> Optional[QueuedPodInfo]:
         self.flush_backoff_completed()
         if not self._active:
             return None
@@ -133,13 +160,18 @@ class SchedulingQueue:
         return qp
 
     def pop_batch(self, k: int) -> List[QueuedPodInfo]:
-        """Drain up to k pods in queue order — the TPU micro-batch feed."""
+        """Drain up to k pods in queue order — the TPU micro-batch feed.
+        The pending gauge syncs ONCE per batch: per-pop intermediate values
+        are unobservable by a scraper and k locked gauge writes per cycle
+        would sit on the batched hot path for nothing."""
         out = []
         for _ in range(k):
-            qp = self.pop()
+            qp = self._pop_unsynced()
             if qp is None:
                 break
             out.append(qp)
+        if out:
+            self._sync_gauges()
         return out
 
     def add_unschedulable_if_not_present(self, qp: QueuedPodInfo, pod_scheduling_cycle: int) -> None:
@@ -150,21 +182,26 @@ class SchedulingQueue:
             return
         qp.timestamp = self.now_fn()
         if self.move_request_cycle >= pod_scheduling_cycle:
-            self._push_backoff(qp)
+            self._push_backoff(qp, event="ScheduleAttemptFailure")
         else:
             self._unschedulable[key] = qp
+            self._record_incoming("unschedulable", "ScheduleAttemptFailure")
+        self._sync_gauges()
 
     def move_all_to_active_or_backoff_queue(self, event: ClusterEvent) -> int:
         """Reactivate unschedulable pods whose failed plugins registered
         interest in ``event`` (:614 MoveAllToActiveOrBackoffQueue)."""
         self.move_request_cycle = self.scheduling_cycle
+        label = event.label or str(event.resource)
         moved = 0
         for key in list(self._unschedulable):
             qp = self._unschedulable[key]
             if self._pod_matches_event(qp, event):
                 del self._unschedulable[key]
-                self._requeue(qp)
+                self._requeue(qp, event=label)
                 moved += 1
+        if moved:
+            self._sync_gauges()
         return moved
 
     def _pod_matches_event(self, qp: QueuedPodInfo, event: ClusterEvent) -> bool:
@@ -181,29 +218,37 @@ class SchedulingQueue:
             self._event_match_memo[memo_key] = hit
         return hit
 
-    def _requeue(self, qp: QueuedPodInfo) -> None:
+    def _requeue(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:
         """Moved pods land in backoffQ unless their backoff already lapsed."""
         if self.now_fn() - qp.timestamp >= self._backoff_duration(qp):
-            self._push_active(qp)
+            self._push_active(qp, event=event)
         else:
-            self._push_backoff(qp)
+            self._push_backoff(qp, event=event)
 
     def flush_backoff_completed(self) -> None:
         """backoffQ → activeQ for expired backoffs (:432)."""
         now = self.now_fn()
+        flushed = False
         while self._backoff and self._backoff[0][0] <= now:
             _, _, qp = heapq.heappop(self._backoff)
             self._in_queue.discard(qp.pod.key())
-            self._push_active(qp)
+            self._push_active(qp, event="BackoffComplete")
+            flushed = True
+        if flushed:
+            self._sync_gauges()
 
     def flush_unschedulable_left_over(self) -> None:
         """Pods stuck unschedulable > timeout get retried (:463)."""
         now = self.now_fn()
+        flushed = False
         for key in list(self._unschedulable):
             qp = self._unschedulable[key]
             if now - qp.timestamp > self.unschedulable_timeout:
                 del self._unschedulable[key]
-                self._requeue(qp)
+                self._requeue(qp, event="UnschedulableTimeout")
+                flushed = True
+        if flushed:
+            self._sync_gauges()
 
     def assigned_pod_updated_or_added(self, pod: Pod) -> None:
         """An assigned pod changed: pods failed on affinity may now fit
@@ -229,6 +274,41 @@ class SchedulingQueue:
             + [e[2] for e in self._backoff]
             + list(self._unschedulable.values())
         )
+
+    def dump(self) -> Dict[str, object]:
+        """Structured snapshot of the three sub-queues (the /debug/queue
+        introspection body; the JSON twin of dumper.go's queue section).
+
+        Called from the serving thread while the scheduling thread mutates
+        the queue: each sub-queue is first shallow-copied with a C-level
+        ``list()`` (atomic under the GIL), so iteration never races a
+        concurrent push/delete — the snapshot may be a moment stale, which
+        is fine for a debug endpoint."""
+        now = self.now_fn()
+        active = list(self._active)
+        backoff = list(self._backoff)
+        unschedulable = list(self._unschedulable.values())
+
+        def entry(qp: QueuedPodInfo, **extra):
+            return {
+                "pod": qp.pod.key(),
+                "priority": qp.pod.spec.priority,
+                "attempts": qp.attempts,
+                "unschedulablePlugins": sorted(qp.unschedulable_plugins),
+                **extra,
+            }
+
+        return {
+            "counts": {"active": len(active), "backoff": len(backoff),
+                       "unschedulable": len(unschedulable)},
+            "schedulingCycle": self.scheduling_cycle,
+            "moveRequestCycle": self.move_request_cycle,
+            "active": [entry(e[2]) for e in sorted(active)],
+            "backoff": [entry(e[2], backoffRemaining=max(e[0] - now, 0.0))
+                        for e in sorted(backoff)],
+            "unschedulable": [entry(qp, parkedFor=max(now - qp.timestamp, 0.0))
+                              for qp in unschedulable],
+        }
 
     def __len__(self) -> int:
         return len(self._active) + len(self._backoff) + len(self._unschedulable)

@@ -116,6 +116,7 @@ class Scheduler:
             max_backoff=pod_max_backoff,
             cluster_event_map=event_map,
             now_fn=now_fn,
+            metrics=self.smetrics,
         )
         self._add_all_event_handlers()
 
@@ -186,6 +187,8 @@ class Scheduler:
             elif self._responsible_for(new):
                 self.queue.update(old, new)
         elif event == DELETED:
+            if old is not None:
+                self.smetrics.clear_unschedulable(old.key())
             if old is not None and old.spec.node_name:
                 self.cache.remove_pod(old)
                 self.queue.move_all_to_active_or_backoff_queue(qevents.POD_DELETE)
@@ -249,7 +252,7 @@ class Scheduler:
         pod = qp.pod
         fwk = self.framework_for_pod(pod)
         self.metrics["schedule_attempts"] += 1
-        state = CycleState()
+        state = self._new_cycle_state()
         t0 = self.now_fn()
         try:
             node_name = self.schedule_pod(fwk, state, pod, attempts=qp.attempts)
@@ -264,6 +267,24 @@ class Scheduler:
             return
         self.smetrics.scheduling_algorithm_duration.observe(self.now_fn() - t0, fwk.profile_name)
         self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle, t0=t0)
+
+    # plugin-metrics sampling period: the reference samples ~10% of attempts;
+    # a sampled attempt pays per-(node, plugin) filter observes, which in
+    # Python is a bigger relative cost than in Go, so the default is 1-in-20
+    PLUGIN_METRICS_SAMPLE_PERIOD = 20
+
+    def _new_cycle_state(self) -> CycleState:
+        """CycleState with the plugin-metrics sampling decision made
+        (extension-point totals are always recorded; per-plugin durations
+        only on sampled cycles). Attempt 1 always samples, so short runs
+        still surface per-plugin samples."""
+        state = CycleState()
+        # (attempts - 1) % period: attempt 1 always samples, and period=1
+        # degrades to sample-everything instead of sample-nothing
+        state.record_plugin_metrics = (
+            (self.metrics["schedule_attempts"] - 1)
+            % self.PLUGIN_METRICS_SAMPLE_PERIOD == 0)
+        return state
 
     def assume_and_bind(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, pod: Pod, node_name: str, pod_cycle: int, t0: Optional[float] = None) -> None:
         """The post-decision tail shared by the sequential and TPU-batched
@@ -326,6 +347,11 @@ class Scheduler:
                 current = self.store.get_pod(pod.key())
                 if current is not None and not current.spec.node_name:
                     self.queue.add(current)
+            # cache-size + worker gauges ride the 1s sweep (the reference's
+            # periodic updateSchedulerCacheSize / binding-goroutine gauges)
+            nodes, pods, assumed = self.cache.stats()
+            self.smetrics.sync_cache_gauges(nodes, pods, assumed)
+            self.smetrics.goroutines.set("binding", value=len(self.waiting_pods))
         if now - self._last_unsched_flush >= 30.0:
             self._last_unsched_flush = now
             self.queue.flush_unschedulable_left_over()
@@ -345,6 +371,7 @@ class Scheduler:
             return
         self.cache.finish_binding(assumed)
         self.metrics["scheduled"] += 1
+        self.smetrics.clear_unschedulable(assumed.key())
         self.smetrics.observe_attempt(
             "scheduled", fwk.profile_name,
             self.now_fn() - t0 if t0 is not None else 0.0,
@@ -415,7 +442,15 @@ class Scheduler:
 
     def find_nodes_that_fit_pod(self, fwk: Framework, state: CycleState, pod: Pod, all_nodes) -> Tuple[List, Diagnosis]:
         """(schedule_one.go:364) PreFilter → (restricted) node list → filters
-        with adaptive sampling + round-robin start (:449-:545)."""
+        with adaptive sampling + round-robin start (:449-:545).
+
+        The "filter" EXTENSION-POINT duration is observed here, once per
+        attempt over the node walk only (the reference observes Filter at
+        this level, schedule_one.go:373 defer — per-node observation would
+        put a histogram write on every node visit). The clock starts AFTER
+        PreFilter, which already has its own extension-point histogram;
+        timing it into "filter" too would double-count PreFilter-heavy
+        plugins and misattribute their latency."""
         diagnosis = Diagnosis()
         result, status = fwk.run_pre_filter_plugins(state, pod)
         if not status.is_success():
@@ -430,37 +465,46 @@ class Scheduler:
         if result is not None and not result.all_nodes():
             nodes = [ni for ni in all_nodes if ni.node.meta.name in result.node_names]
 
-        # nominated-node fast path (schedule_one.go:394-403): a pod that
-        # preempted evaluates its nominated node first and schedules there
-        # when feasible — without it, adaptive sampling usually misses the
-        # node the victims were evicted from
-        if pod.status.nominated_node_name:
-            ni = next((n for n in nodes
-                       if n.node.meta.name == pod.status.nominated_node_name), None)
-            if ni is not None:
+        t_filter = time.perf_counter()
+        filter_status = "Error"  # overwritten unless an exception escapes
+        try:
+            # nominated-node fast path (schedule_one.go:394-403): a pod that
+            # preempted evaluates its nominated node first and schedules
+            # there when feasible — without it, adaptive sampling usually
+            # misses the node the victims were evicted from
+            if pod.status.nominated_node_name:
+                ni = next((n for n in nodes
+                           if n.node.meta.name == pod.status.nominated_node_name), None)
+                if ni is not None:
+                    st = fwk.run_filter_plugins_with_nominated_pods(state, pod, ni)
+                    if st.is_success():
+                        filter_status = "Success"
+                        return [ni], diagnosis
+
+            num_to_find = self.num_feasible_nodes_to_find(len(nodes))
+            feasible = []
+            checked = 0
+            start = self.next_start_node_index % len(nodes) if nodes else 0
+            for i in range(len(nodes)):
+                ni = nodes[(start + i) % len(nodes)]
+                checked += 1
                 st = fwk.run_filter_plugins_with_nominated_pods(state, pod, ni)
                 if st.is_success():
-                    return [ni], diagnosis
-
-        num_to_find = self.num_feasible_nodes_to_find(len(nodes))
-        feasible = []
-        checked = 0
-        start = self.next_start_node_index % len(nodes) if nodes else 0
-        for i in range(len(nodes)):
-            ni = nodes[(start + i) % len(nodes)]
-            checked += 1
-            st = fwk.run_filter_plugins_with_nominated_pods(state, pod, ni)
-            if st.is_success():
-                feasible.append(ni)
-                if len(feasible) >= num_to_find:
-                    break
-            else:
-                diagnosis.node_to_status[ni.node.meta.name] = st
-                diagnosis.unschedulable_plugins.add(st.plugin)
-        self.next_start_node_index = (start + checked) % len(nodes) if nodes else 0
-        if feasible and self.extenders:
-            feasible = self._find_nodes_that_pass_extenders(pod, feasible, diagnosis)
-        return feasible, diagnosis
+                    feasible.append(ni)
+                    if len(feasible) >= num_to_find:
+                        break
+                else:
+                    diagnosis.node_to_status[ni.node.meta.name] = st
+                    diagnosis.unschedulable_plugins.add(st.plugin)
+            self.next_start_node_index = (start + checked) % len(nodes) if nodes else 0
+            if feasible and self.extenders:
+                feasible = self._find_nodes_that_pass_extenders(pod, feasible, diagnosis)
+            filter_status = "Success" if feasible else "Unschedulable"
+            return feasible, diagnosis
+        finally:
+            self.smetrics.framework_extension_point_duration.observe(
+                time.perf_counter() - t_filter, "filter", filter_status,
+                fwk.profile_name)
 
     def _find_nodes_that_pass_extenders(self, pod: Pod, feasible: List, diagnosis: Diagnosis) -> List:
         """(schedule_one.go:547) run each interested extender's Filter verb;
@@ -532,8 +576,8 @@ class Scheduler:
         nominated_node = ""
         if status.is_unschedulable():
             self.metrics["unschedulable"] += 1
-            for plugin in diagnosis.unschedulable_plugins:
-                self.smetrics.unschedulable_pods.set(plugin, fwk.profile_name, value=1)
+            self.smetrics.mark_unschedulable(
+                pod.key(), fwk.profile_name, diagnosis.unschedulable_plugins)
             if diagnosis.node_to_status and fwk.points.get("post_filter"):
                 self.smetrics.preemption_attempts.inc()
                 nominated, pf_status = fwk.run_post_filter_plugins(state, pod, diagnosis.node_to_status)
@@ -552,6 +596,7 @@ class Scheduler:
         # re-check existence/binding before re-queueing (MakeDefaultErrorFunc)
         current = self.store.get_pod(pod.key())
         if current is None or current.spec.node_name:
+            self.smetrics.clear_unschedulable(pod.key())  # gone or bound
             return
         qp.pod = current
         qp.unschedulable_plugins = set(diagnosis.unschedulable_plugins)

@@ -111,6 +111,21 @@ class Tracer:
             trace_id, parent_id = stack[-1].trace_id, stack[-1].span_id
         else:
             trace_id, parent_id = uuid.uuid4().hex, None
+        with self._run_span(name, trace_id, parent_id, attributes) as s:
+            yield s
+
+    @contextlib.contextmanager
+    def span_remote(self, name: str, trace_id: str, parent_id: str,
+                    **attributes):
+        """A span whose parent lives in ANOTHER process (the W3C
+        traceparent seam): the local thread stack starts from the remote
+        context, so nested spans chain under the caller's trace."""
+        with self._run_span(name, trace_id, parent_id, attributes) as s:
+            yield s
+
+    @contextlib.contextmanager
+    def _run_span(self, name, trace_id, parent_id, attributes):
+        stack = self._stack()
         s = Span(name, trace_id, parent_id, attributes)
         stack.append(s)
         try:
@@ -150,6 +165,65 @@ def span(name: str, **attributes):
     else:
         with t.span(name, **attributes) as s:
             yield s
+
+
+def current() -> Optional[Span]:
+    """The active span on this thread, or None (disabled / no open span)."""
+    t = _tracer
+    if t is None:
+        return None
+    stack = t._stack()
+    return stack[-1] if stack else None
+
+
+def format_traceparent() -> Optional[str]:
+    """W3C traceparent of the active span (``00-<trace_id>-<span_id>-01``),
+    or None when tracing is disabled or no span is open. Inject this into a
+    wire request so the server side parents under the caller's trace."""
+    s = current()
+    if s is None:
+        return None
+    return f"00-{s.trace_id}-{s.span_id}-01"
+
+
+def parse_traceparent(tp) -> Optional[tuple]:
+    """``(trace_id, parent_span_id)`` from a traceparent string, or None on
+    anything malformed (propagation is best-effort; a bad header just means
+    the server span roots its own trace)."""
+    if not tp or not isinstance(tp, str):
+        return None
+    parts = tp.split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    return parts[1], parts[2]
+
+
+@contextlib.contextmanager
+def span_from_remote(traceparent, name: str, **attributes):
+    """Open a span parented under a remote caller's traceparent (the server
+    half of cross-boundary propagation). Falls back to a normal local span
+    when the context is absent/malformed; no-op when tracing is disabled."""
+    t = _tracer
+    if t is None:
+        yield None
+        return
+    parsed = parse_traceparent(traceparent)
+    if parsed is None:
+        with t.span(name, **attributes) as s:
+            yield s
+    else:
+        with t.span_remote(name, parsed[0], parsed[1], **attributes) as s:
+            yield s
+
+
+def tail(n: int = 256) -> List[Span]:
+    """Last ``n`` finished spans when the active exporter keeps them in
+    memory (InMemoryExporter); [] otherwise — the /debug/spans feed."""
+    t = _tracer
+    spans = getattr(getattr(t, "exporter", None), "spans", None) if t else None
+    if not spans:
+        return []
+    return list(spans[-n:])
 
 
 def maybe_enable_from_env() -> None:

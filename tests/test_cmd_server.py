@@ -114,6 +114,83 @@ class TestComponentServer:
             srv.stop()
 
 
+class TestDebugEndpoints:
+    def test_debug_mux_over_http(self):
+        from kubernetes_tpu.utils import tracing
+
+        store = ClusterStore()
+        for i in range(3):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        app = SchedulerApp(store, raw_config=None)
+        port = app.server.start()
+        tracing.enable()  # in-memory exporter feeds /debug/spans
+        try:
+            store.create_pod(make_pod("ok").req({"cpu": "100m"}).obj())
+            store.create_pod(make_pod("huge").req({"cpu": "64"}).obj())
+            app.tick()
+
+            status, body = _get(port, "/debug")
+            assert status == 200
+            assert set(json.loads(body)["endpoints"]) == {
+                "/debug/queue", "/debug/cache", "/debug/devicestate",
+                "/debug/spans"}
+
+            status, body = _get(port, "/debug/queue")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc["counts"]["unschedulable"] == 1
+            assert doc["unschedulable"][0]["pod"] == "default/huge"
+            assert "NodeResourcesFit" in doc["unschedulable"][0]["unschedulablePlugins"]
+
+            status, body = _get(port, "/debug/cache")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc["nodes"] == 3 and doc["pods"] >= 1
+            assert doc["inSync"] is True
+
+            status, body = _get(port, "/debug/devicestate")
+            assert status == 200
+            assert json.loads(body) == {"enabled": False}  # oracle scheduler
+
+            with tracing.span("probe"):
+                pass
+            status, body = _get(port, "/debug/spans")
+            doc = json.loads(body)
+            assert status == 200
+            assert any(s["name"] == "probe" for s in doc)
+
+            try:
+                _get(port, "/debug/nope")
+                assert False, "expected 404"
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            tracing.disable()
+            app.server.stop()
+
+    def test_devicestate_dump_on_batched_scheduler(self):
+        from kubernetes_tpu.backend import TPUScheduler
+        from kubernetes_tpu.cmd.server import build_debug_handlers
+
+        store = ClusterStore()
+        for i in range(4):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        sched = TPUScheduler(store, batch_size=8)
+        for i in range(5):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        doc = json.loads(json.dumps(
+            build_debug_handlers(sched)["devicestate"](), default=str))
+        assert doc["enabled"] is True
+        assert doc["caps"]["nodes"] >= 4
+        assert doc["nodesMirrored"] == 4
+        assert doc["batchCounter"] >= 1
+        assert doc["sigTable"]["nSigs"] >= 1
+        assert doc["batchSizer"]["target"] >= 1
+
+
 class TestSchedulerApp:
     def test_app_schedules_and_serves(self):
         store = ClusterStore()

@@ -2,6 +2,8 @@
 (SURVEY.md §5.1/§5.5: identical metric names keep a scheduler_perf-style
 metricsCollector working; dedup in the event recorder; LogIfLong)."""
 
+import re
+
 from kubernetes_tpu.api.wrappers import make_node, make_pod
 from kubernetes_tpu.apiserver.store import ClusterStore
 from kubernetes_tpu.metrics import Histogram, Registry, SchedulerMetrics
@@ -64,6 +66,240 @@ def test_event_dedup():
         clock[0] += 1
     assert len(r.events) == 1
     assert r.events[0].count == 5
+
+
+def test_framework_runtime_observes_extension_points():
+    """Tentpole: the framework runtime itself feeds the two attribution
+    histograms — per extension point always, per plugin on sampled cycles
+    (attempt 1 always samples)."""
+    store = ClusterStore()
+    for i in range(3):
+        store.create_node(make_node(f"n{i}").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    s = Scheduler(store)
+    store.create_pod(make_pod("p").req({"cpu": "100m"}).obj())
+    s.run_until_settled()
+
+    h = s.smetrics.framework_extension_point_duration
+    points = {lv[0] for lv in h.label_sets()}
+    assert {"pre_filter", "filter", "pre_score", "score",
+            "reserve", "permit", "pre_bind", "bind", "post_bind"} <= points
+    # "filter" is observed once per attempt over the whole node walk (the
+    # reference's findNodesThatFitPod-level observation, not per node)
+    assert sum(h.count(*lv) for lv in h.label_sets() if lv[0] == "filter") == 1
+    # profile label rides along
+    assert all(lv[2] == "default-scheduler" for lv in h.label_sets())
+
+    hp = s.smetrics.plugin_execution_duration
+    plugin_points = {(lv[0], lv[1]) for lv in hp.label_sets()}
+    assert ("NodeResourcesFit", "filter") in plugin_points
+    assert ("DefaultBinder", "bind") in plugin_points
+    assert all(lv[2] == "Success" for lv in hp.label_sets()
+               if lv[1] == "bind")
+
+
+def test_wire_backend_observes_every_bind_path_plugin():
+    """Acceptance: after a wire-backend run /metrics shows nonzero
+    extension-point and per-plugin duration samples for every enabled
+    plugin that ran."""
+    from kubernetes_tpu.backend.service import DeviceService, WireScheduler, serve
+
+    store = ClusterStore()
+    svc = DeviceService(batch_size=8)
+    server, port = serve(svc)
+    try:
+        s = WireScheduler(store, endpoint=f"http://127.0.0.1:{port}",
+                          batch_size=8)
+        for i in range(4):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        for i in range(6):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        s.run_until_settled()
+    finally:
+        server.shutdown()
+        server.server_close()
+    assert s.metrics["scheduled"] == 6
+
+    exposition = s.smetrics.registry.expose()
+    assert "scheduler_framework_extension_point_duration_seconds_count" in exposition
+    assert "scheduler_plugin_execution_duration_seconds_count" in exposition
+
+    h = s.smetrics.framework_extension_point_duration
+    ran_points = {lv[0] for lv in h.label_sets()}
+    assert {"reserve", "permit", "pre_bind", "bind", "post_bind"} <= ran_points
+    hp = s.smetrics.plugin_execution_duration
+    fwk = s.profiles["default-scheduler"]
+    for point in ("reserve", "permit", "pre_bind", "bind", "post_bind"):
+        for plugin, _w in fwk.points.get(point, []):
+            n = sum(hp.count(*lv) for lv in hp.label_sets()
+                    if lv[0] == plugin.name() and lv[1] == point)
+            assert n > 0, f"no samples for {plugin.name()}@{point}"
+
+
+def test_unschedulable_pods_gauge_counts_and_clears():
+    """Satellite: the gauge tracks real per-plugin counts (not a sticky 1)
+    and drains when pods schedule or are deleted."""
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity(
+        {"cpu": "1", "memory": "4Gi", "pods": 10}).obj())
+    s = Scheduler(store, pod_initial_backoff=0.0, pod_max_backoff=0.0)
+    g = s.smetrics.unschedulable_pods
+    store.create_pod(make_pod("big-a").req({"cpu": "64"}).obj())
+    store.create_pod(make_pod("big-b").req({"cpu": "64"}).obj())
+    s.run_until_settled()
+    assert g.labels("NodeResourcesFit", "default-scheduler") == 2
+
+    store.delete_pod("default/big-a")
+    assert g.labels("NodeResourcesFit", "default-scheduler") == 1
+
+    # capacity arrives: the remaining pod schedules and the gauge drains
+    store.create_node(make_node("n2").capacity(
+        {"cpu": "128", "memory": "64Gi", "pods": 10}).obj())
+    s.run_until_settled()
+    assert store.get_pod("default/big-b").spec.node_name == "n2"
+    assert g.labels("NodeResourcesFit", "default-scheduler") == 0
+
+
+def test_queue_metrics_wired():
+    """Satellite: queue_incoming_pods counters + pending_pods gauge sync on
+    queue transitions (both were registered-but-dead)."""
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity(
+        {"cpu": "1", "memory": "4Gi", "pods": 10}).obj())
+    s = Scheduler(store, pod_initial_backoff=0.0, pod_max_backoff=0.0)
+    m = s.smetrics
+    store.create_pod(make_pod("ok").req({"cpu": "100m"}).obj())
+    store.create_pod(make_pod("huge").req({"cpu": "64"}).obj())
+    assert m.queue_incoming_pods.labels("active", "PodAdd") == 2
+    assert m.pending_pods.labels("active") == 2
+    s.run_until_settled()
+    # the failed pod landed in the unschedulable map on attempt failure
+    assert m.queue_incoming_pods.labels("unschedulable", "ScheduleAttemptFailure") >= 1
+    assert m.pending_pods.labels("active") == 0
+    assert m.pending_pods.labels("unschedulable") == 1
+    # a relevant cluster event moves it back out
+    store.create_node(make_node("n2").capacity(
+        {"cpu": "128", "memory": "64Gi", "pods": 10}).obj())
+    s.run_until_settled()
+    assert m.pending_pods.labels("unschedulable") == 0
+    incoming = m.queue_incoming_pods
+    moved = sum(incoming.labels(q, e) for q, e in incoming.label_sets()
+                if e not in ("PodAdd",))
+    assert moved >= 1
+
+
+def _parse_prom(text):
+    """Tiny Prometheus text-format parser: returns (help, type, samples)
+    keyed by metric family, samples as (name, {label: value}, float)."""
+    import re
+
+    helps, types, samples = {}, {}, []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, h = line.split(" ", 3)
+            helps[name] = h
+        elif line.startswith("# TYPE "):
+            _, _, name, t = line.split(" ", 3)
+            types[name] = t
+        else:
+            mm = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$", line)
+            assert mm, f"malformed sample line: {line!r}"
+            labels = {}
+            if mm.group(3):
+                for lm in re.finditer(r'(\w+)="((?:[^"\\]|\\.)*)"', mm.group(3)):
+                    # single left-to-right pass: sequential replace() would
+                    # corrupt a literal backslash followed by 'n'
+                    labels[lm.group(1)] = re.sub(
+                        r"\\(.)", lambda e: {"n": "\n"}.get(e.group(1), e.group(1)),
+                        lm.group(2))
+            samples.append((mm.group(1), labels, float(mm.group(4))))
+    return helps, types, samples
+
+
+def test_metrics_exposition_well_formed_over_http():
+    """Satellite: scrape /metrics over HTTP after a mixed oracle+batched run;
+    assert HELP/TYPE pairs, histogram bucket consistency, label escaping."""
+    import urllib.request
+
+    from kubernetes_tpu.backend import TPUScheduler
+    from kubernetes_tpu.cmd.server import ComponentServer
+
+    m = SchedulerMetrics()
+    # oracle run
+    store1 = ClusterStore()
+    store1.create_node(make_node("n1").capacity(
+        {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    s1 = Scheduler(store1, metrics=m)
+    store1.create_pod(make_pod("seq").req({"cpu": "100m"}).obj())
+    store1.create_pod(make_pod("huge").req({"cpu": "64"}).obj())
+    s1.run_until_settled()
+    # batched run against the same metric set
+    store2 = ClusterStore()
+    for i in range(4):
+        store2.create_node(make_node(f"b{i}").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    s2 = TPUScheduler(store2, metrics=m, batch_size=8)
+    for i in range(6):
+        store2.create_pod(make_pod(f"bp{i}").req({"cpu": "100m"}).obj())
+    s2.run_until_settled()
+    # escaping probe: a label value with quote, backslash, and newline
+    m.queue_incoming_pods.inc('que"ue\\q\nx', "Probe")
+
+    srv = ComponentServer(configz={}, registry=m.registry)
+    port = srv.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            assert r.status == 200
+            body = r.read().decode()
+    finally:
+        srv.stop()
+
+    helps, types, samples = _parse_prom(body)
+    assert samples
+    # escaping round-trips (and never breaks line framing — _parse_prom
+    # would already have choked on a raw newline)
+    assert any(lab.get("queue") == 'que"ue\\q\nx' for _, lab, _ in samples)
+    # every sample belongs to a family with a HELP and TYPE line
+    for name, _labels, _v in samples:
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        fam = base if base in types else name
+        assert fam in types and fam in helps, f"no HELP/TYPE for {name}"
+    # histogram consistency per labelset: cumulative buckets, +Inf == _count
+    hists = [n for n, t in types.items() if t == "histogram"]
+    checked = 0
+    for fam in hists:
+        series = {}
+        for name, labels, v in samples:
+            if not name.startswith(fam + "_"):
+                continue
+            key = tuple(sorted((k, v2) for k, v2 in labels.items() if k != "le"))
+            series.setdefault(key, {"buckets": [], "sum": None, "count": None})
+            if name == fam + "_bucket":
+                le = labels["le"]
+                series[key]["buckets"].append(
+                    (float("inf") if le == "+Inf" else float(le), v))
+            elif name == fam + "_sum":
+                series[key]["sum"] = v
+            elif name == fam + "_count":
+                series[key]["count"] = v
+        for key, d in series.items():
+            assert d["sum"] is not None and d["count"] is not None, (fam, key)
+            buckets = sorted(d["buckets"])
+            counts = [c for _, c in buckets]
+            assert counts == sorted(counts), f"{fam}{key}: non-cumulative"
+            assert buckets[-1][0] == float("inf")
+            assert buckets[-1][1] == d["count"], f"{fam}{key}: +Inf != _count"
+            checked += 1
+    assert checked > 0
+    # the tentpole histograms made it to the wire with samples
+    assert any(n.startswith("scheduler_framework_extension_point_duration_seconds")
+               for n, _l, _v in samples)
+    assert any(n.startswith("scheduler_plugin_execution_duration_seconds")
+               for n, _l, _v in samples)
 
 
 def test_trace_log_if_long():

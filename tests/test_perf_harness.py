@@ -53,6 +53,51 @@ def test_scheduling_basic_tpu_backend():
     assert len(lat) == 1 and lat[0].data["Perc99"] > 0  # batch path observes
 
 
+def test_metrics_collector_per_phase_dataitems():
+    """The generalized metricsCollector: extension-point and batch-phase
+    percentiles ride along as DataItems without touching the headline
+    SchedulingThroughput / attempt-duration items."""
+    tc = TEST_CASES["SchedulingBasic"](nodes=16, init_pods=4, measured=12)
+    items = run_workload(tc, backend="tpu", batch_size=8)
+    tput = [it for it in items if it.labels["Name"] == "SchedulingThroughput"]
+    assert len(tput) == 1  # headline untouched
+    ext = [it for it in items
+           if it.labels["Name"] == "framework_extension_point_duration_seconds"]
+    assert ext, [it.labels for it in items]
+    for it in ext:
+        assert it.unit == "s"
+        assert it.data["Perc99"] >= it.data["Perc50"] >= 0
+        assert it.data["Count"] > 0
+        assert {"extension_point", "status", "profile"} <= set(it.labels)
+    # the batched path contributes its device phase histogram too
+    batch = [it for it in items
+             if it.labels["Name"] == "tpu_batch_duration_seconds"]
+    assert batch and all("phase" in it.labels for it in batch)
+
+
+def test_metrics_collector_scrape_delta():
+    """Collector snapshots at start: pre-phase samples are excluded,
+    labelsets first seen mid-phase delta against zero."""
+    from kubernetes_tpu.metrics import Registry, Histogram
+    from kubernetes_tpu.perf.harness import MetricsCollector
+
+    reg = Registry()
+    h = reg.register(Histogram(
+        "scheduler_framework_extension_point_duration_seconds", "t",
+        ["extension_point", "status", "profile"]))
+    h.observe(5.0, "filter", "Success", "p")  # pre-phase outlier
+    col = MetricsCollector(reg)
+    col.start()
+    for _ in range(10):
+        h.observe(0.002, "filter", "Success", "p")
+    h.observe(0.004, "bind", "Success", "p")  # new labelset mid-phase
+    items = col.collect()
+    by_point = {it.labels["extension_point"]: it for it in items}
+    assert by_point["filter"].data["Count"] == 10
+    assert by_point["filter"].data["Perc99"] < 1.0  # outlier excluded
+    assert by_point["bind"].data["Count"] == 1
+
+
 def test_pod_anti_affinity_workload_tpu():
     tc = TEST_CASES["SchedulingPodAntiAffinity"](nodes=24, init_pods=8, measured=12)
     items = run_workload(tc, backend="tpu", batch_size=8)

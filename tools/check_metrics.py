@@ -1,0 +1,129 @@
+#!/usr/bin/env python3
+"""Static dead-metric check (tier-1; run by tests/test_check_metrics.py).
+
+Every metric registered in ``SchedulerMetrics.__init__`` must be observed /
+incremented / set somewhere in the package outside its definition — either
+directly (``smetrics.<attr>.observe(...)``) or through a SchedulerMetrics
+helper method that is itself called from outside the metrics module. This
+PR fixed a family of defined-but-never-observed metrics
+(framework_extension_point_duration, plugin_execution_duration,
+queue_incoming_pods, pending_pods, ...); this check keeps them from
+reappearing: a new metric that nothing feeds fails tier-1.
+
+Usage: ``python tools/check_metrics.py`` — exits 0 when every metric is
+live, 1 with a listing otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "kubernetes_tpu")
+METRICS_FILE = os.path.join(PKG, "metrics", "scheduler_metrics.py")
+
+# the mutating calls that count as "feeding" a metric
+_MUTATORS = ("observe", "inc", "set")
+
+
+def registered_metrics(tree: ast.Module):
+    """Metric attribute names from ``self.<attr> = r.register(...)``
+    assignments in SchedulerMetrics.__init__."""
+    attrs = []
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef) and cls.name == "SchedulerMetrics"):
+            continue
+        for fn in cls.body:
+            if not (isinstance(fn, ast.FunctionDef) and fn.name == "__init__"):
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                    continue
+                tgt = node.targets[0]
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and isinstance(node.value, ast.Call)
+                        and isinstance(node.value.func, ast.Attribute)
+                        and node.value.func.attr == "register"):
+                    attrs.append(tgt.attr)
+    return attrs
+
+
+def helper_map(tree: ast.Module):
+    """SchedulerMetrics method name → set of metric attrs it mutates
+    (``self.<attr>.<mutator>(...)`` calls inside the method)."""
+    out = {}
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef) and cls.name == "SchedulerMetrics"):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef) or fn.name == "__init__":
+                continue
+            touched = set()
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _MUTATORS
+                        and isinstance(node.func.value, ast.Attribute)
+                        and isinstance(node.func.value.value, ast.Name)
+                        and node.func.value.value.id == "self"):
+                    touched.add(node.func.value.attr)
+            if touched:
+                out[fn.name] = touched
+    return out
+
+
+def package_sources():
+    for root, _dirs, files in os.walk(PKG):
+        for f in files:
+            if f.endswith(".py"):
+                path = os.path.join(root, f)
+                with open(path, encoding="utf-8") as fh:
+                    yield path, fh.read()
+
+
+def find_dead_metrics():
+    tree = ast.parse(open(METRICS_FILE, encoding="utf-8").read())
+    attrs = registered_metrics(tree)
+    helpers = helper_map(tree)
+
+    outside = []  # package sources excluding the definition module
+    for path, text in package_sources():
+        if os.path.abspath(path) == os.path.abspath(METRICS_FILE):
+            continue
+        outside.append(text)
+    blob = "\n".join(outside)
+
+    # which helper methods are actually invoked outside the metrics module
+    live_helpers = {name for name in helpers
+                    if re.search(rf"\.{name}\s*\(", blob)}
+
+    dead = []
+    for attr in attrs:
+        direct = re.search(
+            rf"\.{attr}\.(?:{'|'.join(_MUTATORS)})\s*\(", blob)
+        via_helper = any(attr in helpers[h] for h in live_helpers)
+        if not direct and not via_helper:
+            dead.append(attr)
+    return attrs, dead
+
+
+def main() -> int:
+    attrs, dead = find_dead_metrics()
+    if dead:
+        print(f"DEAD METRICS ({len(dead)}/{len(attrs)}): registered in "
+              "SchedulerMetrics but never observed/inc'd/set outside the "
+              "definition:")
+        for attr in dead:
+            print(f"  - {attr}")
+        return 1
+    print(f"ok: all {len(attrs)} registered scheduler metrics are observed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
