@@ -25,13 +25,17 @@ families (ClusterRole, WebhookConfiguration) that also live in the store.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
-from typing import Optional
+import zlib
+from typing import Iterator, Optional
 
 from ..api.codec import from_wire, to_wire
 
 _SNAP_SUFFIX = ".snap"
+
+logger = logging.getLogger(__name__)
 
 
 def _resolve_type(type_name: str):
@@ -70,8 +74,13 @@ class WriteAheadLog:
             rv = getattr(getattr(obj, "meta", None), "resource_version", None)
             if rv is not None:
                 rec["rv"] = rv
+        body = json.dumps(rec)
+        # per-record guard: an 8-hex crc32 of the JSON body prefixes every
+        # line, so replay can tell a torn tail (the process died mid-write,
+        # etcd walpb.Record's CRC role) from a clean record
+        line = f"{zlib.crc32(body.encode()):08x} {body}\n"
         with self._lock:
-            self._f.write(json.dumps(rec) + "\n")
+            self._f.write(line)
             self._f.flush()
             if self.fsync:
                 os.fsync(self._f.fileno())
@@ -113,6 +122,58 @@ class WriteAheadLog:
         return len(objs)
 
 
+def _parse_line(line: str) -> Optional[dict]:
+    """One WAL line → record dict, or None when torn/corrupt. Current
+    format is ``<crc32hex> <json>``; a bare-JSON line (pre-checksum WAL)
+    parses without the crc guard."""
+    try:
+        if len(line) > 9 and line[8] == " ":
+            crc, body = line[:8], line[9:]
+            try:
+                expect = int(crc, 16)
+            except ValueError:
+                return json.loads(line)  # legacy bare JSON starting oddly
+            if zlib.crc32(body.encode()) != expect:
+                return None
+            return json.loads(body)
+        return json.loads(line)
+    except ValueError:
+        return None
+
+
+def replay(path: str) -> Iterator[dict]:
+    """Yield WAL records in append order, stopping CLEANLY at a truncated
+    or corrupt record instead of raising — the crash left a torn tail (the
+    write died mid-line); everything before it is the durable prefix, and
+    availability beats the tail (crash-only recovery, SURVEY §5.3). If
+    non-empty lines FOLLOW the corrupt one, that is more than a torn tail:
+    log what is being dropped, still recover the clean prefix."""
+    if not os.path.exists(path):
+        return
+    with open(path, encoding="utf-8") as f:
+        # streamed, not readlines(): an un-compacted WAL can be huge and
+        # replay runs at startup; the trailing-record count only walks the
+        # remainder in the rare corrupt-record case
+        for i, line in enumerate(f):
+            line = line.rstrip("\n")
+            if not line.strip():
+                continue
+            rec = _parse_line(line)
+            if rec is None:
+                trailing = sum(1 for rest in f if rest.strip())
+                if trailing:
+                    logger.warning(
+                        "WAL %s: corrupt record at line %d with %d records "
+                        "after it; replaying the clean prefix only",
+                        path, i + 1, trailing)
+                else:
+                    logger.warning(
+                        "WAL %s: torn tail at line %d (crash mid-append); "
+                        "stopping replay cleanly", path, i + 1)
+                return
+            yield rec
+
+
 def attach_wal(store, path: str, fsync: bool = False) -> WriteAheadLog:
     """Hook a WAL into a store's mutation funnel; returns the WAL."""
     wal = WriteAheadLog(path, fsync=fsync)
@@ -144,22 +205,17 @@ def restore(path: str, store_factory=None):
                     if rec["kind"] == "CustomResourceDefinition":
                         store._register_crd_kind(obj)
                     store._kind_map(rec["kind"])[rec["key"]] = obj
-        if os.path.exists(path):
-            with open(path, encoding="utf-8") as f:
-                for line in f:
-                    if not line.strip():
-                        continue
-                    rec = json.loads(line)
-                    m = store._kind_map(rec["kind"])
-                    if rec["event"] == "DELETED":
-                        m.pop(rec["key"], None)
-                    else:
-                        obj = from_wire(_resolve_type(rec["type"]), rec["obj"])
-                        if rec["kind"] == "CustomResourceDefinition":
-                            store._register_crd_kind(obj)
-                        m[rec["key"]] = obj
-                        max_rv = max(max_rv, int(rec.get("rv", 0) or 0))
-                    max_seq = max(max_seq, int(rec.get("seq", 0) or 0))
+        for rec in replay(path):
+            m = store._kind_map(rec["kind"])
+            if rec["event"] == "DELETED":
+                m.pop(rec["key"], None)
+            else:
+                obj = from_wire(_resolve_type(rec["type"]), rec["obj"])
+                if rec["kind"] == "CustomResourceDefinition":
+                    store._register_crd_kind(obj)
+                m[rec["key"]] = obj
+                max_rv = max(max_rv, int(rec.get("rv", 0) or 0))
+            max_seq = max(max_seq, int(rec.get("seq", 0) or 0))
     finally:
         store.admission = saved_admission
     store._rv = max(store._rv, max_rv)

@@ -1,0 +1,93 @@
+"""Circuit breaker over the device-service transport.
+
+After N consecutive transport failures the breaker OPENS and the
+WireScheduler routes every pod through the sequential oracle path —
+scheduling never stops when the accelerator sidecar dies (the crash-only
+contract, SURVEY §5.3, extended to the TPU backend). After
+``reset_timeout_s`` the next wire attempt is a HALF_OPEN probe: success
+closes the breaker (the client resyncs via the epoch protocol and the
+batched path resumes), failure re-opens it for another timeout.
+
+Driven by the scheduler's injectable ``now_fn`` so chaos tests advance a
+FakeClock instead of sleeping against the wall clock. The scheduling loop
+is single-threaded; no locking needed.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# gauge encoding for scheduler_backend_circuit_state
+STATE_VALUES = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}
+
+
+class CircuitBreaker:
+    def __init__(self, failure_threshold: int = 3, reset_timeout_s: float = 5.0,
+                 now_fn: Callable[[], float] = time.monotonic,
+                 on_state_change: Optional[Callable[[str, str], None]] = None):
+        self.failure_threshold = failure_threshold
+        self.reset_timeout_s = reset_timeout_s
+        self.now_fn = now_fn
+        self.on_state_change = on_state_change
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self.opened_at: Optional[float] = None
+        self.opens = 0          # lifetime open transitions (debug surface)
+        self.last_error: str = ""
+
+    def _transition(self, new: str) -> None:
+        if new == self.state:
+            return
+        old, self.state = self.state, new
+        if new == OPEN:
+            self.opens += 1
+            self.opened_at = self.now_fn()
+        if self.on_state_change is not None:
+            self.on_state_change(old, new)
+
+    def allow(self) -> bool:
+        """True when a wire attempt may proceed. An OPEN breaker past its
+        reset timeout transitions to HALF_OPEN and admits the one probe."""
+        if self.state == CLOSED:
+            return True
+        if self.state == OPEN:
+            if self.now_fn() - self.opened_at >= self.reset_timeout_s:
+                self._transition(HALF_OPEN)
+                return True
+            return False
+        return True  # HALF_OPEN: the loop is sequential, this IS the probe
+
+    def record_success(self) -> None:
+        self.consecutive_failures = 0
+        self._transition(CLOSED)
+
+    def record_failure(self, error: Optional[BaseException] = None) -> None:
+        self.consecutive_failures += 1
+        if error is not None:
+            self.last_error = f"{type(error).__name__}: {error}"
+        if (self.state == HALF_OPEN
+                or self.consecutive_failures >= self.failure_threshold):
+            # re-stamp opened_at even when already OPEN (a failed probe
+            # restarts the reset timer)
+            self.opened_at = self.now_fn()
+            self._transition(OPEN)
+
+    def dump(self) -> dict:
+        """JSON body for /debug/circuit."""
+        now = self.now_fn()
+        return {
+            "state": self.state,
+            "consecutiveFailures": self.consecutive_failures,
+            "failureThreshold": self.failure_threshold,
+            "resetTimeoutS": self.reset_timeout_s,
+            "opens": self.opens,
+            "openFor": (now - self.opened_at
+                        if self.state == OPEN and self.opened_at is not None
+                        else 0.0),
+            "lastError": self.last_error,
+        }

@@ -1,0 +1,128 @@
+"""Typed error taxonomy + retry policy for the device-service transport.
+
+The wire hop (backend/service.py, backend/grpc_service.py) is the one
+control-plane link that can fail independently of the host process — the
+accelerator-sidecar failure mode. client-go's answer is a taxonomy
+(retriable vs terminal) feeding a rate-limited requeue; this module is the
+same contract for the batched device path:
+
+  * ``TransientDeviceError`` — connection refused/reset, read timeout,
+    5xx: the service may come back; retry with backoff inside the
+    per-call deadline budget, then count against the circuit breaker.
+  * ``PermanentDeviceError`` — 4xx, protocol violations, a service-side
+    exception (deterministic: re-sending the same batch re-raises it).
+    Never retried at the transport layer; the pods re-enter the backoff
+    queue (rate-limited requeue) so a host-side fix can land.
+  * ``StaleEpochError`` — the service answered but its process epoch does
+    not match the client's last-known one: a restarted device holds a
+    fresh empty DeviceState, so applying deltas against it would silently
+    build the wrong base. Not a retry — the client performs a full-state
+    resync and carries on.
+
+All three subclass RuntimeError so pre-taxonomy callers that caught the
+old ``RuntimeError`` from ``WireClient._post`` keep working.
+
+``RetryPolicy`` is the shared retry-with-exponential-backoff+jitter loop
+(workqueue's ItemExponentialFailureRateLimiter shape): injectable
+``sleep_fn``/``now_fn``/``rng`` keep chaos tests deterministic — no test
+ever sleeps against the wall clock.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional
+
+
+class DeviceServiceError(RuntimeError):
+    """Base of the wire-transport taxonomy."""
+
+
+class TransientDeviceError(DeviceServiceError):
+    """The call may succeed if repeated: retry, then breaker-count it."""
+
+
+class PermanentDeviceError(DeviceServiceError):
+    """Retrying the identical call cannot help; surface it."""
+
+
+class StaleEpochError(DeviceServiceError):
+    """The device restarted since we last synced: its state is a fresh
+    empty mirror under a new process epoch. Carries the CURRENT epoch so
+    the client can resync and re-stamp in one round trip."""
+
+    def __init__(self, epoch: str, message: str = ""):
+        super().__init__(message or f"device epoch changed (now {epoch!r}); "
+                         "full resync required")
+        self.epoch = epoch
+
+
+def raise_injected_fault(fault_plan, op: str, read_timeout: float) -> None:
+    """Shared client-side fault-injection hook (WireClient and GrpcClient):
+    consume the next scripted fault for ``op`` and raise what the network
+    would have — drop/error as a transient failure, a delay past the read
+    deadline as the timeout it would become. Deterministic: no sleeping."""
+    if fault_plan is None:
+        return
+    fault = fault_plan.next_client(op)
+    if fault is None:
+        return
+    if fault.kind in ("drop", "error"):
+        raise TransientDeviceError(f"injected {fault.kind}: {op}")
+    if fault.kind == "delay" and fault.seconds >= read_timeout:
+        raise TransientDeviceError(
+            f"injected timeout: {op} delayed {fault.seconds}s "
+            f"> read deadline {read_timeout}s")
+
+
+class RetryPolicy:
+    """Exponential backoff + jitter over transient failures, bounded by a
+    per-call deadline budget (the per-cycle transport budget: a scheduling
+    cycle must fail over to degraded mode rather than wedge behind an
+    unbounded retry storm)."""
+
+    def __init__(self, max_retries: int = 3, backoff_base: float = 0.05,
+                 backoff_max: float = 2.0, deadline_s: float = 60.0,
+                 jitter: float = 0.5,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 now_fn: Callable[[], float] = time.monotonic,
+                 rng: Optional[random.Random] = None,
+                 on_retry: Optional[Callable[[str], None]] = None):
+        self.max_retries = max_retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.deadline_s = deadline_s
+        self.jitter = jitter
+        self.sleep_fn = sleep_fn
+        self.now_fn = now_fn
+        # seeded by default: retry timing must not introduce nondeterminism
+        # into tests; production callers pass random.Random() if they care
+        self.rng = rng if rng is not None else random.Random(0)
+        self.on_retry = on_retry  # hook: scheduler_wire_retries_total
+
+    def backoff_for(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based): base·2^(attempt-1)
+        capped, scaled by a jitter factor in [1-jitter, 1]."""
+        d = min(self.backoff_base * (2 ** (attempt - 1)), self.backoff_max)
+        return d * (1.0 - self.jitter + self.jitter * self.rng.random())
+
+    def run(self, op: str, fn):
+        """Run ``fn`` retrying TransientDeviceError. Permanent and
+        stale-epoch errors propagate immediately; the final transient
+        (budget or retry count exhausted) propagates for the breaker."""
+        start = self.now_fn()
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except TransientDeviceError:
+                attempt += 1
+                elapsed = self.now_fn() - start
+                if attempt > self.max_retries or elapsed >= self.deadline_s:
+                    raise
+                delay = min(self.backoff_for(attempt),
+                            max(self.deadline_s - elapsed, 0.0))
+                if self.on_retry is not None:
+                    self.on_retry(op)
+                self.sleep_fn(delay)

@@ -22,6 +22,14 @@ import subprocess
 import threading
 from typing import Dict, Optional
 
+from .errors import (
+    PermanentDeviceError,
+    RetryPolicy,
+    StaleEpochError,
+    TransientDeviceError,
+    raise_injected_fault,
+)
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 _PROTO_DIR = os.path.join(_REPO_ROOT, "native")
 _PROTO = os.path.join(_PROTO_DIR, "ktpu_device.proto")
@@ -74,6 +82,7 @@ def _deltas_to_proto(payload: dict):
     for ns, labels in (payload.get("namespaces") or {}).items():
         req.namespaces[ns] = json.dumps(labels).encode()
     req.traceparent = payload.get("traceparent") or ""
+    req.expect_epoch = payload.get("expectEpoch") or ""
     return req
 
 
@@ -90,6 +99,8 @@ def _deltas_from_proto(req) -> dict:
     }
     if req.traceparent:
         out["traceparent"] = req.traceparent
+    if req.expect_epoch:
+        out["expectEpoch"] = req.expect_epoch
     return out
 
 
@@ -114,6 +125,8 @@ def _batch_to_proto(payload: dict):
                                  namespace=namespace, uid=uid))
     req.tie_seeds.extend(int(s) for s in payload.get("tieSeeds", ()))
     req.traceparent = payload.get("traceparent") or ""
+    req.expect_epoch = payload.get("expectEpoch") or ""
+    req.batch_id = payload.get("batchId") or ""
     return req
 
 
@@ -133,6 +146,10 @@ def _batch_from_proto(req) -> dict:
         out["tieSeeds"] = list(req.tie_seeds)
     if req.traceparent:
         out["traceparent"] = req.traceparent
+    if req.expect_epoch:
+        out["expectEpoch"] = req.expect_epoch
+    if req.batch_id:
+        out["batchId"] = req.batch_id
     return out
 
 
@@ -187,12 +204,31 @@ def serve_grpc(service, port: int = 0):
 
     p = pb2()
 
-    def apply_deltas(request, _ctx):
-        out = service.apply_deltas(_deltas_from_proto(request))
-        return p.ApplyDeltasResponse(nodes=int(out.get("nodes", 0)))
+    def _abort_stale(ctx, exc):
+        # FAILED_PRECONDITION carries the CURRENT epoch in the details so
+        # the client can resync and re-stamp in one round trip (the HTTP
+        # binding's 409 + staleEpoch body)
+        ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                  f"stale epoch; current={exc.epoch}")
 
-    def schedule_batch(request, _ctx):
-        return _results_to_proto(service.schedule_batch(_batch_from_proto(request)))
+    def apply_deltas(request, ctx):
+        try:
+            out = service.apply_deltas(_deltas_from_proto(request))
+        except StaleEpochError as exc:
+            _abort_stale(ctx, exc)
+        return p.ApplyDeltasResponse(nodes=int(out.get("nodes", 0)),
+                                     epoch=out.get("epoch", ""),
+                                     delta_seq=int(out.get("deltaSeq", 0)))
+
+    def schedule_batch(request, ctx):
+        try:
+            out = service.schedule_batch(_batch_from_proto(request))
+        except StaleEpochError as exc:
+            _abort_stale(ctx, exc)
+        resp = _results_to_proto(out)
+        resp.epoch = out.get("epoch", "")
+        resp.delta_seq = int(out.get("deltaSeq", 0))
+        return resp
 
     handlers = grpc.method_handlers_generic_handler(SERVICE, {
         "ApplyDeltas": grpc.unary_unary_rpc_method_handler(
@@ -212,12 +248,23 @@ def serve_grpc(service, port: int = 0):
 
 
 class GrpcClient:
-    """Drop-in for service.WireClient over gRPC: same dict payloads."""
+    """Drop-in for service.WireClient over gRPC: same dict payloads, same
+    error taxonomy and retry policy. gRPC status codes map onto the
+    taxonomy: UNAVAILABLE/DEADLINE_EXCEEDED are transient,
+    FAILED_PRECONDITION is the stale-epoch signal, everything else is
+    permanent (a deterministic server exception re-raises on re-send)."""
 
-    def __init__(self, endpoint: str):
+    _STALE_PREFIX = "stale epoch; current="
+
+    def __init__(self, endpoint: str, read_timeout: float = 60.0,
+                 retry: Optional[RetryPolicy] = None, fault_plan=None):
         import grpc
 
         p = pb2()
+        self.read_timeout = read_timeout
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.fault_plan = fault_plan
+        self._grpc = grpc
         self._channel = grpc.insecure_channel(endpoint)
         self._apply = self._channel.unary_unary(
             f"/{SERVICE}/ApplyDeltas",
@@ -228,13 +275,47 @@ class GrpcClient:
             request_serializer=p.ScheduleBatchRequest.SerializeToString,
             response_deserializer=p.ScheduleBatchResponse.FromString)
 
+    def _call(self, op: str, stub, request):
+        grpc = self._grpc
+
+        def attempt():
+            raise_injected_fault(self.fault_plan, op, self.read_timeout)
+            try:
+                return stub(request, timeout=self.read_timeout)
+            except grpc.RpcError as e:
+                code = e.code()
+                details = e.details() or ""
+                if code == grpc.StatusCode.FAILED_PRECONDITION:
+                    epoch = ""
+                    if self._STALE_PREFIX in details:
+                        epoch = details.split(self._STALE_PREFIX, 1)[1].strip()
+                    raise StaleEpochError(epoch, details) from e
+                if code in (grpc.StatusCode.UNAVAILABLE,
+                            grpc.StatusCode.DEADLINE_EXCEEDED,
+                            grpc.StatusCode.RESOURCE_EXHAUSTED):
+                    raise TransientDeviceError(
+                        f"device service {code.name}: {details}") from e
+                raise PermanentDeviceError(
+                    f"device service {code.name}: {details}") from e
+
+        return self.retry.run(op, attempt)
+
     def apply_deltas(self, payload: dict) -> dict:
-        resp = self._apply(_deltas_to_proto(payload), timeout=120)
-        return {"nodes": resp.nodes}
+        resp = self._call("apply_deltas", self._apply, _deltas_to_proto(payload))
+        out = {"nodes": resp.nodes}
+        if resp.epoch:
+            out["epoch"] = resp.epoch
+            out["deltaSeq"] = resp.delta_seq
+        return out
 
     def schedule_batch(self, payload: dict) -> dict:
-        return _results_from_proto(
-            self._schedule(_batch_to_proto(payload), timeout=120))
+        resp = self._call("schedule_batch", self._schedule,
+                          _batch_to_proto(payload))
+        out = _results_from_proto(resp)
+        if resp.epoch:
+            out["epoch"] = resp.epoch
+            out["deltaSeq"] = resp.delta_seq
+        return out
 
     def close(self) -> None:
         self._channel.close()

@@ -26,10 +26,14 @@ Wire envelope: {"apiVersion": "ktpu/v1", ...}; objects use api/codec.py.
 
 from __future__ import annotations
 
+import http.client
+import itertools
 import json
+import os
+import socket
 import threading
-import urllib.error
-import urllib.request
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from types import SimpleNamespace
 from typing import Dict, List, Optional
@@ -44,10 +48,29 @@ from ..ops.encode import CapacityError
 from ..scheduler.scheduler import Scheduler
 from ..utils import tracing
 from .batch import build_schedule_batch_fn
+from .circuit import CircuitBreaker, OPEN, STATE_VALUES
 from .device_state import DeviceState, caps_for_cluster
+from .errors import (
+    DeviceServiceError,
+    PermanentDeviceError,
+    RetryPolicy,
+    StaleEpochError,
+    TransientDeviceError,
+    raise_injected_fault,
+)
 from .tpu_scheduler import _ATTRIBUTION_ORDER, TPUScheduler
 
 API_VERSION = "ktpu/v1"
+
+# process-epoch minting: unique per DeviceService INSTANCE (a restarted
+# sidecar is a new instance holding a fresh empty DeviceState; the epoch is
+# how the client tells a restart from a healthy peer — etcd's cluster-id /
+# member-id check on reconnect plays the same role)
+_EPOCH_IDS = itertools.count(1)
+
+
+def _new_epoch() -> str:
+    return f"{os.getpid():x}-{next(_EPOCH_IDS)}"
 
 
 class DeviceService:
@@ -57,6 +80,20 @@ class DeviceService:
                  percentage_of_nodes_to_score: int = 0):
         self.batch_size = batch_size
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        # state-resync protocol: the epoch names THIS process incarnation;
+        # delta_seq counts applied delta generations within it. A client
+        # whose expectEpoch disagrees gets a stale-state error instead of
+        # silently having its deltas applied against the wrong (empty) base.
+        self.epoch = _new_epoch()
+        self.delta_seq = 0
+        # idempotency cache: (batchId, response) of the last committed
+        # batch. A transport retry after a LOST RESPONSE (timeout/reset
+        # once the server already committed) replays the cached response
+        # instead of double-committing the pods against capacity their
+        # first copies consumed. One entry suffices: the client is
+        # sequential and only ever retries its most recent batch.
+        self._last_batch: Optional[tuple] = None
+        self.batch_replays = 0
         self.infos: Dict[str, NodeInfo] = {}
         # duck-typed Snapshot: the wire service mirrors nodes wholesale per
         # delta, so every sync is a "structure changed" full walk — the
@@ -72,9 +109,26 @@ class DeviceService:
         self._start_carry = None  # adaptive-sampling rotation (device scalar)
         self._lock = threading.Lock()
 
+    # ------------------------------------------------------------- epoch
+
+    def check_epoch(self, req: dict) -> None:
+        """Refuse a request stamped with another incarnation's epoch: the
+        client's incremental deltas assume a base THIS process never had.
+        A full resync (``full: true``) establishes a new base, so it is
+        exempt — it is exactly the recovery move the error demands."""
+        expect = req.get("expectEpoch")
+        if expect and expect != self.epoch and not req.get("full"):
+            raise StaleEpochError(self.epoch)
+
+    def _stamp(self, out: dict) -> dict:
+        out["epoch"] = self.epoch
+        out["deltaSeq"] = self.delta_seq
+        return out
+
     # ------------------------------------------------------------- deltas
 
     def apply_deltas(self, req: dict) -> dict:
+        self.check_epoch(req)
         # server half of W3C-traceparent propagation: the delta sync parents
         # under the client's scheduling.cycle span (no-op, one global read,
         # when tracing is disabled)
@@ -87,6 +141,7 @@ class DeviceService:
         with self._lock:
             if req.get("full"):
                 self.infos.clear()
+                self.ns_labels.clear()
                 self.device = None
             for e in req.get("nodes", ()):
                 node = from_wire(Node, e["node"])
@@ -102,7 +157,9 @@ class DeviceService:
             for ns, labels in (req.get("namespaces") or {}).items():
                 self.ns_labels[ns] = dict(labels)
             self._sync()
-            return {"apiVersion": API_VERSION, "nodes": len(self.infos)}
+            self.delta_seq += 1
+            return self._stamp({"apiVersion": API_VERSION,
+                                "nodes": len(self.infos)})
 
     def _ensure_device(self) -> None:
         import dataclasses
@@ -155,6 +212,13 @@ class DeviceService:
     # ------------------------------------------------------------- schedule
 
     def schedule_batch(self, req: dict) -> dict:
+        self.check_epoch(req)
+        batch_id = req.get("batchId")
+        with self._lock:
+            if (batch_id and self._last_batch is not None
+                    and self._last_batch[0] == batch_id):
+                self.batch_replays += 1
+                return self._last_batch[1]
         pods = [from_wire(Pod, pw) for pw in req.get("pods", ())]
         tie_seeds = req.get("tieSeeds") or None
         # parent the whole server-side batch under the client's
@@ -163,7 +227,11 @@ class DeviceService:
         with tracing.span_from_remote(req.get("traceparent"),
                                       "device.schedule_batch",
                                       batch=len(pods)):
-            return self._schedule_batch_traced(pods, tie_seeds)
+            out = self._schedule_batch_traced(pods, tie_seeds)
+        if batch_id:
+            with self._lock:
+                self._last_batch = (batch_id, out)
+        return out
 
     def _schedule_batch_traced(self, pods: List[Pod], tie_seeds) -> dict:
         with self._lock:
@@ -278,82 +346,194 @@ class DeviceService:
                         # still helps (preferred-node fast path)
                         r["preempt"] = {"candidates": None, "best": best_name}
                 results.append(r)
-        return {"apiVersion": API_VERSION, "results": results}
+        return self._stamp({"apiVersion": API_VERSION, "results": results})
 
 
 # ---------------------------------------------------------------- transport
 
 
+class ServiceBinding:
+    """Mutable service slot behind a running server: the handler dispatches
+    through it, so a crash-and-restart fault (or an operator restart) can
+    swap in a FRESH DeviceService — new epoch, empty DeviceState — without
+    tearing down the listener, exactly like a sidecar process restart
+    behind a stable Service IP."""
+
+    def __init__(self, service: DeviceService, fault_plan=None):
+        self.service = service
+        self.fault_plan = fault_plan
+        self.restarts = 0
+
+    def restart(self) -> DeviceService:
+        old = self.service
+        self.service = DeviceService(
+            batch_size=old.batch_size,
+            percentage_of_nodes_to_score=old.percentage_of_nodes_to_score)
+        self.restarts += 1
+        return self.service
+
+
+_OPS = {"/v1/applyDeltas": "apply_deltas", "/v1/scheduleBatch": "schedule_batch"}
+
+
 class _Handler(BaseHTTPRequestHandler):
-    service: DeviceService = None  # set by serve()
+    binding: ServiceBinding = None  # set by serve()
 
     def log_message(self, *args):  # quiet
         pass
 
-    def do_POST(self):  # noqa: N802 — stdlib naming
-        n = int(self.headers.get("Content-Length", 0))
-        body = json.loads(self.rfile.read(n) or b"{}")
-        try:
-            if self.path == "/v1/applyDeltas":
-                out = self.service.apply_deltas(body)
-            elif self.path == "/v1/scheduleBatch":
-                out = self.service.schedule_batch(body)
-            else:
-                self.send_error(404)
-                return
-        except Exception as exc:  # noqa: BLE001 — wire errors must be JSON
-            payload = json.dumps({"error": f"{type(exc).__name__}: {exc}"}).encode()
-            self.send_response(500)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(payload)))
-            self.end_headers()
-            self.wfile.write(payload)
-            return
+    def _json(self, code: int, out: dict) -> None:
         payload = json.dumps(out).encode()
-        self.send_response(200)
+        self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(payload)))
         self.end_headers()
         self.wfile.write(payload)
 
+    def do_POST(self):  # noqa: N802 — stdlib naming
+        op = _OPS.get(self.path)
+        if op is None:
+            self.send_error(404)
+            return
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n) or b"{}")
+        plan = self.binding.fault_plan
+        fault = plan.next_server(op) if plan is not None else None
+        if fault is not None:
+            if fault.kind == "crash":
+                # the sidecar dies mid-request and supervision restarts it:
+                # swap in a fresh service (new epoch, empty state) and sever
+                # the connection — the client sees a reset, not a response
+                self.binding.restart()
+                self.close_connection = True
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return
+            self._json(fault.status,
+                       {"error": f"injected fault: {fault.kind}"})
+            return
+        try:
+            out = getattr(self.binding.service, op)(body)
+        except StaleEpochError as exc:
+            # 409: the client must full-resync (distinct from 5xx so the
+            # retry loop does not burn its budget re-sending stale deltas)
+            self._json(409, {"error": str(exc), "staleEpoch": True,
+                             "epoch": exc.epoch})
+            return
+        except Exception as exc:  # noqa: BLE001 — wire errors must be JSON
+            self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._json(200, out)
 
-def serve(service: DeviceService, port: int = 0):
+
+def serve(service: DeviceService, port: int = 0, fault_plan=None):
     """Start the HTTP binding on localhost; returns (server, port). The
-    caller owns shutdown (server.shutdown())."""
-    handler = type("BoundHandler", (_Handler,), {"service": service})
+    caller owns shutdown (server.shutdown()). ``server.binding`` exposes
+    the live service slot (restartable; chaos tests script crashes through
+    ``fault_plan``, a testing.faults.FaultPlan)."""
+    binding = ServiceBinding(service, fault_plan=fault_plan)
+    handler = type("BoundHandler", (_Handler,), {"binding": binding})
     server = ThreadingHTTPServer(("127.0.0.1", port), handler)
+    server.binding = binding
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server, server.server_address[1]
 
 
 class WireClient:
-    def __init__(self, endpoint: str):
-        self.endpoint = endpoint.rstrip("/")
+    """HTTP/JSON transport with the full fault story: split connect/read
+    deadlines (a hung accept and a slow batch are different failures), the
+    typed error taxonomy (backend/errors.py), and retry-with-backoff for
+    transient failures inside the RetryPolicy's per-call deadline budget.
+    ``fault_plan`` intercepts calls before the socket for deterministic
+    chaos tests."""
 
-    def _post(self, path: str, payload: dict) -> dict:
-        req = urllib.request.Request(
-            self.endpoint + path, data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"})
+    def __init__(self, endpoint: str, connect_timeout: float = 5.0,
+                 read_timeout: float = 60.0, retry: Optional[RetryPolicy] = None,
+                 fault_plan=None):
+        self.endpoint = endpoint.rstrip("/")
+        u = urllib.parse.urlsplit(self.endpoint)
+        scheme = u.scheme or "http"
+        if scheme not in ("http", "https") or not u.netloc:
+            # a scheme-less endpoint ('127.0.0.1:5000', the gRPC form)
+            # would silently parse as a PATH and hit port 80 forever —
+            # loud error now beats permanent breaker-open later
+            raise ValueError(
+                f"device-service endpoint must be http(s)://host:port, "
+                f"got {endpoint!r}")
+        self._conn_cls = (http.client.HTTPSConnection if scheme == "https"
+                          else http.client.HTTPConnection)
+        self._host = u.hostname or "127.0.0.1"
+        self._port = u.port or (443 if scheme == "https" else 80)
+        self._base_path = u.path.rstrip("/")
+        self.connect_timeout = connect_timeout
+        self.read_timeout = read_timeout
+        self.retry = retry if retry is not None else RetryPolicy()
+        self.fault_plan = fault_plan
+
+    def _do_post(self, path: str, data: bytes) -> dict:
+        conn = self._conn_cls(self._host, self._port,
+                              timeout=self.connect_timeout)
         try:
-            with urllib.request.urlopen(req, timeout=120) as resp:
-                out = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            # surface the handler's JSON diagnostic, not the bare status line
             try:
-                detail = json.loads(e.read()).get("error", "")
-            except Exception:  # noqa: BLE001
-                detail = ""
-            raise RuntimeError(f"device service {e.code}: {detail}") from e
+                conn.connect()
+                # connected: the remaining budget is the READ deadline
+                conn.sock.settimeout(self.read_timeout)
+                conn.request("POST", self._base_path + path, body=data,
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                status = resp.status
+                body = resp.read()
+            except (ConnectionError, http.client.HTTPException, socket.timeout,
+                    TimeoutError, OSError) as e:
+                # refused/reset/timeout/torn response: the transient family
+                raise TransientDeviceError(
+                    f"device service unreachable: {type(e).__name__}: {e}") from e
+        finally:
+            conn.close()
+        try:
+            out = json.loads(body or b"{}")
+        except ValueError as e:
+            # classify by status first: a torn/HTML body on an
+            # infrastructure 5xx is still the transient family
+            if status in (502, 503, 504):
+                raise TransientDeviceError(
+                    f"device service {status}: non-JSON body") from e
+            raise PermanentDeviceError(f"malformed device response: {e}") from e
+        if status == 409 and out.get("staleEpoch"):
+            raise StaleEpochError(out.get("epoch", ""), out.get("error", ""))
+        if status in (502, 503, 504):
+            # infrastructure-flavored 5xx (overload, proxy, restart in
+            # progress) MAY clear: give the retry loop a chance before the
+            # breaker counts it
+            raise TransientDeviceError(
+                f"device service {status}: {out.get('error', '')}")
+        if status >= 400:
+            # includes 500: the handler answers it only for a service-side
+            # exception, which is deterministic — re-sending the identical
+            # batch re-raises it (matches gRPC's UNKNOWN → permanent)
+            raise PermanentDeviceError(
+                f"device service {status}: {out.get('error', '')}")
         if "error" in out:
-            raise RuntimeError(out["error"])
+            raise PermanentDeviceError(out["error"])
         return out
 
+    def _post(self, path: str, payload: dict, op: str) -> dict:
+        data = json.dumps(payload).encode()
+
+        def attempt():
+            raise_injected_fault(self.fault_plan, op, self.read_timeout)
+            return self._do_post(path, data)
+
+        return self.retry.run(op, attempt)
+
     def apply_deltas(self, payload: dict) -> dict:
-        return self._post("/v1/applyDeltas", payload)
+        return self._post("/v1/applyDeltas", payload, "apply_deltas")
 
     def schedule_batch(self, payload: dict) -> dict:
-        return self._post("/v1/scheduleBatch", payload)
+        return self._post("/v1/scheduleBatch", payload, "schedule_batch")
 
 
 # ---------------------------------------------------------------- scheduler
@@ -365,19 +545,74 @@ class WireScheduler(Scheduler):
     TPUScheduler (queue order, assume/bind, failure handling + backoff)."""
 
     def __init__(self, *args, endpoint: str, batch_size: int = 256,
-                 transport: str = "http", **kwargs):
+                 transport: str = "http",
+                 connect_timeout: float = 5.0, read_timeout: float = 60.0,
+                 wire_max_retries: int = 3, wire_backoff_base: float = 0.05,
+                 wire_backoff_max: float = 2.0, wire_deadline_s: float = 90.0,
+                 breaker_threshold: int = 3, breaker_reset_s: float = 5.0,
+                 fault_plan=None, sleep_fn=None, **kwargs):
         super().__init__(*args, **kwargs)
+        self.retry_policy = RetryPolicy(
+            max_retries=wire_max_retries, backoff_base=wire_backoff_base,
+            backoff_max=wire_backoff_max, deadline_s=wire_deadline_s,
+            sleep_fn=sleep_fn if sleep_fn is not None else time.sleep,
+            now_fn=self.now_fn,
+            on_retry=lambda op: self.smetrics.wire_retries.inc(op))
         if transport == "grpc":
             from .grpc_service import GrpcClient
 
-            self.client = GrpcClient(endpoint)
+            self.client = GrpcClient(endpoint, read_timeout=read_timeout,
+                                     retry=self.retry_policy,
+                                     fault_plan=fault_plan)
         else:
-            self.client = WireClient(endpoint)
+            self.client = WireClient(endpoint, connect_timeout=connect_timeout,
+                                     read_timeout=read_timeout,
+                                     retry=self.retry_policy,
+                                     fault_plan=fault_plan)
         self.batch_size = batch_size
+        # circuit breaker + oracle degradation: N consecutive transport
+        # failures open the breaker and every pod takes the sequential
+        # oracle path until a half-open probe heals the wire (scheduling
+        # never stops with a dead sidecar)
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            reset_timeout_s=breaker_reset_s, now_fn=self.now_fn,
+            on_state_change=self._on_breaker_state)
+        self.smetrics.backend_circuit_state.set(value=0)
+        self._degraded_since: Optional[float] = None
+        self.degraded_pods = 0
+        # state-resync protocol: last epoch the device answered with; a
+        # mismatch (restart) surfaces as StaleEpochError → full resync
+        self._device_epoch: Optional[str] = None
+        self.resyncs = 0
+        # idempotency keys for schedule_batch: one id per LOGICAL batch
+        # (transport retries re-send the same id, so a server that already
+        # committed replays its response instead of double-committing)
+        self._batch_id_prefix = _new_epoch()
+        self._batch_ids = itertools.count(1)
         self._sent_gens: Dict[str, int] = {}
         self._sent_ns: Dict[str, dict] = {}
         self._batchable_cache: Dict[str, bool] = {}
         self.settle_abandoned = False
+
+    # ------------------------------------------------------- degraded mode
+
+    def _on_breaker_state(self, old: str, new: str) -> None:
+        self.smetrics.backend_circuit_state.set(value=STATE_VALUES[new])
+        now = self.now_fn()
+        if new == "open" and self._degraded_since is None:
+            self._degraded_since = now
+        elif new == "closed" and self._degraded_since is not None:
+            self.smetrics.degraded_seconds.inc(value=now - self._degraded_since)
+            self._degraded_since = None
+
+    def _accrue_degraded(self) -> None:
+        """Fold elapsed degraded time into the counter incrementally so a
+        long-open breaker is visible before it heals."""
+        if self._degraded_since is not None:
+            now = self.now_fn()
+            self.smetrics.degraded_seconds.inc(value=now - self._degraded_since)
+            self._degraded_since = now
 
     def _wire_supported(self, pod: Pod) -> bool:
         """Same gating as TPUScheduler.batch_supported: the service runs the
@@ -399,35 +634,90 @@ class WireScheduler(Scheduler):
             self._batchable_cache[fwk.profile_name] = cached
         return cached
 
-    def _push_deltas(self) -> None:
-        self.cache.update_snapshot(self.snapshot)
-        entries = []
-        current = self.snapshot.node_info_map
-        removed = [n for n in self._sent_gens if n not in current]
-        for name, ni in current.items():
-            if self._sent_gens.get(name) == ni.generation or ni.node is None:
+    def _build_entries(self, skip_unsent_check: bool = False):
+        """(entries, pending_gens) over the current snapshot — the one wire
+        shape for per-node deltas, shared by the incremental push and the
+        full resync so the two payloads can never drift apart."""
+        entries: List[dict] = []
+        pending_gens: Dict[str, int] = {}
+        for name, ni in self.snapshot.node_info_map.items():
+            if ni.node is None:
+                continue
+            if not skip_unsent_check and self._sent_gens.get(name) == ni.generation:
                 continue
             entries.append({
                 "gen": ni.generation,
                 "node": to_wire(ni.node),
                 "pods": [to_wire(p) for p in ni.pods],
             })
-            self._sent_gens[name] = ni.generation
-        for n in removed:
-            del self._sent_gens[n]
+            pending_gens[name] = ni.generation
+        return entries, pending_gens
+
+    def _push_deltas(self) -> None:
+        """Incremental state sync. Bookkeeping (_sent_gens/_sent_ns) commits
+        only AFTER the wire call succeeds: a failed push must leave the rows
+        marked unsent, or the retry after recovery would skip them and the
+        device mirror would silently diverge from host truth."""
+        self.cache.update_snapshot(self.snapshot)
+        current = self.snapshot.node_info_map
+        removed = [n for n in self._sent_gens if n not in current]
+        entries, pending_gens = self._build_entries()
         namespaces = {}
         for ns, obj in self.store.namespaces.items():
             labels = dict(obj.meta.labels)
             if self._sent_ns.get(ns) != labels:
                 namespaces[ns] = labels
-                self._sent_ns[ns] = labels
-        if entries or removed or namespaces:
-            payload = {"apiVersion": API_VERSION, "nodes": entries,
-                       "removed": removed, "namespaces": namespaces}
-            tp = tracing.format_traceparent()
-            if tp:
-                payload["traceparent"] = tp
-            self.client.apply_deltas(payload)
+        if not (entries or removed or namespaces):
+            return
+        payload = {"apiVersion": API_VERSION, "nodes": entries,
+                   "removed": removed, "namespaces": namespaces}
+        if self._device_epoch:
+            payload["expectEpoch"] = self._device_epoch
+        else:
+            # epoch unknown = WE are the fresh process (client restart): a
+            # surviving device may hold a mirror from our predecessor —
+            # ghost nodes we cannot name in `removed` (_sent_gens is empty).
+            # The first contact is therefore a FULL sync, establishing a
+            # clean base exactly like the informer relist on startup.
+            payload["full"] = True
+        tp = tracing.format_traceparent()
+        if tp:
+            payload["traceparent"] = tp
+        try:
+            out = self.client.apply_deltas(payload)
+        except StaleEpochError as exc:
+            # the device restarted under us: its mirror is a fresh empty
+            # state — incremental deltas are meaningless against it
+            self._full_resync(exc.epoch)
+            return
+        self._device_epoch = out.get("epoch", self._device_epoch)
+        self._sent_gens.update(pending_gens)
+        for n in removed:
+            self._sent_gens.pop(n, None)
+        for ns, labels in namespaces.items():
+            self._sent_ns[ns] = labels
+
+    def _full_resync(self, new_epoch: Optional[str] = None) -> None:
+        """Epoch-mismatch recovery: forget everything we believe the device
+        holds and ship the complete host truth as one ``full`` delta (the
+        informer relist of the crash-only contract, pointed at the device)."""
+        self.resyncs += 1
+        self._sent_gens.clear()
+        self._sent_ns.clear()
+        self._device_epoch = new_epoch
+        self.cache.update_snapshot(self.snapshot)
+        entries, pending_gens = self._build_entries(skip_unsent_check=True)
+        namespaces = {ns: dict(obj.meta.labels)
+                      for ns, obj in self.store.namespaces.items()}
+        payload = {"apiVersion": API_VERSION, "full": True, "nodes": entries,
+                   "removed": [], "namespaces": namespaces}
+        tp = tracing.format_traceparent()
+        if tp:
+            payload["traceparent"] = tp
+        out = self.client.apply_deltas(payload)
+        self._device_epoch = out.get("epoch", new_epoch)
+        self._sent_gens.update(pending_gens)
+        self._sent_ns.update(namespaces)
 
     def schedule_batch_cycle(self) -> int:
         self._periodic_housekeeping()
@@ -465,16 +755,90 @@ class WireScheduler(Scheduler):
             self._flush_wire_traced(batch, pod_cycle, t0)
 
     def _flush_wire_traced(self, batch: List[QueuedPodInfo], pod_cycle: int, t0: float) -> None:
-        self._push_deltas()
+        if not self.breaker.allow():
+            # breaker open: the device is presumed down — route the whole
+            # batch through the sequential oracle path (scheduling never
+            # stops); the next allow() past the reset timeout probes
+            self._accrue_degraded()
+            self._schedule_degraded(batch, pod_cycle)
+            return
+        try:
+            self._push_deltas()
+            res = self._wire_schedule_batch(batch)
+        except DeviceServiceError as exc:
+            # deliberately counts PERMANENT errors too: a deterministically
+            # broken device (version skew answering 4xx forever) should open
+            # the breaker and degrade to the oracle — the alternative is an
+            # endless requeue→fail loop with zero wire throughput. The
+            # breaker's lastError (/debug/circuit) keeps the bug visible.
+            self.breaker.record_failure(exc)
+            if self.breaker.state == OPEN:
+                # threshold crossed (or a failed half-open probe): degrade
+                # THIS batch immediately rather than bouncing it off backoff
+                self._accrue_degraded()
+                self._schedule_degraded(batch, pod_cycle)
+            else:
+                # breaker still counting: rate-limited requeue — the pods
+                # re-enter via the backoff queue with their attempt counts,
+                # never hot-looping the active queue
+                self._requeue_wire_failure(batch, exc, pod_cycle, t0)
+            return
+        self.breaker.record_success()
+        self._process_wire_results(batch, res, pod_cycle, t0)
+
+    def _wire_schedule_batch(self, batch: List[QueuedPodInfo]) -> dict:
         from ..ops.tiebreak import seeds_for
 
         payload = {"apiVersion": API_VERSION,
                    "pods": [to_wire(qp.pod) for qp in batch],
-                   "tieSeeds": [int(s) for s in seeds_for(batch)]}
+                   "tieSeeds": [int(s) for s in seeds_for(batch)],
+                   "batchId": f"{self._batch_id_prefix}-{next(self._batch_ids)}"}
         tp = tracing.format_traceparent()
         if tp:
             payload["traceparent"] = tp
-        res = self.client.schedule_batch(payload)
+        if self._device_epoch:
+            payload["expectEpoch"] = self._device_epoch
+        # device restarted between the delta push and this batch (or again
+        # mid-recovery — a crash-looping sidecar): each stale answer costs
+        # one cheap full resync, bounded so a restart storm falls through to
+        # the breaker instead of spinning here
+        stale_retries = 0
+        while True:
+            try:
+                res = self.client.schedule_batch(payload)
+                break
+            except StaleEpochError as exc:
+                stale_retries += 1
+                if stale_retries > 2:
+                    raise
+                self._full_resync(exc.epoch)
+                if self._device_epoch:
+                    payload["expectEpoch"] = self._device_epoch
+                else:
+                    payload.pop("expectEpoch", None)
+        self._device_epoch = res.get("epoch", self._device_epoch)
+        return res
+
+    def _schedule_degraded(self, batch: List[QueuedPodInfo], pod_cycle: int) -> None:
+        self.degraded_pods += len(batch)
+        self.cache.update_snapshot(self.snapshot)
+        for qp in batch:
+            self.schedule_one_pod(qp, pod_cycle)
+
+    def _requeue_wire_failure(self, batch: List[QueuedPodInfo],
+                              exc: Exception, pod_cycle: int, t0: float) -> None:
+        for qp in batch:
+            fwk = self.framework_for_pod(qp.pod)
+            self.metrics["schedule_attempts"] += 1
+            self.metrics["errors"] += 1
+            self.smetrics.observe_attempt(
+                "error", fwk.profile_name, self.now_fn() - t0)
+            self._handle_scheduling_failure(
+                fwk, self._new_cycle_state(), qp,
+                Status.error(f"device service: {exc}"), Diagnosis(), pod_cycle)
+
+    def _process_wire_results(self, batch: List[QueuedPodInfo], res: dict,
+                              pod_cycle: int, t0: float) -> None:
         # hint-screen scaffolding, shared by every failed pod in the batch
         hint_names = hint_slot_of = None
         for qp, r in zip(batch, res["results"]):
@@ -482,6 +846,19 @@ class WireScheduler(Scheduler):
             self.metrics["schedule_attempts"] += 1
             node_name = r.get("nodeName")
             if node_name:
+                if self.snapshot.get(node_name) is None:
+                    # ghost placement: the device named a node the host no
+                    # longer knows (a desync window the resync protocol
+                    # hasn't closed yet) — error-requeue the pod instead of
+                    # binding it to a nonexistent node
+                    self.metrics["errors"] += 1
+                    self.smetrics.observe_attempt(
+                        "error", fwk.profile_name, self.now_fn() - t0)
+                    self._handle_scheduling_failure(
+                        fwk, self._new_cycle_state(), qp,
+                        Status.error(f"device placed pod on unknown node "
+                                     f"{node_name}"), Diagnosis(), pod_cycle)
+                    continue
                 self.assume_and_bind(fwk, self._new_cycle_state(), qp, qp.pod,
                                      node_name, pod_cycle, t0=t0)
             else:
@@ -526,3 +903,20 @@ class WireScheduler(Scheduler):
         return self.run_batched_until_settled(
             max_cycles=max_cycles, flush=flush, idle_wait=idle_wait,
             max_no_progress=max_no_progress)
+
+    def debug_circuit(self) -> dict:
+        """/debug/circuit body: breaker state + resync/degradation story."""
+        out = self.breaker.dump()
+        out.update({
+            "enabled": True,
+            "deviceEpoch": self._device_epoch,
+            "resyncs": self.resyncs,
+            "degradedPods": self.degraded_pods,
+            "retryPolicy": {
+                "maxRetries": self.retry_policy.max_retries,
+                "backoffBase": self.retry_policy.backoff_base,
+                "backoffMax": self.retry_policy.backoff_max,
+                "deadlineS": self.retry_policy.deadline_s,
+            },
+        })
+        return out

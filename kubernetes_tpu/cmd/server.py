@@ -111,6 +111,8 @@ def build_debug_handlers(sched) -> dict:
       /debug/devicestate  DeviceState capacities, sig-table occupancy,
                           batch-sizer model (TPU/batched schedulers only)
       /debug/spans        tail of the in-memory span exporter
+      /debug/circuit      device-service circuit breaker state, resync and
+                          degradation counters (WireScheduler only)
     """
     from ..cache.debugger import CacheComparer
     from ..utils import tracing
@@ -160,8 +162,14 @@ def build_debug_handlers(sched) -> dict:
     def spans_dump():
         return [s.to_otlp() for s in tracing.tail(256)]
 
+    def circuit_dump():
+        if not hasattr(sched, "debug_circuit"):
+            return {"enabled": False}
+        return sched.debug_circuit()
+
     return {"queue": queue_dump, "cache": cache_dump,
-            "devicestate": device_dump, "spans": spans_dump}
+            "devicestate": device_dump, "spans": spans_dump,
+            "circuit": circuit_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,

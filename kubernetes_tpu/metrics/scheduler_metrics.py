@@ -99,6 +99,23 @@ class SchedulerMetrics:
             "ResourceClaim allocation outcomes by result.",
             ["result"],
         ))
+        # fault-tolerant wire path (backend/service.py): transport retries,
+        # breaker state (0 closed, 1 half-open, 2 open), and cumulative time
+        # spent scheduling through the sequential oracle because the device
+        # service was unavailable
+        self.wire_retries = r.register(Counter(
+            "scheduler_wire_retries_total",
+            "Device-service transport retries by operation.",
+            ["op"],
+        ))
+        self.backend_circuit_state = r.register(Gauge(
+            "scheduler_backend_circuit_state",
+            "Device-service circuit breaker state (0 closed, 1 half-open, 2 open).",
+        ))
+        self.degraded_seconds = r.register(Counter(
+            "scheduler_degraded_seconds_total",
+            "Seconds spent in breaker-open degraded (oracle fallback) mode.",
+        ))
 
         # unschedulable_pods bookkeeping: gauge value = number of pods
         # CURRENTLY unschedulable attributed to each (plugin, profile); a

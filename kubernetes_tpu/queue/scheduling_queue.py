@@ -174,14 +174,22 @@ class SchedulingQueue:
             self._sync_gauges()
         return out
 
-    def add_unschedulable_if_not_present(self, qp: QueuedPodInfo, pod_scheduling_cycle: int) -> None:
+    def add_unschedulable_if_not_present(self, qp: QueuedPodInfo, pod_scheduling_cycle: int,
+                                         error: bool = False) -> None:
         """Failed pod → unschedulable map, or backoffQ if a move request
-        raced with its cycle (:393 AddUnschedulableIfNotPresent)."""
+        raced with its cycle (:393 AddUnschedulableIfNotPresent).
+
+        ``error=True`` marks a pod rejected by a cycle ERROR (device batch
+        failure, bind error) rather than an unschedulable verdict: no
+        ClusterEvent will ever reactivate it (it failed no plugin), so it
+        re-enters via the backoffQ — the reference's rate-limited error
+        requeue (attempts already incremented at pop, so the backoff grows
+        1s→10s instead of hot-looping the active queue)."""
         key = qp.pod.key()
         if key in self._in_queue or key in self._unschedulable:
             return
         qp.timestamp = self.now_fn()
-        if self.move_request_cycle >= pod_scheduling_cycle:
+        if error or self.move_request_cycle >= pod_scheduling_cycle:
             self._push_backoff(qp, event="ScheduleAttemptFailure")
         else:
             self._unschedulable[key] = qp

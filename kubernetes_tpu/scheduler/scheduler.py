@@ -600,7 +600,11 @@ class Scheduler:
             return
         qp.pod = current
         qp.unschedulable_plugins = set(diagnosis.unschedulable_plugins)
-        self.queue.add_unschedulable_if_not_present(qp, pod_cycle)
+        # error-status pods (device batch failure, bind error) take the
+        # rate-limited backoff requeue — no plugin failed, so no ClusterEvent
+        # would ever wake them from the unschedulable map
+        self.queue.add_unschedulable_if_not_present(
+            qp, pod_cycle, error=not status.is_unschedulable())
 
     # ----------------------------------------------------------- driving
 

@@ -2,6 +2,7 @@
 kubernetes/fake/clientset_generated.go + testing/fixture.go).
 """
 
+from .faults import Fault, FaultPlan
 from .reactors import ReactionError, with_reactors
 
-__all__ = ["ReactionError", "with_reactors"]
+__all__ = ["Fault", "FaultPlan", "ReactionError", "with_reactors"]

@@ -1,0 +1,99 @@
+"""Scripted device-fault injection (the chaosmonkey Do/Setup analog for
+the device-service seam, test/e2e/chaosmonkey/chaosmonkey.go).
+
+A ``FaultPlan`` is a deterministic script of transport/service failures
+consumed in order, wired into two interception points:
+
+  * client side (``WireClient``/``GrpcClient``): a fault fires BEFORE the
+    request touches the network — ``drop`` raises the same transient error
+    a refused connection would, ``delay`` raises the read-timeout error a
+    slow service would (no wall-clock sleep: the injected latency is
+    compared against the client's read deadline), ``error`` raises a
+    transient error N times (error-once / error-N).
+  * server side (``serve``'s handler): ``error`` answers 503 (transient on
+    the client's taxonomy), ``crash`` replaces the served DeviceService
+    with a FRESH instance — new process epoch, empty DeviceState — and
+    severs the connection without a response, exactly what a sidecar
+    segfault+restart looks like from the client.
+
+Every consumed fault is appended to ``log`` so tests assert the script
+actually fired. Thread-safe: handler threads and the scheduling thread
+consume concurrently.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+APPLY_DELTAS = "apply_deltas"
+SCHEDULE_BATCH = "schedule_batch"
+ANY = "*"
+
+CLIENT = "client"
+SERVER = "server"
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str            # "error" | "delay" | "drop" | "crash"
+    count: int = 1       # calls this fault applies to before expiring
+    seconds: float = 0.0  # injected latency ("delay" only)
+    status: int = 503    # HTTP status for server-side "error"
+
+
+class FaultPlan:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (side, op) -> FIFO of pending faults; ANY matches either op
+        self._faults: Dict[Tuple[str, str], List[Fault]] = {}
+        self.log: List[Tuple[str, str, str]] = []  # (side, op, kind)
+
+    # ------------------------------------------------------------ authoring
+
+    def inject(self, op: str, fault: Fault, side: str = CLIENT) -> "FaultPlan":
+        with self._lock:
+            self._faults.setdefault((side, op), []).append(fault)
+        return self
+
+    def error_once(self, op: str = ANY, side: str = CLIENT) -> "FaultPlan":
+        return self.inject(op, Fault("error"), side=side)
+
+    def error_n(self, n: int, op: str = ANY, side: str = CLIENT) -> "FaultPlan":
+        return self.inject(op, Fault("error", count=n), side=side)
+
+    def delay(self, seconds: float, op: str = ANY, count: int = 1) -> "FaultPlan":
+        return self.inject(op, Fault("delay", count=count, seconds=seconds))
+
+    def drop(self, op: str = ANY, count: int = 1) -> "FaultPlan":
+        return self.inject(op, Fault("drop", count=count))
+
+    def crash(self, op: str = ANY) -> "FaultPlan":
+        return self.inject(op, Fault("crash"), side=SERVER)
+
+    # ------------------------------------------------------------ consuming
+
+    def _take(self, side: str, op: str) -> Optional[Fault]:
+        with self._lock:
+            for key in ((side, op), (side, ANY)):
+                queue = self._faults.get(key)
+                if not queue:
+                    continue
+                fault = queue[0]
+                fault.count -= 1
+                if fault.count <= 0:
+                    queue.pop(0)
+                self.log.append((side, op, fault.kind))
+                return fault
+            return None
+
+    def next_client(self, op: str) -> Optional[Fault]:
+        return self._take(CLIENT, op)
+
+    def next_server(self, op: str) -> Optional[Fault]:
+        return self._take(SERVER, op)
+
+    def pending(self) -> int:
+        with self._lock:
+            return sum(f.count for q in self._faults.values() for f in q)

@@ -1,16 +1,22 @@
 """Chaos & recovery (test/e2e/chaosmonkey + SURVEY §5.3 build mapping):
 disruption injected concurrently with scheduling; crash-only recovery —
-a restarted scheduler/device rebuilds from the store and continues.
+a restarted scheduler/device rebuilds from the store and continues. The
+device-failure suite (TestDeviceServiceFaults) scripts sidecar crashes,
+drops, and restarts through testing/faults.py — deterministic, no sleeps
+against the wall clock.
 """
 
 import numpy as np
 
 from kubernetes_tpu.api.wrappers import make_node, make_pod
 from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.backend import circuit
+from kubernetes_tpu.backend.service import DeviceService, WireScheduler, serve
 from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
 from kubernetes_tpu.client.informer import SharedInformerFactory
 from kubernetes_tpu.controllers import ControllerManager
 from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing.faults import FaultPlan
 from kubernetes_tpu.utils.clock import FakeClock
 
 
@@ -102,6 +108,29 @@ class TestCrashOnlyRecovery:
             per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
         assert all(v <= 30 for v in per_node.values())
 
+    def test_wal_torn_tail_recovery_is_chaos_safe(self, tmp_path):
+        """Process dies mid-append: the WAL's last record is torn. Restore
+        must recover the clean prefix and scheduling must resume (the
+        crash-only contract extended to the log itself)."""
+        from kubernetes_tpu.apiserver.wal import attach_wal, restore
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        attach_wal(store, path)
+        _cluster(store, 4)
+        for i in range(6):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        # tear the tail: the crash truncated the final record mid-line
+        with open(path, "rb+") as f:
+            f.seek(-17, 2)
+            f.truncate()
+        restored = restore(path)
+        assert set(restored.nodes) == {"n0", "n1", "n2", "n3"}
+        assert len(restored.pods) == 5  # the torn record's pod is lost, rest live
+        sched = Scheduler(restored)
+        sched.run_until_settled()
+        assert all(p.spec.node_name for p in restored.pods.values())
+
     def test_assumed_pods_expire_after_ttl(self):
         """Assume-TTL sweep (cache.go:731): an assume never confirmed by a
         bind event expires and the node's resources free up."""
@@ -118,3 +147,208 @@ class TestCrashOnlyRecovery:
         assert [p.meta.name for p in expired] == ["ghost"]
         ni = sched.cache.nodes["n1"]
         assert ni.requested.milli_cpu == 0
+
+
+def _bound(store):
+    return {p.meta.name: p.spec.node_name
+            for p in store.pods.values() if p.spec.node_name}
+
+
+class _WireRig:
+    """A WireScheduler + restartable served DeviceService on an injected
+    clock: retry sleeps advance the FakeClock, never the wall clock."""
+
+    def __init__(self, fault_plan=None, nodes=4, **sched_kw):
+        self.plan = fault_plan
+        self.service = DeviceService(batch_size=32)
+        self.server, port = serve(self.service, fault_plan=fault_plan)
+        self.store = ClusterStore()
+        self.clock = FakeClock()
+        self.sleeps = []
+
+        def sleep(s):
+            self.sleeps.append(s)
+            self.clock.advance(s)
+
+        sched_kw.setdefault("batch_size", 8)
+        sched_kw.setdefault("wire_max_retries", 1)
+        self.sched = WireScheduler(
+            self.store, endpoint=f"http://127.0.0.1:{port}",
+            now_fn=self.clock, sleep_fn=sleep, fault_plan=fault_plan,
+            **sched_kw)
+        for i in range(nodes):
+            self.store.create_node(
+                make_node(f"n{i}").capacity(
+                    {"cpu": "4", "memory": "8Gi", "pods": 10})
+                .label("zone", f"z{i % 2}").obj())
+
+    def close(self):
+        self.server.shutdown()
+
+
+class TestDeviceServiceFaults:
+    """The device-failure acceptance suite: sidecar killed mid-batch,
+    restart + epoch resync, breaker-open oracle degradation and heal."""
+
+    def test_crash_mid_batch_no_pod_lost_or_double_bound(self):
+        """The service dies while a batch is on the wire: the retry hits
+        the restarted (empty, new-epoch) service, the stale-epoch error
+        forces a full resync, and the batch lands — every pod bound exactly
+        once, none lost, capacity respected."""
+        plan = FaultPlan().crash("schedule_batch")
+        rig = _WireRig(fault_plan=plan)
+        try:
+            for i in range(12):
+                rig.store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+            rig.sched.run_until_settled()
+            bound = _bound(rig.store)
+            assert len(bound) == 12                      # zero lost
+            assert rig.server.binding.restarts == 1      # the crash fired
+            assert rig.sched.resyncs == 1                # epoch-detected
+            assert rig.sched.breaker.state == circuit.CLOSED
+            per_node = {}
+            for n in bound.values():
+                per_node[n] = per_node.get(n, 0) + 1
+            # zero double-bound: occupancy within capacity on the resynced
+            # base (a double-commit would overshoot 4 cpu / 1 cpu each)
+            assert all(v <= 4 for v in per_node.values()), per_node
+            assert ("server", "schedule_batch", "crash") in plan.log
+        finally:
+            rig.close()
+
+    def test_restart_resyncs_to_identical_placements(self):
+        """A restarted device service is detected via epoch mismatch and
+        fully resynced: placements are byte-identical to an uncrashed run
+        AND to the sequential oracle, with zero permanent fallback (the
+        batched wire path resumes)."""
+        def workload(store):
+            for i in range(6):
+                store.create_pod(
+                    make_pod(f"a{i}").req({"cpu": "500m", "memory": "1Gi"}).obj())
+
+        def workload2(store):
+            for i in range(6):
+                store.create_pod(
+                    make_pod(f"b{i}").req({"cpu": "700m", "memory": "1Gi"}).obj())
+
+        # run A: healthy service end to end
+        rig_a = _WireRig()
+        try:
+            workload(rig_a.store)
+            rig_a.sched.run_until_settled()
+            workload2(rig_a.store)
+            rig_a.sched.run_until_settled()
+            bound_a = _bound(rig_a.store)
+        finally:
+            rig_a.close()
+
+        # run B: the service crashes (and restarts empty) between the waves
+        plan = FaultPlan()
+        rig_b = _WireRig(fault_plan=plan)
+        try:
+            workload(rig_b.store)
+            rig_b.sched.run_until_settled()
+            epoch_before = rig_b.sched._device_epoch
+            plan.crash("apply_deltas")  # the sidecar dies between the waves
+            workload2(rig_b.store)
+            rig_b.sched.run_until_settled()
+            bound_b = _bound(rig_b.store)
+            assert rig_b.server.binding.restarts == 1
+            assert rig_b.sched.resyncs == 1
+            assert rig_b.sched._device_epoch != epoch_before
+            assert rig_b.sched._device_epoch == rig_b.server.binding.service.epoch
+            # zero permanent fallback: nothing went through the degraded
+            # oracle path and the breaker never opened
+            assert rig_b.sched.degraded_pods == 0
+            assert rig_b.sched.breaker.state == circuit.CLOSED
+            assert rig_b.server.binding.service.batch_counter > 0
+        finally:
+            rig_b.close()
+        assert bound_b == bound_a  # byte-identical across the crash
+
+        # oracle-identical: the same workload through the sequential path
+        store_o = ClusterStore()
+        for i in range(4):
+            store_o.create_node(
+                make_node(f"n{i}").capacity(
+                    {"cpu": "4", "memory": "8Gi", "pods": 10})
+                .label("zone", f"z{i % 2}").obj())
+        sched_o = Scheduler(store_o)
+        workload(store_o)
+        sched_o.run_until_settled()
+        workload2(store_o)
+        sched_o.run_until_settled()
+        assert _bound(store_o) == bound_a
+
+    def test_breaker_opens_degrades_to_oracle_and_heals(self):
+        """A flapping/dead service: transient failures re-enter pods via
+        the backoff queue, the breaker opens after the threshold and every
+        pod schedules through the sequential oracle (throughput never
+        zero), scheduler_degraded_seconds_total grows, and once the
+        service behaves a half-open probe closes the breaker and the
+        batched wire path resumes."""
+        # 6 drops: 2 per wire flush (initial + 1 retry) — flush 1 counts
+        # breaker failure #1, flush 2 opens it, the first probe re-opens it
+        plan = FaultPlan().drop(count=6)
+        rig = _WireRig(fault_plan=plan, breaker_threshold=2, breaker_reset_s=5.0)
+        m = rig.sched.smetrics
+        try:
+            for i in range(6):
+                rig.store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m", "memory": "1Gi"}).obj())
+            # flush 1: transport fails after retry → rate-limited requeue
+            rig.sched.run_until_settled()
+            assert rig.sched.metrics["scheduled"] == 0
+            assert rig.sched.queue.pending_pods()["backoff"] == 6
+            assert m.wire_retries.labels("apply_deltas") > 0
+            assert rig.sched.breaker.state == circuit.CLOSED
+
+            # flush 2 (after backoff): fails again → breaker OPENS → the
+            # batch degrades to the oracle path in the same cycle
+            rig.clock.advance(1.1)
+            rig.sched.run_until_settled()
+            assert rig.sched.breaker.state == circuit.OPEN
+            assert m.backend_circuit_state.labels() == 2
+            assert rig.sched.metrics["scheduled"] == 6   # throughput nonzero
+            assert rig.sched.degraded_pods == 6
+
+            # still open (reset timeout not reached): new pods keep landing
+            # through the oracle; degraded seconds accrue on the fake clock
+            rig.clock.advance(2.0)
+            for i in range(2):
+                rig.store.create_pod(
+                    make_pod(f"q{i}").req({"cpu": "200m"}).obj())
+            rig.sched.run_until_settled()
+            assert rig.sched.metrics["scheduled"] == 8
+            assert rig.sched.degraded_pods == 8
+            assert m.degraded_seconds.labels() > 0
+
+            # first half-open probe: the remaining 2 drops kill it → the
+            # breaker re-opens, the probe batch still lands via the oracle
+            rig.clock.advance(5.5)
+            for i in range(2):
+                rig.store.create_pod(
+                    make_pod(f"r{i}").req({"cpu": "200m"}).obj())
+            rig.sched.run_until_settled()
+            assert plan.pending() == 0
+            assert rig.sched.breaker.state == circuit.OPEN
+            assert rig.sched.metrics["scheduled"] == 10
+
+            # faults exhausted: the next probe succeeds, the breaker closes,
+            # and the batched wire path resumes (device sees real batches)
+            rig.clock.advance(5.5)
+            for i in range(2):
+                rig.store.create_pod(
+                    make_pod(f"s{i}").req({"cpu": "200m"}).obj())
+            rig.sched.run_until_settled()
+            assert rig.sched.breaker.state == circuit.CLOSED
+            assert m.backend_circuit_state.labels() == 0
+            assert rig.sched.metrics["scheduled"] == 12
+            assert rig.server.binding.service.batch_counter > 0
+            assert rig.sched._device_epoch == rig.server.binding.service.epoch
+            # degraded window closed: total seconds strictly positive and
+            # the open→close span is accounted exactly once
+            assert m.degraded_seconds.labels() > 0
+        finally:
+            rig.close()

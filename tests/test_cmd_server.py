@@ -134,7 +134,7 @@ class TestDebugEndpoints:
             assert status == 200
             assert set(json.loads(body)["endpoints"]) == {
                 "/debug/queue", "/debug/cache", "/debug/devicestate",
-                "/debug/spans"}
+                "/debug/spans", "/debug/circuit"}
 
             status, body = _get(port, "/debug/queue")
             doc = json.loads(body)
@@ -152,6 +152,10 @@ class TestDebugEndpoints:
             status, body = _get(port, "/debug/devicestate")
             assert status == 200
             assert json.loads(body) == {"enabled": False}  # oracle scheduler
+
+            status, body = _get(port, "/debug/circuit")
+            assert status == 200
+            assert json.loads(body) == {"enabled": False}  # no wire backend
 
             with tracing.span("probe"):
                 pass

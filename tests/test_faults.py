@@ -1,0 +1,350 @@
+"""Fault-tolerant device path, unit layer: error taxonomy + retry policy,
+circuit breaker transitions, fault-plan scripting, split deadlines, and the
+epoch/resync protocol over a real localhost socket. No test sleeps against
+the wall clock — sleeps and clocks are injected."""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.backend import circuit
+from kubernetes_tpu.backend.circuit import CircuitBreaker
+from kubernetes_tpu.backend.errors import (
+    DeviceServiceError,
+    PermanentDeviceError,
+    RetryPolicy,
+    StaleEpochError,
+    TransientDeviceError,
+)
+from kubernetes_tpu.backend.service import DeviceService, WireClient, serve
+from kubernetes_tpu.framework.types import QueuedPodInfo
+from kubernetes_tpu.queue.scheduling_queue import SchedulingQueue
+from kubernetes_tpu.testing.faults import FaultPlan
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+class _FakeSleeper:
+    """sleep_fn that advances a FakeClock instead of blocking."""
+
+    def __init__(self, clock):
+        self.clock = clock
+        self.sleeps = []
+
+    def __call__(self, seconds):
+        self.sleeps.append(seconds)
+        self.clock.advance(seconds)
+
+
+class TestRetryPolicy:
+    def _policy(self, **kw):
+        clock = FakeClock()
+        sleeper = _FakeSleeper(clock)
+        kw.setdefault("sleep_fn", sleeper)
+        kw.setdefault("now_fn", clock)
+        return RetryPolicy(**kw), sleeper
+
+    def test_transient_retries_then_succeeds(self):
+        policy, sleeper = self._policy(max_retries=3, backoff_base=0.1)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise TransientDeviceError("flake")
+            return "ok"
+
+        assert policy.run("op", fn) == "ok"
+        assert len(calls) == 3 and len(sleeper.sleeps) == 2
+
+    def test_exponential_backoff_with_jitter_bounds(self):
+        policy, sleeper = self._policy(max_retries=4, backoff_base=0.1,
+                                       backoff_max=10.0, jitter=0.5)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise TransientDeviceError("down")
+
+        with pytest.raises(TransientDeviceError):
+            policy.run("op", fn)
+        assert len(calls) == 5  # initial + 4 retries
+        # jittered backoff stays in [0.5, 1.0]·(base·2^k)
+        for k, s in enumerate(sleeper.sleeps):
+            nominal = 0.1 * (2 ** k)
+            assert 0.5 * nominal <= s <= nominal
+
+    def test_deadline_budget_bounds_retries(self):
+        policy, sleeper = self._policy(max_retries=100, backoff_base=1.0,
+                                       backoff_max=1.0, deadline_s=3.0,
+                                       jitter=0.0)
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise TransientDeviceError("down")
+
+        with pytest.raises(TransientDeviceError):
+            policy.run("op", fn)
+        # 1s sleeps against a 3s budget: the loop must stop near the budget,
+        # nowhere near the 100-retry ceiling
+        assert len(calls) <= 5
+
+    def test_permanent_and_stale_never_retry(self):
+        for exc in (PermanentDeviceError("bad"), StaleEpochError("e2")):
+            policy, sleeper = self._policy(max_retries=5)
+            calls = []
+
+            def fn():
+                calls.append(1)
+                raise exc
+
+            with pytest.raises(DeviceServiceError):
+                policy.run("op", fn)
+            assert len(calls) == 1 and not sleeper.sleeps
+
+    def test_on_retry_hook_fires_per_retry(self):
+        seen = []
+        policy, _ = self._policy(max_retries=2, on_retry=seen.append)
+        with pytest.raises(TransientDeviceError):
+            policy.run("sync", lambda: (_ for _ in ()).throw(
+                TransientDeviceError("x")))
+        assert seen == ["sync", "sync"]
+
+
+class TestCircuitBreaker:
+    def test_opens_after_threshold_and_probes_after_timeout(self):
+        clock = FakeClock()
+        transitions = []
+        br = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0,
+                            now_fn=clock,
+                            on_state_change=lambda o, n: transitions.append(n))
+        assert br.allow() and br.state == circuit.CLOSED
+        br.record_failure(TransientDeviceError("a"))
+        br.record_failure(TransientDeviceError("b"))
+        assert br.state == circuit.CLOSED and br.allow()
+        br.record_failure(TransientDeviceError("c"))
+        assert br.state == circuit.OPEN
+        assert not br.allow()  # timer not expired
+        clock.advance(5.1)
+        assert br.allow() and br.state == circuit.HALF_OPEN  # the probe
+        br.record_failure(TransientDeviceError("probe failed"))
+        assert br.state == circuit.OPEN  # one half-open failure re-opens
+        clock.advance(5.1)
+        assert br.allow()
+        br.record_success()
+        assert br.state == circuit.CLOSED and br.consecutive_failures == 0
+        assert transitions == [circuit.OPEN, circuit.HALF_OPEN, circuit.OPEN,
+                               circuit.HALF_OPEN, circuit.CLOSED]
+
+    def test_success_resets_failure_count(self):
+        br = CircuitBreaker(failure_threshold=2, now_fn=FakeClock())
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == circuit.CLOSED  # never two CONSECUTIVE failures
+
+
+class TestFaultPlan:
+    def test_error_n_consumes_in_order(self):
+        plan = FaultPlan().error_n(2, "schedule_batch").drop("apply_deltas")
+        assert plan.next_client("schedule_batch").kind == "error"
+        assert plan.next_client("schedule_batch").kind == "error"
+        assert plan.next_client("schedule_batch") is None
+        assert plan.next_client("apply_deltas").kind == "drop"
+        assert plan.pending() == 0
+        assert [k for _, _, k in plan.log] == ["error", "error", "drop"]
+
+    def test_wildcard_matches_either_op(self):
+        plan = FaultPlan().error_once("*")
+        assert plan.next_client("apply_deltas") is not None
+        assert plan.next_client("schedule_batch") is None
+
+    def test_server_and_client_sides_independent(self):
+        plan = FaultPlan().crash("schedule_batch").error_once("schedule_batch")
+        assert plan.next_client("schedule_batch").kind == "error"
+        assert plan.next_server("schedule_batch").kind == "crash"
+
+
+class TestWireClientTaxonomy:
+    def test_connection_refused_is_transient(self):
+        # nothing listens on this port: refusal must classify transient and
+        # burn exactly max_retries+1 attempts
+        clock = FakeClock()
+        sleeper = _FakeSleeper(clock)
+        retries = []
+        client = WireClient(
+            "http://127.0.0.1:9",  # discard port, never bound
+            connect_timeout=0.2,
+            retry=RetryPolicy(max_retries=2, sleep_fn=sleeper, now_fn=clock,
+                              on_retry=retries.append))
+        with pytest.raises(TransientDeviceError):
+            client.apply_deltas({"nodes": []})
+        assert retries == ["apply_deltas", "apply_deltas"]
+
+    def test_injected_delay_beyond_read_deadline_is_timeout(self):
+        plan = FaultPlan().delay(10.0, "schedule_batch")
+        client = WireClient("http://127.0.0.1:9", read_timeout=1.0,
+                            retry=RetryPolicy(max_retries=0),
+                            fault_plan=plan)
+        with pytest.raises(TransientDeviceError, match="timeout"):
+            client.schedule_batch({"pods": []})
+
+    def test_injected_delay_under_deadline_is_absorbed(self):
+        service = DeviceService(batch_size=8)
+        server, port = serve(service)
+        try:
+            plan = FaultPlan().delay(0.01, "apply_deltas")
+            client = WireClient(f"http://127.0.0.1:{port}", read_timeout=5.0,
+                                fault_plan=plan)
+            out = client.apply_deltas({"nodes": []})
+            assert out["epoch"] == service.epoch
+        finally:
+            server.shutdown()
+
+    def test_server_exception_is_permanent(self):
+        service = DeviceService(batch_size=8)
+        server, port = serve(service)
+        try:
+            client = WireClient(f"http://127.0.0.1:{port}",
+                                retry=RetryPolicy(max_retries=3))
+            # 500 (service-side exception) and 4xx are PERMANENT — only
+            # infrastructure 502/503/504 are transient; exercise the
+            # permanent arm via an unknown route (404)
+            with pytest.raises(DeviceServiceError):
+                client._post("/v1/doesNotExist", {}, "apply_deltas")
+        finally:
+            server.shutdown()
+
+
+class TestEpochProtocol:
+    def test_stale_epoch_detected_over_socket(self):
+        service = DeviceService(batch_size=8)
+        server, port = serve(service)
+        try:
+            client = WireClient(f"http://127.0.0.1:{port}")
+            out = client.apply_deltas({"nodes": []})
+            e1 = out["epoch"]
+            assert out["deltaSeq"] == 1
+            # sidecar restart: fresh service behind the same socket
+            fresh = server.binding.restart()
+            assert fresh.epoch != e1
+            with pytest.raises(StaleEpochError) as ei:
+                client.apply_deltas({"nodes": [], "expectEpoch": e1})
+            assert ei.value.epoch == fresh.epoch
+            with pytest.raises(StaleEpochError):
+                client.schedule_batch({"pods": [], "expectEpoch": e1})
+            # the recovery move — a FULL resync — is exempt from the check
+            node = make_node("n0").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+            from kubernetes_tpu.api.codec import to_wire
+
+            out = client.apply_deltas({
+                "full": True, "expectEpoch": e1,
+                "nodes": [{"gen": 1, "node": to_wire(node), "pods": []}]})
+            assert out["epoch"] == fresh.epoch and out["nodes"] == 1
+        finally:
+            server.shutdown()
+
+    def test_batch_replay_is_idempotent(self):
+        """A transport retry after a LOST RESPONSE (the server committed,
+        then the connection died) must replay the committed result, not
+        double-commit the pods against capacity their first copies took."""
+        from kubernetes_tpu.api.codec import to_wire
+
+        service = DeviceService(batch_size=8)
+        node = make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        service.apply_deltas({"nodes": [{"gen": 1, "node": to_wire(node),
+                                         "pods": []}]})
+        pods = [to_wire(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+                for i in range(4)]
+        req = {"pods": pods, "batchId": "client-1-7"}
+        first = service.schedule_batch(req)
+        assert all(r["nodeName"] == "n0" for r in first["results"])
+        counter = service.batch_counter
+        # the retry: identical request, same batchId
+        second = service.schedule_batch(req)
+        assert second == first                      # byte-identical replay
+        assert service.batch_counter == counter     # nothing recomputed
+        assert service.batch_replays == 1
+        # a NEW batch id computes normally (and 4 more 1-cpu pods no longer
+        # fit the 4-cpu node the first batch filled — no phantom capacity)
+        third = service.schedule_batch({"pods": pods, "batchId": "client-1-8"})
+        assert all(r["nodeName"] is None for r in third["results"])
+
+    def test_fresh_client_first_push_is_full_sync(self):
+        """A restarted CLIENT against a surviving device: the first push
+        (epoch unknown) must be a full sync so ghost nodes from the
+        previous client's lifetime cannot linger in the device mirror."""
+        from kubernetes_tpu.apiserver import ClusterStore
+        from kubernetes_tpu.api.codec import to_wire
+        from kubernetes_tpu.backend.service import WireScheduler
+
+        service = DeviceService(batch_size=8)
+        server, port = serve(service)
+        try:
+            # the PREVIOUS client's world: a node that no longer exists
+            ghost = make_node("ghost").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+            service.apply_deltas({"nodes": [{"gen": 1, "node": to_wire(ghost),
+                                             "pods": []}]})
+            assert "ghost" in service.infos
+            store = ClusterStore()
+            store.create_node(make_node("real").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+            sched = WireScheduler(store, endpoint=f"http://127.0.0.1:{port}",
+                                  batch_size=4)
+            store.create_pod(make_pod("p").req({"cpu": "1"}).obj())
+            sched.run_until_settled()
+            assert set(service.infos) == {"real"}   # ghost swept by full sync
+            assert store.get_pod("default/p").spec.node_name == "real"
+        finally:
+            server.shutdown()
+
+    def test_full_resync_clears_stale_mirror(self):
+        service = DeviceService(batch_size=8)
+        from kubernetes_tpu.api.codec import to_wire
+
+        nodes = [make_node(f"n{i}").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj() for i in range(3)]
+        service.apply_deltas({"nodes": [
+            {"gen": 1, "node": to_wire(n), "pods": []} for n in nodes]})
+        assert len(service.infos) == 3
+        out = service.apply_deltas({"full": True, "nodes": [
+            {"gen": 1, "node": to_wire(nodes[0]), "pods": []}]})
+        assert out["nodes"] == 1 and set(service.infos) == {"n0"}
+
+
+class TestErrorRequeue:
+    def test_error_status_reenters_via_backoff_queue(self):
+        """A cycle ERROR (device batch failure) must re-enter via the
+        backoffQ — rate-limited — not park in the unschedulable map (no
+        ClusterEvent would ever wake it) and not hot-loop activeQ."""
+        clock = FakeClock()
+        q = SchedulingQueue(now_fn=clock)
+        q.add(make_pod("p1").obj())
+        qp = q.pop()
+        assert qp.attempts == 1
+        q.add_unschedulable_if_not_present(qp, q.scheduling_cycle, error=True)
+        pending = q.pending_pods()
+        assert pending["backoff"] == 1 and pending["unschedulable"] == 0
+        assert q.pop() is None          # backoff gates the retry
+        clock.advance(1.1)              # initial backoff 1s
+        qp2 = q.pop()
+        assert qp2 is not None and qp2.attempts == 2
+        # second error: attempts grew, so the backoff window doubles
+        q.add_unschedulable_if_not_present(qp2, q.scheduling_cycle, error=True)
+        clock.advance(1.1)
+        assert q.pop() is None          # 2s window now — still rate-limited
+        clock.advance(1.0)
+        assert q.pop() is not None
+
+    def test_unschedulable_status_still_parks(self):
+        clock = FakeClock()
+        q = SchedulingQueue(now_fn=clock)
+        q.add(make_pod("p1").obj())
+        qp = q.pop()
+        qp.unschedulable_plugins = {"NodeResourcesFit"}
+        q.add_unschedulable_if_not_present(qp, q.scheduling_cycle)
+        assert q.pending_pods()["unschedulable"] == 1
