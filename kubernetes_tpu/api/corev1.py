@@ -864,6 +864,35 @@ def pod_scheduling_context_to(ctx: t.PodSchedulingContext) -> dict:
     return {"metadata": meta_to(ctx.meta), "spec": spec}
 
 
+# ------------------------------------------- scheduling.x-k8s.io/v1alpha1
+
+
+def pod_group_from(doc: dict) -> t.PodGroup:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    return t.PodGroup(
+        meta=meta_from(doc.get("metadata") or {}),
+        min_member=int(spec.get("minMember", 1)),
+        schedule_timeout_seconds=int(spec.get("scheduleTimeoutSeconds", 0)),
+        phase=status.get("phase", t.POD_GROUP_PENDING),
+        scheduled=int(status.get("scheduled", 0)))
+
+
+def pod_group_to(pg: t.PodGroup) -> dict:
+    spec: dict = {"minMember": pg.min_member}
+    if pg.schedule_timeout_seconds:
+        spec["scheduleTimeoutSeconds"] = pg.schedule_timeout_seconds
+    status: dict = {}
+    if pg.phase and pg.phase != t.POD_GROUP_PENDING:
+        status["phase"] = pg.phase
+    if pg.scheduled:
+        status["scheduled"] = pg.scheduled
+    out: dict = {"metadata": meta_to(pg.meta), "spec": spec}
+    if status:
+        out["status"] = status
+    return out
+
+
 def register(scheme: Scheme) -> None:
     """Register every modeled external version (AddToScheme analog)."""
     core = [
@@ -908,4 +937,7 @@ def register(scheme: Scheme) -> None:
         scheme.add_known_type(
             GroupVersionKind("resource.k8s.io", "v1alpha2", kind),
             typ, dec, enc)
+    scheme.add_known_type(
+        GroupVersionKind("scheduling.x-k8s.io", "v1alpha1", "PodGroup"),
+        t.PodGroup, pod_group_from, pod_group_to)
     scheme.add_defaulter(t.Pod, _default_pod)

@@ -1046,3 +1046,34 @@ class PodSchedulingContext:
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     selected_node: str = ""
     potential_nodes: Tuple[str, ...] = ()
+
+
+# ---------------------------------------------------------------------------
+# scheduling.x-k8s.io (gang scheduling / coscheduling)
+
+# the pod label naming the PodGroup a pod belongs to (scheduler-plugins'
+# pod-group.scheduling.sigs.k8s.io label, shortened to this repo's group)
+POD_GROUP_LABEL = "scheduling.x-k8s.io/pod-group"
+
+# PodGroup status phases (scheduler-plugins apis/scheduling/v1alpha1)
+POD_GROUP_PENDING = "Pending"
+POD_GROUP_SCHEDULING = "Scheduling"
+POD_GROUP_RUNNING = "Running"
+
+
+@dataclass
+class PodGroup:
+    """scheduling.x-k8s.io PodGroup (namespaced): the gang contract for
+    all-or-nothing placement. Pods join via the POD_GROUP_LABEL label; the
+    Coscheduling plugin parks members at Permit until ``min_member`` of them
+    hold a node, then releases the whole gang — or rejects it wholesale when
+    ``schedule_timeout_seconds`` passes first (a 32-pod training job with 31
+    pods bound is pure waste; multi-host TPU jobs need all or nothing)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    min_member: int = 1
+    # 0 = the Coscheduling plugin's default permit timeout applies
+    schedule_timeout_seconds: int = 0
+    # status (maintained by the Coscheduling plugin's PostBind/Unreserve)
+    phase: str = POD_GROUP_PENDING
+    scheduled: int = 0  # members currently bound

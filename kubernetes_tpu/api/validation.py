@@ -454,8 +454,22 @@ _NAMESPACED_META_ONLY = (
 )
 
 
+def validate_pod_group(pg) -> list:
+    errs = validate_object_meta(pg.meta, requires_namespace=True)
+    if pg.min_member < 1:
+        errs.append("spec.minMember: must be >= 1")
+    if pg.schedule_timeout_seconds < 0:
+        errs.append("spec.scheduleTimeoutSeconds: must be >= 0")
+    return errs
+
+
 def validate(kind: str, obj) -> None:
     """Strategy.Validate dispatch; raises ValidationError on failure."""
+    if kind == "PodGroup":
+        errs = validate_pod_group(obj)
+        if errs:
+            raise ValidationError(kind, obj.meta.name, errs)
+        return
     if kind == "Pod":
         errs = validate_pod(obj)
     elif kind == "Node":

@@ -95,6 +95,15 @@ class PodWrapper:
         )
         return self
 
+    def pod_group(self, name: str) -> "PodWrapper":
+        """Join a gang: set the scheduling.x-k8s.io pod-group label the
+        Coscheduling plugin keys on (the PodGroup object itself is created
+        separately in the pod's namespace)."""
+        from .types import POD_GROUP_LABEL
+
+        self.pod.meta.labels[POD_GROUP_LABEL] = name
+        return self
+
     def owner(self, kind: str, name: str) -> "PodWrapper":
         """Set the controller ownerReference (metav1.GetControllerOf)."""
         from .types import OwnerReference

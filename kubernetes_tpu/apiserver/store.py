@@ -151,6 +151,8 @@ class ClusterStore:
         self.resource_claims: Dict[str, object] = {}
         self.resource_claim_templates: Dict[str, object] = {}
         self.pod_scheduling_contexts: Dict[str, object] = {}
+        # scheduling.x-k8s.io: gang contracts the Coscheduling plugin gates on
+        self.pod_groups: Dict[str, object] = {}
         # apiextensions (VERDICT r4 item 10): registered CRDs + one dynamic
         # kind map per served kind — plugin-requested GVKs get real objects,
         # journaled watches and informers through the same generic machinery
@@ -371,6 +373,7 @@ class ClusterStore:
                 "ResourceClaim": self.resource_claims,
                 "ResourceClaimTemplate": self.resource_claim_templates,
                 "PodSchedulingContext": self.pod_scheduling_contexts,
+                "PodGroup": self.pod_groups,
                 "CustomResourceDefinition": self.crds,
                 "APIService": self.api_services,
                 **self._custom_kinds,

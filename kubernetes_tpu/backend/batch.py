@@ -113,6 +113,36 @@ def claim_feasibility_mask(sel_key: jax.Array, sel_op: jax.Array,
     return jax.vmap(one_pod)(sel_key, sel_op, sel_kind, sel_val)
 
 
+# ---------------------------------------------------------------------------
+# gang all-or-nothing verdicts (PodGroup / Coscheduling)
+#
+# One device call per batch carrying gangs: gathers each gang's member rows
+# out of the batch program's outputs (node_idx = the program's per-member
+# choices, first_fail == 0 = decision-time feasibility) and runs the greedy
+# distinct-node assigner (ops/gang.py). The host commit reads three small
+# arrays instead of walking [P, N] masks per gang.
+
+
+@jax.jit
+def gang_verdicts(node_idx: jax.Array, first_fail: jax.Array,
+                  member_idx: jax.Array, member_valid: jax.Array):
+    """``member_idx`` [G, M] int32 rows into the batch pod axis (-1 pad),
+    ``member_valid`` [G, M] bool. Returns (placed_all [G] bool — the batch
+    program placed every member, the commit verdict; kernel_ok [G] bool —
+    a distinct-node cover exists on the decision-time masks; assign [G, M]
+    int32 — the greedy assignment, equal to the program's choices whenever
+    they are distinct and feasible)."""
+    from ..ops.gang import assign_gangs
+
+    p = node_idx.shape[0]
+    safe = jnp.clip(member_idx, 0, p - 1)
+    feasible = (first_fail[safe] == 0) & member_valid[..., None]
+    prefer = jnp.where(member_valid, node_idx[safe], jnp.int32(-1))
+    assign, kernel_ok = assign_gangs(feasible, prefer, member_valid)
+    placed_all = jnp.all((node_idx[safe] >= 0) | ~member_valid, axis=1)
+    return placed_all, kernel_ok, assign
+
+
 # default plugin weights on the batched path (default_plugins.go:32-51)
 DEFAULT_WEIGHTS = {
     "NodeResourcesBalancedAllocation": 1.0,

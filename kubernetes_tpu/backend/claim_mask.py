@@ -33,6 +33,108 @@ def _bucket(n: int, floor: int = 4) -> int:
     return b
 
 
+def build_dra_mask(device, entries, pad_to: int):
+    """The shared mask assembler: ``entries`` is
+    [(pod index, [DeviceSelector...], [allocated node names])] — built from
+    the store by ClaimMaskBuilder (in-process path) or decoded from the
+    wire request by DeviceService (remote path; the service has no store,
+    so the client ships pre-resolved selector rows). Returns the
+    [pad_to, nodes] bool device mask, or None when no entry carries
+    selectors or restrictions. Selector encoding registers attribute keys
+    and string operands in the device vocab first, so the kernel sees the
+    post-growth table."""
+    if not entries:
+        return None
+    n_cap = device.caps.nodes
+    restrict: Optional[np.ndarray] = None
+    s_cap = _bucket(max((len(sels) for _p, sels, _a in entries), default=1))
+    sel_key = np.zeros((pad_to, s_cap), np.int32)
+    sel_op = np.full((pad_to, s_cap), -1, np.int32)   # -1 = padding
+    sel_kind = np.zeros((pad_to, s_cap), np.int32)
+    sel_val = np.zeros((pad_to, s_cap), np.int32)
+    for p, sels, allocated in entries:
+        if p < 0 or p >= pad_to:
+            continue
+        for s, sel in enumerate(sels):
+            sel_key[p, s] = device.attr_slot(sel.key)
+            sel_op[p, s] = sel.op
+            sel_kind[p, s] = sel.operand_kind
+            sel_val[p, s] = (sel.operand if sel.operand_kind == dra.KIND_INT
+                             else device.attr_value_id(sel.operand))
+        for node in allocated:
+            if restrict is None:
+                restrict = np.ones((pad_to, n_cap), bool)
+            slot = device.encoder.node_slots.get(node)
+            row = np.zeros(n_cap, bool)
+            if slot is not None:
+                row[slot] = True
+            restrict[p] &= row
+    import jax.numpy as jnp
+
+    from .batch import claim_feasibility_mask
+
+    mask = claim_feasibility_mask(
+        jnp.asarray(sel_key), jnp.asarray(sel_op), jnp.asarray(sel_kind),
+        jnp.asarray(sel_val), device.attr_kind, device.attr_val)
+    if restrict is not None:
+        mask = mask & jnp.asarray(restrict)
+    return mask
+
+
+def claim_rows_for_pod(client, pod) -> Tuple[List[dra.DeviceSelector], List[str]]:
+    """(merged selectors, allocated nodes) across a pod's claims — the
+    resolved form that rides the wire so the remote device service can
+    build the same mask without a store. Unresolvable claims are skipped
+    (the commit-time PreFilter owns them, exactly as in build())."""
+    sels: List[dra.DeviceSelector] = []
+    allocated: List[str] = []
+    for _name, claim_key in dra.claim_refs_for_pod(pod):
+        claim = client.get_object("ResourceClaim", claim_key)
+        if claim is None:
+            continue
+        merged, err = dra.selectors_for_claim(client, claim)
+        if err:
+            continue
+        sels.extend(merged)
+        if claim.allocated_node:
+            allocated.append(claim.allocated_node)
+    return sels, allocated
+
+
+def wire_claims_for_batch(client, pods) -> List[dict]:
+    """The request-schema form of a batch's claims: one sparse entry per
+    claim-bearing pod, selectors flattened to [key, op, kind, operand]
+    quadruples (JSON- and proto-friendly)."""
+    out: List[dict] = []
+    for i, pod in enumerate(pods):
+        if not pod.spec.resource_claims:
+            continue
+        sels, allocated = claim_rows_for_pod(client, pod)
+        out.append({
+            "pod": i,
+            "selectors": [[s.key, s.op, s.operand_kind, s.operand]
+                          for s in sels],
+            "allocatedNodes": allocated,
+        })
+    return out
+
+
+def wire_claims_to_entries(claims) -> List[tuple]:
+    """Decode the request-schema claims back into build_dra_mask entries
+    (the server half; typed operands re-derive from the kind tag)."""
+    entries = []
+    for c in claims or ():
+        sels = []
+        for key, op, kind, operand in c.get("selectors") or ():
+            kind = int(kind)
+            sels.append(dra.DeviceSelector(
+                key=str(key), op=int(op), operand_kind=kind,
+                operand=int(operand) if kind == dra.KIND_INT else str(operand)))
+        entries.append((int(c.get("pod", -1)), sels,
+                        [str(n) for n in c.get("allocatedNodes") or ()]))
+    return entries
+
+
 class ClaimMaskBuilder:
     def __init__(self, client):
         self.client = client
@@ -58,53 +160,13 @@ class ClaimMaskBuilder:
     def build(self, qps, device, pad_to: int):
         """[pad_to, device.caps.nodes] bool DEVICE array, or None when no
         pod in the batch carries claims. Rows for claim-less (and padding)
-        pods are all-True; selector encoding registers attribute keys and
-        string operands in the device vocab first, so the kernel sees the
-        post-growth table."""
+        pods are all-True."""
         if not any(qp.pod.spec.resource_claims for qp in qps):
             return None
-        n_cap = device.caps.nodes
-        per_pod: List[List[dra.DeviceSelector]] = []
-        restrict: Optional[np.ndarray] = None
+        entries = []
         for p, qp in enumerate(qps):
-            pod = qp.pod
-            sels: List[dra.DeviceSelector] = []
-            for _name, claim_key in dra.claim_refs_for_pod(pod):
-                claim = self.client.get_object("ResourceClaim", claim_key)
-                if claim is None:
-                    continue  # raced with deletion: commit-time PreFilter owns it
-                merged, err = dra.selectors_for_claim(self.client, claim)
-                if err:
-                    continue  # class vanished mid-batch: same commit-time story
-                sels.extend(merged)
-                if claim.allocated_node:
-                    if restrict is None:
-                        restrict = np.ones((pad_to, n_cap), bool)
-                    slot = device.encoder.node_slots.get(claim.allocated_node)
-                    row = np.zeros(n_cap, bool)
-                    if slot is not None:
-                        row[slot] = True
-                    restrict[p] &= row
-            per_pod.append(sels)
-        s_cap = _bucket(max((len(s) for s in per_pod), default=1))
-        sel_key = np.zeros((pad_to, s_cap), np.int32)
-        sel_op = np.full((pad_to, s_cap), -1, np.int32)   # -1 = padding
-        sel_kind = np.zeros((pad_to, s_cap), np.int32)
-        sel_val = np.zeros((pad_to, s_cap), np.int32)
-        for p, sels in enumerate(per_pod):
-            for s, sel in enumerate(sels):
-                sel_key[p, s] = device.attr_slot(sel.key)
-                sel_op[p, s] = sel.op
-                sel_kind[p, s] = sel.operand_kind
-                sel_val[p, s] = (sel.operand if sel.operand_kind == dra.KIND_INT
-                                 else device.attr_value_id(sel.operand))
-        import jax.numpy as jnp
-
-        from .batch import claim_feasibility_mask
-
-        mask = claim_feasibility_mask(
-            jnp.asarray(sel_key), jnp.asarray(sel_op), jnp.asarray(sel_kind),
-            jnp.asarray(sel_val), device.attr_kind, device.attr_val)
-        if restrict is not None:
-            mask = mask & jnp.asarray(restrict)
-        return mask
+            if not qp.pod.spec.resource_claims:
+                continue
+            sels, allocated = claim_rows_for_pod(self.client, qp.pod)
+            entries.append((p, sels, allocated))
+        return build_dra_mask(device, entries, pad_to)

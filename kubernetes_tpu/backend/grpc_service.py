@@ -127,6 +127,21 @@ def _batch_to_proto(payload: dict):
     req.traceparent = payload.get("traceparent") or ""
     req.expect_epoch = payload.get("expectEpoch") or ""
     req.batch_id = payload.get("batchId") or ""
+    from ..api import dra
+
+    for c in payload.get("claims") or ():
+        pc = req.claims.add()
+        pc.pod = int(c.get("pod", 0))
+        for key, op, kind, operand in c.get("selectors") or ():
+            s = pc.selectors.add()
+            s.key = str(key)
+            s.op = int(op)
+            s.kind = int(kind)
+            if int(kind) == dra.KIND_INT:
+                s.int_val = int(operand)
+            else:
+                s.str_val = str(operand)
+        pc.allocated_nodes.extend(c.get("allocatedNodes") or ())
     return req
 
 
@@ -150,6 +165,17 @@ def _batch_from_proto(req) -> dict:
         out["expectEpoch"] = req.expect_epoch
     if req.batch_id:
         out["batchId"] = req.batch_id
+    if req.claims:
+        from ..api import dra
+
+        out["claims"] = [{
+            "pod": pc.pod,
+            "selectors": [
+                [s.key, s.op, s.kind,
+                 s.int_val if s.kind == dra.KIND_INT else s.str_val]
+                for s in pc.selectors],
+            "allocatedNodes": list(pc.allocated_nodes),
+        } for pc in req.claims]
     return out
 
 
@@ -230,6 +256,13 @@ def serve_grpc(service, port: int = 0):
         resp.delta_seq = int(out.get("deltaSeq", 0))
         return resp
 
+    def health(request, ctx):
+        out = service.health({})
+        return p.HealthResponse(status=out.get("status", "serving"),
+                                epoch=out.get("epoch", ""),
+                                delta_seq=int(out.get("deltaSeq", 0)),
+                                nodes=int(out.get("nodes", 0)))
+
     handlers = grpc.method_handlers_generic_handler(SERVICE, {
         "ApplyDeltas": grpc.unary_unary_rpc_method_handler(
             apply_deltas,
@@ -239,6 +272,10 @@ def serve_grpc(service, port: int = 0):
             schedule_batch,
             request_deserializer=p.ScheduleBatchRequest.FromString,
             response_serializer=p.ScheduleBatchResponse.SerializeToString),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            health,
+            request_deserializer=p.HealthRequest.FromString,
+            response_serializer=p.HealthResponse.SerializeToString),
     })
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
     server.add_generic_rpc_handlers((handlers,))
@@ -274,6 +311,18 @@ class GrpcClient:
             f"/{SERVICE}/ScheduleBatch",
             request_serializer=p.ScheduleBatchRequest.SerializeToString,
             response_deserializer=p.ScheduleBatchResponse.FromString)
+        # feature-detect against the COMPILED schema: a stale pb2 built
+        # from an older proto must degrade (claim pods fall back to the
+        # local sequential path; the half-open probe pushes a full batch)
+        # rather than crash mid-request
+        self.supports_dra = (
+            "claims" in p.ScheduleBatchRequest.DESCRIPTOR.fields_by_name)
+        self.supports_health = hasattr(p, "HealthRequest")
+        self._health = (self._channel.unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=p.HealthRequest.SerializeToString,
+            response_deserializer=p.HealthResponse.FromString)
+            if self.supports_health else None)
 
     def _call(self, op: str, stub, request):
         grpc = self._grpc
@@ -316,6 +365,14 @@ class GrpcClient:
             out["epoch"] = resp.epoch
             out["deltaSeq"] = resp.delta_seq
         return out
+
+    def health(self) -> dict:
+        """The cheap identity/liveness verb (half-open circuit probe)."""
+        if self._health is None:
+            raise PermanentDeviceError("Health RPC unsupported by this pb2")
+        resp = self._call("health", self._health, pb2().HealthRequest())
+        return {"status": resp.status, "epoch": resp.epoch,
+                "deltaSeq": resp.delta_seq, "nodes": resp.nodes}
 
     def close(self) -> None:
         self._channel.close()

@@ -209,6 +209,17 @@ class DeviceService:
             dataclasses.replace(caps, **updates),
             ns_labels_fn=lambda ns: self.ns_labels.get(ns, {}))
 
+    # --------------------------------------------------------------- health
+    def health(self, req: dict) -> dict:
+        """Cheap liveness/identity verb: no device work, no epoch check (a
+        stale client calling this LEARNS the current epoch — exactly what a
+        half-open circuit probe needs instead of pushing a full batch
+        through a maybe-dead service)."""
+        with self._lock:
+            return self._stamp({"apiVersion": API_VERSION,
+                                "status": "serving",
+                                "nodes": len(self.infos)})
+
     # ------------------------------------------------------------- schedule
 
     def schedule_batch(self, req: dict) -> dict:
@@ -227,13 +238,15 @@ class DeviceService:
         with tracing.span_from_remote(req.get("traceparent"),
                                       "device.schedule_batch",
                                       batch=len(pods)):
-            out = self._schedule_batch_traced(pods, tie_seeds)
+            out = self._schedule_batch_traced(pods, tie_seeds,
+                                              req.get("claims"))
         if batch_id:
             with self._lock:
                 self._last_batch = (batch_id, out)
         return out
 
-    def _schedule_batch_traced(self, pods: List[Pod], tie_seeds) -> dict:
+    def _schedule_batch_traced(self, pods: List[Pod], tie_seeds,
+                               claims=None) -> dict:
         with self._lock:
             self._ensure_device()
             for _attempt in range(8):
@@ -273,12 +286,24 @@ class DeviceService:
             else:
                 sample_k = None
                 sample_start = None
+            # resource.k8s.io claims: the client ships pre-resolved selector
+            # rows (it has the store; this process does not) and the mask
+            # builds against THIS device's attribute table — the same
+            # claim_feasibility_mask the in-process path dispatches
+            dra_mask = None
+            if claims:
+                from .claim_mask import build_dra_mask, wire_claims_to_entries
+
+                pad_to = len(host_pb["req"])
+                dra_mask = build_dra_mask(
+                    self.device, wire_claims_to_entries(claims), pad_to)
             with tracing.span("device.dispatch", batch=len(pods)):
                 result = self.schedule_batch_fn(
                     pb, et, self.device.nt, self.device.tc, tb,
                     np.int32(self.batch_counter),
                     topo_enabled=self.device.topo_enabled,
-                    sample_k=sample_k, sample_start=sample_start)
+                    sample_k=sample_k, sample_start=sample_start,
+                    dra_mask=dra_mask)
             if result.final_sample_start is not None:
                 self._start_carry = result.final_sample_start
             # adopt exactly like the in-process path: the client will assume
@@ -373,7 +398,8 @@ class ServiceBinding:
         return self.service
 
 
-_OPS = {"/v1/applyDeltas": "apply_deltas", "/v1/scheduleBatch": "schedule_batch"}
+_OPS = {"/v1/applyDeltas": "apply_deltas", "/v1/scheduleBatch": "schedule_batch",
+        "/v1/health": "health"}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -529,11 +555,19 @@ class WireClient:
 
         return self.retry.run(op, attempt)
 
+    # the JSON transport is schema-free: claim rows ride the request as-is
+    supports_dra = True
+    supports_health = True
+
     def apply_deltas(self, payload: dict) -> dict:
         return self._post("/v1/applyDeltas", payload, "apply_deltas")
 
     def schedule_batch(self, payload: dict) -> dict:
         return self._post("/v1/scheduleBatch", payload, "schedule_batch")
+
+    def health(self) -> dict:
+        """The cheap identity/liveness verb (half-open probe)."""
+        return self._post("/v1/health", {"apiVersion": API_VERSION}, "health")
 
 
 # ---------------------------------------------------------------- scheduler
@@ -594,6 +628,11 @@ class WireScheduler(Scheduler):
         self._sent_ns: Dict[str, dict] = {}
         self._batchable_cache: Dict[str, bool] = {}
         self.settle_abandoned = False
+        # claim resolution for the wire dra_mask path (the builder only
+        # reads the store; the mask itself builds server-side)
+        from .claim_mask import ClaimMaskBuilder
+
+        self._claim_masks = ClaimMaskBuilder(self.store)
 
     # ------------------------------------------------------- degraded mode
 
@@ -616,11 +655,19 @@ class WireScheduler(Scheduler):
 
     def _wire_supported(self, pod: Pod) -> bool:
         """Same gating as TPUScheduler.batch_supported: the service runs the
-        compiled DEFAULT plugin set — volume pods, resource.k8s.io claim
-        pods (the wire protocol carries no dra_mask yet), and custom
-        profiles take the local sequential path."""
-        if pod.spec.volumes or pod.spec.resource_claims:
+        compiled DEFAULT plugin set — volume pods and custom profiles take
+        the local sequential path. Claim pods ride the wire when every
+        claim resolves AND the transport carries the dra_mask input
+        (ROADMAP PR 1 follow-up: the request schema ships resolved
+        selector rows; the server builds the mask against its own
+        attribute table)."""
+        if pod.spec.volumes:
             return False
+        if pod.spec.resource_claims:
+            if not getattr(self.client, "supports_dra", False):
+                return False
+            if not self._claim_masks.batchable(pod):
+                return False
         fwk = self.framework_for_pod(pod)
         cached = self._batchable_cache.get(fwk.profile_name)
         if cached is None:
@@ -732,6 +779,21 @@ class WireScheduler(Scheduler):
             if pod is None or pod.spec.node_name or not self._responsible_for(pod):
                 continue
             qp.pod = pod
+            # host-side gang quorum gate (the remote program does not model
+            # Coscheduling's PreFilter) — same rule as the in-process path
+            from ..framework.plugins.coscheduling import gang_precheck_status
+
+            fwk = self.framework_for_pod(pod)
+            gang_st = gang_precheck_status(fwk, pod)
+            if gang_st is not None:
+                self.metrics["schedule_attempts"] += 1
+                self.smetrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, self.now_fn() - t0)
+                self._handle_scheduling_failure(
+                    fwk, self._new_cycle_state(), qp, gang_st,
+                    Diagnosis(unschedulable_plugins={"Coscheduling"}),
+                    pod_cycle)
+                continue
             if self._wire_supported(pod):
                 buffer.append(qp)
                 continue
@@ -762,6 +824,21 @@ class WireScheduler(Scheduler):
             self._accrue_degraded()
             self._schedule_degraded(batch, pod_cycle)
             return
+        from .circuit import HALF_OPEN
+
+        if (self.breaker.state == HALF_OPEN
+                and getattr(self.client, "supports_health", False)):
+            # half-open probe = the cheap health RPC, not a full batch
+            # pushed through a maybe-dead service: a dead sidecar costs one
+            # tiny request and this batch degrades immediately; a live one
+            # answers in microseconds and the real push proceeds
+            try:
+                self.client.health()
+            except DeviceServiceError as exc:
+                self.breaker.record_failure(exc)  # half-open: re-opens
+                self._accrue_degraded()
+                self._schedule_degraded(batch, pod_cycle)
+                return
         try:
             self._push_deltas()
             res = self._wire_schedule_batch(batch)
@@ -788,11 +865,15 @@ class WireScheduler(Scheduler):
 
     def _wire_schedule_batch(self, batch: List[QueuedPodInfo]) -> dict:
         from ..ops.tiebreak import seeds_for
+        from .claim_mask import wire_claims_for_batch
 
         payload = {"apiVersion": API_VERSION,
                    "pods": [to_wire(qp.pod) for qp in batch],
                    "tieSeeds": [int(s) for s in seeds_for(batch)],
                    "batchId": f"{self._batch_id_prefix}-{next(self._batch_ids)}"}
+        claims = wire_claims_for_batch(self.store, [qp.pod for qp in batch])
+        if claims:
+            payload["claims"] = claims
         tp = tracing.format_traceparent()
         if tp:
             payload["traceparent"] = tp
@@ -837,14 +918,68 @@ class WireScheduler(Scheduler):
                 fwk, self._new_cycle_state(), qp,
                 Status.error(f"device service: {exc}"), Diagnosis(), pod_cycle)
 
+    def _invalidate_node(self, node_name: str) -> None:
+        """Force ``node_name``'s row back through the delta channel: the
+        device adopted a placement the host is rejecting, and the host
+        generation did NOT advance (nothing was assumed), so without this
+        the server would keep the phantom commit forever — its sync skips
+        rows whose generation matches and its mirror already holds the
+        adopted state. Bumping the cache generation makes the next push
+        re-send host truth; the server's content diff then repairs the row
+        (the wire twin of TPUScheduler's ``_uploaded_gen`` pop)."""
+        from ..framework.types import next_generation
+
+        with self.cache._lock:
+            ni = self.cache.nodes.get(node_name)
+            if ni is not None:
+                ni.generation = next_generation()
+                # the incremental snapshot walks the dirty set, not raw
+                # generations — without this the bump is never revisited
+                self.cache._dirty.add(node_name)
+        self._sent_gens.pop(node_name, None)
+
     def _process_wire_results(self, batch: List[QueuedPodInfo], res: dict,
                               pod_cycle: int, t0: float) -> None:
+        from ..framework.plugins.coscheduling import pod_group_key
+
         # hint-screen scaffolding, shared by every failed pod in the batch
         hint_names = hint_slot_of = None
-        for qp, r in zip(batch, res["results"]):
+        # gang all-or-nothing: a gang with any unplaced member is rejected
+        # WHOLE — placed members surrender their slots instead of parking a
+        # partial gang at Permit (mirror of the in-process _judge_gangs)
+        gang_rejected: Dict[int, str] = {}
+        groups: Dict[str, List[int]] = {}
+        for i, qp in enumerate(batch):
+            gkey = pod_group_key(qp.pod)
+            if gkey is not None:
+                groups.setdefault(gkey, []).append(i)
+        for gkey, idxs in groups.items():
+            if any(not res["results"][i].get("nodeName") for i in idxs):
+                for i in idxs:
+                    gang_rejected[i] = gkey
+                plugin = self.framework_for_pod(
+                    batch[idxs[0]].pod).plugin("Coscheduling")
+                if plugin is not None:
+                    plugin.reject_gang(gkey, "incomplete")
+        for i, (qp, r) in enumerate(zip(batch, res["results"])):
             fwk = self.framework_for_pod(qp.pod)
             self.metrics["schedule_attempts"] += 1
             node_name = r.get("nodeName")
+            if i in gang_rejected:
+                if node_name:
+                    # the device already adopted this member's placement;
+                    # surrendering it must re-send the node's host truth
+                    self._invalidate_node(node_name)
+                d = Diagnosis(unschedulable_plugins={"Coscheduling"})
+                d.unschedulable_plugins.update(
+                    r.get("unschedulablePlugins") or ())
+                self._handle_scheduling_failure(
+                    fwk, self._new_cycle_state(), qp, Status.unschedulable(
+                        f'gang "{gang_rejected[i]}" could not be fully '
+                        "placed"), d, pod_cycle)
+                self.smetrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, self.now_fn() - t0)
+                continue
             if node_name:
                 if self.snapshot.get(node_name) is None:
                     # ghost placement: the device named a node the host no
@@ -859,7 +994,20 @@ class WireScheduler(Scheduler):
                         Status.error(f"device placed pod on unknown node "
                                      f"{node_name}"), Diagnosis(), pod_cycle)
                     continue
-                self.assume_and_bind(fwk, self._new_cycle_state(), qp, qp.pod,
+                state = self._new_cycle_state()
+                if qp.pod.spec.resource_claims or qp.pod.spec.volumes:
+                    # Reserve allocates claims from PreFilter cycle state
+                    # (and re-verifies the claims still exist) — exactly
+                    # the in-process commit rule
+                    _, pre_st = fwk.run_pre_filter_plugins(state, qp.pod)
+                    if not pre_st.is_success():
+                        # host rejected what the device adopted: re-send
+                        # the node's truth on the next push
+                        self._invalidate_node(node_name)
+                        self.cache.update_snapshot(self.snapshot)
+                        self.schedule_one_pod(qp, pod_cycle)
+                        continue
+                self.assume_and_bind(fwk, state, qp, qp.pod,
                                      node_name, pod_cycle, t0=t0)
             else:
                 d = Diagnosis()

@@ -102,6 +102,47 @@ class ComponentServer:
         self.httpd.server_close()
 
 
+def _device_occupancy(device) -> dict:
+    """Per-axis tensor occupancy for /debug/devicestate: used vs capacity
+    per RESOURCE axis (summed over valid mirrored rows — the question an
+    operator actually asks: how full is the fleet per resource?) plus how
+    much of each static vocab/axis the encoder has consumed. Reads only the
+    host-side mirror — no device round-trip from the serving thread."""
+    from ..ops import schema
+
+    mirror = device._mirror
+    valid = mirror["valid"].reshape(-1).astype(bool)
+    used = mirror["requested"][valid].sum(axis=0)
+    cap = mirror["allocatable"][valid].sum(axis=0)
+    enc = device.encoder
+    fixed = (("cpu", schema.COL_CPU), ("memory", schema.COL_MEM),
+             ("ephemeral-storage", schema.COL_EPH), ("pods", schema.COL_PODS))
+    resources = {name: {"used": int(used[col]), "capacity": int(cap[col])}
+                 for name, col in fixed}
+    for rid in range(1, len(enc.scalar_vocab)):
+        col = schema.N_FIXED_COLS + rid - 1
+        if col < used.shape[0]:
+            resources[str(enc.scalar_vocab.item(rid))] = {
+                "used": int(used[col]), "capacity": int(cap[col])}
+    caps = device.caps
+    axes = {
+        "nodes": {"used": int(valid.sum()), "capacity": caps.nodes},
+        "resources": {"used": schema.N_FIXED_COLS + len(enc.scalar_vocab) - 1,
+                      "capacity": caps.resources},
+        "labelKeys": {"used": len(enc.key_vocab) - 1,
+                      "capacity": caps.label_keys},
+        "ports": {"used": len(enc.port_vocab) - 1,
+                  "capacity": caps.port_words * 32},
+        "images": {"used": len(enc.image_vocab) - 1, "capacity": caps.images},
+        "prioClasses": {"used": len(enc.prio_vocab),
+                        "capacity": caps.prio_classes},
+        "sigs": {"used": device.sig_table.n_sigs, "capacity": caps.sigs},
+        "attrKeys": {"used": len(device.attr_slots),
+                     "capacity": device._attr_cols},
+    }
+    return {"resources": resources, "axes": axes}
+
+
 def build_debug_handlers(sched) -> dict:
     """The /debug endpoint family over a live scheduler (SURVEY §5.2's
     SIGUSR2 comparer/dumper, but always-on and JSON over the serving mux):
@@ -149,6 +190,7 @@ def build_debug_handlers(sched) -> dict:
             "pipelinedBatches": getattr(sched, "pipelined_batches", 0),
             "fallbackScheduled": getattr(sched, "fallback_scheduled", 0),
             "batchScheduled": getattr(sched, "batch_scheduled", 0),
+            "occupancy": _device_occupancy(device),
         }
         sizer = getattr(sched, "sizer", None)
         if sizer is not None:

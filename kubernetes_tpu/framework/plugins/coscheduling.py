@@ -1,0 +1,322 @@
+"""Coscheduling — gang (all-or-nothing) scheduling over PodGroup.
+
+Analog of the scheduler-plugins Coscheduling plugin (sigs.k8s.io/
+scheduler-plugins pkg/coscheduling): pods join a gang via the
+``scheduling.x-k8s.io/pod-group`` label; the plugin
+
+  * QueueSort: orders by priority desc, then the gang's first-seen queue
+    timestamp, then group key — so members of one gang sort ADJACENTLY and
+    drain into the same micro-batch on the TPU path (coscheduling.go Less);
+  * PreFilter: fast-fails a member when the cluster holds fewer than
+    ``minMember`` total siblings (no point parking resources a gang can
+    never complete), and while the group sits in rejection backoff (the
+    lastDeniedPG cache — the starvation guard that keeps a hopeless 32-pod
+    gang from parking whole-node assumes every cycle);
+  * Permit: parks members (WAIT + the group's scheduleTimeoutSeconds) until
+    ``minMember`` of them hold a node (waiting + already bound + self), then
+    releases the entire gang through the waiting-pods handle;
+  * Unreserve: any member's post-Reserve failure rejects every waiting
+    sibling — a gang fails wholesale, never in part;
+  * PostBind: maintains PodGroup status (scheduled count, phase Running at
+    quorum) — the status write fires a PodGroup cluster event that
+    reactivates parked siblings.
+
+The batched backends share the same machinery: gang members commit through
+``assume_and_bind`` (so Permit parks/releases identically), and the
+whole-gang reject on the device path calls ``reject_gang`` here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from ...api.types import (
+    POD_GROUP_LABEL,
+    POD_GROUP_PENDING,
+    POD_GROUP_RUNNING,
+    POD_GROUP_SCHEDULING,
+    Pod,
+    PodGroup,
+)
+from ..interface import (
+    CycleState,
+    OK,
+    PermitPlugin,
+    PostBindPlugin,
+    PreFilterPlugin,
+    PreFilterResult,
+    QueueSortPlugin,
+    ReservePlugin,
+    Status,
+    WAIT,
+)
+from ..types import ADD, ALL, ClusterEvent, POD, POD_GROUP
+from . import names
+
+ERR_REASON_MISSING_GROUP = "pod group not found"
+ERR_REASON_TOO_FEW_MEMBERS = "fewer than minMember sibling pods exist"
+ERR_REASON_GANG_BACKOFF = "pod group is in rejection backoff"
+
+
+def pod_group_name(pod: Pod) -> Optional[str]:
+    return pod.meta.labels.get(POD_GROUP_LABEL) or None
+
+
+def pod_group_key(pod: Pod) -> Optional[str]:
+    """``namespace/name`` PodGroup key for a gang member, else None."""
+    name = pod.meta.labels.get(POD_GROUP_LABEL)
+    if not name:
+        return None
+    return f"{pod.meta.namespace}/{name}"
+
+
+def gang_precheck_status(fwk, pod: Pod) -> Optional[Status]:
+    """Host-side stand-in for Coscheduling's PreFilter on the batched paths
+    (the compiled device program does not model gang quorum or rejection
+    backoff): returns the non-success Status a gang member should fail with
+    before dispatch, or None when the pod may ride the batch."""
+    gkey = pod_group_key(pod)
+    if gkey is None:
+        return None
+    plugin = fwk.plugin(names.COSCHEDULING)
+    if plugin is None:
+        return None
+    _r, st = plugin.pre_filter(CycleState(), pod)
+    return None if st.is_success() else st
+
+
+class Coscheduling(QueueSortPlugin, PreFilterPlugin, PermitPlugin,
+                   ReservePlugin, PostBindPlugin):
+    STATE_KEY = "PreFilter/Coscheduling"
+
+    # plugin-arg defaults (registry): the Permit park timeout when the
+    # PodGroup does not name one, and how long a rejected group fast-fails
+    # at PreFilter before its members may park resources again
+    DEFAULT_PERMIT_TIMEOUT_S = 60.0
+    DEFAULT_GANG_BACKOFF_S = 5.0
+
+    def __init__(self, client=None, metrics=None, waiting=None, now_fn=None,
+                 permit_timeout_s: float = DEFAULT_PERMIT_TIMEOUT_S,
+                 gang_backoff_s: float = DEFAULT_GANG_BACKOFF_S):
+        import time
+
+        self.client = client
+        self.metrics = metrics
+        self.waiting = waiting  # scheduler WaitingPods handle (may be None)
+        self.now_fn = now_fn or time.monotonic
+        self.permit_timeout_s = permit_timeout_s
+        self.gang_backoff_s = gang_backoff_s
+        # gang first-seen queue timestamp: members share one sort key so a
+        # gang drains contiguously; dropped when the group reaches Running
+        self._group_ts: Dict[str, float] = {}
+        # gkey -> bound-member count (seeded lazily from the store so a
+        # restarted scheduler resumes mid-gang; advanced at PostBind)
+        self._bound: Dict[str, int] = {}
+        # gkey -> first-member park time (gang wait-duration clock)
+        self._first_wait: Dict[str, float] = {}
+        # gkey -> denial expiry (lastDeniedPG cache)
+        self._denied: Dict[str, float] = {}
+        # reentrancy guard: reject_gang cascades through unreserve
+        self._rejecting: Set[str] = set()
+
+    def name(self) -> str:
+        return names.COSCHEDULING
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        # PodGroup churn (creation, the PostBind status writes) and new pod
+        # arrivals (a missing sibling appearing) must reactivate parked
+        # members
+        return [
+            ClusterEvent(POD_GROUP, ALL, "PodGroupChange"),
+            ClusterEvent(POD, ADD, "PodAdd"),
+        ]
+
+    # ------------------------------------------------------------ queue sort
+
+    def sort_key(self, qp) -> Tuple:
+        """Heap key: (-priority, gang-or-pod timestamp, group key). Groupless
+        pods keep the PrioritySort order exactly (empty third component, so
+        equal-(priority, timestamp) pods still fall to the FIFO counter)."""
+        pod = qp.pod
+        name = pod.meta.labels.get(POD_GROUP_LABEL)
+        if not name:
+            return (-pod.spec.priority, qp.timestamp, "")
+        gkey = f"{pod.meta.namespace}/{name}"
+        ts = self._group_ts.setdefault(gkey, qp.timestamp)
+        return (-pod.spec.priority, ts, gkey)
+
+    def less(self, a, b) -> bool:
+        return self.sort_key(a) < self.sort_key(b)
+
+    # ------------------------------------------------------------- helpers
+
+    def _group(self, gkey: str) -> Optional[PodGroup]:
+        if self.client is None:
+            return None
+        return self.client.get_object("PodGroup", gkey)
+
+    def _members_in_store(self, gkey: str, bound_only: bool = False) -> int:
+        pods = getattr(self.client, "pods", None)
+        if pods is None:
+            return 0
+        ns, _, name = gkey.partition("/")
+        n = 0
+        for p in pods.values():
+            if (p.meta.namespace == ns
+                    and p.meta.labels.get(POD_GROUP_LABEL) == name
+                    and (p.spec.node_name or not bound_only)):
+                n += 1
+        return n
+
+    def _bound_count(self, gkey: str) -> int:
+        n = self._bound.get(gkey)
+        if n is None:
+            n = self._members_in_store(gkey, bound_only=True)
+            self._bound[gkey] = n
+        return n
+
+    def _waiting_members(self, gkey: str) -> List[str]:
+        if self.waiting is None:
+            return []
+        return [key for key, pod in self.waiting.iterate()
+                if pod_group_key(pod) == gkey]
+
+    def _observe_wait(self, gkey: str, result: str) -> None:
+        t0 = self._first_wait.pop(gkey, None)
+        if t0 is not None and self.metrics is not None:
+            self.metrics.gang_wait_duration.observe(self.now_fn() - t0, result)
+
+    # ------------------------------------------------------------ prefilter
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        gkey = pod_group_key(pod)
+        if gkey is None:
+            return None, OK
+        until = self._denied.get(gkey)
+        if until is not None:
+            if self.now_fn() < until:
+                # starvation guard: a just-rejected gang fast-fails instead
+                # of re-parking whole-node assumes under singleton pods.
+                # Unresolvable (scheduler-plugins PreFilter semantics):
+                # preemption cannot fix a gang, so no dry-run fan-out.
+                return None, Status.unresolvable(
+                    f'{ERR_REASON_GANG_BACKOFF} "{gkey}"')
+            del self._denied[gkey]
+        pg = self._group(gkey)
+        if pg is None:
+            # the group object has not been created yet: unresolvable — the
+            # PodGroup cluster event reactivates the member
+            return None, Status.unresolvable(
+                f'{ERR_REASON_MISSING_GROUP} "{gkey}"')
+        if self._members_in_store(gkey) < pg.min_member:
+            # a gang that cannot possibly reach quorum must not park
+            # resources at Permit (coscheduling PreFilter's total-pods
+            # check); unresolvable — evicting victims cannot create the
+            # missing siblings
+            return None, Status.unresolvable(
+                f'{ERR_REASON_TOO_FEW_MEMBERS} for "{gkey}"')
+        return None, OK
+
+    # --------------------------------------------------------------- permit
+
+    def permit(self, state: CycleState, pod: Pod, node_name: str) -> Tuple[Status, float]:
+        gkey = pod_group_key(pod)
+        if gkey is None:
+            return OK, 0.0
+        pg = self._group(gkey)
+        if pg is None:
+            return Status.unschedulable(
+                f'{ERR_REASON_MISSING_GROUP} "{gkey}"'), 0.0
+        waiting = self._waiting_members(gkey)
+        # quorum = parked siblings + already-bound members + this pod
+        if len(waiting) + self._bound_count(gkey) + 1 >= pg.min_member:
+            self._observe_wait(gkey, "scheduled")
+            if self.waiting is not None:
+                for key in waiting:
+                    self.waiting.allow(key)
+            return OK, 0.0
+        self._first_wait.setdefault(gkey, self.now_fn())
+        self._set_phase(gkey, POD_GROUP_SCHEDULING)
+        timeout = float(pg.schedule_timeout_seconds or self.permit_timeout_s)
+        return Status(WAIT), timeout
+
+    # -------------------------------------------------------------- reserve
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        return OK  # nothing to hold; unreserve carries the gang semantics
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        """Any member's post-Reserve failure (permit rejection/timeout, bind
+        error) takes the whole gang down with it: reject every waiting
+        sibling so no partial gang survives."""
+        gkey = pod_group_key(pod)
+        if gkey is None or gkey in self._rejecting:
+            return
+        self.reject_gang(gkey, "member_failure", force=False)
+
+    def reject_gang(self, gkey: str, reason: str, force: bool = True) -> int:
+        """Reject every waiting member of ``gkey`` (all-or-nothing teardown);
+        counts one gang-rejection event and arms the denial backoff. Called
+        from Unreserve, the scheduler's permit-timeout sweep, and the
+        batched backends' whole-gang reject. Returns members rejected."""
+        if gkey in self._rejecting:
+            return 0
+        self._rejecting.add(gkey)
+        try:
+            waited = gkey in self._first_wait
+            rejected = 0
+            if self.waiting is not None:
+                for key in self._waiting_members(gkey):
+                    if self.waiting.reject(
+                            key, f'gang "{gkey}" rejected: {reason}',
+                            plugins=(self.name(),)):
+                        rejected += 1
+            if force or rejected or waited:
+                if self.metrics is not None:
+                    self.metrics.gangs_rejected.inc(reason)
+                self._observe_wait(gkey, "rejected")
+                self._denied[gkey] = self.now_fn() + self.gang_backoff_s
+                self._set_phase(gkey, POD_GROUP_PENDING)
+            return rejected
+        finally:
+            self._rejecting.discard(gkey)
+
+    # ------------------------------------------------------------ post bind
+
+    def post_bind(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        gkey = pod_group_key(pod)
+        if gkey is None:
+            return
+        if gkey in self._bound:
+            self._bound[gkey] += 1
+        else:
+            # seed includes this pod: the store already reflects the bind
+            self._bound[gkey] = self._members_in_store(gkey, bound_only=True)
+        n = self._bound[gkey]
+        pg = self._group(gkey)
+        if pg is None:
+            return
+        phase = POD_GROUP_RUNNING if n >= pg.min_member else POD_GROUP_SCHEDULING
+        if phase == POD_GROUP_RUNNING:
+            self._group_ts.pop(gkey, None)
+            self._denied.pop(gkey, None)
+        self._update_status(pg, phase=phase, scheduled=n)
+
+    def _set_phase(self, gkey: str, phase: str) -> None:
+        pg = self._group(gkey)
+        if pg is not None and pg.phase != phase:
+            self._update_status(pg, phase=phase, scheduled=pg.scheduled)
+
+    def _update_status(self, pg: PodGroup, phase: str, scheduled: int) -> None:
+        if self.client is None:
+            return
+        if pg.phase == phase and pg.scheduled == scheduled:
+            return
+        from ...apiserver.store import Conflict, NotFound
+
+        try:
+            self.client.update_object("PodGroup", dataclasses.replace(
+                pg, phase=phase, scheduled=scheduled))
+        except (Conflict, NotFound):
+            pass  # concurrent writer / group deleted: status is advisory

@@ -12,6 +12,7 @@ from typing import Callable, Dict, List, Tuple
 
 from .plugins import names
 from .plugins.basic import NodeName, NodePorts, NodeUnschedulable, PrioritySort, TaintToleration
+from .plugins.coscheduling import Coscheduling
 from .plugins.defaultbinder import DefaultBinder
 from .plugins.defaultpreemption import DefaultPreemption
 from .plugins.dynamicresources import DynamicResources
@@ -78,6 +79,13 @@ def in_tree_registry() -> Dict[str, Factory]:
         names.VOLUME_BINDING: lambda h, a: VolumeBinding(client=h.get("client")),
         names.DYNAMIC_RESOURCES: lambda h, a: DynamicResources(
             client=h.get("client"), metrics=h.get("metrics")),
+        names.COSCHEDULING: lambda h, a: Coscheduling(
+            client=h.get("client"), metrics=h.get("metrics"),
+            waiting=h.get("waiting_pods"), now_fn=h.get("now_fn"),
+            permit_timeout_s=a.get(
+                "permit_timeout_s", Coscheduling.DEFAULT_PERMIT_TIMEOUT_S),
+            gang_backoff_s=a.get(
+                "gang_backoff_s", Coscheduling.DEFAULT_GANG_BACKOFF_S)),
         names.DEFAULT_PREEMPTION: lambda h, a: DefaultPreemption(
             snapshot_fn=h.get("snapshot_fn"),
             pdb_lister=(h["client"].list_pdbs if h.get("client") is not None and hasattr(h["client"], "list_pdbs") else None),
@@ -90,8 +98,13 @@ def in_tree_registry() -> Dict[str, Factory]:
 
 # (plugin name, weight) per extension point — default_plugins.go:32-51.
 DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
-    "queue_sort": [(names.PRIORITY_SORT, 0)],
+    # Coscheduling owns QueueSort (gang members sort adjacently); for
+    # groupless pods its key degrades EXACTLY to PrioritySort's
+    # (-priority, queue timestamp) order
+    "queue_sort": [(names.COSCHEDULING, 0)],
     "pre_filter": [
+        # first: the gang quorum gate is the cheapest possible fast-fail
+        (names.COSCHEDULING, 0),
         (names.NODE_AFFINITY, 0),
         (names.NODE_PORTS, 0),
         (names.NODE_RESOURCES_FIT, 0),
@@ -133,9 +146,10 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.POD_TOPOLOGY_SPREAD, 2),
         (names.TAINT_TOLERATION, 3),
     ],
-    "reserve": [(names.VOLUME_BINDING, 0), (names.DYNAMIC_RESOURCES, 0)],
-    "permit": [],
+    "reserve": [(names.VOLUME_BINDING, 0), (names.DYNAMIC_RESOURCES, 0),
+                (names.COSCHEDULING, 0)],
+    "permit": [(names.COSCHEDULING, 0)],
     "pre_bind": [(names.VOLUME_BINDING, 0)],
     "bind": [(names.DEFAULT_BINDER, 0)],
-    "post_bind": [(names.DYNAMIC_RESOURCES, 0)],
+    "post_bind": [(names.DYNAMIC_RESOURCES, 0), (names.COSCHEDULING, 0)],
 }

@@ -26,6 +26,13 @@ from .registry import DEFAULT_PLUGINS, in_tree_registry
 from .types import ClusterEvent, Diagnosis, NodeInfo, QueuedPodInfo
 
 
+# CycleState key carrying a WAIT permit's timeout to the scheduler, and the
+# default park duration when a plugin returns WAIT with no timeout
+# (runtime/framework.go maxTimeout is 15min; gangs use far shorter)
+PERMIT_TIMEOUT_KEY = "Permit/waitTimeout"
+DEFAULT_PERMIT_WAIT_S = 600.0
+
+
 class PodNominator:
     """Tracks preemption nominations (framework/interface.go:690;
     nominated pods get re-considered by filters before their victims exit)."""
@@ -212,6 +219,12 @@ class Framework:
         qs = self.points.get("queue_sort") or []
         if qs:
             plugin = qs[0][0]
+            # a QueueSort plugin exposing a heap-key extractor (the form
+            # SchedulingQueue consumes) drives ordering directly —
+            # Coscheduling's gang-adjacent key; plain Less-only plugins get
+            # the PrioritySort default
+            if hasattr(plugin, "sort_key"):
+                return plugin.sort_key
             return lambda qp: (-qp.pod.spec.priority, qp.timestamp)
         return lambda qp: qp.timestamp
 
@@ -387,12 +400,17 @@ class Framework:
     @_instrument_point("permit")
     def run_permit_plugins(self, state: CycleState, pod: Pod, node_name: str) -> Status:
         for plugin, _w in self.points.get("permit", []):
-            status, _timeout = self._timed(
+            status, timeout = self._timed(
                 state, "permit", plugin,
                 lambda: plugin.permit(state, pod, node_name))
             if not status.is_success() and status.code != fw.WAIT:
                 return status.with_plugin(plugin.name())
             if status.code == fw.WAIT:
+                # the plugin's wait timeout rides the CycleState so the
+                # scheduler can park the pod with a real deadline
+                # (waiting_pods_map.go's per-pod timer)
+                state.write(PERMIT_TIMEOUT_KEY,
+                            float(timeout) if timeout else DEFAULT_PERMIT_WAIT_S)
                 return Status(fw.WAIT).with_plugin(plugin.name())
         return OK
 

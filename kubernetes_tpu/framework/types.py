@@ -280,6 +280,7 @@ CSI_NODE = GVK("CSINode")
 RESOURCE_CLAIM = GVK("ResourceClaim")
 RESOURCE_CLASS = GVK("ResourceClass")
 POD_SCHEDULING_CONTEXT = GVK("PodSchedulingContext")
+POD_GROUP = GVK("PodGroup")
 WILDCARD = GVK("*")
 
 

@@ -52,6 +52,7 @@ GETTABLE = {
     "resourceclaimtemplate": "ResourceClaimTemplate",
     "podschedulingcontexts": "PodSchedulingContext",
     "podschedulingcontext": "PodSchedulingContext",
+    "podgroups": "PodGroup", "podgroup": "PodGroup", "pg": "PodGroup",
 }
 
 

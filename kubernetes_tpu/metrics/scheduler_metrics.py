@@ -99,6 +99,20 @@ class SchedulerMetrics:
             "ResourceClaim allocation outcomes by result.",
             ["result"],
         ))
+        # gang scheduling (Coscheduling/PodGroup): gang-level rejection
+        # events (timeout at Permit, a member's failure, a device batch that
+        # could not place the whole gang) and how long a gang's first member
+        # waits at Permit before the gang releases or is torn down
+        self.gangs_rejected = r.register(Counter(
+            "scheduler_gangs_rejected_total",
+            "PodGroup gang rejection events by reason.",
+            ["reason"],
+        ))
+        self.gang_wait_duration = r.register(Histogram(
+            "scheduler_gang_wait_duration_seconds",
+            "Gang wait at Permit from first parked member to release/rejection.",
+            ["result"],  # scheduled|rejected
+        ))
         # fault-tolerant wire path (backend/service.py): transport retries,
         # breaker state (0 closed, 1 half-open, 2 open), and cumulative time
         # spent scheduling through the sequential oracle because the device

@@ -1,0 +1,89 @@
+"""Batched gang placement: greedy all-or-nothing assignment on device.
+
+The pods axis the reference never batches (SURVEY §2 P8): a gang is a set
+of pods that must place together or not at all. This kernel takes the
+per-pod feasibility masks the batch program already computed (a node is
+feasible for a member iff its first-fail id is 0 at that pod's decision
+time) and greedily assigns every member of a gang to a DISTINCT feasible
+node in one vmapped pass — the multi-host TPU contract, one worker per
+host. The result is either a full assignment or a whole-gang miss; no
+partial assignment ever escapes the kernel, which is exactly the property
+the host commit needs to never strand a half-placed gang.
+
+Greedy order: members in batch (= queue) order; each member takes its
+preferred node (the batch program's own choice) when it is feasible and
+untaken, else the first feasible untaken slot. With the program's choices
+as preferences, a gang the program fully placed on distinct nodes
+reproduces those placements bit for bit — the kernel only "repairs" when
+preferences collide, and reports infeasibility when no distinct cover
+exists.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gang_assign(feasible: jax.Array, prefer: jax.Array,
+                active: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One gang: ``feasible`` [M, N] bool, ``prefer`` [M] int32 (-1 = no
+    preference), ``active`` [M] bool (False = padding member). Returns
+    (idx [M] int32, ok scalar bool); idx is all -1 unless every active
+    member got a distinct feasible node (all-or-nothing)."""
+    n = feasible.shape[1]
+
+    def step(taken, xs):
+        feas, pref, act = xs
+        avail = feas & ~taken
+        pref_c = jnp.clip(pref, 0, n - 1)
+        has_pref = (pref >= 0) & avail[pref_c]
+        # argmax over bool picks the FIRST available slot — deterministic,
+        # and irrelevant to parity (preferences win whenever they can)
+        fallback = jnp.argmax(avail).astype(jnp.int32)
+        any_avail = jnp.any(avail)
+        choice = jnp.where(has_pref, pref_c.astype(jnp.int32),
+                           jnp.where(any_avail, fallback, jnp.int32(-1)))
+        choice = jnp.where(act, choice, jnp.int32(-1))
+        taken = jnp.where(choice >= 0,
+                          taken.at[jnp.clip(choice, 0, n - 1)].set(True),
+                          taken)
+        return taken, choice
+
+    taken0 = jnp.zeros((n,), bool)
+    _taken, idx = lax.scan(step, taken0, (feasible, prefer, active))
+    ok = jnp.all((idx >= 0) | ~active)
+    return jnp.where(ok, idx, jnp.int32(-1)), ok
+
+
+# [G, M, N] feasibility, [G, M] preferences, [G, M] active
+# -> ([G, M] assignment, [G] ok): every gang in the batch in one pass
+assign_gangs = jax.vmap(gang_assign)
+
+
+def gang_assign_host(feasible, prefer, active) -> Tuple[list, bool]:
+    """Host oracle of ``gang_assign`` (parity tests): same greedy walk in
+    plain Python over one gang's numpy masks."""
+    taken = set()
+    out = []
+    for m in range(len(feasible)):
+        if not active[m]:
+            out.append(-1)
+            continue
+        pref = int(prefer[m])
+        if pref >= 0 and bool(feasible[m][pref]) and pref not in taken:
+            choice = pref
+        else:
+            choice = -1
+            for slot in range(len(feasible[m])):
+                if bool(feasible[m][slot]) and slot not in taken:
+                    choice = slot
+                    break
+        if choice < 0:
+            return [-1] * len(feasible), False
+        taken.add(choice)
+        out.append(choice)
+    return out, True

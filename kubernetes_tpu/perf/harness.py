@@ -181,6 +181,24 @@ def _pod_wrapper(i: int, prefix: str, params: dict):
     pw = make_pod(f"{prefix}-{i}",
                   namespace=str(params.get("namespace", "default")))
     pw.req(params.get("req", {"cpu": "900m", "memory": "2Gi"}))
+    if params.get("gang_size"):
+        # gang membership: consecutive pods share one PodGroup (the Runner
+        # creates it with minMember = gang size), and each member carries a
+        # required anti-affinity to its OWN group on the hostname key — the
+        # multi-host TPU contract, one worker per host
+        from ..api.types import LabelSelector, POD_GROUP_LABEL
+
+        size = int(params["gang_size"])
+        # group by the op-LOCAL ordinal: the global pod counter does not
+        # start at a multiple of the gang size, and a gang split across a
+        # misaligned boundary could never reach quorum
+        group = f"{prefix}-pg{int(params.get('_gang_ordinal', i)) // size}"
+        pw.pod_group(group)
+        if params.get("gang_anti_affinity", True):
+            pw.pod_affinity(
+                "kubernetes.io/hostname",
+                LabelSelector(match_labels={POD_GROUP_LABEL: group}),
+                anti=True)
     if params.get("node_affinity_in"):
         # pod-with-node-affinity.yaml: required NodeAffinity In terms
         for key, values in dict(params["node_affinity_in"]).items():
@@ -381,6 +399,24 @@ class Runner:
                         resource_class_name=cls_name,
                         selectors=dict(cfg.get("selectors") or {})))
 
+    def _ensure_pod_group(self, pod, params: dict) -> None:
+        """Create the PodGroup a gang pod's label references (minMember =
+        the gang size unless overridden) — the workload-side contract the
+        Coscheduling plugin gates on."""
+        from ..api.types import ObjectMeta, POD_GROUP_LABEL, PodGroup
+
+        name = pod.meta.labels.get(POD_GROUP_LABEL)
+        if not name:
+            return
+        key = f"{pod.meta.namespace}/{name}"
+        if self.store.get_object("PodGroup", key) is None:
+            self.store.create_object("PodGroup", PodGroup(
+                meta=ObjectMeta(name=name, namespace=pod.meta.namespace),
+                min_member=int(params.get("gang_min_member",
+                                          params.get("gang_size", 1))),
+                schedule_timeout_seconds=int(
+                    params.get("gang_timeout_s", 0))))
+
     def _pump_dra(self) -> None:
         """One resourceclaim controller round (claims materialize before the
         scheduler's next look at their pods)."""
@@ -393,6 +429,8 @@ class Runner:
         the shared Secret) — the persistentVolumeTemplatePath /
         defaultPodTemplatePath machinery of the reference harness."""
         pw = _pod_wrapper(self._pod_counter, prefix, params)
+        if params.get("gang_size"):
+            self._ensure_pod_group(pw.pod, params)
         if params.get("claims"):
             self._ensure_dra(params["claims"], pw.pod.meta.namespace)
         if params.get("secret_volume"):
@@ -431,8 +469,10 @@ class Runner:
         return pw.obj()
 
     def create_pods(self, count: int, prefix: str = "pod", **params) -> None:
-        for _ in range(count):
-            self.store.create_pod(self._make_pod(prefix, params))
+        for j in range(count):
+            self.store.create_pod(self._make_pod(
+                prefix, dict(params, _gang_ordinal=j)
+                if params.get("gang_size") else params))
             self._pod_counter += 1
         self._pump_dra()
 
@@ -503,8 +543,10 @@ class Runner:
         mcol.start()
         col = ThroughputCollector(scheduled_count, interval=collector_interval)
         col.start(time.monotonic())
-        for _ in range(count):
-            self.store.create_pod(self._make_pod(prefix, params))
+        for j in range(count):
+            self.store.create_pod(self._make_pod(
+                prefix, dict(params, _gang_ordinal=j)
+                if params.get("gang_size") else params))
             self._pod_counter += 1
         self._pump_dra()
         scheduled_before = scheduled_count()

@@ -258,6 +258,35 @@ def scheduling_dra(nodes=5000, init_pods=1000, measured=1000) -> dict:
     }
 
 
+def scheduling_gangs(nodes=5000, init_gangs=4, measured_gangs=8) -> dict:
+    """SchedulingGangs — the gang-scheduling acceptance workload: mixed
+    gang sizes 8 and 32 (the multi-host TPU job shapes), each member
+    carrying the pod-group label plus a required anti-affinity to its own
+    group on the hostname key (one worker per host). The Runner creates the
+    PodGroup objects (minMember = gang size) and the Coscheduling plugin
+    releases each gang atomically at Permit; on the tpu backend the gangs
+    ride the batched path end to end (gang kernel verdicts + whole-gang
+    commit), measured by SchedulingThroughput plus the
+    scheduler_gang_wait_duration_seconds / scheduler_gangs_rejected_total
+    family."""
+    base = {"req": {"cpu": "100m", "memory": "500Mi"}}
+    return {
+        "name": f"SchedulingGangs/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, "zones": 10},
+            {"opcode": "createPods", "count": init_gangs * 8,
+             "prefix": "initg8", "gang_size": 8, **base},
+            {"opcode": "createPods", "count": init_gangs * 32,
+             "prefix": "initg32", "gang_size": 32, **base},
+            {"opcode": "barrier"},
+            {"opcode": "measurePods", "count": measured_gangs * 8,
+             "prefix": "g8", "gang_size": 8, **base},
+            {"opcode": "measurePods", "count": measured_gangs * 32,
+             "prefix": "g32", "gang_size": 32, **base},
+        ],
+    }
+
+
 def preemption_basic(nodes=500, init_pods=2000, measured=500) -> dict:
     return {
         "name": f"PreemptionBasic/{nodes}Nodes",
@@ -405,6 +434,7 @@ TEST_CASES = {
     "SchedulingInTreePVs": scheduling_intree_pvs,
     "SchedulingCSIPVs": scheduling_csi_pvs,
     "SchedulingDRA": scheduling_dra,
+    "SchedulingGangs": scheduling_gangs,
     "MixedSchedulingBasePod": mixed_scheduling_base_pod,
     "TopologySpreading": topology_spreading,
     "Unschedulable": unschedulable,

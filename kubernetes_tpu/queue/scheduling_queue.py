@@ -42,6 +42,8 @@ class SchedulingQueue:
         cluster_event_map: Optional[Dict[ClusterEvent, Set[str]]] = None,
         now_fn=time.monotonic,
         metrics=None,
+        gang_key_fn=None,
+        gang_coactivation_interval: Optional[float] = None,
     ):
         # default QueueSort: priority desc then FIFO (PrioritySort)
         self.less_key = less_key or (lambda qp: (-qp.pod.spec.priority, qp.timestamp))
@@ -60,6 +62,18 @@ class SchedulingQueue:
         # transition + pending_pods gauge sync (metrics.go:120-134; both were
         # registered-but-dead before the queue owned them)
         self._metrics = metrics
+
+        # gang co-activation (Coscheduling): pod -> group key (or None).
+        # When a member enters the active path its unschedulable siblings
+        # move too, so a gang re-attempts TOGETHER instead of trickling in
+        # one member per event and timing out at Permit. The per-gang
+        # interval is the starvation guard: a flapping gang cannot spin the
+        # queue faster than the backoff it would otherwise pay.
+        self.gang_key_fn = gang_key_fn
+        self._gang_co_interval = (gang_coactivation_interval
+                                  if gang_coactivation_interval is not None
+                                  else initial_backoff)
+        self._gang_last_co: Dict[str, float] = {}
 
         self._counter = itertools.count()  # FIFO tie-break inside heaps
         self._active: List[Tuple[object, int, QueuedPodInfo]] = []
@@ -110,9 +124,15 @@ class SchedulingQueue:
     # ------------------------------------------------------------- API
 
     def add(self, pod: Pod) -> None:
-        """New unscheduled pod (informer add) → activeQ (:300)."""
+        """New unscheduled pod (informer add) → activeQ (:300). A gang
+        member's arrival co-activates its parked siblings — the late 32nd
+        pod of a gang must wake the 31 that failed PreFilter on it."""
         self._push_active(QueuedPodInfo(pod=pod, timestamp=self.now_fn()),
                           event="PodAdd")
+        if self.gang_key_fn is not None:
+            gkey = self.gang_key_fn(pod)
+            if gkey is not None:
+                self.activate_gang(gkey)
         self._sync_gauges()
 
     def update(self, old: Optional[Pod], new: Pod) -> None:
@@ -198,17 +218,51 @@ class SchedulingQueue:
 
     def move_all_to_active_or_backoff_queue(self, event: ClusterEvent) -> int:
         """Reactivate unschedulable pods whose failed plugins registered
-        interest in ``event`` (:614 MoveAllToActiveOrBackoffQueue)."""
+        interest in ``event`` (:614 MoveAllToActiveOrBackoffQueue). Moved
+        gang members pull their parked siblings along (a member waking
+        WITHOUT its gang just parks at Permit and times out)."""
         self.move_request_cycle = self.scheduling_cycle
         label = event.label or str(event.resource)
         moved = 0
+        gangs_moved: Set[str] = set()
         for key in list(self._unschedulable):
             qp = self._unschedulable[key]
             if self._pod_matches_event(qp, event):
                 del self._unschedulable[key]
                 self._requeue(qp, event=label)
                 moved += 1
+                if self.gang_key_fn is not None:
+                    gkey = self.gang_key_fn(qp.pod)
+                    if gkey is not None:
+                        gangs_moved.add(gkey)
+        for gkey in gangs_moved:
+            moved += self.activate_gang(gkey)
         if moved:
+            self._sync_gauges()
+        return moved
+
+    def activate_gang(self, gkey: str) -> int:
+        """Move every unschedulable member of ``gkey`` to active/backoff
+        (siblings travel together). Rate-limited per gang — the starvation
+        guard: a huge gang cycling through rejection cannot re-flood the
+        active queue faster than once per interval, so singleton pods keep
+        getting their turn."""
+        if self.gang_key_fn is None:
+            return 0
+        now = self.now_fn()
+        last = self._gang_last_co.get(gkey)
+        if last is not None and now - last < self._gang_co_interval:
+            return 0
+        moved = 0
+        for key in list(self._unschedulable):
+            qp = self._unschedulable[key]
+            if self.gang_key_fn(qp.pod) == gkey:
+                del self._unschedulable[key]
+                self._requeue(qp, event="GangActivate")
+                moved += 1
+        if moved:
+            self._gang_last_co[gkey] = now
+            self.move_request_cycle = self.scheduling_cycle
             self._sync_gauges()
         return moved
 

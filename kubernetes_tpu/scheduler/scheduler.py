@@ -46,6 +46,45 @@ MIN_FEASIBLE_NODES_TO_FIND = 100           # schedule_one.go:52
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # :56
 
 
+import dataclasses as _dc
+
+
+@_dc.dataclass
+class WaitingPod:
+    """One Permit-parked pod (runtime/waiting_pods_map.go waitingPod):
+    binding resumes on allow, unreserve+failure on reject, and the
+    housekeeping sweep rejects it once ``deadline`` passes."""
+
+    fwk: "Framework"
+    state: CycleState
+    pod: Pod
+    node_name: str
+    pod_cycle: int
+    t0: float
+    deadline: Optional[float] = None
+    plugin: str = ""  # the plugin that voted WAIT
+
+
+class WaitingPods:
+    """The Handle surface Permit plugins use to release or reject parked
+    pods (interface.go Handle.IterateOverWaitingPods/GetWaitingPod) —
+    Coscheduling drives whole-gang release/teardown through this."""
+
+    def __init__(self, sched: "Scheduler"):
+        self._sched = sched
+
+    def iterate(self) -> List[Tuple[str, Pod]]:
+        return [(k, wp.pod) for k, wp in self._sched.waiting_pods.items()]
+
+    def allow(self, pod_key: str) -> bool:
+        return self._sched.allow_waiting_pod(pod_key)
+
+    def reject(self, pod_key: str, reason: str = "rejected while waiting on permit",
+               plugins: Tuple[str, ...] = ()) -> bool:
+        return self._sched.reject_waiting_pod(pod_key, reason=reason,
+                                              plugins=plugins)
+
+
 class Scheduler:
     def __init__(
         self,
@@ -77,7 +116,8 @@ class Scheduler:
         self.metrics: Dict[str, int] = {
             "schedule_attempts": 0, "scheduled": 0, "unschedulable": 0, "errors": 0,
         }
-        self.waiting_pods: Dict[str, Tuple[Framework, CycleState, Pod, str, int]] = {}
+        self.waiting_pods: Dict[str, WaitingPod] = {}
+        self._reject_depth = 0  # nested teardown guard (reject_waiting_pod)
         self._last_cleanup = now_fn()
         self._last_unsched_flush = now_fn()
 
@@ -90,6 +130,8 @@ class Scheduler:
             "client": store,
             "extenders": self.extenders,
             "metrics": self.smetrics,
+            "now_fn": now_fn,
+            "waiting_pods": WaitingPods(self),
         }
         specs = profiles or {"default-scheduler": {}}
         self.profiles: Dict[str, Framework] = {}
@@ -110,6 +152,8 @@ class Scheduler:
             for ev, plugins in fwk.cluster_event_map().items():
                 event_map.setdefault(ev, set()).update(plugins)
         first = next(iter(self.profiles.values()))
+        from ..framework.plugins.coscheduling import pod_group_key
+
         self.queue = SchedulingQueue(
             less_key=first.queue_sort_key(),
             initial_backoff=pod_initial_backoff,
@@ -117,6 +161,7 @@ class Scheduler:
             cluster_event_map=event_map,
             now_fn=now_fn,
             metrics=self.smetrics,
+            gang_key_fn=pod_group_key,
         )
         self._add_all_event_handlers()
 
@@ -303,8 +348,18 @@ class Scheduler:
             status = fwk.run_permit_plugins(state, assumed, node_name)
         if status.code == fw.WAIT:
             # park: stays assumed; binding resumes on allow_waiting_pod
-            # (runtime/waiting_pods_map.go; WaitOnPermit schedule_one.go:199)
-            self.waiting_pods[assumed.key()] = (fwk, state, assumed, node_name, pod_cycle, t0)
+            # (runtime/waiting_pods_map.go; WaitOnPermit schedule_one.go:199).
+            # The WAIT plugin's timeout (clock-injected via now_fn) bounds
+            # the park: the housekeeping sweep rejects expired waiters.
+            from ..framework.runtime import DEFAULT_PERMIT_WAIT_S, PERMIT_TIMEOUT_KEY
+
+            try:
+                timeout = float(state.read(PERMIT_TIMEOUT_KEY))
+            except KeyError:
+                timeout = DEFAULT_PERMIT_WAIT_S
+            self.waiting_pods[assumed.key()] = WaitingPod(
+                fwk, state, assumed, node_name, pod_cycle, t0,
+                deadline=self.now_fn() + timeout, plugin=status.plugin)
             return
         if not status.is_success():
             fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
@@ -316,25 +371,67 @@ class Scheduler:
 
     def allow_waiting_pod(self, pod_key: str) -> bool:
         """Approve a Permit-parked pod: continue its binding cycle."""
-        entry = self.waiting_pods.pop(pod_key, None)
-        if entry is None:
+        wp = self.waiting_pods.pop(pod_key, None)
+        if wp is None:
             return False
-        fwk, state, assumed, node_name, pod_cycle, t0 = entry
-        self._binding_cycle(fwk, state, QueuedPodInfo(pod=assumed), assumed, node_name, pod_cycle, t0)
+        self._binding_cycle(wp.fwk, wp.state, QueuedPodInfo(pod=wp.pod),
+                            wp.pod, wp.node_name, wp.pod_cycle, wp.t0)
         return True
 
-    def reject_waiting_pod(self, pod_key: str) -> bool:
-        entry = self.waiting_pods.pop(pod_key, None)
-        if entry is None:
+    def reject_waiting_pod(self, pod_key: str,
+                           reason: str = "pod rejected while waiting on permit",
+                           plugins: Tuple[str, ...] = ()) -> bool:
+        """Reject a parked pod: unreserve (which may cascade — a gang
+        member's rejection tears down its siblings through Coscheduling's
+        Unreserve), forget the assume, and requeue with the rejecting
+        plugins attributed so event gating can reactivate it."""
+        wp = self.waiting_pods.pop(pod_key, None)
+        if wp is None:
             return False
-        fwk, state, assumed, node_name, pod_cycle, t0 = entry
-        fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
-        self.cache.forget_pod(assumed)
-        self._handle_scheduling_failure(
-            fwk, state, QueuedPodInfo(pod=assumed), Status.unschedulable("pod rejected while waiting on permit"),
-            Diagnosis(), pod_cycle,
-        )
+        self._reject_depth += 1
+        try:
+            wp.fwk.run_reserve_plugins_unreserve(wp.state, wp.pod, wp.node_name)
+            self.cache.forget_pod(wp.pod)
+            diagnosis = Diagnosis(
+                unschedulable_plugins=set(p for p in plugins if p))
+            self._handle_scheduling_failure(
+                wp.fwk, wp.state, QueuedPodInfo(pod=wp.pod),
+                Status.unschedulable(reason), diagnosis, wp.pod_cycle)
+            self.smetrics.observe_attempt(
+                "unschedulable", wp.fwk.profile_name, self.now_fn() - wp.t0)
+        finally:
+            self._reject_depth -= 1
+        # the forget released real capacity: pods parked on resource/port
+        # fit can now succeed — the assumed pod's release is the moral
+        # equivalent of an assigned-pod delete for queue gating. Fired once
+        # per teardown, not per member: a whole-gang cascade (unreserve →
+        # Coscheduling.reject_gang → nested rejects) re-enters this method,
+        # and only the OUTERMOST frame pays the full-queue move.
+        if self._reject_depth == 0:
+            self.queue.move_all_to_active_or_backoff_queue(qevents.POD_DELETE)
         return True
+
+    def _sweep_expired_waiting_pods(self, now: float) -> None:
+        """WaitOnPermit timeout (waiting_pods_map.go per-pod timer, driven
+        inline off the housekeeping tick): a parked pod past its deadline is
+        rejected — for a gang member the WHOLE gang is torn down first so no
+        partial gang survives the timeout."""
+        expired = [(k, wp) for k, wp in self.waiting_pods.items()
+                   if wp.deadline is not None and now >= wp.deadline]
+        if not expired:
+            return
+        from ..framework.plugins.coscheduling import pod_group_key
+
+        for key, wp in expired:
+            if key not in self.waiting_pods:
+                continue  # a gang cascade already rejected it
+            gkey = pod_group_key(wp.pod)
+            plugin = wp.fwk.plugin("Coscheduling") if gkey else None
+            if gkey is not None and plugin is not None:
+                plugin.reject_gang(gkey, "timeout")
+            if key in self.waiting_pods:  # no cascade (bare framework)
+                self.reject_waiting_pod(key, reason="permit wait timeout",
+                                        plugins=(wp.plugin,))
 
     def _periodic_housekeeping(self) -> None:
         """The reference's background tickers, driven inline: assume-expiry
@@ -343,6 +440,7 @@ class Scheduler:
         now = self.now_fn()
         if now - self._last_cleanup >= 1.0:
             self._last_cleanup = now
+            self._sweep_expired_waiting_pods(now)
             for pod in self.cache.cleanup(now):
                 current = self.store.get_pod(pod.key())
                 if current is not None and not current.spec.node_name:

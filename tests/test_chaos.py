@@ -186,6 +186,97 @@ class _WireRig:
         self.server.shutdown()
 
 
+class TestGangChaos:
+    """Gang all-or-nothing under device failure: a sidecar killed and
+    restarted mid-gang must never leave a partially-bound gang, and the
+    epoch resync must re-place the gang byte-identically to an uncrashed
+    run (ISSUE 4 acceptance, chaos half)."""
+
+    GROUP = "train"
+
+    def _gang_workload(self, store, n=4):
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name=self.GROUP), min_member=n,
+            schedule_timeout_seconds=30))
+        for i in range(n):
+            store.create_pod(
+                make_pod(f"{self.GROUP}-{i}").req({"cpu": "1", "memory": "1Gi"})
+                .pod_group(self.GROUP).obj())
+
+    def _gang_bound_count(self, store):
+        from kubernetes_tpu.api.types import POD_GROUP_LABEL
+
+        return sum(1 for p in store.pods.values()
+                   if p.meta.labels.get(POD_GROUP_LABEL) == self.GROUP
+                   and p.spec.node_name)
+
+    def test_device_kill_mid_gang_no_partial_bind(self):
+        """The service crashes while the gang's batch is on the wire: after
+        the stale-epoch resync the WHOLE gang lands — at no settle point is
+        the gang partially bound, and placements match an uncrashed run
+        byte for byte."""
+        # run A: healthy baseline
+        rig_a = _WireRig()
+        try:
+            self._gang_workload(rig_a.store)
+            rig_a.sched.run_until_settled()
+            bound_a = _bound(rig_a.store)
+        finally:
+            rig_a.close()
+        assert len(bound_a) == 4
+
+        # run B: the sidecar dies mid-batch (crash + fresh empty epoch)
+        plan = FaultPlan().crash("schedule_batch")
+        rig_b = _WireRig(fault_plan=plan)
+        try:
+            self._gang_workload(rig_b.store)
+            rig_b.sched.run_until_settled()
+            assert self._gang_bound_count(rig_b.store) in (0, 4)  # atomic
+            bound_b = _bound(rig_b.store)
+            assert rig_b.server.binding.restarts == 1
+            assert rig_b.sched.resyncs == 1
+            assert len(rig_b.sched.waiting_pods) == 0
+            assert rig_b.sched.breaker.state == circuit.CLOSED
+        finally:
+            rig_b.close()
+        assert bound_b == bound_a  # byte-identical across the crash
+
+    def test_crash_between_gang_waves_resyncs_atomically(self):
+        """First gang lands, the device restarts, a second gang lands on
+        the resynced mirror: both gangs complete, neither ever partial,
+        zero degraded fallback."""
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
+        plan = FaultPlan()
+        rig = _WireRig(fault_plan=plan)
+        try:
+            self._gang_workload(rig.store)
+            rig.sched.run_until_settled()
+            assert self._gang_bound_count(rig.store) == 4
+            plan.crash("apply_deltas")  # dies between the waves
+            rig.store.create_object("PodGroup", PodGroup(
+                meta=ObjectMeta(name="second"), min_member=2,
+                schedule_timeout_seconds=30))
+            for i in range(2):
+                rig.store.create_pod(
+                    make_pod(f"second-{i}").req({"cpu": "500m"})
+                    .pod_group("second").obj())
+            rig.sched.run_until_settled()
+            bound = _bound(rig.store)
+            assert len(bound) == 6
+            assert rig.sched.resyncs == 1
+            assert rig.sched.degraded_pods == 0
+            # capacity respected on the resynced base: no double-commit
+            per_node = {}
+            for n in bound.values():
+                per_node[n] = per_node.get(n, 0) + 1
+            assert all(v <= 4 for v in per_node.values()), per_node
+        finally:
+            rig.close()
+
+
 class TestDeviceServiceFaults:
     """The device-failure acceptance suite: sidecar killed mid-batch,
     restart + epoch resync, breaker-open oracle degradation and heal."""

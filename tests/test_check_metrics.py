@@ -1,7 +1,8 @@
-"""tools/check_metrics.py as a tier-1 gate: every metric registered in
-SchedulerMetrics must be observed/set somewhere outside its definition, so
-defined-but-dead metrics (the family this PR wired: extension-point/plugin
-durations, queue_incoming_pods, pending_pods, ...) can't reappear."""
+"""tools/check_metrics.py + tools/check_markers.py as tier-1 gates: every
+metric registered in SchedulerMetrics must be observed/set somewhere outside
+its definition (defined-but-dead metrics can't reappear), and every
+perf-scale test (>= 1k nodes / TEST_CASES defaults) must carry the ``slow``
+marker so tier-1's ``-m 'not slow'`` budget holds."""
 
 import importlib.util
 import os
@@ -10,13 +11,18 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TOOL = os.path.join(REPO, "tools", "check_metrics.py")
+MARKER_TOOL = os.path.join(REPO, "tools", "check_markers.py")
 
 
-def _load_tool():
-    spec = importlib.util.spec_from_file_location("check_metrics", TOOL)
+def _load(path, name):
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_tool():
+    return _load(TOOL, "check_metrics")
 
 
 def test_no_dead_metrics():
@@ -62,3 +68,50 @@ def test_detects_a_dead_metric(tmp_path, monkeypatch):
     attrs, dead = mod.find_dead_metrics()
     assert set(attrs) == {"live_metric", "helper_metric", "dead_metric"}
     assert dead == ["dead_metric"]
+
+
+def test_gang_metrics_registered_and_live():
+    """The gang metrics are in the checked roster AND fed (the check's
+    coverage extends to them: a future refactor that orphans either fails
+    tier-1 like any other dead metric)."""
+    mod = _load_tool()
+    attrs, dead = mod.find_dead_metrics()
+    assert "gangs_rejected" in attrs
+    assert "gang_wait_duration" in attrs
+    assert dead == []
+
+
+def test_marker_lint_clean():
+    p = subprocess.run([sys.executable, MARKER_TOOL], cwd=REPO,
+                       capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "ok:" in p.stdout
+
+
+def test_marker_lint_detects_unmarked_perf_test(tmp_path):
+    """Negative control: an unmarked >=1k-node test (and a TEST_CASES
+    default-size call) are both flagged; the slow-marked twin is not."""
+    mod = _load(MARKER_TOOL, "check_markers")
+    bad = tmp_path / "test_scale.py"
+    bad.write_text(
+        "import pytest\n"
+        "def test_big_cluster(run):\n"
+        "    run(nodes=5000)\n"
+        "def test_defaults():\n"
+        "    tc = TEST_CASES['SchedulingBasic']()\n"
+        "@pytest.mark.slow\n"
+        "def test_big_marked(run):\n"
+        "    run(nodes=5000)\n"
+        "def test_small(run):\n"
+        "    run(nodes=16)\n"
+        "class TestScale:\n"
+        "    def test_in_class(self, run):\n"
+        "        run(nodes=2000)\n"
+        "@pytest.mark.slow\n"
+        "class TestMarkedScale:\n"
+        "    def test_covered(self, run):\n"
+        "        run(nodes=2000)\n"
+    )
+    out = mod.find_unmarked([str(bad)])
+    names = {v.split()[-1] for v in out}
+    assert names == {"test_big_cluster", "test_defaults", "test_in_class"}

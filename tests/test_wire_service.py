@@ -129,3 +129,111 @@ def test_wire_matches_in_process_placements():
         assert _bound(store_w) == _bound(store_l)
     finally:
         server.shutdown()
+
+
+def test_wire_dra_mask_claim_pods_stay_on_wire():
+    """ROADMAP PR 1 follow-up closed: claim-bearing pods ride the wire
+    backend (the request ships resolved selector rows; the server builds
+    the dra_mask against its own attribute table) — zero oracle fallback,
+    allocations identical to the sequential path."""
+    from kubernetes_tpu.api.types import ObjectMeta, ResourceClaim, ResourceClass
+    from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+    def build(store):
+        for i in range(6):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 20})
+                .device_attrs({"tpu.dev/cores": 8 if i % 2 else 2,
+                               "tpu.dev/gen": "v5" if i % 2 else "v4"}).obj())
+        store.create_object("ResourceClass", ResourceClass(
+            meta=ObjectMeta(name="tpu.example.com", namespace=""),
+            driver_name="tpu.example.com", selectors={"tpu.dev/gen": "v5"}))
+        for i in range(4):
+            store.create_object("ResourceClaim", ResourceClaim(
+                meta=ObjectMeta(name=f"c{i}"),
+                resource_class_name="tpu.example.com",
+                selectors={"tpu.dev/cores": ">=4"}))
+            store.create_pod(make_pod(f"claim-{i}").req({"cpu": "300m"})
+                             .resource_claim("dev", claim_name=f"c{i}").obj())
+            store.create_pod(make_pod(f"plain-{i}").req({"cpu": "300m"}).obj())
+
+    service = DeviceService(batch_size=32)
+    server, port = serve(service)
+    try:
+        store_w = ClusterStore()
+        sched_w = WireScheduler(store_w, endpoint=f"http://127.0.0.1:{port}",
+                                batch_size=16)
+        build(store_w)
+        sched_w.run_until_settled()
+        assert sched_w.metrics["scheduled"] == 8
+        assert sched_w.degraded_pods == 0
+
+        store_o = ClusterStore()
+        sched_o = Scheduler(store_o)
+        build(store_o)
+        sched_o.run_until_settled()
+        assert _bound(store_w) == _bound(store_o)
+        claims_w = {k: (c.allocated_node, c.reserved_for)
+                    for k, c in store_w.resource_claims.items()}
+        claims_o = {k: (c.allocated_node, c.reserved_for)
+                    for k, c in store_o.resource_claims.items()}
+        assert claims_w == claims_o
+        # only v5 nodes (odd indices) hold claim pods
+        for k, node in _bound(store_w).items():
+            if k.startswith("claim"):
+                assert int(node[1:]) % 2 == 1, (k, node)
+    finally:
+        server.shutdown()
+
+
+def test_wire_health_verb_and_half_open_probe():
+    """The Health RPC answers cheaply with the process identity, and a
+    half-open breaker probes through it instead of pushing a full batch."""
+    from kubernetes_tpu.backend import circuit
+    from kubernetes_tpu.testing.faults import FaultPlan
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    service = DeviceService(batch_size=16)
+    plan = FaultPlan()
+    server, port = serve(service, fault_plan=plan)
+    try:
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = WireScheduler(
+            store, endpoint=f"http://127.0.0.1:{port}", batch_size=8,
+            now_fn=clock, sleep_fn=lambda s: clock.advance(s),
+            fault_plan=plan, wire_max_retries=0, breaker_threshold=1,
+            breaker_reset_s=5.0)
+        out = sched.client.health()
+        assert out["status"] == "serving"
+        assert out["epoch"] == service.epoch
+
+        for i in range(2):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        # open the breaker: one dropped push
+        plan.drop(count=1)
+        store.create_pod(make_pod("p0").req({"cpu": "500m"}).obj())
+        sched.run_until_settled()
+        assert sched.breaker.state == circuit.OPEN
+        assert sched.metrics["scheduled"] == 1  # degraded via oracle
+
+        # half-open probe: health is the FIRST wire op attempted, and a
+        # dead service fails it without burning a batch push
+        plan.drop(op="health", count=1)
+        clock.advance(5.5)
+        store.create_pod(make_pod("p1").req({"cpu": "500m"}).obj())
+        sched.run_until_settled()
+        assert ("client", "health", "drop") in plan.log
+        assert sched.breaker.state == circuit.OPEN  # probe failed, re-opened
+        assert sched.metrics["scheduled"] == 2      # batch still landed
+
+        # next probe succeeds -> breaker closes, wire path resumes
+        clock.advance(5.5)
+        store.create_pod(make_pod("p2").req({"cpu": "500m"}).obj())
+        sched.run_until_settled()
+        assert sched.breaker.state == circuit.CLOSED
+        assert sched.metrics["scheduled"] == 3
+        assert service.batch_counter > 0
+    finally:
+        server.shutdown()

@@ -1,0 +1,118 @@
+#!/usr/bin/env python3
+"""Marker lint (tier-1; run by tests/test_check_metrics.py): a perf-scale
+test must carry ``@pytest.mark.slow``.
+
+Tier-1 runs ``-m 'not slow'`` under a hard timeout; one unmarked
+reference-scale workload test (5000 nodes on the CPU fallback) blows the
+whole gate. A test function counts as perf-scale when it
+
+  * passes ``nodes=<constant >= 1000>`` to any call, or
+  * invokes a ``TEST_CASES[...](...)`` workload factory WITHOUT a ``nodes``
+    override — the factory defaults are the reference 5000Nodes sizes.
+
+A test is "marked slow" when the function, its class, or the module-level
+``pytestmark`` carries ``pytest.mark.slow``.
+
+Usage: ``python tools/check_markers.py`` — exits 0 when clean, 1 with a
+listing otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TESTS = os.path.join(REPO, "tests")
+
+PERF_SCALE_NODES = 1000
+
+
+def _is_slow_mark(node: ast.AST) -> bool:
+    """True for ``pytest.mark.slow`` (bare or called)."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    return (isinstance(node, ast.Attribute) and node.attr == "slow"
+            and isinstance(node.value, ast.Attribute)
+            and node.value.attr == "mark")
+
+
+def _has_slow(decorators) -> bool:
+    return any(_is_slow_mark(d) for d in decorators)
+
+
+def _module_marked_slow(tree: ast.Module) -> bool:
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "pytestmark":
+                    for cand in ast.walk(node.value):
+                        if _is_slow_mark(cand):
+                            return True
+    return False
+
+
+def _is_perf_scale(fn: ast.FunctionDef) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        kw_names = {k.arg for k in node.keywords}
+        for k in node.keywords:
+            if (k.arg == "nodes" and isinstance(k.value, ast.Constant)
+                    and isinstance(k.value.value, int)
+                    and k.value.value >= PERF_SCALE_NODES):
+                return True
+        # TEST_CASES["X"](...) with the reference-size defaults
+        if (isinstance(node.func, ast.Subscript)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "TEST_CASES"
+                and "nodes" not in kw_names):
+            return True
+    return False
+
+
+def find_unmarked(paths=None):
+    violations = []
+    paths = paths or sorted(
+        os.path.join(TESTS, f) for f in os.listdir(TESTS)
+        if f.startswith("test_") and f.endswith(".py"))
+    for path in paths:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+        if _module_marked_slow(tree):
+            continue
+        scopes = [(tree.body, False)]
+        for cls in tree.body:
+            if isinstance(cls, ast.ClassDef):
+                scopes.append((cls.body, _has_slow(cls.decorator_list)))
+        for body, class_slow in scopes:
+            for fn in body:
+                if not isinstance(fn, ast.FunctionDef):
+                    continue
+                if not fn.name.startswith("test_"):
+                    continue
+                if class_slow or _has_slow(fn.decorator_list):
+                    continue
+                if _is_perf_scale(fn):
+                    violations.append(
+                        f"{os.path.relpath(path, REPO)}:{fn.lineno} "
+                        f"{fn.name}")
+    return violations
+
+
+def main() -> int:
+    violations = find_unmarked()
+    if violations:
+        print(f"UNMARKED PERF-SCALE TESTS ({len(violations)}): "
+              f">= {PERF_SCALE_NODES} nodes (or TEST_CASES defaults) "
+              "without @pytest.mark.slow:")
+        for v in violations:
+            print(f"  - {v}")
+        return 1
+    print("ok: every perf-scale test carries the slow marker")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
