@@ -70,9 +70,65 @@ def make_pods(store, name_prefix, n):
         )
 
 
+def _critical_path_from_spans(spans):
+    """Span-based critical-path breakdown (ROADMAP PR2 follow-up): per
+    scheduling.cycle span, attribute its wall time to child phase spans
+    (sync/encode/dispatch + the overlapped previous batch's commit.wait /
+    host.commit / commit.reconcile, which land inside the cycle by
+    pipelining design) plus an "other" residual, and report which phase
+    DOMINATED each cycle. Commit spans outside any cycle (queue-empty
+    drains) are aggregated under "drain". The per-phase shares complement
+    batch_phase_ms: means say where time goes on average, the dominant
+    counts say what the slowest path through a typical cycle actually is."""
+    by_id = {s.span_id: s for s in spans}
+    cycles = []
+    children = {}
+    for s in spans:
+        parent = by_id.get(s.parent_id) if s.parent_id else None
+        if s.name == "scheduling.cycle":
+            cycles.append(s)
+        elif parent is not None and parent.name == "scheduling.cycle":
+            children.setdefault(parent.span_id, []).append(s)
+    if not cycles:
+        return None
+    dominant = {}
+    totals = {}
+    wall_total = 0.0
+    for c in cycles:
+        wall = c.duration_s
+        wall_total += wall
+        phase_ms = {}
+        for ch in children.get(c.span_id, ()):
+            phase_ms[ch.name] = phase_ms.get(ch.name, 0.0) + ch.duration_s
+        other = wall - sum(phase_ms.values())
+        if other > 0:
+            phase_ms["other"] = other
+        for name, dur in phase_ms.items():
+            totals[name] = totals.get(name, 0.0) + dur
+        if phase_ms:
+            top = max(phase_ms, key=phase_ms.get)
+            dominant[top] = dominant.get(top, 0) + 1
+    # commits that landed outside a cycle (drain at queue-empty / settle end)
+    drain = sum(s.duration_s for s in spans
+                if s.name.startswith(("device.commit", "host.commit"))
+                and (s.parent_id not in by_id
+                     or by_id[s.parent_id].name != "scheduling.cycle"))
+    out = {
+        "cycles": len(cycles),
+        "dominant": dict(sorted(dominant.items(), key=lambda kv: -kv[1])),
+        "share_pct": {name: round(100.0 * t / max(wall_total, 1e-9), 1)
+                      for name, t in sorted(totals.items(), key=lambda kv: -kv[1])},
+        "cycle_wall_ms_mean": round(wall_total / len(cycles) * 1000, 2),
+    }
+    if drain > 0:
+        out["drain_commit_ms_total"] = round(drain * 1000, 2)
+    return out
+
+
 def run_tpu(n_nodes, n_init, n_measured, batch):
     from kubernetes_tpu.apiserver import ClusterStore
     from kubernetes_tpu.backend import TPUScheduler
+    from kubernetes_tpu.utils import tracing
 
     store = ClusterStore()
     # comparer on (every 256th placement re-checked by the scalar oracle):
@@ -95,10 +151,20 @@ def run_tpu(n_nodes, n_init, n_measured, batch):
     # snapshot sums/counts so phase means cover ONLY the measured phase
     # (the init phase pays the one-off jit compile)
     pre = {ph: (dur.sum(ph), dur.count(ph)) for ph in phase_names}
+    # span capture over the measured phase only (in-memory, ~10 spans per
+    # batch): feeds the critical-path breakdown below
+    own_tracer = tracing.get() is None
+    exporter = tracing.enable(tracing.InMemoryExporter()).exporter \
+        if own_tracer else None
+    stall_pre = sched.smetrics.pipeline_stall_seconds.labels()
     make_pods(store, "meas", n_measured)
     t0 = time.perf_counter()
     sched.run_until_settled()
     dt = time.perf_counter() - t0
+    critical = None
+    if exporter is not None:
+        critical = _critical_path_from_spans(exporter.spans)
+        tracing.disable()
     assert sched.metrics["scheduled"] == n_init + n_measured, sched.metrics
     assert not sched.settle_abandoned, "measured phase abandoned with pods pending"
     latency = {
@@ -118,7 +184,17 @@ def run_tpu(n_nodes, n_init, n_measured, batch):
         # where the sizer converged — p99 should sit within the deadline
         "batch_deadline_ms": round(sched.sizer.deadline_s * 1000, 1),
         "batch_target_final": sched.sizer.target(),
+        # async-commit-pipeline evidence: ring depth, seconds the commit
+        # site blocked on device execution over the MEASURED phase only
+        # (init/jit-compile waits snapshotted out, like the phase means),
+        # and where the stall controller pinned the bucket
+        "pipeline_depth": sched.pipeline_depth,
+        "pipeline_stall_s": round(
+            sched.smetrics.pipeline_stall_seconds.labels() - stall_pre, 3),
+        "stall_target_ms": round(sched.sizer.stall_target_s * 1000, 1),
     }
+    if critical is not None:
+        evidence["critical_path"] = critical
     return n_measured / dt, latency, phases, evidence
 
 

@@ -184,6 +184,41 @@ class BatchResult(NamedTuple):
     final_class_req: Optional[jax.Array] = None      # [N, C, R] int32
     # evolved adaptive-sampling rotation start (None when sampling disabled)
     final_sample_start: Optional[jax.Array] = None   # [] int32
+    # PACKED RESULT BLOCK: one contiguous [P, 1 + N/4] int32 array carrying
+    # everything the host commit needs per pod — column 0 is node_idx, the
+    # rest is the [P, N] int8 first_fail table bitcast to int32 words. The
+    # scheduler issues copy_to_host_async() on THIS array at dispatch, so
+    # landing a batch is a single already-overlapped transfer instead of
+    # independent node_idx/first_fail materializations (each a full relay
+    # round-trip on the axon TPU tunnel). None on the sharded core path
+    # (mesh.py), whose callers keep the per-array reads.
+    packed: Optional[jax.Array] = None               # [P, 1 + ceil(N/4)] int32
+
+
+def pack_result_block(node_idx: jax.Array, first_fail: jax.Array) -> jax.Array:
+    """[P, 1 + ceil(N/4)] int32: node_idx in column 0, the int8 first_fail
+    rows bitcast into int32 words after it. Traced into the batch program
+    (schedule_batch's jit), so the packing is free relative to a transfer:
+    one fused device buffer replaces two independent host reads."""
+    p, n = first_fail.shape
+    pad = (-n) % 4
+    if pad:
+        first_fail = jnp.pad(first_fail, ((0, 0), (0, pad)))
+    words = lax.bitcast_convert_type(
+        first_fail.reshape(p, (n + pad) // 4, 4), jnp.int32)
+    return jnp.concatenate([node_idx[:, None], words], axis=1)
+
+
+def unpack_result_block(packed, n_nodes: int):
+    """(node_idx [P] int32, first_fail [P, N] int8) from one materialized
+    packed block. The np.asarray here is THE blocking device read of a batch
+    commit; everything after is host-side reinterpretation (the int32→int8
+    view matches lax.bitcast_convert_type byte order on both CPU and TPU —
+    pinned by tests/test_kernel_parity.py)."""
+    arr = np.asarray(packed)
+    node_idx = arr[:, 0]
+    ff = np.ascontiguousarray(arr[:, 1:]).view(np.int8)
+    return node_idx, ff.reshape(arr.shape[0], -1)[:, :n_nodes]
 
 
 def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
@@ -1364,13 +1399,18 @@ def schedule_batch(
     extra_mask: Optional[jax.Array] = None,
     dra_mask: Optional[jax.Array] = None,
 ) -> BatchResult:
-    return schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
-                               pallas=pallas, topo_carry=topo_carry,
-                               sample_k=sample_k, sample_start=sample_start,
-                               topo_mode=topo_mode, vd_override=vd_override,
-                               host_key=host_key, spec_decode=spec_decode,
-                               ports_enabled=ports_enabled,
-                               extra_mask=extra_mask, dra_mask=dra_mask)
+    res = schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
+                              pallas=pallas, topo_carry=topo_carry,
+                              sample_k=sample_k, sample_start=sample_start,
+                              topo_mode=topo_mode, vd_override=vd_override,
+                              host_key=host_key, spec_decode=spec_decode,
+                              ports_enabled=ports_enabled,
+                              extra_mask=extra_mask, dra_mask=dra_mask)
+    # fuse the host-commit payload into one block here (inside the jit), so
+    # every single-device variant — scan, speculative rounds, pallas —
+    # returns it; the sharded core entry (parallel/mesh.py) bypasses this
+    # wrapper and keeps packed=None
+    return res._replace(packed=pack_result_block(res.node_idx, res.first_fail))
 
 
 def spec_decode_eligible(sample_k) -> bool:

@@ -402,7 +402,15 @@ class DeviceState:
                     and np.array_equal(row["class_req"], creq_m[slot])):
                 self._uploaded_gen[name] = ni.generation
                 self.rows_elided += 1
-                self.sig_table.recount_node(slot, ni)
+                # per-row recount is the reconcile constant-factor hot spot
+                # (O(sigs × pods-on-node) python per elided row); with no
+                # sigs/terms registered the counts are all zero and only
+                # the _slot_pods bookkeeping matters
+                st = self.sig_table
+                if st.n_sigs > 1 or st.n_terms > 1:
+                    st.recount_node(slot, ni)
+                else:
+                    st.track_slot_pods(slot, ni)
             else:
                 left += 1
                 pending.add(name)

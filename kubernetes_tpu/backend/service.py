@@ -310,7 +310,17 @@ class DeviceService:
             # these placements; its next delta push re-encodes any row the
             # host view disagrees on and the content diff repairs it
             with tracing.span("device.commit", batch=len(pods)):
-                node_idx = np.asarray(result.node_idx)  # THE blocking read
+                # THE blocking read: the packed result block lands node_idx
+                # AND first_fail in one materialization (the per-array reads
+                # were one relay round-trip each on the TPU tunnel)
+                if result.packed is not None:
+                    from .batch import unpack_result_block
+
+                    node_idx, ff = unpack_result_block(
+                        result.packed, self.device.caps.nodes)
+                else:
+                    node_idx = np.asarray(result.node_idx)
+                    ff = None
                 self.device.adopt_device(result)
                 self.device.adopt_commits(result, host_pb, node_idx)
             slot_names = self.device.slot_to_name()
@@ -330,14 +340,13 @@ class DeviceService:
                     best = np.asarray(pres.best)
                 except Exception:  # noqa: BLE001 — hints are optional
                     screen = best = None
-            ff = None
             results: List[dict] = []
             for i in range(len(pods)):
                 idx = int(node_idx[i])
                 if idx >= 0 and idx in slot_names:
                     results.append({"nodeName": slot_names[idx]})
                     continue
-                if ff is None:
+                if ff is None:  # packless (sharded-core) results only
                     ff = np.asarray(result.first_fail)
                 # REAL slots only — padding slots fail the fit check and
                 # would pollute the plugin attribution (queue gating)

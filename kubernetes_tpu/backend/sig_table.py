@@ -190,6 +190,22 @@ class SigTable:
             pod.__dict__["_sig_term_keys"] = cached
         return cached
 
+    def track_slot_pods(self, slot: int, ni: Optional[NodeInfo]) -> None:
+        """Bookkeeping-only recount for the reconcile fast path: with NO
+        registered signatures or terms both count tables are identically
+        zero, so a full recount_node exists only to keep ``_slot_pods``
+        fresh (the backfill source when a sig/term registers later). Any
+        pod that could register a sig/term reaches the table first — the
+        batched path registers at encode time (n_sigs/n_terms > 1 before
+        its commit reconciles, taking the full-recount branch), and the
+        fallback/sync paths recount on the next drain — so skipping the
+        per-pod matching loops here loses nothing."""
+        pods = list(ni.pods) if ni is not None else []
+        if pods:
+            self._slot_pods[slot] = pods
+        else:
+            self._slot_pods.pop(slot, None)
+
     def recount_node(self, slot: int, ni: Optional[NodeInfo]) -> None:
         """Recompute both count columns for one node slot from its pod list
         (called by DeviceState.sync for generation-dirty nodes)."""

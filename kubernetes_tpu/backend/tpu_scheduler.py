@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -51,6 +52,57 @@ _ATTRIBUTION_ORDER = (
 )
 
 
+class _DecayedFit:
+    """Exponentially-decayed least squares y(x) = a + b·x with compile-blip
+    outlier rejection — the one estimator behind both BatchSizer models
+    (pop→commit latency and commit-wait residual)."""
+
+    def __init__(self, a: float, b: float, decay: float = 0.95,
+                 floor: float = 0.0):
+        self.a = a
+        self.b = b
+        self.decay = decay
+        self.floor = floor  # prediction floor for the outlier test
+        self.updates = 0
+        self.outliers = 0  # consecutive rejected observations
+        self._sw = self._sx = self._sy = self._sxx = self._sxy = 0.0
+
+    def update(self, x: float, y: float) -> None:
+        if x <= 0:
+            return
+        # outlier rejection: a jit-compile cycle reads as 10-100x the model
+        # prediction; folding it in would shrink the target, switch buckets,
+        # trigger ANOTHER compile, and feed back into a collapse. Warmup
+        # observations (first few) always fold in, and THREE consecutive
+        # outliers mean the machine genuinely got slower — accept then.
+        predicted = self.a + self.b * x
+        if (self.updates >= 3 and y > 4.0 * max(predicted, self.floor)
+                and self.outliers < 2):
+            self.outliers += 1
+            return
+        self.outliers = 0
+        self.updates += 1
+        d = self.decay
+        self._sw = self._sw * d + 1.0
+        self._sx = self._sx * d + x
+        self._sy = self._sy * d + y
+        self._sxx = self._sxx * d + x * x
+        self._sxy = self._sxy * d + x * y
+        xm = self._sx / self._sw
+        ym = self._sy / self._sw
+        var = self._sxx / self._sw - xm * xm
+        if var > 1e-6:
+            cov = self._sxy / self._sw - xm * ym
+            slope = cov / var
+            # a degenerate or negative slope (one bucket size observed, or a
+            # machine-speed shift inverting the decayed samples) KEEPS the
+            # prior per-unit estimate — snapping b to a floor would read as
+            # "units are free" and blow the target out
+            if slope > 1e-5:
+                self.b = slope
+        self.a = max(ym - self.b * xm, 0.0)
+
+
 class BatchSizer:
     """Deadline-based batch cutting (SURVEY §7 hard-part 7: iso-p99 needs
     the batch size bounded by a latency budget, not just throughput).
@@ -69,55 +121,74 @@ class BatchSizer:
     pops less than the target anyway; under heavy load this trades peak
     throughput for a bounded p99. ``deadline_s=0`` disables cutting."""
 
-    def __init__(self, max_batch: int, deadline_s: float, min_batch: int = 16):
+    def __init__(self, max_batch: int, deadline_s: float, min_batch: int = 16,
+                 stall_target_s: Optional[float] = None):
         self.max_batch = max_batch
         self.min_batch = min(min_batch, max_batch)
         self.deadline_s = deadline_s
-        self._a = 0.040  # fixed seed: one relay RTT
-        self._b = 0.0003  # per-pod seed: ~0.3 ms encode+commit
-        self.updates = 0
-        self._outliers = 0  # consecutive rejected observations
         self._bucket: Optional[int] = None  # sticky chosen bucket
         # exponentially-decayed least squares over (B, latency): the old
         # alternating a/b EMA decomposition was biased — with mixed bucket
         # sizes it attributed nearly everything to the fixed cost (a→0.2s,
-        # b→0) and collapsed the target to min_batch
-        self._decay = 0.95
-        self._sw = self._sx = self._sy = self._sxx = self._sxy = 0.0
+        # b→0) and collapsed the target to min_batch. Seeds: one relay RTT
+        # fixed + ~0.3 ms/pod encode+commit.
+        self._fit = _DecayedFit(a=0.040, b=0.0003)
+        # second controlled quantity: the COMMIT-WAIT residual (time the
+        # pipeline blocks on device execution after the packed-block copy
+        # was staged at dispatch). On an execution-bound backend the wait
+        # grows ~linearly with the bucket while the per-pod exec cost is
+        # ~flat, so capping predicted wait at a stall target picks the
+        # bucket where device time balances the overlapped host window —
+        # maximum overlap efficiency instead of maximum batch. Inactive
+        # until fed (b = 0). KTPU_STALL_TARGET_MS=0 disables.
+        if stall_target_s is None:
+            stall_target_s = float(os.environ.get(
+                "KTPU_STALL_TARGET_MS", "15")) / 1000.0
+        self.stall_target_s = stall_target_s
+        # floor=1e-3: near-zero residual predictions would otherwise flag
+        # every first real wait as a 4x outlier
+        self._wfit = _DecayedFit(a=0.0, b=0.0, floor=1e-3)
+
+    # latency-model accessors: calibration writes them, tests read them
+    @property
+    def _a(self) -> float:
+        return self._fit.a
+
+    @_a.setter
+    def _a(self, v: float) -> None:
+        self._fit.a = v
+
+    @property
+    def _b(self) -> float:
+        return self._fit.b
+
+    @_b.setter
+    def _b(self, v: float) -> None:
+        self._fit.b = v
+
+    @property
+    def updates(self) -> int:
+        return self._fit.updates
+
+    @updates.setter
+    def updates(self, v: int) -> None:
+        self._fit.updates = v
+
+    @property
+    def _outliers(self) -> int:
+        return self._fit.outliers
+
+    @_outliers.setter
+    def _outliers(self, v: int) -> None:
+        self._fit.outliers = v
 
     def update(self, batch_size: int, latency_s: float) -> None:
-        if batch_size <= 0:
-            return
-        # outlier rejection: a jit-compile cycle reads as 10-100x the model
-        # prediction; folding it in would shrink the target, switch buckets,
-        # trigger ANOTHER compile, and feed back into a collapse. Warmup
-        # cycles (first few updates) always fold in, and THREE consecutive
-        # outliers mean the machine genuinely got slower — accept then.
-        predicted = self._a + self._b * batch_size
-        if self.updates >= 3 and latency_s > 4.0 * predicted and self._outliers < 2:
-            self._outliers += 1
-            return
-        self._outliers = 0
-        self.updates += 1
-        d = self._decay
-        self._sw = self._sw * d + 1.0
-        self._sx = self._sx * d + batch_size
-        self._sy = self._sy * d + latency_s
-        self._sxx = self._sxx * d + batch_size * batch_size
-        self._sxy = self._sxy * d + batch_size * latency_s
-        xm = self._sx / self._sw
-        ym = self._sy / self._sw
-        var = self._sxx / self._sw - xm * xm
-        if var > 1e-6:
-            cov = self._sxy / self._sw - xm * ym
-            slope = cov / var
-            # a degenerate or negative slope (one bucket size observed, or a
-            # machine-speed shift inverting the decayed samples) KEEPS the
-            # prior per-pod estimate — snapping b to a floor would read as
-            # "pods are free" and blow the target to max_batch
-            if slope > 1e-5:
-                self._b = slope
-        self._a = max(ym - self._b * xm, 0.0)
+        self._fit.update(batch_size, latency_s)
+
+    def update_wait(self, batch_size: int, wait_s: float) -> None:
+        """Feed one commit-wait observation (the blocking residual measured
+        at the commit site) into the stall model."""
+        self._wfit.update(batch_size, wait_s)
 
     # pod-axis buckets: the compiled program's step count is the PADDED pod
     # capacity, so the target quantizes to a small set of compile shapes;
@@ -151,6 +222,14 @@ class BatchSizer:
         if budget <= 0 or self._b <= 0:
             return self.min_batch
         raw = max(self.min_batch, min(self.max_batch, int(budget / self._b)))
+        # stall bound: the largest bucket whose PREDICTED commit-wait stays
+        # at the residual target — past it, extra batch size converts host
+        # overlap into blocked device wait 1:1 (no throughput, worse p99)
+        if self.stall_target_s and self._wfit.b > 0:
+            stall_budget = self.stall_target_s - self._wfit.a
+            raw_stall = (int(stall_budget / self._wfit.b)
+                         if stall_budget > 0 else 0)
+            raw = max(self.min_batch, min(raw, raw_stall))
         # sticky hysteresis: keep the current bucket while the model's raw
         # target stays in its neighborhood (a switch = a new compiled shape)
         cur = self._bucket
@@ -259,11 +338,21 @@ class TPUScheduler(Scheduler):
         self._profile_dir = os.environ.get("KTPU_PROFILE_DIR", "")
         self._profile_batches = int(os.environ.get("KTPU_PROFILE_BATCHES", "4"))
         self._profiling = False
-        # async pipeline (SURVEY §2.7 P3 analog): at most one dispatched
-        # batch in flight; its host commit overlaps the next batch's device
-        # compute. KTPU_PIPELINE=0 forces the synchronous path.
-        self._pipeline_enabled = os.environ.get("KTPU_PIPELINE", "1") != "0"
-        self._inflight: Optional[_Inflight] = None
+        # async pipeline (SURVEY §2.7 P3 analog), generalized to a bounded
+        # multi-batch in-flight RING: up to ``pipeline_depth`` dispatched
+        # batches ride the device at once (oldest commits first), so the
+        # host work of landing batch k overlaps the device execution of
+        # k+1..k+K instead of just the dispatch of k+1. KTPU_PIPELINE=0
+        # forces the synchronous path; KTPU_PIPELINE_DEPTH sets K (default
+        # 2 — deeper rings add pop→commit latency per batch, which the
+        # deadline sizer then pays for in smaller batches).
+        if os.environ.get("KTPU_PIPELINE", "1") == "0":
+            self.pipeline_depth = 0
+        else:
+            # depth 0 is a valid setting: synchronous, same as KTPU_PIPELINE=0
+            self.pipeline_depth = max(0, int(os.environ.get(
+                "KTPU_PIPELINE_DEPTH", "2")))
+        self._inflight: Deque[_Inflight] = deque()
         self.pipelined_batches = 0
         # volume-bindability pre-pass (ops/volume_mask.py): lets PVC-bearing
         # pods ride the batched path with a [P, N] static screen + exact
@@ -570,9 +659,10 @@ class TPUScheduler(Scheduler):
         # round-trips per batch once the session has synchronized)
         key = np.int32(self.batch_counter)
         host_pb = self.device.encoder.last_host_pb
-        prev = self._inflight
-        # cross-batch topology carry: batch k+1 starts from batch k's evolved
-        # sel_counts/seg_exist instead of the (stale, pre-k) host tables.
+        prev = self._inflight[-1] if self._inflight else None
+        # cross-batch topology carry: batch k+1 starts from the NEWEST
+        # in-flight batch's evolved sel_counts/seg_exist instead of the
+        # (stale, pre-k) host tables — the ring chains carries end to end.
         # Only valid on the pipelined path — after a drain the host recounts
         # and device.tc is the truth again (prev is None then).
         carry = None
@@ -630,28 +720,30 @@ class TPUScheduler(Scheduler):
             self._start_carry = result.final_sample_start
         t_dispatch = self.now_fn()
         try:
-            # stage the one host-read early: by commit time the transfer has
-            # ridden along with the execution instead of paying its own
-            # round-trip
-            result.node_idx.copy_to_host_async()
+            # stage the one host-read the moment the batch is dispatched:
+            # the device→host copy of the packed result block rides along
+            # with the execution (and the ring's later batches) instead of
+            # paying its own round-trip inside commit_wait
+            (result.packed if result.packed is not None
+             else result.node_idx).copy_to_host_async()
         except Exception:  # noqa: BLE001 — optional fast path only
             pass
-        self._inflight = _Inflight(batched, result, pod_cycle, t_pop, host_pb, pb,
-                                   mode_info)
-        committed = 0
-        if prev is not None:
-            # the host commit of batch k overlaps the device compute of k+1
-            self.pipelined_batches += 1
-            committed = len(prev.qps)
-            self._commit_inflight(prev)
+        self._inflight.append(_Inflight(batched, result, pod_cycle, t_pop,
+                                        host_pb, pb, mode_info))
+        self.smetrics.pipeline_inflight.set(value=len(self._inflight))
+        # land the oldest batches beyond the ring depth: their host commits
+        # overlap the device execution of everything dispatched after them
+        # (depth 0 = synchronous: the batch just dispatched commits now)
+        while len(self._inflight) > self.pipeline_depth:
+            fl = self._inflight.popleft()
+            if self.pipeline_depth:
+                self.pipelined_batches += 1
+            self._commit_inflight(fl)
         dur = self.smetrics.device_batch_duration
         dur.observe(t_sync - t0, "upload")
         dur.observe(t_enc - t_sync, "encode")
         dur.observe(t_dispatch - t_enc, "compute")
         self.smetrics.device_batch_size.observe(len(batched))
-        if not self._pipeline_enabled:
-            committed = len(batched)
-            self._drain_inflight()
         # (the sizer's latency observations are fed at the commit site,
         # where the batch's true pop→commit span is known)
 
@@ -662,7 +754,7 @@ class TPUScheduler(Scheduler):
         registers no new signature/term (a fresh row is backfilled from host
         counts that cannot see the in-flight commits). Returns (pb, et, tb)
         or None to take the drain+sync path."""
-        if not self._pipeline_enabled or self._inflight is None or self.device is None:
+        if not self.pipeline_depth or not self._inflight or self.device is None:
             return None
         self.cache.update_snapshot(self.snapshot)
         if self.device.has_dirty(self.snapshot):
@@ -685,41 +777,54 @@ class TPUScheduler(Scheduler):
             return None  # grow via the drain+sync path (idempotent re-encode)
         if (st.n_sigs, st.n_terms) != vocab0:
             return None
-        if self._topo_mode_info() != self._inflight.mode_info:
+        if self._topo_mode_info() != self._inflight[-1].mode_info:
             # the carry shapes (seg_exist vs term_cnt, vd bucket) differ —
-            # land the in-flight batch and restart the chain on host truth
+            # land the in-flight batches and restart the chain on host truth
             return None
         return pb, et, tb, extra_mask, dra_mask
 
     def _drain_inflight(self) -> None:
-        prev, self._inflight = self._inflight, None
-        if prev is not None:
-            self._commit_inflight(prev)
+        """Land every in-flight batch, oldest first (a device-death commit
+        failure poisons and clears the rest of the ring from inside
+        _commit_inflight, which ends this loop)."""
+        while self._inflight:
+            self._commit_inflight(self._inflight.popleft())
 
     def _commit_inflight(self, fl: _Inflight) -> None:
-        """Land one dispatched batch on the host. The np.asarray(node_idx)
-        is the ONE device sync of the batch cycle (it waits for the remote
-        execution; everything else is async dispatch). A device failure at
+        """Land one dispatched batch on the host. Materializing the PACKED
+        result block (node_idx + first_fail in one buffer, its device→host
+        copy already staged at dispatch) is the ONE device sync of the batch
+        cycle; everything else is async dispatch. A device failure at
         materialization (e.g. the TPU relay dropping mid-flight) fails the
-        whole batch back to the queue and rebuilds the device from the host
-        cache — crash-only, §5.3."""
+        whole IN-FLIGHT RING back to the queue and rebuilds the device from
+        the host cache — crash-only, §5.3."""
         from ..utils import tracing
 
         t0 = self.now_fn()
+        wait: Optional[float] = None
         try:
             from ..utils import relay
+            from .batch import unpack_result_block
 
             relay.count_sync("commit-read")  # THE one blocking read per batch
             with tracing.span("device.commit.wait", batch=len(fl.qps)):
                 t_wait0 = self.now_fn()
-                node_idx = np.asarray(fl.result.node_idx)
-                self.smetrics.device_batch_duration.observe(
-                    self.now_fn() - t_wait0, "commit_wait")
+                if fl.result.packed is not None:
+                    node_idx, ff = unpack_result_block(
+                        fl.result.packed, self.device.caps.nodes)
+                else:  # sharded-core results carry no packed block
+                    node_idx = np.asarray(fl.result.node_idx)
+                    ff = None
+                wait = self.now_fn() - t_wait0
+                self.smetrics.device_batch_duration.observe(wait, "commit_wait")
+                # residual stall: the transfer was staged at dispatch, so any
+                # time spent here is the pipeline waiting on device execution
+                self.smetrics.pipeline_stall_seconds.inc(value=wait)
             self.device.adopt_commits(fl.result, fl.host_pb, node_idx)
             with tracing.span("host.commit", batch=len(fl.qps)):
                 t_host0 = self.now_fn()
                 self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0,
-                                   node_idx, pb=fl.pb)
+                                   node_idx, pb=fl.pb, ff=ff)
                 self.smetrics.device_batch_duration.observe(
                     self.now_fn() - t_host0, "commit_host")
             # reconcile: the commits above advanced node generations; the
@@ -729,36 +834,45 @@ class TPUScheduler(Scheduler):
             # breaking it every batch. Rows needing a real upload (external
             # change, host-rejected commit repair) stay dirty → chain break
             # → safe drain+sync. A host-rejected pod's phantom topology
-            # commit can thus survive in the carry for exactly one already-
-            # dispatched batch (conservative direction: nodes look MORE
-            # occupied), after which the break resyncs from host truth.
+            # commit can thus survive in the carry for as long as the ring
+            # holds already-dispatched batches (conservative direction:
+            # nodes look MORE occupied), after which the break resyncs from
+            # host truth.
             if self.device is not None:
-                t_rec0 = self.now_fn()
-                self.cache.update_snapshot(self.snapshot)
-                self.device.reconcile(self.snapshot)
-                self.smetrics.device_batch_duration.observe(
-                    self.now_fn() - t_rec0, "commit_reconcile")
+                with tracing.span("device.commit.reconcile", batch=len(fl.qps)):
+                    t_rec0 = self.now_fn()
+                    self.cache.update_snapshot(self.snapshot)
+                    self.device.reconcile(self.snapshot)
+                    self.smetrics.device_batch_duration.observe(
+                        self.now_fn() - t_rec0, "commit_reconcile")
         except Exception as exc:  # noqa: BLE001 — backend death must not kill us
             import logging
 
             logging.getLogger(__name__).exception("batch commit failed; requeueing")
             self.device = None  # full rebuild + resync on next _ensure_device
             self._start_carry = None  # dead-backend future
-            # anything dispatched after fl was computed on the dead device;
-            # its futures are poison too — fail it back alongside fl
-            stale, self._inflight = self._inflight, None
-            for batch in (fl, stale) if stale is not None else (fl,):
+            # everything dispatched after fl was computed on the dead device;
+            # those futures are poison too — fail the WHOLE ring back
+            # alongside fl, oldest first (queue order preserved)
+            stale = list(self._inflight)
+            self._inflight.clear()
+            for batch in (fl, *stale):
                 for qp in batch.qps:
                     fwk = self.framework_for_pod(qp.pod)
                     self._fail(fwk, qp, Status.error(f"device batch failed: {exc}"),
                                batch.pod_cycle)
+        self.smetrics.pipeline_inflight.set(value=len(self._inflight))
         self.smetrics.device_batch_duration.observe(self.now_fn() - t0, "commit")
         # the sizer controls the POP→COMMIT attempt latency: observe it here,
         # where this batch's span just completed (fl.t0 = its pop time). The
         # size fed is the BUCKET (padded program length) — that is what the
-        # latency actually tracks.
-        self.sizer.update(self.sizer.bucket_for(len(fl.qps)),
-                          self.now_fn() - fl.t0)
+        # latency actually tracks. The commit-wait residual feeds the stall
+        # model, which caps the bucket where device time outruns the
+        # overlapped host window.
+        bucket = self.sizer.bucket_for(len(fl.qps))
+        self.sizer.update(bucket, self.now_fn() - fl.t0)
+        if wait is not None:
+            self.sizer.update_wait(bucket, wait)
 
     _VOLUME_FILTERS = frozenset((
         "VolumeRestrictions", "NodeVolumeLimits", "EBSLimits", "GCEPDLimits",
@@ -827,11 +941,13 @@ class TPUScheduler(Scheduler):
     def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult,
                       pod_cycle: int, t0: float,
                       node_idx: Optional[np.ndarray] = None,
-                      pb=None) -> None:
+                      pb=None, ff: Optional[np.ndarray] = None) -> None:
         if node_idx is None:
             node_idx = np.asarray(result.node_idx)
         slot_names = self.device.slot_to_name()
-        ff: Optional[np.ndarray] = None  # lazy single read: failures only
+        # ff (first_fail) normally arrives unpacked from the packed result
+        # block — already on host, zero extra syncs; the lazy reads below
+        # only fire for packless (sharded-core) results
 
         # gang all-or-nothing (PodGroup/Coscheduling): one vmapped device
         # pass over the batch's gangs decides per-gang verdicts; any gang
@@ -1298,11 +1414,12 @@ class TPUScheduler(Scheduler):
     def _calibrate_sizer(self, timings) -> None:
         """Seed the BatchSizer's latency model from the warm runs' measured
         per-bucket execution times (least squares on exec(B) = ea + eb·B).
-        The pop→commit latency of a pipelined batch spans its own and the
-        next batch's execution, so the seed is a ≈ 2·ea + host overhead,
-        b ≈ 2·eb. Without this the model starts from blind seeds and the
-        first dozen measured batches are spent oscillating through buckets
-        (each flip breaking the pipelined carry chain)."""
+        The pop→commit latency of a pipelined batch spans its own execution
+        plus the ring's worth of batches dispatched after it, so the seed is
+        a ≈ (K+1)·ea + host overhead, b ≈ (K+1)·eb for ring depth K. Without
+        this the model starts from blind seeds and the first dozen measured
+        batches are spent oscillating through buckets (each flip breaking
+        the pipelined carry chain)."""
         if len(timings) < 2:
             return
         xs = np.array([float(b) for b, _ in timings])
@@ -1310,11 +1427,19 @@ class TPUScheduler(Scheduler):
         eb, ea = np.polyfit(xs, ys, 1)
         if eb <= 0:
             return
+        span = self.pipeline_depth + 1
         s = self.sizer
-        s._a = max(2.0 * ea, 0.0) + 0.03
-        s._b = 2.0 * eb
+        s._a = max(span * ea, 0.0) + 0.03
+        s._b = span * eb
         s.updates = max(s.updates, 3)
         s._outliers = 0
+        # the warm runs time EXECUTION directly (idle host): seed the stall
+        # model with wait ≈ exec — conservative (the steady state subtracts
+        # the overlapped host window), and the commit-site observations
+        # correct it within a few batches
+        s._wfit.a = max(ea, 0.0)
+        s._wfit.b = eb
+        s._wfit.updates = max(s._wfit.updates, 3)
         s._bucket = None  # let target() re-derive from the calibrated model
         s.target()  # pin the sticky bucket now
 

@@ -58,6 +58,10 @@ class Cache:
         # O(1) instead of dry-running candidates (preemption.go:319's
         # eligibility is per-pod; this is the cluster-level shortcut)
         self._prio_counts: Dict[int, int] = {}
+        # entries with a REAL Node object (ghost NodeInfos excluded):
+        # node_count() sits on the per-batch hot path and a full scan of
+        # self.nodes was a measured ~5ms/call at 5k nodes
+        self._real_nodes = 0
 
     # ------------------------------------------------------------- pods
 
@@ -153,6 +157,8 @@ class Cache:
             if ni is None:
                 ni = NodeInfo()
                 self.nodes[node.meta.name] = ni
+            if ni.node is None:
+                self._real_nodes += 1
             ni.set_node(node)
             self._dirty.add(node.meta.name)
             self._removed.discard(node.meta.name)
@@ -167,6 +173,8 @@ class Cache:
                 return
             # keep the entry while pods remain (reference keeps ghost nodes
             # for pods not yet deleted), else drop
+            if ni.node is not None:
+                self._real_nodes -= 1
             ni.node = None
             ni.generation = next_generation()
             self._dirty.add(node_name)
@@ -279,11 +287,10 @@ class Cache:
 
     def node_count(self) -> int:
         with self._lock:
-            return sum(1 for ni in self.nodes.values() if ni.node is not None)
+            return self._real_nodes
 
     def stats(self) -> Tuple[int, int, int]:
         """(nodes, pods, assumed_pods) — the scheduler_cache_size gauge feed
         and the /debug/cache counts (cache.go:96 Dump's totals)."""
         with self._lock:
-            nodes = sum(1 for ni in self.nodes.values() if ni.node is not None)
-            return nodes, len(self.pod_states), len(self._assumed)
+            return self._real_nodes, len(self.pod_states), len(self._assumed)

@@ -303,6 +303,46 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PermitPlugin,
             self._denied.pop(gkey, None)
         self._update_status(pg, phase=phase, scheduled=n)
 
+    # --------------------------------------------------------- pod lifecycle
+
+    def pod_deleted(self, pod: Pod) -> None:
+        """Member deletion bookkeeping (ROADMAP PR4 follow-up: `_bound`
+        counts never decremented, so a re-created gang was judged against
+        stale quorum). A BOUND member's deletion decrements the gang's
+        bound count and refreshes PodGroup status; when the LAST member
+        disappears the per-gang plugin state is GC'd wholesale, so a
+        future gang reusing the group key starts from a clean slate
+        (fresh quorum, fresh queue timestamp, no leftover denial backoff).
+        Called from the scheduler's Pod DELETE event hook."""
+        gkey = pod_group_key(pod)
+        if gkey is None:
+            return
+        if pod.spec.node_name and gkey in self._bound:
+            self._bound[gkey] = max(self._bound[gkey] - 1, 0)
+        if self._members_in_store(gkey) == 0:
+            self._gc_group(gkey)
+            return
+        if pod.spec.node_name:
+            pg = self._group(gkey)
+            if pg is not None:
+                n = self._bound_count(gkey)
+                phase = (POD_GROUP_RUNNING if n >= pg.min_member
+                         else POD_GROUP_SCHEDULING if n else POD_GROUP_PENDING)
+                self._update_status(pg, phase=phase, scheduled=n)
+
+    def _gc_group(self, gkey: str) -> None:
+        """Drop every per-gang cache for a group with no members left (the
+        finished-group GC half of the PodGroup controller follow-up). The
+        PodGroup API object survives — it is user-owned — but its status
+        resets to Pending/0 so a re-created gang is judged afresh."""
+        self._bound.pop(gkey, None)
+        self._group_ts.pop(gkey, None)
+        self._first_wait.pop(gkey, None)
+        self._denied.pop(gkey, None)
+        pg = self._group(gkey)
+        if pg is not None:
+            self._update_status(pg, phase=POD_GROUP_PENDING, scheduled=0)
+
     def _set_phase(self, gkey: str, phase: str) -> None:
         pg = self._group(gkey)
         if pg is not None and pg.phase != phase:

@@ -92,6 +92,20 @@ class SchedulerMetrics:
             "Pods per device batch.",
             buckets=[1, 2, 4, 8, 16, 32, 64, 128, 256, 512],
         ))
+        # async commit pipeline (backend/tpu_scheduler.py in-flight ring):
+        # current dispatched-but-uncommitted batch count, and cumulative
+        # seconds the commit site spent blocked on device execution AFTER
+        # the packed-block transfer was already staged at dispatch (the
+        # residual stall the ring exists to hide — a growing rate here says
+        # the ring is too shallow or the host fell behind)
+        self.pipeline_inflight = r.register(Gauge(
+            "scheduler_pipeline_inflight",
+            "Dispatched device batches not yet committed (ring occupancy).",
+        ))
+        self.pipeline_stall_seconds = r.register(Counter(
+            "scheduler_pipeline_stall_seconds_total",
+            "Seconds the batch commit site blocked waiting on device results.",
+        ))
         # resource.k8s.io (DRA): claim allocation outcomes at Reserve time
         # (allocated|conflict) and Unreserve rollbacks (released)
         self.dra_claim_allocations = r.register(Counter(

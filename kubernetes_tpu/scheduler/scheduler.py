@@ -239,6 +239,20 @@ class Scheduler:
                 self.queue.move_all_to_active_or_backoff_queue(qevents.POD_DELETE)
             elif old is not None:
                 self.queue.delete(old)
+            if old is not None:
+                self._notify_gang_pod_deleted(old)
+
+    def _notify_gang_pod_deleted(self, pod: Pod) -> None:
+        """PodGroup lifecycle on member deletion: the Coscheduling plugin's
+        bound-count cache must decrement (and GC when the gang empties) or
+        a re-created gang is judged against stale quorum."""
+        from ..framework.plugins.coscheduling import pod_group_key
+
+        if pod_group_key(pod) is None or not self._responsible_for(pod):
+            return
+        plugin = self.framework_for_pod(pod).plugin("Coscheduling")
+        if plugin is not None:
+            plugin.pod_deleted(pod)
 
     def _on_node_event(self, event: str, old: Optional[Node], new: Optional[Node]) -> None:
         if event == ADDED:

@@ -154,6 +154,110 @@ def _bound(store):
             for p in store.pods.values() if p.spec.node_name}
 
 
+class TestPipelineRingChaos:
+    """Device death with K>1 batches in the in-flight ring (ISSUE 5): every
+    poisoned batch — the one being committed AND everything dispatched after
+    it — must fail back to the queue with zero lost / double-bound pods, and
+    the rebuilt device mirror must be byte-identical to a fresh sync from
+    host truth."""
+
+    def _fill_ring(self, monkeypatch):
+        monkeypatch.setenv("KTPU_PIPELINE_DEPTH", "2")
+        store = ClusterStore()
+        _cluster(store, 6)
+        # short error backoff: the recovery half of the test must not spin
+        # the settle loop's no-progress bound against the real-time backoff
+        sched = TPUScheduler(store, batch_size=4, comparer_every_n=1,
+                             pod_initial_backoff=0.01, pod_max_backoff=0.05)
+        # two waves, one cycle each: both batches sit dispatched in the ring
+        for i in range(4):
+            store.create_pod(make_pod(f"a{i}").req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+        for i in range(4):
+            store.create_pod(make_pod(f"b{i}").req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+        assert len(sched._inflight) == 2, "ring must hold K=2 batches"
+        return store, sched
+
+    def test_device_kill_poisons_all_inflight_batches(self, monkeypatch):
+        store, sched = self._fill_ring(monkeypatch)
+        from kubernetes_tpu.backend import batch as batch_mod
+
+        real_unpack = batch_mod.unpack_result_block
+
+        def dead(*a, **kw):
+            raise RuntimeError("relay dropped mid-flight")
+
+        monkeypatch.setattr(batch_mod, "unpack_result_block", dead)
+        sched._drain_inflight()
+        # ALL in-flight batches poisoned: nothing bound, nothing lost, the
+        # ring is empty and the device is marked for rebuild
+        assert sched.metrics["scheduled"] == 0
+        assert _bound(store) == {}
+        assert len(sched._inflight) == 0
+        assert sched.device is None
+        pending = sched.queue.pending_pods()
+        assert sum(pending.values()) == 8, pending
+
+        # device heals: every pod schedules exactly once, capacity respected
+        monkeypatch.setattr(batch_mod, "unpack_result_block", real_unpack)
+        import time as _time
+
+        _time.sleep(0.06)  # let the (shortened) error backoff expire
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 8
+        bound = _bound(store)
+        assert len(bound) == 8  # zero lost
+        assert len(store.pods) == 8  # zero duplicated
+        assert sched.comparer_mismatches == 0
+        per_node = {}
+        for n in bound.values():
+            per_node[n] = per_node.get(n, 0) + 1
+        assert all(v <= 30 for v in per_node.values())
+
+        # byte-identical resync: the rebuilt mirror equals a fresh device
+        # synced from the same host snapshot, field for field
+        from kubernetes_tpu.backend.device_state import DeviceState
+
+        sched.cache.update_snapshot(sched.snapshot)
+        fresh = DeviceState(sched.device.caps,
+                            ns_labels_fn=sched.store.ns_labels)
+        fresh.sync(sched.snapshot)
+        for field, arr in sched.device._mirror.items():
+            import numpy as _np
+
+            assert _np.array_equal(arr, fresh._mirror[field]), field
+
+    def test_mid_drain_death_requeues_newer_batches_too(self, monkeypatch):
+        """The failure hits while the OLDEST batch commits: the newer
+        in-flight batch must be poisoned alongside it, not committed from
+        dead futures (the single-slot code handled exactly one stale
+        batch; the ring handles them all)."""
+        store, sched = self._fill_ring(monkeypatch)
+        from kubernetes_tpu.backend import batch as batch_mod
+
+        real_unpack = batch_mod.unpack_result_block
+        calls = []
+
+        def die_once(*a, **kw):
+            calls.append(1)
+            raise RuntimeError("relay dropped")
+
+        monkeypatch.setattr(batch_mod, "unpack_result_block", die_once)
+        sched._drain_inflight()
+        # the first materialization failed; the SECOND batch must never
+        # have been materialized at all (its futures are poison)
+        assert len(calls) == 1
+        assert sched.metrics["scheduled"] == 0
+        monkeypatch.setattr(batch_mod, "unpack_result_block", real_unpack)
+        import time as _time
+
+        _time.sleep(0.06)  # let the (shortened) error backoff expire
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 8
+        assert sched.comparer_mismatches == 0
+
+
 class _WireRig:
     """A WireScheduler + restartable served DeviceService on an injected
     clock: retry sleeps advance the FakeClock, never the wall clock."""

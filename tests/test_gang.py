@@ -244,6 +244,81 @@ class TestCoschedulingOracle:
         pg = store.get_object("PodGroup", "default/h")
         assert pg.phase == "Running"
 
+    def test_member_delete_decrements_bound_and_updates_status(self):
+        """ROADMAP PR4 follow-up: deleting a bound gang member must
+        decrement the plugin's bound-count cache and refresh the PodGroup
+        status instead of leaving both frozen at quorum."""
+        store = mk_store(8)
+        s = Scheduler(store)
+        add_group(store, "train", min_member=4)
+        for i in range(4):
+            store.create_pod(gang_pod(f"train-{i}", "train"))
+        s.run_until_settled()
+        assert len(bound_map(store)) == 4
+        plugin = s.profiles["default-scheduler"].plugin("Coscheduling")
+        assert plugin._bound["default/train"] == 4
+        store.delete_pod("default/train-0")
+        assert plugin._bound["default/train"] == 3
+        pg = store.get_object("PodGroup", "default/train")
+        assert pg.scheduled == 3 and pg.phase == "Scheduling"
+
+    def test_stale_quorum_cannot_release_partial_recreated_gang(self):
+        """THE stale-quorum bug: after members of a Running gang die, a
+        replacement member must NOT be released at Permit on the strength
+        of the old bound count — that binds a partial gang that can never
+        complete. 4 one-per-node members fill 4 nodes; 2 die; of the 2
+        replacements one is unschedulable, so the other must park (real
+        quorum 2+1 < 4) and then tear down — bound stays exactly 2."""
+        store = mk_store(4, cpu="2")
+        clock = FakeClock()
+        s = Scheduler(store, now_fn=clock)
+        add_group(store, "train", min_member=4, timeout_s=1)
+        for i in range(4):
+            store.create_pod(gang_pod(f"train-{i}", "train", cpu="2",
+                                      anti=False))
+        s.run_until_settled()
+        assert len(bound_map(store)) == 4
+        store.delete_pod("default/train-0")
+        store.delete_pod("default/train-1")
+        # two replacements: one fits a freed node, one can never fit
+        store.create_pod(gang_pod("train-4", "train", cpu="2", anti=False))
+        store.create_pod(gang_pod("train-5", "train", cpu="16", anti=False))
+        clock.advance(2.0)
+        s.run_until_settled()
+        clock.advance(2.0)  # permit-timeout sweep for any parked member
+        s.run_until_settled()
+        # with the stale count (4) the fitting replacement would have been
+        # released solo → 3 bound members of a gang that can never reach 4
+        assert len(bound_map(store)) == 2, bound_map(store)
+
+    def test_emptied_gang_gc_resets_state_for_recreation(self):
+        """When the last member disappears the per-gang plugin state is
+        GC'd and the PodGroup status resets, so a re-created gang with the
+        same group key is judged entirely afresh."""
+        store = mk_store(8)
+        clock = FakeClock()
+        s = Scheduler(store, now_fn=clock)
+        add_group(store, "train", min_member=4)
+        for i in range(4):
+            store.create_pod(gang_pod(f"train-{i}", "train"))
+        s.run_until_settled()
+        assert len(bound_map(store)) == 4
+        plugin = s.profiles["default-scheduler"].plugin("Coscheduling")
+        for i in range(4):
+            store.delete_pod(f"default/train-{i}")
+        assert "default/train" not in plugin._bound
+        assert "default/train" not in plugin._denied
+        pg = store.get_object("PodGroup", "default/train")
+        assert pg.phase == "Pending" and pg.scheduled == 0
+        # the re-created gang schedules from scratch and reaches Running
+        for i in range(4):
+            store.create_pod(gang_pod(f"redo-{i}", "train"))
+        clock.advance(2.0)
+        s.run_until_settled()
+        assert len(bound_map(store)) == 4
+        pg = store.get_object("PodGroup", "default/train")
+        assert pg.phase == "Running" and pg.scheduled == 4
+
 
 # ---------------------------------------------------------------------------
 # the gang kernel (ops/gang.py) — device vs host-oracle parity

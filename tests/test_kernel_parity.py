@@ -251,3 +251,31 @@ def test_encode_template_cache_parity(seed):
     for f in dataclasses.fields(cold_t):
         a, b = getattr(cold_t, f.name), getattr(warm_t, f.name)
         assert np.array_equal(np.asarray(a), np.asarray(b)), f.name
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_packed_result_block_parity(seed):
+    """The packed result block (ISSUE 5) must reproduce node_idx and
+    first_fail EXACTLY after the host-side unpack — this pins the
+    lax.bitcast_convert_type ↔ numpy-view byte order the single-transfer
+    commit depends on, end to end through a real schedule_batch call."""
+    import jax
+
+    from kubernetes_tpu.backend.batch import schedule_batch, unpack_result_block
+    from kubernetes_tpu.backend.sig_table import SigTable
+
+    rng = random.Random(seed)
+    infos = random_cluster(rng, 20)
+    pods = random_pods(rng, 16, 20)
+    enc = ClusterEncoder(Capacities(nodes=32, pods=16, value_words=32))
+    sig = SigTable(enc)
+    nt = enc.encode_snapshot(infos)
+    pb, et = enc.encode_pods(pods)
+    tb = sig.encode_topo(pods)
+    tc = sig.topo_counts()
+    res = schedule_batch(pb, et, nt, tc, tb, jax.random.PRNGKey(seed),
+                         topo_enabled=False)
+    assert res.packed is not None
+    node_idx, ff = unpack_result_block(res.packed, nt.capacity)
+    assert np.array_equal(node_idx, np.asarray(res.node_idx))
+    assert np.array_equal(ff, np.asarray(res.first_fail))

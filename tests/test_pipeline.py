@@ -51,6 +51,35 @@ def test_pipeline_overlaps_and_matches_synchronous():
     assert sched_p.comparer_mismatches == 0
 
 
+@pytest.mark.parametrize("depth", [2, 3])
+def test_ring_depth_k_matches_synchronous(depth, monkeypatch):
+    """Placement parity at ring depth K≥2 (ISSUE 5 acceptance): with
+    multiple batches in flight on the carry chain, placements must equal
+    the synchronous run's exactly — including under anti-affinity, where a
+    stale carry would immediately show as a same-zone double-place."""
+    monkeypatch.setenv("KTPU_PIPELINE_DEPTH", str(depth))
+
+    def build(store):
+        for i in range(8):
+            store.create_node(
+                make_node(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": 10})
+                .label("zone", f"z{i % 2}").obj())
+        sel = LabelSelector(match_labels={"app": "x"})
+        for i in range(6):
+            store.create_pod(
+                make_pod(f"aa{i}").req({"cpu": "1"}).label("app", "x")
+                .pod_affinity("zone", sel, anti=True).obj())
+        for i in range(18):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+
+    store_p, sched_p = _run(True, build)
+    monkeypatch.delenv("KTPU_PIPELINE_DEPTH")
+    store_s, sched_s = _run(False, build)
+    assert sched_p.pipelined_batches > 0
+    assert _bound(store_p) == _bound(store_s)
+    assert sched_p.comparer_mismatches == 0
+
+
 def test_pipeline_capacity_respected_across_batches():
     """The r2 stale-device failure mode, now across PIPELINED batches: a
     1-slot cluster must admit exactly one pod even when later batches are
@@ -217,6 +246,9 @@ def test_deadline_bounds_pop_size_end_to_end():
                 return 9
 
             def update(self, *a):
+                pass
+
+            def update_wait(self, *a):
                 pass
 
             def bucket_for(self, n):
