@@ -58,6 +58,19 @@ class StaleEpochError(DeviceServiceError):
         self.epoch = epoch
 
 
+class ConflictError(DeviceServiceError):
+    """Another scheduler replica won a race this client lost: the pod (or
+    this client's whole session, if its lease was fenced) is owned by
+    someone else NOW. Distinct from StaleEpochError — the client's mirror
+    base is fine and the service is healthy, so neither a resync of state
+    nor a transport retry can help; the pods re-enter via the backoffQ and
+    a fenced session rejoins under a fresh session generation. HTTP 409
+    with ``conflict: true``; gRPC ABORTED."""
+
+    def __init__(self, message: str = "commit conflict"):
+        super().__init__(message)
+
+
 def raise_injected_fault(fault_plan, op: str, read_timeout: float) -> None:
     """Shared client-side fault-injection hook (WireClient and GrpcClient):
     consume the next scripted fault for ``op`` and raise what the network

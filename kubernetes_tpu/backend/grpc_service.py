@@ -23,6 +23,7 @@ import threading
 from typing import Dict, Optional
 
 from .errors import (
+    ConflictError,
     PermanentDeviceError,
     RetryPolicy,
     StaleEpochError,
@@ -83,7 +84,27 @@ def _deltas_to_proto(payload: dict):
         req.namespaces[ns] = json.dumps(labels).encode()
     req.traceparent = payload.get("traceparent") or ""
     req.expect_epoch = payload.get("expectEpoch") or ""
+    _stamp_session_proto(req, payload)
     return req
+
+
+def _stamp_session_proto(req, payload: dict) -> None:
+    """clientId/sessionGen onto a request proto (0 = not yet joined); a
+    stale pb2 without the fields just drops them (legacy single-client)."""
+    fields = req.DESCRIPTOR.fields_by_name
+    if "client_id" in fields:
+        req.client_id = payload.get("clientId") or ""
+        req.session_gen = int(payload.get("sessionGen") or 0)
+
+
+def _session_from_proto(req) -> dict:
+    fields = req.DESCRIPTOR.fields_by_name
+    if "client_id" not in fields:
+        return {}
+    out = {"clientId": req.client_id or None}
+    if req.session_gen:
+        out["sessionGen"] = int(req.session_gen)
+    return out
 
 
 def _deltas_from_proto(req) -> dict:
@@ -101,6 +122,7 @@ def _deltas_from_proto(req) -> dict:
         out["traceparent"] = req.traceparent
     if req.expect_epoch:
         out["expectEpoch"] = req.expect_epoch
+    out.update(_session_from_proto(req))
     return out
 
 
@@ -142,6 +164,7 @@ def _batch_to_proto(payload: dict):
             else:
                 s.str_val = str(operand)
         pc.allocated_nodes.extend(c.get("allocatedNodes") or ())
+    _stamp_session_proto(req, payload)
     return req
 
 
@@ -176,14 +199,21 @@ def _batch_from_proto(req) -> dict:
                 for s in pc.selectors],
             "allocatedNodes": list(pc.allocated_nodes),
         } for pc in req.claims]
+    out.update(_session_from_proto(req))
     return out
 
 
 def _results_to_proto(out: dict):
     p = pb2()
     resp = p.ScheduleBatchResponse()
+    has_conflict = "conflict" in p.PodResult.DESCRIPTOR.fields_by_name
     for r in out.get("results", ()):
         pr = p.PodResult(node_name=r.get("nodeName") or "")
+        if has_conflict and r.get("conflict"):
+            pr.conflict = True
+            pr.error = r.get("error") or ""
+            resp.results.append(pr)
+            continue
         if not pr.node_name:
             pr.unschedulable_plugins.extend(r.get("unschedulablePlugins") or ())
             pr.statuses_json = json.dumps(r.get("statuses") or {}).encode()
@@ -200,7 +230,14 @@ def _results_to_proto(out: dict):
 
 def _results_from_proto(resp) -> dict:
     results = []
+    pod_result_fields = (
+        resp.DESCRIPTOR.fields_by_name["results"].message_type.fields_by_name)
+    has_conflict = "conflict" in pod_result_fields
     for pr in resp.results:
+        if has_conflict and pr.conflict:
+            results.append({"nodeName": None, "conflict": True,
+                            "error": pr.error or ""})
+            continue
         if pr.node_name:
             results.append({"nodeName": pr.node_name})
             continue
@@ -237,24 +274,57 @@ def serve_grpc(service, port: int = 0):
         ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
                   f"stale epoch; current={exc.epoch}")
 
+    def _abort_conflict(ctx, exc):
+        # ABORTED = the cross-client race / fenced-session verdict (the
+        # HTTP binding's 409 + conflict body): the state base is fine, a
+        # resync cannot help — rejoin/requeue, never retry the transport
+        ctx.abort(grpc.StatusCode.ABORTED, f"commit conflict: {exc}")
+
     def apply_deltas(request, ctx):
         try:
             out = service.apply_deltas(_deltas_from_proto(request))
         except StaleEpochError as exc:
             _abort_stale(ctx, exc)
-        return p.ApplyDeltasResponse(nodes=int(out.get("nodes", 0)),
+        except ConflictError as exc:
+            _abort_conflict(ctx, exc)
+        resp = p.ApplyDeltasResponse(nodes=int(out.get("nodes", 0)),
                                      epoch=out.get("epoch", ""),
                                      delta_seq=int(out.get("deltaSeq", 0)))
+        if "session_gen" in p.ApplyDeltasResponse.DESCRIPTOR.fields_by_name:
+            resp.session_gen = int(out.get("sessionGen") or 0)
+        return resp
 
     def schedule_batch(request, ctx):
         try:
             out = service.schedule_batch(_batch_from_proto(request))
         except StaleEpochError as exc:
             _abort_stale(ctx, exc)
+        except ConflictError as exc:
+            _abort_conflict(ctx, exc)
         resp = _results_to_proto(out)
         resp.epoch = out.get("epoch", "")
         resp.delta_seq = int(out.get("deltaSeq", 0))
+        if "session_gen" in p.ScheduleBatchResponse.DESCRIPTOR.fields_by_name:
+            resp.session_gen = int(out.get("sessionGen") or 0)
         return resp
+
+    def heartbeat(request, ctx):
+        try:
+            out = service.heartbeat(_session_from_proto(request))
+        except ConflictError as exc:
+            _abort_conflict(ctx, exc)
+        resp = p.HeartbeatResponse(
+            epoch=out.get("epoch", ""),
+            session_gen=int(out.get("sessionGen") or 0),
+            sessions=int(out.get("sessions") or 0),
+            lease_ttl_s=float(out.get("leaseTtlS") or 0.0),
+            delta_seq=int(out.get("deltaSeq") or 0))
+        resp.fenced.extend(out.get("fenced") or ())
+        return resp
+
+    def sessions_dump(request, ctx):
+        out = service.sessions_dump({})
+        return p.SessionsResponse(sessions_json=json.dumps(out).encode())
 
     def health(request, ctx):
         out = service.health({})
@@ -263,7 +333,7 @@ def serve_grpc(service, port: int = 0):
                                 delta_seq=int(out.get("deltaSeq", 0)),
                                 nodes=int(out.get("nodes", 0)))
 
-    handlers = grpc.method_handlers_generic_handler(SERVICE, {
+    rpc_handlers = {
         "ApplyDeltas": grpc.unary_unary_rpc_method_handler(
             apply_deltas,
             request_deserializer=p.ApplyDeltasRequest.FromString,
@@ -276,7 +346,17 @@ def serve_grpc(service, port: int = 0):
             health,
             request_deserializer=p.HealthRequest.FromString,
             response_serializer=p.HealthResponse.SerializeToString),
-    })
+    }
+    if hasattr(p, "HeartbeatRequest"):  # stale pb2: no session verbs
+        rpc_handlers["Heartbeat"] = grpc.unary_unary_rpc_method_handler(
+            heartbeat,
+            request_deserializer=p.HeartbeatRequest.FromString,
+            response_serializer=p.HeartbeatResponse.SerializeToString)
+        rpc_handlers["Sessions"] = grpc.unary_unary_rpc_method_handler(
+            sessions_dump,
+            request_deserializer=p.SessionsRequest.FromString,
+            response_serializer=p.SessionsResponse.SerializeToString)
+    handlers = grpc.method_handlers_generic_handler(SERVICE, rpc_handlers)
     server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
     server.add_generic_rpc_handlers((handlers,))
     bound = server.add_insecure_port(f"127.0.0.1:{port}")
@@ -323,6 +403,19 @@ class GrpcClient:
             request_serializer=p.HealthRequest.SerializeToString,
             response_deserializer=p.HealthResponse.FromString)
             if self.supports_health else None)
+        self.supports_sessions = (
+            hasattr(p, "HeartbeatRequest")
+            and "client_id" in p.ApplyDeltasRequest.DESCRIPTOR.fields_by_name)
+        self._heartbeat = (self._channel.unary_unary(
+            f"/{SERVICE}/Heartbeat",
+            request_serializer=p.HeartbeatRequest.SerializeToString,
+            response_deserializer=p.HeartbeatResponse.FromString)
+            if self.supports_sessions else None)
+        self._sessions = (self._channel.unary_unary(
+            f"/{SERVICE}/Sessions",
+            request_serializer=p.SessionsRequest.SerializeToString,
+            response_deserializer=p.SessionsResponse.FromString)
+            if self.supports_sessions else None)
 
     def _call(self, op: str, stub, request):
         grpc = self._grpc
@@ -339,6 +432,10 @@ class GrpcClient:
                     if self._STALE_PREFIX in details:
                         epoch = details.split(self._STALE_PREFIX, 1)[1].strip()
                     raise StaleEpochError(epoch, details) from e
+                if code == grpc.StatusCode.ABORTED:
+                    # the typed conflict verdict: fenced session or a
+                    # cross-client pod/capacity race — rejoin/requeue
+                    raise ConflictError(details or "commit conflict") from e
                 if code in (grpc.StatusCode.UNAVAILABLE,
                             grpc.StatusCode.DEADLINE_EXCEEDED,
                             grpc.StatusCode.RESOURCE_EXHAUSTED):
@@ -349,13 +446,20 @@ class GrpcClient:
 
         return self.retry.run(op, attempt)
 
+    @staticmethod
+    def _session_gen_out(resp, out: dict) -> dict:
+        if ("session_gen" in resp.DESCRIPTOR.fields_by_name
+                and resp.session_gen):
+            out["sessionGen"] = int(resp.session_gen)
+        return out
+
     def apply_deltas(self, payload: dict) -> dict:
         resp = self._call("apply_deltas", self._apply, _deltas_to_proto(payload))
         out = {"nodes": resp.nodes}
         if resp.epoch:
             out["epoch"] = resp.epoch
             out["deltaSeq"] = resp.delta_seq
-        return out
+        return self._session_gen_out(resp, out)
 
     def schedule_batch(self, payload: dict) -> dict:
         resp = self._call("schedule_batch", self._schedule,
@@ -364,7 +468,29 @@ class GrpcClient:
         if resp.epoch:
             out["epoch"] = resp.epoch
             out["deltaSeq"] = resp.delta_seq
-        return out
+        return self._session_gen_out(resp, out)
+
+    def heartbeat(self, payload: dict) -> dict:
+        """Lease renewal + takeover signal (HA session verb)."""
+        if self._heartbeat is None:
+            raise PermanentDeviceError("Heartbeat RPC unsupported by this pb2")
+        p = pb2()
+        req = p.HeartbeatRequest(
+            client_id=payload.get("clientId") or "",
+            session_gen=int(payload.get("sessionGen") or 0))
+        resp = self._call("heartbeat", self._heartbeat, req)
+        return {"epoch": resp.epoch, "sessionGen": int(resp.session_gen),
+                "sessions": int(resp.sessions),
+                "fenced": list(resp.fenced),
+                "leaseTtlS": float(resp.lease_ttl_s),
+                "deltaSeq": int(resp.delta_seq)}
+
+    def sessions_dump(self) -> dict:
+        """Session-table introspection (/debug/sessions passthrough)."""
+        if self._sessions is None:
+            raise PermanentDeviceError("Sessions RPC unsupported by this pb2")
+        resp = self._call("sessions", self._sessions, pb2().SessionsRequest())
+        return json.loads(resp.sessions_json or b"{}")
 
     def health(self) -> dict:
         """The cheap identity/liveness verb (half-open circuit probe)."""

@@ -21,6 +21,13 @@ Three pieces:
     machinery (the north-star seam: the control plane does not know whether
     the backend is in-process or remote).
 
+HA topology (ISSUE 6): N WireScheduler replicas share ONE DeviceService.
+Requests carry a ``clientId``/``sessionGen``; the service keeps per-client
+sessions with leases, overlays adopted-but-unconfirmed placements as holds,
+validates every placement at commit time (typed ``conflict`` verdicts on
+cross-client races), and fences dead clients so survivors adopt the freed
+capacity. See README "HA topology".
+
 Wire envelope: {"apiVersion": "ktpu/v1", ...}; objects use api/codec.py.
 """
 
@@ -51,6 +58,7 @@ from .batch import build_schedule_batch_fn
 from .circuit import CircuitBreaker, OPEN, STATE_VALUES
 from .device_state import DeviceState, caps_for_cluster
 from .errors import (
+    ConflictError,
     DeviceServiceError,
     PermanentDeviceError,
     RetryPolicy,
@@ -61,6 +69,12 @@ from .errors import (
 from .tpu_scheduler import _ATTRIBUTION_ORDER, TPUScheduler
 
 API_VERSION = "ktpu/v1"
+
+# session lease: a scheduler replica that stops heartbeating for this long
+# is declared dead and FENCED — its uncommitted capacity is released for the
+# survivors and any late request from the dead incarnation gets a Conflict
+# (the fencing-token rule: a fenced writer can never commit)
+DEFAULT_LEASE_TTL_S = 15.0
 
 # process-epoch minting: unique per DeviceService INSTANCE (a restarted
 # sidecar is a new instance holding a fresh empty DeviceState; the epoch is
@@ -73,27 +87,93 @@ def _new_epoch() -> str:
     return f"{os.getpid():x}-{next(_EPOCH_IDS)}"
 
 
+class ClientSession:
+    """Per-client sync state (the server half of what used to be the single
+    unnamed client's ``_sent_gens``): which node generations THIS client has
+    pushed, its delta sequence, its idempotency cache, and its lease. A
+    fresh/rejoining client resets only its own slice — other clients' state
+    is untouched."""
+
+    __slots__ = ("client_id", "gen", "created_at", "last_seen", "delta_seq",
+                 "sent_gens", "last_batch", "batch_replays", "batches",
+                 "fenced", "fenced_seq", "fence_seq_seen", "released_holds")
+
+    def __init__(self, client_id: str, gen: int, now: float):
+        self.client_id = client_id
+        self.gen = gen                      # session incarnation (rejoin bumps)
+        self.created_at = now
+        self.last_seen = now                # lease heartbeat clock
+        self.delta_seq = 0
+        self.sent_gens: Dict[str, int] = {}  # node -> last gen this client pushed
+        self.last_batch: Optional[tuple] = None  # (batchId, response)
+        self.batch_replays = 0
+        self.batches = 0
+        self.fenced = False
+        self.fenced_seq = 0                 # fence-log seq of OUR fencing
+        self.fence_seq_seen = 0             # fence-log cursor for heartbeats
+        self.released_holds = 0
+
+
+class _Hold:
+    """One adopted-but-unconfirmed placement: the device committed the pod
+    for ``owner``, but no client's host truth includes it yet. While held,
+    every delta for the node re-overlays the pod so another replica's
+    (lagging) push can never erase the capacity and hand it out twice."""
+
+    __slots__ = ("pod", "node_name", "owner", "seen")
+
+    def __init__(self, pod: Pod, node_name: str, owner: str):
+        self.pod = pod
+        self.node_name = node_name
+        self.owner = owner
+        self.seen: set = set()  # client ids whose pushed content included it
+
+
 class DeviceService:
-    """Server core: node mirror + device state + one compiled batch program."""
+    """Server core: node mirror + device state + one compiled batch program.
+
+    Multi-tenant (active-active HA): any number of scheduler replicas share
+    this one service. Every request may carry a ``clientId`` (+ the
+    ``sessionGen`` the service answered with); the service keeps per-client
+    sessions, overlays adopted-but-unconfirmed placements onto the shared
+    mirror (``_Hold``), validates every placement at commit time against
+    current ownership/occupancy (cross-client races get a typed ``conflict``
+    verdict, never a double-bind), and fences clients whose lease expires —
+    releasing their uncommitted capacity to the survivors."""
 
     def __init__(self, batch_size: int = 512,
-                 percentage_of_nodes_to_score: int = 0):
+                 percentage_of_nodes_to_score: int = 0,
+                 lease_ttl_s: float = DEFAULT_LEASE_TTL_S,
+                 now_fn=time.monotonic):
         self.batch_size = batch_size
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
+        self.lease_ttl_s = lease_ttl_s
+        self.now_fn = now_fn
         # state-resync protocol: the epoch names THIS process incarnation;
         # delta_seq counts applied delta generations within it. A client
         # whose expectEpoch disagrees gets a stale-state error instead of
         # silently having its deltas applied against the wrong (empty) base.
         self.epoch = _new_epoch()
         self.delta_seq = 0
-        # idempotency cache: (batchId, response) of the last committed
-        # batch. A transport retry after a LOST RESPONSE (timeout/reset
-        # once the server already committed) replays the cached response
-        # instead of double-committing the pods against capacity their
-        # first copies consumed. One entry suffices: the client is
-        # sequential and only ever retries its most recent batch.
-        self._last_batch: Optional[tuple] = None
+        # per-client sessions (idempotency caches live inside — one entry
+        # per client suffices: each client is sequential and only ever
+        # retries its most recent batch). batch_replays stays as the
+        # aggregate counter the single-client tests read.
+        self.sessions: Dict[str, ClientSession] = {}
+        self._session_gens = itertools.count(1)
         self.batch_replays = 0
+        # adopted-but-unconfirmed placements: pod key -> _Hold
+        self.holds: Dict[str, _Hold] = {}
+        # pod key -> node for pods present in pushed CONTENT (host truth):
+        # the ownership check's "already bound" index
+        self._pod_nodes: Dict[str, str] = {}
+        self._node_pod_keys: Dict[str, set] = {}
+        # fence log: (seq, client_id) — heartbeat responses tell survivors
+        # which peers were fenced since their last beat
+        self._fences: List[tuple] = []
+        self._fence_seq = 0
+        self.takeovers = 0
+        self.commit_conflicts = 0
         self.infos: Dict[str, NodeInfo] = {}
         # duck-typed Snapshot: the wire service mirrors nodes wholesale per
         # delta, so every sync is a "structure changed" full walk — the
@@ -125,6 +205,158 @@ class DeviceService:
         out["deltaSeq"] = self.delta_seq
         return out
 
+    # ------------------------------------------------------------ sessions
+
+    def _live_sessions(self) -> List[ClientSession]:
+        return [s for s in self.sessions.values() if not s.fenced]
+
+    def _session_for(self, req: dict) -> ClientSession:
+        """Resolve (creating/rejoining as needed) the request's session and
+        touch its lease. Caller holds the lock. Raises ConflictError for a
+        fenced incarnation: a dead-declared client must rejoin (fresh
+        sessionGen + full resync), never silently keep committing."""
+        now = self.now_fn()
+        self._sweep_leases(now)
+        cid = req.get("clientId") or ""
+        gen = req.get("sessionGen")
+        s = self.sessions.get(cid)
+        if s is None or (s.fenced and gen is None):
+            # first contact, or an explicit rejoin after a fence: a fresh
+            # incarnation with its own generation and empty sync state.
+            # History starts NOW — fences that predate this session are not
+            # takeover news for it.
+            s = ClientSession(cid, next(self._session_gens), now)
+            s.fence_seq_seen = self._fence_seq
+            self.sessions[cid] = s
+        if s.fenced:
+            raise ConflictError(
+                f"client {cid!r} session {gen} was fenced (lease expired "
+                f"after {self.lease_ttl_s}s); rejoin with a full resync")
+        if gen is not None and gen != s.gen:
+            # a zombie from a previous incarnation of the same clientId:
+            # its view of its own holds is gone — it must not commit
+            raise ConflictError(
+                f"client {cid!r} session {gen} superseded by {s.gen}")
+        s.last_seen = now
+        return s
+
+    def _sweep_leases(self, now: float) -> None:
+        """Fence every named session whose lease expired. Anonymous
+        (legacy, clientId-less) sessions never expire — they are the
+        single-client demo topology and send no heartbeats."""
+        for cid, s in list(self.sessions.items()):
+            if not cid or s.fenced:
+                continue
+            if now - s.last_seen > self.lease_ttl_s:
+                self._fence(s)
+
+    def _fence(self, s: ClientSession) -> None:
+        """Declare a client dead: poison its idempotency cache server-side
+        (a late transport retry of its last batch will NOT be replayed),
+        and release its adopted-but-unconfirmed rows so a survivor adopts
+        the freed capacity — the scheduler-death twin of PR 5's device
+        poison-and-requeue."""
+        s.fenced = True
+        s.last_batch = None
+        self._fence_seq += 1
+        s.fenced_seq = self._fence_seq
+        self._fences.append((self._fence_seq, s.client_id))
+        self.takeovers += 1
+        for key, hold in list(self.holds.items()):
+            if hold.owner != s.client_id:
+                continue
+            # only never-confirmed capacity is released: a hold whose pod
+            # is in the node's current pushed content — or was EVER seen in
+            # any client's truth — is really bound; removing it would free
+            # capacity a live pod still occupies and hand it out twice
+            confirmed = (key in self._node_pod_keys.get(hold.node_name, ())
+                         or hold.seen)
+            if not confirmed:
+                ni = self.infos.get(hold.node_name)
+                if ni is not None:
+                    ni.remove_pod(hold.pod)
+                s.released_holds += 1
+            del self.holds[key]
+
+    def _prune_fences(self) -> None:
+        """Bound the fence bookkeeping (lock held): default client ids are
+        unique per scheduler process, so routine replica redeploys would
+        otherwise accrete one dead ClientSession (O(nodes) sent_gens) and
+        one fence-log entry FOREVER. Once every live session's heartbeat
+        cursor has passed a fence, the log entry and the dead session are
+        droppable — the fencing token lives in the session GENERATION (a
+        zombie's stamped gen can never match a newly minted one), not in
+        the fenced record."""
+        live = [s for s in self.sessions.values()
+                if not s.fenced and s.client_id]
+        if not live:
+            return
+        horizon = min(s.fence_seq_seen for s in live)
+        if self._fences and self._fences[0][0] <= horizon:
+            self._fences = [(seq, cid) for seq, cid in self._fences
+                            if seq > horizon]
+        # dead session records stay inspectable (/debug/sessions) for a
+        # grace window, then drop once every live peer has been told
+        grace = 10.0 * self.lease_ttl_s
+        now = self.now_fn()
+        for cid, s in list(self.sessions.items()):
+            if (s.fenced and s.fenced_seq <= horizon
+                    and now - s.last_seen > grace):
+                del self.sessions[cid]
+
+    def heartbeat(self, req: dict) -> dict:
+        """Lease renewal + takeover signal: touching the session IS the
+        renewal; the response carries every peer fenced since this
+        client's previous beat so a survivor can adopt the dead replica's
+        queue slice (and count scheduler_ha_takeovers_total)."""
+        with self._lock:
+            s = self._session_for(req)
+            fenced = [cid for seq, cid in self._fences
+                      if seq > s.fence_seq_seen and cid != s.client_id]
+            s.fence_seq_seen = self._fence_seq
+            self._prune_fences()
+            return self._stamp({
+                "apiVersion": API_VERSION,
+                "sessionGen": s.gen,
+                "leaseTtlS": self.lease_ttl_s,
+                "sessions": len(self._live_sessions()),
+                "fenced": fenced,
+            })
+
+    def sessions_dump(self, req: Optional[dict] = None) -> dict:
+        """/v1/sessions (the /debug/sessions body): per-client lease age,
+        delta sequence, in-flight hold count, replay/fence counters."""
+        with self._lock:
+            now = self.now_fn()
+            per_owner: Dict[str, int] = {}
+            for hold in self.holds.values():
+                per_owner[hold.owner] = per_owner.get(hold.owner, 0) + 1
+            sessions = []
+            for cid in sorted(self.sessions):
+                s = self.sessions[cid]
+                sessions.append({
+                    "clientId": cid,
+                    "sessionGen": s.gen,
+                    "leaseAgeS": now - s.last_seen,
+                    "leaseTtlS": self.lease_ttl_s if cid else None,
+                    "deltaSeq": s.delta_seq,
+                    "sentNodes": len(s.sent_gens),
+                    "batches": s.batches,
+                    "batchReplays": s.batch_replays,
+                    "inflightHolds": per_owner.get(cid, 0),
+                    "releasedHolds": s.released_holds,
+                    "fenced": s.fenced,
+                })
+            return self._stamp({
+                "apiVersion": API_VERSION,
+                "enabled": True,
+                "leaseTtlS": self.lease_ttl_s,
+                "takeovers": self.takeovers,
+                "commitConflicts": self.commit_conflicts,
+                "holds": len(self.holds),
+                "sessions": sessions,
+            })
+
     # ------------------------------------------------------------- deltas
 
     def apply_deltas(self, req: dict) -> dict:
@@ -139,27 +371,97 @@ class DeviceService:
 
     def _apply_deltas_traced(self, req: dict) -> dict:
         with self._lock:
-            if req.get("full"):
-                self.infos.clear()
-                self.ns_labels.clear()
-                self.device = None
+            s = self._session_for(req)
+            decoded = []
             for e in req.get("nodes", ()):
                 node = from_wire(Node, e["node"])
+                pods = [from_wire(Pod, pw) for pw in e.get("pods", ())]
+                decoded.append((node, pods, e.get("gen")))
+            if req.get("full"):
+                # full resync replaces THIS client's contribution only. A
+                # mirror node no other live session claims and the full set
+                # omits is a ghost (a dead predecessor's world) — sweep it.
+                # With a single session this degenerates to the old
+                # clear-everything semantics.
+                s.sent_gens.clear()
+                pushed = {node.meta.name for node, _, _ in decoded}
+                # the anonymous (legacy single-client) session never claims
+                # nodes: it predates sessions, sends no heartbeats, and its
+                # full pushes keep the old everything-or-nothing contract
+                others = [o for o in self._live_sessions()
+                          if o is not s and o.client_id]
+                for name in list(self.infos):
+                    if name in pushed:
+                        continue
+                    if any(name in o.sent_gens for o in others):
+                        continue
+                    self._drop_node(name)
+                if not others:
+                    self.ns_labels.clear()
+                    self.device = None
+            live_ids = {o.client_id for o in self._live_sessions()}
+            for node, pods, gen in decoded:
+                name = node.meta.name
                 ni = NodeInfo(node)
-                for pw in e.get("pods", ()):
-                    ni.add_pod(from_wire(Pod, pw))
-                ni.generation = e.get("gen", ni.generation)
-                self.infos[node.meta.name] = ni
+                content_keys = set()
+                for pod in pods:
+                    ni.add_pod(pod)
+                    content_keys.add(pod.key())
+                if gen is not None:
+                    ni.generation = gen
+                    s.sent_gens[name] = gen
+                # hold reconciliation: the pusher's content is authoritative
+                # for its OWN holds (assumed pods live in its cache, so an
+                # omission means surrendered/forgotten/expired — release);
+                # other owners' holds are re-overlaid until every live
+                # client's truth has caught up (else a lagging replica's
+                # push would erase capacity another replica just committed
+                # and the next batch would hand it out twice)
+                for key, hold in list(self.holds.items()):
+                    if hold.node_name != name:
+                        continue
+                    if key in content_keys:
+                        hold.seen.add(s.client_id)
+                        if live_ids <= hold.seen:
+                            del self.holds[key]  # durable in everyone's truth
+                    elif hold.owner == s.client_id:
+                        del self.holds[key]      # owner surrendered it
+                    else:
+                        ni.add_pod(hold.pod)     # overlay: capacity stays taken
+                for key in self._node_pod_keys.get(name, ()):
+                    # only drop index entries still pointing HERE: a pod
+                    # deleted and re-bound elsewhere under the same key has
+                    # a live entry for its new node that must survive this
+                    # node's stale key list
+                    if self._pod_nodes.get(key) == name:
+                        del self._pod_nodes[key]
+                self._node_pod_keys[name] = content_keys
+                for key in content_keys:
+                    self._pod_nodes[key] = name
+                self.infos[name] = ni
             for name in req.get("removed", ()):
-                self.infos.pop(name, None)
+                self._drop_node(name)
+                s.sent_gens.pop(name, None)
             # namespace labels ride along so namespaceSelector terms match
             # identically to the in-process path (sig_table ns_labels_fn)
             for ns, labels in (req.get("namespaces") or {}).items():
                 self.ns_labels[ns] = dict(labels)
             self._sync()
             self.delta_seq += 1
+            s.delta_seq += 1
             return self._stamp({"apiVersion": API_VERSION,
-                                "nodes": len(self.infos)})
+                                "nodes": len(self.infos),
+                                "sessionGen": s.gen})
+
+    def _drop_node(self, name: str) -> None:
+        """Remove a node and every index/hold anchored to it (lock held)."""
+        self.infos.pop(name, None)
+        for key in self._node_pod_keys.pop(name, ()):
+            if self._pod_nodes.get(key) == name:  # see _apply_deltas_traced
+                del self._pod_nodes[key]
+        for key, hold in list(self.holds.items()):
+            if hold.node_name == name:
+                del self.holds[key]
 
     def _ensure_device(self) -> None:
         import dataclasses
@@ -225,11 +527,15 @@ class DeviceService:
     def schedule_batch(self, req: dict) -> dict:
         self.check_epoch(req)
         batch_id = req.get("batchId")
+        session_req = {"clientId": req.get("clientId"),
+                       "sessionGen": req.get("sessionGen")}
         with self._lock:
-            if (batch_id and self._last_batch is not None
-                    and self._last_batch[0] == batch_id):
+            s = self._session_for(session_req)
+            if (batch_id and s.last_batch is not None
+                    and s.last_batch[0] == batch_id):
+                s.batch_replays += 1
                 self.batch_replays += 1
-                return self._last_batch[1]
+                return s.last_batch[1]
         pods = [from_wire(Pod, pw) for pw in req.get("pods", ())]
         tie_seeds = req.get("tieSeeds") or None
         # parent the whole server-side batch under the client's
@@ -239,15 +545,82 @@ class DeviceService:
                                       "device.schedule_batch",
                                       batch=len(pods)):
             out = self._schedule_batch_traced(pods, tie_seeds,
-                                              req.get("claims"))
+                                              req.get("claims"),
+                                              session_req=session_req)
         if batch_id:
             with self._lock:
-                self._last_batch = (batch_id, out)
+                cur = self.sessions.get(session_req.get("clientId") or "")
+                if cur is not None and not cur.fenced:
+                    cur.last_batch = (batch_id, out)
         return out
 
+    def _placement_fits(self, ni: NodeInfo, pod: Pod) -> bool:
+        """Commit-time occupancy re-check of one proposed placement against
+        the CURRENT mirror (content + holds), via the same fitsRequest the
+        admission-time Filter runs — commit and filter can never disagree.
+        The kernel judged against the same state under the same lock, so a
+        miss here means the capacity raced between this client's sync and
+        its batch — conflict, not double-bind."""
+        from ..framework.plugins.noderesources import fits_request
+
+        return not fits_request(pod.resource_request(), ni)
+
+    def _validate_placements(self, cid: str, pods: List[Pod],
+                             node_idx: np.ndarray,
+                             slot_names: Dict[int, str]) -> Dict[int, str]:
+        """Ownership check (lock held): every proposed placement is judged
+        against current ownership and occupancy AT COMMIT TIME. Accepted
+        placements become holds (overlaid into the mirror immediately, so
+        later pods in this batch and every later batch from any client see
+        the capacity taken); rejected ones return {batch index: reason} and
+        are answered with a typed conflict verdict. Two replicas racing for
+        the same pod or the same capacity can never both win."""
+        conflicts: Dict[int, str] = {}
+        for i, pod in enumerate(pods):
+            idx = int(node_idx[i])
+            if idx < 0 or idx not in slot_names:
+                continue
+            key = pod.key()
+            node_name = slot_names[idx]
+            bound = self._pod_nodes.get(key)
+            if bound is not None:
+                conflicts[i] = f"pod already bound on {bound}"
+                continue
+            hold = self.holds.get(key)
+            if hold is not None and hold.owner != cid:
+                conflicts[i] = (f"pod already committed by client "
+                                f"{hold.owner!r}")
+                continue
+            ni = self.infos.get(node_name)
+            if ni is None:
+                conflicts[i] = f"node {node_name} left the mirror"
+                continue
+            if hold is not None:
+                # the owner re-deciding its own pod (retry after a failed
+                # host commit): surrender the old hold before re-checking
+                old_ni = self.infos.get(hold.node_name)
+                if old_ni is not None:
+                    old_ni.remove_pod(hold.pod)
+                del self.holds[key]
+            if not self._placement_fits(ni, pod):
+                conflicts[i] = (f"node {node_name} occupancy changed "
+                                "(capacity raced)")
+                continue
+            ni.add_pod(pod)
+            self.holds[key] = _Hold(pod, node_name, cid)
+        if conflicts:
+            self.commit_conflicts += len(conflicts)
+        return conflicts
+
     def _schedule_batch_traced(self, pods: List[Pod], tie_seeds,
-                               claims=None) -> dict:
+                               claims=None, session_req=None) -> dict:
         with self._lock:
+            # re-validate the session at COMMIT time (the fencing-token
+            # rule): a client fenced between accepting the request and
+            # committing the batch must not mutate shared state
+            s = self._session_for(session_req or {})
+            s.batches += 1
+            cid = s.client_id
             self._ensure_device()
             for _attempt in range(8):
                 try:
@@ -324,6 +697,15 @@ class DeviceService:
                 self.device.adopt_device(result)
                 self.device.adopt_commits(result, host_pb, node_idx)
             slot_names = self.device.slot_to_name()
+            # ownership check: judge every proposed placement against
+            # current ownership/occupancy; winners become holds (overlaid
+            # into host truth so no later sync from a lagging replica can
+            # erase them), losers get a typed conflict verdict. The device
+            # arrays adopted the loser too — the next sync's content diff
+            # repairs that row from the (hold-free) host truth, exactly the
+            # PR-4 gang-surrender repair path.
+            conflicts = self._validate_placements(cid, pods, node_idx,
+                                                  slot_names)
             # device preemption screen for the batch's failures (ROADMAP
             # wire-hardening: hints ride back with unschedulable results so
             # the client's PostFilter skips hopeless candidates)
@@ -343,6 +725,10 @@ class DeviceService:
             results: List[dict] = []
             for i in range(len(pods)):
                 idx = int(node_idx[i])
+                if i in conflicts:
+                    results.append({"nodeName": None, "conflict": True,
+                                    "error": conflicts[i]})
+                    continue
                 if idx >= 0 and idx in slot_names:
                     results.append({"nodeName": slot_names[idx]})
                     continue
@@ -380,7 +766,8 @@ class DeviceService:
                         # still helps (preferred-node fast path)
                         r["preempt"] = {"candidates": None, "best": best_name}
                 results.append(r)
-        return self._stamp({"apiVersion": API_VERSION, "results": results})
+        return self._stamp({"apiVersion": API_VERSION, "results": results,
+                            "sessionGen": s.gen})
 
 
 # ---------------------------------------------------------------- transport
@@ -402,13 +789,15 @@ class ServiceBinding:
         old = self.service
         self.service = DeviceService(
             batch_size=old.batch_size,
-            percentage_of_nodes_to_score=old.percentage_of_nodes_to_score)
+            percentage_of_nodes_to_score=old.percentage_of_nodes_to_score,
+            lease_ttl_s=old.lease_ttl_s, now_fn=old.now_fn)
         self.restarts += 1
         return self.service
 
 
 _OPS = {"/v1/applyDeltas": "apply_deltas", "/v1/scheduleBatch": "schedule_batch",
-        "/v1/health": "health"}
+        "/v1/health": "health", "/v1/heartbeat": "heartbeat",
+        "/v1/sessions": "sessions_dump"}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -446,6 +835,13 @@ class _Handler(BaseHTTPRequestHandler):
                 except OSError:
                     pass
                 return
+            if fault.kind == "conflict":
+                # scripted cross-client race: the 409-conflict body, so the
+                # taxonomy tests can drive the client mapping without
+                # staging a real two-replica collision
+                self._json(409, {"error": "injected conflict",
+                                 "conflict": True})
+                return
             self._json(fault.status,
                        {"error": f"injected fault: {fault.kind}"})
             return
@@ -456,6 +852,12 @@ class _Handler(BaseHTTPRequestHandler):
             # retry loop does not burn its budget re-sending stale deltas)
             self._json(409, {"error": str(exc), "staleEpoch": True,
                              "epoch": exc.epoch})
+            return
+        except ConflictError as exc:
+            # 409 too, but a DIFFERENT 409: the state base is fine and a
+            # resync cannot help — another client owns the pod/session.
+            # The body's ``conflict`` flag is the discriminator.
+            self._json(409, {"error": str(exc), "conflict": True})
             return
         except Exception as exc:  # noqa: BLE001 — wire errors must be JSON
             self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
@@ -539,6 +941,8 @@ class WireClient:
             raise PermanentDeviceError(f"malformed device response: {e}") from e
         if status == 409 and out.get("staleEpoch"):
             raise StaleEpochError(out.get("epoch", ""), out.get("error", ""))
+        if status == 409 and out.get("conflict"):
+            raise ConflictError(out.get("error", "commit conflict"))
         if status in (502, 503, 504):
             # infrastructure-flavored 5xx (overload, proxy, restart in
             # progress) MAY clear: give the retry loop a chance before the
@@ -567,6 +971,7 @@ class WireClient:
     # the JSON transport is schema-free: claim rows ride the request as-is
     supports_dra = True
     supports_health = True
+    supports_sessions = True
 
     def apply_deltas(self, payload: dict) -> dict:
         return self._post("/v1/applyDeltas", payload, "apply_deltas")
@@ -577,6 +982,15 @@ class WireClient:
     def health(self) -> dict:
         """The cheap identity/liveness verb (half-open probe)."""
         return self._post("/v1/health", {"apiVersion": API_VERSION}, "health")
+
+    def heartbeat(self, payload: dict) -> dict:
+        """Lease renewal for this client's session (HA topology)."""
+        return self._post("/v1/heartbeat", payload, "heartbeat")
+
+    def sessions_dump(self) -> dict:
+        """Session-table introspection (/debug/sessions passthrough)."""
+        return self._post("/v1/sessions", {"apiVersion": API_VERSION},
+                          "sessions")
 
 
 # ---------------------------------------------------------------- scheduler
@@ -593,6 +1007,8 @@ class WireScheduler(Scheduler):
                  wire_max_retries: int = 3, wire_backoff_base: float = 0.05,
                  wire_backoff_max: float = 2.0, wire_deadline_s: float = 90.0,
                  breaker_threshold: int = 3, breaker_reset_s: float = 5.0,
+                 client_id: Optional[str] = None,
+                 heartbeat_interval_s: float = 5.0,
                  fault_plan=None, sleep_fn=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.retry_policy = RetryPolicy(
@@ -637,6 +1053,16 @@ class WireScheduler(Scheduler):
         self._sent_ns: Dict[str, dict] = {}
         self._batchable_cache: Dict[str, bool] = {}
         self.settle_abandoned = False
+        # HA session: this replica's identity on the shared device service.
+        # sessionGen is learned from the first response; a ConflictError
+        # (fenced/zombie session, or a raced pod) never counts against the
+        # breaker — the service is healthy, another replica just won.
+        self.client_id = client_id or f"ktpu-{_new_epoch()}"
+        self.heartbeat_interval_s = heartbeat_interval_s
+        self._session_gen: Optional[int] = None
+        self._last_heartbeat = self.now_fn()
+        self.session_rejoins = 0
+        self.ha_takeovers = 0
         # claim resolution for the wire dra_mask path (the builder only
         # reads the store; the mask itself builds server-side)
         from .claim_mask import ClaimMaskBuilder
@@ -727,6 +1153,7 @@ class WireScheduler(Scheduler):
             return
         payload = {"apiVersion": API_VERSION, "nodes": entries,
                    "removed": removed, "namespaces": namespaces}
+        self._stamp_session(payload)
         if self._device_epoch:
             payload["expectEpoch"] = self._device_epoch
         else:
@@ -747,6 +1174,7 @@ class WireScheduler(Scheduler):
             self._full_resync(exc.epoch)
             return
         self._device_epoch = out.get("epoch", self._device_epoch)
+        self._session_gen = out.get("sessionGen", self._session_gen)
         self._sent_gens.update(pending_gens)
         for n in removed:
             self._sent_gens.pop(n, None)
@@ -761,19 +1189,99 @@ class WireScheduler(Scheduler):
         self._sent_gens.clear()
         self._sent_ns.clear()
         self._device_epoch = new_epoch
+        # a new epoch = a new service INSTANCE: no session of ours survived
+        # it. Stamping the dead incarnation's sessionGen would read as a
+        # zombie (ConflictError) — rejoin fresh and learn the new gen from
+        # the resync response.
+        self._session_gen = None
         self.cache.update_snapshot(self.snapshot)
         entries, pending_gens = self._build_entries(skip_unsent_check=True)
         namespaces = {ns: dict(obj.meta.labels)
                       for ns, obj in self.store.namespaces.items()}
         payload = {"apiVersion": API_VERSION, "full": True, "nodes": entries,
                    "removed": [], "namespaces": namespaces}
+        self._stamp_session(payload)
         tp = tracing.format_traceparent()
         if tp:
             payload["traceparent"] = tp
         out = self.client.apply_deltas(payload)
         self._device_epoch = out.get("epoch", new_epoch)
+        self._session_gen = out.get("sessionGen", self._session_gen)
         self._sent_gens.update(pending_gens)
         self._sent_ns.update(namespaces)
+
+    # ------------------------------------------------------------ HA session
+
+    def _stamp_session(self, payload: dict) -> None:
+        payload["clientId"] = self.client_id
+        if self._session_gen is not None:
+            payload["sessionGen"] = self._session_gen
+        else:
+            payload.pop("sessionGen", None)  # re-stamp after a rejoin
+
+    def _session_rejoin(self) -> None:
+        """This incarnation was fenced (or superseded): forget the session
+        AND everything we believe the service holds for us, so the next
+        push re-establishes a fresh session with a full resync — the
+        scheduler-side twin of the stale-epoch recovery."""
+        self.session_rejoins += 1
+        self._session_gen = None
+        self._device_epoch = None
+        self._sent_gens.clear()
+        self._sent_ns.clear()
+
+    def _periodic_housekeeping(self) -> None:
+        super()._periodic_housekeeping()
+        if not getattr(self.client, "supports_sessions", False):
+            return
+        if self.breaker.state == OPEN:
+            # device presumed down: a heartbeat would just burn the retry
+            # budget's backoff sleeps inside the degraded loop. The breaker
+            # probe owns re-discovery; if our lease died meanwhile, the
+            # first post-heal request gets fenced and rejoins.
+            return
+        now = self.now_fn()
+        if (self.heartbeat_interval_s
+                and now - self._last_heartbeat >= self.heartbeat_interval_s):
+            self._last_heartbeat = now
+            self._heartbeat()
+
+    def _heartbeat(self) -> None:
+        payload = {"apiVersion": API_VERSION}
+        self._stamp_session(payload)
+        try:
+            out = self.client.heartbeat(payload)
+        except ConflictError:
+            self._session_rejoin()
+            return
+        except DeviceServiceError:
+            return  # transport trouble: the breaker path owns the wire story
+        self._session_gen = out.get("sessionGen", self._session_gen)
+        self.smetrics.client_sessions.set(value=out.get("sessions", 1))
+        for cid in out.get("fenced", ()):
+            self.ha_takeovers += 1
+            self.smetrics.ha_takeovers.inc()
+            self._adopt_after_takeover(cid)
+
+    def _adopt_after_takeover(self, dead_client: str) -> None:
+        """A peer replica was fenced: its uncommitted capacity is already
+        released server-side; adopt its orphaned queue slice. Unbound pods
+        this replica is (now) responsible for but is not tracking re-enter
+        the queue, and parked unschedulable pods get the capacity-freed
+        wake-up (the fence released real capacity, like an assigned-pod
+        delete)."""
+        from ..queue import events as qevents
+
+        pending = {qp.pod.key() for qp in self.queue.pending_pod_infos()}
+        for pod in list(self.store.pods.values()):
+            if pod.spec.node_name or not self._responsible_for(pod):
+                continue
+            key = pod.key()
+            if key in pending or key in self.waiting_pods:
+                continue
+            self.queue.add(pod)
+        self.queue.move_all_to_active_or_backoff_queue(
+            qevents.SCHEDULER_TAKEOVER)
 
     def schedule_batch_cycle(self) -> int:
         self._periodic_housekeeping()
@@ -851,6 +1359,16 @@ class WireScheduler(Scheduler):
         try:
             self._push_deltas()
             res = self._wire_schedule_batch(batch)
+        except ConflictError as exc:
+            # fenced session / cross-client race: the service is HEALTHY, so
+            # this never counts against the breaker. Rejoin under a fresh
+            # session and give the pods back to the backoffQ — the next
+            # attempt runs on a clean session against whatever the winning
+            # replica left behind.
+            self.smetrics.commit_conflicts.inc(self.client_id)
+            self._session_rejoin()
+            self._requeue_wire_failure(batch, exc, pod_cycle, t0)
+            return
         except DeviceServiceError as exc:
             # deliberately counts PERMANENT errors too: a deterministically
             # broken device (version skew answering 4xx forever) should open
@@ -880,6 +1398,7 @@ class WireScheduler(Scheduler):
                    "pods": [to_wire(qp.pod) for qp in batch],
                    "tieSeeds": [int(s) for s in seeds_for(batch)],
                    "batchId": f"{self._batch_id_prefix}-{next(self._batch_ids)}"}
+        self._stamp_session(payload)
         claims = wire_claims_for_batch(self.store, [qp.pod for qp in batch])
         if claims:
             payload["claims"] = claims
@@ -906,7 +1425,9 @@ class WireScheduler(Scheduler):
                     payload["expectEpoch"] = self._device_epoch
                 else:
                     payload.pop("expectEpoch", None)
+                self._stamp_session(payload)  # resync may have re-joined
         self._device_epoch = res.get("epoch", self._device_epoch)
+        self._session_gen = res.get("sessionGen", self._session_gen)
         return res
 
     def _schedule_degraded(self, batch: List[QueuedPodInfo], pod_cycle: int) -> None:
@@ -974,6 +1495,21 @@ class WireScheduler(Scheduler):
             fwk = self.framework_for_pod(qp.pod)
             self.metrics["schedule_attempts"] += 1
             node_name = r.get("nodeName")
+            if r.get("conflict") and i not in gang_rejected:
+                # another replica owns the pod (or won the capacity): the
+                # typed verdict maps to a rate-limited backoffQ requeue —
+                # by the retry either the winner's bind is visible (pod
+                # skipped at pop) or this replica gets a clean shot
+                self.smetrics.commit_conflicts.inc(self.client_id)
+                self.metrics["errors"] += 1
+                self.smetrics.observe_attempt(
+                    "error", fwk.profile_name, self.now_fn() - t0)
+                self._handle_scheduling_failure(
+                    fwk, self._new_cycle_state(), qp,
+                    Status.error(
+                        f"commit conflict: {r.get('error') or 'raced'}"),
+                    Diagnosis(), pod_cycle)
+                continue
             if i in gang_rejected:
                 if node_name:
                     # the device already adopted this member's placement;
@@ -1060,6 +1596,27 @@ class WireScheduler(Scheduler):
         return self.run_batched_until_settled(
             max_cycles=max_cycles, flush=flush, idle_wait=idle_wait,
             max_no_progress=max_no_progress)
+
+    def debug_sessions(self) -> dict:
+        """/debug/sessions body: this replica's session identity plus the
+        device service's whole session table (lease ages, per-client
+        deltaSeq, in-flight hold counts) fetched over the wire."""
+        out = {
+            "enabled": True,
+            "clientId": self.client_id,
+            "sessionGen": self._session_gen,
+            "sessionRejoins": self.session_rejoins,
+            "haTakeovers": self.ha_takeovers,
+            "heartbeatIntervalS": self.heartbeat_interval_s,
+        }
+        if getattr(self.client, "supports_sessions", False):
+            try:
+                out["service"] = self.client.sessions_dump()
+            except DeviceServiceError as exc:
+                out["service"] = {"error": f"{type(exc).__name__}: {exc}"}
+        else:
+            out["service"] = {"error": "transport lacks the sessions verb"}
+        return out
 
     def debug_circuit(self) -> dict:
         """/debug/circuit body: breaker state + resync/degradation story."""

@@ -58,8 +58,17 @@ class ComponentServer:
                 elif path == "/configz":
                     self._respond(200, json.dumps(outer.configz), "application/json")
                 elif path == "/metrics":
-                    text = outer.registry.expose() if outer.registry else ""
-                    self._respond(200, text, "text/plain; version=0.0.4")
+                    # content negotiation: an OpenMetrics scraper (Accept:
+                    # application/openmetrics-text) gets exemplars on the
+                    # histogram buckets; everyone else gets 0.0.4 text,
+                    # byte-identical to before (exemplars are illegal there)
+                    om = "openmetrics-text" in (self.headers.get("Accept") or "")
+                    text = (outer.registry.expose(openmetrics=om)
+                            if outer.registry else "")
+                    ctype = ("application/openmetrics-text; version=1.0.0; "
+                             "charset=utf-8" if om
+                             else "text/plain; version=0.0.4")
+                    self._respond(200, text, ctype)
                 elif path == "/debug" or path == "/debug/":
                     self._respond(200, json.dumps(
                         {"endpoints": sorted("/debug/" + n for n in outer.debug)}),
@@ -154,6 +163,9 @@ def build_debug_handlers(sched) -> dict:
       /debug/spans        tail of the in-memory span exporter
       /debug/circuit      device-service circuit breaker state, resync and
                           degradation counters (WireScheduler only)
+      /debug/sessions     HA session table: this replica's identity plus the
+                          device service's per-client lease age, deltaSeq,
+                          and in-flight hold counts (WireScheduler only)
     """
     from ..cache.debugger import CacheComparer
     from ..utils import tracing
@@ -209,9 +221,14 @@ def build_debug_handlers(sched) -> dict:
             return {"enabled": False}
         return sched.debug_circuit()
 
+    def sessions_dump():
+        if not hasattr(sched, "debug_sessions"):
+            return {"enabled": False}
+        return sched.debug_sessions()
+
     return {"queue": queue_dump, "cache": cache_dump,
             "devicestate": device_dump, "spans": spans_dump,
-            "circuit": circuit_dump}
+            "circuit": circuit_dump, "sessions": sessions_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,

@@ -56,6 +56,18 @@ class PodNominator:
         return self._by_node.get(node_name, [])
 
 
+def _trace_exemplar() -> Optional[dict]:
+    """Active trace/span id as an OpenMetrics exemplar for a sampled
+    duration observation: a slow plugin_execution_duration p99 bucket then
+    links straight to a concrete trace (/debug/spans, KTPU_TRACE_FILE)
+    instead of leaving the operator to guess which cycle was slow. One
+    global read (None) when tracing is disabled."""
+    span = tracing.current()
+    if span is None:
+        return None
+    return {"trace_id": span.trace_id, "span_id": span.span_id}
+
+
 def _status_str(out) -> str:
     """Extension-point status label from a run_* return value (Status,
     (x, Status) tuple, or anything else = Success)."""
@@ -193,7 +205,8 @@ class Framework:
         finally:
             if m is not None:
                 m.plugin_execution_duration.observe(
-                    perf_counter() - t0, plugin.name(), point, status)
+                    perf_counter() - t0, plugin.name(), point, status,
+                    exemplar=_trace_exemplar())
 
     # --------------------------------------------------------------- events
 
@@ -292,7 +305,8 @@ class Framework:
                 label = status.code_name()
             finally:
                 m.plugin_execution_duration.observe(
-                    perf_counter() - t0, plugin.name(), "filter", label)
+                    perf_counter() - t0, plugin.name(), "filter", label,
+                    exemplar=_trace_exemplar())
             if not status.is_success():
                 return status.with_plugin(plugin.name())
         return OK

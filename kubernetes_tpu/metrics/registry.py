@@ -90,13 +90,22 @@ class Histogram:
         self._counts: Dict[LabelValues, List[int]] = {}
         self._sums: Dict[LabelValues, float] = {}
         self._totals: Dict[LabelValues, int] = {}
+        # OpenMetrics exemplars: per (labelset, bucket) the LAST observed
+        # (exemplar labels, value) — a slow p99 bucket links to a concrete
+        # trace id. Bounded: one slot per bucket per labelset.
+        self._exemplars: Dict[LabelValues, Dict[int, Tuple[dict, float]]] = {}
         self._lock = threading.Lock()
 
-    def observe(self, value: float, *labels: str) -> None:
+    def observe(self, value: float, *labels: str,
+                exemplar: Optional[dict] = None) -> None:
         """O(log buckets): counts are stored PER-BUCKET (non-cumulative) and
         cumulated on the read paths — observe sits on the scheduling hot
         path (extension-point timing per examined node), a linear cumulative
-        write loop per sample was a measurable slice of the oracle cycle."""
+        write loop per sample was a measurable slice of the oracle cycle.
+
+        ``exemplar``: optional {label: value} (e.g. trace/span id) attached
+        to the bucket this observation lands in; exposed only in the
+        OpenMetrics exposition (the 0.0.4 text format has no exemplars)."""
         with self._lock:
             counts = self._counts.get(labels)
             if counts is None:
@@ -106,8 +115,17 @@ class Histogram:
             i = bisect.bisect_left(self.buckets, value)
             if i < len(counts):
                 counts[i] += 1
+                if exemplar:
+                    self._exemplars.setdefault(labels, {})[i] = (
+                        dict(exemplar), value)
             self._sums[labels] += value
             self._totals[labels] += 1
+
+    def exemplar_for(self, bucket_index: int, *labels: str):
+        """(exemplar labels, observed value) last landed in the bucket, or
+        None — the scrape-side accessor tests and dashboards use."""
+        with self._lock:
+            return self._exemplars.get(labels, {}).get(bucket_index)
 
     def count(self, *labels: str) -> int:
         return self._totals.get(labels, 0)
@@ -170,18 +188,27 @@ class Histogram:
                 return lo + frac * (b - lo)
         return self.buckets[-1]
 
-    def collect(self) -> List[str]:
+    def collect(self, openmetrics: bool = False) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
         # under the lock: a scrape racing observe() could otherwise hit a
         # mid-insert dict or emit +Inf (from _totals) below the last finite
         # cumulative bucket — exactly the invariant the exposition test checks
         with self._lock:
             for lv in sorted(self._totals):
+                exemplars = self._exemplars.get(lv, {}) if openmetrics else {}
                 cum = 0
                 for i, b in enumerate(self.buckets):  # exposition is cumulative
                     cum += self._counts[lv][i]
                     labels = _fmt_labels([*self.label_names, "le"], (*lv, repr(b)))
-                    out.append(f"{self.name}_bucket{labels} {cum}")
+                    line = f"{self.name}_bucket{labels} {cum}"
+                    ex = exemplars.get(i)
+                    if ex is not None:
+                        ex_labels, ex_value = ex
+                        inner = ",".join(
+                            f'{k}="{_escape_label_value(v)}"'
+                            for k, v in ex_labels.items())
+                        line += f" # {{{inner}}} {ex_value}"
+                    out.append(line)
                 labels = _fmt_labels([*self.label_names, "le"], (*lv, "+Inf"))
                 out.append(f"{self.name}_bucket{labels} {self._totals[lv]}")
                 out.append(f"{self.name}_sum{_fmt_labels(self.label_names, lv)} {self._sums[lv]}")
@@ -192,6 +219,7 @@ class Histogram:
         self._counts.clear()
         self._sums.clear()
         self._totals.clear()
+        self._exemplars.clear()
 
 
 class Registry:
@@ -211,11 +239,21 @@ class Registry:
     def get(self, name: str):
         return self._metrics.get(name)
 
-    def expose(self) -> str:
-        """Prometheus text exposition (the /metrics endpoint body)."""
+    def expose(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition (the /metrics endpoint body). With
+        ``openmetrics``, histogram bucket lines carry exemplars (`# {...} v`)
+        and the body ends with the spec-required ``# EOF``; the default
+        0.0.4 text format is byte-identical to before (exemplars are not
+        legal there)."""
         lines: List[str] = []
         for name in sorted(self._metrics):
-            lines.extend(self._metrics[name].collect())
+            metric = self._metrics[name]
+            if isinstance(metric, Histogram):
+                lines.extend(metric.collect(openmetrics=openmetrics))
+            else:  # counters/gauges have no exemplar surface
+                lines.extend(metric.collect())
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:

@@ -144,6 +144,23 @@ class SchedulerMetrics:
             "scheduler_degraded_seconds_total",
             "Seconds spent in breaker-open degraded (oracle fallback) mode.",
         ))
+        # active-active HA (per-client device-service sessions): live
+        # session count as seen by the last heartbeat, peer-fence takeover
+        # events this replica observed (and adopted after), and typed
+        # commit conflicts (another replica owned the pod/capacity)
+        self.client_sessions = r.register(Gauge(
+            "scheduler_client_sessions",
+            "Live scheduler sessions on the shared device service.",
+        ))
+        self.ha_takeovers = r.register(Counter(
+            "scheduler_ha_takeovers_total",
+            "Peer scheduler sessions fenced and adopted by this replica.",
+        ))
+        self.commit_conflicts = r.register(Counter(
+            "scheduler_commit_conflicts_total",
+            "Ownership-check conflicts at device commit time.",
+            ["client"],
+        ))
 
         # unschedulable_pods bookkeeping: gauge value = number of pods
         # CURRENTLY unschedulable attributed to each (plugin, profile); a

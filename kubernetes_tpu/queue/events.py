@@ -23,6 +23,11 @@ NODE_ADD = ClusterEvent(NODE, ADD, "NodeAdd")
 NODE_DELETE = ClusterEvent(NODE, DELETE, "NodeDelete")
 POD_ADD = ClusterEvent(POD, ADD, "PodAdd")
 POD_DELETE = ClusterEvent(POD, DELETE, "AssignedPodDelete")
+# HA fence: a dead scheduler replica's uncommitted capacity was released —
+# from a parked pod's perspective the same wake-up as an assigned-pod
+# delete (real capacity freed), but labeled so queue_incoming_pods can
+# attribute the surge to the takeover
+SCHEDULER_TAKEOVER = ClusterEvent(POD, DELETE, "SchedulerTakeover")
 POD_UPDATE = ClusterEvent(POD, UPDATE, "AssignedPodUpdate")
 NODE_ALLOCATABLE_CHANGE = ClusterEvent(NODE, UPDATE_NODE_ALLOCATABLE, "NodeAllocatableChange")
 NODE_LABEL_CHANGE = ClusterEvent(NODE, UPDATE_NODE_LABEL, "NodeLabelChange")

@@ -14,7 +14,8 @@ consumed in order, wired into two interception points:
     the client's taxonomy), ``crash`` replaces the served DeviceService
     with a FRESH instance — new process epoch, empty DeviceState — and
     severs the connection without a response, exactly what a sidecar
-    segfault+restart looks like from the client.
+    segfault+restart looks like from the client; ``conflict`` answers the
+    409 + ``conflict: true`` cross-client race verdict (HA taxonomy).
 
 Every consumed fault is appended to ``log`` so tests assert the script
 actually fired. Thread-safe: handler threads and the scheduling thread
@@ -71,6 +72,11 @@ class FaultPlan:
 
     def crash(self, op: str = ANY) -> "FaultPlan":
         return self.inject(op, Fault("crash"), side=SERVER)
+
+    def conflict(self, op: str = ANY, count: int = 1) -> "FaultPlan":
+        """Server answers 409 + ``conflict: true`` — the cross-client race
+        verdict, scriptable without staging a real two-replica collision."""
+        return self.inject(op, Fault("conflict", count=count), side=SERVER)
 
     # ------------------------------------------------------------ consuming
 

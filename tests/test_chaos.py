@@ -276,6 +276,10 @@ class _WireRig:
 
         sched_kw.setdefault("batch_size", 8)
         sched_kw.setdefault("wire_max_retries", 1)
+        # the device-fault suites script EXACT fault counts against the
+        # delta/batch ops; lease heartbeats would consume wildcard faults
+        # and skew the accounting (the HA suite opts back in)
+        sched_kw.setdefault("heartbeat_interval_s", 0.0)
         self.sched = WireScheduler(
             self.store, endpoint=f"http://127.0.0.1:{port}",
             now_fn=self.clock, sleep_fn=sleep, fault_plan=fault_plan,
@@ -377,6 +381,316 @@ class TestGangChaos:
             for n in bound.values():
                 per_node[n] = per_node.get(n, 0) + 1
             assert all(v <= 4 for v in per_node.values()), per_node
+        finally:
+            rig.close()
+
+
+class _Die(RuntimeError):
+    """Injected scheduler death: raised from inside a replica's result
+    processing, after the service committed the batch — the exact window
+    where a real process kill strands adopted-but-unbound capacity."""
+
+
+class _ReplicaScheduler(WireScheduler):
+    """WireScheduler with a partitionable pod keyspace: each active-active
+    replica owns a slice of the unbound pods (partition=None owns all);
+    observing a peer's fence widens this replica's slice to everything
+    before the normal orphan adoption runs."""
+
+    def __init__(self, *args, partition=None, **kwargs):
+        self._partition = partition  # before super(): event replay uses it
+        super().__init__(*args, **kwargs)
+
+    def _responsible_for(self, pod):
+        if not super()._responsible_for(pod):
+            return False
+        return self._partition is None or self._partition(pod)
+
+    def _adopt_after_takeover(self, dead_client):
+        self._partition = None  # adopt the whole keyspace
+        super()._adopt_after_takeover(dead_client)
+
+
+def _assert_oracle_replay_valid(store):
+    """Single-scheduler oracle replay validation: every bound placement,
+    re-judged by the sequential oracle's filters against the final cluster
+    state (the pod itself removed from its node), must pass — and no node
+    may exceed its allocatable on any axis."""
+    from kubernetes_tpu.framework.interface import CycleState
+    from kubernetes_tpu.framework.types import NodeInfo
+
+    infos = {}
+    for name, node in store.nodes.items():
+        infos[name] = NodeInfo(node)
+    bound = []
+    for p in store.pods.values():
+        if p.spec.node_name:
+            assert p.spec.node_name in infos, (p.meta.name, p.spec.node_name)
+            infos[p.spec.node_name].add_pod(p)
+            bound.append(p)
+    for ni in infos.values():
+        assert ni.requested.milli_cpu <= ni.allocatable.milli_cpu, ni.node.meta.name
+        assert ni.requested.memory <= ni.allocatable.memory, ni.node.meta.name
+        assert len(ni.pods) <= ni.allocatable.allowed_pod_number, ni.node.meta.name
+    oracle = Scheduler(store)
+    oracle.cache.update_snapshot(oracle.snapshot)
+    for p in bound:
+        fwk = oracle.framework_for_pod(p)
+        ni = infos[p.spec.node_name].clone()
+        ni.remove_pod(p)
+        state = CycleState()
+        fwk.run_pre_filter_plugins(state, p)
+        st = fwk.run_filter_plugins(state, p, ni)
+        assert st.is_success(), (p.meta.name, p.spec.node_name, st.message)
+
+
+class _HaRig:
+    """Two active-active scheduler replicas on ONE device service and ONE
+    apiserver store, partitioned pod queues, every clock (lease, backoff,
+    heartbeat, breaker) on a single FakeClock — no wall-clock sleeps."""
+
+    LEASE_TTL = 6.0
+
+    def __init__(self, nodes=4, cap="8", partition=True):
+        self.clock = FakeClock()
+        self.service = DeviceService(batch_size=64, now_fn=self.clock,
+                                     lease_ttl_s=self.LEASE_TTL)
+        self.server, self.port = serve(self.service)
+        self.store = ClusterStore()
+        for i in range(nodes):
+            self.store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": cap, "memory": "16Gi", "pods": 20}).obj())
+        part_a = (lambda p: p.meta.name.startswith("a-")) if partition else None
+        part_b = (lambda p: p.meta.name.startswith("b-")) if partition else None
+        self.a = self._replica("A", part_a)
+        self.b = self._replica("B", part_b)
+
+    def _replica(self, cid, partition):
+        return _ReplicaScheduler(
+            self.store, endpoint=f"http://127.0.0.1:{self.port}",
+            batch_size=8, client_id=cid, partition=partition,
+            now_fn=self.clock, sleep_fn=lambda s: self.clock.advance(s),
+            heartbeat_interval_s=1.0, wire_max_retries=1,
+            pod_initial_backoff=0.01, pod_max_backoff=0.05)
+
+    def survive(self, replica, rounds=4, step=2.0):
+        """Advance time past the lease TTL in sub-TTL steps, driving
+        ``replica`` each step so ITS heartbeats keep its own lease fresh
+        while the dead peer's lease runs out — the real deployment shape
+        (a jumped shared clock would expire both leases at once)."""
+        for _ in range(rounds):
+            self.clock.advance(step)
+            replica.run_until_settled()
+
+    def close(self):
+        self.server.shutdown()
+
+
+class TestActiveActiveChaos:
+    """ISSUE 6 acceptance: two replicas, one DeviceService; killing one
+    mid-gang and mid-drain yields zero lost pods and zero double-binds;
+    the survivor adopts the fenced capacity within the lease TTL; final
+    placements pass single-scheduler oracle replay validation."""
+
+    def _gang(self, store, prefix, n=4):
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name=prefix), min_member=n,
+            schedule_timeout_seconds=30))
+        for i in range(n):
+            store.create_pod(
+                make_pod(f"{prefix}-{i}").req({"cpu": "1", "memory": "1Gi"})
+                .pod_group(prefix).obj())
+
+    def test_kill_replica_mid_gang_survivor_adopts(self, monkeypatch):
+        """Replica A dies after the service committed its gang batch but
+        before any member bound (the mid-gang window): the gang's capacity
+        sits in server-side holds, the lease fence releases it, and the
+        survivor re-places the WHOLE gang — never a partial bind."""
+        rig = _HaRig()
+        try:
+            self._gang(rig.store, "a-train")
+            for i in range(2):
+                rig.store.create_pod(
+                    make_pod(f"b-solo-{i}").req({"cpu": "500m"}).obj())
+            rig.b.run_until_settled()
+            assert sum(1 for p in rig.store.pods.values()
+                       if p.spec.node_name) == 2  # B's slice landed
+
+            def boom(*a, **kw):
+                raise _Die("replica A killed mid-gang")
+
+            monkeypatch.setattr(rig.a, "_process_wire_results", boom)
+            import pytest
+
+            with pytest.raises(_Die):
+                rig.a.schedule_batch_cycle()
+            # the service committed the gang: 4 adopted-but-unbound holds
+            # occupy real capacity; the store shows the gang unbound (a
+            # partial bind never exists at ANY point). Bound pods may hold
+            # too until every replica's truth confirms them — count A's
+            # UNBOUND holds, the fenced-capacity set.
+            unbound_held = [
+                k for k, h in rig.service.holds.items()
+                if h.owner == "A"
+                and not rig.store.get_pod(k).spec.node_name]
+            assert len(unbound_held) == 4
+            assert all(not p.spec.node_name for p in rig.store.pods.values()
+                       if p.meta.name.startswith("a-train"))
+
+            # lease runs out under B's heartbeats: A is fenced, its holds
+            # release, B adopts the orphaned slice and lands the gang
+            rig.survive(rig.b)
+            assert rig.service.sessions["A"].fenced
+            assert rig.service.takeovers == 1
+            assert rig.b.ha_takeovers == 1
+            assert rig.b.smetrics.ha_takeovers.labels() == 1
+            bound = _bound(rig.store)
+            assert len(bound) == 6  # zero lost
+            gang_nodes = {bound[f"a-train-{i}"] for i in range(4)}
+            assert len(gang_nodes) == 4  # distinct-node gang, fully placed
+            _assert_oracle_replay_valid(rig.store)
+        finally:
+            rig.close()
+
+    def test_kill_replica_mid_drain_zero_lost_zero_double_bind(self, monkeypatch):
+        """Replica A dies mid-way through draining a multi-batch queue:
+        batch 1's pods are already bound (they stay), batch 2 was committed
+        server-side but never processed (fenced + released), the unpopped
+        tail was never sent. The survivor adopts everything unbound; no pod
+        is lost, none binds twice, and a zombie commit from the fenced
+        session is refused with the typed conflict."""
+        import pytest
+
+        from kubernetes_tpu.backend.errors import ConflictError
+
+        rig = _HaRig()
+        try:
+            for i in range(12):
+                rig.store.create_pod(
+                    make_pod(f"a-p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+            for i in range(2):
+                rig.store.create_pod(
+                    make_pod(f"b-p{i}").req({"cpu": "500m"}).obj())
+            rig.b.run_until_settled()
+            # batch 1 (8 pods) lands normally on A
+            rig.a.schedule_batch_cycle()
+            bound_before = _bound(rig.store)
+            assert sum(1 for k in bound_before if k.startswith("a-")) == 8
+            zombie_gen = rig.a._session_gen
+            assert zombie_gen is not None
+
+            def boom(*a, **kw):
+                raise _Die("replica A killed mid-drain")
+
+            monkeypatch.setattr(rig.a, "_process_wire_results", boom)
+            with pytest.raises(_Die):
+                rig.a.schedule_batch_cycle()  # batch 2 committed, A dead
+            unbound_held = [
+                k for k, h in rig.service.holds.items()
+                if h.owner == "A"
+                and not rig.store.get_pod(k).spec.node_name]
+            assert len(unbound_held) == 4
+
+            rig.survive(rig.b)
+            assert rig.service.sessions["A"].fenced
+            # the fenced incarnation can never commit again (fencing token)
+            with pytest.raises(ConflictError):
+                rig.a.client.schedule_batch({
+                    "apiVersion": "ktpu/v1", "clientId": "A",
+                    "sessionGen": zombie_gen, "pods": [],
+                    "batchId": "zombie-late-retry"})
+
+            bound = _bound(rig.store)
+            assert len(bound) == 14                      # zero lost
+            assert len(rig.store.pods) == 14             # zero duplicated
+            for name, node in bound_before.items():
+                assert bound[name] == node               # batch 1 undisturbed
+            _assert_oracle_replay_valid(rig.store)
+        finally:
+            rig.close()
+
+    def test_deliberate_race_same_pod_two_clients_single_winner(self):
+        """The ownership check, proven by a deliberate race: two sessions
+        submit the SAME pod; exactly one gets a placement, the other gets
+        the typed conflict verdict, and the capacity is counted once."""
+        from kubernetes_tpu.api.codec import to_wire
+        from kubernetes_tpu.utils.clock import FakeClock as _FC
+
+        service = DeviceService(batch_size=8, now_fn=_FC())
+        node = make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        entry = {"gen": 1, "node": to_wire(node), "pods": []}
+        service.apply_deltas({"clientId": "A", "nodes": [entry]})
+        service.apply_deltas({"clientId": "B", "nodes": [entry]})
+        pod = to_wire(make_pod("raced").req({"cpu": "1"}).obj())
+        first = service.schedule_batch(
+            {"clientId": "A", "pods": [pod], "batchId": "a-1"})
+        assert first["results"][0]["nodeName"] == "n0"
+        second = service.schedule_batch(
+            {"clientId": "B", "pods": [pod], "batchId": "b-1"})
+        assert second["results"][0]["nodeName"] is None
+        assert second["results"][0]["conflict"] is True
+        assert service.commit_conflicts == 1
+        # capacity counted exactly once
+        assert service.infos["n0"].requested.milli_cpu == 1000
+
+    def test_lagging_replica_delta_cannot_erase_peer_commit(self):
+        """The hold overlay: B pushes a node's content that predates A's
+        commit on that node — the service re-overlays A's held pod so the
+        capacity stays taken, and a B batch cannot double-allocate it."""
+        from kubernetes_tpu.api.codec import to_wire
+        from kubernetes_tpu.utils.clock import FakeClock as _FC
+
+        service = DeviceService(batch_size=8, now_fn=_FC())
+        node = make_node("n0").capacity(
+            {"cpu": "2", "memory": "8Gi", "pods": 10}).obj()
+        entry = {"gen": 1, "node": to_wire(node), "pods": []}
+        service.apply_deltas({"clientId": "A", "nodes": [entry]})
+        service.apply_deltas({"clientId": "B", "nodes": [entry]})
+        # A commits a 2-cpu pod: node n0 is now full (held)
+        big = to_wire(make_pod("a-big").req({"cpu": "2"}).obj())
+        out = service.schedule_batch({"clientId": "A", "pods": [big],
+                                      "batchId": "a-1"})
+        assert out["results"][0]["nodeName"] == "n0"
+        # B's lagging push re-sends n0 WITHOUT a-big: the hold re-overlays
+        service.apply_deltas({"clientId": "B",
+                              "nodes": [{"gen": 2, "node": to_wire(node),
+                                         "pods": []}]})
+        assert service.infos["n0"].requested.milli_cpu == 2000
+        # B's batch finds no room on n0 (no double-allocation)
+        small = to_wire(make_pod("b-small").req({"cpu": "1"}).obj())
+        out_b = service.schedule_batch({"clientId": "B", "pods": [small],
+                                        "batchId": "b-1"})
+        assert out_b["results"][0]["nodeName"] is None
+
+    def test_two_replicas_shared_keyspace_never_oversubscribe(self):
+        """Both replicas responsible for EVERY pod (no partition), driven
+        interleaved against one service on an exactly-filling workload: all
+        pods land exactly once, no node oversubscribes, and the run passes
+        oracle replay — the two-replica concurrent acceptance check."""
+        rig = _HaRig(nodes=4, cap="4", partition=False)
+        try:
+            for i in range(16):  # 16 × 1cpu == 4 nodes × 4cpu: exact fill
+                rig.store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+            for _ in range(300):
+                rig.a.schedule_batch_cycle()
+                rig.b.schedule_batch_cycle()
+                rig.clock.advance(0.1)
+                rig.a.queue.flush_backoff_completed()
+                rig.b.queue.flush_backoff_completed()
+                if len(_bound(rig.store)) == 16:
+                    break
+            bound = _bound(rig.store)
+            assert len(bound) == 16
+            per_node = {}
+            for n in bound.values():
+                per_node[n] = per_node.get(n, 0) + 1
+            assert all(v <= 4 for v in per_node.values()), per_node
+            _assert_oracle_replay_valid(rig.store)
+            assert rig.service.takeovers == 0  # both leases stayed fresh
         finally:
             rig.close()
 

@@ -134,7 +134,7 @@ class TestDebugEndpoints:
             assert status == 200
             assert set(json.loads(body)["endpoints"]) == {
                 "/debug/queue", "/debug/cache", "/debug/devicestate",
-                "/debug/spans", "/debug/circuit"}
+                "/debug/spans", "/debug/circuit", "/debug/sessions"}
 
             status, body = _get(port, "/debug/queue")
             doc = json.loads(body)
@@ -172,6 +172,49 @@ class TestDebugEndpoints:
         finally:
             tracing.disable()
             app.server.stop()
+
+    def test_debug_sessions_on_wire_scheduler(self):
+        """/debug/sessions smoke: per-client lease age, deltaSeq, and
+        in-flight hold counts ride the cmd mux for a WireScheduler; plain
+        schedulers answer enabled=false."""
+        from kubernetes_tpu.backend.service import DeviceService, WireScheduler, serve
+        from kubernetes_tpu.cmd.server import ComponentServer, build_debug_handlers
+        from kubernetes_tpu.scheduler.scheduler import Scheduler
+
+        service = DeviceService(batch_size=16)
+        dev_server, dev_port = serve(service)
+        try:
+            store = ClusterStore()
+            sched = WireScheduler(store,
+                                  endpoint=f"http://127.0.0.1:{dev_port}",
+                                  batch_size=8, client_id="muxed")
+            store.create_node(make_node("n0").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+            store.create_pod(make_pod("p0").req({"cpu": "500m"}).obj())
+            sched.run_until_settled()
+            srv = ComponentServer(configz={},
+                                  debug=build_debug_handlers(sched))
+            port = srv.start()
+            try:
+                status, body = _get(port, "/debug/sessions")
+                assert status == 200
+                doc = json.loads(body)
+                assert doc["enabled"] is True and doc["clientId"] == "muxed"
+                table = {s["clientId"]: s
+                         for s in doc["service"]["sessions"]}
+                row = table["muxed"]
+                assert row["deltaSeq"] >= 1
+                assert row["leaseAgeS"] >= 0.0
+                assert row["batches"] >= 1
+                assert row["fenced"] is False
+                assert "inflightHolds" in row
+            finally:
+                srv.stop()
+        finally:
+            dev_server.shutdown()
+        # a non-wire scheduler has no session surface
+        plain = build_debug_handlers(Scheduler(ClusterStore()))
+        assert plain["sessions"]() == {"enabled": False}
 
     def test_devicestate_dump_on_batched_scheduler(self):
         from kubernetes_tpu.backend import TPUScheduler

@@ -11,6 +11,7 @@ from kubernetes_tpu.api.wrappers import make_node, make_pod
 from kubernetes_tpu.backend import circuit
 from kubernetes_tpu.backend.circuit import CircuitBreaker
 from kubernetes_tpu.backend.errors import (
+    ConflictError,
     DeviceServiceError,
     PermanentDeviceError,
     RetryPolicy,
@@ -91,7 +92,8 @@ class TestRetryPolicy:
         assert len(calls) <= 5
 
     def test_permanent_and_stale_never_retry(self):
-        for exc in (PermanentDeviceError("bad"), StaleEpochError("e2")):
+        for exc in (PermanentDeviceError("bad"), StaleEpochError("e2"),
+                    ConflictError("raced")):
             policy, sleeper = self._policy(max_retries=5)
             calls = []
 
@@ -314,6 +316,274 @@ class TestEpochProtocol:
         out = service.apply_deltas({"full": True, "nodes": [
             {"gen": 1, "node": to_wire(nodes[0]), "pods": []}]})
         assert out["nodes"] == 1 and set(service.infos) == {"n0"}
+
+
+class TestConflictTaxonomy:
+    """ConflictError is its own family: a 409 whose body says ``conflict``
+    (NOT staleEpoch) — never retried at the transport, never a resync."""
+
+    def test_injected_conflict_maps_to_typed_error(self):
+        service = DeviceService(batch_size=8)
+        plan = FaultPlan().conflict("schedule_batch")
+        server, port = serve(service, fault_plan=plan)
+        try:
+            client = WireClient(f"http://127.0.0.1:{port}",
+                                retry=RetryPolicy(max_retries=3))
+            with pytest.raises(ConflictError):
+                client.schedule_batch({"pods": []})
+            assert ("server", "schedule_batch", "conflict") in plan.log
+        finally:
+            server.shutdown()
+
+
+class TestSessionLease:
+    """Per-client sessions + lease fencing at the DeviceService level."""
+
+    def _service(self, ttl=5.0):
+        clock = FakeClock()
+        return DeviceService(batch_size=8, lease_ttl_s=ttl, now_fn=clock), clock
+
+    def test_lease_expiry_fences_and_releases_holds(self):
+        from kubernetes_tpu.api.codec import to_wire
+
+        service, clock = self._service()
+        node = make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        entry = {"gen": 1, "node": to_wire(node), "pods": []}
+        out_a = service.apply_deltas({"clientId": "A", "nodes": [entry]})
+        gen_a = out_a["sessionGen"]
+        service.apply_deltas({"clientId": "B", "nodes": [entry]})
+        pod = to_wire(make_pod("p").req({"cpu": "2"}).obj())
+        out = service.schedule_batch({"clientId": "A", "sessionGen": gen_a,
+                                      "pods": [pod], "batchId": "a-1"})
+        assert out["results"][0]["nodeName"] == "n0"
+        assert service.infos["n0"].requested.milli_cpu == 2000  # held
+
+        # A goes silent past the TTL while B keeps beating; B's next
+        # heartbeat sweeps A's lease
+        clock.advance(3.0)
+        service.heartbeat({"clientId": "B"})
+        clock.advance(3.0)
+        hb = service.heartbeat({"clientId": "B"})
+        assert hb["fenced"] == ["A"]
+        assert service.sessions["A"].fenced
+        assert service.takeovers == 1
+        # the held (assumed-but-unbound) capacity is released
+        assert service.infos["n0"].requested.milli_cpu == 0
+        assert service.holds == {}
+
+        # fencing token: the dead incarnation can never commit again
+        with pytest.raises(ConflictError):
+            service.schedule_batch({"clientId": "A", "sessionGen": gen_a,
+                                    "pods": [pod], "batchId": "a-2"})
+        # ...and its poisoned idempotency cache never replays a-1
+        assert service.sessions["A"].last_batch is None
+
+        # rejoin (no sessionGen): a fresh incarnation under a new gen
+        out = service.heartbeat({"clientId": "A"})
+        assert out["sessionGen"] != gen_a
+        assert not service.sessions["A"].fenced
+
+    def test_fence_keeps_confirmed_bound_capacity(self):
+        """Fencing releases only NEVER-CONFIRMED holds: a hold whose pod
+        already appeared in the owner's pushed content is really bound —
+        freeing it would hand a live pod's capacity out twice."""
+        from kubernetes_tpu.api.codec import to_wire
+
+        service, clock = self._service()
+        node = make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        entry = {"gen": 1, "node": to_wire(node), "pods": []}
+        service.apply_deltas({"clientId": "A", "nodes": [entry]})
+        service.apply_deltas({"clientId": "B", "nodes": [entry]})
+        bound_pod = make_pod("bound").req({"cpu": "2"}).obj()
+        service.schedule_batch({"clientId": "A",
+                                "pods": [to_wire(bound_pod)],
+                                "batchId": "a-1"})
+        # A binds the pod and pushes content INCLUDING it (host truth);
+        # B (lagging) has not confirmed, so the hold still exists
+        bound_pod.spec.node_name = "n0"
+        service.apply_deltas({"clientId": "A", "nodes": [{
+            "gen": 2, "node": to_wire(node),
+            "pods": [to_wire(bound_pod)]}]})
+        assert "default/bound" in service.holds  # B hasn't seen it yet
+        # ...and an unconfirmed second commit from A on top
+        loose = make_pod("loose").req({"cpu": "1"}).obj()
+        service.schedule_batch({"clientId": "A",
+                                "pods": [to_wire(loose)], "batchId": "a-2"})
+        assert service.infos["n0"].requested.milli_cpu == 3000
+
+        clock.advance(3.0)
+        service.heartbeat({"clientId": "B"})
+        clock.advance(3.0)
+        service.heartbeat({"clientId": "B"})  # sweeps A's lease
+        assert service.sessions["A"].fenced
+        # only the never-confirmed hold ("loose") was released; the bound
+        # pod's capacity is untouched
+        assert service.holds == {}
+        assert service.infos["n0"].requested.milli_cpu == 2000
+        assert service.sessions["A"].released_holds == 1
+
+    def test_pod_index_survives_same_key_rebind(self):
+        """A pod deleted and re-created under the same key on another node:
+        the old node's stale key list must not erase the live index entry,
+        or a rival's in-flight copy would pass the 'already bound' check."""
+        from kubernetes_tpu.api.codec import to_wire
+
+        service, _clock = self._service()
+        n1 = make_node("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        n2 = make_node("n2").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        p_on_n1 = make_pod("p").req({"cpu": "1"}).obj()
+        p_on_n1.spec.node_name = "n1"
+        service.apply_deltas({"clientId": "A", "nodes": [
+            {"gen": 1, "node": to_wire(n1), "pods": [to_wire(p_on_n1)]},
+            {"gen": 1, "node": to_wire(n2), "pods": []}]})
+        assert service._pod_nodes["default/p"] == "n1"
+        # rebind lands in one push with the NEW node's entry first and the
+        # old node's (now empty) entry second — the adversarial order
+        p_on_n2 = make_pod("p").req({"cpu": "1"}).obj()
+        p_on_n2.spec.node_name = "n2"
+        service.apply_deltas({"clientId": "A", "nodes": [
+            {"gen": 2, "node": to_wire(n2), "pods": [to_wire(p_on_n2)]},
+            {"gen": 2, "node": to_wire(n1), "pods": []}]})
+        assert service._pod_nodes["default/p"] == "n2"
+        # a rival's in-flight copy of the pod still hits the bound check
+        out = service.schedule_batch({"clientId": "B", "batchId": "b-1",
+                                      "pods": [to_wire(
+                                          make_pod("p").req({"cpu": "1"})
+                                          .obj())]})
+        assert out["results"][0]["conflict"] is True
+
+    def test_fence_bookkeeping_is_pruned(self):
+        """Dead replicas must not accrete forever: once every live session's
+        heartbeat cursor passed a fence and the grace window (10×TTL)
+        elapsed, the fence-log entry and the dead session record drop."""
+        service, clock = self._service(ttl=5.0)
+        service.heartbeat({"clientId": "A"})
+        service.heartbeat({"clientId": "B"})
+        clock.advance(3.0)
+        service.heartbeat({"clientId": "B"})
+        clock.advance(3.0)
+        hb = service.heartbeat({"clientId": "B"})  # fences A, reports it
+        assert hb["fenced"] == ["A"]
+        assert "A" in service.sessions  # grace window: still inspectable
+        # B keeps beating past the grace window (10×TTL = 50s)
+        for _ in range(14):
+            clock.advance(4.0)
+            service.heartbeat({"clientId": "B"})
+        assert "A" not in service.sessions
+        assert service._fences == []
+
+    def test_anonymous_session_never_expires(self):
+        service, clock = self._service()
+        service.apply_deltas({"nodes": []})  # legacy clientId-less client
+        clock.advance(3600.0)
+        out = service.apply_deltas({"nodes": []})  # still served, no fence
+        assert out["deltaSeq"] == 2
+        assert service.takeovers == 0
+
+    def test_heartbeat_keeps_lease_fresh(self):
+        service, clock = self._service(ttl=5.0)
+        service.heartbeat({"clientId": "A"})
+        for _ in range(5):
+            clock.advance(3.0)  # 15s total, but beats every 3s
+            service.heartbeat({"clientId": "A"})
+        assert not service.sessions["A"].fenced
+
+
+class TestRelayBreakerProbeCadence:
+    """PR 3 carryover: the in-process TPU relay path gets its OWN breaker
+    with a cheap probe cadence — a dead relay degrades the batch path to
+    the oracle, and a healed one is probed after 0.5s (relay default), not
+    the wire breaker's 5s."""
+
+    def _sched(self, monkeypatch, clock):
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        monkeypatch.setenv("KTPU_PIPELINE", "0")  # commit inline per cycle
+        store = ClusterStore()
+        for i in range(4):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        sched = TPUScheduler(store, batch_size=4, now_fn=clock,
+                             relay_breaker_threshold=2,
+                             relay_probe_interval_s=0.5,
+                             pod_initial_backoff=0.01, pod_max_backoff=0.02)
+        return store, sched
+
+    def test_relay_death_degrades_and_cheap_probe_heals(self, monkeypatch):
+        from kubernetes_tpu.backend import batch as batch_mod
+
+        clock = FakeClock()
+        store, sched = self._sched(monkeypatch, clock)
+        real_unpack = batch_mod.unpack_result_block
+
+        def dead(*a, **kw):
+            raise RuntimeError("relay dropped mid-flight")
+
+        monkeypatch.setattr(batch_mod, "unpack_result_block", dead)
+        for i in range(4):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        # failure 1: commit dies, pods requeue, breaker still counting
+        sched.schedule_batch_cycle()
+        assert sched.metrics["scheduled"] == 0
+        assert sched.relay_breaker.state == circuit.CLOSED
+        clock.advance(0.05)
+        # failure 2: threshold crossed -> OPEN
+        sched.schedule_batch_cycle()
+        assert sched.relay_breaker.state == circuit.OPEN
+        clock.advance(0.05)
+        # open: every pod takes the oracle path in-cycle — scheduling never
+        # stops, and the dead device is not rebuilt per cycle
+        sched.schedule_batch_cycle()
+        assert sched.metrics["scheduled"] == 4
+        assert sched.relay_degraded_pods == 4
+        assert sched.fallback_scheduled == 4
+        assert sched.relay_breaker.state == circuit.OPEN
+
+        # the relay heals, but the probe interval hasn't elapsed: still open
+        monkeypatch.setattr(batch_mod, "unpack_result_block", real_unpack)
+        for i in range(2):
+            store.create_pod(make_pod(f"q{i}").req({"cpu": "100m"}).obj())
+        clock.advance(0.3)
+        sched.schedule_batch_cycle()
+        assert sched.relay_breaker.state == circuit.OPEN
+        assert sched.metrics["scheduled"] == 6  # oracle keeps landing pods
+
+        # past the RELAY cadence (0.5s — a wire-tuned 5s breaker would still
+        # be waiting): the next batch is the half-open probe; it commits and
+        # the batch path resumes
+        for i in range(2):
+            store.create_pod(make_pod(f"r{i}").req({"cpu": "100m"}).obj())
+        clock.advance(0.3)  # 0.6 total since the last failure
+        sched.schedule_batch_cycle()
+        assert sched.relay_breaker.state == circuit.CLOSED
+        assert sched.metrics["scheduled"] == 8
+        assert sched.batch_scheduled >= 2  # the probe batch went on-device
+
+    def test_failed_probe_reopens(self, monkeypatch):
+        from kubernetes_tpu.backend import batch as batch_mod
+
+        clock = FakeClock()
+        store, sched = self._sched(monkeypatch, clock)
+
+        def dead(*a, **kw):
+            raise RuntimeError("still dead")
+
+        monkeypatch.setattr(batch_mod, "unpack_result_block", dead)
+        for i in range(2):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+        clock.advance(0.05)
+        sched.schedule_batch_cycle()
+        assert sched.relay_breaker.state == circuit.OPEN
+        # probe admitted after the cadence, fails, re-opens immediately
+        clock.advance(0.6)
+        sched.schedule_batch_cycle()
+        assert sched.relay_breaker.state == circuit.OPEN
 
 
 class TestErrorRequeue:

@@ -219,6 +219,83 @@ def _parse_prom(text):
     return helps, types, samples
 
 
+def test_plugin_duration_exemplars_link_to_traces():
+    """Satellite (PR 2 carryover): sampled plugin_execution_duration
+    observations carry the active trace/span id as an OpenMetrics exemplar
+    — a slow p99 bucket links to a concrete trace. The 0.0.4 exposition is
+    untouched (exemplars are illegal there); the OpenMetrics body carries
+    `# {trace_id=...,span_id=...} value` on bucket lines and ends in # EOF."""
+    import urllib.request
+
+    from kubernetes_tpu.cmd.server import ComponentServer
+    from kubernetes_tpu.utils import tracing
+
+    m = SchedulerMetrics()
+    store = ClusterStore()
+    store.create_node(make_node("n1").capacity(
+        {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    sched = Scheduler(store, metrics=m)
+    tracing.enable()
+    try:
+        store.create_pod(make_pod("traced").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()  # attempt 1 always samples plugin metrics
+    finally:
+        spans = tracing.tail(4096)
+        tracing.disable()
+    trace_ids = {s.trace_id for s in spans}
+    assert trace_ids
+
+    # 0.0.4 exposition: byte-compatible, no exemplar syntax anywhere
+    plain = m.registry.expose()
+    assert " # {" not in plain
+    assert "# EOF" not in plain
+
+    om = m.registry.expose(openmetrics=True)
+    assert om.rstrip().endswith("# EOF")
+    ex_re = re.compile(
+        r'^scheduler_plugin_execution_duration_seconds_bucket\{[^}]*\} '
+        r'\d+ # \{trace_id="([0-9a-f]+)",span_id="([0-9a-f]+)"\} '
+        r'[0-9.e+-]+$')
+    matches = [ex_re.match(line) for line in om.splitlines()]
+    matches = [mm for mm in matches if mm]
+    assert matches, "no exemplar on any plugin-duration bucket line"
+    # every exemplar's trace id names a REAL exported span's trace
+    for mm in matches:
+        assert mm.group(1) in trace_ids
+
+    # exemplar rides the bucket its observation landed in (accessor view)
+    hist = m.plugin_execution_duration
+    found = False
+    for lv in hist.label_sets():
+        for i in range(len(hist.buckets)):
+            ex = hist.exemplar_for(i, *lv)
+            if ex is not None:
+                ex_labels, value = ex
+                assert set(ex_labels) == {"trace_id", "span_id"}
+                assert value <= hist.buckets[i]
+                found = True
+    assert found
+
+    # content negotiation on the serving mux: an OpenMetrics Accept header
+    # gets the exemplar exposition, the default scrape does not
+    srv = ComponentServer(configz={}, registry=m.registry)
+    port = srv.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/metrics",
+            headers={"Accept": "application/openmetrics-text; version=1.0.0"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            body = r.read().decode()
+            assert "openmetrics-text" in r.headers["Content-Type"]
+        assert body.rstrip().endswith("# EOF")
+        assert any(ex_re.match(line) for line in body.splitlines())
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+            assert " # {" not in r.read().decode()
+    finally:
+        srv.stop()
+
+
 def test_metrics_exposition_well_formed_over_http():
     """Satellite: scrape /metrics over HTTP after a mixed oracle+batched run;
     assert HELP/TYPE pairs, histogram bucket consistency, label escaping."""

@@ -186,6 +186,238 @@ def test_wire_dra_mask_claim_pods_stay_on_wire():
         server.shutdown()
 
 
+def test_conflict_vs_stale_epoch_409_disambiguation():
+    """Two DIFFERENT 409s ride the same status code: ``staleEpoch`` (resync
+    and carry on) vs ``conflict`` (another client owns it — requeue). The
+    client must map them to distinct typed errors."""
+    import pytest
+
+    from kubernetes_tpu.backend.errors import ConflictError, StaleEpochError
+    from kubernetes_tpu.backend.service import WireClient
+
+    service = DeviceService(batch_size=8)
+    server, port = serve(service)
+    try:
+        client = WireClient(f"http://127.0.0.1:{port}")
+        # 409 + staleEpoch: wrong process epoch
+        with pytest.raises(StaleEpochError):
+            client.apply_deltas({"expectEpoch": "not-this-process",
+                                 "nodes": []})
+        # 409 + conflict: a fenced/raced session commit
+        service.apply_deltas({"clientId": "A", "nodes": []})
+        gen_a = service.sessions["A"].gen
+        service._fence(service.sessions["A"])
+        with pytest.raises(ConflictError):
+            client.schedule_batch({"clientId": "A", "sessionGen": gen_a,
+                                   "pods": []})
+    finally:
+        server.shutdown()
+
+
+def test_wire_conflict_requeues_via_backoff_not_breaker():
+    """A conflict verdict maps to a rate-limited backoffQ requeue and a
+    session rejoin — never a breaker count (the service is healthy) and
+    never oracle degradation."""
+    from kubernetes_tpu.backend import circuit
+    from kubernetes_tpu.testing.faults import FaultPlan
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    service = DeviceService(batch_size=16)
+    plan = FaultPlan()
+    server, port = serve(service, fault_plan=plan)
+    try:
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = WireScheduler(
+            store, endpoint=f"http://127.0.0.1:{port}", batch_size=8,
+            client_id="confl", now_fn=clock,
+            sleep_fn=lambda s: clock.advance(s), fault_plan=plan,
+            breaker_threshold=2)
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        plan.conflict("schedule_batch")
+        store.create_pod(make_pod("p0").req({"cpu": "500m"}).obj())
+        sched.run_until_settled()
+        # the conflicted pod sat out a backoff window, the session rejoined,
+        # and the retry landed the pod on the wire path
+        assert sched.metrics["scheduled"] == 0
+        assert sched.queue.pending_pods()["backoff"] == 1
+        assert sched.breaker.state == circuit.CLOSED
+        assert sched.degraded_pods == 0
+        assert sched.session_rejoins == 1
+        assert sched.smetrics.commit_conflicts.labels("confl") == 1
+        clock.advance(1.1)
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 1
+        assert sched.breaker.state == circuit.CLOSED
+        assert service.batch_counter > 0
+    finally:
+        server.shutdown()
+
+
+def test_per_pod_conflict_verdict_requeues_one_pod():
+    """A per-result conflict (ownership check lost for ONE pod of a batch)
+    requeues just that pod; the rest of the batch binds normally."""
+    from kubernetes_tpu.api.codec import to_wire
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    service = DeviceService(batch_size=16)
+    server, port = serve(service)
+    try:
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = WireScheduler(
+            store, endpoint=f"http://127.0.0.1:{port}", batch_size=8,
+            client_id="mine", now_fn=clock,
+            sleep_fn=lambda s: clock.advance(s))
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "8", "memory": "8Gi", "pods": 10}).obj())
+        # a rival session commits (holds) the pod "stolen" before our
+        # scheduler's batch reaches the service
+        store.create_pod(make_pod("stolen").req({"cpu": "1"}).obj())
+        store.create_pod(make_pod("okay").req({"cpu": "1"}).obj())
+        rival_entry = {"gen": 1,
+                       "node": to_wire(store.nodes["n0"]), "pods": []}
+        service.apply_deltas({"clientId": "rival", "nodes": [rival_entry]})
+        service.schedule_batch({
+            "clientId": "rival", "batchId": "rival-1",
+            "pods": [to_wire(store.get_pod("default/stolen"))]})
+        sched.run_until_settled()
+        assert _bound(store).get("okay") == "n0"
+        assert "stolen" not in _bound(store)  # conflicted, parked in backoff
+        assert sched.smetrics.commit_conflicts.labels("mine") >= 1
+        assert sched.queue.pending_pods()["backoff"] == 1
+    finally:
+        server.shutdown()
+
+
+def test_full_resync_after_restart_with_rejoined_session():
+    """Device restart recovery must not depend on session-generation
+    coincidence: a client whose session had already been re-minted (gen > 1)
+    full-resyncs a RESTARTED service cleanly — the resync joins fresh
+    instead of stamping the dead incarnation's gen (which the new instance
+    would refuse as a zombie)."""
+    from kubernetes_tpu.backend import circuit
+    from kubernetes_tpu.testing.faults import FaultPlan
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    service = DeviceService(batch_size=16)
+    plan = FaultPlan()
+    server, port = serve(service, fault_plan=plan)
+    try:
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = WireScheduler(
+            store, endpoint=f"http://127.0.0.1:{port}", batch_size=8,
+            client_id="rs", now_fn=clock,
+            sleep_fn=lambda s: clock.advance(s), fault_plan=plan)
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "8", "memory": "8Gi", "pods": 10}).obj())
+        store.create_pod(make_pod("p0").req({"cpu": "1"}).obj())
+        sched.run_until_settled()
+        assert sched._session_gen is not None
+        # server-side fence forces a rejoin: the client's NEXT flush gets a
+        # conflict, rejoins, and lands under a fresh (non-1) generation
+        server.binding.service._fence(
+            server.binding.service.sessions["rs"])
+        store.create_pod(make_pod("p1").req({"cpu": "1"}).obj())
+        sched.run_until_settled()
+        clock.advance(1.1)
+        sched.run_until_settled()
+        assert sched.session_rejoins == 1
+        assert sched._session_gen is not None and sched._session_gen > 1
+        assert _bound(store).get("p1") == "n0"
+        conflicts_after_rejoin = sched.smetrics.commit_conflicts.labels("rs")
+
+        # the sidecar crashes (fresh instance, session gens restart at 1):
+        # stale-epoch recovery must be ONE clean full resync, not a second
+        # conflict round-trip
+        plan.crash("apply_deltas")
+        store.create_pod(make_pod("p2").req({"cpu": "1"}).obj())
+        sched.run_until_settled()
+        clock.advance(1.1)
+        sched.run_until_settled()
+        assert _bound(store).get("p2") == "n0"
+        assert sched.resyncs == 1
+        assert sched.breaker.state == circuit.CLOSED
+        assert (sched.smetrics.commit_conflicts.labels("rs")
+                == conflicts_after_rejoin)  # no conflict on the restart path
+    finally:
+        server.shutdown()
+
+
+def test_heartbeat_skipped_while_breaker_open():
+    """Degraded-mode liveness: with the breaker OPEN the scheduler must not
+    burn retry backoffs on heartbeats against a dead device — the breaker
+    probe owns re-discovery."""
+    from kubernetes_tpu.backend import circuit
+    from kubernetes_tpu.testing.faults import FaultPlan
+    from kubernetes_tpu.utils.clock import FakeClock
+
+    service = DeviceService(batch_size=16)
+    plan = FaultPlan()
+    server, port = serve(service, fault_plan=plan)
+    try:
+        store = ClusterStore()
+        clock = FakeClock()
+        sched = WireScheduler(
+            store, endpoint=f"http://127.0.0.1:{port}", batch_size=8,
+            client_id="hb", now_fn=clock,
+            sleep_fn=lambda s: clock.advance(s), fault_plan=plan,
+            wire_max_retries=0, breaker_threshold=1, breaker_reset_s=60.0,
+            heartbeat_interval_s=1.0)
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        beats = []
+        real_heartbeat = sched.client.heartbeat
+        sched.client.heartbeat = lambda p: (beats.append(1),
+                                            real_heartbeat(p))[1]
+        plan.drop(count=1)
+        store.create_pod(make_pod("p0").req({"cpu": "500m"}).obj())
+        sched.run_until_settled()
+        assert sched.breaker.state == circuit.OPEN
+        assert sched.metrics["scheduled"] == 1  # degraded oracle path
+        # heartbeat intervals elapse while open: no wire beats fired
+        for _ in range(5):
+            clock.advance(2.0)
+            sched.run_until_settled()
+        assert beats == []
+    finally:
+        server.shutdown()
+
+
+def test_heartbeat_verb_and_debug_sessions():
+    """The heartbeat verb renews the lease and reports live sessions; the
+    /debug/sessions body carries per-client lease age, deltaSeq, and hold
+    counts from the service's session table."""
+    service = DeviceService(batch_size=16)
+    server, port = serve(service)
+    try:
+        store = ClusterStore()
+        sched = WireScheduler(store, endpoint=f"http://127.0.0.1:{port}",
+                              batch_size=8, client_id="dbg")
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        store.create_pod(make_pod("p0").req({"cpu": "500m"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 1
+        sched._heartbeat()
+        assert sched._session_gen == service.sessions["dbg"].gen
+        assert sched.smetrics.client_sessions.labels() == 1
+
+        doc = sched.debug_sessions()
+        assert doc["enabled"] and doc["clientId"] == "dbg"
+        table = {s["clientId"]: s for s in doc["service"]["sessions"]}
+        assert "dbg" in table
+        row = table["dbg"]
+        assert row["deltaSeq"] >= 1
+        assert row["leaseAgeS"] >= 0.0
+        assert row["batches"] >= 1
+        assert "inflightHolds" in row and row["fenced"] is False
+    finally:
+        server.shutdown()
+
+
 def test_wire_health_verb_and_half_open_probe():
     """The Health RPC answers cheaply with the process identity, and a
     half-open breaker probes through it instead of pushing a full batch."""
