@@ -71,11 +71,14 @@ def build_dra_mask(device, entries, pad_to: int):
             restrict[p] &= row
     import jax.numpy as jnp
 
+    from . import telemetry
     from .batch import claim_feasibility_mask
 
-    mask = claim_feasibility_mask(
-        jnp.asarray(sel_key), jnp.asarray(sel_op), jnp.asarray(sel_kind),
-        jnp.asarray(sel_val), device.attr_kind, device.attr_val)
+    with telemetry.dispatch("claim_mask",
+                            bucket=f"{pad_to}x{sel_key.shape[1]}"):
+        mask = claim_feasibility_mask(
+            jnp.asarray(sel_key), jnp.asarray(sel_op), jnp.asarray(sel_kind),
+            jnp.asarray(sel_val), device.attr_kind, device.attr_val)
     if restrict is not None:
         mask = mask & jnp.asarray(restrict)
     return mask

@@ -75,6 +75,11 @@ class DeviceState:
         self.syncs = 0
         self.rows_uploaded = 0
         self.rows_elided = 0
+        # transfer telemetry: bytes scattered device-ward by the last /
+        # all sync calls (the padded row-block size — what actually rides
+        # the relay), read by backend/telemetry.py and /debug
+        self.last_upload_bytes = 0
+        self.upload_bytes = 0
         # host-side mirror of the device row content: lets sync skip rows
         # whose re-encoded content already matches the device (in particular
         # rows whose only change was an adopted batch commit). Initialized to
@@ -245,6 +250,7 @@ class DeviceState:
         """Upload rows for nodes whose generation advanced; returns number of
         rows uploaded. Raises CapacityError when the cluster outgrows caps."""
         self._refresh_class_prio()
+        self.last_upload_bytes = 0
         dirty: List[Tuple[int, NodeInfo]] = []
         current = set()
         images_changed = False
@@ -334,10 +340,17 @@ class DeviceState:
         else:
             image_sizes = nt.image_sizes
             image_num_nodes = nt.image_num_nodes
-        self.nt = _apply_rows_jit(nt, jnp.asarray(slots), updates,
-                                  image_sizes, image_num_nodes)
+        from . import telemetry
+
+        with telemetry.dispatch("apply_rows", bucket=str(b)):
+            self.nt = _apply_rows_jit(nt, jnp.asarray(slots), updates,
+                                      image_sizes, image_num_nodes)
         self.syncs += 1
         self.rows_uploaded += n
+        nbytes = sum(arr.nbytes for arr in updates.values()) + slots.nbytes
+        self.last_upload_bytes = int(nbytes)
+        self.upload_bytes += int(nbytes)
+        telemetry.transfer("upload", nbytes)
         return n
 
     def reconcile(self, snapshot: Snapshot) -> int:

@@ -54,6 +54,7 @@ from ..framework.interface import CycleState, Status
 from ..ops.encode import CapacityError
 from ..scheduler.scheduler import Scheduler
 from ..utils import tracing
+from . import telemetry
 from .batch import build_schedule_batch_fn
 from .circuit import CircuitBreaker, OPEN, STATE_VALUES
 from .device_state import DeviceState, caps_for_cluster
@@ -256,12 +257,14 @@ class DeviceService:
         and release its adopted-but-unconfirmed rows so a survivor adopts
         the freed capacity — the scheduler-death twin of PR 5's device
         poison-and-requeue."""
+        last_batch_id = s.last_batch[0] if s.last_batch else None
         s.fenced = True
         s.last_batch = None
         self._fence_seq += 1
         s.fenced_seq = self._fence_seq
         self._fences.append((self._fence_seq, s.client_id))
         self.takeovers += 1
+        released_before = s.released_holds
         for key, hold in list(self.holds.items()):
             if hold.owner != s.client_id:
                 continue
@@ -277,6 +280,9 @@ class DeviceService:
                     ni.remove_pod(hold.pod)
                 s.released_holds += 1
             del self.holds[key]
+        telemetry.event("fence", client=s.client_id, epoch=self.epoch,
+                        batchId=last_batch_id,
+                        releasedHolds=s.released_holds - released_before)
 
     def _prune_fences(self) -> None:
         """Bound the fence bookkeeping (lock held): default client ids are
@@ -546,7 +552,8 @@ class DeviceService:
                                       batch=len(pods)):
             out = self._schedule_batch_traced(pods, tie_seeds,
                                               req.get("claims"),
-                                              session_req=session_req)
+                                              session_req=session_req,
+                                              batch_id=batch_id)
         if batch_id:
             with self._lock:
                 cur = self.sessions.get(session_req.get("clientId") or "")
@@ -567,7 +574,8 @@ class DeviceService:
 
     def _validate_placements(self, cid: str, pods: List[Pod],
                              node_idx: np.ndarray,
-                             slot_names: Dict[int, str]) -> Dict[int, str]:
+                             slot_names: Dict[int, str],
+                             batch_id=None) -> Dict[int, str]:
         """Ownership check (lock held): every proposed placement is judged
         against current ownership and occupancy AT COMMIT TIME. Accepted
         placements become holds (overlaid into the mirror immediately, so
@@ -610,10 +618,14 @@ class DeviceService:
             self.holds[key] = _Hold(pod, node_name, cid)
         if conflicts:
             self.commit_conflicts += len(conflicts)
+            for i, reason in conflicts.items():
+                telemetry.event("conflict", client=cid, batchId=batch_id,
+                                pod=pods[i].key(), reason=reason)
         return conflicts
 
     def _schedule_batch_traced(self, pods: List[Pod], tie_seeds,
-                               claims=None, session_req=None) -> dict:
+                               claims=None, session_req=None,
+                               batch_id=None) -> dict:
         with self._lock:
             # re-validate the session at COMMIT time (the fencing-token
             # rule): a client fenced between accepting the request and
@@ -670,19 +682,27 @@ class DeviceService:
                 pad_to = len(host_pb["req"])
                 dra_mask = build_dra_mask(
                     self.device, wire_claims_to_entries(claims), pad_to)
+            bucket = int(getattr(pb, "capacity", len(pods)))
+            telemetry.event("dispatch", batchId=batch_id, client=cid,
+                            epoch=self.epoch, bucket=bucket, pods=len(pods))
             with tracing.span("device.dispatch", batch=len(pods)):
-                result = self.schedule_batch_fn(
-                    pb, et, self.device.nt, self.device.tc, tb,
-                    np.int32(self.batch_counter),
-                    topo_enabled=self.device.topo_enabled,
-                    sample_k=sample_k, sample_start=sample_start,
-                    dra_mask=dra_mask)
+                sig = f"{bucket}/" + (
+                    "general" if self.device.topo_enabled else "off")
+                with telemetry.dispatch("schedule_batch", bucket=sig):
+                    result = self.schedule_batch_fn(
+                        pb, et, self.device.nt, self.device.tc, tb,
+                        np.int32(self.batch_counter),
+                        topo_enabled=self.device.topo_enabled,
+                        sample_k=sample_k, sample_start=sample_start,
+                        dra_mask=dra_mask)
             if result.final_sample_start is not None:
                 self._start_carry = result.final_sample_start
             # adopt exactly like the in-process path: the client will assume
             # these placements; its next delta push re-encodes any row the
             # host view disagrees on and the content diff repairs it
-            with tracing.span("device.commit", batch=len(pods)):
+            with tracing.span("device.commit", batch=len(pods),
+                              packed="packed" if result.packed is not None
+                              else "fallback"):
                 # THE blocking read: the packed result block lands node_idx
                 # AND first_fail in one materialization (the per-array reads
                 # were one relay round-trip each on the TPU tunnel)
@@ -691,9 +711,13 @@ class DeviceService:
 
                     node_idx, ff = unpack_result_block(
                         result.packed, self.device.caps.nodes)
+                    telemetry.transfer("fetch", result.packed.nbytes)
                 else:
                     node_idx = np.asarray(result.node_idx)
                     ff = None
+                    telemetry.transfer("fetch", node_idx.nbytes)
+                    telemetry.event("packed_fallback", batchId=batch_id,
+                                    client=cid, pods=len(pods))
                 self.device.adopt_device(result)
                 self.device.adopt_commits(result, host_pb, node_idx)
             slot_names = self.device.slot_to_name()
@@ -705,7 +729,18 @@ class DeviceService:
             # repairs that row from the (hold-free) host truth, exactly the
             # PR-4 gang-surrender repair path.
             conflicts = self._validate_placements(cid, pods, node_idx,
-                                                  slot_names)
+                                                  slot_names,
+                                                  batch_id=batch_id)
+            if telemetry.get() is not None:
+                # placed= is an O(batch) scan — keep it behind the enabled
+                # check so the disabled hot path stays one global read
+                telemetry.event(
+                    "commit", batchId=batch_id, client=cid, epoch=self.epoch,
+                    bucket=bucket, pods=len(pods),
+                    placed=int(sum(1 for i in range(len(pods))
+                                   if int(node_idx[i]) >= 0
+                                   and i not in conflicts)),
+                    conflicts=len(conflicts))
             # device preemption screen for the batch's failures (ROADMAP
             # wire-hardening: hints ride back with unschedulable results so
             # the client's PostFilter skips hopeless candidates)
@@ -715,9 +750,11 @@ class DeviceService:
                     from ..ops.preempt import screen_prefix
 
                     self.device._refresh_class_prio()
-                    pres = screen_prefix(pb, self.device.nt,
-                                         result.static_masks,
-                                         node_idx[:len(pods)] < 0)
+                    with telemetry.dispatch("preempt_screen",
+                                            bucket=str(bucket)):
+                        pres = screen_prefix(pb, self.device.nt,
+                                             result.static_masks,
+                                             node_idx[:len(pods)] < 0)
                     screen = np.asarray(pres.screen)
                     best = np.asarray(pres.best)
                 except Exception:  # noqa: BLE001 — hints are optional
@@ -1261,6 +1298,8 @@ class WireScheduler(Scheduler):
         for cid in out.get("fenced", ()):
             self.ha_takeovers += 1
             self.smetrics.ha_takeovers.inc()
+            telemetry.event("takeover", client=self.client_id,
+                            fencedPeer=cid)
             self._adopt_after_takeover(cid)
 
     def _adopt_after_takeover(self, dead_client: str) -> None:
@@ -1284,6 +1323,9 @@ class WireScheduler(Scheduler):
             qevents.SCHEDULER_TAKEOVER)
 
     def schedule_batch_cycle(self) -> int:
+        if self.informer_factory is not None:
+            self.informer_factory.pump()  # see TPUScheduler: the batched
+            # loop pumps the informer bus exactly like schedule_one
         self._periodic_housekeeping()
         qps = self.queue.pop_batch(self.batch_size)
         if not qps:
@@ -1366,6 +1408,8 @@ class WireScheduler(Scheduler):
             # attempt runs on a clean session against whatever the winning
             # replica left behind.
             self.smetrics.commit_conflicts.inc(self.client_id)
+            telemetry.event("conflict", client=self.client_id,
+                            pods=len(batch), reason=str(exc)[:200])
             self._session_rejoin()
             self._requeue_wire_failure(batch, exc, pod_cycle, t0)
             return
@@ -1431,6 +1475,8 @@ class WireScheduler(Scheduler):
         return res
 
     def _schedule_degraded(self, batch: List[QueuedPodInfo], pod_cycle: int) -> None:
+        telemetry.event("degrade", client=self.client_id, pods=len(batch),
+                        reason="wire breaker open")
         self.degraded_pods += len(batch)
         self.cache.update_snapshot(self.snapshot)
         for qp in batch:
@@ -1438,6 +1484,8 @@ class WireScheduler(Scheduler):
 
     def _requeue_wire_failure(self, batch: List[QueuedPodInfo],
                               exc: Exception, pod_cycle: int, t0: float) -> None:
+        telemetry.event("requeue", client=self.client_id, pods=len(batch),
+                        error=f"{type(exc).__name__}: {exc}"[:200])
         for qp in batch:
             fwk = self.framework_for_pod(qp.pod)
             self.metrics["schedule_attempts"] += 1
@@ -1501,6 +1549,9 @@ class WireScheduler(Scheduler):
                 # by the retry either the winner's bind is visible (pod
                 # skipped at pop) or this replica gets a clean shot
                 self.smetrics.commit_conflicts.inc(self.client_id)
+                telemetry.event("conflict", client=self.client_id,
+                                pod=qp.pod.key(),
+                                reason=(r.get("error") or "raced")[:200])
                 self.metrics["errors"] += 1
                 self.smetrics.observe_attempt(
                     "error", fwk.profile_name, self.now_fn() - t0)

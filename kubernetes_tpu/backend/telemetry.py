@@ -1,0 +1,422 @@
+"""Device-runtime observability: compile/retrace ledger, HBM & transfer
+telemetry, and the batch flight recorder.
+
+PR 2 instrumented the *scheduling pipeline* (extension points, spans,
+/debug); this module watches the JAX/XLA *device runtime* underneath it:
+
+  * **CompileLedger** — every XLA backend compile is counted and timed per
+    (program, bucket signature). Call sites wrap their jitted dispatches in
+    ``telemetry.dispatch("schedule_batch", bucket="128/host")``; a
+    ``jax.monitoring`` duration listener attributes each
+    ``backend_compile_duration`` event to the active dispatch context.
+    A *retrace* is any compile beyond a program's first; a *retrace storm*
+    (>= STORM_RETRACES retraces of one program within STORM_WINDOW of its
+    dispatches — e.g. the BatchSizer walking buckets mid-run) is flagged
+    once per storm and exposed on /debug/flightrecorder and in bench
+    evidence.
+  * **HBM & transfer telemetry** — ``sample_hbm()`` reads the accelerator's
+    ``memory_stats()`` into ``scheduler_device_hbm_bytes{kind}`` gauges;
+    ``transfer(direction, nbytes)`` accumulates per-batch host->device
+    (row upload) and device->host (packed-block fetch) byte counts, also
+    annotated onto the active span as ``device.upload``/``device.fetch``.
+  * **FlightRecorder** — a bounded ring of structured batch lifecycle
+    events (encode/dispatch/commit/poison/requeue/conflict/fence/degrade/
+    takeover/packed_fallback) carrying batchId, client, epoch, bucket.
+    Dumped via /debug/flightrecorder; chaos suites read it for
+    postmortems instead of print-debugging.
+
+Disabled contract (the PR 2 disabled-tracer rule): the process recorder is
+``None`` by default and every hook is one module-global read before
+returning — enabling the layer must change *no* scheduling decision, only
+counters and the ring (tests/test_telemetry.py pins both halves).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+_recorder: Optional["DeviceTelemetry"] = None
+
+# the jax.monitoring event that fires exactly once per XLA backend compile
+# (never on an executable-cache hit — verified against jax 0.4.x)
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+# compiles attributed while no dispatch context is open (helper jits,
+# warm-path internals) land here instead of being dropped
+OTHER_PROGRAM = "(other)"
+
+# retrace-storm detector: >= STORM_RETRACES compiles of one program within
+# STORM_WINDOW dispatches of that program, after its first compile
+STORM_RETRACES = 3
+STORM_WINDOW = 32
+
+
+class FlightRecorder:
+    """Bounded, lock-cheap ring of batch lifecycle events. ``deque.append``
+    with a maxlen is atomic under the GIL, so the hot path takes no lock;
+    ``dump`` snapshots with a C-level ``list()`` the same way the queue
+    dump does."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._ring: deque = deque(maxlen=capacity)
+        self._seq = itertools.count(1)
+        self.recorded = 0  # total ever recorded (evictions = recorded - len)
+
+    def record(self, etype: str, **fields) -> dict:
+        ev = {"seq": next(self._seq), "t": time.time(), "type": etype}
+        ev.update(fields)
+        self._ring.append(ev)
+        # store-of-seq, not +=: the read-modify-write would lose counts
+        # under concurrent writers; a plain store of the monotone seq can
+        # only transiently understate (self-heals on the next event)
+        self.recorded = ev["seq"]
+        return ev
+
+    def dump(self, limit: Optional[int] = None) -> List[dict]:
+        events = list(self._ring)
+        if limit is not None and limit >= 0:
+            events = events[-limit:] if limit else []
+        return events
+
+    def events(self, etype: Optional[str] = None,
+               batch_id=None) -> List[dict]:
+        """Filtered view (test/postmortem convenience)."""
+        return [e for e in self._ring
+                if (etype is None or e["type"] == etype)
+                and (batch_id is None or e.get("batchId") == batch_id)]
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+
+class CompileLedger:
+    """Per-(program, bucket) XLA compile counts and times, with the
+    retrace-storm detector. Attribution rides a thread-local dispatch
+    context; the jax.monitoring listener calls ``record_compile`` from
+    whatever thread runs the trace (the dispatching one)."""
+
+    def __init__(self, metrics=None, flight: Optional[FlightRecorder] = None):
+        # a shared list when owned by DeviceTelemetry (attach_metrics
+        # appends into it), a fresh one when constructed standalone
+        self.metrics_sets = (metrics if isinstance(metrics, list)
+                             else [metrics] if metrics is not None else [])
+        self.flight = flight
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.compilations: Dict[tuple, int] = {}   # (program, bucket) -> n
+        self.compile_seconds: Dict[str, float] = {}  # program -> total s
+        self.dispatches: Dict[str, int] = {}       # program -> dispatch count
+        self.retraces: Dict[str, int] = {}         # recompiling dispatches
+        self.storms: Dict[str, int] = {}           # storms flagged per program
+        # deliberate-precompilation windows (warm_buckets): retraces still
+        # count (bench reports measured-phase deltas), storms do not — a
+        # warmup sweep compiling every bucket back-to-back is not a storm
+        self.calibrating = 0
+        # per program: the dispatch ordinal of its FIRST compile (one jit
+        # call fires several backend sub-compiles; only a compile in a LATER
+        # dispatch is a retrace) and the last dispatch already counted as a
+        # retrace (so a retracing dispatch's sub-compiles count once)
+        self._first_compile_disp: Dict[str, int] = {}
+        self._retrace_disp: Dict[str, int] = {}
+        # per program: dispatch indices at which retraces landed (bounded)
+        self._compile_marks: Dict[str, deque] = {}
+
+    @contextlib.contextmanager
+    def dispatch(self, program: str, bucket: Optional[str] = None):
+        """Mark ``program`` (at ``bucket``) as the owner of any XLA compile
+        fired while the body runs."""
+        prev = getattr(self._local, "ctx", None)
+        self._local.ctx = (program, bucket or "-")
+        with self._lock:
+            self.dispatches[program] = self.dispatches.get(program, 0) + 1
+        try:
+            yield
+        finally:
+            self._local.ctx = prev
+
+    def record_compile(self, duration_s: float) -> None:
+        program, bucket = getattr(self._local, "ctx", None) or (OTHER_PROGRAM,
+                                                                "-")
+        storm = False
+        retrace = False
+        with self._lock:
+            key = (program, bucket)
+            self.compilations[key] = self.compilations.get(key, 0) + 1
+            self.compile_seconds[program] = (
+                self.compile_seconds.get(program, 0.0) + duration_s)
+            cur_disp = self.dispatches.get(program, 0)
+            first = self._first_compile_disp.setdefault(program, cur_disp)
+            if cur_disp > first and self._retrace_disp.get(program) != cur_disp:
+                retrace = True
+                self._retrace_disp[program] = cur_disp
+                self.retraces[program] = self.retraces.get(program, 0) + 1
+                if not self.calibrating:
+                    marks = self._compile_marks.setdefault(
+                        program, deque(maxlen=STORM_RETRACES))
+                    marks.append(cur_disp)
+                    if (len(marks) == STORM_RETRACES
+                            and marks[-1] - marks[0] <= STORM_WINDOW):
+                        self.storms[program] = self.storms.get(program, 0) + 1
+                        marks.clear()  # one flag per storm, then re-arm
+                        storm = True
+        for m in self.metrics_sets:
+            m.xla_compilations.inc(program, bucket)
+            m.xla_compile_duration.observe(duration_s, program)
+            if retrace:
+                m.xla_retraces.inc(program)
+        if storm:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "XLA retrace storm: %d recompiles of %r within %d dispatches "
+                "(bucket walk or shape churn mid-run)",
+                STORM_RETRACES, program, STORM_WINDOW)
+            if self.flight is not None:
+                self.flight.record("retrace_storm", program=program,
+                                   bucket=bucket)
+
+    @contextlib.contextmanager
+    def calibration(self):
+        """Mark a deliberate-precompilation window (warm_buckets): compiles
+        and retraces keep counting, storms are not flagged."""
+        with self._lock:
+            self.calibrating += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self.calibrating -= 1
+
+    def total_compilations(self) -> int:
+        with self._lock:
+            return sum(self.compilations.values())
+
+    def total_retraces(self) -> int:
+        with self._lock:
+            return sum(self.retraces.values())
+
+    def dump(self) -> dict:
+        with self._lock:
+            return {
+                "compilations": {f"{p}@{b}": n for (p, b), n
+                                 in sorted(self.compilations.items())},
+                "compileSeconds": {p: round(s, 4) for p, s
+                                   in sorted(self.compile_seconds.items())},
+                "dispatches": dict(self.dispatches),
+                "retraces": dict(self.retraces),
+                "storms": dict(self.storms),
+            }
+
+
+class DeviceTelemetry:
+    """The process recorder: ledger + flight recorder + transfer/HBM
+    counters, optionally feeding a SchedulerMetrics set."""
+
+    def __init__(self, metrics=None, ring_capacity: int = 4096):
+        self.metrics_sets = [metrics] if metrics is not None else []
+        self.flight = FlightRecorder(ring_capacity)
+        # the ledger shares the list object, so attach_metrics reaches both
+        self.ledger = CompileLedger(self.metrics_sets, self.flight)
+        self._lock = threading.Lock()
+        self.transfer_bytes: Dict[str, int] = {"upload": 0, "fetch": 0}
+        self.transfers: Dict[str, int] = {"upload": 0, "fetch": 0}
+        self.hbm: dict = {}          # last memory_stats sample (or {})
+        self.hbm_peak: int = 0       # max peak_bytes_in_use ever sampled
+
+    def attach_metrics(self, metrics) -> None:
+        """Bind an ADDITIONAL SchedulerMetrics set — a second scheduler set
+        up in the same process gets the telemetry families in its own
+        registry instead of silently feeding the first one's."""
+        if metrics is not None and all(m is not metrics
+                                       for m in self.metrics_sets):
+            self.metrics_sets.append(metrics)
+
+    def event(self, etype: str, **fields) -> None:
+        self.flight.record(etype, **fields)
+        for m in self.metrics_sets:
+            m.flight_events.inc(etype)
+
+    def transfer(self, direction: str, nbytes: int) -> None:
+        with self._lock:
+            self.transfer_bytes[direction] = (
+                self.transfer_bytes.get(direction, 0) + int(nbytes))
+            self.transfers[direction] = self.transfers.get(direction, 0) + 1
+        for m in self.metrics_sets:
+            m.device_transfer_bytes.inc(direction, value=float(nbytes))
+        # annotate the active span (device.sync / device.commit.wait) so the
+        # bench critical path can see the bytes behind each phase
+        from ..utils import tracing
+
+        tracing.annotate(**{f"device.{direction}": int(nbytes)})
+
+    def sample_hbm(self) -> Optional[dict]:
+        """One ``memory_stats()`` read of device 0 (a host-side C call, no
+        device round-trip). Returns the sample, or None when the backend
+        (CPU) exposes no stats."""
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:  # noqa: BLE001 — telemetry must never take us down
+            stats = None
+        if not stats:
+            return None
+        sample = {k: stats[k] for k in
+                  ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+                  if k in stats}
+        with self._lock:
+            self.hbm = sample
+            self.hbm_peak = max(self.hbm_peak,
+                                int(sample.get("peak_bytes_in_use", 0)))
+        kinds = {"bytes_in_use": "in_use", "peak_bytes_in_use": "peak",
+                 "bytes_limit": "limit"}
+        for m in self.metrics_sets:
+            for k, kind in kinds.items():
+                if k in sample:
+                    m.hbm_bytes.set(kind, value=float(sample[k]))
+        return sample
+
+    def dump(self, limit: Optional[int] = None) -> dict:
+        """The /debug/flightrecorder body."""
+        with self._lock:
+            transfer = {
+                "uploadBytes": self.transfer_bytes.get("upload", 0),
+                "fetchBytes": self.transfer_bytes.get("fetch", 0),
+                "uploads": self.transfers.get("upload", 0),
+                "fetches": self.transfers.get("fetch", 0),
+            }
+            hbm = dict(self.hbm, peak_ever=self.hbm_peak) if self.hbm else {}
+        events = self.flight.dump(limit)
+        held = len(self.flight)
+        out = {
+            "enabled": True,
+            "ring": {"capacity": self.flight.capacity,
+                     "recorded": self.flight.recorded,
+                     "held": held},
+            "compile": self.ledger.dump(),
+            "transfer": transfer,
+            "hbm": hbm,
+            "events": events,
+        }
+        if len(events) < held:
+            # same cap-marker contract as every other /debug handler: a
+            # capped list is never indistinguishable from a short one
+            out["truncated"] = {"events": held}
+        return out
+
+
+# --------------------------------------------------------------- module API
+#
+# Every hot-path hook below starts with one read of the module global and
+# returns immediately when telemetry is disabled — the near-zero disabled
+# cost the tier-1 guard asserts.
+
+_NULL_CM = contextlib.nullcontext()
+_listener_installed = False
+
+
+def _install_listener() -> None:
+    """Register the jax.monitoring compile listener once per process. The
+    callback itself is disabled-guarded, so a later disable() costs one
+    global read per *compile event* (compiles are rare by definition)."""
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        import jax.monitoring as mon
+
+        def _on_duration(name, duration_s, **_kw):
+            t = _recorder
+            if t is None or name != _COMPILE_EVENT:
+                return
+            try:
+                t.ledger.record_compile(duration_s)
+            except Exception:  # noqa: BLE001 — never fail a compile
+                pass
+
+        mon.register_event_duration_secs_listener(_on_duration)
+        _listener_installed = True
+    except Exception:  # noqa: BLE001 — no monitoring API: ledger stays zero
+        _listener_installed = True  # don't retry per enable
+
+
+def enable(metrics=None, ring_capacity: int = 4096) -> DeviceTelemetry:
+    """Install the process recorder (idempotent refresh). ``metrics`` is a
+    SchedulerMetrics set to feed the scheduler_xla_*/hbm/transfer/flight
+    metric families; None keeps the internal counters only."""
+    global _recorder
+    _install_listener()
+    _recorder = DeviceTelemetry(metrics, ring_capacity)
+    return _recorder
+
+
+def disable() -> None:
+    global _recorder
+    _recorder = None
+
+
+def get() -> Optional[DeviceTelemetry]:
+    return _recorder
+
+
+def maybe_enable_from_env(metrics=None) -> None:
+    """KTPU_TELEMETRY=1 turns the layer on at setup (the KTPU_TRACE_FILE
+    twin); 0/unset leaves it off (the zero-cost default)."""
+    import os
+
+    if os.environ.get("KTPU_TELEMETRY") != "1":
+        return
+    if _recorder is None:
+        enable(metrics)
+    elif metrics is not None:
+        # a second scheduler set up in the same process: bind its registry
+        # too instead of silently feeding only the first one's
+        _recorder.attach_metrics(metrics)
+
+
+def event(etype: str, **fields) -> None:
+    """Record one flight-recorder event; no-op when disabled (one global
+    read)."""
+    t = _recorder
+    if t is None:
+        return
+    t.event(etype, **fields)
+
+
+def dispatch(program: str, bucket: Optional[str] = None):
+    """Compile-attribution context for one jitted dispatch; the shared
+    null context manager when disabled (no allocation)."""
+    t = _recorder
+    if t is None:
+        return _NULL_CM
+    return t.ledger.dispatch(program, bucket)
+
+
+def calibration():
+    """Storm-suppressed precompilation window; the shared null context
+    manager when disabled."""
+    t = _recorder
+    if t is None:
+        return _NULL_CM
+    return t.ledger.calibration()
+
+
+def transfer(direction: str, nbytes: int) -> None:
+    """Count one host<->device transfer (direction: upload|fetch)."""
+    t = _recorder
+    if t is None:
+        return
+    t.transfer(direction, nbytes)
+
+
+def sample_hbm() -> None:
+    t = _recorder
+    if t is None:
+        return
+    t.sample_hbm()

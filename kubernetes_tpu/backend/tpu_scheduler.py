@@ -258,6 +258,8 @@ class _Inflight:
     host_pb: dict  # encoder's host copy of req/nonzero_req/port_ids
     pb: object = None  # device PodBatch — preemption screen input on failures
     mode_info: tuple = ()  # (topo_mode, vd_bucket, host_key): carry-shape id
+    batch_id: str = ""  # flight-recorder identity (in-process: "b<counter>")
+    bucket: int = 0  # padded pod capacity the program ran at
 
 
 def _default_full_batch() -> bool:
@@ -554,6 +556,12 @@ class TPUScheduler(Scheduler):
         one device call, and hitting a fallback pod first flushes the
         accumulated batch — so a high-priority fallback pod never loses its
         turn to lower-priority batched pods (reference strict-serial order)."""
+        if self.informer_factory is not None:
+            # the batched loop must pump the shared-informer bus exactly like
+            # schedule_one does — without this the cmd-binary topology
+            # (setup() wires a SharedInformerFactory) never delivers pod/node
+            # events to the batched frontends and the queue stays empty
+            self.informer_factory.pump()
         self._periodic_housekeeping()
         qps = self.queue.pop_batch(self.sizer.target())
         if not qps:
@@ -603,6 +611,10 @@ class TPUScheduler(Scheduler):
                 # (the permanent oracle-fallback population is not relay
                 # impact)
                 self.relay_degraded_pods += 1
+                from . import telemetry
+
+                telemetry.event("degrade", pod=pod.key(),
+                                reason="relay breaker open")
             # fallback pod: flush what's queued first (strict pop order) and
             # land it, then give the sequential path a fresh snapshot
             self._flush_batch(buffer, pod_cycle, t_pop)
@@ -691,6 +703,10 @@ class TPUScheduler(Scheduler):
                 return
         t_enc = self.now_fn()
         self.batch_counter += 1
+        from . import telemetry
+
+        batch_id = f"b{self.batch_counter}"
+        bucket = int(pb.capacity)
         # scalar seed, not an eager jax.random.PRNGKey: the key derivation is
         # traced into the program (an eager PRNGKey costs two relay
         # round-trips per batch once the session has synchronized)
@@ -735,6 +751,8 @@ class TPUScheduler(Scheduler):
             sample_start = None
         mode_info = self._topo_mode_info()
         topo_mode, vd_bucket, host_key = mode_info
+        telemetry.event("encode", batchId=batch_id, bucket=bucket,
+                        pods=len(batched), pipelined=enc is not None)
         with tracing.span("device.dispatch", topo=topo_mode):
             result = self._run_batch_fn(
                 pb, et, self.device.nt, self.device.tc, tb, key,
@@ -766,7 +784,12 @@ class TPUScheduler(Scheduler):
         except Exception:  # noqa: BLE001 — optional fast path only
             pass
         self._inflight.append(_Inflight(batched, result, pod_cycle, t_pop,
-                                        host_pb, pb, mode_info))
+                                        host_pb, pb, mode_info,
+                                        batch_id, bucket))
+        telemetry.event("dispatch", batchId=batch_id, bucket=bucket,
+                        pods=len(batched), topo=topo_mode,
+                        packed=result.packed is not None,
+                        inflight=len(self._inflight))
         self.smetrics.pipeline_inflight.set(value=len(self._inflight))
         # land the oldest batches beyond the ring depth: their host commits
         # overlap the device execution of everything dispatched after them
@@ -837,21 +860,32 @@ class TPUScheduler(Scheduler):
         the host cache — crash-only, §5.3."""
         from ..utils import tracing
 
+        from . import telemetry
+
         t0 = self.now_fn()
         wait: Optional[float] = None
+        packed_ok = fl.result.packed is not None
         try:
             from ..utils import relay
             from .batch import unpack_result_block
 
             relay.count_sync("commit-read")  # THE one blocking read per batch
-            with tracing.span("device.commit.wait", batch=len(fl.qps)):
+            # the packed tag keeps bench critical-path attribution honest on
+            # mesh-sharded runs: packed=None falls back to per-array reads,
+            # a materially different commit-wait shape
+            with tracing.span("device.commit.wait", batch=len(fl.qps),
+                              packed="packed" if packed_ok else "fallback"):
                 t_wait0 = self.now_fn()
-                if fl.result.packed is not None:
+                if packed_ok:
                     node_idx, ff = unpack_result_block(
                         fl.result.packed, self.device.caps.nodes)
+                    telemetry.transfer("fetch", fl.result.packed.nbytes)
                 else:  # sharded-core results carry no packed block
                     node_idx = np.asarray(fl.result.node_idx)
                     ff = None
+                    telemetry.transfer("fetch", node_idx.nbytes)
+                    telemetry.event("packed_fallback", batchId=fl.batch_id,
+                                    bucket=fl.bucket, pods=len(fl.qps))
                 wait = self.now_fn() - t_wait0
                 self.smetrics.device_batch_duration.observe(wait, "commit_wait")
                 # residual stall: the transfer was staged at dispatch, so any
@@ -898,12 +932,21 @@ class TPUScheduler(Scheduler):
             stale = list(self._inflight)
             self._inflight.clear()
             for batch in (fl, *stale):
+                telemetry.event("poison", batchId=batch.batch_id,
+                                bucket=batch.bucket, pods=len(batch.qps),
+                                error=f"{type(exc).__name__}: {exc}"[:200])
                 for qp in batch.qps:
                     fwk = self.framework_for_pod(qp.pod)
                     self._fail(fwk, qp, Status.error(f"device batch failed: {exc}"),
                                batch.pod_cycle)
+                telemetry.event("requeue", batchId=batch.batch_id,
+                                pods=len(batch.qps))
         else:
             self.relay_breaker.record_success()
+            telemetry.event("commit", batchId=fl.batch_id, bucket=fl.bucket,
+                            pods=len(fl.qps), packed=packed_ok,
+                            wait_s=round(wait, 6) if wait is not None else None)
+            telemetry.sample_hbm()
         self.smetrics.pipeline_inflight.set(value=len(self._inflight))
         self.smetrics.device_batch_duration.observe(self.now_fn() - t0, "commit")
         # the sizer controls the POP→COMMIT attempt latency: observe it here,
@@ -968,15 +1011,25 @@ class TPUScheduler(Scheduler):
         import logging
         import os
 
+        from . import telemetry
+
+        # compile-ledger attribution: bucket signature = padded pod capacity
+        # + topology mode — the two shape axes the sizer/topo walk retraces
+        # over (ops/schema.PodBatch.capacity; kwargs as built by the callers)
+        mode = kwargs.get("topo_mode") or (
+            "general" if kwargs.get("topo_enabled", True) else "off")
+        sig = f"{getattr(args[0], 'capacity', '?')}/{mode}"
         try:
-            result = self.schedule_batch_fn(*args, **kwargs)
+            with telemetry.dispatch("schedule_batch", bucket=sig):
+                result = self.schedule_batch_fn(*args, **kwargs)
         except Exception:  # noqa: BLE001 — any lowering/runtime failure
             if os.environ.get("KTPU_PALLAS", "auto") == "0":
                 raise  # already on the XLA path: a real error
             logging.getLogger(__name__).exception(
                 "pallas step failed; disabling KTPU_PALLAS and retrying via XLA")
             os.environ["KTPU_PALLAS"] = "0"
-            result = self.schedule_batch_fn(*args, **kwargs)
+            with telemetry.dispatch("schedule_batch", bucket=sig):
+                result = self.schedule_batch_fn(*args, **kwargs)
         if adopt:
             self.device.adopt_device(result)
         return result
@@ -1032,12 +1085,17 @@ class TPUScheduler(Scheduler):
             if preempt_hints is None:
                 try:
                     from ..ops.preempt import screen_prefix
+                    from . import telemetry
 
                     # a priority class first seen this cycle is still INT_MAX
                     # on device (= never evictable) unless refreshed now
                     self.device._refresh_class_prio()
-                    pres = screen_prefix(pb, self.device.nt, result.static_masks,
-                                         node_idx[:len(qps)] < 0)
+                    with telemetry.dispatch(
+                            "preempt_screen",
+                            bucket=str(getattr(pb, "capacity", "?"))):
+                        pres = screen_prefix(pb, self.device.nt,
+                                             result.static_masks,
+                                             node_idx[:len(qps)] < 0)
                     from ..utils import relay
 
                     relay.count_sync("preempt-read")
@@ -1188,10 +1246,13 @@ class TPUScheduler(Scheduler):
         kernel_ok: Optional[np.ndarray] = None
         try:
             from ..utils import relay
+            from . import telemetry
 
-            placed_all_d, kernel_ok_d, _assign = gang_verdicts(
-                result.node_idx, result.first_fail,
-                member_idx, member_valid)
+            with telemetry.dispatch("gang_verdicts",
+                                    bucket=f"{g_cap}x{m_cap}"):
+                placed_all_d, kernel_ok_d, _assign = gang_verdicts(
+                    result.node_idx, result.first_fail,
+                    member_idx, member_valid)
             relay.count_sync("gang-read")
             placed_all = np.asarray(placed_all_d)
             kernel_ok = np.asarray(kernel_ok_d)
@@ -1303,6 +1364,15 @@ class TPUScheduler(Scheduler):
         batches will run — without a sample, a cluster whose first spread/
         affinity pods arrive in the measured window would warm the
         topology-off program and compile the topo one mid-measure."""
+        from . import telemetry
+
+        # deliberate precompilation: retraces keep counting (the bench's
+        # measured-phase delta is taken after this), storms are not
+        # flagged — a warmup sweep is not a mid-run bucket walk
+        with telemetry.calibration():
+            return self._warm_buckets_inner(sample_pods)
+
+    def _warm_buckets_inner(self, sample_pods=None) -> int:
         from ..api.wrappers import make_pod
 
         self._drain_inflight()

@@ -29,6 +29,36 @@ from ..metrics.registry import Registry
 from ..utils.featuregate import DEFAULT_FEATURE_GATE
 
 
+# default per-list entry cap for /debug dumps (override per request with
+# ?limit=N): a 5k-node queue/cache dump serialized whole is megabytes of
+# JSON from the serving thread — bounded by default, explicit to go deeper
+DEFAULT_DEBUG_LIMIT = 1000
+
+
+def _cap(items, limit):
+    """(first ``limit`` entries, original length if truncated else None) —
+    the one cap-plus-marker primitive every /debug handler shares, so a
+    capped list is never indistinguishable from a genuinely short one."""
+    items = list(items)
+    if limit is not None and 0 <= limit < len(items):
+        return items[:limit], len(items)
+    return items, None
+
+
+def _accepts_limit(fn) -> bool:
+    """Whether a debug handler takes the ``limit`` kwarg (checked by
+    signature, never by catching TypeError around the CALL — a genuine
+    TypeError from inside the handler must not re-execute it uncapped)."""
+    import inspect
+
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    return ("limit" in params
+            or any(p.kind == p.VAR_KEYWORD for p in params.values()))
+
+
 class ComponentServer:
     """healthz/readyz/configz/metrics/debug mux shared by the component
     binaries (component-base: healthz.InstallHandler + configz +
@@ -42,6 +72,10 @@ class ComponentServer:
         # /debug/<name> → zero-arg callable returning a JSON-serializable
         # body (build_debug_handlers wires the scheduler's set)
         self.debug = debug or {}
+        # signature introspection is constant per handler — once here, not
+        # per request on the serving thread
+        self._debug_accepts_limit = {n: _accepts_limit(f)
+                                     for n, f in self.debug.items()}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -74,12 +108,28 @@ class ComponentServer:
                         {"endpoints": sorted("/debug/" + n for n in outer.debug)}),
                         "application/json")
                 elif path.startswith("/debug/"):
-                    fn = outer.debug.get(path[len("/debug/"):])
+                    name = path[len("/debug/"):]
+                    fn = outer.debug.get(name)
                     if fn is None:
                         self._respond(404, "not found", "text/plain")
                         return
+                    # ?limit=N caps unbounded dumps (queue/cache/spans/...)
+                    # at N entries per list; the default keeps a 5k-node
+                    # dump bounded instead of serializing the whole world
+                    import urllib.parse as _up
+
+                    limit = DEFAULT_DEBUG_LIMIT
                     try:
-                        body = json.dumps(fn(), default=str)
+                        q = _up.parse_qs(query)
+                        if "limit" in q:
+                            limit = max(0, int(q["limit"][0]))
+                    except (ValueError, IndexError):
+                        pass
+                    try:
+                        out = (fn(limit=limit)
+                               if outer._debug_accepts_limit.get(name)
+                               else fn())
+                        body = json.dumps(out, default=str)
                     except Exception as exc:  # noqa: BLE001 — debug must not kill serving
                         self._respond(500, json.dumps(
                             {"error": f"{type(exc).__name__}: {exc}"}),
@@ -166,31 +216,56 @@ def build_debug_handlers(sched) -> dict:
       /debug/sessions     HA session table: this replica's identity plus the
                           device service's per-client lease age, deltaSeq,
                           and in-flight hold counts (WireScheduler only)
+      /debug/flightrecorder  device-runtime flight recorder: compile/retrace
+                          ledger, HBM/transfer counters, and the bounded
+                          event ring (backend/telemetry.py; enabled=False
+                          when the telemetry layer is off)
+
+    Every handler takes an entry cap (``?limit=N`` on the mux, default
+    DEFAULT_DEBUG_LIMIT) so a 5k-node dump stays bounded.
     """
     from ..cache.debugger import CacheComparer
     from ..utils import tracing
 
-    def queue_dump():
-        return sched.queue.dump()
+    def _capped_lists(out, limit, keys):
+        """Cap ``out[key]`` lists in place, recording original lengths
+        under out["truncated"][key] (counts stay exact, truncation is
+        always visible)."""
+        for key in keys:
+            entries, orig = _cap(out.get(key) or [], limit)
+            out[key] = entries
+            if orig is not None:
+                out.setdefault("truncated", {})[key] = orig
+        return out
 
-    def cache_dump():
+    def queue_dump(limit=None):
+        return _capped_lists(sched.queue.dump(), limit,
+                             ("active", "backoff", "unschedulable"))
+
+    def cache_dump(limit=None):
         comparer = CacheComparer(sched.store, sched.cache, sched.queue)
         missed_n, redundant_n = comparer.compare_nodes()
         missed_p, redundant_p = comparer.compare_pods()
         nodes, pods, assumed = sched.cache.stats()
-        return {
+        return _capped_lists({
             "nodes": nodes, "pods": pods, "assumedPods": assumed,
             "inSync": not (missed_n or redundant_n or missed_p or redundant_p),
             "missedNodes": missed_n, "redundantNodes": redundant_n,
             "missedPods": missed_p, "redundantPods": redundant_p,
-        }
+        }, limit, ("missedNodes", "redundantNodes", "missedPods",
+                   "redundantPods"))
 
-    def device_dump():
+    def device_dump(limit=None):
         import dataclasses
 
         device = getattr(sched, "device", None)
         if device is None:
             return {"enabled": False}
+        occupancy = _device_occupancy(device)
+        capped, orig = _cap(occupancy["resources"].items(), limit)
+        if orig is not None:
+            occupancy["resources"] = dict(capped)
+            occupancy["resourcesTruncated"] = orig
         out = {
             "enabled": True,
             "caps": dataclasses.asdict(device.caps),
@@ -202,7 +277,8 @@ def build_debug_handlers(sched) -> dict:
             "pipelinedBatches": getattr(sched, "pipelined_batches", 0),
             "fallbackScheduled": getattr(sched, "fallback_scheduled", 0),
             "batchScheduled": getattr(sched, "batch_scheduled", 0),
-            "occupancy": _device_occupancy(device),
+            "uploadBytes": device.upload_bytes,
+            "occupancy": occupancy,
         }
         sizer = getattr(sched, "sizer", None)
         if sizer is not None:
@@ -213,28 +289,45 @@ def build_debug_handlers(sched) -> dict:
             }
         return out
 
-    def spans_dump():
-        return [s.to_otlp() for s in tracing.tail(256)]
+    def spans_dump(limit=None):
+        return [s.to_otlp() for s in tracing.tail(
+            256 if limit is None or limit < 0 else limit)]
 
-    def circuit_dump():
+    def circuit_dump(limit=None):
         if not hasattr(sched, "debug_circuit"):
             return {"enabled": False}
         return sched.debug_circuit()
 
-    def sessions_dump():
+    def sessions_dump(limit=None):
         if not hasattr(sched, "debug_sessions"):
             return {"enabled": False}
-        return sched.debug_sessions()
+        out = sched.debug_sessions()
+        svc = out.get("service")
+        if isinstance(svc, dict) and isinstance(svc.get("sessions"), list):
+            svc["sessions"], orig = _cap(svc["sessions"], limit)
+            if orig is not None:
+                svc["sessionsTruncated"] = orig
+        return out
+
+    def flightrecorder_dump(limit=None):
+        from ..backend import telemetry
+
+        t = telemetry.get()
+        if t is None:
+            return {"enabled": False}
+        return t.dump(limit)
 
     return {"queue": queue_dump, "cache": cache_dump,
             "devicestate": device_dump, "spans": spans_dump,
-            "circuit": circuit_dump, "sessions": sessions_dump}
+            "circuit": circuit_dump, "sessions": sessions_dump,
+            "flightrecorder": flightrecorder_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
           raw: Optional[dict] = None, feature_gates: str = "",
           use_informers: bool = True, tpu: bool = False, **kwargs):
     """server.go:300 Setup: config + registries → a runnable scheduler."""
+    from ..backend import telemetry
     from ..utils.tracing import maybe_enable_from_env
 
     maybe_enable_from_env()  # KTPU_TRACE_FILE: OTLP-shaped span export (§5.1)
@@ -248,6 +341,10 @@ def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
     sched = scheduler_from_config(
         store, cfg=cfg, raw=raw, informer_factory=factory, **kwargs
     )
+    # KTPU_TELEMETRY=1: device-runtime observability (compile ledger, HBM/
+    # transfer gauges, flight recorder) feeding THIS scheduler's registry —
+    # off by default, one-global-read disabled cost
+    telemetry.maybe_enable_from_env(sched.smetrics)
     return sched
 
 

@@ -8,7 +8,7 @@ import threading
 import time
 from typing import Dict, FrozenSet, Iterable, Tuple
 
-from .registry import Counter, Gauge, Histogram, Registry
+from .registry import Counter, Gauge, Histogram, Registry, exponential_buckets
 
 SCHEDULER_SUBSYSTEM = "scheduler"
 
@@ -160,6 +160,44 @@ class SchedulerMetrics:
             "scheduler_commit_conflicts_total",
             "Ownership-check conflicts at device commit time.",
             ["client"],
+        ))
+        # device-runtime observability (backend/telemetry.py): XLA compile
+        # ledger per (program, bucket signature) with retrace counts (a
+        # compile beyond a program's first — the BatchSizer's bucket walk
+        # shows up here when it recompiles mid-run), accelerator memory
+        # stats, host<->device transfer volume, and flight-recorder event
+        # counts by type
+        self.xla_compilations = r.register(Counter(
+            "scheduler_xla_compilations_total",
+            "XLA backend compilations by program and bucket signature.",
+            ["program", "bucket"],
+        ))
+        self.xla_compile_duration = r.register(Histogram(
+            "scheduler_xla_compile_seconds",
+            "XLA backend compile latency by program.",
+            ["program"],
+            buckets=exponential_buckets(0.01, 2, 14),
+        ))
+        self.xla_retraces = r.register(Counter(
+            "scheduler_xla_retraces_total",
+            "XLA compilations beyond a program's first (retraces).",
+            ["program"],
+        ))
+        self.hbm_bytes = r.register(Gauge(
+            "scheduler_device_hbm_bytes",
+            "Device memory stats sample (in_use|peak|limit).",
+            ["kind"],
+        ))
+        self.device_transfer_bytes = r.register(Counter(
+            "scheduler_device_transfer_bytes_total",
+            "Host<->device transfer volume (upload = row sync, fetch = "
+            "packed result block).",
+            ["direction"],
+        ))
+        self.flight_events = r.register(Counter(
+            "scheduler_flight_recorder_events_total",
+            "Batch flight-recorder events by type.",
+            ["type"],
         ))
 
         # unschedulable_pods bookkeeping: gauge value = number of pods

@@ -176,6 +176,18 @@ def current() -> Optional[Span]:
     return stack[-1] if stack else None
 
 
+def annotate(**attributes) -> None:
+    """Attach attributes to the active span (no-op when tracing is disabled
+    or no span is open — one global read). The device-telemetry layer uses
+    this to ride ``device.upload``/``device.fetch`` byte counts on the
+    ``device.sync`` / ``device.commit.wait`` spans without the call sites
+    having to thread span handles around."""
+    s = current()
+    if s is None:
+        return
+    s.attributes.update(attributes)
+
+
 def format_traceparent() -> Optional[str]:
     """W3C traceparent of the active span (``00-<trace_id>-<span_id>-01``),
     or None when tracing is disabled or no span is open. Inject this into a
@@ -221,7 +233,7 @@ def tail(n: int = 256) -> List[Span]:
     memory (InMemoryExporter); [] otherwise — the /debug/spans feed."""
     t = _tracer
     spans = getattr(getattr(t, "exporter", None), "spans", None) if t else None
-    if not spans:
+    if not spans or n <= 0:  # n=0 means none, not all (spans[-0:] trap)
         return []
     return list(spans[-n:])
 

@@ -861,3 +861,128 @@ class TestDeviceServiceFaults:
             assert m.degraded_seconds.labels() > 0
         finally:
             rig.close()
+
+
+class TestFlightRecorderChaos:
+    """ISSUE 7 acceptance: after a kill-mid-drain run the flight recorder
+    (read over the REAL /debug/flightrecorder endpoint, not the in-process
+    object) carries the poison/requeue event sequence for every affected
+    batchId; the HA suite's lease fence lands a fence event naming the dead
+    client and its last committed batchId. Postmortems read the ring, not
+    print-debugging."""
+
+    def _debug_get(self, sched, path):
+        import json
+        import urllib.request
+
+        from kubernetes_tpu.cmd.server import (
+            ComponentServer, build_debug_handlers)
+
+        server = ComponentServer(configz={}, debug=build_debug_handlers(sched))
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return json.loads(r.read())
+        finally:
+            server.stop()
+
+    def test_kill_mid_drain_poison_requeue_sequence_per_batch_id(
+            self, monkeypatch):
+        from kubernetes_tpu.backend import batch as batch_mod
+        from kubernetes_tpu.backend import telemetry
+
+        telemetry.enable()
+        try:
+            monkeypatch.setenv("KTPU_PIPELINE_DEPTH", "2")
+            store = ClusterStore()
+            _cluster(store, 6)
+            sched = TPUScheduler(store, batch_size=4, comparer_every_n=1,
+                                 pod_initial_backoff=0.01,
+                                 pod_max_backoff=0.05)
+            for i in range(4):
+                store.create_pod(make_pod(f"a{i}").req({"cpu": "100m"}).obj())
+            sched.schedule_batch_cycle()
+            for i in range(4):
+                store.create_pod(make_pod(f"b{i}").req({"cpu": "100m"}).obj())
+            sched.schedule_batch_cycle()
+            assert len(sched._inflight) == 2
+            affected = [fl.batch_id for fl in sched._inflight]
+
+            def dead(*a, **kw):
+                raise RuntimeError("relay dropped mid-drain")
+
+            monkeypatch.setattr(batch_mod, "unpack_result_block", dead)
+            sched._drain_inflight()
+            assert sched.metrics["scheduled"] == 0
+
+            body = self._debug_get(sched, "/debug/flightrecorder")
+            assert body["enabled"] is True
+            events = body["events"]
+            assert body["ring"]["held"] == len(events)
+            for bid in affected:
+                seq = [e["type"] for e in events if e.get("batchId") == bid]
+                # the full lifecycle per poisoned batch, in ring order:
+                # dispatched, then poisoned by the device death, then every
+                # pod requeued via backoffQ
+                assert seq.index("dispatch") < seq.index("poison") \
+                    < seq.index("requeue"), (bid, seq)
+                poison = next(e for e in events
+                              if e.get("batchId") == bid
+                              and e["type"] == "poison")
+                assert poison["pods"] == 4
+                assert "relay dropped" in poison["error"]
+            # nothing outside the two affected batches was poisoned
+            assert sum(1 for e in events if e["type"] == "poison") == 2
+        finally:
+            telemetry.disable()
+
+    def test_ha_lease_fence_event_names_client_and_batch_id(self, monkeypatch):
+        from kubernetes_tpu.backend import telemetry
+
+        tele = telemetry.enable()
+        rig = _HaRig()
+        try:
+            for i in range(8):
+                rig.store.create_pod(
+                    make_pod(f"a-p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+            for i in range(2):
+                rig.store.create_pod(
+                    make_pod(f"b-p{i}").req({"cpu": "500m"}).obj())
+            rig.b.run_until_settled()
+
+            def boom(*a, **kw):
+                raise _Die("replica A killed mid-drain")
+
+            monkeypatch.setattr(rig.a, "_process_wire_results", boom)
+            import pytest as _pytest
+
+            with _pytest.raises(_Die):
+                rig.a.schedule_batch_cycle()
+            # the service committed A's batch: its id is in the commit event
+            commits_a = [e for e in tele.flight.events("commit")
+                         if e.get("client") == "A"]
+            assert commits_a, "server-side commit event missing"
+            a_batch_id = commits_a[-1]["batchId"]
+            assert a_batch_id
+
+            rig.survive(rig.b)
+            assert rig.service.sessions["A"].fenced
+            fences = [e for e in tele.flight.events("fence")
+                      if e.get("client") == "A"]
+            assert len(fences) == 1
+            # the fence names the dead client's last committed batch — the
+            # postmortem link from "capacity released" back to the batch
+            # whose holds were fenced
+            assert fences[0]["batchId"] == a_batch_id
+            assert fences[0]["releasedHolds"] > 0
+            # the survivor recorded its takeover of the fenced peer
+            takeovers = [e for e in tele.flight.events("takeover")
+                         if e.get("fencedPeer") == "A"]
+            assert len(takeovers) == 1
+            assert takeovers[0]["client"] == "B"
+            # and the fence ordered strictly after A's commit in the ring
+            assert fences[0]["seq"] > commits_a[-1]["seq"]
+        finally:
+            rig.close()
+            telemetry.disable()

@@ -115,3 +115,80 @@ def test_marker_lint_detects_unmarked_perf_test(tmp_path):
     out = mod.find_unmarked([str(bad)])
     names = {v.split()[-1] for v in out}
     assert names == {"test_big_cluster", "test_defaults", "test_in_class"}
+
+
+def test_telemetry_metrics_registered_and_live():
+    """The device-runtime metric families (ISSUE 7) are in the checked
+    roster AND fed — orphaning any of them fails tier-1."""
+    mod = _load_tool()
+    attrs, dead = mod.find_dead_metrics()
+    for expected in ("xla_compilations", "xla_compile_duration",
+                     "xla_retraces", "hbm_bytes", "device_transfer_bytes",
+                     "flight_events"):
+        assert expected in attrs
+    assert dead == []
+
+
+def test_span_lint_clean():
+    """Every span name the package emits is in bench.py's critical-path
+    attribution table or the explicit ignore list."""
+    mod = _load_tool()
+    emitted, unattributed = mod.find_unattributed_spans()
+    assert unattributed == [], unattributed
+    # the lint actually sees the core cycle spans
+    for must in ("scheduling.cycle", "device.sync", "device.commit.wait",
+                 "host.commit"):
+        assert must in emitted
+
+
+def test_span_lint_detects_unattributed_span(tmp_path):
+    """Negative control: a span emitted in code but absent from the bench
+    table (and not ignored) is flagged; table entries, ignored prefixes,
+    and dynamic f-string spans with attributed prefixes are not."""
+    mod = _load_tool()
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "from x import tracing\n"
+        "def f(point):\n"
+        "    with tracing.span('device.sync'):\n"
+        "        pass\n"
+        "    with tracing.span('mystery.phase'):\n"
+        "        pass\n"
+        "    with tracing.span('framework.' + point):\n"
+        "        pass\n"
+        "    with tracing.span_from_remote(None, 'device.apply_deltas'):\n"
+        "        pass\n"
+        "    with tracing.span_from_remote(None, 'rogue.remote'):\n"
+        "        pass\n"
+    )
+    bench = tmp_path / "bench.py"
+    bench.write_text(
+        "CRITICAL_PATH_SPANS = frozenset({\n"
+        "    'device.sync', 'device.apply_deltas',\n"
+        "})\n"
+    )
+    emitted, unattributed = mod.find_unattributed_spans(
+        pkg=str(pkg), bench_path=str(bench))
+    assert unattributed == ["mystery.phase", "rogue.remote"]
+    assert "device.sync" in emitted
+
+
+def test_fence_zero_throughput_is_judged_not_skipped():
+    """A collapse to 0.0 pods/s is the worst regression, not a missing
+    metric — the fence must flag it."""
+    spec = importlib.util.spec_from_file_location(
+        "trend", os.path.join(REPO, "tools", "trend.py"))
+    trend = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trend)
+    out = trend.fence({"value": 0.0, "platform": "cpu-fallback"},
+                      [{"value": 500.0, "platform": "cpu-fallback",
+                        "_round": 7}])
+    assert any("headline pods/s" in v for v in out["violations"])
+
+
+def test_bench_span_table_parses_without_importing_bench():
+    mod = _load_tool()
+    table = mod.bench_span_table()
+    assert "scheduling.cycle" in table
+    assert "device.commit.wait" in table

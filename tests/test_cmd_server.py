@@ -134,7 +134,8 @@ class TestDebugEndpoints:
             assert status == 200
             assert set(json.loads(body)["endpoints"]) == {
                 "/debug/queue", "/debug/cache", "/debug/devicestate",
-                "/debug/spans", "/debug/circuit", "/debug/sessions"}
+                "/debug/spans", "/debug/circuit", "/debug/sessions",
+                "/debug/flightrecorder"}
 
             status, body = _get(port, "/debug/queue")
             doc = json.loads(body)
@@ -171,6 +172,95 @@ class TestDebugEndpoints:
                 assert e.code == 404
         finally:
             tracing.disable()
+            app.server.stop()
+
+    def test_debug_limit_caps_unbounded_dumps(self):
+        """ISSUE 7 satellite: ?limit=N bounds every list-shaped /debug dump
+        (a 5k-node queue dump serialized whole is megabytes of JSON from
+        the serving thread); the default cap applies without the query."""
+        from kubernetes_tpu.cmd import server as srv_mod
+
+        store = ClusterStore()
+        for i in range(3):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "32", "memory": "64Gi", "pods": 110}).obj())
+        app = SchedulerApp(store, raw_config=None)
+        port = app.server.start()
+        try:
+            # park more pods than the limit in the unschedulable queue
+            for i in range(8):
+                store.create_pod(make_pod(f"huge{i}").req({"cpu": "640"}).obj())
+            app.tick()
+
+            status, body = _get(port, "/debug/queue?limit=3")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc["counts"]["unschedulable"] == 8  # counts stay exact
+            assert len(doc["unschedulable"]) == 3       # entries capped
+            assert doc["truncated"]["unschedulable"] == 8
+
+            # default cap (no query) leaves small dumps whole
+            status, body = _get(port, "/debug/queue")
+            doc = json.loads(body)
+            assert len(doc["unschedulable"]) == 8
+            assert "truncated" not in doc
+            assert srv_mod.DEFAULT_DEBUG_LIMIT >= 8
+
+            status, body = _get(port, "/debug/spans?limit=2")
+            assert status == 200
+            assert len(json.loads(body)) <= 2
+
+            # limit=0 means ZERO entries, never "all" (the spans[-0:] trap)
+            from kubernetes_tpu.utils import tracing
+            tracing.enable()
+            try:
+                with tracing.span("probe"):
+                    pass
+                status, body = _get(port, "/debug/spans?limit=0")
+                assert status == 200 and json.loads(body) == []
+            finally:
+                tracing.disable()
+            status, body = _get(port, "/debug/queue?limit=0")
+            doc = json.loads(body)
+            assert doc["unschedulable"] == []
+            assert doc["counts"]["unschedulable"] == 8
+
+            # cache dump truncation is visible, never silent
+            status, body = _get(port, "/debug/cache?limit=1000")
+            doc = json.loads(body)
+            assert status == 200 and "truncated" not in doc
+
+            # a garbage limit falls back to the default instead of erroring
+            status, _body = _get(port, "/debug/queue?limit=bogus")
+            assert status == 200
+        finally:
+            app.server.stop()
+
+    def test_debug_flightrecorder_endpoint(self):
+        from kubernetes_tpu.backend import telemetry
+
+        store = ClusterStore()
+        app = SchedulerApp(store, raw_config=None)
+        port = app.server.start()
+        try:
+            # off by default: the endpoint reports disabled, not an error
+            status, body = _get(port, "/debug/flightrecorder")
+            assert status == 200
+            assert json.loads(body) == {"enabled": False}
+
+            t = telemetry.enable()
+            for i in range(5):
+                t.event("dispatch", batchId=f"b{i}")
+            status, body = _get(port, "/debug/flightrecorder?limit=2")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc["enabled"] is True
+            assert doc["ring"]["held"] == 5
+            assert [e["batchId"] for e in doc["events"]] == ["b3", "b4"]
+            assert doc["truncated"] == {"events": 5}  # capped ≠ short
+            assert "compile" in doc and "transfer" in doc
+        finally:
+            telemetry.disable()
             app.server.stop()
 
     def test_debug_sessions_on_wire_scheduler(self):

@@ -1,0 +1,266 @@
+"""Device-runtime observability (backend/telemetry.py): compile/retrace
+ledger attribution, flight-recorder ring bounds, HBM/transfer counters, the
+near-zero-disabled-cost contract (the PR 2 disabled-tracer rule: one global
+read per event), and the placement-parity guard — enabling the layer must
+change no scheduling decision."""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler, telemetry
+from kubernetes_tpu.backend.telemetry import (
+    CompileLedger,
+    DeviceTelemetry,
+    FlightRecorder,
+    STORM_RETRACES,
+)
+from kubernetes_tpu.metrics.scheduler_metrics import SchedulerMetrics
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+class TestDisabledContract:
+    """Tier-1 guard: the whole layer is near-zero-cost when disabled —
+    every module hook returns after ONE module-global read, allocating
+    nothing."""
+
+    def test_disabled_hooks_are_noops(self):
+        assert telemetry.get() is None
+        assert telemetry.event("dispatch", batchId="x") is None
+        assert telemetry.transfer("upload", 1024) is None
+        assert telemetry.sample_hbm() is None
+
+    def test_disabled_dispatch_returns_shared_null_context(self):
+        # identity, not just equality: the disabled path allocates NO
+        # per-call context manager object
+        cm1 = telemetry.dispatch("schedule_batch", bucket="128/off")
+        cm2 = telemetry.dispatch("gang_verdicts")
+        assert cm1 is cm2 is telemetry._NULL_CM
+        with cm1:
+            pass  # reusable
+
+    def test_enable_disable_roundtrip(self):
+        t = telemetry.enable()
+        assert telemetry.get() is t
+        telemetry.event("encode", batchId="b1")
+        assert len(t.flight) == 1
+        telemetry.disable()
+        assert telemetry.get() is None
+        telemetry.event("encode", batchId="b2")  # no-op, no error
+        assert len(t.flight) == 1
+
+
+class TestFlightRecorderRing:
+    def test_ring_overflow_evicts_oldest_bounded_memory(self):
+        fr = FlightRecorder(capacity=8)
+        for i in range(20):
+            fr.record("encode", batchId=f"b{i}")
+        assert len(fr) == 8  # bounded
+        events = fr.dump()
+        # oldest evicted: the ring holds exactly the newest 8, in order
+        assert [e["batchId"] for e in events] == [f"b{i}" for i in range(12, 20)]
+        assert events[0]["seq"] == 13  # seqs keep counting across evictions
+        assert fr.recorded == 20
+
+    def test_dump_limit_caps_from_the_newest_end(self):
+        fr = FlightRecorder(capacity=64)
+        for i in range(10):
+            fr.record("commit", batchId=f"b{i}")
+        tail = fr.dump(limit=3)
+        assert [e["batchId"] for e in tail] == ["b7", "b8", "b9"]
+        assert fr.dump(limit=0) == []
+
+    def test_filtered_events_view(self):
+        fr = FlightRecorder()
+        fr.record("dispatch", batchId="b1")
+        fr.record("poison", batchId="b1")
+        fr.record("dispatch", batchId="b2")
+        assert [e["type"] for e in fr.events(batch_id="b1")] == [
+            "dispatch", "poison"]
+        assert len(fr.events("dispatch")) == 2
+
+
+class TestCompileLedger:
+    def test_attribution_retraces_and_storm(self):
+        m = SchedulerMetrics()
+        led = CompileLedger(m, FlightRecorder())
+        # first compile of a program is not a retrace
+        with led.dispatch("prog", bucket="16/off"):
+            led.record_compile(0.5)
+        assert led.compilations[("prog", "16/off")] == 1
+        assert led.total_retraces() == 0
+        # every further compile (new bucket = the sizer walking) retraces
+        for i in range(STORM_RETRACES):
+            with led.dispatch("prog", bucket=f"{32 * (i + 1)}/off"):
+                led.record_compile(0.1)
+        assert led.total_compilations() == 1 + STORM_RETRACES
+        assert led.retraces["prog"] == STORM_RETRACES
+        # three retraces within the window: exactly one storm flagged
+        assert led.storms.get("prog") == 1
+        assert led.flight.events("retrace_storm")
+        # metrics fed: per-(program, bucket) counter + retrace counter
+        assert m.xla_compilations.labels("prog", "16/off") == 1
+        assert m.xla_retraces.labels("prog") == STORM_RETRACES
+        assert m.xla_compile_duration.count("prog") == 1 + STORM_RETRACES
+
+    def test_unattributed_compile_lands_in_other(self):
+        led = CompileLedger()
+        led.record_compile(0.2)
+        assert led.compilations[(telemetry.OTHER_PROGRAM, "-")] == 1
+
+    def test_real_jit_compile_is_counted(self):
+        """End to end through jax.monitoring: a fresh jitted program
+        compiled inside a dispatch context lands in the ledger; a cache
+        hit does not."""
+        import jax
+        import jax.numpy as jnp
+
+        t = telemetry.enable()
+
+        @jax.jit
+        def probe(x):
+            return x * 3 + 1
+
+        with telemetry.dispatch("probe_prog", bucket="4"):
+            probe(jnp.ones(4)).block_until_ready()
+        n = t.ledger.compilations.get(("probe_prog", "4"), 0)
+        assert n >= 1
+        with telemetry.dispatch("probe_prog", bucket="4"):
+            probe(jnp.ones(4)).block_until_ready()  # cache hit
+        assert t.ledger.compilations[("probe_prog", "4")] == n
+        assert t.ledger.total_retraces() == 0
+        # a new shape retraces
+        with telemetry.dispatch("probe_prog", bucket="8"):
+            probe(jnp.ones(8)).block_until_ready()
+        assert t.ledger.compilations.get(("probe_prog", "8", ), 0) >= 1
+        assert t.ledger.total_retraces() >= 1
+
+
+class TestTransferAndHbm:
+    def test_transfer_counters_and_metrics(self):
+        m = SchedulerMetrics()
+        t = telemetry.enable(m)
+        telemetry.transfer("upload", 4096)
+        telemetry.transfer("upload", 1024)
+        telemetry.transfer("fetch", 256)
+        assert t.transfer_bytes == {"upload": 5120, "fetch": 256}
+        assert m.device_transfer_bytes.labels("upload") == 5120.0
+        assert m.device_transfer_bytes.labels("fetch") == 256.0
+
+    def test_transfer_annotates_active_span(self):
+        from kubernetes_tpu.utils import tracing
+
+        telemetry.enable()
+        tracer = tracing.enable()
+        with tracing.span("device.sync") as s:
+            telemetry.transfer("upload", 7777)
+        assert s.attributes["device.upload"] == 7777
+        tracing.disable()
+        assert tracer is not None
+
+    def test_second_scheduler_registry_attaches(self, monkeypatch):
+        """Two schedulers set up in one process (the HA topology): the
+        second maybe_enable_from_env binds its SchedulerMetrics too —
+        events land in BOTH registries, not silently only the first."""
+        monkeypatch.setenv("KTPU_TELEMETRY", "1")
+        m1, m2 = SchedulerMetrics(), SchedulerMetrics()
+        telemetry.maybe_enable_from_env(m1)
+        telemetry.maybe_enable_from_env(m2)
+        telemetry.maybe_enable_from_env(m2)  # idempotent: no double-count
+        telemetry.event("dispatch", batchId="b1")
+        telemetry.transfer("upload", 128)
+        for m in (m1, m2):
+            assert m.flight_events.labels("dispatch") == 1.0
+            assert m.device_transfer_bytes.labels("upload") == 128.0
+
+    def test_sample_hbm_never_raises(self):
+        t = telemetry.enable()
+        # CPU backend: memory_stats() is None -> sample returns None and
+        # the peak stays 0; on an accelerator it returns the stats dict
+        out = t.sample_hbm()
+        assert out is None or "bytes_in_use" in out
+
+    def test_dump_shape(self):
+        t = telemetry.enable()
+        telemetry.event("dispatch", batchId="b1", bucket=16)
+        telemetry.transfer("fetch", 64)
+        body = t.dump(limit=10)
+        assert body["enabled"] is True
+        assert body["ring"]["held"] == 1
+        assert body["transfer"]["fetchBytes"] == 64
+        assert body["events"][0]["batchId"] == "b1"
+        assert "compilations" in body["compile"]
+
+
+def _run_small_cluster(n_nodes=12, n_pods=24):
+    store = ClusterStore()
+    sched = TPUScheduler(store, batch_size=8, comparer_every_n=1)
+    for i in range(n_nodes):
+        store.create_node(
+            make_node(f"n{i}")
+            .capacity({"cpu": str(4 + i % 5), "memory": "16Gi", "pods": 20})
+            .label("zone", f"z{i % 3}").obj())
+    for i in range(n_pods):
+        store.create_pod(
+            make_pod(f"p{i}").req({"cpu": "500m", "memory": "1Gi"}).obj())
+    sched.run_until_settled()
+    placements = {k: p.spec.node_name for k, p in store.pods.items()
+                  if p.spec.node_name}
+    return sched, placements
+
+
+class TestPlacementParityGuard:
+    """Enabling the layer changes counters, never placements: identical
+    clusters scheduled with telemetry off and on bind identically, and the
+    in-run oracle comparer stays clean (oracle<->tpu parity unchanged)."""
+
+    def test_enabled_changes_no_placements(self):
+        telemetry.disable()
+        sched_off, placements_off = _run_small_cluster()
+        assert sched_off.comparer_mismatches == 0
+
+        t = telemetry.enable(SchedulerMetrics())
+        sched_on, placements_on = _run_small_cluster()
+        assert sched_on.comparer_mismatches == 0
+        assert placements_on == placements_off
+        # and the layer actually observed the run: lifecycle events with
+        # the in-process batch ids, and fetch transfer per commit
+        dispatches = t.flight.events("dispatch")
+        commits = t.flight.events("commit")
+        assert dispatches and commits
+        assert all(e["batchId"].startswith("b") for e in dispatches)
+        assert t.transfer_bytes["fetch"] > 0
+
+
+class TestDeviceStateUploadBytes:
+    def test_sync_counts_upload_bytes(self):
+        from kubernetes_tpu.backend.device_state import (
+            DeviceState, caps_for_cluster)
+
+        t = telemetry.enable()
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=8)
+        for i in range(4):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        sched.cache.update_snapshot(sched.snapshot)
+        dev = DeviceState(caps_for_cluster(4, batch=8),
+                          ns_labels_fn=store.ns_labels)
+        rows = dev.sync(sched.snapshot)
+        assert rows == 4
+        assert dev.last_upload_bytes > 0
+        assert dev.upload_bytes == dev.last_upload_bytes
+        assert t.transfer_bytes["upload"] == dev.upload_bytes
+        # clean resync: nothing dirty -> no upload counted
+        rows2 = dev.sync(sched.snapshot)
+        assert rows2 == 0
+        assert dev.last_upload_bytes == 0

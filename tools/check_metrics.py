@@ -1,17 +1,22 @@
 #!/usr/bin/env python3
-"""Static dead-metric check (tier-1; run by tests/test_check_metrics.py).
+"""Static dead-metric check + span-name lint (tier-1; run by
+tests/test_check_metrics.py).
 
-Every metric registered in ``SchedulerMetrics.__init__`` must be observed /
-incremented / set somewhere in the package outside its definition — either
-directly (``smetrics.<attr>.observe(...)``) or through a SchedulerMetrics
-helper method that is itself called from outside the metrics module. This
-PR fixed a family of defined-but-never-observed metrics
-(framework_extension_point_duration, plugin_execution_duration,
-queue_incoming_pods, pending_pods, ...); this check keeps them from
-reappearing: a new metric that nothing feeds fails tier-1.
+Dead metrics: every metric registered in ``SchedulerMetrics.__init__`` must
+be observed / incremented / set somewhere in the package outside its
+definition — either directly (``smetrics.<attr>.observe(...)``) or through
+a SchedulerMetrics helper method that is itself called from outside the
+metrics module. A new metric that nothing feeds fails tier-1.
+
+Span lint: every span name emitted in the package (``tracing.span("...")``
+/ ``span_from_remote(..., "...")``) must appear in bench.py's critical-path
+attribution table (``CRITICAL_PATH_SPANS``) or match an entry in the
+explicit ignore list below. Without this, a new phase span silently falls
+into the attribution's "other" bucket and the bench's critical-path story
+quietly stops adding up.
 
 Usage: ``python tools/check_metrics.py`` — exits 0 when every metric is
-live, 1 with a listing otherwise.
+live and every span is attributed, 1 with a listing otherwise.
 """
 
 from __future__ import annotations
@@ -24,9 +29,15 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "kubernetes_tpu")
 METRICS_FILE = os.path.join(PKG, "metrics", "scheduler_metrics.py")
+BENCH_FILE = os.path.join(REPO, "bench.py")
 
 # the mutating calls that count as "feeding" a metric
 _MUTATORS = ("observe", "inc", "set")
+
+# span names (prefix match) consciously OUTSIDE the bench critical-path
+# attribution: the sampled per-extension-point / per-plugin spans are
+# latency *exemplars*, not cycle phases
+SPAN_IGNORE_PREFIXES = ("framework.", "plugin.")
 
 
 def registered_metrics(tree: ast.Module):
@@ -112,17 +123,116 @@ def find_dead_metrics():
     return attrs, dead
 
 
+# ---------------------------------------------------------------- span lint
+
+
+def _literal_prefix(node):
+    """(value, exact) for a span-name argument: a plain string constant is
+    exact; an f-string / ``"prefix" + expr`` concatenation contributes its
+    leading literal as a prefix; anything else is unlintable (None)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, True
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                break
+        return ("".join(parts), False) if parts else (None, False)
+    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)):
+        return node.left.value, False
+    return None, False
+
+
+def emitted_span_names(pkg: str = None):
+    """(exact names, dynamic prefixes) of every span the package emits:
+    ``<anything>.span("name", ...)`` and
+    ``<anything>.span_from_remote(tp, "name", ...)`` calls."""
+    names, prefixes = set(), set()
+    for root, _dirs, files in os.walk(pkg or PKG):
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            try:
+                tree = ast.parse(open(path, encoding="utf-8").read())
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)):
+                    continue
+                arg = None
+                if node.func.attr in ("span", "span_remote") and node.args:
+                    arg = node.args[0]
+                elif node.func.attr == "span_from_remote" and len(node.args) >= 2:
+                    arg = node.args[1]
+                if arg is None:
+                    continue
+                val, exact = _literal_prefix(arg)
+                if val is None:
+                    continue
+                (names if exact else prefixes).add(val)
+    return names, prefixes
+
+
+def bench_span_table(path: str = None):
+    """The ``CRITICAL_PATH_SPANS`` literal from bench.py, via AST (importing
+    bench.py would drag the whole package + jax into a lint)."""
+    tree = ast.parse(open(path or BENCH_FILE, encoding="utf-8").read())
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Name) and tgt.id == "CRITICAL_PATH_SPANS"):
+            continue
+        consts = [n.value for n in ast.walk(node.value)
+                  if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+        return set(consts)
+    return set()
+
+
+def find_unattributed_spans(pkg: str = None, bench_path: str = None):
+    """(emitted, unattributed): span names/prefixes neither in bench.py's
+    attribution table nor matched by SPAN_IGNORE_PREFIXES."""
+    names, prefixes = emitted_span_names(pkg)
+    table = bench_span_table(bench_path)
+    bad = [n for n in sorted(names)
+           if n not in table and not n.startswith(SPAN_IGNORE_PREFIXES)]
+    for p in sorted(prefixes):
+        if p.startswith(SPAN_IGNORE_PREFIXES):
+            continue
+        if any(t.startswith(p) for t in table):
+            continue
+        bad.append(p + "*")
+    return sorted(names | prefixes), bad
+
+
 def main() -> int:
     attrs, dead = find_dead_metrics()
+    rc = 0
     if dead:
         print(f"DEAD METRICS ({len(dead)}/{len(attrs)}): registered in "
               "SchedulerMetrics but never observed/inc'd/set outside the "
               "definition:")
         for attr in dead:
             print(f"  - {attr}")
-        return 1
-    print(f"ok: all {len(attrs)} registered scheduler metrics are observed")
-    return 0
+        rc = 1
+    emitted, unattributed = find_unattributed_spans()
+    if unattributed:
+        print(f"UNATTRIBUTED SPANS ({len(unattributed)}/{len(emitted)}): "
+              "emitted in code but absent from bench.py CRITICAL_PATH_SPANS "
+              "and the ignore list:")
+        for name in unattributed:
+            print(f"  - {name}")
+        rc = 1
+    if rc == 0:
+        print(f"ok: all {len(attrs)} registered scheduler metrics are "
+              f"observed; all {len(emitted)} emitted span names attributed")
+    return rc
 
 
 if __name__ == "__main__":

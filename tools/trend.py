@@ -25,20 +25,54 @@ _REGRESSION_PCT = 20.0
 _INVALID_ROUNDS = {1, 2}
 
 
+def round_files() -> List[tuple]:
+    """Sorted ``(round, path)`` for every committed BENCH_r*.json — the ONE
+    place that knows the snapshot naming/location rule (the fence's
+    newest-on-disk refusal check must agree with the loader it guards)."""
+    out = []
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            out.append((int(m.group(1)), path))
+    # numeric, not lexicographic: 'BENCH_r100' sorts before 'BENCH_r99' as
+    # a string, and "newest round" must mean the highest number
+    return sorted(out)
+
+
+def recover_record(doc: dict) -> dict:
+    """Extract the bench record from a round wrapper: ``parsed`` when
+    present, else the one-JSON-line contract recovered from the raw stdout
+    tail (some rounds carry parsed=null with the record only in the tail —
+    r05's shape). Returns {} when nothing judgeable can be recovered."""
+    rec = doc.get("parsed") or {}
+    if rec:
+        return rec
+    tail = doc.get("tail") or ""
+    for line in reversed(tail.splitlines() if isinstance(tail, str) else []):
+        line = line.strip()
+        start = line.find('{"')
+        if start < 0:
+            continue
+        try:
+            cand = json.loads(line[start:])
+        except json.JSONDecodeError:
+            continue
+        if isinstance(cand, dict) and "value" in cand:
+            return cand
+    return {}
+
+
 def _load_rounds() -> List[dict]:
     rounds = []
-    for path in sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))):
-        m = re.search(r"BENCH_r(\d+)\.json$", path)
-        if not m:
-            continue
+    for rno, path in round_files():
         try:
             with open(path) as f:
                 doc = json.load(f)
         except (OSError, json.JSONDecodeError):
             continue
-        rec = doc.get("parsed") or {}
+        rec = recover_record(doc)
         if rec:
-            rec = dict(rec, _round=int(m.group(1)))
+            rec = dict(rec, _round=rno)
             rounds.append(rec)
     return rounds
 
@@ -96,6 +130,98 @@ def _flag_regressions(rows: List[dict]) -> List[str]:
     worst("grpc_pods_per_s", True,
           "grpc row {cur:.1f} pods/s is {pct:.0f}% below best prior {best:.1f}")
     return flags
+
+
+# --------------------------------------------------------------- SLO fence
+#
+# Declared tolerances for `bench.py --fence` (percent). The trend table
+# above is *evidence* (flags in a Markdown file); the fence is a *gate*: a
+# violation exits nonzero, so CI can refuse a regression instead of a
+# verdict discovering it one round later.
+FENCE_TOLERANCES = {
+    "pods_per_s": 25.0,            # headline throughput: % below baseline
+    "p99_s": 50.0,                 # headline attempt p99: % above baseline
+    "workload_pods_per_s": 40.0,   # per-workload matrix throughput
+    "workload_p99_s": 100.0,       # per-workload attempt p99
+}
+# per-workload overrides for rows whose history is structurally volatile
+# (PreemptionBasic swung 2953 -> 69 -> 243 pods/s across r02-r05 as the
+# screen/batching strategy changed; a tight fence there would only flap)
+FENCE_WORKLOAD_OVERRIDES = {
+    "PreemptionBasic": {"workload_pods_per_s": 85.0, "workload_p99_s": 300.0},
+}
+
+
+def _same_platform(a: dict, b: dict) -> bool:
+    return (str(a.get("platform", "")).startswith("cpu")
+            == str(b.get("platform", "")).startswith("cpu"))
+
+
+def fence(current: dict, rounds: Optional[List[dict]] = None) -> dict:
+    """Judge ``current`` against the newest valid same-platform prior round
+    (comparing cpu-fallback numbers against a TPU round is noise, not a
+    regression signal). Returns {"baselineRound", "checked", "violations",
+    "tolerances"}; an empty violations list means the fence holds."""
+    if rounds is None:
+        rounds = _load_rounds()
+    prior = [r for r in rounds
+             if r.get("_round") not in _INVALID_ROUNDS
+             and _same_platform(r, current)]
+    if not prior:
+        return {"baselineRound": None, "checked": 0, "violations": [],
+                "tolerances": FENCE_TOLERANCES,
+                "note": "no valid same-platform baseline round"}
+    base = prior[-1]
+    violations: List[str] = []
+    checked = 0
+
+    def check(label: str, cur, ref, tol_pct: float,
+              higher_is_better: bool) -> None:
+        nonlocal checked
+        # a current value of 0 (total collapse) is the WORST regression,
+        # not a missing metric — only None/absent (or a zero baseline the
+        # ratio can't be computed against) skips the check
+        if cur is None or ref is None or not ref:
+            return
+        checked += 1
+        if higher_is_better:
+            floor = ref * (1.0 - tol_pct / 100.0)
+            if cur < floor:
+                violations.append(
+                    f"{label}: {cur:.2f} is {100.0 * (ref - cur) / ref:.0f}% "
+                    f"below baseline {ref:.2f} (tolerance {tol_pct:.0f}%)")
+        else:
+            ceil = ref * (1.0 + tol_pct / 100.0)
+            if cur > ceil:
+                violations.append(
+                    f"{label}: {cur:.4f} is {100.0 * (cur - ref) / ref:.0f}% "
+                    f"above baseline {ref:.4f} (tolerance {tol_pct:.0f}%)")
+
+    tol = FENCE_TOLERANCES
+    check("headline pods/s", current.get("value"), base.get("value"),
+          tol["pods_per_s"], True)
+    check("headline attempt p99",
+          (current.get("attempt_latency_s") or {}).get("p99"),
+          (base.get("attempt_latency_s") or {}).get("p99"),
+          tol["p99_s"], False)
+    cur_wl = current.get("workloads") or {}
+    base_wl = base.get("workloads") or {}
+    for name in sorted(set(cur_wl) & set(base_wl)):
+        c, b = cur_wl[name], base_wl[name]
+        if not isinstance(c, dict) or not isinstance(b, dict):
+            continue
+        if "error" in c or "skipped" in c or "error" in b or "skipped" in b:
+            continue
+        over = FENCE_WORKLOAD_OVERRIDES.get(name, {})
+        check(f"workload {name} pods/s", c.get("pods_per_s"),
+              b.get("pods_per_s"),
+              over.get("workload_pods_per_s", tol["workload_pods_per_s"]),
+              True)
+        check(f"workload {name} attempt p99", c.get("attempt_p99_s"),
+              b.get("attempt_p99_s"),
+              over.get("workload_p99_s", tol["workload_p99_s"]), False)
+    return {"baselineRound": base.get("_round"), "checked": checked,
+            "violations": violations, "tolerances": FENCE_TOLERANCES}
 
 
 def write_trend(current: Optional[dict] = None) -> dict:
