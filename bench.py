@@ -659,6 +659,14 @@ def run_fence(argv) -> int:
     out = fence(current, rounds)
     out["record"] = path or f"BENCH_r{current.get('_round', '?')}.json"
     if not out["checked"]:
+        if out.get("epochBoundary"):
+            # a DECLARED platform-epoch boundary (trend.PLATFORM_EPOCHS):
+            # earlier rounds exist but were measured on a different
+            # environment class, so "no baseline" is the reviewed,
+            # committed state — pass with the note, don't fail closed
+            print(json.dumps({"metric": "slo_fence", "violations": 0,
+                              "fence": out}))
+            return 0
         # zero comparisons performed (e.g. no same-platform baseline
         # round): the gate has judged NOTHING and must say so, not pass
         print(json.dumps({"metric": "slo_fence",

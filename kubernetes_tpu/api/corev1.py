@@ -893,6 +893,26 @@ def pod_group_to(pg: t.PodGroup) -> dict:
     return out
 
 
+def scheduling_quota_from(doc: dict) -> t.SchedulingQuota:
+    spec = doc.get("spec") or {}
+    status = doc.get("status") or {}
+    return t.SchedulingQuota(
+        meta=meta_from(doc.get("metadata") or {}),
+        hard={k: int(v) for k, v in (spec.get("hard") or {}).items()},
+        weight=int(spec.get("weight", 1)),
+        used={k: int(v) for k, v in (status.get("used") or {}).items()})
+
+
+def scheduling_quota_to(sq: t.SchedulingQuota) -> dict:
+    spec: dict = {"weight": sq.weight}
+    if sq.hard:
+        spec["hard"] = dict(sq.hard)
+    out: dict = {"metadata": meta_to(sq.meta), "spec": spec}
+    if sq.used:
+        out["status"] = {"used": dict(sq.used)}
+    return out
+
+
 def register(scheme: Scheme) -> None:
     """Register every modeled external version (AddToScheme analog)."""
     core = [
@@ -940,4 +960,7 @@ def register(scheme: Scheme) -> None:
     scheme.add_known_type(
         GroupVersionKind("scheduling.x-k8s.io", "v1alpha1", "PodGroup"),
         t.PodGroup, pod_group_from, pod_group_to)
+    scheme.add_known_type(
+        GroupVersionKind("scheduling.x-k8s.io", "v1alpha1", "SchedulingQuota"),
+        t.SchedulingQuota, scheduling_quota_from, scheduling_quota_to)
     scheme.add_defaulter(t.Pod, _default_pod)

@@ -1061,6 +1061,39 @@ POD_GROUP_SCHEDULING = "Scheduling"
 POD_GROUP_RUNNING = "Running"
 
 
+# canonical SchedulingQuota dimension names (the subset of the core
+# ResourceQuota evaluator's dimensions the scheduler admits on, plus the
+# resource.k8s.io claim count)
+QUOTA_PODS = "pods"
+QUOTA_CPU = "requests.cpu"        # milli-cpu (api/resource.py canonical)
+QUOTA_MEMORY = "requests.memory"  # KiB
+QUOTA_CLAIMS = "claims"           # pod.spec.resourceClaims entries
+
+
+@dataclass
+class SchedulingQuota:
+    """scheduling.x-k8s.io SchedulingQuota (namespaced): the scheduler-side
+    multi-tenant admission contract — per-namespace hard caps the quota
+    admission gate (framework/plugins/quota.py) enforces BEFORE a pod may
+    occupy a device batch slot, plus the fair-share ``weight`` the
+    scheduling queue's deficit-round-robin dequeuer serves the namespace
+    with. Distinct from core/v1 ResourceQuota (apiserver admission on pod
+    CREATE): this kind admits on *scheduling* — usage counts scheduled
+    (assumed + bound) pods, so an over-quota tenant's pods exist but park
+    in the unschedulable queue until capacity frees.
+
+    ``hard`` keys are the QUOTA_* dimension names in canonical ints; absent
+    keys are unlimited. ``used`` is advisory status (the authoritative
+    ledger lives in the QuotaAdmission plugin and is rebuilt from the store
+    on restart)."""
+
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    hard: Dict[str, int] = field(default_factory=dict)
+    weight: int = 1  # fair-share weight (>= 0; 0 = background tenant)
+    # status
+    used: Dict[str, int] = field(default_factory=dict)
+
+
 @dataclass
 class PodGroup:
     """scheduling.x-k8s.io PodGroup (namespaced): the gang contract for

@@ -20,6 +20,7 @@ import re
 from typing import List, Optional
 
 from . import resource as resource_api
+from .types import QUOTA_CLAIMS, QUOTA_CPU, QUOTA_MEMORY, QUOTA_PODS
 
 # util/validation/validation.go IsDNS1123Subdomain / IsDNS1123Label /
 # IsQualifiedName / IsValidLabelValue
@@ -463,10 +464,34 @@ def validate_pod_group(pg) -> list:
     return errs
 
 
+def validate_scheduling_quota(sq) -> list:
+    errs = validate_object_meta(sq.meta, requires_namespace=True)
+    if sq.weight < 0:
+        errs.append("spec.weight: must be >= 0")
+    for dim, v in sq.hard.items():
+        if dim not in _QUOTA_DIMENSIONS:
+            errs.append(f"spec.hard[{dim}]: unknown quota dimension "
+                        f"(expected one of {sorted(_QUOTA_DIMENSIONS)})")
+        elif not isinstance(v, int) or v < 0:
+            errs.append(f"spec.hard[{dim}]: must be a non-negative integer")
+    return errs
+
+
+# one source of truth with the ledger's dimension keys (api/types.py /
+# framework/plugins/quota.py) — a dimension added there validates here
+_QUOTA_DIMENSIONS = frozenset(
+    (QUOTA_PODS, QUOTA_CPU, QUOTA_MEMORY, QUOTA_CLAIMS))
+
+
 def validate(kind: str, obj) -> None:
     """Strategy.Validate dispatch; raises ValidationError on failure."""
     if kind == "PodGroup":
         errs = validate_pod_group(obj)
+        if errs:
+            raise ValidationError(kind, obj.meta.name, errs)
+        return
+    if kind == "SchedulingQuota":
+        errs = validate_scheduling_quota(obj)
         if errs:
             raise ValidationError(kind, obj.meta.name, errs)
         return

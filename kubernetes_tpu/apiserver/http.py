@@ -87,6 +87,7 @@ RESOURCES = {
     ("apis/resource.k8s.io/v1alpha2", "podschedulingcontexts"):
         "PodSchedulingContext",
     ("apis/scheduling.x-k8s.io/v1alpha1", "podgroups"): "PodGroup",
+    ("apis/scheduling.x-k8s.io/v1alpha1", "schedulingquotas"): "SchedulingQuota",
     ("apis/apiextensions.k8s.io/v1", "customresourcedefinitions"):
         "CustomResourceDefinition",
     ("apis/apiregistration.k8s.io/v1", "apiservices"): "APIService",

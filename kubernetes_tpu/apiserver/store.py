@@ -151,8 +151,11 @@ class ClusterStore:
         self.resource_claims: Dict[str, object] = {}
         self.resource_claim_templates: Dict[str, object] = {}
         self.pod_scheduling_contexts: Dict[str, object] = {}
-        # scheduling.x-k8s.io: gang contracts the Coscheduling plugin gates on
+        # scheduling.x-k8s.io: gang contracts the Coscheduling plugin gates
+        # on, plus the per-namespace scheduler-admission quota contracts the
+        # QuotaAdmission plugin + fair-share dequeuer read
         self.pod_groups: Dict[str, object] = {}
+        self.scheduling_quotas: Dict[str, object] = {}
         # apiextensions (VERDICT r4 item 10): registered CRDs + one dynamic
         # kind map per served kind — plugin-requested GVKs get real objects,
         # journaled watches and informers through the same generic machinery
@@ -374,6 +377,7 @@ class ClusterStore:
                 "ResourceClaimTemplate": self.resource_claim_templates,
                 "PodSchedulingContext": self.pod_scheduling_contexts,
                 "PodGroup": self.pod_groups,
+                "SchedulingQuota": self.scheduling_quotas,
                 "CustomResourceDefinition": self.crds,
                 "APIService": self.api_services,
                 **self._custom_kinds,

@@ -10,8 +10,11 @@ riding back with unschedulable results.
 grpc service stubs are not generated (grpc_tools is absent from the image);
 the server registers generic method handlers and the client uses
 channel.unary_unary — functionally identical to protoc-gen-grpc output.
-Messages compile on demand: `protoc --python_out` into native/build at
-first import (protoc is in the image; the output is cached by mtime).
+Messages come from the vendored module tools/gen_pb2.py emits into
+kubernetes_tpu/native/ktpu_device_pb2.py (trusted while its embedded
+PROTO_SHA256 matches the .proto source); a stale vendored module falls
+back to `protoc --python_out` into native/build (cached by mtime), and
+when protoc is absent too the error names the regeneration command.
 """
 
 from __future__ import annotations
@@ -43,20 +46,84 @@ _pb2_lock = threading.Lock()
 SERVICE = "ktpu.v1.Device"
 
 
+def _proto_sha256() -> str:
+    import hashlib
+
+    with open(_PROTO, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _vendored_hash() -> Optional[str]:
+    """PROTO_SHA256 literal read from the vendored module's TEXT — the
+    staleness check must run BEFORE the module is imported: executing a
+    stale module registers 'ktpu_device.proto' in the process-default
+    descriptor pool, and the protoc-built fallback would then raise
+    duplicate-file instead of loading."""
+    import re
+
+    try:
+        with open(os.path.join(_REPO_ROOT, "kubernetes_tpu", "native",
+                               "ktpu_device_pb2.py"), encoding="utf-8") as f:
+            head = f.read(4096)
+    except OSError:
+        return None
+    m = re.search(r'^PROTO_SHA256 = "([0-9a-f]{64})"', head, re.M)
+    return m.group(1) if m else None
+
+
+def _vendored_pb2():
+    """The tools/gen_pb2.py-vendored module, or None when it is absent or
+    stale against the current .proto source (hash-gated so a proto edit
+    without regeneration can never speak a stale schema)."""
+    if _vendored_hash() != _proto_sha256():
+        return None
+    try:
+        from ..native import ktpu_device_pb2 as vendored
+    except ImportError:
+        return None
+    return vendored
+
+
+def pb2_available() -> bool:
+    """True when pb2() will succeed: a hash-fresh vendored module, a
+    cached protoc build, or protoc itself."""
+    import shutil
+
+    if _pb2 is not None:
+        return True
+    if _vendored_pb2() is not None:
+        return True
+    if (os.path.exists(_PB2)
+            and os.path.getmtime(_PB2) >= os.path.getmtime(_PROTO)):
+        return True
+    return shutil.which("protoc") is not None
+
+
 def pb2():
-    """Import (building if stale) the generated protobuf module."""
+    """Import the protobuf message module: the vendored gen_pb2.py output
+    when fresh, else a protoc build (cached by mtime)."""
     global _pb2
     if _pb2 is not None:
         return _pb2
     with _pb2_lock:
         if _pb2 is not None:
             return _pb2
+        vendored = _vendored_pb2()
+        if vendored is not None:
+            _pb2 = vendored
+            return _pb2
         if (not os.path.exists(_PB2)
                 or os.path.getmtime(_PB2) < os.path.getmtime(_PROTO)):
             os.makedirs(_BUILD_DIR, exist_ok=True)
-            subprocess.run(
-                ["protoc", f"--python_out={_BUILD_DIR}", "-I", _PROTO_DIR, _PROTO],
-                check=True, capture_output=True, timeout=60)
+            try:
+                subprocess.run(
+                    ["protoc", f"--python_out={_BUILD_DIR}", "-I",
+                     _PROTO_DIR, _PROTO],
+                    check=True, capture_output=True, timeout=60)
+            except FileNotFoundError as e:
+                raise RuntimeError(
+                    "vendored ktpu_device_pb2 is stale or missing and protoc "
+                    "is not installed; run `python tools/gen_pb2.py`") from e
         import importlib.util
 
         spec = importlib.util.spec_from_file_location("ktpu_device_pb2", _PB2)

@@ -1338,11 +1338,22 @@ class WireScheduler(Scheduler):
             if pod is None or pod.spec.node_name or not self._responsible_for(pod):
                 continue
             qp.pod = pod
-            # host-side gang quorum gate (the remote program does not model
-            # Coscheduling's PreFilter) — same rule as the in-process path
+            # host-side gang quorum + namespace-quota gates (the remote
+            # program models neither) — same rules as the in-process path
             from ..framework.plugins.coscheduling import gang_precheck_status
+            from ..framework.plugins.quota import quota_precheck_status
 
             fwk = self.framework_for_pod(pod)
+            quota_st = quota_precheck_status(fwk, pod)
+            if quota_st is not None:
+                self.metrics["schedule_attempts"] += 1
+                self.smetrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, self.now_fn() - t0)
+                self._handle_scheduling_failure(
+                    fwk, self._new_cycle_state(), qp, quota_st,
+                    Diagnosis(unschedulable_plugins={"QuotaAdmission"}),
+                    pod_cycle)
+                continue
             gang_st = gang_precheck_status(fwk, pod)
             if gang_st is not None:
                 self.metrics["schedule_attempts"] += 1

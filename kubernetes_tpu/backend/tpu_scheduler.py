@@ -23,7 +23,7 @@ from __future__ import annotations
 import dataclasses
 import os
 from collections import deque
-from typing import Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -31,6 +31,7 @@ import numpy as np
 from ..api.types import Pod
 from ..framework.interface import CycleState, Status
 from ..framework.plugins.coscheduling import gang_precheck_status, pod_group_key
+from ..framework.plugins.quota import quota_precheck_status
 from ..framework.types import Diagnosis, QueuedPodInfo
 from ..ops.encode import CapacityError
 from ..scheduler.scheduler import Scheduler
@@ -316,7 +317,7 @@ class TPUScheduler(Scheduler):
         # local dispatch (microseconds of host work), not a wire round trip,
         # so the half-open interval defaults to 0.5s instead of the wire
         # breaker's 5s — a healed relay is re-adopted ~10x sooner.
-        from .circuit import CircuitBreaker, STATE_VALUES
+        from .circuit import CircuitBreaker
 
         if relay_breaker_threshold is None:
             relay_breaker_threshold = int(os.environ.get(
@@ -327,10 +328,19 @@ class TPUScheduler(Scheduler):
         self.relay_breaker = CircuitBreaker(
             failure_threshold=relay_breaker_threshold,
             reset_timeout_s=relay_probe_interval_s, now_fn=self.now_fn,
-            on_state_change=lambda _o, new: (
-                self.smetrics.backend_circuit_state.set(
-                    value=STATE_VALUES[new])))
+            on_state_change=self._relay_state_change)
         self.relay_degraded_pods = 0
+        # degraded-window accounting for the IN-PROCESS breaker: the wire
+        # path accrues scheduler_degraded_seconds_total on its own breaker
+        # (backend/service.py); before this, a relay-breaker-open window on
+        # the in-process backend was invisible to the SLO metric
+        self._relay_degraded_since: Optional[float] = None
+        # scripted device-fault hook (soak workloads / chaos rigs): called
+        # with the op name ("commit") before each batch materialization;
+        # a returned exception is raised through the real relay-death path
+        # (breaker count, ring poison, backoffQ requeue, device rebuild) —
+        # the in-process analog of testing/faults.FaultPlan on the wire
+        self.relay_fault_fn: Optional[Callable[[str], Optional[BaseException]]] = None
         if batch_deadline_ms is None:
             # ON by default (VERDICT r3 item 4): the iso-p99 contract needs
             # pop→commit bounded, so the sizer cuts batches to fit ~2 cycles
@@ -392,6 +402,23 @@ class TPUScheduler(Scheduler):
         from .claim_mask import ClaimMaskBuilder
 
         self._claim_masks = ClaimMaskBuilder(self.store)
+
+    def _relay_state_change(self, _old: str, new: str) -> None:
+        """Relay breaker transition: publish the circuit gauge and accrue
+        scheduler_degraded_seconds_total over the open→closed window (the
+        in-process mirror of WireScheduler's degraded accounting). A
+        half-open probe neither closes nor restarts the window — only a
+        successful close books the seconds."""
+        from .circuit import STATE_VALUES
+
+        self.smetrics.backend_circuit_state.set(value=STATE_VALUES[new])
+        now = self.now_fn()
+        if new == "open" and self._relay_degraded_since is None:
+            self._relay_degraded_since = now
+        elif new == "closed" and self._relay_degraded_since is not None:
+            self.smetrics.degraded_seconds.inc(
+                value=now - self._relay_degraded_since)
+            self._relay_degraded_since = None
 
     # ------------------------------------------------------------- device mgmt
 
@@ -582,6 +609,14 @@ class TPUScheduler(Scheduler):
         # (relay-tuned, cheap) probe interval admits the next batch as the
         # half-open probe.
         relay_ok = self.relay_breaker.allow()
+        if self._relay_degraded_since is not None:
+            # streaming accrual while the breaker stays open (the wire
+            # service's periodic-sample pattern): consumers see degraded
+            # seconds grow DURING the outage, not only after the close
+            now = self.now_fn()
+            self.smetrics.degraded_seconds.inc(
+                value=now - self._relay_degraded_since)
+            self._relay_degraded_since = now
         if relay_ok:
             self._ensure_device()
         for qp in qps:
@@ -590,6 +625,19 @@ class TPUScheduler(Scheduler):
                 continue  # skipPodSchedule
             qp.pod = pod
             fwk = self.framework_for_pod(pod)
+            # host-side namespace-quota gate (QuotaAdmission's PreFilter —
+            # the compiled program does not model tenant quota): an
+            # over-quota pod fails here without spending a device slot.
+            # Usually PreEnqueue already parked it; this closes the race
+            # where usage grew between enqueue and pop.
+            quota_st = quota_precheck_status(fwk, pod)
+            if quota_st is not None:
+                self.metrics["schedule_attempts"] += 1
+                self._fail(fwk, qp, quota_st, pod_cycle,
+                           Diagnosis(unschedulable_plugins={"QuotaAdmission"}))
+                self.smetrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, self.now_fn() - t_pop)
+                continue
             # host-side gang quorum gate (Coscheduling's PreFilter, which
             # the compiled program does not model): a member whose gang
             # cannot reach quorum — or sits in rejection backoff — fails
@@ -869,6 +917,13 @@ class TPUScheduler(Scheduler):
             from ..utils import relay
             from .batch import unpack_result_block
 
+            if self.relay_fault_fn is not None:
+                # scripted device fault (soak flap / chaos): surfaces at the
+                # same point a real relay death would — the materialization
+                # read — and takes the identical poison/requeue/rebuild path
+                fault = self.relay_fault_fn("commit")
+                if fault is not None:
+                    raise fault
             relay.count_sync("commit-read")  # THE one blocking read per batch
             # the packed tag keeps bench critical-path attribution honest on
             # mesh-sharded runs: packed=None falls back to per-array reads,

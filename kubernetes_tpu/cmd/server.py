@@ -220,6 +220,8 @@ def build_debug_handlers(sched) -> dict:
                           ledger, HBM/transfer counters, and the bounded
                           event ring (backend/telemetry.py; enabled=False
                           when the telemetry layer is off)
+      /debug/quota        per-namespace SchedulingQuota caps, the ledger's
+                          live usage, fair-share weight, charged pod count
 
     Every handler takes an entry cap (``?limit=N`` on the mux, default
     DEFAULT_DEBUG_LIMIT) so a 5k-node dump stays bounded.
@@ -240,7 +242,18 @@ def build_debug_handlers(sched) -> dict:
 
     def queue_dump(limit=None):
         return _capped_lists(sched.queue.dump(), limit,
-                             ("active", "backoff", "unschedulable"))
+                             ("active", "backoff", "unschedulable", "gated"))
+
+    def quota_dump(limit=None):
+        plugin = sched._quota_plugin()
+        if plugin is None:
+            return {"enabled": False}
+        out = plugin.dump()
+        capped, orig = _cap(sorted(out.items()), limit)
+        result = {"enabled": True, "namespaces": dict(capped)}
+        if orig is not None:
+            result["namespacesTruncated"] = orig
+        return result
 
     def cache_dump(limit=None):
         comparer = CacheComparer(sched.store, sched.cache, sched.queue)
@@ -320,7 +333,7 @@ def build_debug_handlers(sched) -> dict:
     return {"queue": queue_dump, "cache": cache_dump,
             "devicestate": device_dump, "spans": spans_dump,
             "circuit": circuit_dump, "sessions": sessions_dump,
-            "flightrecorder": flightrecorder_dump}
+            "flightrecorder": flightrecorder_dump, "quota": quota_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,

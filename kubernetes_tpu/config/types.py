@@ -26,6 +26,7 @@ DEFAULT_SCHEDULER_NAME = "default-scheduler"
 # camelCase extension-point names as they appear in config files → internal
 _POINT_NAMES = {
     "queueSort": "queue_sort",
+    "preEnqueue": "pre_enqueue",
     "preFilter": "pre_filter",
     "filter": "filter",
     "postFilter": "post_filter",

@@ -52,6 +52,7 @@ from .extras import (
     TTLController,
 )
 from .nodelifecycle import NodeLifecycleController
+from .podgroup import PodGroupController
 from .resourceclaim import ResourceClaimController
 from .workloads import (
     DaemonSetController,
@@ -90,6 +91,10 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         "endpoints": lambda m: EndpointsController(m.store, m.factory),
         "pvbinder": lambda m: PVBinderController(m.store, m.factory),
         "resourcequota": lambda m: ResourceQuotaController(m.store, m.factory),
+        # gang-group status truth-keeper + orphaned-group GC (the controller
+        # half of the Coscheduling lifecycle; GC ages on wall time)
+        "podgroup": lambda m: PodGroupController(m.store, m.factory,
+                                                 now_fn=_wall_now(m)),
         "disruption": lambda m: DisruptionController(m.store, m.factory),
         "ttl": lambda m: TTLController(m.store, m.factory),
         "endpointslice": lambda m: EndpointSliceController(m.store, m.factory),

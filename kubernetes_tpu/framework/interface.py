@@ -159,6 +159,18 @@ class EnqueueExtensions(Plugin):
         return []
 
 
+class PreEnqueuePlugin(Plugin):
+    """Gates a pod's entry into the active scheduling queue (interface.go
+    PreEnqueuePlugin): a non-success status parks the pod GATED in the
+    unschedulable pool — it never occupies a scheduling cycle (or a device
+    batch slot) until the gating condition clears and a cluster event
+    re-admits it. Runs OUTSIDE the scheduling cycle (no CycleState): queue
+    transitions call it, so it must be cheap and side-effect-free."""
+
+    @abc.abstractmethod
+    def pre_enqueue(self, pod: Pod) -> Status: ...
+
+
 class PreFilterPlugin(Plugin):
     @abc.abstractmethod
     def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]: ...
@@ -236,8 +248,8 @@ class PostBindPlugin(Plugin):
 
 
 EXTENSION_POINTS = (
-    "queue_sort", "pre_filter", "filter", "post_filter", "pre_score", "score",
-    "reserve", "permit", "pre_bind", "bind", "post_bind",
+    "queue_sort", "pre_enqueue", "pre_filter", "filter", "post_filter",
+    "pre_score", "score", "reserve", "permit", "pre_bind", "bind", "post_bind",
 )
 
 

@@ -1,0 +1,386 @@
+"""QuotaAdmission — scheduler-side multi-tenant admission over
+SchedulingQuota (scheduling.x-k8s.io/v1alpha1).
+
+The Kueue/ElasticQuota analog collapsed onto the scheduling framework: a
+namespace's SchedulingQuota caps what the scheduler may *admit* (assume +
+bind), not what the apiserver may store — an over-quota tenant's pods exist
+but park GATED in the unschedulable pool, where they cost no scheduling
+cycles and no device batch slots. The plugin is a PreEnqueue/PreFilter pair
+plus a Reserve-time charge:
+
+  * PreEnqueue: the queue-admission gate. Every transition toward activeQ
+    re-runs it, so a reactivation wave (assigned-pod delete, gang teardown,
+    unschedulable-timeout flush) can never flood the active queue with pods
+    whose namespace is still over quota (the reactivation-thrash guard).
+  * PreFilter: the in-cycle re-check (usage may have grown between enqueue
+    and pop — a batched frontend pops hundreds of pods per cycle).
+    Over-quota is UnschedulableAndUnresolvable: evicting node-capacity
+    victims cannot raise a namespace's quota, so no preemption dry-run
+    fans out.
+  * Reserve: the authoritative charge, atomically with the assume on the
+    single-threaded scheduling loop — usage can never oversubscribe ``hard``
+    because the charge IS the admission. Unreserve releases.
+
+Release (unreserve, bound-pod delete) fires a targeted quota-release queue
+move for the namespace: only gated/quota-failed pods whose request now fits
+(tracked against a shadow ledger, so one freed slot admits one pod, not the
+whole parked backlog) re-enter the queue.
+
+The ledger is in-memory and seeded per namespace from the store's bound
+pods on first touch, so a restarted scheduler resumes with true usage.
+
+Fair share: the queue's deficit-round-robin layer asks ``weight_for(ns)``
+— namespaces with a SchedulingQuota are tenants served in proportion to
+``spec.weight``; namespaces without one share the default bucket.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ...api.types import (
+    Pod,
+    QUOTA_CLAIMS,
+    QUOTA_CPU,
+    QUOTA_MEMORY,
+    QUOTA_PODS,
+    SchedulingQuota,
+)
+from ...api import resource as resource_api
+from ..interface import (
+    CycleState,
+    OK,
+    PreEnqueuePlugin,
+    PreFilterPlugin,
+    PreFilterResult,
+    ReservePlugin,
+    Status,
+)
+from ..types import ALL, ClusterEvent, SCHEDULING_QUOTA
+from . import names
+
+ERR_REASON_QUOTA_EXCEEDED = "QuotaExceeded"
+
+
+def pod_quota_request(pod: Pod) -> Dict[str, int]:
+    """The SchedulingQuota dimensions one pod consumes (canonical ints,
+    api/resource.py): max(containers)+init+overhead cpu/memory via the
+    cached resource_request(), one pod slot, and its resource.k8s.io claim
+    count."""
+    req = pod.resource_request()
+    return {
+        QUOTA_PODS: 1,
+        QUOTA_CPU: req.get(resource_api.CPU, 0),
+        QUOTA_MEMORY: req.get(resource_api.MEMORY, 0),
+        QUOTA_CLAIMS: len(pod.spec.resource_claims),
+    }
+
+
+def quota_precheck_status(fwk, pod: Pod) -> Optional[Status]:
+    """Host-side stand-in for QuotaAdmission's PreFilter on the batched
+    paths (the compiled device program does not model namespace quota):
+    returns the non-success Status the pod should fail with before
+    dispatch, or None when it may ride the batch."""
+    plugin = fwk.plugin(names.QUOTA_ADMISSION)
+    if plugin is None:
+        return None
+    _r, st = plugin.pre_filter(CycleState(), pod)
+    return None if st.is_success() else st
+
+
+class QuotaAdmission(PreEnqueuePlugin, PreFilterPlugin, ReservePlugin):
+    def __init__(self, client=None, metrics=None):
+        self.client = client
+        self.metrics = metrics
+        # ns -> dim -> charged usage (the authoritative scheduler-side ledger)
+        self._usage: Dict[str, Dict[str, int]] = {}
+        # pod key -> (ns, charge vector): exactly-once charge accounting
+        # across Reserve, external-bind observation, and release paths
+        self._charged: Dict[str, Tuple[str, Dict[str, int]]] = {}
+        self._seeded: Set[str] = set()
+        # pods already counted as a "rejected" admission decision — the
+        # decisions counter records pod-level outcomes, and _fits_status
+        # re-runs on every PreEnqueue wave / PreFilter / release probe
+        self._rejected: Set[str] = set()
+        # ns -> [SchedulingQuota] index + per-ns (hard, weight) memo over
+        # the cluster quota map: quotas_for sits on the queue-push and DRR
+        # rotation hot paths, where an O(all-quotas) scan per call is not
+        # acceptable. Invalidated by SchedulingQuota store events (and by
+        # quota-map size changes, for event-less clients).
+        self._quota_index: Optional[Dict[str, List[SchedulingQuota]]] = None
+        self._index_len = -1
+        self._derived: Dict[str, Tuple[Optional[Dict[str, int]],
+                                       Optional[float]]] = {}
+        if client is not None and hasattr(client, "add_event_handler"):
+            client.add_event_handler(
+                "SchedulingQuota", lambda _e, _o, _n: self.quotas_changed())
+        # targeted quota-release queue move, wired by the Scheduler:
+        # fn(namespace) -> pods moved
+        self.on_release: Optional[Callable[[str], int]] = None
+
+    def name(self) -> str:
+        return names.QUOTA_ADMISSION
+
+    def events_to_register(self) -> List[ClusterEvent]:
+        # the quota-release move and user edits to SchedulingQuota objects
+        # (raising a cap must wake the namespace's gated pods)
+        return [ClusterEvent(SCHEDULING_QUOTA, ALL, "SchedulingQuotaChange")]
+
+    # ------------------------------------------------------------- quota view
+
+    def _quota_map(self) -> Dict[str, SchedulingQuota]:
+        if self.client is None:
+            return {}
+        m = getattr(self.client, "scheduling_quotas", None)
+        if m is not None:
+            return m
+        try:
+            return self.client.snapshot_map("SchedulingQuota")
+        except Exception:  # noqa: BLE001 — clients without the kind: no quota
+            return {}
+
+    def quotas_changed(self) -> None:
+        """Invalidate the ns index + derived memos (SchedulingQuota event)."""
+        self._quota_index = None
+        self._derived.clear()
+
+    def _index(self) -> Dict[str, List[SchedulingQuota]]:
+        m = self._quota_map()
+        if self._quota_index is None or len(m) != self._index_len:
+            idx: Dict[str, List[SchedulingQuota]] = {}
+            for q in m.values():
+                idx.setdefault(q.meta.namespace, []).append(q)
+            self._quota_index = idx
+            self._index_len = len(m)
+            self._derived.clear()
+        return self._quota_index
+
+    def quotas_for(self, ns: str) -> List[SchedulingQuota]:
+        return self._index().get(ns, [])
+
+    def _derived_for(self, ns: str) -> Tuple[Optional[Dict[str, int]],
+                                             Optional[float]]:
+        """(effective hard caps, fair-share weight) for a namespace, memoized
+        until the quota map changes — weight_for runs on every queue push and
+        every DRR rotation visit."""
+        self._index()  # revalidate (clears _derived on rebuild)
+        d = self._derived.get(ns)
+        if d is None:
+            quotas = self.quotas_for(ns)
+            if not quotas:
+                d = (None, None)
+            else:
+                hard: Dict[str, int] = {}
+                for q in quotas:
+                    for dim, cap in q.hard.items():
+                        hard[dim] = min(hard[dim], cap) if dim in hard else cap
+                d = (hard, float(max(q.weight for q in quotas)))
+            self._derived[ns] = d
+        return d
+
+    def effective_hard(self, ns: str) -> Optional[Dict[str, int]]:
+        """Per-dimension caps for a namespace (min across its quota objects;
+        every matching quota must admit, exactly like core ResourceQuota).
+        None when the namespace has no SchedulingQuota — unlimited."""
+        return self._derived_for(ns)[0]
+
+    def weight_for(self, ns: str) -> Optional[float]:
+        """Fair-share weight for the queue's DRR layer: max across the
+        namespace's quota objects; None = not a tenant (default bucket)."""
+        return self._derived_for(ns)[1]
+
+    def share_ledger(self, other: "QuotaAdmission") -> None:
+        """Alias this instance's ledger state onto ``other``'s. Quota usage
+        is cluster-level per-namespace state: in a multi-profile scheduler
+        every profile's QuotaAdmission instance must charge and read ONE
+        ledger, or charges split across per-profile ledgers and the release
+        wave / fair-share weights read one that undercounts usage."""
+        self._usage = other._usage
+        self._charged = other._charged
+        self._seeded = other._seeded
+        self._rejected = other._rejected
+
+    # ---------------------------------------------------------------- ledger
+
+    def _ensure_seeded(self, ns: str) -> None:
+        """First touch of a namespace: charge every already-bound pod so a
+        restarted scheduler resumes with true usage (the ledger analog of
+        Coscheduling's bound-count seed)."""
+        if ns in self._seeded:
+            return
+        self._seeded.add(ns)
+        pods = getattr(self.client, "pods", None) if self.client else None
+        if pods is None:
+            return
+        for pod in list(pods.values()):
+            if pod.meta.namespace == ns and pod.spec.node_name:
+                self._charge(pod)
+
+    def usage(self, ns: str) -> Dict[str, int]:
+        self._ensure_seeded(ns)
+        return dict(self._usage.get(ns, {}))
+
+    def _violated(self, hard: Dict[str, int], used: Dict[str, int],
+                  req: Dict[str, int]) -> Optional[str]:
+        for dim, cap in hard.items():
+            if used.get(dim, 0) + req.get(dim, 0) > cap:
+                return dim
+        return None
+
+    def _fits_status(self, pod: Pod) -> Optional[Status]:
+        """None when the pod fits its namespace's quota headroom (or is
+        already charged / unquota'd); else the typed QuotaExceeded status."""
+        ns = pod.meta.namespace
+        hard = self.effective_hard(ns)
+        if hard is None or pod.key() in self._charged:
+            return None
+        self._ensure_seeded(ns)
+        dim = self._violated(hard, self._usage.get(ns, {}),
+                             pod_quota_request(pod))
+        if dim is None:
+            # headroom appeared: a later over-quota verdict is a NEW decision
+            self._rejected.discard(pod.key())
+            return None
+        # pod-level decision counting: _fits_status re-runs on every
+        # PreEnqueue wave, PreFilter and release probe — only the first
+        # rejection of an over-quota episode is an admission outcome
+        if self.metrics is not None and pod.key() not in self._rejected:
+            self._rejected.add(pod.key())
+            self.metrics.quota_decisions.inc(ns, "rejected")
+        # Unresolvable: node-capacity preemption cannot raise a namespace
+        # quota, so the failure must not fan out a preemption dry-run. The
+        # quota-release event (not a node event) wakes the pod.
+        return Status.unresolvable(
+            f'{ERR_REASON_QUOTA_EXCEEDED}: namespace "{ns}" over quota '
+            f'on {dim}')
+
+    def _charge(self, pod: Pod) -> bool:
+        key = pod.key()
+        if key in self._charged:
+            return False
+        ns = pod.meta.namespace
+        req = pod_quota_request(pod)
+        used = self._usage.setdefault(ns, {})
+        for dim, v in req.items():
+            used[dim] = used.get(dim, 0) + v
+        self._charged[key] = (ns, req)
+        self._rejected.discard(key)
+        self._sync_metrics(ns)
+        return True
+
+    def _release(self, pod_key: str) -> Optional[str]:
+        entry = self._charged.pop(pod_key, None)
+        if entry is None:
+            return None
+        ns, req = entry
+        used = self._usage.setdefault(ns, {})
+        for dim, v in req.items():
+            used[dim] = max(used.get(dim, 0) - v, 0)
+        self._sync_metrics(ns)
+        return ns
+
+    def _sync_metrics(self, ns: str) -> None:
+        if self.metrics is None:
+            return
+        used = self._usage.get(ns, {})
+        for dim in (QUOTA_PODS, QUOTA_CPU, QUOTA_MEMORY, QUOTA_CLAIMS):
+            self.metrics.quota_usage.set(ns, dim, value=used.get(dim, 0))
+
+    def shadow_admitter(self, ns: str) -> Callable[[Pod], Optional[Status]]:
+        """A gate for one quota-release wave: admitted pods charge a SHADOW
+        copy of the namespace's usage, so freeing one pod slot re-admits one
+        gated pod instead of the whole parked backlog (each would otherwise
+        pass an independent headroom check and thrash back)."""
+        self._ensure_seeded(ns)
+        shadow = dict(self._usage.get(ns, {}))
+        hard = self.effective_hard(ns)
+
+        def admit(pod: Pod) -> Optional[Status]:
+            if hard is None or pod.meta.namespace != ns:
+                return self.pre_enqueue_status(pod)
+            req = pod_quota_request(pod)
+            dim = self._violated(hard, shadow, req)
+            if dim is not None:
+                return Status.unresolvable(
+                    f'{ERR_REASON_QUOTA_EXCEEDED}: namespace "{ns}" over '
+                    f'quota on {dim}').with_plugin(self.name())
+            for d, v in req.items():
+                shadow[d] = shadow.get(d, 0) + v
+            return None
+
+        return admit
+
+    # ------------------------------------------------------------ pre-enqueue
+
+    def pre_enqueue_status(self, pod: Pod) -> Optional[Status]:
+        st = self._fits_status(pod)
+        return None if st is None else st.with_plugin(self.name())
+
+    def pre_enqueue(self, pod: Pod) -> Status:
+        st = self._fits_status(pod)
+        return OK if st is None else st
+
+    # ------------------------------------------------------------- pre-filter
+
+    def pre_filter(self, state: CycleState, pod: Pod) -> Tuple[Optional[PreFilterResult], Status]:
+        st = self._fits_status(pod)
+        return None, (OK if st is None else st)
+
+    # ---------------------------------------------------------------- reserve
+
+    def reserve(self, state: CycleState, pod: Pod, node_name: str) -> Status:
+        """The authoritative charge — atomic with the assume on the
+        single-threaded loop, so ledger usage never exceeds ``hard``."""
+        ns = pod.meta.namespace
+        hard = self.effective_hard(ns)
+        if hard is None:
+            return OK
+        st = self._fits_status(pod)
+        if st is not None:
+            return st
+        self._charge(pod)
+        if self.metrics is not None:
+            self.metrics.quota_decisions.inc(ns, "admitted")
+        return OK
+
+    def unreserve(self, state: CycleState, pod: Pod, node_name: str) -> None:
+        ns = self._release(pod.key())
+        if ns is not None:
+            self._fire_release(ns)
+
+    # ------------------------------------------------------------- lifecycle
+    # (driven by the Scheduler's pod event hooks, like Coscheduling's)
+
+    def pod_observed_bound(self, pod: Pod) -> None:
+        """A pod bound outside this scheduler's Reserve (external binder,
+        peer replica, store replay) still consumes quota."""
+        if self.effective_hard(pod.meta.namespace) is None:
+            return
+        self._ensure_seeded(pod.meta.namespace)
+        self._charge(pod)
+
+    def pod_deleted(self, pod: Pod) -> None:
+        self._rejected.discard(pod.key())
+        ns = self._release(pod.key())
+        if ns is not None:
+            self._fire_release(ns)
+
+    def _fire_release(self, ns: str) -> None:
+        if self.on_release is not None and self.quotas_for(ns):
+            self.on_release(ns)
+
+    # ----------------------------------------------------------------- debug
+
+    def dump(self) -> dict:
+        """/debug/quota body: per-namespace caps, ledger usage, weight."""
+        out = {}
+        for q in list(self._quota_map().values()):
+            ns = q.meta.namespace
+            out[ns] = {
+                "hard": self.effective_hard(ns) or {},
+                "used": self.usage(ns),
+                "weight": self.weight_for(ns),
+                "charged_pods": sum(1 for _k, (n, _r) in self._charged.items()
+                                    if n == ns),
+            }
+        return out

@@ -16,6 +16,7 @@ from .plugins.coscheduling import Coscheduling
 from .plugins.defaultbinder import DefaultBinder
 from .plugins.defaultpreemption import DefaultPreemption
 from .plugins.dynamicresources import DynamicResources
+from .plugins.quota import QuotaAdmission
 from .plugins.imagelocality import ImageLocality
 from .plugins.interpodaffinity import InterPodAffinity
 from .plugins.nodeaffinity import NodeAffinity
@@ -79,6 +80,8 @@ def in_tree_registry() -> Dict[str, Factory]:
         names.VOLUME_BINDING: lambda h, a: VolumeBinding(client=h.get("client")),
         names.DYNAMIC_RESOURCES: lambda h, a: DynamicResources(
             client=h.get("client"), metrics=h.get("metrics")),
+        names.QUOTA_ADMISSION: lambda h, a: QuotaAdmission(
+            client=h.get("client"), metrics=h.get("metrics")),
         names.COSCHEDULING: lambda h, a: Coscheduling(
             client=h.get("client"), metrics=h.get("metrics"),
             waiting=h.get("waiting_pods"), now_fn=h.get("now_fn"),
@@ -102,8 +105,14 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
     # groupless pods its key degrades EXACTLY to PrioritySort's
     # (-priority, queue timestamp) order
     "queue_sort": [(names.COSCHEDULING, 0)],
+    # queue-admission gate: over-quota pods park GATED without spending a
+    # scheduling cycle (upstream PreEnqueue semantics; SchedulingQueue runs
+    # the point on every transition toward activeQ)
+    "pre_enqueue": [(names.QUOTA_ADMISSION, 0)],
     "pre_filter": [
-        # first: the gang quorum gate is the cheapest possible fast-fail
+        # first: quota then gang quorum — the two cheapest fast-fails, both
+        # namespace-level (no per-node work behind them)
+        (names.QUOTA_ADMISSION, 0),
         (names.COSCHEDULING, 0),
         (names.NODE_AFFINITY, 0),
         (names.NODE_PORTS, 0),
@@ -146,8 +155,11 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.POD_TOPOLOGY_SPREAD, 2),
         (names.TAINT_TOLERATION, 3),
     ],
-    "reserve": [(names.VOLUME_BINDING, 0), (names.DYNAMIC_RESOURCES, 0),
-                (names.COSCHEDULING, 0)],
+    # QuotaAdmission first: the charge is the cheapest reserve step and its
+    # rejection must precede volume/claim reservations (its Unreserve runs
+    # last in the reverse teardown, releasing the charge after them)
+    "reserve": [(names.QUOTA_ADMISSION, 0), (names.VOLUME_BINDING, 0),
+                (names.DYNAMIC_RESOURCES, 0), (names.COSCHEDULING, 0)],
     "permit": [(names.COSCHEDULING, 0)],
     "pre_bind": [(names.VOLUME_BINDING, 0)],
     "bind": [(names.DEFAULT_BINDER, 0)],

@@ -121,6 +121,7 @@ def _instrument_point(point: str):
 # gate MultiPoint-expanded config entries onto real implementations)
 _POINT_METHODS = {
     "queue_sort": "less",
+    "pre_enqueue": "pre_enqueue",
     "pre_filter": "pre_filter",
     "filter": "filter",
     "post_filter": "post_filter",
@@ -240,6 +241,21 @@ class Framework:
                 return plugin.sort_key
             return lambda qp: (-qp.pod.spec.priority, qp.timestamp)
         return lambda qp: qp.timestamp
+
+    # --------------------------------------------------------------- pre-enqueue
+
+    def run_pre_enqueue_plugins(self, pod: Pod) -> Status:
+        """Queue-admission gate (runtime/framework.go RunPreEnqueuePlugins):
+        first non-success status wins and the pod parks GATED. Called on
+        every queue transition toward activeQ — deliberately outside the
+        extension-point instrumentation (no CycleState exists yet and a
+        histogram write per queue push would sit on the informer hot path).
+        """
+        for plugin, _w in self.points.get("pre_enqueue", []):
+            status = plugin.pre_enqueue(pod)
+            if not status.is_success():
+                return status.with_plugin(plugin.name())
+        return OK
 
     # --------------------------------------------------------------- prefilter
 

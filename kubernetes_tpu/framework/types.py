@@ -281,6 +281,7 @@ RESOURCE_CLAIM = GVK("ResourceClaim")
 RESOURCE_CLASS = GVK("ResourceClass")
 POD_SCHEDULING_CONTEXT = GVK("PodSchedulingContext")
 POD_GROUP = GVK("PodGroup")
+SCHEDULING_QUOTA = GVK("SchedulingQuota")
 WILDCARD = GVK("*")
 
 

@@ -199,6 +199,33 @@ class SchedulerMetrics:
             "Batch flight-recorder events by type.",
             ["type"],
         ))
+        # multi-tenant admission (SchedulingQuota + QuotaAdmission plugin):
+        # the scheduler-side ledger per (namespace, dimension), admission
+        # decisions at the gate/Reserve, gated pods woken by targeted
+        # quota-release moves, and the fair-share dequeuer's tenant turns
+        # (the denominator of the quota-weighted fairness bound)
+        self.quota_usage = r.register(Gauge(
+            "scheduler_quota_usage",
+            "Scheduler-side quota ledger usage by namespace and dimension.",
+            ["namespace", "resource"],
+        ))
+        self.quota_decisions = r.register(Counter(
+            "scheduler_quota_admission_decisions_total",
+            "Pod-level quota admission outcomes by namespace "
+            "(admitted at Reserve charge; rejected once per over-quota "
+            "episode, not per re-check).",
+            ["namespace", "result"],
+        ))
+        self.quota_released_pods = r.register(Counter(
+            "scheduler_quota_released_pods_total",
+            "Gated pods re-admitted by targeted quota-release queue moves.",
+            ["namespace"],
+        ))
+        self.fair_share_turns = r.register(Counter(
+            "scheduler_fair_share_turns_total",
+            "Deficit-round-robin dequeue turns served per tenant namespace.",
+            ["namespace"],
+        ))
 
         # unschedulable_pods bookkeeping: gauge value = number of pods
         # CURRENTLY unschedulable attributed to each (plugin, profile); a

@@ -292,18 +292,27 @@ class Runner:
 
     def __init__(self, scheduler_config: Optional[dict] = None, backend: str = "oracle",
                  batch_size: int = 128, seed: int = 0,
-                 collect_metrics: Optional[List[str]] = None):
+                 collect_metrics: Optional[List[str]] = None,
+                 now_fn: Optional[Callable[[], float]] = None,
+                 comparer_every_n: int = 0):
         self.store = ClusterStore()
         self.backend = backend
+        # injectable clock (soak workloads drive a FakeClock so queue-wait
+        # measurement is deterministic in tier-1); None = wall monotonic
+        self.now_fn = now_fn or time.monotonic
         # metricsCollector scrape list (None = the default per-phase set;
         # pass an empty list to disable the extra DataItems)
         self.collect_metrics = (DEFAULT_COLLECTED_METRICS
                                 if collect_metrics is None else collect_metrics)
+        clock_kw = {"now_fn": now_fn} if now_fn is not None else {}
         cfg = load_config(scheduler_config)
         if backend == "tpu":
             from ..backend.tpu_scheduler import TPUScheduler
 
-            self.scheduler = TPUScheduler(self.store, batch_size=batch_size, seed=seed)
+            self.scheduler = TPUScheduler(self.store, batch_size=batch_size,
+                                          seed=seed,
+                                          comparer_every_n=comparer_every_n,
+                                          **clock_kw)
         elif backend == "wire":
             # transport-inclusive mode: the batched device service behind a
             # real localhost HTTP socket (SURVEY §5.8 hop 6)
@@ -313,7 +322,7 @@ class Runner:
             self._server, port = serve(self._service)
             self.scheduler = WireScheduler(
                 self.store, endpoint=f"http://127.0.0.1:{port}",
-                batch_size=batch_size, seed=seed)
+                batch_size=batch_size, seed=seed, **clock_kw)
         elif backend == "grpc":
             # the hardened transport: gRPC framing + template-deduped pod
             # batches (backend/grpc_service.py)
@@ -325,9 +334,11 @@ class Runner:
             self._grpc = True
             self.scheduler = WireScheduler(
                 self.store, endpoint=f"127.0.0.1:{port}",
-                batch_size=batch_size, seed=seed, transport="grpc")
+                batch_size=batch_size, seed=seed, transport="grpc",
+                **clock_kw)
         else:
-            self.scheduler = scheduler_from_config(self.store, cfg, seed=seed)
+            self.scheduler = scheduler_from_config(self.store, cfg, seed=seed,
+                                                   **clock_kw)
         self.data_items: List[DataItem] = []
         self._pod_counter = 0
         # resource.k8s.io side-car loop: the resourceclaim controller that
@@ -487,6 +498,20 @@ class Runner:
                 meta=ObjectMeta(name=f"{prefix}-{i}", namespace="",
                                 labels=dict(labels or {}))))
 
+    def create_quota(self, namespace: str, hard: dict, weight: int = 1,
+                     name: str = "quota") -> None:
+        """createQuota op: the namespace's SchedulingQuota (plus the
+        Namespace object itself) — the tenant contract the QuotaAdmission
+        plugin and the queue's fair-share layer read."""
+        from ..api.types import Namespace, ObjectMeta, SchedulingQuota
+
+        if namespace not in self.store.namespaces:
+            self.store.create_namespace(Namespace(
+                meta=ObjectMeta(name=namespace, namespace="")))
+        self.store.create_object("SchedulingQuota", SchedulingQuota(
+            meta=ObjectMeta(name=name, namespace=namespace),
+            hard=dict(hard), weight=int(weight)))
+
     def barrier(self, timeout_s: float = 300.0) -> None:
         """Wait (drive) until every pending pod has been attempted
         (scheduler_perf_test.go:518 barrierOp)."""
@@ -587,6 +612,199 @@ class Runner:
         self.data_items.extend(mcol.collect())
         return summary
 
+    # ---- multi-tenant soak phase ----
+
+    def _quota_plugin(self):
+        # the Scheduler owns the profile→plugin lookup (shared ledger, so
+        # any profile's instance is THE ledger); don't re-implement it here
+        lookup = getattr(self.scheduler, "_quota_plugin", None)
+        return lookup() if lookup is not None else None
+
+    def soak_phase(self, rounds: int = 8, mix=(), churn_frac: float = 0.0,
+                   flap: Optional[dict] = None, cycles_per_round: int = 40,
+                   tick_s: float = 0.0, label: str = "SchedulingSoak",
+                   collector_interval: float = 1.0) -> Dict[str, float]:
+        """soakPhase op — the compressed multi-tenant production mix
+        (ISSUE 8 tentpole e): per round, every ``mix`` entry lands its
+        arrivals (plain pods, gangs, DRA claims, preemptors — any
+        createPods param set, plus ``namespace``/``count``/``every``),
+        the scheduler drives up to ``cycles_per_round`` cycles, and
+        ``churn_frac`` of each tenant's soak-bound pods are deleted
+        (freeing quota + node capacity → the targeted release moves).
+        ``flap = {"round": r, "batches": n}`` scripts one device flap: the
+        next ``n`` batch commits die through the real relay-death path
+        (tpu backend; no-op elsewhere).
+
+        Evidence out (DataItems): SchedulingThroughput; attempt-latency
+        percentiles; one ``SoakTenant`` item per namespace (admitted count,
+        fair-share weight, queue-wait p50/p99 on the runner clock); one
+        ``SoakInvariants`` item (quota-oversubscription violations sampled
+        every cycle, degraded-seconds delta, breaker state, flap batches,
+        comparer checks/mismatches). Assertions live in the tests — the
+        harness measures."""
+        quota_plugin = self._quota_plugin()
+        sched = self.scheduler
+        tenants = sorted({str(m["namespace"]) for m in mix})
+        created_at: Dict[str, float] = {}
+        waits: Dict[str, List[float]] = {ns: [] for ns in tenants}
+        admitted: Dict[str, int] = {ns: 0 for ns in tenants}
+        bound_seen = {p.key() for p in self.store.pods.values()
+                      if p.spec.node_name}
+        soak_bound: Dict[str, List[str]] = {ns: [] for ns in tenants}
+        oversub = 0
+        flap_left = 0
+        flap_consumed = 0
+
+        def note_new_bindings() -> None:
+            for p in self.store.pods.values():
+                if not p.spec.node_name or p.key() in bound_seen:
+                    continue
+                bound_seen.add(p.key())
+                ns = p.meta.namespace
+                t0 = created_at.get(p.key())
+                if ns in admitted and t0 is not None:
+                    admitted[ns] += 1
+                    waits[ns].append(self.now_fn() - t0)
+                    soak_bound[ns].append(p.key())
+
+        def check_oversubscription() -> int:
+            """Quota ledger vs hard caps, every tenant, every dimension —
+            the zero-oversubscription invariant sampled once per cycle."""
+            if quota_plugin is None:
+                return 0
+            bad = 0
+            for ns in tenants:
+                hard = quota_plugin.effective_hard(ns)
+                if not hard:
+                    continue
+                used = quota_plugin.usage(ns)
+                bad += sum(1 for dim, cap in hard.items()
+                           if used.get(dim, 0) > cap)
+            return bad
+
+        def relay_fault(_op: str):
+            nonlocal flap_left, flap_consumed
+            if flap_left <= 0:
+                sched.relay_fault_fn = None
+                return None
+            flap_left -= 1
+            flap_consumed += 1
+            return RuntimeError("scripted device flap (soak)")
+
+        def drive_cycle() -> bool:
+            if self.backend in ("tpu", "wire", "grpc"):
+                return sched.schedule_batch_cycle() > 0
+            return sched.schedule_one()
+
+        degraded0 = sched.smetrics.degraded_seconds.labels()
+        hist = sched.smetrics.scheduling_attempt_duration
+        from ..config.types import DEFAULT_SCHEDULER_NAME
+
+        profile = DEFAULT_SCHEDULER_NAME
+        lat_snaps = {res: hist.snapshot(res, profile)
+                     for res in ("scheduled", "unschedulable")}
+        col = ThroughputCollector(
+            lambda: sched.metrics["scheduled"], interval=collector_interval)
+        col.start(time.monotonic())
+        tick = getattr(self.now_fn, "advance", None) if tick_s else None
+
+        for r in range(rounds):
+            for mi, m in enumerate(mix):
+                if r % int(m.get("every", 1)):
+                    continue
+                params = {k: v for k, v in m.items()
+                          if k not in ("count", "every")}
+                prefix = f"{m.get('prefix', params['namespace'])}-m{mi}r{r}"
+                params.pop("prefix", None)
+                for j in range(int(m["count"])):
+                    p = self._make_pod(
+                        prefix, dict(params, _gang_ordinal=j)
+                        if params.get("gang_size") else params)
+                    self.store.create_pod(p)
+                    created_at[p.key()] = self.now_fn()
+                    self._pod_counter += 1
+            self._pump_dra()
+            if (flap is not None and r == int(flap.get("round", rounds // 2))
+                    and hasattr(sched, "relay_fault_fn")):
+                flap_left = int(flap.get("batches", 3))
+                sched.relay_fault_fn = relay_fault
+            for _c in range(cycles_per_round):
+                progressed = drive_cycle()
+                if tick is not None:
+                    tick(tick_s)
+                note_new_bindings()
+                oversub += check_oversubscription()
+                col.maybe_sample(time.monotonic())
+                if not progressed:
+                    sched.queue.flush_backoff_completed()
+                    if len(sched.queue) == 0:
+                        break
+            if churn_frac > 0.0:
+                for ns in tenants:
+                    keys = soak_bound[ns]
+                    n_churn = int(len(keys) * churn_frac)
+                    for key in keys[:n_churn]:
+                        if self.store.get_pod(key) is not None:
+                            self.store.delete_pod(key)
+                    soak_bound[ns] = keys[n_churn:]
+                note_new_bindings()
+                oversub += check_oversubscription()
+        drain = getattr(sched, "_drain_inflight", None)
+        if drain is not None:
+            drain()  # land stragglers before the final accounting
+        note_new_bindings()
+        oversub += check_oversubscription()
+        col.finish(time.monotonic())
+
+        def pct(vals: List[float], q: float) -> float:
+            if not vals:
+                return 0.0
+            s = sorted(vals)
+            return s[min(len(s) - 1, max(0, int(q * len(s)) - 1))]
+
+        summary = col.summary()
+        self.data_items.append(DataItem(
+            data=summary, unit="pods/s", labels={"Name": label}))
+        for res, snap in lat_snaps.items():
+            if hist.count_since(snap, res, profile) == 0:
+                continue
+            self.data_items.append(DataItem(
+                data={"Perc50": hist.percentile_since(snap, 0.50, res, profile),
+                      "Perc90": hist.percentile_since(snap, 0.90, res, profile),
+                      "Perc99": hist.percentile_since(snap, 0.99, res, profile)},
+                unit="s",
+                labels={"Name": "scheduling_attempt_duration_seconds",
+                        "result": res}))
+        pending = sched.queue.pending_pods()
+        for ns in tenants:
+            weight = (quota_plugin.weight_for(ns)
+                      if quota_plugin is not None else None)
+            self.data_items.append(DataItem(
+                data={"Admitted": float(admitted[ns]),
+                      "Weight": float(weight or 0.0),
+                      "WaitP50": pct(waits[ns], 0.50),
+                      "WaitP99": pct(waits[ns], 0.99)},
+                unit="", labels={"Name": "SoakTenant", "namespace": ns}))
+        breaker = getattr(sched, "relay_breaker", None)
+        from ..backend.circuit import STATE_VALUES
+
+        invariants = {
+            "OversubscriptionViolations": float(oversub),
+            "DegradedSeconds":
+                float(sched.smetrics.degraded_seconds.labels() - degraded0),
+            "BreakerState": float(STATE_VALUES.get(
+                getattr(breaker, "state", None), -1.0)),
+            "FlapBatches": float(flap_consumed),
+            "ComparerChecks": float(getattr(sched, "comparer_checks", 0)),
+            "ComparerMismatches":
+                float(getattr(sched, "comparer_mismatches", 0)),
+            "PendingAtEnd": float(sum(pending.values())),
+            "GatedAtEnd": float(pending.get("gated", 0)),
+        }
+        self.data_items.append(DataItem(
+            data=invariants, unit="", labels={"Name": "SoakInvariants"}))
+        return invariants
+
     # ---- config-driven entry ----
 
     def run_ops(self, ops: List[dict]) -> None:
@@ -602,6 +820,10 @@ class Runner:
                 self.measure(**kwargs)
             elif kind == "createNamespaces":
                 self.create_namespaces(**kwargs)
+            elif kind == "createQuota":
+                self.create_quota(**kwargs)
+            elif kind == "soakPhase":
+                self.soak_phase(**kwargs)
             elif kind == "barrier":
                 self.barrier(**kwargs)
             elif kind == "churn":

@@ -424,6 +424,66 @@ def required_anti_affinity_ns_selector(nodes=5000, init_namespaces=100,
             "ops": ops}
 
 
+# (tenant, DRR weight) for the soak's three asymmetric namespaces —
+# quota caps scale with the weight, so the quota-weighted fair share and
+# the DRR service share agree (the fairness bound the soak test asserts)
+SOAK_TENANTS = (("soak-a", 4), ("soak-b", 2), ("soak-c", 1))
+
+
+def scheduling_soak(nodes=1000, rounds=8, scale=24, cycles_per_round=120,
+                    gangs=True, claims=True, preempt=True, flap=True,
+                    tick_s=0.05, churn_frac=0.25) -> dict:
+    """SchedulingSoak — the compressed multi-tenant production mix (ISSUE 8
+    tentpole e): three namespaces with asymmetric SchedulingQuotas (weights
+    4/2/1, pod caps proportional), each submitting MORE than its headroom
+    every round so the QuotaAdmission gate engages, plus per-round churn
+    that frees quota (driving the targeted release moves). The arrival mix
+    layers gangs (soak-a), DRA claims (soak-b), and high-priority
+    preemptors (soak-c) over the plain-pod base, and ``flap`` scripts one
+    device flap mid-soak (tpu backend; no-op on oracle).
+
+    ``scale`` is the per-weight-unit pod cap: soak-a holds ≤ 4·scale pods
+    concurrently, soak-b ≤ 2·scale, soak-c ≤ scale. Per-round arrivals are
+    ~weight·scale/2 per tenant, so after two rounds every ledger is at its
+    cap and admission follows churn-freed headroom — which is proportional
+    to the cap, hence to the weight: the quota-weighted fairness bound
+    is measurable from the SoakTenant DataItems."""
+    claim = {"claims": [{"name": "accel", "template": "soak-claim",
+                         "class": "tpu.example.com",
+                         "class_selectors": {"tpu.dev/gen": "v5"},
+                         "selectors": {"tpu.dev/cores": ">=8"}}]}
+    base = {"req": {"cpu": "100m", "memory": "500Mi"}}
+    node_op = {"opcode": "createNodes", "count": nodes, "zones": 10,
+               "capacity": {"cpu": "4", "memory": "16Gi", "pods": 32}}
+    if claims:
+        node_op["device_attributes"] = {"tpu.dev/cores": [8, 16],
+                                        "tpu.dev/gen": ["v5", "v5", "v4", "v5"]}
+    ops = [node_op]
+    mix = []
+    for ns, w in SOAK_TENANTS:
+        ops.append({"opcode": "createQuota", "namespace": ns, "weight": w,
+                    "hard": {"pods": w * scale,
+                             "requests.cpu": w * scale * 1000,
+                             "claims": w * scale}})
+        mix.append({"namespace": ns, "count": max(w * scale // 2, 2), **base})
+    if gangs:
+        mix.append({"namespace": "soak-a", "count": 8, "gang_size": 8,
+                    "every": 2, "prefix": "gang", **base})
+    if claims:
+        mix.append({"namespace": "soak-b", "count": max(scale // 2, 2),
+                    "prefix": "claim", **base, **claim})
+    if preempt:
+        mix.append({"namespace": "soak-c", "count": 2, "every": 2,
+                    "prefix": "preemptor", "priority": 100,
+                    "req": {"cpu": "2", "memory": "4Gi"}})
+    ops.append({"opcode": "soakPhase", "rounds": rounds, "mix": mix,
+                "churn_frac": churn_frac, "cycles_per_round": cycles_per_round,
+                "tick_s": tick_s,
+                "flap": ({"round": rounds // 2, "batches": 3}
+                         if flap else None)})
+    return {"name": f"SchedulingSoak/{nodes}Nodes", "ops": ops}
+
+
 TEST_CASES = {
     "SchedulingBasic": scheduling_basic,
     "SchedulingPodAntiAffinity": scheduling_pod_anti_affinity,
@@ -435,6 +495,7 @@ TEST_CASES = {
     "SchedulingCSIPVs": scheduling_csi_pvs,
     "SchedulingDRA": scheduling_dra,
     "SchedulingGangs": scheduling_gangs,
+    "SchedulingSoak": scheduling_soak,
     "MixedSchedulingBasePod": mixed_scheduling_base_pod,
     "TopologySpreading": topology_spreading,
     "Unschedulable": unschedulable,

@@ -10,6 +10,7 @@ from ..framework.types import (
     POD,
     PV,
     PVC,
+    SCHEDULING_QUOTA,
     STORAGE_CLASS,
     UPDATE,
     UPDATE_NODE_ALLOCATABLE,
@@ -32,6 +33,12 @@ POD_UPDATE = ClusterEvent(POD, UPDATE, "AssignedPodUpdate")
 NODE_ALLOCATABLE_CHANGE = ClusterEvent(NODE, UPDATE_NODE_ALLOCATABLE, "NodeAllocatableChange")
 NODE_LABEL_CHANGE = ClusterEvent(NODE, UPDATE_NODE_LABEL, "NodeLabelChange")
 NODE_TAINT_CHANGE = ClusterEvent(NODE, UPDATE_NODE_TAINT, "NodeTaintChange")
+# namespace quota headroom opened (a charged pod released capacity, or the
+# SchedulingQuota object itself grew): wakes ONLY pods gated/failed on the
+# QuotaAdmission plugin — and the queue's pre-enqueue re-check keeps pods in
+# still-over-quota namespaces parked, so sustained over-quota load cannot
+# thrash the active queue
+QUOTA_RELEASE = ClusterEvent(SCHEDULING_QUOTA, ALL, "QuotaReleased")
 PVC_ADD = ClusterEvent(PVC, ADD, "PvcAdd")
 PV_ADD = ClusterEvent(PV, ADD, "PvAdd")
 STORAGE_CLASS_ADD = ClusterEvent(STORAGE_CLASS, ADD, "StorageClassAdd")

@@ -3,12 +3,28 @@
 activeQ        heap ordered by the profile's QueueSort (priority desc, FIFO)
 podBackoffQ    heap ordered by backoff expiry (1s → 10s doubling, :766)
 unschedulable  map of pods that failed, waiting for a relevant ClusterEvent
+               (including GATED pods a PreEnqueue plugin refused admission)
 
 Event-driven reactivation (``move_all_to_active_or_backoff``) is gated on the
 cluster-event map: a pod moves only if some plugin it failed on registered
 interest in the fired event (:614,:627), or on the wildcard flush.  The
 ``move_request_cycle`` guard (:163-167) keeps pods that failed *during* an
 in-flight cycle eligible for the move that raced with them.
+
+Pre-enqueue gating: every transition toward activeQ/backoffQ re-runs the
+profile's PreEnqueue gate (``pre_enqueue_fn``); refused pods park in the
+unschedulable map with ``gated=True`` — so a reactivation wave (assigned-pod
+delete, gang teardown, unschedulable-timeout flush) can never move a pod
+whose namespace is still over quota (the reactivation-thrash guard).
+
+Fair-share dequeueing: namespaces with a SchedulingQuota (``ns_weight_fn``
+returns a weight) get their own activeQ sub-heap and are served by deficit
+round robin in proportion to weight — one flooding tenant cannot starve the
+rest. WITHIN a tenant's turn the profile's QueueSort key still orders pods,
+so gang members stay adjacent; a gang larger than the tenant's quantum keeps
+the turn via gang continuation (the deficit goes negative and is paid back
+over the following rounds). Namespaces without a quota share the default
+bucket, which participates in the rotation with weight 1.
 
 Flush tickers (:432,:463) become explicit ``flush_*`` calls driven by the
 scheduler loop (no background goroutines; the loop is single-threaded and the
@@ -17,6 +33,7 @@ TPU batch path wants deterministic drain points anyway).
 
 from __future__ import annotations
 
+import bisect
 import heapq
 import itertools
 import time
@@ -28,6 +45,11 @@ from ..framework.types import ClusterEvent, QueuedPodInfo
 DEFAULT_POD_INITIAL_BACKOFF = 1.0
 DEFAULT_POD_MAX_BACKOFF = 10.0
 DEFAULT_UNSCHEDULABLE_TIMEOUT = 300.0  # flushUnschedulablePodsLeftover, 5min
+
+# DRR quantum: pods a weight-1 tenant may drain per rotation turn. Large
+# enough that small gangs stay in one turn, small enough that a turn cannot
+# monopolize a micro-batch.
+DEFAULT_FAIR_QUANTUM = 4.0
 
 LessFn = Callable[[QueuedPodInfo], object]  # sort-key extractor
 
@@ -44,6 +66,9 @@ class SchedulingQueue:
         metrics=None,
         gang_key_fn=None,
         gang_coactivation_interval: Optional[float] = None,
+        pre_enqueue_fn: Optional[Callable[[Pod], Optional[object]]] = None,
+        ns_weight_fn: Optional[Callable[[str], Optional[float]]] = None,
+        fair_quantum: float = DEFAULT_FAIR_QUANTUM,
     ):
         # default QueueSort: priority desc then FIFO (PrioritySort)
         self.less_key = less_key or (lambda qp: (-qp.pod.spec.priority, qp.timestamp))
@@ -75,6 +100,21 @@ class SchedulingQueue:
                                   else initial_backoff)
         self._gang_last_co: Dict[str, float] = {}
 
+        # pre-enqueue gate: fn(pod) -> None (admit) or a non-success Status
+        # (park gated; status.plugin attributes the gate for event matching)
+        self.pre_enqueue_fn = pre_enqueue_fn
+        # fair share: fn(namespace) -> weight for tenant namespaces, None
+        # for default-bucket namespaces
+        self.ns_weight_fn = ns_weight_fn
+        self._fair_quantum = fair_quantum
+        self._active_ns: Dict[str, List[Tuple[object, int, QueuedPodInfo]]] = {}
+        # sorted(_active_ns) maintained incrementally (bisect on bucket
+        # create/empty) — _drr_pop runs once per pop and must not re-sort
+        self._drr_names: List[str] = []
+        self._deficit: Dict[str, float] = {}
+        self._drr_cur: Optional[str] = None
+        self._gang_cont: Optional[Tuple[str, str]] = None
+
         self._counter = itertools.count()  # FIFO tie-break inside heaps
         self._active: List[Tuple[object, int, QueuedPodInfo]] = []
         self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []
@@ -94,11 +134,26 @@ class SchedulingQueue:
                 return self.max_backoff
         return d
 
+    def _tenant_of(self, pod: Pod) -> Optional[str]:
+        """Fair-share bucket for a pod: its namespace when that namespace is
+        a tenant (has a SchedulingQuota weight), else None (default bucket)."""
+        if self.ns_weight_fn is None:
+            return None
+        ns = pod.meta.namespace
+        return ns if self.ns_weight_fn(ns) is not None else None
+
     def _push_active(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:
         key = qp.pod.key()
         if key in self._in_queue:
             return
-        heapq.heappush(self._active, (self.less_key(qp), next(self._counter), qp))
+        entry = (self.less_key(qp), next(self._counter), qp)
+        tenant = self._tenant_of(qp.pod)
+        if tenant is None:
+            heapq.heappush(self._active, entry)
+        else:
+            if tenant not in self._active_ns:
+                bisect.insort(self._drr_names, tenant)
+            heapq.heappush(self._active_ns.setdefault(tenant, []), entry)
         self._in_queue.add(key)
         self._record_incoming("active", event)
 
@@ -116,19 +171,46 @@ class SchedulingQueue:
             self._metrics.queue_incoming_pods.inc(queue, event)
 
     def _sync_gauges(self) -> None:
-        """pending_pods gauge ← the three sub-queue sizes (SchedulerQueue
+        """pending_pods gauge ← the sub-queue sizes (SchedulerQueue
         Incoming/Pending recorders; cheap enough to run per transition)."""
         if self._metrics is not None:
             self._metrics.sync_queue_gauges(self.pending_pods())
 
+    # -------------------------------------------------------- pre-enqueue gate
+
+    def _park_gated(self, qp: QueuedPodInfo, event: Optional[str]) -> bool:
+        """Run the PreEnqueue gate for a pod about to enter active/backoff.
+        True = refused and parked gated in the unschedulable map (with the
+        gating plugin attributed, so its release event can wake the pod)."""
+        if self.pre_enqueue_fn is None:
+            return False
+        key = qp.pod.key()
+        if key in self._in_queue:
+            return False
+        st = self.pre_enqueue_fn(qp.pod)
+        if st is None:
+            qp.gated = False
+            return False
+        qp.gated = True
+        qp.timestamp = self.now_fn()
+        plugin = getattr(st, "plugin", "")
+        if plugin:
+            qp.unschedulable_plugins.add(plugin)
+        if key not in self._unschedulable:
+            self._record_incoming("gated", event)
+        self._unschedulable[key] = qp
+        return True
+
     # ------------------------------------------------------------- API
 
     def add(self, pod: Pod) -> None:
-        """New unscheduled pod (informer add) → activeQ (:300). A gang
-        member's arrival co-activates its parked siblings — the late 32nd
-        pod of a gang must wake the 31 that failed PreFilter on it."""
-        self._push_active(QueuedPodInfo(pod=pod, timestamp=self.now_fn()),
-                          event="PodAdd")
+        """New unscheduled pod (informer add) → activeQ (:300), unless the
+        PreEnqueue gate parks it. A gang member's arrival co-activates its
+        parked siblings — the late 32nd pod of a gang must wake the 31 that
+        failed PreFilter on it."""
+        qp = QueuedPodInfo(pod=pod, timestamp=self.now_fn())
+        if not self._park_gated(qp, "PodAdd"):
+            self._push_active(qp, event="PodAdd")
         if self.gang_key_fn is not None:
             gkey = self.gang_key_fn(pod)
             if gkey is not None:
@@ -145,7 +227,8 @@ class SchedulingQueue:
         qp = self._unschedulable.pop(key, None)
         if qp is not None:
             qp.pod = new
-            self._push_backoff(qp, event="PodUpdate")
+            if not self._park_gated(qp, "PodUpdate"):
+                self._push_backoff(qp, event="PodUpdate")
             self._sync_gauges()
         else:
             self.add(new)
@@ -157,6 +240,19 @@ class SchedulingQueue:
             self._in_queue.discard(key)
             self._active = [e for e in self._active if e[2].pod.key() != key]
             heapq.heapify(self._active)
+            # tenant buckets are keyed by namespace, so only the pod's own
+            # bucket can hold it — rebuilding every tenant heap would make
+            # each delete O(total active pods) under churn
+            ns = pod.meta.namespace
+            heap = self._active_ns.get(ns)
+            if heap is not None:
+                h = [e for e in heap if e[2].pod.key() != key]
+                if h:
+                    heapq.heapify(h)
+                    self._active_ns[ns] = h
+                else:
+                    del self._active_ns[ns]
+                    self._drop_drr_name(ns)
             self._backoff = [e for e in self._backoff if e[2].pod.key() != key]
             heapq.heapify(self._backoff)
         self._sync_gauges()
@@ -171,12 +267,104 @@ class SchedulingQueue:
 
     def _pop_unsynced(self) -> Optional[QueuedPodInfo]:
         self.flush_backoff_completed()
-        if not self._active:
+        qp = self._pop_active()
+        if qp is None:
             return None
-        _, _, qp = heapq.heappop(self._active)
         self._in_queue.discard(qp.pod.key())
         qp.attempts += 1
         self.scheduling_cycle += 1
+        return qp
+
+    def _pop_active(self) -> Optional[QueuedPodInfo]:
+        if not self._active_ns:
+            # no tenant heaps: the exact legacy single-heap order
+            if not self._active:
+                return None
+            return heapq.heappop(self._active)[2]
+        return self._drr_pop()
+
+    # -------------------------------------------------- fair-share dequeueing
+
+    def _weight_of(self, ns: str) -> float:
+        if not ns:  # default bucket (unquota'd namespaces)
+            return 1.0
+        w = self.ns_weight_fn(ns) if self.ns_weight_fn is not None else None
+        return max(float(w), 0.0) if w is not None else 1.0
+
+    def _drop_drr_name(self, ns: str) -> None:
+        i = bisect.bisect_left(self._drr_names, ns)
+        if i < len(self._drr_names) and self._drr_names[i] == ns:
+            del self._drr_names[i]
+
+    def _drr_bucket(self, ns: str) -> List:
+        return self._active if ns == "" else self._active_ns[ns]
+
+    def _drr_pop(self) -> Optional[QueuedPodInfo]:
+        # tenant heaps are never empty (emptied buckets are dropped at the
+        # _drr_take/delete sites), so _drr_names IS sorted(buckets) — no
+        # per-pop dict rebuild or sort on the batched-drain hot path
+        has_default = bool(self._active)
+        n_buckets = len(self._active_ns) + (1 if has_default else 0)
+        if n_buckets == 0:
+            return None
+        if n_buckets == 1:
+            # uncontended service is free — classic DRR only tracks deficit
+            # while tenants compete. Charging here would bank unbounded debt
+            # for a tenant that ran alone (one -1 per solo pop) and starve
+            # it for thousands of rotations once a second tenant appears.
+            ns = "" if has_default else self._drr_names[0]
+            return self._drr_take(ns, self._drr_bucket(ns), charge=False)
+        # gang continuation: a tenant mid-gang keeps the turn regardless of
+        # deficit (which goes negative and is paid back next rounds) — a
+        # gang must never interleave with another tenant's pods
+        if self._gang_cont is not None:
+            ns, gkey = self._gang_cont
+            h = self._active if ns == "" else self._active_ns.get(ns)
+            if (h and self.gang_key_fn is not None
+                    and self.gang_key_fn(h[0][2].pod) == gkey):
+                return self._drr_take(ns, h)
+            self._gang_cont = None
+        names = ([""] if has_default else []) + self._drr_names
+        cur = self._drr_cur
+        cur_live = ((cur == "" and has_default)
+                    or (cur in self._active_ns))
+        if cur_live and self._deficit.get(cur, 0.0) >= 1.0:
+            return self._drr_take(cur, self._drr_bucket(cur))  # finish turn
+        start = (names.index(cur) + 1) if cur_live else 0
+        for step in range(len(names)):
+            ns = names[(start + step) % len(names)]
+            w = self._weight_of(ns)
+            credit = self._fair_quantum * w
+            # cap banked credit at two quanta: a tenant that idles through
+            # rotations must not save up an unbounded burst
+            self._deficit[ns] = min(self._deficit.get(ns, 0.0) + credit,
+                                    max(2.0 * credit, 1.0))
+            if self._deficit[ns] >= 1.0:
+                return self._drr_take(ns, self._drr_bucket(ns))
+        # every candidate is weight-0 (background tenants): stay
+        # work-conserving rather than wedging the queue. No charge — their
+        # rotation credit is 0, so debt could never be paid back and would
+        # starve any of them later granted a real weight.
+        ns = names[start % len(names)]
+        return self._drr_take(ns, self._drr_bucket(ns), charge=False)
+
+    def _drr_take(self, ns: str, heap: List, charge: bool = True) -> QueuedPodInfo:
+        _k, _c, qp = heapq.heappop(heap)
+        if heap:
+            if charge:
+                self._deficit[ns] = self._deficit.get(ns, 0.0) - 1.0
+        else:
+            # classic DRR: an emptied queue forfeits leftover credit
+            self._deficit.pop(ns, None)
+            if ns:
+                self._active_ns.pop(ns, None)
+                self._drop_drr_name(ns)
+        if self._drr_cur != ns:
+            self._drr_cur = ns
+            if self._metrics is not None and ns:
+                self._metrics.fair_share_turns.inc(ns)
+        gkey = self.gang_key_fn(qp.pod) if self.gang_key_fn is not None else None
+        self._gang_cont = (ns, gkey) if gkey is not None else None
         return qp
 
     def pop_batch(self, k: int) -> List[QueuedPodInfo]:
@@ -210,8 +398,14 @@ class SchedulingQueue:
             return
         qp.timestamp = self.now_fn()
         if error or self.move_request_cycle >= pod_scheduling_cycle:
-            self._push_backoff(qp, event="ScheduleAttemptFailure")
-        else:
+            if not self._park_gated(qp, "ScheduleAttemptFailure"):
+                self._push_backoff(qp, event="ScheduleAttemptFailure")
+        elif not self._park_gated(qp, "ScheduleAttemptFailure"):
+            # the PreEnqueue gate re-check first: a pod that failed its
+            # cycle on the quota gate (PreFilter caught what PreEnqueue
+            # raced past) parks GATED, not plain-unschedulable, so only the
+            # targeted quota-release move — never the timeout flush or an
+            # unrelated event wave — can wake it
             self._unschedulable[key] = qp
             self._record_incoming("unschedulable", "ScheduleAttemptFailure")
         self._sync_gauges()
@@ -220,7 +414,8 @@ class SchedulingQueue:
         """Reactivate unschedulable pods whose failed plugins registered
         interest in ``event`` (:614 MoveAllToActiveOrBackoffQueue). Moved
         gang members pull their parked siblings along (a member waking
-        WITHOUT its gang just parks at Permit and times out)."""
+        WITHOUT its gang just parks at Permit and times out). Pods the
+        PreEnqueue gate still refuses re-park without a queue move."""
         self.move_request_cycle = self.scheduling_cycle
         label = event.label or str(event.resource)
         moved = 0
@@ -229,15 +424,58 @@ class SchedulingQueue:
             qp = self._unschedulable[key]
             if self._pod_matches_event(qp, event):
                 del self._unschedulable[key]
-                self._requeue(qp, event=label)
-                moved += 1
-                if self.gang_key_fn is not None:
-                    gkey = self.gang_key_fn(qp.pod)
-                    if gkey is not None:
-                        gangs_moved.add(gkey)
+                if self._requeue(qp, event=label):
+                    moved += 1
+                    if self.gang_key_fn is not None:
+                        gkey = self.gang_key_fn(qp.pod)
+                        if gkey is not None:
+                            gangs_moved.add(gkey)
         for gkey in gangs_moved:
             moved += self.activate_gang(gkey)
         if moved:
+            self._sync_gauges()
+        return moved
+
+    def move_gated_pods(self, namespace: Optional[str] = None,
+                        plugin: Optional[str] = None,
+                        admit_fn: Optional[Callable[[Pod], Optional[object]]] = None,
+                        event: str = "QuotaReleased") -> int:
+        """Targeted reactivation for a PreEnqueue gate release (quota
+        headroom opened in ``namespace``): move gated pods — and pods whose
+        failure is attributed to ``plugin`` — back toward activeQ, re-gated
+        through ``admit_fn`` (a shadow-ledger gate: one freed slot admits
+        one pod) or, absent one, the regular pre-enqueue re-check. Pods
+        still refused never fire a queue move; admitted pods go straight to
+        activeQ — they are not backing off a failure, the headroom they
+        waited for just opened."""
+        moved = 0
+        for key in list(self._unschedulable):
+            qp = self._unschedulable.get(key)
+            if qp is None:
+                continue
+            if namespace is not None and qp.pod.meta.namespace != namespace:
+                continue
+            if not qp.gated and (plugin is None
+                                 or plugin not in qp.unschedulable_plugins):
+                continue
+            if admit_fn is not None:
+                st = admit_fn(qp.pod)
+                if st is not None:
+                    qp.gated = True  # refreshed park, no queue move
+                    continue
+                del self._unschedulable[key]
+                qp.gated = False
+            else:
+                del self._unschedulable[key]
+                qp.gated = False
+                if self._park_gated(qp, event):
+                    continue  # the regular gate still refuses
+            self._push_active(qp, event=event)
+            moved += 1
+            if self._metrics is not None:
+                self._metrics.quota_released_pods.inc(qp.pod.meta.namespace)
+        if moved:
+            self.move_request_cycle = self.scheduling_cycle
             self._sync_gauges()
         return moved
 
@@ -258,8 +496,8 @@ class SchedulingQueue:
             qp = self._unschedulable[key]
             if self.gang_key_fn(qp.pod) == gkey:
                 del self._unschedulable[key]
-                self._requeue(qp, event="GangActivate")
-                moved += 1
+                if self._requeue(qp, event="GangActivate"):
+                    moved += 1
         if moved:
             self._gang_last_co[gkey] = now
             self.move_request_cycle = self.scheduling_cycle
@@ -280,31 +518,43 @@ class SchedulingQueue:
             self._event_match_memo[memo_key] = hit
         return hit
 
-    def _requeue(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:
-        """Moved pods land in backoffQ unless their backoff already lapsed."""
+    def _requeue(self, qp: QueuedPodInfo, event: Optional[str] = None) -> bool:
+        """Moved pods land in backoffQ unless their backoff already lapsed —
+        after the PreEnqueue gate re-check (a still-refused pod re-parks
+        gated instead; returns False: no queue move happened)."""
+        if self._park_gated(qp, event):
+            return False
         if self.now_fn() - qp.timestamp >= self._backoff_duration(qp):
             self._push_active(qp, event=event)
         else:
             self._push_backoff(qp, event=event)
+        return True
 
     def flush_backoff_completed(self) -> None:
-        """backoffQ → activeQ for expired backoffs (:432)."""
+        """backoffQ → activeQ for expired backoffs (:432), re-gated: quota
+        may have filled while the pod backed off."""
         now = self.now_fn()
         flushed = False
         while self._backoff and self._backoff[0][0] <= now:
             _, _, qp = heapq.heappop(self._backoff)
             self._in_queue.discard(qp.pod.key())
-            self._push_active(qp, event="BackoffComplete")
+            if not self._park_gated(qp, "BackoffComplete"):
+                self._push_active(qp, event="BackoffComplete")
             flushed = True
         if flushed:
             self._sync_gauges()
 
     def flush_unschedulable_left_over(self) -> None:
-        """Pods stuck unschedulable > timeout get retried (:463)."""
+        """Pods stuck unschedulable > timeout get retried (:463). Gated pods
+        are exempt: the gate condition (namespace over quota) is level-held
+        and re-checked on every release — a timeout flush would just churn
+        them through ``_requeue`` back into the same parked state."""
         now = self.now_fn()
         flushed = False
         for key in list(self._unschedulable):
             qp = self._unschedulable[key]
+            if qp.gated:
+                continue
             if now - qp.timestamp > self.unschedulable_timeout:
                 del self._unschedulable[key]
                 self._requeue(qp, event="UnschedulableTimeout")
@@ -322,23 +572,27 @@ class SchedulingQueue:
     # ------------------------------------------------------------- stats
 
     def pending_pods(self) -> Dict[str, int]:
+        gated = sum(1 for qp in self._unschedulable.values() if qp.gated)
         return {
-            "active": len(self._active),
+            "active": len(self._active) + sum(
+                len(h) for h in self._active_ns.values()),
             "backoff": len(self._backoff),
-            "unschedulable": len(self._unschedulable),
+            "unschedulable": len(self._unschedulable) - gated,
+            "gated": gated,
         }
 
     def pending_pod_infos(self) -> List[QueuedPodInfo]:
-        """All queued pods across the three sub-queues (PendingPods, :530) —
+        """All queued pods across the sub-queues (PendingPods, :530) —
         the debugger/comparer's queue-side truth."""
         return (
             [e[2] for e in self._active]
+            + [e[2] for h in self._active_ns.values() for e in h]
             + [e[2] for e in self._backoff]
             + list(self._unschedulable.values())
         )
 
     def dump(self) -> Dict[str, object]:
-        """Structured snapshot of the three sub-queues (the /debug/queue
+        """Structured snapshot of the sub-queues (the /debug/queue
         introspection body; the JSON twin of dumper.go's queue section).
 
         Called from the serving thread while the scheduling thread mutates
@@ -348,6 +602,8 @@ class SchedulingQueue:
         is fine for a debug endpoint."""
         now = self.now_fn()
         active = list(self._active)
+        for ns in list(self._active_ns):
+            active += list(self._active_ns.get(ns, ()))
         backoff = list(self._backoff)
         unschedulable = list(self._unschedulable.values())
 
@@ -360,17 +616,27 @@ class SchedulingQueue:
                 **extra,
             }
 
+        counts = self.pending_pods()
         return {
-            "counts": {"active": len(active), "backoff": len(backoff),
-                       "unschedulable": len(unschedulable)},
+            "counts": dict(counts),
             "schedulingCycle": self.scheduling_cycle,
             "moveRequestCycle": self.move_request_cycle,
+            "fairShare": {
+                "tenants": {ns: len(h) for ns, h in self._active_ns.items()},
+                "deficits": {ns: round(d, 3)
+                             for ns, d in self._deficit.items()},
+                "currentTurn": self._drr_cur,
+            },
             "active": [entry(e[2]) for e in sorted(active)],
             "backoff": [entry(e[2], backoffRemaining=max(e[0] - now, 0.0))
                         for e in sorted(backoff)],
             "unschedulable": [entry(qp, parkedFor=max(now - qp.timestamp, 0.0))
-                              for qp in unschedulable],
+                              for qp in unschedulable if not qp.gated],
+            "gated": [entry(qp, parkedFor=max(now - qp.timestamp, 0.0))
+                      for qp in unschedulable if qp.gated],
         }
 
     def __len__(self) -> int:
-        return len(self._active) + len(self._backoff) + len(self._unschedulable)
+        return (len(self._active)
+                + sum(len(h) for h in self._active_ns.values())
+                + len(self._backoff) + len(self._unschedulable))

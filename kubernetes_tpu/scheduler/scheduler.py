@@ -153,6 +153,7 @@ class Scheduler:
                 event_map.setdefault(ev, set()).update(plugins)
         first = next(iter(self.profiles.values()))
         from ..framework.plugins.coscheduling import pod_group_key
+        from ..framework.plugins.names import QUOTA_ADMISSION
 
         self.queue = SchedulingQueue(
             less_key=first.queue_sort_key(),
@@ -162,8 +163,83 @@ class Scheduler:
             now_fn=now_fn,
             metrics=self.smetrics,
             gang_key_fn=pod_group_key,
+            pre_enqueue_fn=self._pre_enqueue_gate,
+            ns_weight_fn=self._ns_fair_weight,
         )
+        # targeted quota-release moves: a released charge wakes exactly the
+        # gated pods the freed headroom admits (shadow-ledger gate), never
+        # the whole parked backlog of a still-over-quota namespace. Every
+        # profile's QuotaAdmission shares ONE ledger — usage is cluster
+        # state, and Reserve charges land in the pod's own profile's
+        # instance while release/fair-share read through _quota_plugin().
+        shared_quota = None
+        for fwk in self.profiles.values():
+            plugin = fwk.plugin(QUOTA_ADMISSION)
+            if plugin is not None:
+                plugin.on_release = self._on_quota_release
+                if shared_quota is None:
+                    shared_quota = plugin
+                else:
+                    plugin.share_ledger(shared_quota)
         self._add_all_event_handlers()
+
+    # ------------------------------------------------------- quota admission
+
+    def _quota_plugin(self, pod: Optional[Pod] = None):
+        """The pod's profile's QuotaAdmission, else ANY profile's (the
+        ledger is shared, so instances are interchangeable — and a custom
+        first profile without the plugin must not hide the others')."""
+        from ..framework.plugins.names import QUOTA_ADMISSION
+
+        fwk = (self.profiles.get(pod.spec.scheduler_name)
+               if pod is not None else None)
+        if fwk is not None:
+            plugin = fwk.plugin(QUOTA_ADMISSION)
+            if plugin is not None:
+                return plugin
+        for fwk in self.profiles.values():
+            plugin = fwk.plugin(QUOTA_ADMISSION)
+            if plugin is not None:
+                return plugin
+        return None
+
+    def _pre_enqueue_gate(self, pod: Pod):
+        """SchedulingQueue admission gate: the pod's profile's PreEnqueue
+        plugins. None = admit; a non-success Status = park gated."""
+        fwk = self.profiles.get(pod.spec.scheduler_name)
+        if fwk is None:
+            return None
+        status = fwk.run_pre_enqueue_plugins(pod)
+        return None if status.is_success() else status
+
+    def _ns_fair_weight(self, ns: str) -> Optional[float]:
+        """Fair-share weight for the queue's DRR layer (None = the
+        namespace is not a tenant and shares the default bucket)."""
+        plugin = self._quota_plugin()
+        return plugin.weight_for(ns) if plugin is not None else None
+
+    def _on_quota_release(self, ns: str) -> int:
+        plugin = self._quota_plugin()
+        if plugin is None:
+            return 0
+        return self.queue.move_gated_pods(
+            namespace=ns, plugin=plugin.name(),
+            admit_fn=plugin.shadow_admitter(ns))
+
+    def _notify_quota_pod_bound(self, pod: Pod) -> None:
+        """A pod observed bound (assumed-confirmation is a no-op; an
+        external binder's pod still charges the namespace ledger)."""
+        plugin = self._quota_plugin(pod)
+        if plugin is not None:
+            plugin.pod_observed_bound(pod)
+
+    def _notify_quota_pod_deleted(self, pod: Pod) -> None:
+        """Release the pod's quota charge (if any) BEFORE the queue's
+        reactivation wave runs, so the wave's gate re-check sees the freed
+        headroom."""
+        plugin = self._quota_plugin(pod)
+        if plugin is not None:
+            plugin.pod_deleted(pod)
 
     # ----------------------------------------------------------- event wiring
 
@@ -182,6 +258,12 @@ class Scheduler:
             pod_inf.add_event_handler(lambda e, old, new: self._on_pod_event(evmap[e], old, new))
             node_inf.add_event_handler(lambda e, old, new: self._on_node_event(evmap[e], old, new))
             self.informer_factory.wait_for_cache_sync()
+            # dynamic plugin-requested kinds (SchedulingQuota, PodGroup …)
+            # have no informers — they ride the store's direct handler bus in
+            # BOTH topologies. Skipping them here strands gated pods forever
+            # on the production server: a quota raise would fire no queue
+            # move, and gated pods are exempt from the timeout flush.
+            self._add_dynamic_event_handlers()
             return
         for node in list(self.store.nodes.values()):
             self._on_node_event(ADDED, None, node)
@@ -218,6 +300,7 @@ class Scheduler:
         if event == ADDED:
             if new.spec.node_name:
                 self.cache.add_pod(new)
+                self._notify_quota_pod_bound(new)
                 self.queue.assigned_pod_updated_or_added(new)
             elif self._responsible_for(new):
                 self.queue.add(new)
@@ -225,6 +308,7 @@ class Scheduler:
             if new.spec.node_name:
                 if old is not None and not old.spec.node_name:
                     self.cache.add_pod(new)  # binding confirmation
+                    self._notify_quota_pod_bound(new)
                     self.queue.assigned_pod_updated_or_added(new)
                 else:
                     self.cache.update_pod(old, new)
@@ -234,6 +318,9 @@ class Scheduler:
         elif event == DELETED:
             if old is not None:
                 self.smetrics.clear_unschedulable(old.key())
+                # quota release first: the POD_DELETE reactivation wave
+                # below must re-gate against the freed headroom
+                self._notify_quota_pod_deleted(old)
             if old is not None and old.spec.node_name:
                 self.cache.remove_pod(old)
                 self.queue.move_all_to_active_or_backoff_queue(qevents.POD_DELETE)
@@ -749,7 +836,9 @@ class Scheduler:
         self.settle_abandoned = False
         while cycles < max_cycles:
             before_sched = self.metrics["scheduled"]
-            before_unsched = self.queue.pending_pods()["unschedulable"]
+            before_pending = self.queue.pending_pods()
+            before_unsched = (before_pending["unschedulable"]
+                              + before_pending.get("gated", 0))
             n = self.schedule_batch_cycle()
             if n == 0:
                 if flush:
@@ -769,7 +858,8 @@ class Scheduler:
             # nor park — a pod flapping straight back into activeQ — pay the
             # wait and count toward the bound.
             if (self.metrics["scheduled"] > before_sched
-                    or pending["unschedulable"] > before_unsched):
+                    or pending["unschedulable"] + pending.get("gated", 0)
+                    > before_unsched):
                 no_progress = 0
             else:
                 no_progress += 1

@@ -129,6 +129,45 @@ def test_telemetry_metrics_registered_and_live():
     assert dead == []
 
 
+def test_quota_and_fairness_metrics_registered_and_live():
+    """The multi-tenant admission families (ISSUE 8): per-namespace quota
+    usage/decisions/releases and the fair-share turn counter are in the
+    checked roster AND fed — orphaning any of them fails tier-1."""
+    mod = _load_tool()
+    attrs, dead = mod.find_dead_metrics()
+    for expected in ("quota_usage", "quota_decisions", "quota_released_pods",
+                     "fair_share_turns"):
+        assert expected in attrs
+    assert dead == []
+
+
+def test_marker_lint_requires_slow_on_large_soak(tmp_path):
+    """The large SchedulingSoak variant must be slow-marked even with a
+    small ``nodes`` override: soak cost scales with rounds x scale, so the
+    lint flags default/reference-size soak knobs; the small tier-1 shape
+    and the slow-marked large twin pass."""
+    mod = _load(MARKER_TOOL, "check_markers")
+    f = tmp_path / "test_soak_scale.py"
+    f.write_text(
+        "import pytest\n"
+        "def test_soak_default_knobs():\n"
+        "    TEST_CASES['SchedulingSoak'](nodes=32)\n"
+        "def test_soak_big_scale():\n"
+        "    TEST_CASES['SchedulingSoak'](nodes=32, scale=64, rounds=4)\n"
+        "def test_soak_big_rounds():\n"
+        "    TEST_CASES['SchedulingSoak'](nodes=32, scale=6, rounds=50)\n"
+        "def test_soak_small():\n"
+        "    TEST_CASES['SchedulingSoak'](nodes=32, scale=6, rounds=4)\n"
+        "@pytest.mark.slow\n"
+        "def test_soak_large_marked():\n"
+        "    TEST_CASES['SchedulingSoak']()\n"
+    )
+    out = mod.find_unmarked([str(f)])
+    names = {v.split()[-1] for v in out}
+    assert names == {"test_soak_default_knobs", "test_soak_big_scale",
+                     "test_soak_big_rounds"}
+
+
 def test_span_lint_clean():
     """Every span name the package emits is in bench.py's critical-path
     attribution table or the explicit ignore list."""

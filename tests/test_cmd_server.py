@@ -135,7 +135,7 @@ class TestDebugEndpoints:
             assert set(json.loads(body)["endpoints"]) == {
                 "/debug/queue", "/debug/cache", "/debug/devicestate",
                 "/debug/spans", "/debug/circuit", "/debug/sessions",
-                "/debug/flightrecorder"}
+                "/debug/flightrecorder", "/debug/quota"}
 
             status, body = _get(port, "/debug/queue")
             doc = json.loads(body)

@@ -102,6 +102,42 @@ class TestFenceLogic:
                       [_record(value=5000.0, rnd=bad_round)])
         assert out["baselineRound"] is None
 
+    def test_epoch_boundary_excludes_pre_epoch_baselines(self):
+        """A declared PLATFORM_EPOCHS boundary: rounds from the previous
+        environment class are not baselines, and the boundary round's
+        missing baseline is reported as the documented epoch state (the
+        CLI passes on it instead of failing closed)."""
+        t = _trend()
+        epoch = max(t.PLATFORM_EPOCHS)
+        out = t.fence(_record(value=10.0, rnd=epoch),
+                      [_record(value=5000.0, rnd=epoch - 2)])
+        assert out["baselineRound"] is None
+        assert out["violations"] == []
+        assert out["epochBoundary"] == t.PLATFORM_EPOCHS[epoch]
+
+    def test_same_epoch_rounds_still_judged(self):
+        """Within one epoch the fence bites normally — and prefers the
+        newest same-epoch baseline while ignoring pre-epoch rounds."""
+        t = _trend()
+        epoch = max(t.PLATFORM_EPOCHS)
+        out = t.fence(
+            _record(value=10.0, rnd=epoch + 2),
+            [_record(value=5000.0, rnd=epoch - 2),   # pre-epoch: ignored
+             _record(value=100.0, rnd=epoch)])       # same epoch: baseline
+        assert out["baselineRound"] == epoch
+        assert any("headline pods/s" in v for v in out["violations"])
+
+    def test_fresh_record_belongs_to_the_newest_epoch(self):
+        """A record with no round number (an in-flight `--record` run) is
+        measured on the current environment, so pre-epoch rounds are not
+        its baseline either."""
+        t = _trend()
+        epoch = max(t.PLATFORM_EPOCHS)
+        out = t.fence(_record(value=10.0),
+                      [_record(value=5000.0, rnd=epoch - 2)])
+        assert out["baselineRound"] is None
+        assert out["epochBoundary"] == t.PLATFORM_EPOCHS[epoch]
+
     def test_repo_history_self_fence_holds(self):
         """The committed rounds pass their own fence (the gate starts
         green): the newest valid round judged against its priors."""
@@ -242,6 +278,10 @@ class TestBenchFenceCli:
         if len(valid) < 2:
             pytest.skip("fewer than two valid committed rounds")
         newest = valid[-1]
+        if not any(r["_round"] >= t._epoch_start(newest["_round"])
+                   for r in valid[:-1]):
+            pytest.skip("newest round is a platform-epoch boundary: no "
+                        "prior baseline exists to self-compare against")
         # regress the newest round 99% and hand it over under its own name:
         # without self-exclusion the fence would compare it to itself and
         # pass

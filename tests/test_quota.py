@@ -1,0 +1,590 @@
+"""Multi-tenant admission (ISSUE 8 tentpole): SchedulingQuota kind,
+QuotaAdmission PreEnqueue/PreFilter/Reserve gate, targeted quota-release
+reactivation (no thrash under sustained over-quota load), and the
+scheduling queue's per-namespace fair-share (DRR) dequeueing."""
+
+import dataclasses
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    ObjectMeta,
+    QUOTA_CLAIMS,
+    QUOTA_CPU,
+    QUOTA_MEMORY,
+    QUOTA_PODS,
+    SchedulingQuota,
+)
+from kubernetes_tpu.api.scheme import GroupVersionKind, default_scheme
+from kubernetes_tpu.api.validation import ValidationError
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.framework.plugins.quota import (
+    ERR_REASON_QUOTA_EXCEEDED,
+    QuotaAdmission,
+    pod_quota_request,
+)
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def ensure_ns(store, ns):
+    from kubernetes_tpu.api.types import Namespace
+
+    if ns != "default" and ns not in store.namespaces:
+        store.create_namespace(Namespace(meta=ObjectMeta(name=ns)))
+
+
+def quota(store, ns, hard, weight=1, name="quota"):
+    ensure_ns(store, ns)
+    sq = SchedulingQuota(meta=ObjectMeta(name=name, namespace=ns),
+                         hard=dict(hard), weight=weight)
+    store.create_object("SchedulingQuota", sq)
+    return sq
+
+
+def nodes(store, n=4, cpu="8", pods=32):
+    for i in range(n):
+        store.create_node(make_node(f"n{i}").capacity(
+            {"cpu": cpu, "memory": "32Gi", "pods": pods}).obj())
+
+
+def pod(store, name, ns="default", cpu="1", prio=0, group=None):
+    ensure_ns(store, ns)
+    pw = make_pod(name, namespace=ns).req({"cpu": cpu, "memory": "1Gi"})
+    if prio:
+        pw.priority(prio)
+    if group:
+        pw.pod_group(group)
+    p = pw.obj()
+    store.create_pod(p)
+    return p
+
+
+def sched_with_clock(store, **kw):
+    clock = FakeClock()
+    s = Scheduler(store, now_fn=clock, pod_initial_backoff=0.1,
+                  pod_max_backoff=0.5, **kw)
+    return s, clock
+
+
+def settle(s, clock, rounds=60):
+    for _ in range(rounds):
+        progressed = s.schedule_one()
+        clock.advance(0.2)
+        if not progressed:
+            s.queue.flush_backoff_completed()
+            if s.queue.pending_pods()["active"] == 0:
+                break
+
+
+# ---------------------------------------------------------------------------
+# the API kind
+
+
+class TestSchedulingQuotaKind:
+    def test_scheme_round_trip(self):
+        scheme = default_scheme()
+        sq = SchedulingQuota(
+            meta=ObjectMeta(name="q", namespace="team-a"),
+            hard={QUOTA_PODS: 10, QUOTA_CPU: 4000}, weight=3,
+            used={QUOTA_PODS: 2})
+        doc = scheme.encode(sq)
+        assert doc["apiVersion"] == "scheduling.x-k8s.io/v1alpha1"
+        assert doc["kind"] == "SchedulingQuota"
+        back = scheme.decode(doc)
+        assert back.hard == sq.hard
+        assert back.weight == 3
+        assert back.used == {QUOTA_PODS: 2}
+        assert scheme.recognizes(GroupVersionKind(
+            "scheduling.x-k8s.io", "v1alpha1", "SchedulingQuota"))
+
+    def test_wal_round_trip(self, tmp_path):
+        from kubernetes_tpu.apiserver.wal import attach_wal, restore
+
+        store = ClusterStore()
+        attach_wal(store, str(tmp_path / "wal.log"))
+        quota(store, "team-a", {QUOTA_PODS: 5, QUOTA_CPU: 2000}, weight=2)
+
+        store2 = restore(str(tmp_path / "wal.log"))
+        sq = store2.get_object("SchedulingQuota", "team-a/quota")
+        assert sq is not None
+        assert sq.hard == {QUOTA_PODS: 5, QUOTA_CPU: 2000}
+        assert sq.weight == 2
+
+    def test_http_route(self):
+        from kubernetes_tpu.apiserver.http import serve_api
+
+        store = ClusterStore()
+        ensure_ns(store, "team-a")
+        server, port = serve_api(store)
+        try:
+            import json
+            import urllib.request
+
+            body = json.dumps({
+                "apiVersion": "scheduling.x-k8s.io/v1alpha1",
+                "kind": "SchedulingQuota",
+                "metadata": {"name": "q", "namespace": "team-a"},
+                "spec": {"hard": {QUOTA_PODS: 3}, "weight": 2},
+            }).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/apis/scheduling.x-k8s.io/"
+                "v1alpha1/namespaces/team-a/schedulingquotas",
+                data=body, method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as resp:
+                assert resp.status in (200, 201)
+            assert store.get_object("SchedulingQuota", "team-a/q").weight == 2
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/apis/scheduling.x-k8s.io/"
+                    "v1alpha1/namespaces/team-a/schedulingquotas/q") as resp:
+                doc = json.loads(resp.read())
+            # GET serves the framework's reflection wire format (same
+            # contract as every other kind, e.g. PodGroup)
+            assert doc["hard"] == {QUOTA_PODS: 3}
+            assert doc["weight"] == 2
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_validation(self):
+        store = ClusterStore()
+        with pytest.raises(ValidationError):
+            quota(store, "a", {"bogus.dimension": 1})
+        with pytest.raises(ValidationError):
+            quota(store, "a", {QUOTA_PODS: -1})
+        with pytest.raises(ValidationError):
+            store.create_object("SchedulingQuota", SchedulingQuota(
+                meta=ObjectMeta(name="q", namespace="a"),
+                hard={QUOTA_PODS: 1}, weight=-1))
+        quota(store, "a", {QUOTA_PODS: 1, QUOTA_CPU: 100,
+                           QUOTA_MEMORY: 1024, QUOTA_CLAIMS: 2})
+
+    def test_pod_quota_request_dimensions(self):
+        p = make_pod("p").req({"cpu": "500m", "memory": "1Gi"}).obj()
+        req = pod_quota_request(p)
+        assert req[QUOTA_PODS] == 1
+        assert req[QUOTA_CPU] == 500
+        assert req[QUOTA_MEMORY] == 1 << 20  # KiB
+        assert req[QUOTA_CLAIMS] == 0
+
+
+# ---------------------------------------------------------------------------
+# the admission gate
+
+
+class TestQuotaGate:
+    def test_over_quota_pods_park_gated(self):
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 2})
+        s, clock = sched_with_clock(store)
+        for i in range(5):
+            pod(store, f"p{i}", ns="team-a")
+        settle(s, clock)
+        bound = [p for p in store.pods.values() if p.spec.node_name]
+        assert len(bound) == 2  # exactly the quota
+        pending = s.queue.pending_pods()
+        assert pending["gated"] == 3
+        assert pending["active"] == 0  # gated pods cost no cycles
+        # typed attribution: the gate names its plugin
+        gated = [qp for qp in s.queue.pending_pod_infos() if qp.gated]
+        assert all("QuotaAdmission" in qp.unschedulable_plugins
+                   for qp in gated)
+
+    def test_cpu_dimension_gates(self):
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_CPU: 2000})
+        s, clock = sched_with_clock(store)
+        for i in range(4):
+            pod(store, f"p{i}", ns="team-a", cpu="1")  # 1000m each
+        settle(s, clock)
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 2
+
+    def test_usage_seeds_from_bound_pods(self):
+        """A restarted scheduler resumes with true ledger usage: pods bound
+        before it started still count."""
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 2})
+        ensure_ns(store, "team-a")
+        pre = make_pod("pre", namespace="team-a").req({"cpu": "1"}).obj()
+        pre.spec.node_name = "n0"  # bound by a previous incarnation
+        store.create_pod(pre)
+        s, clock = sched_with_clock(store)
+        for i in range(3):
+            pod(store, f"p{i}", ns="team-a")
+        settle(s, clock)
+        newly = [p for p in store.pods.values()
+                 if p.spec.node_name and p.meta.name != "pre"]
+        assert len(newly) == 1  # 1 slot of headroom, not 2
+
+    def test_delete_releases_and_reactivates(self):
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 1})
+        s, clock = sched_with_clock(store)
+        pod(store, "p0", ns="team-a")
+        pod(store, "p1", ns="team-a")
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 1
+        bound = next(p for p in store.pods.values() if p.spec.node_name)
+        store.delete_pod(bound.key())
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 0
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 1
+
+    def test_raising_quota_reactivates(self):
+        store = ClusterStore()
+        nodes(store)
+        sq = quota(store, "team-a", {QUOTA_PODS: 1})
+        s, clock = sched_with_clock(store)
+        for i in range(3):
+            pod(store, f"p{i}", ns="team-a")
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 2
+        store.update_object("SchedulingQuota", dataclasses.replace(
+            sq, hard={QUOTA_PODS: 3}))
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 0
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 3
+
+    def test_raising_quota_reactivates_under_informers(self):
+        """The production topology (cmd/server wires shared informers): the
+        SchedulingQuota store handler must be registered there too — gated
+        pods are exempt from the timeout flush, so the quota-change queue
+        move is their ONLY wake-up when an admin raises the cap."""
+        from kubernetes_tpu.client.informer import SharedInformerFactory
+
+        store = ClusterStore()
+        nodes(store)
+        sq = quota(store, "team-a", {QUOTA_PODS: 1})
+        clock = FakeClock()
+        s = Scheduler(store, now_fn=clock, pod_initial_backoff=0.1,
+                      pod_max_backoff=0.5,
+                      informer_factory=SharedInformerFactory(store))
+        for i in range(3):
+            pod(store, f"p{i}", ns="team-a")
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 2
+        store.update_object("SchedulingQuota", dataclasses.replace(
+            sq, hard={QUOTA_PODS: 3}))
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 0
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 3
+
+    def test_zero_oversubscription_under_settle(self):
+        """The ledger never exceeds hard at any instant: Reserve is the
+        charge, so admitted usage is checked before every assume."""
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 3, QUOTA_CPU: 2500})
+        s, clock = sched_with_clock(store)
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        for i in range(8):
+            pod(store, f"p{i}", ns="team-a", cpu="1")
+        for _ in range(80):
+            s.schedule_one()
+            clock.advance(0.2)
+            used = plugin.usage("team-a")
+            assert used.get(QUOTA_PODS, 0) <= 3
+            assert used.get(QUOTA_CPU, 0) <= 2500
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 2
+
+    def test_rejected_counted_once_per_episode(self):
+        """The decisions counter records pod-level outcomes: a parked pod
+        re-checked by every wave/flush/probe still counts ONE rejection."""
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 1})
+        s, clock = sched_with_clock(store)
+        pod(store, "p0", ns="team-a")
+        pod(store, "p1", ns="team-a")
+        settle(s, clock)
+        m = s.smetrics.quota_decisions
+        assert m.labels("team-a", "rejected") == 1
+        clock.advance(400.0)  # timeout flush re-runs the gate on p1
+        s.queue.flush_unschedulable_left_over()
+        settle(s, clock)
+        assert m.labels("team-a", "rejected") == 1
+
+    def test_multi_profile_shares_one_ledger(self):
+        """Reserve charges land in the pod's own profile's QuotaAdmission;
+        with two profiles both instances must read ONE cluster ledger or
+        the release wave / fair-share weights undercount usage."""
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 2})
+        s, clock = sched_with_clock(
+            store, profiles={"default-scheduler": {}, "second": {}})
+        ensure_ns(store, "team-a")
+        for i in range(2):  # fill the quota through the SECOND profile
+            store.create_pod(make_pod(f"p{i}", namespace="team-a")
+                             .req({"cpu": "1", "memory": "1Gi"})
+                             .scheduler_name("second").obj())
+        settle(s, clock)
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 2
+        first = s.profiles["default-scheduler"].plugin("QuotaAdmission")
+        second = s.profiles["second"].plugin("QuotaAdmission")
+        assert first.usage("team-a")[QUOTA_PODS] == 2
+        assert first.usage("team-a") == second.usage("team-a")
+        # the default profile gates its pod against the same ledger
+        pod(store, "p2", ns="team-a")
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 1
+
+    def test_quota_metrics_live(self):
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 1})
+        s, clock = sched_with_clock(store)
+        pod(store, "p0", ns="team-a")
+        pod(store, "p1", ns="team-a")
+        settle(s, clock)
+        m = s.smetrics
+        assert m.quota_usage.labels("team-a", QUOTA_PODS) == 1
+        assert m.quota_decisions.labels("team-a", "admitted") >= 1
+        assert m.quota_decisions.labels("team-a", "rejected") >= 1
+        store.delete_pod(next(
+            p for p in store.pods.values() if p.spec.node_name).key())
+        settle(s, clock)
+        assert m.quota_released_pods.labels("team-a") >= 1
+
+
+class TestReactivationThrash:
+    """Satellite: reject_waiting_pod / quota-release reactivation must not
+    fire a queue move for pods in namespaces still over quota."""
+
+    def test_pod_delete_wave_skips_still_over_quota_namespace(self):
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 1})
+        s, clock = sched_with_clock(store)
+        for i in range(6):
+            pod(store, f"p{i}", ns="team-a")
+        # an unrelated tenant binds + deletes, firing AssignedPodDelete
+        # reactivation waves — the classic thrash trigger
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 5
+        incoming = s.smetrics.queue_incoming_pods
+        before = sum(incoming.labels(q, e) for q, e in incoming.label_sets()
+                     if q in ("active", "backoff"))
+        other = pod(store, "noise", ns="default")
+        settle(s, clock)
+        store.delete_pod(other.key())  # bound-pod delete → POD_DELETE wave
+        after_del = s.queue.pending_pods()
+        assert after_del["gated"] == 5  # nobody in team-a moved
+        after = sum(incoming.labels(q, e) for q, e in incoming.label_sets()
+                    if q in ("active", "backoff"))
+        # the only active/backoff traffic was the noise pod itself
+        assert after - before <= 2
+
+    def test_release_admits_exactly_the_freed_headroom(self):
+        """The shadow-ledger release gate: freeing ONE pod slot moves ONE
+        gated pod toward activeQ, not the whole parked backlog."""
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 1})
+        s, clock = sched_with_clock(store)
+        for i in range(6):
+            pod(store, f"p{i}", ns="team-a")
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 5
+        bound = next(p for p in store.pods.values() if p.spec.node_name)
+        store.delete_pod(bound.key())
+        pending = s.queue.pending_pods()  # before any new cycle runs
+        assert pending["active"] + pending["backoff"] == 1
+        assert pending["gated"] == 4
+
+    def test_unschedulable_timeout_flush_exempts_gated(self):
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 1})
+        s, clock = sched_with_clock(store)
+        for i in range(3):
+            pod(store, f"p{i}", ns="team-a")
+        settle(s, clock)
+        assert s.queue.pending_pods()["gated"] == 2
+        clock.advance(400.0)  # past DEFAULT_UNSCHEDULABLE_TIMEOUT
+        s.queue.flush_unschedulable_left_over()
+        pending = s.queue.pending_pods()
+        assert pending["gated"] == 2
+        assert pending["active"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fair-share dequeueing
+
+
+class TestFairShare:
+    def _flooded_queue(self, weights, per_tenant=30):
+        store = ClusterStore()
+        for ns, w in weights.items():
+            quota(store, ns, {QUOTA_PODS: 10 ** 6}, weight=w)
+        s, clock = sched_with_clock(store)
+        for ns in weights:
+            for i in range(per_tenant):
+                pod(store, f"{ns}-p{i}", ns=ns)
+        return store, s, clock
+
+    def test_drr_shares_proportional_to_weight(self):
+        weights = {"team-a": 1, "team-b": 2, "team-c": 4}
+        _store, s, _clock = self._flooded_queue(weights)
+        popped = {ns: 0 for ns in weights}
+        for _ in range(56):  # a prefix window while all stay backlogged
+            qp = s.queue.pop()
+            popped[qp.pod.meta.namespace] += 1
+        total = sum(popped.values())
+        for ns, w in weights.items():
+            fair = w / sum(weights.values())
+            share = popped[ns] / total
+            assert abs(share - fair) <= 0.2 * fair + 2 / total, \
+                f"{ns}: share {share:.2f} vs fair {fair:.2f} ({popped})"
+
+    def test_flooding_tenant_cannot_starve(self):
+        """One tenant floods 10x the pods; the other's drain rate is still
+        its weight share, not its backlog share."""
+        store = ClusterStore()
+        quota(store, "flood", {QUOTA_PODS: 10 ** 6}, weight=1)
+        quota(store, "calm", {QUOTA_PODS: 10 ** 6}, weight=1)
+        s, clock = sched_with_clock(store)
+        for i in range(200):
+            pod(store, f"f{i}", ns="flood")
+        for i in range(20):
+            pod(store, f"c{i}", ns="calm")
+        calm_positions = []
+        for pos in range(80):
+            qp = s.queue.pop()
+            if qp.pod.meta.namespace == "calm":
+                calm_positions.append(pos)
+        # all 20 calm pods drained inside the first ~half of the window
+        assert len(calm_positions) == 20
+        assert calm_positions[-1] < 60
+
+    def test_gang_members_stay_adjacent_within_turn(self):
+        """A gang bigger than the DRR quantum holds the tenant's turn (gang
+        continuation): members never interleave with another tenant."""
+        store = ClusterStore()
+        from kubernetes_tpu.api.types import PodGroup as PG
+
+        quota(store, "team-a", {QUOTA_PODS: 10 ** 6}, weight=1)
+        quota(store, "team-b", {QUOTA_PODS: 10 ** 6}, weight=1)
+        store.create_object("PodGroup", PG(
+            meta=ObjectMeta(name="gang", namespace="team-a"), min_member=8))
+        s, clock = sched_with_clock(store)
+        for i in range(8):
+            pod(store, f"g{i}", ns="team-a", group="gang")
+        for i in range(16):
+            pod(store, f"b{i}", ns="team-b")
+        order = [s.queue.pop() for _ in range(24)]
+        gang_positions = [i for i, qp in enumerate(order)
+                          if qp.pod.meta.labels.get(
+                              "scheduling.x-k8s.io/pod-group")]
+        assert gang_positions == list(range(
+            gang_positions[0], gang_positions[0] + 8))
+
+    def test_solo_tenant_accrues_no_debt(self):
+        """Uncontended pops (single-bucket fast path) charge no deficit:
+        a tenant that drained 50 pods alone is NOT starved for 50 pops of
+        payback when a second tenant appears — shares are proportional
+        immediately."""
+        store = ClusterStore()
+        quota(store, "solo", {QUOTA_PODS: 10 ** 6}, weight=1)
+        quota(store, "late", {QUOTA_PODS: 10 ** 6}, weight=1)
+        s, clock = sched_with_clock(store)
+        for i in range(100):
+            pod(store, f"s{i}", ns="solo")
+        for _ in range(50):  # solo drains alone
+            assert s.queue.pop().pod.meta.namespace == "solo"
+        assert s.queue._deficit.get("solo", 0.0) >= 0.0  # no banked debt
+        for i in range(50):
+            pod(store, f"l{i}", ns="late")
+        popped = {"solo": 0, "late": 0}
+        for _ in range(40):
+            popped[s.queue.pop().pod.meta.namespace] += 1
+        # equal weights: the former solo tenant gets ~half of the window
+        assert popped["solo"] >= 14, popped
+
+    def test_priority_order_preserved_within_tenant(self):
+        store = ClusterStore()
+        quota(store, "team-a", {QUOTA_PODS: 10 ** 6}, weight=1)
+        s, clock = sched_with_clock(store)
+        pod(store, "low", ns="team-a", prio=0)
+        pod(store, "high", ns="team-a", prio=100)
+        first = s.queue.pop()
+        assert first.pod.meta.name == "high"
+
+    def test_no_quota_namespaces_keep_legacy_order(self):
+        """Without tenants the queue is byte-identical to the legacy single
+        heap: strict (-priority, timestamp) order."""
+        store = ClusterStore()
+        s, clock = sched_with_clock(store)
+        pod(store, "a", prio=1)
+        clock.advance(0.01)
+        pod(store, "b", prio=5)
+        clock.advance(0.01)
+        pod(store, "c", prio=1)
+        names = [s.queue.pop().pod.meta.name for _ in range(3)]
+        assert names == ["b", "a", "c"]
+        assert s.queue._active_ns == {}  # the DRR layer never engaged
+
+    def test_fair_share_turn_metric(self):
+        weights = {"team-a": 1, "team-b": 1}
+        _store, s, _clock = self._flooded_queue(weights, per_tenant=10)
+        for _ in range(20):
+            s.queue.pop()
+        m = s.smetrics.fair_share_turns
+        assert m.labels("team-a") >= 1
+        assert m.labels("team-b") >= 1
+
+
+# ---------------------------------------------------------------------------
+# the batched path
+
+
+class TestBatchedQuotaGate:
+    def test_tpu_precheck_fails_over_quota_pod_without_device_slot(self):
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 2})
+        sched = TPUScheduler(store, batch_size=16)
+        for i in range(5):
+            pod(store, f"p{i}", ns="team-a")
+        sched.run_batched_until_settled()
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 2
+        pending = sched.queue.pending_pods()
+        assert pending["gated"] + pending["unschedulable"] == 3
+        plugin = next(iter(sched.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.usage("team-a")[QUOTA_PODS] == 2
+
+    def test_tpu_release_reactivation(self):
+        from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
+
+        store = ClusterStore()
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 1})
+        sched = TPUScheduler(store, batch_size=16)
+        pod(store, "p0", ns="team-a")
+        pod(store, "p1", ns="team-a")
+        sched.run_batched_until_settled()
+        bound = [p for p in store.pods.values() if p.spec.node_name]
+        assert len(bound) == 1
+        store.delete_pod(bound[0].key())
+        sched.run_batched_until_settled()
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 1
+
+
+class TestPreFilterStatus:
+    def test_quota_exceeded_is_unresolvable_and_typed(self):
+        store = ClusterStore()
+        quota(store, "team-a", {QUOTA_PODS: 0})
+        plugin = QuotaAdmission(client=store)
+        p = make_pod("p", namespace="team-a").req({"cpu": "1"}).obj()
+        _r, st = plugin.pre_filter(None, p)
+        assert not st.is_success()
+        assert st.code == 3  # UNSCHEDULABLE_AND_UNRESOLVABLE: no preemption
+        assert any(ERR_REASON_QUOTA_EXCEEDED in r for r in st.reasons)

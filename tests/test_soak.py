@@ -1,0 +1,150 @@
+"""SchedulingSoak — the multi-tenant production soak (ISSUE 8 tentpole e).
+
+Tier-1 runs the small variant on a FakeClock and asserts the acceptance
+SLOs: zero quota oversubscription at every sampled instant, each tenant's
+admitted share within 20% of its quota-weighted fair share, and a flooding
+tenant unable to push a calm tenant's p99 queue wait above 2x its solo
+baseline. The reference-size variant (gangs + claims + preemption + device
+flap on the batched path, oracle<->tpu parity) is slow-marked.
+"""
+
+import pytest
+
+from kubernetes_tpu.perf import TEST_CASES, run_workload
+from kubernetes_tpu.perf.harness import Runner
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def _items_by_name(items, name):
+    return [it for it in items if it.labels.get("Name") == name]
+
+
+def _invariants(items):
+    (inv,) = _items_by_name(items, "SoakInvariants")
+    return inv.data
+
+
+def _tenant_map(items):
+    return {it.labels["namespace"]: it.data
+            for it in _items_by_name(items, "SoakTenant")}
+
+
+def _assert_fair_shares(tenants, tol=0.2):
+    """Each tenant's admitted share within ``tol`` (relative) of its
+    quota-weighted fair share — the ISSUE 8 fairness bound."""
+    total = sum(t["Admitted"] for t in tenants.values())
+    total_w = sum(t["Weight"] for t in tenants.values())
+    assert total > 0 and total_w > 0
+    for ns, t in tenants.items():
+        fair = t["Weight"] / total_w
+        share = t["Admitted"] / total
+        # +2/total: integer-granularity slack for the tiny tier-1 variant
+        assert abs(share - fair) <= tol * fair + 2 / total, (
+            f"{ns}: admitted share {share:.3f} deviates more than "
+            f"{tol:.0%} from quota-weighted fair share {fair:.3f}")
+
+
+class TestSchedulingSoakSmall:
+    """The tier-1 variant: oracle backend, FakeClock, 32 nodes."""
+
+    def _run(self, **kw):
+        tc = TEST_CASES["SchedulingSoak"](
+            nodes=32, rounds=4, scale=6, cycles_per_round=80,
+            flap=False, tick_s=0.05, **kw)
+        return run_workload(tc, backend="oracle", now_fn=FakeClock())
+
+    def test_zero_oversubscription_and_fairness(self):
+        items = self._run()
+        inv = _invariants(items)
+        # sampled after every cycle and every churn wave: the ledger never
+        # exceeded any tenant's hard cap on any dimension, at any instant
+        assert inv["OversubscriptionViolations"] == 0.0
+        # sustained over-cap arrivals: the gate parked a backlog
+        assert inv["GatedAtEnd"] > 0
+        tenants = _tenant_map(items)
+        assert set(tenants) == {"soak-a", "soak-b", "soak-c"}
+        _assert_fair_shares(tenants)
+
+    def test_attempt_latency_slo(self):
+        """p99 scheduling-attempt latency SLO over the whole soak (the
+        wall-clock histogram, not the FakeClock): the small oracle variant
+        must stay under 1s even on a starved CI box."""
+        items = self._run()
+        atts = [it for it in _items_by_name(
+                    items, "scheduling_attempt_duration_seconds")
+                if it.labels.get("result") == "scheduled"]
+        assert atts, "no scheduled-attempt latency item"
+        assert all(it.data["Perc99"] < 1.0 for it in atts)
+
+    def test_flooding_tenant_p99_bound(self):
+        """A 10x-flooding tenant cannot push the calm tenant's p99 queue
+        wait above 2x its solo baseline (deterministic on the FakeClock:
+        every cycle ticks 0.05s, so waits count scheduling cycles)."""
+
+        def soak(mix):
+            clock = FakeClock()
+            r = Runner(backend="oracle", now_fn=clock)
+            try:
+                r.create_nodes(count=32, zones=4)
+                r.create_quota(namespace="calm",
+                               hard={"pods": 10 ** 6}, weight=2)
+                r.create_quota(namespace="flood",
+                               hard={"pods": 10 ** 6}, weight=1)
+                r.soak_phase(rounds=4, mix=mix, cycles_per_round=80,
+                             tick_s=0.05)
+                return _tenant_map(r.data_items)
+            finally:
+                r.close()
+
+        calm = {"namespace": "calm", "count": 10,
+                "req": {"cpu": "100m", "memory": "500Mi"}}
+        solo = soak([calm])
+        flooded = soak([calm, {"namespace": "flood", "count": 100,
+                               "req": {"cpu": "100m", "memory": "500Mi"}}])
+        solo_p99 = solo["calm"]["WaitP99"]
+        assert solo_p99 > 0
+        assert flooded["calm"]["Admitted"] == solo["calm"]["Admitted"]
+        assert flooded["calm"]["WaitP99"] <= 2.0 * solo_p99, (
+            f'flooded p99 {flooded["calm"]["WaitP99"]} vs '
+            f"solo {solo_p99}")
+
+
+class TestSchedulingSoakTPU:
+    """The batched path in tier-1: same small shape plus the scripted
+    device flap and the cycle-sampled oracle comparer."""
+
+    def test_flap_degrades_and_heals_with_parity(self):
+        tc = TEST_CASES["SchedulingSoak"](
+            nodes=32, rounds=4, scale=6, cycles_per_round=40, tick_s=0.05)
+        items = run_workload(tc, backend="tpu", now_fn=FakeClock(),
+                             comparer_every_n=2)
+        inv = _invariants(items)
+        assert inv["OversubscriptionViolations"] == 0.0
+        # the flap fired and was consumed through the real relay-death path
+        assert inv["FlapBatches"] > 0
+        assert inv["DegradedSeconds"] > 0
+        # the soak survived it: tenants kept being admitted, fairly
+        tenants = _tenant_map(items)
+        assert sum(t["Admitted"] for t in tenants.values()) > 0
+        _assert_fair_shares(tenants)
+        # oracle<->tpu placement parity maintained across the whole soak
+        assert inv["ComparerChecks"] > 0
+        assert inv["ComparerMismatches"] == 0.0
+
+
+@pytest.mark.slow
+class TestSchedulingSoakLarge:
+    def test_reference_size_mixed_soak(self):
+        """The reference-size row (kept out of tier-1: slow): 1000 nodes,
+        gangs + DRA claims + preemptors + one scripted device flap on the
+        tpu backend, oracle<->tpu parity sampled throughout."""
+        tc = TEST_CASES["SchedulingSoak"]()
+        items = run_workload(tc, backend="tpu", comparer_every_n=8)
+        inv = _invariants(items)
+        assert inv["OversubscriptionViolations"] == 0.0
+        assert inv["FlapBatches"] > 0
+        assert inv["ComparerChecks"] > 0
+        assert inv["ComparerMismatches"] == 0.0
+        _assert_fair_shares(_tenant_map(items))
+        tput = _items_by_name(items, "SchedulingSoak")
+        assert tput and tput[0].data["Average"] > 0

@@ -8,7 +8,12 @@ whole gate. A test function counts as perf-scale when it
 
   * passes ``nodes=<constant >= 1000>`` to any call, or
   * invokes a ``TEST_CASES[...](...)`` workload factory WITHOUT a ``nodes``
-    override — the factory defaults are the reference 5000Nodes sizes.
+    override — the factory defaults are the reference 5000Nodes sizes, or
+  * invokes ``TEST_CASES["SchedulingSoak"](...)`` at soak scale: the soak's
+    cost grows with ``rounds``x``scale``x``cycles_per_round``, not node
+    count, so a "small-nodes" soak with reference-size soak knobs
+    (``scale >= 16`` or ``rounds >= 16``, or either left at its default)
+    still must be slow-marked.
 
 A test is "marked slow" when the function, its class, or the module-level
 ``pytestmark`` carries ``pytest.mark.slow``.
@@ -27,6 +32,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 TESTS = os.path.join(REPO, "tests")
 
 PERF_SCALE_NODES = 1000
+# soak knobs at/above these are reference-size regardless of node count
+SOAK_SCALE = 16
+SOAK_ROUNDS = 16
 
 
 def _is_slow_mark(node: ast.AST) -> bool:
@@ -53,6 +61,26 @@ def _module_marked_slow(tree: ast.Module) -> bool:
     return False
 
 
+def _test_cases_key(call: ast.Call):
+    """The workload name of a ``TEST_CASES["X"](...)`` call, else None."""
+    if not (isinstance(call.func, ast.Subscript)
+            and isinstance(call.func.value, ast.Name)
+            and call.func.value.id == "TEST_CASES"):
+        return None
+    sl = call.func.slice
+    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+        return sl.value
+    return ""  # dynamic key: still a TEST_CASES call
+
+
+def _int_kw(call: ast.Call, name: str):
+    for k in call.keywords:
+        if (k.arg == name and isinstance(k.value, ast.Constant)
+                and isinstance(k.value.value, int)):
+            return k.value.value
+    return None
+
+
 def _is_perf_scale(fn: ast.FunctionDef) -> bool:
     for node in ast.walk(fn):
         if not isinstance(node, ast.Call):
@@ -64,11 +92,17 @@ def _is_perf_scale(fn: ast.FunctionDef) -> bool:
                     and k.value.value >= PERF_SCALE_NODES):
                 return True
         # TEST_CASES["X"](...) with the reference-size defaults
-        if (isinstance(node.func, ast.Subscript)
-                and isinstance(node.func.value, ast.Name)
-                and node.func.value.id == "TEST_CASES"
-                and "nodes" not in kw_names):
+        key = _test_cases_key(node)
+        if key is not None and "nodes" not in kw_names:
             return True
+        # the soak scales with its arrival knobs, not node count: a small-
+        # nodes call with default (or reference-size) scale/rounds is still
+        # the large variant
+        if key == "SchedulingSoak":
+            scale, rounds = _int_kw(node, "scale"), _int_kw(node, "rounds")
+            if (scale is None or scale >= SOAK_SCALE
+                    or rounds is None or rounds >= SOAK_ROUNDS):
+                return True
     return False
 
 

@@ -24,6 +24,31 @@ _REGRESSION_PCT = 20.0
 # regression baselines
 _INVALID_ROUNDS = {1, 2}
 
+# Platform epochs: the first round measured on a NEW execution-environment
+# class. Rounds before a boundary are not comparable baselines for rounds
+# at/after it — same-box A/B is the only honest comparison across such a
+# change. Declared explicitly (like _INVALID_ROUNDS and the per-workload
+# tolerance overrides) so the fence never quietly decides on its own that
+# a uniform slowdown is "just the box": adding an entry here IS the
+# reviewed human judgment, and the fence treats the boundary round's
+# missing baseline as a documented state, not an accidental one.
+#   r06: bench moved to a 2-core CI container ~4x slower than the r01-r05
+#   box (uniform drop across ALL workloads incl. ones the r06 diff never
+#   touched; interleaved same-container A/B of the r06 code vs its parent
+#   commit showed parity).
+PLATFORM_EPOCHS = {6: "2-core CI container (r06+); r01-r05 ran on a "
+                      "~4x faster box"}
+
+
+def _epoch_start(round_no=None) -> int:
+    """First round of the platform epoch ``round_no`` belongs to (0 = the
+    original epoch). A record with no round number — a fresh, not-yet-
+    committed bench run — is by definition measured on the CURRENT
+    environment class, i.e. the newest epoch."""
+    if not isinstance(round_no, int):
+        return max(PLATFORM_EPOCHS, default=0)
+    return max((s for s in PLATFORM_EPOCHS if s <= round_no), default=0)
+
 
 def round_files() -> List[tuple]:
     """Sorted ``(round, path)`` for every committed BENCH_r*.json — the ONE
@@ -102,9 +127,12 @@ def _flag_regressions(rows: List[dict]) -> List[str]:
         return []
     cur = rows[-1]
     fam = "cpu" if str(cur["platform"]).startswith("cpu") else "acc"
+    epoch = _epoch_start(cur.get("round"))
     prior = [r for r in rows[:-1]
              if (str(r["platform"]).startswith("cpu")) == (fam == "cpu")
-             and r.get("round") not in _INVALID_ROUNDS]
+             and r.get("round") not in _INVALID_ROUNDS
+             and (not isinstance(r.get("round"), int)
+                  or r["round"] >= epoch)]
     if not prior:
         return []
     flags = []
@@ -164,13 +192,23 @@ def fence(current: dict, rounds: Optional[List[dict]] = None) -> dict:
     "tolerances"}; an empty violations list means the fence holds."""
     if rounds is None:
         rounds = _load_rounds()
-    prior = [r for r in rounds
-             if r.get("_round") not in _INVALID_ROUNDS
-             and _same_platform(r, current)]
+    epoch = _epoch_start(current.get("_round"))
+    comparable = [r for r in rounds
+                  if r.get("_round") not in _INVALID_ROUNDS
+                  and _same_platform(r, current)]
+    prior = [r for r in comparable if r.get("_round", 0) >= epoch]
     if not prior:
-        return {"baselineRound": None, "checked": 0, "violations": [],
-                "tolerances": FENCE_TOLERANCES,
-                "note": "no valid same-platform baseline round"}
+        out = {"baselineRound": None, "checked": 0, "violations": [],
+               "tolerances": FENCE_TOLERANCES,
+               "note": "no valid same-platform baseline round"}
+        if comparable and epoch in PLATFORM_EPOCHS:
+            # emptiness is the DECLARED epoch boundary, not accidental
+            # baseline loss: earlier rounds exist but were measured on a
+            # different environment class (see PLATFORM_EPOCHS)
+            out["epochBoundary"] = PLATFORM_EPOCHS[epoch]
+            out["note"] = (f"first comparable round of platform epoch "
+                           f"r{epoch:02d}: {PLATFORM_EPOCHS[epoch]}")
+        return out
     base = prior[-1]
     violations: List[str] = []
     checked = 0
