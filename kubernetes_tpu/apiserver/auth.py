@@ -338,7 +338,8 @@ class FlowController:
         level_name = self.classify(user, groups, verb)
         level = self.levels[level_name]
         if level.exempt:
-            self.dispatched_total[level_name] += 1
+            with self._lock:  # += on a shared counter is read-modify-write
+                self.dispatched_total[level_name] += 1
             return lambda: None
         deadline = None
         with self._cond:

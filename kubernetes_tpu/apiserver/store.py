@@ -106,7 +106,9 @@ class Watch:
 
 class ClusterStore:
     def __init__(self):
-        self._lock = threading.RLock()
+        from ..testing import locktrace
+
+        self._lock = locktrace.make_rlock("ClusterStore")
         self.pods: Dict[str, Pod] = {}
         self.nodes: Dict[str, Node] = {}
         self.namespaces: Dict[str, Namespace] = {}
@@ -198,7 +200,7 @@ class ClusterStore:
     def add_event_handler(self, kind: str, handler: Handler) -> None:
         self._handlers.setdefault(kind, []).append(handler)
 
-    def _journal_event(self, kind: str, event: str, old, new) -> None:
+    def _journal_event(self, kind: str, event: str, old, new) -> None:  # ktpu: locked
         """Append to the watch journal + push to live watchers. MUST be
         called inside the mutator's critical section so the journal order
         matches the map mutation order (else concurrent writers could
@@ -290,7 +292,7 @@ class ClusterStore:
 
         return _Ctx()
 
-    def _bump(self, obj) -> None:
+    def _bump(self, obj) -> None:  # ktpu: locked
         self._rv += 1
         obj.meta.resource_version = self._rv
         if not obj.meta.creation_timestamp:
@@ -334,7 +336,7 @@ class ClusterStore:
         """Every kind the store persists (the WAL snapshot's catalog)."""
         return tuple(self._kind_maps())
 
-    def _kind_maps(self) -> Dict[str, Dict[str, object]]:
+    def _kind_maps(self) -> Dict[str, Dict[str, object]]:  # ktpu: locked
         return {
                 "Pod": self.pods,
                 "Node": self.nodes,
@@ -402,7 +404,7 @@ class ClusterStore:
         self._notify("Node", ADDED, None, node)
 
     def update_node(self, node: Node) -> None:
-        def commit(old):
+        def commit(old):  # ktpu: locked
             if old is None:
                 raise NotFound(node.meta.name)
             self._bump(node)
@@ -410,7 +412,7 @@ class ClusterStore:
             self._journal_event("Node", MODIFIED, old, node)
 
         old = self._guarded_update("Node", node,
-                                   lambda: self.nodes.get(node.meta.name), commit)
+                                   lambda: self.nodes.get(node.meta.name), commit)  # ktpu: unguarded-ok(the lookup closure runs under the lock inside _guarded_update)
         self._notify("Node", MODIFIED, old, node)
 
     def delete_node(self, name: str) -> None:
@@ -444,14 +446,14 @@ class ClusterStore:
         self._notify("Pod", ADDED, None, pod)
 
     def update_pod(self, pod: Pod) -> None:
-        def commit(old):
+        def commit(old):  # ktpu: locked
             if old is None:
                 raise NotFound(pod.key())
             self._bump(pod)
             self.pods[pod.key()] = pod
             self._journal_event("Pod", MODIFIED, old, pod)
 
-        old = self._guarded_update("Pod", pod, lambda: self.pods.get(pod.key()),
+        old = self._guarded_update("Pod", pod, lambda: self.pods.get(pod.key()),  # ktpu: unguarded-ok(the lookup closure runs under the lock inside _guarded_update)
                                    commit)
         self._notify("Pod", MODIFIED, old, pod)
 
@@ -543,14 +545,14 @@ class ClusterStore:
         if kind in self.CLUSTER_SCOPED_KINDS or kind in (
                 "CustomResourceDefinition", "APIService"):
             return True
-        return kind in self._custom_scope and not self._custom_scope[kind]
+        return kind in self._custom_scope and not self._custom_scope[kind]  # ktpu: unguarded-ok(grow-only registration dict; read from both locked and HTTP-front contexts)
 
     def _key_of(self, kind: str, obj) -> str:
         return obj.meta.name if self.is_cluster_scoped(kind) else obj.meta.key()
 
     # -------------------------------------------------------- dynamic kinds
 
-    def _register_crd_kind(self, crd) -> None:
+    def _register_crd_kind(self, crd) -> None:  # ktpu: locked
         """Kind-map registration half of create_crd — also used by WAL
         restore, where CRD objects re-enter through the raw kind map and
         must re-register their served kinds before any custom object."""
@@ -754,7 +756,7 @@ class ClusterStore:
         """Guarded update: fails unless the stored lease still has
         ``expect_rv`` (GuaranteedUpdate's optimistic concurrency,
         etcd3/store.go:328 — what makes leader election safe)."""
-        self._admit_update("Lease", self.leases.get(lease.meta.key()), lease)
+        self._admit_update("Lease", self.leases.get(lease.meta.key()), lease)  # ktpu: unguarded-ok(optimistic-concurrency read; the locked section re-checks resourceVersion)
         with self._lock:
             old = self.leases.get(lease.meta.key())
             if old is None:

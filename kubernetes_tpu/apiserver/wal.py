@@ -79,6 +79,14 @@ class WriteAheadLog:
         # line, so replay can tell a torn tail (the process died mid-write,
         # etcd walpb.Record's CRC role) from a clean record
         line = f"{zlib.crc32(body.encode()):08x} {body}\n"
+        # deliberate blocking-under-lock: append runs inside the store
+        # mutator's critical section BY CONTRACT (journal order must match
+        # map mutation order — see ClusterStore._journal_event)
+        from ..testing import locktrace
+
+        locktrace.note_blocking(
+            "wal_append", self.path,
+            allowed="WAL order must match the store journal order")
         with self._lock:
             self._f.write(line)
             self._f.flush()

@@ -138,4 +138,9 @@ class RetryPolicy:
                             max(self.deadline_s - elapsed, 0.0))
                 if self.on_retry is not None:
                     self.on_retry(op)
+                # a retry sleep under any component lock would wedge that
+                # component for the whole backoff — locktrace flags it
+                from ..testing.locktrace import note_blocking
+
+                note_blocking("sleep", f"retry backoff: {op}")
                 self.sleep_fn(delay)

@@ -121,7 +121,9 @@ def pb2():
                      _PROTO_DIR, _PROTO],
                     check=True, capture_output=True, timeout=60)
             except FileNotFoundError as e:
-                raise RuntimeError(
+                # typed: deterministic config/availability failure — a
+                # retry of the identical call cannot help
+                raise PermanentDeviceError(
                     "vendored ktpu_device_pb2 is stale or missing and protoc "
                     "is not installed; run `python tools/gen_pb2.py`") from e
         import importlib.util

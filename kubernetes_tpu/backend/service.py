@@ -53,6 +53,7 @@ from ..framework.types import Diagnosis, NodeInfo, QueuedPodInfo
 from ..framework.interface import CycleState, Status
 from ..ops.encode import CapacityError
 from ..scheduler.scheduler import Scheduler
+from ..testing import locktrace
 from ..utils import tracing
 from . import telemetry
 from .batch import build_schedule_batch_fn
@@ -188,7 +189,7 @@ class DeviceService:
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
         self._start_carry = None  # adaptive-sampling rotation (device scalar)
-        self._lock = threading.Lock()
+        self._lock = locktrace.make_lock("DeviceService")
 
     # ------------------------------------------------------------- epoch
 
@@ -201,17 +202,17 @@ class DeviceService:
         if expect and expect != self.epoch and not req.get("full"):
             raise StaleEpochError(self.epoch)
 
-    def _stamp(self, out: dict) -> dict:
+    def _stamp(self, out: dict) -> dict:  # ktpu: locked
         out["epoch"] = self.epoch
         out["deltaSeq"] = self.delta_seq
         return out
 
     # ------------------------------------------------------------ sessions
 
-    def _live_sessions(self) -> List[ClientSession]:
+    def _live_sessions(self) -> List[ClientSession]:  # ktpu: locked
         return [s for s in self.sessions.values() if not s.fenced]
 
-    def _session_for(self, req: dict) -> ClientSession:
+    def _session_for(self, req: dict) -> ClientSession:  # ktpu: locked
         """Resolve (creating/rejoining as needed) the request's session and
         touch its lease. Caller holds the lock. Raises ConflictError for a
         fenced incarnation: a dead-declared client must rejoin (fresh
@@ -241,7 +242,7 @@ class DeviceService:
         s.last_seen = now
         return s
 
-    def _sweep_leases(self, now: float) -> None:
+    def _sweep_leases(self, now: float) -> None:  # ktpu: locked
         """Fence every named session whose lease expired. Anonymous
         (legacy, clientId-less) sessions never expire — they are the
         single-client demo topology and send no heartbeats."""
@@ -251,7 +252,7 @@ class DeviceService:
             if now - s.last_seen > self.lease_ttl_s:
                 self._fence(s)
 
-    def _fence(self, s: ClientSession) -> None:
+    def _fence(self, s: ClientSession) -> None:  # ktpu: locked
         """Declare a client dead: poison its idempotency cache server-side
         (a late transport retry of its last batch will NOT be replayed),
         and release its adopted-but-unconfirmed rows so a survivor adopts
@@ -284,7 +285,7 @@ class DeviceService:
                         batchId=last_batch_id,
                         releasedHolds=s.released_holds - released_before)
 
-    def _prune_fences(self) -> None:
+    def _prune_fences(self) -> None:  # ktpu: locked
         """Bound the fence bookkeeping (lock held): default client ids are
         unique per scheduler process, so routine replica redeploys would
         otherwise accrete one dead ClientSession (O(nodes) sent_gens) and
@@ -376,13 +377,17 @@ class DeviceService:
             return self._apply_deltas_traced(req)
 
     def _apply_deltas_traced(self, req: dict) -> dict:
+        # decode OUTSIDE the lock: the wire payload is request-local and the
+        # from_wire walk is O(nodes × pods) pure-CPU work — holding the
+        # service lock across it starves peer replicas' heartbeats for no
+        # consistency gain (found by the locktrace hold-time review)
+        decoded = []
+        for e in req.get("nodes", ()):
+            node = from_wire(Node, e["node"])
+            pods = [from_wire(Pod, pw) for pw in e.get("pods", ())]
+            decoded.append((node, pods, e.get("gen")))
         with self._lock:
             s = self._session_for(req)
-            decoded = []
-            for e in req.get("nodes", ()):
-                node = from_wire(Node, e["node"])
-                pods = [from_wire(Pod, pw) for pw in e.get("pods", ())]
-                decoded.append((node, pods, e.get("gen")))
             if req.get("full"):
                 # full resync replaces THIS client's contribution only. A
                 # mirror node no other live session claims and the full set
@@ -459,7 +464,7 @@ class DeviceService:
                                 "nodes": len(self.infos),
                                 "sessionGen": s.gen})
 
-    def _drop_node(self, name: str) -> None:
+    def _drop_node(self, name: str) -> None:  # ktpu: locked
         """Remove a node and every index/hold anchored to it (lock held)."""
         self.infos.pop(name, None)
         for key in self._node_pod_keys.pop(name, ()):
@@ -469,11 +474,11 @@ class DeviceService:
             if hold.node_name == name:
                 del self.holds[key]
 
-    def _ensure_device(self) -> None:
+    def _ensure_device(self) -> None:  # ktpu: locked
         import dataclasses
 
         n = max(len(self.infos), 1)
-        ns_fn = lambda ns: self.ns_labels.get(ns, {})  # noqa: E731
+        ns_fn = lambda ns: self.ns_labels.get(ns, {})  # noqa: E731  # ktpu: unguarded-ok(invoked by device.sync, which only runs under the service lock)
         if self.device is None:
             self.device = DeviceState(caps_for_cluster(n, batch=self.batch_size),
                                       ns_labels_fn=ns_fn)
@@ -487,18 +492,26 @@ class DeviceService:
                 value_words=max(caps.value_words, (nodes + 2 + 31) // 32)),
                 ns_labels_fn=ns_fn)
 
-    def _sync(self) -> None:
+    def _sync(self) -> None:  # ktpu: locked
         self._ensure_device()
         for _attempt in range(8):
             try:
+                # deliberate blocking-under-lock: the mirror the device syncs
+                # from must not change until the batch that judged against it
+                # commits — the commit-time validation contract
+                locktrace.note_blocking(
+                    "device_sync", "DeviceService.sync",
+                    allowed="mirror must stay frozen from sync to commit")
                 with tracing.span("device.sync"):
                     self.device.sync(self.snap)
                 return
             except CapacityError as e:
                 self._grow(e)
-        raise RuntimeError("device capacities refuse to converge")
+        # typed per the taxonomy: deterministic (the same delta re-raises),
+        # so the client must never burn retry budget on it
+        raise PermanentDeviceError("device capacities refuse to converge")
 
-    def _grow(self, err: CapacityError) -> None:
+    def _grow(self, err: CapacityError) -> None:  # ktpu: locked
         import dataclasses
 
         caps = self.device.caps
@@ -506,7 +519,8 @@ class DeviceService:
         if fields is None and err.dimension.startswith("value vocab"):
             fields = ("value_words",)
         if fields is None:
-            raise RuntimeError(f"unknown capacity dimension {err.dimension!r}") from err
+            raise PermanentDeviceError(
+                f"unknown capacity dimension {err.dimension!r}") from err
         updates = {}
         for f in fields:
             v = getattr(caps, f)
@@ -515,7 +529,7 @@ class DeviceService:
             updates[f] = v
         self.device = DeviceState(
             dataclasses.replace(caps, **updates),
-            ns_labels_fn=lambda ns: self.ns_labels.get(ns, {}))
+            ns_labels_fn=lambda ns: self.ns_labels.get(ns, {}))  # ktpu: unguarded-ok(invoked by device.sync, which only runs under the service lock)
 
     # --------------------------------------------------------------- health
     def health(self, req: dict) -> dict:
@@ -575,7 +589,7 @@ class DeviceService:
     def _validate_placements(self, cid: str, pods: List[Pod],
                              node_idx: np.ndarray,
                              slot_names: Dict[int, str],
-                             batch_id=None) -> Dict[int, str]:
+                             batch_id=None) -> Dict[int, str]:  # ktpu: locked
         """Ownership check (lock held): every proposed placement is judged
         against current ownership and occupancy AT COMMIT TIME. Accepted
         placements become holds (overlaid into the mirror immediately, so
@@ -646,7 +660,8 @@ class DeviceService:
                 except CapacityError as e:
                     self._grow(e)
             else:
-                raise RuntimeError("device capacities refuse to converge")
+                raise PermanentDeviceError(
+                    "device capacities refuse to converge")
             host_pb = self.device.encoder.last_host_pb
             self.batch_counter += 1
             # sampling parity with the in-process batched path: explicit
@@ -685,6 +700,13 @@ class DeviceService:
             bucket = int(getattr(pb, "capacity", len(pods)))
             telemetry.event("dispatch", batchId=batch_id, client=cid,
                             epoch=self.epoch, bucket=bucket, pods=len(pods))
+            # deliberate blocking-under-lock: dispatch+commit must run against
+            # exactly the synced mirror — releasing here would let a peer's
+            # delta interleave between the kernel's view and the ownership
+            # check, re-opening the double-bind window PR 6 closed
+            locktrace.note_blocking(
+                "device_dispatch", "DeviceService.schedule_batch",
+                allowed="kernel must judge under the same lock as commit")
             with tracing.span("device.dispatch", batch=len(pods)):
                 sig = f"{bucket}/" + (
                     "general" if self.device.topo_enabled else "off")
@@ -803,8 +825,12 @@ class DeviceService:
                         # still helps (preferred-node fast path)
                         r["preempt"] = {"candidates": None, "best": best_name}
                 results.append(r)
-        return self._stamp({"apiVersion": API_VERSION, "results": results,
-                            "sessionGen": s.gen})
+            # stamp INSIDE the lock: epoch/deltaSeq are mutated by
+            # concurrent apply_deltas calls from peer replicas — stamping
+            # after release could pair this batch's results with a peer's
+            # half-advanced deltaSeq (found by the locks pass)
+            return self._stamp({"apiVersion": API_VERSION, "results": results,
+                                "sessionGen": s.gen})
 
 
 # ---------------------------------------------------------------- transport
@@ -948,6 +974,9 @@ class WireClient:
         self.fault_plan = fault_plan
 
     def _do_post(self, path: str, data: bytes) -> dict:
+        # socket IO must never run under a traced lock (a slow device
+        # service would wedge whatever component held it)
+        locktrace.note_blocking("http", path)
         conn = self._conn_cls(self._host, self._port,
                               timeout=self.connect_timeout)
         try:

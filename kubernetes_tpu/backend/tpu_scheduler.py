@@ -37,6 +37,7 @@ from ..ops.encode import CapacityError
 from ..scheduler.scheduler import Scheduler
 from .batch import BatchResult, build_schedule_batch_fn
 from .device_state import DeviceState, caps_for_cluster
+from .errors import PermanentDeviceError
 
 # filter config order for failure attribution (default_plugins.go filter order)
 _ATTRIBUTION_ORDER = (
@@ -483,7 +484,9 @@ class TPUScheduler(Scheduler):
         if fields is None and err.dimension.startswith("value vocab"):
             fields = ("value_words",)
         if fields is None:
-            raise RuntimeError(f"unknown capacity dimension {err.dimension!r}") from err
+            # typed per backend/errors.py: deterministic, never retried
+            raise PermanentDeviceError(
+                f"unknown capacity dimension {err.dimension!r}") from err
         updates = {}
         for f in fields:
             v = getattr(caps, f)
@@ -687,7 +690,7 @@ class TPUScheduler(Scheduler):
         elif self._profiling and self.batch_counter >= self._profile_batches:
             try:
                 jax.profiler.stop_trace()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — a torn profiler trace must not kill the batch path
                 pass
             self._profiling = False
             self._profile_dir = ""
@@ -1631,7 +1634,7 @@ class TPUScheduler(Scheduler):
         if self._profiling:  # fewer batches than the window: flush the trace
             try:
                 jax.profiler.stop_trace()
-            except Exception:  # noqa: BLE001
+            except Exception:  # noqa: BLE001 — a torn profiler trace must not fail the settle
                 pass
             self._profiling = False
             self._profile_dir = ""

@@ -20,13 +20,13 @@ same generation stream drives the TPU backend's delta uploads.
 
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..api.types import Node, Pod
 from ..framework.types import NodeInfo, next_generation
+from ..testing import locktrace
 from .snapshot import Snapshot
 
 DEFAULT_ASSUME_TTL = 30.0  # durationToExpireAssumedPod (scheduler.go:311)
@@ -42,7 +42,7 @@ class _PodState:
 
 class Cache:
     def __init__(self, ttl: float = DEFAULT_ASSUME_TTL, now_fn=time.monotonic):
-        self._lock = threading.RLock()
+        self._lock = locktrace.make_rlock("Cache")
         self.ttl = ttl
         self.now_fn = now_fn
         self.nodes: Dict[str, NodeInfo] = {}
@@ -183,14 +183,14 @@ class Cache:
                 self._dirty.discard(node_name)
                 self._removed.add(node_name)
 
-    def _node_info(self, node_name: str) -> NodeInfo:
+    def _node_info(self, node_name: str) -> NodeInfo:  # ktpu: locked
         ni = self.nodes.get(node_name)
         if ni is None:
             ni = NodeInfo()  # pod arrived before its node: ghost entry
             self.nodes[node_name] = ni
         return ni
 
-    def _add_pod_to_node(self, pod: Pod, node_name: str) -> None:
+    def _add_pod_to_node(self, pod: Pod, node_name: str) -> None:  # ktpu: locked
         if node_name:
             self._node_info(node_name).add_pod(pod)
             self._dirty.add(node_name)
@@ -198,7 +198,7 @@ class Cache:
             prio = pod.spec.priority
             self._prio_counts[prio] = self._prio_counts.get(prio, 0) + 1
 
-    def _remove_pod_from_node(self, pod: Pod, node_name: str) -> None:
+    def _remove_pod_from_node(self, pod: Pod, node_name: str) -> None:  # ktpu: locked
         ni = self.nodes.get(node_name)
         if ni is not None:
             ni.remove_pod(pod)
@@ -268,7 +268,7 @@ class Cache:
             snapshot.generation = max_gen
         return snapshot
 
-    def _horizon(self) -> int:
+    def _horizon(self) -> int:  # ktpu: locked
         """Oldest snapshot generation the dirty set can serve incrementally."""
         return self._sync_generation
 

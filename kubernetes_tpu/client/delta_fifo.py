@@ -41,7 +41,7 @@ class DeltaFIFO:
     def _key_of(self, obj) -> str:
         return self._key_fn(obj)
 
-    def _queue_action(self, action: str, obj) -> None:
+    def _queue_action(self, action: str, obj) -> None:  # ktpu: locked
         key = self._key_of(obj)
         deltas = self._items.get(key)
         if deltas is None:
@@ -52,7 +52,7 @@ class DeltaFIFO:
             self._dedup(key)
         self._lock.notify_all()
 
-    def _dedup(self, key: str) -> None:
+    def _dedup(self, key: str) -> None:  # ktpu: locked
         """Collapse two consecutive Deleted deltas (delta_fifo.go dedupDeltas)."""
         deltas = self._items[key]
         if len(deltas) >= 2 and deltas[-1].type == DELETED and deltas[-2].type == DELETED:

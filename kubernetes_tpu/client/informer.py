@@ -96,9 +96,12 @@ class SharedIndexInformer:
                 handler("add", None, obj)
 
     def start(self) -> None:
-        if self._started:
-            return
-        self._started = True
+        # check-and-set under the lock: two consumers starting the shared
+        # informer concurrently must not double-list (locks pass finding)
+        with self._lock:
+            if self._started:
+                return
+            self._started = True
         self.reflector.list_and_establish_watch()
         self.pump()
 
@@ -140,7 +143,9 @@ class SharedIndexInformer:
             h(event, old, new)
 
     def has_synced(self) -> bool:
-        return self._started and self.fifo.has_synced()
+        with self._lock:  # pairs with start()'s check-and-set
+            started = self._started
+        return started and self.fifo.has_synced()
 
     # -- lister surface
 
@@ -196,4 +201,6 @@ class SharedInformerFactory:
     def wait_for_cache_sync(self) -> bool:
         self.start()
         self.pump()
-        return all(inf.has_synced() for inf in self._informers.values())
+        with self._lock:  # registration may race the sync check
+            informers = list(self._informers.values())
+        return all(inf.has_synced() for inf in informers)

@@ -58,7 +58,9 @@ class RESTWatch:
 
     def _read_loop(self) -> None:
         try:
-            while not self.stopped:
+            # polling read: stop() tears the blocking readline via close(),
+            # so a stale read here costs one extra loop at most
+            while not self.stopped:  # ktpu: unguarded-ok(polling flag; stop() closes the socket to interrupt the blocking readline)
                 line = self._resp.readline()
                 if not line:
                     break  # server closed the stream
@@ -75,7 +77,7 @@ class RESTWatch:
                     self._events.append(ev)
                     self._cond.notify_all()
         except Exception as exc:  # noqa: BLE001 — transport death → Expired
-            self._error = exc
+            self._error = exc  # ktpu: unguarded-ok(published before the cond-guarded stopped flip in finally; readers check stopped first)
         finally:
             with self._cond:
                 self.stopped = True
@@ -95,7 +97,13 @@ class RESTWatch:
             return None
 
     def stop(self) -> None:
-        self.stopped = True
+        # flip under the cond + notify: a consumer parked in next()'s wait
+        # must wake NOW, not when the reader thread notices the closed
+        # socket (found by the locks pass: the unguarded write was only
+        # eventually published through the reader's finally block)
+        with self._cond:
+            self.stopped = True
+            self._cond.notify_all()
         try:
             self._resp.close()
         except OSError:

@@ -220,6 +220,9 @@ def build_debug_handlers(sched) -> dict:
                           ledger, HBM/transfer counters, and the bounded
                           event ring (backend/telemetry.py; enabled=False
                           when the telemetry layer is off)
+      /debug/locktrace    lock-order graph, acquisition counts, blocking
+                          events from testing/locktrace.py (enabled only
+                          under KTPU_LOCKTRACE=1)
       /debug/quota        per-namespace SchedulingQuota caps, the ledger's
                           live usage, fair-share weight, charged pod count
 
@@ -330,10 +333,22 @@ def build_debug_handlers(sched) -> dict:
             return {"enabled": False}
         return t.dump(limit)
 
+    def locktrace_dump(limit=None):
+        from ..testing import locktrace
+
+        if not locktrace.enabled():
+            return {"enabled": False}
+        out = locktrace.tracer().report()
+        out["enabled"] = True
+        out["cycles"] = locktrace.tracer().cycles()
+        return _capped_lists(out, limit,
+                             ("blockingViolations", "blockingAllowed"))
+
     return {"queue": queue_dump, "cache": cache_dump,
             "devicestate": device_dump, "spans": spans_dump,
             "circuit": circuit_dump, "sessions": sessions_dump,
-            "flightrecorder": flightrecorder_dump, "quota": quota_dump}
+            "flightrecorder": flightrecorder_dump, "quota": quota_dump,
+            "locktrace": locktrace_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,

@@ -43,7 +43,8 @@ class Counter:
             self._values[labels] = self._values.get(labels, 0.0) + value
 
     def labels(self, *labels: str) -> float:
-        return self._values.get(labels, 0.0)
+        with self._lock:  # scrape-side read races the scheduling thread's inc
+            return self._values.get(labels, 0.0)
 
     def label_sets(self) -> List[LabelValues]:
         with self._lock:
@@ -57,7 +58,8 @@ class Counter:
         return out
 
     def reset(self) -> None:
-        self._values.clear()
+        with self._lock:
+            self._values.clear()
 
 
 class Gauge(Counter):
@@ -128,7 +130,8 @@ class Histogram:
             return self._exemplars.get(labels, {}).get(bucket_index)
 
     def count(self, *labels: str) -> int:
-        return self._totals.get(labels, 0)
+        with self._lock:
+            return self._totals.get(labels, 0)
 
     def label_sets(self) -> List[LabelValues]:
         """Every label-value combination observed so far (the scrape-side
@@ -137,7 +140,8 @@ class Histogram:
             return list(self._totals)
 
     def sum(self, *labels: str) -> float:
-        return self._sums.get(labels, 0.0)
+        with self._lock:
+            return self._sums.get(labels, 0.0)
 
     def percentile(self, q: float, *labels: str) -> float:
         """Linear-interpolated percentile from bucket counts (scrape-side
@@ -216,10 +220,11 @@ class Histogram:
         return out
 
     def reset(self) -> None:
-        self._counts.clear()
-        self._sums.clear()
-        self._totals.clear()
-        self._exemplars.clear()
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._totals.clear()
+            self._exemplars.clear()
 
 
 class Registry:
@@ -237,7 +242,8 @@ class Registry:
             return metric
 
     def get(self, name: str):
-        return self._metrics.get(name)
+        with self._lock:
+            return self._metrics.get(name)
 
     def expose(self, openmetrics: bool = False) -> str:
         """Prometheus text exposition (the /metrics endpoint body). With
@@ -246,8 +252,10 @@ class Registry:
         0.0.4 text format is byte-identical to before (exemplars are not
         legal there)."""
         lines: List[str] = []
-        for name in sorted(self._metrics):
-            metric = self._metrics[name]
+        with self._lock:  # registration may race a scrape
+            metrics = dict(self._metrics)
+        for name in sorted(metrics):
+            metric = metrics[name]
             if isinstance(metric, Histogram):
                 lines.extend(metric.collect(openmetrics=openmetrics))
             else:  # counters/gauges have no exemplar surface
@@ -257,5 +265,7 @@ class Registry:
         return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
-        for m in self._metrics.values():
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
             m.reset()

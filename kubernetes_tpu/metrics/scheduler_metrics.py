@@ -262,7 +262,7 @@ class SchedulerMetrics:
         with self._unsched_lock:
             self._clear_unschedulable_locked(pod_key)
 
-    def _clear_unschedulable_locked(self, pod_key: str) -> None:
+    def _clear_unschedulable_locked(self, pod_key: str) -> None:  # ktpu: locked
         prev = self._unsched_pods.pop(pod_key, None)
         if prev is None:
             return

@@ -34,6 +34,7 @@ TPU batch path wants deterministic drain points anyway).
 from __future__ import annotations
 
 import bisect
+import functools
 import heapq
 import itertools
 import time
@@ -41,6 +42,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api.types import Pod
 from ..framework.types import ClusterEvent, QueuedPodInfo
+from ..testing import locktrace
 
 DEFAULT_POD_INITIAL_BACKOFF = 1.0
 DEFAULT_POD_MAX_BACKOFF = 10.0
@@ -52,6 +54,23 @@ DEFAULT_UNSCHEDULABLE_TIMEOUT = 300.0  # flushUnschedulablePodsLeftover, 5min
 DEFAULT_FAIR_QUANTUM = 4.0
 
 LessFn = Callable[[QueuedPodInfo], object]  # sort-key extractor
+
+
+def _locked(fn):
+    """Every public entry point runs under the queue's RLock: the queue is
+    mutated by the scheduling loop but READ by the serving threads
+    (/debug/queue dump, pending gauges) and, under the cmd topology, poked
+    by informer handlers. The lock is reentrant — public methods call each
+    other (update→add, pop→flush) — and comes from the locktrace factory so
+    the chaos suites can prove the queue participates in no lock-order
+    cycle. The lock-discipline pass treats @_locked bodies as guarded."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
 
 
 class SchedulingQueue:
@@ -115,6 +134,7 @@ class SchedulingQueue:
         self._drr_cur: Optional[str] = None
         self._gang_cont: Optional[Tuple[str, str]] = None
 
+        self._lock = locktrace.make_rlock("SchedulingQueue")
         self._counter = itertools.count()  # FIFO tie-break inside heaps
         self._active: List[Tuple[object, int, QueuedPodInfo]] = []
         self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []
@@ -142,7 +162,7 @@ class SchedulingQueue:
         ns = pod.meta.namespace
         return ns if self.ns_weight_fn(ns) is not None else None
 
-    def _push_active(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:
+    def _push_active(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:  # ktpu: locked
         key = qp.pod.key()
         if key in self._in_queue:
             return
@@ -157,7 +177,7 @@ class SchedulingQueue:
         self._in_queue.add(key)
         self._record_incoming("active", event)
 
-    def _push_backoff(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:
+    def _push_backoff(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:  # ktpu: locked
         key = qp.pod.key()
         if key in self._in_queue:
             return
@@ -178,7 +198,7 @@ class SchedulingQueue:
 
     # -------------------------------------------------------- pre-enqueue gate
 
-    def _park_gated(self, qp: QueuedPodInfo, event: Optional[str]) -> bool:
+    def _park_gated(self, qp: QueuedPodInfo, event: Optional[str]) -> bool:  # ktpu: locked
         """Run the PreEnqueue gate for a pod about to enter active/backoff.
         True = refused and parked gated in the unschedulable map (with the
         gating plugin attributed, so its release event can wake the pod)."""
@@ -203,6 +223,7 @@ class SchedulingQueue:
 
     # ------------------------------------------------------------- API
 
+    @_locked
     def add(self, pod: Pod) -> None:
         """New unscheduled pod (informer add) → activeQ (:300), unless the
         PreEnqueue gate parks it. A gang member's arrival co-activates its
@@ -217,6 +238,7 @@ class SchedulingQueue:
                 self.activate_gang(gkey)
         self._sync_gauges()
 
+    @_locked
     def update(self, old: Optional[Pod], new: Pod) -> None:
         """Pod update may make an unschedulable pod schedulable again (:525);
         a pod the queue has never seen falls through to activeQ (reference
@@ -233,6 +255,7 @@ class SchedulingQueue:
         else:
             self.add(new)
 
+    @_locked
     def delete(self, pod: Pod) -> None:
         key = pod.key()
         self._unschedulable.pop(key, None)
@@ -257,6 +280,7 @@ class SchedulingQueue:
             heapq.heapify(self._backoff)
         self._sync_gauges()
 
+    @_locked
     def pop(self) -> Optional[QueuedPodInfo]:
         """Next pod to schedule, or None (non-blocking; the reference blocks,
         :484 — the loop idles instead). Bumps attempts + scheduling_cycle."""
@@ -265,7 +289,7 @@ class SchedulingQueue:
             self._sync_gauges()
         return qp
 
-    def _pop_unsynced(self) -> Optional[QueuedPodInfo]:
+    def _pop_unsynced(self) -> Optional[QueuedPodInfo]:  # ktpu: locked
         self.flush_backoff_completed()
         qp = self._pop_active()
         if qp is None:
@@ -275,7 +299,7 @@ class SchedulingQueue:
         self.scheduling_cycle += 1
         return qp
 
-    def _pop_active(self) -> Optional[QueuedPodInfo]:
+    def _pop_active(self) -> Optional[QueuedPodInfo]:  # ktpu: locked
         if not self._active_ns:
             # no tenant heaps: the exact legacy single-heap order
             if not self._active:
@@ -291,15 +315,15 @@ class SchedulingQueue:
         w = self.ns_weight_fn(ns) if self.ns_weight_fn is not None else None
         return max(float(w), 0.0) if w is not None else 1.0
 
-    def _drop_drr_name(self, ns: str) -> None:
+    def _drop_drr_name(self, ns: str) -> None:  # ktpu: locked
         i = bisect.bisect_left(self._drr_names, ns)
         if i < len(self._drr_names) and self._drr_names[i] == ns:
             del self._drr_names[i]
 
-    def _drr_bucket(self, ns: str) -> List:
+    def _drr_bucket(self, ns: str) -> List:  # ktpu: locked
         return self._active if ns == "" else self._active_ns[ns]
 
-    def _drr_pop(self) -> Optional[QueuedPodInfo]:
+    def _drr_pop(self) -> Optional[QueuedPodInfo]:  # ktpu: locked
         # tenant heaps are never empty (emptied buckets are dropped at the
         # _drr_take/delete sites), so _drr_names IS sorted(buckets) — no
         # per-pop dict rebuild or sort on the batched-drain hot path
@@ -348,7 +372,7 @@ class SchedulingQueue:
         ns = names[start % len(names)]
         return self._drr_take(ns, self._drr_bucket(ns), charge=False)
 
-    def _drr_take(self, ns: str, heap: List, charge: bool = True) -> QueuedPodInfo:
+    def _drr_take(self, ns: str, heap: List, charge: bool = True) -> QueuedPodInfo:  # ktpu: locked
         _k, _c, qp = heapq.heappop(heap)
         if heap:
             if charge:
@@ -367,6 +391,7 @@ class SchedulingQueue:
         self._gang_cont = (ns, gkey) if gkey is not None else None
         return qp
 
+    @_locked
     def pop_batch(self, k: int) -> List[QueuedPodInfo]:
         """Drain up to k pods in queue order — the TPU micro-batch feed.
         The pending gauge syncs ONCE per batch: per-pop intermediate values
@@ -382,6 +407,7 @@ class SchedulingQueue:
             self._sync_gauges()
         return out
 
+    @_locked
     def add_unschedulable_if_not_present(self, qp: QueuedPodInfo, pod_scheduling_cycle: int,
                                          error: bool = False) -> None:
         """Failed pod → unschedulable map, or backoffQ if a move request
@@ -410,6 +436,7 @@ class SchedulingQueue:
             self._record_incoming("unschedulable", "ScheduleAttemptFailure")
         self._sync_gauges()
 
+    @_locked
     def move_all_to_active_or_backoff_queue(self, event: ClusterEvent) -> int:
         """Reactivate unschedulable pods whose failed plugins registered
         interest in ``event`` (:614 MoveAllToActiveOrBackoffQueue). Moved
@@ -436,6 +463,7 @@ class SchedulingQueue:
             self._sync_gauges()
         return moved
 
+    @_locked
     def move_gated_pods(self, namespace: Optional[str] = None,
                         plugin: Optional[str] = None,
                         admit_fn: Optional[Callable[[Pod], Optional[object]]] = None,
@@ -479,6 +507,7 @@ class SchedulingQueue:
             self._sync_gauges()
         return moved
 
+    @_locked
     def activate_gang(self, gkey: str) -> int:
         """Move every unschedulable member of ``gkey`` to active/backoff
         (siblings travel together). Rate-limited per gang — the starvation
@@ -504,7 +533,7 @@ class SchedulingQueue:
             self._sync_gauges()
         return moved
 
-    def _pod_matches_event(self, qp: QueuedPodInfo, event: ClusterEvent) -> bool:
+    def _pod_matches_event(self, qp: QueuedPodInfo, event: ClusterEvent) -> bool:  # ktpu: locked
         if event.is_wildcard():
             return True
         failed = frozenset(qp.unschedulable_plugins)
@@ -518,7 +547,7 @@ class SchedulingQueue:
             self._event_match_memo[memo_key] = hit
         return hit
 
-    def _requeue(self, qp: QueuedPodInfo, event: Optional[str] = None) -> bool:
+    def _requeue(self, qp: QueuedPodInfo, event: Optional[str] = None) -> bool:  # ktpu: locked
         """Moved pods land in backoffQ unless their backoff already lapsed —
         after the PreEnqueue gate re-check (a still-refused pod re-parks
         gated instead; returns False: no queue move happened)."""
@@ -530,6 +559,7 @@ class SchedulingQueue:
             self._push_backoff(qp, event=event)
         return True
 
+    @_locked
     def flush_backoff_completed(self) -> None:
         """backoffQ → activeQ for expired backoffs (:432), re-gated: quota
         may have filled while the pod backed off."""
@@ -544,6 +574,7 @@ class SchedulingQueue:
         if flushed:
             self._sync_gauges()
 
+    @_locked
     def flush_unschedulable_left_over(self) -> None:
         """Pods stuck unschedulable > timeout get retried (:463). Gated pods
         are exempt: the gate condition (namespace over quota) is level-held
@@ -562,6 +593,7 @@ class SchedulingQueue:
         if flushed:
             self._sync_gauges()
 
+    @_locked
     def assigned_pod_updated_or_added(self, pod: Pod) -> None:
         """An assigned pod changed: pods failed on affinity may now fit
         (movePodsToActiveOrBackoffQueue with Pod events)."""
@@ -571,6 +603,7 @@ class SchedulingQueue:
 
     # ------------------------------------------------------------- stats
 
+    @_locked
     def pending_pods(self) -> Dict[str, int]:
         gated = sum(1 for qp in self._unschedulable.values() if qp.gated)
         return {
@@ -581,6 +614,7 @@ class SchedulingQueue:
             "gated": gated,
         }
 
+    @_locked
     def pending_pod_infos(self) -> List[QueuedPodInfo]:
         """All queued pods across the sub-queues (PendingPods, :530) —
         the debugger/comparer's queue-side truth."""
@@ -591,6 +625,7 @@ class SchedulingQueue:
             + list(self._unschedulable.values())
         )
 
+    @_locked
     def dump(self) -> Dict[str, object]:
         """Structured snapshot of the sub-queues (the /debug/queue
         introspection body; the JSON twin of dumper.go's queue section).
@@ -636,6 +671,7 @@ class SchedulingQueue:
                       for qp in unschedulable if qp.gated],
         }
 
+    @_locked
     def __len__(self) -> int:
         return (len(self._active)
                 + sum(len(h) for h in self._active_ns.values())

@@ -1,8 +1,12 @@
 """Test infrastructure (the client-go fake clientset + reaction-hook role,
-kubernetes/fake/clientset_generated.go + testing/fixture.go).
+kubernetes/fake/clientset_generated.go + testing/fixture.go) — plus the
+lock-order/race tracer the production lock factories route through
+(locktrace; a plain ``threading`` primitive unless KTPU_LOCKTRACE=1).
 """
 
+from . import locktrace
 from .faults import Fault, FaultPlan
 from .reactors import ReactionError, with_reactors
 
-__all__ = ["Fault", "FaultPlan", "ReactionError", "with_reactors"]
+__all__ = ["Fault", "FaultPlan", "ReactionError", "locktrace",
+           "with_reactors"]

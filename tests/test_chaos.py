@@ -7,6 +7,7 @@ against the wall clock.
 """
 
 import numpy as np
+import pytest
 
 from kubernetes_tpu.api.wrappers import make_node, make_pod
 from kubernetes_tpu.apiserver.store import ClusterStore
@@ -16,8 +17,33 @@ from kubernetes_tpu.backend.tpu_scheduler import TPUScheduler
 from kubernetes_tpu.client.informer import SharedInformerFactory
 from kubernetes_tpu.controllers import ControllerManager
 from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.testing import locktrace
 from kubernetes_tpu.testing.faults import FaultPlan
 from kubernetes_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture
+def locktraced(monkeypatch):
+    """Run the test with the instrumented-lock harness on: every lock the
+    factory hands out (DeviceService, SchedulingQueue, Cache, ClusterStore)
+    records acquisitions into the global lock-order graph, and the known
+    blocking seams (device dispatch, HTTP, retry sleeps, WAL appends) report
+    when fired under a held lock. Teardown asserts the run produced ZERO
+    order-inversion cycles and ZERO non-allowed blocking-under-lock events —
+    a new nested acquire or a sleep under a component lock fails the suite
+    here before it ever wedges production."""
+    monkeypatch.setenv("KTPU_LOCKTRACE", "1")
+    locktrace.reset()
+    yield locktrace.tracer()
+    try:
+        locktrace.assert_clean()
+        # the suites this fixture guards construct traced locks and drive
+        # them from multiple threads; a zero-acquisition run means the
+        # factory swap silently stopped covering them
+        assert locktrace.tracer().acquisitions, \
+            "locktrace saw no acquisitions — factory locks not traced?"
+    finally:
+        locktrace.reset()
 
 
 def _cluster(store, n=20, cap="8"):
@@ -159,7 +185,15 @@ class TestPipelineRingChaos:
     poisoned batch — the one being committed AND everything dispatched after
     it — must fail back to the queue with zero lost / double-bound pods, and
     the rebuilt device mirror must be byte-identical to a fresh sync from
-    host truth."""
+    host truth.
+
+    Runs under KTPU_LOCKTRACE=1 (the ``locktraced`` fixture): the ring's
+    dispatch/poison/requeue interleavings must produce an acyclic lock-order
+    graph and no blocking-under-lock events."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, locktraced):
+        yield
 
     def _fill_ring(self, monkeypatch):
         monkeypatch.setenv("KTPU_PIPELINE_DEPTH", "2")
@@ -490,7 +524,17 @@ class TestActiveActiveChaos:
     """ISSUE 6 acceptance: two replicas, one DeviceService; killing one
     mid-gang and mid-drain yields zero lost pods and zero double-binds;
     the survivor adopts the fenced capacity within the lease TTL; final
-    placements pass single-scheduler oracle replay validation."""
+    placements pass single-scheduler oracle replay validation.
+
+    Runs under KTPU_LOCKTRACE=1 (the ``locktraced`` fixture): two replicas
+    hammering one DeviceService across serving threads is exactly the
+    topology where a lock-order inversion or a blocking call under the
+    service lock would deadlock or fence healthy peers — the teardown
+    asserts the whole suite observed neither."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, locktraced):
+        yield
 
     def _gang(self, store, prefix, n=4):
         from kubernetes_tpu.api.types import ObjectMeta, PodGroup

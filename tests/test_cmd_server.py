@@ -135,7 +135,17 @@ class TestDebugEndpoints:
             assert set(json.loads(body)["endpoints"]) == {
                 "/debug/queue", "/debug/cache", "/debug/devicestate",
                 "/debug/spans", "/debug/circuit", "/debug/sessions",
-                "/debug/flightrecorder", "/debug/quota"}
+                "/debug/flightrecorder", "/debug/quota", "/debug/locktrace"}
+
+            # locktrace endpoint: disabled report by default, full graph
+            # dump when the suite runs under KTPU_LOCKTRACE=1
+            status, body = _get(port, "/debug/locktrace")
+            assert status == 200
+            doc = json.loads(body)
+            if doc["enabled"]:
+                assert "cycles" in doc and "acquisitions" in doc
+            else:
+                assert doc == {"enabled": False}
 
             status, body = _get(port, "/debug/queue")
             doc = json.loads(body)

@@ -1,0 +1,501 @@
+"""tools/ktpu_check.py — the unified static-analysis driver — as a tier-1
+gate: ``--all`` over the real tree must be clean, and every pass must still
+DETECT a seeded violation (negative controls per rule) while reporting zero
+false positives on a clean fixture. The dynamic half (testing/locktrace.py)
+gets the same treatment: a scripted lock-order inversion and a blocking
+call under a held lock must be caught; a clean run must assert clean."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "ktpu_check.py")
+
+
+def _load_tool():
+    spec = importlib.util.spec_from_file_location("ktpu_check_t", TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+kc = _load_tool()
+
+
+# ------------------------------------------------------------------ driver
+
+
+def test_all_passes_clean_on_real_tree():
+    """THE gate: every registered pass over the actual tree, exit 0. A new
+    unguarded access, untyped raise, host sync in the traced region, dead
+    metric, unattributed span, unmarked perf test, stale pb2, or reasonless
+    suppression fails tier-1 right here."""
+    p = subprocess.run([sys.executable, "-m", "tools.ktpu_check", "--all"],
+                       cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    for name in ("locks", "jit", "errors", "metrics", "spans", "markers",
+                 "pb2-drift", "suppress"):
+        assert f"ok   {name}" in p.stdout, p.stdout
+
+
+def test_json_output_shape():
+    p = subprocess.run([sys.executable, "-m", "tools.ktpu_check", "--all",
+                        "--json"], cwd=REPO, capture_output=True, text=True,
+                       timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+    out = json.loads(p.stdout)
+    assert out["total"] == 0
+    assert set(out["passes"]) == set(kc.PASSES)
+    for body in out["passes"].values():
+        assert body["count"] == 0 and body["findings"] == []
+
+
+def test_selective_pass_and_bad_args():
+    p = subprocess.run([sys.executable, "-m", "tools.ktpu_check",
+                        "--pass", "errors"], cwd=REPO, capture_output=True,
+                       text=True, timeout=120)
+    assert p.returncode == 0 and "ok   errors" in p.stdout
+    assert "locks" not in p.stdout
+    p = subprocess.run([sys.executable, "-m", "tools.ktpu_check",
+                        "--pass", "nonsense"], cwd=REPO, capture_output=True,
+                       text=True, timeout=60)
+    assert p.returncode == 2
+
+
+def test_registry_covers_the_absorbed_gates():
+    """The three pre-existing lint CLIs are registered passes now."""
+    for absorbed in ("metrics", "spans", "markers", "pb2-drift"):
+        assert absorbed in kc.PASSES
+
+
+# ----------------------------------------------------------------- locks
+
+
+def _write_pkg(tmp_path, name, text):
+    pkg = tmp_path / name
+    pkg.mkdir(exist_ok=True)
+    (pkg / "mod.py").write_text(text)
+    return str(pkg)
+
+
+LOCKY_BAD = '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.seq = 0
+        self.items = {}
+
+    def bump(self):
+        with self._lock:
+            self.seq += 1
+            self.items["k"] = self.seq
+
+    def leak(self):
+        return self.seq          # BAD: unguarded read
+
+    def stomp(self):
+        self.items["x"] = 1      # BAD: unguarded write
+'''
+
+LOCKY_CLEAN = '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.seq = 0
+        self.items = {}
+        self.config = "init-only"   # never rebound later: exempt
+
+    def bump(self):
+        with self._lock:
+            self.seq += 1
+            self._bump_items()
+
+    def _bump_items(self):  # ktpu: locked
+        self.items["k"] = self.seq
+
+    def _shrink_locked(self):
+        self.items.clear()          # *_locked naming = caller holds it
+
+    def read_config(self):
+        return self.config
+
+    def snapshot(self):
+        return self.seq  # ktpu: unguarded-ok(torn read tolerated in the debug dump)
+'''
+
+
+def test_locks_pass_detects_seeded_violations(tmp_path):
+    pkg = _write_pkg(tmp_path, "pkg", LOCKY_BAD)
+    found = kc.find_lock_violations(pkg=pkg)
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2, msgs
+    assert "unguarded read of Svc.seq in leak()" in msgs
+    assert "unguarded write to Svc.items in stomp()" in msgs
+
+
+def test_locks_pass_clean_fixture_has_zero_false_positives(tmp_path):
+    pkg = _write_pkg(tmp_path, "pkg", LOCKY_CLEAN)
+    assert kc.find_lock_violations(pkg=pkg) == []
+
+
+def test_locks_pass_ignores_lockless_classes(tmp_path):
+    pkg = _write_pkg(tmp_path, "pkg", '''
+class Plain:
+    def __init__(self):
+        self.x = 0
+    def bump(self):
+        self.x += 1
+''')
+    assert kc.find_lock_violations(pkg=pkg) == []
+
+
+def test_locks_suppression_without_reason_does_not_silence(tmp_path):
+    pkg = _write_pkg(tmp_path, "pkg", '''
+import threading
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.seq = 0
+    def bump(self):
+        with self._lock:
+            self.seq += 1
+    def leak(self):
+        return self.seq  # ktpu: unguarded-ok()
+''')
+    # the empty-reason marker neither silences the locks finding...
+    assert len(kc.find_lock_violations(pkg=pkg)) == 1
+    # ...nor passes suppression hygiene
+    sup = kc.pass_suppress(files=[os.path.join(pkg, "mod.py")])
+    assert len(sup) == 1 and "no reason" in sup[0].message
+
+
+# ------------------------------------------------------------------- jit
+
+
+JIT_BAD = '''
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def helper(x):
+    v = float(x)          # BAD: host sync in a reachable function
+    if x > 0:             # BAD: python branch on traced
+        return v
+    return x.item()       # BAD
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def entry(x, mode="a", opts=[1]):
+    arr = np.asarray(x)   # BAD: host materialization
+    if mode == "b":       # fine: static arg
+        return arr
+    n = int(x.shape[0])   # fine: shape metadata
+    w = np.asarray([1.0, 2.0])  # fine: literal
+    return helper(x)
+'''
+
+JIT_BAD_STATIC_DEFAULT = '''
+import functools
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("opts",))
+def entry(x, opts=[1, 2]):
+    return x
+'''
+
+JIT_CLEAN = '''
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def helper(x, flag):
+    if flag:                       # static by propagation
+        x = x + 1
+    return jnp.where(x > 0, x, 0)  # traced branch done right
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def entry(x, flag=True):
+    if x is None:                  # identity test: host bool
+        return None
+    n = int(x.shape[0])            # metadata
+    return helper(x, flag)
+
+
+def host_commit(result):
+    # NOT reachable from a jit entry: host code may sync freely
+    return int(np.asarray(result)[0])
+'''
+
+
+def test_jit_pass_detects_seeded_violations(tmp_path):
+    pkg = _write_pkg(tmp_path, "pkg", JIT_BAD)
+    found = kc.find_jit_violations(pkg=pkg)
+    msgs = "\n".join(f.message for f in found)
+    assert "float() on a traced value" in msgs
+    assert ".item() on a traced value" in msgs
+    assert "np.asarray() on a traced value" in msgs
+    assert "Python branch on a traced value" in msgs
+    # the static-arg branch and the shape/literal lines are NOT flagged
+    assert "mode" not in msgs
+    lines = {f.line for f in found}
+    assert lines == {9, 10, 12, 17}, sorted(lines)
+
+
+def test_jit_pass_detects_unhashable_static_default(tmp_path):
+    pkg = _write_pkg(tmp_path, "pkg", JIT_BAD_STATIC_DEFAULT)
+    found = kc.find_jit_violations(pkg=pkg)
+    assert len(found) == 1
+    assert "unhashable literal" in found[0].message
+
+
+def test_jit_pass_clean_fixture_has_zero_false_positives(tmp_path):
+    pkg = _write_pkg(tmp_path, "pkg", JIT_CLEAN)
+    assert kc.find_jit_violations(pkg=pkg) == []
+
+
+def test_jit_pass_discovers_the_real_entry_points():
+    """The pass must actually see the five jitted programs — if discovery
+    breaks, --all would go green by analyzing nothing."""
+    _fns, entries, _sites = kc._collect_jit_functions(kc.PKG)
+    for must in ("schedule_batch", "gang_verdicts", "claim_feasibility_mask",
+                 "_screen_jit", "_apply_rows"):
+        assert must in entries, sorted(entries)
+    # schedule_batch's static surface is where retrace control lives
+    assert "topo_enabled" in entries["schedule_batch"]
+    assert "weights_key" in entries["schedule_batch"]
+
+
+# ----------------------------------------------------------------- errors
+
+
+ERRORS_BAD = '''
+def send(conn, data):
+    try:
+        conn.post(data)
+    except Exception:
+        pass
+
+def grow(dim):
+    raise RuntimeError(f"unknown dimension {dim}")
+'''
+
+ERRORS_CLEAN = '''
+from .errors import PermanentDeviceError, TransientDeviceError
+
+def send(conn, data):
+    try:
+        conn.post(data)
+    except Exception as e:  # reclassified below, so no comment needed
+        raise TransientDeviceError(str(e)) from e
+
+def send2(conn, data):
+    try:
+        conn.post(data)
+    except Exception:  # noqa: BLE001 — hints are optional, scheduling continues
+        return None
+
+def grow(dim):
+    raise PermanentDeviceError(f"unknown dimension {dim}")
+
+def legacy(dim):
+    raise RuntimeError("measured")  # ktpu: taxonomy-ok(pre-taxonomy contract pinned by a wire test)
+'''
+
+
+def test_errors_pass_detects_seeded_violations(tmp_path):
+    backend = _write_pkg(tmp_path, "backend", ERRORS_BAD)
+    found = kc.find_error_violations(backend=backend)
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2, msgs
+    assert "untyped raise RuntimeError" in msgs
+    assert "broad 'except Exception'" in msgs
+
+
+def test_errors_pass_clean_fixture_has_zero_false_positives(tmp_path):
+    backend = _write_pkg(tmp_path, "backend", ERRORS_CLEAN)
+    assert kc.find_error_violations(backend=backend) == []
+
+
+# ------------------------------------------------------------- locktrace
+
+
+@pytest.fixture
+def tracer(monkeypatch):
+    from kubernetes_tpu.testing import locktrace
+
+    monkeypatch.setenv("KTPU_LOCKTRACE", "1")
+    locktrace.reset()
+    yield locktrace
+    locktrace.reset()
+
+
+def test_factory_returns_plain_locks_when_disabled(monkeypatch):
+    from kubernetes_tpu.testing import locktrace
+
+    monkeypatch.delenv("KTPU_LOCKTRACE", raising=False)
+    lk = locktrace.make_lock("X")
+    assert type(lk) is type(threading.Lock())
+    rl = locktrace.make_rlock("X")
+    assert not isinstance(rl, locktrace.TracedLock)
+
+
+def test_traced_lock_records_edges_and_detects_cycle(tracer):
+    a = tracer.make_lock("A")
+    b = tracer.make_lock("B")
+    assert isinstance(a, tracer.TracedLock)
+    with a:
+        with b:
+            pass
+    assert tracer.tracer().cycles() == []  # A->B alone is fine
+    with b:
+        with a:                            # the inversion
+            pass
+    cycles = tracer.tracer().cycles()
+    assert cycles == [["A", "B"]], cycles
+    with pytest.raises(AssertionError, match="lock-order cycle: A -> B -> A"):
+        tracer.assert_clean()
+
+
+def test_cycle_detection_spans_threads(tracer):
+    """The deadlock never fires (acquisitions are sequential), but the
+    opposing edges from two different threads still form the cycle — the
+    point of the graph: POTENTIAL deadlocks, not wedged runs."""
+    a, b = tracer.make_lock("A"), tracer.make_lock("B")
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th1.start(); th1.join()
+    th2 = threading.Thread(target=t2)
+    th2.start(); th2.join()
+    assert tracer.tracer().cycles() == [["A", "B"]]
+
+
+def test_reentrant_acquisition_records_no_self_edge(tracer):
+    r = tracer.make_rlock("R")
+    with r:
+        with r:
+            pass
+    assert tracer.tracer().cycles() == []
+    assert tracer.tracer().edges == {}
+    # the held stack balanced: nothing left on this thread
+    assert tracer.tracer().held() == []
+
+
+def test_blocking_under_lock_is_a_violation(tracer):
+    lk = tracer.make_lock("Svc")
+    with lk:
+        tracer.note_blocking("http", "/v1/scheduleBatch")
+    v = tracer.tracer().blocking_violations
+    assert len(v) == 1 and v[0]["locks"] == ["Svc"]
+    with pytest.raises(AssertionError, match="blocking under lock: http"):
+        tracer.assert_clean()
+
+
+def test_allowed_blocking_is_ledgered_not_flagged(tracer):
+    lk = tracer.make_lock("Svc")
+    with lk:
+        tracer.note_blocking("device_sync", "sync",
+                             allowed="mirror frozen until commit")
+    assert tracer.tracer().blocking_violations == []
+    assert len(tracer.tracer().blocking_allowed) == 1
+    tracer.assert_clean()  # must not raise
+
+
+def test_blocking_without_held_lock_records_nothing(tracer):
+    tracer.note_blocking("sleep", "retry backoff")
+    assert tracer.tracer().blocking_violations == []
+    assert tracer.tracer().blocking_allowed == []
+
+
+def test_note_blocking_disabled_is_a_noop(monkeypatch):
+    from kubernetes_tpu.testing import locktrace
+
+    monkeypatch.delenv("KTPU_LOCKTRACE", raising=False)
+    locktrace.reset()
+    locktrace.note_blocking("http", "x")
+    assert locktrace.tracer().blocking_violations == []
+
+
+def test_queue_cache_store_service_locks_come_from_the_factory(tracer):
+    """The four concurrent-path components construct their locks through
+    the factory: driving them under KTPU_LOCKTRACE=1 shows up in the
+    acquisition ledger (the chaos suites rely on exactly this)."""
+    from kubernetes_tpu.api.wrappers import make_node, make_pod
+    from kubernetes_tpu.apiserver.store import ClusterStore
+    from kubernetes_tpu.backend.service import DeviceService
+    from kubernetes_tpu.cache.cache import Cache
+    from kubernetes_tpu.queue.scheduling_queue import SchedulingQueue
+
+    store = ClusterStore()
+    store.create_node(make_node("n0").capacity(
+        {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+    q = SchedulingQueue()
+    q.add(make_pod("p0").req({"cpu": "100m"}).obj())
+    assert q.pop() is not None
+    c = Cache()
+    c.add_node(store.nodes["n0"])
+    assert c.node_count() == 1
+    svc = DeviceService(batch_size=8)
+    svc.health({})
+    acq = tracer.tracer().acquisitions
+    for name in ("ClusterStore", "SchedulingQueue", "Cache", "DeviceService"):
+        assert acq.get(name, 0) > 0, (name, acq)
+    tracer.assert_clean()
+
+
+def test_wire_client_http_marks_blocking(tracer):
+    """The WireClient's socket IO reports as a blocking op: held under any
+    traced lock it would be a violation (negative control proving the real
+    seam is instrumented, not just the unit fixture above)."""
+    from kubernetes_tpu.backend.errors import TransientDeviceError
+    from kubernetes_tpu.backend.service import WireClient
+
+    guard = tracer.make_lock("TestGuard")
+    client = WireClient("http://127.0.0.1:1",  # nothing listens: fails fast
+                        connect_timeout=0.05, read_timeout=0.05)
+    client.retry.max_retries = 0
+    with guard:
+        with pytest.raises(TransientDeviceError):
+            client.apply_deltas({"apiVersion": "ktpu/v1"})
+    v = tracer.tracer().blocking_violations
+    assert any(ev["kind"] == "http" and "TestGuard" in ev["locks"]
+               for ev in v), v
+
+
+def test_reset_isolates_runs(tracer):
+    lk = tracer.make_lock("A")
+    with lk:
+        pass
+    assert tracer.tracer().acquisitions
+    tracer.reset()
+    assert tracer.tracer().acquisitions == {}
+    # locks made before the reset keep reporting into the NEW tracer
+    with lk:
+        pass
+    assert tracer.tracer().acquisitions == {"A": 1}

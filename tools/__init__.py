@@ -1,0 +1,1 @@
+"""Repo tooling (``python -m tools.ktpu_check``, trend/fence, pb2 vendoring)."""

@@ -1,138 +1,40 @@
 #!/usr/bin/env python3
-"""Marker lint (tier-1; run by tests/test_check_metrics.py): a perf-scale
-test must carry ``@pytest.mark.slow``.
+"""Thin shim over tools/ktpu_check.py (the ``markers`` pass).
 
-Tier-1 runs ``-m 'not slow'`` under a hard timeout; one unmarked
-reference-scale workload test (5000 nodes on the CPU fallback) blows the
-whole gate. A test function counts as perf-scale when it
-
-  * passes ``nodes=<constant >= 1000>`` to any call, or
-  * invokes a ``TEST_CASES[...](...)`` workload factory WITHOUT a ``nodes``
-    override — the factory defaults are the reference 5000Nodes sizes, or
-  * invokes ``TEST_CASES["SchedulingSoak"](...)`` at soak scale: the soak's
-    cost grows with ``rounds``x``scale``x``cycles_per_round``, not node
-    count, so a "small-nodes" soak with reference-size soak knobs
-    (``scale >= 16`` or ``rounds >= 16``, or either left at its default)
-    still must be slow-marked.
-
-A test is "marked slow" when the function, its class, or the module-level
-``pytestmark`` carries ``pytest.mark.slow``.
-
-Usage: ``python tools/check_markers.py`` — exits 0 when clean, 1 with a
-listing otherwise.
+The slow-marker lint lives in the unified ``ktpu_check`` registry; this CLI
+keeps the historical invocation (``python tools/check_markers.py``) and the
+``find_unmarked(paths)`` surface the tier-1 tests call. Prefer
+``python -m tools.ktpu_check --pass markers``.
 """
 
 from __future__ import annotations
 
-import ast
+import importlib.util
 import os
-import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(_HERE)
 TESTS = os.path.join(REPO, "tests")
 
-PERF_SCALE_NODES = 1000
-# soak knobs at/above these are reference-size regardless of node count
-SOAK_SCALE = 16
-SOAK_ROUNDS = 16
+
+def _ktpu_check():
+    spec = importlib.util.spec_from_file_location(
+        "ktpu_check", os.path.join(_HERE, "ktpu_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
-def _is_slow_mark(node: ast.AST) -> bool:
-    """True for ``pytest.mark.slow`` (bare or called)."""
-    if isinstance(node, ast.Call):
-        node = node.func
-    return (isinstance(node, ast.Attribute) and node.attr == "slow"
-            and isinstance(node.value, ast.Attribute)
-            and node.value.attr == "mark")
-
-
-def _has_slow(decorators) -> bool:
-    return any(_is_slow_mark(d) for d in decorators)
-
-
-def _module_marked_slow(tree: ast.Module) -> bool:
-    for node in tree.body:
-        if isinstance(node, ast.Assign):
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name) and tgt.id == "pytestmark":
-                    for cand in ast.walk(node.value):
-                        if _is_slow_mark(cand):
-                            return True
-    return False
-
-
-def _test_cases_key(call: ast.Call):
-    """The workload name of a ``TEST_CASES["X"](...)`` call, else None."""
-    if not (isinstance(call.func, ast.Subscript)
-            and isinstance(call.func.value, ast.Name)
-            and call.func.value.id == "TEST_CASES"):
-        return None
-    sl = call.func.slice
-    if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
-        return sl.value
-    return ""  # dynamic key: still a TEST_CASES call
-
-
-def _int_kw(call: ast.Call, name: str):
-    for k in call.keywords:
-        if (k.arg == name and isinstance(k.value, ast.Constant)
-                and isinstance(k.value.value, int)):
-            return k.value.value
-    return None
-
-
-def _is_perf_scale(fn: ast.FunctionDef) -> bool:
-    for node in ast.walk(fn):
-        if not isinstance(node, ast.Call):
-            continue
-        kw_names = {k.arg for k in node.keywords}
-        for k in node.keywords:
-            if (k.arg == "nodes" and isinstance(k.value, ast.Constant)
-                    and isinstance(k.value.value, int)
-                    and k.value.value >= PERF_SCALE_NODES):
-                return True
-        # TEST_CASES["X"](...) with the reference-size defaults
-        key = _test_cases_key(node)
-        if key is not None and "nodes" not in kw_names:
-            return True
-        # the soak scales with its arrival knobs, not node count: a small-
-        # nodes call with default (or reference-size) scale/rounds is still
-        # the large variant
-        if key == "SchedulingSoak":
-            scale, rounds = _int_kw(node, "scale"), _int_kw(node, "rounds")
-            if (scale is None or scale >= SOAK_SCALE
-                    or rounds is None or rounds >= SOAK_ROUNDS):
-                return True
-    return False
+_kc = _ktpu_check()
+PERF_SCALE_NODES = _kc.PERF_SCALE_NODES
+SOAK_SCALE = _kc.SOAK_SCALE
+SOAK_ROUNDS = _kc.SOAK_ROUNDS
 
 
 def find_unmarked(paths=None):
-    violations = []
-    paths = paths or sorted(
-        os.path.join(TESTS, f) for f in os.listdir(TESTS)
-        if f.startswith("test_") and f.endswith(".py"))
-    for path in paths:
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read())
-        if _module_marked_slow(tree):
-            continue
-        scopes = [(tree.body, False)]
-        for cls in tree.body:
-            if isinstance(cls, ast.ClassDef):
-                scopes.append((cls.body, _has_slow(cls.decorator_list)))
-        for body, class_slow in scopes:
-            for fn in body:
-                if not isinstance(fn, ast.FunctionDef):
-                    continue
-                if not fn.name.startswith("test_"):
-                    continue
-                if class_slow or _has_slow(fn.decorator_list):
-                    continue
-                if _is_perf_scale(fn):
-                    violations.append(
-                        f"{os.path.relpath(path, REPO)}:{fn.lineno} "
-                        f"{fn.name}")
-    return violations
+    """Violations as the historical ``"path:line name"`` strings."""
+    return [f"{os.path.relpath(path, REPO)}:{line} {name}"
+            for path, line, name in _kc.find_unmarked(paths)]
 
 
 def main() -> int:

@@ -1,214 +1,58 @@
 #!/usr/bin/env python3
-"""Static dead-metric check + span-name lint (tier-1; run by
-tests/test_check_metrics.py).
+"""Thin shim over tools/ktpu_check.py (the ``metrics`` + ``spans`` passes).
 
-Dead metrics: every metric registered in ``SchedulerMetrics.__init__`` must
-be observed / incremented / set somewhere in the package outside its
-definition — either directly (``smetrics.<attr>.observe(...)``) or through
-a SchedulerMetrics helper method that is itself called from outside the
-metrics module. A new metric that nothing feeds fails tier-1.
-
-Span lint: every span name emitted in the package (``tracing.span("...")``
-/ ``span_from_remote(..., "...")``) must appear in bench.py's critical-path
-attribution table (``CRITICAL_PATH_SPANS``) or match an entry in the
-explicit ignore list below. Without this, a new phase span silently falls
-into the attribution's "other" bucket and the bench's critical-path story
-quietly stops adding up.
-
-Usage: ``python tools/check_metrics.py`` — exits 0 when every metric is
-live and every span is attributed, 1 with a listing otherwise.
+The dead-metric gate and span-name lint now live in the unified
+``ktpu_check`` pass registry; this CLI keeps the historical invocation
+(``python tools/check_metrics.py``) and the monkeypatchable module surface
+(``PKG``/``METRICS_FILE``/``find_dead_metrics``/...) the tier-1 tests use.
+Prefer ``python -m tools.ktpu_check --pass metrics --pass spans``.
 """
 
 from __future__ import annotations
 
-import ast
+import importlib.util
 import os
-import re
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(_HERE)
 PKG = os.path.join(REPO, "kubernetes_tpu")
 METRICS_FILE = os.path.join(PKG, "metrics", "scheduler_metrics.py")
 BENCH_FILE = os.path.join(REPO, "bench.py")
 
-# the mutating calls that count as "feeding" a metric
-_MUTATORS = ("observe", "inc", "set")
 
-# span names (prefix match) consciously OUTSIDE the bench critical-path
-# attribution: the sampled per-extension-point / per-plugin spans are
-# latency *exemplars*, not cycle phases
-SPAN_IGNORE_PREFIXES = ("framework.", "plugin.")
-
-
-def registered_metrics(tree: ast.Module):
-    """Metric attribute names from ``self.<attr> = r.register(...)``
-    assignments in SchedulerMetrics.__init__."""
-    attrs = []
-    for cls in ast.walk(tree):
-        if not (isinstance(cls, ast.ClassDef) and cls.name == "SchedulerMetrics"):
-            continue
-        for fn in cls.body:
-            if not (isinstance(fn, ast.FunctionDef) and fn.name == "__init__"):
-                continue
-            for node in ast.walk(fn):
-                if not isinstance(node, ast.Assign) or len(node.targets) != 1:
-                    continue
-                tgt = node.targets[0]
-                if (isinstance(tgt, ast.Attribute)
-                        and isinstance(tgt.value, ast.Name)
-                        and tgt.value.id == "self"
-                        and isinstance(node.value, ast.Call)
-                        and isinstance(node.value.func, ast.Attribute)
-                        and node.value.func.attr == "register"):
-                    attrs.append(tgt.attr)
-    return attrs
+def _ktpu_check():
+    spec = importlib.util.spec_from_file_location(
+        "ktpu_check", os.path.join(_HERE, "ktpu_check.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
-def helper_map(tree: ast.Module):
-    """SchedulerMetrics method name → set of metric attrs it mutates
-    (``self.<attr>.<mutator>(...)`` calls inside the method)."""
-    out = {}
-    for cls in ast.walk(tree):
-        if not (isinstance(cls, ast.ClassDef) and cls.name == "SchedulerMetrics"):
-            continue
-        for fn in cls.body:
-            if not isinstance(fn, ast.FunctionDef) or fn.name == "__init__":
-                continue
-            touched = set()
-            for node in ast.walk(fn):
-                if (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)
-                        and node.func.attr in _MUTATORS
-                        and isinstance(node.func.value, ast.Attribute)
-                        and isinstance(node.func.value.value, ast.Name)
-                        and node.func.value.value.id == "self"):
-                    touched.add(node.func.value.attr)
-            if touched:
-                out[fn.name] = touched
-    return out
-
-
-def package_sources():
-    for root, _dirs, files in os.walk(PKG):
-        for f in files:
-            if f.endswith(".py"):
-                path = os.path.join(root, f)
-                with open(path, encoding="utf-8") as fh:
-                    yield path, fh.read()
+_kc = _ktpu_check()
+SPAN_IGNORE_PREFIXES = _kc.SPAN_IGNORE_PREFIXES
+_MUTATORS = _kc._MUTATORS
+registered_metrics = _kc.registered_metrics
+helper_map = _kc.helper_map
 
 
 def find_dead_metrics():
-    tree = ast.parse(open(METRICS_FILE, encoding="utf-8").read())
-    attrs = registered_metrics(tree)
-    helpers = helper_map(tree)
-
-    outside = []  # package sources excluding the definition module
-    for path, text in package_sources():
-        if os.path.abspath(path) == os.path.abspath(METRICS_FILE):
-            continue
-        outside.append(text)
-    blob = "\n".join(outside)
-
-    # which helper methods are actually invoked outside the metrics module
-    live_helpers = {name for name in helpers
-                    if re.search(rf"\.{name}\s*\(", blob)}
-
-    dead = []
-    for attr in attrs:
-        direct = re.search(
-            rf"\.{attr}\.(?:{'|'.join(_MUTATORS)})\s*\(", blob)
-        via_helper = any(attr in helpers[h] for h in live_helpers)
-        if not direct and not via_helper:
-            dead.append(attr)
-    return attrs, dead
-
-
-# ---------------------------------------------------------------- span lint
-
-
-def _literal_prefix(node):
-    """(value, exact) for a span-name argument: a plain string constant is
-    exact; an f-string / ``"prefix" + expr`` concatenation contributes its
-    leading literal as a prefix; anything else is unlintable (None)."""
-    if isinstance(node, ast.Constant) and isinstance(node.value, str):
-        return node.value, True
-    if isinstance(node, ast.JoinedStr):
-        parts = []
-        for v in node.values:
-            if isinstance(v, ast.Constant) and isinstance(v.value, str):
-                parts.append(v.value)
-            else:
-                break
-        return ("".join(parts), False) if parts else (None, False)
-    if (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add)
-            and isinstance(node.left, ast.Constant)
-            and isinstance(node.left.value, str)):
-        return node.left.value, False
-    return None, False
+    # reads the module globals at call time so tests can monkeypatch
+    # PKG/METRICS_FILE on THIS module and still exercise the real pass
+    return _kc.find_dead_metrics(pkg=PKG, metrics_file=METRICS_FILE)
 
 
 def emitted_span_names(pkg: str = None):
-    """(exact names, dynamic prefixes) of every span the package emits:
-    ``<anything>.span("name", ...)`` and
-    ``<anything>.span_from_remote(tp, "name", ...)`` calls."""
-    names, prefixes = set(), set()
-    for root, _dirs, files in os.walk(pkg or PKG):
-        for f in files:
-            if not f.endswith(".py"):
-                continue
-            path = os.path.join(root, f)
-            try:
-                tree = ast.parse(open(path, encoding="utf-8").read())
-            except SyntaxError:
-                continue
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)):
-                    continue
-                arg = None
-                if node.func.attr in ("span", "span_remote") and node.args:
-                    arg = node.args[0]
-                elif node.func.attr == "span_from_remote" and len(node.args) >= 2:
-                    arg = node.args[1]
-                if arg is None:
-                    continue
-                val, exact = _literal_prefix(arg)
-                if val is None:
-                    continue
-                (names if exact else prefixes).add(val)
-    return names, prefixes
+    return _kc.emitted_span_names(pkg or PKG)
 
 
 def bench_span_table(path: str = None):
-    """The ``CRITICAL_PATH_SPANS`` literal from bench.py, via AST (importing
-    bench.py would drag the whole package + jax into a lint)."""
-    tree = ast.parse(open(path or BENCH_FILE, encoding="utf-8").read())
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
-            continue
-        tgt = node.targets[0]
-        if not (isinstance(tgt, ast.Name) and tgt.id == "CRITICAL_PATH_SPANS"):
-            continue
-        consts = [n.value for n in ast.walk(node.value)
-                  if isinstance(n, ast.Constant) and isinstance(n.value, str)]
-        return set(consts)
-    return set()
+    return _kc.bench_span_table(path or BENCH_FILE)
 
 
 def find_unattributed_spans(pkg: str = None, bench_path: str = None):
-    """(emitted, unattributed): span names/prefixes neither in bench.py's
-    attribution table nor matched by SPAN_IGNORE_PREFIXES."""
-    names, prefixes = emitted_span_names(pkg)
-    table = bench_span_table(bench_path)
-    bad = [n for n in sorted(names)
-           if n not in table and not n.startswith(SPAN_IGNORE_PREFIXES)]
-    for p in sorted(prefixes):
-        if p.startswith(SPAN_IGNORE_PREFIXES):
-            continue
-        if any(t.startswith(p) for t in table):
-            continue
-        bad.append(p + "*")
-    return sorted(names | prefixes), bad
+    return _kc.find_unattributed_spans(pkg=pkg or PKG,
+                                       bench_path=bench_path or BENCH_FILE)
 
 
 def main() -> int:

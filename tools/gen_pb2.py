@@ -14,6 +14,10 @@ module byte-equivalent in behavior to ``protoc --python_out`` output.
     python tools/gen_pb2.py --check    # CI gate: exit 1 when the vendored
                                        # module is stale vs the .proto
 
+The drift gate is also registered as the ``pb2-drift`` pass in
+tools/ktpu_check.py (``python -m tools.ktpu_check --pass pb2-drift``) —
+this CLI stays for direct invocation and regeneration.
+
 The vendored module embeds the source .proto's sha256;
 ``backend/grpc_service.pb2()`` only trusts it while the hash matches, so a
 proto edit without regeneration falls back to protoc (or fails with a
