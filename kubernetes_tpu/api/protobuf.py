@@ -38,6 +38,15 @@ _pb2 = None
 _pb2_lock = threading.Lock()
 
 
+def pb2_available() -> bool:
+    """True when pb2() will succeed (the apiserver codec is not vendored
+    the way native/ktpu_device_pb2.py is — tests skip with a reason
+    instead of erroring when the on-demand build cannot happen)."""
+    from ..utils.protoc import build_available
+
+    return build_available(_pb2, _PB2, _PROTO)
+
+
 def pb2():
     global _pb2
     if _pb2 is not None:

@@ -58,6 +58,21 @@ class StaleEpochError(DeviceServiceError):
         self.epoch = epoch
 
 
+class FailoverError(TransientDeviceError):
+    """The device fabric's ACTIVE replica was lost and a standby was
+    promoted (backend/fabric.py). Transient by taxonomy: the batch that
+    was in flight is poisoned and requeued — nothing is replayed — and
+    the retry lands on the promoted standby after the next push's
+    epoch-mismatch forces the client's full resync to re-seed it. Carries
+    both endpoints for the flight recorder and /debug/fabric."""
+
+    def __init__(self, message: str = "device fabric failover",
+                 from_endpoint: str = "", to_endpoint: str = ""):
+        super().__init__(message)
+        self.from_endpoint = from_endpoint
+        self.to_endpoint = to_endpoint
+
+
 class ConflictError(DeviceServiceError):
     """Another scheduler replica won a race this client lost: the pod (or
     this client's whole session, if its lease was fenced) is owned by

@@ -1,0 +1,364 @@
+"""Device-side HA fabric: one client fronting N DeviceService replicas.
+
+PR 6 made the *scheduler* tier active-active — N replicas share one
+DeviceService behind per-client sessions, fencing, and commit holds — but
+every replica still talked to ONE device process: a single sidecar crash
+dropped the whole batched path to the oracle fallback until restart. This
+module covers the other half (ROADMAP item 3): multiple DeviceServices
+behind one ``DeviceFabric``, so the degradation ladder becomes
+replica-failover → surviving-replica → oracle instead of
+single-process → oracle — the device tier's analog of the replicated
+storage under the reference's apiserver (PAPER.md L0/L2: etcd quorum +
+watch cache; a member loss is absorbed by the survivors, not by clients).
+
+Design:
+
+  * **Per-endpoint replicas.** Each endpoint gets its own transport client
+    (``WireClient``/``GrpcClient``) and its own ``CircuitBreaker``
+    (backend/circuit.py). The replica breaker does NOT gate calls to the
+    active replica (the scheduler's own breaker owns whole-fabric
+    degradation) — it rate-limits how often a DOWN endpoint is re-probed
+    with the cheap Health verb (PR 4), exactly the half-open-probe reuse.
+  * **Sticky primary/standby selection.** Every verb routes to the ACTIVE
+    replica. A rejoining ex-primary is detected by the standby probe and
+    becomes a healthy *standby* — it is never re-adopted mid-flight. It
+    only becomes active again through a later failover, and the first
+    contact then trips the epoch check (its epoch is not the one the
+    client last synced), so it is re-seeded with a ``full=True`` resync
+    before any incremental delta can land on its stale mirror.
+  * **Failover rides the proven recovery machinery** (PRs 3/6) instead of
+    inventing a replication protocol. On active loss the fabric marks the
+    replica down, poisons the in-flight batch (flight event; the typed
+    transient ``FailoverError`` makes the scheduler requeue its pods
+    exactly like device death poisons the in-process ring), and promotes
+    the first standby whose Health answers. Nothing is replayed: batch
+    ids are idempotent per service, and the next delta push hits the
+    standby's unknown epoch → ``StaleEpochError`` → the client's existing
+    ``_full_resync`` seeds the standby under a fresh session (new
+    sessionGen — a zombie commit from the dead primary's session can then
+    only fence as a ``ConflictError``).
+  * **All replicas down** → the original transport error propagates and
+    the scheduler's breaker degrades to the sequential oracle; scheduling
+    never stops. Heal is the scheduler's half-open probe calling
+    ``health()`` here, which answers from (or fails over to) whichever
+    replica recovered first.
+  * **Permanent errors fail over too** (reason="permanent" on the
+    failover counter): a single replica deterministically answering 4xx
+    is the version-skewed-deploy failure this tier exists to absorb. The
+    cost when the REQUEST is at fault (every replica rejects it) is one
+    extra hop per attempt until the scheduler breaker opens — bounded,
+    and distinguishable in telemetry by the reason label plus identical
+    lastError strings across replicas in /debug/fabric.
+
+Locking: the fabric lock guards only the selection state (active index,
+failover counters, probe clock) for /debug readers — transport calls and
+health probes always run OUTSIDE it (a slow replica must never wedge the
+serving thread; the locktrace blocking pass enforces this). Probes of
+maybe-dead replicas additionally ride a dedicated SINGLE-ATTEMPT probe
+client (``probe_client_factory``; no retries, no backoff sleeps) so a
+blackholed standby costs one connect timeout per window on the
+scheduling thread, never the full retry budget.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Callable, List, Optional
+
+from ..testing import locktrace
+from . import telemetry
+from .circuit import CircuitBreaker
+from .errors import (
+    ConflictError,
+    DeviceServiceError,
+    FailoverError,
+    PermanentDeviceError,
+    StaleEpochError,
+)
+
+# how often a down standby is re-probed with the Health verb (also the
+# per-replica breaker's reset timeout, so allow() admits one probe per
+# window) — wire-tuned like the scheduler breaker's 5s default
+DEFAULT_PROBE_INTERVAL_S = 5.0
+
+# bounded failover journal for /debug/fabric
+LOG_CAPACITY = 64
+
+
+class _Replica:
+    """One DeviceService endpoint: transport client plus health
+    bookkeeping. Plain attributes only (single writer: the scheduling
+    thread; /debug readers tolerate a torn snapshot of booleans)."""
+
+    __slots__ = ("index", "endpoint", "client", "probe", "breaker",
+                 "healthy", "epoch", "last_error", "last_batch_id")
+
+    def __init__(self, index: int, endpoint: str, client,
+                 now_fn, probe_interval_s: float, probe_client=None):
+        self.index = index
+        self.endpoint = endpoint
+        self.client = client
+        # Health probes of a maybe-dead replica run synchronously on the
+        # scheduling thread: the dedicated probe client (no retry budget)
+        # bounds a blackholed standby's cost to ONE connect timeout per
+        # window instead of retries × timeout + backoff sleeps
+        self.probe = probe_client if probe_client is not None else client
+        # threshold 1: one failed call marks the replica down; the reset
+        # timeout then meters Health re-probes (half-open = one probe)
+        self.breaker = CircuitBreaker(failure_threshold=1,
+                                      reset_timeout_s=probe_interval_s,
+                                      now_fn=now_fn)
+        self.healthy = True
+        self.epoch: Optional[str] = None      # last epoch this replica answered
+        self.last_error = ""
+        self.last_batch_id: Optional[str] = None  # last batch it accepted
+
+
+class DeviceFabric:
+    """Client-side fabric over N DeviceService endpoints, presenting the
+    single-client surface ``WireScheduler`` already speaks (apply_deltas /
+    schedule_batch / health / heartbeat / sessions_dump + supports_*)."""
+
+    def __init__(self, endpoints: List[str],
+                 client_factory: Callable[[str, int], object],
+                 probe_client_factory: Optional[Callable] = None,
+                 metrics=None, now_fn=time.monotonic,
+                 probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S):
+        if not endpoints:
+            raise ValueError("DeviceFabric needs at least one endpoint")
+        self.now_fn = now_fn
+        self.probe_interval_s = probe_interval_s
+        self.metrics = metrics
+        self.replicas = [
+            _Replica(i, ep, client_factory(ep, i), now_fn, probe_interval_s,
+                     probe_client=(probe_client_factory(ep, i)
+                                   if probe_client_factory is not None
+                                   else None))
+            for i, ep in enumerate(endpoints)]
+        first = self.replicas[0].client
+        # capability flags mirror the underlying transport (all replicas
+        # share one transport class by construction)
+        self.supports_dra = getattr(first, "supports_dra", False)
+        self.supports_health = getattr(first, "supports_health", False)
+        self.supports_sessions = getattr(first, "supports_sessions", False)
+        self._lock = locktrace.make_lock("DeviceFabric")
+        self._active = 0
+        self.failovers = 0
+        self.log: deque = deque(maxlen=LOG_CAPACITY)
+        self._last_probe = now_fn()
+        if metrics is not None:
+            metrics.fabric_active_replica.set(value=0)
+            for rep in self.replicas:
+                metrics.fabric_replica_health.set(rep.endpoint, value=1)
+
+    # --------------------------------------------------------------- verbs
+
+    def apply_deltas(self, payload: dict) -> dict:
+        return self._call("apply_deltas", payload)
+
+    def schedule_batch(self, payload: dict) -> dict:
+        return self._call("schedule_batch", payload)
+
+    def heartbeat(self, payload: dict) -> dict:
+        return self._call("heartbeat", payload)
+
+    def health(self) -> dict:
+        return self._call("health", None)
+
+    def sessions_dump(self) -> dict:
+        # read-only introspection, invoked from the /debug SERVING thread
+        # (WireScheduler.debug_sessions): it must never run the failover/
+        # probe machinery — the scheduling thread is the single failover
+        # writer. A transport error surfaces to the debug body (the
+        # caller renders it), not as a demotion.
+        return self.active_replica().client.sessions_dump()
+
+    # ------------------------------------------------------------- routing
+
+    def active_replica(self) -> _Replica:
+        with self._lock:
+            return self.replicas[self._active]
+
+    def active_endpoint(self) -> str:
+        return self.active_replica().endpoint
+
+    def _call(self, verb: str, payload: Optional[dict]):
+        rep = self.active_replica()
+        fn = getattr(rep.client, verb)
+        try:
+            # transport IO runs outside the fabric lock — see module doc
+            out = fn(payload) if payload is not None else fn()
+        except (StaleEpochError, ConflictError):
+            # protocol verdicts from a HEALTHY service (restart detected /
+            # ownership lost): the client's own recovery paths handle
+            # them; they are not replica loss
+            raise
+        except DeviceServiceError as exc:
+            new, probe_out = self._replica_lost(rep, verb, payload, exc)
+            if verb == "health":
+                # the promotion probe's answer IS a health answer: the
+                # scheduler's half-open probe should see the live standby,
+                # not a failed fabric (the batch proceeds and the epoch
+                # protocol re-seeds on the next push)
+                return probe_out
+            raise FailoverError(
+                f"device replica {rep.endpoint} lost "
+                f"({type(exc).__name__}: {exc}); promoted standby "
+                f"{new.endpoint} — next push re-seeds it via epoch resync",
+                from_endpoint=rep.endpoint,
+                to_endpoint=new.endpoint) from exc
+        self._note_success(rep, verb, payload, out)
+        self._maybe_probe_standbys()
+        return out
+
+    def _note_success(self, rep: _Replica, verb: str,
+                      payload: Optional[dict], out: dict) -> None:
+        rep.breaker.record_success()
+        if isinstance(out, dict):
+            rep.epoch = out.get("epoch", rep.epoch)
+        if verb == "schedule_batch" and payload:
+            rep.last_batch_id = payload.get("batchId", rep.last_batch_id)
+        if not rep.healthy:
+            self._mark_health(rep, True)
+
+    def _mark_health(self, rep: _Replica, up: bool) -> None:
+        rep.healthy = up
+        if self.metrics is not None:
+            self.metrics.fabric_replica_health.set(rep.endpoint,
+                                                   value=1 if up else 0)
+
+    # ------------------------------------------------------------ failover
+
+    def _replica_lost(self, rep: _Replica, verb: str,
+                      payload: Optional[dict], exc: DeviceServiceError):
+        """The active replica failed a call: mark it down, poison the
+        in-flight batch, promote the first live standby. Returns
+        ``(new_active, its_health_response)``; raises the ORIGINAL error
+        when no standby answers (all replicas down — the scheduler's
+        breaker owns the next rung of the ladder: oracle degrade)."""
+        rep.breaker.record_failure(exc)
+        rep.last_error = f"{type(exc).__name__}: {exc}"
+        self._mark_health(rep, False)
+        batch_id = (payload or {}).get("batchId")
+        telemetry.event("replica_down", endpoint=rep.endpoint, verb=verb,
+                        lastBatchId=rep.last_batch_id,
+                        error=str(exc)[:200])
+        if batch_id:
+            # the in-flight batch dies with its replica — the wire twin of
+            # the in-process ring's poison-on-device-death: the scheduler
+            # requeues the pods (idempotent batch ids mean nothing is
+            # replayed; a fresh batch retries them after the resync)
+            telemetry.event("poison", batchId=batch_id,
+                            endpoint=rep.endpoint,
+                            pods=len((payload or {}).get("pods") or ()),
+                            error=str(exc)[:200])
+        promoted = self._promote_standby(rep)
+        if promoted is None:
+            raise exc
+        new, probe_out = promoted
+        reason = ("permanent" if isinstance(exc, PermanentDeviceError)
+                  else "transient")
+        if self.metrics is not None:
+            self.metrics.fabric_failovers.inc(reason)
+        # ordered strictly after the poison of the last in-flight batch:
+        # the postmortem reads "batch died, THEN the fabric moved on"
+        telemetry.event("failover", fromEndpoint=rep.endpoint,
+                        endpoint=new.endpoint, batchId=batch_id,
+                        lastBatchId=rep.last_batch_id, reason=reason)
+        return new, probe_out
+
+    def _promote_standby(self, dead: _Replica):
+        """Probe standbys (rotation order from the active) with the cheap
+        Health verb; the first that answers becomes active. Probes run
+        outside the lock; only the index flip is guarded."""
+        with self._lock:
+            start = self._active
+        n = len(self.replicas)
+        for k in range(1, n):
+            cand = self.replicas[(start + k) % n]
+            if cand is dead or not cand.breaker.allow():
+                continue
+            try:
+                out = cand.probe.health()
+            except DeviceServiceError as probe_exc:
+                cand.breaker.record_failure(probe_exc)
+                cand.last_error = (f"{type(probe_exc).__name__}: "
+                                   f"{probe_exc}")
+                self._mark_health(cand, False)
+                continue
+            cand.breaker.record_success()
+            cand.epoch = out.get("epoch", cand.epoch)
+            self._mark_health(cand, True)
+            with self._lock:
+                self._active = cand.index
+                self.failovers += 1
+                self.log.append({"t": self.now_fn(),
+                                 "from": dead.endpoint,
+                                 "to": cand.endpoint,
+                                 "error": dead.last_error})
+            if self.metrics is not None:
+                self.metrics.fabric_active_replica.set(value=cand.index)
+            return cand, out
+        return None
+
+    def _maybe_probe_standbys(self) -> None:
+        """Rate-limited rejoin detection: probe DOWN standbys with Health.
+        A replica that answers becomes a healthy standby again — never
+        the active (sticky selection): adoption happens only through a
+        failover, whose epoch-mismatch resync re-seeds the stale mirror."""
+        with self._lock:
+            now = self.now_fn()
+            if now - self._last_probe < self.probe_interval_s:
+                return
+            self._last_probe = now
+            active = self._active
+        down = [r for r in self.replicas
+                if not r.healthy and r.index != active]
+        for rep in down:
+            if not rep.breaker.allow():
+                continue
+            try:
+                out = rep.probe.health()
+            except DeviceServiceError as exc:
+                rep.breaker.record_failure(exc)
+                rep.last_error = f"{type(exc).__name__}: {exc}"
+                continue
+            rep.breaker.record_success()
+            restarted = (rep.epoch is not None
+                         and out.get("epoch") != rep.epoch)
+            rep.epoch = out.get("epoch", rep.epoch)
+            self._mark_health(rep, True)
+            telemetry.event("replica_rejoin", endpoint=rep.endpoint,
+                            restarted=restarted,
+                            lastBatchId=rep.last_batch_id)
+
+    # --------------------------------------------------------------- debug
+
+    def dump(self) -> dict:
+        """/debug/fabric body: replica table + bounded failover journal."""
+        with self._lock:
+            active = self._active
+            failovers = self.failovers
+            log = list(self.log)
+        replicas = []
+        for rep in self.replicas:
+            replicas.append({
+                "endpoint": rep.endpoint,
+                "active": rep.index == active,
+                "healthy": rep.healthy,
+                "epoch": rep.epoch,
+                "lastBatchId": rep.last_batch_id,
+                "lastError": rep.last_error,
+                "breaker": rep.breaker.dump(),
+            })
+        return {
+            "enabled": True,
+            "active": self.replicas[active].endpoint,
+            "activeIndex": active,
+            "replicaCount": len(self.replicas),
+            "failovers": failovers,
+            "probeIntervalS": self.probe_interval_s,
+            "replicas": replicas,
+            "log": log,
+        }

@@ -87,16 +87,11 @@ def _vendored_pb2():
 def pb2_available() -> bool:
     """True when pb2() will succeed: a hash-fresh vendored module, a
     cached protoc build, or protoc itself."""
-    import shutil
+    from ..utils.protoc import build_available
 
-    if _pb2 is not None:
-        return True
     if _vendored_pb2() is not None:
         return True
-    if (os.path.exists(_PB2)
-            and os.path.getmtime(_PB2) >= os.path.getmtime(_PROTO)):
-        return True
-    return shutil.which("protoc") is not None
+    return build_available(_pb2, _PB2, _PROTO)
 
 
 def pb2():

@@ -1067,7 +1067,7 @@ class WireScheduler(Scheduler):
     analog of the HTTP extender, with the same host machinery around it as
     TPUScheduler (queue order, assume/bind, failure handling + backoff)."""
 
-    def __init__(self, *args, endpoint: str, batch_size: int = 256,
+    def __init__(self, *args, endpoint, batch_size: int = 256,
                  transport: str = "http",
                  connect_timeout: float = 5.0, read_timeout: float = 60.0,
                  wire_max_retries: int = 3, wire_backoff_base: float = 0.05,
@@ -1075,6 +1075,7 @@ class WireScheduler(Scheduler):
                  breaker_threshold: int = 3, breaker_reset_s: float = 5.0,
                  client_id: Optional[str] = None,
                  heartbeat_interval_s: float = 5.0,
+                 fabric_probe_interval_s: float = 5.0,
                  fault_plan=None, sleep_fn=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.retry_policy = RetryPolicy(
@@ -1083,17 +1084,58 @@ class WireScheduler(Scheduler):
             sleep_fn=sleep_fn if sleep_fn is not None else time.sleep,
             now_fn=self.now_fn,
             on_retry=lambda op: self.smetrics.wire_retries.inc(op))
+        # ``endpoint`` names one device service ("http://host:port"), a
+        # comma-separated list, or a sequence — more than one enables the
+        # device-side HA fabric (backend/fabric.py): primary/standby
+        # selection with failover riding the epoch-resync machinery.
+        # ``fault_plan`` may be a matching list for per-endpoint chaos.
+        endpoints = ([e.strip() for e in endpoint.split(",") if e.strip()]
+                     if isinstance(endpoint, str)
+                     else [str(e) for e in endpoint])
+        if not endpoints:
+            raise ValueError("WireScheduler needs at least one endpoint")
+        plans = (list(fault_plan) if isinstance(fault_plan, (list, tuple))
+                 else [fault_plan] * len(endpoints))
+        if len(plans) != len(endpoints):
+            raise ValueError(
+                f"fault_plan list ({len(plans)}) must match endpoints "
+                f"({len(endpoints)})")
         if transport == "grpc":
             from .grpc_service import GrpcClient
 
-            self.client = GrpcClient(endpoint, read_timeout=read_timeout,
-                                     retry=self.retry_policy,
-                                     fault_plan=fault_plan)
+            def make_client(ep, plan, retry=None):
+                return GrpcClient(ep, read_timeout=read_timeout,
+                                  retry=retry or self.retry_policy,
+                                  fault_plan=plan)
         else:
-            self.client = WireClient(endpoint, connect_timeout=connect_timeout,
-                                     read_timeout=read_timeout,
-                                     retry=self.retry_policy,
-                                     fault_plan=fault_plan)
+            def make_client(ep, plan, retry=None):
+                return WireClient(ep, connect_timeout=connect_timeout,
+                                  read_timeout=read_timeout,
+                                  retry=retry or self.retry_policy,
+                                  fault_plan=plan)
+        if len(endpoints) > 1:
+            from .fabric import DeviceFabric
+
+            # fabric health probes of maybe-dead replicas run on the
+            # scheduling thread: a single-attempt probe client (no retry,
+            # no backoff sleeps) bounds a blackholed standby's cost to one
+            # connect timeout per probe window, not the full retry budget
+            probe_retry = RetryPolicy(
+                max_retries=0, backoff_base=wire_backoff_base,
+                backoff_max=wire_backoff_max, deadline_s=wire_deadline_s,
+                sleep_fn=sleep_fn if sleep_fn is not None else time.sleep,
+                now_fn=self.now_fn)
+            self.client = DeviceFabric(
+                endpoints,
+                lambda ep, i: make_client(ep, plans[i]),
+                probe_client_factory=lambda ep, i: make_client(
+                    ep, plans[i], retry=probe_retry),
+                metrics=self.smetrics, now_fn=self.now_fn,
+                probe_interval_s=fabric_probe_interval_s)
+        else:
+            # single-replica fast path: the plain transport client, zero
+            # fabric indirection on the per-batch hot path
+            self.client = make_client(endpoints[0], plans[0])
         self.batch_size = batch_size
         # circuit breaker + oracle degradation: N consecutive transport
         # failures open the breaker and every pod takes the sequential
@@ -1708,6 +1750,17 @@ class WireScheduler(Scheduler):
         else:
             out["service"] = {"error": "transport lacks the sessions verb"}
         return out
+
+    def debug_fabric(self) -> dict:
+        """/debug/fabric body: the device-side HA fabric's replica table
+        (active endpoint, per-endpoint health/breaker/epoch) plus the
+        bounded failover journal; a single-endpoint transport reports
+        enabled=False (no fabric in the path)."""
+        dump = getattr(self.client, "dump", None)
+        if dump is None:
+            return {"enabled": False,
+                    "endpoint": getattr(self.client, "endpoint", None)}
+        return dump()
 
     def debug_circuit(self) -> dict:
         """/debug/circuit body: breaker state + resync/degradation story."""

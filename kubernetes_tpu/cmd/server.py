@@ -213,6 +213,9 @@ def build_debug_handlers(sched) -> dict:
       /debug/spans        tail of the in-memory span exporter
       /debug/circuit      device-service circuit breaker state, resync and
                           degradation counters (WireScheduler only)
+      /debug/fabric       device-side HA fabric: active replica, per-
+                          endpoint health/breaker/epoch, failover journal
+                          (WireScheduler with >1 device endpoint)
       /debug/sessions     HA session table: this replica's identity plus the
                           device service's per-client lease age, deltaSeq,
                           and in-flight hold counts (WireScheduler only)
@@ -314,6 +317,14 @@ def build_debug_handlers(sched) -> dict:
             return {"enabled": False}
         return sched.debug_circuit()
 
+    def fabric_dump(limit=None):
+        if not hasattr(sched, "debug_fabric"):
+            return {"enabled": False}
+        out = sched.debug_fabric()
+        if not out.get("enabled"):
+            return out
+        return _capped_lists(out, limit, ("replicas", "log"))
+
     def sessions_dump(limit=None):
         if not hasattr(sched, "debug_sessions"):
             return {"enabled": False}
@@ -347,14 +358,20 @@ def build_debug_handlers(sched) -> dict:
     return {"queue": queue_dump, "cache": cache_dump,
             "devicestate": device_dump, "spans": spans_dump,
             "circuit": circuit_dump, "sessions": sessions_dump,
+            "fabric": fabric_dump,
             "flightrecorder": flightrecorder_dump, "quota": quota_dump,
             "locktrace": locktrace_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
           raw: Optional[dict] = None, feature_gates: str = "",
-          use_informers: bool = True, tpu: bool = False, **kwargs):
-    """server.go:300 Setup: config + registries → a runnable scheduler."""
+          use_informers: bool = True, tpu: bool = False,
+          device_endpoints=None, **kwargs):
+    """server.go:300 Setup: config + registries → a runnable scheduler.
+
+    ``device_endpoints`` (list or comma-separated string) points the
+    scheduler at remote DeviceService bindings over the wire; more than
+    one enables the device-side HA fabric (backend/fabric.py)."""
     from ..backend import telemetry
     from ..utils.tracing import maybe_enable_from_env
 
@@ -362,7 +379,12 @@ def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
     if feature_gates:
         DEFAULT_FEATURE_GATE.set_from_string(feature_gates)
     factory = SharedInformerFactory(store) if use_informers else None
-    if tpu and DEFAULT_FEATURE_GATE.enabled("TPUBatchedScheduling"):
+    if device_endpoints:
+        from ..backend.service import WireScheduler
+
+        kwargs.setdefault("scheduler_cls", WireScheduler)
+        kwargs.setdefault("endpoint", device_endpoints)
+    elif tpu and DEFAULT_FEATURE_GATE.enabled("TPUBatchedScheduling"):
         from ..backend.tpu_scheduler import TPUScheduler
 
         kwargs.setdefault("scheduler_cls", TPUScheduler)
@@ -381,10 +403,12 @@ class SchedulerApp:
 
     def __init__(self, store: ClusterStore, raw_config: Optional[dict] = None,
                  identity: str = "kube-scheduler-0", port: int = 0,
-                 feature_gates: str = "", tpu: bool = False):
+                 feature_gates: str = "", tpu: bool = False,
+                 device_endpoints=None):
         self.cfg = load_config(raw_config)
         self.store = store
-        self.sched = setup(store, cfg=self.cfg, feature_gates=feature_gates, tpu=tpu)
+        self.sched = setup(store, cfg=self.cfg, feature_gates=feature_gates,
+                           tpu=tpu, device_endpoints=device_endpoints)
         self.elector = LeaderElector(
             store,
             LeaderElectionConfig(
@@ -446,6 +470,14 @@ def main(argv=None) -> int:
     parser.add_argument("--leader-elect", default=None, choices=["true", "false"])
     parser.add_argument("--simulate", default="",
                         help="nodes=N,pods=P: run against a synthetic cluster")
+    parser.add_argument("--device-endpoints", default="",
+                        help="comma-separated device-service endpoints "
+                             "(http://host:port); more than one enables "
+                             "the device-side HA fabric")
+    parser.add_argument("--serve-devices", type=int, default=0,
+                        help="serve N in-process DeviceService bindings and "
+                             "point the scheduler at all of them — the "
+                             "single-binary fabric demo topology")
     args = parser.parse_args(argv)
 
     raw = None
@@ -459,8 +491,21 @@ def main(argv=None) -> int:
         raw.setdefault("leaderElection", {})["leaderElect"] = args.leader_elect == "true"
 
     store = ClusterStore()
+    endpoints = [e.strip() for e in args.device_endpoints.split(",")
+                 if e.strip()]
+    device_servers = []
+    if args.serve_devices:
+        from ..backend.service import DeviceService, serve
+
+        for _ in range(args.serve_devices):
+            server, dev_port = serve(DeviceService())
+            device_servers.append(server)
+            endpoints.append(f"http://127.0.0.1:{dev_port}")
+        print(f"device fabric: serving {len(device_servers)} DeviceService "
+              f"bindings: {', '.join(endpoints[-len(device_servers):])}")
     app = SchedulerApp(store, raw_config=raw, port=args.port,
-                       feature_gates=args.feature_gates)
+                       feature_gates=args.feature_gates,
+                       device_endpoints=endpoints or None)
     if args.simulate:
         from ..api.wrappers import make_node, make_pod
 
@@ -484,6 +529,8 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         pass
     app.stop()
+    for server in device_servers:
+        server.shutdown()
     return 0
 
 

@@ -38,6 +38,15 @@ RUNTIME_NAME = "ktpu-hollow"
 RUNTIME_VERSION = "v1"
 
 
+def pb2_available() -> bool:
+    """True when pb2() will succeed (the CRI messages are not vendored
+    yet — gRPC-path tests skip with a reason instead of erroring when
+    the on-demand build cannot happen)."""
+    from ..utils.protoc import build_available
+
+    return build_available(_pb2, _PB2, _PROTO)
+
+
 def pb2():
     global _pb2
     if _pb2 is not None:

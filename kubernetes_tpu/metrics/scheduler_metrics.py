@@ -161,6 +161,24 @@ class SchedulerMetrics:
             "Ownership-check conflicts at device commit time.",
             ["client"],
         ))
+        # device-side HA fabric (backend/fabric.py): which replica the
+        # fabric currently routes to (index into the endpoint list),
+        # primary failovers by trigger family, and per-endpoint replica
+        # health (1 healthy / 0 down) as seen by calls + Health probes
+        self.fabric_active_replica = r.register(Gauge(
+            "scheduler_fabric_active_replica",
+            "Index of the device-service replica the fabric routes to.",
+        ))
+        self.fabric_failovers = r.register(Counter(
+            "scheduler_fabric_failovers_total",
+            "Device-fabric primary failovers by triggering error family.",
+            ["reason"],
+        ))
+        self.fabric_replica_health = r.register(Gauge(
+            "scheduler_fabric_replica_health",
+            "Device-service replica health by endpoint (1 up, 0 down).",
+            ["endpoint"],
+        ))
         # device-runtime observability (backend/telemetry.py): XLA compile
         # ledger per (program, bucket signature) with retrace counts (a
         # compile beyond a program's first — the BatchSizer's bucket walk

@@ -17,6 +17,13 @@ consumed in order, wired into two interception points:
     segfault+restart looks like from the client; ``conflict`` answers the
     409 + ``conflict: true`` cross-client race verdict (HA taxonomy).
 
+HA-fabric primitives (per-ENDPOINT scoping comes from attaching one plan
+per endpoint client): ``partition()`` persistently drops the batch-path
+verbs while Health still answers (the asymmetric partition a health-only
+detector never catches), ``slow()`` injects persistent per-call latency
+(below the read deadline = laggy-but-live, at/above = dead), ``kill()``
+persistently drops everything, and ``heal()`` lifts persistent faults.
+
 Every consumed fault is appended to ``log`` so tests assert the script
 actually fired. Thread-safe: handler threads and the scheduling thread
 consume concurrently.
@@ -39,9 +46,13 @@ SERVER = "server"
 @dataclasses.dataclass
 class Fault:
     kind: str            # "error" | "delay" | "drop" | "crash"
-    count: int = 1       # calls this fault applies to before expiring
+    count: int = 1       # calls this fault applies to; -1 = persistent
     seconds: float = 0.0  # injected latency ("delay" only)
     status: int = 503    # HTTP status for server-side "error"
+
+    @property
+    def persistent(self) -> bool:
+        return self.count < 0
 
 
 class FaultPlan:
@@ -55,7 +66,15 @@ class FaultPlan:
 
     def inject(self, op: str, fault: Fault, side: str = CLIENT) -> "FaultPlan":
         with self._lock:
-            self._faults.setdefault((side, op), []).append(fault)
+            queue = self._faults.setdefault((side, op), [])
+            if any(f.persistent for f in queue):
+                # a persistent fault never leaves the head of its queue,
+                # so anything injected behind it would silently never
+                # fire — reject the script instead of losing its intent
+                raise ValueError(
+                    f"({side}, {op}) already has a persistent fault; "
+                    f"heal() it before injecting more")
+            queue.append(fault)
         return self
 
     def error_once(self, op: str = ANY, side: str = CLIENT) -> "FaultPlan":
@@ -78,6 +97,62 @@ class FaultPlan:
         verdict, scriptable without staging a real two-replica collision."""
         return self.inject(op, Fault("conflict", count=count), side=SERVER)
 
+    # ------------------------------------------------- HA-fabric primitives
+
+    def partition(self, *ops: str) -> "FaultPlan":
+        """Asymmetric network partition of ONE endpoint (attach this plan
+        to that endpoint's client): batch traffic fails PERSISTENTLY while
+        the Health verb still answers — the failure mode where a naive
+        health-probe-only detector never fails over. Defaults to both
+        batch-path verbs; pass explicit ops to narrow (e.g. only
+        ``SCHEDULE_BATCH`` so delta pushes still land). ``heal()`` lifts
+        it."""
+        for op in (ops or (APPLY_DELTAS, SCHEDULE_BATCH)):
+            self.inject(op, Fault("drop", count=-1))
+        return self
+
+    def slow(self, seconds: float, op: str = ANY) -> "FaultPlan":
+        """Persistently slow endpoint: every matching call carries
+        ``seconds`` of injected latency (deterministic — compared against
+        the client's read deadline, never slept). Below the deadline the
+        calls succeed slow (a laggy-but-live standby must NOT trigger
+        failover); at/above it every call times out like a dead one."""
+        return self.inject(op, Fault("delay", count=-1, seconds=seconds))
+
+    def kill(self) -> "FaultPlan":
+        """Endpoint death: every client-side call — Health included —
+        fails persistently, what a killed sidecar process looks like from
+        its clients. ``heal()`` is the restart-less recovery (partition
+        healed / process back on the same epoch)."""
+        return self.inject(ANY, Fault("drop", count=-1))
+
+    def heal(self, op: Optional[str] = None,
+             side: Optional[str] = None) -> "FaultPlan":
+        """Remove pending faults (all of them by default, or only the
+        given op/side): the partition heals, the slow replica catches up,
+        the killed process answers again. Healing a specific op while a
+        WILDCARD fault still covers it raises — a silent no-op there
+        would leave the script believing the op recovered while every
+        call keeps matching the ``*`` queue."""
+        with self._lock:
+            matched = False
+            for key in list(self._faults):
+                s, o = key
+                if (op is None or o == op) and (side is None or s == side):
+                    del self._faults[key]
+                    matched = True
+            if op is not None and op != ANY and not matched:
+                wild = [key for key in self._faults
+                        if key[1] == ANY and (side is None or key[0] == side)
+                        and self._faults[key]]
+                if wild:
+                    raise ValueError(
+                        f"heal(op={op!r}) matched no per-op fault, but a "
+                        f"wildcard (op='*') fault still covers it — heal "
+                        f"the wildcard (heal() / heal(op='*')) or inject "
+                        f"per-op faults instead of kill()")
+        return self
+
     # ------------------------------------------------------------ consuming
 
     def _take(self, side: str, op: str) -> Optional[Fault]:
@@ -87,9 +162,10 @@ class FaultPlan:
                 if not queue:
                     continue
                 fault = queue[0]
-                fault.count -= 1
-                if fault.count <= 0:
-                    queue.pop(0)
+                if not fault.persistent:  # persistent faults never expire
+                    fault.count -= 1
+                    if fault.count <= 0:
+                        queue.pop(0)
                 self.log.append((side, op, fault.kind))
                 return fault
             return None
@@ -101,5 +177,8 @@ class FaultPlan:
         return self._take(SERVER, op)
 
     def pending(self) -> int:
+        """Finite faults not yet consumed (persistent ones never drain,
+        so they are excluded — scripts assert exact finite consumption)."""
         with self._lock:
-            return sum(f.count for q in self._faults.values() for f in q)
+            return sum(max(f.count, 0)
+                       for q in self._faults.values() for f in q)

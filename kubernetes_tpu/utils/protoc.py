@@ -1,0 +1,35 @@
+"""Shared protoc-build availability check.
+
+Three modules compile a .proto on demand into ``native/build`` with the
+same gate (api/protobuf.py, kubelet/cri.py, backend/grpc_service.py —
+the last also prefers its hash-gated vendored module). The availability
+rule lives here ONCE so a future change (e.g. tolerating a missing
+.proto, or also requiring grpcio) cannot leave the three ``pb2()`` gates
+silently inconsistent.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def build_available(cached_module, pb2_path: str, proto_path: str) -> bool:
+    """True when an on-demand protoc build will succeed (or already did):
+    the module object is already imported, a cached build at ``pb2_path``
+    is at least as fresh as ``proto_path``, or protoc is on PATH."""
+    import shutil
+
+    if cached_module is not None:
+        return True
+    if not os.path.exists(proto_path):
+        # the pb2() builders compare mtimes against the .proto even when
+        # a cached build exists, so a missing source means every path
+        # through pb2() raises — having protoc changes nothing
+        return False
+    try:
+        if (os.path.exists(pb2_path)
+                and os.path.getmtime(pb2_path) >= os.path.getmtime(proto_path)):
+            return True
+    except OSError:
+        return False
+    return shutil.which("protoc") is not None

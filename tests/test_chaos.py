@@ -1030,3 +1030,371 @@ class TestFlightRecorderChaos:
         finally:
             rig.close()
             telemetry.disable()
+
+
+class _FabricRig:
+    """A WireScheduler over N served DeviceServices through the
+    DeviceFabric (backend/fabric.py), one FaultPlan per endpoint so chaos
+    scripts scope to a single replica. Every clock (retry backoff, breaker,
+    probe interval) rides one FakeClock — no wall-clock sleeps."""
+
+    def __init__(self, nodes=4, cap="4", replicas=2, **sched_kw):
+        self.clock = FakeClock()
+        self.plans = [FaultPlan() for _ in range(replicas)]
+        self.services = [DeviceService(batch_size=32, now_fn=self.clock)
+                         for _ in range(replicas)]
+        self.servers = []
+        self.endpoints = []
+        for svc, plan in zip(self.services, self.plans):
+            server, port = serve(svc, fault_plan=plan)
+            self.servers.append(server)
+            self.endpoints.append(f"http://127.0.0.1:{port}")
+        self.store = ClusterStore()
+        for i in range(nodes):
+            self.store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": cap, "memory": "16Gi", "pods": 10}).obj())
+        sched_kw.setdefault("batch_size", 8)
+        sched_kw.setdefault("wire_max_retries", 1)
+        # fault scripts count exact ops per endpoint; heartbeats off
+        sched_kw.setdefault("heartbeat_interval_s", 0.0)
+        sched_kw.setdefault("pod_initial_backoff", 0.01)
+        sched_kw.setdefault("pod_max_backoff", 0.05)
+        self.sched = WireScheduler(
+            self.store, endpoint=self.endpoints, fault_plan=self.plans,
+            now_fn=self.clock, sleep_fn=lambda s: self.clock.advance(s),
+            **sched_kw)
+
+    def settle(self, rounds=2, step=1.1):
+        """Drive the scheduler with clock advances between rounds so
+        error-requeued pods clear their backoff windows."""
+        self.sched.run_until_settled()
+        for _ in range(rounds):
+            self.clock.advance(step)
+            self.sched.run_until_settled()
+
+    def active_service(self):
+        return self.services[self.sched.client.active_replica().index]
+
+    def close(self):
+        for s in self.servers:
+            s.shutdown()
+
+
+def _assert_resync_mirror_identical(rig):
+    """Byte-identical post-resync mirror: force a FULL resync into the
+    surviving replica and assert its rebuilt device mirror equals, array
+    for array, the state it already held — i.e. the post-failover state
+    is exactly what a from-scratch sync of host truth produces (the wire
+    twin of TestPipelineRingChaos's fresh-device comparison)."""
+    svc = rig.active_service()
+    before = {k: v.copy() for k, v in svc.device._mirror.items()}
+    rig.sched._full_resync(svc.epoch)
+    after = svc.device._mirror
+    assert set(before) == set(after)
+    for field, arr in before.items():
+        assert np.array_equal(arr, after[field]), field
+
+
+class TestDeviceFabricChaos:
+    """ISSUE 10 acceptance: N DeviceService replicas behind one
+    DeviceFabric. Killing the primary mid-gang and mid-drain, an
+    asymmetric partition, a slow standby, a flapping primary, and
+    all-replicas-down each complete with zero lost pods, zero
+    double-binds, a byte-identical post-resync mirror on the surviving
+    replica, and placements that pass single-scheduler oracle replay.
+
+    Runs under KTPU_LOCKTRACE=1 (the ``locktraced`` fixture): failover
+    probes and transport calls must never fire under the fabric lock, and
+    the whole suite must produce an acyclic lock-order graph."""
+
+    GROUP = "train"
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, locktraced):
+        yield
+
+    def _gang(self, store, n=4):
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name=self.GROUP), min_member=n,
+            schedule_timeout_seconds=30))
+        for i in range(n):
+            store.create_pod(
+                make_pod(f"{self.GROUP}-{i}").req({"cpu": "1", "memory": "1Gi"})
+                .pod_group(self.GROUP).obj())
+
+    def test_primary_kill_mid_gang_fails_over_whole_gang(self):
+        """The primary dies while the gang's batch is on the wire (deltas
+        landed, ScheduleBatch never answers): the fabric poisons the
+        in-flight batch, promotes the standby, the epoch resync seeds it
+        under a fresh session, and the WHOLE gang lands there — never a
+        partial bind, nothing replayed."""
+        from kubernetes_tpu.testing.faults import SCHEDULE_BATCH
+
+        rig = _FabricRig(cap="8")
+        try:
+            self._gang(rig.store)
+            # deltas reach the primary; the gang batch dies with it
+            rig.plans[0].partition(SCHEDULE_BATCH)
+            rig.settle()
+            bound = _bound(rig.store)
+            assert len(bound) == 4                        # zero lost
+            assert len(rig.store.pods) == 4               # zero duplicated
+            gang_nodes = {bound[f"{self.GROUP}-{i}"] for i in range(4)}
+            assert len(gang_nodes) == 4                   # distinct, whole
+            assert len(rig.sched.waiting_pods) == 0       # never parked partial
+            fab = rig.sched.client
+            assert fab.failovers == 1
+            assert fab.active_endpoint() == rig.endpoints[1]
+            assert rig.sched.smetrics.fabric_failovers.labels("transient") == 1
+            # the primary never computed the gang; the standby computed it
+            # exactly once — idempotent batch ids, nothing replayed
+            assert rig.services[0].batch_counter == 0
+            assert rig.services[1].batch_counter >= 1
+            assert rig.services[1].batch_replays == 0
+            # failover is a replica hop, not a degrade: the oracle path
+            # never fired and the scheduler breaker stayed closed
+            assert rig.sched.degraded_pods == 0
+            assert rig.sched.breaker.state == circuit.CLOSED
+            _assert_oracle_replay_valid(rig.store)
+            _assert_resync_mirror_identical(rig)
+        finally:
+            rig.close()
+
+    def test_primary_kill_mid_drain_batch1_undisturbed(self):
+        """The primary dies mid-way through draining a multi-batch queue:
+        batch 1's binds stay exactly where they are, the in-flight work
+        requeues, and the remainder lands on the re-seeded standby within
+        capacity — zero lost, zero double-bound."""
+        rig = _FabricRig(cap="8")
+        try:
+            for i in range(12):
+                rig.store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+            rig.sched.schedule_batch_cycle()               # batch 1 on A
+            bound_before = _bound(rig.store)
+            assert len(bound_before) == 8
+            assert rig.services[0].batch_counter == 1
+            rig.plans[0].kill()                            # primary dies
+            rig.settle()
+            bound = _bound(rig.store)
+            assert len(bound) == 12                        # zero lost
+            assert len(rig.store.pods) == 12               # zero duplicated
+            for name, node in bound_before.items():
+                assert bound[name] == node                 # batch 1 untouched
+            per_node = {}
+            for n in bound.values():
+                per_node[n] = per_node.get(n, 0) + 1
+            assert all(v <= 8 for v in per_node.values()), per_node
+            assert rig.sched.client.failovers == 1
+            assert rig.sched.degraded_pods == 0
+            _assert_oracle_replay_valid(rig.store)
+            _assert_resync_mirror_identical(rig)
+        finally:
+            rig.close()
+
+    def test_asymmetric_partition_fails_over_despite_healthy_probe(self):
+        """Batch traffic to the primary is dropped while its Health verb
+        still answers — the failure a health-only detector never catches.
+        Failure detection is call-driven, so the fabric still fails over;
+        the partitioned primary later rejoins as a STANDBY (sticky
+        selection: never re-adopted mid-flight)."""
+        rig = _FabricRig()
+        try:
+            rig.plans[0].partition()
+            for i in range(6):
+                rig.store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+            rig.settle()
+            bound = _bound(rig.store)
+            assert len(bound) == 6
+            fab = rig.sched.client
+            assert fab.failovers == 1
+            assert fab.active_endpoint() == rig.endpoints[1]
+            # A's health answers: the rate-limited standby probe marks it
+            # healthy again — but the active NEVER flips back mid-flight
+            rig.clock.advance(6.0)
+            rig.store.create_pod(make_pod("late").req({"cpu": "500m"}).obj())
+            rig.settle(rounds=1)
+            assert fab.replicas[0].healthy is True
+            assert fab.active_endpoint() == rig.endpoints[1]  # sticky
+            assert rig.sched.smetrics.fabric_replica_health.labels(
+                rig.endpoints[0]) == 1
+            assert len(_bound(rig.store)) == 7
+            _assert_oracle_replay_valid(rig.store)
+            _assert_resync_mirror_identical(rig)
+        finally:
+            rig.close()
+
+    def test_slow_standby_absorbed_then_adopted_on_failover(self):
+        """A laggy-but-live standby (persistent delay under the read
+        deadline) must not destabilize the healthy primary; when the
+        primary dies the slow standby is still adopted and serves."""
+        rig = _FabricRig(cap="8")
+        try:
+            rig.plans[1].slow(0.05)
+            for i in range(4):
+                rig.store.create_pod(
+                    make_pod(f"a{i}").req({"cpu": "1"}).obj())
+            rig.settle(rounds=1)
+            assert len(_bound(rig.store)) == 4
+            assert rig.sched.client.failovers == 0        # slowness != death
+            rig.plans[0].kill()
+            for i in range(4):
+                rig.store.create_pod(
+                    make_pod(f"b{i}").req({"cpu": "1"}).obj())
+            rig.settle()
+            assert len(_bound(rig.store)) == 8
+            assert rig.sched.client.failovers == 1
+            # the slow script really fired (delays absorbed, not raised)
+            assert any(k == "delay" for _, _, k in rig.plans[1].log)
+            _assert_oracle_replay_valid(rig.store)
+        finally:
+            rig.close()
+
+    def test_flapping_primary_reseeded_on_failback_never_adopted_stale(self):
+        """Partition A → fail over to B → heal A (same epoch, STALE
+        mirror) → kill B → fail back to A. The rejoined ex-primary must be
+        re-seeded by a full resync (the client's known epoch is B's, so
+        A's first answer is the stale-epoch verdict) — its stale mirror is
+        never trusted mid-flight, and every wave lands exactly once."""
+        rig = _FabricRig(cap="8")
+        try:
+            for i in range(4):
+                rig.store.create_pod(make_pod(f"w1-{i}").req({"cpu": "1"}).obj())
+            rig.settle(rounds=1)                           # wave 1 on A
+            assert rig.sched.client.failovers == 0
+            resyncs_before = rig.sched.resyncs
+            rig.plans[0].partition()
+            for i in range(4):
+                rig.store.create_pod(make_pod(f"w2-{i}").req({"cpu": "1"}).obj())
+            rig.settle()                                   # wave 2 → B
+            assert rig.sched.client.failovers == 1
+            assert rig.sched.resyncs > resyncs_before      # B was seeded
+            rig.plans[0].heal()
+            rig.clock.advance(6.0)
+            for i in range(2):
+                rig.store.create_pod(make_pod(f"w3-{i}").req({"cpu": "1"}).obj())
+            rig.settle(rounds=1)                           # wave 3 on B; A rejoins
+            assert rig.sched.client.replicas[0].healthy
+            assert rig.sched.client.active_endpoint() == rig.endpoints[1]
+            resyncs_mid = rig.sched.resyncs
+            rig.plans[1].kill()
+            for i in range(2):
+                rig.store.create_pod(make_pod(f"w4-{i}").req({"cpu": "1"}).obj())
+            rig.settle()                                   # wave 4 → back to A
+            fab = rig.sched.client
+            assert fab.failovers == 2
+            assert fab.active_endpoint() == rig.endpoints[0]
+            # the failback re-seeded A: a full resync fired against its
+            # unchanged epoch (stale-mirror detection, not blind adoption)
+            assert rig.sched.resyncs > resyncs_mid
+            bound = _bound(rig.store)
+            assert len(bound) == 12 and len(rig.store.pods) == 12
+            _assert_oracle_replay_valid(rig.store)
+            _assert_resync_mirror_identical(rig)
+        finally:
+            rig.close()
+
+    def test_all_replicas_down_degrades_to_oracle_then_heals(self):
+        """The last rung of the ladder: with EVERY replica dead the
+        original transport error reaches the scheduler breaker, which
+        opens and routes pods through the sequential oracle — throughput
+        never zero. When a replica heals, the half-open probe rides the
+        fabric's health() and the batched path resumes on it."""
+        rig = _FabricRig(breaker_threshold=2, cap="8")
+        try:
+            rig.plans[0].kill()
+            rig.plans[1].kill()
+            for i in range(6):
+                rig.store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "1"}).obj())
+            rig.settle()
+            bound = _bound(rig.store)
+            assert len(bound) == 6                         # oracle landed them
+            assert rig.sched.degraded_pods >= 6
+            assert rig.sched.breaker.state == circuit.OPEN
+            assert rig.sched.client.failovers == 0         # nowhere to go
+            assert rig.services[0].batch_counter == 0
+            assert rig.services[1].batch_counter == 0
+
+            rig.plans[0].heal()                            # A comes back
+            rig.clock.advance(5.5)                         # past breaker reset
+            for i in range(2):
+                rig.store.create_pod(
+                    make_pod(f"q{i}").req({"cpu": "1"}).obj())
+            rig.settle()
+            assert rig.sched.breaker.state == circuit.CLOSED
+            assert len(_bound(rig.store)) == 8             # zero lost
+            assert rig.services[0].batch_counter > 0       # batched path back
+            # the open→close degraded window is accounted on the fake clock
+            assert rig.sched.smetrics.degraded_seconds.labels() > 0
+            _assert_oracle_replay_valid(rig.store)
+            _assert_resync_mirror_identical(rig)
+        finally:
+            rig.close()
+
+    def _debug_get(self, sched, path):
+        import json
+        import urllib.request
+
+        from kubernetes_tpu.cmd.server import (
+            ComponentServer, build_debug_handlers)
+
+        server = ComponentServer(configz={}, debug=build_debug_handlers(sched))
+        port = server.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+                return json.loads(r.read())
+        finally:
+            server.stop()
+
+    def test_failover_flight_event_ordered_after_last_poison(self):
+        """ISSUE 10 acceptance, observability half: read over the REAL
+        /debug/flightrecorder endpoint, the failover event is ordered
+        strictly after the last poisoned batch's poison event and names
+        both endpoints + the batch; /debug/fabric serves the replica
+        table with the uniform ?limit= capping."""
+        from kubernetes_tpu.backend import telemetry
+        from kubernetes_tpu.testing.faults import SCHEDULE_BATCH
+
+        telemetry.enable()
+        rig = _FabricRig()
+        try:
+            rig.plans[0].partition(SCHEDULE_BATCH)  # batch dies in flight
+            for i in range(4):
+                rig.store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+            rig.settle()
+            assert len(_bound(rig.store)) == 4
+            assert rig.sched.smetrics.fabric_failovers.labels("transient") == 1
+
+            body = self._debug_get(rig.sched, "/debug/flightrecorder")
+            assert body["enabled"] is True
+            events = body["events"]
+            poisons = [e for e in events if e["type"] == "poison"]
+            failovers = [e for e in events if e["type"] == "failover"]
+            downs = [e for e in events if e["type"] == "replica_down"]
+            assert poisons and failovers and downs
+            last_poison = max(e["seq"] for e in poisons)
+            assert failovers[0]["seq"] > last_poison
+            assert failovers[0]["batchId"] == poisons[-1]["batchId"]
+            assert failovers[0]["fromEndpoint"] == rig.endpoints[0]
+            assert failovers[0]["endpoint"] == rig.endpoints[1]
+            assert downs[0]["endpoint"] == rig.endpoints[0]
+            # the poisoned batch's pods were requeued (ring lifecycle)
+            requeues = [e for e in events if e["type"] == "requeue"]
+            assert requeues and requeues[0]["seq"] > last_poison
+
+            fab = self._debug_get(rig.sched, "/debug/fabric")
+            assert fab["enabled"] is True and fab["activeIndex"] == 1
+            assert [r["endpoint"] for r in fab["replicas"]] == rig.endpoints
+            assert fab["log"][0]["from"] == rig.endpoints[0]
+            capped = self._debug_get(rig.sched, "/debug/fabric?limit=0")
+            assert capped["log"] == [] and capped["replicas"] == []
+            assert capped["truncated"]["replicas"] == 2
+        finally:
+            rig.close()
+            telemetry.disable()

@@ -135,7 +135,13 @@ class TestDebugEndpoints:
             assert set(json.loads(body)["endpoints"]) == {
                 "/debug/queue", "/debug/cache", "/debug/devicestate",
                 "/debug/spans", "/debug/circuit", "/debug/sessions",
-                "/debug/flightrecorder", "/debug/quota", "/debug/locktrace"}
+                "/debug/fabric", "/debug/flightrecorder", "/debug/quota",
+                "/debug/locktrace"}
+
+            # non-wire scheduler: the fabric endpoint reports disabled
+            status, body = _get(port, "/debug/fabric")
+            assert status == 200
+            assert json.loads(body)["enabled"] is False
 
             # locktrace endpoint: disabled report by default, full graph
             # dump when the suite runs under KTPU_LOCKTRACE=1

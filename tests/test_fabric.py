@@ -1,0 +1,423 @@
+"""Device-side HA fabric, unit layer (backend/fabric.py): selection
+policy, failover verdicts, health passthrough, rejoin probing, metrics,
+and the WireScheduler construction seam. Transport is stubbed — the
+over-the-socket story lives in tests/test_chaos.py::TestDeviceFabricChaos."""
+
+import pytest
+
+from kubernetes_tpu.backend import telemetry
+from kubernetes_tpu.backend.errors import (
+    ConflictError,
+    FailoverError,
+    PermanentDeviceError,
+    StaleEpochError,
+    TransientDeviceError,
+)
+from kubernetes_tpu.backend.fabric import DeviceFabric
+from kubernetes_tpu.metrics.scheduler_metrics import SchedulerMetrics
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+class _StubClient:
+    """Scripted transport client: raises ``fail`` on batch-path verbs and
+    ``fail_health`` on Health — per-endpoint, mutable mid-test."""
+
+    supports_dra = True
+    supports_health = True
+    supports_sessions = True
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        self.epoch = f"epoch-{endpoint}"
+        self.calls = []
+        self.fail = None         # exception for apply_deltas/schedule_batch
+        self.fail_health = None  # exception for health
+
+    def _out(self, **extra):
+        out = {"apiVersion": "ktpu/v1", "epoch": self.epoch, "deltaSeq": 1}
+        out.update(extra)
+        return out
+
+    def apply_deltas(self, payload):
+        self.calls.append("apply_deltas")
+        if self.fail is not None:
+            raise self.fail
+        return self._out(nodes=len(payload.get("nodes", ())))
+
+    def schedule_batch(self, payload):
+        self.calls.append("schedule_batch")
+        if self.fail is not None:
+            raise self.fail
+        return self._out(results=[])
+
+    def heartbeat(self, payload):
+        self.calls.append("heartbeat")
+        if self.fail is not None:
+            raise self.fail
+        return self._out(fenced=[])
+
+    def health(self):
+        self.calls.append("health")
+        if self.fail_health is not None:
+            raise self.fail_health
+        return self._out(status="serving")
+
+    def sessions_dump(self):
+        self.calls.append("sessions")
+        if self.fail is not None:
+            raise self.fail
+        return self._out(sessions=[])
+
+
+def _fabric(n=3, metrics=None, clock=None, probe_interval_s=5.0):
+    clock = clock or FakeClock()
+    clients = {}
+
+    def factory(ep, i):
+        clients[ep] = _StubClient(ep)
+        return clients[ep]
+
+    fab = DeviceFabric([f"ep{i}" for i in range(n)], factory,
+                       metrics=metrics, now_fn=clock,
+                       probe_interval_s=probe_interval_s)
+    return fab, clients, clock
+
+
+class TestSelection:
+    def test_routes_to_first_endpoint_and_mirrors_capabilities(self):
+        fab, clients, _ = _fabric()
+        out = fab.schedule_batch({"pods": [], "batchId": "b-1"})
+        assert out["epoch"] == "epoch-ep0"
+        assert clients["ep0"].calls == ["schedule_batch"]
+        assert clients["ep1"].calls == []
+        assert fab.supports_dra and fab.supports_health
+        assert fab.supports_sessions
+        assert fab.active_endpoint() == "ep0"
+
+    def test_needs_at_least_one_endpoint(self):
+        with pytest.raises(ValueError):
+            DeviceFabric([], lambda ep, i: _StubClient(ep))
+
+    def test_protocol_verdicts_pass_through_without_failover(self):
+        """StaleEpoch/Conflict come from a HEALTHY service — the client's
+        own recovery paths handle them; the fabric must not demote."""
+        fab, clients, _ = _fabric()
+        clients["ep0"].fail = StaleEpochError("fresh-epoch")
+        with pytest.raises(StaleEpochError):
+            fab.apply_deltas({"nodes": []})
+        clients["ep0"].fail = ConflictError("raced")
+        with pytest.raises(ConflictError):
+            fab.schedule_batch({"pods": [], "batchId": "b-2"})
+        assert fab.active_endpoint() == "ep0"
+        assert fab.failovers == 0
+        assert fab.replicas[0].healthy
+
+
+class TestFailover:
+    def test_primary_loss_promotes_first_live_standby(self):
+        m = SchedulerMetrics()
+        fab, clients, _ = _fabric(metrics=m)
+        clients["ep0"].fail = TransientDeviceError("connection reset")
+        with pytest.raises(FailoverError) as ei:
+            fab.schedule_batch({"pods": [{}], "batchId": "b-7"})
+        assert ei.value.from_endpoint == "ep0"
+        assert ei.value.to_endpoint == "ep1"
+        assert fab.active_endpoint() == "ep1"
+        assert fab.failovers == 1
+        assert not fab.replicas[0].healthy and fab.replicas[1].healthy
+        # the standby was verified live with the cheap Health verb, not a
+        # blind adoption
+        assert clients["ep1"].calls == ["health"]
+        assert m.fabric_active_replica.labels() == 1
+        assert m.fabric_failovers.labels("transient") == 1
+        assert m.fabric_replica_health.labels("ep0") == 0
+        assert m.fabric_replica_health.labels("ep1") == 1
+        # FailoverError is transient by taxonomy: the scheduler requeues
+        # the batch and counts it against ITS breaker, never retries it
+        assert isinstance(ei.value, TransientDeviceError)
+
+    def test_dead_standby_skipped_for_the_next_one(self):
+        fab, clients, _ = _fabric(n=3)
+        clients["ep0"].fail = TransientDeviceError("down")
+        clients["ep1"].fail_health = TransientDeviceError("also down")
+        with pytest.raises(FailoverError) as ei:
+            fab.apply_deltas({"nodes": []})
+        assert ei.value.to_endpoint == "ep2"
+        assert fab.active_endpoint() == "ep2"
+        assert not fab.replicas[1].healthy
+
+    def test_all_replicas_down_propagates_original_error(self):
+        """No standby answers: the ORIGINAL transport error reaches the
+        scheduler so its breaker walks the last rung of the ladder
+        (oracle degrade) with the true failure visible."""
+        fab, clients, _ = _fabric(n=2)
+        exc = TransientDeviceError("primary gone")
+        clients["ep0"].fail = exc
+        clients["ep1"].fail_health = TransientDeviceError("standby gone")
+        with pytest.raises(TransientDeviceError) as ei:
+            fab.schedule_batch({"pods": [], "batchId": "b-1"})
+        assert ei.value is exc
+        assert fab.failovers == 0
+        assert fab.active_endpoint() == "ep0"  # nowhere better to point
+
+    def test_permanent_error_fails_over_with_reason_label(self):
+        m = SchedulerMetrics()
+        fab, clients, _ = _fabric(metrics=m)
+        clients["ep0"].fail = PermanentDeviceError("version skew: 400")
+        with pytest.raises(FailoverError):
+            fab.apply_deltas({"nodes": []})
+        assert m.fabric_failovers.labels("permanent") == 1
+
+    def test_health_fails_over_transparently(self):
+        """The scheduler's half-open probe calls health(): with the
+        primary dead but a standby live, the probe must SUCCEED (answer
+        from the standby) — the batch proceeds and the epoch protocol
+        re-seeds on the next push."""
+        fab, clients, _ = _fabric()
+        clients["ep0"].fail = TransientDeviceError("dead")
+        clients["ep0"].fail_health = TransientDeviceError("dead")
+        out = fab.health()
+        assert out["epoch"] == "epoch-ep1"
+        assert fab.active_endpoint() == "ep1"
+        assert fab.failovers == 1
+
+    def test_poison_then_failover_event_order(self):
+        """The in-flight batch's poison event lands strictly before the
+        failover event — the postmortem reads 'batch died, THEN the
+        fabric moved on' (ISSUE 10 acceptance, unit half)."""
+        tele = telemetry.enable()
+        try:
+            fab, clients, _ = _fabric()
+            clients["ep0"].fail = TransientDeviceError("mid-batch death")
+            with pytest.raises(FailoverError):
+                fab.schedule_batch({"pods": [{}, {}], "batchId": "b-9"})
+            poisons = tele.flight.events("poison", batch_id="b-9")
+            failovers = tele.flight.events("failover")
+            downs = tele.flight.events("replica_down")
+            assert len(poisons) == 1 and poisons[0]["pods"] == 2
+            assert len(failovers) == 1
+            assert failovers[0]["batchId"] == "b-9"
+            assert failovers[0]["fromEndpoint"] == "ep0"
+            assert failovers[0]["endpoint"] == "ep1"
+            assert downs[0]["seq"] < poisons[0]["seq"] < failovers[0]["seq"]
+        finally:
+            telemetry.disable()
+
+    def test_delta_failure_poisons_nothing(self):
+        tele = telemetry.enable()
+        try:
+            fab, clients, _ = _fabric()
+            clients["ep0"].fail = TransientDeviceError("down")
+            with pytest.raises(FailoverError):
+                fab.apply_deltas({"nodes": []})
+            assert tele.flight.events("poison") == []
+            assert len(tele.flight.events("failover")) == 1
+        finally:
+            telemetry.disable()
+
+
+class TestRejoin:
+    def _failed_over(self, m=None):
+        clock = FakeClock()
+        fab, clients, _ = _fabric(n=2, metrics=m, clock=clock)
+        fab.apply_deltas({"nodes": []})  # learn ep0's epoch while healthy
+        clients["ep0"].fail = TransientDeviceError("down")
+        clients["ep0"].fail_health = TransientDeviceError("down")
+        with pytest.raises(FailoverError):
+            fab.apply_deltas({"nodes": []})
+        return fab, clients, clock
+
+    def test_rejoined_primary_becomes_standby_never_active(self):
+        """Sticky selection: the probed-up ex-primary is healthy again
+        but the fabric keeps routing to the promoted standby — adoption
+        only ever happens through a failover (whose resync re-seeds the
+        stale mirror via the epoch check)."""
+        m = SchedulerMetrics()
+        fab, clients, clock = self._failed_over(m)
+        clients["ep0"].fail = None
+        clients["ep0"].fail_health = None
+        clock.advance(6.0)  # past probe_interval AND the replica breaker
+        tele = telemetry.enable()
+        try:
+            fab.schedule_batch({"pods": [], "batchId": "b-2"})
+            rejoins = tele.flight.events("replica_rejoin")
+            assert [e["endpoint"] for e in rejoins] == ["ep0"]
+            assert rejoins[0]["restarted"] is False  # same epoch answered
+        finally:
+            telemetry.disable()
+        assert fab.replicas[0].healthy
+        assert fab.active_endpoint() == "ep1"  # sticky
+        assert m.fabric_replica_health.labels("ep0") == 1
+
+    def test_restarted_primary_flagged_on_rejoin(self):
+        fab, clients, clock = self._failed_over()
+        clients["ep0"].fail = None
+        clients["ep0"].fail_health = None
+        clients["ep0"].epoch = "epoch-ep0-RESTARTED"
+        clock.advance(6.0)
+        tele = telemetry.enable()
+        try:
+            fab.schedule_batch({"pods": [], "batchId": "b-3"})
+            rejoins = tele.flight.events("replica_rejoin")
+            assert rejoins and rejoins[0]["restarted"] is True
+        finally:
+            telemetry.disable()
+
+    def test_probe_is_rate_limited(self):
+        fab, clients, clock = self._failed_over()
+        clients["ep0"].fail_health = None
+        probes_before = clients["ep0"].calls.count("health")
+        fab.schedule_batch({"pods": [], "batchId": "b-4"})  # interval not up
+        assert clients["ep0"].calls.count("health") == probes_before
+        clock.advance(6.0)
+        fab.schedule_batch({"pods": [], "batchId": "b-5"})
+        assert clients["ep0"].calls.count("health") == probes_before + 1
+        # and not again until the next window
+        fab.schedule_batch({"pods": [], "batchId": "b-6"})
+        assert clients["ep0"].calls.count("health") == probes_before + 1
+
+    def test_failback_probes_the_rejoined_primary(self):
+        """Standby dies after the ex-primary rejoined: the fabric fails
+        BACK — verifying with Health first — so the scheduler's next push
+        hits the old epoch mismatch and re-seeds it."""
+        fab, clients, clock = self._failed_over()
+        clients["ep0"].fail = None
+        clients["ep0"].fail_health = None
+        clock.advance(6.0)
+        fab.schedule_batch({"pods": [], "batchId": "b-7"})  # rejoin probe
+        clients["ep1"].fail = TransientDeviceError("standby dies")
+        with pytest.raises(FailoverError) as ei:
+            fab.schedule_batch({"pods": [], "batchId": "b-8"})
+        assert ei.value.to_endpoint == "ep0"
+        assert fab.active_endpoint() == "ep0"
+        assert fab.failovers == 2
+
+
+class TestProbeClient:
+    def test_probes_ride_the_dedicated_probe_client(self):
+        """Promotion and rejoin probes use the single-attempt probe
+        client, never the main (retry-budgeted) transport client — a
+        blackholed standby costs one connect timeout per window on the
+        scheduling thread, not retries × timeout + backoff sleeps."""
+        clock = FakeClock()
+        mains, probes = {}, {}
+
+        def factory(ep, i):
+            mains[ep] = _StubClient(ep)
+            return mains[ep]
+
+        def pfactory(ep, i):
+            probes[ep] = _StubClient(ep)
+            return probes[ep]
+
+        fab = DeviceFabric(["ep0", "ep1"], factory,
+                           probe_client_factory=pfactory, now_fn=clock)
+        mains["ep0"].fail = TransientDeviceError("down")
+        with pytest.raises(FailoverError):
+            fab.apply_deltas({"nodes": []})
+        assert probes["ep1"].calls == ["health"]   # promotion probe
+        assert mains["ep1"].calls == []
+        clock.advance(6.0)
+        fab.schedule_batch({"pods": [], "batchId": "b-1"})
+        assert probes["ep0"].calls == ["health"]   # rejoin probe
+        assert "health" not in mains["ep0"].calls
+
+    def test_wire_scheduler_probe_clients_have_no_retry_budget(self):
+        from kubernetes_tpu.api.wrappers import make_node
+        from kubernetes_tpu.apiserver.store import ClusterStore
+        from kubernetes_tpu.backend.service import WireScheduler
+
+        store = ClusterStore()
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        sched = WireScheduler(
+            store, endpoint=["http://127.0.0.1:9", "http://127.0.0.1:10"])
+        for rep in sched.client.replicas:
+            assert rep.probe is not rep.client
+            assert rep.probe.retry.max_retries == 0
+            assert rep.client.retry.max_retries == 3
+
+
+class TestSessionsDumpIntrospection:
+    def test_sessions_dump_never_runs_failover_machinery(self):
+        """sessions_dump is reachable from the /debug SERVING thread
+        (WireScheduler.debug_sessions): it must be a pure read of the
+        active replica — a transport error surfaces to the caller, never
+        a demotion, promotion probe, or failover counter bump from a
+        nominally read-only endpoint."""
+        fab, clients, _ = _fabric()
+        clients["ep0"].fail = TransientDeviceError("down")
+        with pytest.raises(TransientDeviceError):
+            fab.sessions_dump()
+        assert fab.failovers == 0
+        assert fab.active_endpoint() == "ep0"
+        assert fab.replicas[0].healthy            # no demotion
+        assert clients["ep1"].calls == []         # no probes fired
+
+
+class TestDump:
+    def test_dump_shape(self):
+        fab, clients, _ = _fabric(n=2)
+        clients["ep0"].fail = TransientDeviceError("down")
+        with pytest.raises(FailoverError):
+            fab.apply_deltas({"nodes": []})
+        out = fab.dump()
+        assert out["enabled"] is True
+        assert out["active"] == "ep1" and out["activeIndex"] == 1
+        assert out["failovers"] == 1 and out["replicaCount"] == 2
+        assert [r["endpoint"] for r in out["replicas"]] == ["ep0", "ep1"]
+        assert out["replicas"][0]["healthy"] is False
+        assert out["replicas"][1]["active"] is True
+        assert "TransientDeviceError" in out["replicas"][0]["lastError"]
+        assert out["log"] and out["log"][0]["from"] == "ep0"
+        assert out["replicas"][0]["breaker"]["state"] == "open"
+
+
+class TestWireSchedulerSeam:
+    def _store(self):
+        from kubernetes_tpu.api.wrappers import make_node
+        from kubernetes_tpu.apiserver.store import ClusterStore
+
+        store = ClusterStore()
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+        return store
+
+    def test_single_endpoint_keeps_the_plain_client(self):
+        from kubernetes_tpu.backend.service import WireClient, WireScheduler
+
+        sched = WireScheduler(self._store(), endpoint="http://127.0.0.1:9")
+        assert isinstance(sched.client, WireClient)
+        assert sched.debug_fabric() == {"enabled": False,
+                                       "endpoint": "http://127.0.0.1:9"}
+
+    def test_endpoint_list_and_comma_string_build_the_fabric(self):
+        from kubernetes_tpu.backend.service import WireScheduler
+
+        sched = WireScheduler(
+            self._store(),
+            endpoint="http://127.0.0.1:9, http://127.0.0.1:10")
+        assert isinstance(sched.client, DeviceFabric)
+        assert [r.endpoint for r in sched.client.replicas] == [
+            "http://127.0.0.1:9", "http://127.0.0.1:10"]
+        assert sched.debug_fabric()["enabled"] is True
+        sched2 = WireScheduler(
+            self._store(),
+            endpoint=["http://127.0.0.1:9", "http://127.0.0.1:10"])
+        assert isinstance(sched2.client, DeviceFabric)
+
+    def test_fault_plan_list_must_match_endpoints(self):
+        from kubernetes_tpu.backend.service import WireScheduler
+        from kubernetes_tpu.testing.faults import FaultPlan
+
+        with pytest.raises(ValueError, match="fault_plan"):
+            WireScheduler(
+                self._store(),
+                endpoint=["http://127.0.0.1:9", "http://127.0.0.1:10"],
+                fault_plan=[FaultPlan()])
+
+    def test_empty_endpoint_rejected(self):
+        from kubernetes_tpu.backend.service import WireScheduler
+
+        with pytest.raises(ValueError, match="endpoint"):
+            WireScheduler(self._store(), endpoint=" , ")

@@ -168,6 +168,130 @@ class TestFaultPlan:
         assert plan.next_server("schedule_batch").kind == "crash"
 
 
+class TestPartitionPrimitive:
+    """ISSUE 10 satellite: per-verb persistent drop — batch traffic fails
+    while Health still answers (the asymmetric-partition failure mode)."""
+
+    def test_batch_verbs_drop_health_answers(self):
+        plan = FaultPlan().partition()
+        for _ in range(5):  # persistent: never drains
+            assert plan.next_client("schedule_batch").kind == "drop"
+            assert plan.next_client("apply_deltas").kind == "drop"
+        assert plan.next_client("health") is None
+        assert plan.next_client("heartbeat") is None
+        assert plan.pending() == 0  # persistent faults don't count down
+
+    def test_partition_narrowed_to_one_verb(self):
+        from kubernetes_tpu.testing.faults import SCHEDULE_BATCH
+
+        plan = FaultPlan().partition(SCHEDULE_BATCH)
+        assert plan.next_client("schedule_batch").kind == "drop"
+        assert plan.next_client("apply_deltas") is None
+
+    def test_heal_lifts_the_partition(self):
+        plan = FaultPlan().partition()
+        assert plan.next_client("schedule_batch") is not None
+        plan.heal()
+        assert plan.next_client("schedule_batch") is None
+        assert plan.next_client("apply_deltas") is None
+
+    def test_heal_is_selective_by_op(self):
+        plan = FaultPlan().partition()
+        plan.heal(op="apply_deltas")
+        assert plan.next_client("apply_deltas") is None
+        assert plan.next_client("schedule_batch").kind == "drop"
+
+    def test_per_op_heal_under_a_wildcard_fault_raises(self):
+        """heal(op=X) against a kill() (wildcard drop) would otherwise
+        silently no-op — every X call still matches the '*' queue while
+        the script believes X recovered. The plan rejects it loudly."""
+        plan = FaultPlan().kill()
+        with pytest.raises(ValueError, match="wildcard"):
+            plan.heal(op="schedule_batch")
+        assert plan.next_client("schedule_batch").kind == "drop"  # still dead
+        plan.heal()  # the sanctioned full heal
+        assert plan.next_client("schedule_batch") is None
+        # idempotent no-op heal with no wildcard present stays silent
+        plan.heal(op="schedule_batch")
+
+    def test_kill_drops_everything_including_health(self):
+        plan = FaultPlan().kill()
+        for op in ("apply_deltas", "schedule_batch", "health", "heartbeat"):
+            assert plan.next_client(op).kind == "drop"
+        assert plan.next_client("health").kind == "drop"  # persistent
+        plan.heal()
+        assert plan.next_client("health") is None
+
+    def test_injecting_behind_a_persistent_fault_is_rejected(self):
+        """A persistent fault never leaves its queue head, so a finite
+        fault injected behind it on the same key would silently never
+        fire — the plan rejects the script instead of losing its intent
+        (heal() first, or target a different op: exact-op queues are
+        consulted before the ANY queue, so kill() + a per-op fault still
+        composes)."""
+        from kubernetes_tpu.testing.faults import ANY, Fault
+
+        plan = FaultPlan().kill()
+        with pytest.raises(ValueError, match="persistent"):
+            plan.inject(ANY, Fault("error"))
+        # exact-op injection behind a wildcard kill is fine (and fires
+        # first: _take prefers the (side, op) queue over (side, ANY))
+        plan.inject("schedule_batch", Fault("error"))
+        assert plan.next_client("schedule_batch").kind == "error"
+        assert plan.next_client("schedule_batch").kind == "drop"
+        # heal() then re-inject is the sanctioned sequence
+        plan.heal()
+        plan.inject(ANY, Fault("error"))
+        assert plan.next_client("health").kind == "error"
+
+    def test_partition_raises_the_transient_family(self):
+        from kubernetes_tpu.backend.errors import raise_injected_fault
+
+        plan = FaultPlan().partition()
+        with pytest.raises(TransientDeviceError):
+            raise_injected_fault(plan, "schedule_batch", read_timeout=60.0)
+        raise_injected_fault(plan, "health", read_timeout=60.0)  # no-op
+
+
+class TestSlowPrimitive:
+    """ISSUE 10 satellite: persistent per-endpoint latency — below the
+    read deadline the calls succeed slow (laggy-but-live must NOT read as
+    dead), at/above it every call times out."""
+
+    def test_slow_below_deadline_is_absorbed_forever(self):
+        from kubernetes_tpu.backend.errors import raise_injected_fault
+
+        plan = FaultPlan().slow(0.05)
+        for _ in range(4):
+            raise_injected_fault(plan, "schedule_batch", read_timeout=1.0)
+        # consumed (and logged) every time, but never raised
+        assert [k for _, _, k in plan.log] == ["delay"] * 4
+        assert plan.pending() == 0
+
+    def test_slow_past_deadline_times_out_every_call(self):
+        from kubernetes_tpu.backend.errors import raise_injected_fault
+
+        plan = FaultPlan().slow(10.0)
+        for _ in range(3):
+            with pytest.raises(TransientDeviceError, match="timeout"):
+                raise_injected_fault(plan, "apply_deltas", read_timeout=1.0)
+        plan.heal()
+        raise_injected_fault(plan, "apply_deltas", read_timeout=1.0)  # healed
+
+    def test_slow_endpoint_still_serves_over_the_socket(self):
+        service = DeviceService(batch_size=8)
+        server, port = serve(service)
+        try:
+            plan = FaultPlan().slow(0.01)
+            client = WireClient(f"http://127.0.0.1:{port}", read_timeout=5.0,
+                                fault_plan=plan)
+            for _ in range(3):
+                out = client.apply_deltas({"nodes": []})
+            assert out["deltaSeq"] == 3
+        finally:
+            server.shutdown()
+
+
 class TestWireClientTaxonomy:
     def test_connection_refused_is_transient(self):
         # nothing listens on this port: refusal must classify transient and

@@ -177,6 +177,19 @@ FENCE_TOLERANCES = {
 # screen/batching strategy changed; a tight fence there would only flap)
 FENCE_WORKLOAD_OVERRIDES = {
     "PreemptionBasic": {"workload_pods_per_s": 85.0, "workload_p99_s": 300.0},
+    # r07 A/A evidence (two --record runs of the IDENTICAL tree, 40 min
+    # apart, on the r06 2-core container): PreemptionPVs 46.9 -> 609.0
+    # pods/s (13x) and PreemptionBasic 590.7 -> 35.3 (17x) — the
+    # preemption rows share PreemptionBasic's structural volatility on
+    # this box. The attempt-p99 rows are read from histogram buckets
+    # (~2x spacing: 3.776 -> 7.872), so ONE bucket step reads as
+    # ~100-108% and flaps a 100% tolerance.
+    "PreemptionPVs": {"workload_pods_per_s": 85.0, "workload_p99_s": 300.0},
+    # SchedulingPodAffinity swings across the box's bimodal modes
+    # (r06 35.2 vs same-code r07 runs 20.8 / 23.5 pods/s) — a 40%/100%
+    # fence there flaps on mode, not on code.
+    "SchedulingPodAffinity": {"workload_pods_per_s": 60.0,
+                              "workload_p99_s": 200.0},
 }
 
 
