@@ -75,6 +75,7 @@ class DeviceState:
         self.syncs = 0
         self.rows_uploaded = 0
         self.rows_elided = 0
+        self.nodes_removed = 0  # removal-sweep tombstones (elastic churn)
         # transfer telemetry: bytes scattered device-ward by the last /
         # all sync calls (the padded row-block size — what actually rides
         # the relay), read by backend/telemetry.py and /debug
@@ -252,25 +253,26 @@ class DeviceState:
         self._refresh_class_prio()
         self.last_upload_bytes = 0
         dirty: List[Tuple[int, NodeInfo]] = []
-        current = set()
         images_changed = False
         attr_pending: Dict[int, dict] = {}
-        for name, ni in snapshot.node_info_map.items():
-            current.add(name)
-            if self._uploaded_gen.get(name) == ni.generation:
-                continue
-            slot = self.encoder.node_slot(name)
-            dirty.append((slot, ni))
-            self._uploaded_gen[name] = ni.generation
-            images_changed |= self._track_images(name, ni)
-            self._track_attrs(name, ni, slot, attr_pending)
-            self.sig_table.recount_node(slot, ni)
-        # removed nodes: zero their rows
-        removed = [n for n in self._uploaded_gen if n not in current]
+        from . import telemetry
+
+        # removed nodes FIRST: tombstone their rows (zeroed on device, slot
+        # to the free-list, vocab retentions dropped), so a node added in
+        # the SAME sync reuses the freed slot immediately instead of
+        # growing the axis for one generation. Membership comes from the
+        # ENCODER's slot map, not _uploaded_gen — commit-repair paths pop a
+        # node's gen to force re-upload, and a node deleted in that window
+        # would otherwise leak its slot (and stale mirror row) forever.
+        current = snapshot.node_info_map
+        removed = [n for n in self.encoder.node_slots if n not in current]
         for name in removed:
-            del self._uploaded_gen[name]
+            self._uploaded_gen.pop(name, None)
             self._mirror_node.pop(name, None)
             slot = self.encoder.release_node_slot(name)
+            self.nodes_removed += 1
+            telemetry.event("node_remove", node=name,
+                            slot=slot if slot is not None else -1)
             if slot is not None:
                 dirty.append((slot, NodeInfo()))  # empty row: valid=False
                 self.sig_table.recount_node(slot, None)
@@ -278,6 +280,30 @@ class DeviceState:
             else:
                 self._node_attrs.pop(name, None)
             images_changed |= self._track_images(name, None)
+        for name, ni in current.items():
+            if self._uploaded_gen.get(name) == ni.generation:
+                continue
+            reuses0 = self.encoder.slot_reuses
+            slot = self.encoder.node_slot(name)
+            if self.encoder.slot_reuses != reuses0:
+                # a tombstoned row was handed to this node: the free-list
+                # kept row capacity bounded instead of growing the axis
+                telemetry.event("slot_reclaim", node=name, slot=slot)
+            dirty.append((slot, ni))
+            self._uploaded_gen[name] = ni.generation
+            images_changed |= self._track_images(name, ni)
+            self._track_attrs(name, ni, slot, attr_pending)
+            if ni.node is not self._mirror_node.get(name):
+                # labels/taints can only change with the Node OBJECT; rows
+                # dirtied by commits alone skip the retention re-diff (the
+                # same identity gate the static-row cache rides)
+                self.encoder.retain_node_values(name, ni.node)
+            self.sig_table.recount_node(slot, ni)
+        if removed and dirty:
+            # a slot tombstoned AND re-assigned within this sync appears
+            # twice in the worklist; the scatter must see only the LAST
+            # write per slot (duplicate indices in .at[].set are undefined)
+            dirty = list({slot: (slot, ni) for slot, ni in dirty}.values())
         # device-attribute table upload happens even when every row upload
         # below gets content-elided (attrs live outside the row mirror)
         self._upload_attrs(attr_pending)
@@ -340,8 +366,6 @@ class DeviceState:
         else:
             image_sizes = nt.image_sizes
             image_num_nodes = nt.image_num_nodes
-        from . import telemetry
-
         with telemetry.dispatch("apply_rows", bucket=str(b)):
             self.nt = _apply_rows_jit(nt, jnp.asarray(slots), updates,
                                       image_sizes, image_num_nodes)
@@ -428,7 +452,7 @@ class DeviceState:
                 left += 1
                 pending.add(name)
         if check_removals:
-            removed = [n for n in self._uploaded_gen
+            removed = [n for n in self.encoder.node_slots
                        if n not in snapshot.node_info_map]
             left += len(removed)
             pending.update(removed)
@@ -517,6 +541,10 @@ class DeviceState:
             c = self._image_counts.get(img, 0) - 1
             if c <= 0:
                 self._image_counts.pop(img, None)
+                self._image_sizes.pop(img, None)
+                # no node reports it anymore: free the vocab id so image
+                # churn cannot grow the image axis monotonically
+                self.encoder.release_image(img)
             else:
                 self._image_counts[img] = c
         if new:

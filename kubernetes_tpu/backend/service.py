@@ -1158,6 +1158,12 @@ class WireScheduler(Scheduler):
         self._batch_id_prefix = _new_epoch()
         self._batch_ids = itertools.count(1)
         self._sent_gens: Dict[str, int] = {}
+        # names ever pushed to the CURRENT device base: the removal list is
+        # computed from this set, not _sent_gens — _invalidate_node pops a
+        # node's sent gen to force a re-send, and a node deleted in that
+        # window would otherwise never be named in `removed` (a ghost row
+        # on the service swept only by a full resync)
+        self._pushed_nodes: set = set()
         self._sent_ns: Dict[str, dict] = {}
         self._batchable_cache: Dict[str, bool] = {}
         self.settle_abandoned = False
@@ -1250,7 +1256,7 @@ class WireScheduler(Scheduler):
         device mirror would silently diverge from host truth."""
         self.cache.update_snapshot(self.snapshot)
         current = self.snapshot.node_info_map
-        removed = [n for n in self._sent_gens if n not in current]
+        removed = [n for n in self._pushed_nodes if n not in current]
         entries, pending_gens = self._build_entries()
         namespaces = {}
         for ns, obj in self.store.namespaces.items():
@@ -1284,8 +1290,10 @@ class WireScheduler(Scheduler):
         self._device_epoch = out.get("epoch", self._device_epoch)
         self._session_gen = out.get("sessionGen", self._session_gen)
         self._sent_gens.update(pending_gens)
+        self._pushed_nodes.update(pending_gens)
         for n in removed:
             self._sent_gens.pop(n, None)
+            self._pushed_nodes.discard(n)
         for ns, labels in namespaces.items():
             self._sent_ns[ns] = labels
 
@@ -1295,6 +1303,7 @@ class WireScheduler(Scheduler):
         informer relist of the crash-only contract, pointed at the device)."""
         self.resyncs += 1
         self._sent_gens.clear()
+        self._pushed_nodes.clear()
         self._sent_ns.clear()
         self._device_epoch = new_epoch
         # a new epoch = a new service INSTANCE: no session of ours survived
@@ -1316,6 +1325,7 @@ class WireScheduler(Scheduler):
         self._device_epoch = out.get("epoch", new_epoch)
         self._session_gen = out.get("sessionGen", self._session_gen)
         self._sent_gens.update(pending_gens)
+        self._pushed_nodes.update(pending_gens)
         self._sent_ns.update(namespaces)
 
     # ------------------------------------------------------------ HA session
@@ -1336,6 +1346,7 @@ class WireScheduler(Scheduler):
         self._session_gen = None
         self._device_epoch = None
         self._sent_gens.clear()
+        self._pushed_nodes.clear()
         self._sent_ns.clear()
 
     def _periodic_housekeeping(self) -> None:

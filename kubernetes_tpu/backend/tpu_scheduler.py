@@ -262,6 +262,10 @@ class _Inflight:
     mode_info: tuple = ()  # (topo_mode, vd_bucket, host_key): carry-shape id
     batch_id: str = ""  # flight-recorder identity (in-process: "b<counter>")
     bucket: int = 0  # padded pod capacity the program ran at
+    # encoder.reclaim_gen at dispatch: a winner slot released after this
+    # (node removed / tombstone reused) gets a typed rejection at commit
+    # instead of a ghost placement (None = guard by cache existence only)
+    reclaim_gen: Optional[int] = None
 
 
 def _default_full_batch() -> bool:
@@ -357,6 +361,10 @@ class TPUScheduler(Scheduler):
         self.comparer_checks = 0
         self.comparer_mismatches = 0
         self.device: Optional[DeviceState] = None
+        # high-water mark of encoder.slot_reuses already exported to the
+        # scheduler_device_slot_reuse_total counter (device rebuilds reset
+        # the encoder counter; the metric stays cumulative)
+        self._slot_reuses_seen = 0
         self._batchable_cache: Dict[str, bool] = {}
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
@@ -422,6 +430,19 @@ class TPUScheduler(Scheduler):
             self._relay_degraded_since = None
 
     # ------------------------------------------------------------- device mgmt
+
+    def _sync_slot_reuse_metric(self) -> None:
+        """Export the encoder's slot-reuse count delta into the cumulative
+        scheduler_device_slot_reuse_total counter."""
+        if self.device is None:
+            return
+        reuses = self.device.encoder.slot_reuses
+        if reuses < self._slot_reuses_seen:  # fresh device: counter reset
+            self._slot_reuses_seen = 0
+        if reuses > self._slot_reuses_seen:
+            self.smetrics.device_slot_reuse.inc(
+                value=reuses - self._slot_reuses_seen)
+            self._slot_reuses_seen = reuses
 
     def _ensure_device(self) -> None:
         n = max(self.cache.node_count(), 1)
@@ -731,6 +752,7 @@ class TPUScheduler(Scheduler):
                 try:
                     with tracing.span("device.sync"):
                         self.device.sync(self.snapshot)
+                    self._sync_slot_reuse_metric()
                     t_sync = self.now_fn()
                     pods = [qp.pod for qp in batched]
                     bucket = self.sizer.bucket_for(len(pods))
@@ -836,7 +858,8 @@ class TPUScheduler(Scheduler):
             pass
         self._inflight.append(_Inflight(batched, result, pod_cycle, t_pop,
                                         host_pb, pb, mode_info,
-                                        batch_id, bucket))
+                                        batch_id, bucket,
+                                        self.device.encoder.reclaim_gen))
         telemetry.event("dispatch", batchId=batch_id, bucket=bucket,
                         pods=len(batched), topo=topo_mode,
                         packed=result.packed is not None,
@@ -953,7 +976,9 @@ class TPUScheduler(Scheduler):
             with tracing.span("host.commit", batch=len(fl.qps)):
                 t_host0 = self.now_fn()
                 self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0,
-                                   node_idx, pb=fl.pb, ff=ff)
+                                   node_idx, pb=fl.pb, ff=ff,
+                                   reclaim_gen=fl.reclaim_gen,
+                                   batch_id=fl.batch_id)
                 self.smetrics.device_batch_duration.observe(
                     self.now_fn() - t_host0, "commit_host")
             # reconcile: the commits above advanced node generations; the
@@ -1095,13 +1120,42 @@ class TPUScheduler(Scheduler):
     def _commit_batch(self, qps: List[QueuedPodInfo], result: BatchResult,
                       pod_cycle: int, t0: float,
                       node_idx: Optional[np.ndarray] = None,
-                      pb=None, ff: Optional[np.ndarray] = None) -> None:
+                      pb=None, ff: Optional[np.ndarray] = None,
+                      reclaim_gen: Optional[int] = None,
+                      batch_id: str = "") -> None:
         if node_idx is None:
             node_idx = np.asarray(result.node_idx)
         slot_names = self.device.slot_to_name()
         # ff (first_fail) normally arrives unpacked from the packed result
         # block — already on host, zero extra syncs; the lazy reads below
         # only fire for packless (sharded-core) results
+
+        # elastic-cluster commit guard: a winner whose slot was released
+        # since dispatch (node removed; possibly already reused by a NEW
+        # node), or whose named node left the host cache while the batch
+        # was in flight, gets a TYPED rejection + backoffQ requeue — never
+        # a ghost placement on a node the kernel did not judge. O(winners).
+        stale: Dict[int, str] = {}
+        encoder = self.device.encoder
+        to_probe: Dict[str, List[int]] = {}
+        for i in range(len(qps)):
+            idx = int(node_idx[i])
+            if idx < 0:
+                continue
+            if reclaim_gen is not None and encoder.slot_stale_since(
+                    idx, reclaim_gen):
+                stale[i] = f"slot {idx} reclaimed since dispatch"
+                continue
+            name = slot_names.get(idx)
+            if name is not None:
+                to_probe.setdefault(name, []).append(i)
+        if to_probe:
+            # one cache-lock round trip for the whole batch (per-winner
+            # has_real_node calls would put N acquisitions on host.commit,
+            # the measured critical-path bottleneck)
+            for name in self.cache.missing_real_nodes(to_probe):
+                for i in to_probe[name]:
+                    stale[i] = f"node {name} removed while batch in flight"
 
         # gang all-or-nothing (PodGroup/Coscheduling): one vmapped device
         # pass over the batch's gangs decides per-gang verdicts; any gang
@@ -1117,6 +1171,20 @@ class TPUScheduler(Scheduler):
         if gang_members:
             gang_rejected = self._judge_gangs(qps, result, node_idx,
                                               gang_members)
+        if gang_members and stale:
+            # a stale member poisons its WHOLE gang: the kernel "placed" it
+            # (so _judge_gangs saw the gang complete), but the placement is
+            # unlandable — all-or-nothing means every sibling surrenders
+            for gkey, idxs in gang_members.items():
+                if idxs[0] in gang_rejected or not any(i in stale
+                                                       for i in idxs):
+                    continue
+                for i in idxs:
+                    gang_rejected[i] = gkey
+                plugin = self.framework_for_pod(
+                    qps[idxs[0]].pod).plugin("Coscheduling")
+                if plugin is not None:
+                    plugin.reject_gang(gkey, "incomplete")
 
         # device preemption screen+rank, ONE call for every failed pod in the
         # batch (the batched analog of DryRunPreemption's parallel fan-out;
@@ -1196,6 +1264,26 @@ class TPUScheduler(Scheduler):
                     pod_cycle, diagnosis)
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
+                continue
+            if i in stale:
+                # typed rejection: the device adopted this commit, but the
+                # slot's node is gone (or the slot now names a node the
+                # kernel never judged). Invalidate whatever row the slot
+                # maps to so the next sync repairs the device copy, and
+                # requeue via backoffQ — never bind.
+                from . import telemetry
+
+                node_name = slot_names.get(idx)
+                if node_name is not None:
+                    self.device._uploaded_gen.pop(node_name, None)
+                telemetry.event("slot_reclaim", batchId=batch_id,
+                                pod=pod.key(), slot=idx, reason=stale[i])
+                self.metrics["errors"] += 1
+                self._fail(fwk, qp,
+                           Status.error(f"stale placement: {stale[i]}"),
+                           pod_cycle)
+                self.smetrics.observe_attempt(
+                    "error", fwk.profile_name, self.now_fn() - t0)
                 continue
             if idx >= 0:
                 node_name = slot_names.get(idx)

@@ -289,6 +289,27 @@ class Cache:
         with self._lock:
             return self._real_nodes
 
+    def has_real_node(self, node_name: str) -> bool:
+        """True iff the cache holds a LIVE Node object under this name
+        (ghost entries kept for not-yet-deleted pods don't count) — the
+        commit-time existence probe for placements decided while the node
+        was being removed."""
+        with self._lock:
+            ni = self.nodes.get(node_name)
+            return ni is not None and ni.node is not None
+
+    def missing_real_nodes(self, names) -> set:
+        """Subset of ``names`` with no LIVE Node object — the batched form
+        of has_real_node (one lock acquisition for a whole commit's worth
+        of winner probes; the commit plane is the measured bottleneck)."""
+        with self._lock:
+            out = set()
+            for name in names:
+                ni = self.nodes.get(name)
+                if ni is None or ni.node is None:
+                    out.add(name)
+            return out
+
     def stats(self) -> Tuple[int, int, int]:
         """(nodes, pods, assumed_pods) — the scheduler_cache_size gauge feed
         and the /debug/cache counts (cache.go:96 Dump's totals)."""

@@ -192,7 +192,9 @@ def _device_occupancy(device) -> dict:
                       "capacity": caps.label_keys},
         "ports": {"used": len(enc.port_vocab) - 1,
                   "capacity": caps.port_words * 32},
-        "images": {"used": len(enc.image_vocab) - 1, "capacity": caps.images},
+        # live(), not len-1: image ids free when the last reporting node
+        # leaves (elastic churn), so the raw table length counts holes
+        "images": {"used": enc.image_vocab.live(), "capacity": caps.images},
         "prioClasses": {"used": len(enc.prio_vocab),
                         "capacity": caps.prio_classes},
         "sigs": {"used": device.sig_table.n_sigs, "capacity": caps.sigs},

@@ -16,6 +16,7 @@ from .housekeeping import (
     PodGCController,
     PVBinderController,
 )
+from .drain import DrainOrchestrator
 from .manager import ControllerManager
 from .nodelifecycle import NodeLifecycleController
 from .resourceclaim import ResourceClaimController
@@ -31,6 +32,7 @@ __all__ = [
     "ControllerManager",
     "DaemonSetController",
     "DeploymentController",
+    "DrainOrchestrator",
     "EndpointsController",
     "GarbageCollector",
     "JobController",

@@ -1,0 +1,244 @@
+"""Drain orchestration: cordon/uncordon, gang-aware drain waves, and spot
+reclamation (the elastic-cluster ladder of ISSUE 12).
+
+The reference splits this machinery across kubectl drain (cordon + evict),
+the autoscaler (node group scale-down), and cloud termination handlers
+(spot NoExecute taints drained by the taint manager).  Here one orchestrator
+drives all three against the store, so rolling upgrades and spot storms are
+scriptable from workloads and chaos suites:
+
+  * **cordon** — ``spec.unschedulable = True`` plus the
+    ``node.kubernetes.io/unschedulable:NoSchedule`` taint (the
+    TaintNodesByCondition dual-write kubectl performs), so both the
+    NodeUnschedulable filter and TaintToleration keep new pods off.
+  * **drain_wave** — cordon a window of nodes, then evict their bound pods
+    WHOLE-GANG atomically: a gang with any member on a draining node is
+    evicted in full (members on healthy nodes included), so the gang
+    rebinds as a unit instead of stranding a partial quorum.  Evicted pods
+    are deleted and (by default) recreated unbound — the workload-controller
+    recreate that drives the rebind wave — and the queue gets a targeted
+    EVICTION move.
+  * **spot_reclaim** — stamp the ``node.kubernetes.io/spot-reclaiming``
+    NoExecute taint and push the nodes through the SAME taint-manager
+    eviction the nodelifecycle controller runs for unreachable nodes
+    (controllers/nodelifecycle.evict_noexecute_pods) — a mass reclamation
+    is just a NoExecute storm riding existing machinery.
+
+Every wave records an ``evict_wave`` flight event and feeds
+``scheduler_evicted_pods_total{reason}`` when a metrics set is attached.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, Iterable, List, Sequence
+
+from ..api.types import (
+    Node,
+    Pod,
+    PodStatus,
+    TAINT_NO_EXECUTE,
+    TAINT_NO_SCHEDULE,
+    Taint,
+)
+from ..backend import telemetry
+
+TAINT_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+TAINT_SPOT_RECLAIM = "node.kubernetes.io/spot-reclaiming"
+
+
+def _with_taints(node: Node, taints: tuple) -> Node:
+    new = node.clone() if hasattr(node, "clone") else dataclasses.replace(node)
+    new.meta = dataclasses.replace(node.meta)
+    new.spec = dataclasses.replace(node.spec, taints=taints)
+    return new
+
+
+class DrainOrchestrator:
+    """Store-driven drain/reclaim ladder. ``queue`` (a SchedulingQueue) is
+    optional — when present, each wave fires one targeted EVICTION move so
+    parked pods re-check against the freed capacity immediately instead of
+    waiting for the per-delete POD_DELETE waves alone."""
+
+    def __init__(self, store, metrics=None, queue=None,
+                 now_fn=time.monotonic, recreate: bool = True):
+        self.store = store
+        self.metrics = metrics
+        self.queue = queue
+        self.now_fn = now_fn
+        self.recreate = recreate
+        self.waves = 0
+        self.evicted = 0
+
+    # ------------------------------------------------------------- cordon
+
+    def cordon(self, node_name: str) -> bool:
+        node = self.store.nodes.get(node_name)
+        if node is None or node.spec.unschedulable:
+            return False
+        taints = node.spec.taints
+        if not any(t.key == TAINT_UNSCHEDULABLE for t in taints):
+            taints = taints + (Taint(key=TAINT_UNSCHEDULABLE,
+                                     effect=TAINT_NO_SCHEDULE),)
+        new = _with_taints(node, taints)
+        new.spec = dataclasses.replace(new.spec, unschedulable=True)
+        self.store.update_node(new)
+        return True
+
+    def uncordon(self, node_name: str) -> bool:
+        node = self.store.nodes.get(node_name)
+        if node is None or not node.spec.unschedulable:
+            return False
+        taints = tuple(t for t in node.spec.taints
+                       if t.key != TAINT_UNSCHEDULABLE)
+        new = _with_taints(node, taints)
+        new.spec = dataclasses.replace(new.spec, unschedulable=False)
+        self.store.update_node(new)
+        return True
+
+    # ------------------------------------------------------------- eviction
+
+    def _gang_closure(self, pods: List[Pod]) -> List[Pod]:
+        """Expand an eviction set to whole gangs: any gang touched by the
+        set contributes EVERY bound member (all-or-nothing in reverse)."""
+        from ..framework.plugins.coscheduling import pod_group_key
+
+        groups = {pod_group_key(p) for p in pods} - {None}
+        if not groups:
+            return pods
+        keys = {p.key() for p in pods}
+        out = list(pods)
+        for p in self.store.pods.values():
+            if (p.spec.node_name and p.key() not in keys
+                    and pod_group_key(p) in groups):
+                out.append(p)
+                keys.add(p.key())
+        return out
+
+    def _evict(self, pods: Sequence[Pod], reason: str) -> List[str]:
+        """Delete (and by default recreate unbound) the eviction set. The
+        deletes fire the store's Pod DELETE events — the scheduler's cache
+        removal, Coscheduling bound-count decrement, quota release, and
+        POD_DELETE queue moves all ride them."""
+        evicted: List[str] = []
+        recreations: List[Pod] = []
+        for pod in pods:
+            key = pod.key()
+            if self.store.get_pod(key) is None:
+                continue
+            self.store.delete_pod(key)
+            evicted.append(key)
+            if self.recreate:
+                clone = pod.clone()
+                clone.spec.node_name = ""
+                clone.status = PodStatus()
+                recreations.append(clone)
+        # recreate AFTER every delete landed: a gang must be fully torn
+        # down (PodGroup status reset, bound counts zeroed) before any
+        # member re-enters the queue, or quorum is judged against a
+        # half-deleted gang
+        for clone in recreations:
+            self.store.create_pod(clone)
+        if evicted:
+            self.evicted += len(evicted)
+            if self.metrics is not None:
+                self.metrics.evicted_pods.inc(reason, value=len(evicted))
+        return evicted
+
+    def _wave_done(self, reason: str, nodes: int, evicted: List[str],
+                   gangs: int) -> Dict[str, int]:
+        self.waves += 1
+        telemetry.event("evict_wave", reason=reason, nodes=nodes,
+                        pods=len(evicted), gangs=gangs)
+        if self.queue is not None and evicted:
+            from ..queue import events as qevents
+
+            self.queue.move_all_to_active_or_backoff_queue(qevents.EVICTION)
+        return {"nodes": nodes, "evicted": len(evicted), "gangs": gangs}
+
+    # ------------------------------------------------------------- waves
+
+    def drain_wave(self, node_names: Iterable[str],
+                   gang_aware: bool = True) -> Dict[str, int]:
+        """One rolling-upgrade wave: cordon every node in the window, then
+        evict its bound pods (whole gangs when ``gang_aware``)."""
+        from ..framework.plugins.coscheduling import pod_group_key
+
+        names = [n for n in node_names if n in self.store.nodes]
+        for name in names:
+            self.cordon(name)
+        victims = [p for p in list(self.store.pods.values())
+                   if p.spec.node_name in names]
+        if gang_aware:
+            victims = self._gang_closure(victims)
+        gangs = len({pod_group_key(p) for p in victims} - {None})
+        evicted = self._evict(victims, "drain")
+        return self._wave_done("drain", len(names), evicted, gangs)
+
+    def spot_reclaim(self, node_names: Iterable[str],
+                     delete_nodes: bool = False,
+                     gang_aware: bool = True) -> Dict[str, int]:
+        """Mass spot reclamation: stamp the NoExecute reclaim taint and run
+        the shared taint-manager eviction (the nodelifecycle path), so the
+        storm exercises exactly the machinery unreachable-node eviction
+        uses. A pod whose tolerations ride out this one-shot pass (finite
+        windows not yet elapsed, or unbounded) is honored — the periodic
+        taint-manager sweep owns timed evictions. ``delete_nodes``
+        additionally removes the reclaimed nodes (the cloud actually
+        taking the capacity away) — the node's REMAINING bound pods are
+        then evicted too, tolerations notwithstanding: a toleration delays
+        eviction from a tainted node, it cannot keep a pod on hardware
+        that no longer exists (otherwise they would strand bound to a
+        deleted node, outside every rebind wave)."""
+        from ..framework.plugins.coscheduling import pod_group_key
+
+        from .nodelifecycle import evict_noexecute_pods
+
+        names = [n for n in node_names if n in self.store.nodes]
+        now = self.now_fn()
+        taken: List[Pod] = []
+        for name in names:
+            node = self.store.nodes.get(name)
+            taints = node.spec.taints
+            if not any(t.key == TAINT_SPOT_RECLAIM for t in taints):
+                node = _with_taints(node, taints + (Taint(
+                    key=TAINT_SPOT_RECLAIM, effect=TAINT_NO_EXECUTE),))
+                self.store.update_node(node)
+            taken.extend(evict_noexecute_pods(
+                self.store, node, now, since=now,
+                metrics=self.metrics, reason="spot"))
+        if delete_nodes:
+            # the capacity is GOING AWAY: survivors of the toleration pass
+            # must not stay bound to a node about to vanish
+            survivors = [p for p in list(self.store.pods.values())
+                         if p.spec.node_name in names]
+            for pod in survivors:
+                self.store.delete_pod(pod.meta.key())
+                taken.append(pod)
+            if survivors and self.metrics is not None:
+                self.metrics.evicted_pods.inc("spot", value=len(survivors))
+        evicted = [p.key() for p in taken]
+        self.evicted += len(evicted)
+        gangs = 0
+        if gang_aware and taken:
+            # whole-gang closure over what the taint manager took: siblings
+            # on healthy nodes (or members that tolerated the taint) are
+            # evicted too so the gang rebinds as a unit
+            groups = {pod_group_key(p) for p in taken} - {None}
+            gangs = len(groups)
+            survivors = [p for p in list(self.store.pods.values())
+                         if p.spec.node_name and pod_group_key(p) in groups]
+            evicted.extend(self._evict(survivors, "spot"))
+        if self.recreate:
+            # the taint-manager deletes bypass _evict: recreate their
+            # unbound clones so the reclamation drives a rebind wave
+            for pod in taken:
+                clone = pod.clone()
+                clone.spec.node_name = ""
+                clone.status = PodStatus()
+                self.store.create_pod(clone)
+        if delete_nodes:
+            for name in names:
+                self.store.delete_node(name)
+        return self._wave_done("spot", len(names), evicted, gangs)

@@ -83,7 +83,7 @@ def new_controller_initializers() -> Dict[str, Initializer]:
         "daemonset": lambda m: DaemonSetController(m.store, m.factory),
         "job": lambda m: JobController(m.store, m.factory, now_fn=m.now_fn),
         "nodelifecycle": lambda m: NodeLifecycleController(
-            m.store, m.factory, now_fn=m.now_fn
+            m.store, m.factory, now_fn=m.now_fn, metrics=m.metrics
         ),
         "podgc": lambda m: PodGCController(m.store, m.factory),
         "garbagecollector": lambda m: GarbageCollector(m.store, m.factory),
@@ -136,10 +136,14 @@ def new_controller_initializers() -> Dict[str, Initializer]:
 class ControllerManager:
     def __init__(self, store, factory: Optional[SharedInformerFactory] = None,
                  controllers: Optional[List[str]] = None, now_fn=time.monotonic,
-                 leader_election: bool = False, identity: str = "kcm-0"):
+                 leader_election: bool = False, identity: str = "kcm-0",
+                 metrics=None):
         self.store = store
         self.factory = factory or SharedInformerFactory(store)
         self.now_fn = now_fn
+        # optional SchedulerMetrics set: controllers that feed scheduler_*
+        # families (the taint manager's evicted-pods counter) bind it here
+        self.metrics = metrics
         inits = new_controller_initializers()
         names = controllers if controllers is not None else list(inits)
         self.controllers: Dict[str, Controller] = {n: inits[n](self) for n in names}

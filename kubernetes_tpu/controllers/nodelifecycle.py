@@ -34,16 +34,56 @@ _PRESSURE_TAINTS = (
 )
 
 
+def evict_noexecute_pods(store, node: Node, now: float,
+                         since: Optional[float] = None,
+                         metrics=None, reason: str = "taint") -> List:
+    """The NoExecute taint manager (taint_manager.go), shared by node-health
+    eviction and spot reclamation (controllers/drain.py): a pod on ``node``
+    is evicted unless it tolerates EVERY NoExecute taint; a pod whose
+    matching tolerations all carry finite tolerationSeconds goes once the
+    minimum window elapses past ``since``; an unbounded matching toleration
+    keeps the pod forever. Returns the evicted Pod objects (callers that
+    drive rebind waves recreate them unbound; health eviction leaves the
+    rest to PodGC)."""
+    noexec = [t for t in node.spec.taints if t.effect == TAINT_NO_EXECUTE]
+    if not noexec:
+        return []
+    evicted = []
+    for pod in list(store.pods.values()):
+        if pod.spec.node_name != node.meta.name:
+            continue
+        windows: List[int] = []
+        tolerated = True
+        for taint in noexec:
+            matching = [tol for tol in pod.spec.tolerations
+                        if tol.tolerates(taint)]
+            if not matching:
+                tolerated = False
+                break
+            finite = [tol.toleration_seconds for tol in matching]
+            if None not in finite:
+                windows.append(min(finite))
+        if tolerated and (not windows or since is None
+                          or now - since <= min(windows)):
+            continue
+        store.delete_pod(pod.meta.key())
+        evicted.append(pod)
+    if evicted and metrics is not None:
+        metrics.evicted_pods.inc(reason, value=len(evicted))
+    return evicted
+
+
 class NodeLifecycleController(Controller):
     name = "nodelifecycle"
     watch_kinds = ("Node", "Lease")
 
     def __init__(self, store, factory, grace_period: float = DEFAULT_GRACE_PERIOD,
-                 now_fn=time.monotonic, evict: bool = True):
+                 now_fn=time.monotonic, evict: bool = True, metrics=None):
         super().__init__(store, factory)
         self.grace_period = grace_period
         self.now_fn = now_fn
         self.evict = evict
+        self.metrics = metrics
         self._not_ready_since: dict = {}  # node -> when it went unhealthy
 
     def keys_for(self, kind: str, obj, event: str) -> List[str]:
@@ -123,26 +163,18 @@ class NodeLifecycleController(Controller):
         self.store.update_node(new)
 
     def _evict_pods(self, node_name: str) -> None:
-        """NoExecute taint manager (taint_manager.go): pods with no matching
-        toleration go immediately; pods whose matching tolerations all carry
-        a finite tolerationSeconds go after the minimum window (the
-        DefaultTolerationSeconds admission default is 300s); an unbounded
-        matching toleration keeps the pod forever."""
-        since = self._not_ready_since.get(node_name)
-        now = self.now_fn()
-        for pod in list(self.store.pods.values()):
-            if pod.spec.node_name != node_name:
-                continue
-            matching = [
-                tol for tol in pod.spec.tolerations
-                if tol.key in (TAINT_UNREACHABLE, TAINT_NOT_READY, "")
-                and tol.effect in ("", TAINT_NO_EXECUTE)
-            ]
-            if not matching:
-                self.store.delete_pod(pod.meta.key())
-                continue
-            windows = [t.toleration_seconds for t in matching]
-            if None in windows:
-                continue  # unbounded toleration
-            if since is not None and now - since > min(windows):
-                self.store.delete_pod(pod.meta.key())
+        """Health-driven NoExecute eviction through the shared taint
+        manager (evict_noexecute_pods — the same path drain.py's spot
+        storms ride). A node judged unhealthy before _set_health stamped
+        the unreachable taint is evaluated AS IF tainted (the reference
+        evicts on the condition, not the taint write racing it)."""
+        node = self.store.nodes.get(node_name)
+        if node is None:
+            return
+        if not any(t.effect == TAINT_NO_EXECUTE for t in node.spec.taints):
+            node = dataclasses.replace(node, spec=dataclasses.replace(
+                node.spec, taints=node.spec.taints + (Taint(
+                    key=TAINT_UNREACHABLE, effect=TAINT_NO_EXECUTE),)))
+        evict_noexecute_pods(self.store, node, self.now_fn(),
+                             since=self._not_ready_since.get(node_name),
+                             metrics=self.metrics, reason="taint")

@@ -244,6 +244,26 @@ class SchedulerMetrics:
             "Deficit-round-robin dequeue turns served per tenant namespace.",
             ["namespace"],
         ))
+        # elastic clusters (node churn / drain / spot reclamation): informer
+        # node-event volume by action, evictions by machinery (drain wave,
+        # spot NoExecute storm, taint manager), and device row-slot reuse
+        # (the free-list keeping DeviceState capacity bounded under churn)
+        self.node_events = r.register(Counter(
+            "scheduler_node_events_total",
+            "Node informer events observed by the scheduler, by action.",
+            ["action"],
+        ))
+        self.evicted_pods = r.register(Counter(
+            "scheduler_evicted_pods_total",
+            "Pods evicted by the elasticity machinery, by reason "
+            "(drain|spot|taint).",
+            ["reason"],
+        ))
+        self.device_slot_reuse = r.register(Counter(
+            "scheduler_device_slot_reuse_total",
+            "Tombstoned DeviceState row slots handed to new nodes "
+            "(bounded-capacity churn instead of monotonic growth).",
+        ))
 
         # unschedulable_pods bookkeeping: gauge value = number of pods
         # CURRENTLY unschedulable attributed to each (plugin, profile); a

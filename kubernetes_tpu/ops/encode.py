@@ -118,6 +118,23 @@ class ClusterEncoder:
         self.node_slots: Dict[str, int] = {}          # node name -> slot
         self.slot_names: Dict[int, str] = {}          # live reverse map
         self._free_slots: List[int] = []
+        # slot-reclamation bookkeeping (elastic clusters): a released slot is
+        # a TOMBSTONE until reused — ``reclaim_gen`` is a monotonic release
+        # counter and ``slot_release_gen[slot]`` the gen at the slot's last
+        # release, so an in-flight batch (which captured reclaim_gen at
+        # dispatch) can prove at commit time that a winner slot still names
+        # the node the kernel judged (slot_stale_since). ``slot_reuses``
+        # counts free-list pops (the scheduler_device_slot_reuse_total feed).
+        self.reclaim_gen = 0
+        self.slot_release_gen: Dict[int, int] = {}
+        self.slot_reuses = 0
+        # node-retained vocab refcounts: (key, value) string pairs each LIVE
+        # node's labels/taints pin in the per-key value vocabs. Release at
+        # refcount zero frees the id for reuse (bounded vocab consumption
+        # under node churn); any free invalidates the pod-template cache,
+        # whose compiled expr keys embed value ids.
+        self._value_refs: Dict[Tuple[str, str], int] = {}
+        self._node_value_pairs: Dict[str, frozenset] = {}
         self._pod_templates: Dict[Tuple, _PodTemplate] = {}
         self.last_has_ports = False                   # set by encode_pods
         self._template_cap = 4096                     # runaway-shape guard
@@ -192,6 +209,7 @@ class ClusterEncoder:
     def node_slot(self, name: str) -> int:
         slot = self.node_slots.get(name)
         if slot is None:
+            reused = bool(self._free_slots)
             slot = self._free_slots.pop() if self._free_slots else len(self.node_slots)
             # slots are dense; a freed slot is reused before extending
             used = set(self.node_slots.values())
@@ -199,17 +217,90 @@ class ClusterEncoder:
                 slot = next(i for i in range(self.caps.nodes + 1) if i not in used)
             if slot >= self.caps.nodes:
                 raise CapacityError("nodes", slot + 1, self.caps.nodes)
+            if reused:
+                self.slot_reuses += 1
             self.node_slots[name] = slot
             self.slot_names[slot] = name
         return slot
 
     def release_node_slot(self, name: str) -> Optional[int]:
+        """Tombstone a removed node's slot: the row index goes to the
+        free-list for reuse, the release generation is stamped so in-flight
+        commits naming it get a typed rejection, and the node's vocab
+        retentions are dropped (value ids free at refcount zero)."""
         slot = self.node_slots.pop(name, None)
         self._static_rows.pop(name, None)
+        self.release_node_values(name)
         if slot is not None:
             self.slot_names.pop(slot, None)
             self._free_slots.append(slot)
+            self.reclaim_gen += 1
+            self.slot_release_gen[slot] = self.reclaim_gen
         return slot
+
+    def slot_stale_since(self, slot: int, gen: int) -> bool:
+        """True iff ``slot`` was released (tombstoned/reused) after an
+        observer captured ``reclaim_gen == gen`` — the commit-time guard for
+        placements decided before the release."""
+        return self.slot_release_gen.get(slot, 0) > gen
+
+    # ------------------------------------------------- node vocab retention
+
+    @staticmethod
+    def _node_pairs(node) -> frozenset:
+        pairs = {(k, v) for k, v in node.meta.labels.items()}
+        pairs.update((t.key, t.value) for t in node.spec.taints)
+        return frozenset(pairs)
+
+    def retain_node_values(self, name: str, node) -> None:
+        """Refcount the (key, value) label/taint pairs ``node`` pins in the
+        value vocabs (called per dirty row from DeviceState.sync — the same
+        walk that encodes the row, so every retained pair is interned)."""
+        new = self._node_pairs(node) if node is not None else frozenset()
+        old = self._node_value_pairs.get(name, frozenset())
+        if new == old:
+            return
+        for pair in new - old:
+            self._value_refs[pair] = self._value_refs.get(pair, 0) + 1
+        freed = False
+        for pair in old - new:
+            freed |= self._drop_value_ref(pair)
+        if new:
+            self._node_value_pairs[name] = new
+        else:
+            self._node_value_pairs.pop(name, None)
+        if freed:
+            # cached templates embed value ids; a freed id may be recycled
+            # for a different string, so every compiled key set is suspect
+            self._pod_templates.clear()
+
+    def release_node_values(self, name: str) -> None:
+        old = self._node_value_pairs.pop(name, None)
+        if not old:
+            return
+        freed = False
+        for pair in old:
+            freed |= self._drop_value_ref(pair)
+        if freed:
+            self._pod_templates.clear()
+
+    def _drop_value_ref(self, pair: Tuple[str, str]) -> bool:
+        """Decrement one (key, value) retention; free the vocab id at zero.
+        Returns True when an id was actually freed."""
+        left = self._value_refs.get(pair, 0) - 1
+        if left > 0:
+            self._value_refs[pair] = left
+            return False
+        self._value_refs.pop(pair, None)
+        ks = self.key_vocab.lookup(pair[0])
+        vv = self.value_vocabs.get(ks)
+        return vv is not None and vv.release(pair[1]) is not None
+
+    def release_image(self, name: str) -> None:
+        """Free an image vocab id once no node reports the image (driven by
+        DeviceState._track_images' global refcount). Image ids are looked up
+        per encode (never cached in templates), so no cache invalidation."""
+        self.image_vocab.release(name)
 
     # ------------------------------------------------------------- resources
 

@@ -32,6 +32,20 @@ AXIS = "nodes"
 _REPLICATED_NT_FIELDS = ("image_sizes", "image_num_nodes", "class_prio")
 
 
+def resolve_shard_map():
+    """The shard_map entry point across the JAX rename: new JAX exposes
+    ``jax.shard_map`` (with ``check_vma=``); older releases only ship
+    ``jax.experimental.shard_map.shard_map`` (with ``check_rep=``).
+    Returns ``(fn, check_kwarg_name)`` so callers pass the right spelling
+    of the replication-check knob."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn, "check_vma"
+    from jax.experimental.shard_map import shard_map as fn  # noqa: F811
+
+    return fn, "check_rep"
+
+
 def make_node_mesh(devices=None) -> Mesh:
     import numpy as np
 
@@ -127,10 +141,11 @@ def make_sharded_schedule_fn(mesh: Mesh, weights: Optional[Dict[str, float]] = N
                              num_shards=mesh.size, spec_decode=spec_decode,
                              topo_mode=topo_mode, host_key=host_key,
                              vd_override=vd_override)
-    sharded = jax.shard_map(
+    shard_map_fn, check_kw = resolve_shard_map()
+    sharded = shard_map_fn(
         body, mesh=mesh,
         in_specs=(pb_spec, et_spec, nt_spec, tc_spec, tb_spec, P()),
         out_specs=out_spec,
-        check_vma=False,
+        **{check_kw: False},
     )
     return jax.jit(sharded)

@@ -341,6 +341,7 @@ class Runner:
                                                    **clock_kw)
         self.data_items: List[DataItem] = []
         self._pod_counter = 0
+        self._node_counter = 0
         # resource.k8s.io side-car loop: the resourceclaim controller that
         # materializes template claims, created lazily on the first DRA
         # workload op and pumped by barrier/measure (the reference harness
@@ -370,9 +371,20 @@ class Runner:
 
         csi_driver = params.pop("csi_driver", None)
         csi_count = int(params.pop("csi_count", 39))
-        for i in range(len(self.store.nodes), len(self.store.nodes) + count):
+        # monotonic ordinal, never reused: under elastic churn (nodes
+        # deleted mid-run) naming by len(store.nodes) would collide with
+        # live names — replacements must be NEW identities (fresh hostname
+        # vocab entries, the shrink-then-grow stress the elastic workload
+        # exists to exercise)
+        created = 0
+        while created < count:
+            i = self._node_counter
+            self._node_counter += 1
+            if f"node-{i}" in self.store.nodes:
+                continue  # pre-churn ordinal still live
             node = _node_wrapper(i, params).obj()
             self.store.create_node(node)
+            created += 1
             if csi_driver:
                 # nodeAllocatableStrategy.csiNodeAllocatable
                 # (performance-config.yaml:142-148): per-node CSINode with
@@ -805,6 +817,193 @@ class Runner:
             data=invariants, unit="", labels={"Name": "SoakInvariants"}))
         return invariants
 
+    # ---- elastic-cluster phase ----
+
+    def elastic_phase(self, rounds: int = 6, mix=(), storm_frac: float = 0.3,
+                      drain_nodes: int = 2, spot_frac: float = 0.15,
+                      cycles_per_round: int = 80, tick_s: float = 0.0,
+                      settle_rounds: int = 2,
+                      label: str = "SchedulingElastic",
+                      collector_interval: float = 1.0) -> Dict[str, float]:
+        """elasticPhase op — cluster elasticity under load (ISSUE 12): per
+        round, the ``mix`` entries land their arrivals and the scheduler
+        drives; then one chaos sub-phase rotates through (a) an autoscaler
+        add/remove STORM (``storm_frac`` of the cluster drained, deleted,
+        and replaced with NEW node names — the DeviceState shrink direction:
+        tombstoned slots reused, vocab retention released), (b) a rolling
+        DRAIN wave (``drain_nodes`` cordoned + evicted whole-gang, uncordoned
+        next round), and (c) a mass SPOT reclamation (``spot_frac`` of nodes
+        NoExecute-tainted through the taint-manager path, deleted, replaced).
+        Evicted pods are recreated unbound, so the rebind waves are part of
+        the measured load.
+
+        Evidence out: SchedulingThroughput + attempt percentiles, and one
+        ``ElasticInvariants`` DataItem — LostPods (created keys missing from
+        the store at settle), Oversubscribed (per-node cpu/pods overcommit
+        samples), RowCapacity (final DeviceState node axis — boundedness
+        under churn), SlotReuses, NodesRemoved/NodesAdded, EvictedPods,
+        UploadBytesSteady (last sync's upload bytes after the post-storm
+        settle — 0 = delta elision recovered), HbmPeakBytes. Assertions
+        live in the tests; the harness measures."""
+        from ..controllers.drain import DrainOrchestrator
+
+        sched = self.scheduler
+        drainer = DrainOrchestrator(self.store, metrics=sched.smetrics,
+                                    queue=sched.queue, now_fn=self.now_fn)
+        created: set = set()
+        nodes_added = 0
+        nodes_removed = 0
+        oversub = 0
+        cordoned: List[str] = []
+        reuse0 = sched.smetrics.device_slot_reuse.labels()
+        evict0 = sum(sched.smetrics.evicted_pods.labels(r)
+                     for r in ("drain", "spot", "taint"))
+
+        def drive_cycle() -> bool:
+            if self.backend in ("tpu", "wire", "grpc"):
+                return sched.schedule_batch_cycle() > 0
+            return sched.schedule_one()
+
+        def check_oversubscribed() -> int:
+            """Per-node cpu overcommit vs allocatable over BOUND pods (the
+            zero-double-bind invariant, sampled from store truth)."""
+            from ..api import resource as resource_api
+
+            used: Dict[str, int] = {}
+            npods: Dict[str, int] = {}
+            for p in self.store.pods.values():
+                n = p.spec.node_name
+                if not n:
+                    continue
+                used[n] = used.get(n, 0) + p.resource_request().get(
+                    resource_api.CPU, 0)
+                npods[n] = npods.get(n, 0) + 1
+            bad = 0
+            for n, cpu in used.items():
+                node = self.store.nodes.get(n)
+                if node is None:
+                    continue  # orphans of a raw node delete are PodGC's job
+                alloc = node.status.allocatable
+                cap = resource_api.canonical(
+                    resource_api.CPU, alloc.get(resource_api.CPU, "0"))
+                pods_cap = int(alloc.get(resource_api.PODS, 0) or 0)
+                if cpu > cap or (pods_cap and npods.get(n, 0) > pods_cap):
+                    bad += 1
+            return bad
+
+        def add_nodes(count: int, params: dict) -> None:
+            nonlocal nodes_added
+            self.create_nodes(count, **{k: v for k, v in params.items()
+                                        if k != "count"})
+            nodes_added += count
+
+        node_params = getattr(self, "_elastic_node_params", {"zones": 10})
+        tick = getattr(self.now_fn, "advance", None) if tick_s else None
+        col = ThroughputCollector(
+            lambda: sched.metrics["scheduled"], interval=collector_interval)
+        col.start(time.monotonic())
+
+        def drive_round() -> None:
+            for _c in range(cycles_per_round):
+                progressed = drive_cycle()
+                if tick is not None:
+                    tick(tick_s)
+                if not progressed:
+                    sched.queue.flush_backoff_completed()
+                    if len(sched.queue) == 0:
+                        break
+                col.maybe_sample(time.monotonic())
+
+        for r in range(rounds):
+            for mi, m in enumerate(mix):
+                if r % int(m.get("every", 1)):
+                    continue
+                params = {k: v for k, v in m.items()
+                          if k not in ("count", "every", "prefix")}
+                prefix = f"{m.get('prefix', 'el')}-m{mi}r{r}"
+                for j in range(int(m["count"])):
+                    p = self._make_pod(
+                        prefix, dict(params, _gang_ordinal=j)
+                        if params.get("gang_size") else params)
+                    self.store.create_pod(p)
+                    created.add(p.key())
+                    self._pod_counter += 1
+            self._pump_dra()
+            drive_round()
+            # rotate the chaos sub-phases; every removal drains first so
+            # bound pods rebind instead of orphaning (zero-lost accounting)
+            live = sorted(self.store.nodes)
+            phase = r % 3
+            if phase == 0 and storm_frac > 0:
+                storm = live[: max(1, int(len(live) * storm_frac))]
+                drainer.drain_wave(storm)
+                for name in storm:
+                    self.store.delete_node(name)
+                nodes_removed += len(storm)
+                add_nodes(len(storm), node_params)
+            elif phase == 1 and drain_nodes > 0:
+                for name in cordoned:
+                    drainer.uncordon(name)
+                cordoned = [n for n in live[-drain_nodes:]]
+                drainer.drain_wave(cordoned)
+            elif phase == 2 and spot_frac > 0:
+                spot = live[: max(1, int(len(live) * spot_frac))]
+                drainer.spot_reclaim(spot, delete_nodes=True)
+                nodes_removed += len(spot)
+                add_nodes(len(spot), node_params)
+            drive_round()
+            oversub += check_oversubscribed()
+        # settle: lift every cordon, land stragglers, then run no-churn
+        # rounds so the delta path returns to steady state
+        for name in cordoned:
+            drainer.uncordon(name)
+        for name in sorted(self.store.nodes):
+            drainer.uncordon(name)
+        for _s in range(max(settle_rounds, 1)):
+            drive_round()
+        drain = getattr(sched, "_drain_inflight", None)
+        if drain is not None:
+            drain()
+        oversub += check_oversubscribed()
+        col.finish(time.monotonic())
+        self.data_items.append(DataItem(
+            data=col.summary(), unit="pods/s", labels={"Name": label}))
+        device = getattr(sched, "device", None)
+        upload_steady = None
+        if device is not None:
+            # flush the post-settle dirtiness (commit-advanced generations),
+            # then measure: at steady state the SECOND sync must upload
+            # ZERO bytes — the delta-elision recovery check
+            sched.cache.update_snapshot(sched.snapshot)
+            device.sync(sched.snapshot)
+            sched.cache.update_snapshot(sched.snapshot)
+            device.sync(sched.snapshot)
+            upload_steady = device.last_upload_bytes
+        from ..backend import telemetry as dev_telemetry
+
+        rec = dev_telemetry.get()
+        lost = sum(1 for k in created if self.store.get_pod(k) is None)
+        invariants = {
+            "LostPods": float(lost),
+            "Oversubscribed": float(oversub),
+            "RowCapacity": float(device.caps.nodes) if device is not None
+            else 0.0,
+            "SlotReuses": float(
+                sched.smetrics.device_slot_reuse.labels() - reuse0),
+            "NodesRemoved": float(nodes_removed),
+            "NodesAdded": float(nodes_added),
+            "EvictedPods": float(sum(
+                sched.smetrics.evicted_pods.labels(r)
+                for r in ("drain", "spot", "taint")) - evict0),
+            "UploadBytesSteady": float(upload_steady
+                                       if upload_steady is not None else -1),
+            "HbmPeakBytes": float(rec.hbm_peak if rec is not None else 0),
+            "PendingAtEnd": float(sum(sched.queue.pending_pods().values())),
+        }
+        self.data_items.append(DataItem(
+            data=invariants, unit="", labels={"Name": "ElasticInvariants"}))
+        return invariants
+
     # ---- config-driven entry ----
 
     def run_ops(self, ops: List[dict]) -> None:
@@ -824,6 +1023,11 @@ class Runner:
                 self.create_quota(**kwargs)
             elif kind == "soakPhase":
                 self.soak_phase(**kwargs)
+            elif kind == "elasticPhase":
+                # remember the node shape for storm replacements
+                self._elastic_node_params = dict(kwargs.pop("node_params", {})
+                                                 or {"zones": 10})
+                self.elastic_phase(**kwargs)
             elif kind == "barrier":
                 self.barrier(**kwargs)
             elif kind == "churn":

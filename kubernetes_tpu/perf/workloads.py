@@ -484,6 +484,37 @@ def scheduling_soak(nodes=1000, rounds=8, scale=24, cycles_per_round=120,
     return {"name": f"SchedulingSoak/{nodes}Nodes", "ops": ops}
 
 
+def scheduling_elastic(nodes=1000, rounds=6, pods_per_round=150,
+                       storm_frac=0.3, drain_nodes=8, spot_frac=0.15,
+                       cycles_per_round=120, tick_s=0.05, gangs=True) -> dict:
+    """SchedulingElastic — cluster elasticity under load (ISSUE 12): a
+    plain-pod base plus small gangs arrives every round while the chaos
+    ladder rotates through a 30%-of-cluster add/remove storm (drain →
+    delete → NEW node names, so DeviceState shrinks and its tombstoned
+    slots/vocab retentions are reused), a rolling cordon/drain/rebind
+    wave, and a mass spot reclamation riding the NoExecute taint-manager
+    path. Judged by the ElasticInvariants DataItem: zero lost pods, zero
+    oversubscription, bounded RowCapacity/HbmPeak under 2x-cluster churn,
+    SlotReuses > 0, and UploadBytesSteady back at 0 after the storms."""
+    base = {"req": {"cpu": "100m", "memory": "500Mi"}}
+    node_params = {"zones": 10,
+                   "capacity": {"cpu": "4", "memory": "16Gi", "pods": 32}}
+    mix = [{"count": pods_per_round, "prefix": "el", **base}]
+    if gangs:
+        mix.append({"count": 8, "gang_size": 4, "every": 2,
+                    "prefix": "elg", **base})
+    return {
+        "name": f"SchedulingElastic/{nodes}Nodes",
+        "ops": [
+            {"opcode": "createNodes", "count": nodes, **node_params},
+            {"opcode": "elasticPhase", "rounds": rounds, "mix": mix,
+             "storm_frac": storm_frac, "drain_nodes": drain_nodes,
+             "spot_frac": spot_frac, "cycles_per_round": cycles_per_round,
+             "tick_s": tick_s, "node_params": node_params},
+        ],
+    }
+
+
 TEST_CASES = {
     "SchedulingBasic": scheduling_basic,
     "SchedulingPodAntiAffinity": scheduling_pod_anti_affinity,
@@ -494,6 +525,7 @@ TEST_CASES = {
     "SchedulingInTreePVs": scheduling_intree_pvs,
     "SchedulingCSIPVs": scheduling_csi_pvs,
     "SchedulingDRA": scheduling_dra,
+    "SchedulingElastic": scheduling_elastic,
     "SchedulingGangs": scheduling_gangs,
     "SchedulingSoak": scheduling_soak,
     "MixedSchedulingBasePod": mixed_scheduling_base_pod,

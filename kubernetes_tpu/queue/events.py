@@ -29,6 +29,10 @@ POD_DELETE = ClusterEvent(POD, DELETE, "AssignedPodDelete")
 # delete (real capacity freed), but labeled so queue_incoming_pods can
 # attribute the surge to the takeover
 SCHEDULER_TAKEOVER = ClusterEvent(POD, DELETE, "SchedulerTakeover")
+# drain/spot eviction wave (controllers/drain.py): bound pods were deleted
+# en masse — capacity freed for everything parked on resource fit, labeled
+# so the rebind surge is attributable to the wave rather than organic churn
+EVICTION = ClusterEvent(POD, DELETE, "EvictionWave")
 POD_UPDATE = ClusterEvent(POD, UPDATE, "AssignedPodUpdate")
 NODE_ALLOCATABLE_CHANGE = ClusterEvent(NODE, UPDATE_NODE_ALLOCATABLE, "NodeAllocatableChange")
 NODE_LABEL_CHANGE = ClusterEvent(NODE, UPDATE_NODE_LABEL, "NodeLabelChange")

@@ -343,14 +343,20 @@ class Scheduler:
 
     def _on_node_event(self, event: str, old: Optional[Node], new: Optional[Node]) -> None:
         if event == ADDED:
+            self.smetrics.node_events.inc("add")
             self.cache.add_node(new)
+            # targeted capacity wake-up: pods parked Unschedulable on
+            # resource pressure (NodeResourcesFit registers NODE|ADD)
+            # reactivate the moment new capacity joins the cluster
             self.queue.move_all_to_active_or_backoff_queue(qevents.NODE_ADD)
         elif event == MODIFIED:
+            self.smetrics.node_events.inc("update")
             self.cache.update_node(new)
             ev = self._node_scheduling_properties_change(old, new)
             if ev is not None:
                 self.queue.move_all_to_active_or_backoff_queue(ev)
         elif event == DELETED:
+            self.smetrics.node_events.inc("delete")
             self.cache.remove_node(old.meta.name)
 
     @staticmethod

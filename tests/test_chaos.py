@@ -1398,3 +1398,301 @@ class TestDeviceFabricChaos:
         finally:
             rig.close()
             telemetry.disable()
+
+
+class TestElasticChaos:
+    """ISSUE 12 acceptance: cluster elasticity as a chaos-proven capability.
+    A 30%-of-cluster add/remove storm, a rolling gang-aware drain wave, and
+    a mass spot reclamation each overlap in-flight batches (ring depth 2);
+    a fourth scenario overlaps node churn with a fabric failover. Standing
+    invariants throughout: zero lost pods, zero double-binds, byte-identical
+    post-resync mirrors, oracle-replay-valid placements — plus the new
+    shrink-direction guarantees: a commit naming a reclaimed slot is a
+    TYPED rejection (backoffQ requeue), never a ghost placement, and
+    tombstoned slots are reused instead of growing the node axis.
+
+    Runs under KTPU_LOCKTRACE=1 (the ``locktraced`` fixture): the removal
+    sweep and slot free-list ride the same device path the lock passes
+    cover; the teardown asserts an acyclic lock graph and zero non-allowed
+    blocking events."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, locktraced):
+        yield
+
+    @pytest.fixture(autouse=True)
+    def _flight(self):
+        from kubernetes_tpu.backend import telemetry
+
+        self.tele = telemetry.enable()
+        yield
+        telemetry.disable()
+
+    def _ring_sched(self, monkeypatch, store, batch=4, **kw):
+        monkeypatch.setenv("KTPU_PIPELINE_DEPTH", "2")
+        kw.setdefault("pod_initial_backoff", 0.01)
+        kw.setdefault("pod_max_backoff", 0.05)
+        return TPUScheduler(store, batch_size=batch, **kw)
+
+    def _assert_mirror_byte_identical(self, sched):
+        """Post-resync byte-identity, free-list aware: slot AND vocab-id
+        reuse make the churned encoder's mapping legitimately differ from a
+        fresh encoder's, so identity is judged the way the fabric suite
+        does — a forced FULL re-encode of host truth through the SAME
+        device leaves every mirror array byte-identical (any drift between
+        mirror and host truth would rewrite rows), the slot map covers
+        exactly the live nodes, and every tombstoned slot still holds the
+        empty-row encoding."""
+        from kubernetes_tpu.backend.device_state import DeviceState
+        from kubernetes_tpu.framework.types import NodeInfo
+
+        sched._drain_inflight()
+        sched._ensure_device()
+        sched.cache.update_snapshot(sched.snapshot)
+        dev = sched.device
+        dev.sync(sched.snapshot)
+        before = {f: arr.copy() for f, arr in dev._mirror.items()}
+        dev._uploaded_gen.clear()  # force a full re-encode of every row
+        dev._mirror_node.clear()
+        dev.sync(sched.snapshot)
+        for field, arr in dev._mirror.items():
+            assert np.array_equal(arr, before[field]), field
+        assert set(dev.encoder.node_slots) == set(
+            sched.snapshot.node_info_map)
+        empty_row = dev.encoder.encode_node_row(NodeInfo())
+        assigned = set(dev.encoder.node_slots.values())
+        from kubernetes_tpu.backend.device_state import _ROW_FIELDS
+
+        for slot in range(dev.caps.nodes):
+            if slot in assigned:
+                continue
+            for field, dtype in _ROW_FIELDS:
+                assert np.array_equal(
+                    dev._mirror[field][slot],
+                    np.asarray(empty_row[field], dtype)), (field, slot)
+
+    def test_node_delete_midflight_typed_rejection_no_ghost(self, monkeypatch):
+        """Regression (ISSUE 12 satellite): a node deleted while a
+        ring-depth-2 in-flight batch holds a placement on it — the commit
+        rejects with a typed verdict, the pods requeue via backoffQ, and no
+        ghost placement survives on the device or in the cache."""
+        store = ClusterStore()
+        store.create_node(make_node("doomed").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+        sched = self._ring_sched(monkeypatch, store)
+        for i in range(4):
+            store.create_pod(make_pod(f"a{i}").req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+        for i in range(4):
+            store.create_pod(make_pod(f"b{i}").req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+        assert len(sched._inflight) == 2, "ring must hold K=2 batches"
+        # the only node leaves while both batches are in flight
+        store.delete_node("doomed")
+        sched._drain_inflight()
+        # typed rejection, never a ghost: nothing bound, nothing lost
+        assert sched.metrics["scheduled"] == 0
+        assert _bound(store) == {}
+        assert sched.metrics["errors"] == 8
+        pending = sched.queue.pending_pods()
+        assert pending["backoff"] == 8, pending  # error → backoffQ requeue
+        # no ghost NodeInfo materialized in the cache for the dead node
+        assert not sched.cache.has_real_node("doomed")
+        reclaims = self.tele.flight.events("slot_reclaim")
+        assert len([e for e in reclaims if e.get("reason")]) == 8
+        assert all("removed while batch in flight" in e["reason"]
+                   or "reclaimed since dispatch" in e["reason"]
+                   for e in reclaims if e.get("reason"))
+        # capacity arrives: the NODE_ADD move + expired backoff rebind all 8
+        store.create_node(make_node("fresh").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+        import time as _time
+
+        _time.sleep(0.06)
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 8
+        assert set(_bound(store).values()) == {"fresh"}
+        # the next sync's removal sweep tombstoned the dead node's row
+        removes = self.tele.flight.events("node_remove")
+        assert any(e["node"] == "doomed" for e in removes)
+        _assert_oracle_replay_valid(store)
+        self._assert_mirror_byte_identical(sched)
+
+    def test_reclaimed_slot_reused_by_new_node_rejected_not_misplaced(
+            self, monkeypatch):
+        """The sharper half of the guard: the dead node's SLOT is already
+        reused by a replacement node when the in-flight commit lands. The
+        slot now resolves to a live node the kernel never judged — the
+        release-generation check must reject it (requeue), not bind."""
+        store = ClusterStore()
+        store.create_node(make_node("doomed").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+        sched = self._ring_sched(monkeypatch, store)
+        for i in range(4):
+            store.create_pod(make_pod(f"a{i}").req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+        assert len(sched._inflight) == 1
+        # churn while in flight: the tombstoned slot goes to the newcomer
+        store.delete_node("doomed")
+        store.create_node(make_node("newcomer").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+        sched.cache.update_snapshot(sched.snapshot)
+        sched.device.sync(sched.snapshot)  # release + free-list reuse
+        assert sched.device.encoder.node_slots.get("newcomer") == 0
+        assert sched.device.encoder.slot_reuses == 1
+        sched._drain_inflight()
+        # the commit named slot 0, which now means "newcomer": typed
+        # rejection — newcomer was never judged by that batch's kernel
+        assert sched.metrics["scheduled"] == 0
+        assert _bound(store) == {}
+        assert sched.queue.pending_pods()["backoff"] == 4
+        reclaims = [e for e in self.tele.flight.events("slot_reclaim")
+                    if e.get("reason")]
+        assert reclaims and all("reclaimed since dispatch" in e["reason"]
+                                for e in reclaims)
+        import time as _time
+
+        _time.sleep(0.06)
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 4
+        assert set(_bound(store).values()) == {"newcomer"}
+        _assert_oracle_replay_valid(store)
+        self._assert_mirror_byte_identical(sched)
+
+    def test_storm_drain_spot_overlapping_inflight_invariants(
+            self, monkeypatch):
+        """The full elastic ladder against a ring-depth-2 pipeline: an
+        add/remove storm over 30% of the cluster, a rolling drain wave, and
+        a mass spot reclamation, each launched while batches are in flight.
+        Zero lost pods, zero double-binds, bounded row capacity (slot
+        reuse), byte-identical post-resync mirror, oracle-replay-valid."""
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+        from kubernetes_tpu.controllers.drain import DrainOrchestrator
+
+        store = ClusterStore()
+        for i in range(10):
+            store.create_node(make_node(f"node-{i}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+        sched = self._ring_sched(monkeypatch, store, batch=8)
+        drainer = DrainOrchestrator(store, metrics=sched.smetrics,
+                                    queue=sched.queue)
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name="band"), min_member=3,
+            schedule_timeout_seconds=30))
+        created = []
+        for i in range(8):
+            p = make_pod(f"p{i}").req({"cpu": "200m"}).obj()
+            store.create_pod(p)
+            created.append(p.key())
+        for i in range(3):
+            p = (make_pod(f"band-{i}").req({"cpu": "200m"})
+                 .pod_group("band").obj())
+            store.create_pod(p)
+            created.append(p.key())
+        sched.run_until_settled()
+        caps_nodes0 = sched.device.caps.nodes
+        next_node = 10
+
+        def churn_pods(wave):
+            for i in range(4):
+                p = make_pod(f"w{wave}-{i}").req({"cpu": "200m"}).obj()
+                store.create_pod(p)
+                created.append(p.key())
+
+        import time as _time
+
+        def settle():
+            for _ in range(6):
+                _time.sleep(0.06)  # clear the (shortened) error backoff
+                sched.run_until_settled()
+                if sum(sched.queue.pending_pods().values()) == 0:
+                    break
+
+        # --- 1. add/remove storm (30%) over in-flight batches ------------
+        churn_pods(0)
+        sched.schedule_batch_cycle()  # leave a batch in flight
+        live = sorted(store.nodes)
+        storm = live[:3]
+        drainer.drain_wave(storm)
+        for name in storm:
+            store.delete_node(name)
+        for _ in range(3):
+            store.create_node(make_node(f"node-{next_node}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+            next_node += 1
+        settle()
+        # --- 2. rolling drain wave over in-flight batches ----------------
+        churn_pods(1)
+        sched.schedule_batch_cycle()
+        wave = sorted(store.nodes)[:2]
+        drainer.drain_wave(wave)
+        settle()
+        for name in wave:
+            drainer.uncordon(name)
+        # --- 3. mass spot reclamation over in-flight batches -------------
+        churn_pods(2)
+        sched.schedule_batch_cycle()
+        spots = sorted(store.nodes)[-3:]
+        drainer.spot_reclaim(spots, delete_nodes=True)
+        for _ in range(3):
+            store.create_node(make_node(f"node-{next_node}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 30}).obj())
+            next_node += 1
+        settle()
+
+        # standing invariants
+        bound = _bound(store)
+        assert len(bound) == len(created), "zero lost pods"
+        assert sorted(p.key() for p in store.pods.values()) == sorted(created)
+        live = set(store.nodes)
+        assert all(n in live for n in bound.values())
+        # whole-gang atomicity: all 3 members bound (never partial)
+        assert sum(1 for k in bound if k.startswith("band")) == 3
+        _assert_oracle_replay_valid(store)
+        # bounded shrink/grow: churned well past the free-list, capacity
+        # never grew and tombstoned slots were REUSED
+        assert sched.device.caps.nodes == caps_nodes0
+        assert sched.smetrics.device_slot_reuse.labels() > 0
+        assert self.tele.flight.events("node_remove")
+        assert self.tele.flight.events("evict_wave")
+        self._assert_mirror_byte_identical(sched)
+
+    def test_churn_with_fabric_failover_no_ghost_on_standby(self):
+        """Elasticity × HA: nodes churn while the fabric primary dies
+        mid-batch. The poisoned work requeues, the standby is seeded by the
+        full resync — WITHOUT the removed node (no ghost row on any
+        replica) — and every pod lands with oracle-valid placements and a
+        byte-identical post-resync mirror."""
+        from kubernetes_tpu.backend import telemetry
+
+        rig = _FabricRig(nodes=4, cap="8", replicas=2)
+        try:
+            for i in range(6):
+                rig.store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+            rig.settle()
+            assert len(_bound(rig.store)) == 6
+            # churn: one node out (its pods evicted+recreated), one in —
+            # then kill the primary while the rebind batch is on the wire
+            from kubernetes_tpu.controllers.drain import DrainOrchestrator
+
+            drainer = DrainOrchestrator(rig.store, queue=rig.sched.queue)
+            drainer.drain_wave(["n0"])
+            rig.store.delete_node("n0")
+            rig.store.create_node(make_node("n9").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 10}).obj())
+            self_kill = rig.plans[0]
+            self_kill.kill()
+            rig.settle(rounds=4)
+            bound = _bound(rig.store)
+            assert len(bound) == 6, "zero lost pods across churn + failover"
+            assert "n0" not in set(bound.values())
+            _assert_oracle_replay_valid(rig.store)
+            # the surviving replica's mirror carries no ghost of n0
+            svc = rig.active_service()
+            assert svc is rig.services[1]
+            assert "n0" not in svc.infos
+            assert "n0" not in svc.device.encoder.node_slots
+            _assert_resync_mirror_identical(rig)
+        finally:
+            rig.close()

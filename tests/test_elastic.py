@@ -1,0 +1,413 @@
+"""Cluster elasticity (ISSUE 12): slot tombstones + vocab reclamation on
+the shrink path, the drain/spot orchestration ladder, targeted node-ADD
+queue moves, and the SchedulingElastic workload.
+
+Tier-1 runs the small variants on a FakeClock; the reference-size
+SchedulingElastic row is slow-marked."""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver.store import ClusterStore
+from kubernetes_tpu.backend.device_state import DeviceState, caps_for_cluster
+from kubernetes_tpu.cache import Snapshot
+from kubernetes_tpu.controllers.drain import (
+    TAINT_SPOT_RECLAIM,
+    TAINT_UNSCHEDULABLE,
+    DrainOrchestrator,
+)
+from kubernetes_tpu.framework.types import NodeInfo
+from kubernetes_tpu.perf import TEST_CASES, run_workload
+from kubernetes_tpu.scheduler.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+from kubernetes_tpu.utils.vocab import Vocab
+
+
+def _bound(store):
+    return {p.meta.name: p.spec.node_name
+            for p in store.pods.values() if p.spec.node_name}
+
+
+class TestVocabReclamation:
+    def test_release_reuses_id_before_growing(self):
+        v = Vocab("t")
+        a, b = v.id("a"), v.id("b")
+        assert (a, b) == (1, 2)
+        assert v.release("a") == 1
+        assert v.lookup("a") == 0
+        assert v.id("c") == 1  # freed id reused
+        assert v.id("d") == 3  # then the table grows
+        assert v.live() == 3
+        assert v.release("never") is None
+
+    def test_encoder_node_retention_frees_label_values(self):
+        from kubernetes_tpu.ops.encode import ClusterEncoder
+        from kubernetes_tpu.ops.schema import Capacities
+
+        enc = ClusterEncoder(Capacities(nodes=8, pods=4, value_words=32))
+        n0 = make_node("n0").label("zone", "z-only-n0").obj()
+        n1 = make_node("n1").label("zone", "z-shared").obj()
+        n2 = make_node("n2").label("zone", "z-shared").obj()
+        for n in (n0, n1, n2):
+            enc.retain_node_values(n.meta.name, n)
+            enc.encode_node_row(NodeInfo(n))
+        ks = enc.key_vocab.lookup("zone")
+        vv = enc.value_vocabs[ks]
+        only_id = vv.lookup("z-only-n0")
+        assert only_id > 0 and vv.lookup("z-shared") > 0
+        # n0 leaves: its unique value frees; the shared one is still pinned
+        enc.release_node_values("n0")
+        assert vv.lookup("z-only-n0") == 0
+        assert vv.lookup("z-shared") > 0
+        # one of two sharers leaves: still pinned; the last leaves: freed
+        enc.release_node_values("n1")
+        assert vv.lookup("z-shared") > 0
+        enc.release_node_values("n2")
+        assert vv.lookup("z-shared") == 0
+
+    def test_value_free_invalidates_pod_template_cache(self):
+        from kubernetes_tpu.ops.encode import ClusterEncoder
+        from kubernetes_tpu.ops.schema import Capacities
+
+        enc = ClusterEncoder(Capacities(nodes=8, pods=4, value_words=32))
+        node = make_node("n0").label("zone", "zx").obj()
+        enc.retain_node_values("n0", node)
+        enc.encode_node_row(NodeInfo(node))
+        pod = make_pod("p").req({"cpu": "1"}).obj()
+        pod.spec.node_selector = {"zone": "zx"}
+        enc.encode_pods([pod])
+        assert enc._pod_templates  # compiled key set embeds the value id
+        enc.release_node_values("n0")  # frees "zx"'s id
+        assert not enc._pod_templates, \
+            "template cache must clear when a value id is freed"
+
+
+class TestSlotTombstones:
+    def _snap(self, names):
+        snap = Snapshot()
+        for i, name in enumerate(names):
+            snap.node_info_map[name] = NodeInfo(
+                make_node(name).capacity(
+                    {"cpu": "4", "memory": "8Gi", "pods": 10})
+                .label("zone", f"z{i % 2}").obj())
+        snap.node_info_list = list(snap.node_info_map.values())
+        snap.structure_version += 1
+        return snap
+
+    def test_release_generation_guards_inflight_commits(self):
+        dev = DeviceState(caps_for_cluster(4))
+        dev.sync(self._snap(["a", "b"]))
+        slot_a = dev.encoder.node_slots["a"]
+        gen0 = dev.encoder.reclaim_gen
+        assert not dev.encoder.slot_stale_since(slot_a, gen0)
+        dev.sync(self._snap(["b"]))  # a removed: slot tombstoned
+        assert dev.encoder.slot_stale_since(slot_a, gen0)
+        assert "a" not in dev.encoder.node_slots
+        # reuse: the tombstone goes to the newcomer, still stale vs gen0
+        dev.sync(self._snap(["b", "c"]))
+        assert dev.encoder.node_slots["c"] == slot_a
+        assert dev.encoder.slot_reuses == 1
+        assert dev.encoder.slot_stale_since(slot_a, gen0)
+        assert not dev.encoder.slot_stale_since(slot_a,
+                                                dev.encoder.reclaim_gen)
+
+    def test_sustained_churn_capacity_and_vocab_bounded(self):
+        """The ISSUE 12 acceptance bound at unit level: remove/add cycling
+        2x the initial cluster size leaves row capacity, the hostname value
+        vocab, and the node-slot space all at their initial size — and the
+        delta path back at zero upload bytes at steady state."""
+        n0 = 8
+        names = [f"node-{i}" for i in range(n0)]
+        dev = DeviceState(caps_for_cluster(n0))
+        dev.sync(self._snap(names))
+        caps0 = dev.caps.nodes
+        ks = dev.encoder.key_vocab.lookup("kubernetes.io/hostname")
+        vocab_len0 = (len(dev.encoder.value_vocabs[ks])
+                      if ks in dev.encoder.value_vocabs else 0)
+        next_i = n0
+        for _cycle in range(2 * n0):  # churn 2x the cluster size
+            names = names[1:] + [f"node-{next_i}"]
+            next_i += 1
+            dev.sync(self._snap(names))
+        assert dev.caps.nodes == caps0, "row capacity must not grow"
+        assert max(dev.encoder.node_slots.values()) < n0, \
+            "slots must recycle through the free-list"
+        assert dev.encoder.slot_reuses >= 2 * n0
+        if ks in dev.encoder.value_vocabs:
+            vv = dev.encoder.value_vocabs[ks]
+            # hostname ids recycle: live count bounded by the cluster size,
+            # table length never exceeds initial + one transient
+            assert vv.live() <= n0
+            assert len(vv) <= max(vocab_len0, n0 + 2)
+        # steady state: an unchanged snapshot uploads zero bytes
+        snap = self._snap(names)
+        dev.sync(snap)
+        dev.sync(snap)
+        assert dev.last_upload_bytes == 0
+
+    def test_tombstoned_row_zeroed_on_device(self):
+        dev = DeviceState(caps_for_cluster(4))
+        dev.sync(self._snap(["a", "b"]))
+        slot_a = dev.encoder.node_slots["a"]
+        assert bool(np.asarray(dev.nt.valid)[slot_a])
+        dev.sync(self._snap(["b"]))
+        assert not bool(np.asarray(dev.nt.valid)[slot_a])
+        assert not dev._mirror["valid"][slot_a]
+
+
+def _cluster(store, n=4, cap="8"):
+    for i in range(n):
+        store.create_node(make_node(f"n{i}").capacity(
+            {"cpu": cap, "memory": "16Gi", "pods": 20}).obj())
+
+
+class TestDrainOrchestrator:
+    def test_cordon_writes_unschedulable_and_taint(self):
+        store = ClusterStore()
+        _cluster(store, 1)
+        d = DrainOrchestrator(store)
+        assert d.cordon("n0")
+        node = store.nodes["n0"]
+        assert node.spec.unschedulable
+        assert any(t.key == TAINT_UNSCHEDULABLE and t.effect == "NoSchedule"
+                   for t in node.spec.taints)
+        assert not d.cordon("n0")  # idempotent
+        assert d.uncordon("n0")
+        node = store.nodes["n0"]
+        assert not node.spec.unschedulable
+        assert not any(t.key == TAINT_UNSCHEDULABLE for t in node.spec.taints)
+
+    def test_drain_wave_evicts_whole_gang_atomically(self):
+        """A gang member on a draining node drags the WHOLE gang (members
+        on healthy nodes included) through the eviction, so the gang
+        rebinds as a unit — never a stranded partial quorum."""
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
+        store = ClusterStore()
+        clock = FakeClock()
+        _cluster(store, 4, cap="2")
+        sched = Scheduler(store, now_fn=clock)
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name="g"), min_member=3,
+            schedule_timeout_seconds=30))
+        for i in range(3):
+            store.create_pod(make_pod(f"g-{i}").req({"cpu": "1"})
+                             .pod_group("g").obj())
+        store.create_pod(make_pod("solo").req({"cpu": "1"}).obj())
+        sched.run_until_settled()
+        bound = _bound(store)
+        assert len(bound) == 4
+        gang_nodes = {bound[f"g-{i}"] for i in range(3)}
+        assert len(gang_nodes) > 1  # spread over several nodes
+        victim_node = bound["g-0"]
+        d = DrainOrchestrator(store, metrics=sched.smetrics,
+                              queue=sched.queue, now_fn=clock)
+        summary = d.drain_wave([victim_node])
+        # every gang member evicted (recreated unbound), wherever it was
+        for i in range(3):
+            p = store.get_pod(f"default/g-{i}")
+            assert p is not None and not p.spec.node_name
+        # the solo pod is evicted only if it lived on the drained node
+        assert summary["gangs"] == 1
+        assert sched.smetrics.evicted_pods.labels("drain") >= 3
+        # rebind: uncordon and everything lands again, gang whole
+        d.uncordon(victim_node)
+        clock.advance(11.0)
+        sched.run_until_settled()
+        bound = _bound(store)
+        assert sum(1 for k in bound if k.startswith("g-")) == 3
+
+    def test_spot_reclaim_rides_taint_manager_and_respects_tolerations(self):
+        store = ClusterStore()
+        clock = FakeClock()
+        _cluster(store, 2)
+        sched = Scheduler(store, now_fn=clock)
+        from kubernetes_tpu.api.types import Toleration
+
+        store.create_pod(make_pod("plain").req({"cpu": "1"}).obj())
+        shielded = make_pod("shielded").req({"cpu": "1"}).obj()
+        shielded.spec.tolerations = (Toleration(
+            key=TAINT_SPOT_RECLAIM, operator="Exists",
+            effect="NoExecute"),)  # unbounded: survives the reclaim
+        store.create_pod(shielded)
+        sched.run_until_settled()
+        nodes_used = set(_bound(store).values())
+        d = DrainOrchestrator(store, metrics=sched.smetrics,
+                              queue=sched.queue, now_fn=clock)
+        summary = d.spot_reclaim(sorted(store.nodes))
+        reclaimed = {n for n in store.nodes
+                     if any(t.key == TAINT_SPOT_RECLAIM
+                            for t in store.nodes[n].spec.taints)}
+        assert reclaimed == set(store.nodes) and nodes_used <= reclaimed
+        # the taint manager evicted the non-tolerating pod only
+        plain = store.get_pod("default/plain")
+        assert plain is not None and not plain.spec.node_name  # recreated
+        assert store.get_pod("default/shielded").spec.node_name
+        assert summary["evicted"] == 1
+        assert sched.smetrics.evicted_pods.labels("spot") == 1
+        # the capacity actually vanishes: even the tolerating pod must be
+        # evicted (recreated unbound) — a toleration cannot keep a pod on
+        # deleted hardware
+        d.spot_reclaim(sorted(store.nodes), delete_nodes=True)
+        assert not store.nodes
+        shielded2 = store.get_pod("default/shielded")
+        assert shielded2 is not None and not shielded2.spec.node_name
+        assert all(not p.spec.node_name for p in store.pods.values())
+
+    def test_nodelifecycle_eviction_uses_shared_taint_manager(self):
+        """The unreachable-node path and the spot path are one machinery:
+        evict_noexecute_pods judges per actual NoExecute taint, so a
+        not-ready-only toleration no longer shields against unreachable."""
+        from kubernetes_tpu.api.types import Lease, ObjectMeta, Toleration
+        from kubernetes_tpu.client.informer import SharedInformerFactory
+        from kubernetes_tpu.controllers.nodelifecycle import (
+            NODE_LEASE_NAMESPACE,
+            TAINT_UNREACHABLE,
+            NodeLifecycleController,
+        )
+        from kubernetes_tpu.metrics import SchedulerMetrics
+
+        store = ClusterStore()
+        clock = FakeClock()
+        _cluster(store, 1)
+        store.create_object("Lease", Lease(
+            meta=ObjectMeta(name="n0", namespace=NODE_LEASE_NAMESPACE),
+            renew_time=clock()))
+        p = make_pod("w").req({"cpu": "1"}).obj()
+        p.spec.node_name = "n0"
+        store.create_pod(p)
+        tol = make_pod("tol").req({"cpu": "1"}).obj()
+        tol.spec.node_name = "n0"
+        tol.spec.tolerations = (Toleration(
+            key=TAINT_UNREACHABLE, operator="Exists", effect="NoExecute"),)
+        store.create_pod(tol)
+        metrics = SchedulerMetrics()
+        ctrl = NodeLifecycleController(
+            store, SharedInformerFactory(store), grace_period=40.0,
+            now_fn=clock, metrics=metrics)
+        clock.advance(60.0)  # lease expires
+        ctrl.monitor_node_health()
+        node = store.nodes["n0"]
+        assert not node.status.ready
+        assert any(t.key == TAINT_UNREACHABLE for t in node.spec.taints)
+        # admission stamped the 300s DefaultTolerationSeconds pair: both
+        # pods ride the toleration window first
+        assert store.get_pod("default/w") is not None
+        clock.advance(301.0)  # the finite window expires
+        ctrl.monitor_node_health()
+        assert store.get_pod("default/w") is None  # evicted
+        assert store.get_pod("default/tol") is not None  # unbounded: stays
+        assert metrics.evicted_pods.labels("taint") == 1
+
+
+class TestWireRemovalDelta:
+    def test_invalidated_then_deleted_node_still_named_in_removed(self):
+        """Regression: _invalidate_node pops the node's sent gen (the
+        repair idiom); a node DELETED in that window must still be named
+        in the next delta's ``removed`` list — previously the removal set
+        was computed from _sent_gens, so the service kept a ghost row
+        until a full resync."""
+        from kubernetes_tpu.backend.service import (
+            DeviceService,
+            WireScheduler,
+            serve,
+        )
+
+        service = DeviceService(batch_size=32)
+        server, port = serve(service)
+        try:
+            store = ClusterStore()
+            _cluster(store, 2)
+            sched = WireScheduler(store,
+                                  endpoint=f"http://127.0.0.1:{port}")
+            store.create_pod(make_pod("p0").req({"cpu": "1"}).obj())
+            sched.run_until_settled()
+            assert set(service.infos) == {"n0", "n1"}
+            resyncs0 = sched.resyncs
+            # the repair idiom fires, then the node leaves
+            sched._invalidate_node("n0")
+            store.delete_node("n0")
+            store.create_pod(make_pod("p1").req({"cpu": "1"}).obj())
+            sched.run_until_settled()
+            assert "n0" not in service.infos, \
+                "removal must ride the delta, not wait for a full resync"
+            assert "n0" not in service.device.encoder.node_slots
+            assert sched.resyncs == resyncs0
+        finally:
+            server.shutdown()
+
+
+class TestNodeAddQueueMove:
+    def test_parked_pods_reactivate_when_capacity_arrives(self):
+        """ISSUE 12 satellite: a pod parked Unschedulable on resource
+        pressure must reactivate on a node ADD (NodeResourcesFit registers
+        NODE|ADD) and bind to the new capacity — no unschedulable-timeout
+        flush needed."""
+        store = ClusterStore()
+        clock = FakeClock()
+        _cluster(store, 1, cap="1")
+        sched = Scheduler(store, now_fn=clock, pod_initial_backoff=0.5)
+        store.create_pod(make_pod("big").req({"cpu": "4"}).obj())
+        sched.run_until_settled()
+        pending = sched.queue.pending_pods()
+        assert pending["unschedulable"] == 1, pending
+        assert sched.smetrics.node_events.labels("add") == 1
+        # capacity arrives: the targeted NODE_ADD move reactivates the pod
+        store.create_node(make_node("big-node").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 20}).obj())
+        pending = sched.queue.pending_pods()
+        assert pending["unschedulable"] == 0, \
+            "NODE_ADD must move the parked pod out of the unschedulable map"
+        clock.advance(1.0)  # clear the move's backoff window
+        sched.run_until_settled()
+        assert _bound(store) == {"big": "big-node"}
+        assert sched.smetrics.node_events.labels("add") == 2
+
+
+class TestSchedulingElasticSmall:
+    """The tier-1 variant: tpu backend, FakeClock, 24 nodes — storms,
+    drain waves, and spot reclamations rotating over the batched pipeline
+    with ring depth 2."""
+
+    def _run(self, **kw):
+        tc = TEST_CASES["SchedulingElastic"](
+            nodes=24, rounds=6, pods_per_round=12, drain_nodes=3,
+            cycles_per_round=40, tick_s=0.05, **kw)
+        return run_workload(tc, backend="tpu", now_fn=FakeClock())
+
+    def test_invariants_under_chaos_ladder(self):
+        items = self._run()
+        (inv,) = [it.data for it in items
+                  if it.labels.get("Name") == "ElasticInvariants"]
+        assert inv["LostPods"] == 0.0
+        assert inv["Oversubscribed"] == 0.0
+        assert inv["PendingAtEnd"] == 0.0
+        # the shrink direction engaged: nodes removed, rows tombstoned and
+        # REUSED (capacity bounded at the initial bucket), evictions rode
+        # the drain/spot machinery, and the delta path returned to zero
+        assert inv["NodesRemoved"] > 0 and inv["NodesAdded"] > 0
+        assert inv["SlotReuses"] > 0
+        assert inv["EvictedPods"] > 0
+        assert inv["RowCapacity"] == float(caps_for_cluster(24).nodes), \
+            "sustained churn must not grow the node axis"
+        assert inv["UploadBytesSteady"] == 0.0, \
+            "delta elision must recover after the storms"
+
+
+@pytest.mark.slow
+class TestSchedulingElasticLarge:
+    def test_reference_size_elastic(self):
+        """The reference-size row (kept out of tier-1: slow): 1000 nodes,
+        six rounds of storm/drain/spot over the batched pipeline."""
+        tc = TEST_CASES["SchedulingElastic"]()
+        items = run_workload(tc, backend="tpu")
+        (inv,) = [it.data for it in items
+                  if it.labels.get("Name") == "ElasticInvariants"]
+        assert inv["LostPods"] == 0.0
+        assert inv["Oversubscribed"] == 0.0
+        assert inv["SlotReuses"] > 0
+        assert inv["UploadBytesSteady"] == 0.0
+        tput = [it for it in items
+                if it.labels.get("Name") == "SchedulingElastic"]
+        assert tput and tput[0].data["Average"] > 0
