@@ -1,0 +1,486 @@
+"""Commit data plane: the batched bind/WAL/cache/notify engine.
+
+BENCH_r08 measured ``host.commit`` at 76.5% of the batch critical path
+(~64ms/batch) while device compute was 1.4ms — the per-pod Python commit
+loop (assume → Reserve → Permit → bind → cache → notify → PostBind, one
+lock round trip and one store write each) had become THE bottleneck of the
+batched scheduler. This module rebuilds that loop as a data plane:
+
+  * ``CommitPlane.commit_bindings`` — the batched bind tail shared by
+    ``TPUScheduler._commit_batch`` and ``WireScheduler._process_wire_results``:
+    one ``Cache.apply_batch`` lock round trip assumes every winner, the
+    Reserve/Permit/PreBind extension points run batch-instrumented (one
+    histogram observation + one span per point per batch instead of one
+    per pod), the store lands every bind in ONE ``bind_batch`` transaction
+    whose journal records flush as ONE group-commit WAL append
+    (``apiserver/wal.py append_batch`` — crc-framed, per-record replay,
+    torn-tail rules unchanged), a second ``apply_batch`` finishes every
+    binding, and PostBind runs through ``run_post_bind_plugins_batch``
+    (Coscheduling updates each touched gang's status once per commit).
+    Per-pod SEMANTICS are unchanged: each pod's plugins see the same calls
+    in the same order, each pod fails independently, and Permit WAIT still
+    parks the pod.
+
+  * queue-move coalescing — callers wrap the whole commit (winners AND
+    failures) in ``SchedulingQueue.coalesce_moves()``: every
+    ``move_all_to_active_or_backoff_queue`` fired by the commit's store
+    events collapses into one union scan of the unschedulable map.
+
+  * ``CommitWorker`` — a single background thread that lands in-flight
+    batches strictly in submission order, overlapping batch K's host
+    commit with batch K+1's encode/dispatch/device execution (the PR-5
+    in-flight ring provides the entries; the scheduler's device lock keeps
+    the worker's adopt/reconcile phases exclusive with encode/dispatch).
+    ``flush()`` is the synchronization point the drain paths use; a commit
+    failure inside the worker runs the scheduler's existing ring-poison
+    path (all batches requeue via backoffQ, device rebuilds).
+
+  * ``materialize_result`` — the one-blocking-read materialization of a
+    batch's packed result block, shared by the in-process commit, the
+    commit worker, and ``DeviceService``'s server-side commit.
+
+Durability contract of the group commit: one crc-framed WAL line carries
+the whole batch's bind records in journal order. A crash mid-write tears
+the LINE, so the whole batch drops atomically on replay (none of its binds
+recovered — exactly the per-record torn-tail rule, batch-sized); a crash
+after the write recovers every bind. No interleaving with other writers is
+possible: the group buffer fills inside the store's mutation critical
+section, the same lock every per-record append runs under.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from time import perf_counter
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ..api.types import Binding, Pod
+from ..framework.interface import CycleState, Status
+from ..framework.types import Diagnosis, QueuedPodInfo
+from ..testing import locktrace
+from ..utils.events import TYPE_NORMAL
+
+
+@dataclass
+class BindItem:
+    """One device-placed winner entering the batched bind tail."""
+
+    fwk: object
+    qp: QueuedPodInfo
+    pod: Pod
+    node_name: str
+    state: CycleState
+    # filled by the engine:
+    assumed: Optional[Pod] = None
+    outcome: str = "pending"  # bound | waiting | failed
+    status: Optional[Status] = None
+
+
+@dataclass
+class CommitStats:
+    bound: int = 0
+    waiting: int = 0
+    failed: int = 0
+    stage_s: dict = field(default_factory=dict)
+
+
+class CommitPlane:
+    """Batched bind engine over one scheduler's store/cache/queue/framework
+    surfaces. Stateless between calls except for the per-profile
+    default-binder memo; thread-compatible with the commit worker (all
+    shared state it touches — cache, store, queue, metrics — carries its
+    own lock)."""
+
+    def __init__(self, sched):
+        self.sched = sched
+        self._default_binder: dict = {}  # profile -> bind point is [DefaultBinder]
+        self.batches = 0
+        self.pods_bound = 0
+        # the DEVICE MUTEX of the async commit protocol: the scheduling
+        # thread holds it across sync/encode/dispatch, the commit worker
+        # across adopt/judge/reconcile — the two owners' mutations of the
+        # shared DeviceState/encoder/sig-table never interleave. Owned here
+        # (not on the scheduler) deliberately: the static lock-discipline
+        # pass reasons per class about `self._lock` attribute guards, which
+        # cannot express a two-thread phase protocol over a foreign object;
+        # the dynamic KTPU_LOCKTRACE tracer covers this lock by name in the
+        # chaos suites instead (cycle + blocking-under-lock checks).
+        self.device_mutex = locktrace.make_rlock("DeviceMutex")
+
+    # ------------------------------------------------------------ helpers
+
+    def _bind_point_is_default(self, fwk) -> bool:
+        """True when the profile's bind point is exactly [DefaultBinder] —
+        the store's batched bind then IS the bind plugin run. Any other
+        bind plugin set takes the per-pod run_bind_plugins path."""
+        memo = self._default_binder.get(fwk.profile_name)
+        if memo is None:
+            from ..framework.plugins.defaultbinder import DefaultBinder
+
+            point = fwk.points.get("bind", [])
+            memo = len(point) == 1 and isinstance(point[0][0], DefaultBinder)
+            self._default_binder[fwk.profile_name] = memo
+        return memo
+
+    def _binder_extender_for(self, pod: Pod):
+        for ext in self.sched.extenders:
+            if ext.is_binder() and ext.is_interested(pod):
+                return ext
+        return None
+
+    def _fail(self, item: BindItem, status: Status, pod_cycle: int,
+              unreserve: bool = True) -> None:
+        """Roll one winner back: unreserve (when its reserve ran), forget
+        the assume, and hand the pod to the shared failure path — the exact
+        per-pod assume_and_bind failure sequence."""
+        s = self.sched
+        if unreserve:
+            item.fwk.run_reserve_plugins_unreserve(
+                item.state, item.assumed, item.node_name)
+        s.cache.forget_pod(item.assumed)
+        s._handle_scheduling_failure(item.fwk, item.state, item.qp, status,
+                                     Diagnosis(), pod_cycle)
+        item.outcome = "failed"
+        item.status = status
+
+    # ------------------------------------------------------------- engine
+
+    def commit_bindings(self, items: List[BindItem], pod_cycle: int,
+                        t0: float) -> CommitStats:
+        """Land a batch of device-placed winners. Every stage is batched
+        (one lock round trip / one store transaction / one WAL line / one
+        instrumentation record), while per-pod plugin calls and failure
+        isolation match the sequential assume_and_bind tail exactly."""
+        s = self.sched
+        stats = CommitStats()
+        if not items:
+            return stats
+        self.batches += 1
+        hist = s.smetrics.commit_batch_duration
+        coalesced = s.smetrics.commit_coalesced_events
+        t_begin = perf_counter()
+
+        # ---- stage: assume (one cache lock round trip for the batch)
+        for item in items:
+            item.assumed = item.pod.clone()
+        errs = s.cache.apply_batch([("assume", item.assumed, item.node_name)
+                                    for item in items])
+        coalesced.inc("cache_op", value=len(items))
+        live: List[BindItem] = []
+        for item, err in zip(items, errs):
+            if err is not None:
+                # per-pod parity: an already-cached key surfaced as a cycle
+                # error and re-enqueued (the clone never joined the cache,
+                # so there is nothing to unreserve or forget)
+                s._handle_scheduling_failure(
+                    item.fwk, item.state, item.qp, Status.error(str(err)),
+                    Diagnosis(), pod_cycle)
+                item.outcome = "failed"
+                continue
+            item.fwk.nominator.delete_nominated_pod_if_exists(item.pod)
+            live.append(item)
+        hist.observe(perf_counter() - t_begin, "assume")
+
+        # ---- stages: reserve, permit (batch-instrumented extension
+        # points; observed separately inside — gang park/quorum work is
+        # permit cost and must not masquerade as reserve in the evidence)
+        live = self._run_reserve_permit(live, pod_cycle, t0, hist)
+
+        # ---- stage: pre-bind
+        t_pb = perf_counter()
+        live = self._run_pre_bind(live, pod_cycle)
+        hist.observe(perf_counter() - t_pb, "pre_bind")
+
+        # ---- stage: bind (one store transaction + one WAL group append)
+        t_bind = perf_counter()
+        live = self._run_bind(live, pod_cycle)
+        hist.observe(perf_counter() - t_bind, "bind")
+
+        # ---- stage: finish + bookkeeping + batched PostBind
+        t_fin = perf_counter()
+        if live:
+            s.cache.apply_batch([("finish", item.assumed) for item in live])
+            coalesced.inc("cache_op", value=len(live))
+            now = s.now_fn()
+            for item in live:
+                item.outcome = "bound"
+                s.metrics.inc("scheduled")
+                s.smetrics.clear_unschedulable(item.assumed.key())
+                s.smetrics.observe_attempt(
+                    "scheduled", item.fwk.profile_name, now - t0)
+                s.recorder.eventf(
+                    item.assumed.key(), TYPE_NORMAL, "Scheduled", "Binding",
+                    f"Successfully assigned {item.assumed.key()} to "
+                    f"{item.node_name}")
+            by_fwk = {}
+            for item in live:
+                by_fwk.setdefault(item.fwk, []).append(
+                    (item.state, item.assumed, item.node_name))
+            for fwk, batch in by_fwk.items():
+                fwk.run_post_bind_plugins_batch(batch)
+            coalesced.inc("post_bind", value=len(live))
+            self.pods_bound += len(live)
+        hist.observe(perf_counter() - t_fin, "finish")
+        s.smetrics.commit_batch_duration.observe(
+            perf_counter() - t_begin, "total")
+
+        for item in items:
+            if item.outcome == "bound":
+                stats.bound += 1
+            elif item.outcome == "waiting":
+                stats.waiting += 1
+            else:
+                stats.failed += 1
+        return stats
+
+    def _run_reserve_permit(self, live: List[BindItem], pod_cycle: int,
+                            t0: float, hist) -> List[BindItem]:
+        from ..framework import interface as fw
+        from ..framework.runtime import DEFAULT_PERMIT_WAIT_S, PERMIT_TIMEOUT_KEY
+        from ..scheduler.scheduler import WaitingPod
+
+        s = self.sched
+        reserve_s = 0.0
+        permit_s = 0.0
+        by_fwk = {}
+        for item in live:
+            by_fwk.setdefault(item.fwk, []).append(item)
+        out: List[BindItem] = []
+        for fwk, group in by_fwk.items():
+            t_res = perf_counter()
+            sts = fwk.run_reserve_plugins_reserve_batch(
+                [(item.state, item.assumed, item.node_name)
+                 for item in group])
+            survivors = []
+            for item, st in zip(group, sts):
+                if not st.is_success():
+                    self._fail(item, st, pod_cycle)
+                    continue
+                survivors.append(item)
+            reserve_s += perf_counter() - t_res
+            if not survivors:
+                continue
+
+            def park(i, st, group=survivors):
+                # fires the instant item i votes WAIT — the NEXT member's
+                # permit must count this one among the parked holders
+                # (gang quorum), exactly like the per-pod cycle
+                item = group[i]
+                try:
+                    timeout = float(item.state.read(PERMIT_TIMEOUT_KEY))
+                except KeyError:
+                    timeout = DEFAULT_PERMIT_WAIT_S
+                s.waiting_pods[item.assumed.key()] = WaitingPod(
+                    item.fwk, item.state, item.assumed, item.node_name,
+                    pod_cycle, t0=t0,
+                    deadline=s.now_fn() + timeout, plugin=st.plugin)
+                item.outcome = "waiting"
+
+            t_per = perf_counter()
+            psts = fwk.run_permit_plugins_batch(
+                [(item.state, item.assumed, item.node_name)
+                 for item in survivors], on_wait=park)
+            for item, st in zip(survivors, psts):
+                if st.code == fw.WAIT:
+                    continue  # parked by the on_wait callback
+                if not st.is_success():
+                    self._fail(item, st, pod_cycle)
+                    continue
+                out.append(item)
+            permit_s += perf_counter() - t_per
+        hist.observe(reserve_s, "reserve")
+        hist.observe(permit_s, "permit")
+        return out
+
+    def _run_pre_bind(self, live: List[BindItem],
+                      pod_cycle: int) -> List[BindItem]:
+        by_fwk = {}
+        for item in live:
+            by_fwk.setdefault(item.fwk, []).append(item)
+        out: List[BindItem] = []
+        for fwk, group in by_fwk.items():
+            sts = fwk.run_pre_bind_plugins_batch(
+                [(item.state, item.assumed, item.node_name)
+                 for item in group])
+            for item, st in zip(group, sts):
+                if not st.is_success():
+                    self._fail(item, st, pod_cycle)
+                    continue
+                out.append(item)
+        return out
+
+    def _run_bind(self, live: List[BindItem],
+                  pod_cycle: int) -> List[BindItem]:
+        s = self.sched
+        batched: List[BindItem] = []
+        out: List[BindItem] = []
+        for item in live:
+            ext = self._binder_extender_for(item.assumed)
+            if ext is None and self._bind_point_is_default(item.fwk):
+                batched.append(item)
+                continue
+            # extender-bound or custom bind plugins: the per-pod path
+            status = s._extenders_binding(item.assumed, item.node_name)
+            if status is None:
+                status = item.fwk.run_bind_plugins(
+                    item.state, item.assumed, item.node_name)
+            if not status.is_success():
+                self._fail(item, status, pod_cycle)
+                continue
+            out.append(item)
+        if batched:
+            t_bind = perf_counter()
+            outcomes = s.store.bind_batch([
+                Binding(pod_key=item.assumed.key(), node_name=item.node_name)
+                for item in batched])
+            bind_s = perf_counter() - t_bind
+            s.smetrics.commit_coalesced_events.inc(
+                "wal_record", value=len(batched))
+            n_failed = 0
+            for item, err in zip(batched, outcomes):
+                if err is not None:
+                    # Status-wrapped like DefaultBinder.bind does (AsStatus)
+                    n_failed += 1
+                    self._fail(item, Status.error(str(err)), pod_cycle)
+                    continue
+                out.append(item)
+            # the batched store transaction IS the DefaultBinder run:
+            # extension-point totals observe once per (fwk, batch), and
+            # sampled items keep the per-plugin duration contract
+            by_fwk = {}
+            for item in batched:
+                by_fwk.setdefault(item.fwk, []).append(item)
+            status_label = "Success" if n_failed == 0 else "Error"
+            for fwk, group in by_fwk.items():
+                if fwk._metrics is None:
+                    continue
+                fwk._metrics.framework_extension_point_duration.observe(
+                    bind_s, "bind", status_label, fwk.profile_name)
+                if any(item.state.record_plugin_metrics for item in group):
+                    for plugin, _w in fwk.points.get("bind", []):
+                        fwk._metrics.plugin_execution_duration.observe(
+                            bind_s, plugin.name(), "bind", status_label)
+        return out
+
+
+def materialize_result(result, n_nodes: int, batch_id: str = "",
+                       pods: int = 0, **event_extra):
+    """THE one blocking device read of a batch commit: materialize the
+    packed result block (node_idx + first_fail in one buffer) or take the
+    per-array fallback for packless (mesh-sharded) results. Returns
+    ``(node_idx, ff, packed_ok)``; ``ff`` is None on the fallback path
+    (callers lazily read result.first_fail). Shared by the in-process
+    commit, the commit worker, and DeviceService's server-side commit so
+    transfer accounting and flight events stay identical."""
+    from . import telemetry
+    from .batch import unpack_result_block
+
+    if result.packed is not None:
+        node_idx, ff = unpack_result_block(result.packed, n_nodes)
+        telemetry.transfer("fetch", result.packed.nbytes)
+        return node_idx, ff, True
+    node_idx = np.asarray(result.node_idx)
+    telemetry.transfer("fetch", node_idx.nbytes)
+    telemetry.event("packed_fallback", batchId=batch_id, pods=pods,
+                    **event_extra)
+    return node_idx, None, False
+
+
+class CommitWorker:
+    """Single background thread landing in-flight batches strictly in
+    submission order — batch K's host commit overlaps batch K+1's device
+    execution. The commit callable owns ALL failure handling (the
+    scheduler's ring-poison path never raises through it); a worker-level
+    surprise is stashed and re-raised at the next flush so drains can't
+    silently lose batches."""
+
+    def __init__(self, commit_fn: Callable[[object], None],
+                 name: str = "ktpu-commit"):
+        self._commit_fn = commit_fn
+        self._name = name
+        self._cv = threading.Condition(locktrace.make_lock("CommitWorker"))
+        self._pending: deque = deque()
+        self._busy = False
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        self._surprise: Optional[BaseException] = None
+        self.committed = 0
+
+    # ----------------------------------------------------------- interface
+
+    def submit(self, item) -> None:
+        with self._cv:
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True)
+                self._thread.start()
+            self._pending.append(item)
+            self._cv.notify_all()
+
+    def flush(self) -> None:
+        """Block until every submitted batch has committed (the drain
+        paths' synchronization point)."""
+        with self._cv:
+            while self._pending or self._busy:
+                self._cv.wait()
+            surprise, self._surprise = self._surprise, None
+        if surprise is not None:
+            raise surprise
+
+    def steal_pending(self) -> list:
+        """Snatch the not-yet-started backlog (the ring-poison path fails
+        them without running their commits — the batches were computed on a
+        dead device)."""
+        with self._cv:
+            out = list(self._pending)
+            self._pending.clear()
+            self._cv.notify_all()
+            return out
+
+    def depth(self) -> int:
+        with self._cv:
+            return len(self._pending) + (1 if self._busy else 0)
+
+    def wait_below(self, n: int) -> None:
+        """Backpressure: block until fewer than ``n`` batches are pending
+        or running (the bounded-backlog guarantee — a commit-bound pipeline
+        stalls the dispatcher here instead of growing an unbounded queue)."""
+        with self._cv:
+            while len(self._pending) + (1 if self._busy else 0) >= n:
+                self._cv.wait()
+
+    def idle(self) -> bool:
+        with self._cv:
+            return not self._pending and not self._busy
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+
+    # ----------------------------------------------------------- internals
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending and not self._stopped:
+                    self._cv.wait()
+                if self._stopped and not self._pending:
+                    self._cv.notify_all()
+                    return
+                item = self._pending.popleft()
+                self._busy = True
+            try:
+                self._commit_fn(item)
+            except BaseException as exc:  # noqa: BLE001 — commit_fn contract is no-raise; stash for flush
+                with self._cv:
+                    self._surprise = exc
+            finally:
+                with self._cv:
+                    self._busy = False
+                    self.committed += 1
+                    self._cv.notify_all()

@@ -109,6 +109,17 @@ class DeviceState:
         # static shapes, same policy as Capacities).
         self.attr_slots: Dict[str, int] = {}   # attribute key -> column
         self.attr_val_ids: Dict[str, int] = {} # string value vocab (ids from 1)
+        # refcounted release for the attribute-value vocab (the label/taint
+        # vocab treatment from the elastic PR, ROADMAP item 5 follow-up):
+        # per-value publishing-node counts; an id freed at refcount zero
+        # joins the free-list and is recycled before the counter grows, so
+        # node churn with fresh attribute values cannot grow the vocab
+        # monotonically. Selector operands interned without a publishing
+        # node stay pinned (bounded by distinct configured operand values).
+        self._attr_val_refs: Dict[str, int] = {}
+        self._attr_val_free: List[int] = []
+        self._attr_val_next = 1
+        self._node_attr_values: Dict[str, frozenset] = {}
         self._attr_cols = 8
         self._attr_kind_m = np.zeros((caps.nodes, self._attr_cols), np.int32)
         self._attr_val_m = np.zeros((caps.nodes, self._attr_cols), np.int32)
@@ -185,12 +196,49 @@ class DeviceState:
 
     def attr_value_id(self, value: str) -> int:
         """Interned id for a string attribute value (shared by node rows and
-        selector operands — string equality becomes id equality)."""
+        selector operands — string equality becomes id equality). Freed ids
+        (refcount-zero releases) are recycled before the counter grows."""
         vid = self.attr_val_ids.get(value)
         if vid is None:
-            vid = len(self.attr_val_ids) + 1
+            if self._attr_val_free:
+                vid = self._attr_val_free.pop()
+            else:
+                vid = self._attr_val_next
+                self._attr_val_next += 1
             self.attr_val_ids[value] = vid
         return vid
+
+    def _retain_attr_values(self, name: str, attrs: dict) -> None:
+        """Refcount the STRING attribute values ``name`` publishes; a value
+        no node publishes anymore frees its vocab id to the free-list.
+        Rows re-encode per sync and selector rows rebuild per batch, so a
+        recycled id can never be read through a stale compiled artifact."""
+        from ..api import dra as dra_api
+
+        new = set()
+        for raw in attrs.values():
+            kind, val = dra_api.attr_kind_val(raw)
+            if kind == dra_api.KIND_STR:
+                new.add(val)
+        new = frozenset(new)
+        old = self._node_attr_values.get(name, frozenset())
+        if new == old:
+            return
+        for v in new - old:
+            self._attr_val_refs[v] = self._attr_val_refs.get(v, 0) + 1
+        for v in old - new:
+            left = self._attr_val_refs.get(v, 0) - 1
+            if left > 0:
+                self._attr_val_refs[v] = left
+                continue
+            self._attr_val_refs.pop(v, None)
+            vid = self.attr_val_ids.pop(v, None)
+            if vid is not None:
+                self._attr_val_free.append(vid)
+        if new:
+            self._node_attr_values[name] = new
+        else:
+            self._node_attr_values.pop(name, None)
 
     def _track_attrs(self, name: str, ni: Optional[NodeInfo], slot: int,
                      pending: Dict[int, dict]) -> None:
@@ -202,6 +250,10 @@ class DeviceState:
                  if node is not None else {})
         if self._node_attrs.get(name, {}) == attrs:
             return
+        # refcounted value retention BEFORE the row encodes: a value whose
+        # last publisher just left frees its id here, so the encode below
+        # can already recycle it for this sync's newcomers
+        self._retain_attr_values(name, attrs)
         if attrs:
             self._node_attrs[name] = attrs
         else:

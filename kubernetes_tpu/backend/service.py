@@ -727,19 +727,13 @@ class DeviceService:
                               else "fallback"):
                 # THE blocking read: the packed result block lands node_idx
                 # AND first_fail in one materialization (the per-array reads
-                # were one relay round-trip each on the TPU tunnel)
-                if result.packed is not None:
-                    from .batch import unpack_result_block
+                # were one relay round-trip each on the TPU tunnel) — the
+                # same commit-plane materializer the in-process commit runs
+                from .commit_plane import materialize_result
 
-                    node_idx, ff = unpack_result_block(
-                        result.packed, self.device.caps.nodes)
-                    telemetry.transfer("fetch", result.packed.nbytes)
-                else:
-                    node_idx = np.asarray(result.node_idx)
-                    ff = None
-                    telemetry.transfer("fetch", node_idx.nbytes)
-                    telemetry.event("packed_fallback", batchId=batch_id,
-                                    client=cid, pods=len(pods))
+                node_idx, ff, _ = materialize_result(
+                    result, self.device.caps.nodes,
+                    batch_id=batch_id, pods=len(pods), client=cid)
                 self.device.adopt_device(result)
                 self.device.adopt_commits(result, host_pb, node_idx)
             slot_names = self.device.slot_to_name()
@@ -1349,8 +1343,8 @@ class WireScheduler(Scheduler):
         self._pushed_nodes.clear()
         self._sent_ns.clear()
 
-    def _periodic_housekeeping(self) -> None:
-        super()._periodic_housekeeping()
+    def _periodic_housekeeping(self, now: Optional[float] = None) -> None:
+        super()._periodic_housekeeping(now)
         if not getattr(self.client, "supports_sessions", False):
             return
         if self.breaker.state == OPEN:
@@ -1428,7 +1422,7 @@ class WireScheduler(Scheduler):
             fwk = self.framework_for_pod(pod)
             quota_st = quota_precheck_status(fwk, pod)
             if quota_st is not None:
-                self.metrics["schedule_attempts"] += 1
+                self.metrics.inc("schedule_attempts")
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
                 self._handle_scheduling_failure(
@@ -1438,7 +1432,7 @@ class WireScheduler(Scheduler):
                 continue
             gang_st = gang_precheck_status(fwk, pod)
             if gang_st is not None:
-                self.metrics["schedule_attempts"] += 1
+                self.metrics.inc("schedule_attempts")
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
                 self._handle_scheduling_failure(
@@ -1581,8 +1575,8 @@ class WireScheduler(Scheduler):
                         error=f"{type(exc).__name__}: {exc}"[:200])
         for qp in batch:
             fwk = self.framework_for_pod(qp.pod)
-            self.metrics["schedule_attempts"] += 1
-            self.metrics["errors"] += 1
+            self.metrics.inc("schedule_attempts")
+            self.metrics.inc("errors")
             self.smetrics.observe_attempt(
                 "error", fwk.profile_name, self.now_fn() - t0)
             self._handle_scheduling_failure(
@@ -1611,8 +1605,19 @@ class WireScheduler(Scheduler):
 
     def _process_wire_results(self, batch: List[QueuedPodInfo], res: dict,
                               pod_cycle: int, t0: float) -> None:
-        from ..framework.plugins.coscheduling import pod_group_key
+        # the whole wire commit (binds + requeues) coalesces its queue
+        # moves, and the winners land through the batched commit engine —
+        # the same commit data plane the in-process path runs
+        with self.queue.coalesce_moves():
+            self._process_wire_results_coalesced(batch, res, pod_cycle, t0)
 
+    def _process_wire_results_coalesced(self, batch: List[QueuedPodInfo],
+                                        res: dict, pod_cycle: int,
+                                        t0: float) -> None:
+        from ..framework.plugins.coscheduling import pod_group_key
+        from .commit_plane import BindItem
+
+        bind_items: List[BindItem] = []
         # hint-screen scaffolding, shared by every failed pod in the batch
         hint_names = hint_slot_of = None
         # gang all-or-nothing: a gang with any unplaced member is rejected
@@ -1634,7 +1639,7 @@ class WireScheduler(Scheduler):
                     plugin.reject_gang(gkey, "incomplete")
         for i, (qp, r) in enumerate(zip(batch, res["results"])):
             fwk = self.framework_for_pod(qp.pod)
-            self.metrics["schedule_attempts"] += 1
+            self.metrics.inc("schedule_attempts")
             node_name = r.get("nodeName")
             if r.get("conflict") and i not in gang_rejected:
                 # another replica owns the pod (or won the capacity): the
@@ -1645,7 +1650,7 @@ class WireScheduler(Scheduler):
                 telemetry.event("conflict", client=self.client_id,
                                 pod=qp.pod.key(),
                                 reason=(r.get("error") or "raced")[:200])
-                self.metrics["errors"] += 1
+                self.metrics.inc("errors")
                 self.smetrics.observe_attempt(
                     "error", fwk.profile_name, self.now_fn() - t0)
                 self._handle_scheduling_failure(
@@ -1675,7 +1680,7 @@ class WireScheduler(Scheduler):
                     # longer knows (a desync window the resync protocol
                     # hasn't closed yet) — error-requeue the pod instead of
                     # binding it to a nonexistent node
-                    self.metrics["errors"] += 1
+                    self.metrics.inc("errors")
                     self.smetrics.observe_attempt(
                         "error", fwk.profile_name, self.now_fn() - t0)
                     self._handle_scheduling_failure(
@@ -1696,8 +1701,7 @@ class WireScheduler(Scheduler):
                         self.cache.update_snapshot(self.snapshot)
                         self.schedule_one_pod(qp, pod_cycle)
                         continue
-                self.assume_and_bind(fwk, state, qp, qp.pod,
-                                     node_name, pod_cycle, t0=t0)
+                bind_items.append(BindItem(fwk, qp, qp.pod, node_name, state))
             else:
                 d = Diagnosis()
                 for name, plugin in (r.get("statuses") or {}).items():
@@ -1732,6 +1736,13 @@ class WireScheduler(Scheduler):
                     d, pod_cycle)
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
+        if bind_items:
+            self.commit_plane.commit_bindings(bind_items, pod_cycle, t0)
+            for item in bind_items:
+                if item.outcome == "failed":
+                    # host rejected what the device adopted: re-send the
+                    # node's truth on the next push
+                    self._invalidate_node(item.node_name)
 
     def run_until_settled(self, max_cycles: int = 100000, flush: bool = True,
                           idle_wait: float = 0.005, max_no_progress: int = 200) -> int:

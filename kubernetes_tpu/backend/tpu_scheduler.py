@@ -266,6 +266,11 @@ class _Inflight:
     # (node removed / tombstone reused) gets a typed rejection at commit
     # instead of a ghost placement (None = guard by cache existence only)
     reclaim_gen: Optional[int] = None
+    # the DeviceState instance this batch was computed on: a commit (worker
+    # or inline) finding a DIFFERENT live device poisons the batch instead
+    # of committing foreign-device results against a rebuilt mirror — the
+    # race-free form of "clear the whole ring on device death"
+    device: object = None
 
 
 def _default_full_batch() -> bool:
@@ -399,6 +404,48 @@ class TPUScheduler(Scheduler):
                 "KTPU_PIPELINE_DEPTH", "2")))
         self._inflight: Deque[_Inflight] = deque()
         self.pipelined_batches = 0
+        # ---- commit data plane (backend/commit_plane.py) ----
+        # The commit WORKER lands ring-overflow batches on its own thread,
+        # overlapping batch K's host commit with batch K+1's encode/
+        # dispatch/device execution. The device mutex (owned by the commit
+        # plane so the per-class static lock pass analyzes the classes that
+        # own state, while KTPU_LOCKTRACE traces the protocol end to end)
+        # serializes the two owners' device-touching phases: the scheduling
+        # thread's sync/encode/dispatch vs the worker's adopt/judge/
+        # reconcile. PLATFORM-AWARE default (the _default_full_batch rule):
+        # on an accelerator the device executes off-host and the worker's
+        # overlap is free; on the CPU fallback "device compute" is host CPU
+        # time, so a second thread only contends with XLA (measured ~18%
+        # slower on the 2-core bench box) — commits stay inline there.
+        # KTPU_COMMIT_WORKER=1/0 overrides either way.
+        self.commit_worker = None
+        worker_env = os.environ.get("KTPU_COMMIT_WORKER", "")
+        if worker_env in ("0", "1"):
+            want_worker = worker_env == "1"
+        else:
+            try:
+                want_worker = jax.default_backend() != "cpu"
+            except Exception:  # noqa: BLE001 — no backend: stay inline
+                want_worker = False
+        if self.pipeline_depth and want_worker:
+            from .commit_plane import CommitWorker
+
+            self.commit_worker = CommitWorker(self._commit_inflight)
+        # worker-owned snapshot for commit-side reconciles: the scheduling
+        # thread keeps self.snapshot; sharing one Snapshot object across
+        # threads would let reconcile iterate node_info_map mid-update
+        from ..cache import Snapshot
+
+        self._commit_snapshot = Snapshot()
+        # carry gate for the async pipeline: the pipelined encode rides the
+        # device carry only while (a) no EXTERNAL node-truth change arrived
+        # since the last full sync (Scheduler.external_change_seq) and (b)
+        # no host-rejected commit invalidated a device row (_chain_dirty).
+        # The has_dirty cache walk the synchronous pipeline uses cannot
+        # distinguish the worker's own in-progress commits from external
+        # changes, so the worker mode gates on events instead.
+        self._chain_ext_seq = -1
+        self._chain_dirty = False
         # volume-bindability pre-pass (ops/volume_mask.py): lets PVC-bearing
         # pods ride the batched path with a [P, N] static screen + exact
         # host verify of the chosen node at commit (VERDICT r4 item 4)
@@ -445,18 +492,31 @@ class TPUScheduler(Scheduler):
             self._slot_reuses_seen = reuses
 
     def _ensure_device(self) -> None:
+        """Build or grow the device mirror. Always called on the scheduling
+        thread; drains (commit-worker flush included) happen OUTSIDE the
+        device mutex — the worker needs the mutex to finish its commits —
+        and the rebuild+sync run under it."""
         n = max(self.cache.node_count(), 1)
-        if self.device is None:
-            self.device = DeviceState(caps_for_cluster(n, batch=self.batch_size),
-                                      ns_labels_fn=self.store.ns_labels)
-            self.device.sync(self.snapshot)
-        elif self.device.caps.nodes < n:
-            # preserve every previously-grown axis; only widen the node axis
-            # (and the hostname value vocab that must cover it)
-            self._drain_inflight()  # old-device results must commit first
-            if self.device is None:  # the drain's commit killed the device
-                self._ensure_device()
-                return
+        with self.commit_plane.device_mutex:
+            device = self.device
+            needs_grow = device is not None and device.caps.nodes < n
+        if device is None:
+            with self.commit_plane.device_mutex:
+                if self.device is None:
+                    self.device = DeviceState(
+                        caps_for_cluster(n, batch=self.batch_size),
+                        ns_labels_fn=self.store.ns_labels)
+                    self.device.sync(self.snapshot)
+            return
+        if not needs_grow:
+            return
+        # preserve every previously-grown axis; only widen the node axis
+        # (and the hostname value vocab that must cover it)
+        self._drain_inflight()  # old-device results must commit first
+        if self.device is None:  # the drain's commit killed the device
+            self._ensure_device()
+            return
+        with self.commit_plane.device_mutex:
             caps = self.device.caps
             nodes = caps.nodes
             while nodes < n:
@@ -495,12 +555,13 @@ class TPUScheduler(Scheduler):
     }
 
     def _resync_grown(self, err: CapacityError) -> None:
-        """Grow exactly the offending capacity axis and rebuild the mirror."""
+        """Grow exactly the offending capacity axis and rebuild the mirror.
+        Callers raise CapacityError OUTSIDE the device mutex (the drain
+        below must let the commit worker take it)."""
         self._drain_inflight()
         if self.device is None:  # the drain's commit killed the device
             self._ensure_device()
             return
-        caps = self.device.caps
         fields = self._GROW_FIELDS.get(err.dimension)
         if fields is None and err.dimension.startswith("value vocab"):
             fields = ("value_words",)
@@ -508,15 +569,17 @@ class TPUScheduler(Scheduler):
             # typed per backend/errors.py: deterministic, never retried
             raise PermanentDeviceError(
                 f"unknown capacity dimension {err.dimension!r}") from err
-        updates = {}
-        for f in fields:
-            v = getattr(caps, f)
-            while v < err.needed:
-                v *= 2
-            updates[f] = v
-        self.device = DeviceState(dataclasses.replace(caps, **updates),
-                                  ns_labels_fn=self.store.ns_labels)
-        self.device.sync(self.snapshot)
+        with self.commit_plane.device_mutex:
+            caps = self.device.caps
+            updates = {}
+            for f in fields:
+                v = getattr(caps, f)
+                while v < err.needed:
+                    v *= 2
+                updates[f] = v
+            self.device = DeviceState(dataclasses.replace(caps, **updates),
+                                      ns_labels_fn=self.store.ns_labels)
+            self.device.sync(self.snapshot)
 
     # ------------------------------------------------------------- batch support
 
@@ -611,8 +674,11 @@ class TPUScheduler(Scheduler):
             # the batched loop must pump the shared-informer bus exactly like
             # schedule_one does — without this the cmd-binary topology
             # (setup() wires a SharedInformerFactory) never delivers pod/node
-            # events to the batched frontends and the queue stays empty
-            self.informer_factory.pump()
+            # events to the batched frontends and the queue stays empty.
+            # Coalesced: a pump delivering a whole commit's worth of bind
+            # confirmations fires ONE queue-move scan, not one per pod.
+            with self.queue.coalesce_moves():
+                self.informer_factory.pump()
         self._periodic_housekeeping()
         qps = self.queue.pop_batch(self.sizer.target())
         if not qps:
@@ -656,7 +722,7 @@ class TPUScheduler(Scheduler):
             # where usage grew between enqueue and pop.
             quota_st = quota_precheck_status(fwk, pod)
             if quota_st is not None:
-                self.metrics["schedule_attempts"] += 1
+                self.metrics.inc("schedule_attempts")
                 self._fail(fwk, qp, quota_st, pod_cycle,
                            Diagnosis(unschedulable_plugins={"QuotaAdmission"}))
                 self.smetrics.observe_attempt(
@@ -668,7 +734,7 @@ class TPUScheduler(Scheduler):
             # here without spending a device slot
             gang_st = gang_precheck_status(fwk, pod)
             if gang_st is not None:
-                self.metrics["schedule_attempts"] += 1
+                self.metrics.inc("schedule_attempts")
                 self._fail(fwk, qp, gang_st, pod_cycle,
                            Diagnosis(unschedulable_plugins={"Coscheduling"}))
                 self.smetrics.observe_attempt(
@@ -696,6 +762,22 @@ class TPUScheduler(Scheduler):
             self._schedule_fallback(qp, pod_cycle)
         self._flush_batch(buffer, pod_cycle, t_pop)
         return len(qps)
+
+    def _periodic_housekeeping(self, now: Optional[float] = None) -> None:
+        """The 1s sweep (assume expiry, permit timeouts) mutates waiting-pod
+        and plugin ledger state the commit worker's Reserve/Permit phases
+        also touch: land the in-flight commits first so the sweep judges
+        settled state instead of racing a half-committed batch. ONE clock
+        read feeds both this gate and the base sweep — two reads straddling
+        the tick boundary would skip the flush yet still run the sweep,
+        iterating waiting_pods while the worker parks into it."""
+        if now is None:
+            now = self.now_fn()
+        if (self.commit_worker is not None
+                and now - self._last_cleanup >= 1.0
+                and not self.commit_worker.idle()):
+            self.commit_worker.flush()
+        super()._periodic_housekeeping(now)
 
     def _maybe_profile(self) -> None:
         """Start/stop a JAX profiler capture window over the first N batch
@@ -735,8 +817,11 @@ class TPUScheduler(Scheduler):
         self._maybe_profile()
         t0 = self.now_fn()
         t_pop = t_pop if t_pop is not None else t0
+        mutex = self.commit_plane.device_mutex
         with tracing.span("device.encode.pipelined", batch=len(batched)):
-            enc = self._try_pipelined_encode(batched)
+            with mutex:
+                enc = self._try_pipelined_encode(batched)
+                device = self.device  # instance the encode ran against
         extra_mask = None
         dra_mask = None
         if enc is not None:
@@ -747,33 +832,45 @@ class TPUScheduler(Scheduler):
             # own); only sync+encode below belong to THIS batch's spans
             self._drain_inflight()
             self._ensure_device()  # the drain's commit may have killed it
+            # carry-gate baseline: capture BEFORE the snapshot update — an
+            # external event racing in after this reads as a changed seq on
+            # the next pipelined probe (conservative break, never a miss)
+            ext_seq = self.external_change_seq()
             self.cache.update_snapshot(self.snapshot)
             for _attempt in range(8):
                 try:
-                    with tracing.span("device.sync"):
-                        self.device.sync(self.snapshot)
-                    self._sync_slot_reuse_metric()
-                    t_sync = self.now_fn()
-                    pods = [qp.pod for qp in batched]
-                    bucket = self.sizer.bucket_for(len(pods))
-                    from ..ops.tiebreak import seeds_for
+                    with mutex:
+                        with tracing.span("device.sync"):
+                            self.device.sync(self.snapshot)
+                        self._sync_slot_reuse_metric()
+                        t_sync = self.now_fn()
+                        pods = [qp.pod for qp in batched]
+                        bucket = self.sizer.bucket_for(len(pods))
+                        from ..ops.tiebreak import seeds_for
 
-                    with tracing.span("device.encode", batch=len(batched)):
-                        pb, et = self.device.encoder.encode_pods(
-                            pods, capacity=bucket, tie_seeds=seeds_for(batched))
-                        tb = self.device.sig_table.encode_topo(pods, capacity=bucket)
-                        extra_mask = self._volume_masks.build(
-                            batched, self.snapshot, self.device.encoder,
-                            self.device.caps.nodes, bucket)
-                        dra_mask = self._claim_masks.build(
-                            batched, self.device, bucket)
+                        with tracing.span("device.encode", batch=len(batched)):
+                            pb, et = self.device.encoder.encode_pods(
+                                pods, capacity=bucket,
+                                tie_seeds=seeds_for(batched))
+                            tb = self.device.sig_table.encode_topo(
+                                pods, capacity=bucket)
+                            extra_mask = self._volume_masks.build(
+                                batched, self.snapshot, self.device.encoder,
+                                self.device.caps.nodes, bucket)
+                            dra_mask = self._claim_masks.build(
+                                batched, self.device, bucket)
+                        device = self.device
                     break
                 except CapacityError as e:
+                    # outside the mutex: the grow path drains, and the
+                    # commit worker needs the mutex to finish its commits
                     self._resync_grown(e)
             else:
                 for qp in batched:  # capacities refuse to converge
                     self._schedule_fallback(qp, pod_cycle)
                 return
+            self._chain_ext_seq = ext_seq
+            self._chain_dirty = False
         t_enc = self.now_fn()
         self.batch_counter += 1
         from . import telemetry
@@ -784,7 +881,6 @@ class TPUScheduler(Scheduler):
         # traced into the program (an eager PRNGKey costs two relay
         # round-trips per batch once the session has synchronized)
         key = np.int32(self.batch_counter)
-        host_pb = self.device.encoder.last_host_pb
         prev = self._inflight[-1] if self._inflight else None
         # cross-batch topology carry: batch k+1 starts from the NEWEST
         # in-flight batch's evolved sel_counts/seg_exist instead of the
@@ -822,44 +918,60 @@ class TPUScheduler(Scheduler):
         else:
             sample_k = None
             sample_start = None
-        mode_info = self._topo_mode_info()
-        topo_mode, vd_bucket, host_key = mode_info
-        telemetry.event("encode", batchId=batch_id, bucket=bucket,
-                        pods=len(batched), pipelined=enc is not None)
-        with tracing.span("device.dispatch", topo=topo_mode):
-            result = self._run_batch_fn(
-                pb, et, self.device.nt, self.device.tc, tb, key,
-                adopt=True,
-                topo_enabled=self.device.topo_enabled,
-                topo_carry=carry,
-                sample_k=sample_k,
-                sample_start=sample_start,
-                topo_mode=topo_mode,
-                vd_override=vd_bucket,
-                host_key=host_key,
-                ports_enabled=self.device.encoder.last_has_ports,
-                extra_mask=extra_mask,
-                dra_mask=dra_mask,
-            )
-        if result.final_sample_start is not None:
-            # keep the rotation index across unsampled batches too (the
-            # reference's nextStartNodeIndex persists across attempts) —
-            # only sampled batches advance it
-            self._start_carry = result.final_sample_start
-        t_dispatch = self.now_fn()
-        try:
-            # stage the one host-read the moment the batch is dispatched:
-            # the device→host copy of the packed result block rides along
-            # with the execution (and the ring's later batches) instead of
-            # paying its own round-trip inside commit_wait
-            (result.packed if result.packed is not None
-             else result.node_idx).copy_to_host_async()
-        except Exception:  # noqa: BLE001 — optional fast path only
-            pass
-        self._inflight.append(_Inflight(batched, result, pod_cycle, t_pop,
-                                        host_pb, pb, mode_info,
-                                        batch_id, bucket,
-                                        self.device.encoder.reclaim_gen))
+        with mutex:
+            if self.device is not device:
+                # a worker-side poison killed (or a rebuild replaced) the
+                # device between encode and dispatch: the encoded batch
+                # references dead arrays — requeue it via backoffQ exactly
+                # like a poisoned in-flight batch, never dispatch it
+                with self.queue.coalesce_moves():
+                    for qp in batched:
+                        fwk = self.framework_for_pod(qp.pod)
+                        self._fail(fwk, qp, Status.error(
+                            "device replaced while batch encoding"),
+                            pod_cycle)
+                return
+            host_pb = device.encoder.last_host_pb
+            mode_info = self._topo_mode_info()
+            topo_mode, vd_bucket, host_key = mode_info
+            telemetry.event("encode", batchId=batch_id, bucket=bucket,
+                            pods=len(batched), pipelined=enc is not None)
+            with tracing.span("device.dispatch", topo=topo_mode):
+                result = self._run_batch_fn(
+                    pb, et, device.nt, device.tc, tb, key,
+                    adopt=True,
+                    topo_enabled=device.topo_enabled,
+                    topo_carry=carry,
+                    sample_k=sample_k,
+                    sample_start=sample_start,
+                    topo_mode=topo_mode,
+                    vd_override=vd_bucket,
+                    host_key=host_key,
+                    ports_enabled=device.encoder.last_has_ports,
+                    extra_mask=extra_mask,
+                    dra_mask=dra_mask,
+                )
+            if result.final_sample_start is not None:
+                # keep the rotation index across unsampled batches too (the
+                # reference's nextStartNodeIndex persists across attempts) —
+                # only sampled batches advance it
+                self._start_carry = result.final_sample_start
+            t_dispatch = self.now_fn()
+            try:
+                # stage the one host-read the moment the batch is
+                # dispatched: the device→host copy of the packed result
+                # block rides along with the execution (and the ring's
+                # later batches) instead of paying its own round-trip
+                # inside commit_wait
+                (result.packed if result.packed is not None
+                 else result.node_idx).copy_to_host_async()
+            except Exception:  # noqa: BLE001 — optional fast path only
+                pass
+            self._inflight.append(_Inflight(batched, result, pod_cycle,
+                                            t_pop, host_pb, pb, mode_info,
+                                            batch_id, bucket,
+                                            device.encoder.reclaim_gen,
+                                            device))
         telemetry.event("dispatch", batchId=batch_id, bucket=bucket,
                         pods=len(batched), topo=topo_mode,
                         packed=result.packed is not None,
@@ -867,12 +979,27 @@ class TPUScheduler(Scheduler):
         self.smetrics.pipeline_inflight.set(value=len(self._inflight))
         # land the oldest batches beyond the ring depth: their host commits
         # overlap the device execution of everything dispatched after them
-        # (depth 0 = synchronous: the batch just dispatched commits now)
+        # (depth 0 = synchronous: the batch just dispatched commits now).
+        # With the commit worker the handoff is a queue push — batch K's
+        # commit runs on the worker thread while this thread pops/encodes/
+        # dispatches K+1. The backpressure wait (bounded worker backlog)
+        # carries its own span so bench attribution can't mistake a
+        # commit-bound pipeline for free overlap.
         while len(self._inflight) > self.pipeline_depth:
             fl = self._inflight.popleft()
             if self.pipeline_depth:
                 self.pipelined_batches += 1
-            self._commit_inflight(fl)
+            if self.commit_worker is not None:
+                backlog = max(1, self.pipeline_depth)
+                if self.commit_worker.depth() >= backlog:
+                    with tracing.span("device.commit.backpressure"):
+                        t_bp = self.now_fn()
+                        self.commit_worker.wait_below(backlog)
+                        self.smetrics.device_batch_duration.observe(
+                            self.now_fn() - t_bp, "commit_backpressure")
+                self.commit_worker.submit(fl)
+            else:
+                self._commit_inflight(fl)
         dur = self.smetrics.device_batch_duration
         dur.observe(t_sync - t0, "upload")
         dur.observe(t_enc - t_sync, "encode")
@@ -887,12 +1014,28 @@ class TPUScheduler(Scheduler):
         touched the cluster since the in-flight dispatch and (b) encoding
         registers no new signature/term (a fresh row is backfilled from host
         counts that cannot see the in-flight commits). Returns (pb, et, tb)
-        or None to take the drain+sync path."""
+        or None to take the drain+sync path. Caller holds the device mutex."""
         if not self.pipeline_depth or not self._inflight or self.device is None:
             return None
-        self.cache.update_snapshot(self.snapshot)
-        if self.device.has_dirty(self.snapshot):
-            return None  # external change breaks the device-carry chain
+        if self.commit_worker is not None:
+            # async-commit mode: the worker's own in-progress commits dirty
+            # the cache, so the has_dirty walk below cannot tell them from
+            # external changes. Gate on the event-driven signals instead:
+            # any external node-truth event since the chain's last full
+            # sync, or a host-rejected commit (device row invalidated),
+            # breaks the chain — both strictly conservative.
+            if (self._chain_dirty
+                    or self.external_change_seq() != self._chain_ext_seq):
+                return None
+            if any(qp.pod.spec.volumes for qp in batched):
+                # the volume prescreen reads self.snapshot, which must not
+                # be refreshed while the worker's commit tail may be
+                # reading it — PVC batches take the drain+sync path
+                return None
+        else:
+            self.cache.update_snapshot(self.snapshot)
+            if self.device.has_dirty(self.snapshot):
+                return None  # external change breaks the device-carry chain
         st = self.device.sig_table
         vocab0 = (st.n_sigs, st.n_terms)
         try:
@@ -918,30 +1061,49 @@ class TPUScheduler(Scheduler):
         return pb, et, tb, extra_mask, dra_mask
 
     def _drain_inflight(self) -> None:
-        """Land every in-flight batch, oldest first (a device-death commit
-        failure poisons and clears the rest of the ring from inside
-        _commit_inflight, which ends this loop)."""
+        """Land every in-flight batch, oldest first. With the commit worker
+        this submits the remaining ring and BLOCKS on the worker's flush —
+        the one synchronization point the sync/fallback/settle paths rely
+        on. A device-death commit poisons the rest (worker backlog stolen
+        in one sweep; ring stragglers fail the device-instance check)."""
+        if self.commit_worker is not None:
+            while self._inflight:
+                self.commit_worker.submit(self._inflight.popleft())
+            self.commit_worker.flush()
+            return
         while self._inflight:
             self._commit_inflight(self._inflight.popleft())
 
     def _commit_inflight(self, fl: _Inflight) -> None:
-        """Land one dispatched batch on the host. Materializing the PACKED
+        """Land one dispatched batch on the host — on the scheduling thread
+        (synchronous mode) or the commit worker. Materializing the PACKED
         result block (node_idx + first_fail in one buffer, its device→host
         copy already staged at dispatch) is the ONE device sync of the batch
         cycle; everything else is async dispatch. A device failure at
         materialization (e.g. the TPU relay dropping mid-flight) fails the
         whole IN-FLIGHT RING back to the queue and rebuilds the device from
-        the host cache — crash-only, §5.3."""
+        the host cache — crash-only, §5.3. Batches reaching here after a
+        death (worker-ring stragglers) carry a stale device instance and
+        poison individually without committing."""
         from ..utils import tracing
 
         from . import telemetry
+        from .commit_plane import materialize_result
 
         t0 = self.now_fn()
         wait: Optional[float] = None
         packed_ok = fl.result.packed is not None
+        mutex = self.commit_plane.device_mutex
+        on_worker = self.commit_worker is not None
+        if fl.device is not None and fl.device is not self.device:
+            # computed on a device that has since died or been rebuilt:
+            # slot maps and adopted state no longer correspond — requeue
+            # without committing (the per-batch form of ring poison)
+            self._poison_batches((fl,), RuntimeError(
+                "device rebuilt while batch in flight"), count_breaker=False)
+            return
         try:
             from ..utils import relay
-            from .batch import unpack_result_block
 
             if self.relay_fault_fn is not None:
                 # scripted device fault (soak flap / chaos): surfaces at the
@@ -955,25 +1117,21 @@ class TPUScheduler(Scheduler):
             # mesh-sharded runs: packed=None falls back to per-array reads,
             # a materially different commit-wait shape
             with tracing.span("device.commit.wait", batch=len(fl.qps),
-                              packed="packed" if packed_ok else "fallback"):
+                              packed="packed" if packed_ok else "fallback",
+                              worker="commit" if on_worker else "inline"):
                 t_wait0 = self.now_fn()
-                if packed_ok:
-                    node_idx, ff = unpack_result_block(
-                        fl.result.packed, self.device.caps.nodes)
-                    telemetry.transfer("fetch", fl.result.packed.nbytes)
-                else:  # sharded-core results carry no packed block
-                    node_idx = np.asarray(fl.result.node_idx)
-                    ff = None
-                    telemetry.transfer("fetch", node_idx.nbytes)
-                    telemetry.event("packed_fallback", batchId=fl.batch_id,
-                                    bucket=fl.bucket, pods=len(fl.qps))
+                node_idx, ff, _ = materialize_result(
+                    fl.result, self.device.caps.nodes,
+                    batch_id=fl.batch_id, pods=len(fl.qps), bucket=fl.bucket)
                 wait = self.now_fn() - t_wait0
                 self.smetrics.device_batch_duration.observe(wait, "commit_wait")
                 # residual stall: the transfer was staged at dispatch, so any
                 # time spent here is the pipeline waiting on device execution
                 self.smetrics.pipeline_stall_seconds.inc(value=wait)
-            self.device.adopt_commits(fl.result, fl.host_pb, node_idx)
-            with tracing.span("host.commit", batch=len(fl.qps)):
+            with mutex:
+                self.device.adopt_commits(fl.result, fl.host_pb, node_idx)
+            with tracing.span("host.commit", batch=len(fl.qps),
+                              worker="commit" if on_worker else "inline"):
                 t_host0 = self.now_fn()
                 self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0,
                                    node_idx, pb=fl.pb, ff=ff,
@@ -991,39 +1149,41 @@ class TPUScheduler(Scheduler):
             # commit can thus survive in the carry for as long as the ring
             # holds already-dispatched batches (conservative direction:
             # nodes look MORE occupied), after which the break resyncs from
-            # host truth.
+            # host truth. The worker reconciles against its OWN snapshot
+            # (self.snapshot belongs to the scheduling thread) and reports
+            # rows left dirty through the chain gate instead.
             if self.device is not None:
-                with tracing.span("device.commit.reconcile", batch=len(fl.qps)):
+                with tracing.span("device.commit.reconcile",
+                                  batch=len(fl.qps),
+                                  worker="commit" if on_worker else "inline"):
                     t_rec0 = self.now_fn()
-                    self.cache.update_snapshot(self.snapshot)
-                    self.device.reconcile(self.snapshot)
+                    snap = (self._commit_snapshot if on_worker
+                            else self.snapshot)
+                    with mutex:
+                        self.cache.update_snapshot(snap)
+                        left = self.device.reconcile(snap)
+                    if left:
+                        self._chain_dirty = True
                     self.smetrics.device_batch_duration.observe(
                         self.now_fn() - t_rec0, "commit_reconcile")
         except Exception as exc:  # noqa: BLE001 — backend death must not kill us
             import logging
 
             logging.getLogger(__name__).exception("batch commit failed; requeueing")
-            self.device = None  # full rebuild + resync on next _ensure_device
+            # everything dispatched after fl was computed on the dead
+            # device; those futures are poison too. Worker mode: steal the
+            # worker backlog in one sweep — ring entries still owned by the
+            # scheduling thread fail the device-instance check when they
+            # arrive. Synchronous mode: clear the ring here (same thread).
+            with mutex:
+                self.device = None  # full rebuild on next _ensure_device
             self._start_carry = None  # dead-backend future
-            # relay breaker: count the death; past the threshold (or on a
-            # failed half-open probe) the batch path degrades to the oracle
-            # until the cheap-cadence probe heals it
-            self.relay_breaker.record_failure(exc)
-            # everything dispatched after fl was computed on the dead device;
-            # those futures are poison too — fail the WHOLE ring back
-            # alongside fl, oldest first (queue order preserved)
-            stale = list(self._inflight)
-            self._inflight.clear()
-            for batch in (fl, *stale):
-                telemetry.event("poison", batchId=batch.batch_id,
-                                bucket=batch.bucket, pods=len(batch.qps),
-                                error=f"{type(exc).__name__}: {exc}"[:200])
-                for qp in batch.qps:
-                    fwk = self.framework_for_pod(qp.pod)
-                    self._fail(fwk, qp, Status.error(f"device batch failed: {exc}"),
-                               batch.pod_cycle)
-                telemetry.event("requeue", batchId=batch.batch_id,
-                                pods=len(batch.qps))
+            if self.commit_worker is not None:
+                stale = self.commit_worker.steal_pending()
+            else:
+                stale = list(self._inflight)
+                self._inflight.clear()
+            self._poison_batches((fl, *stale), exc)
         else:
             self.relay_breaker.record_success()
             telemetry.event("commit", batchId=fl.batch_id, bucket=fl.bucket,
@@ -1042,6 +1202,32 @@ class TPUScheduler(Scheduler):
         self.sizer.update(bucket, self.now_fn() - fl.t0)
         if wait is not None:
             self.sizer.update_wait(bucket, wait)
+
+    def _poison_batches(self, batches, exc: BaseException,
+                        count_breaker: bool = True) -> None:
+        """Fail dispatched-but-uncommitted batches back to the queue
+        (poison + requeue flight events per batch, backoffQ re-entry per
+        pod) — the shared tail of ring poison and the stale-device check.
+        Requeue moves coalesce into one scan."""
+        from . import telemetry
+
+        if count_breaker:
+            # relay breaker: count the death; past the threshold (or on a
+            # failed half-open probe) the batch path degrades to the oracle
+            # until the cheap-cadence probe heals it
+            self.relay_breaker.record_failure(exc)
+        with self.queue.coalesce_moves():
+            for batch in batches:
+                telemetry.event("poison", batchId=batch.batch_id,
+                                bucket=batch.bucket, pods=len(batch.qps),
+                                error=f"{type(exc).__name__}: {exc}"[:200])
+                for qp in batch.qps:
+                    fwk = self.framework_for_pod(qp.pod)
+                    self._fail(fwk, qp,
+                               Status.error(f"device batch failed: {exc}"),
+                               batch.pod_cycle)
+                telemetry.event("requeue", batchId=batch.batch_id,
+                                pods=len(batch.qps))
 
     _VOLUME_FILTERS = frozenset((
         "VolumeRestrictions", "NodeVolumeLimits", "EBSLimits", "GCEPDLimits",
@@ -1066,9 +1252,14 @@ class TPUScheduler(Scheduler):
     # default bind-path plugins that tolerate absent PreFilter state (their
     # state is only written for volume-/claim-bearing pods, and those pods
     # run the host prefilter explicitly in _commit_batch; Coscheduling's
-    # Permit/Reserve recompute from the store and the waiting-pods map)
+    # Permit/Reserve recompute from the store and the waiting-pods map;
+    # QuotaAdmission's Reserve charge reads only the pod + its own ledger —
+    # its absence from this set silently put a FULL host PreFilter on every
+    # batch-committed pod after PR 8, the single largest slice of the
+    # r08-measured host.commit bottleneck)
     _DEFAULT_BIND_PATH_PLUGINS = frozenset(
-        ("VolumeBinding", "DynamicResources", "Coscheduling"))
+        ("VolumeBinding", "DynamicResources", "Coscheduling",
+         "QuotaAdmission"))
 
     @classmethod
     def _bind_path_needs_prefilter(cls, fwk) -> bool:
@@ -1125,6 +1316,20 @@ class TPUScheduler(Scheduler):
                       batch_id: str = "") -> None:
         if node_idx is None:
             node_idx = np.asarray(result.node_idx)
+        # the whole commit — winner binds AND loser requeues — runs inside
+        # one queue-move coalescing window: every POD_ADD/POD_DELETE wave
+        # the commit's store events fire collapses into one union scan
+        with self.queue.coalesce_moves():
+            self._commit_batch_coalesced(qps, result, pod_cycle, t0,
+                                         node_idx, pb, ff, reclaim_gen,
+                                         batch_id)
+
+    def _commit_batch_coalesced(self, qps: List[QueuedPodInfo],
+                                result: BatchResult, pod_cycle: int,
+                                t0: float, node_idx: np.ndarray,
+                                pb=None, ff: Optional[np.ndarray] = None,
+                                reclaim_gen: Optional[int] = None,
+                                batch_id: str = "") -> None:
         slot_names = self.device.slot_to_name()
         # ff (first_fail) normally arrives unpacked from the packed result
         # block — already on host, zero extra syncs; the lazy reads below
@@ -1213,15 +1418,18 @@ class TPUScheduler(Scheduler):
                     from ..ops.preempt import screen_prefix
                     from . import telemetry
 
-                    # a priority class first seen this cycle is still INT_MAX
-                    # on device (= never evictable) unless refreshed now
-                    self.device._refresh_class_prio()
-                    with telemetry.dispatch(
-                            "preempt_screen",
-                            bucket=str(getattr(pb, "capacity", "?"))):
-                        pres = screen_prefix(pb, self.device.nt,
-                                             result.static_masks,
-                                             node_idx[:len(qps)] < 0)
+                    with self.commit_plane.device_mutex:
+                        # a priority class first seen this cycle is still
+                        # INT_MAX on device (= never evictable) unless
+                        # refreshed now; the refresh replaces device.nt, so
+                        # it must not interleave with a dispatch's adopt
+                        self.device._refresh_class_prio()
+                        with telemetry.dispatch(
+                                "preempt_screen",
+                                bucket=str(getattr(pb, "capacity", "?"))):
+                            pres = screen_prefix(pb, self.device.nt,
+                                                 result.static_masks,
+                                                 node_idx[:len(qps)] < 0)
                     from ..utils import relay
 
                     relay.count_sync("preempt-read")
@@ -1234,10 +1442,13 @@ class TPUScheduler(Scheduler):
 
                     logging.getLogger(__name__).exception("preempt screen failed")
 
+        from .commit_plane import BindItem
+
+        bind_items: List[BindItem] = []
         for i, qp in enumerate(qps):
             pod = qp.pod
             fwk = self.framework_for_pod(pod)
-            self.metrics["schedule_attempts"] += 1
+            self.metrics.inc("schedule_attempts")
             idx = int(node_idx[i])
             if i in gang_rejected:
                 gkey = gang_rejected[i]
@@ -1248,7 +1459,7 @@ class TPUScheduler(Scheduler):
                     # next sync repair the device copy from host truth
                     node_name = slot_names.get(idx)
                     if node_name is not None:
-                        self.device._uploaded_gen.pop(node_name, None)
+                        self._invalidate_device_row(node_name)
                     diagnosis = Diagnosis(
                         unschedulable_plugins={"Coscheduling"})
                 else:
@@ -1275,10 +1486,10 @@ class TPUScheduler(Scheduler):
 
                 node_name = slot_names.get(idx)
                 if node_name is not None:
-                    self.device._uploaded_gen.pop(node_name, None)
+                    self._invalidate_device_row(node_name)
                 telemetry.event("slot_reclaim", batchId=batch_id,
                                 pod=pod.key(), slot=idx, reason=stale[i])
-                self.metrics["errors"] += 1
+                self.metrics.inc("errors")
                 self._fail(fwk, qp,
                            Status.error(f"stale placement: {stale[i]}"),
                            pod_cycle)
@@ -1309,7 +1520,7 @@ class TPUScheduler(Scheduler):
                         # not model. The exact sequential path owns the pod
                         # (it re-runs PreFilter and records the proper
                         # unschedulable/unresolvable condition).
-                        self.device._uploaded_gen.pop(node_name, None)
+                        self._invalidate_device_row(node_name)
                         self.cache.update_snapshot(self.snapshot)
                         self._schedule_fallback(qp, pod_cycle)
                         continue
@@ -1325,28 +1536,17 @@ class TPUScheduler(Scheduler):
                         # this pod. Re-batching could pick the same node
                         # (deterministic tie-break) — route to the EXACT
                         # sequential path instead, which terminates.
-                        self.device._uploaded_gen.pop(node_name, None)
+                        self._invalidate_device_row(node_name)
                         self.cache.update_snapshot(self.snapshot)
                         self._schedule_fallback(qp, pod_cycle)
                         continue
                 if (self.comparer_every_n
                         and self.batch_scheduled % self.comparer_every_n == 0):
                     self._compare_with_oracle(fwk, pod, node_name)
-                # t0 = batch pop time: the binding cycle observes the
-                # scheduled-attempt duration (pop → bind) exactly once.
-                before_sched = self.metrics["scheduled"]
-                before_wait = len(self.waiting_pods)
-                self.assume_and_bind(fwk, state, qp, pod, node_name, pod_cycle,
-                                     t0=t0)
-                if (self.metrics["scheduled"] == before_sched
-                        and len(self.waiting_pods) == before_wait):
-                    # host rejected what the device already adopted (assume/
-                    # reserve/bind failure): invalidate the row's uploaded
-                    # generation so the next sync re-encodes it from host
-                    # truth and the content diff repairs the device copy
-                    self.device._uploaded_gen.pop(node_name, None)
-                else:
-                    self.batch_scheduled += 1
+                # the batched bind tail (commit_plane.py) lands the whole
+                # batch's winners after the loop: one cache lock round
+                # trip, one store transaction, one group-commit WAL line
+                bind_items.append(BindItem(fwk, qp, pod, node_name, state))
             else:
                 if ff is None:
                     # one [P, N] int8 read covers diagnosis for the whole
@@ -1368,6 +1568,28 @@ class TPUScheduler(Scheduler):
                            diagnosis, state=state)
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
+        if bind_items:
+            stats = self.commit_plane.commit_bindings(bind_items, pod_cycle,
+                                                      t0)
+            # waiting (Permit-parked) pods hold their assume exactly like
+            # the per-pod path's WAIT outcome — they count as batch-landed
+            self.batch_scheduled += stats.bound + stats.waiting
+            for item in bind_items:
+                if item.outcome == "failed":
+                    # host rejected what the device already adopted (assume/
+                    # reserve/bind failure): invalidate the row's uploaded
+                    # generation so the next sync re-encodes it from host
+                    # truth and the content diff repairs the device copy
+                    self._invalidate_device_row(item.node_name)
+
+    def _invalidate_device_row(self, node_name: str) -> None:
+        """Drop a node row's uploaded generation (the next sync re-encodes
+        it from host truth) and break the pipelined carry chain — the
+        device adopted state the host is rejecting."""
+        with self.commit_plane.device_mutex:
+            if self.device is not None:
+                self.device._uploaded_gen.pop(node_name, None)
+        self._chain_dirty = True
 
     def _judge_gangs(self, qps: List[QueuedPodInfo], result: BatchResult,
                      node_idx: np.ndarray,

@@ -65,34 +65,46 @@ class Cache:
 
     # ------------------------------------------------------------- pods
 
+    # locked cores — ONE implementation each, shared by the per-pod verbs
+    # and apply_batch so the two paths can never drift
+
+    def _assume_locked(self, pod: Pod, node_name: str) -> None:
+        key = pod.key()
+        if key in self.pod_states:
+            raise KeyError(f"pod {key} already in cache")
+        pod.spec.node_name = node_name
+        self._add_pod_to_node(pod, node_name)
+        self.pod_states[key] = _PodState(pod=pod, assumed=True)
+        self._assumed.add(key)
+
+    def _finish_locked(self, pod: Pod) -> None:
+        ps = self.pod_states.get(pod.key())
+        if ps and ps.assumed:
+            ps.binding_finished = True
+            ps.deadline = self.now_fn() + self.ttl
+
+    def _forget_locked(self, pod: Pod) -> None:
+        ps = self.pod_states.pop(pod.key(), None)
+        self._assumed.discard(pod.key())
+        if ps is not None:
+            self._remove_pod_from_node(ps.pod, ps.pod.spec.node_name)
+
     def assume_pod(self, pod: Pod, node_name: str) -> None:
         """Optimistically commit ``pod`` to ``node_name``. Takes ownership of
         the passed object (callers pass a clone; its spec.node_name is set
         here so Reserve/Permit/Bind plugins see the assignment, matching the
         reference's assumedPod)."""
-        key = pod.key()
         with self._lock:
-            if key in self.pod_states:
-                raise KeyError(f"pod {key} already in cache")
-            pod.spec.node_name = node_name
-            self._add_pod_to_node(pod, node_name)
-            self.pod_states[key] = _PodState(pod=pod, assumed=True)
-            self._assumed.add(key)
+            self._assume_locked(pod, node_name)
 
     def finish_binding(self, pod: Pod) -> None:
         with self._lock:
-            ps = self.pod_states.get(pod.key())
-            if ps and ps.assumed:
-                ps.binding_finished = True
-                ps.deadline = self.now_fn() + self.ttl
+            self._finish_locked(pod)
 
     def forget_pod(self, pod: Pod) -> None:
         """Binding failed: roll the assumption back (cache.go:416)."""
         with self._lock:
-            ps = self.pod_states.pop(pod.key(), None)
-            self._assumed.discard(pod.key())
-            if ps is not None:
-                self._remove_pod_from_node(ps.pod, ps.pod.spec.node_name)
+            self._forget_locked(pod)
 
     def add_pod(self, pod: Pod) -> None:
         """Informer confirmation of a bound pod (cache.go:497)."""
@@ -128,6 +140,38 @@ class Cache:
             self._assumed.discard(pod.key())
             if ps is not None:
                 self._remove_pod_from_node(ps.pod, ps.pod.spec.node_name)
+
+    def apply_batch(self, ops) -> List[Optional[Exception]]:
+        """Batched pod-state transitions — the cache half of the commit data
+        plane: one lock round trip applies a whole scheduler batch's worth
+        of assume/finish/forget transitions (per-pod calls were 2+ lock
+        acquisitions per committed pod on the measured host.commit
+        bottleneck). ``ops`` is a sequence of tuples:
+
+            ("assume", pod, node_name)  — assume_pod semantics
+            ("finish", pod)             — finish_binding semantics
+            ("forget", pod)             — forget_pod semantics
+
+        Each op applies independently; a failing op (assume of an already-
+        cached key) records its exception and later ops still apply. Returns
+        per-op None-or-exception in input order — callers decide per pod,
+        exactly as with the per-pod calls."""
+        out: List[Optional[Exception]] = [None] * len(ops)
+        with self._lock:
+            for i, op in enumerate(ops):
+                verb = op[0]
+                if verb == "assume":
+                    try:
+                        self._assume_locked(op[1], op[2])
+                    except KeyError as err:
+                        out[i] = err
+                elif verb == "finish":
+                    self._finish_locked(op[1])
+                elif verb == "forget":
+                    self._forget_locked(op[1])
+                else:
+                    out[i] = ValueError(f"unknown cache batch op {verb!r}")
+        return out
 
     def is_assumed(self, pod_key: str) -> bool:
         with self._lock:

@@ -157,6 +157,31 @@ class DrainOrchestrator:
             self.queue.move_all_to_active_or_backoff_queue(qevents.EVICTION)
         return {"nodes": nodes, "evicted": len(evicted), "gangs": gangs}
 
+    def _pdb_disruption_gate(self):
+        """Per-wave PDB budget gate: ``fn(pod) -> bool`` consults every
+        matching PodDisruptionBudget's ``disruptionsAllowed`` (maintained
+        live by the disruption controller) and charges one disruption per
+        eviction this wave — so a wave can never take more pods from a
+        budget than the controller last allowed, even before its next
+        reconcile lands. Pods matching no PDB pass freely."""
+        spent: Dict[str, int] = {}
+
+        def allow(pod: Pod) -> bool:
+            matched = []
+            for pdb in self.store.pdbs.values():
+                if (pdb.meta.namespace == pod.meta.namespace
+                        and pdb.selector is not None
+                        and pdb.selector.matches(pod.meta.labels)):
+                    key = pdb.meta.key()
+                    if pdb.disruptions_allowed - spent.get(key, 0) <= 0:
+                        return False
+                    matched.append(key)
+            for key in matched:
+                spent[key] = spent.get(key, 0) + 1
+            return True
+
+        return allow
+
     # ------------------------------------------------------------- waves
 
     def drain_wave(self, node_names: Iterable[str],
@@ -198,6 +223,7 @@ class DrainOrchestrator:
         names = [n for n in node_names if n in self.store.nodes]
         now = self.now_fn()
         taken: List[Pod] = []
+        pdb_gate = self._pdb_disruption_gate()
         for name in names:
             node = self.store.nodes.get(name)
             taints = node.spec.taints
@@ -205,9 +231,17 @@ class DrainOrchestrator:
                 node = _with_taints(node, taints + (Taint(
                     key=TAINT_SPOT_RECLAIM, effect=TAINT_NO_EXECUTE),))
                 self.store.update_node(node)
+            # PDB-gated (the eviction API's budget check, carried from the
+            # elastic PR review): a pod whose PodDisruptionBudget has no
+            # disruptionsAllowed left is DEFERRED — the reclaim taint stays
+            # on the node, and the periodic taint-manager sweep takes the
+            # pod once the disruption controller's reconcile shows budget
+            # again. delete_nodes=True still force-evicts survivors below
+            # (a budget cannot keep a pod on hardware that no longer
+            # exists).
             taken.extend(evict_noexecute_pods(
                 self.store, node, now, since=now,
-                metrics=self.metrics, reason="spot"))
+                metrics=self.metrics, reason="spot", allow_fn=pdb_gate))
         if delete_nodes:
             # the capacity is GOING AWAY: survivors of the toleration pass
             # must not stay bound to a node about to vanish

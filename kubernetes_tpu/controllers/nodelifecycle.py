@@ -36,15 +36,19 @@ _PRESSURE_TAINTS = (
 
 def evict_noexecute_pods(store, node: Node, now: float,
                          since: Optional[float] = None,
-                         metrics=None, reason: str = "taint") -> List:
+                         metrics=None, reason: str = "taint",
+                         allow_fn=None) -> List:
     """The NoExecute taint manager (taint_manager.go), shared by node-health
     eviction and spot reclamation (controllers/drain.py): a pod on ``node``
     is evicted unless it tolerates EVERY NoExecute taint; a pod whose
     matching tolerations all carry finite tolerationSeconds goes once the
     minimum window elapses past ``since``; an unbounded matching toleration
-    keeps the pod forever. Returns the evicted Pod objects (callers that
-    drive rebind waves recreate them unbound; health eviction leaves the
-    rest to PodGC)."""
+    keeps the pod forever. ``allow_fn(pod)`` — when given — gates each
+    eviction (the PDB budget check of the eviction API): a refused pod
+    stays on the tainted node for a LATER sweep to take once the budget
+    recovers. Returns the evicted Pod objects (callers that drive rebind
+    waves recreate them unbound; health eviction leaves the rest to
+    PodGC)."""
     noexec = [t for t in node.spec.taints if t.effect == TAINT_NO_EXECUTE]
     if not noexec:
         return []
@@ -65,6 +69,8 @@ def evict_noexecute_pods(store, node: Node, now: float,
                 windows.append(min(finite))
         if tolerated and (not windows or since is None
                           or now - since <= min(windows)):
+            continue
+        if allow_fn is not None and not allow_fn(pod):
             continue
         store.delete_pod(pod.meta.key())
         evicted.append(pod)

@@ -202,7 +202,10 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PermitPlugin,
                 # preemption cannot fix a gang, so no dry-run fan-out.
                 return None, Status.unresolvable(
                     f'{ERR_REASON_GANG_BACKOFF} "{gkey}"')
-            del self._denied[gkey]
+            # pop, not del: the commit worker's fallback path and the
+            # scheduling thread's gang precheck can both observe the same
+            # expiry — the second remover must be a no-op, not a KeyError
+            self._denied.pop(gkey, None)
         pg = self._group(gkey)
         if pg is None:
             # the group object has not been created yet: unresolvable — the
@@ -288,11 +291,31 @@ class Coscheduling(QueueSortPlugin, PreFilterPlugin, PermitPlugin,
         gkey = pod_group_key(pod)
         if gkey is None:
             return
+        self._bump_bound(gkey, 1)
+        self._refresh_group_status(gkey)
+
+    def post_bind_batch(self, items) -> None:
+        """Commit-plane batched PostBind: a gang whose members bound in one
+        batch gets ONE bound-count bump and ONE PodGroup status write
+        instead of a store update per member (the per-member writes were a
+        per-pod store lock + journal event each on the host.commit path)."""
+        per_gang: Dict[str, int] = {}
+        for _state, pod, _node in items:
+            gkey = pod_group_key(pod)
+            if gkey is not None:
+                per_gang[gkey] = per_gang.get(gkey, 0) + 1
+        for gkey, n in per_gang.items():
+            self._bump_bound(gkey, n)
+            self._refresh_group_status(gkey)
+
+    def _bump_bound(self, gkey: str, n: int) -> None:
         if gkey in self._bound:
-            self._bound[gkey] += 1
+            self._bound[gkey] += n
         else:
-            # seed includes this pod: the store already reflects the bind
+            # seed includes these pods: the store already reflects the binds
             self._bound[gkey] = self._members_in_store(gkey, bound_only=True)
+
+    def _refresh_group_status(self, gkey: str) -> None:
         n = self._bound[gkey]
         pg = self._group(gkey)
         if pg is None:

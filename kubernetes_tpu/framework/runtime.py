@@ -14,6 +14,7 @@ Key behaviors mirrored:
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from time import perf_counter
 from typing import Dict, List, Optional, Set, Tuple
@@ -467,3 +468,180 @@ class Framework:
         for plugin, _w in self.points.get("post_bind", []):
             self._timed(state, "post_bind", plugin,
                         lambda: plugin.post_bind(state, pod, node_name))
+
+    # ----------------------------------------------------- batched bind tail
+    # (the commit data plane's coalesced instrumentation: one extension-point
+    # observation and one span cover a whole committed batch instead of one
+    # per pod — at 5k nodes the per-pod wrapper overhead alone was a
+    # measured multi-ms slice of host.commit. Per-plugin SAMPLED metrics are
+    # deliberately not recorded on the batched executors; the per-pod paths
+    # keep them.)
+
+    def _observe_plugin_sample(self, state, point, plugin, call):
+        """One SAMPLED per-plugin observation inside a batched executor:
+        items whose CycleState carries record_plugin_metrics (attempt-1 /
+        1-in-20, the per-pod sampling rule) still feed
+        plugin_execution_duration — the batch path batches the
+        extension-point totals, not the sampled per-plugin contract."""
+        if self._metrics is None or not state.record_plugin_metrics:
+            return call()
+        t0 = perf_counter()
+        status = "Error"
+        try:
+            out = call()
+            status = _status_str(out)
+            return out
+        finally:
+            self._metrics.plugin_execution_duration.observe(
+                perf_counter() - t0, plugin.name(), point, status,
+                exemplar=_trace_exemplar())
+
+    def _run_point_batch(self, point: str, items, call) -> list:
+        """Run ``call(plugin, state, pod, node_name) -> Status`` for every
+        plugin at ``point`` over every (state, pod, node_name) item, with
+        ONE framework_extension_point_duration observation and one span for
+        the whole batch (sampled items keep their per-plugin duration
+        observations). Returns per-item Status (first failure per item
+        wins; remaining plugins skip that item, matching the per-pod
+        short-circuit)."""
+        statuses = [OK] * len(items)
+        plugins = self.points.get(point, [])
+        if not plugins or not items:
+            return statuses
+        m = self._metrics
+        tr = tracing._tracer
+        t0 = perf_counter()
+        worst = "Success"
+        try:
+            span = (tr.span("framework." + point, profile=self.profile_name,
+                            batch=len(items))
+                    if tr is not None else contextlib.nullcontext())
+            with span:
+                # item-outer: pod i runs its whole plugin chain before pod
+                # i+1 starts — byte-for-byte the per-pod executor's order
+                for i, (state, pod, node_name) in enumerate(items):
+                    for plugin, _w in plugins:
+                        st = self._observe_plugin_sample(
+                            state, point, plugin,
+                            lambda p=plugin, s=state, pd=pod, n=node_name:
+                            call(p, s, pd, n))
+                        if st is not None and not st.is_success():
+                            statuses[i] = st.with_plugin(plugin.name())
+                            worst = st.code_name()
+                            break
+            return statuses
+        except Exception:
+            worst = "Error"
+            raise
+        finally:
+            if m is not None:
+                m.framework_extension_point_duration.observe(
+                    perf_counter() - t0, point, worst, self.profile_name)
+
+    def run_reserve_plugins_reserve_batch(self, items) -> list:
+        """Batched Reserve over (state, pod, node_name) items; per-item
+        short-circuit semantics identical to run_reserve_plugins_reserve.
+        A failed item's ALREADY-RUN reserve plugins are unreserved by the
+        caller via run_reserve_plugins_unreserve (whole-point unreserve is
+        the per-pod contract too — Unreserve must tolerate a reserve that
+        never ran, and every in-tree plugin does)."""
+        return self._run_point_batch(
+            "reserve", items,
+            lambda plugin, state, pod, node: plugin.reserve(state, pod, node))
+
+    def run_permit_plugins_batch(self, items, on_wait=None) -> list:
+        """Batched Permit: per-item semantics of run_permit_plugins (first
+        WAIT wins and stamps PERMIT_TIMEOUT_KEY on the item's CycleState;
+        first failure wins) with one instrumentation record per batch.
+        ``on_wait(i, status)`` fires the moment item i votes WAIT — BEFORE
+        the next item's permit runs. Gang quorum depends on this: member
+        i+1's Coscheduling permit counts member i among the parked holders,
+        exactly as the per-pod cycle interleaves park and permit."""
+        statuses = [OK] * len(items)
+        plugins = self.points.get("permit", [])
+        if not plugins or not items:
+            return statuses
+        m = self._metrics
+        tr = tracing._tracer
+        t0 = perf_counter()
+        worst = "Success"
+        try:
+            span = (tr.span("framework.permit", profile=self.profile_name,
+                            batch=len(items))
+                    if tr is not None else contextlib.nullcontext())
+            with span:
+                for i, (state, pod, node_name) in enumerate(items):
+                    for plugin, _w in plugins:
+                        status, timeout = self._observe_plugin_sample(
+                            state, "permit", plugin,
+                            lambda p=plugin, s=state, pd=pod, n=node_name:
+                            p.permit(s, pd, n))
+                        if not status.is_success() and status.code != fw.WAIT:
+                            statuses[i] = status.with_plugin(plugin.name())
+                            worst = status.code_name()
+                            break
+                        if status.code == fw.WAIT:
+                            state.write(PERMIT_TIMEOUT_KEY,
+                                        float(timeout) if timeout
+                                        else DEFAULT_PERMIT_WAIT_S)
+                            statuses[i] = Status(fw.WAIT).with_plugin(
+                                plugin.name())
+                            if on_wait is not None:
+                                on_wait(i, statuses[i])
+                            break
+            return statuses
+        except Exception:
+            worst = "Error"
+            raise
+        finally:
+            if m is not None:
+                m.framework_extension_point_duration.observe(
+                    perf_counter() - t0, "permit", worst, self.profile_name)
+
+    def run_pre_bind_plugins_batch(self, items) -> list:
+        return self._run_point_batch(
+            "pre_bind", items,
+            lambda plugin, state, pod, node: plugin.pre_bind(state, pod, node))
+
+    def run_post_bind_plugins_batch(self, items) -> None:
+        """Batched PostBind: plugins exposing ``post_bind_batch`` get the
+        whole batch in one call (Coscheduling updates each touched gang's
+        status ONCE per commit instead of once per member); the rest run
+        per item."""
+        plugins = self.points.get("post_bind", [])
+        if not plugins or not items:
+            return
+        m = self._metrics
+        tr = tracing._tracer
+        t0 = perf_counter()
+        try:
+            span = (tr.span("framework.post_bind", profile=self.profile_name,
+                            batch=len(items))
+                    if tr is not None else contextlib.nullcontext())
+            with span:
+                sampled = (m is not None
+                           and any(state.record_plugin_metrics
+                                   for state, _p, _n in items))
+                for plugin, _w in plugins:
+                    batch_fn = getattr(plugin, "post_bind_batch", None)
+                    if batch_fn is not None:
+                        tp0 = perf_counter()
+                        batch_fn(items)
+                        if sampled:
+                            # batch-granular plugin sample: the whole-batch
+                            # call IS this plugin's unit of work here
+                            m.plugin_execution_duration.observe(
+                                perf_counter() - tp0, plugin.name(),
+                                "post_bind", "Success",
+                                exemplar=_trace_exemplar())
+                    else:
+                        for state, pod, node_name in items:
+                            self._observe_plugin_sample(
+                                state, "post_bind", plugin,
+                                lambda p=plugin, s=state, pd=pod,
+                                n=node_name: p.post_bind(s, pd, n))
+        finally:
+            if m is not None:
+                m.framework_extension_point_duration.observe(
+                    perf_counter() - t0, "post_bind", "Success",
+                    self.profile_name)

@@ -264,6 +264,22 @@ class SchedulerMetrics:
             "Tombstoned DeviceState row slots handed to new nodes "
             "(bounded-capacity churn instead of monotonic growth).",
         ))
+        # commit data plane (backend/commit_plane.py): per-batch commit
+        # engine stage latencies (assume → bind → finish → notify →
+        # post_bind, plus the whole-batch total) and the notification /
+        # WAL / queue-move deliveries coalesced into per-batch operations
+        # instead of per-pod ones
+        self.commit_batch_duration = r.register(Histogram(
+            "scheduler_commit_batch_duration_seconds",
+            "Batched commit engine latency by stage.",
+            ["stage"],
+        ))
+        self.commit_coalesced_events = r.register(Counter(
+            "scheduler_commit_coalesced_events_total",
+            "Per-pod deliveries coalesced into batched commit operations "
+            "(queue_move|wal_record|cache_op|post_bind).",
+            ["kind"],
+        ))
 
         # unschedulable_pods bookkeeping: gauge value = number of pods
         # CURRENTLY unschedulable attributed to each (plugin, profile); a

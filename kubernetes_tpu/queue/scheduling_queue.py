@@ -135,6 +135,11 @@ class SchedulingQueue:
         self._gang_cont: Optional[Tuple[str, str]] = None
 
         self._lock = locktrace.make_rlock("SchedulingQueue")
+        # commit-plane coalescing window (coalesce_moves): while not None,
+        # move_all_to_active_or_backoff_queue defers its event here and the
+        # window exit runs ONE unschedulable-map scan over the union —
+        # a batch of binds otherwise fires one full-map scan per bound pod
+        self._move_backlog: Optional[List[ClusterEvent]] = None
         self._counter = itertools.count()  # FIFO tie-break inside heaps
         self._active: List[Tuple[object, int, QueuedPodInfo]] = []
         self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []
@@ -442,26 +447,80 @@ class SchedulingQueue:
         interest in ``event`` (:614 MoveAllToActiveOrBackoffQueue). Moved
         gang members pull their parked siblings along (a member waking
         WITHOUT its gang just parks at Permit and times out). Pods the
-        PreEnqueue gate still refuses re-park without a queue move."""
+        PreEnqueue gate still refuses re-park without a queue move.
+
+        Inside a ``coalesce_moves`` window the scan is DEFERRED (returns 0):
+        the event joins the window's backlog and the exit flush runs one
+        union scan. ``move_request_cycle`` still advances immediately — a
+        racing cycle's failure must see the pending move and take the
+        backoffQ, exactly as with the eager scan."""
         self.move_request_cycle = self.scheduling_cycle
-        label = event.label or str(event.resource)
+        if self._move_backlog is not None:
+            self._move_backlog.append(event)
+            if self._metrics is not None:
+                self._metrics.commit_coalesced_events.inc("queue_move")
+            return 0
+        return self._move_all_locked((event,))
+
+    def _move_all_locked(self, events) -> int:  # ktpu: locked
+        """One scan of the unschedulable map against every event in
+        ``events``; a pod moves once, attributed to the first event that
+        matches it."""
         moved = 0
         gangs_moved: Set[str] = set()
         for key in list(self._unschedulable):
             qp = self._unschedulable[key]
-            if self._pod_matches_event(qp, event):
-                del self._unschedulable[key]
-                if self._requeue(qp, event=label):
-                    moved += 1
-                    if self.gang_key_fn is not None:
-                        gkey = self.gang_key_fn(qp.pod)
-                        if gkey is not None:
-                            gangs_moved.add(gkey)
+            for event in events:
+                if self._pod_matches_event(qp, event):
+                    del self._unschedulable[key]
+                    if self._requeue(qp, event=event.label
+                                     or str(event.resource)):
+                        moved += 1
+                        if self.gang_key_fn is not None:
+                            gkey = self.gang_key_fn(qp.pod)
+                            if gkey is not None:
+                                gangs_moved.add(gkey)
+                    break
         for gkey in gangs_moved:
             moved += self.activate_gang(gkey)
         if moved:
             self._sync_gauges()
         return moved
+
+    def coalesce_moves(self):
+        """Context manager: defer every move_all_to_active_or_backoff_queue
+        fired inside the window into ONE union scan at exit (the commit
+        data plane's notification coalescing — a committed batch of N binds
+        fires N POD_ADD moves, each a full unschedulable-map scan without
+        this). Windows nest: only the outermost flushes. Targeted moves
+        (move_gated_pods, activate_gang) stay eager — they are O(released),
+        not O(map)."""
+        queue = self
+
+        class _Window:
+            def __enter__(self):
+                with queue._lock:
+                    self._owner = queue._move_backlog is None
+                    if self._owner:
+                        queue._move_backlog = []
+                return self
+
+            def __exit__(self, *exc):
+                if self._owner:
+                    queue.flush_coalesced_moves()
+                return False
+
+        return _Window()
+
+    @_locked
+    def flush_coalesced_moves(self) -> int:
+        """Close the coalescing window: run the single union scan over the
+        deferred events (deduplicated — a batch of binds repeats POD_ADD)."""
+        backlog, self._move_backlog = self._move_backlog, None
+        if not backlog:
+            return 0
+        events = list(dict.fromkeys(backlog))
+        return self._move_all_locked(events)
 
     @_locked
     def move_gated_pods(self, namespace: Optional[str] = None,

@@ -15,6 +15,7 @@ cache, assume, bind, failure handling) is shared.
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -85,6 +86,22 @@ class WaitingPods:
                                               plugins=plugins)
 
 
+class _SyncCounters(dict):
+    """The scheduler's coarse outcome counters (scheduled/attempts/errors),
+    with an atomic ``inc``: the commit worker (backend/commit_plane.py)
+    lands batch outcomes concurrently with the scheduling thread's precheck
+    failures, and a bare ``d[k] += 1`` from two threads can lose updates.
+    Plain dict reads everywhere else are unchanged."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._mu = threading.Lock()
+
+    def inc(self, key: str, n: int = 1) -> None:
+        with self._mu:
+            self[key] = self.get(key, 0) + n
+
+
 class Scheduler:
     def __init__(
         self,
@@ -113,9 +130,17 @@ class Scheduler:
         self.percentage_of_nodes_to_score = percentage_of_nodes_to_score
         self.next_start_node_index = 0
         self.rng = random.Random(seed)
-        self.metrics: Dict[str, int] = {
-            "schedule_attempts": 0, "scheduled": 0, "unschedulable": 0, "errors": 0,
-        }
+        self.metrics: Dict[str, int] = _SyncCounters(
+            schedule_attempts=0, scheduled=0, unschedulable=0, errors=0)
+        # external-change sequence for the commit data plane's carry gate:
+        # bumped (under _ext_mu — a lost bump would silently keep a stale
+        # device carry) on every event that can change NODE-side truth the
+        # device mirrors — node add/update/remove, and bound-pod add/update/
+        # delete NOT caused by this scheduler's own commits. New PENDING
+        # pods don't bump: they enter the queue, not the node tensors.
+        self._ext_mu = threading.Lock()
+        self._external_events = 0
+        self._commit_plane = None  # built lazily (backend import is heavy)
         self.waiting_pods: Dict[str, WaitingPod] = {}
         self._reject_depth = 0  # nested teardown guard (reject_waiting_pod)
         self._last_cleanup = now_fn()
@@ -299,6 +324,7 @@ class Scheduler:
     def _on_pod_event(self, event: str, old: Optional[Pod], new: Optional[Pod]) -> None:
         if event == ADDED:
             if new.spec.node_name:
+                self._bump_external()  # pre-bound pod: external node truth
                 self.cache.add_pod(new)
                 self._notify_quota_pod_bound(new)
                 self.queue.assigned_pod_updated_or_added(new)
@@ -307,15 +333,24 @@ class Scheduler:
         elif event == MODIFIED:
             if new.spec.node_name:
                 if old is not None and not old.spec.node_name:
+                    if not self.cache.is_assumed(new.key()):
+                        # an EXTERNAL binder's pod (a peer replica, a test
+                        # poking the store) changes node truth; confirming
+                        # our own assume does not — the device carry
+                        # already holds that placement
+                        self._bump_external()
                     self.cache.add_pod(new)  # binding confirmation
                     self._notify_quota_pod_bound(new)
                     self.queue.assigned_pod_updated_or_added(new)
                 else:
+                    self._bump_external()
                     self.cache.update_pod(old, new)
                     self.queue.assigned_pod_updated_or_added(new)
             elif self._responsible_for(new):
                 self.queue.update(old, new)
         elif event == DELETED:
+            if old is not None and old.spec.node_name:
+                self._bump_external()
             if old is not None:
                 self.smetrics.clear_unschedulable(old.key())
                 # quota release first: the POD_DELETE reactivation wave
@@ -342,6 +377,7 @@ class Scheduler:
             plugin.pod_deleted(pod)
 
     def _on_node_event(self, event: str, old: Optional[Node], new: Optional[Node]) -> None:
+        self._bump_external()  # any node event invalidates the device carry
         if event == ADDED:
             self.smetrics.node_events.inc("add")
             self.cache.add_node(new)
@@ -380,6 +416,29 @@ class Scheduler:
     def framework_for_pod(self, pod: Pod) -> Framework:
         return self.profiles[pod.spec.scheduler_name]
 
+    # -------------------------------------------------------- commit plane
+
+    @property
+    def commit_plane(self):
+        """The batched commit engine (backend/commit_plane.py), built on
+        first use — plain oracle schedulers never pay the backend import."""
+        if self._commit_plane is None:
+            from ..backend.commit_plane import CommitPlane
+
+            self._commit_plane = CommitPlane(self)
+        return self._commit_plane
+
+    def _bump_external(self) -> None:
+        """Record one external node-truth change (see _external_events)."""
+        with self._ext_mu:
+            self._external_events += 1
+
+    def external_change_seq(self) -> int:
+        """Monotonic count of external node-truth changes — the commit data
+        plane's carry gate compares snapshots of this across a pipelined
+        chain instead of walking cache generations."""
+        return self._external_events  # ktpu: unguarded-ok(monotonic int probe; a racing bump reads as a changed seq on the NEXT gate check — conservative chain break, never a missed change)
+
     # ----------------------------------------------------------- the cycle
 
     def schedule_one(self) -> bool:
@@ -403,7 +462,7 @@ class Scheduler:
         assume/bind tail. Shared by schedule_one and the batch fallback path."""
         pod = qp.pod
         fwk = self.framework_for_pod(pod)
-        self.metrics["schedule_attempts"] += 1
+        self.metrics.inc("schedule_attempts")
         state = self._new_cycle_state()
         t0 = self.now_fn()
         try:
@@ -413,7 +472,7 @@ class Scheduler:
             self._handle_scheduling_failure(fwk, state, qp, Status.unschedulable(*fe.args), fe.diagnosis, pod_cycle)
             return
         except Exception as e:  # noqa: BLE001 — cycle errors re-enqueue the pod
-            self.metrics["errors"] += 1
+            self.metrics.inc("errors")
             self.smetrics.observe_attempt("error", fwk.profile_name, self.now_fn() - t0)
             self._handle_scheduling_failure(fwk, state, qp, Status.error(str(e)), Diagnosis(), pod_cycle)
             return
@@ -540,11 +599,14 @@ class Scheduler:
                 self.reject_waiting_pod(key, reason="permit wait timeout",
                                         plugins=(wp.plugin,))
 
-    def _periodic_housekeeping(self) -> None:
+    def _periodic_housekeeping(self, now: Optional[float] = None) -> None:
         """The reference's background tickers, driven inline: assume-expiry
         sweep (1s, cache.go:731) and the unschedulable-timeout flush (30s,
-        scheduling_queue.go:463)."""
-        now = self.now_fn()
+        scheduling_queue.go:463). ``now`` lets an override evaluate its own
+        pre-sweep gates against the SAME clock read the sweep uses (a
+        second read could cross the tick boundary the gate just tested)."""
+        if now is None:
+            now = self.now_fn()
         if now - self._last_cleanup >= 1.0:
             self._last_cleanup = now
             self._sweep_expired_waiting_pods(now)
@@ -575,7 +637,7 @@ class Scheduler:
             self._handle_scheduling_failure(fwk, state, qp, status, Diagnosis(), pod_cycle)
             return
         self.cache.finish_binding(assumed)
-        self.metrics["scheduled"] += 1
+        self.metrics.inc("scheduled")
         self.smetrics.clear_unschedulable(assumed.key())
         self.smetrics.observe_attempt(
             "scheduled", fwk.profile_name,
@@ -780,7 +842,7 @@ class Scheduler:
         pod = qp.pod
         nominated_node = ""
         if status.is_unschedulable():
-            self.metrics["unschedulable"] += 1
+            self.metrics.inc("unschedulable")
             self.smetrics.mark_unschedulable(
                 pod.key(), fwk.profile_name, diagnosis.unschedulable_plugins)
             if diagnosis.node_to_status and fwk.points.get("post_filter"):

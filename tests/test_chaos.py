@@ -292,6 +292,128 @@ class TestPipelineRingChaos:
         assert sched.comparer_mismatches == 0
 
 
+class TestCommitWorkerChaos:
+    """Commit data plane, async half: the commit WORKER lands batch K's
+    host commit on its own thread while K+1 dispatches. Killing the device
+    mid-batch with the worker mid-commit must preserve the ring-poison
+    contract exactly — zero lost, zero double-bound, every in-flight batch
+    requeued (worker backlog stolen in one sweep; ring stragglers fail the
+    device-instance check) — under KTPU_LOCKTRACE (acyclic lock graph, no
+    blocking-under-lock across the worker/scheduler interleavings)."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, locktraced):
+        yield
+
+    def _rig(self, monkeypatch):
+        monkeypatch.setenv("KTPU_PIPELINE_DEPTH", "2")
+        monkeypatch.setenv("KTPU_COMMIT_WORKER", "1")  # force on (CPU box)
+        store = ClusterStore()
+        _cluster(store, 6)
+        sched = TPUScheduler(store, batch_size=4, comparer_every_n=1,
+                             pod_initial_backoff=0.01, pod_max_backoff=0.05)
+        assert sched.commit_worker is not None
+        return store, sched
+
+    def test_steady_state_worker_commits_all(self, monkeypatch):
+        store, sched = self._rig(monkeypatch)
+        for i in range(24):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 24
+        bound = _bound(store)
+        assert len(bound) == 24 and len(store.pods) == 24
+        assert sched.comparer_mismatches == 0
+        assert sched.commit_worker.committed > 0  # commits ran off-thread
+        assert sched.commit_plane.pods_bound == sched.batch_scheduled
+
+    def test_worker_kill_mid_batch_poisons_ring(self, monkeypatch):
+        from kubernetes_tpu.backend import batch as batch_mod
+        from kubernetes_tpu.backend import telemetry
+
+        store, sched = self._rig(monkeypatch)
+        tele = telemetry.enable(sched.smetrics)
+        # two waves, one cycle each: both batches sit dispatched
+        for i in range(4):
+            store.create_pod(make_pod(f"a{i}").req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+        for i in range(4):
+            store.create_pod(make_pod(f"b{i}").req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+
+        real_unpack = batch_mod.unpack_result_block
+        calls = []
+
+        def dead(*a, **kw):
+            calls.append(1)
+            raise RuntimeError("relay dropped mid-commit (worker)")
+
+        monkeypatch.setattr(batch_mod, "unpack_result_block", dead)
+        sched._drain_inflight()  # submits the ring; flush joins the worker
+        # ring-poison semantics preserved across the thread boundary:
+        # nothing bound, nothing lost, at most one materialization, device
+        # marked for rebuild, every pod back in a queue
+        assert len(calls) == 1, "newer batches must never materialize"
+        assert sched.metrics["scheduled"] == 0
+        assert _bound(store) == {}
+        assert len(sched._inflight) == 0
+        assert sched.device is None
+        pending = sched.queue.pending_pods()
+        assert sum(pending.values()) == 8, pending
+        # flight events: each poisoned batch logged poison AND requeue
+        events = [e for e in tele.flight.dump()
+                  if e.get("type") in ("poison", "requeue")]
+        poisoned = {e["batchId"] for e in events if e["type"] == "poison"}
+        requeued = {e["batchId"] for e in events if e["type"] == "requeue"}
+        assert poisoned == requeued and len(poisoned) == 2
+        telemetry.disable()
+
+        # heal: the rebuilt device schedules everything exactly once
+        monkeypatch.setattr(batch_mod, "unpack_result_block", real_unpack)
+        import time as _time
+
+        _time.sleep(0.06)  # let the (shortened) error backoff expire
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 8
+        bound = _bound(store)
+        assert len(bound) == 8 and len(store.pods) == 8
+        assert sched.comparer_mismatches == 0
+
+        # byte-identical resync: the rebuilt mirror equals a fresh device
+        # synced from the same host snapshot
+        from kubernetes_tpu.backend.device_state import DeviceState
+
+        sched.cache.update_snapshot(sched.snapshot)
+        fresh = DeviceState(sched.device.caps,
+                            ns_labels_fn=sched.store.ns_labels)
+        fresh.sync(sched.snapshot)
+        for field, arr in sched.device._mirror.items():
+            assert np.array_equal(arr, fresh._mirror[field]), field
+
+    def test_worker_gang_atomicity_under_churn(self, monkeypatch):
+        """Gangs committed THROUGH the worker stay all-or-nothing while
+        plain pods interleave — the Permit-park interleaving the batched
+        engine must reproduce runs on the worker thread here."""
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
+
+        store, sched = self._rig(monkeypatch)
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name="g1", namespace="default"), min_member=3))
+        for i in range(3):
+            store.create_pod(
+                make_pod(f"g1-{i}").req({"cpu": "100m"})
+                .pod_group("g1").obj())
+        for i in range(5):
+            store.create_pod(make_pod(f"solo{i}").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        bound = _bound(store)
+        gang_bound = [k for k in bound if k.startswith("g1-")]
+        assert len(gang_bound) in (0, 3), "partial gang must never land"
+        assert len(gang_bound) == 3
+        assert len(bound) == 8
+
+
 class _WireRig:
     """A WireScheduler + restartable served DeviceService on an injected
     clock: retry sleeps advance the FakeClock, never the wall clock."""
@@ -338,6 +460,7 @@ class TestGangChaos:
 
     def _gang_workload(self, store, n=4):
         from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
 
         store.create_object("PodGroup", PodGroup(
             meta=ObjectMeta(name=self.GROUP), min_member=n,
@@ -390,6 +513,7 @@ class TestGangChaos:
         the resynced mirror: both gangs complete, neither ever partial,
         zero degraded fallback."""
         from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
 
         plan = FaultPlan()
         rig = _WireRig(fault_plan=plan)
@@ -538,6 +662,7 @@ class TestActiveActiveChaos:
 
     def _gang(self, store, prefix, n=4):
         from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
 
         store.create_object("PodGroup", PodGroup(
             meta=ObjectMeta(name=prefix), min_member=n,
@@ -1116,6 +1241,7 @@ class TestDeviceFabricChaos:
     def _gang(self, store, n=4):
         from kubernetes_tpu.api.types import ObjectMeta, PodGroup
 
+
         store.create_object("PodGroup", PodGroup(
             meta=ObjectMeta(name=self.GROUP), min_member=n,
             schedule_timeout_seconds=30))
@@ -1567,6 +1693,7 @@ class TestElasticChaos:
         Zero lost pods, zero double-binds, bounded row capacity (slot
         reuse), byte-identical post-resync mirror, oracle-replay-valid."""
         from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
         from kubernetes_tpu.controllers.drain import DrainOrchestrator
 
         store = ClusterStore()

@@ -146,6 +146,77 @@ class TestSlotTombstones:
         dev.sync(snap)
         assert dev.last_upload_bytes == 0
 
+    def _attr_snap(self, gens):
+        """Snapshot of nodes publishing UNIQUE string attribute values per
+        (name, generation) — the worst case for vocab growth under churn."""
+        snap = Snapshot()
+        for name, gen in gens:
+            snap.node_info_map[name] = NodeInfo(
+                make_node(name).capacity(
+                    {"cpu": "4", "memory": "8Gi", "pods": 10})
+                .device_attrs({"vendor.example/serial": f"sn-{name}-{gen}",
+                               "vendor.example/model": f"m-{gen % 3}",
+                               "vendor.example/hbm_gb": 16}).obj())
+        snap.node_info_list = list(snap.node_info_map.values())
+        snap.structure_version += 1
+        return snap
+
+    def test_attr_value_vocab_bounded_under_churn(self):
+        """ROADMAP item 5 carried follow-up: DRA attribute-value ids were
+        append-only — churning 2x the cluster size with fresh per-node
+        serial strings grew the vocab (and the int32 id range) without
+        bound. With the refcounted free-list, live vocab size and the id
+        high-water mark both stay at cluster scale."""
+        n0 = 8
+        gens = [(f"node-{i}", 0) for i in range(n0)]
+        dev = DeviceState(caps_for_cluster(n0))
+        dev.sync(self._attr_snap(gens))
+        live0 = len(dev.attr_val_ids)
+        assert live0 >= n0  # unique serials + shared models
+        next_i = n0
+        for cycle in range(2 * n0):  # churn 2x the cluster size
+            gens = gens[1:] + [(f"node-{next_i}", cycle + 1)]
+            next_i += 1
+            dev.sync(self._attr_snap(gens))
+        # live values bounded by what the LIVE nodes publish
+        assert len(dev.attr_val_ids) <= live0 + 3
+        # and freed ids were RECYCLED, not burned: the id counter's
+        # high-water mark stays at cluster scale instead of growing by
+        # one serial per churned node
+        assert dev._attr_val_next <= live0 + 4, dev._attr_val_next
+        assert max(dev.attr_val_ids.values()) <= live0 + 3
+        # refcounts match live publishers exactly (no leak, no double-free)
+        serials = {v for v in dev._attr_val_refs if v.startswith("sn-")}
+        assert len(serials) == n0
+
+    def test_attr_value_refcount_shared_values(self):
+        """A value published by several nodes frees only when the LAST
+        publisher leaves; rows re-encode with recycled ids consistently."""
+        dev = DeviceState(caps_for_cluster(4))
+        snap = Snapshot()
+        for name in ("a", "b"):
+            snap.node_info_map[name] = NodeInfo(
+                make_node(name).capacity({"cpu": "4", "pods": 10})
+                .device_attrs({"k": "shared"}).obj())
+        snap.node_info_list = list(snap.node_info_map.values())
+        snap.structure_version += 1
+        dev.sync(snap)
+        vid = dev.attr_val_ids["shared"]
+        assert dev._attr_val_refs["shared"] == 2
+        # one publisher leaves: id stays
+        snap2 = Snapshot()
+        snap2.node_info_map["a"] = snap.node_info_map["a"]
+        snap2.node_info_list = [snap.node_info_map["a"]]
+        snap2.structure_version += 1
+        dev.sync(snap2)
+        assert dev.attr_val_ids["shared"] == vid
+        # last publisher leaves: id freed and recycled for the next value
+        snap3 = Snapshot()
+        snap3.structure_version += 1
+        dev.sync(snap3)
+        assert "shared" not in dev.attr_val_ids
+        assert dev.attr_value_id("fresh") == vid
+
     def test_tombstoned_row_zeroed_on_device(self):
         dev = DeviceState(caps_for_cluster(4))
         dev.sync(self._snap(["a", "b"]))
@@ -254,6 +325,81 @@ class TestDrainOrchestrator:
         shielded2 = store.get_pod("default/shielded")
         assert shielded2 is not None and not shielded2.spec.node_name
         assert all(not p.spec.node_name for p in store.pods.values())
+
+    def test_spot_reclaim_defers_to_pdb_budget(self):
+        """A PodDisruptionBudget at its budget (disruptionsAllowed == 0)
+        DEFERS the spot eviction: the reclaim taint lands, the pod stays,
+        and the periodic taint-manager sweep takes it once the disruption
+        controller's reconcile shows budget again (ROADMAP item 5
+        follow-up, carried from the elastic PR)."""
+        import dataclasses
+
+        from kubernetes_tpu.api.types import (
+            LabelSelector, ObjectMeta, PodDisruptionBudget)
+        from kubernetes_tpu.controllers.nodelifecycle import (
+            evict_noexecute_pods)
+
+        store = ClusterStore()
+        clock = FakeClock()
+        _cluster(store, 2)
+        sched = Scheduler(store, now_fn=clock)
+        store.create_pod(make_pod("guarded").req({"cpu": "1"})
+                         .label("app", "db").obj())
+        store.create_pod(make_pod("free").req({"cpu": "1"}).obj())
+        sched.run_until_settled()
+        store.create_pdb(PodDisruptionBudget(
+            meta=ObjectMeta(name="db-pdb", namespace="default"),
+            selector=LabelSelector(match_labels={"app": "db"}),
+            min_available=1, disruptions_allowed=0))  # budget exhausted
+        d = DrainOrchestrator(store, metrics=sched.smetrics,
+                              queue=sched.queue, now_fn=clock)
+        summary = d.spot_reclaim(sorted(store.nodes))
+        # the unguarded pod evicted; the PDB-guarded one DEFERRED — still
+        # bound, on a node that now carries the reclaim taint
+        guarded = store.get_pod("default/guarded")
+        assert guarded is not None and guarded.spec.node_name
+        free = store.get_pod("default/free")
+        assert free is not None and not free.spec.node_name
+        assert summary["evicted"] == 1
+        node = store.nodes[guarded.spec.node_name]
+        assert any(t.key == TAINT_SPOT_RECLAIM for t in node.spec.taints)
+        # budget recovers (the disruption controller's reconcile raises
+        # disruptionsAllowed): the PERIODIC taint-manager sweep takes the
+        # deferred pod through the very same machinery
+        pdb = store.pdbs["default/db-pdb"]
+        new = dataclasses.replace(pdb, disruptions_allowed=1)
+        new.meta = dataclasses.replace(pdb.meta)
+        store.update_object("PodDisruptionBudget", new)
+        taken = evict_noexecute_pods(
+            store, node, clock(), since=None,
+            allow_fn=d._pdb_disruption_gate())
+        assert [p.meta.name for p in taken] == ["guarded"]
+
+    def test_pdb_gate_charges_budget_within_one_wave(self):
+        """One wave can never take more pods from a budget than
+        disruptionsAllowed, even before the controller re-reconciles."""
+        from kubernetes_tpu.api.types import (
+            LabelSelector, ObjectMeta, PodDisruptionBudget)
+
+        store = ClusterStore()
+        clock = FakeClock()
+        _cluster(store, 3)
+        sched = Scheduler(store, now_fn=clock)
+        for i in range(3):
+            store.create_pod(make_pod(f"db-{i}").req({"cpu": "1"})
+                             .label("app", "db").obj())
+        sched.run_until_settled()
+        store.create_pdb(PodDisruptionBudget(
+            meta=ObjectMeta(name="db-pdb", namespace="default"),
+            selector=LabelSelector(match_labels={"app": "db"}),
+            min_available=2, disruptions_allowed=1))
+        d = DrainOrchestrator(store, metrics=sched.smetrics,
+                              queue=sched.queue, now_fn=clock)
+        summary = d.spot_reclaim(sorted(store.nodes))
+        still_bound = [p for p in store.pods.values()
+                       if p.spec.node_name and p.meta.labels.get("app") == "db"]
+        assert len(still_bound) == 2, "wave overdrew the disruption budget"
+        assert summary["evicted"] == 1
 
     def test_nodelifecycle_eviction_uses_shared_taint_manager(self):
         """The unreachable-node path and the spot path are one machinery:

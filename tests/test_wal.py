@@ -143,3 +143,156 @@ class TestTornTail:
         with open(path, "a", encoding="utf-8") as f:
             f.write('deadbeef {"not": "valid for that crc"}\n')
         assert len(list(replay(path))) == 2  # guard trips, no raise
+
+    def test_torn_batch_record_tail_drops_whole_batch(self, tmp_path):
+        """Group-commit torn tail: the crc frames the WHOLE batch line, so
+        a crash mid-write drops the batch atomically — none of its binds
+        replay, everything before the line is the durable prefix."""
+        from kubernetes_tpu.api.types import Binding
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        attach_wal(store, path)
+        _cluster(store, nodes=2)
+        for name in ("a", "b", "c"):
+            store.create_pod(make_pod(name).req({"cpu": "1"}).obj())
+        outcomes = store.bind_batch([
+            Binding(pod_key=f"default/{n}", node_name="n0")
+            for n in ("a", "b", "c")])
+        assert outcomes == [None, None, None]
+        with open(path, "rb+") as f:  # the crash: the batch line is torn
+            f.seek(-10, 2)
+            f.truncate()
+        restored = restore(path)
+        # every pre-batch record intact; NO bind from the torn batch
+        assert set(restored.pods) == {"default/a", "default/b", "default/c"}
+        assert all(not p.spec.node_name for p in restored.pods.values())
+
+    def test_corrupt_batch_record_checksum_drops_whole_batch(self, tmp_path):
+        from kubernetes_tpu.api.types import Binding
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        attach_wal(store, path)
+        _cluster(store, nodes=1)
+        for name in ("x", "y"):
+            store.create_pod(make_pod(name).req({"cpu": "1"}).obj())
+        store.bind_batch([Binding(pod_key=f"default/{n}", node_name="n0")
+                          for n in ("x", "y")])
+        with open(path, encoding="utf-8") as f:
+            lines = f.readlines()
+        lines[-1] = lines[-1].replace("Running", "Runnjng")
+        with open(path, "w", encoding="utf-8") as f:
+            f.writelines(lines)
+        restored = restore(path)
+        assert all(not p.spec.node_name for p in restored.pods.values())
+
+
+class TestGroupCommit:
+    """The commit data plane's WAL half: one crc-framed line per batch,
+    per-record replay semantics, and byte-parity with the per-pod log."""
+
+    def test_one_line_per_batch_and_per_record_replay(self, tmp_path):
+        from kubernetes_tpu.api.types import Binding
+        from kubernetes_tpu.apiserver.wal import replay
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        wal = attach_wal(store, path)
+        _cluster(store, nodes=2)
+        for i in range(5):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+        lines_before = wal.lines_written
+        recs_before = wal.records_appended
+        outcomes = store.bind_batch([
+            Binding(pod_key=f"default/p{i}", node_name=f"n{i % 2}")
+            for i in range(5)])
+        assert outcomes == [None] * 5
+        assert wal.lines_written == lines_before + 1  # ONE group append
+        assert wal.records_appended == recs_before + 5
+        # replay unpacks the envelope: five MODIFIED records in order
+        tail = list(replay(path))[-5:]
+        assert [r["event"] for r in tail] == ["MODIFIED"] * 5
+        assert [r["key"] for r in tail] == [f"default/p{i}" for i in range(5)]
+        restored = restore(path)
+        assert {k: p.spec.node_name for k, p in restored.pods.items()} == {
+            f"default/p{i}": f"n{i % 2}" for i in range(5)}
+
+    def test_mixed_legacy_and_batch_replay_byte_identical(self, tmp_path):
+        """A log mixing per-pod appends and group-commit batches restores a
+        store byte-identical (wire form) to one written per-pod only."""
+        from kubernetes_tpu.api.codec import to_wire
+        from kubernetes_tpu.api.types import Binding
+
+        def build(batched: bool, path: str) -> ClusterStore:
+            store = ClusterStore()
+            attach_wal(store, path)
+            _cluster(store, nodes=2)
+            for i in range(6):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "100m"}).obj())
+            # first two bind per-pod (legacy records) in BOTH stores
+            store.bind(Binding(pod_key="default/p0", node_name="n0"))
+            store.bind(Binding(pod_key="default/p1", node_name="n1"))
+            rest = [Binding(pod_key=f"default/p{i}", node_name=f"n{i % 2}")
+                    for i in range(2, 6)]
+            if batched:
+                assert store.bind_batch(rest) == [None] * 4
+            else:
+                for b in rest:
+                    store.bind(b)
+            return store
+
+        path_a = str(tmp_path / "legacy.wal")
+        path_b = str(tmp_path / "batched.wal")
+        build(False, path_a)
+        build(True, path_b)
+        ra, rb = restore(path_a), restore(path_b)
+
+        def dump(store):
+            out = {}
+            for k, p in store.pods.items():
+                wire = to_wire(p)
+                # the only legitimate difference between the two builds is
+                # the wall clock each create ran at
+                wire["meta"]["creation_timestamp"] = 0
+                out[k] = wire
+            return out
+
+        assert dump(ra) == dump(rb)
+        assert ra._rv == rb._rv and ra._event_seq == rb._event_seq
+
+    def test_single_record_batch_degenerates_to_legacy_form(self, tmp_path):
+        from kubernetes_tpu.api.types import Binding
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        attach_wal(store, path)
+        _cluster(store, nodes=1)
+        store.create_pod(make_pod("solo").req({"cpu": "1"}).obj())
+        store.bind_batch([Binding(pod_key="default/solo", node_name="n0")])
+        with open(path, encoding="utf-8") as f:
+            last = f.readlines()[-1]
+        assert '"batch"' not in last  # legacy per-record form on the wire
+
+    def test_per_pod_failures_do_not_block_batch_siblings(self, tmp_path):
+        from kubernetes_tpu.api.types import Binding
+        from kubernetes_tpu.apiserver.store import Conflict, NotFound
+
+        path = str(tmp_path / "store.wal")
+        store = ClusterStore()
+        attach_wal(store, path)
+        _cluster(store, nodes=1)
+        store.create_pod(make_pod("ok").req({"cpu": "1"}).obj())
+        store.create_pod(make_pod("dup").req({"cpu": "1"}).obj())
+        store.bind(Binding(pod_key="default/dup", node_name="n0"))
+        outcomes = store.bind_batch([
+            Binding(pod_key="default/ghost", node_name="n0"),
+            Binding(pod_key="default/dup", node_name="n0"),
+            Binding(pod_key="default/ok", node_name="n0"),
+        ])
+        assert isinstance(outcomes[0], NotFound)
+        assert isinstance(outcomes[1], Conflict)
+        assert outcomes[2] is None
+        restored = restore(path)
+        assert restored.get_pod("default/ok").spec.node_name == "n0"
