@@ -402,19 +402,58 @@ def run_wire(n_nodes=1000, n_init=200, n_measured=500, backend="wire"):
     in-process number does not pay. backend="wire" is HTTP/JSON;
     backend="grpc" is the hardened gRPC + template-dedup transport."""
     entry = {"transport": backend}
-    try:
-        from kubernetes_tpu.perf.harness import run_workload
+
+    def one(depth_env):
+        """One measured run; depth_env='' keeps the session default."""
+        from kubernetes_tpu.perf.harness import Runner
         from kubernetes_tpu.perf.workloads import scheduling_basic
 
-        items = run_workload(
-            scheduling_basic(nodes=n_nodes, init_pods=n_init, measured=n_measured),
-            backend=backend)
-        for it in items:
-            if it.labels.get("Name") == "SchedulingThroughput":
-                entry["pods_per_s"] = round(it.data["Average"], 2)
-            elif (it.labels.get("Name") == "scheduling_attempt_duration_seconds"
-                  and it.labels.get("result") == "scheduled"):
-                entry["attempt_p99_s"] = round(it.data["Perc99"], 4)
+        prior = os.environ.get("KTPU_WIRE_PIPELINE_DEPTH")
+        if depth_env != "":
+            os.environ["KTPU_WIRE_PIPELINE_DEPTH"] = depth_env
+        try:
+            test_case = scheduling_basic(nodes=n_nodes, init_pods=n_init,
+                                         measured=n_measured)
+            r = Runner(scheduler_config=test_case.get("schedulerConfig"),
+                       backend=backend)
+            try:
+                r.run_ops(test_case["ops"])
+                sched = r.scheduler
+                out = {
+                    "wire_pipeline_depth": getattr(
+                        sched, "wire_pipeline_depth", 0),
+                    "pipelined_batches": getattr(
+                        sched, "pipelined_wire_batches", 0),
+                }
+                pipeline = getattr(sched, "_wire_pipeline", None)
+                if pipeline is not None:
+                    out["duplicate_replies"] = pipeline.duplicate_replies
+            finally:
+                r.close()
+            for it in r.data_items:
+                if it.labels.get("Name") == "SchedulingThroughput":
+                    out["pods_per_s"] = round(it.data["Average"], 2)
+                elif (it.labels.get("Name")
+                      == "scheduling_attempt_duration_seconds"
+                      and it.labels.get("result") == "scheduled"):
+                    out["attempt_p99_s"] = round(it.data["Perc99"], 4)
+            return out
+        finally:
+            if depth_env != "":
+                if prior is None:
+                    os.environ.pop("KTPU_WIRE_PIPELINE_DEPTH", None)
+                else:
+                    os.environ["KTPU_WIRE_PIPELINE_DEPTH"] = prior
+
+    try:
+        # headline row: the pipelined transport at its default depth, plus
+        # a SAME-RUN depth-0 control — the box is bimodal across runs
+        # (ROADMAP bench caveats), so the pipelining lift is judged at
+        # iso-conditions inside one record, not across rounds
+        entry.update(one(""))
+        sync = one("0")
+        entry["sync_pods_per_s"] = sync.get("pods_per_s")
+        entry["sync_attempt_p99_s"] = sync.get("attempt_p99_s")
     except Exception as exc:  # noqa: BLE001 — a bad row must not kill the bench
         entry["error"] = f"{type(exc).__name__}: {exc}"[:200]
     return entry

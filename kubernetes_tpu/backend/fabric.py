@@ -37,6 +37,37 @@ Design:
     ``_full_resync`` seeds the standby under a fresh session (new
     sessionGen — a zombie commit from the dead primary's session can then
     only fence as a ``ConflictError``).
+  * **Concurrent callers.** The pipelined wire transport keeps K batches
+    in flight, so several lanes can observe the active's death at once.
+    Failover is serialized by a single in-progress flag under the fabric
+    lock: the FIRST failing call runs the promotion; concurrent failers
+    wait for it to finish and raise ``FailoverError`` against the new
+    active — every in-flight batch is poisoned, the promotion happens
+    exactly once, and ``failovers`` counts one event.
+  * **Warm standbys (background delta replication).** When enabled
+    (``replication=True`` — WireScheduler's default with >1 endpoint),
+    the fabric folds every delta push it successfully delivers to the
+    active into a cumulative replication state (node name → last wire
+    entry) and a background worker fans the DIRTY SUFFIX out to each
+    healthy standby under its own replication session — asynchronous,
+    off the scheduling thread's critical path, coalesced per node (a node
+    that changed five times while a standby lagged ships once), so the
+    standby's DeviceState mirror tracks the primary's. At promote, the
+    client's epoch-mismatch full resync still runs — but the standby's
+    device already holds matching rows, so the row-content/generation
+    elision (PR 5/7) uploads only the dirty suffix: failover resync cost
+    drops from O(cluster) to O(replication lag), asserted by the
+    upload-byte telemetry, not wall time.
+  * **Standby sessions stay warm.** Fabric heartbeats/sessions otherwise
+    reach only the active, so a standby's lease for the scheduler client
+    (and for the replicator itself) could silently expire and fence the
+    first post-failover commit — or fence the replicator and drop the
+    warm device at the promote-time ghost sweep. The replication worker
+    therefore fans lease heartbeats out to standbys: the replicator's own
+    session, plus the scheduler client's (sessionGen-stripped — the
+    standby mints its own generation; what matters is the lease staying
+    fresh so the post-failover resync joins a LIVE session whose node
+    claims keep the warm DeviceState alive).
   * **All replicas down** → the original transport error propagates and
     the scheduler's breaker degrades to the sequential oracle; scheduling
     never stops. Heal is the scheduler's half-open probe calling
@@ -50,21 +81,37 @@ Design:
     and distinguishable in telemetry by the reason label plus identical
     lastError strings across replicas in /debug/fabric.
 
-Locking: the fabric lock guards only the selection state (active index,
-failover counters, probe clock) for /debug readers — transport calls and
-health probes always run OUTSIDE it (a slow replica must never wedge the
-serving thread; the locktrace blocking pass enforces this). Probes of
-maybe-dead replicas additionally ride a dedicated SINGLE-ATTEMPT probe
-client (``probe_client_factory``; no retries, no backoff sleeps) so a
-blackholed standby costs one connect timeout per window on the
-scheduling thread, never the full retry budget.
+Locking: the fabric lock guards only the selection/failover state (active
+index, in-progress flag, counters, probe clock) and the replicator lock
+(``FabricReplicator``) only the cumulative delta state + per-standby
+dirty sets — transport calls, health probes, and replication pushes ALL
+run outside every traced lock (a slow replica must never wedge a serving
+thread; the locktrace blocking pass enforces this). The one
+promote-vs-replication race — a replication push landing on a replica
+just promoted to active, overwriting newer client content with the
+replicator's older view — is closed without holding a lock across IO:
+each replica carries a ``repl_idle`` event cleared around its push; the
+replicator re-checks the active index under the fabric lock immediately
+before clearing it, and the promotion flips the active index first and
+then waits (bounded) for ``repl_idle`` before returning, so no new push
+can start against the new active and a straggler normally finishes
+before the scheduler client ever talks to it. The backstop for a push
+hung PAST that bounded wait is server-side: replicator sessions are
+flagged, and the service skips any replicated entry whose generation is
+not newer than what a direct client session has already pushed for that
+node — stale replication can cost a skipped row (repaired by the next
+delta), never a backward overwrite.
 """
 
 from __future__ import annotations
 
+import itertools
+import json
+import os
+import threading
 import time
 from collections import deque
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..testing import locktrace
 from . import telemetry
@@ -77,6 +124,8 @@ from .errors import (
     StaleEpochError,
 )
 
+API_VERSION = "ktpu/v1"
+
 # how often a down standby is re-probed with the Health verb (also the
 # per-replica breaker's reset timeout, so allow() admits one probe per
 # window) — wire-tuned like the scheduler breaker's 5s default
@@ -85,14 +134,21 @@ DEFAULT_PROBE_INTERVAL_S = 5.0
 # bounded failover journal for /debug/fabric
 LOG_CAPACITY = 64
 
+_REPL_IDS = itertools.count(1)
+
 
 class _Replica:
     """One DeviceService endpoint: transport client plus health
-    bookkeeping. Plain attributes only (single writer: the scheduling
-    thread; /debug readers tolerate a torn snapshot of booleans)."""
+    bookkeeping. Plain attributes only (single writer per field: the
+    calling thread for health/epoch, the replication worker for repl_*;
+    /debug readers tolerate a torn snapshot of booleans)."""
 
     __slots__ = ("index", "endpoint", "client", "probe", "breaker",
-                 "healthy", "epoch", "last_error", "last_batch_id")
+                 "healthy", "epoch", "last_error", "last_batch_id",
+                 "repl_idle", "repl_needs_full", "repl_synced_seq",
+                 "repl_dirty", "repl_removed", "repl_ns_dirty",
+                 "repl_epoch", "repl_session_gen", "repl_backoff_until",
+                 "repl_hb_at", "repl_pushes", "repl_last_error")
 
     def __init__(self, index: int, endpoint: str, client,
                  now_fn, probe_interval_s: float, probe_client=None):
@@ -113,6 +169,20 @@ class _Replica:
         self.epoch: Optional[str] = None      # last epoch this replica answered
         self.last_error = ""
         self.last_batch_id: Optional[str] = None  # last batch it accepted
+        # ---- warm-standby replication (worker-owned unless noted) ----
+        self.repl_idle = threading.Event()    # clear = push in flight
+        self.repl_idle.set()
+        self.repl_needs_full = True           # seed/reseed with full=True
+        self.repl_synced_seq = 0              # primary seq last acked
+        self.repl_dirty: set = set()          # node names pending (repl lock)
+        self.repl_removed: set = set()        # removals pending (repl lock)
+        self.repl_ns_dirty: set = set()       # namespaces pending (repl lock)
+        self.repl_epoch: Optional[str] = None
+        self.repl_session_gen: Optional[int] = None
+        self.repl_backoff_until = 0.0
+        self.repl_hb_at = 0.0
+        self.repl_pushes = 0
+        self.repl_last_error = ""
 
 
 class DeviceFabric:
@@ -124,7 +194,9 @@ class DeviceFabric:
                  client_factory: Callable[[str, int], object],
                  probe_client_factory: Optional[Callable] = None,
                  metrics=None, now_fn=time.monotonic,
-                 probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S):
+                 probe_interval_s: float = DEFAULT_PROBE_INTERVAL_S,
+                 replication: bool = False,
+                 replication_worker: bool = True):
         if not endpoints:
             raise ValueError("DeviceFabric needs at least one endpoint")
         self.now_fn = now_fn
@@ -143,14 +215,55 @@ class DeviceFabric:
         self.supports_health = getattr(first, "supports_health", False)
         self.supports_sessions = getattr(first, "supports_sessions", False)
         self._lock = locktrace.make_lock("DeviceFabric")
+        # serializes concurrent failovers (pipelined lanes can observe the
+        # active's death at once): waiters park on this condition while the
+        # first failer promotes; promotion probes run OUTSIDE the lock
+        self._failover_cv = threading.Condition(self._lock)
+        self._failover_inprogress = False
         self._active = 0
         self.failovers = 0
         self.log: deque = deque(maxlen=LOG_CAPACITY)
         self._last_probe = now_fn()
+        # ---- warm-standby replication ----
+        self.replication_enabled = bool(replication) and len(endpoints) > 1
+        # worker=False: no background thread — replication happens only on
+        # explicit replication_flush() calls (the unit tests' deterministic
+        # mode; production keeps the worker)
+        self._repl_worker_enabled = replication_worker
+        # serializes whole flush ROUNDS (worker vs an explicit test/debug
+        # flush). Deliberately a plain lock, NOT locktrace.make_lock: a
+        # round contains transport IO by design, and no traced lock is
+        # ever acquired while holding it except the fine-grained state
+        # locks the round itself takes — it exists to keep two concurrent
+        # rounds from splitting one dirty set, not to guard state
+        self._repl_round_mutex = threading.Lock()
+        self._repl_client_id = f"fabric-repl-{os.getpid():x}-{next(_REPL_IDS)}"
+        self._repl_cv = threading.Condition(
+            locktrace.make_lock("FabricReplicator"))
+        self._repl_nodes: Dict[str, dict] = {}   # name -> last wire entry
+        self._repl_namespaces: Dict[str, dict] = {}
+        self._repl_seq = 0            # delta generations folded from primary
+        self._repl_pending = False
+        self._repl_stopped = False
+        self._repl_thread: Optional[threading.Thread] = None
+        self._client_hb: Optional[str] = None  # scheduler clientId to keep warm
+        self.repl_rounds = 0
         if metrics is not None:
             metrics.fabric_active_replica.set(value=0)
             for rep in self.replicas:
                 metrics.fabric_replica_health.set(rep.endpoint, value=1)
+
+    def close(self) -> None:
+        """Stop the replication worker and release transport clients that
+        own resources (gRPC channels)."""
+        with self._repl_cv:
+            self._repl_stopped = True
+            self._repl_cv.notify_all()
+        for rep in self.replicas:
+            for c in {id(rep.client): rep.client, id(rep.probe): rep.probe}.values():
+                close = getattr(c, "close", None)
+                if close is not None:
+                    close()
 
     # --------------------------------------------------------------- verbs
 
@@ -219,11 +332,28 @@ class DeviceFabric:
             rep.epoch = out.get("epoch", rep.epoch)
         if verb == "schedule_batch" and payload:
             rep.last_batch_id = payload.get("batchId", rep.last_batch_id)
+        if self.replication_enabled:
+            if verb == "apply_deltas" and payload:
+                # the push the active just acknowledged is now part of the
+                # primary's truth: fold it into the replication state and
+                # wake the fan-out worker (off this thread's critical path)
+                self._repl_fold(payload)
+            elif verb == "heartbeat" and payload:
+                # remember the scheduler client's identity so the worker
+                # can keep ITS standby sessions warm too (satellite: a
+                # silently expired standby lease would fence the first
+                # post-failover commit)
+                self._client_hb = payload.get("clientId") or self._client_hb
         if not rep.healthy:
             self._mark_health(rep, True)
 
     def _mark_health(self, rep: _Replica, up: bool) -> None:
+        came_back = up and not rep.healthy
         rep.healthy = up
+        if came_back:
+            # a replica that was away holds an arbitrarily stale mirror:
+            # the next replication push must re-seed it wholesale
+            rep.repl_needs_full = True
         if self.metrics is not None:
             self.metrics.fabric_replica_health.set(rep.endpoint,
                                                    value=1 if up else 0)
@@ -236,7 +366,13 @@ class DeviceFabric:
         in-flight batch, promote the first live standby. Returns
         ``(new_active, its_health_response)``; raises the ORIGINAL error
         when no standby answers (all replicas down — the scheduler's
-        breaker owns the next rung of the ladder: oracle degrade)."""
+        breaker owns the next rung of the ladder: oracle degrade).
+
+        Concurrency: with the pipelined transport several lanes can fail
+        on the same dead active at once. Exactly ONE runs the promotion;
+        the rest wait for it and re-raise against the promoted standby —
+        each caller's batch is still poisoned (flight event above), but
+        the failover happens, and is counted, once."""
         rep.breaker.record_failure(exc)
         rep.last_error = f"{type(exc).__name__}: {exc}"
         self._mark_health(rep, False)
@@ -253,7 +389,24 @@ class DeviceFabric:
                             endpoint=rep.endpoint,
                             pods=len((payload or {}).get("pods") or ()),
                             error=str(exc)[:200])
-        promoted = self._promote_standby(rep)
+        with self._lock:
+            while self._failover_inprogress:
+                # another lane is already promoting: wait it out (the cv
+                # releases the lock), then judge against the result
+                self._failover_cv.wait()
+            cur = self.replicas[self._active]
+            if cur is not rep and cur.healthy:
+                # a concurrent lane already failed over: this batch just
+                # dies against the new active (poisoned above, requeued by
+                # the caller) — no second promotion, no double count
+                return cur, None
+            self._failover_inprogress = True
+        try:
+            promoted = self._promote_standby(rep)
+        finally:
+            with self._lock:
+                self._failover_inprogress = False
+                self._failover_cv.notify_all()
         if promoted is None:
             raise exc
         new, probe_out = promoted
@@ -271,7 +424,11 @@ class DeviceFabric:
     def _promote_standby(self, dead: _Replica):
         """Probe standbys (rotation order from the active) with the cheap
         Health verb; the first that answers becomes active. Probes run
-        outside the lock; only the index flip is guarded."""
+        outside the lock; only the index flip is guarded. After the flip,
+        wait for any in-flight replication push to the promoted replica to
+        land — the replicator re-checks the active index before each push,
+        so after this wait no stale replication content can ever overwrite
+        what the scheduler client is about to resync."""
         with self._lock:
             start = self._active
         n = len(self.replicas)
@@ -297,6 +454,11 @@ class DeviceFabric:
                                  "from": dead.endpoint,
                                  "to": cand.endpoint,
                                  "error": dead.last_error})
+            # bounded wall-clock wait: a replication push that started
+            # before the flip finishes its (probe-client, single-attempt)
+            # call and sets the event; no NEW push can start — the worker
+            # re-checks the active index under the fabric lock first
+            cand.repl_idle.wait(timeout=10.0)
             if self.metrics is not None:
                 self.metrics.fabric_active_replica.set(value=cand.index)
             return cand, out
@@ -333,14 +495,282 @@ class DeviceFabric:
                             restarted=restarted,
                             lastBatchId=rep.last_batch_id)
 
+    # ------------------------------------------------- standby replication
+
+    @staticmethod
+    def _entry_name(entry: dict) -> Optional[str]:
+        try:
+            return entry["node"]["meta"]["name"]
+        except (KeyError, TypeError):
+            return None
+
+    def _standby_targets(self) -> List[_Replica]:
+        with self._lock:
+            active = self._active
+        return [r for r in self.replicas if r.index != active]
+
+    def _repl_fold(self, payload: dict) -> None:
+        """Fold one successfully-delivered delta push into the cumulative
+        replication state (node name → newest wire entry) and mark the
+        changed names dirty for every standby. Coalescing happens here: a
+        node that changes five times while a standby lags ships ONCE. The
+        caller is the scheduling thread — only dict/set work under the
+        replicator lock, never IO."""
+        targets = self._standby_targets()
+        with self._repl_cv:
+            full = bool(payload.get("full"))
+            entries = payload.get("nodes") or ()
+            pushed = set()
+            for e in entries:
+                name = self._entry_name(e)
+                if name is None:
+                    continue
+                pushed.add(name)
+                prev = self._repl_nodes.get(name)
+                self._repl_nodes[name] = e
+                if prev is None or prev.get("gen") != e.get("gen"):
+                    for rep in targets:
+                        rep.repl_dirty.add(name)
+                        rep.repl_removed.discard(name)
+            removed = list(payload.get("removed") or ())
+            if full:
+                # a full push IS the client's whole truth: names it omits
+                # are gone (the server-side ghost sweep's replication twin)
+                removed.extend(n for n in list(self._repl_nodes)
+                               if n not in pushed)
+            for name in removed:
+                self._repl_nodes.pop(name, None)
+                for rep in targets:
+                    rep.repl_dirty.discard(name)
+                    rep.repl_removed.add(name)
+            for ns, labels in (payload.get("namespaces") or {}).items():
+                self._repl_namespaces[ns] = dict(labels)
+                for rep in targets:
+                    rep.repl_ns_dirty.add(ns)
+            self._repl_seq += 1
+            self._repl_pending = True
+            if (self._repl_worker_enabled
+                    and (self._repl_thread is None
+                         or not self._repl_thread.is_alive())):
+                self._repl_thread = threading.Thread(
+                    target=self._repl_run, name="ktpu-fabric-repl",
+                    daemon=True)
+                self._repl_thread.start()
+            self._repl_cv.notify_all()
+
+    def _repl_run(self) -> None:
+        """Replication worker: fan the dirty suffix out to standbys when
+        signaled; wake periodically for lease keep-warm heartbeats (gated
+        by the injected clock, so FakeClock tests stay deterministic)."""
+        while True:
+            with self._repl_cv:
+                if not self._repl_pending and not self._repl_stopped:
+                    self._repl_cv.wait(timeout=0.5)
+                if self._repl_stopped:
+                    return
+                self._repl_pending = False
+            try:
+                self.replication_flush()
+            except Exception:  # noqa: BLE001 — the worker must survive surprises
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "standby replication round failed")
+
+    def replication_flush(self) -> int:
+        """Run ONE replication round synchronously: push the pending dirty
+        suffix (or a full seed) to every healthy standby, send keep-warm
+        heartbeats, refresh the lag gauges. Called by the worker thread —
+        and directly by tests that want deterministic replication without
+        racing the wall clock. Returns the number of delta pushes made."""
+        if not self.replication_enabled:
+            return 0
+        with self._repl_round_mutex:
+            self.repl_rounds += 1
+            pushes = 0
+            now = self.now_fn()
+            for rep in self._standby_targets():
+                if not rep.healthy or now < rep.repl_backoff_until:
+                    continue
+                pushes += self._replicate_to(rep)
+                self._repl_keep_warm(rep, now)
+            self._update_repl_lag()
+            return pushes
+
+    def _replicate_to(self, rep: _Replica) -> int:
+        """Push the pending dirty suffix (or a full seed) to one standby.
+        State snapshot under the replicator lock; the transport call runs
+        with NO traced lock held. The promote race is closed by the
+        repl_idle event + active re-check (see _promote_standby)."""
+        with self._repl_cv:
+            full = rep.repl_needs_full
+            if (not full and not rep.repl_dirty and not rep.repl_removed
+                    and not rep.repl_ns_dirty
+                    and rep.repl_synced_seq == self._repl_seq):
+                return 0
+            if full:
+                entries = list(self._repl_nodes.values())
+                removed: List[str] = []
+                namespaces = {ns: dict(l)
+                              for ns, l in self._repl_namespaces.items()}
+                backup = None
+            else:
+                entries = [self._repl_nodes[n] for n in rep.repl_dirty
+                           if n in self._repl_nodes]
+                removed = [n for n in rep.repl_removed]
+                namespaces = {ns: dict(self._repl_namespaces[ns])
+                              for ns in rep.repl_ns_dirty
+                              if ns in self._repl_namespaces}
+                backup = (set(rep.repl_dirty), set(rep.repl_removed),
+                          set(rep.repl_ns_dirty))
+            rep.repl_dirty.clear()
+            rep.repl_removed.clear()
+            rep.repl_ns_dirty.clear()
+            target_seq = self._repl_seq
+        payload = {"apiVersion": API_VERSION, "nodes": entries,
+                   "removed": removed, "namespaces": namespaces,
+                   "clientId": self._repl_client_id, "replicator": True}
+        if full:
+            payload["full"] = True
+        elif rep.repl_epoch:
+            payload["expectEpoch"] = rep.repl_epoch
+        if rep.repl_session_gen is not None:
+            payload["sessionGen"] = rep.repl_session_gen
+        # the promote race guard: no push may start once this replica is
+        # the active (its truth now comes from the scheduler client)
+        with self._lock:
+            if self.replicas[self._active] is rep:
+                self._repl_restore(rep, backup, full)
+                return 0
+            rep.repl_idle.clear()
+        try:
+            out = rep.probe.apply_deltas(payload)
+        except StaleEpochError as exc:
+            # the standby restarted under the replicator: reseed wholesale
+            rep.repl_needs_full = True
+            rep.repl_epoch = exc.epoch or None
+            rep.repl_session_gen = None
+            self._repl_signal()
+            return 0
+        except ConflictError:
+            # the replicator's lease was fenced (it lagged past the TTL),
+            # or the service fenced a LAPPED push (a direct client
+            # full-resynced since our last contact — our incremental view
+            # may name nodes the resync swept): rejoin under a fresh
+            # session and reseed wholesale
+            rep.repl_session_gen = None
+            rep.repl_needs_full = True
+            self._repl_signal()
+            return 0
+        except DeviceServiceError as exc:
+            rep.repl_last_error = f"{type(exc).__name__}: {exc}"
+            rep.repl_backoff_until = self.now_fn() + self.probe_interval_s
+            self._repl_restore(rep, backup, full)
+            return 0
+        finally:
+            rep.repl_idle.set()
+        rep.repl_epoch = out.get("epoch", rep.repl_epoch)
+        rep.repl_session_gen = out.get("sessionGen", rep.repl_session_gen)
+        rep.repl_needs_full = False
+        rep.repl_synced_seq = target_seq
+        rep.repl_pushes += 1
+        rep.repl_last_error = ""
+        kind = "full" if full else "delta"
+        if self.metrics is not None or telemetry.get() is not None:
+            # payload volume as canonical JSON — a transport-independent
+            # APPROXIMATION (gRPC framing/template dedup differs); the
+            # O(dirty) promote evidence rides DeviceState upload bytes,
+            # this counter only shows full-seed vs dirty-suffix shape.
+            # Computed only when someone is listening (an O(cluster)
+            # serialization per full seed otherwise).
+            nbytes = len(json.dumps(payload).encode())
+            if self.metrics is not None:
+                self.metrics.standby_resync_bytes.inc(kind,
+                                                      value=float(nbytes))
+            telemetry.event("replication", endpoint=rep.endpoint,
+                            seq=target_seq, nodes=len(entries),
+                            removed=len(removed), full=full, bytes=nbytes)
+        return 1
+
+    def _repl_restore(self, rep: _Replica, backup, full: bool) -> None:
+        """Give a failed round's dirty snapshot back (union — new dirt may
+        have accrued meanwhile). A failed FULL push keeps needs_full."""
+        with self._repl_cv:
+            if full:
+                rep.repl_needs_full = True
+            elif backup is not None:
+                dirty, removed, ns_dirty = backup
+                rep.repl_dirty |= dirty
+                rep.repl_removed |= removed
+                rep.repl_ns_dirty |= ns_dirty
+
+    def _repl_signal(self) -> None:
+        with self._repl_cv:
+            self._repl_pending = True
+            self._repl_cv.notify_all()
+
+    def _repl_keep_warm(self, rep: _Replica, now: float) -> None:
+        """Lease keep-warm heartbeats to a standby: the replicator's own
+        session (whose node claims keep the warm DeviceState alive through
+        the promote-time ghost sweep) and the scheduler client's session
+        (sessionGen-stripped — the standby owns its generation; a live
+        lease is what prevents the first post-failover commit from being
+        fenced). Rate-limited on the injected clock."""
+        if now - rep.repl_hb_at < self.probe_interval_s:
+            return
+        rep.repl_hb_at = now
+        for cid in (self._repl_client_id, self._client_hb):
+            if not cid:
+                continue
+            payload = {"apiVersion": API_VERSION, "clientId": cid}
+            if cid == self._repl_client_id:
+                payload["replicator"] = True
+                if rep.repl_session_gen is not None:
+                    payload["sessionGen"] = rep.repl_session_gen
+            try:
+                out = rep.probe.heartbeat(payload)
+            except ConflictError:
+                if cid == self._repl_client_id:
+                    rep.repl_session_gen = None
+                continue
+            except DeviceServiceError as exc:
+                rep.repl_last_error = f"{type(exc).__name__}: {exc}"
+                rep.repl_backoff_until = (self.now_fn()
+                                          + self.probe_interval_s)
+                return
+            if cid == self._repl_client_id:
+                rep.repl_session_gen = out.get("sessionGen",
+                                               rep.repl_session_gen)
+
+    def _update_repl_lag(self) -> None:
+        if self.metrics is None:
+            return
+        with self._repl_cv:
+            seq = self._repl_seq
+        with self._lock:
+            active = self._active
+        for rep in self.replicas:
+            lag = 0 if rep.index == active else max(
+                0, seq - rep.repl_synced_seq)
+            self.metrics.standby_replication_lag.set(rep.endpoint,
+                                                     value=lag)
+
+    def replication_lag(self, rep: _Replica) -> int:
+        """Delta generations ``rep``'s mirror lags the primary stream."""
+        with self._repl_cv:
+            return max(0, self._repl_seq - rep.repl_synced_seq)
+
     # --------------------------------------------------------------- debug
 
     def dump(self) -> dict:
-        """/debug/fabric body: replica table + bounded failover journal."""
+        """/debug/fabric body: replica table + bounded failover journal +
+        the warm-standby replication state."""
         with self._lock:
             active = self._active
             failovers = self.failovers
             log = list(self.log)
+        with self._repl_cv:
+            repl_seq = self._repl_seq
         replicas = []
         for rep in self.replicas:
             replicas.append({
@@ -351,6 +781,14 @@ class DeviceFabric:
                 "lastBatchId": rep.last_batch_id,
                 "lastError": rep.last_error,
                 "breaker": rep.breaker.dump(),
+                "replication": {
+                    "syncedSeq": rep.repl_synced_seq,
+                    "lag": (0 if rep.index == active
+                            else max(0, repl_seq - rep.repl_synced_seq)),
+                    "needsFull": rep.repl_needs_full,
+                    "pushes": rep.repl_pushes,
+                    "lastError": rep.repl_last_error,
+                },
             })
         return {
             "enabled": True,
@@ -359,6 +797,12 @@ class DeviceFabric:
             "replicaCount": len(self.replicas),
             "failovers": failovers,
             "probeIntervalS": self.probe_interval_s,
+            "replication": {
+                "enabled": self.replication_enabled,
+                "seq": repl_seq,
+                "clientId": self._repl_client_id,
+                "rounds": self.repl_rounds,  # ktpu: unguarded-ok(monotonic int counter; /debug introspection tolerates a torn read)
+            },
             "replicas": replicas,
             "log": log,
         }

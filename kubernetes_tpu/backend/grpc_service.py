@@ -148,6 +148,13 @@ def _deltas_to_proto(payload: dict):
         req.namespaces[ns] = json.dumps(labels).encode()
     req.traceparent = payload.get("traceparent") or ""
     req.expect_epoch = payload.get("expectEpoch") or ""
+    fields = req.DESCRIPTOR.fields_by_name
+    if "inflight_batch_ids" in fields:
+        # pipelined clients: holds from these batches survive owner-content
+        # omission (a stale pb2 just drops them — legacy request/response)
+        req.inflight_batch_ids.extend(payload.get("inflightBatchIds") or ())
+    if "replicator" in fields:
+        req.replicator = bool(payload.get("replicator"))
     _stamp_session_proto(req, payload)
     return req
 
@@ -186,6 +193,11 @@ def _deltas_from_proto(req) -> dict:
         out["traceparent"] = req.traceparent
     if req.expect_epoch:
         out["expectEpoch"] = req.expect_epoch
+    fields = req.DESCRIPTOR.fields_by_name
+    if "inflight_batch_ids" in fields and req.inflight_batch_ids:
+        out["inflightBatchIds"] = list(req.inflight_batch_ids)
+    if "replicator" in fields and req.replicator:
+        out["replicator"] = True
     out.update(_session_from_proto(req))
     return out
 
@@ -368,13 +380,20 @@ def serve_grpc(service, port: int = 0):
         resp = _results_to_proto(out)
         resp.epoch = out.get("epoch", "")
         resp.delta_seq = int(out.get("deltaSeq", 0))
-        if "session_gen" in p.ScheduleBatchResponse.DESCRIPTOR.fields_by_name:
+        fields = p.ScheduleBatchResponse.DESCRIPTOR.fields_by_name
+        if "session_gen" in fields:
             resp.session_gen = int(out.get("sessionGen") or 0)
+        if "batch_id" in fields:
+            resp.batch_id = out.get("batchId") or ""
         return resp
 
     def heartbeat(request, ctx):
+        req_dict = _session_from_proto(request)
+        if ("replicator" in request.DESCRIPTOR.fields_by_name
+                and request.replicator):
+            req_dict["replicator"] = True
         try:
-            out = service.heartbeat(_session_from_proto(request))
+            out = service.heartbeat(req_dict)
         except ConflictError as exc:
             _abort_conflict(ctx, exc)
         resp = p.HeartbeatResponse(
@@ -532,6 +551,10 @@ class GrpcClient:
         if resp.epoch:
             out["epoch"] = resp.epoch
             out["deltaSeq"] = resp.delta_seq
+        if ("batch_id" in resp.DESCRIPTOR.fields_by_name and resp.batch_id):
+            # echoed idempotency key: the pipelined reply router matches
+            # out-of-order replies to their in-flight batches by this id
+            out["batchId"] = resp.batch_id
         return self._session_gen_out(resp, out)
 
     def heartbeat(self, payload: dict) -> dict:
@@ -542,6 +565,9 @@ class GrpcClient:
         req = p.HeartbeatRequest(
             client_id=payload.get("clientId") or "",
             session_gen=int(payload.get("sessionGen") or 0))
+        if ("replicator" in req.DESCRIPTOR.fields_by_name
+                and payload.get("replicator")):
+            req.replicator = True
         resp = self._call("heartbeat", self._heartbeat, req)
         return {"epoch": resp.epoch, "sessionGen": int(resp.session_gen),
                 "sessions": int(resp.sessions),

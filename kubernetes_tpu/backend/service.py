@@ -41,9 +41,10 @@ import socket
 import threading
 import time
 import urllib.parse
+from collections import OrderedDict, deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from types import SimpleNamespace
-from typing import Dict, List, Optional
+from typing import Deque, Dict, List, Optional
 
 import numpy as np
 
@@ -96,18 +97,39 @@ class ClientSession:
     fresh/rejoining client resets only its own slice — other clients' state
     is untouched."""
 
+    # idempotency-cache depth: a PIPELINED client keeps up to K batches in
+    # flight, so a transport retry can be for any of its last K logical
+    # batches, not just the newest (the single-entry cache of the strictly
+    # request/response era). Bounded well above any sane pipeline depth —
+    # a retry falling off this cache is re-COMPUTED, which the ownership
+    # check resolves as the owner re-deciding its own holds (no double
+    # bind), but the replayed-result fast path is lost.
+    IDEMPOTENCY_DEPTH = 32
+
     __slots__ = ("client_id", "gen", "created_at", "last_seen", "delta_seq",
-                 "sent_gens", "last_batch", "batch_replays", "batches",
-                 "fenced", "fenced_seq", "fence_seq_seen", "released_holds")
+                 "sent_gens", "last_batches", "batch_replays", "batches",
+                 "fenced", "fenced_seq", "fence_seq_seen", "released_holds",
+                 "replicator", "last_push_seq")
 
     def __init__(self, client_id: str, gen: int, now: float):
         self.client_id = client_id
+        # warm-standby replication session (DeviceFabric): its node claims
+        # keep the warm DeviceState alive across the promote-time full
+        # resync, but never block another client's ghost sweep — the
+        # replicator mirrors a PAST truth; the resyncing client IS truth
+        self.replicator = False
+        # service delta_seq at this session's last applied push: a
+        # replicator "lapped" by a direct client's full resync (the resync
+        # happened after the replicator's last contact) must reseed — its
+        # next push could re-create nodes the resync swept
+        self.last_push_seq = 0
         self.gen = gen                      # session incarnation (rejoin bumps)
         self.created_at = now
         self.last_seen = now                # lease heartbeat clock
         self.delta_seq = 0
         self.sent_gens: Dict[str, int] = {}  # node -> last gen this client pushed
-        self.last_batch: Optional[tuple] = None  # (batchId, response)
+        # batchId -> response, insertion-ordered, bounded (see above)
+        self.last_batches: "OrderedDict[str, dict]" = OrderedDict()
         self.batch_replays = 0
         self.batches = 0
         self.fenced = False
@@ -115,20 +137,41 @@ class ClientSession:
         self.fence_seq_seen = 0             # fence-log cursor for heartbeats
         self.released_holds = 0
 
+    @property
+    def last_batch(self) -> Optional[tuple]:
+        """(batchId, response) of the NEWEST cached batch (None when the
+        cache is empty/poisoned) — the single-entry era's introspection
+        surface, kept for the fence tests and /debug/sessions."""
+        if not self.last_batches:
+            return None
+        bid = next(reversed(self.last_batches))
+        return (bid, self.last_batches[bid])
+
+    def cache_batch(self, batch_id: str, response: dict) -> None:
+        self.last_batches[batch_id] = response
+        while len(self.last_batches) > self.IDEMPOTENCY_DEPTH:
+            self.last_batches.popitem(last=False)
+
 
 class _Hold:
     """One adopted-but-unconfirmed placement: the device committed the pod
     for ``owner``, but no client's host truth includes it yet. While held,
     every delta for the node re-overlays the pod so another replica's
-    (lagging) push can never erase the capacity and hand it out twice."""
+    (lagging) push can never erase the capacity and hand it out twice.
+    ``batch_id`` names the batch that created it: a PIPELINED owner's delta
+    push may predate its processing of that batch's reply, so omission from
+    the owner's content releases the hold only once the owner no longer
+    lists the batch as in flight."""
 
-    __slots__ = ("pod", "node_name", "owner", "seen")
+    __slots__ = ("pod", "node_name", "owner", "seen", "batch_id")
 
-    def __init__(self, pod: Pod, node_name: str, owner: str):
+    def __init__(self, pod: Pod, node_name: str, owner: str,
+                 batch_id: Optional[str] = None):
         self.pod = pod
         self.node_name = node_name
         self.owner = owner
         self.seen: set = set()  # client ids whose pushed content included it
+        self.batch_id = batch_id
 
 
 class DeviceService:
@@ -170,6 +213,10 @@ class DeviceService:
         # the ownership check's "already bound" index
         self._pod_nodes: Dict[str, str] = {}
         self._node_pod_keys: Dict[str, set] = {}
+        # delta_seq of the most recent DIRECT (non-replicator) full
+        # resync: the lap marker for replicator sessions (see
+        # ClientSession.last_push_seq)
+        self._last_direct_full_seq = 0
         # fence log: (seq, client_id) — heartbeat responses tell survivors
         # which peers were fenced since their last beat
         self._fences: List[tuple] = []
@@ -239,6 +286,8 @@ class DeviceService:
             # its view of its own holds is gone — it must not commit
             raise ConflictError(
                 f"client {cid!r} session {gen} superseded by {s.gen}")
+        if req.get("replicator"):
+            s.replicator = True
         s.last_seen = now
         return s
 
@@ -260,7 +309,7 @@ class DeviceService:
         poison-and-requeue."""
         last_batch_id = s.last_batch[0] if s.last_batch else None
         s.fenced = True
-        s.last_batch = None
+        s.last_batches.clear()  # poison: a zombie retry must never replay
         self._fence_seq += 1
         s.fenced_seq = self._fence_seq
         self._fences.append((self._fence_seq, s.client_id))
@@ -386,8 +435,23 @@ class DeviceService:
             node = from_wire(Node, e["node"])
             pods = [from_wire(Pod, pw) for pw in e.get("pods", ())]
             decoded.append((node, pods, e.get("gen")))
+        # pipelined clients name the batches whose replies they have not
+        # processed yet: holds created by those batches must survive
+        # owner-content omission (the owner's truth CANNOT include them)
+        inflight_ids = set(req.get("inflightBatchIds") or ())
         with self._lock:
             s = self._session_for(req)
+            if s.replicator and self._last_direct_full_seq > s.last_push_seq:
+                # LAPPED replicator: a direct client full-resynced this
+                # service after the replicator's last contact (promote, or
+                # a failback reseed window). Its pending push was built
+                # against a pre-resync world and could re-CREATE nodes the
+                # resync swept — refuse it and demand a fresh full seed
+                # (the fabric's ConflictError handler reseeds). The cursor
+                # advances so the reseed itself is accepted.
+                s.last_push_seq = self.delta_seq
+                raise ConflictError(
+                    "replicator lapped by a direct full resync; reseed")
             if req.get("full"):
                 # full resync replaces THIS client's contribution only. A
                 # mirror node no other live session claims and the full set
@@ -401,18 +465,64 @@ class DeviceService:
                 # full pushes keep the old everything-or-nothing contract
                 others = [o for o in self._live_sessions()
                           if o is not s and o.client_id]
+                # a REPLICATOR session's claims never block the sweep: it
+                # mirrors a past truth, and a node it alone still claims
+                # after a scheduler client's full resync is exactly the
+                # ghost the sweep exists to drop (the fabric's delta
+                # stream repairs the replicator's view separately). It DOES
+                # count for device retention below — dropping the warm
+                # DeviceState at promote would throw the O(dirty) resync
+                # away.
+                claimers = [o for o in others if not o.replicator]
+                if s.replicator:
+                    # a replicator's full RESEED outranks direct claims
+                    # older than its own previous contact (a healed
+                    # ex-active's idle session would otherwise pin its
+                    # stale tenure claims — and their ghost nodes —
+                    # forever); claims refreshed by a newer direct push
+                    # still win (the promote-resync case)
+                    claimers = [o for o in claimers
+                                if o.last_push_seq > s.last_push_seq]
                 for name in list(self.infos):
                     if name in pushed:
                         continue
-                    if any(name in o.sent_gens for o in others):
+                    if any(name in o.sent_gens for o in claimers):
                         continue
                     self._drop_node(name)
+                    for o in others:
+                        o.sent_gens.pop(name, None)
                 if not others:
                     self.ns_labels.clear()
                     self.device = None
             live_ids = {o.client_id for o in self._live_sessions()}
+            # a REPLICATOR mirrors a client's PAST pushes: if a direct
+            # (non-replicator) session has already pushed a node at the
+            # same or a newer generation, the replicator's entry is stale
+            # — skip it. This closes the promote-time race where an
+            # in-flight replication push lands AFTER the promoted
+            # replica's full resync: the client's rows can never be
+            # overwritten backward (worst case a skipped row stays for
+            # the next delta to repair — extra upload bytes, never wrong
+            # truth).
+            direct = ([o for o in self._live_sessions()
+                       if o is not s and not o.replicator and o.client_id]
+                      if s.replicator else [])
+            # ...but only direct sessions that pushed SINCE the
+            # replicator's previous contact outrank the stream wholesale
+            # (removals/sweeps below): a healed ex-active's idle session
+            # keeps stale claims alive forever (its lease is deliberately
+            # kept warm), and deferring to those would strand deleted
+            # nodes in the standby mirror until the next promote.
+            # s.last_push_seq still holds the PREVIOUS contact here — it
+            # advances only after this push applies.
+            direct_newer = [o for o in direct
+                            if o.last_push_seq > s.last_push_seq]
             for node, pods, gen in decoded:
                 name = node.meta.name
+                if s.replicator and gen is not None and any(
+                        o.sent_gens.get(name) is not None
+                        and o.sent_gens[name] >= gen for o in direct):
+                    continue
                 ni = NodeInfo(node)
                 content_keys = set()
                 for pod in pods:
@@ -435,10 +545,16 @@ class DeviceService:
                         hold.seen.add(s.client_id)
                         if live_ids <= hold.seen:
                             del self.holds[key]  # durable in everyone's truth
-                    elif hold.owner == s.client_id:
+                    elif (hold.owner == s.client_id
+                          and not (hold.batch_id
+                                   and hold.batch_id in inflight_ids)):
                         del self.holds[key]      # owner surrendered it
                     else:
-                        ni.add_pod(hold.pod)     # overlay: capacity stays taken
+                        # overlay: capacity stays taken — a peer's unconfirmed
+                        # hold, or the pusher's OWN hold from a batch still in
+                        # flight on its pipelined transport (its truth cannot
+                        # include the placement before it processes the reply)
+                        ni.add_pod(hold.pod)
                 for key in self._node_pod_keys.get(name, ()):
                     # only drop index entries still pointing HERE: a pod
                     # deleted and re-bound elsewhere under the same key has
@@ -451,6 +567,13 @@ class DeviceService:
                     self._pod_nodes[key] = name
                 self.infos[name] = ni
             for name in req.get("removed", ()):
+                if s.replicator and any(name in o.sent_gens
+                                        for o in direct_newer):
+                    # stale replicated removal: a direct client has pushed
+                    # the node SINCE this replicator's previous contact —
+                    # its truth wins
+                    s.sent_gens.pop(name, None)
+                    continue
                 self._drop_node(name)
                 s.sent_gens.pop(name, None)
             # namespace labels ride along so namespaceSelector terms match
@@ -460,6 +583,9 @@ class DeviceService:
             self._sync()
             self.delta_seq += 1
             s.delta_seq += 1
+            s.last_push_seq = self.delta_seq
+            if req.get("full") and not s.replicator and s.client_id:
+                self._last_direct_full_seq = self.delta_seq
             return self._stamp({"apiVersion": API_VERSION,
                                 "nodes": len(self.infos),
                                 "sessionGen": s.gen})
@@ -551,11 +677,13 @@ class DeviceService:
                        "sessionGen": req.get("sessionGen")}
         with self._lock:
             s = self._session_for(session_req)
-            if (batch_id and s.last_batch is not None
-                    and s.last_batch[0] == batch_id):
+            if batch_id and batch_id in s.last_batches:
+                # transport retry of a batch this session already committed
+                # (with pipelining the retry can be for ANY of the last K
+                # batches, not just the newest): replay the stored response
                 s.batch_replays += 1
                 self.batch_replays += 1
-                return s.last_batch[1]
+                return s.last_batches[batch_id]
         pods = [from_wire(Pod, pw) for pw in req.get("pods", ())]
         tie_seeds = req.get("tieSeeds") or None
         # parent the whole server-side batch under the client's
@@ -572,7 +700,7 @@ class DeviceService:
             with self._lock:
                 cur = self.sessions.get(session_req.get("clientId") or "")
                 if cur is not None and not cur.fenced:
-                    cur.last_batch = (batch_id, out)
+                    cur.cache_batch(batch_id, out)
         return out
 
     def _placement_fits(self, ni: NodeInfo, pod: Pod) -> bool:
@@ -629,7 +757,7 @@ class DeviceService:
                                 "(capacity raced)")
                 continue
             ni.add_pod(pod)
-            self.holds[key] = _Hold(pod, node_name, cid)
+            self.holds[key] = _Hold(pod, node_name, cid, batch_id=batch_id)
         if conflicts:
             self.commit_conflicts += len(conflicts)
             for i, reason in conflicts.items():
@@ -823,8 +951,13 @@ class DeviceService:
             # concurrent apply_deltas calls from peer replicas — stamping
             # after release could pair this batch's results with a peer's
             # half-advanced deltaSeq (found by the locks pass)
-            return self._stamp({"apiVersion": API_VERSION, "results": results,
-                                "sessionGen": s.gen})
+            out = {"apiVersion": API_VERSION, "results": results,
+                   "sessionGen": s.gen}
+            if batch_id:
+                # echo the idempotency key: a pipelined client matches
+                # out-of-order replies to their requests by this id
+                out["batchId"] = batch_id
+            return self._stamp(out)
 
 
 # ---------------------------------------------------------------- transport
@@ -898,6 +1031,22 @@ class _Handler(BaseHTTPRequestHandler):
                 # staging a real two-replica collision
                 self._json(409, {"error": "injected conflict",
                                  "conflict": True})
+                return
+            if fault.kind == "torn":
+                # torn mid-stream disconnect: the request is PROCESSED (the
+                # service's state advances — a batch commits, holds land)
+                # but the reply never leaves. The client's transport retry
+                # re-sends the same batchId and the idempotency cache
+                # replays the committed result — the lost-response case.
+                try:
+                    getattr(self.binding.service, op)(body)
+                except Exception:  # noqa: BLE001 — the reply is lost either way
+                    pass
+                self.close_connection = True
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
                 return
             self._json(fault.status,
                        {"error": f"injected fault: {fault.kind}"})
@@ -1053,6 +1202,136 @@ class WireClient:
                           "sessions")
 
 
+# ---------------------------------------------------------------- pipeline
+
+
+class _WireInflight:
+    """One wire batch submitted but whose reply has not been processed —
+    the wire twin of tpu_scheduler._Inflight (a dispatched-but-uncommitted
+    ring entry). ``payload`` is kept whole so a stale-epoch drain can
+    re-send the identical logical batch (same idempotent batchId) after
+    the resync."""
+
+    __slots__ = ("qps", "payload", "batch_id", "pod_cycle", "t0", "t_sent",
+                 "era")
+
+    def __init__(self, qps: List[QueuedPodInfo], payload: dict,
+                 pod_cycle: int, t0: float, t_sent: float, era: int):
+        self.qps = qps
+        self.payload = payload
+        self.batch_id = payload["batchId"]
+        self.pod_cycle = pod_cycle
+        self.t0 = t0          # pop time: the attempt-latency clock
+        self.t_sent = t_sent  # submit time: the sizer's service-span clock
+        self.era = era        # sync era at submit (see _wire_sync_era)
+
+
+class WirePipeline:
+    """Concurrent transport lanes for the pipelined wire path: up to
+    ``depth`` ScheduleBatch calls ride their own connections at once (the
+    "second connection" form of the streaming channel), and every reply is
+    deposited into a completion map keyed by the batchId the server echoes
+    — so replies that arrive OUT OF ORDER, duplicated, or on the wrong
+    lane (testing/faults.py ``reorder``/``dup_reply``) still route to
+    exactly the in-flight batch they answer.
+
+    Lane threads run ONLY transport work (``send_fn`` — the full
+    retry/taxonomy client call); every scheduler-state mutation (commit,
+    resync, requeue, breaker) stays on the scheduling thread, which blocks
+    in ``claim`` for the batch it wants next. Lanes are spawned on demand
+    and exit when the submit queue drains — no idle threads linger."""
+
+    OP = "schedule_batch"
+
+    def __init__(self, send_fn, depth: int, fault_plan=None):
+        self._send = send_fn
+        self.depth = max(1, int(depth))
+        self.fault_plan = fault_plan
+        self._cv = threading.Condition(locktrace.make_lock("WirePipeline"))
+        self._submitted: Deque[dict] = deque()
+        # batchId -> ("ok", reply) | ("err", exc); claimable while expected
+        self._completions: Dict[str, tuple] = {}
+        self._expected: set = set()
+        self._lanes = 0
+        self.duplicate_replies = 0  # late/duplicate/foreign deliveries dropped
+
+    def submit(self, payload: dict) -> None:
+        with self._cv:
+            self._expected.add(payload["batchId"])
+            self._submitted.append(payload)
+            if self._lanes < self.depth:
+                self._lanes += 1
+                threading.Thread(target=self._lane, name="ktpu-wire-lane",
+                                 daemon=True).start()
+
+    def claim(self, batch_id: str, timeout: Optional[float] = None):
+        """Block until the reply for ``batch_id`` arrives, then return it
+        (or raise the transport error that ended its call). The wait is on
+        the COMPLETION of that id, not on any particular lane — replies
+        for newer batches landing first are simply left for their own
+        claims (out-of-order tolerated by construction)."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: batch_id in self._completions,  # ktpu: unguarded-ok(wait_for predicate is evaluated by Condition with its lock held)
+                timeout=timeout)
+            self._expected.discard(batch_id)
+            outcome = self._completions.pop(batch_id, None)
+        if outcome is None:
+            raise TransientDeviceError(
+                f"pipelined reply for batch {batch_id} never arrived")
+        kind, value = outcome
+        if kind == "err":
+            raise value
+        return value
+
+    def inflight(self) -> int:
+        with self._cv:
+            return len(self._expected)
+
+    # ------------------------------------------------------------ internals
+
+    def _lane(self) -> None:
+        while True:
+            with self._cv:
+                if not self._submitted:
+                    self._lanes -= 1
+                    return
+                payload = self._submitted.popleft()
+            sent_id = payload["batchId"]
+            fault = (self.fault_plan.next_reply(self.OP)
+                     if self.fault_plan is not None else None)
+            try:
+                out = self._send(payload)
+            except BaseException as exc:  # noqa: BLE001 — routed, not raised here
+                # transport errors carry no reply id: they belong to the
+                # batch THIS lane was sending
+                self._deposit(sent_id, ("err", exc))
+                continue
+            if (fault is not None and fault.kind == "reorder"
+                    and fault.rendezvous is not None):
+                # scripted cross-lane delivery: this lane receives the
+                # OTHER call's reply — the router below must still pair it
+                # with the right in-flight batch via the echoed batchId
+                out = fault.rendezvous.swap(out)
+            reply_id = out.get("batchId") or sent_id
+            self._deposit(reply_id, ("ok", out))
+            if fault is not None and fault.kind == "dup":
+                self._deposit(reply_id, ("ok", out))  # duplicated delivery
+
+    def _deposit(self, batch_id: str, outcome: tuple) -> None:
+        with self._cv:
+            if batch_id not in self._expected or batch_id in self._completions:
+                # a reply nobody is (still) waiting on: a duplicate
+                # delivery, a reply after its claim, or a foreign id —
+                # dropping it is the only safe move (idempotent batchIds
+                # mean the real reply was or will be processed exactly once)
+                self.duplicate_replies += 1
+                telemetry.event("pipeline_dup_reply", batchId=batch_id)
+                return
+            self._completions[batch_id] = outcome
+            self._cv.notify_all()
+
+
 # ---------------------------------------------------------------- scheduler
 
 
@@ -1070,6 +1349,9 @@ class WireScheduler(Scheduler):
                  client_id: Optional[str] = None,
                  heartbeat_interval_s: float = 5.0,
                  fabric_probe_interval_s: float = 5.0,
+                 wire_pipeline_depth: Optional[int] = None,
+                 batch_deadline_ms: Optional[float] = None,
+                 standby_replication: bool = True,
                  fault_plan=None, sleep_fn=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.retry_policy = RetryPolicy(
@@ -1125,7 +1407,10 @@ class WireScheduler(Scheduler):
                 probe_client_factory=lambda ep, i: make_client(
                     ep, plans[i], retry=probe_retry),
                 metrics=self.smetrics, now_fn=self.now_fn,
-                probe_interval_s=fabric_probe_interval_s)
+                probe_interval_s=fabric_probe_interval_s,
+                # warm standbys: background delta fan-out so a promoted
+                # standby resyncs O(dirty) instead of O(cluster)
+                replication=standby_replication)
         else:
             # single-replica fast path: the plain transport client, zero
             # fabric indirection on the per-batch hot path
@@ -1176,6 +1461,51 @@ class WireScheduler(Scheduler):
         from .claim_mask import ClaimMaskBuilder
 
         self._claim_masks = ClaimMaskBuilder(self.store)
+        # ---- pipelined wire transport (ROADMAP item 2, wire half) ----
+        # Up to K logical batches ride the wire at once, each on its own
+        # connection lane, replies matched by the server-echoed batchId —
+        # the wire twin of the in-process in-flight ring (_Inflight/
+        # _drain_inflight): batch K's server-side device work overlaps
+        # batch K-1's host commit AND the next pop/encode, instead of the
+        # strictly request/response transport forfeiting the overlap.
+        # Depth semantics mirror KTPU_PIPELINE_DEPTH; 0 = synchronous.
+        # Default 3: the wire RTT is long relative to host work, so the
+        # wire ring runs one deeper than the in-process default (bench
+        # A/B: depth 3 > 2 > 0 on both transports at iso-conditions).
+        if wire_pipeline_depth is None:
+            if os.environ.get("KTPU_WIRE_PIPELINE", "1") == "0":
+                wire_pipeline_depth = 0
+            else:
+                wire_pipeline_depth = max(0, int(os.environ.get(
+                    "KTPU_WIRE_PIPELINE_DEPTH", "3")))
+        self.wire_pipeline_depth = wire_pipeline_depth
+        self._wire_inflight: Deque[_WireInflight] = deque()
+        self._wire_pipeline: Optional[WirePipeline] = None
+        if wire_pipeline_depth:
+            # lanes run the raw transport call only (full retry/taxonomy);
+            # every recovery move — resync, rejoin, requeue, breaker —
+            # happens at claim time on the scheduling thread
+            self._wire_pipeline = WirePipeline(
+                self.client.schedule_batch, wire_pipeline_depth,
+                fault_plan=plans[0] if len(endpoints) == 1 else None)
+        self.pipelined_wire_batches = 0
+        # sync ERA: bumped by every full resync and session rejoin. A
+        # pipelined reply completed before the bump carries epoch/session
+        # stamps of the pre-resync world — its RESULTS are valid (the
+        # server committed them under a then-live session), but adopting
+        # its stamps would regress the freshly-learned epoch/sessionGen
+        self._wire_sync_era = 0
+        # the stall-aware sizer, reused from the in-process ring: the
+        # controlled quantity is the same pop→processed attempt latency,
+        # and the claim-blocked residual feeds the stall model so the
+        # batch size settles where wire round-trip time balances the
+        # overlapped host window
+        from .sizer import BatchSizer
+
+        if batch_deadline_ms is None:
+            batch_deadline_ms = float(os.environ.get(
+                "KTPU_BATCH_DEADLINE_MS", "500"))
+        self.wire_sizer = BatchSizer(batch_size, batch_deadline_ms / 1000.0)
 
     # ------------------------------------------------------- degraded mode
 
@@ -1262,6 +1592,7 @@ class WireScheduler(Scheduler):
         payload = {"apiVersion": API_VERSION, "nodes": entries,
                    "removed": removed, "namespaces": namespaces}
         self._stamp_session(payload)
+        self._stamp_inflight(payload)
         if self._device_epoch:
             payload["expectEpoch"] = self._device_epoch
         else:
@@ -1296,6 +1627,7 @@ class WireScheduler(Scheduler):
         holds and ship the complete host truth as one ``full`` delta (the
         informer relist of the crash-only contract, pointed at the device)."""
         self.resyncs += 1
+        self._wire_sync_era += 1
         self._sent_gens.clear()
         self._pushed_nodes.clear()
         self._sent_ns.clear()
@@ -1312,6 +1644,7 @@ class WireScheduler(Scheduler):
         payload = {"apiVersion": API_VERSION, "full": True, "nodes": entries,
                    "removed": [], "namespaces": namespaces}
         self._stamp_session(payload)
+        self._stamp_inflight(payload)
         tp = tracing.format_traceparent()
         if tp:
             payload["traceparent"] = tp
@@ -1331,12 +1664,22 @@ class WireScheduler(Scheduler):
         else:
             payload.pop("sessionGen", None)  # re-stamp after a rejoin
 
+    def _stamp_inflight(self, payload: dict) -> None:
+        """Name the batches whose replies this client has not yet processed
+        (pipelined transport): the service must keep their commit holds
+        alive through this push's owner-content reconciliation — our truth
+        cannot include placements we have not seen yet."""
+        if self._wire_inflight:
+            payload["inflightBatchIds"] = [e.batch_id
+                                           for e in self._wire_inflight]
+
     def _session_rejoin(self) -> None:
         """This incarnation was fenced (or superseded): forget the session
         AND everything we believe the service holds for us, so the next
         push re-establishes a fresh session with a full resync — the
         scheduler-side twin of the stale-epoch recovery."""
         self.session_rejoins += 1
+        self._wire_sync_era += 1
         self._session_gen = None
         self._device_epoch = None
         self._sent_gens.clear()
@@ -1403,8 +1746,23 @@ class WireScheduler(Scheduler):
             self.informer_factory.pump()  # see TPUScheduler: the batched
             # loop pumps the informer bus exactly like schedule_one
         self._periodic_housekeeping()
-        qps = self.queue.pop_batch(self.batch_size)
+        # the stall-aware sizer bounds the SYNCHRONOUS pop exactly like
+        # the in-process ring's cycle (deadline-cut batches keep the
+        # pop→processed p99 inside the budget). The PIPELINED pop takes
+        # the full batch: the server serializes batches under its service
+        # lock, so a pipelined batch's latency is dominated by its ~K-cycle
+        # ring dwell — cutting the batch cannot shorten it (measured: the
+        # deadline model collapses the target to min_batch and costs ~2.5x
+        # wire throughput); the latency lever there is the DEPTH, and the
+        # sizer keeps recording spans/waits as evidence.
+        target = (self.batch_size if self._wire_pipeline is not None
+                  else min(self.batch_size, self.wire_sizer.target()))
+        qps = self.queue.pop_batch(target)
         if not qps:
+            # nothing new to overlap with: land the in-flight wire batches
+            # so their binds/failures settle before the caller judges
+            # settlement (the ring's empty-pop drain, on the wire)
+            self._drain_wire_inflight()
             return 0
         t0 = self.now_fn()
         pod_cycle = self.queue.scheduling_cycle
@@ -1444,9 +1802,11 @@ class WireScheduler(Scheduler):
                 buffer.append(qp)
                 continue
             # strict pop order: flush the wire batch before a fallback pod so
-            # a lower-priority local pod never jumps a batched one
+            # a lower-priority local pod never jumps a batched one — and
+            # land everything in flight first (same rule on the pipeline)
             self._flush_wire(buffer, pod_cycle, t0)
             buffer = []
+            self._drain_wire_inflight()
             self.cache.update_snapshot(self.snapshot)
             self.schedule_one_pod(qp, pod_cycle)
         self._flush_wire(buffer, pod_cycle, t0)
@@ -1464,9 +1824,12 @@ class WireScheduler(Scheduler):
 
     def _flush_wire_traced(self, batch: List[QueuedPodInfo], pod_cycle: int, t0: float) -> None:
         if not self.breaker.allow():
-            # breaker open: the device is presumed down — route the whole
-            # batch through the sequential oracle path (scheduling never
-            # stops); the next allow() past the reset timeout probes
+            # breaker open: the device is presumed down — land what is
+            # already in flight (the entries fail with their own errors and
+            # requeue), then route the whole batch through the sequential
+            # oracle path (scheduling never stops); the next allow() past
+            # the reset timeout probes
+            self._drain_wire_inflight()
             self._accrue_degraded()
             self._schedule_degraded(batch, pod_cycle)
             return
@@ -1487,6 +1850,23 @@ class WireScheduler(Scheduler):
                 return
         try:
             self._push_deltas()
+            if self._wire_pipeline is not None:
+                # pipelined: the batch rides a transport lane; replies are
+                # claimed oldest-first once the ring exceeds its depth, so
+                # K batches stay in flight across the wire while this
+                # thread pops/encodes the next one
+                payload = self._build_batch_payload(batch)
+                entry = _WireInflight(batch, payload, pod_cycle, t0,
+                                      self.now_fn(), self._wire_sync_era)
+                self._wire_inflight.append(entry)
+                if len(self._wire_inflight) > 1:
+                    self.pipelined_wire_batches += 1
+                self.smetrics.wire_inflight.set(
+                    value=len(self._wire_inflight))
+                self._wire_pipeline.submit(payload)
+                while len(self._wire_inflight) > self.wire_pipeline_depth:
+                    self._drain_oldest_wire()
+                return
             res = self._wire_schedule_batch(batch)
         except ConflictError as exc:
             # fenced session / cross-client race: the service is HEALTHY, so
@@ -1494,34 +1874,137 @@ class WireScheduler(Scheduler):
             # session and give the pods back to the backoffQ — the next
             # attempt runs on a clean session against whatever the winning
             # replica left behind.
-            self.smetrics.commit_conflicts.inc(self.client_id)
-            telemetry.event("conflict", client=self.client_id,
-                            pods=len(batch), reason=str(exc)[:200])
-            self._session_rejoin()
-            self._requeue_wire_failure(batch, exc, pod_cycle, t0)
+            self._wire_conflict(batch, exc, pod_cycle, t0)
             return
         except DeviceServiceError as exc:
-            # deliberately counts PERMANENT errors too: a deterministically
-            # broken device (version skew answering 4xx forever) should open
-            # the breaker and degrade to the oracle — the alternative is an
-            # endless requeue→fail loop with zero wire throughput. The
-            # breaker's lastError (/debug/circuit) keeps the bug visible.
-            self.breaker.record_failure(exc)
-            if self.breaker.state == OPEN:
-                # threshold crossed (or a failed half-open probe): degrade
-                # THIS batch immediately rather than bouncing it off backoff
-                self._accrue_degraded()
-                self._schedule_degraded(batch, pod_cycle)
-            else:
-                # breaker still counting: rate-limited requeue — the pods
-                # re-enter via the backoff queue with their attempt counts,
-                # never hot-looping the active queue
-                self._requeue_wire_failure(batch, exc, pod_cycle, t0)
+            self._wire_transport_failure(batch, exc, pod_cycle, t0)
             return
         self.breaker.record_success()
         self._process_wire_results(batch, res, pod_cycle, t0)
+        # feed the deadline model on the synchronous path too — it is the
+        # mode whose pop the sizer actually cuts, so it must observe real
+        # pop→processed spans (not run forever on its seeds)
+        bucket = self.wire_sizer.bucket_for(len(batch))
+        self.wire_sizer.update(bucket, self.now_fn() - t0)
 
-    def _wire_schedule_batch(self, batch: List[QueuedPodInfo]) -> dict:
+    def _wire_conflict(self, batch: List[QueuedPodInfo], exc: Exception,
+                       pod_cycle: int, t0: float) -> None:
+        """Typed conflict verdict (fenced session / cross-client race):
+        rejoin + backoffQ requeue, never a breaker count — identical for
+        the synchronous path and a pipelined entry's claimed reply."""
+        self.smetrics.commit_conflicts.inc(self.client_id)
+        telemetry.event("conflict", client=self.client_id,
+                        pods=len(batch), reason=str(exc)[:200])
+        self._session_rejoin()
+        self._requeue_wire_failure(batch, exc, pod_cycle, t0)
+
+    def _wire_transport_failure(self, batch: List[QueuedPodInfo],
+                                exc: Exception, pod_cycle: int,
+                                t0: float,
+                                batch_id: Optional[str] = None) -> None:
+        """Transport-failure tail shared by both paths. Deliberately counts
+        PERMANENT errors too: a deterministically broken device (version
+        skew answering 4xx forever) should open the breaker and degrade to
+        the oracle — the alternative is an endless requeue→fail loop with
+        zero wire throughput. The breaker's lastError (/debug/circuit)
+        keeps the bug visible."""
+        self.breaker.record_failure(exc)
+        if self.breaker.state == OPEN:
+            # threshold crossed (or a failed half-open probe): degrade
+            # THIS batch immediately rather than bouncing it off backoff
+            self._accrue_degraded()
+            self._schedule_degraded(batch, pod_cycle)
+        else:
+            # breaker still counting: rate-limited requeue — the pods
+            # re-enter via the backoff queue with their attempt counts,
+            # never hot-looping the active queue
+            self._requeue_wire_failure(batch, exc, pod_cycle, t0,
+                                       batch_id=batch_id)
+
+    # ------------------------------------------------------ pipelined drain
+
+    def _drain_wire_inflight(self) -> int:
+        """Land every in-flight wire batch, oldest first — the wire twin of
+        the ring's _drain_inflight: the synchronization point before
+        fallback pods, degraded mode, and settlement judgment."""
+        n = 0
+        while self._wire_inflight:
+            n += self._drain_oldest_wire()
+        return n
+
+    def _drain_oldest_wire(self) -> int:
+        """Claim and process the OLDEST in-flight batch's reply. Replies
+        arriving out of order are matched by batchId inside the pipeline's
+        completion router; recovery (stale resync + re-send, conflict
+        rejoin, breaker/requeue) runs here on the scheduling thread with
+        semantics identical to the synchronous path."""
+        entry = self._wire_inflight.popleft()
+        self.smetrics.wire_inflight.set(value=len(self._wire_inflight))
+        batch, pod_cycle, t0 = entry.qps, entry.pod_cycle, entry.t0
+        t_wait0 = self.now_fn()
+        try:
+            try:
+                res = self._wire_pipeline.claim(entry.batch_id)
+                # adopt the reply's epoch/session only when no resync or
+                # rejoin happened since this batch was SUBMITTED (the sync
+                # era matches): an earlier entry's drain may have moved to
+                # a fresh incarnation/session while this (older) reply was
+                # already complete — re-adopting its stamps would cost a
+                # spurious second full resync on the next push, or restore
+                # a superseded sessionGen that then reads as a zombie
+                if entry.era == self._wire_sync_era:
+                    ep = res.get("epoch")
+                    if ep:
+                        self._device_epoch = ep
+                        self._session_gen = res.get("sessionGen",
+                                                    self._session_gen)
+            except StaleEpochError as exc:
+                # the device restarted (or a fabric failover promoted a
+                # fresh standby) while this batch was in flight: re-seed
+                # via the existing full resync — unless an earlier entry's
+                # drain ALREADY resynced to exactly this epoch (K in-flight
+                # batches all bounce off the same restart; one O(cluster)
+                # resync suffices) — then re-send the SAME logical batch
+                # (same idempotent batchId — nothing can double-commit)
+                # through the bounded stale-retry loop
+                if not (exc.epoch and exc.epoch == self._device_epoch):
+                    self._full_resync(exc.epoch)
+                self._restamp_batch_payload(entry.payload)
+                res = self._send_batch_payload(entry.payload)
+        except ConflictError as exc:
+            self._wire_conflict(batch, exc, pod_cycle, t0)
+            return len(batch)
+        except DeviceServiceError as exc:
+            # the in-flight batch died with its transport (replica loss,
+            # torn stream, retry budget exhausted): the typed poison —
+            # requeue via backoffQ exactly like in-process ring poison,
+            # zero replays thanks to the per-client idempotent batchId
+            telemetry.event("pipeline_poison", batchId=entry.batch_id,
+                            pods=len(batch),
+                            error=f"{type(exc).__name__}: {exc}"[:200])
+            self._wire_transport_failure(batch, exc, pod_cycle, t0,
+                                         batch_id=entry.batch_id)
+            return len(batch)
+        wait = self.now_fn() - t_wait0
+        self.breaker.record_success()
+        self._process_wire_results(batch, res, pod_cycle, t0)
+        # stall-aware sizing, the in-process ring's controller: the span
+        # fed is the batch's SERVICE time (submit → claimed), not its full
+        # pop→processed attempt latency — a pipelined batch deliberately
+        # dwells ~K cycles in the ring, and feeding that dwell into the
+        # a+b·B fit reads as per-pod cost and collapses the target (a
+        # measured 2.5x wire-throughput loss). The claim-blocked residual
+        # still feeds the stall model, capping the batch where wire
+        # latency outruns the overlapped host window.
+        bucket = self.wire_sizer.bucket_for(len(batch))
+        self.wire_sizer.update(bucket, self.now_fn() - entry.t_sent)
+        self.wire_sizer.update_wait(bucket, wait)
+        return len(batch)
+
+    def _build_batch_payload(self, batch: List[QueuedPodInfo]) -> dict:
+        """The ScheduleBatch request for one logical batch, stamped with a
+        fresh idempotent batchId — the one payload shape shared by the
+        synchronous path, the pipelined lanes, and stale-epoch re-sends."""
         from ..ops.tiebreak import seeds_for
         from .claim_mask import wire_claims_for_batch
 
@@ -1538,6 +2021,12 @@ class WireScheduler(Scheduler):
             payload["traceparent"] = tp
         if self._device_epoch:
             payload["expectEpoch"] = self._device_epoch
+        return payload
+
+    def _send_batch_payload(self, payload: dict) -> dict:
+        """Send one batch payload with the bounded stale-epoch recovery
+        loop; commits epoch/session learned from the response. Runs on the
+        SCHEDULING thread only (resync/rejoin mutate scheduler state)."""
         # device restarted between the delta push and this batch (or again
         # mid-recovery — a crash-looping sidecar): each stale answer costs
         # one cheap full resync, bounded so a restart storm falls through to
@@ -1552,14 +2041,22 @@ class WireScheduler(Scheduler):
                 if stale_retries > 2:
                     raise
                 self._full_resync(exc.epoch)
-                if self._device_epoch:
-                    payload["expectEpoch"] = self._device_epoch
-                else:
-                    payload.pop("expectEpoch", None)
-                self._stamp_session(payload)  # resync may have re-joined
+                self._restamp_batch_payload(payload)
         self._device_epoch = res.get("epoch", self._device_epoch)
         self._session_gen = res.get("sessionGen", self._session_gen)
         return res
+
+    def _restamp_batch_payload(self, payload: dict) -> None:
+        """Refresh a payload's epoch/session stamps after a resync or
+        rejoin changed them (the batchId stays — same logical batch)."""
+        if self._device_epoch:
+            payload["expectEpoch"] = self._device_epoch
+        else:
+            payload.pop("expectEpoch", None)
+        self._stamp_session(payload)
+
+    def _wire_schedule_batch(self, batch: List[QueuedPodInfo]) -> dict:
+        return self._send_batch_payload(self._build_batch_payload(batch))
 
     def _schedule_degraded(self, batch: List[QueuedPodInfo], pod_cycle: int) -> None:
         telemetry.event("degrade", client=self.client_id, pods=len(batch),
@@ -1570,8 +2067,10 @@ class WireScheduler(Scheduler):
             self.schedule_one_pod(qp, pod_cycle)
 
     def _requeue_wire_failure(self, batch: List[QueuedPodInfo],
-                              exc: Exception, pod_cycle: int, t0: float) -> None:
+                              exc: Exception, pod_cycle: int, t0: float,
+                              batch_id: Optional[str] = None) -> None:
         telemetry.event("requeue", client=self.client_id, pods=len(batch),
+                        batchId=batch_id,
                         error=f"{type(exc).__name__}: {exc}"[:200])
         for qp in batch:
             fwk = self.framework_for_pod(qp.pod)
@@ -1785,13 +2284,19 @@ class WireScheduler(Scheduler):
         return dump()
 
     def debug_circuit(self) -> dict:
-        """/debug/circuit body: breaker state + resync/degradation story."""
+        """/debug/circuit body: breaker state + resync/degradation story +
+        the pipelined-transport occupancy."""
         out = self.breaker.dump()
         out.update({
             "enabled": True,
             "deviceEpoch": self._device_epoch,
             "resyncs": self.resyncs,
             "degradedPods": self.degraded_pods,
+            "wirePipelineDepth": self.wire_pipeline_depth,
+            "wireInflight": len(self._wire_inflight),
+            "pipelinedBatches": self.pipelined_wire_batches,
+            "duplicateReplies": (self._wire_pipeline.duplicate_replies
+                                 if self._wire_pipeline is not None else 0),
             "retryPolicy": {
                 "maxRetries": self.retry_policy.max_retries,
                 "backoffBase": self.retry_policy.backoff_base,

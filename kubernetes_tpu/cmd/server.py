@@ -406,11 +406,15 @@ class SchedulerApp:
     def __init__(self, store: ClusterStore, raw_config: Optional[dict] = None,
                  identity: str = "kube-scheduler-0", port: int = 0,
                  feature_gates: str = "", tpu: bool = False,
-                 device_endpoints=None):
+                 device_endpoints=None, wire_pipeline_depth=None):
         self.cfg = load_config(raw_config)
         self.store = store
+        extra = ({"wire_pipeline_depth": wire_pipeline_depth}
+                 if device_endpoints and wire_pipeline_depth is not None
+                 else {})
         self.sched = setup(store, cfg=self.cfg, feature_gates=feature_gates,
-                           tpu=tpu, device_endpoints=device_endpoints)
+                           tpu=tpu, device_endpoints=device_endpoints,
+                           **extra)
         self.elector = LeaderElector(
             store,
             LeaderElectionConfig(
@@ -480,6 +484,10 @@ def main(argv=None) -> int:
                         help="serve N in-process DeviceService bindings and "
                              "point the scheduler at all of them — the "
                              "single-binary fabric demo topology")
+    parser.add_argument("--wire-pipeline-depth", type=int, default=None,
+                        help="wire batches kept in flight on the pipelined "
+                             "transport (default: KTPU_WIRE_PIPELINE_DEPTH "
+                             "or 3; 0 = strictly request/response)")
     args = parser.parse_args(argv)
 
     raw = None
@@ -507,7 +515,8 @@ def main(argv=None) -> int:
               f"bindings: {', '.join(endpoints[-len(device_servers):])}")
     app = SchedulerApp(store, raw_config=raw, port=args.port,
                        feature_gates=args.feature_gates,
-                       device_endpoints=endpoints or None)
+                       device_endpoints=endpoints or None,
+                       wire_pipeline_depth=args.wire_pipeline_depth)
     if args.simulate:
         from ..api.wrappers import make_node, make_pod
 

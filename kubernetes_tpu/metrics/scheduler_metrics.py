@@ -179,6 +179,27 @@ class SchedulerMetrics:
             "Device-service replica health by endpoint (1 up, 0 down).",
             ["endpoint"],
         ))
+        # pipelined wire transport + warm-standby replication: wire batches
+        # submitted but not yet processed (the wire ring's occupancy), how
+        # many delta generations each standby's mirror lags the primary
+        # stream, and the wire bytes the background replicator shipped to
+        # standbys (full seeds vs incremental deltas) — the denominator of
+        # the O(dirty)-resync-at-promote evidence
+        self.wire_inflight = r.register(Gauge(
+            "scheduler_wire_inflight",
+            "Wire batches in flight on the pipelined transport.",
+        ))
+        self.standby_replication_lag = r.register(Gauge(
+            "scheduler_standby_replication_lag",
+            "Delta generations a standby replica lags the primary stream.",
+            ["endpoint"],
+        ))
+        self.standby_resync_bytes = r.register(Counter(
+            "scheduler_standby_resync_bytes_total",
+            "Wire bytes shipped to standbys by the background replicator "
+            "(full = seed/reseed, delta = incremental dirty set).",
+            ["kind"],
+        ))
         # device-runtime observability (backend/telemetry.py): XLA compile
         # ledger per (program, bucket signature) with retrace counts (a
         # compile beyond a program's first — the BatchSizer's bucket walk

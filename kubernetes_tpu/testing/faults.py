@@ -24,6 +24,25 @@ detector never catches), ``slow()`` injects persistent per-call latency
 (below the read deadline = laggy-but-live, at/above = dead), ``kill()``
 persistently drops everything, and ``heal()`` lifts persistent faults.
 
+Stream-level primitives (the pipelined-transport failure modes — K batches
+in flight, replies matched by batchId):
+
+  * ``torn(op)`` — server side: the request is PROCESSED (the service
+    commits) but the connection is severed before the reply leaves — the
+    lost-response case whose only safe recovery is the idempotent-batchId
+    replay. Distinct from ``crash``: the service survives with its state.
+  * ``dup_reply(op)`` — reply side: the reply is DELIVERED TWICE into the
+    pipelined reply router (a retransmit duplicate); the router must drop
+    the second copy by batchId, never double-process.
+  * ``reorder(op)`` — reply side: the next TWO replies swap delivery order
+    across pipeline lanes (each lane receives the OTHER call's reply), so
+    the router's match-by-batchId is exercised for real, not incidentally.
+
+Reply-side faults live in their own queue (side=``reply``) and are
+consumed by the pipelined transport's reply router (``next_reply``), never
+by ``raise_injected_fault`` — a request-side script cannot accidentally
+swallow them.
+
 Every consumed fault is appended to ``log`` so tests assert the script
 actually fired. Thread-safe: handler threads and the scheduling thread
 consume concurrently.
@@ -41,14 +60,41 @@ ANY = "*"
 
 CLIENT = "client"
 SERVER = "server"
+REPLY = "reply"
+
+
+class _Rendezvous:
+    """Two-party reply swap: each party deposits its reply and receives the
+    OTHER party's. The first arrival waits (bounded) for the second; if the
+    partner never comes — the script fired but only one call happened — the
+    party falls back to its own reply so a test bug reads as an assertion
+    failure, not a hang."""
+
+    def __init__(self, timeout_s: float = 10.0):
+        self.cv = threading.Condition()
+        self.slots: List[object] = []
+        self.timeout_s = timeout_s
+
+    def swap(self, reply):
+        with self.cv:
+            idx = len(self.slots)
+            self.slots.append(reply)
+            if idx == 0:
+                self.cv.wait_for(lambda: len(self.slots) >= 2,
+                                 timeout=self.timeout_s)
+                return self.slots[1] if len(self.slots) >= 2 else reply
+            self.cv.notify_all()
+            return self.slots[0]
 
 
 @dataclasses.dataclass
 class Fault:
-    kind: str            # "error" | "delay" | "drop" | "crash"
+    kind: str            # "error" | "delay" | "drop" | "crash" | "conflict"
+    #                    # | "torn" | "dup" | "reorder"
     count: int = 1       # calls this fault applies to; -1 = persistent
     seconds: float = 0.0  # injected latency ("delay" only)
     status: int = 503    # HTTP status for server-side "error"
+    rendezvous: object = None  # "reorder" only: the two-party reply swap
 
     @property
     def persistent(self) -> bool:
@@ -96,6 +142,29 @@ class FaultPlan:
         """Server answers 409 + ``conflict: true`` — the cross-client race
         verdict, scriptable without staging a real two-replica collision."""
         return self.inject(op, Fault("conflict", count=count), side=SERVER)
+
+    # ------------------------------------------------- stream-level primitives
+
+    def torn(self, op: str = ANY, count: int = 1) -> "FaultPlan":
+        """Torn mid-stream disconnect: the server PROCESSES the request
+        (state committed) but the connection dies before the reply leaves.
+        The client sees a transport error for work that actually happened —
+        recovery is the transport retry hitting the idempotent-batchId
+        replay, never a re-commit."""
+        return self.inject(op, Fault("torn", count=count), side=SERVER)
+
+    def dup_reply(self, op: str = ANY, count: int = 1) -> "FaultPlan":
+        """Duplicated delivery: the reply router receives the same reply
+        twice (a retransmit duplicate on the stream). The router must drop
+        the second copy by batchId."""
+        return self.inject(op, Fault("dup", count=count), side=REPLY)
+
+    def reorder(self, op: str = ANY) -> "FaultPlan":
+        """Reordered replies: the next TWO calls' replies swap delivery
+        lanes — each pipeline lane receives the OTHER call's reply, so only
+        batchId matching can pair results with requests."""
+        return self.inject(op, Fault("reorder", count=2,
+                                     rendezvous=_Rendezvous()), side=REPLY)
 
     # ------------------------------------------------- HA-fabric primitives
 
@@ -175,6 +244,11 @@ class FaultPlan:
 
     def next_server(self, op: str) -> Optional[Fault]:
         return self._take(SERVER, op)
+
+    def next_reply(self, op: str) -> Optional[Fault]:
+        """Reply-side faults (dup/reorder), consumed by the pipelined
+        transport's reply router only."""
+        return self._take(REPLY, op)
 
     def pending(self) -> int:
         """Finite faults not yet consumed (persistent ones never drain,

@@ -436,6 +436,10 @@ class _WireRig:
         # delta/batch ops; lease heartbeats would consume wildcard faults
         # and skew the accounting (the HA suite opts back in)
         sched_kw.setdefault("heartbeat_interval_s", 0.0)
+        # synchronous transport by default: these scripts assert per-cycle
+        # visibility and exact op ordering; the pipelined-wire chaos suite
+        # (TestWirePipelineChaos) opts in with an explicit depth
+        sched_kw.setdefault("wire_pipeline_depth", 0)
         self.sched = WireScheduler(
             self.store, endpoint=f"http://127.0.0.1:{port}",
             now_fn=self.clock, sleep_fn=sleep, fault_plan=fault_plan,
@@ -629,6 +633,9 @@ class _HaRig:
             batch_size=8, client_id=cid, partition=partition,
             now_fn=self.clock, sleep_fn=lambda s: self.clock.advance(s),
             heartbeat_interval_s=1.0, wire_max_retries=1,
+            # synchronous: the kill scripts fire _Die at exact per-cycle
+            # commit points (pipelined drains would shift them)
+            wire_pipeline_depth=0,
             pod_initial_backoff=0.01, pod_max_backoff=0.05)
 
     def survive(self, replica, rounds=4, step=2.0):
@@ -1182,6 +1189,9 @@ class _FabricRig:
         sched_kw.setdefault("wire_max_retries", 1)
         # fault scripts count exact ops per endpoint; heartbeats off
         sched_kw.setdefault("heartbeat_interval_s", 0.0)
+        # synchronous transport: the per-endpoint scripts assert per-cycle
+        # visibility (the pipelined fabric suite opts in with K>=3)
+        sched_kw.setdefault("wire_pipeline_depth", 0)
         sched_kw.setdefault("pod_initial_backoff", 0.01)
         sched_kw.setdefault("pod_max_backoff", 0.05)
         self.sched = WireScheduler(
@@ -1821,5 +1831,284 @@ class TestElasticChaos:
             assert "n0" not in svc.infos
             assert "n0" not in svc.device.encoder.node_slots
             _assert_resync_mirror_identical(rig)
+        finally:
+            rig.close()
+
+
+class TestWirePipelineChaos:
+    """Pipelined wire transport under fire (ROADMAP item 2, wire half):
+    K>=3 batches in flight across the wire while the device service
+    crashes, the transport drops everything, or the stream reorders/tears —
+    zero pods lost, zero double-binds, zero replays beyond the idempotent
+    ones, and the flight recorder carries pipeline_poison -> requeue per
+    poisoned batch.
+
+    Runs under KTPU_LOCKTRACE=1 (the ``locktraced`` fixture): the reply
+    lanes and the completion router are new threads against the
+    WirePipeline condition — the suite must produce an acyclic lock graph
+    and zero non-allowed blocking-under-lock events."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, locktraced):
+        yield
+
+    @pytest.fixture(autouse=True)
+    def _flight(self):
+        from kubernetes_tpu.backend import telemetry
+
+        self.tele = telemetry.enable()
+        yield
+        telemetry.disable()
+
+    def _pods(self, rig, n, cpu="500m", prefix="p"):
+        for i in range(n):
+            rig.store.create_pod(
+                make_pod(f"{prefix}{i}").req({"cpu": cpu}).obj())
+
+    def _settle(self, rig, rounds=3, step=1.1):
+        rig.sched.run_until_settled()
+        for _ in range(rounds):
+            rig.clock.advance(step)
+            rig.sched.run_until_settled()
+
+    def test_crash_with_k_batches_in_flight_recovers_in_place(self):
+        """The sidecar dies while three batches ride the wire: the torn
+        call retries into the restarted (fresh-epoch) service, the stale
+        verdicts trigger ONE full resync, and every batch re-sends under
+        its original idempotent batchId — nothing lost, nothing double,
+        nothing replayed from a cache (the new instance computed fresh)."""
+        plan = FaultPlan().crash("schedule_batch")
+        rig = _WireRig(fault_plan=plan, nodes=6,
+                       wire_pipeline_depth=3, batch_size=4)
+        try:
+            self._pods(rig, 12)
+            self._settle(rig)
+            bound = _bound(rig.store)
+            assert len(bound) == 12                    # zero lost
+            assert len(rig.store.pods) == 12           # zero duplicated
+            assert rig.server.binding.restarts == 1
+            assert rig.sched.resyncs >= 1
+            assert rig.server.binding.service.batch_replays == 0
+            assert rig.sched.breaker.state == circuit.CLOSED
+            per_node = {}
+            for n in bound.values():
+                per_node[n] = per_node.get(n, 0) + 1
+            assert all(v <= 10 for v in per_node.values()), per_node
+        finally:
+            rig.close()
+
+    def test_kill_with_k_batches_in_flight_poisons_all(self):
+        """Transport death with K=3 in flight: every in-flight batch is
+        poisoned exactly like ring poison — pipeline_poison then requeue
+        per batchId in the flight recorder, pods re-enter via backoffQ (or
+        the oracle once the breaker opens), zero lost, zero double."""
+        from kubernetes_tpu.testing.faults import SCHEDULE_BATCH
+
+        plan = FaultPlan()
+        rig = _WireRig(fault_plan=plan, nodes=6,
+                       wire_pipeline_depth=3, batch_size=4,
+                       wire_max_retries=0)
+        try:
+            self._pods(rig, 12)
+            # deltas land; every batch call dies (so three submitted
+            # batches are genuinely in flight when the poison fires)
+            plan.partition(SCHEDULE_BATCH)
+            for _ in range(3):                         # 3 batches in flight
+                rig.sched.schedule_batch_cycle()
+            assert len(rig.sched._wire_inflight) == 3
+            rig.sched._drain_wire_inflight()
+            poisons = self.tele.flight.events("pipeline_poison")
+            assert len(poisons) == 3
+            requeues = [e for e in self.tele.flight.events("requeue")
+                        if e.get("batchId")]
+            # poison strictly before its own requeue, per batchId (the
+            # third batch degrades to the oracle instead: breaker opened)
+            by_batch = {e["batchId"]: e["seq"] for e in poisons}
+            for e in requeues:
+                assert by_batch[e["batchId"]] < e["seq"]
+            plan.heal()
+            rig.clock.advance(6.0)                     # breaker reset window
+            self._settle(rig)
+            bound = _bound(rig.store)
+            assert len(bound) == 12                    # zero lost
+            assert len(rig.store.pods) == 12           # zero duplicated
+            assert rig.server.binding.service.batch_replays == 0
+        finally:
+            rig.close()
+
+    def test_reordered_and_torn_stream_under_load(self):
+        """Reordered replies + a torn response while pipelined: the router
+        matches by batchId, the torn call replays idempotently — all pods
+        land once."""
+        plan = FaultPlan().reorder("schedule_batch").torn("schedule_batch")
+        rig = _WireRig(fault_plan=plan, nodes=6,
+                       wire_pipeline_depth=3, batch_size=4)
+        try:
+            self._pods(rig, 12)
+            self._settle(rig)
+            bound = _bound(rig.store)
+            assert len(bound) == 12
+            assert rig.server.binding.service.batch_replays == 1  # the tear
+            assert rig.sched._wire_pipeline.duplicate_replies == 0
+        finally:
+            rig.close()
+
+
+class TestWarmStandbyChaos:
+    """Warm-standby failover (ROADMAP item 2, device half): the fabric
+    fans the delta stream out to standbys in the background, so a promoted
+    standby resyncs O(dirty) — asserted in upload BYTES via the PR-7
+    telemetry, not wall time — the device survives lease windows (kept
+    warm by the replication worker's heartbeats), and a kill with K=3 wire
+    batches in flight loses zero pods with poison ordered before failover
+    in the flight recorder."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, locktraced):
+        yield
+
+    @pytest.fixture(autouse=True)
+    def _flight(self):
+        from kubernetes_tpu.backend import telemetry
+
+        self.tele = telemetry.enable()
+        yield
+        telemetry.disable()
+
+    def _rig(self, nodes=32, **kw):
+        kw.setdefault("wire_pipeline_depth", 3)
+        kw.setdefault("heartbeat_interval_s", 1.0)
+        kw.setdefault("batch_size", 16)
+        return _FabricRig(nodes=nodes, cap="8", replicas=2, **kw)
+
+    def _steady_state(self, rig, pods=32):
+        """Settle a workload AND push the settled truth: the trailing pod
+        forces one more delta flush so the replication state matches the
+        bound cluster (continuous traffic does this for free)."""
+        for i in range(pods):
+            rig.store.create_pod(
+                make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+        rig.settle()
+        rig.store.create_pod(make_pod("trail").req({"cpu": "100m"}).obj())
+        rig.settle(rounds=1)
+        rig.sched.client.replication_flush()
+
+    def test_promote_resyncs_dirty_suffix_only(self):
+        """The headline assertion: a warm standby's promote-time resync
+        uploads a small fraction of the cold full=True seed — O(dirty),
+        judged by DeviceState upload bytes (PR-7 telemetry)."""
+        rig = self._rig()
+        try:
+            self._steady_state(rig)
+            standby = rig.services[1]
+            assert standby.device is not None          # warmed by replication
+            cold_seed = standby.device.upload_bytes
+            assert cold_seed > 0
+            dev_id = id(standby.device)
+            up_before = standby.device.upload_bytes
+            assert self.tele.flight.events("replication")
+            # primary dies; a small live wave rides the failover
+            rig.plans[0].kill()
+            for i in range(4):
+                rig.store.create_pod(
+                    make_pod(f"x{i}").req({"cpu": "250m"}).obj())
+            rig.settle(rounds=4)
+            bound = _bound(rig.store)
+            assert len(bound) == 37                    # 32 + trail + 4: zero lost
+            assert len(rig.store.pods) == 37           # zero duplicated
+            fab = rig.sched.client
+            assert fab.failovers == 1
+            assert fab.active_endpoint() == rig.endpoints[1]
+            assert standby.batch_replays == 0          # nothing replayed
+            # the warm win: the SAME DeviceState survived the promote (no
+            # rebuild) and the resync uploaded only the dirty suffix
+            assert id(standby.device) == dev_id
+            promote_bytes = standby.device.upload_bytes - up_before
+            assert promote_bytes * 4 < cold_seed, (promote_bytes, cold_seed)
+            _assert_oracle_replay_valid(rig.store)
+        finally:
+            rig.close()
+
+    def test_lagging_standby_at_failover_loses_nothing(self):
+        """The standby lags (its delta path is partitioned) when the
+        primary dies with K=3 batches in flight: the fabric poisons the
+        in-flight work BEFORE the failover event (flight-recorder order),
+        the full resync repairs the stale mirror, and every pod lands
+        exactly once — the lag costs upload bytes, never correctness."""
+        from kubernetes_tpu.testing.faults import APPLY_DELTAS, SCHEDULE_BATCH
+
+        rig = self._rig(nodes=8)
+        try:
+            self._steady_state(rig, pods=8)
+            fab = rig.sched.client
+            # the standby stops receiving deltas: lag grows
+            rig.plans[1].partition(APPLY_DELTAS)
+            for i in range(6):
+                rig.store.create_pod(
+                    make_pod(f"lag{i}").req({"cpu": "250m"}).obj())
+            rig.settle(rounds=1)
+            fab.replication_flush()
+            assert fab.replication_lag(fab.replicas[1]) > 0
+            # primary's batch path dies while batches are in flight; the
+            # standby heals just as it is promoted
+            rig.plans[1].heal()
+            rig.plans[0].partition(SCHEDULE_BATCH)
+            for i in range(4):
+                rig.store.create_pod(
+                    make_pod(f"x{i}").req({"cpu": "250m"}).obj())
+            rig.settle(rounds=4)
+            bound = _bound(rig.store)
+            assert len(bound) == len(rig.store.pods)   # zero lost
+            assert fab.failovers == 1
+            # ordering: the first poison precedes the failover event
+            poisons = self.tele.flight.events("poison")
+            failovers = self.tele.flight.events("failover")
+            assert poisons and failovers
+            assert min(e["seq"] for e in poisons) < failovers[0]["seq"]
+            assert rig.services[1].batch_replays == 0
+            _assert_oracle_replay_valid(rig.store)
+        finally:
+            rig.close()
+
+    def test_standby_sessions_survive_lease_windows(self):
+        """The standby blind spot, closed: nothing but replication talks
+        to a standby, so its sessions would silently expire (fencing the
+        replicator releases its node claims — the promote-time ghost sweep
+        would then drop the warm DeviceState). The replication worker's
+        keep-warm heartbeats carry both sessions across several lease
+        TTLs; the promote still finds the warm device. (32 nodes: row
+        uploads are bucket-padded to 8-row blocks, so the dirty-suffix /
+        cold-seed byte ratio needs a cluster several buckets wide.)"""
+        rig = self._rig()
+        try:
+            self._steady_state(rig, pods=8)
+            standby = rig.services[1]
+            dev_id = id(standby.device)
+            cold_seed = standby.device.upload_bytes
+            repl_cid = rig.sched.client._repl_client_id
+            # several lease TTLs pass; the scheduler only heartbeats the
+            # primary — the worker's keep-warm beats carry the standby
+            for _ in range(6):
+                rig.clock.advance(6.0)                 # > probe interval
+                rig.sched.run_until_settled()          # primary heartbeats
+                rig.sched.client.replication_flush()   # keep-warm beats
+            assert repl_cid in standby.sessions
+            assert standby.sessions[repl_cid].fenced is False
+            assert standby.sessions[repl_cid].replicator is True
+            # the scheduler client's session was fanned out and kept warm
+            assert rig.sched.client_id in standby.sessions
+            assert standby.sessions[rig.sched.client_id].fenced is False
+            up_before = standby.device.upload_bytes
+            rig.plans[0].kill()
+            rig.store.create_pod(make_pod("late").req({"cpu": "250m"}).obj())
+            rig.settle(rounds=4)
+            assert len(_bound(rig.store)) == len(rig.store.pods)
+            assert rig.sched.client.failovers == 1
+            # the warm device SURVIVED the lease window + promote: no
+            # ghost-sweep teardown, dirty-suffix upload only
+            assert id(standby.device) == dev_id
+            promote_bytes = standby.device.upload_bytes - up_before
+            assert promote_bytes * 4 < cold_seed, (promote_bytes, cold_seed)
+            _assert_oracle_replay_valid(rig.store)
         finally:
             rig.close()

@@ -421,3 +421,200 @@ class TestWireSchedulerSeam:
 
         with pytest.raises(ValueError, match="endpoint"):
             WireScheduler(self._store(), endpoint=" , ")
+
+
+class _RecordingStub(_StubClient):
+    """_StubClient that also keeps the payloads (the replication tests
+    assert WHAT was pushed, not just that something was)."""
+
+    def __init__(self, endpoint):
+        super().__init__(endpoint)
+        self.payloads = []
+
+    def apply_deltas(self, payload):
+        self.payloads.append(("apply_deltas", payload))
+        return super().apply_deltas(payload)
+
+    def heartbeat(self, payload):
+        self.payloads.append(("heartbeat", payload))
+        return super().heartbeat(payload)
+
+
+def _entry(name, gen=1):
+    return {"gen": gen, "node": {"meta": {"name": name}}, "pods": []}
+
+
+def _repl_fabric(n=2, metrics=None, probe_interval_s=5.0):
+    clock = FakeClock()
+    clients = {}
+
+    def factory(ep, i):
+        clients.setdefault(ep, _RecordingStub(ep))
+        return clients[ep]
+
+    # replication_worker=False: these tests drive replication_flush()
+    # themselves — a background worker consuming the dirty set would make
+    # the asserted push counts/payloads racy
+    fab = DeviceFabric([f"ep{i}" for i in range(n)], factory,
+                       metrics=metrics, now_fn=clock,
+                       probe_interval_s=probe_interval_s,
+                       replication=True, replication_worker=False)
+    return fab, clients, clock
+
+
+class TestStandbyReplication:
+    """Warm-standby delta fan-out, unit layer: fold/coalesce semantics,
+    full seeds vs dirty suffixes, the replicator session flag, keep-warm
+    heartbeats, failure backoff, and lag accounting — all driven through
+    replication_flush() (no background-thread timing in assertions)."""
+
+    def _deltas(self, fab, entries, removed=(), full=False, client="sched-A"):
+        payload = {"nodes": entries, "removed": list(removed),
+                   "clientId": client}
+        if full:
+            payload["full"] = True
+        return fab.apply_deltas(payload)
+
+    def test_first_flush_seeds_standby_with_full_push(self):
+        fab, clients, _ = _repl_fabric()
+        self._deltas(fab, [_entry("n0"), _entry("n1")])
+        assert fab.replication_flush() == 1
+        op, payload = clients["ep1"].payloads[0]
+        assert op == "apply_deltas"
+        assert payload["full"] is True
+        assert payload["replicator"] is True
+        assert payload["clientId"].startswith("fabric-repl-")
+        assert {e["node"]["meta"]["name"] for e in payload["nodes"]} == \
+            {"n0", "n1"}
+        assert fab.replicas[1].repl_needs_full is False
+        assert fab.replicas[1].repl_synced_seq == fab._repl_seq
+
+    def test_dirty_suffix_coalesces_per_node(self):
+        """A node that changed N times while the standby lagged ships
+        ONCE, with its newest content — replication cost is O(dirty
+        nodes), not O(delta stream)."""
+        fab, clients, _ = _repl_fabric()
+        self._deltas(fab, [_entry("n0"), _entry("n1")])
+        fab.replication_flush()                       # seed
+        for gen in (2, 3, 4):
+            self._deltas(fab, [_entry("n0", gen=gen)])
+        assert fab.replication_flush() == 1
+        _, payload = clients["ep1"].payloads[-1]
+        assert "full" not in payload
+        assert [e["node"]["meta"]["name"] for e in payload["nodes"]] == ["n0"]
+        assert payload["nodes"][0]["gen"] == 4        # newest content only
+        assert fab.replication_flush() == 0           # nothing left pending
+
+    def test_removals_propagate_incrementally_and_from_full_folds(self):
+        fab, clients, _ = _repl_fabric()
+        self._deltas(fab, [_entry("n0"), _entry("n1"), _entry("n2")])
+        fab.replication_flush()
+        # incremental removal
+        self._deltas(fab, [], removed=["n2"])
+        fab.replication_flush()
+        _, payload = clients["ep1"].payloads[-1]
+        assert payload["removed"] == ["n2"]
+        # a full client push omitting n1 IS its removal (ghost-sweep twin)
+        self._deltas(fab, [_entry("n0", gen=5)], full=True)
+        fab.replication_flush()
+        _, payload = clients["ep1"].payloads[-1]
+        assert payload["removed"] == ["n1"]
+        assert "n1" not in fab._repl_nodes and "n2" not in fab._repl_nodes
+
+    def test_replication_skips_the_active_and_backs_off_failures(self):
+        fab, clients, clock = _repl_fabric(n=3)
+        self._deltas(fab, [_entry("n0")])
+        clients["ep2"].fail = TransientDeviceError("standby down")
+        assert fab.replication_flush() == 1           # ep1 only
+        # the active receives the CLIENT's pushes, never the replicator's
+        assert all(p["clientId"] == "sched-A"
+                   for _op, p in clients["ep0"].payloads)
+        assert fab.replicas[2].repl_needs_full is True
+        assert fab.replicas[2].repl_last_error.startswith("TransientDeviceError")
+        # backoff: no retry inside the probe window, retry after it
+        clients["ep2"].fail = None
+        assert fab.replication_flush() == 0
+        clock.advance(6.0)
+        assert fab.replication_flush() == 1
+        assert fab.replicas[2].repl_needs_full is False
+
+    def test_stale_epoch_reseeds_conflict_rejoins(self):
+        fab, clients, clock = _repl_fabric()
+        self._deltas(fab, [_entry("n0")])
+        fab.replication_flush()
+        assert fab.replicas[1].repl_needs_full is False
+        # the standby restarted: next push must be a fresh full seed
+        clients["ep1"].fail = StaleEpochError("fresh-epoch")
+        self._deltas(fab, [_entry("n0", gen=2)])
+        fab.replication_flush()
+        assert fab.replicas[1].repl_needs_full is True
+        assert fab.replicas[1].repl_session_gen is None
+        clients["ep1"].fail = None
+        fab.replication_flush()
+        _, payload = clients["ep1"].payloads[-1]
+        assert payload["full"] is True
+        # a fenced replicator session rejoins without a gen
+        clients["ep1"].fail = ConflictError("lease fenced")
+        self._deltas(fab, [_entry("n0", gen=3)])
+        fab.replication_flush()
+        assert fab.replicas[1].repl_session_gen is None
+        clients["ep1"].fail = None
+        fab.replication_flush()
+        _, payload = clients["ep1"].payloads[-1]
+        assert "sessionGen" not in payload
+
+    def test_keep_warm_heartbeats_cover_replicator_and_client_sessions(self):
+        fab, clients, clock = _repl_fabric()
+        self._deltas(fab, [_entry("n0")])
+        fab.heartbeat({"clientId": "sched-A"})       # records the client id
+        clock.advance(6.0)
+        fab.replication_flush()
+        beats = [p for op, p in clients["ep1"].payloads if op == "heartbeat"]
+        cids = {p["clientId"] for p in beats}
+        assert "sched-A" in cids                      # client session warmed
+        assert any(c.startswith("fabric-repl-") for c in cids)
+        # the client fan-out never stamps a sessionGen (the standby owns
+        # its generation) and never claims to be the replicator
+        sched_beat = [p for p in beats if p["clientId"] == "sched-A"][0]
+        assert "sessionGen" not in sched_beat
+        assert "replicator" not in sched_beat
+
+    def test_lag_accounting_and_metrics(self):
+        m = SchedulerMetrics()
+        fab, clients, clock = _repl_fabric(metrics=m)
+        clients["ep1"].fail = TransientDeviceError("lagging")
+        for gen in (1, 2, 3):
+            self._deltas(fab, [_entry("n0", gen=gen)])
+        fab.replication_flush()
+        assert fab.replication_lag(fab.replicas[1]) == 3
+        assert m.standby_replication_lag.labels("ep1") == 3
+        clients["ep1"].fail = None
+        clock.advance(6.0)
+        fab.replication_flush()
+        assert fab.replication_lag(fab.replicas[1]) == 0
+        assert m.standby_replication_lag.labels("ep1") == 0
+        assert m.standby_resync_bytes.labels("full") > 0
+        dump = fab.dump()
+        assert dump["replication"]["enabled"] is True
+        assert dump["replicas"][1]["replication"]["lag"] == 0
+
+    def test_rejoining_replica_is_reseeded_wholesale(self):
+        """down -> up marks needs_full: the mirror went arbitrarily stale
+        while the replica was away."""
+        fab, clients, clock = _repl_fabric()
+        self._deltas(fab, [_entry("n0")])
+        fab.replication_flush()
+        assert fab.replicas[1].repl_needs_full is False
+        # the standby drops off (call-driven detection marks it down),
+        # then answers the rate-limited rejoin probe
+        fab._mark_health(fab.replicas[1], False)
+        self._deltas(fab, [_entry("n0", gen=2)])
+        assert fab.replication_flush() == 0           # down: not a target
+        clock.advance(6.0)
+        self._deltas(fab, [_entry("n0", gen=3)])      # probe window passes
+        assert fab.replicas[1].healthy
+        assert fab.replicas[1].repl_needs_full is True
+        fab.replication_flush()
+        payload = [p for op, p in clients["ep1"].payloads
+                   if op == "apply_deltas"][-1]
+        assert payload["full"] is True

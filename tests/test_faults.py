@@ -742,3 +742,117 @@ class TestErrorRequeue:
         qp.unschedulable_plugins = {"NodeResourcesFit"}
         q.add_unschedulable_if_not_present(qp, q.scheduling_cycle)
         assert q.pending_pods()["unschedulable"] == 1
+
+
+class TestStreamFaultPrimitives:
+    """Stream-level fault primitives (pipelined transport failure modes):
+    torn mid-stream disconnect, duplicated reply delivery, reordered
+    replies. Unit layer — the end-to-end behavior under load lives in
+    tests/test_wire_service.py::TestWirePipeline and
+    tests/test_chaos.py::TestWirePipelineChaos."""
+
+    def test_reply_faults_live_on_their_own_queue(self):
+        """dup/reorder are REPLY-side: raise_injected_fault (the request
+        side) must never consume or fire them — a request script cannot
+        accidentally swallow a stream fault."""
+        from kubernetes_tpu.backend.errors import raise_injected_fault
+
+        plan = FaultPlan().dup_reply("schedule_batch")
+        raise_injected_fault(plan, "schedule_batch", 60.0)  # no raise, no consume
+        assert plan.pending() == 1
+        f = plan.next_reply("schedule_batch")
+        assert f is not None and f.kind == "dup"
+        assert plan.next_reply("schedule_batch") is None
+        assert ("reply", "schedule_batch", "dup") in plan.log
+
+    def test_reorder_injects_two_shot_fault_with_shared_rendezvous(self):
+        plan = FaultPlan().reorder("schedule_batch")
+        f1 = plan.next_reply("schedule_batch")
+        f2 = plan.next_reply("schedule_batch")
+        assert f1 is f2                      # one fault consumed twice
+        assert f1.kind == "reorder" and f1.rendezvous is not None
+        assert plan.next_reply("schedule_batch") is None
+
+    def test_rendezvous_swaps_replies_across_threads(self):
+        from kubernetes_tpu.testing.faults import _Rendezvous
+
+        rv = _Rendezvous()
+        out = {}
+
+        def first():
+            out["first"] = rv.swap({"batchId": "b-1"})
+
+        t = threading.Thread(target=first)
+        t.start()
+        out["second"] = rv.swap({"batchId": "b-2"})
+        t.join(5)
+        # each party received the OTHER call's reply
+        assert out["first"]["batchId"] == "b-2"
+        assert out["second"]["batchId"] == "b-1"
+
+    def test_rendezvous_partner_never_arrives_falls_back_to_own_reply(self):
+        from kubernetes_tpu.testing.faults import _Rendezvous
+
+        rv = _Rendezvous(timeout_s=0.01)
+        assert rv.swap({"batchId": "b-1"})["batchId"] == "b-1"
+
+    def test_torn_server_side_processes_then_severs(self):
+        """torn: the service COMMITS the request but the reply never
+        leaves — the client's transport retry re-sends the same batchId
+        and the idempotency cache replays; one commit, ever."""
+        from kubernetes_tpu.api.codec import to_wire
+        from kubernetes_tpu.backend.service import WireClient
+
+        plan = FaultPlan().torn("schedule_batch")
+        service = DeviceService(batch_size=8)
+        server, port = serve(service, fault_plan=plan)
+        try:
+            clock = FakeClock()
+            sleeper = _FakeSleeper(clock)
+            client = WireClient(
+                f"http://127.0.0.1:{port}",
+                retry=RetryPolicy(max_retries=2, sleep_fn=sleeper,
+                                  now_fn=clock))
+            node = make_node("n0").capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+            client.apply_deltas({"nodes": [
+                {"gen": 1, "node": to_wire(node), "pods": []}]})
+            pod = to_wire(make_pod("p").req({"cpu": "1"}).obj())
+            out = client.schedule_batch({"pods": [pod], "batchId": "t-1"})
+            # the retry's reply is the REPLAY of the torn call's commit
+            assert out["results"][0]["nodeName"] == "n0"
+            assert out["batchId"] == "t-1"
+            assert service.batch_replays == 1
+            assert service.batch_counter == 1       # computed exactly once
+            assert ("server", "schedule_batch", "torn") in plan.log
+        finally:
+            server.shutdown()
+
+    def test_idempotency_cache_covers_last_k_batches(self):
+        """Pipelined clients retry any of their last K batches, not just
+        the newest: the per-session idempotency cache is a bounded map."""
+        from kubernetes_tpu.api.codec import to_wire
+
+        service = DeviceService(batch_size=8)
+        node = make_node("n0").capacity(
+            {"cpu": "16", "memory": "8Gi", "pods": 20}).obj()
+        service.apply_deltas({"clientId": "A", "nodes": [
+            {"gen": 1, "node": to_wire(node), "pods": []}]})
+        outs = {}
+        for i in range(3):
+            pod = to_wire(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+            outs[f"b-{i}"] = service.schedule_batch(
+                {"clientId": "A", "pods": [pod], "batchId": f"b-{i}"})
+        # a retry of the OLDEST of the three replays its stored response
+        replay = service.schedule_batch(
+            {"clientId": "A", "pods": [], "batchId": "b-0"})
+        assert replay is outs["b-0"]
+        assert service.batch_replays == 1
+        # the cache is bounded: far-older ids fall off
+        s = service.sessions["A"]
+        for i in range(3, 3 + s.IDEMPOTENCY_DEPTH):
+            pod = to_wire(make_pod(f"p{i}").req({"cpu": "1"}).obj())
+            service.schedule_batch(
+                {"clientId": "A", "pods": [pod], "batchId": f"b-{i}"})
+        assert len(s.last_batches) == s.IDEMPOTENCY_DEPTH
+        assert "b-0" not in s.last_batches

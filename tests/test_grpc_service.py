@@ -218,11 +218,17 @@ class TestGrpcSessionsAndConflicts:
                 store.create_node(
                     make_node(f"n{i}").capacity(
                         {"cpu": "4", "memory": "8Gi", "pods": 10}).obj())
+            # synchronous transport: the exact-fill race below counts on
+            # each cycle's binds being visible to the peer's next pop (the
+            # pipelined path defers processing by a cycle, which just means
+            # more conflict/backoff rounds than this bounded loop runs)
             a = WireScheduler(store, endpoint=f"127.0.0.1:{port}",
                               batch_size=4, transport="grpc", client_id="A",
+                              wire_pipeline_depth=0,
                               pod_initial_backoff=0.05, pod_max_backoff=0.1)
             b = WireScheduler(store, endpoint=f"127.0.0.1:{port}",
                               batch_size=4, transport="grpc", client_id="B",
+                              wire_pipeline_depth=0,
                               pod_initial_backoff=0.05, pod_max_backoff=0.1)
             for i in range(8):  # 8 x 1cpu == 2 nodes x 4cpu: exact fill
                 store.create_pod(make_pod(f"p{i}").req({"cpu": "1"}).obj())

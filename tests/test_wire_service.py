@@ -469,3 +469,191 @@ def test_wire_health_verb_and_half_open_probe():
         assert service.batch_counter > 0
     finally:
         server.shutdown()
+
+
+class TestWirePipeline:
+    """Pipelined wire transport (ROADMAP item 2, wire half): K batches in
+    flight over concurrent connection lanes, replies matched by the
+    server-echoed batchId, epoch/session/conflict semantics identical to
+    the synchronous path, and commit holds protected across the pipelined
+    delta/reply interleaving."""
+
+    def _rig(self, depth, plan=None, nodes=4, pods=12, batch_size=4):
+        from kubernetes_tpu.testing.faults import FaultPlan
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        plan = plan if plan is not None else FaultPlan()
+        service = DeviceService(batch_size=32)
+        server, port = serve(service, fault_plan=plan)
+        clock = FakeClock()
+        store = ClusterStore()
+        sched = WireScheduler(
+            store, endpoint=f"http://127.0.0.1:{port}", batch_size=batch_size,
+            wire_pipeline_depth=depth, fault_plan=plan,
+            now_fn=clock, sleep_fn=lambda s: clock.advance(s),
+            heartbeat_interval_s=0.0, wire_max_retries=1,
+            pod_initial_backoff=0.01, pod_max_backoff=0.05)
+        for i in range(nodes):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 20}).obj())
+        for i in range(pods):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+        return service, server, store, sched, clock, plan
+
+    def test_pipelined_placements_match_synchronous(self):
+        """Depth K>1 changes WHEN replies are processed, never WHAT is
+        decided: placements are byte-identical to the synchronous path."""
+        results = {}
+        for depth in (0, 3):
+            service, server, store, sched, _, _ = self._rig(depth)
+            try:
+                sched.run_until_settled()
+                results[depth] = _bound(store)
+                assert len(results[depth]) == 12
+                assert service.batch_replays == 0
+            finally:
+                server.shutdown()
+        assert results[0] == results[3]
+
+    def test_keeps_k_batches_in_flight(self):
+        """Three cycles submit three batches without blocking on replies —
+        the ring only drains past its depth (or at the empty-pop flush)."""
+        service, server, store, sched, _, _ = self._rig(3)
+        try:
+            for _ in range(3):
+                sched.schedule_batch_cycle()
+            assert len(sched._wire_inflight) == 3
+            assert sched.smetrics.wire_inflight.labels() == 3
+            sched.run_until_settled()
+            assert len(sched._wire_inflight) == 0
+            assert sched.smetrics.wire_inflight.labels() == 0
+            assert len(_bound(store)) == 12
+            assert sched.pipelined_wire_batches >= 2
+            # the stall-aware sizer (shared with the in-process ring) was
+            # fed real pop->processed observations
+            assert sched.wire_sizer.updates >= 3
+        finally:
+            server.shutdown()
+
+    def test_out_of_order_replies_matched_by_batch_id(self):
+        """The reorder fault swaps the next two replies across lanes: each
+        lane receives the OTHER call's reply, and the completion router
+        must pair every reply with its batch by the echoed batchId."""
+        from kubernetes_tpu.testing.faults import FaultPlan
+
+        plan = FaultPlan().reorder("schedule_batch")
+        service, server, store, sched, _, _ = self._rig(3, plan=plan)
+        try:
+            sched.run_until_settled()
+            assert len(_bound(store)) == 12
+            assert service.batch_replays == 0
+            assert sched._wire_pipeline.duplicate_replies == 0
+            # the swap really fired: both consumptions of the two-shot fault
+            assert [e for e in plan.log if e == ("reply", "schedule_batch",
+                                                 "reorder")] != []
+        finally:
+            server.shutdown()
+
+    def test_duplicate_reply_dropped_by_router(self):
+        from kubernetes_tpu.testing.faults import FaultPlan
+
+        plan = FaultPlan().dup_reply("schedule_batch")
+        service, server, store, sched, _, _ = self._rig(3, plan=plan)
+        try:
+            sched.run_until_settled()
+            assert len(_bound(store)) == 12
+            assert sched._wire_pipeline.duplicate_replies == 1
+            assert service.batch_replays == 0
+        finally:
+            server.shutdown()
+
+    def test_torn_reply_replays_idempotently_under_pipeline(self):
+        """Torn mid-stream disconnect with batches in flight: the server
+        committed, the reply died — the transport retry replays by batchId
+        and nothing is double-committed."""
+        from kubernetes_tpu.testing.faults import FaultPlan
+
+        plan = FaultPlan().torn("schedule_batch")
+        service, server, store, sched, _, _ = self._rig(3, plan=plan)
+        try:
+            sched.run_until_settled()
+            bound = _bound(store)
+            assert len(bound) == 12
+            assert service.batch_replays == 1
+            per_node = {}
+            for n in bound.values():
+                per_node[n] = per_node.get(n, 0) + 1
+            assert all(v <= 16 for v in per_node.values())
+        finally:
+            server.shutdown()
+
+    def test_inflight_batch_holds_survive_owner_delta_push(self):
+        """The pipelined hole in hold reconciliation, closed: the owner's
+        delta push omits placements from batches whose replies it has not
+        processed — naming them in inflightBatchIds keeps their holds (and
+        the capacity they occupy) alive; omitting the name releases."""
+        node = make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).obj()
+        service = DeviceService(batch_size=8)
+        entry = {"gen": 1, "node": to_wire(node), "pods": []}
+        service.apply_deltas({"clientId": "A", "nodes": [entry]})
+        pod = to_wire(make_pod("p").req({"cpu": "2"}).obj())
+        out = service.schedule_batch({"clientId": "A", "pods": [pod],
+                                      "batchId": "b-1"})
+        assert out["results"][0]["nodeName"] == "n0"
+        assert out["batchId"] == "b-1"
+        assert service.infos["n0"].requested.milli_cpu == 2000
+        # the owner pushes the node WITHOUT the pod, but names b-1 in
+        # flight: the hold must survive (the owner cannot know yet)
+        service.apply_deltas({"clientId": "A",
+                              "nodes": [dict(entry, gen=2)],
+                              "inflightBatchIds": ["b-1"]})
+        assert "p" in {h.pod.meta.name for h in service.holds.values()}
+        assert service.infos["n0"].requested.milli_cpu == 2000
+        # same push with b-1 no longer in flight: owner content is truth
+        # again - the omission means surrendered, the hold releases
+        service.apply_deltas({"clientId": "A",
+                              "nodes": [dict(entry, gen=3)]})
+        assert service.holds == {}
+        assert service.infos["n0"].requested.milli_cpu == 0
+
+
+def test_replicator_entries_never_regress_direct_client_rows():
+    """A warm-standby replicator mirrors a client's PAST pushes; if one of
+    its pushes lands late (e.g. a push hung across a promote), it must
+    never overwrite a direct session's newer truth — entries at a
+    generation <= the direct client's are skipped, stale removals too."""
+    service = DeviceService(batch_size=8)
+
+    def node_v(v):
+        return to_wire(make_node("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": 10}).label("v", v).obj())
+
+    service.apply_deltas({"clientId": "A",
+                          "nodes": [{"gen": 5, "node": node_v("2"),
+                                     "pods": []}]})
+    # a lagging replicator entry (older gen) is skipped...
+    service.apply_deltas({"clientId": "R", "replicator": True,
+                          "nodes": [{"gen": 3, "node": node_v("1"),
+                                     "pods": []}]})
+    assert service.infos["n0"].node.meta.labels["v"] == "2"
+    assert "n0" not in service.sessions["R"].sent_gens
+    # ...and a stale replicated removal is skipped when the direct client
+    # pushed the node SINCE the replicator's previous contact
+    service.apply_deltas({"clientId": "A",
+                          "nodes": [{"gen": 6, "node": node_v("2"),
+                                     "pods": []}]})
+    service.apply_deltas({"clientId": "R", "replicator": True,
+                          "nodes": [], "removed": ["n0"]})
+    assert "n0" in service.infos
+    # a replicated entry NEWER than the direct client's applies normally
+    service.apply_deltas({"clientId": "R", "replicator": True,
+                          "nodes": [{"gen": 7, "node": node_v("3"),
+                                     "pods": []}]})
+    assert service.infos["n0"].node.meta.labels["v"] == "3"
+    # healed-ex-active case: the direct session goes idle (its lease kept
+    # warm but no pushes) — the replication stream is the freshest truth
+    # and its removal must land, not strand a ghost behind stale claims
+    service.apply_deltas({"clientId": "R", "replicator": True,
+                          "nodes": [], "removed": ["n0"]})
+    assert "n0" not in service.infos

@@ -190,6 +190,22 @@ FENCE_WORKLOAD_OVERRIDES = {
     # fence there flaps on mode, not on code.
     "SchedulingPodAffinity": {"workload_pods_per_s": 60.0,
                               "workload_p99_s": 200.0},
+    # r10 A/A evidence (three runs of the IDENTICAL tree on the r06+
+    # container class): SchedulingNodeAffinity 393.9 / 796.7 / 639.5
+    # pods/s and SchedulingSecrets 335.9 / 726.1 / 506.5 — ~2x swings on
+    # box mode with the standalone runs ABOVE the r09 baseline, so the
+    # 40%/100% default flaps on mode, not on code. The p99 rows read from
+    # ~2x-spaced histogram buckets, where one bucket step is ~100%.
+    "SchedulingNodeAffinity": {"workload_pods_per_s": 60.0,
+                               "workload_p99_s": 200.0},
+    "SchedulingSecrets": {"workload_pods_per_s": 60.0,
+                          "workload_p99_s": 200.0},
+    # p99 history 0.256 -> 0.341 -> 0.507 -> 0.127 -> 0.255 across
+    # r06-r10: the row bounces between adjacent ~2x histogram buckets
+    # (one step = ~100%), with r09 its best-ever bucket — a 100% p99
+    # fence against r09 flaps on bucket quantization, not on code
+    # (throughput stays inside the default tolerance).
+    "SchedulingPreferredPodAffinity": {"workload_p99_s": 200.0},
 }
 
 
