@@ -189,6 +189,7 @@ def _critical_path_from_spans(spans):
 def run_tpu(n_nodes, n_init, n_measured, batch):
     from kubernetes_tpu.apiserver import ClusterStore
     from kubernetes_tpu.backend import TPUScheduler, telemetry
+    from kubernetes_tpu.metrics import latency_ledger
     from kubernetes_tpu.utils import tracing
 
     store = ClusterStore()
@@ -200,6 +201,10 @@ def run_tpu(n_nodes, n_init, n_measured, batch):
     # stats, per-batch transfer bytes — the bench evidence for ROADMAP items
     # 1/2 (encode is device_put-heavy; 100k-node sharding is HBM-bounded)
     tele = telemetry.enable(sched.smetrics)
+    # pod-lifetime latency ledger: per-pod e2e + per-segment attribution —
+    # the iso-p99 evidence now covers the WHOLE pod lifetime, not just the
+    # winning attempt
+    latency_ledger.enable(sched.smetrics, tenant_fn=sched._ns_fair_weight)
     build_cluster(store, n_nodes)
     make_pods(store, "init", n_init)
     sched.run_until_settled()  # init phase + jit warmup
@@ -222,6 +227,10 @@ def run_tpu(n_nodes, n_init, n_measured, batch):
     own_tracer = tracing.get() is None
     exporter = tracing.enable(tracing.InMemoryExporter()).exporter \
         if own_tracer else None
+    e2e_hist = sched.smetrics.pod_e2e_duration
+    e2e_snap = e2e_hist.snapshot("scheduled")
+    seg_hist = sched.smetrics.pod_latency_segment
+    seg_pre = {lv[0]: seg_hist.sum(*lv) for lv in seg_hist.label_sets()}
     stall_pre = sched.smetrics.pipeline_stall_seconds.labels()
     coal = sched.smetrics.commit_coalesced_events
     coal_pre = {k: coal.labels(k)
@@ -299,6 +308,21 @@ def run_tpu(n_nodes, n_init, n_measured, batch):
                       / max(cbd.count(st) - cbd_pre[st][1], 1) * 1000, 3)
             for st in cbd_stages},
     }
+    # pod-lifetime e2e over the measured phase + where the lifetime went
+    # (top segment shares of the measured-phase segment-seconds delta)
+    if e2e_hist.count_since(e2e_snap, "scheduled"):
+        evidence["e2e_latency_s"] = {
+            "p50": round(e2e_hist.percentile_since(e2e_snap, 0.50, "scheduled"), 4),
+            "p99": round(e2e_hist.percentile_since(e2e_snap, 0.99, "scheduled"), 4),
+        }
+        seg_delta = {lv[0]: seg_hist.sum(*lv) - seg_pre.get(lv[0], 0.0)
+                     for lv in seg_hist.label_sets()}
+        seg_total = sum(v for v in seg_delta.values() if v > 0)
+        if seg_total > 0:
+            evidence["segment_shares_pct"] = {
+                seg: round(100.0 * v / seg_total, 1)
+                for seg, v in sorted(seg_delta.items(), key=lambda kv: -kv[1])
+                if v > 0}
     meas_batches = max(sched.batch_counter - batches_pre, 1)
     evidence["upload_bytes_per_batch"] = round(
         (tele.transfer_bytes.get("upload", 0) - xfer_pre.get("upload", 0))
@@ -308,6 +332,9 @@ def run_tpu(n_nodes, n_init, n_measured, batch):
         / meas_batches)
     if critical is not None:
         evidence["critical_path"] = critical
+    # release the module-global ledger so later rows (run_wire's Runner)
+    # can own a fresh one against their own registry
+    latency_ledger.disable()
     return n_measured / dt, latency, phases, evidence
 
 
@@ -340,7 +367,11 @@ def run_matrix(budget_deadline, platform):
             out[name] = {"skipped": "bench time budget exhausted"}
             continue
         env = dict(os.environ, BENCH_MATRIX_CHILD=name,
-                   BENCH_PLATFORM_RESOLVED=platform)
+                   BENCH_PLATFORM_RESOLVED=platform,
+                   # per-workload e2e evidence: the child Runner enables
+                   # the latency ledger and run_matrix_child lifts its
+                   # DataItems into the row
+                   KTPU_LEDGER="1")
         if platform.startswith("cpu"):
             env["JAX_PLATFORMS"] = "cpu"
         try:
@@ -391,6 +422,19 @@ def run_matrix_child(name: str) -> None:
                 entry["elastic"] = {k: it.data[k] for k in (
                     "LostPods", "Oversubscribed", "RowCapacity",
                     "SlotReuses", "UploadBytesSteady", "HbmPeakBytes")}
+            elif label == "pod_e2e_duration_seconds" \
+                    and it.labels.get("result") == "scheduled":
+                # pod-lifetime e2e (latency ledger): the fence's
+                # workload_e2e_p99_s tolerance judges this row r11+
+                entry["e2e_p50_s"] = round(it.data["Perc50"], 4)
+                entry["e2e_p99_s"] = round(it.data["Perc99"], 4)
+            elif label == "pod_latency_segments":
+                total = sum(v for v in it.data.values() if v > 0)
+                if total > 0:
+                    shares = sorted(it.data.items(), key=lambda kv: -kv[1])
+                    entry["segments_top_pct"] = {
+                        seg: round(100.0 * v / total, 1)
+                        for seg, v in shares[:4] if v > 0}
     except Exception as exc:  # noqa: BLE001
         entry["error"] = f"{type(exc).__name__}: {exc}"[:200]
     print(json.dumps(entry))
@@ -415,7 +459,7 @@ def run_wire(n_nodes=1000, n_init=200, n_measured=500, backend="wire"):
             test_case = scheduling_basic(nodes=n_nodes, init_pods=n_init,
                                          measured=n_measured)
             r = Runner(scheduler_config=test_case.get("schedulerConfig"),
-                       backend=backend)
+                       backend=backend, ledger=True)
             try:
                 r.run_ops(test_case["ops"])
                 sched = r.scheduler
@@ -437,6 +481,10 @@ def run_wire(n_nodes=1000, n_init=200, n_measured=500, backend="wire"):
                       == "scheduling_attempt_duration_seconds"
                       and it.labels.get("result") == "scheduled"):
                     out["attempt_p99_s"] = round(it.data["Perc99"], 4)
+                elif (it.labels.get("Name") == "pod_e2e_duration_seconds"
+                      and it.labels.get("result") == "scheduled"):
+                    out["e2e_p50_s"] = round(it.data["Perc50"], 4)
+                    out["e2e_p99_s"] = round(it.data["Perc99"], 4)
             return out
         finally:
             if depth_env != "":

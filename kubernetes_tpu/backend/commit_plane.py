@@ -61,6 +61,7 @@ import numpy as np
 from ..api.types import Binding, Pod
 from ..framework.interface import CycleState, Status
 from ..framework.types import Diagnosis, QueuedPodInfo
+from ..metrics import latency_ledger
 from ..testing import locktrace
 from ..utils.events import TYPE_NORMAL
 
@@ -196,6 +197,8 @@ class CommitPlane:
         hist.observe(perf_counter() - t_pb, "pre_bind")
 
         # ---- stage: bind (one store transaction + one WAL group append)
+        latency_ledger.transition_many(
+            [item.assumed.key() for item in live], "bind")
         t_bind = perf_counter()
         live = self._run_bind(live, pod_cycle)
         hist.observe(perf_counter() - t_bind, "bind")
@@ -223,6 +226,8 @@ class CommitPlane:
             for fwk, batch in by_fwk.items():
                 fwk.run_post_bind_plugins_batch(batch)
             coalesced.inc("post_bind", value=len(live))
+            latency_ledger.close_many(
+                [item.assumed.key() for item in live], "scheduled")
             self.pods_bound += len(live)
         hist.observe(perf_counter() - t_fin, "finish")
         s.smetrics.commit_batch_duration.observe(
@@ -279,6 +284,9 @@ class CommitPlane:
                     pod_cycle, t0=t0,
                     deadline=s.now_fn() + timeout, plugin=st.plugin)
                 item.outcome = "waiting"
+                latency_ledger.transition(
+                    item.assumed.key(), "gang.permit_park",
+                    namespace=item.assumed.meta.namespace, create=False)
 
             t_per = perf_counter()
             psts = fwk.run_permit_plugins_batch(

@@ -52,6 +52,7 @@ from ..api.codec import from_wire, to_wire
 from ..api.types import Node, Pod
 from ..framework.types import Diagnosis, NodeInfo, QueuedPodInfo
 from ..framework.interface import CycleState, Status
+from ..metrics import latency_ledger
 from ..ops.encode import CapacityError
 from ..scheduler.scheduler import Scheduler
 from ..testing import locktrace
@@ -1770,6 +1771,8 @@ class WireScheduler(Scheduler):
         for qp in qps:
             pod = self.store.get_pod(qp.pod.key())
             if pod is None or pod.spec.node_name or not self._responsible_for(pod):
+                # deleted/bound meanwhile: drop the pop-opened ledger entry
+                latency_ledger.close_skipped(qp.pod.key(), pod)
                 continue
             qp.pod = pod
             # host-side gang quorum + namespace-quota gates (the remote
@@ -1863,11 +1866,21 @@ class WireScheduler(Scheduler):
                     self.pipelined_wire_batches += 1
                 self.smetrics.wire_inflight.set(
                     value=len(self._wire_inflight))
+                # ledger: the batch rides a transport lane — device.inflight
+                # dwell is the wire ring's K-cycle residency, correlated by
+                # the idempotent batchId
+                latency_ledger.transition_many(
+                    [qp.pod.key() for qp in batch], "device.inflight",
+                    batch_id=entry.batch_id)
                 self._wire_pipeline.submit(payload)
                 while len(self._wire_inflight) > self.wire_pipeline_depth:
                     self._drain_oldest_wire()
                 return
-            res = self._wire_schedule_batch(batch)
+            payload = self._build_batch_payload(batch)
+            latency_ledger.transition_many(
+                [qp.pod.key() for qp in batch], "device.inflight",
+                batch_id=payload["batchId"])
+            res = self._send_batch_payload(payload)
         except ConflictError as exc:
             # fenced session / cross-client race: the service is HEALTHY, so
             # this never counts against the breaker. Rejoin under a fresh
@@ -2055,9 +2068,6 @@ class WireScheduler(Scheduler):
             payload.pop("expectEpoch", None)
         self._stamp_session(payload)
 
-    def _wire_schedule_batch(self, batch: List[QueuedPodInfo]) -> dict:
-        return self._send_batch_payload(self._build_batch_payload(batch))
-
     def _schedule_degraded(self, batch: List[QueuedPodInfo], pod_cycle: int) -> None:
         telemetry.event("degrade", client=self.client_id, pods=len(batch),
                         reason="wire breaker open")
@@ -2115,6 +2125,11 @@ class WireScheduler(Scheduler):
                                         t0: float) -> None:
         from ..framework.plugins.coscheduling import pod_group_key
         from .commit_plane import BindItem
+
+        # ledger: the reply is claimed — the batch leaves the wire ring and
+        # enters the host commit tail
+        latency_ledger.transition_many(
+            [qp.pod.key() for qp in batch], "commit.host")
 
         bind_items: List[BindItem] = []
         # hint-screen scaffolding, shared by every failed pod in the batch

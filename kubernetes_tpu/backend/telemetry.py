@@ -55,6 +55,27 @@ OTHER_PROGRAM = "(other)"
 STORM_RETRACES = 3
 STORM_WINDOW = 32
 
+# The declared flight-recorder event-kind registry. Every LITERAL kind the
+# package passes to ``telemetry.event(...)`` / ``FlightRecorder.record(...)``
+# must appear here — enforced by ``python -m tools.ktpu_check --pass events``
+# (the span-lint twin), so a new lifecycle event cannot ship unattributed:
+# adding a kind means declaring it, which keeps this table the one place
+# the postmortem vocabulary is documented.
+EVENT_KINDS = frozenset({
+    # batch lifecycle (in-process ring + wire)
+    "encode", "dispatch", "commit", "poison", "requeue",
+    # degradation / sessions / HA
+    "conflict", "fence", "degrade", "takeover",
+    # device runtime
+    "packed_fallback", "retrace_storm",
+    # elasticity
+    "slot_reclaim", "node_remove", "evict_wave",
+    # device-side fabric + replication
+    "replica_down", "replica_rejoin", "failover", "replication",
+    # pipelined wire transport
+    "pipeline_poison", "pipeline_dup_reply",
+})
+
 
 class FlightRecorder:
     """Bounded, lock-cheap ring of batch lifecycle events. ``deque.append``

@@ -33,6 +33,7 @@ from ..framework.interface import CycleState, Status
 from ..framework.plugins.coscheduling import gang_precheck_status, pod_group_key
 from ..framework.plugins.quota import quota_precheck_status
 from ..framework.types import Diagnosis, QueuedPodInfo
+from ..metrics import latency_ledger
 from ..ops.encode import CapacityError
 from ..scheduler.scheduler import Scheduler
 from .batch import BatchResult, build_schedule_batch_fn
@@ -525,6 +526,7 @@ class TPUScheduler(Scheduler):
         for qp in qps:
             pod = self.store.get_pod(qp.pod.key())
             if pod is None or pod.spec.node_name or not self._responsible_for(pod):
+                latency_ledger.close_skipped(qp.pod.key(), pod)
                 continue  # skipPodSchedule
             qp.pod = pod
             fwk = self.framework_for_pod(pod)
@@ -789,6 +791,11 @@ class TPUScheduler(Scheduler):
                         pods=len(batched), topo=topo_mode,
                         packed=result.packed is not None,
                         inflight=len(self._inflight))
+        # ledger: the whole batch enters device.inflight (ring dwell),
+        # batchId-correlated with the flight recorder's dispatch/commit
+        latency_ledger.transition_many(
+            [qp.pod.key() for qp in batched], "device.inflight",
+            batch_id=batch_id)
         self.smetrics.pipeline_inflight.set(value=len(self._inflight))
         # land the oldest batches beyond the ring depth: their host commits
         # overlap the device execution of everything dispatched after them
@@ -1143,6 +1150,10 @@ class TPUScheduler(Scheduler):
                                 pb=None, ff: Optional[np.ndarray] = None,
                                 reclaim_gen: Optional[int] = None,
                                 batch_id: str = "") -> None:
+        # ledger: claim time — the batch leaves the device ring and enters
+        # the host commit tail (one lock round trip for the whole batch)
+        latency_ledger.transition_many(
+            [qp.pod.key() for qp in qps], "commit.host", batch_id=batch_id)
         slot_names = self.device.slot_to_name()
         # ff (first_fail) normally arrives unpacked from the packed result
         # block — already on host, zero extra syncs; the lazy reads below
@@ -1528,7 +1539,7 @@ class TPUScheduler(Scheduler):
             self.comparer_mismatches += 1
             logging.getLogger(__name__).warning(
                 "comparer: oracle rejects device placement %s -> %s: %s",
-                pod.key(), node_name, status.message)
+                pod.key(), node_name, "; ".join(status.reasons))
 
     def warm_buckets(self, sample_pods=None) -> int:
         """Precompile the batch program at every sizer bucket for the

@@ -230,6 +230,14 @@ def build_debug_handlers(sched) -> dict:
                           under KTPU_LOCKTRACE=1)
       /debug/quota        per-namespace SchedulingQuota caps, the ledger's
                           live usage, fair-share weight, charged pod count
+      /debug/ledger       pod-lifetime latency ledger: live/closed entry
+                          counts, eviction count, per-pod segment
+                          accumulators (metrics/latency_ledger.py;
+                          enabled=False when the ledger is off)
+      /debug/timeline     unified Chrome trace-event JSON (Perfetto /
+                          chrome://tracing loadable): span tail + flight-
+                          recorder events + ledger pod segments on one
+                          wall-clock axis, batchId/pod-UID correlated
 
     Every handler takes an entry cap (``?limit=N`` on the mux, default
     DEFAULT_DEBUG_LIMIT) so a 5k-node dump stays bounded.
@@ -357,12 +365,35 @@ def build_debug_handlers(sched) -> dict:
         return _capped_lists(out, limit,
                              ("blockingViolations", "blockingAllowed"))
 
+    def ledger_dump(limit=None):
+        from ..metrics import latency_ledger
+
+        led = latency_ledger.get()
+        if led is None:
+            return {"enabled": False}
+        return led.dump(limit)
+
+    def timeline_dump(limit=None):
+        """One Chrome trace-event JSON body unifying the span tail, the
+        flight-recorder ring, and the latency ledger's pod segments —
+        `curl :PORT/debug/timeline > t.json` then load in Perfetto."""
+        from ..backend import telemetry
+        from ..metrics import latency_ledger
+
+        cap = 256 if limit is None or limit < 0 else limit
+        t = telemetry.get()
+        flight = t.flight.dump(cap) if t is not None else []
+        return latency_ledger.chrome_trace(
+            spans=tracing.tail(cap), flight=flight,
+            ledger=latency_ledger.get(), limit=cap)
+
     return {"queue": queue_dump, "cache": cache_dump,
             "devicestate": device_dump, "spans": spans_dump,
             "circuit": circuit_dump, "sessions": sessions_dump,
             "fabric": fabric_dump,
             "flightrecorder": flightrecorder_dump, "quota": quota_dump,
-            "locktrace": locktrace_dump}
+            "locktrace": locktrace_dump, "ledger": ledger_dump,
+            "timeline": timeline_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
@@ -397,6 +428,14 @@ def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,
     # transfer gauges, flight recorder) feeding THIS scheduler's registry —
     # off by default, one-global-read disabled cost
     telemetry.maybe_enable_from_env(sched.smetrics)
+    # KTPU_LEDGER=1: pod-lifetime latency ledger (per-segment e2e
+    # attribution + tenant SLO histograms + /debug/timeline) — same
+    # off-by-default, one-global-read contract; the quota tenant index
+    # bounds the {namespace} label set
+    from ..metrics import latency_ledger
+
+    latency_ledger.maybe_enable_from_env(sched.smetrics,
+                                         tenant_fn=sched._ns_fair_weight)
     return sched
 
 

@@ -301,6 +301,42 @@ class SchedulerMetrics:
             "(queue_move|wal_record|cache_op|post_bind).",
             ["kind"],
         ))
+        # pod-lifetime latency ledger (metrics/latency_ledger.py): one entry
+        # per pod from first enqueue to bind (or terminal delete), spanning
+        # every attempt — the end-to-end complement of the per-attempt
+        # histogram. Segments are the named wall-clock slices of that
+        # lifetime (queue.active/backoff/unschedulable/gated/drr_wait,
+        # cycle.host, gang.permit_park, device.inflight, commit.host, bind);
+        # the tenant histogram is the per-namespace SLO feed, its label set
+        # bounded by the quota tenant index. Buckets reach ~160s: a pod can
+        # legitimately dwell minutes across backoff/gate parks.
+        _e2e_buckets = exponential_buckets(0.005, 2, 16)
+        self.pod_e2e_duration = r.register(Histogram(
+            "scheduler_pod_e2e_duration_seconds",
+            "Pod end-to-end latency from first enqueue to bind (or terminal "
+            "delete), across all attempts.",
+            ["result"],
+            buckets=_e2e_buckets,
+        ))
+        self.pod_latency_segment = r.register(Histogram(
+            "scheduler_pod_latency_segment_seconds",
+            "Per-pod lifetime wall-clock attribution by named segment "
+            "(observed once per segment at pod close).",
+            ["segment"],
+            buckets=_e2e_buckets,
+        ))
+        self.tenant_e2e_duration = r.register(Histogram(
+            "scheduler_tenant_e2e_duration_seconds",
+            "Pod end-to-end latency per tenant namespace (quota tenants "
+            "only — the fair-share SLO feed).",
+            ["namespace"],
+            buckets=_e2e_buckets,
+        ))
+        self.ledger_evicted = r.register(Counter(
+            "scheduler_pod_ledger_evicted_total",
+            "Latency-ledger entries evicted at the live-entry cap (oldest "
+            "first; nonzero means e2e attribution lost pods).",
+        ))
 
         # unschedulable_pods bookkeeping: gauge value = number of pods
         # CURRENTLY unschedulable attributed to each (plugin, profile); a

@@ -42,6 +42,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..api.types import Pod
 from ..framework.types import ClusterEvent, QueuedPodInfo
+from ..metrics import latency_ledger
 from ..testing import locktrace
 
 DEFAULT_POD_INITIAL_BACKOFF = 1.0
@@ -181,6 +182,15 @@ class SchedulingQueue:
             heapq.heappush(self._active_ns.setdefault(tenant, []), entry)
         self._in_queue.add(key)
         self._record_incoming("active", event)
+        # latency ledger: activeQ dwell of a tenant-bucketed pod under
+        # contention (another bucket is live, so the DRR rotation is what
+        # the pod actually waits on) attributes to queue.drr_wait
+        contended = (tenant is not None
+                     and len(self._active_ns)
+                     + (1 if self._active else 0) > 1)
+        latency_ledger.transition(
+            key, "queue.drr_wait" if contended else "queue.active",
+            namespace=qp.pod.meta.namespace)
 
     def _push_backoff(self, qp: QueuedPodInfo, event: Optional[str] = None) -> None:  # ktpu: locked
         key = qp.pod.key()
@@ -190,6 +200,8 @@ class SchedulingQueue:
         heapq.heappush(self._backoff, (expiry, next(self._counter), qp))
         self._in_queue.add(key)
         self._record_incoming("backoff", event)
+        latency_ledger.transition(key, "queue.backoff",
+                                  namespace=qp.pod.meta.namespace)
 
     def _record_incoming(self, queue: str, event: Optional[str]) -> None:
         if self._metrics is not None and event is not None:
@@ -224,6 +236,8 @@ class SchedulingQueue:
         if key not in self._unschedulable:
             self._record_incoming("gated", event)
         self._unschedulable[key] = qp
+        latency_ledger.transition(key, "queue.gated",
+                                  namespace=qp.pod.meta.namespace)
         return True
 
     # ------------------------------------------------------------- API
@@ -263,6 +277,9 @@ class SchedulingQueue:
     @_locked
     def delete(self, pod: Pod) -> None:
         key = pod.key()
+        # terminal delete of an unbound pod: the ledger entry drops (closed
+        # result="deleted") so cluster churn cannot leak entries
+        latency_ledger.drop(key)
         self._unschedulable.pop(key, None)
         if key in self._in_queue:
             self._in_queue.discard(key)
@@ -302,6 +319,8 @@ class SchedulingQueue:
         self._in_queue.discard(qp.pod.key())
         qp.attempts += 1
         self.scheduling_cycle += 1
+        latency_ledger.transition(qp.pod.key(), "cycle.host",
+                                  namespace=qp.pod.meta.namespace)
         return qp
 
     def _pop_active(self) -> Optional[QueuedPodInfo]:  # ktpu: locked
@@ -439,6 +458,8 @@ class SchedulingQueue:
             # unrelated event wave — can wake it
             self._unschedulable[key] = qp
             self._record_incoming("unschedulable", "ScheduleAttemptFailure")
+            latency_ledger.transition(key, "queue.unschedulable",
+                                      namespace=qp.pod.meta.namespace)
         self._sync_gauges()
 
     @_locked

@@ -37,7 +37,7 @@ from ..framework.types import (
     UPDATE_NODE_TAINT,
     ClusterEvent,
 )
-from ..metrics import SchedulerMetrics
+from ..metrics import SchedulerMetrics, latency_ledger
 from ..queue import SchedulingQueue
 from ..queue import events as qevents
 from ..utils.events import EventRecorder, TYPE_NORMAL, TYPE_WARNING
@@ -452,7 +452,10 @@ class Scheduler:
             return False
         pod = self.store.get_pod(qp.pod.key())
         if pod is None or pod.spec.node_name or not self._responsible_for(pod):
-            return True  # skipPodSchedule (:285): deleted/bound meanwhile
+            # skipPodSchedule (:285): deleted/bound meanwhile — close the
+            # ledger entry the pop just transitioned (no-op when absent)
+            latency_ledger.close_skipped(qp.pod.key(), pod)
+            return True
         qp.pod = pod
         self.schedule_one_pod(qp, self.queue.scheduling_cycle)
         return True
@@ -526,6 +529,9 @@ class Scheduler:
             self.waiting_pods[assumed.key()] = WaitingPod(
                 fwk, state, assumed, node_name, pod_cycle, t0,
                 deadline=self.now_fn() + timeout, plugin=status.plugin)
+            latency_ledger.transition(assumed.key(), "gang.permit_park",
+                                      namespace=assumed.meta.namespace,
+                                      create=False)
             return
         if not status.is_success():
             fwk.run_reserve_plugins_unreserve(state, assumed, node_name)
@@ -540,6 +546,9 @@ class Scheduler:
         wp = self.waiting_pods.pop(pod_key, None)
         if wp is None:
             return False
+        latency_ledger.transition(pod_key, "commit.host",
+                                  namespace=wp.pod.meta.namespace,
+                                  create=False)
         self._binding_cycle(wp.fwk, wp.state, QueuedPodInfo(pod=wp.pod),
                             wp.pod, wp.node_name, wp.pod_cycle, wp.t0)
         return True
@@ -626,6 +635,9 @@ class Scheduler:
     def _binding_cycle(self, fwk: Framework, state: CycleState, qp: QueuedPodInfo, assumed: Pod, node_name: str, pod_cycle: int, t0: Optional[float] = None) -> None:
         """(schedule_one.go:193) — synchronous here; the perf harness measures
         end-to-end anyway and the in-process store makes binds cheap."""
+        latency_ledger.transition(assumed.key(), "bind",
+                                  namespace=assumed.meta.namespace,
+                                  create=False)
         status = fwk.run_pre_bind_plugins(state, assumed, node_name)
         if status.is_success():
             status = self._extenders_binding(assumed, node_name)
@@ -639,6 +651,7 @@ class Scheduler:
         self.cache.finish_binding(assumed)
         self.metrics.inc("scheduled")
         self.smetrics.clear_unschedulable(assumed.key())
+        latency_ledger.close(assumed.key(), "scheduled")
         self.smetrics.observe_attempt(
             "scheduled", fwk.profile_name,
             self.now_fn() - t0 if t0 is not None else 0.0,
@@ -864,6 +877,9 @@ class Scheduler:
         current = self.store.get_pod(pod.key())
         if current is None or current.spec.node_name:
             self.smetrics.clear_unschedulable(pod.key())  # gone or bound
+            # gone (deleted mid-cycle) or bound by an external binder:
+            # either way the entry must not linger until the cap evicts it
+            latency_ledger.close_skipped(pod.key(), current)
             return
         qp.pod = current
         qp.unschedulable_plugins = set(diagnosis.unschedulable_plugins)

@@ -130,13 +130,36 @@ class TestDebugEndpoints:
             store.create_pod(make_pod("huge").req({"cpu": "64"}).obj())
             app.tick()
 
+            # GET /debug is the self-describing index: one JSON listing of
+            # every mounted endpoint, so docs can't silently drift — the
+            # set below IS the documented surface (README Observability)
             status, body = _get(port, "/debug")
             assert status == 200
             assert set(json.loads(body)["endpoints"]) == {
                 "/debug/queue", "/debug/cache", "/debug/devicestate",
                 "/debug/spans", "/debug/circuit", "/debug/sessions",
                 "/debug/fabric", "/debug/flightrecorder", "/debug/quota",
-                "/debug/locktrace"}
+                "/debug/locktrace", "/debug/ledger", "/debug/timeline"}
+            # every listed endpoint answers 200 with a JSON body (the
+            # index can't name a route the mux doesn't actually serve)
+            for ep in json.loads(body)["endpoints"]:
+                st, b = _get(port, ep)
+                assert st == 200, ep
+                json.loads(b)
+
+            # latency ledger off by default: the disabled report
+            status, body = _get(port, "/debug/ledger")
+            assert status == 200
+            assert json.loads(body) == {"enabled": False}
+
+            # the unified timeline renders even with the ledger off
+            # (spans + flight events only) and is valid Chrome trace JSON
+            status, body = _get(port, "/debug/timeline")
+            assert status == 200
+            doc = json.loads(body)
+            assert isinstance(doc["traceEvents"], list)
+            assert all("ph" in ev and "name" in ev
+                       for ev in doc["traceEvents"])
 
             # non-wire scheduler: the fabric endpoint reports disabled
             status, body = _get(port, "/debug/fabric")

@@ -39,8 +39,8 @@ def test_all_passes_clean_on_real_tree():
     p = subprocess.run([sys.executable, "-m", "tools.ktpu_check", "--all"],
                        cwd=REPO, capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, p.stdout + p.stderr
-    for name in ("locks", "jit", "errors", "metrics", "spans", "markers",
-                 "pb2-drift", "suppress"):
+    for name in ("locks", "jit", "errors", "metrics", "spans", "events",
+                 "markers", "pb2-drift", "suppress"):
         assert f"ok   {name}" in p.stdout, p.stdout
 
 
@@ -72,6 +72,77 @@ def test_registry_covers_the_absorbed_gates():
     """The three pre-existing lint CLIs are registered passes now."""
     for absorbed in ("metrics", "spans", "markers", "pb2-drift"):
         assert absorbed in kc.PASSES
+
+
+# ----------------------------------------------------------------- events
+
+
+_FAKE_TELEMETRY = '''
+EVENT_KINDS = frozenset({"dispatch", "commit", "poison"})
+'''
+
+EVENTS_BAD = '''
+from . import telemetry
+
+def f(t):
+    telemetry.event("mystery_kind", batchId="b1")     # BAD: undeclared
+    t.flight.record("another_unknown", pods=3)        # BAD: undeclared
+    telemetry.event("dispatch", batchId="b2")         # declared: fine
+'''
+
+EVENTS_CLEAN = '''
+from . import telemetry
+
+def f(t, etype):
+    telemetry.event("dispatch", batchId="b1")
+    telemetry.event("commit", batchId="b1")
+    t.flight.record("poison", batchId="b1")
+    t.flight.record(etype, batchId="b1")   # pass-through: checked at the
+                                           # forwarding call's literal site
+    t.recorder.record("not-an-event")      # non-flight receiver: ignored
+'''
+
+
+def _events_fixture(tmp_path, pkg_text):
+    pkg = _write_pkg(tmp_path, "pkg", pkg_text)
+    tel = tmp_path / "telemetry.py"
+    tel.write_text(_FAKE_TELEMETRY)
+    return pkg, str(tel)
+
+
+def test_events_pass_detects_seeded_violations(tmp_path):
+    pkg, tel = _events_fixture(tmp_path, EVENTS_BAD)
+    findings = kc.find_undeclared_events(pkg, tel)
+    kinds = {f.message.split("'")[1] for f in findings}
+    assert kinds == {"mystery_kind", "another_unknown"}
+
+
+def test_events_pass_clean_fixture_has_zero_false_positives(tmp_path):
+    pkg, tel = _events_fixture(tmp_path, EVENTS_CLEAN)
+    assert kc.find_undeclared_events(pkg, tel) == []
+
+
+def test_events_pass_missing_registry_is_a_finding(tmp_path):
+    """An analysis that cannot find its registry must FAIL, not silently
+    judge nothing (the entry-point-discovery guard, events edition)."""
+    pkg = _write_pkg(tmp_path, "pkg", EVENTS_CLEAN)
+    tel = tmp_path / "telemetry.py"
+    tel.write_text("OTHER = 1\n")
+    findings = kc.find_undeclared_events(pkg, str(tel))
+    assert len(findings) == 1 and "EVENT_KINDS" in findings[0].message
+
+
+def test_events_registry_matches_real_tree():
+    """The real tree's emitted kinds EXACTLY equal the declared registry:
+    an undeclared emission fails here (and the lint), and a kind whose
+    last emission site was deleted must leave EVENT_KINDS too — the
+    vocabulary never accumulates dead entries."""
+    declared = kc.declared_event_kinds()
+    emitted = {k for _p, _l, k in kc.emitted_event_kinds()}
+    assert emitted, "entry-point discovery guard: no emission sites found?"
+    assert emitted == declared, (
+        f"undeclared: {sorted(emitted - declared)}; "
+        f"stale: {sorted(declared - emitted)}")
 
 
 # ----------------------------------------------------------------- locks

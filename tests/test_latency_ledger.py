@@ -1,0 +1,502 @@
+"""Pod-lifetime latency ledger (metrics/latency_ledger.py): per-segment
+e2e attribution across adversarial flows (backoff requeue, ring poison,
+gang Permit park + whole-gang reject, wire conflict), the e2e == sum(
+segments) invariant, churn-cannot-leak + cap eviction bounds, the
+disabled-cost/placement-parity contract (the PR-2/PR-7 rule), the bounded
+tenant SLO label set, and the unified /debug/timeline Chrome-trace export
+— including the acceptance proof: a pod scheduled through the pipelined
+wire path after one injected poison requeue."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.metrics import latency_ledger
+from kubernetes_tpu.metrics.latency_ledger import PodLatencyLedger, SEGMENTS
+from kubernetes_tpu.metrics.scheduler_metrics import SchedulerMetrics
+from kubernetes_tpu.scheduler import Scheduler
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    latency_ledger.disable()
+    yield
+    latency_ledger.disable()
+
+
+def _entry_invariant(entry, eps=1e-9):
+    """The gap-free state machine's contract: e2e == sum(segments)."""
+    assert entry is not None and entry["closed"] is not None
+    e2e = entry["closed"] - entry["opened"]
+    total = sum(entry["segments"].values())
+    assert abs(e2e - total) <= eps, (e2e, total, entry["segments"])
+    assert set(entry["segments"]) <= SEGMENTS
+    return e2e
+
+
+# ------------------------------------------------------------ unit mechanics
+
+
+class TestLedgerMechanics:
+    def test_transitions_accumulate_and_close_observes(self):
+        clock = FakeClock()
+        m = SchedulerMetrics()
+        led = PodLatencyLedger(m, now_fn=clock,
+                               tenant_fn=lambda ns: 2 if ns == "t" else None)
+        led.transition("t/p", "queue.active", namespace="t")
+        clock.advance(1.0)
+        led.transition("t/p", "cycle.host")
+        clock.advance(0.5)
+        led.transition("t/p", "queue.backoff")  # requeue
+        clock.advance(2.0)
+        led.transition("t/p", "cycle.host")     # second attempt
+        clock.advance(0.25)
+        led.transition("t/p", "bind")
+        clock.advance(0.125)
+        led.close("t/p", "scheduled")
+        e = led.entry("t/p")
+        assert e["segments"] == {
+            "queue.active": 1.0, "cycle.host": 0.75,
+            "queue.backoff": 2.0, "bind": 0.125}
+        assert _entry_invariant(e) == pytest.approx(3.875)
+        assert m.pod_e2e_duration.count("scheduled") == 1
+        assert m.pod_latency_segment.count("queue.backoff") == 1
+        assert m.pod_latency_segment.sum("cycle.host") == 0.75
+        # tenant namespace: the SLO histogram observed it
+        assert m.tenant_e2e_duration.count("t") == 1
+        assert len(led) == 0
+
+    def test_tenant_label_set_is_bounded_to_quota_tenants(self):
+        m = SchedulerMetrics()
+        led = PodLatencyLedger(m, now_fn=FakeClock(),
+                               tenant_fn=lambda ns: 1 if ns == "quota" else None)
+        for ns in ("quota", "default", "anon-1", "anon-2", "anon-3"):
+            led.transition(f"{ns}/p", "queue.active", namespace=ns)
+            led.close(f"{ns}/p", "scheduled")
+        # only the quota tenant appears — an unbounded namespace population
+        # cannot explode the registry
+        assert m.tenant_e2e_duration.label_sets() == [("quota",)]
+        assert m.pod_e2e_duration.count("scheduled") == 5
+
+    def test_deleted_close_skips_tenant_slo(self):
+        m = SchedulerMetrics()
+        led = PodLatencyLedger(m, now_fn=FakeClock(),
+                               tenant_fn=lambda ns: 1)
+        led.transition("t/p", "queue.active", namespace="t")
+        led.drop("t/p")
+        assert m.pod_e2e_duration.count("deleted") == 1
+        assert m.tenant_e2e_duration.label_sets() == []
+
+    def test_cap_evicts_oldest_with_counter(self):
+        m = SchedulerMetrics()
+        led = PodLatencyLedger(m, cap=4, now_fn=FakeClock())
+        for i in range(10):
+            led.transition(f"ns/p{i}", "queue.active", namespace="ns")
+        assert len(led) == 4
+        assert led.evicted == 6
+        assert m.ledger_evicted.labels() == 6
+        # the oldest are gone, the newest survive
+        assert led.entry("ns/p0") is None
+        assert led.entry("ns/p9") is not None
+
+    def test_batch_transitions_share_one_clock_read(self):
+        clock = FakeClock()
+        led = PodLatencyLedger(now_fn=clock)
+        led.transition_many(["a/1", "a/2", "a/3"], "queue.active",
+                            create=True)
+        clock.advance(1.0)
+        led.transition_many(["a/1", "a/2", "a/3"], "device.inflight",
+                            batch_id="b7")
+        clock.advance(0.5)
+        led.close_many(["a/1", "a/2", "a/3"], "scheduled")
+        for k in ("a/1", "a/2", "a/3"):
+            e = led.entry(k)
+            assert e["batchId"] == "b7"
+            assert e["segments"] == {"queue.active": 1.0,
+                                     "device.inflight": 0.5}
+            _entry_invariant(e)
+
+    def test_post_queue_transitions_never_resurrect_dropped_entries(self):
+        """A pod deleted mid-flight has its entry dropped; the batch's
+        later claim/bind hooks (create=False) must NOT re-create it as a
+        ghost with a bogus near-zero e2e — one pod, one close."""
+        m = SchedulerMetrics()
+        led = PodLatencyLedger(m, now_fn=FakeClock())
+        led.transition("ns/p", "queue.active", namespace="ns")
+        led.transition_many(["ns/p"], "device.inflight", batch_id="b1")
+        led.drop("ns/p")  # user deletes the pod while the batch flies
+        # the claim and bind-tail hooks arrive after the drop
+        led.transition_many(["ns/p"], "commit.host")
+        led.transition("ns/p", "bind", create=False)
+        assert len(led) == 0
+        led.close_many(["ns/p"], "scheduled")  # no-op on the absent key
+        assert m.pod_e2e_duration.count("deleted") == 1
+        assert m.pod_e2e_duration.count("scheduled") == 0
+
+    def test_chrome_trace_structure(self):
+        clock = FakeClock(1000.0)
+        led = PodLatencyLedger(now_fn=clock)
+        led.transition("ns/p", "queue.active", namespace="ns")
+        clock.advance(1.0)
+        led.transition("ns/p", "device.inflight", batch_id="b1")
+        clock.advance(1.0)
+        led.close("ns/p", "scheduled")
+        doc = latency_ledger.chrome_trace(
+            flight=[{"seq": 1, "t": 1001.0, "type": "dispatch",
+                     "batchId": "b1"}],
+            ledger=led)
+        body = json.dumps(doc)  # must be JSON-serializable as-is
+        doc = json.loads(body)
+        evs = doc["traceEvents"]
+        slices = [e for e in evs if e.get("cat") == "ledger"]
+        assert {e["name"] for e in slices} == {"queue.active",
+                                               "device.inflight"}
+        for e in slices:
+            assert e["ph"] == "X" and e["args"]["pod"] == "ns/p"
+            assert e["ts"] >= 1000.0 * 1e6 and e["dur"] > 0
+        (inst,) = [e for e in evs if e.get("cat") == "flight"]
+        assert inst["ph"] == "i" and inst["args"]["batchId"] == "b1"
+        # pod track named after the pod UID
+        assert any(e["ph"] == "M" and e["name"] == "thread_name"
+                   and e["args"]["name"] == "ns/p" for e in evs)
+
+
+# -------------------------------------------------------- disabled contract
+
+
+class TestDisabledContract:
+    """The PR-2/PR-7 rule: one module-global read per hook when off."""
+
+    def test_disabled_hooks_are_noops(self):
+        assert latency_ledger.get() is None
+        assert latency_ledger.transition("a/b", "queue.active") is None
+        assert latency_ledger.transition_many(["a/b"], "bind") is None
+        assert latency_ledger.close("a/b") is None
+        assert latency_ledger.close_many(["a/b"]) is None
+        assert latency_ledger.drop("a/b") is None
+
+    def test_enable_disable_roundtrip(self):
+        led = latency_ledger.enable()
+        assert latency_ledger.get() is led
+        latency_ledger.transition("a/b", "queue.active")
+        assert len(led) == 1
+        latency_ledger.disable()
+        assert latency_ledger.get() is None
+        latency_ledger.transition("a/c", "queue.active")  # no-op, no error
+        assert len(led) == 1
+
+    def test_maybe_enable_from_env_gate(self, monkeypatch):
+        monkeypatch.delenv("KTPU_LEDGER", raising=False)
+        latency_ledger.maybe_enable_from_env()
+        assert latency_ledger.get() is None
+        monkeypatch.setenv("KTPU_LEDGER", "1")
+        latency_ledger.maybe_enable_from_env()
+        assert latency_ledger.get() is not None
+
+    def test_placement_parity_ledger_on_equals_off(self):
+        """Enabling the ledger changes counters, never decisions."""
+
+        def run(with_ledger):
+            store = ClusterStore()
+            sched = Scheduler(store, seed=3)
+            if with_ledger:
+                latency_ledger.enable(sched.smetrics)
+            for i in range(6):
+                store.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": str(4 + i), "memory": "16Gi", "pods": 20}).obj())
+            for i in range(12):
+                store.create_pod(make_pod(f"p{i}").req(
+                    {"cpu": "500m", "memory": "1Gi"}).obj())
+            sched.run_until_settled()
+            latency_ledger.disable()
+            return {k: p.spec.node_name for k, p in store.pods.items()}
+
+        assert run(False) == run(True)
+
+
+# -------------------------------------------------------- adversarial flows
+
+
+class TestAdversarialFlows:
+    def test_backoff_and_unschedulable_accumulate_across_attempts(self):
+        """No-capacity park -> NODE_ADD wake -> bind: the entry carries
+        queue.unschedulable dwell plus both attempts' cycle work, and the
+        invariant holds on the FakeClock exactly."""
+        clock = FakeClock()
+        store = ClusterStore()
+        sched = Scheduler(store, now_fn=clock)
+        led = latency_ledger.enable(sched.smetrics, now_fn=clock)
+        store.create_pod(make_pod("p0").req({"cpu": "1"}).obj())
+        sched.run_until_settled()  # no nodes: parks unschedulable
+        assert sched.queue.pending_pods()["unschedulable"] == 1
+        clock.advance(3.0)
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": 10}).obj())
+        sched.run_until_settled()
+        assert sched.metrics["scheduled"] == 1
+        e = led.entry("default/p0")
+        assert e["result"] == "scheduled"
+        assert e["segments"]["queue.unschedulable"] >= 3.0
+        # host segments exist even though the FakeClock reads 0 for them
+        # (nothing advances it during host work)
+        assert {"cycle.host", "bind"} <= set(e["segments"])
+        _entry_invariant(e)
+        assert len(led) == 0
+
+    def test_ring_poison_requeue_accumulates_device_and_backoff(self):
+        """In-process pipelined path: one scripted relay death poisons the
+        ring; the pods' entries carry device.inflight + queue.backoff from
+        the poisoned attempt AND the successful retry's segments."""
+        from kubernetes_tpu.backend import TPUScheduler
+
+        store = ClusterStore()
+        sched = TPUScheduler(store, batch_size=8,
+                             pod_initial_backoff=0.05, pod_max_backoff=0.1)
+        led = latency_ledger.enable(sched.smetrics)
+        for i in range(4):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 20}).obj())
+        for i in range(6):
+            store.create_pod(make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+        fired = []
+
+        def fault(_op):
+            if not fired:
+                fired.append(1)
+                return RuntimeError("scripted poison")
+            return None
+
+        sched.relay_fault_fn = fault
+        for _ in range(40):
+            sched.run_until_settled()
+            if sched.metrics["scheduled"] == 6:
+                break
+            time.sleep(0.06)
+        assert sched.metrics["scheduled"] == 6
+        assert fired  # the poison actually fired
+        e = led.entry("default/p0")
+        assert e["result"] == "scheduled"
+        assert e["segments"]["device.inflight"] > 0
+        assert e["segments"]["queue.backoff"] > 0
+        assert e["segments"]["commit.host"] > 0
+        _entry_invariant(e)
+        assert len(led) == 0
+
+    def test_gang_permit_park_and_whole_gang_reject(self):
+        """A lone gang member parks at Permit (gang.permit_park), the
+        timeout sweep rejects the WHOLE gang, and when the missing sibling
+        arrives both members bind — their entries carrying the park."""
+        from kubernetes_tpu.api.types import ObjectMeta, PodGroup
+
+        clock = FakeClock()
+        store = ClusterStore()
+        sched = Scheduler(store, now_fn=clock)
+        led = latency_ledger.enable(sched.smetrics, now_fn=clock)
+        for i in range(4):
+            store.create_node(make_node(f"n{i}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": 20}).obj())
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name="g", namespace="default"),
+            min_member=2, schedule_timeout_seconds=5))
+        store.create_pod(
+            make_pod("g-0").req({"cpu": "500m"}).pod_group("g").obj())
+        store.create_pod(
+            make_pod("g-1").req({"cpu": "500m"}).pod_group("g").obj())
+        # one cycle: the FIRST member parks at Permit waiting on quorum
+        assert sched.schedule_one()
+        assert "default/g-0" in sched.waiting_pods
+        assert led.entry("default/g-0")["segment"] == "gang.permit_park"
+        # past the gang timeout BEFORE the sibling's cycle runs: the sweep
+        # tears down the WHOLE gang (reject cascades through Coscheduling)
+        clock.advance(6.0)
+        sched.schedule_one()
+        assert "default/g-0" not in sched.waiting_pods
+        e = led.entry("default/g-0")
+        assert e["segments"]["gang.permit_park"] >= 5.0
+        # both members park unschedulable (no ClusterEvent wakes a gang
+        # denial); the unschedulable-timeout flush retries them — by then
+        # the denial backoff has lapsed, quorum holds, the gang binds whole
+        for _ in range(20):
+            sched.run_until_settled()
+            if sched.metrics["scheduled"] == 2:
+                break
+            clock.advance(60.0)
+        assert sched.metrics["scheduled"] == 2
+        e = led.entry("default/g-0")
+        assert e["result"] == "scheduled"
+        assert e["segments"]["gang.permit_park"] >= 5.0
+        # the post-reject park shows up as queue dwell (map or backoff)
+        assert (e["segments"].get("queue.unschedulable", 0)
+                + e["segments"].get("queue.backoff", 0)) > 0
+        _entry_invariant(e)
+        assert len(led) == 0
+
+    def test_delete_while_unbound_drops_entry_under_churn(self):
+        """2x-cluster churn of never-schedulable pods: every deleted pod's
+        entry drops (result=deleted) — the ledger cannot leak."""
+        store = ClusterStore()
+        sched = Scheduler(store)
+        led = latency_ledger.enable(sched.smetrics)
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "1", "memory": "1Gi", "pods": 10}).obj())
+        for round_ in range(4):
+            for i in range(5):
+                store.create_pod(make_pod(f"c{round_}-{i}").req(
+                    {"cpu": "64"}).obj())  # never fits
+            sched.run_until_settled()
+            for i in range(5):
+                store.delete_pod(f"default/c{round_}-{i}")
+        assert len(led) == 0
+        assert led.evicted == 0
+        assert sched.smetrics.pod_e2e_duration.count("deleted") == 20
+
+
+# ------------------------------------------- wire acceptance + /debug/timeline
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode()
+
+
+class TestWirePipelineAcceptance:
+    """The ISSUE acceptance proof: a pod scheduled through the PIPELINED
+    wire path after one injected poison requeue shows e2e == sum(segments)
+    with nonzero device.inflight and queue.backoff, and /debug/timeline
+    renders its segments next to its batch's dispatch/commit flight events
+    as valid Chrome trace-event JSON."""
+
+    def test_pipelined_wire_poison_then_timeline(self):
+        from kubernetes_tpu.backend import telemetry
+        from kubernetes_tpu.backend.service import (DeviceService,
+                                                    WireScheduler, serve)
+        from kubernetes_tpu.cmd.server import ComponentServer, \
+            build_debug_handlers
+        from kubernetes_tpu.testing.faults import FaultPlan
+
+        # one transport error burst that outlives the retry budget: the
+        # in-flight batch dies with its transport -> pipeline_poison ->
+        # backoffQ requeue, exactly like ring poison
+        plan = FaultPlan().error_n(2, "schedule_batch")
+        service = DeviceService(batch_size=32)
+        server, port = serve(service, fault_plan=plan)
+        clock = FakeClock()
+        store = ClusterStore()
+        sched = WireScheduler(
+            store, endpoint=f"http://127.0.0.1:{port}", batch_size=4,
+            wire_pipeline_depth=3, fault_plan=plan,
+            now_fn=clock, sleep_fn=lambda s: clock.advance(s),
+            heartbeat_interval_s=0.0, wire_max_retries=1,
+            pod_initial_backoff=0.01, pod_max_backoff=0.05)
+        # ledger on its own wall clock: transport dwell is real time even
+        # though the scheduler runs on the FakeClock
+        led = latency_ledger.enable(sched.smetrics)
+        tele = telemetry.enable(sched.smetrics)
+        try:
+            for i in range(4):
+                store.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": "8", "memory": "16Gi", "pods": 20}).obj())
+            for i in range(8):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+            for _ in range(40):
+                sched.run_until_settled()
+                if sched.metrics["scheduled"] == 8:
+                    break
+                clock.advance(0.1)
+                time.sleep(0.002)  # real dwell for the backoff segment
+            assert sched.metrics["scheduled"] == 8
+            # the poison really happened
+            assert tele.flight.events("pipeline_poison")
+            poisoned = {e["batchId"]
+                        for e in tele.flight.events("pipeline_poison")}
+            # find a pod whose batch was poisoned and later rebound
+            victim = None
+            for view in led.timeline_entries():
+                if (view["result"] == "scheduled"
+                        and view["segments"].get("queue.backoff", 0) > 0):
+                    victim = view
+                    break
+            assert victim is not None, "no poisoned-then-bound pod found"
+            assert victim["segments"]["device.inflight"] > 0
+            assert victim["segments"]["queue.backoff"] > 0
+            _entry_invariant(victim)
+            assert victim["batchId"] not in poisoned  # rebound on a NEW batch
+
+            # ---- /debug/timeline over real HTTP
+            mux = ComponentServer(configz={},
+                                  registry=sched.smetrics.registry,
+                                  debug=build_debug_handlers(sched))
+            mux_port = mux.start()
+            try:
+                status, body = _get(mux_port, "/debug/timeline?limit=2000")
+                assert status == 200
+                doc = json.loads(body)  # valid Chrome trace-event JSON
+                evs = doc["traceEvents"]
+                assert all("ph" in e and "name" in e and "pid" in e
+                           for e in evs)
+                pod_slices = [e for e in evs if e.get("cat") == "ledger"
+                              and e["args"].get("pod") == victim["pod"]]
+                names = {e["name"] for e in pod_slices}
+                assert {"device.inflight", "queue.backoff"} <= names
+                # the pod's FINAL batch's dispatch + commit flight events
+                # share the timeline, correlated by batchId
+                flight_names = {
+                    e["name"] for e in evs if e.get("cat") == "flight"
+                    and e["args"].get("batchId") == victim["batchId"]}
+                assert {"dispatch", "commit"} <= flight_names
+            finally:
+                mux.stop()
+        finally:
+            telemetry.disable()
+            server.shutdown()
+
+    def test_wire_conflict_requeue_accumulates(self):
+        """A scripted cross-client conflict verdict: the pod bounces off
+        backoffQ (conflict -> error requeue) and the retry binds it — the
+        entry spans both attempts."""
+        from kubernetes_tpu.backend.service import (DeviceService,
+                                                    WireScheduler, serve)
+        from kubernetes_tpu.testing.faults import FaultPlan
+
+        plan = FaultPlan().conflict("schedule_batch")
+        service = DeviceService(batch_size=32)
+        server, port = serve(service, fault_plan=plan)
+        clock = FakeClock()
+        store = ClusterStore()
+        sched = WireScheduler(
+            store, endpoint=f"http://127.0.0.1:{port}", batch_size=8,
+            fault_plan=plan, now_fn=clock,
+            sleep_fn=lambda s: clock.advance(s),
+            heartbeat_interval_s=0.0, wire_max_retries=1,
+            pod_initial_backoff=0.01, pod_max_backoff=0.05)
+        led = latency_ledger.enable(sched.smetrics)
+        try:
+            for i in range(4):
+                store.create_node(make_node(f"n{i}").capacity(
+                    {"cpu": "8", "memory": "16Gi", "pods": 20}).obj())
+            for i in range(4):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "500m"}).obj())
+            for _ in range(40):
+                sched.run_until_settled()
+                if sched.metrics["scheduled"] == 4:
+                    break
+                clock.advance(0.1)
+                time.sleep(0.002)
+            assert sched.metrics["scheduled"] == 4
+            assert sched.session_rejoins >= 1  # the conflict really fired
+            e = led.entry("default/p0")
+            assert e["result"] == "scheduled"
+            assert e["segments"]["queue.backoff"] > 0
+            assert e["segments"]["device.inflight"] > 0
+            _entry_invariant(e)
+            assert len(led) == 0
+        finally:
+            server.shutdown()

@@ -77,9 +77,14 @@ class TestSchedulingSoakSmall:
         assert all(it.data["Perc99"] < 1.0 for it in atts)
 
     def test_flooding_tenant_p99_bound(self):
-        """A 10x-flooding tenant cannot push the calm tenant's p99 queue
-        wait above 2x its solo baseline (deterministic on the FakeClock:
-        every cycle ticks 0.05s, so waits count scheduling cycles)."""
+        """A 10x-flooding tenant cannot push the calm tenant's p99 e2e
+        above 2x its solo baseline (deterministic on the FakeClock: every
+        cycle ticks 0.05s, so waits count scheduling cycles). The SLO is
+        judged from ``scheduler_tenant_e2e_duration_seconds`` read off the
+        REGISTRY (the latency ledger's per-tenant histogram — what a real
+        alert would scrape from /metrics; closes the ROADMAP item-4 SLO
+        fragment); the harness-internal wait accounting stays only as a
+        cross-check."""
 
         def soak(mix):
             clock = FakeClock()
@@ -92,21 +97,35 @@ class TestSchedulingSoakSmall:
                                hard={"pods": 10 ** 6}, weight=1)
                 r.soak_phase(rounds=4, mix=mix, cycles_per_round=80,
                              tick_s=0.05)
-                return _tenant_map(r.data_items)
+                # the registry is the source of truth for the SLO numbers:
+                # re-derive the tenant p99 straight off the histogram too,
+                # proving the DataItem is a faithful scrape
+                hist = r.scheduler.smetrics.registry.get(
+                    "scheduler_tenant_e2e_duration_seconds")
+                reg_p99 = {ns: hist.percentile(0.99, ns)
+                           for (ns,) in hist.label_sets()}
+                return _tenant_map(r.data_items), reg_p99
             finally:
                 r.close()
 
         calm = {"namespace": "calm", "count": 10,
                 "req": {"cpu": "100m", "memory": "500Mi"}}
-        solo = soak([calm])
-        flooded = soak([calm, {"namespace": "flood", "count": 100,
-                               "req": {"cpu": "100m", "memory": "500Mi"}}])
-        solo_p99 = solo["calm"]["WaitP99"]
+        solo, solo_reg = soak([calm])
+        flooded, flooded_reg = soak(
+            [calm, {"namespace": "flood", "count": 100,
+                    "req": {"cpu": "100m", "memory": "500Mi"}}])
+        # the SLO bound, judged from the registry metric
+        solo_p99 = solo_reg["calm"]
         assert solo_p99 > 0
+        assert solo["calm"]["E2eCount"] == solo["calm"]["Admitted"] > 0
         assert flooded["calm"]["Admitted"] == solo["calm"]["Admitted"]
-        assert flooded["calm"]["WaitP99"] <= 2.0 * solo_p99, (
-            f'flooded p99 {flooded["calm"]["WaitP99"]} vs '
-            f"solo {solo_p99}")
+        assert flooded_reg["calm"] <= 2.0 * solo_p99, (
+            f'flooded e2e p99 {flooded_reg["calm"]} vs solo {solo_p99}')
+        # harness-internal accounting kept as the cross-check: the ledger's
+        # registry p99 and the created_at->bound wait p99 must agree on the
+        # shared FakeClock (bucket interpolation gives the histogram slack)
+        assert flooded["calm"]["WaitP99"] <= 2.0 * solo["calm"]["WaitP99"]
+        assert flooded["calm"]["E2eP99"] == flooded_reg["calm"]
 
 
 class TestSchedulingSoakTPU:

@@ -353,6 +353,85 @@ def pass_spans() -> List[Finding]:
             for n in bad]
 
 
+# ====================================================================== events
+# Flight-recorder event-kind lint (the span-name lint's twin, PR-7 follow-
+# up): every LITERAL kind passed to ``telemetry.event(...)`` or
+# ``<...>.flight.record(...)`` inside the package must appear in the
+# declared registry frozenset ``backend/telemetry.py EVENT_KINDS`` — a new
+# lifecycle event cannot ship without joining the documented vocabulary.
+
+TELEMETRY_FILE = os.path.join(PKG, "backend", "telemetry.py")
+
+
+def declared_event_kinds(path: str = None) -> Set[str]:
+    """The EVENT_KINDS frozenset literal from backend/telemetry.py."""
+    tree = ast.parse(_read(path or TELEMETRY_FILE))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Name) and tgt.id == "EVENT_KINDS"):
+            continue
+        return {c.value for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)}
+    return set()
+
+
+def emitted_event_kinds(pkg: str = None) -> List[Tuple[str, int, str]]:
+    """(path, line, kind) for every literal event-kind emission site:
+    calls whose attribute is ``event`` (``telemetry.event`` and
+    ``DeviceTelemetry.event`` call-throughs) or ``record`` on a
+    flight-recorder receiver (``self.flight.record`` / ``flight.record``).
+    Non-literal first args (pass-through helpers) are skipped — they
+    forward kinds already checked at their own literal call sites."""
+    out: List[Tuple[str, int, str]] = []
+    for path in _walk_py(pkg or PKG):
+        try:
+            tree = ast.parse(_read(path))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.args):
+                continue
+            attr = node.func.attr
+            if attr == "record":
+                recv = node.func.value
+                recv_name = (recv.attr if isinstance(recv, ast.Attribute)
+                             else recv.id if isinstance(recv, ast.Name)
+                             else "")
+                if recv_name != "flight":
+                    continue
+            elif attr != "event":
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append((path, node.lineno, arg.value))
+    return out
+
+
+def find_undeclared_events(pkg: str = None,
+                           telemetry_path: str = None) -> List[Finding]:
+    declared = declared_event_kinds(telemetry_path)
+    if not declared:
+        return [Finding(telemetry_path or TELEMETRY_FILE, 0,
+                        "EVENT_KINDS registry frozenset not found — the "
+                        "events lint has nothing to check against")]
+    return [Finding(path, line,
+                    f"undeclared flight-recorder event kind {kind!r}: add "
+                    "it to backend/telemetry.py EVENT_KINDS (the declared "
+                    "postmortem vocabulary) or rename to a declared kind")
+            for path, line, kind in emitted_event_kinds(pkg)
+            if kind not in declared]
+
+
+@register("events", "emitted flight-recorder event kinds are declared in "
+                    "telemetry.EVENT_KINDS")
+def pass_events() -> List[Finding]:
+    return find_undeclared_events()
+
+
 # ===================================================================== markers
 # (absorbed from tools/check_markers.py — the PR-4 slow-marker lint)
 

@@ -171,6 +171,12 @@ FENCE_TOLERANCES = {
     "p99_s": 50.0,                 # headline attempt p99: % above baseline
     "workload_pods_per_s": 40.0,   # per-workload matrix throughput
     "workload_p99_s": 100.0,       # per-workload attempt p99
+    # pod end-to-end p99 (latency ledger, first recorded r11+): e2e spans
+    # every attempt — backoff requeues quantize it in ~1s steps and queue
+    # dwell scales with arrival burstiness, so the tolerances are one
+    # notch looser than the attempt-p99 rows they wrap
+    "e2e_p99_s": 100.0,            # headline pod e2e p99
+    "workload_e2e_p99_s": 200.0,   # per-workload pod e2e p99
 }
 # per-workload overrides for rows whose history is structurally volatile
 # (PreemptionBasic swung 2953 -> 69 -> 243 pods/s across r02-r05 as the
@@ -271,6 +277,12 @@ def fence(current: dict, rounds: Optional[List[dict]] = None) -> dict:
           (current.get("attempt_latency_s") or {}).get("p99"),
           (base.get("attempt_latency_s") or {}).get("p99"),
           tol["p99_s"], False)
+    # pod e2e p99 (latency ledger): judged only when BOTH rounds recorded
+    # it — pre-ledger baselines skip the check rather than fake a pass
+    check("headline e2e p99",
+          (current.get("e2e_latency_s") or {}).get("p99"),
+          (base.get("e2e_latency_s") or {}).get("p99"),
+          tol["e2e_p99_s"], False)
     cur_wl = current.get("workloads") or {}
     base_wl = base.get("workloads") or {}
     for name in sorted(set(cur_wl) & set(base_wl)):
@@ -287,6 +299,10 @@ def fence(current: dict, rounds: Optional[List[dict]] = None) -> dict:
         check(f"workload {name} attempt p99", c.get("attempt_p99_s"),
               b.get("attempt_p99_s"),
               over.get("workload_p99_s", tol["workload_p99_s"]), False)
+        check(f"workload {name} e2e p99", c.get("e2e_p99_s"),
+              b.get("e2e_p99_s"),
+              over.get("workload_e2e_p99_s", tol["workload_e2e_p99_s"]),
+              False)
     return {"baselineRound": base.get("_round"), "checked": checked,
             "violations": violations, "tolerances": FENCE_TOLERANCES}
 
