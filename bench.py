@@ -351,7 +351,7 @@ MATRIX_ROWS = ("SchedulingPodAntiAffinity", "TopologySpreading",
                "SchedulingNodeAffinity", "PreferredTopologySpreading",
                "MigratedInTreePVs", "PreemptionPVs",
                "SchedulingRequiredPodAntiAffinityWithNSSelector",
-               "SchedulingElastic")
+               "SchedulingElastic", "SchedulingSlices")
 
 
 def run_matrix(budget_deadline, platform):
@@ -422,6 +422,21 @@ def run_matrix_child(name: str) -> None:
                 entry["elastic"] = {k: it.data[k] for k in (
                     "LostPods", "Oversubscribed", "RowCapacity",
                     "SlotReuses", "UploadBytesSteady", "HbmPeakBytes")}
+            elif label == "SliceStats":
+                # slice-packing acceptance evidence (ISSUE 16): placement
+                # quality + correctness counters ride the bench row; the
+                # fence judges wait_p99_s/frag_max, the zero-counters are
+                # judged by eye/tests
+                entry["slices"] = {
+                    "frag_max": round(it.data["FragmentationMax"], 4),
+                    "frag_mean": round(it.data["FragmentationMean"], 4),
+                    "contiguity_violations": it.data["ContiguityViolations"],
+                    "bound_gangs": it.data["BoundSliceGangs"],
+                    "rejected": it.data["SliceRejected"],
+                    "fallback": it.data["FallbackScheduled"],
+                    "wait_p50_s": round(it.data["SliceWaitP50"], 4),
+                    "wait_p99_s": round(it.data["SliceWaitP99"], 4),
+                }
             elif label == "pod_e2e_duration_seconds" \
                     and it.labels.get("result") == "scheduled":
                 # pod-lifetime e2e (latency ledger): the fence's

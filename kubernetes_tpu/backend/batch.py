@@ -195,30 +195,40 @@ class BatchResult(NamedTuple):
     packed: Optional[jax.Array] = None               # [P, 1 + ceil(N/4)] int32
 
 
-def pack_result_block(node_idx: jax.Array, first_fail: jax.Array) -> jax.Array:
-    """[P, 1 + ceil(N/4)] int32: node_idx in column 0, the int8 first_fail
-    rows bitcast into int32 words after it. Traced into the batch program
-    (schedule_batch's jit), so the packing is free relative to a transfer:
-    one fused device buffer replaces two independent host reads."""
+def pack_result_block(node_idx: jax.Array, first_fail: jax.Array,
+                      slice_words: Optional[jax.Array] = None) -> jax.Array:
+    """[P, 1 + ceil(N/4) (+1)] int32: node_idx in column 0, the int8
+    first_fail rows bitcast into int32 words after it, and — when the batch
+    carried slice gangs — one trailing column of per-pod slice verdict words
+    (see _slice_plan). Traced into the batch program (schedule_batch's jit),
+    so the packing is free relative to a transfer: one fused device buffer
+    replaces independent node_idx/first_fail/verdict host reads."""
     p, n = first_fail.shape
     pad = (-n) % 4
     if pad:
         first_fail = jnp.pad(first_fail, ((0, 0), (0, pad)))
     words = lax.bitcast_convert_type(
         first_fail.reshape(p, (n + pad) // 4, 4), jnp.int32)
-    return jnp.concatenate([node_idx[:, None], words], axis=1)
+    cols = [node_idx[:, None], words]
+    if slice_words is not None:
+        cols.append(slice_words[:, None])
+    return jnp.concatenate(cols, axis=1)
 
 
 def unpack_result_block(packed, n_nodes: int):
-    """(node_idx [P] int32, first_fail [P, N] int8) from one materialized
-    packed block. The np.asarray here is THE blocking device read of a batch
-    commit; everything after is host-side reinterpretation (the int32→int8
-    view matches lax.bitcast_convert_type byte order on both CPU and TPU —
-    pinned by tests/test_kernel_parity.py)."""
+    """(node_idx [P] int32, first_fail [P, N] int8, slice_words [P] int32 or
+    None) from one materialized packed block. The np.asarray here is THE
+    blocking device read of a batch commit; everything after is host-side
+    reinterpretation (the int32→int8 view matches lax.bitcast_convert_type
+    byte order on both CPU and TPU — pinned by tests/test_kernel_parity.py).
+    The slice column's presence is inferred from the block width, so
+    slice-free batches pay nothing."""
     arr = np.asarray(packed)
     node_idx = arr[:, 0]
-    ff = np.ascontiguousarray(arr[:, 1:]).view(np.int8)
-    return node_idx, ff.reshape(arr.shape[0], -1)[:, :n_nodes]
+    ff_words = (n_nodes + 3) // 4
+    slice_words = arr[:, 1 + ff_words] if arr.shape[1] > 1 + ff_words else None
+    ff = np.ascontiguousarray(arr[:, 1:1 + ff_words]).view(np.int8)
+    return node_idx, ff.reshape(arr.shape[0], -1)[:, :n_nodes], slice_words
 
 
 def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
@@ -972,6 +982,7 @@ def schedule_batch_core(
     ports_enabled: bool = True,
     extra_mask: Optional[jax.Array] = None,
     dra_mask: Optional[jax.Array] = None,
+    slice_mask: Optional[jax.Array] = None,
 ) -> BatchResult:
     """The traceable body; nt's node axis may be a shard (axis_name set).
 
@@ -1030,11 +1041,18 @@ def schedule_batch_core(
         static_ok = static_ok & extra_mask
     if dra_mask is not None:
         static_ok = static_ok & dra_mask
+    if slice_mask is not None:
+        # slice-gang members are pinned to their planned torus window (a
+        # one-hot row; all-False when the plan rejected the gang) — ANDing
+        # into static_ok covers the scan, speculative and pallas paths alike
+        static_ok = static_ok & slice_mask
 
     # static half of the first-failing-plugin table (ids follow the filter
     # config order in tpu_scheduler._ATTRIBUTION_ORDER; 0 = passes). Reverse
     # assignment order makes the earliest failing plugin win.
     static_ff = jnp.zeros(static_ok.shape, jnp.int8)
+    if slice_mask is not None:
+        static_ff = jnp.where(~slice_mask, np.int8(11), static_ff)
     if dra_mask is not None:
         static_ff = jnp.where(~dra_mask, np.int8(10), static_ff)
     if extra_mask is not None:
@@ -1375,9 +1393,51 @@ def schedule_batch_core(
     )
 
 
+# per-pod slice verdict word (the packed block's optional trailing column):
+# bit 0 = pod is a slice-gang member, bit 1 = its gang's torus plan was
+# feasible, bits 2+ = planned node slot + 1 (0 = none). The commit side
+# combines bit 1 with the member's own node_idx — the mask pins members to
+# their planned window, so "every member landed" IS the contiguity verdict,
+# with zero extra device dispatch.
+SLICE_MEMBER_BIT = 1
+SLICE_PLAN_OK_BIT = 2
+SLICE_TARGET_SHIFT = 2
+
+
+def _slice_plan(pb: PodBatch, nt: NodeTensors, slice_members,
+                slice_grid: Tuple[int, int]):
+    """(slice_mask [P, N] bool, slice_words [P] int32): run the torus
+    planner (ops/slice.py) inside the batch jit and lower its per-gang
+    targets to the per-pod form the core and the packed block consume.
+    Non-members get an all-True mask row and a zero word; members of a
+    rejected gang get an all-False row (all-or-nothing by construction)."""
+    from ..ops.slice import plan_slices
+
+    member_idx, member_valid = slice_members
+    targets, ok = plan_slices(nt, pb.req, member_idx, member_valid,
+                              slice_grid)
+    p = pb.valid.shape[0]
+    n = nt.capacity
+    midx = member_idx.reshape(-1)
+    act = member_valid.reshape(-1)
+    tgt = targets.reshape(-1)
+    okf = jnp.broadcast_to(ok[:, None], member_idx.shape).reshape(-1)
+    rows = jnp.where(act, midx, p)  # padding entries scatter to a spill row
+    row_mask = jnp.where((okf & (tgt >= 0))[:, None],
+                         jnp.arange(n, dtype=jnp.int32)[None, :]
+                         == tgt[:, None], False)
+    mask = jnp.ones((p + 1, n), bool).at[rows].set(row_mask)[:p]
+    word = (np.int32(SLICE_MEMBER_BIT)
+            | jnp.where(okf, np.int32(SLICE_PLAN_OK_BIT), 0)
+            | ((tgt + 1) << SLICE_TARGET_SHIFT)).astype(jnp.int32)
+    words = jnp.zeros(p + 1, jnp.int32).at[rows].set(
+        jnp.where(act, word, 0))[:p]
+    return mask, words
+
+
 @functools.partial(jax.jit, static_argnames=(
     "weights_key", "topo_enabled", "pallas", "topo_mode", "vd_override",
-    "host_key", "spec_decode", "ports_enabled"))
+    "host_key", "spec_decode", "ports_enabled", "slice_grid"))
 def schedule_batch(
     pb: PodBatch,
     et: ExprTable,
@@ -1398,19 +1458,30 @@ def schedule_batch(
     ports_enabled: bool = True,
     extra_mask: Optional[jax.Array] = None,
     dra_mask: Optional[jax.Array] = None,
+    slice_members=None,
+    slice_grid: Optional[Tuple[int, int]] = None,
 ) -> BatchResult:
+    # slice gangs plan in-jit, ahead of the core: the plan pins members via
+    # slice_mask and its verdict words ride the packed block's extra column
+    if slice_members is not None and slice_grid is not None:
+        slice_mask, slice_words = _slice_plan(pb, nt, slice_members,
+                                              slice_grid)
+    else:
+        slice_mask = slice_words = None
     res = schedule_batch_core(pb, et, nt, tc, tb, key, weights_key, topo_enabled,
                               pallas=pallas, topo_carry=topo_carry,
                               sample_k=sample_k, sample_start=sample_start,
                               topo_mode=topo_mode, vd_override=vd_override,
                               host_key=host_key, spec_decode=spec_decode,
                               ports_enabled=ports_enabled,
-                              extra_mask=extra_mask, dra_mask=dra_mask)
+                              extra_mask=extra_mask, dra_mask=dra_mask,
+                              slice_mask=slice_mask)
     # fuse the host-commit payload into one block here (inside the jit), so
     # every single-device variant — scan, speculative rounds, pallas —
     # returns it; the sharded core entry (parallel/mesh.py) bypasses this
     # wrapper and keeps packed=None
-    return res._replace(packed=pack_result_block(res.node_idx, res.first_fail))
+    return res._replace(packed=pack_result_block(
+        res.node_idx, res.first_fail, slice_words=slice_words))
 
 
 def spec_decode_eligible(sample_k) -> bool:
@@ -1444,14 +1515,15 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
 
     def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
            sample_k=None, sample_start=None, topo_mode=None, vd_override=None,
-           host_key=0, ports_enabled=True, extra_mask=None, dra_mask=None):
+           host_key=0, ports_enabled=True, extra_mask=None, dra_mask=None,
+           slice_members=None, slice_grid=None):
         spec = spec_decode_eligible(sample_k)
         # the pallas fused step has no sampling emulation yet; the
         # speculative path replaces it where both apply (fewer device steps).
-        # The fused kernel has no extra-mask/dra-mask input either — volume
-        # and claim batches take the XLA path.
+        # The fused kernel has no extra-mask/dra-mask/slice input either —
+        # volume, claim and slice batches take the XLA path.
         mode = (None if (sample_k is not None or spec or extra_mask is not None
-                         or dra_mask is not None)
+                         or dra_mask is not None or slice_members is not None)
                 else pallas_mode(nt, None, topo_enabled))
         return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
                               topo_enabled=topo_enabled, pallas=mode,
@@ -1459,6 +1531,8 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
                               sample_start=sample_start, topo_mode=topo_mode,
                               vd_override=vd_override, host_key=host_key,
                               spec_decode=spec, ports_enabled=ports_enabled,
-                              extra_mask=extra_mask, dra_mask=dra_mask)
+                              extra_mask=extra_mask, dra_mask=dra_mask,
+                              slice_members=slice_members,
+                              slice_grid=slice_grid)
 
     return fn

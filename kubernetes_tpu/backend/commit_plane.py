@@ -378,24 +378,27 @@ class CommitPlane:
 def materialize_result(result, n_nodes: int, batch_id: str = "",
                        pods: int = 0, **event_extra):
     """THE one blocking device read of a batch commit: materialize the
-    packed result block (node_idx + first_fail in one buffer) or take the
-    per-array fallback for packless (mesh-sharded) results. Returns
-    ``(node_idx, ff, packed_ok)``; ``ff`` is None on the fallback path
-    (callers lazily read result.first_fail). Shared by the in-process
-    commit, the commit worker, and DeviceService's server-side commit so
-    transfer accounting and flight events stay identical."""
+    packed result block (node_idx + first_fail + optional slice verdict
+    column in one buffer) or take the per-array fallback for packless
+    (mesh-sharded) results. Returns ``(node_idx, ff, slice_words,
+    packed_ok)``; ``ff`` is None on the fallback path (callers lazily read
+    result.first_fail) and ``slice_words`` is None whenever the batch
+    carried no slice gangs. Shared by the in-process commit, the commit
+    worker, and DeviceService's server-side commit so transfer accounting
+    and flight events stay identical."""
     from . import telemetry
     from .batch import unpack_result_block
 
     if result.packed is not None:
-        node_idx, ff = unpack_result_block(result.packed, n_nodes)
+        node_idx, ff, slice_words = unpack_result_block(result.packed,
+                                                        n_nodes)
         telemetry.transfer("fetch", result.packed.nbytes)
-        return node_idx, ff, True
+        return node_idx, ff, slice_words, True
     node_idx = np.asarray(result.node_idx)
     telemetry.transfer("fetch", node_idx.nbytes)
     telemetry.event("packed_fallback", batchId=batch_id, pods=pods,
                     **event_extra)
-    return node_idx, None, False
+    return node_idx, None, None, False
 
 
 class CommitWorker:

@@ -29,7 +29,7 @@ _ROW_FIELDS = (
     ("label_val", np.int32), ("label_num", np.int32),
     ("taint_key", np.int32), ("taint_val", np.int32), ("taint_effect", np.int32),
     ("port_bits", np.uint32), ("image_bits", np.uint32), ("class_req", np.int32),
-    ("name_hash", np.uint32),
+    ("name_hash", np.uint32), ("topo_sp", np.int32), ("topo_pos", np.int32),
 )
 
 
@@ -169,6 +169,8 @@ class DeviceState:
             class_req=jnp.asarray(z((c.nodes, c.prio_classes, c.resources), np.int32)),
             class_prio=jnp.asarray(self.encoder.class_prio_array()),
             name_hash=jnp.asarray(z(c.nodes, np.uint32)),
+            topo_sp=jnp.asarray(np.full(c.nodes, -1, np.int32)),
+            topo_pos=jnp.asarray(np.full(c.nodes, -1, np.int32)),
         )
 
     # ------------------------------------------------------- device attributes
@@ -619,4 +621,9 @@ def caps_for_cluster(n_nodes: int, batch: int = 128) -> Capacities:
 
     nodes = round_node_capacity(n_nodes)
     value_words = max(32, (nodes + 2 + 31) // 32)  # hostname vocab ≥ node count
-    return Capacities(nodes=nodes, pods=batch, value_words=value_words)
+    # synthetic torus fallback assigns sp = slot // sp_slots: the superpod
+    # axis must cover every slot or the first sync of a large cluster spins
+    # through CapacityError growth
+    superpods = max(16, (nodes + 15) // 16)
+    return Capacities(nodes=nodes, pods=batch, value_words=value_words,
+                      superpods=superpods)

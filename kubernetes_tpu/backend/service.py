@@ -826,6 +826,34 @@ class DeviceService:
                 pad_to = len(host_pb["req"])
                 dra_mask = build_dra_mask(
                     self.device, wire_claims_to_entries(claims), pad_to)
+            # slice gangs: the server sees the actual Pod objects, so the
+            # member bucketing mirrors the in-process _slice_batch_args and
+            # the in-jit planner runs identically on both transports
+            slice_members = slice_grid = None
+            slice_groups: Dict[str, List[int]] = {}
+            from ..framework.plugins.coscheduling import pod_group_key
+            from ..ops.slice import is_slice_pod
+
+            for i, pod in enumerate(pods):
+                if is_slice_pod(pod):
+                    gkey = pod_group_key(pod)
+                    if gkey is not None:
+                        slice_groups.setdefault(gkey, []).append(i)
+            if slice_groups:
+                from .claim_mask import _bucket
+
+                g_cap = _bucket(len(slice_groups), floor=2)
+                m_cap = _bucket(
+                    max(len(v) for v in slice_groups.values()), floor=2)
+                member_idx = np.full((g_cap, m_cap), -1, np.int32)
+                member_valid = np.zeros((g_cap, m_cap), bool)
+                for g, gkey in enumerate(slice_groups):
+                    for m, i in enumerate(slice_groups[gkey]):
+                        member_idx[g, m] = i
+                        member_valid[g, m] = True
+                slice_members = (member_idx, member_valid)
+                slice_grid = (self.device.caps.superpods,
+                              self.device.caps.sp_slots)
             bucket = int(getattr(pb, "capacity", len(pods)))
             telemetry.event("dispatch", batchId=batch_id, client=cid,
                             epoch=self.epoch, bucket=bucket, pods=len(pods))
@@ -845,7 +873,8 @@ class DeviceService:
                         np.int32(self.batch_counter),
                         topo_enabled=self.device.topo_enabled,
                         sample_k=sample_k, sample_start=sample_start,
-                        dra_mask=dra_mask)
+                        dra_mask=dra_mask, slice_members=slice_members,
+                        slice_grid=slice_grid)
             if result.final_sample_start is not None:
                 self._start_carry = result.final_sample_start
             # adopt exactly like the in-process path: the client will assume
@@ -860,7 +889,7 @@ class DeviceService:
                 # same commit-plane materializer the in-process commit runs
                 from .commit_plane import materialize_result
 
-                node_idx, ff, _ = materialize_result(
+                node_idx, ff, slice_words, _ = materialize_result(
                     result, self.device.caps.nodes,
                     batch_id=batch_id, pods=len(pods), client=cid)
                 self.device.adopt_device(result)
@@ -948,6 +977,12 @@ class DeviceService:
                         # still helps (preferred-node fast path)
                         r["preempt"] = {"candidates": None, "best": best_name}
                 results.append(r)
+            if slice_words is not None and slice_groups:
+                # ship each member's verdict word so the client can split
+                # plan-infeasible from lost-in-flight without a second trip
+                for idxs in slice_groups.values():
+                    for i in idxs:
+                        results[i]["slice"] = int(slice_words[i])
             # stamp INSIDE the lock: epoch/deltaSeq are mutated by
             # concurrent apply_deltas calls from peer replicas — stamping
             # after release could pair this batch's results with a peer's
@@ -2139,10 +2174,17 @@ class WireScheduler(Scheduler):
         # partial gang at Permit (mirror of the in-process _judge_gangs)
         gang_rejected: Dict[int, str] = {}
         groups: Dict[str, List[int]] = {}
+        slice_groups: Dict[str, List[int]] = {}
+        from ..ops.slice import is_slice_pod
+        from .batch import SLICE_PLAN_OK_BIT
+
         for i, qp in enumerate(batch):
             gkey = pod_group_key(qp.pod)
             if gkey is not None:
-                groups.setdefault(gkey, []).append(i)
+                if is_slice_pod(qp.pod):
+                    slice_groups.setdefault(gkey, []).append(i)
+                else:
+                    groups.setdefault(gkey, []).append(i)
         for gkey, idxs in groups.items():
             if any(not res["results"][i].get("nodeName") for i in idxs):
                 for i in idxs:
@@ -2151,6 +2193,35 @@ class WireScheduler(Scheduler):
                     batch[idxs[0]].pod).plugin("Coscheduling")
                 if plugin is not None:
                     plugin.reject_gang(gkey, "incomplete")
+        # slice gangs, the wire twin of _judge_slice_gangs: verdict from the
+        # reply alone (every member placed ⟺ the pinned window landed), the
+        # echoed verdict word splitting plan-infeasible from lost-in-flight
+        for gkey, idxs in slice_groups.items():
+            now = self.now_fn()
+            if all(res["results"][i].get("nodeName") for i in idxs):
+                telemetry.event("slice_assign", client=self.client_id,
+                                gang=gkey, members=len(idxs))
+                self.smetrics.slice_wait_duration.observe(
+                    now - t0, "scheduled")
+                continue
+            plan_ok = all(
+                res["results"][i].get("slice", SLICE_PLAN_OK_BIT)
+                & SLICE_PLAN_OK_BIT for i in idxs)
+            reason = "incomplete" if plan_ok else "infeasible"
+            telemetry.event("slice_reject", client=self.client_id,
+                            gang=gkey, members=len(idxs), reason=reason)
+            self.smetrics.slice_wait_duration.observe(now - t0, "rejected")
+            for i in idxs:
+                gang_rejected[i] = gkey
+            fwk = self.framework_for_pod(batch[idxs[0]].pod)
+            plugin = fwk.plugin("Coscheduling")
+            if plugin is not None:
+                plugin.reject_gang(gkey, reason)
+            sp = fwk.plugin("SlicePacking")
+            if sp is not None:
+                # a rejected gang's oracle plan (if any) must not keep its
+                # node reservations pinned across the retry
+                sp.forget_gang(gkey)
         for i, (qp, r) in enumerate(zip(batch, res["results"])):
             fwk = self.framework_for_pod(qp.pod)
             self.metrics.inc("schedule_attempts")

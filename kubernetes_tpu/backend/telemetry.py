@@ -74,6 +74,9 @@ EVENT_KINDS = frozenset({
     "replica_down", "replica_rejoin", "failover", "replication",
     # pipelined wire transport
     "pipeline_poison", "pipeline_dup_reply",
+    # slice-topology packing (ops/slice.py): per-gang torus placement
+    # verdicts and the edge-triggered superpod fragmentation alert
+    "slice_assign", "slice_reject", "frag_alert",
 })
 
 

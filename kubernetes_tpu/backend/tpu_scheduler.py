@@ -52,6 +52,7 @@ _ATTRIBUTION_ORDER = (
     ("InterPodAffinity", "node(s) didn't match pod affinity/anti-affinity rules"),
     ("VolumeBinding", "node(s) didn't satisfy volume placement"),
     ("DynamicResources", "cannot allocate all claims"),
+    ("SlicePacking", "node(s) outside the gang's planned torus slice"),
 )
 
 
@@ -366,6 +367,8 @@ class TPUScheduler(Scheduler):
         "ipa_terms": ("ipa_terms",),
         "ipa_pref": ("ipa_pref",),
         "prio_classes": ("prio_classes",),
+        "superpods": ("superpods",),
+        "sp_slots": ("sp_slots",),
     }
 
     def _resync_grown(self, err: CapacityError) -> None:
@@ -751,6 +754,10 @@ class TPUScheduler(Scheduler):
             topo_mode, vd_bucket, host_key = mode_info
             telemetry.event("encode", batchId=batch_id, bucket=bucket,
                             pods=len(batched), pipelined=enc is not None)
+            # slice gangs plan in-jit (ops/slice.py): hand the batch program
+            # the bucketed member index so verdicts ride the packed block
+            slice_members, slice_grid = self._slice_batch_args(batched,
+                                                               device)
             with tracing.span("device.dispatch", topo=topo_mode):
                 result = self._run_batch_fn(
                     pb, et, device.nt, device.tc, tb, key,
@@ -765,6 +772,8 @@ class TPUScheduler(Scheduler):
                     ports_enabled=device.encoder.last_has_ports,
                     extra_mask=extra_mask,
                     dra_mask=dra_mask,
+                    slice_members=slice_members,
+                    slice_grid=slice_grid,
                 )
             if result.final_sample_start is not None:
                 # keep the rotation index across unsampled batches too (the
@@ -940,7 +949,7 @@ class TPUScheduler(Scheduler):
                               packed="packed" if packed_ok else "fallback",
                               worker="commit" if on_worker else "inline"):
                 t_wait0 = self.now_fn()
-                node_idx, ff, _ = materialize_result(
+                node_idx, ff, slice_words, _ = materialize_result(
                     fl.result, self.device.caps.nodes,
                     batch_id=fl.batch_id, pods=len(fl.qps), bucket=fl.bucket)
                 wait = self.now_fn() - t_wait0
@@ -956,7 +965,8 @@ class TPUScheduler(Scheduler):
                 self._commit_batch(fl.qps, fl.result, fl.pod_cycle, fl.t0,
                                    node_idx, pb=fl.pb, ff=ff,
                                    reclaim_gen=fl.reclaim_gen,
-                                   batch_id=fl.batch_id)
+                                   batch_id=fl.batch_id,
+                                   slice_words=slice_words)
                 self.smetrics.device_batch_duration.observe(
                     self.now_fn() - t_host0, "commit_host")
             # reconcile: the commits above advanced node generations; the
@@ -1133,7 +1143,8 @@ class TPUScheduler(Scheduler):
                       node_idx: Optional[np.ndarray] = None,
                       pb=None, ff: Optional[np.ndarray] = None,
                       reclaim_gen: Optional[int] = None,
-                      batch_id: str = "") -> None:
+                      batch_id: str = "",
+                      slice_words: Optional[np.ndarray] = None) -> None:
         if node_idx is None:
             node_idx = np.asarray(result.node_idx)
         # the whole commit — winner binds AND loser requeues — runs inside
@@ -1142,14 +1153,16 @@ class TPUScheduler(Scheduler):
         with self.queue.coalesce_moves():
             self._commit_batch_coalesced(qps, result, pod_cycle, t0,
                                          node_idx, pb, ff, reclaim_gen,
-                                         batch_id)
+                                         batch_id, slice_words)
 
     def _commit_batch_coalesced(self, qps: List[QueuedPodInfo],
                                 result: BatchResult, pod_cycle: int,
                                 t0: float, node_idx: np.ndarray,
                                 pb=None, ff: Optional[np.ndarray] = None,
                                 reclaim_gen: Optional[int] = None,
-                                batch_id: str = "") -> None:
+                                batch_id: str = "",
+                                slice_words: Optional[np.ndarray] = None
+                                ) -> None:
         # ledger: claim time — the batch leaves the device ring and enters
         # the host commit tail (one lock round trip for the whole batch)
         latency_ledger.transition_many(
@@ -1193,13 +1206,27 @@ class TPUScheduler(Scheduler):
         # sequential cycles the oracle path would spend are one kernel here)
         gang_rejected: Dict[int, str] = {}  # batch index -> group key
         gang_members: Dict[str, List[int]] = {}
+        slice_gangs: Dict[str, List[int]] = {}
+        from ..ops.slice import is_slice_pod
+
         for i, qp in enumerate(qps):
             gkey = pod_group_key(qp.pod)
             if gkey is not None:
-                gang_members.setdefault(gkey, []).append(i)
+                # slice gangs never take the vmapped gang kernel: their
+                # verdict is already on host (planned members are pinned to
+                # their torus window, so "every member landed" == placed
+                # contiguously) — zero extra device dispatch, zero reads
+                if is_slice_pod(qp.pod):
+                    slice_gangs.setdefault(gkey, []).append(i)
+                else:
+                    gang_members.setdefault(gkey, []).append(i)
         if gang_members:
             gang_rejected = self._judge_gangs(qps, result, node_idx,
                                               gang_members)
+        if slice_gangs:
+            gang_rejected.update(self._judge_slice_gangs(
+                qps, node_idx, slice_gangs, slice_words, batch_id, t0))
+            gang_members = {**gang_members, **slice_gangs}
         if gang_members and stale:
             # a stale member poisons its WHOLE gang: the kernel "placed" it
             # (so _judge_gangs saw the gang complete), but the placement is
@@ -1475,6 +1502,116 @@ class TPUScheduler(Scheduler):
                 # the rejection backoff (the PreFilter fast-fail window)
                 plugin.reject_gang(gkey, reason)
         return rejected
+
+    def _slice_batch_args(self, batched: List[QueuedPodInfo], device):
+        """Bucketed member index of the batch's slice gangs (ops/slice.py
+        marker label + PodGroup key), or (None, None) when the batch has
+        none — the common case, whose batch program is unchanged. Member
+        rows follow batch order (= queue order), the same ordinal the host
+        oracle assigns."""
+        from ..ops.slice import is_slice_pod
+
+        groups: Dict[str, List[int]] = {}
+        for i, qp in enumerate(batched):
+            if is_slice_pod(qp.pod):
+                gkey = pod_group_key(qp.pod)
+                if gkey is not None:
+                    groups.setdefault(gkey, []).append(i)
+        if not groups:
+            return None, None
+        from .claim_mask import _bucket
+
+        g_cap = _bucket(len(groups), floor=2)
+        m_cap = _bucket(max(len(v) for v in groups.values()), floor=2)
+        member_idx = np.full((g_cap, m_cap), -1, np.int32)
+        member_valid = np.zeros((g_cap, m_cap), bool)
+        for g, gkey in enumerate(groups):
+            for m, i in enumerate(groups[gkey]):
+                member_idx[g, m] = i
+                member_valid[g, m] = True
+        return ((member_idx, member_valid),
+                (device.caps.superpods, device.caps.sp_slots))
+
+    def _judge_slice_gangs(self, qps: List[QueuedPodInfo],
+                           node_idx: np.ndarray,
+                           slice_gangs: Dict[str, List[int]],
+                           slice_words: Optional[np.ndarray],
+                           batch_id: str, t0: float) -> Dict[int, str]:
+        """Slice-gang verdicts from data already on host: the packed
+        block's verdict words (plan feasibility) plus node_idx (whether
+        every pinned member actually landed — the plan mask makes landing
+        equivalent to contiguous placement). No kernel dispatch, no device
+        read: the one-blocking-sync guard covers slice batches unchanged."""
+        from . import telemetry
+        from .batch import SLICE_PLAN_OK_BIT
+
+        rejected: Dict[int, str] = {}
+        now = self.now_fn()
+        for gkey, idxs in slice_gangs.items():
+            plan_ok = slice_words is None or all(
+                int(slice_words[i]) & SLICE_PLAN_OK_BIT for i in idxs)
+            if all(int(node_idx[i]) >= 0 for i in idxs):
+                telemetry.event("slice_assign", batchId=batch_id, gang=gkey,
+                                members=len(idxs))
+                self.smetrics.slice_wait_duration.observe(
+                    now - t0, "scheduled")
+                continue
+            # "infeasible" = the in-jit planner found no contiguous window
+            # on decision-time state; "incomplete" = a window was planned
+            # but a pinned member lost it to the scan's sequential evolution
+            reason = "incomplete" if plan_ok else "infeasible"
+            telemetry.event("slice_reject", batchId=batch_id, gang=gkey,
+                            members=len(idxs), reason=reason)
+            self.smetrics.slice_wait_duration.observe(now - t0, "rejected")
+            for i in idxs:
+                rejected[i] = gkey
+            fwk = self.framework_for_pod(qps[idxs[0]].pod)
+            plugin = fwk.plugin("Coscheduling")
+            if plugin is not None:
+                plugin.reject_gang(gkey, reason)
+            sp = fwk.plugin("SlicePacking")
+            if sp is not None:
+                # release the oracle plan's node reservations so the retried
+                # gang replans against post-rejection state
+                sp.forget_gang(gkey)
+        self._update_slice_frag_metrics()
+        return rejected
+
+    def _update_slice_frag_metrics(self) -> None:
+        """Refresh scheduler_slice_fragmentation per superpod from the host
+        mirror (numpy — no device sync) and emit an edge-triggered
+        frag_alert when a superpod's score crosses the alert threshold
+        (KTPU_FRAG_ALERT, default 0.5). Re-arms when the score drops back
+        below, so a persistently-shredded superpod alerts once, not per
+        batch."""
+        device = self.device
+        if device is None:
+            return
+        from ..ops.schema import COL_PODS
+        from ..ops.slice import fragmentation_host
+        from . import telemetry
+
+        mirror = device._mirror
+        valid = mirror["valid"]
+        node_free = valid & (mirror["requested"][:, COL_PODS] == 0)
+        rows = fragmentation_host(
+            mirror["topo_sp"], mirror["topo_pos"], valid, node_free,
+            (device.caps.superpods, device.caps.sp_slots))
+        threshold = float(os.environ.get("KTPU_FRAG_ALERT", "0.5"))
+        alerted = getattr(self, "_frag_alerted", None)
+        if alerted is None:
+            alerted = self._frag_alerted = set()
+        for row in rows:
+            self.smetrics.slice_fragmentation.set(
+                str(row["sp"]), value=row["frag"])
+            if row["frag"] >= threshold and row["sp"] not in alerted:
+                alerted.add(row["sp"])
+                telemetry.event("frag_alert", superpod=row["sp"],
+                                frag=round(row["frag"], 4),
+                                largestRun=row["largest_run"],
+                                free=row["free"])
+            elif row["frag"] < threshold:
+                alerted.discard(row["sp"])
 
     # one immutable Status per attribution id, shared across every node and
     # every diagnosis — building 5k fresh Status objects per failed pod was

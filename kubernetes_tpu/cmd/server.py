@@ -204,6 +204,56 @@ def _device_occupancy(device) -> dict:
     return {"resources": resources, "axes": axes}
 
 
+def _slice_rows(device):
+    """(fragmentation rows, per-cell free map, grid) off the host mirror —
+    the shared read for /debug/slices and the devicestate topology block.
+    No device round-trip: slice-free means zero pods on the host."""
+    from ..ops.schema import COL_PODS
+    from ..ops.slice import fragmentation_host
+
+    mirror = device._mirror
+    valid = mirror["valid"].reshape(-1).astype(bool)
+    sp_arr = mirror["topo_sp"].reshape(-1)
+    pos_arr = mirror["topo_pos"].reshape(-1)
+    free = valid & (mirror["requested"][:, COL_PODS] == 0)
+    grid = (device.caps.superpods, device.caps.sp_slots)
+    rows = fragmentation_host(sp_arr, pos_arr, valid, free, grid)
+    cell_free = {}
+    for idx in range(len(sp_arr)):
+        sp, pos = int(sp_arr[idx]), int(pos_arr[idx])
+        if valid[idx] and 0 <= sp < grid[0] and 0 <= pos < grid[1]:
+            cell_free[(sp, pos)] = bool(free[idx])
+    return rows, cell_free, grid
+
+
+def _topology_block(device, limit=None) -> dict:
+    """Per-node torus coords + per-superpod free/used chip counts for
+    /debug/devicestate (``?limit=`` caps the node list)."""
+    from ..ops import schema
+
+    mirror = device._mirror
+    nodes = []
+    for name, slot in sorted(device.encoder.node_slots.items()):
+        sp = int(mirror["topo_sp"][slot])
+        pos = int(mirror["topo_pos"][slot])
+        if sp >= 0 and pos >= 0:
+            nodes.append({"node": name, "superpod": sp, "slot": pos})
+    capped, orig = _cap(nodes, limit)
+    rows, _cells, grid = _slice_rows(device)
+    out = {
+        "chipsPerNode": schema.CHIPS_PER_NODE,
+        "grid": {"superpods": grid[0], "slots": grid[1]},
+        "nodes": capped,
+        "superpods": [{"sp": r["sp"],
+                       "freeChips": r["free"] * schema.CHIPS_PER_NODE,
+                       "usedChips": r["used"] * schema.CHIPS_PER_NODE}
+                      for r in rows],
+    }
+    if orig is not None:
+        out["nodesTruncated"] = orig
+    return out
+
+
 def build_debug_handlers(sched) -> dict:
     """The /debug endpoint family over a live scheduler (SURVEY §5.2's
     SIGUSR2 comparer/dumper, but always-on and JSON over the serving mux):
@@ -211,7 +261,11 @@ def build_debug_handlers(sched) -> dict:
       /debug/queue        active/backoff/unschedulable dump
       /debug/cache        comparer drift report + node/pod/assumed counts
       /debug/devicestate  DeviceState capacities, sig-table occupancy,
-                          batch-sizer model (TPU/batched schedulers only)
+                          batch-sizer model, torus topology block
+                          (TPU/batched schedulers only)
+      /debug/slices       torus occupancy map: per-superpod cell strings
+                          plus free/used/largest-run/fragmentation rows
+                          (the slice-packing operator view)
       /debug/spans        tail of the in-memory span exporter
       /debug/circuit      device-service circuit breaker state, resync and
                           degradation counters (WireScheduler only)
@@ -316,6 +370,32 @@ def build_debug_handlers(sched) -> dict:
                 "deadlineS": sizer.deadline_s, "target": sizer.target(),
                 "maxBatch": sizer.max_batch,
             }
+        out["topology"] = _topology_block(device, limit)
+        return out
+
+    def slices_dump(limit=None):
+        """Torus occupancy map: one row per mapped superpod — a cell string
+        ('.' free host, '#' used host, '-' no host at that slot) plus the
+        free/used/largest-run/fragmentation accounting behind the
+        scheduler_slice_fragmentation gauge."""
+        device = getattr(sched, "device", None)
+        if device is None:
+            return {"enabled": False}
+        rows, cell_free, grid = _slice_rows(device)
+        superpods = []
+        for r in rows:
+            s = r["sp"]
+            cells = "".join(
+                "-" if (s, b) not in cell_free
+                else ("." if cell_free[(s, b)] else "#")
+                for b in range(grid[1]))
+            superpods.append({**r, "map": cells})
+        capped, orig = _cap(superpods, limit)
+        out = {"enabled": True,
+               "grid": {"superpods": grid[0], "slots": grid[1]},
+               "superpods": capped}
+        if orig is not None:
+            out["superpodsTruncated"] = orig
         return out
 
     def spans_dump(limit=None):
@@ -388,7 +468,8 @@ def build_debug_handlers(sched) -> dict:
             ledger=latency_ledger.get(), limit=cap)
 
     return {"queue": queue_dump, "cache": cache_dump,
-            "devicestate": device_dump, "spans": spans_dump,
+            "devicestate": device_dump, "slices": slices_dump,
+            "spans": spans_dump,
             "circuit": circuit_dump, "sessions": sessions_dump,
             "fabric": fabric_dump,
             "flightrecorder": flightrecorder_dump, "quota": quota_dump,

@@ -147,10 +147,11 @@ class DrainOrchestrator:
         return evicted
 
     def _wave_done(self, reason: str, nodes: int, evicted: List[str],
-                   gangs: int) -> Dict[str, int]:
+                   gangs: int, slice_gangs: int = 0) -> Dict[str, int]:
         self.waves += 1
         telemetry.event("evict_wave", reason=reason, nodes=nodes,
-                        pods=len(evicted), gangs=gangs)
+                        pods=len(evicted), gangs=gangs,
+                        sliceGangs=slice_gangs)
         if self.queue is not None and evicted:
             from ..queue import events as qevents
 
@@ -198,8 +199,30 @@ class DrainOrchestrator:
         if gang_aware:
             victims = self._gang_closure(victims)
         gangs = len({pod_group_key(p) for p in victims} - {None})
+        # slice-atomic by construction: the whole-gang closure means a drain
+        # touching ONE host of a placed slice gang evicts every member, so
+        # the gang re-packs onto a fresh contiguous window instead of
+        # stranding a torn slice (counted separately for the flight log)
+        from ..ops.slice import is_slice_pod
+
+        slice_gangs = len({pod_group_key(p) for p in victims
+                           if is_slice_pod(p)} - {None})
         evicted = self._evict(victims, "drain")
-        return self._wave_done("drain", len(names), evicted, gangs)
+        return self._wave_done("drain", len(names), evicted, gangs,
+                               slice_gangs=slice_gangs)
+
+    def drain_superpod(self, superpod: int,
+                       gang_aware: bool = True) -> Dict[str, int]:
+        """Slice-aligned maintenance drain: one wave over every labeled
+        host of ``superpod`` — the natural TPU upgrade domain. Resident
+        slice gangs are evicted whole (the gang closure) and rebind onto
+        other superpods' contiguous windows."""
+        from ..ops.encode import TOPO_SUPERPOD_LABEL
+
+        names = [n for n, node in self.store.nodes.items()
+                 if node.meta.labels.get(TOPO_SUPERPOD_LABEL)
+                 == str(superpod)]
+        return self.drain_wave(names, gang_aware=gang_aware)
 
     def spot_reclaim(self, node_names: Iterable[str],
                      delete_nodes: bool = False,

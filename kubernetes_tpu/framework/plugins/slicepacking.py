@@ -1,0 +1,170 @@
+"""SlicePacking — torus-contiguous placement for slice gangs (oracle side).
+
+The sequential twin of the in-jit slice planner (ops/slice.py plan_slices):
+at a slice gang's FIRST member this PreFilter runs the shared greedy oracle
+``slice_assign_host`` over the live snapshot and caches one target node per
+member ordinal; Filter then pins each member to its planned node, so the
+oracle path lands gangs on exactly the windows the device path picks (the
+SchedulingSlices parity contract). Inert for pods without the
+``ktpu.dev/slice`` marker — the default profiles stay batchable.
+
+Coordinates come from the well-known node labels ONLY (the encoder's
+slot-derived synthetic fallback has no oracle analog — slot numbering is a
+device-side artifact); unlabeled nodes are simply not sliceable here.
+
+Plan lifetime: targets are reserved (excluded from later plans' feasibility)
+until every member ordinal has been handed out — the sequential analog of
+the batch planner's taken-cell bitmap. Gang rejection (Coscheduling
+reject_gang, permit timeout) clears the plan via ``forget_gang`` so a
+retried gang replans against current state.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ...api.types import Pod
+from ..interface import (
+    CycleState,
+    FilterPlugin,
+    OK,
+    PreFilterPlugin,
+    PreFilterResult,
+    Status,
+)
+from ..types import NodeInfo
+from . import names
+from .coscheduling import pod_group_key
+from .noderesources import fits_request
+
+
+class SlicePacking(PreFilterPlugin, FilterPlugin):
+    """Plan-then-pin slice placement over labeled torus coordinates."""
+
+    ERR_NO_SLICE = "no contiguous torus slice for gang"
+    ERR_OUTSIDE = "node(s) outside the gang's planned torus slice"
+    TARGET_KEY = "PreFilter/SlicePacking/target"
+
+    def __init__(self, snapshot_fn=None, client=None):
+        self.snapshot_fn = snapshot_fn
+        self.client = client
+        # gkey -> {"targets": [node names], "next": ordinal}
+        self._plans: Dict[str, dict] = {}
+        self._reserved: set = set()  # node names held by active plans
+
+    def name(self) -> str:
+        return names.SLICE_PACKING
+
+    # -- PreFilter
+
+    def pre_filter(self, state: CycleState, pod: Pod
+                   ) -> Tuple[Optional[PreFilterResult], Status]:
+        from ...ops.slice import is_slice_pod
+
+        if not is_slice_pod(pod):
+            return None, OK
+        gkey = pod_group_key(pod)
+        if gkey is None:
+            return None, OK
+        plan = self._plans.get(gkey)
+        if plan is not None and pod.key() in plan["seen"]:
+            # the same member is back — the gang's first pass failed
+            # somewhere (filter miss, permit teardown): replan from current
+            # state instead of re-serving a plan the cluster outgrew
+            self.forget_gang(gkey)
+            plan = None
+        if plan is None:
+            plan = self._compute_plan(gkey, pod)
+            if plan is None:
+                return None, Status.unschedulable(self.ERR_NO_SLICE)
+            self._plans[gkey] = plan
+            self._reserved.update(plan["targets"])
+        target = plan["targets"][plan["next"] % len(plan["targets"])]
+        plan["next"] += 1
+        plan["seen"].add(pod.key())
+        if plan["next"] >= len(plan["targets"]):
+            # every ordinal handed out: the members themselves now hold the
+            # nodes (assumed/parked capacity), so the reservation dissolves
+            self.forget_gang(gkey)
+        state.write(self.TARGET_KEY, target)
+        return None, OK
+
+    # -- Filter
+
+    def filter(self, state: CycleState, pod: Pod,
+               node_info: NodeInfo) -> Status:
+        from ...ops.slice import is_slice_pod
+
+        if not is_slice_pod(pod) or pod_group_key(pod) is None:
+            return OK
+        target = state.read(self.TARGET_KEY)
+        if target is None:
+            return Status.unschedulable(self.ERR_NO_SLICE)
+        node = node_info.node
+        if node is None or node.meta.name != target:
+            return Status.unschedulable(self.ERR_OUTSIDE)
+        return OK
+
+    # -- plan machinery
+
+    def forget_gang(self, gkey: str) -> None:
+        """Drop a gang's plan and release its node reservations (called on
+        plan exhaustion here and by gang-rejection paths)."""
+        plan = self._plans.pop(gkey, None)
+        if plan is not None:
+            self._reserved.difference_update(plan["targets"])
+
+    def _want(self, gkey: str, pod: Pod) -> int:
+        if self.client is not None:
+            pg = self.client.get_object("PodGroup", gkey)
+            if pg is not None and pg.min_member > 0:
+                return int(pg.min_member)
+        return 1
+
+    def _compute_plan(self, gkey: str, pod: Pod) -> Optional[dict]:
+        from ...ops.slice import (TOPO_SLOT_LABEL, TOPO_SUPERPOD_LABEL,
+                                  slice_assign_host)
+
+        node_infos: List[NodeInfo] = (self.snapshot_fn()
+                                      if self.snapshot_fn else [])
+        coords: List[Tuple[int, int, NodeInfo]] = []
+        for ni in node_infos:
+            node = ni.node
+            if node is None:
+                continue
+            sp_s = node.meta.labels.get(TOPO_SUPERPOD_LABEL)
+            pos_s = node.meta.labels.get(TOPO_SLOT_LABEL)
+            if sp_s is None or pos_s is None:
+                continue
+            try:
+                sp, pos = int(sp_s), int(pos_s)
+            except (ValueError, OverflowError):
+                continue
+            if sp >= 0 and pos >= 0:
+                coords.append((sp, pos, ni))
+        if not coords:
+            return None
+        # the grid spans exactly the labeled coordinate range; the device
+        # grid is capacity-padded beyond it, but padding cells hold no node
+        # and never affect window choice or leftover runs
+        s_pods = max(c[0] for c in coords) + 1
+        ps = max(c[1] for c in coords) + 1
+        request = pod.resource_request()
+        topo_sp, topo_pos, valid, fits = [], [], [], []
+        for sp, pos, ni in coords:
+            topo_sp.append(sp)
+            topo_pos.append(pos)
+            valid.append(True)
+            node = ni.node
+            fits.append(
+                node is not None
+                and not node.spec.unschedulable
+                and node.meta.name not in self._reserved
+                and not fits_request(request, ni))
+        targets, ok = slice_assign_host(
+            topo_sp, topo_pos, valid, [fits],
+            [self._want(gkey, pod)], (s_pods, ps))
+        if not ok[0]:
+            return None
+        return {"targets": [coords[t][2].node.meta.name
+                            for t in targets[0]], "next": 0, "seen": set()}

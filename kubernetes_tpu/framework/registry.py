@@ -23,6 +23,7 @@ from .plugins.nodeaffinity import NodeAffinity
 from .plugins.noderesources import BalancedAllocation, Fit
 from .plugins.podtopologyspread import PodTopologySpread
 from .plugins.selectorspread import SelectorSpread
+from .plugins.slicepacking import SlicePacking
 from .plugins.volume import (
     NodeVolumeLimits,
     VolumeBinding,
@@ -82,6 +83,8 @@ def in_tree_registry() -> Dict[str, Factory]:
             client=h.get("client"), metrics=h.get("metrics")),
         names.QUOTA_ADMISSION: lambda h, a: QuotaAdmission(
             client=h.get("client"), metrics=h.get("metrics")),
+        names.SLICE_PACKING: lambda h, a: SlicePacking(
+            snapshot_fn=h.get("snapshot_fn"), client=h.get("client")),
         names.COSCHEDULING: lambda h, a: Coscheduling(
             client=h.get("client"), metrics=h.get("metrics"),
             waiting=h.get("waiting_pods"), now_fn=h.get("now_fn"),
@@ -122,6 +125,9 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.INTER_POD_AFFINITY, 0),
         (names.VOLUME_BINDING, 0),
         (names.DYNAMIC_RESOURCES, 0),
+        # slice-topology plan (inert without the ktpu.dev/slice marker):
+        # runs LAST so the plan sees every cheaper fast-fail first
+        (names.SLICE_PACKING, 0),
     ],
     "filter": [
         (names.NODE_UNSCHEDULABLE, 0),
@@ -137,6 +143,9 @@ DEFAULT_PLUGINS: Dict[str, List[Tuple[str, int]]] = {
         (names.POD_TOPOLOGY_SPREAD, 0),
         (names.INTER_POD_AFFINITY, 0),
         (names.DYNAMIC_RESOURCES, 0),
+        # torus pin for slice-gang members (ops/slice.py plan; id 11 in the
+        # batch path's first-fail attribution)
+        (names.SLICE_PACKING, 0),
     ],
     "post_filter": [(names.DEFAULT_PREEMPTION, 0)],
     "pre_score": [

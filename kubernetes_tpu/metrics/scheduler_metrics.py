@@ -127,6 +127,21 @@ class SchedulerMetrics:
             "Gang wait at Permit from first parked member to release/rejection.",
             ["result"],  # scheduled|rejected
         ))
+        # slice-topology packing (ops/slice.py): per-superpod fragmentation
+        # (1 - largest free contiguous run / free nodes; 0 = one unbroken
+        # run or nothing free) refreshed from the host mirror at each slice
+        # commit, and how long a slice gang's pods waited from queue pop to
+        # a contiguous torus placement landing
+        self.slice_fragmentation = r.register(Gauge(
+            "scheduler_slice_fragmentation",
+            "Torus fragmentation score per superpod (0 contiguous, ->1 shredded).",
+            ["superpod"],
+        ))
+        self.slice_wait_duration = r.register(Histogram(
+            "scheduler_slice_wait_duration_seconds",
+            "Slice gang wait from batch pop to contiguous placement commit.",
+            ["result"],  # scheduled|rejected
+        ))
         # fault-tolerant wire path (backend/service.py): transport retries,
         # breaker state (0 closed, 1 half-open, 2 open), and cumulative time
         # spent scheduling through the sequential oracle because the device

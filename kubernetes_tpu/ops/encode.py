@@ -61,6 +61,13 @@ _EFFECT_CODE = {
 
 _UNSCHEDULABLE_TAINT = Taint(key="node.kubernetes.io/unschedulable", effect=TAINT_NO_SCHEDULE)
 
+# well-known TPU torus labels (GKE `cloud.google.com/gke-tpu-topology`-style
+# keys): the superpod a host belongs to and its linear position inside that
+# superpod's torus. Nodes without both labels fall back to slot-derived
+# synthetic coordinates (the harness's simulated torus).
+TOPO_SUPERPOD_LABEL = "cloud.google.com/gke-tpu-superpod"
+TOPO_SLOT_LABEL = "cloud.google.com/gke-tpu-slot"
+
 
 class CapacityError(Exception):
     """A static tensor capacity was exceeded; re-encode with larger Capacities."""
@@ -357,6 +364,30 @@ class ClusterEncoder:
             iid = self.image_id(name)
             ibits[iid >> 5] |= np.uint32(1 << (iid & 31))
         row["image_bits"] = ibits
+
+        # torus coordinates: labeled nodes are authoritative; unlabeled ones
+        # take slot-derived synthetic coords (slots are stable for a node's
+        # lifetime and this cached row is dropped on release_node_slot, so
+        # the slot dependence cannot go stale while cached)
+        sp = pos = -1
+        if node is not None:
+            sp_s = node.meta.labels.get(TOPO_SUPERPOD_LABEL)
+            pos_s = node.meta.labels.get(TOPO_SLOT_LABEL)
+            if sp_s is not None and pos_s is not None:
+                try:
+                    sp, pos = int(sp_s), int(pos_s)
+                except (ValueError, OverflowError):
+                    sp = pos = -1
+            if sp < 0 or pos < 0:
+                slot = self.node_slots.get(node.meta.name)
+                if slot is not None:
+                    sp, pos = slot // caps.sp_slots, slot % caps.sp_slots
+            if sp >= caps.superpods:
+                raise CapacityError("superpods", sp + 1, caps.superpods)
+            if pos >= caps.sp_slots:
+                raise CapacityError("sp_slots", pos + 1, caps.sp_slots)
+        row["topo_sp"] = np.array(sp, np.int32)
+        row["topo_pos"] = np.array(pos, np.int32)
         return row
 
     def encode_dynamic_fields(self, ni: NodeInfo) -> Dict[str, np.ndarray]:
@@ -437,6 +468,8 @@ class ClusterEncoder:
             out = np.zeros((caps.nodes,) + shape_tail, dtype)
             if field == "label_num":
                 out[:] = INT_NONE
+            elif field in ("topo_sp", "topo_pos"):
+                out[:] = -1  # padding rows carry no topology
             for i, r in enumerate(rows):
                 out[self.node_slots[node_infos[i].node.meta.name]] = r[field]
             return out
@@ -460,6 +493,8 @@ class ClusterEncoder:
             class_req=jnp.asarray(stack("class_req", np.int32, (caps.prio_classes, caps.resources))),
             class_prio=jnp.asarray(self.class_prio_array()),
             name_hash=jnp.asarray(stack("name_hash", np.uint32, ())),
+            topo_sp=jnp.asarray(stack("topo_sp", np.int32, ())),
+            topo_pos=jnp.asarray(stack("topo_pos", np.int32, ())),
         )
         return nt
 

@@ -32,6 +32,11 @@ import numpy as np
 
 INT_NONE = np.int32(-(2**31))  # sentinel for "absent" numeric label
 
+# TPU torus geometry: chips per schedulable host (a v4/v5 host exposes one
+# 4-chip board to the control plane; slice sizes quoted in chips are
+# node-count * CHIPS_PER_NODE)
+CHIPS_PER_NODE = 4
+
 # resource columns
 COL_CPU = 0
 COL_MEM = 1
@@ -93,6 +98,11 @@ class NodeTensors:
     class_req: jax.Array      # [N, C, R] int32 requested by pods of class c
     class_prio: jax.Array     # [C] int32 priority value of class c (vocab)
     name_hash: jax.Array      # [N] uint32 fnv1a(node name) — seeded tie-break
+    # torus topology axis (slice packing): superpod id and linear position
+    # inside the superpod's torus, parsed from well-known node labels (or the
+    # synthetic slot-derived fallback); -1 = node carries no topology
+    topo_sp: jax.Array        # [N] int32 superpod id (-1 absent)
+    topo_pos: jax.Array       # [N] int32 torus slot within superpod (-1 absent)
 
     @property
     def capacity(self) -> int:
@@ -248,6 +258,8 @@ class Capacities:
     ipa_terms: int = 2        # A: required (anti-)affinity terms per pod
     ipa_pref: int = 2         # PT: preferred terms per pod (both signs combined)
     prio_classes: int = 32    # distinct pod priority values (+ reserved row 0)
+    superpods: int = 16       # S: torus superpods the grid axis can hold
+    sp_slots: int = 16        # P: node positions per superpod torus
 
     def grow_nodes(self, n: int) -> "Capacities":
         return dataclasses.replace(self, nodes=round_node_capacity(n, self.nodes))

@@ -1,0 +1,240 @@
+"""Slice-topology packing: contiguous torus placement for gangs on device.
+
+A slice gang (a PodGroup whose pods carry the ``ktpu.dev/slice`` marker
+label) must land on a *contiguous* run of torus-adjacent hosts inside one
+superpod — the multi-host TPU contract the flat gang assigner (ops/gang.py)
+cannot express. The planner here runs INSIDE the batch program's jit,
+before the commit scan: it picks one window of ``k`` adjacent free cells
+per gang, and the scan is then pinned to those choices through a per-pod
+feasibility mask, so slice verdicts ride the packed result block with zero
+extra device dispatch per batch.
+
+Coordinate model: every node carries ``(topo_sp, topo_pos)`` — the superpod
+id and a LINEAR position inside that superpod's torus (ops/encode.py parses
+them from the well-known labels, or synthesizes them from the node slot).
+The torus is linearized: contiguity means consecutive ``topo_pos`` values
+within one superpod, the 1-D snake order a real (x, y, z) torus walk
+induces. Windows never span superpods and never wrap.
+
+Scoring (best fit + anti-fragmentation): among feasible windows the planner
+minimizes ``left_run + right_run`` — the free cells the placement strands
+on either side. A hole of exactly ``k`` scores 0 (perfect fit), so small
+jobs prefer already-shredded capacity; a pristine superpod-wide run scores
+``P - k`` and is only split when no tighter hole exists. Ties break to the
+lowest superpod id, then the lowest start position — reproduced exactly by
+``slice_assign_host``, the greedy numpy oracle the parity tests and the
+host SlicePacking plugin share.
+
+Cross-gang consistency inside one batch: gangs plan sequentially
+(``lax.scan``) against a shared taken-cell bitmap, so two gangs in one
+batch can never be planned onto overlapping windows.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .encode import TOPO_SLOT_LABEL, TOPO_SUPERPOD_LABEL  # noqa: F401 — re-export
+
+# marker label: a PodGroup whose pods carry it is slice-placed (contiguous
+# torus window) instead of flat gang-assigned
+SLICE_LABEL = "ktpu.dev/slice"
+
+
+def is_slice_pod(pod) -> bool:
+    return bool(pod.meta.labels.get(SLICE_LABEL))
+
+
+def _row_runs(fg: jax.Array) -> jax.Array:
+    """[S, P] bool -> [S, P] int32: length of the free run ENDING at each
+    cell (0 where blocked). Scan-free: distance to the last blocked cell,
+    via a cummax over blocked positions."""
+    p = fg.shape[1]
+    iota = jnp.arange(p, dtype=jnp.int32)[None, :]
+    last_blocked = lax.cummax(jnp.where(fg, np.int32(-1), iota), axis=1)
+    return jnp.where(fg, iota - last_blocked, 0)
+
+
+def plan_slices(nt, req: jax.Array, member_idx: jax.Array,
+                member_valid: jax.Array,
+                slice_grid: Tuple[int, int]) -> Tuple[jax.Array, jax.Array]:
+    """Plan every slice gang of a batch onto contiguous torus windows.
+
+    ``nt``: NodeTensors (pre-batch state). ``req``: [P, R] int32 per-pod
+    requests (pb.req). ``member_idx``: [G, M] int32 rows into the pod axis
+    (-1 pad); ``member_valid``: [G, M] bool. ``slice_grid``: static
+    (superpods, slots-per-superpod). Returns (targets [G, M] int32 node
+    slots, -1 for padding/rejected; ok [G] bool all-or-nothing verdicts).
+
+    Per-gang request = elementwise max over active members — exact for the
+    homogeneous gangs slice jobs are, conservative otherwise. Feasibility is
+    valid & schedulable & resource fit at batch start; non-slice pods the
+    scan places mid-batch are invisible to the plan (their capacity charge
+    lands in-scan, where a collision turns into a whole-gang miss, never a
+    partial placement).
+    """
+    s_pods, ps = slice_grid
+    cells = s_pods * ps
+    g, m = member_idx.shape
+    p = req.shape[0]
+    n = nt.valid.shape[0]
+
+    safe = jnp.clip(member_idx, 0, p - 1)
+    mreq = jnp.where(member_valid[..., None], req[safe], 0)      # [G, M, R]
+    req_g = jnp.max(mreq, axis=1)                                 # [G, R]
+    want = jnp.sum(member_valid, axis=1).astype(jnp.int32)        # [G]
+
+    # node -> linearized grid cell; nodes without (in-range) coordinates
+    # land in a spill cell past the grid and never participate
+    has_coord = (nt.topo_sp >= 0) & (nt.topo_sp < s_pods) \
+        & (nt.topo_pos >= 0) & (nt.topo_pos < ps) & nt.valid
+    cell = jnp.where(has_coord, nt.topo_sp * ps + nt.topo_pos, cells)
+    grid_node = jnp.full(cells + 1, -1, jnp.int32).at[cell].set(
+        jnp.arange(n, dtype=jnp.int32))[:cells]
+    node_of_cell = jnp.clip(grid_node, 0, n - 1)
+
+    free = nt.allocatable - nt.requested                          # [N, R]
+    ok_node = nt.valid & ~nt.unschedulable                        # [N]
+    iota_ps = jnp.arange(ps, dtype=jnp.int32)
+    iota_cells = jnp.arange(cells, dtype=jnp.int32)
+    big = np.int32(2 ** 31 - 1)
+
+    def place(taken, xs):
+        rg, k, mv = xs
+        # `req == 0 always fits` sentinel, same trick as the batch scan
+        gate = jnp.where(rg == 0, jnp.int32(-(2 ** 30)), rg)
+        fits = jnp.all(free >= gate[None, :], axis=-1) & ok_node  # [N]
+        feas = (grid_node >= 0) & fits[node_of_cell] & ~taken     # [cells]
+        fg = feas.reshape(s_pods, ps)
+
+        # window feasibility for dynamic length k via row prefix sums:
+        # window [b, b+k) is free iff csum[b+k-1] - csum[b-1] == k
+        csum = jnp.cumsum(fg.astype(jnp.int32), axis=1)
+        hi_idx = jnp.clip(iota_ps + k - 1, 0, ps - 1)
+        hi = jnp.take(csum, hi_idx, axis=1)
+        lo = jnp.pad(csum, ((0, 0), (1, 0)))[:, :-1]
+        win_ok = (k > 0) & (iota_ps[None, :] + k <= ps) & (hi - lo == k)
+
+        # fragmentation term: free run stranded left of b plus right of
+        # b+k-1 — best fit minimizes the leftover
+        run_end = _row_runs(fg)
+        run_start = jnp.flip(_row_runs(jnp.flip(fg, axis=1)), axis=1)
+        left = jnp.pad(run_end, ((0, 0), (1, 0)))[:, :-1]
+        right_idx = jnp.clip(iota_ps + k, 0, ps - 1)
+        right = jnp.where((iota_ps[None, :] + k) < ps,
+                          jnp.take(run_start, right_idx, axis=1), 0)
+        leftover = left + right
+
+        # encoded preference: leftover, then superpod, then start — one
+        # argmin, identical to slice_assign_host's (leftover, s, b) tuple
+        score = jnp.where(win_ok, leftover * cells
+                          + iota_cells.reshape(s_pods, ps), big).reshape(-1)
+        best = jnp.argmin(score).astype(jnp.int32)
+        okg = score[best] < big
+
+        off = jnp.cumsum(mv.astype(jnp.int32)) - 1                # [M]
+        tcell = jnp.clip(best + off, 0, cells - 1)
+        tnode = jnp.where(mv & okg, grid_node[tcell], jnp.int32(-1))
+        taken = taken | (okg & (iota_cells >= best) & (iota_cells < best + k))
+        return taken, (tnode, okg)
+
+    taken0 = jnp.zeros(cells, bool)
+    _taken, (targets, ok) = lax.scan(
+        place, taken0, (req_g, want, member_valid))
+    return targets, ok
+
+
+def slice_assign_host(topo_sp, topo_pos, valid, fits, want,
+                      slice_grid: Tuple[int, int],
+                      taken_cells=None) -> Tuple[List[List[int]], List[bool]]:
+    """Host oracle of ``plan_slices`` (parity tests + the SlicePacking
+    plugin): the same greedy best-fit walk in plain Python. ``fits`` is
+    [G, N] bool (node currently fits gang g's request and is schedulable),
+    ``want`` [G] member counts. ``taken_cells`` optionally seeds the
+    taken-cell bitmap (the plugin's live-plan reservations). Returns
+    (per-gang node-slot lists — empty when rejected, ok flags)."""
+    s_pods, ps = slice_grid
+    cells = s_pods * ps
+    grid_node = np.full(cells, -1, np.int64)
+    for nidx in range(len(topo_sp)):
+        sp, pos = int(topo_sp[nidx]), int(topo_pos[nidx])
+        if valid[nidx] and 0 <= sp < s_pods and 0 <= pos < ps:
+            grid_node[sp * ps + pos] = nidx
+    taken = np.zeros(cells, bool)
+    if taken_cells is not None:
+        for c in taken_cells:
+            if 0 <= c < cells:
+                taken[c] = True
+    out_targets: List[List[int]] = []
+    out_ok: List[bool] = []
+    for gi in range(len(want)):
+        k = int(want[gi])
+        best, best_score = -1, None
+        if k > 0:
+            feas = np.array([
+                grid_node[c] >= 0 and bool(fits[gi][grid_node[c]])
+                and not taken[c] for c in range(cells)])
+            fg = feas.reshape(s_pods, ps)
+            for s in range(s_pods):
+                row = fg[s]
+                for b in range(ps - k + 1):
+                    if not row[b:b + k].all():
+                        continue
+                    left = 0
+                    q = b - 1
+                    while q >= 0 and row[q]:
+                        left += 1
+                        q -= 1
+                    right = 0
+                    q = b + k
+                    while q < ps and row[q]:
+                        right += 1
+                        q += 1
+                    cand = (left + right, s, b)
+                    if best_score is None or cand < best_score:
+                        best_score, best = cand, s * ps + b
+        if best < 0:
+            out_targets.append([])
+            out_ok.append(False)
+            continue
+        out_targets.append([int(grid_node[best + o]) for o in range(k)])
+        taken[best:best + k] = True
+        out_ok.append(True)
+    return out_targets, out_ok
+
+
+def fragmentation_host(topo_sp, topo_pos, valid, node_free,
+                       slice_grid: Tuple[int, int]) -> List[dict]:
+    """Per-superpod fragmentation accounting (host-side, numpy over the
+    device mirror — no device sync). ``node_free`` [N] bool marks nodes
+    whose full chip complement is available for slice use. Returns one dict
+    per superpod that has any mapped node: {sp, free, used, largest_run,
+    frag} where frag = 1 - largest_free_run / free_count (0.0 when nothing
+    is free — an exhausted superpod is full, not fragmented)."""
+    s_pods, ps = slice_grid
+    rows: List[dict] = []
+    free_grid = np.zeros((s_pods, ps), bool)
+    present = np.zeros((s_pods, ps), bool)
+    for nidx in range(len(topo_sp)):
+        sp, pos = int(topo_sp[nidx]), int(topo_pos[nidx])
+        if valid[nidx] and 0 <= sp < s_pods and 0 <= pos < ps:
+            present[sp, pos] = True
+            free_grid[sp, pos] = bool(node_free[nidx])
+    for s in range(s_pods):
+        if not present[s].any():
+            continue
+        free = int(free_grid[s].sum())
+        used = int(present[s].sum()) - free
+        largest = run = 0
+        for cell_free in free_grid[s]:
+            run = run + 1 if cell_free else 0
+            largest = max(largest, run)
+        frag = 0.0 if free == 0 else 1.0 - largest / free
+        rows.append({"sp": s, "free": free, "used": used,
+                     "largest_run": largest, "frag": frag})
+    return rows

@@ -174,6 +174,15 @@ def _node_wrapper(i: int, params: dict):
         for k, v in dict(params["device_attributes"]).items():
             attrs[k] = v[i % len(v)] if isinstance(v, (list, tuple)) else v
         nw.device_attrs(attrs)
+    if params.get("tpu_topology"):
+        # well-known torus coordinate labels (ops/encode.py): node i is
+        # host (i // slots, i % slots) — slot order is the superpod's
+        # linearized torus walk, so consecutive ordinals are torus-adjacent
+        from ..ops.encode import TOPO_SLOT_LABEL, TOPO_SUPERPOD_LABEL
+
+        slots = int(dict(params["tpu_topology"]).get("slots", 16))
+        nw.label(TOPO_SUPERPOD_LABEL, str(i // slots))
+        nw.label(TOPO_SLOT_LABEL, str(i % slots))
     return nw
 
 
@@ -194,6 +203,13 @@ def _pod_wrapper(i: int, prefix: str, params: dict):
         # misaligned boundary could never reach quorum
         group = f"{prefix}-pg{int(params.get('_gang_ordinal', i)) // size}"
         pw.pod_group(group)
+        if params.get("slice"):
+            # slice gang: contiguous-torus placement contract (ops/slice.py)
+            # instead of the flat gang assigner; the planner pins one member
+            # per host, so the anti-affinity term is usually redundant here
+            from ..ops.slice import SLICE_LABEL
+
+            pw.label(SLICE_LABEL, "1")
         if params.get("gang_anti_affinity", True):
             pw.pod_affinity(
                 "kubernetes.io/hostname",
@@ -680,6 +696,82 @@ class Runner:
         self.data_items.extend(mcol.collect())
         return summary
 
+    # ---- slice-topology evidence ----
+
+    def collect_slice_stats(self, label: str = "SliceStats") -> Dict[str, float]:
+        """collectSliceStats op — slice-packing evidence from STORE truth,
+        so oracle/tpu/wire rows are directly comparable: per-superpod
+        fragmentation over free (pod-less) labeled hosts, contiguity of
+        every bound slice gang (consecutive slots inside one superpod, one
+        member per host), plus the slice wait/verdict metrics the batched
+        paths observe and the sequential-fallback count (must stay 0 for
+        slice batches). Assertions live in the tests; the harness measures."""
+        from ..api.types import POD_GROUP_LABEL
+        from ..ops.encode import TOPO_SLOT_LABEL, TOPO_SUPERPOD_LABEL
+        from ..ops.slice import SLICE_LABEL, fragmentation_host
+
+        coords: Dict[str, tuple] = {}
+        for name, node in self.store.nodes.items():
+            sp_s = node.meta.labels.get(TOPO_SUPERPOD_LABEL)
+            pos_s = node.meta.labels.get(TOPO_SLOT_LABEL)
+            if sp_s is not None and pos_s is not None:
+                coords[name] = (int(sp_s), int(pos_s))
+        occupied: Dict[str, int] = {}
+        for p in self.store.pods.values():
+            if p.spec.node_name:
+                occupied[p.spec.node_name] = (
+                    occupied.get(p.spec.node_name, 0) + 1)
+        frag_max = frag_mean = 0.0
+        if coords:
+            names = sorted(coords)
+            grid = (max(c[0] for c in coords.values()) + 1,
+                    max(c[1] for c in coords.values()) + 1)
+            rows = fragmentation_host(
+                [coords[n][0] for n in names],
+                [coords[n][1] for n in names],
+                [True] * len(names),
+                [occupied.get(n, 0) == 0 for n in names], grid)
+            scores = [r["frag"] for r in rows]
+            if scores:
+                frag_max = max(scores)
+                frag_mean = sum(scores) / len(scores)
+        gangs: Dict[str, List[str]] = {}
+        for p in self.store.pods.values():
+            if (p.spec.node_name and p.meta.labels.get(SLICE_LABEL)
+                    and p.meta.labels.get(POD_GROUP_LABEL)):
+                gkey = (f"{p.meta.namespace}/"
+                        f"{p.meta.labels[POD_GROUP_LABEL]}")
+                gangs.setdefault(gkey, []).append(p.spec.node_name)
+        violations = 0
+        for gkey, members in gangs.items():
+            cells = [coords.get(n) for n in members]
+            if any(c is None for c in cells):
+                violations += 1  # a member landed off the labeled torus
+                continue
+            cells.sort()
+            sp_ids = {c[0] for c in cells}
+            pos = [c[1] for c in cells]
+            if (len(sp_ids) != 1 or len(set(pos)) != len(pos)
+                    or pos[-1] - pos[0] != len(pos) - 1):
+                violations += 1
+        h = self.scheduler.smetrics.slice_wait_duration
+        zero = ([], 0)  # all-time snapshot (MetricsCollector's zero form)
+        data = {
+            "FragmentationMax": frag_max,
+            "FragmentationMean": frag_mean,
+            "ContiguityViolations": float(violations),
+            "BoundSliceGangs": float(len(gangs)),
+            "SliceScheduled": float(h.count_since(zero, "scheduled")),
+            "SliceRejected": float(h.count_since(zero, "rejected")),
+            "SliceWaitP50": h.percentile_since(zero, 0.50, "scheduled"),
+            "SliceWaitP99": h.percentile_since(zero, 0.99, "scheduled"),
+            "FallbackScheduled": float(
+                getattr(self.scheduler, "fallback_scheduled", 0)),
+        }
+        self.data_items.append(DataItem(
+            data=data, unit="", labels={"Name": label}))
+        return data
+
     # ---- multi-tenant soak phase ----
 
     def _quota_plugin(self):
@@ -1095,6 +1187,8 @@ class Runner:
                 self.create_quota(**kwargs)
             elif kind == "soakPhase":
                 self.soak_phase(**kwargs)
+            elif kind == "collectSliceStats":
+                self.collect_slice_stats(**kwargs)
             elif kind == "elasticPhase":
                 # remember the node shape for storm replacements
                 self._elastic_node_params = dict(kwargs.pop("node_params", {})

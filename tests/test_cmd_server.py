@@ -137,9 +137,10 @@ class TestDebugEndpoints:
             assert status == 200
             assert set(json.loads(body)["endpoints"]) == {
                 "/debug/queue", "/debug/cache", "/debug/devicestate",
-                "/debug/spans", "/debug/circuit", "/debug/sessions",
-                "/debug/fabric", "/debug/flightrecorder", "/debug/quota",
-                "/debug/locktrace", "/debug/ledger", "/debug/timeline"}
+                "/debug/slices", "/debug/spans", "/debug/circuit",
+                "/debug/sessions", "/debug/fabric", "/debug/flightrecorder",
+                "/debug/quota", "/debug/locktrace", "/debug/ledger",
+                "/debug/timeline"}
             # every listed endpoint answers 200 with a JSON body (the
             # index can't name a route the mux doesn't actually serve)
             for ep in json.loads(body)["endpoints"]:
@@ -365,6 +366,52 @@ class TestDebugEndpoints:
         assert doc["batchCounter"] >= 1
         assert doc["sigTable"]["nSigs"] >= 1
         assert doc["batchSizer"]["target"] >= 1
+        # unlabeled nodes get synthetic torus coords from the encoder, so
+        # the topology block is populated even without well-known labels
+        topo = doc["topology"]
+        assert topo["chipsPerNode"] >= 1
+        assert len(topo["nodes"]) == 4
+        assert {n["node"] for n in topo["nodes"]} == {f"n{i}" for i in range(4)}
+
+    def test_slices_dump_topology_and_limit(self):
+        """ISSUE 16 satellite: /debug/slices renders the torus occupancy
+        map off the host mirror, /debug/devicestate carries the topology
+        block, and both honor the uniform ?limit= capping."""
+        from kubernetes_tpu.backend import TPUScheduler
+        from kubernetes_tpu.cmd.server import build_debug_handlers
+        from kubernetes_tpu.ops.encode import (TOPO_SLOT_LABEL,
+                                               TOPO_SUPERPOD_LABEL)
+
+        store = ClusterStore()
+        for i in range(8):
+            store.create_node(
+                make_node(f"n{i}").capacity(
+                    {"cpu": "4", "memory": "8Gi", "pods": 10})
+                .label(TOPO_SUPERPOD_LABEL, str(i // 4))
+                .label(TOPO_SLOT_LABEL, str(i % 4)).obj())
+        sched = TPUScheduler(store, batch_size=8)
+        store.create_pod(make_pod("p0").req({"cpu": "100m"}).obj())
+        sched.run_until_settled()
+        handlers = build_debug_handlers(sched)
+        doc = json.loads(json.dumps(handlers["slices"](), default=str))
+        assert doc["enabled"] is True
+        assert len(doc["superpods"]) == 2
+        for row in doc["superpods"]:
+            assert set(row) >= {"sp", "free", "used", "largest_run",
+                                "frag", "map"}
+            # 4 mapped hosts per superpod; one host is used somewhere
+            assert len(row["map"]) == doc["grid"]["slots"]
+            assert row["map"].count("-") == doc["grid"]["slots"] - 4
+        assert sum(r["used"] for r in doc["superpods"]) == 1
+        # ?limit= caps the superpod rows, truncation stays visible
+        capped = handlers["slices"](limit=1)
+        assert len(capped["superpods"]) == 1
+        assert capped["superpodsTruncated"] == 2
+        # the devicestate topology block honors the same cap on nodes
+        dev = handlers["devicestate"](limit=3)
+        assert len(dev["topology"]["nodes"]) == 3
+        assert dev["topology"]["nodesTruncated"] == 8
+        assert dev["topology"]["grid"]["slots"] >= 4
 
 
 class TestSchedulerApp:

@@ -177,6 +177,13 @@ FENCE_TOLERANCES = {
     # notch looser than the attempt-p99 rows they wrap
     "e2e_p99_s": 100.0,            # headline pod e2e p99
     "workload_e2e_p99_s": 200.0,   # per-workload pod e2e p99
+    # SchedulingSlices row (first recorded r15+): slice wait p99 reads
+    # from the same ~2x histogram buckets as the other p99 rows; frag_max
+    # is a placement-quality score in [0, 1] that shifts with the gang
+    # mix, so both fences are loose. check() skips when either round
+    # lacks the row (pre-slice baselines, or a budget-skipped matrix).
+    "workload_slice_wait_p99_s": 200.0,
+    "workload_slice_frag_max": 75.0,
 }
 # per-workload overrides for rows whose history is structurally volatile
 # (PreemptionBasic swung 2953 -> 69 -> 243 pods/s across r02-r05 as the
@@ -303,6 +310,18 @@ def fence(current: dict, rounds: Optional[List[dict]] = None) -> dict:
               b.get("e2e_p99_s"),
               over.get("workload_e2e_p99_s", tol["workload_e2e_p99_s"]),
               False)
+        # slice-packing rows only (skip-when-absent via check()'s None
+        # guard: non-slice workloads carry no "slices" block)
+        check(f"workload {name} slice wait p99",
+              (c.get("slices") or {}).get("wait_p99_s"),
+              (b.get("slices") or {}).get("wait_p99_s"),
+              over.get("workload_slice_wait_p99_s",
+                       tol["workload_slice_wait_p99_s"]), False)
+        check(f"workload {name} slice frag max",
+              (c.get("slices") or {}).get("frag_max"),
+              (b.get("slices") or {}).get("frag_max"),
+              over.get("workload_slice_frag_max",
+                       tol["workload_slice_frag_max"]), False)
     return {"baselineRound": base.get("_round"), "checked": checked,
             "violations": violations, "tolerances": FENCE_TOLERANCES}
 
