@@ -84,6 +84,13 @@ CRITICAL_PATH_SPANS = frozenset({
     "device.dispatch",
     "device.commit",          # device-service server-side commit
     "device.commit.wait",
+    # dispatch-profiler children of device.commit.wait (telemetry.py
+    # emit_phase_spans): the wait's dwell/exec/fetch waterfall. Grand-
+    # children of scheduling.cycle, so the cycle attribution above never
+    # double-counts them; _commit_wait_breakdown consumes them instead.
+    "device.dispatch.dwell",
+    "device.dispatch.exec",
+    "device.dispatch.fetch",
     "device.commit.reconcile",
     "device.commit.backpressure",  # dispatcher blocked on the commit worker
     "host.commit",
@@ -186,6 +193,67 @@ def _critical_path_from_spans(spans):
     return out
 
 
+def _commit_wait_breakdown(spans):
+    """Dispatch-profiler waterfall (ROADMAP item 2): decompose the total
+    device.commit.wait wall into its dwell / exec / fetch children
+    (telemetry.emit_phase_spans window partition — the three phases are
+    clamped into the wait window, so their sum tracks the wait total by
+    construction; any residual is wait time outside a profiled record,
+    e.g. the ready-poll slack before the first record lands)."""
+    wait_total = 0.0
+    waits = 0
+    phase = {"dwell": 0.0, "exec": 0.0, "fetch": 0.0}
+    for s in spans:
+        if s.name == "device.commit.wait":
+            wait_total += s.duration_s
+            waits += 1
+        elif s.name.startswith("device.dispatch."):
+            key = s.name[len("device.dispatch."):]
+            if key in phase:
+                phase[key] += s.duration_s
+    if not waits or wait_total <= 0:
+        return None
+    return {
+        "commit_wait_ms_total": round(wait_total * 1000, 2),
+        "batches": waits,
+        "phase_ms": {k: round(v * 1000, 2) for k, v in phase.items()},
+        "share_pct": {k: round(100.0 * v / wait_total, 1)
+                      for k, v in phase.items()},
+        "phase_ms_per_batch": {k: round(v / waits * 1000, 3)
+                               for k, v in phase.items()},
+    }
+
+
+def _device_program_table(tele, top_n=8):
+    """Per-program device-time table from the DispatchLedger running stats
+    + cost ledger: where device seconds went by program@bucket, with the
+    XLA cost-analysis flops/bytes (and the achieved rates derived from
+    them) when the one-shot AOT probe captured them."""
+    dump = tele.dispatch_ledger.dump(limit=0)
+    programs = dump.get("programs") or {}
+    if not programs:
+        return None
+    rows = sorted(programs.items(), key=lambda kv: -kv[1].get("execS", 0.0))
+    out = {}
+    for name, st in rows[:top_n]:
+        row = {
+            "count": st["count"],
+            "exec_ms_total": round(st["execS"] * 1000, 2),
+            "dwell_ms_total": round(st["dwellS"] * 1000, 2),
+            "fetch_ms_total": round(st["fetchS"] * 1000, 2),
+            "fetch_bytes": st["fetchBytes"],
+        }
+        if "flops" in st:
+            row["flops"] = st["flops"]
+            row["bytes_accessed"] = st.get("bytesAccessed", 0)
+            if "achievedFlopsPerS" in st:
+                row["achieved_flops_per_s"] = round(st["achievedFlopsPerS"])
+            if "achievedBytesPerS" in st:
+                row["achieved_bytes_per_s"] = round(st["achievedBytesPerS"])
+        out[name] = row
+    return out
+
+
 def run_tpu(n_nodes, n_init, n_measured, batch):
     from kubernetes_tpu.apiserver import ClusterStore
     from kubernetes_tpu.backend import TPUScheduler, telemetry
@@ -251,8 +319,10 @@ def run_tpu(n_nodes, n_init, n_measured, batch):
     sched.run_until_settled()
     dt = time.perf_counter() - t0
     critical = None
+    commit_wait_breakdown = None
     if exporter is not None:
         critical = _critical_path_from_spans(exporter.spans)
+        commit_wait_breakdown = _commit_wait_breakdown(exporter.spans)
         tracing.disable()
     assert sched.metrics["scheduled"] == n_init + n_measured, sched.metrics
     assert not sched.settle_abandoned, "measured phase abandoned with pods pending"
@@ -332,6 +402,14 @@ def run_tpu(n_nodes, n_init, n_measured, batch):
         / meas_batches)
     if critical is not None:
         evidence["critical_path"] = critical
+    # dispatch-profiler evidence (ROADMAP item 2): the commit-wait
+    # waterfall (dwell/exec/fetch shares of device.commit.wait) and the
+    # per-program device-time table with cost-ledger flops/bytes
+    if commit_wait_breakdown is not None:
+        evidence["commit_wait_breakdown"] = commit_wait_breakdown
+    device_programs = _device_program_table(tele)
+    if device_programs is not None:
+        evidence["device_programs"] = device_programs
     # release the module-global ledger so later rows (run_wire's Runner)
     # can own a fresh one against their own registry
     latency_ledger.disable()

@@ -1525,14 +1525,25 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
         mode = (None if (sample_k is not None or spec or extra_mask is not None
                          or dra_mask is not None or slice_members is not None)
                 else pallas_mode(nt, None, topo_enabled))
-        return schedule_batch(pb, et, nt, tc, tb, key, weights_key=wk,
-                              topo_enabled=topo_enabled, pallas=mode,
-                              topo_carry=topo_carry, sample_k=sample_k,
-                              sample_start=sample_start, topo_mode=topo_mode,
-                              vd_override=vd_override, host_key=host_key,
-                              spec_decode=spec, ports_enabled=ports_enabled,
-                              extra_mask=extra_mask, dra_mask=dra_mask,
-                              slice_members=slice_members,
-                              slice_grid=slice_grid)
+        kw = dict(weights_key=wk, topo_enabled=topo_enabled, pallas=mode,
+                  topo_carry=topo_carry, sample_k=sample_k,
+                  sample_start=sample_start, topo_mode=topo_mode,
+                  vd_override=vd_override, host_key=host_key,
+                  spec_decode=spec, ports_enabled=ports_enabled,
+                  extra_mask=extra_mask, dra_mask=dra_mask,
+                  slice_members=slice_members, slice_grid=slice_grid)
+        out = schedule_batch(pb, et, nt, tc, tb, key, **kw)
+        from . import telemetry
+
+        if telemetry.get() is not None:
+            # cost ledger: AOT-lower the exact signature just dispatched and
+            # keep its flops/bytes once per (program, bucket sig) — this is
+            # the one place the batch program's full kwargs exist. Sig
+            # mirrors _run_batch_fn's compile-ledger bucket.
+            sig = (f"{getattr(pb, 'capacity', '?')}/"
+                   f"{topo_mode or ('general' if topo_enabled else 'off')}")
+            telemetry.cost_probe("schedule_batch", sig, schedule_batch,
+                                 (pb, et, nt, tc, tb, key), kw)
+        return out
 
     return fn

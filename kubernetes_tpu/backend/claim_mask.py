@@ -76,9 +76,12 @@ def build_dra_mask(device, entries, pad_to: int):
 
     with telemetry.dispatch("claim_mask",
                             bucket=f"{pad_to}x{sel_key.shape[1]}"):
-        mask = claim_feasibility_mask(
-            jnp.asarray(sel_key), jnp.asarray(sel_op), jnp.asarray(sel_kind),
-            jnp.asarray(sel_val), device.attr_kind, device.attr_val)
+        args = (jnp.asarray(sel_key), jnp.asarray(sel_op),
+                jnp.asarray(sel_kind), jnp.asarray(sel_val),
+                device.attr_kind, device.attr_val)
+        mask = claim_feasibility_mask(*args)
+    telemetry.cost_probe("claim_mask", f"{pad_to}x{sel_key.shape[1]}",
+                         claim_feasibility_mask, args)
     if restrict is not None:
         mask = mask & jnp.asarray(restrict)
     return mask

@@ -37,7 +37,9 @@ batched scheduler. This module rebuilds that loop as a data plane:
 
   * ``materialize_result`` — the one-blocking-read materialization of a
     batch's packed result block, shared by the in-process commit, the
-    commit worker, and ``DeviceService``'s server-side commit.
+    commit worker, and ``DeviceService``'s server-side commit
+    (``materialize_profiled`` wraps it with the dispatch profiler's
+    dwell/exec/fetch decomposition when telemetry is enabled).
 
 Durability contract of the group commit: one crc-framed WAL line carries
 the whole batch's bind records in journal order. A crash mid-write tears
@@ -399,6 +401,52 @@ def materialize_result(result, n_nodes: int, batch_id: str = "",
     telemetry.event("packed_fallback", batchId=batch_id, pods=pods,
                     **event_extra)
     return node_idx, None, None, False
+
+
+def materialize_profiled(result, n_nodes: int, *, program: str,
+                         bucket: Optional[str] = None,
+                         t_submit: Optional[float] = None,
+                         now_fn: Callable[[], float] = perf_counter,
+                         batch_id: str = "", pods: int = 0,
+                         event_extra: Optional[dict] = None):
+    """``materialize_result`` plus the dispatch profiler's phase
+    decomposition. With the profiler off this IS materialize_result (one
+    global read, no extra device calls); with it on, an extra
+    ``block_until_ready`` on the device-side result separates execution
+    completion from the host fetch, and the timestamps land in the
+    DispatchLedger. Returns ``(materialized_tuple, dispatch_record)`` —
+    the record is None when the profiler is disabled."""
+    from . import telemetry
+
+    rec = telemetry.get()
+    t_wait0 = now_fn()
+    t_exec_done = None
+    if rec is not None:
+        arr = result.packed if result.packed is not None else result.node_idx
+        block = getattr(arr, "block_until_ready", None)
+        if block is not None:
+            try:
+                block()
+                t_exec_done = now_fn()
+            except Exception:  # noqa: BLE001 — the materialize below will
+                pass           # surface any real device failure
+    out = materialize_result(result, n_nodes, batch_id=batch_id, pods=pods,
+                             **(event_extra or {}))
+    t_wait_end = now_fn()
+    disp = None
+    if rec is not None:
+        if result.packed is not None:
+            fetch_bytes = result.packed.nbytes
+        else:
+            fetch_bytes = getattr(out[0], "nbytes", 0)
+        disp = rec.dispatch_ledger.record_window(
+            program, bucket, batch_id=batch_id, pods=pods,
+            t_submit=t_submit if t_submit is not None else t_wait0,
+            t_wait0=t_wait0,
+            t_exec_done=t_exec_done if t_exec_done is not None else t_wait_end,
+            t_wait_end=t_wait_end, fetch_bytes=int(fetch_bytes))
+        telemetry.emit_phase_spans(disp)
+    return out, disp
 
 
 class CommitWorker:

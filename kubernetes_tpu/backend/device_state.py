@@ -421,8 +421,12 @@ class DeviceState:
             image_sizes = nt.image_sizes
             image_num_nodes = nt.image_num_nodes
         with telemetry.dispatch("apply_rows", bucket=str(b)):
-            self.nt = _apply_rows_jit(nt, jnp.asarray(slots), updates,
+            dev_slots = jnp.asarray(slots)
+            self.nt = _apply_rows_jit(nt, dev_slots, updates,
                                       image_sizes, image_num_nodes)
+        telemetry.cost_probe("apply_rows", str(b), _apply_rows_jit,
+                             (nt, dev_slots, updates, image_sizes,
+                              image_num_nodes))
         self.syncs += 1
         self.rows_uploaded += n
         nbytes = sum(arr.nbytes for arr in updates.values()) + slots.nbytes

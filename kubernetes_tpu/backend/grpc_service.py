@@ -332,6 +332,32 @@ def _results_from_proto(resp) -> dict:
     return {"results": results}
 
 
+def _device_time_to_proto(resp, out: dict) -> None:
+    """Stamp the dispatch profiler's echoed deviceTime onto the response
+    (no-op when the profiler was off or the vendored pb2 predates the
+    field — the uniform stale-pb2 degradation rule)."""
+    dt = out.get("deviceTime")
+    if (not isinstance(dt, dict)
+            or "device_time" not in resp.DESCRIPTOR.fields_by_name):
+        return
+    resp.device_time.dwell_ms = float(dt.get("dwellMs") or 0.0)
+    resp.device_time.exec_ms = float(dt.get("execMs") or 0.0)
+    resp.device_time.fetch_ms = float(dt.get("fetchMs") or 0.0)
+    resp.device_time.device_ms = float(dt.get("deviceMs") or 0.0)
+
+
+def _device_time_from_proto(resp) -> Optional[dict]:
+    """The client half: the HTTP-shaped deviceTime dict, or None when the
+    server didn't echo one (profiler off / older server or pb2)."""
+    if ("device_time" not in resp.DESCRIPTOR.fields_by_name
+            or not resp.HasField("device_time")):
+        return None
+    return {"dwellMs": resp.device_time.dwell_ms,
+            "execMs": resp.device_time.exec_ms,
+            "fetchMs": resp.device_time.fetch_ms,
+            "deviceMs": resp.device_time.device_ms}
+
+
 # ------------------------------------------------------------------ server
 
 
@@ -385,6 +411,7 @@ def serve_grpc(service, port: int = 0):
             resp.session_gen = int(out.get("sessionGen") or 0)
         if "batch_id" in fields:
             resp.batch_id = out.get("batchId") or ""
+        _device_time_to_proto(resp, out)
         return resp
 
     def heartbeat(request, ctx):
@@ -555,6 +582,9 @@ class GrpcClient:
             # echoed idempotency key: the pipelined reply router matches
             # out-of-order replies to their in-flight batches by this id
             out["batchId"] = resp.batch_id
+        dt = _device_time_from_proto(resp)
+        if dt is not None:
+            out["deviceTime"] = dt
         return self._session_gen_out(resp, out)
 
     def heartbeat(self, payload: dict) -> dict:

@@ -855,8 +855,11 @@ class DeviceService:
                 slice_grid = (self.device.caps.superpods,
                               self.device.caps.sp_slots)
             bucket = int(getattr(pb, "capacity", len(pods)))
+            sig = f"{bucket}/" + (
+                "general" if self.device.topo_enabled else "off")
             telemetry.event("dispatch", batchId=batch_id, client=cid,
-                            epoch=self.epoch, bucket=bucket, pods=len(pods))
+                            epoch=self.epoch, bucket=bucket, sig=sig,
+                            pods=len(pods))
             # deliberate blocking-under-lock: dispatch+commit must run against
             # exactly the synced mirror — releasing here would let a peer's
             # delta interleave between the kernel's view and the ownership
@@ -865,8 +868,6 @@ class DeviceService:
                 "device_dispatch", "DeviceService.schedule_batch",
                 allowed="kernel must judge under the same lock as commit")
             with tracing.span("device.dispatch", batch=len(pods)):
-                sig = f"{bucket}/" + (
-                    "general" if self.device.topo_enabled else "off")
                 with telemetry.dispatch("schedule_batch", bucket=sig):
                     result = self.schedule_batch_fn(
                         pb, et, self.device.nt, self.device.tc, tb,
@@ -875,6 +876,7 @@ class DeviceService:
                         sample_k=sample_k, sample_start=sample_start,
                         dra_mask=dra_mask, slice_members=slice_members,
                         slice_grid=slice_grid)
+            t_dispatch = self.now_fn()
             if result.final_sample_start is not None:
                 self._start_carry = result.final_sample_start
             # adopt exactly like the in-process path: the client will assume
@@ -887,11 +889,14 @@ class DeviceService:
                 # AND first_fail in one materialization (the per-array reads
                 # were one relay round-trip each on the TPU tunnel) — the
                 # same commit-plane materializer the in-process commit runs
-                from .commit_plane import materialize_result
+                from .commit_plane import materialize_profiled
 
-                node_idx, ff, slice_words, _ = materialize_result(
+                (node_idx, ff, slice_words, _), disp = materialize_profiled(
                     result, self.device.caps.nodes,
-                    batch_id=batch_id, pods=len(pods), client=cid)
+                    program="schedule_batch", bucket=sig,
+                    t_submit=t_dispatch, now_fn=self.now_fn,
+                    batch_id=batch_id, pods=len(pods),
+                    event_extra={"client": cid})
                 self.device.adopt_device(result)
                 self.device.adopt_commits(result, host_pb, node_idx)
             slot_names = self.device.slot_to_name()
@@ -908,13 +913,17 @@ class DeviceService:
             if telemetry.get() is not None:
                 # placed= is an O(batch) scan — keep it behind the enabled
                 # check so the disabled hot path stays one global read
+                extra = {}
+                if disp is not None:
+                    extra = {"device_ms": round(disp["execS"] * 1e3, 3),
+                             "fetch_ms": round(disp["fetchS"] * 1e3, 3)}
                 telemetry.event(
                     "commit", batchId=batch_id, client=cid, epoch=self.epoch,
                     bucket=bucket, pods=len(pods),
                     placed=int(sum(1 for i in range(len(pods))
                                    if int(node_idx[i]) >= 0
                                    and i not in conflicts)),
-                    conflicts=len(conflicts))
+                    conflicts=len(conflicts), **extra)
             # device preemption screen for the batch's failures (ROADMAP
             # wire-hardening: hints ride back with unschedulable results so
             # the client's PostFilter skips hopeless candidates)
@@ -993,6 +1002,16 @@ class DeviceService:
                 # echo the idempotency key: a pipelined client matches
                 # out-of-order replies to their requests by this id
                 out["batchId"] = batch_id
+            if disp is not None:
+                # echo the server-side device time so the (pipelined)
+                # client can attribute its round trip: device vs transport
+                out["deviceTime"] = {
+                    "dwellMs": round(disp["dwellS"] * 1e3, 3),
+                    "execMs": round(disp["execS"] * 1e3, 3),
+                    "fetchMs": round(disp["fetchS"] * 1e3, 3),
+                    "deviceMs": round(
+                        (disp["execS"] + disp["fetchS"]) * 1e3, 3),
+                }
             return self._stamp(out)
 
 
@@ -1915,6 +1934,7 @@ class WireScheduler(Scheduler):
             latency_ledger.transition_many(
                 [qp.pod.key() for qp in batch], "device.inflight",
                 batch_id=payload["batchId"])
+            t_send = self.now_fn()
             res = self._send_batch_payload(payload)
         except ConflictError as exc:
             # fenced session / cross-client race: the service is HEALTHY, so
@@ -1928,6 +1948,8 @@ class WireScheduler(Scheduler):
             self._wire_transport_failure(batch, exc, pod_cycle, t0)
             return
         self.breaker.record_success()
+        self._note_device_time(res, len(batch), payload["batchId"],
+                               self.now_fn() - t_send)
         self._process_wire_results(batch, res, pod_cycle, t0)
         # feed the deadline model on the synchronous path too — it is the
         # mode whose pop the sizer actually cuts, so it must observe real
@@ -2035,6 +2057,8 @@ class WireScheduler(Scheduler):
             return len(batch)
         wait = self.now_fn() - t_wait0
         self.breaker.record_success()
+        self._note_device_time(res, len(batch), entry.batch_id,
+                               self.now_fn() - entry.t_sent)
         self._process_wire_results(batch, res, pod_cycle, t0)
         # stall-aware sizing, the in-process ring's controller: the span
         # fed is the batch's SERVICE time (submit → claimed), not its full
@@ -2048,6 +2072,35 @@ class WireScheduler(Scheduler):
         self.wire_sizer.update(bucket, self.now_fn() - entry.t_sent)
         self.wire_sizer.update_wait(bucket, wait)
         return len(batch)
+
+    def _note_device_time(self, res: dict, pods: int, batch_id: str,
+                          rtt_s: float) -> None:
+        """Attribute the server-echoed per-batch device time against this
+        client's round trip: the residual (rtt − server device time) is the
+        TRANSPORT dwell — serialization, the wire, and (pipelined) ring
+        residency — which no server-side profiler can see. One global read
+        when the profiler is off or the server didn't echo (older server:
+        degrade silently, same rule as every wire feature)."""
+        rec = telemetry.get()
+        if rec is None:
+            return
+        dt = res.get("deviceTime")
+        if not isinstance(dt, dict):
+            return
+        try:
+            exec_s = float(dt.get("execMs") or 0.0) / 1e3
+            fetch_s = float(dt.get("fetchMs") or 0.0) / 1e3
+            device_s = float(dt.get("deviceMs") or 0.0) / 1e3
+        except (TypeError, ValueError):
+            return
+        transport_s = max(0.0, rtt_s - device_s)
+        rec.dispatch_ledger.record_phases(
+            "wire_schedule_batch", str(self.wire_sizer.bucket_for(pods)),
+            dwell_s=transport_s, exec_s=exec_s, fetch_s=fetch_s,
+            wait_s=max(rtt_s, device_s), batch_id=batch_id, pods=pods)
+        telemetry.event("wire_device_time", batchId=batch_id,
+                        device_ms=round(device_s * 1e3, 3),
+                        transport_ms=round(transport_s * 1e3, 3))
 
     def _build_batch_payload(self, batch: List[QueuedPodInfo]) -> dict:
         """The ScheduleBatch request for one logical batch, stamped with a

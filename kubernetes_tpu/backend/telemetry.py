@@ -1,5 +1,5 @@
-"""Device-runtime observability: compile/retrace ledger, HBM & transfer
-telemetry, and the batch flight recorder.
+"""Device-runtime observability: compile/retrace ledger, dispatch
+profiler, HBM & transfer telemetry, and the batch flight recorder.
 
 PR 2 instrumented the *scheduling pipeline* (extension points, spans,
 /debug); this module watches the JAX/XLA *device runtime* underneath it:
@@ -14,6 +14,19 @@ PR 2 instrumented the *scheduling pipeline* (extension points, spans,
     dispatches — e.g. the BatchSizer walking buckets mid-run) is flagged
     once per storm and exposed on /debug/flightrecorder and in bench
     evidence.
+  * **DispatchLedger** — per-dispatch device-time attribution: every
+    batch's blocking commit wait decomposes into *dwell* (submit →
+    execution start, inferred from the in-flight ring overlap: the device
+    serializes batches, so batch K+1 cannot start before batch K's
+    execution ends), *execute* (device run time, measured by blocking on
+    the device-side result before the host fetch), and *fetch* (the
+    packed-block device→host transfer staged by ``copy_to_host_async`` at
+    dispatch). Records feed a bounded ring (/debug/dispatch), per-
+    (program, bucket) running stats, the
+    ``scheduler_device_dispatch_seconds{program,phase}`` histogram, and —
+    once per (program, bucket), riding the CompileLedger's first compile —
+    an XLA **cost ledger** (``compiled.cost_analysis()`` flops / bytes
+    accessed) so achieved FLOP/s and bytes/s are derivable per program.
   * **HBM & transfer telemetry** — ``sample_hbm()`` reads the accelerator's
     ``memory_stats()`` into ``scheduler_device_hbm_bytes{kind}`` gauges;
     ``transfer(direction, nbytes)`` accumulates per-batch host->device
@@ -77,6 +90,26 @@ EVENT_KINDS = frozenset({
     # slice-topology packing (ops/slice.py): per-gang torus placement
     # verdicts and the edge-triggered superpod fragmentation alert
     "slice_assign", "slice_reject", "frag_alert",
+    # dispatch profiler: server-echoed device time attributed by the wire
+    # client against its own transport dwell
+    "wire_device_time",
+})
+
+# The declared dispatch-program registry. Every LITERAL program name the
+# package passes to ``telemetry.dispatch(...)`` must appear here, and every
+# jitted entry point's host-side call sites must sit inside such a dispatch
+# context — both enforced by ``python -m tools.ktpu_check --pass dispatch``,
+# so a future kernel can never run device time off the ledger. Names here
+# key the CompileLedger, the DispatchLedger, and the cost ledger alike.
+PROGRAM_NAMES = frozenset({
+    "schedule_batch",   # the batch program (backend/batch.py)
+    "gang_verdicts",    # host-oracle gang re-judgement kernel
+    "claim_mask",       # DRA claim feasibility screen
+    "preempt_screen",   # preemption victim screen
+    "apply_rows",       # device-state row upload kernel
+    # ledger-only program: client-side attribution of a wire batch (the
+    # record is fed from the server's echoed deviceTime, not a local jit)
+    "wire_schedule_batch",
 })
 
 
@@ -164,7 +197,22 @@ class CompileLedger:
         finally:
             self._local.ctx = prev
 
+    @contextlib.contextmanager
+    def probe_guard(self):
+        """Suppress compile accounting on this thread while the dispatch
+        profiler's AOT cost probe runs: ``lower().compile()`` for
+        ``cost_analysis()`` duplicates a compile the ledger already counted
+        (or will count) for the real dispatch, and bench fences
+        compile/retrace totals."""
+        self._local.probing = True
+        try:
+            yield
+        finally:
+            self._local.probing = False
+
     def record_compile(self, duration_s: float) -> None:
+        if getattr(self._local, "probing", False):
+            return
         program, bucket = getattr(self._local, "ctx", None) or (OTHER_PROGRAM,
                                                                 "-")
         storm = False
@@ -238,6 +286,193 @@ class CompileLedger:
             }
 
 
+class DispatchLedger:
+    """Per-dispatch device-time attribution: ring of timing records, per-
+    (program, bucket) running stats, and the XLA cost ledger.
+
+    The phase decomposition of one blocking commit wait:
+
+      * **dwell** — submit → execution start. The device serializes batch
+        programs, so batch K+1's execution cannot start before batch K's
+        execution ends: ``exec_start = max(t_submit, prev_exec_end)``
+        (clamped to ``t_exec_done``), tracked as a monotone device-busy
+        horizon under the ledger lock. Under a depth-1 ring dwell is ~0;
+        under pipelining it is the queueing the overlap buys.
+      * **execute** — execution start → device result ready (the profiler
+        blocks on the device array before the host fetch to observe this
+        edge; profiler-off keeps the single opaque blocking read).
+      * **fetch** — result ready → packed block on host (the
+        ``copy_to_host_async`` transfer staged at dispatch).
+
+    Each record also carries ``window``: the same three phases clamped into
+    the observed wait window ``[t_wait0, t_wait_end]`` so they sum to the
+    wait *exactly* — that partition backs the ``device.dispatch.*`` child
+    spans under ``device.commit.wait`` and the bench waterfall.
+    """
+
+    def __init__(self, metrics=None, capacity: int = 2048,
+                 compile_ledger: Optional[CompileLedger] = None):
+        self.metrics_sets = (metrics if isinstance(metrics, list)
+                             else [metrics] if metrics is not None else [])
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self.recorded = 0
+        self.stats: Dict[tuple, dict] = {}   # (program, bucket) -> sums
+        self.costs: Dict[tuple, dict] = {}   # (program, bucket) -> flops/bytes
+        self._last_exec_end = 0.0            # device-busy horizon (now_fn domain)
+        self._compile_ledger = compile_ledger
+
+    def record_window(self, program: str, bucket: Optional[str] = None, *,
+                      t_submit: float, t_wait0: float, t_exec_done: float,
+                      t_wait_end: float, batch_id: str = "", pods: int = 0,
+                      fetch_bytes: int = 0) -> dict:
+        """Record one dispatch from its raw timestamps (all in the caller's
+        ``now_fn`` domain). ``t_submit`` is when the async dispatch
+        returned; ``t_wait0``/``t_wait_end`` bracket the blocking commit
+        wait; ``t_exec_done`` is when the device-side result was ready."""
+        with self._lock:
+            exec_start = min(max(t_submit, self._last_exec_end), t_exec_done)
+            if t_exec_done > self._last_exec_end:
+                self._last_exec_end = t_exec_done
+        dwell = max(0.0, exec_start - t_submit)
+        exec_s = max(0.0, t_exec_done - exec_start)
+        fetch = max(0.0, t_wait_end - max(t_exec_done, t_wait0))
+        wait = max(0.0, t_wait_end - t_wait0)
+        # the wait-window partition: clamp each phase edge into the window
+        # so dwell+exec+fetch == wait exactly (dwell/exec overlapped with
+        # host work before t_wait0 belong to the full phases above, not to
+        # the blocking wait the critical path sees)
+        a = min(max(exec_start, t_wait0), t_wait_end)
+        b = min(max(t_exec_done, a), t_wait_end)
+        window = {"dwell": a - t_wait0, "exec": b - a, "fetch": t_wait_end - b}
+        return self._commit_record(program, bucket, dwell, exec_s, fetch,
+                                   wait, window, batch_id, pods, fetch_bytes)
+
+    def record_phases(self, program: str, bucket: Optional[str] = None, *,
+                      dwell_s: float, exec_s: float, fetch_s: float,
+                      wait_s: Optional[float] = None, batch_id: str = "",
+                      pods: int = 0, fetch_bytes: int = 0) -> dict:
+        """Record one dispatch from pre-computed phase durations (the wire
+        client's path: the server echoes exec/fetch, transport residual is
+        the dwell). Does not move the device-busy horizon — the phases were
+        measured in another process's clock domain."""
+        if wait_s is None:
+            wait_s = dwell_s + exec_s + fetch_s
+        window = {"dwell": dwell_s, "exec": exec_s, "fetch": fetch_s}
+        return self._commit_record(program, bucket, dwell_s, exec_s, fetch_s,
+                                   wait_s, window, batch_id, pods, fetch_bytes)
+
+    def _commit_record(self, program, bucket, dwell, exec_s, fetch, wait,
+                       window, batch_id, pods, fetch_bytes) -> dict:
+        rec = {
+            "t": time.time(), "program": program, "bucket": bucket or "-",
+            "batchId": batch_id, "pods": int(pods),
+            "dwellS": dwell, "execS": exec_s, "fetchS": fetch,
+            "waitS": wait, "fetchBytes": int(fetch_bytes), "window": window,
+        }
+        with self._lock:
+            self._ring.append(rec)
+            self.recorded += 1
+            st = self.stats.setdefault((program, rec["bucket"]), {
+                "count": 0, "dwellS": 0.0, "execS": 0.0, "fetchS": 0.0,
+                "waitS": 0.0, "fetchBytes": 0})
+            st["count"] += 1
+            st["dwellS"] += dwell
+            st["execS"] += exec_s
+            st["fetchS"] += fetch
+            st["waitS"] += wait
+            st["fetchBytes"] += int(fetch_bytes)
+        for m in self.metrics_sets:
+            m.device_dispatch_duration.observe(dwell, program, "dwell")
+            m.device_dispatch_duration.observe(exec_s, program, "exec")
+            m.device_dispatch_duration.observe(fetch, program, "fetch")
+        return rec
+
+    def maybe_cost(self, program: str, bucket: Optional[str], fn,
+                   args=(), kwargs=None) -> None:
+        """Capture XLA ``cost_analysis()`` flops/bytes for (program, bucket)
+        once: the slot is claimed (as ``{}``) before probing so a failing
+        probe is never retried per batch. The probe's own AOT compile is
+        suppressed from the CompileLedger via ``probe_guard`` (the real
+        dispatch already accounts it)."""
+        key = (program, bucket or "-")
+        with self._lock:
+            if key in self.costs:
+                return
+            self.costs[key] = {}
+        cost = self._probe_cost(fn, args, kwargs or {})
+        if cost:
+            with self._lock:
+                self.costs[key] = cost
+
+    def _probe_cost(self, fn, args, kwargs) -> Optional[dict]:
+        lower = getattr(fn, "lower", None)
+        if lower is None:
+            return None
+        guard = (self._compile_ledger.probe_guard()
+                 if self._compile_ledger is not None
+                 else contextlib.nullcontext())
+        try:
+            with guard:
+                analysis = lower(*args, **kwargs).compile().cost_analysis()
+        except Exception:  # noqa: BLE001 — a backend without cost analysis
+            return None
+        if isinstance(analysis, (list, tuple)):  # older jax: one per device
+            analysis = analysis[0] if analysis else None
+        if not isinstance(analysis, dict):
+            return None
+        out = {}
+        if analysis.get("flops") is not None:
+            out["flops"] = float(analysis["flops"])
+        if analysis.get("bytes accessed") is not None:
+            out["bytesAccessed"] = float(analysis["bytes accessed"])
+        return out or None
+
+    def dump(self, limit: Optional[int] = None) -> dict:
+        """The /debug/dispatch body: ring stats, the per-(program, bucket)
+        table (with achieved FLOP/s / bytes/s where the cost ledger has the
+        program's flops/bytes), and the most recent records."""
+        with self._lock:
+            records = list(self._ring)
+            held = len(records)
+            recorded = self.recorded
+            stats = {k: dict(v) for k, v in self.stats.items()}
+            costs = {k: dict(v) for k, v in self.costs.items()}
+        if limit is not None and limit >= 0:
+            records = records[-limit:] if limit else []
+        programs = {}
+        for (program, bucket), st in sorted(stats.items()):
+            entry = {
+                "count": st["count"],
+                "dwellS": round(st["dwellS"], 6),
+                "execS": round(st["execS"], 6),
+                "fetchS": round(st["fetchS"], 6),
+                "waitS": round(st["waitS"], 6),
+                "fetchBytes": st["fetchBytes"],
+            }
+            cost = costs.get((program, bucket))
+            if cost:
+                entry.update(cost)
+                if st["execS"] > 0 and cost.get("flops"):
+                    entry["achievedFlopsPerS"] = round(
+                        cost["flops"] * st["count"] / st["execS"], 1)
+                if st["execS"] > 0 and cost.get("bytesAccessed"):
+                    entry["achievedBytesPerS"] = round(
+                        cost["bytesAccessed"] * st["count"] / st["execS"], 1)
+            programs[f"{program}@{bucket}"] = entry
+        out = {
+            "enabled": True,
+            "ring": {"capacity": self.capacity, "recorded": recorded,
+                     "held": held},
+            "programs": programs,
+            "records": records,
+        }
+        if len(records) < held:
+            out["truncated"] = {"records": held}
+        return out
+
+
 class DeviceTelemetry:
     """The process recorder: ledger + flight recorder + transfer/HBM
     counters, optionally feeding a SchedulerMetrics set."""
@@ -245,8 +480,10 @@ class DeviceTelemetry:
     def __init__(self, metrics=None, ring_capacity: int = 4096):
         self.metrics_sets = [metrics] if metrics is not None else []
         self.flight = FlightRecorder(ring_capacity)
-        # the ledger shares the list object, so attach_metrics reaches both
+        # the ledgers share the list object, so attach_metrics reaches all
         self.ledger = CompileLedger(self.metrics_sets, self.flight)
+        self.dispatch_ledger = DispatchLedger(self.metrics_sets,
+                                              compile_ledger=self.ledger)
         self._lock = threading.Lock()
         self.transfer_bytes: Dict[str, int] = {"upload": 0, "fetch": 0}
         self.transfers: Dict[str, int] = {"upload": 0, "fetch": 0}
@@ -429,6 +666,62 @@ def calibration():
     if t is None:
         return _NULL_CM
     return t.ledger.calibration()
+
+
+def dispatch_window(program: str, bucket: Optional[str] = None,
+                    **kw) -> Optional[dict]:
+    """Record one dispatch's device-time decomposition from raw timestamps
+    (see DispatchLedger.record_window); returns the record, or None when
+    disabled (one global read)."""
+    t = _recorder
+    if t is None:
+        return None
+    return t.dispatch_ledger.record_window(program, bucket, **kw)
+
+
+def dispatch_phases(program: str, bucket: Optional[str] = None,
+                    **kw) -> Optional[dict]:
+    """Record one dispatch from pre-computed phase durations (the wire
+    client's server-echoed path); None when disabled."""
+    t = _recorder
+    if t is None:
+        return None
+    return t.dispatch_ledger.record_phases(program, bucket, **kw)
+
+
+def cost_probe(program: str, bucket: Optional[str], fn,
+               args=(), kwargs=None) -> None:
+    """Capture the program's XLA cost analysis once per (program, bucket);
+    no-op when disabled (one global read) or after the slot is claimed."""
+    t = _recorder
+    if t is None:
+        return
+    t.dispatch_ledger.maybe_cost(program, bucket, fn, args, kwargs)
+
+
+def emit_phase_spans(rec: Optional[dict]) -> None:
+    """Emit ``device.dispatch.{dwell,exec,fetch}`` child spans for one
+    dispatch record, anchored so the window partition ends *now* — call
+    inside the still-open ``device.commit.wait`` span so they parent under
+    it and sum to it exactly. No-op when the record is None (profiler off)
+    or tracing is disabled."""
+    if rec is None:
+        return
+    from ..utils import tracing
+
+    if tracing.get() is None:
+        return
+    anchor = time.time_ns()
+    win = rec["window"]
+    end_off = 0.0
+    for phase in ("fetch", "exec", "dwell"):  # walk back from the wait end
+        start_off = end_off + max(0.0, win[phase])
+        tracing.emit(f"device.dispatch.{phase}",
+                     anchor - int(start_off * 1e9),
+                     anchor - int(end_off * 1e9),
+                     program=rec["program"], batchId=rec["batchId"],
+                     bucket=rec["bucket"])
+        end_off = start_off
 
 
 def transfer(direction: str, nbytes: int) -> None:

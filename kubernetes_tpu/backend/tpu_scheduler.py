@@ -86,6 +86,10 @@ class _Inflight:
     # of committing foreign-device results against a rebuilt mirror — the
     # race-free form of "clear the whole ring on device death"
     device: object = None
+    # now_fn timestamp when the async dispatch returned — the dispatch
+    # profiler's dwell clock starts here (0.0 = unset: dwell collapses
+    # into the wait window)
+    t_submit: float = 0.0
 
 
 def _default_full_batch() -> bool:
@@ -795,9 +799,12 @@ class TPUScheduler(Scheduler):
                                             t_pop, host_pb, pb, mode_info,
                                             batch_id, bucket,
                                             device.encoder.reclaim_gen,
-                                            device))
+                                            device, t_dispatch))
+        # sig mirrors _run_batch_fn's compile-ledger bucket signature so the
+        # flight recorder, compile ledger, and dispatch ledger key alike
+        sig = f"{bucket}/{topo_mode or ('general' if device.topo_enabled else 'off')}"
         telemetry.event("dispatch", batchId=batch_id, bucket=bucket,
-                        pods=len(batched), topo=topo_mode,
+                        pods=len(batched), topo=topo_mode, sig=sig,
                         packed=result.packed is not None,
                         inflight=len(self._inflight))
         # ledger: the whole batch enters device.inflight (ring dwell),
@@ -917,10 +924,11 @@ class TPUScheduler(Scheduler):
         from ..utils import tracing
 
         from . import telemetry
-        from .commit_plane import materialize_result
+        from .commit_plane import materialize_profiled
 
         t0 = self.now_fn()
         wait: Optional[float] = None
+        disp: Optional[dict] = None
         packed_ok = fl.result.packed is not None
         mutex = self.commit_plane.device_mutex
         on_worker = self.commit_worker is not None
@@ -949,9 +957,15 @@ class TPUScheduler(Scheduler):
                               packed="packed" if packed_ok else "fallback",
                               worker="commit" if on_worker else "inline"):
                 t_wait0 = self.now_fn()
-                node_idx, ff, slice_words, _ = materialize_result(
+                mode = (fl.mode_info[0] if fl.mode_info else None) or (
+                    "general" if getattr(fl.device, "topo_enabled", True)
+                    else "off")
+                (node_idx, ff, slice_words, _), disp = materialize_profiled(
                     fl.result, self.device.caps.nodes,
-                    batch_id=fl.batch_id, pods=len(fl.qps), bucket=fl.bucket)
+                    program="schedule_batch", bucket=f"{fl.bucket}/{mode}",
+                    t_submit=fl.t_submit or None, now_fn=self.now_fn,
+                    batch_id=fl.batch_id, pods=len(fl.qps),
+                    event_extra={"bucket": fl.bucket})
                 wait = self.now_fn() - t_wait0
                 self.smetrics.device_batch_duration.observe(wait, "commit_wait")
                 # residual stall: the transfer was staged at dispatch, so any
@@ -1016,9 +1030,15 @@ class TPUScheduler(Scheduler):
             self._poison_batches((fl, *stale), exc)
         else:
             self.relay_breaker.record_success()
+            extra = {}
+            if disp is not None:  # profiler on: the commit event alone can
+                # spot a slow-program outlier batch on /debug/flightrecorder
+                extra = {"device_ms": round(disp["execS"] * 1e3, 3),
+                         "fetch_ms": round(disp["fetchS"] * 1e3, 3)}
             telemetry.event("commit", batchId=fl.batch_id, bucket=fl.bucket,
                             pods=len(fl.qps), packed=packed_ok,
-                            wait_s=round(wait, 6) if wait is not None else None)
+                            wait_s=round(wait, 6) if wait is not None else None,
+                            **extra)
             telemetry.sample_hbm()
         self.smetrics.pipeline_inflight.set(value=len(self._inflight))
         self.smetrics.device_batch_duration.observe(self.now_fn() - t0, "commit")
@@ -1281,6 +1301,17 @@ class TPUScheduler(Scheduler):
                             pres = screen_prefix(pb, self.device.nt,
                                                  result.static_masks,
                                                  node_idx[:len(qps)] < 0)
+                        if telemetry.get() is not None:
+                            from ..ops.preempt import _screen_jit
+
+                            failed_pad = np.zeros(pb.capacity, bool)
+                            failed_pad[:len(qps)] = node_idx[:len(qps)] < 0
+                            telemetry.cost_probe(
+                                "preempt_screen",
+                                str(getattr(pb, "capacity", "?")),
+                                _screen_jit,
+                                (pb, self.device.nt, result.static_masks,
+                                 failed_pad))
                     from ..utils import relay
 
                     relay.count_sync("preempt-read")
@@ -1472,6 +1503,10 @@ class TPUScheduler(Scheduler):
                 placed_all_d, kernel_ok_d, _assign = gang_verdicts(
                     result.node_idx, result.first_fail,
                     member_idx, member_valid)
+            telemetry.cost_probe("gang_verdicts", f"{g_cap}x{m_cap}",
+                                 gang_verdicts,
+                                 (result.node_idx, result.first_fail,
+                                  member_idx, member_valid))
             relay.count_sync("gang-read")
             placed_all = np.asarray(placed_all_d)
             kernel_ok = np.asarray(kernel_ok_d)

@@ -279,6 +279,11 @@ def build_debug_handlers(sched) -> dict:
                           ledger, HBM/transfer counters, and the bounded
                           event ring (backend/telemetry.py; enabled=False
                           when the telemetry layer is off)
+      /debug/dispatch     dispatch profiler: per-(program, bucket) device-
+                          time stats with cost-ledger flops/bytes (achieved
+                          FLOP/s where both exist) and the per-dispatch
+                          dwell/exec/fetch record ring (backend/telemetry.py
+                          DispatchLedger; enabled=False when telemetry off)
       /debug/locktrace    lock-order graph, acquisition counts, blocking
                           events from testing/locktrace.py (enabled only
                           under KTPU_LOCKTRACE=1)
@@ -290,7 +295,8 @@ def build_debug_handlers(sched) -> dict:
                           enabled=False when the ledger is off)
       /debug/timeline     unified Chrome trace-event JSON (Perfetto /
                           chrome://tracing loadable): span tail + flight-
-                          recorder events + ledger pod segments on one
+                          recorder events + ledger pod segments + the
+                          dispatch profiler's device track on one
                           wall-clock axis, batchId/pod-UID correlated
 
     Every handler takes an entry cap (``?limit=N`` on the mux, default
@@ -434,6 +440,14 @@ def build_debug_handlers(sched) -> dict:
             return {"enabled": False}
         return t.dump(limit)
 
+    def dispatch_dump(limit=None):
+        from ..backend import telemetry
+
+        t = telemetry.get()
+        if t is None:
+            return {"enabled": False}
+        return t.dispatch_ledger.dump(limit)
+
     def locktrace_dump(limit=None):
         from ..testing import locktrace
 
@@ -463,8 +477,10 @@ def build_debug_handlers(sched) -> dict:
         cap = 256 if limit is None or limit < 0 else limit
         t = telemetry.get()
         flight = t.flight.dump(cap) if t is not None else []
+        dispatch = (t.dispatch_ledger.dump(cap)["records"]
+                    if t is not None else [])
         return latency_ledger.chrome_trace(
-            spans=tracing.tail(cap), flight=flight,
+            spans=tracing.tail(cap), flight=flight, dispatch=dispatch,
             ledger=latency_ledger.get(), limit=cap)
 
     return {"queue": queue_dump, "cache": cache_dump,
@@ -473,6 +489,7 @@ def build_debug_handlers(sched) -> dict:
             "circuit": circuit_dump, "sessions": sessions_dump,
             "fabric": fabric_dump,
             "flightrecorder": flightrecorder_dump, "quota": quota_dump,
+            "dispatch": dispatch_dump,
             "locktrace": locktrace_dump, "ledger": ledger_dump,
             "timeline": timeline_dump}
 

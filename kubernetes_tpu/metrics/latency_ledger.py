@@ -328,9 +328,9 @@ class PodLatencyLedger:
 # ------------------------------------------------------------- timeline export
 
 def chrome_trace(spans=(), flight=(), ledger: Optional[PodLatencyLedger] = None,
-                 limit: Optional[int] = None) -> dict:
+                 dispatch=(), limit: Optional[int] = None) -> dict:
     """One Chrome trace-event JSON document (loadable in Perfetto /
-    chrome://tracing) unifying three telemetry layers on one time axis:
+    chrome://tracing) unifying four telemetry layers on one time axis:
 
       pid 1  host/device spans (utils/tracing.py tail) — complete events,
              one track per trace so concurrent cycles don't interleave
@@ -338,11 +338,15 @@ def chrome_trace(spans=(), flight=(), ledger: Optional[PodLatencyLedger] = None,
              carrying batchId/client/epoch args
       pid 3  ledger pod segments — one track per pod, slices named by
              segment with pod UID + batchId args
+      pid 4  device dispatch track (DispatchLedger records) — each batch's
+             dwell/exec/fetch waterfall as back-to-back slices ending at
+             the record's commit time, batchId/program-correlated with the
+             pid 1/2 rows above it
 
     All timestamps are microseconds on the wall clock (spans record
-    time.time_ns, the flight recorder and the ledger time.time), so a
-    pod's ``device.inflight`` slice visually brackets its batch's
-    dispatch→commit events."""
+    time.time_ns, the flight recorder, the ledger, and dispatch records
+    time.time), so a pod's ``device.inflight`` slice visually brackets its
+    batch's dispatch→commit events."""
     events: List[dict] = [
         {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
          "args": {"name": "host spans"}},
@@ -350,6 +354,8 @@ def chrome_trace(spans=(), flight=(), ledger: Optional[PodLatencyLedger] = None,
          "args": {"name": "flight recorder"}},
         {"ph": "M", "name": "process_name", "pid": 3, "tid": 0,
          "args": {"name": "pod latency ledger"}},
+        {"ph": "M", "name": "process_name", "pid": 4, "tid": 0,
+         "args": {"name": "device dispatch"}},
     ]
     trace_tids: Dict[str, int] = {}
     for s in spans:
@@ -388,6 +394,23 @@ def chrome_trace(spans=(), flight=(), ledger: Optional[PodLatencyLedger] = None,
                     "dur": max((t1 - t0) * 1e6, 0.001),
                     "cat": "ledger", "args": args,
                 })
+    for rec in dispatch:
+        # the record's wall stamp is taken as the wait ends; the window
+        # partition (dwell+exec+fetch == wait exactly) walks back from it
+        end_us = float(rec.get("t", 0.0)) * 1e6
+        win = rec.get("window") or {}
+        args = {"program": rec.get("program", "?"),
+                "bucket": rec.get("bucket", "-"),
+                "batchId": rec.get("batchId", "")}
+        for phase in ("fetch", "exec", "dwell"):
+            dur_us = max(float(win.get(phase, 0.0)), 0.0) * 1e6
+            events.append({
+                "name": f"{args['program']}.{phase}", "ph": "X",
+                "pid": 4, "tid": 1,
+                "ts": end_us - dur_us, "dur": max(dur_us, 0.001),
+                "cat": "dispatch", "args": args,
+            })
+            end_us -= dur_us
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 

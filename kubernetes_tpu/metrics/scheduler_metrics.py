@@ -253,6 +253,17 @@ class SchedulerMetrics:
             "Batch flight-recorder events by type.",
             ["type"],
         ))
+        # dispatch profiler (backend/telemetry.py DispatchLedger): the
+        # commit-wait waterfall per program — dwell (submit→exec start,
+        # inferred from the in-flight ring overlap), exec (device run
+        # time), fetch (packed-block device→host transfer)
+        self.device_dispatch_duration = r.register(Histogram(
+            "scheduler_device_dispatch_seconds",
+            "Per-dispatch device-time decomposition by program and phase "
+            "(dwell|exec|fetch).",
+            ["program", "phase"],
+            buckets=exponential_buckets(0.0002, 2, 16),
+        ))
         # multi-tenant admission (SchedulingQuota + QuotaAdmission plugin):
         # the scheduler-side ledger per (namespace, dimension), admission
         # decisions at the gate/Reserve, gated pods woken by targeted

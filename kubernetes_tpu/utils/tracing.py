@@ -188,6 +188,30 @@ def annotate(**attributes) -> None:
     s.attributes.update(attributes)
 
 
+def emit(name: str, start_ns: int, end_ns: int, **attributes) -> None:
+    """Export one ALREADY-FINISHED span with explicit timestamps, parented
+    under this thread's active span (no-op when tracing is disabled — one
+    global read). The dispatch profiler uses this to back-fill the
+    ``device.dispatch.{dwell,exec,fetch}`` waterfall under the still-open
+    ``device.commit.wait`` span: the phases are only known once the
+    blocking wait returns, after their wall-clock windows have passed."""
+    t = _tracer
+    if t is None:
+        return
+    stack = t._stack()
+    if stack:
+        trace_id, parent_id = stack[-1].trace_id, stack[-1].span_id
+    else:
+        trace_id, parent_id = uuid.uuid4().hex, None
+    s = Span(name, trace_id, parent_id, attributes)
+    s.start = int(start_ns)
+    s.end = int(end_ns)
+    try:
+        t.exporter.export(s)
+    except Exception:  # noqa: BLE001 — same never-fail rule as _run_span
+        pass
+
+
 def format_traceparent() -> Optional[str]:
     """W3C traceparent of the active span (``00-<trace_id>-<span_id>-01``),
     or None when tracing is disabled or no span is open. Inject this into a

@@ -140,7 +140,7 @@ class TestDebugEndpoints:
                 "/debug/slices", "/debug/spans", "/debug/circuit",
                 "/debug/sessions", "/debug/fabric", "/debug/flightrecorder",
                 "/debug/quota", "/debug/locktrace", "/debug/ledger",
-                "/debug/timeline"}
+                "/debug/timeline", "/debug/dispatch"}
             # every listed endpoint answers 200 with a JSON body (the
             # index can't name a route the mux doesn't actually serve)
             for ep in json.loads(body)["endpoints"]:

@@ -40,7 +40,7 @@ def test_all_passes_clean_on_real_tree():
                        cwd=REPO, capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, p.stdout + p.stderr
     for name in ("locks", "jit", "errors", "metrics", "spans", "events",
-                 "markers", "pb2-drift", "suppress"):
+                 "dispatch", "markers", "pb2-drift", "suppress"):
         assert f"ok   {name}" in p.stdout, p.stdout
 
 
@@ -143,6 +143,115 @@ def test_events_registry_matches_real_tree():
     assert emitted == declared, (
         f"undeclared: {sorted(emitted - declared)}; "
         f"stale: {sorted(declared - emitted)}")
+
+
+# --------------------------------------------------------------- dispatch
+
+
+_DISPATCH_TELEMETRY = '''
+PROGRAM_NAMES = frozenset({"kernel_prog", "other_prog"})
+'''
+
+_DISPATCH_OPS = '''
+from jax import jit
+
+@jit
+def kernel(x):
+    return x
+
+def warm():
+    return kernel(1)   # same module as the entry: composition, exempt
+'''
+
+DISPATCH_BAD = '''
+from . import telemetry
+from .ops import kernel
+
+def naked(x):
+    return kernel(x)                       # BAD: no dispatch context
+
+def misnamed(x):
+    with telemetry.dispatch("mystery"):    # BAD: undeclared program
+        return kernel(x)
+
+def leaky(x):
+    with telemetry.dispatch("kernel_prog"):
+        def later():
+            return kernel(x)               # BAD: runs after the with exits
+    return later
+'''
+
+DISPATCH_CLEAN = '''
+from jax import jit
+from . import telemetry
+from .ops import kernel
+
+def attributed(x):
+    with telemetry.dispatch("kernel_prog", bucket="8"):
+        out = kernel(x)
+    telemetry.cost_probe("kernel_prog", "8", kernel, (x,))
+    return out
+
+@jit
+def composed(x):
+    return kernel(x)      # traced composition inside another jit entry
+
+def reviewed(x):
+    return kernel(x)  # ktpu: dispatch-ok(warmup outside the profiled path)
+'''
+
+
+def _dispatch_fixture(tmp_path, caller_text):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "ops.py").write_text(_DISPATCH_OPS)
+    (pkg / "callers.py").write_text(caller_text)
+    tel = tmp_path / "telemetry.py"
+    tel.write_text(_DISPATCH_TELEMETRY)
+    return str(pkg), str(tel)
+
+
+def test_dispatch_pass_detects_seeded_violations(tmp_path):
+    pkg, tel = _dispatch_fixture(tmp_path, DISPATCH_BAD)
+    findings = kc.find_unattributed_dispatches(pkg, tel)
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 3, msgs
+    assert "undeclared dispatch program 'mystery'" in msgs
+    assert msgs.count("unattributed dispatch") == 2
+
+
+def test_dispatch_pass_clean_fixture_has_zero_false_positives(tmp_path):
+    pkg, tel = _dispatch_fixture(tmp_path, DISPATCH_CLEAN)
+    assert kc.find_unattributed_dispatches(pkg, tel) == []
+
+
+def test_dispatch_pass_missing_registry_is_a_finding(tmp_path):
+    pkg, _tel = _dispatch_fixture(tmp_path, DISPATCH_CLEAN)
+    tel = tmp_path / "empty.py"
+    tel.write_text("OTHER = 1\n")
+    findings = kc.find_unattributed_dispatches(pkg, str(tel))
+    assert len(findings) == 1 and "PROGRAM_NAMES" in findings[0].message
+
+
+def test_dispatch_registry_matches_real_tree():
+    """Literal program names at real dispatch/cost-probe sites are a subset
+    of PROGRAM_NAMES, and (minus the ledger-only wire program, recorded via
+    record_phases on the client) every declared name is actually used — the
+    attribution vocabulary carries no dead entries."""
+    declared = kc.declared_program_names()
+    used = {prog for _p, _l, prog in kc.dispatch_program_sites()}
+    assert used, "entry-point discovery guard: no dispatch sites found?"
+    assert used <= declared, f"undeclared: {sorted(used - declared)}"
+    assert declared - used == set(), f"stale: {sorted(declared - used)}"
+
+
+def test_dispatch_jit_alias_discovery_covers_assigned_entries():
+    """The alias map sees both decorated entries and `x = jit(f)` bindings
+    on the real tree — the discovery half of the unattributed-call rule."""
+    aliases = kc._jit_entry_aliases(kc.PKG)
+    assert "schedule_batch" in aliases
+    assert any(n.endswith("_jit") or n != "schedule_batch"
+               for n in aliases), aliases
 
 
 # ----------------------------------------------------------------- locks

@@ -108,7 +108,8 @@ def _read(path: str) -> str:
 # ----------------------------------------------------------- suppressions
 
 _SUPPRESS_RE = re.compile(
-    r"#\s*ktpu:\s*(unguarded-ok|host-sync-ok|taxonomy-ok|broad-except-ok)"
+    r"#\s*ktpu:\s*(unguarded-ok|host-sync-ok|taxonomy-ok|broad-except-ok"
+    r"|dispatch-ok)"
     r"\s*\(([^)]*)\)")
 _LOCKED_RE = re.compile(r"#\s*ktpu:\s*locked\b")
 _ANY_MARKER_RE = re.compile(r"#\s*ktpu:\s*([\w-]+)")
@@ -149,7 +150,7 @@ def _suppression_files():
 @register("suppress", "every # ktpu marker is well-formed and carries a reason")
 def pass_suppress(files=None) -> List[Finding]:
     known = {"unguarded-ok", "host-sync-ok", "taxonomy-ok", "broad-except-ok",
-             "locked"}
+             "dispatch-ok", "locked"}
     out: List[Finding] = []
     for path in (files if files is not None else _suppression_files()):
         try:
@@ -308,6 +309,10 @@ def emitted_span_names(pkg: str = None):
                 arg = node.args[0]
             elif node.func.attr == "span_from_remote" and len(node.args) >= 2:
                 arg = node.args[1]
+            elif node.func.attr == "emit" and node.args:
+                # tracing.emit(name, start_ns, end_ns): explicit-timestamp
+                # finished-span export (dispatch-profiler child spans)
+                arg = node.args[0]
             if arg is None:
                 continue
             val, exact = _literal_prefix(arg)
@@ -430,6 +435,159 @@ def find_undeclared_events(pkg: str = None,
                     "telemetry.EVENT_KINDS")
 def pass_events() -> List[Finding]:
     return find_undeclared_events()
+
+
+# ==================================================================== dispatch
+# Device-dispatch attribution lint (the events lint's sibling, PR-17): a
+# jitted entry point invoked OUTSIDE a ``telemetry.dispatch(...)`` context
+# manager produces device time the DispatchLedger can never attribute — it
+# shows up as unexplained commit-wait dwell in the waterfall. Two rules:
+# every literal program name handed to the dispatch/cost-probe family must
+# appear in the declared ``backend/telemetry.py PROGRAM_NAMES`` registry,
+# and every call of a discovered jit entry (tools-wide ``_collect_jit_
+# functions`` — the same discovery the jit pass trusts) must sit lexically
+# under a ``with <...>.dispatch(...)`` block. Exemptions: calls in the
+# entry's own defining module (composition inside the profiled boundary —
+# batch.py assembling schedule_batch from its cores), calls from inside
+# another jit entry (traced composition never blocks on device), and
+# reviewed ``# ktpu: dispatch-ok(reason)`` sites.
+
+_DISPATCH_PROGRAM_ATTRS = ("dispatch", "cost_probe", "dispatch_window",
+                           "dispatch_phases", "record_window",
+                           "record_phases")
+
+
+def declared_program_names(path: str = None) -> Set[str]:
+    """The PROGRAM_NAMES frozenset literal from backend/telemetry.py."""
+    tree = ast.parse(_read(path or TELEMETRY_FILE))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Name) and tgt.id == "PROGRAM_NAMES"):
+            continue
+        return {c.value for c in ast.walk(node.value)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)}
+    return set()
+
+
+def dispatch_program_sites(pkg: str = None) -> List[Tuple[str, int, str]]:
+    """(path, line, program) for every literal program name handed to the
+    dispatch-attribution family (``telemetry.dispatch`` / ``cost_probe`` /
+    ``dispatch_window`` / ``dispatch_phases`` and the DispatchLedger
+    ``record_window`` / ``record_phases`` methods). Non-literal first args
+    are pass-through helpers, checked at their own literal sites."""
+    out: List[Tuple[str, int, str]] = []
+    for path in _walk_py(pkg or PKG):
+        try:
+            tree = ast.parse(_read(path))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _DISPATCH_PROGRAM_ATTRS
+                    and node.args):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                out.append((path, node.lineno, arg.value))
+    return out
+
+
+def _jit_entry_aliases(pkg: str) -> Dict[str, str]:
+    """Every name a jit entry is callable under -> its defining file:
+    decorated function names plus the assignment targets of
+    ``x = jit(f, ...)`` bindings (callers invoke the TARGET name)."""
+    aliases: Dict[str, str] = {}
+    fns, entries, _sites = _collect_jit_functions(pkg)
+    for name in entries:
+        info = fns.get(name)
+        if info is not None:
+            aliases[name] = info.path
+    for path in _walk_py(pkg):
+        try:
+            tree = ast.parse(_read(path))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            if (_callable_name(call.func) == "jit" and call.args
+                    and isinstance(call.args[0], ast.Name)):
+                aliases[node.targets[0].id] = path
+    return aliases
+
+
+def _is_dispatch_with(withnode: ast.With) -> bool:
+    for item in withnode.items:
+        ctx = item.context_expr
+        if (isinstance(ctx, ast.Call) and isinstance(ctx.func, ast.Attribute)
+                and ctx.func.attr == "dispatch"):
+            return True
+    return False
+
+
+def find_unattributed_dispatches(pkg: str = None,
+                                 telemetry_path: str = None) -> List[Finding]:
+    pkg = pkg or PKG
+    declared = declared_program_names(telemetry_path)
+    if not declared:
+        return [Finding(telemetry_path or TELEMETRY_FILE, 0,
+                        "PROGRAM_NAMES registry frozenset not found — the "
+                        "dispatch lint has nothing to check against")]
+    findings = [
+        Finding(path, line,
+                f"undeclared dispatch program {prog!r}: add it to "
+                "backend/telemetry.py PROGRAM_NAMES (the declared device-"
+                "time attribution vocabulary) or rename to a declared one")
+        for path, line, prog in dispatch_program_sites(pkg)
+        if prog not in declared]
+    aliases = _jit_entry_aliases(pkg)
+    entry_names = set(aliases)
+    for path in _walk_py(pkg):
+        src = _read(path)
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        sup = _Suppressions(src)
+
+        def walk(node, in_dispatch, in_entry):
+            if isinstance(node, ast.With) and _is_dispatch_with(node):
+                in_dispatch = True
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a fresh function body is a fresh lexical scope: an
+                # enclosing `with dispatch` does NOT cover calls made when
+                # the nested function runs later
+                in_dispatch = False
+                in_entry = node.name in entry_names
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in entry_names
+                    and not in_dispatch and not in_entry
+                    and aliases[node.func.id] != path
+                    and not sup.silences("dispatch-ok", node.lineno)):
+                findings.append(Finding(
+                    path, node.lineno,
+                    f"unattributed dispatch: jitted entry {node.func.id}() "
+                    "called outside 'with telemetry.dispatch(...)' — its "
+                    "device time lands in no program's ledger; wrap the "
+                    "call or suppress with '# ktpu: dispatch-ok(reason)'"))
+            for child in ast.iter_child_nodes(node):
+                walk(child, in_dispatch, in_entry)
+
+        walk(tree, False, False)
+    return findings
+
+
+@register("dispatch", "jit-entry calls run under telemetry.dispatch with a "
+                      "declared PROGRAM_NAMES program")
+def pass_dispatch() -> List[Finding]:
+    return find_unattributed_dispatches()
 
 
 # ===================================================================== markers

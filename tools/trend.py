@@ -184,6 +184,17 @@ FENCE_TOLERANCES = {
     # lacks the row (pre-slice baselines, or a budget-skipped matrix).
     "workload_slice_wait_p99_s": 200.0,
     "workload_slice_frag_max": 75.0,
+    # dispatch-profiler rows (first recorded r17+): per-batch device time
+    # from the commit-wait waterfall (commit_wait_breakdown, bench.py).
+    # Exec ms/batch is the XLA program's device run time — it tracks the
+    # box's bimodal throughput modes (~2x swings, see the A/A overrides
+    # above), so the fence is one notch looser than commit_ms. Fetch
+    # ms/batch adds the device->host readback, which on CPU is a memcpy
+    # whose cost is mostly scheduling noise — loosest of the family.
+    # check() skips when either round lacks the block (pre-profiler
+    # baselines, or a run with tracing disabled).
+    "device_exec_ms_per_batch": 150.0,
+    "device_fetch_ms_per_batch": 250.0,
 }
 # per-workload overrides for rows whose history is structurally volatile
 # (PreemptionBasic swung 2953 -> 69 -> 243 pods/s across r02-r05 as the
@@ -290,6 +301,16 @@ def fence(current: dict, rounds: Optional[List[dict]] = None) -> dict:
           (current.get("e2e_latency_s") or {}).get("p99"),
           (base.get("e2e_latency_s") or {}).get("p99"),
           tol["e2e_p99_s"], False)
+    # dispatch-profiler waterfall (skip-when-absent: rounds before the
+    # profiler, or runs without span capture, carry no breakdown block)
+    cur_cwb = ((current.get("commit_wait_breakdown") or {})
+               .get("phase_ms_per_batch") or {})
+    base_cwb = ((base.get("commit_wait_breakdown") or {})
+                .get("phase_ms_per_batch") or {})
+    check("device exec ms/batch", cur_cwb.get("exec"), base_cwb.get("exec"),
+          tol["device_exec_ms_per_batch"], False)
+    check("device fetch ms/batch", cur_cwb.get("fetch"), base_cwb.get("fetch"),
+          tol["device_fetch_ms_per_batch"], False)
     cur_wl = current.get("workloads") or {}
     base_wl = base.get("workloads") or {}
     for name in sorted(set(cur_wl) & set(base_wl)):
