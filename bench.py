@@ -429,7 +429,7 @@ MATRIX_ROWS = ("SchedulingPodAntiAffinity", "TopologySpreading",
                "SchedulingNodeAffinity", "PreferredTopologySpreading",
                "MigratedInTreePVs", "PreemptionPVs",
                "SchedulingRequiredPodAntiAffinityWithNSSelector",
-               "SchedulingElastic", "SchedulingSlices")
+               "SchedulingElastic", "SchedulingSlices", "SchedulingReplay")
 
 
 def run_matrix(budget_deadline, platform):
@@ -514,6 +514,20 @@ def run_matrix_child(name: str) -> None:
                     "fallback": it.data["FallbackScheduled"],
                     "wait_p50_s": round(it.data["SliceWaitP50"], 4),
                     "wait_p99_s": round(it.data["SliceWaitP99"], 4),
+                }
+            elif label == "ReplayInvariants":
+                # continuous-rebalancing acceptance evidence (ISSUE 18):
+                # the fence judges packing_eff (higher better) and
+                # tenant_p99_s (a tenant's e2e SLO must not move); wave/
+                # migration counters are judged by eye/tests
+                entry["replay"] = {
+                    "packing_eff": round(it.data["PackingEff"], 4),
+                    "final_entropy": round(it.data["FinalEntropy"], 4),
+                    "tenant_p99_s": round(it.data["TenantP99Max"], 4),
+                    "waves": it.data["Waves"],
+                    "migrations": it.data["Migrations"],
+                    "suspended": it.data["Suspended"],
+                    "pending_at_end": it.data["PendingAtEnd"],
                 }
             elif label == "pod_e2e_duration_seconds" \
                     and it.labels.get("result") == "scheduled":

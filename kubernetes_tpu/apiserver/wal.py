@@ -64,6 +64,13 @@ class WriteAheadLog:
         self._f = open(path, "a", encoding="utf-8")
         self.records_appended = 0
         self.lines_written = 0  # group commits: lines << records
+        # auto-compaction trigger (KTPU_WAL_COMPACT_LINES, default off):
+        # once this many lines accumulate past the last snapshot, the next
+        # housekeeping ``maybe_compact`` folds them into path + '.snap' —
+        # bounding restart replay time under long-lived churn
+        self.compact_lines = int(
+            os.environ.get("KTPU_WAL_COMPACT_LINES", "0") or 0)
+        self._lines_at_compact = 0
 
     # ------------------------------------------------------------- appending
 
@@ -156,7 +163,21 @@ class WriteAheadLog:
             with self._lock:
                 self._f.close()
                 self._f = open(self.path, "w", encoding="utf-8")  # truncate
+                self._lines_at_compact = self.lines_written
         return len(objs)
+
+    def maybe_compact(self, store) -> bool:
+        """Housekeeping hook: snapshot-compact once the log has grown
+        ``compact_lines`` lines past the last compaction. Default off
+        (threshold 0) — opt in via KTPU_WAL_COMPACT_LINES."""
+        if self.compact_lines <= 0:
+            return False
+        with self._lock:
+            grown = self.lines_written - self._lines_at_compact
+        if grown < self.compact_lines:
+            return False
+        self.snapshot(store)
+        return True
 
 
 def _parse_line(line: str) -> Optional[dict]:

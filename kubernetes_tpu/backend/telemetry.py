@@ -93,6 +93,10 @@ EVENT_KINDS = frozenset({
     # dispatch profiler: server-echoed device time attributed by the wire
     # client against its own transport dwell
     "wire_device_time",
+    # continuous rebalancing (controllers/rebalance.py): executed migration
+    # waves, the SLO-guardrail breaker tripping open, and its half-open
+    # probe healing the suspension
+    "rebalance_wave", "rebalance_suspended", "rebalance_resume",
 })
 
 # The declared dispatch-program registry. Every LITERAL program name the
@@ -110,6 +114,7 @@ PROGRAM_NAMES = frozenset({
     # ledger-only program: client-side attribution of a wire batch (the
     # record is fed from the server's echoed deviceTime, not a local jit)
     "wire_schedule_batch",
+    "packing_entropy",  # whole-cluster packing scorer (controllers/rebalance.py)
 })
 
 

@@ -277,6 +277,10 @@ class TPUScheduler(Scheduler):
         from .claim_mask import ClaimMaskBuilder
 
         self._claim_masks = ClaimMaskBuilder(self.store)
+        # continuous rebalancing (controllers/rebalance.py): opt-in via
+        # enable_rebalancer(); driven from _periodic_housekeeping so it
+        # only ever runs on the scheduling thread, in commit-idle gaps
+        self.rebalancer = None
 
     def _relay_state_change(self, _old: str, new: str) -> None:
         """Relay breaker transition: publish the circuit gauge and accrue
@@ -600,6 +604,20 @@ class TPUScheduler(Scheduler):
                 and not self.commit_worker.idle()):
             self.commit_worker.flush()
         super()._periodic_housekeeping(now)
+        if self.rebalancer is not None:
+            # after the sweep (settled ledgers), gated internally on the
+            # score interval + commit-plane idleness
+            self.rebalancer.maybe_run(now)
+
+    def enable_rebalancer(self, **kwargs):
+        """Attach the background Rebalancer (controllers/rebalance.py) —
+        a second consumer of the device backend, scored and executed from
+        housekeeping's idle gaps. Returns it for knob access."""
+        from ..controllers.rebalance import Rebalancer
+
+        self.rebalancer = Rebalancer(self, now_fn=kwargs.pop(
+            "now_fn", self.now_fn), **kwargs)
+        return self.rebalancer
 
     def _maybe_profile(self) -> None:
         """Start/stop a JAX profiler capture window over the first N batch

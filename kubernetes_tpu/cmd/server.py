@@ -298,6 +298,10 @@ def build_debug_handlers(sched) -> dict:
                           recorder events + ledger pod segments + the
                           dispatch profiler's device track on one
                           wall-clock axis, batchId/pod-UID correlated
+      /debug/rebalance    continuous-rebalancing state: trigger band +
+                          current packing score, wave budget, SLO breaker,
+                          recent migration waves, pending uncordons
+                          (enabled=False without an attached Rebalancer)
 
     Every handler takes an entry cap (``?limit=N`` on the mux, default
     DEFAULT_DEBUG_LIMIT) so a 5k-node dump stays bounded.
@@ -467,6 +471,12 @@ def build_debug_handlers(sched) -> dict:
             return {"enabled": False}
         return led.dump(limit)
 
+    def rebalance_dump(limit=None):
+        rb = getattr(sched, "rebalancer", None)
+        if rb is None:
+            return {"enabled": False}
+        return rb.debug_dump(limit)
+
     def timeline_dump(limit=None):
         """One Chrome trace-event JSON body unifying the span tail, the
         flight-recorder ring, and the latency ledger's pod segments —
@@ -491,7 +501,7 @@ def build_debug_handlers(sched) -> dict:
             "flightrecorder": flightrecorder_dump, "quota": quota_dump,
             "dispatch": dispatch_dump,
             "locktrace": locktrace_dump, "ledger": ledger_dump,
-            "timeline": timeline_dump}
+            "timeline": timeline_dump, "rebalance": rebalance_dump}
 
 
 def setup(store: ClusterStore, cfg: Optional[KubeSchedulerConfiguration] = None,

@@ -70,6 +70,12 @@ class DrainOrchestrator:
         self.recreate = recreate
         self.waves = 0
         self.evicted = 0
+        # migrate-then-reopen ledger: waves drained with ``uncordon_after=``
+        # park here until every evicted pod has re-bound (or left the
+        # store), at which point ``poll_pending_uncordons`` reopens the
+        # nodes — uncordoning synchronously would just re-land the victims
+        # on the node the wave was trying to empty
+        self.pending_uncordons: List[Dict] = []
 
     # ------------------------------------------------------------- cordon
 
@@ -186,9 +192,19 @@ class DrainOrchestrator:
     # ------------------------------------------------------------- waves
 
     def drain_wave(self, node_names: Iterable[str],
-                   gang_aware: bool = True) -> Dict[str, int]:
+                   gang_aware: bool = True,
+                   allow_fn=None,
+                   uncordon_after: bool = False) -> Dict[str, int]:
         """One rolling-upgrade wave: cordon every node in the window, then
-        evict its bound pods (whole gangs when ``gang_aware``)."""
+        evict its bound pods (whole gangs when ``gang_aware``).
+
+        ``allow_fn`` is a per-pod disruption gate (``_pdb_disruption_gate``
+        shape): a gang is evicted only if EVERY member passes — charging
+        the budget per member — so the gate can never tear a gang.
+        ``uncordon_after=True`` registers the wave for migrate-then-reopen:
+        the nodes stay cordoned until every evicted pod has re-bound
+        elsewhere (or left the store), then ``poll_pending_uncordons``
+        reopens them."""
         from ..framework.plugins.coscheduling import pod_group_key
 
         names = [n for n in node_names if n in self.store.nodes]
@@ -198,6 +214,8 @@ class DrainOrchestrator:
                    if p.spec.node_name in names]
         if gang_aware:
             victims = self._gang_closure(victims)
+        if allow_fn is not None:
+            victims = self._gate_whole_gangs(victims, allow_fn)
         gangs = len({pod_group_key(p) for p in victims} - {None})
         # slice-atomic by construction: the whole-gang closure means a drain
         # touching ONE host of a placed slice gang evicts every member, so
@@ -208,8 +226,55 @@ class DrainOrchestrator:
         slice_gangs = len({pod_group_key(p) for p in victims
                            if is_slice_pod(p)} - {None})
         evicted = self._evict(victims, "drain")
+        if uncordon_after:
+            self.pending_uncordons.append({
+                "nodes": list(names), "pods": list(evicted),
+                "since": self.now_fn()})
         return self._wave_done("drain", len(names), evicted, gangs,
                                slice_gangs=slice_gangs)
+
+    def _gate_whole_gangs(self, victims: List[Pod], allow_fn) -> List[Pod]:
+        """Apply a disruption gate gang-atomically: group the eviction set
+        by gang, admit a group only when allow_fn passes every member (solo
+        pods are groups of one). Members are charged in order, so a
+        rejected group has already spent budget on its earlier members —
+        acceptable: the gate is conservative, never over-budget."""
+        from ..framework.plugins.coscheduling import pod_group_key
+
+        groups: Dict[object, List[Pod]] = {}
+        for p in victims:
+            groups.setdefault(pod_group_key(p) or p.key(), []).append(p)
+        out: List[Pod] = []
+        for members in groups.values():
+            if all(allow_fn(p) for p in members):
+                out.extend(members)
+        return out
+
+    def poll_pending_uncordons(self) -> List[str]:
+        """Complete migrate-then-reopen waves: a pending wave whose evicted
+        pods have ALL re-bound (to a node outside the wave) or left the
+        store gets its nodes uncordoned. Returns the nodes reopened by this
+        poll. Crash-safe by construction: a lost orchestrator just leaves
+        nodes cordoned — an operator-visible, zero-data-loss degradation."""
+        reopened: List[str] = []
+        still: List[Dict] = []
+        for wave in self.pending_uncordons:
+            done = True
+            for key in wave["pods"]:
+                pod = self.store.get_pod(key)
+                if pod is not None and (
+                        not pod.spec.node_name
+                        or pod.spec.node_name in wave["nodes"]):
+                    done = False
+                    break
+            if done:
+                for name in wave["nodes"]:
+                    if self.uncordon(name):
+                        reopened.append(name)
+            else:
+                still.append(wave)
+        self.pending_uncordons = still
+        return reopened
 
     def drain_superpod(self, superpod: int,
                        gang_aware: bool = True) -> Dict[str, int]:

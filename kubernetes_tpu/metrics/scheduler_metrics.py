@@ -364,6 +364,34 @@ class SchedulerMetrics:
             "first; nonzero means e2e attribution lost pods).",
         ))
 
+        # continuous rebalancing (controllers/rebalance.py): the background
+        # descheduler's control-loop evidence — executed/empty/suspended
+        # wave outcomes, total pods migrated, the packing-entropy score the
+        # trigger band watches (1.0 = load smeared evenly over every node,
+        # ->0 = consolidated), and whether the SLO guardrail breaker
+        # currently has rebalancing suspended (0/1).
+        self.rebalance_waves = r.register(Counter(
+            "scheduler_rebalance_waves_total",
+            "Rebalance wave attempts by outcome (executed / empty / "
+            "suspended).",
+            ["result"],
+        ))
+        self.rebalance_migrations = r.register(Counter(
+            "scheduler_rebalance_migrations_total",
+            "Pods evicted by rebalance migration waves (each re-binds via "
+            "the normal requeue path).",
+        ))
+        self.packing_entropy = r.register(Gauge(
+            "scheduler_packing_entropy",
+            "Mean normalized bin-packing entropy over live resource axes "
+            "(the rebalance trigger's score; lower is better packed).",
+        ))
+        self.rebalance_suspended = r.register(Gauge(
+            "scheduler_rebalance_suspended",
+            "1 while the tenant-SLO guardrail breaker holds rebalancing "
+            "suspended, else 0.",
+        ))
+
         # unschedulable_pods bookkeeping: gauge value = number of pods
         # CURRENTLY unschedulable attributed to each (plugin, profile); a
         # pod's attribution is replaced on every failed attempt and removed

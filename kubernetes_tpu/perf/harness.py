@@ -981,6 +981,186 @@ class Runner:
             data=invariants, unit="", labels={"Name": "SoakInvariants"}))
         return invariants
 
+    # ---- trace-replay phase (continuous rebalancing) ----
+
+    def replay_phase(self, rounds: int = 12, mix=(), curve=(),
+                     bursts=None, shift_round: Optional[int] = None,
+                     churn_frac: float = 0.25, cycles_per_round: int = 40,
+                     tick_s: float = 0.0, label: str = "SchedulingReplay",
+                     rebalance=None,
+                     collector_interval: float = 1.0) -> Dict[str, float]:
+        """replayPhase op — a compressed production trace: per round every
+        ``mix`` entry lands ``count * curve[r] * burst`` arrivals (diurnal
+        ``curve`` multipliers cycle; ``bursts = {round: mult}`` scripts
+        storm rounds), ``shift_round`` rotates the tenants' counts (the
+        tenant-mix shift), and ``churn_frac`` of each tenant's bound pods
+        churn away per round — the fragmentation generator the rebalancer
+        exists to fight. ``rebalance`` (False/None = off, True or a knob
+        dict = on) attaches a Rebalancer and drives it every cycle.
+
+        Evidence out: SchedulingThroughput (under the workload label); one
+        ``ReplayTenant`` item per namespace with the registry-read e2e
+        p50/p99 over the phase; one ``ReplayInvariants`` item — packing
+        efficiency over time (mean 1-entropy over the steady-state second
+        half, scored off store truth so oracle/tpu rows compare), final
+        entropy/frag, the max tenant p99, and the rebalancer's wave/
+        migration/suspension counters. Assertions live in the tests and
+        trend fences — the harness measures."""
+        from ..controllers.rebalance import Rebalancer, score_from_snapshot
+
+        quota_plugin = self._quota_plugin()
+        sched = self.scheduler
+        self._enable_ledger()
+        tenants = sorted({str(m["namespace"]) for m in mix})
+        tenant_hist = sched.smetrics.tenant_e2e_duration
+        tenant_snaps = {ns: tenant_hist.snapshot(ns) for ns in tenants}
+        bound_seen = {p.key() for p in self.store.pods.values()
+                      if p.spec.node_name}
+        replay_bound: Dict[str, List[str]] = {ns: [] for ns in tenants}
+        curve = tuple(curve) or (0.4, 0.7, 1.0, 1.4, 1.6, 1.3, 0.9, 0.5)
+        bursts = dict(bursts or {})
+        base_counts = [int(m["count"]) for m in mix]
+
+        rb: Optional[Rebalancer] = None
+        if rebalance:
+            kw = dict(rebalance) if isinstance(rebalance, dict) else {}
+            if hasattr(sched, "enable_rebalancer"):
+                rb = sched.enable_rebalancer(now_fn=self.now_fn, **kw)
+            else:
+                rb = Rebalancer(sched, now_fn=self.now_fn, **kw)
+                sched.rebalancer = rb  # debug-surface parity
+
+        def note_new_bindings() -> None:
+            for p in self.store.pods.values():
+                if not p.spec.node_name or p.key() in bound_seen:
+                    continue
+                bound_seen.add(p.key())
+                ns = p.meta.namespace
+                if ns in replay_bound:
+                    replay_bound[ns].append(p.key())
+
+        def drive_cycle() -> bool:
+            if self.backend in ("tpu", "wire", "grpc"):
+                return sched.schedule_batch_cycle() > 0
+            return sched.schedule_one()
+
+        def sample_packing() -> Optional[Dict[str, float]]:
+            sched.cache.update_snapshot(sched.snapshot)
+            return score_from_snapshot(sched)
+
+        col = ThroughputCollector(
+            lambda: sched.metrics["scheduled"], interval=collector_interval)
+        col.start(time.monotonic())
+        tick = getattr(self.now_fn, "advance", None) if tick_s else None
+        entropies: List[float] = []
+
+        for r in range(rounds):
+            counts = list(base_counts)
+            if shift_round is not None and r >= shift_round:
+                counts = counts[1:] + counts[:1]  # the tenant-mix shift
+            mult = curve[r % len(curve)] * float(bursts.get(r, 1.0))
+            for mi, m in enumerate(mix):
+                params = {k: v for k, v in m.items()
+                          if k not in ("count", "every")}
+                prefix = f"{m.get('prefix', params['namespace'])}-m{mi}r{r}"
+                params.pop("prefix", None)
+                n_arrive = int(round(counts[mi] * mult))
+                gs = int(params.get("gang_size") or 0)
+                if gs:
+                    # a partial gang can never reach quorum and would park in
+                    # the queue forever — round arrivals down to whole gangs
+                    n_arrive -= n_arrive % gs
+                for j in range(n_arrive):
+                    p = self._make_pod(
+                        prefix, dict(params, _gang_ordinal=j)
+                        if params.get("gang_size") else params)
+                    self.store.create_pod(p)
+                    self._pod_counter += 1
+            self._pump_dra()
+            for _c in range(cycles_per_round):
+                progressed = drive_cycle()
+                if tick is not None:
+                    tick(tick_s)
+                note_new_bindings()
+                if rb is not None:
+                    rb.maybe_run(self.now_fn())
+                col.maybe_sample(time.monotonic())
+                if not progressed:
+                    sched.queue.flush_backoff_completed()
+                    if len(sched.queue) == 0 and (
+                            rb is None or not rb.drain.pending_uncordons):
+                        break
+            if churn_frac > 0.0:
+                for ns in tenants:
+                    keys = replay_bound[ns]
+                    n_churn = int(len(keys) * churn_frac)
+                    for key in keys[:n_churn]:
+                        if self.store.get_pod(key) is not None:
+                            self.store.delete_pod(key)
+                    replay_bound[ns] = keys[n_churn:]
+                note_new_bindings()
+            score = sample_packing()
+            if score is not None:
+                entropies.append(score["entropy"])
+        drain = getattr(sched, "_drain_inflight", None)
+        if drain is not None:
+            drain()
+        # trace over: settle the tail (bounded) so in-flight migration
+        # waves finish — evicted pods re-bind and their cordons reopen.
+        # No maybe_run here: the trace ended, no NEW waves start.
+        for _c in range(cycles_per_round):
+            progressed = drive_cycle()
+            if tick is not None:
+                tick(tick_s)
+            note_new_bindings()
+            if rb is not None:
+                rb.drain.poll_pending_uncordons()
+            if not progressed:
+                sched.queue.flush_backoff_completed()
+                if len(sched.queue) == 0 and (
+                        rb is None or not rb.drain.pending_uncordons):
+                    break
+        note_new_bindings()
+        col.finish(time.monotonic())
+
+        final = sample_packing() or {"entropy": 0.0, "frag_max": 0.0}
+        steady = entropies[len(entropies) // 2:] or [final["entropy"]]
+        packing_eff = 1.0 - sum(steady) / len(steady)
+        summary = col.summary()
+        self.data_items.append(DataItem(
+            data=summary, unit="pods/s", labels={"Name": label}))
+        p99s: List[float] = []
+        for ns in tenants:
+            snap = tenant_snaps[ns]
+            p99 = tenant_hist.percentile_since(snap, 0.99, ns)
+            if tenant_hist.count_since(snap, ns):
+                p99s.append(p99)
+            weight = (quota_plugin.weight_for(ns)
+                      if quota_plugin is not None else None)
+            self.data_items.append(DataItem(
+                data={"Weight": float(weight or 0.0),
+                      "E2eP50": tenant_hist.percentile_since(snap, 0.50, ns),
+                      "E2eP99": p99,
+                      "E2eCount": float(tenant_hist.count_since(snap, ns))},
+                unit="", labels={"Name": "ReplayTenant", "namespace": ns}))
+        pending = sched.queue.pending_pods()
+        invariants = {
+            "PackingEff": float(packing_eff),
+            "FinalEntropy": float(final["entropy"]),
+            "FinalFrag": float(final["frag_max"]),
+            "TenantP99Max": float(max(p99s, default=0.0)),
+            "Waves": float(rb.waves_executed if rb is not None else 0.0),
+            "Migrations": float(rb.migrations if rb is not None else 0.0),
+            "Suspended": float(1.0 if rb is not None and rb.suspended
+                               else 0.0),
+            "PendingUncordons": float(len(rb.drain.pending_uncordons)
+                                      if rb is not None else 0.0),
+            "PendingAtEnd": float(sum(pending.values())),
+        }
+        self.data_items.append(DataItem(
+            data=invariants, unit="", labels={"Name": "ReplayInvariants"}))
+        return invariants
+
     # ---- elastic-cluster phase ----
 
     def elastic_phase(self, rounds: int = 6, mix=(), storm_frac: float = 0.3,
@@ -1189,6 +1369,8 @@ class Runner:
                 self.soak_phase(**kwargs)
             elif kind == "collectSliceStats":
                 self.collect_slice_stats(**kwargs)
+            elif kind == "replayPhase":
+                self.replay_phase(**kwargs)
             elif kind == "elasticPhase":
                 # remember the node shape for storm replacements
                 self._elastic_node_params = dict(kwargs.pop("node_params", {})

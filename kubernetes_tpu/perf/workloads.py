@@ -551,6 +551,49 @@ def scheduling_elastic(nodes=1000, rounds=6, pods_per_round=150,
     }
 
 
+def scheduling_replay(nodes=500, rounds=16, scale=20, cycles_per_round=120,
+                      churn_frac=0.3, tick_s=0.05, gangs=True,
+                      rebalance=True, shift=True, bursts=True) -> dict:
+    """SchedulingReplay — the continuous-rebalancing trace replay (ROADMAP
+    item 3): three quota tenants ride a compressed diurnal arrival curve
+    with scripted burst storms and a mid-trace tenant-mix shift, while
+    per-round churn smears the load thin across the cluster — exactly the
+    decay one-shot placement suffers in production. With ``rebalance`` on,
+    the SLO-guarded Rebalancer runs its migration waves in the gaps;
+    the ReplayInvariants DataItem carries packing-efficiency-over-time,
+    final entropy/frag, and the max tenant e2e p99 — the "packing improves
+    AND no tenant loses its p99" acceptance trend.py fences."""
+    base = {"req": {"cpu": "100m", "memory": "500Mi"}}
+    ops = [{"opcode": "createNodes", "count": nodes, "zones": 10,
+            "capacity": {"cpu": "4", "memory": "16Gi", "pods": 32}}]
+    mix = []
+    for ns, w in SOAK_TENANTS:
+        # caps sized so quota NEVER binds even after the mid-trace mix
+        # shift triples the lightest tenant's arrivals: quota pressure is
+        # the soak's acceptance, not this one — here the quotas exist to
+        # label tenants for the e2e SLO histograms the guardrail watches
+        ops.append({"opcode": "createQuota", "namespace": ns, "weight": w,
+                    "hard": {"pods": (w + 2) * scale * 12,
+                             "requests.cpu": (w + 2) * scale * 12000}})
+        mix.append({"namespace": ns, "count": max(w * scale // 2, 2), **base})
+    if gangs:
+        # whole gangs only: replay_phase rounds gang arrivals down to a
+        # multiple of gang_size, so keep the base count a multiple too
+        mix.append({"namespace": "soak-a", "count": 8, "gang_size": 4,
+                    "prefix": "gang", **base})
+    ops.append({"opcode": "replayPhase", "rounds": rounds, "mix": mix,
+                "churn_frac": churn_frac, "cycles_per_round": cycles_per_round,
+                "tick_s": tick_s,
+                "bursts": ({rounds // 4: 2.5, (3 * rounds) // 4: 2.0}
+                           if bursts else None),
+                "shift_round": (rounds // 2 if shift else None),
+                "rebalance": (rebalance if isinstance(rebalance, dict)
+                              else {"cooldown_s": 2.0, "score_interval_s": 0.5,
+                                    "entropy_high": 0.85, "entropy_low": 0.70}
+                              if rebalance else None)})
+    return {"name": f"SchedulingReplay/{nodes}Nodes", "ops": ops}
+
+
 TEST_CASES = {
     "SchedulingBasic": scheduling_basic,
     "SchedulingPodAntiAffinity": scheduling_pod_anti_affinity,
@@ -563,6 +606,7 @@ TEST_CASES = {
     "SchedulingDRA": scheduling_dra,
     "SchedulingElastic": scheduling_elastic,
     "SchedulingGangs": scheduling_gangs,
+    "SchedulingReplay": scheduling_replay,
     "SchedulingSlices": scheduling_slices,
     "SchedulingSoak": scheduling_soak,
     "MixedSchedulingBasePod": mixed_scheduling_base_pod,

@@ -628,6 +628,12 @@ class Scheduler:
             nodes, pods, assumed = self.cache.stats()
             self.smetrics.sync_cache_gauges(nodes, pods, assumed)
             self.smetrics.goroutines.set("binding", value=len(self.waiting_pods))
+            # WAL auto-compaction rides the 1s sweep: a durable store whose
+            # log outgrew KTPU_WAL_COMPACT_LINES folds it into a snapshot
+            # (no-op without an attached WAL or with the default-off gate)
+            wal = getattr(self.store, "_wal", None)
+            if wal is not None:
+                wal.maybe_compact(self.store)
         if now - self._last_unsched_flush >= 30.0:
             self._last_unsched_flush = now
             self.queue.flush_unschedulable_left_over()

@@ -140,7 +140,7 @@ class TestDebugEndpoints:
                 "/debug/slices", "/debug/spans", "/debug/circuit",
                 "/debug/sessions", "/debug/fabric", "/debug/flightrecorder",
                 "/debug/quota", "/debug/locktrace", "/debug/ledger",
-                "/debug/timeline", "/debug/dispatch"}
+                "/debug/timeline", "/debug/dispatch", "/debug/rebalance"}
             # every listed endpoint answers 200 with a JSON body (the
             # index can't name a route the mux doesn't actually serve)
             for ep in json.loads(body)["endpoints"]:
@@ -150,6 +150,11 @@ class TestDebugEndpoints:
 
             # latency ledger off by default: the disabled report
             status, body = _get(port, "/debug/ledger")
+            assert status == 200
+            assert json.loads(body) == {"enabled": False}
+
+            # no Rebalancer attached on a plain oracle app: disabled report
+            status, body = _get(port, "/debug/rebalance")
             assert status == 200
             assert json.loads(body) == {"enabled": False}
 

@@ -1,0 +1,396 @@
+"""Continuous rebalancing — the SLO-guarded descheduler (ISSUE 18).
+
+Unit tier: the packing-entropy scorer's bounds, the hysteresis trigger
+band, per-wave migration budget + cooldown, migrate-then-reopen
+(``uncordon_after``) completion, gang-atomic disruption gating, the SLO
+guardrail breaker's trip/probe/heal ladder, and the ``/debug/rebalance``
+dump shape.
+
+Acceptance tier (ISSUE 18): the SchedulingReplay trace (diurnal curve,
+burst storms, tenant-mix shift, churn) run A/B with rebalancing on vs
+off on a FakeClock — post-churn packing efficiency must be measurably
+better with the Rebalancer on while every tenant's e2e p99 stays within
+the trend.py fence tolerance of the off run.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubernetes_tpu.controllers.rebalance import (
+    Rebalancer, packing_entropy, score_from_snapshot)
+from kubernetes_tpu.perf import TEST_CASES, run_workload
+from kubernetes_tpu.perf.harness import Runner
+from kubernetes_tpu.utils.clock import FakeClock
+
+
+def _runner(nodes=6, clock=None):
+    clock = clock or FakeClock()
+    r = Runner(backend="oracle", now_fn=clock)
+    r.create_nodes(count=nodes, zones=2,
+                   capacity={"cpu": "4", "memory": "16Gi", "pods": 16})
+    return r, clock
+
+
+def _spawn(r, n, ns="default", prefix="rb", gang_size=0):
+    """Create n pods (optionally gang members) and return their keys."""
+    base = {"namespace": ns, "req": {"cpu": "200m", "memory": "512Mi"}}
+    keys = []
+    for j in range(n):
+        params = (dict(base, gang_size=gang_size, _gang_ordinal=j)
+                  if gang_size else dict(base))
+        p = r._make_pod(prefix, params)
+        r.store.create_pod(p)
+        r._pod_counter += 1
+        keys.append(p.key())
+    return keys
+
+
+def _settle(r, budget=600):
+    sched = r.scheduler
+    for _ in range(budget):
+        if not sched.schedule_one():
+            sched.queue.flush_backoff_completed()
+            if len(sched.queue) == 0:
+                break
+    sched.cache.update_snapshot(sched.snapshot)
+
+
+def _smear(r, keep_every=3):
+    """Delete all but every ``keep_every``-th bound pod — the post-churn
+    thin smear a week of elastic arrivals leaves behind."""
+    bound = [p for p in r.store.pods.values() if p.spec.node_name]
+    for i, p in enumerate(bound):
+        if i % keep_every:
+            r.store.delete_pod(p.key())
+    r.scheduler.cache.update_snapshot(r.scheduler.snapshot)
+
+
+class TestPackingEntropy:
+    def test_even_spread_scores_one(self):
+        req = jnp.full((8, 4), 10.0, jnp.float32)
+        valid = jnp.ones(8, bool)
+        mean, per_axis = packing_entropy(req, valid)
+        assert float(mean) == pytest.approx(1.0, abs=1e-5)
+        assert np.allclose(np.asarray(per_axis), 1.0, atol=1e-5)
+
+    def test_consolidated_scores_zero(self):
+        req = np.zeros((8, 4), np.float32)
+        req[3] = 10.0  # everything on one node
+        mean, _ = packing_entropy(jnp.asarray(req), jnp.ones(8, bool))
+        assert float(mean) == pytest.approx(0.0, abs=1e-5)
+
+    def test_dead_axes_excluded_from_mean(self):
+        req = np.full((8, 4), 10.0, np.float32)
+        req[:, 2] = 0.0  # nobody requests ephemeral: dead axis
+        mean, per_axis = packing_entropy(jnp.asarray(req), jnp.ones(8, bool))
+        assert float(mean) == pytest.approx(1.0, abs=1e-5)
+        assert float(np.asarray(per_axis)[2]) == 0.0
+
+    def test_invalid_rows_ignored(self):
+        req = np.full((8, 4), 10.0, np.float32)
+        valid = np.ones(8, bool)
+        valid[4:] = False
+        req[4:] = 77.0  # garbage on invalid rows must not matter
+        mean, _ = packing_entropy(jnp.asarray(req), jnp.asarray(valid))
+        assert float(mean) == pytest.approx(1.0, abs=1e-5)
+
+
+class TestTriggerBand:
+    def test_hysteresis_arm_and_disarm(self):
+        r, _ = _runner(nodes=2)
+        try:
+            rb = Rebalancer(r.scheduler, entropy_high=0.9, entropy_low=0.7,
+                            frag_high=0.6, frag_low=0.4)
+            s = {"entropy": 0.85, "frag_max": 0.0}
+            rb._update_trigger(s)
+            assert not rb.armed  # below high water: never arms
+            rb._update_trigger({"entropy": 0.95, "frag_max": 0.0})
+            assert rb.armed  # crossed high water
+            rb._update_trigger({"entropy": 0.75, "frag_max": 0.0})
+            assert rb.armed  # inside the band: hysteresis holds the arm
+            rb._update_trigger({"entropy": 0.65, "frag_max": 0.0})
+            assert not rb.armed  # below low water on every axis: disarm
+        finally:
+            r.close()
+
+    def test_frag_axis_arms_independently(self):
+        r, _ = _runner(nodes=2)
+        try:
+            rb = Rebalancer(r.scheduler)
+            rb._update_trigger({"entropy": 0.1, "frag_max": 0.9})
+            assert rb.armed
+            # frag recovered but entropy band not crossed low: stays armed
+            rb._update_trigger({"entropy": 0.81, "frag_max": 0.0})
+            assert rb.armed
+        finally:
+            r.close()
+
+
+class TestMigrationWaves:
+    def _armed_rb(self, r, clock, **kw):
+        kw.setdefault("entropy_high", 0.05)  # any real spread arms
+        kw.setdefault("entropy_low", 0.01)
+        kw.setdefault("score_interval_s", 0.0)
+        kw.setdefault("cooldown_s", 5.0)
+        return Rebalancer(r.scheduler, now_fn=clock, **kw)
+
+    def test_wave_respects_migration_budget_and_cooldown(self):
+        r, clock = _runner(nodes=6)
+        try:
+            _spawn(r, 24)
+            _settle(r)
+            _smear(r)
+            rb = self._armed_rb(r, clock, max_migrations_per_wave=3)
+            out = rb.maybe_run(clock())
+            assert out["ran"], out
+            assert 0 < out["wave"]["evicted"] <= 3
+            assert rb.waves_executed == 1
+            assert rb.migrations == out["wave"]["evicted"]
+            # victims are cordoned until their pods re-bind elsewhere
+            assert rb.drain.pending_uncordons
+            for name in rb.last_waves[-1]["nodes"]:
+                assert r.store.nodes[name].spec.unschedulable
+            # second tick inside the cooldown: no second wave
+            out2 = rb.maybe_run(clock())
+            assert not out2["ran"] and out2["reason"] == "cooldown"
+            m = r.scheduler.smetrics
+            assert m.rebalance_waves.labels("executed") == 1.0
+            assert m.rebalance_migrations.labels() == float(rb.migrations)
+            assert m.packing_entropy.labels() > 0.0
+        finally:
+            r.close()
+
+    def test_densest_node_never_a_victim(self):
+        r, clock = _runner(nodes=6)
+        try:
+            _spawn(r, 24)
+            _settle(r)
+            _smear(r)
+            sched = r.scheduler
+            by_occ = sorted(
+                (ni for ni in sched.snapshot.list() if ni.pods),
+                key=lambda ni: len(ni.pods))
+            densest = by_occ[-1].node.meta.name
+            rb = self._armed_rb(r, clock, max_migrations_per_wave=100)
+            victims = rb._pick_victims()
+            assert victims and densest not in victims
+        finally:
+            r.close()
+
+    def test_uncordon_after_waits_for_rebind(self):
+        r, clock = _runner(nodes=6)
+        try:
+            spawned = _spawn(r, 24)
+            _settle(r)
+            _smear(r)
+            alive = [k for k in spawned if r.store.get_pod(k) is not None]
+            rb = self._armed_rb(r, clock, max_migrations_per_wave=4)
+            out = rb.maybe_run(clock())
+            assert out["ran"]
+            wave_nodes = list(rb.last_waves[-1]["nodes"])
+            # evicted pods are back in the queue; nodes stay cordoned while
+            # any of them is still unbound
+            assert rb.drain.poll_pending_uncordons() == []
+            _settle(r)  # re-binds land elsewhere: victims are cordoned
+            reopened = rb.drain.poll_pending_uncordons()
+            assert sorted(reopened) == sorted(wave_nodes)
+            assert not rb.drain.pending_uncordons
+            for name in wave_nodes:
+                assert not r.store.nodes[name].spec.unschedulable
+            # zero lost, zero double-bound: every pre-wave pod is bound
+            # exactly once, and never onto a wave node it was evicted from
+            for k in alive:
+                pod = r.store.get_pod(k)
+                assert pod is not None and pod.spec.node_name
+                assert pod.spec.node_name not in wave_nodes
+        finally:
+            r.close()
+
+    def test_gang_atomic_disruption_gate(self):
+        r, clock = _runner(nodes=4)
+        try:
+            keys = _spawn(r, 4, prefix="gangrb", gang_size=4)
+            _settle(r)
+            pods = [r.store.get_pod(k) for k in keys]
+            assert all(p is not None and p.spec.node_name for p in pods)
+            rb = self._armed_rb(r, clock)
+            # a gate that rejects ONE member must withhold the whole gang
+            victim = pods[0].meta.name
+            gated = rb.drain._gate_whole_gangs(
+                pods, lambda p: p.meta.name != victim)
+            assert gated == []
+            assert rb.drain._gate_whole_gangs(pods, lambda p: True) == pods
+        finally:
+            r.close()
+
+
+class TestSLOGuardrail:
+    def _tripped(self, r, clock):
+        """Arm a watch on tenant t1, then regress its p99 hard."""
+        rb = Rebalancer(r.scheduler, now_fn=clock, breaker_threshold=1,
+                        probe_interval_s=60.0, slo_min_samples=5)
+        hist = r.scheduler.smetrics.tenant_e2e_duration
+        for _ in range(10):
+            hist.observe(0.01, "t1")
+        rb._arm_slo_watch()
+        assert "t1" in rb._slo_watch
+        rb.waves_executed = 1  # guardrail only judges after a real wave
+        for _ in range(10):
+            hist.observe(5.0, "t1")
+        rb._judge_slo()
+        return rb, hist
+
+    def test_regression_trips_breaker_open(self):
+        r, clock = _runner(nodes=2)
+        try:
+            rb, _ = self._tripped(r, clock)
+            assert rb.suspended
+            assert rb.breaker.dump()["state"] == "open"
+            assert r.scheduler.smetrics.rebalance_suspended.labels() == 1.0
+            # an armed Rebalancer refuses waves while suspended
+            _spawn(r, 6)
+            _settle(r)
+            rb.armed = True
+            rb.score_interval_s = 0.0
+            rb.cooldown_s = 0.0
+            out = rb.maybe_run(clock())
+            assert not out["ran"] and out["reason"] == "slo-suspended"
+            assert r.scheduler.smetrics.rebalance_waves.labels(
+                "suspended") == 1.0
+        finally:
+            r.close()
+
+    def test_half_open_probe_heals_on_clean_window(self):
+        r, clock = _runner(nodes=2)
+        try:
+            rb, hist = self._tripped(r, clock)
+            # clean windows do NOT close an OPEN breaker before the probe
+            for _ in range(10):
+                hist.observe(0.01, "t1")
+            rb._judge_slo()
+            assert rb.breaker.dump()["state"] == "open"
+            # past the probe interval the breaker half-opens one wave …
+            clock.advance(61.0)
+            assert rb.breaker.allow()
+            assert rb.breaker.dump()["state"] == "half_open"
+            # … and only a clean judged window then closes it
+            for _ in range(10):
+                hist.observe(0.01, "t1")
+            rb._judge_slo()
+            assert rb.breaker.dump()["state"] == "closed"
+            assert not rb.suspended
+            assert r.scheduler.smetrics.rebalance_suspended.labels() == 0.0
+        finally:
+            r.close()
+
+    def test_short_window_not_judged(self):
+        r, clock = _runner(nodes=2)
+        try:
+            rb = Rebalancer(r.scheduler, now_fn=clock, breaker_threshold=1,
+                            slo_min_samples=50)
+            hist = r.scheduler.smetrics.tenant_e2e_duration
+            for _ in range(60):
+                hist.observe(0.01, "t1")
+            rb._arm_slo_watch()
+            rb.waves_executed = 1
+            for _ in range(5):  # 5 < slo_min_samples: too little evidence
+                hist.observe(5.0, "t1")
+            rb._judge_slo()
+            assert rb.breaker.dump()["state"] == "closed"
+        finally:
+            r.close()
+
+
+class TestDebugDump:
+    def test_dump_shape_and_limit(self):
+        r, clock = _runner(nodes=6)
+        try:
+            _spawn(r, 24)
+            _settle(r)
+            _smear(r)
+            rb = Rebalancer(r.scheduler, now_fn=clock, entropy_high=0.05,
+                            entropy_low=0.01, score_interval_s=0.0,
+                            cooldown_s=0.0, max_migrations_per_wave=2)
+            for _ in range(3):
+                rb.maybe_run(clock())
+                _settle(r)
+                clock.advance(1.0)
+            assert rb.waves_executed >= 2
+            dump = rb.debug_dump(limit=1)
+            assert dump["enabled"] and dump["waves_executed"] >= 2
+            assert len(dump["last_waves"]) == 1
+            assert dump["truncated"]["last_waves"] == rb.waves_executed
+            assert set(dump["breaker"]) >= {"state", "opens"}
+            assert {"entropy_high", "entropy_low",
+                    "frag_high", "frag_low"} <= set(dump["bands"])
+            json.dumps(dump)  # the /debug/rebalance contract: JSON-clean
+        finally:
+            r.close()
+
+
+class TestReplayAcceptance:
+    """The ISSUE 18 acceptance: trace-replay A/B on a FakeClock."""
+
+    REBALANCE_KNOBS = {"cooldown_s": 1.0, "score_interval_s": 0.25,
+                       "entropy_high": 0.80, "entropy_low": 0.60,
+                       "max_migrations_per_wave": 8}
+
+    def _run(self, rebalance):
+        tc = TEST_CASES["SchedulingReplay"](
+            nodes=24, rounds=6, scale=4, cycles_per_round=120,
+            tick_s=0.05, rebalance=rebalance)
+        return run_workload(tc, backend="oracle", now_fn=FakeClock())
+
+    @pytest.fixture(scope="class")
+    def ab(self):
+        def pick(items, name):
+            return [it for it in items if it.labels.get("Name") == name]
+
+        on_items = self._run(self.REBALANCE_KNOBS)
+        off_items = self._run(False)
+        (on_inv,) = pick(on_items, "ReplayInvariants")
+        (off_inv,) = pick(off_items, "ReplayInvariants")
+        on_t = {it.labels["namespace"]: it.data
+                for it in pick(on_items, "ReplayTenant")}
+        off_t = {it.labels["namespace"]: it.data
+                 for it in pick(off_items, "ReplayTenant")}
+        return on_inv.data, off_inv.data, on_t, off_t
+
+    def test_rebalancer_ran_and_converged(self, ab):
+        on, off, _, _ = ab
+        assert on["Waves"] > 0 and on["Migrations"] > 0
+        assert off["Waves"] == 0 and off["Migrations"] == 0
+        # every migrate-then-reopen wave completed: nothing left cordoned,
+        # nothing parked in the queue at end of trace — zero lost pods
+        assert on["PendingUncordons"] == 0
+        assert on["PendingAtEnd"] == 0 and off["PendingAtEnd"] == 0
+        assert not on["Suspended"]
+
+    def test_packing_measurably_better_with_rebalancing(self, ab):
+        on, off, _, _ = ab
+        # steady-state packing efficiency (1 - mean second-half entropy):
+        # the rebalanced trace must beat churn-decayed one-shot placement
+        # by a real margin, not noise
+        assert on["PackingEff"] > off["PackingEff"] + 0.005, (
+            f"rebalancing on: {on['PackingEff']:.4f} "
+            f"vs off: {off['PackingEff']:.4f}")
+        assert on["FinalEntropy"] < off["FinalEntropy"]
+
+    def test_no_tenant_p99_moved(self, ab):
+        on, off, on_t, off_t = ab
+        # the fence discipline (tools/trend.py workload_replay_tenant_p99_s,
+        # 200% tolerance) plus a floor for FakeClock bucket granularity
+        tol, floor = 2.0, 0.5
+        assert set(on_t) == set(off_t)
+        for ns, t_off in off_t.items():
+            t_on = on_t[ns]
+            if not t_on["E2eCount"] or not t_off["E2eCount"]:
+                continue
+            assert t_on["E2eP99"] <= t_off["E2eP99"] * (1 + tol) + floor, (
+                f"tenant {ns} p99 moved: {t_on['E2eP99']:.3f}s on vs "
+                f"{t_off['E2eP99']:.3f}s off")
+        assert on["TenantP99Max"] <= off["TenantP99Max"] * (1 + tol) + floor

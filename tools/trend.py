@@ -195,6 +195,14 @@ FENCE_TOLERANCES = {
     # baselines, or a run with tracing disabled).
     "device_exec_ms_per_batch": 150.0,
     "device_fetch_ms_per_batch": 250.0,
+    # SchedulingReplay row (first recorded r18+): packing efficiency is
+    # 1 - mean normalized entropy in [0, 1] — a placement-quality score
+    # that shifts with the churned arrival mix, so the fence is loose;
+    # the tenant p99 reads from the same ~2x e2e histogram buckets as
+    # the other e2e rows. check() skips when either round lacks the row
+    # (pre-replay baselines, or a budget-skipped matrix).
+    "workload_replay_packing_eff": 40.0,
+    "workload_replay_tenant_p99_s": 200.0,
 }
 # per-workload overrides for rows whose history is structurally volatile
 # (PreemptionBasic swung 2953 -> 69 -> 243 pods/s across r02-r05 as the
@@ -343,6 +351,19 @@ def fence(current: dict, rounds: Optional[List[dict]] = None) -> dict:
               (b.get("slices") or {}).get("frag_max"),
               over.get("workload_slice_frag_max",
                        tol["workload_slice_frag_max"]), False)
+        # trace-replay rows only (same skip-when-absent): packing
+        # efficiency must not decay, and rebalancing must never cost a
+        # tenant its e2e p99 — the ISSUE 18 acceptance pair
+        check(f"workload {name} replay packing eff",
+              (c.get("replay") or {}).get("packing_eff"),
+              (b.get("replay") or {}).get("packing_eff"),
+              over.get("workload_replay_packing_eff",
+                       tol["workload_replay_packing_eff"]), True)
+        check(f"workload {name} replay tenant p99",
+              (c.get("replay") or {}).get("tenant_p99_s"),
+              (b.get("replay") or {}).get("tenant_p99_s"),
+              over.get("workload_replay_tenant_p99_s",
+                       tol["workload_replay_tenant_p99_s"]), False)
     return {"baselineRound": base.get("_round"), "checked": checked,
             "violations": violations, "tolerances": FENCE_TOLERANCES}
 
