@@ -900,6 +900,7 @@ def scheduling_quota_from(doc: dict) -> t.SchedulingQuota:
         meta=meta_from(doc.get("metadata") or {}),
         hard={k: int(v) for k, v in (spec.get("hard") or {}).items()},
         weight=int(spec.get("weight", 1)),
+        cohort=str(spec.get("cohort", "") or ""),
         used={k: int(v) for k, v in (status.get("used") or {}).items()})
 
 
@@ -907,6 +908,8 @@ def scheduling_quota_to(sq: t.SchedulingQuota) -> dict:
     spec: dict = {"weight": sq.weight}
     if sq.hard:
         spec["hard"] = dict(sq.hard)
+    if sq.cohort:
+        spec["cohort"] = sq.cohort
     out: dict = {"metadata": meta_to(sq.meta), "spec": spec}
     if sq.used:
         out["status"] = {"used": dict(sq.used)}

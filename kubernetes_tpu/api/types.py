@@ -1069,6 +1069,11 @@ QUOTA_CPU = "requests.cpu"        # milli-cpu (api/resource.py canonical)
 QUOTA_MEMORY = "requests.memory"  # KiB
 QUOTA_CLAIMS = "claims"           # pod.spec.resourceClaims entries
 
+# the fixed dimension order every [*, Q] quota tensor row uses — one source
+# of truth shared by the ledger's device-table export (framework/plugins/
+# quota.py) and the device-side over-quota screen (ops/quota.py)
+QUOTA_DIM_ORDER = (QUOTA_PODS, QUOTA_CPU, QUOTA_MEMORY, QUOTA_CLAIMS)
+
 
 @dataclass
 class SchedulingQuota:
@@ -1085,11 +1090,19 @@ class SchedulingQuota:
     ``hard`` keys are the QUOTA_* dimension names in canonical ints; absent
     keys are unlimited. ``used`` is advisory status (the authoritative
     ledger lives in the QuotaAdmission plugin and is rebuilt from the store
-    on restart)."""
+    on restart).
+
+    ``cohort`` (Kueue's direction) names a lending pool: namespaces whose
+    quotas share a cohort may borrow each other's UNUSED guaranteed
+    headroom past their own ``hard`` caps. Borrowed charges are
+    reclaimable — a lender's own pod arriving while the cohort is
+    exhausted preempts borrower pods to take its guarantee back. Empty =
+    no cohort (hard caps only, the pre-borrowing behavior)."""
 
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     hard: Dict[str, int] = field(default_factory=dict)
     weight: int = 1  # fair-share weight (>= 0; 0 = background tenant)
+    cohort: str = ""  # lending pool name ("" = not in any cohort)
     # status
     used: Dict[str, int] = field(default_factory=dict)
 

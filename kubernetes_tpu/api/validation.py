@@ -468,6 +468,9 @@ def validate_scheduling_quota(sq) -> list:
     errs = validate_object_meta(sq.meta, requires_namespace=True)
     if sq.weight < 0:
         errs.append("spec.weight: must be >= 0")
+    if sq.cohort and not is_dns1123_label(sq.cohort):
+        errs.append(f"spec.cohort: {sq.cohort!r} must be a lowercase "
+                    "RFC-1123 label")
     for dim, v in sq.hard.items():
         if dim not in _QUOTA_DIMENSIONS:
             errs.append(f"spec.hard[{dim}]: unknown quota dimension "

@@ -196,13 +196,16 @@ class BatchResult(NamedTuple):
 
 
 def pack_result_block(node_idx: jax.Array, first_fail: jax.Array,
-                      slice_words: Optional[jax.Array] = None) -> jax.Array:
-    """[P, 1 + ceil(N/4) (+1)] int32: node_idx in column 0, the int8
-    first_fail rows bitcast into int32 words after it, and — when the batch
-    carried slice gangs — one trailing column of per-pod slice verdict words
-    (see _slice_plan). Traced into the batch program (schedule_batch's jit),
-    so the packing is free relative to a transfer: one fused device buffer
-    replaces independent node_idx/first_fail/verdict host reads."""
+                      slice_words: Optional[jax.Array] = None,
+                      quota_words: Optional[jax.Array] = None) -> jax.Array:
+    """[P, 1 + ceil(N/4) (+extras)] int32: node_idx in column 0, the int8
+    first_fail rows bitcast into int32 words after it, then the optional
+    trailing verdict columns in fixed order — slice words (see _slice_plan)
+    when the batch carried slice gangs, quota words (ops/quota.py) when it
+    carried screened namespaces. Traced into the batch program
+    (schedule_batch's jit), so the packing is free relative to a transfer:
+    one fused device buffer replaces independent node_idx/first_fail/
+    verdict host reads."""
     p, n = first_fail.shape
     pad = (-n) % 4
     if pad:
@@ -212,23 +215,38 @@ def pack_result_block(node_idx: jax.Array, first_fail: jax.Array,
     cols = [node_idx[:, None], words]
     if slice_words is not None:
         cols.append(slice_words[:, None])
+    if quota_words is not None:
+        cols.append(quota_words[:, None])
     return jnp.concatenate(cols, axis=1)
 
 
-def unpack_result_block(packed, n_nodes: int):
+def unpack_result_block(packed, n_nodes: int, quota_col: bool = False):
     """(node_idx [P] int32, first_fail [P, N] int8, slice_words [P] int32 or
-    None) from one materialized packed block. The np.asarray here is THE
-    blocking device read of a batch commit; everything after is host-side
-    reinterpretation (the int32→int8 view matches lax.bitcast_convert_type
-    byte order on both CPU and TPU — pinned by tests/test_kernel_parity.py).
-    The slice column's presence is inferred from the block width, so
-    slice-free batches pay nothing."""
+    None, quota_words [P] int32 or None) from one materialized packed block.
+    The np.asarray here is THE blocking device read of a batch commit;
+    everything after is host-side reinterpretation (the int32→int8 view
+    matches lax.bitcast_convert_type byte order on both CPU and TPU —
+    pinned by tests/test_kernel_parity.py). Trailing-column presence is
+    inferred from the block width — two extras mean slice THEN quota (the
+    pack order); exactly one is the quota column iff the dispatcher passed
+    quota args (``quota_col``, threaded from the dispatch site), else the
+    slice column. Verdict-free batches pay nothing."""
     arr = np.asarray(packed)
     node_idx = arr[:, 0]
     ff_words = (n_nodes + 3) // 4
-    slice_words = arr[:, 1 + ff_words] if arr.shape[1] > 1 + ff_words else None
+    extras = arr.shape[1] - 1 - ff_words
+    slice_words = quota_words = None
+    if extras >= 2:
+        slice_words = arr[:, 1 + ff_words]
+        quota_words = arr[:, 2 + ff_words]
+    elif extras == 1:
+        if quota_col:
+            quota_words = arr[:, 1 + ff_words]
+        else:
+            slice_words = arr[:, 1 + ff_words]
     ff = np.ascontiguousarray(arr[:, 1:1 + ff_words]).view(np.int8)
-    return node_idx, ff.reshape(arr.shape[0], -1)[:, :n_nodes], slice_words
+    return (node_idx, ff.reshape(arr.shape[0], -1)[:, :n_nodes],
+            slice_words, quota_words)
 
 
 def _pod_port_bits(pb: PodBatch, words: int) -> jax.Array:
@@ -1460,6 +1478,10 @@ def schedule_batch(
     dra_mask: Optional[jax.Array] = None,
     slice_members=None,
     slice_grid: Optional[Tuple[int, int]] = None,
+    quota_ns: Optional[jax.Array] = None,
+    quota_req: Optional[jax.Array] = None,
+    quota_used: Optional[jax.Array] = None,
+    quota_limit: Optional[jax.Array] = None,
 ) -> BatchResult:
     # slice gangs plan in-jit, ahead of the core: the plan pins members via
     # slice_mask and its verdict words ride the packed block's extra column
@@ -1476,12 +1498,23 @@ def schedule_batch(
                               ports_enabled=ports_enabled,
                               extra_mask=extra_mask, dra_mask=dra_mask,
                               slice_mask=slice_mask)
+    # namespace-quota screen over the core's winners, in-jit and post-core:
+    # it replays the batch order against the synced usage/limit tensors and
+    # its verdict words ride the packed block — zero extra dispatch
+    if quota_ns is not None and quota_used is not None:
+        from ..ops.quota import quota_screen
+
+        quota_words = quota_screen(res.node_idx, quota_ns, quota_req,
+                                   quota_used, quota_limit)
+    else:
+        quota_words = None
     # fuse the host-commit payload into one block here (inside the jit), so
     # every single-device variant — scan, speculative rounds, pallas —
     # returns it; the sharded core entry (parallel/mesh.py) bypasses this
     # wrapper and keeps packed=None
     return res._replace(packed=pack_result_block(
-        res.node_idx, res.first_fail, slice_words=slice_words))
+        res.node_idx, res.first_fail, slice_words=slice_words,
+        quota_words=quota_words))
 
 
 def spec_decode_eligible(sample_k) -> bool:
@@ -1516,14 +1549,17 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
     def fn(pb, et, nt, tc, tb, key, topo_enabled=True, topo_carry=None,
            sample_k=None, sample_start=None, topo_mode=None, vd_override=None,
            host_key=0, ports_enabled=True, extra_mask=None, dra_mask=None,
-           slice_members=None, slice_grid=None):
+           slice_members=None, slice_grid=None, quota_ns=None, quota_req=None,
+           quota_used=None, quota_limit=None):
         spec = spec_decode_eligible(sample_k)
         # the pallas fused step has no sampling emulation yet; the
         # speculative path replaces it where both apply (fewer device steps).
-        # The fused kernel has no extra-mask/dra-mask/slice input either —
-        # volume, claim and slice batches take the XLA path.
+        # The fused kernel has no extra-mask/dra-mask/slice/quota input
+        # either — volume, claim, slice and quota-screened batches take the
+        # XLA path.
         mode = (None if (sample_k is not None or spec or extra_mask is not None
-                         or dra_mask is not None or slice_members is not None)
+                         or dra_mask is not None or slice_members is not None
+                         or quota_ns is not None)
                 else pallas_mode(nt, None, topo_enabled))
         kw = dict(weights_key=wk, topo_enabled=topo_enabled, pallas=mode,
                   topo_carry=topo_carry, sample_k=sample_k,
@@ -1531,7 +1567,9 @@ def build_schedule_batch_fn(weights: Dict[str, float] = None):
                   vd_override=vd_override, host_key=host_key,
                   spec_decode=spec, ports_enabled=ports_enabled,
                   extra_mask=extra_mask, dra_mask=dra_mask,
-                  slice_members=slice_members, slice_grid=slice_grid)
+                  slice_members=slice_members, slice_grid=slice_grid,
+                  quota_ns=quota_ns, quota_req=quota_req,
+                  quota_used=quota_used, quota_limit=quota_limit)
         out = schedule_batch(pb, et, nt, tc, tb, key, **kw)
         from . import telemetry
 

@@ -378,29 +378,32 @@ class CommitPlane:
 
 
 def materialize_result(result, n_nodes: int, batch_id: str = "",
-                       pods: int = 0, **event_extra):
+                       pods: int = 0, quota_col: bool = False,
+                       **event_extra):
     """THE one blocking device read of a batch commit: materialize the
-    packed result block (node_idx + first_fail + optional slice verdict
-    column in one buffer) or take the per-array fallback for packless
-    (mesh-sharded) results. Returns ``(node_idx, ff, slice_words,
-    packed_ok)``; ``ff`` is None on the fallback path (callers lazily read
-    result.first_fail) and ``slice_words`` is None whenever the batch
-    carried no slice gangs. Shared by the in-process commit, the commit
-    worker, and DeviceService's server-side commit so transfer accounting
-    and flight events stay identical."""
+    packed result block (node_idx + first_fail + optional slice/quota
+    verdict columns in one buffer) or take the per-array fallback for
+    packless (mesh-sharded) results. Returns ``(node_idx, ff, slice_words,
+    quota_words, packed_ok)``; ``ff`` is None on the fallback path (callers
+    lazily read result.first_fail), ``slice_words``/``quota_words`` are
+    None whenever the batch carried no slice gangs / screened namespaces
+    (``quota_col`` — whether the dispatcher passed quota args — settles the
+    single-extra-column ambiguity). Shared by the in-process commit, the
+    commit worker, and DeviceService's server-side commit so transfer
+    accounting and flight events stay identical."""
     from . import telemetry
     from .batch import unpack_result_block
 
     if result.packed is not None:
-        node_idx, ff, slice_words = unpack_result_block(result.packed,
-                                                        n_nodes)
+        node_idx, ff, slice_words, quota_words = unpack_result_block(
+            result.packed, n_nodes, quota_col=quota_col)
         telemetry.transfer("fetch", result.packed.nbytes)
-        return node_idx, ff, slice_words, True
+        return node_idx, ff, slice_words, quota_words, True
     node_idx = np.asarray(result.node_idx)
     telemetry.transfer("fetch", node_idx.nbytes)
     telemetry.event("packed_fallback", batchId=batch_id, pods=pods,
                     **event_extra)
-    return node_idx, None, None, False
+    return node_idx, None, None, None, False
 
 
 def materialize_profiled(result, n_nodes: int, *, program: str,
@@ -408,6 +411,7 @@ def materialize_profiled(result, n_nodes: int, *, program: str,
                          t_submit: Optional[float] = None,
                          now_fn: Callable[[], float] = perf_counter,
                          batch_id: str = "", pods: int = 0,
+                         quota_col: bool = False,
                          event_extra: Optional[dict] = None):
     """``materialize_result`` plus the dispatch profiler's phase
     decomposition. With the profiler off this IS materialize_result (one
@@ -431,7 +435,7 @@ def materialize_profiled(result, n_nodes: int, *, program: str,
             except Exception:  # noqa: BLE001 — the materialize below will
                 pass           # surface any real device failure
     out = materialize_result(result, n_nodes, batch_id=batch_id, pods=pods,
-                             **(event_extra or {}))
+                             quota_col=quota_col, **(event_extra or {}))
     t_wait_end = now_fn()
     disp = None
     if rec is not None:

@@ -128,6 +128,26 @@ class DeviceState:
         self.attr_kind = jnp.array(self._attr_kind_m)
         self.attr_val = jnp.array(self._attr_val_m)
         self._node_attrs: Dict[str, dict] = {}  # name -> last-synced mapping
+        # --- per-namespace quota screen tensors (ops/quota.py) -----------
+        # [NS, Q] usage/limit pair the batch program's over-quota screen
+        # judges winners against: synced from the host quota ledger before
+        # dispatch (content-diffed — an unchanged table re-uploads nothing)
+        # and carried to remote devices by the delta channel's quotaTable
+        # payload. Kept OUTSIDE NodeTensors like the attribute table: the
+        # namespace axis is its own bucketed shape (grown by doubling) and
+        # no batch commit mutates it device-side — the evolving copy lives
+        # only inside the screen's scan carry.
+        from ..ops.quota import QUOTA_DIMS, QUOTA_NO_LIMIT
+
+        self.nsq_slots: Dict[str, int] = {}   # namespace -> tensor row
+        self._nsq_rows = 8
+        self._nsq_used_m = np.zeros((self._nsq_rows, QUOTA_DIMS), np.int32)
+        self._nsq_limit_m = np.full((self._nsq_rows, QUOTA_DIMS),
+                                    QUOTA_NO_LIMIT, np.int32)
+        # jnp.array (copying) for the same aliasing reason as attr_kind
+        self.nsq_used = jnp.array(self._nsq_used_m)
+        self.nsq_limit = jnp.array(self._nsq_limit_m)
+        self.nsq_uploads = 0  # content-diff re-uploads (telemetry/debug)
         # O(changes) reconcile/has_dirty: names this device previously left
         # dirty, and the snapshot structure version it last fully walked.
         # While the structure version is unchanged, only changed_names ∪
@@ -286,6 +306,67 @@ class DeviceState:
         # row tensors — not worth a third scatter program
         self.attr_kind = jnp.array(self._attr_kind_m)
         self.attr_val = jnp.array(self._attr_val_m)
+
+    # ------------------------------------------------- namespace quota table
+
+    def _grow_nsq_rows(self) -> None:
+        from ..ops.quota import QUOTA_NO_LIMIT
+
+        rows = self._nsq_rows * 2
+        grow = rows - self._nsq_rows
+        self._nsq_used_m = np.pad(self._nsq_used_m, ((0, grow), (0, 0)))
+        self._nsq_limit_m = np.concatenate([
+            self._nsq_limit_m,
+            np.full((grow, self._nsq_limit_m.shape[1]), QUOTA_NO_LIMIT,
+                    np.int32)])
+        self._nsq_rows = rows
+
+    def set_ns_quota(self, table: Dict[str, Tuple]) -> bool:
+        """Sync the namespace-quota tensor pair from a host ledger view
+        (ns -> (used row, limit row) in ops/quota.QUOTA_DIM_ORDER ints).
+        Content-diffed against the host mirror, so a steady-state table
+        uploads nothing; returns whether a re-upload happened. ``table`` is
+        the COMPLETE desired state: a registered namespace absent from it
+        (quota deleted) resets to never-flags rows — a stale screening row
+        for an unquota'd namespace would otherwise reject-and-requeue the
+        same pod forever (the gate re-admits what the screen re-flags)."""
+        from ..ops.quota import QUOTA_NO_LIMIT
+
+        cap = int(QUOTA_NO_LIMIT)
+        dirty = False
+        for ns in self.nsq_slots:
+            if ns not in table:
+                slot = self.nsq_slots[ns]
+                if (self._nsq_used_m[slot].any()
+                        or (self._nsq_limit_m[slot] != cap).any()):
+                    self._nsq_used_m[slot] = 0
+                    self._nsq_limit_m[slot] = cap
+                    dirty = True
+        for ns, (used_row, limit_row) in table.items():
+            slot = self.nsq_slots.get(ns)
+            if slot is None:
+                slot = len(self.nsq_slots)
+                self.nsq_slots[ns] = slot
+                while slot >= self._nsq_rows:
+                    self._grow_nsq_rows()
+                dirty = True
+            u = np.clip(np.asarray(used_row, np.int64), 0, cap).astype(np.int32)
+            lim = np.clip(np.asarray(limit_row, np.int64), 0, cap).astype(np.int32)
+            if not np.array_equal(self._nsq_used_m[slot], u):
+                self._nsq_used_m[slot] = u
+                dirty = True
+            if not np.array_equal(self._nsq_limit_m[slot], lim):
+                self._nsq_limit_m[slot] = lim
+                dirty = True
+        if dirty:
+            # full re-upload, not a scatter: [NS, Q] int32 is tiny next to
+            # the row tensors (the attribute-table treatment)
+            self.nsq_used = jnp.array(self._nsq_used_m)
+            self.nsq_limit = jnp.array(self._nsq_limit_m)
+            self.nsq_uploads += 1
+            self.upload_bytes += (self._nsq_used_m.nbytes
+                                  + self._nsq_limit_m.nbytes)
+        return dirty
 
     # ------------------------------------------------------------------ sync
 

@@ -233,6 +233,11 @@ class DeviceService:
         self.snap = SimpleNamespace(node_info_map=self.infos,
                                     changed_names=set(), structure_version=0)
         self.ns_labels: Dict[str, Dict[str, str]] = {}
+        # ns -> (used row, limit row): the client's quota-ledger export for
+        # the device over-quota screen, replaced whole by each delta
+        # payload that carries a quotaTable (it is tiny, so the client
+        # ships the complete desired state whenever it changes)
+        self.quota_table: Dict[str, tuple] = {}
         self.device: Optional[DeviceState] = None
         self.schedule_batch_fn = build_schedule_batch_fn()
         self.batch_counter = 0
@@ -494,6 +499,7 @@ class DeviceService:
                         o.sent_gens.pop(name, None)
                 if not others:
                     self.ns_labels.clear()
+                    self.quota_table.clear()
                     self.device = None
             live_ids = {o.client_id for o in self._live_sessions()}
             # a REPLICATOR mirrors a client's PAST pushes: if a direct
@@ -581,6 +587,14 @@ class DeviceService:
             # identically to the in-process path (sig_table ns_labels_fn)
             for ns, labels in (req.get("namespaces") or {}).items():
                 self.ns_labels[ns] = dict(labels)
+            # the quota screen table rides the same channel: presence means
+            # the client shipped its COMPLETE ledger view (absent namespaces
+            # lost their quota — set_ns_quota resets their rows)
+            qt = req.get("quotaTable")
+            if qt is not None:
+                self.quota_table = {
+                    ns: (rows.get("used") or [], rows.get("limit") or [])
+                    for ns, rows in qt.items()}
             self._sync()
             self.delta_seq += 1
             s.delta_seq += 1
@@ -855,6 +869,17 @@ class DeviceService:
                 slice_grid = (self.device.caps.superpods,
                               self.device.caps.sp_slots)
             bucket = int(getattr(pb, "capacity", len(pods)))
+            # namespace-quota screen: sync the client-shipped ledger table
+            # into this device and build the batch's ns/req columns — the
+            # same builder the in-process dispatch uses, so both transports
+            # screen identically
+            quota_ns = quota_req = None
+            if self.quota_table or self.device.nsq_slots:
+                from ..ops.quota import build_quota_batch_args
+
+                quota_ns, quota_req = build_quota_batch_args(
+                    pods, self.device, table=self.quota_table,
+                    pad_to=bucket)
             sig = f"{bucket}/" + (
                 "general" if self.device.topo_enabled else "off")
             telemetry.event("dispatch", batchId=batch_id, client=cid,
@@ -875,7 +900,12 @@ class DeviceService:
                         topo_enabled=self.device.topo_enabled,
                         sample_k=sample_k, sample_start=sample_start,
                         dra_mask=dra_mask, slice_members=slice_members,
-                        slice_grid=slice_grid)
+                        slice_grid=slice_grid,
+                        quota_ns=quota_ns, quota_req=quota_req,
+                        quota_used=(self.device.nsq_used
+                                    if quota_ns is not None else None),
+                        quota_limit=(self.device.nsq_limit
+                                     if quota_ns is not None else None))
             t_dispatch = self.now_fn()
             if result.final_sample_start is not None:
                 self._start_carry = result.final_sample_start
@@ -891,11 +921,13 @@ class DeviceService:
                 # same commit-plane materializer the in-process commit runs
                 from .commit_plane import materialize_profiled
 
-                (node_idx, ff, slice_words, _), disp = materialize_profiled(
+                (node_idx, ff, slice_words, quota_words,
+                 _), disp = materialize_profiled(
                     result, self.device.caps.nodes,
                     program="schedule_batch", bucket=sig,
                     t_submit=t_dispatch, now_fn=self.now_fn,
                     batch_id=batch_id, pods=len(pods),
+                    quota_col=quota_ns is not None,
                     event_extra={"client": cid})
                 self.device.adopt_device(result)
                 self.device.adopt_commits(result, host_pb, node_idx)
@@ -992,6 +1024,14 @@ class DeviceService:
                 for idxs in slice_groups.values():
                     for i in idxs:
                         results[i]["slice"] = int(slice_words[i])
+            if quota_words is not None:
+                # every screened pod's quota verdict word rides back: the
+                # client rejects flagged winners against its authoritative
+                # ledger (screen staleness can only reject, never bind)
+                for i in range(len(pods)):
+                    w = int(quota_words[i])
+                    if w:
+                        results[i]["quota"] = w
             # stamp INSIDE the lock: epoch/deltaSeq are mutated by
             # concurrent apply_deltas calls from peer replicas — stamping
             # after release could pair this batch's results with a peer's
@@ -1499,6 +1539,9 @@ class WireScheduler(Scheduler):
         # on the service swept only by a full resync)
         self._pushed_nodes: set = set()
         self._sent_ns: Dict[str, dict] = {}
+        # last quotaTable payload acknowledged by the service — change-
+        # tracked whole (the table is tiny), like _sent_ns for labels
+        self._sent_quota: Dict[str, dict] = {}
         self._batchable_cache: Dict[str, bool] = {}
         self.settle_abandoned = False
         # HA session: this replica's identity on the shared device service.
@@ -1642,10 +1685,13 @@ class WireScheduler(Scheduler):
             labels = dict(obj.meta.labels)
             if self._sent_ns.get(ns) != labels:
                 namespaces[ns] = labels
-        if not (entries or removed or namespaces):
+        quota_table = self._wire_quota_table()
+        if not (entries or removed or namespaces) and quota_table is None:
             return
         payload = {"apiVersion": API_VERSION, "nodes": entries,
                    "removed": removed, "namespaces": namespaces}
+        if quota_table is not None:
+            payload["quotaTable"] = quota_table
         self._stamp_session(payload)
         self._stamp_inflight(payload)
         if self._device_epoch:
@@ -1676,6 +1722,24 @@ class WireScheduler(Scheduler):
             self._pushed_nodes.discard(n)
         for ns, labels in namespaces.items():
             self._sent_ns[ns] = labels
+        if quota_table is not None:
+            self._sent_quota = quota_table
+
+    def _wire_quota_table(self) -> Optional[Dict[str, dict]]:
+        """The COMPLETE quota-ledger export for the device screen when it
+        changed since the last acknowledged push, else None. Shipped whole
+        (it is tiny — one used/limit row pair per quota'd namespace), so
+        apply_deltas can treat every payload as the full desired state;
+        limits already fold in borrowable cohort headroom."""
+        plugin = self._quota_plugin()
+        if plugin is None:
+            return None
+        table = {ns: {"used": list(used), "limit": list(limit)}
+                 for ns, (used, limit)
+                 in plugin.device_quota_table().items()}
+        if table == self._sent_quota:
+            return None
+        return table
 
     def _full_resync(self, new_epoch: Optional[str] = None) -> None:
         """Epoch-mismatch recovery: forget everything we believe the device
@@ -1686,6 +1750,7 @@ class WireScheduler(Scheduler):
         self._sent_gens.clear()
         self._pushed_nodes.clear()
         self._sent_ns.clear()
+        self._sent_quota = {}
         self._device_epoch = new_epoch
         # a new epoch = a new service INSTANCE: no session of ours survived
         # it. Stamping the dead incarnation's sessionGen would read as a
@@ -1698,6 +1763,9 @@ class WireScheduler(Scheduler):
                       for ns, obj in self.store.namespaces.items()}
         payload = {"apiVersion": API_VERSION, "full": True, "nodes": entries,
                    "removed": [], "namespaces": namespaces}
+        quota_table = self._wire_quota_table()
+        if quota_table is not None:
+            payload["quotaTable"] = quota_table
         self._stamp_session(payload)
         self._stamp_inflight(payload)
         tp = tracing.format_traceparent()
@@ -1709,6 +1777,8 @@ class WireScheduler(Scheduler):
         self._sent_gens.update(pending_gens)
         self._pushed_nodes.update(pending_gens)
         self._sent_ns.update(namespaces)
+        if quota_table is not None:
+            self._sent_quota = quota_table
 
     # ------------------------------------------------------------ HA session
 
@@ -2231,6 +2301,17 @@ class WireScheduler(Scheduler):
         from ..ops.slice import is_slice_pod
         from .batch import SLICE_PLAN_OK_BIT
 
+        # device over-quota screen verdicts (echoed words): a flagged winner
+        # surrenders its placement and requeues through the quota gate —
+        # the host ledger stays authoritative, so staleness only retries
+        from ..ops.quota import QUOTA_OK_BIT, QUOTA_SCREEN_BIT
+
+        quota_rejected: set = set()
+        for i, r in enumerate(res["results"]):
+            w = int(r.get("quota") or 0)
+            if (r.get("nodeName") and (w & QUOTA_SCREEN_BIT)
+                    and not (w & QUOTA_OK_BIT)):
+                quota_rejected.add(i)
         for i, qp in enumerate(batch):
             gkey = pod_group_key(qp.pod)
             if gkey is not None:
@@ -2239,7 +2320,10 @@ class WireScheduler(Scheduler):
                 else:
                     groups.setdefault(gkey, []).append(i)
         for gkey, idxs in groups.items():
-            if any(not res["results"][i].get("nodeName") for i in idxs):
+            # a quota-screened member is unlandable: all-or-nothing means
+            # the whole gang surrenders (never half-admitted past quota)
+            if any(not res["results"][i].get("nodeName")
+                   or i in quota_rejected for i in idxs):
                 for i in idxs:
                     gang_rejected[i] = gkey
                 plugin = self.framework_for_pod(
@@ -2251,7 +2335,8 @@ class WireScheduler(Scheduler):
         # echoed verdict word splitting plan-infeasible from lost-in-flight
         for gkey, idxs in slice_groups.items():
             now = self.now_fn()
-            if all(res["results"][i].get("nodeName") for i in idxs):
+            if all(res["results"][i].get("nodeName") and i not in quota_rejected
+                   for i in idxs):
                 telemetry.event("slice_assign", client=self.client_id,
                                 gang=gkey, members=len(idxs))
                 self.smetrics.slice_wait_duration.observe(
@@ -2309,6 +2394,23 @@ class WireScheduler(Scheduler):
                     fwk, self._new_cycle_state(), qp, Status.unschedulable(
                         f'gang "{gang_rejected[i]}" could not be fully '
                         "placed"), d, pod_cycle)
+                self.smetrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, self.now_fn() - t0)
+                continue
+            if i in quota_rejected:
+                # the device adopted the placement before the screen flagged
+                # it: surrender the slot and requeue through the quota gate
+                # (host ledger re-admits once headroom is real)
+                from ..framework.plugins.quota import ERR_REASON_QUOTA_EXCEEDED
+                if node_name:
+                    self._invalidate_node(node_name)
+                self._handle_scheduling_failure(
+                    fwk, self._new_cycle_state(), qp, Status.unresolvable(
+                        f'{ERR_REASON_QUOTA_EXCEEDED}: namespace '
+                        f'"{qp.pod.meta.namespace}" over quota at decision '
+                        "time (device screen)"),
+                    Diagnosis(unschedulable_plugins={"QuotaAdmission"}),
+                    pod_cycle)
                 self.smetrics.observe_attempt(
                     "unschedulable", fwk.profile_name, self.now_fn() - t0)
                 continue

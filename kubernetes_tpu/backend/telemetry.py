@@ -97,6 +97,10 @@ EVENT_KINDS = frozenset({
     # waves, the SLO-guardrail breaker tripping open, and its half-open
     # probe healing the suspension
     "rebalance_wave", "rebalance_suspended", "rebalance_resume",
+    # cohort quota borrowing (framework/plugins/quota.py): loan grants,
+    # executed reclaim-by-preemption waves, and the reclaim SLO breaker
+    # tripping open
+    "borrow_grant", "borrow_reclaim", "reclaim_suspended",
 })
 
 # The declared dispatch-program registry. Every LITERAL program name the

@@ -23,7 +23,7 @@ from __future__ import annotations
 import dataclasses
 import os
 from collections import deque
-from typing import Callable, Deque, Dict, List, Optional, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Set, Tuple
 
 import jax
 import numpy as np
@@ -90,6 +90,10 @@ class _Inflight:
     # profiler's dwell clock starts here (0.0 = unset: dwell collapses
     # into the wait window)
     t_submit: float = 0.0
+    # whether the batch program ran the namespace-quota screen — the
+    # packed block then carries a quota verdict column, and the unpack
+    # must know which a SINGLE trailing column is (slice vs quota)
+    quota_col: bool = False
 
 
 def _default_full_batch() -> bool:
@@ -780,6 +784,12 @@ class TPUScheduler(Scheduler):
             # the bucketed member index so verdicts ride the packed block
             slice_members, slice_grid = self._slice_batch_args(batched,
                                                                device)
+            # namespace-quota screen (ops/quota.py): sync the ledger's
+            # used/limit rows into the device and hand the program the
+            # batch's ns/req columns — the over-quota verdict column rides
+            # the packed block, zero extra dispatch
+            quota_ns, quota_req = self._quota_batch_args(batched, device,
+                                                         bucket)
             with tracing.span("device.dispatch", topo=topo_mode):
                 result = self._run_batch_fn(
                     pb, et, device.nt, device.tc, tb, key,
@@ -796,6 +806,12 @@ class TPUScheduler(Scheduler):
                     dra_mask=dra_mask,
                     slice_members=slice_members,
                     slice_grid=slice_grid,
+                    quota_ns=quota_ns,
+                    quota_req=quota_req,
+                    quota_used=device.nsq_used if quota_ns is not None
+                    else None,
+                    quota_limit=device.nsq_limit if quota_ns is not None
+                    else None,
                 )
             if result.final_sample_start is not None:
                 # keep the rotation index across unsampled batches too (the
@@ -817,7 +833,8 @@ class TPUScheduler(Scheduler):
                                             t_pop, host_pb, pb, mode_info,
                                             batch_id, bucket,
                                             device.encoder.reclaim_gen,
-                                            device, t_dispatch))
+                                            device, t_dispatch,
+                                            quota_col=quota_ns is not None))
         # sig mirrors _run_batch_fn's compile-ledger bucket signature so the
         # flight recorder, compile ledger, and dispatch ledger key alike
         sig = f"{bucket}/{topo_mode or ('general' if device.topo_enabled else 'off')}"
@@ -978,11 +995,13 @@ class TPUScheduler(Scheduler):
                 mode = (fl.mode_info[0] if fl.mode_info else None) or (
                     "general" if getattr(fl.device, "topo_enabled", True)
                     else "off")
-                (node_idx, ff, slice_words, _), disp = materialize_profiled(
+                (node_idx, ff, slice_words, quota_words,
+                 _), disp = materialize_profiled(
                     fl.result, self.device.caps.nodes,
                     program="schedule_batch", bucket=f"{fl.bucket}/{mode}",
                     t_submit=fl.t_submit or None, now_fn=self.now_fn,
                     batch_id=fl.batch_id, pods=len(fl.qps),
+                    quota_col=fl.quota_col,
                     event_extra={"bucket": fl.bucket})
                 wait = self.now_fn() - t_wait0
                 self.smetrics.device_batch_duration.observe(wait, "commit_wait")
@@ -998,7 +1017,8 @@ class TPUScheduler(Scheduler):
                                    node_idx, pb=fl.pb, ff=ff,
                                    reclaim_gen=fl.reclaim_gen,
                                    batch_id=fl.batch_id,
-                                   slice_words=slice_words)
+                                   slice_words=slice_words,
+                                   quota_words=quota_words)
                 self.smetrics.device_batch_duration.observe(
                     self.now_fn() - t_host0, "commit_host")
             # reconcile: the commits above advanced node generations; the
@@ -1182,7 +1202,8 @@ class TPUScheduler(Scheduler):
                       pb=None, ff: Optional[np.ndarray] = None,
                       reclaim_gen: Optional[int] = None,
                       batch_id: str = "",
-                      slice_words: Optional[np.ndarray] = None) -> None:
+                      slice_words: Optional[np.ndarray] = None,
+                      quota_words: Optional[np.ndarray] = None) -> None:
         if node_idx is None:
             node_idx = np.asarray(result.node_idx)
         # the whole commit — winner binds AND loser requeues — runs inside
@@ -1191,7 +1212,7 @@ class TPUScheduler(Scheduler):
         with self.queue.coalesce_moves():
             self._commit_batch_coalesced(qps, result, pod_cycle, t0,
                                          node_idx, pb, ff, reclaim_gen,
-                                         batch_id, slice_words)
+                                         batch_id, slice_words, quota_words)
 
     def _commit_batch_coalesced(self, qps: List[QueuedPodInfo],
                                 result: BatchResult, pod_cycle: int,
@@ -1199,7 +1220,8 @@ class TPUScheduler(Scheduler):
                                 pb=None, ff: Optional[np.ndarray] = None,
                                 reclaim_gen: Optional[int] = None,
                                 batch_id: str = "",
-                                slice_words: Optional[np.ndarray] = None
+                                slice_words: Optional[np.ndarray] = None,
+                                quota_words: Optional[np.ndarray] = None
                                 ) -> None:
         # ledger: claim time — the batch leaves the device ring and enters
         # the host commit tail (one lock round trip for the whole batch)
@@ -1237,6 +1259,20 @@ class TPUScheduler(Scheduler):
                 for i in to_probe[name]:
                     stale[i] = f"node {name} removed while batch in flight"
 
+        # device over-quota screen (ops/quota.py): a SCREENED winner whose
+        # charge crossed the decision-time used/limit rows surrenders its
+        # placement — requeue through the quota gate, which re-judges it
+        # against the authoritative host ledger. Losers never flag.
+        quota_rejected: Set[int] = set()
+        if quota_words is not None:
+            from ..ops.quota import QUOTA_OK_BIT, QUOTA_SCREEN_BIT
+
+            for i in range(len(qps)):
+                w = int(quota_words[i])
+                if (int(node_idx[i]) >= 0 and (w & QUOTA_SCREEN_BIT)
+                        and not (w & QUOTA_OK_BIT)):
+                    quota_rejected.add(i)
+
         # gang all-or-nothing (PodGroup/Coscheduling): one vmapped device
         # pass over the batch's gangs decides per-gang verdicts; any gang
         # with an unplaced member is rejected WHOLE — no member of it is
@@ -1265,13 +1301,14 @@ class TPUScheduler(Scheduler):
             gang_rejected.update(self._judge_slice_gangs(
                 qps, node_idx, slice_gangs, slice_words, batch_id, t0))
             gang_members = {**gang_members, **slice_gangs}
-        if gang_members and stale:
-            # a stale member poisons its WHOLE gang: the kernel "placed" it
-            # (so _judge_gangs saw the gang complete), but the placement is
-            # unlandable — all-or-nothing means every sibling surrenders
+        if gang_members and (stale or quota_rejected):
+            # a stale or quota-screened member poisons its WHOLE gang: the
+            # kernel "placed" it (so _judge_gangs saw the gang complete),
+            # but the placement is unlandable — all-or-nothing means every
+            # sibling surrenders (a PodGroup never half-admits past quota)
             for gkey, idxs in gang_members.items():
-                if idxs[0] in gang_rejected or not any(i in stale
-                                                       for i in idxs):
+                if idxs[0] in gang_rejected or not any(
+                        i in stale or i in quota_rejected for i in idxs):
                     continue
                 for i in idxs:
                     gang_rejected[i] = gkey
@@ -1395,6 +1432,28 @@ class TPUScheduler(Scheduler):
                            pod_cycle)
                 self.smetrics.observe_attempt(
                     "error", fwk.profile_name, self.now_fn() - t0)
+                continue
+            if i in quota_rejected:
+                # surrender the placement like a gang-rejected member: the
+                # device adopted the commit, so repair the row from host
+                # truth, and park the pod back behind the quota gate — the
+                # host ledger (commit-time Reserve) stays authoritative, so
+                # a stale screen row can only cost a retry, never
+                # oversubscribe
+                from ..framework.plugins.quota import (
+                    ERR_REASON_QUOTA_EXCEEDED)
+
+                node_name = slot_names.get(idx)
+                if node_name is not None:
+                    self._invalidate_device_row(node_name)
+                self._fail(fwk, qp, Status.unresolvable(
+                    f'{ERR_REASON_QUOTA_EXCEEDED}: namespace '
+                    f'"{pod.meta.namespace}" over quota at decision time '
+                    '(device screen)'),
+                    pod_cycle,
+                    Diagnosis(unschedulable_plugins={"QuotaAdmission"}))
+                self.smetrics.observe_attempt(
+                    "unschedulable", fwk.profile_name, self.now_fn() - t0)
                 continue
             if idx >= 0:
                 node_name = slot_names.get(idx)
@@ -1584,6 +1643,25 @@ class TPUScheduler(Scheduler):
                 member_valid[g, m] = True
         return ((member_idx, member_valid),
                 (device.caps.superpods, device.caps.sp_slots))
+
+    def _quota_batch_args(self, batched: List[QueuedPodInfo], device,
+                          bucket: int):
+        """(ns_idx, req) columns for the batch program's namespace-quota
+        screen, or (None, None) when no pod rides a screened namespace.
+        Syncs the quota ledger's used/limit rows (own hard + borrowable
+        cohort headroom) into the device first, so the screen judges the
+        freshest decision-time view. Runs under the device mutex (the
+        table sync uploads tensors)."""
+        plugin = self._quota_plugin()
+        if plugin is None:
+            return None, None
+        table = plugin.device_quota_table()
+        if not table and not device.nsq_slots:
+            return None, None
+        from ..ops.quota import build_quota_batch_args
+
+        return build_quota_batch_args([qp.pod for qp in batched], device,
+                                      table=table, pad_to=bucket)
 
     def _judge_slice_gangs(self, qps: List[QueuedPodInfo],
                            node_idx: np.ndarray,

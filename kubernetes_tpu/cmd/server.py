@@ -288,7 +288,10 @@ def build_debug_handlers(sched) -> dict:
                           events from testing/locktrace.py (enabled only
                           under KTPU_LOCKTRACE=1)
       /debug/quota        per-namespace SchedulingQuota caps, the ledger's
-                          live usage, fair-share weight, charged pod count
+                          live usage, fair-share weight, charged pod count,
+                          plus the per-cohort borrowing pool: guaranteed/
+                          lent/headroom, outstanding loans (newest first),
+                          pending reclaim demand, reclaim breaker state
       /debug/ledger       pod-lifetime latency ledger: live/closed entry
                           counts, eviction count, per-pod segment
                           accumulators (metrics/latency_ledger.py;
@@ -329,10 +332,23 @@ def build_debug_handlers(sched) -> dict:
         if plugin is None:
             return {"enabled": False}
         out = plugin.dump()
+        # cohort pool view rides the same dump under a reserved key so the
+        # per-namespace table stays flat
+        cohorts = out.pop("_cohorts", {})
         capped, orig = _cap(sorted(out.items()), limit)
         result = {"enabled": True, "namespaces": dict(capped)}
         if orig is not None:
             result["namespacesTruncated"] = orig
+        ccapped, corig = _cap(sorted(cohorts.items()), limit)
+        result["cohorts"] = {}
+        for name, entry in ccapped:
+            loans, lorig = _cap(entry.get("loans") or [], limit)
+            entry = dict(entry, loans=loans)
+            if lorig is not None:
+                entry["loansTruncated"] = lorig
+            result["cohorts"][name] = entry
+        if corig is not None:
+            result["cohortsTruncated"] = corig
         return result
 
     def cache_dump(limit=None):

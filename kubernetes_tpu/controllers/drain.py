@@ -152,6 +152,21 @@ class DrainOrchestrator:
                 self.metrics.evicted_pods.inc(reason, value=len(evicted))
         return evicted
 
+    def evict_pods(self, pods: Sequence[Pod], reason: str = "quota_reclaim"
+                   ) -> int:
+        """Targeted pod eviction (no cordon): expand the set to whole
+        gangs and run the standard delete-recreate eviction — the quota
+        reclaim pass preempts borrower pods through here, so a borrowed
+        gang tears down atomically and its members rebind as a unit.
+        Returns pods evicted."""
+        from ..framework.plugins.coscheduling import pod_group_key
+
+        closure = self._gang_closure(list(pods))
+        evicted = self._evict(closure, reason)
+        gangs = len({pod_group_key(p) for p in closure} - {None})
+        self._wave_done(reason, 0, evicted, gangs)
+        return len(evicted)
+
     def _wave_done(self, reason: str, nodes: int, evicted: List[str],
                    gangs: int, slice_gangs: int = 0) -> Dict[str, int]:
         self.waves += 1

@@ -82,7 +82,8 @@ def in_tree_registry() -> Dict[str, Factory]:
         names.DYNAMIC_RESOURCES: lambda h, a: DynamicResources(
             client=h.get("client"), metrics=h.get("metrics")),
         names.QUOTA_ADMISSION: lambda h, a: QuotaAdmission(
-            client=h.get("client"), metrics=h.get("metrics")),
+            client=h.get("client"), metrics=h.get("metrics"),
+            now_fn=h.get("now_fn")),
         names.SLICE_PACKING: lambda h, a: SlicePacking(
             snapshot_fn=h.get("snapshot_fn"), client=h.get("client")),
         names.COSCHEDULING: lambda h, a: Coscheduling(

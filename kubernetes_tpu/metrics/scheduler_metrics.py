@@ -286,6 +286,20 @@ class SchedulerMetrics:
             "Gated pods re-admitted by targeted quota-release queue moves.",
             ["namespace"],
         ))
+        # cohort borrowing: the loaned portion of the ledger (subset of
+        # scheduler_quota_usage) and reclaim-by-preemption pass outcomes
+        # (evicted / noop / suspended-by-breaker)
+        self.quota_borrowed = r.register(Gauge(
+            "scheduler_quota_borrowed",
+            "Ledger usage charged against cohort headroom (loans) by "
+            "namespace and dimension.",
+            ["namespace", "resource"],
+        ))
+        self.quota_reclaims = r.register(Counter(
+            "scheduler_quota_reclaims_total",
+            "Cohort reclaim-by-preemption pass outcomes.",
+            ["result"],
+        ))
         self.fair_share_turns = r.register(Counter(
             "scheduler_fair_share_turns_total",
             "Deficit-round-robin dequeue turns served per tenant namespace.",

@@ -1,0 +1,145 @@
+"""Device-side namespace-quota screen: the over-quota verdict column.
+
+The host gate (framework/plugins/quota.py pre_filter, run at pop time)
+is authoritative, but it judges usage as of the POP — on the pipelined
+device path several batches are in flight at once, and on the wire path
+peer replicas charge the same namespaces concurrently, so a winner can be
+over its namespace's (possibly borrowed) headroom by the time its batch
+lands. The screen here replays the batch's winners IN BATCH ORDER against
+a per-namespace usage/limit tensor pair synced into DeviceState, flagging
+every winner whose charge would cross the limit — an extra verdict column
+riding the packed result block, zero extra dispatch, zero extra reads.
+
+The commit side treats a flagged winner exactly like a gang-surrendered
+member: reject + requeue + invalidate the adopted device row. Because
+commit-time host revalidation (Reserve's atomic charge) stays
+authoritative, tensor staleness can only REJECT a pod the host would have
+admitted (it requeues and retries), never admit one the host would
+reject — the screen cannot oversubscribe.
+
+Charging runs as a ``lax.scan`` over the batch so two same-namespace
+winners in one batch see each other's charges, mirroring the sequential
+order the host commit applies them in (the host oracle twin below is the
+parity contract, pinned by tests/test_quota_screen.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..api.types import QUOTA_DIM_ORDER
+
+# the fixed dimension order of the [NS, Q] usage/limit tensors and the
+# [P, Q] per-pod request block (api/types.py is the one source of truth,
+# shared with the ledger's device_quota_table export)
+QUOTA_DIMS = len(QUOTA_DIM_ORDER)
+
+# per-pod quota verdict word (the packed block's trailing quota column):
+# bit 0 = the pod was screened (its namespace has a row in the tensor
+# pair), bit 1 = the charge fit under the synced limit. A screened winner
+# with bit 1 clear is over quota on decision-time state — the commit
+# rejects it before bind. Unscreened pods carry word 0.
+QUOTA_SCREEN_BIT = 1
+QUOTA_OK_BIT = 2
+
+# unlisted-namespace sentinel for the limit tensor: never flags
+QUOTA_NO_LIMIT = np.int32(2**31 - 1)
+
+
+def quota_screen(node_idx: jax.Array, ns_idx: jax.Array, req: jax.Array,
+                 used: jax.Array, limit: jax.Array) -> jax.Array:
+    """[P] int32 verdict words for one batch. ``node_idx`` [P] (the core's
+    placements: < 0 never charges), ``ns_idx`` [P] int32 row into the
+    namespace axis (-1 = unquota'd/exempt), ``req`` [P, Q] int32 per-pod
+    charge vectors, ``used``/``limit`` [NS, Q] int32 the synced tensors.
+    Traced into the batch program (schedule_batch's jit) — no dispatch of
+    its own."""
+    ns_n = used.shape[0]
+
+    def step(u, xs):
+        nidx, ns, r = xs
+        screened = ns >= 0
+        safe = jnp.clip(ns, 0, ns_n - 1)
+        fits = jnp.all(u[safe] + r <= limit[safe])
+        # only a PLACED, screened, fitting pod charges the evolving usage
+        charge = screened & (nidx >= 0) & fits
+        u = u.at[safe].add(jnp.where(charge, r, jnp.zeros_like(r)))
+        # unplaced pods read as ok: there is nothing to reject, and the
+        # commit's verdict ladder only consults the word for winners
+        word = jnp.where(
+            screened,
+            np.int32(QUOTA_SCREEN_BIT)
+            | jnp.where(fits | (nidx < 0), np.int32(QUOTA_OK_BIT), 0),
+            0).astype(jnp.int32)
+        return u, word
+
+    _u, words = lax.scan(step, used, (node_idx, ns_idx, req))
+    return words
+
+
+def quota_screen_host(node_idx, ns_idx, req, used, limit) -> np.ndarray:
+    """Host oracle twin of ``quota_screen`` (numpy, same walk): the parity
+    contract the oracle path and the tests judge the device column by."""
+    used = np.array(used, dtype=np.int64, copy=True)
+    limit = np.asarray(limit, dtype=np.int64)
+    p = len(node_idx)
+    words = np.zeros(p, np.int32)
+    for i in range(p):
+        ns = int(ns_idx[i])
+        if ns < 0:
+            continue
+        r = np.asarray(req[i], dtype=np.int64)
+        fits = bool(np.all(used[ns] + r <= limit[ns]))
+        word = QUOTA_SCREEN_BIT
+        if fits or int(node_idx[i]) < 0:
+            word |= QUOTA_OK_BIT
+        if fits and int(node_idx[i]) >= 0:
+            used[ns] += r
+        words[i] = word
+    return words
+
+
+def quota_request_row(pod) -> np.ndarray:
+    """[Q] int32 charge vector for one pod, in QUOTA_DIM_ORDER — the
+    encode-side twin of the ledger's pod_quota_request."""
+    from ..framework.plugins.quota import pod_quota_request
+
+    req = pod_quota_request(pod)
+    return np.array([min(int(req.get(d, 0)), int(QUOTA_NO_LIMIT))
+                     for d in QUOTA_DIM_ORDER], np.int32)
+
+
+def build_quota_batch_args(pods, device, table: Optional[dict] = None,
+                           pad_to: Optional[int] = None):
+    """(ns_idx [P] int32, req [P, Q] int32) for one batch against
+    ``device``'s namespace-quota table, or (None, None) when no pod in the
+    batch belongs to a screened namespace — the common case, whose batch
+    program is unchanged. ``pad_to`` pads the pod axis to the batch's
+    bucketed capacity (padding rows are exempt: ns_idx -1). ``table``
+    (ns -> (used, limit) rows) is applied to the device first when given,
+    so the screen judges the freshest host ledger view. Shared by the
+    in-process dispatch and the wire server so both transports screen
+    identically."""
+    if table is not None:
+        device.set_ns_quota(table)
+    if not device.nsq_slots:
+        return None, None
+    p = max(pad_to or 0, len(pods))
+    ns_idx = np.full(p, -1, np.int32)
+    req = np.zeros((p, QUOTA_DIMS), np.int32)
+    any_screened = False
+    for i, pod in enumerate(pods):
+        slot = device.nsq_slots.get(pod.meta.namespace)
+        if slot is None:
+            continue
+        ns_idx[i] = slot
+        req[i] = quota_request_row(pod)
+        any_screened = True
+    if not any_screened:
+        return None, None
+    return ns_idx, req

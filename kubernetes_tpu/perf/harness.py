@@ -555,10 +555,11 @@ class Runner:
                                 labels=dict(labels or {}))))
 
     def create_quota(self, namespace: str, hard: dict, weight: int = 1,
-                     name: str = "quota") -> None:
+                     name: str = "quota", cohort: str = "") -> None:
         """createQuota op: the namespace's SchedulingQuota (plus the
         Namespace object itself) — the tenant contract the QuotaAdmission
-        plugin and the queue's fair-share layer read."""
+        plugin and the queue's fair-share layer read. ``cohort`` joins the
+        namespace to a borrowing pool (ISSUE 19)."""
         from ..api.types import Namespace, ObjectMeta, SchedulingQuota
 
         if namespace not in self.store.namespaces:
@@ -566,7 +567,7 @@ class Runner:
                 meta=ObjectMeta(name=namespace, namespace="")))
         self.store.create_object("SchedulingQuota", SchedulingQuota(
             meta=ObjectMeta(name=name, namespace=namespace),
-            hard=dict(hard), weight=int(weight)))
+            hard=dict(hard), weight=int(weight), cohort=str(cohort)))
 
     def barrier(self, timeout_s: float = 300.0) -> None:
         """Wait (drive) until every pending pod has been attempted
@@ -836,16 +837,28 @@ class Runner:
 
         def check_oversubscription() -> int:
             """Quota ledger vs hard caps, every tenant, every dimension —
-            the zero-oversubscription invariant sampled once per cycle."""
+            the zero-oversubscription invariant sampled once per cycle.
+            Borrow-aware (ISSUE 19): a tenant's usage may exceed its own
+            hard cap only by its recorded loans, and every cohort pool must
+            stay within its summed guaranteed capacity."""
             if quota_plugin is None:
                 return 0
             bad = 0
+            cohorts = set()
             for ns in tenants:
                 hard = quota_plugin.effective_hard(ns)
                 if not hard:
                     continue
                 used = quota_plugin.usage(ns)
+                loans = quota_plugin.borrowed(ns)
                 bad += sum(1 for dim, cap in hard.items()
+                           if used.get(dim, 0) - loans.get(dim, 0) > cap)
+                cohort = quota_plugin.cohort_for(ns)
+                if cohort:
+                    cohorts.add(cohort)
+            for cohort in cohorts:
+                caps, used = quota_plugin.cohort_state(cohort)
+                bad += sum(1 for dim, cap in caps.items()
                            if used.get(dim, 0) > cap)
             return bad
 
@@ -979,6 +992,159 @@ class Runner:
         }
         self.data_items.append(DataItem(
             data=invariants, unit="", labels={"Name": "SoakInvariants"}))
+        return invariants
+
+    # ---- cohort-borrowing phase (ISSUE 19) ----
+
+    def borrow_phase(self, rounds: int = 8, mix=(), burst: Optional[dict] = None,
+                     pool=(), cycles_per_round: int = 60, tick_s: float = 0.0,
+                     label: str = "SchedulingBorrow",
+                     collector_interval: float = 1.0) -> Dict[str, float]:
+        """borrowPhase op — the asymmetric-cohort arrival script (ISSUE 19
+        tentpole d): an idle lender and a hungry borrower share one
+        borrowing pool (the OFF arm of the A/B simply drops the cohort
+        field from the quotas — same caps, same arrivals). Per round every
+        ``mix`` entry lands its arrivals; at ``burst["round"]`` the lender
+        wakes up with its own surge, which with borrowing ON must be
+        funded by reclaim-by-preemption of the borrower's loans.
+
+        Evidence out (DataItems): SchedulingThroughput; one BorrowTenant
+        item per namespace (admitted count, borrowed peak, registry e2e
+        p50/p99); one BorrowInvariants item — mean/peak pool utilization
+        over every cycle (pods dimension summed over ``pool``), peak loans
+        outstanding, reclaim passes executed, borrow-aware oversubscription
+        violations sampled every cycle (own-cap net of loans AND cohort
+        pool vs guaranteed). Assertions live in the tests — the harness
+        measures."""
+        quota_plugin = self._quota_plugin()
+        sched = self.scheduler
+        self._enable_ledger()
+        tenants = sorted({str(m["namespace"]) for m in mix}
+                         | ({str(burst["namespace"])} if burst else set()))
+        pool = sorted(pool) or tenants
+        tenant_hist = sched.smetrics.tenant_e2e_duration
+        tenant_snaps = {ns: tenant_hist.snapshot(ns) for ns in tenants}
+        admitted: Dict[str, int] = {ns: 0 for ns in tenants}
+        borrowed_peak: Dict[str, int] = {ns: 0 for ns in tenants}
+        bound_seen = {p.key() for p in self.store.pods.values()
+                      if p.spec.node_name}
+        reclaims0 = (quota_plugin.reclaims_executed
+                     if quota_plugin is not None else 0)
+        util_samples: List[float] = []
+        loans_peak = 0
+        oversub = 0
+
+        def note_new_bindings() -> None:
+            for p in self.store.pods.values():
+                if not p.spec.node_name or p.key() in bound_seen:
+                    continue
+                bound_seen.add(p.key())
+                if p.meta.namespace in admitted:
+                    admitted[p.meta.namespace] += 1
+
+        def sample_invariants() -> None:
+            """Pool utilization + the borrow-aware zero-oversubscription
+            check, once per cycle — 'at every instant' is this sampler."""
+            nonlocal loans_peak, oversub
+            if quota_plugin is None:
+                return
+            cap_sum = used_sum = loans_sum = 0
+            cohorts = set()
+            for ns in pool:
+                hard = quota_plugin.effective_hard(ns)
+                if not hard:
+                    continue
+                used = quota_plugin.usage(ns)
+                loans = quota_plugin.borrowed(ns)
+                cap_sum += hard.get("pods", 0)
+                used_sum += used.get("pods", 0)
+                loans_sum += loans.get("pods", 0)
+                borrowed_peak[ns] = max(borrowed_peak.get(ns, 0),
+                                        loans.get("pods", 0))
+                oversub += sum(1 for dim, cap in hard.items()
+                               if used.get(dim, 0) - loans.get(dim, 0) > cap)
+                cohort = quota_plugin.cohort_for(ns)
+                if cohort:
+                    cohorts.add(cohort)
+            for cohort in cohorts:
+                caps, used = quota_plugin.cohort_state(cohort)
+                oversub += sum(1 for dim, cap in caps.items()
+                               if used.get(dim, 0) > cap)
+            loans_peak = max(loans_peak, loans_sum)
+            if cap_sum:
+                util_samples.append(used_sum / cap_sum)
+
+        def drive_cycle() -> bool:
+            if self.backend in ("tpu", "wire", "grpc"):
+                return sched.schedule_batch_cycle() > 0
+            return sched.schedule_one()
+
+        col = ThroughputCollector(
+            lambda: sched.metrics["scheduled"], interval=collector_interval)
+        col.start(time.monotonic())
+        tick = getattr(self.now_fn, "advance", None) if tick_s else None
+
+        for r in range(rounds):
+            arrivals = [m for mi, m in enumerate(mix)
+                        if not r % int(m.get("every", 1))]
+            if burst is not None and r == int(burst.get("round", rounds // 2)):
+                arrivals = arrivals + [
+                    {k: v for k, v in burst.items() if k != "round"}]
+            for mi, m in enumerate(arrivals):
+                params = {k: v for k, v in m.items()
+                          if k not in ("count", "every")}
+                prefix = f"{m.get('prefix', params['namespace'])}-m{mi}r{r}"
+                params.pop("prefix", None)
+                for j in range(int(m["count"])):
+                    p = self._make_pod(
+                        prefix, dict(params, _gang_ordinal=j)
+                        if params.get("gang_size") else params)
+                    self.store.create_pod(p)
+                    self._pod_counter += 1
+            self._pump_dra()
+            for _c in range(cycles_per_round):
+                progressed = drive_cycle()
+                if tick is not None:
+                    tick(tick_s)
+                note_new_bindings()
+                sample_invariants()
+                col.maybe_sample(time.monotonic())
+                if not progressed:
+                    sched.queue.flush_backoff_completed()
+                    if len(sched.queue) == 0:
+                        break
+        drain = getattr(sched, "_drain_inflight", None)
+        if drain is not None:
+            drain()
+        note_new_bindings()
+        sample_invariants()
+        col.finish(time.monotonic())
+
+        summary = col.summary()
+        self.data_items.append(DataItem(
+            data=summary, unit="pods/s", labels={"Name": label}))
+        for ns in tenants:
+            snap = tenant_snaps[ns]
+            self.data_items.append(DataItem(
+                data={"Admitted": float(admitted[ns]),
+                      "BorrowedPeak": float(borrowed_peak.get(ns, 0)),
+                      "E2eP50": tenant_hist.percentile_since(snap, 0.50, ns),
+                      "E2eP99": tenant_hist.percentile_since(snap, 0.99, ns),
+                      "E2eCount": float(tenant_hist.count_since(snap, ns))},
+                unit="", labels={"Name": "BorrowTenant", "namespace": ns}))
+        invariants = {
+            "PoolUtilizationMean": (sum(util_samples) / len(util_samples)
+                                    if util_samples else 0.0),
+            "PoolUtilizationPeak": max(util_samples) if util_samples else 0.0,
+            "LoansOutstandingPeak": float(loans_peak),
+            "Reclaims": float((quota_plugin.reclaims_executed - reclaims0)
+                              if quota_plugin is not None else 0),
+            "OversubscriptionViolations": float(oversub),
+            "BurstRound": float(burst.get("round", rounds // 2)
+                                if burst else -1),
+        }
+        self.data_items.append(DataItem(
+            data=invariants, unit="", labels={"Name": "BorrowInvariants"}))
         return invariants
 
     # ---- trace-replay phase (continuous rebalancing) ----
@@ -1367,6 +1533,8 @@ class Runner:
                 self.create_quota(**kwargs)
             elif kind == "soakPhase":
                 self.soak_phase(**kwargs)
+            elif kind == "borrowPhase":
+                self.borrow_phase(**kwargs)
             elif kind == "collectSliceStats":
                 self.collect_slice_stats(**kwargs)
             elif kind == "replayPhase":

@@ -468,7 +468,7 @@ SOAK_TENANTS = (("soak-a", 4), ("soak-b", 2), ("soak-c", 1))
 
 def scheduling_soak(nodes=1000, rounds=8, scale=24, cycles_per_round=120,
                     gangs=True, claims=True, preempt=True, flap=True,
-                    tick_s=0.05, churn_frac=0.25) -> dict:
+                    tick_s=0.05, churn_frac=0.25, cohort="") -> dict:
     """SchedulingSoak — the compressed multi-tenant production mix (ISSUE 8
     tentpole e): three namespaces with asymmetric SchedulingQuotas (weights
     4/2/1, pod caps proportional), each submitting MORE than its headroom
@@ -497,7 +497,11 @@ def scheduling_soak(nodes=1000, rounds=8, scale=24, cycles_per_round=120,
     ops = [node_op]
     mix = []
     for ns, w in SOAK_TENANTS:
+        # ``cohort`` joins all three tenants into one borrowing pool
+        # (ISSUE 19): the soak's zero-oversubscription sampler then also
+        # fences the cohort invariant (pool used ≤ pool guaranteed)
         ops.append({"opcode": "createQuota", "namespace": ns, "weight": w,
+                    "cohort": cohort,
                     "hard": {"pods": w * scale,
                              "requests.cpu": w * scale * 1000,
                              "claims": w * scale}})
@@ -517,7 +521,48 @@ def scheduling_soak(nodes=1000, rounds=8, scale=24, cycles_per_round=120,
                 "tick_s": tick_s,
                 "flap": ({"round": rounds // 2, "batches": 3}
                          if flap else None)})
-    return {"name": f"SchedulingSoak/{nodes}Nodes", "ops": ops}
+    suffix = "/Cohort" if cohort else ""
+    return {"name": f"SchedulingSoak/{nodes}Nodes{suffix}", "ops": ops}
+
+
+def scheduling_borrow(nodes=40, rounds=8, scale=12, cycles_per_round=60,
+                      tick_s=0.05, borrowing=True) -> dict:
+    """SchedulingBorrow — the asymmetric-cohort A/B (ISSUE 19 tentpole d):
+    an idle lender (3·scale pod cap, trickle arrivals) and a hungry
+    borrower (scale cap, scale arrivals per round) share one borrowing
+    cohort; halfway through, the lender wakes up with a 2·scale-pod burst
+    that with borrowing ON must be funded by reclaiming the borrower's
+    loans. The OFF arm (``borrowing=False``) drops the cohort field only —
+    same caps, same arrivals — so the BorrowInvariants utilization delta
+    isolates what borrowing buys. Node capacity dwarfs the quota pool:
+    admission, not placement, is the binding constraint. Acceptance (in
+    the tests / trend fences): ON raises pool utilization by a real
+    margin, lender e2e p99 stays within tolerance, zero borrow-aware
+    oversubscription at every sampled instant."""
+    cohort = "pool" if borrowing else ""
+    base = {"req": {"cpu": "100m", "memory": "500Mi"}}
+    ops = [{"opcode": "createNodes", "count": nodes, "zones": 4,
+            "capacity": {"cpu": "4", "memory": "16Gi", "pods": 32}}]
+    for ns, w, cap in (("borrow-lender", 2, 3 * scale),
+                       ("borrow-hungry", 1, scale)):
+        ops.append({"opcode": "createQuota", "namespace": ns, "weight": w,
+                    "hard": {"pods": cap}, "cohort": cohort})
+    mix = [
+        {"namespace": "borrow-hungry", "count": scale,
+         "prefix": "hungry", **base},
+        # the lender's trickle keeps its e2e histogram populated in BOTH
+        # arms — the p99 guardrail needs lender samples to compare
+        {"namespace": "borrow-lender", "count": 1, "prefix": "lender",
+         **base},
+    ]
+    burst = {"round": rounds // 2, "namespace": "borrow-lender",
+             "count": 2 * scale - 4, "prefix": "wake", **base}
+    ops.append({"opcode": "borrowPhase", "rounds": rounds, "mix": mix,
+                "burst": burst,
+                "pool": ["borrow-lender", "borrow-hungry"],
+                "cycles_per_round": cycles_per_round, "tick_s": tick_s})
+    arm = "" if borrowing else "/NoBorrow"
+    return {"name": f"SchedulingBorrow/{nodes}Nodes{arm}", "ops": ops}
 
 
 def scheduling_elastic(nodes=1000, rounds=6, pods_per_round=150,
@@ -603,6 +648,7 @@ TEST_CASES = {
     "SchedulingSecrets": scheduling_secrets,
     "SchedulingInTreePVs": scheduling_intree_pvs,
     "SchedulingCSIPVs": scheduling_csi_pvs,
+    "SchedulingBorrow": scheduling_borrow,
     "SchedulingDRA": scheduling_dra,
     "SchedulingElastic": scheduling_elastic,
     "SchedulingGangs": scheduling_gangs,

@@ -145,6 +145,7 @@ class Scheduler:
         self._reject_depth = 0  # nested teardown guard (reject_waiting_pod)
         self._last_cleanup = now_fn()
         self._last_unsched_flush = now_fn()
+        self._reclaim_drainer = None  # built on first quota reclaim evict
 
         # Profiles are specs (plugin_config/plugin_args/registry dicts), NOT
         # pre-built Frameworks: the Scheduler owns the handle context, so
@@ -202,6 +203,7 @@ class Scheduler:
             plugin = fwk.plugin(QUOTA_ADMISSION)
             if plugin is not None:
                 plugin.on_release = self._on_quota_release
+                plugin.on_evict = self._quota_evict
                 if shared_quota is None:
                     shared_quota = plugin
                 else:
@@ -265,6 +267,19 @@ class Scheduler:
         plugin = self._quota_plugin(pod)
         if plugin is not None:
             plugin.pod_deleted(pod)
+
+    def _quota_evict(self, pods: List[Pod], reason: str) -> int:
+        """Borrower preemption for the quota reclaim pass: whole-gang
+        eviction through the drain orchestrator (delete + recreate unbound
+        + targeted EVICTION queue move), built lazily on first reclaim."""
+        orch = self._reclaim_drainer
+        if orch is None:
+            from ..controllers.drain import DrainOrchestrator
+
+            orch = DrainOrchestrator(self.store, metrics=self.smetrics,
+                                     queue=self.queue, now_fn=self.now_fn)
+            self._reclaim_drainer = orch
+        return orch.evict_pods(pods, reason=reason)
 
     # ----------------------------------------------------------- event wiring
 
@@ -634,6 +649,13 @@ class Scheduler:
             wal = getattr(self.store, "_wal", None)
             if wal is not None:
                 wal.maybe_compact(self.store)
+            # cohort quota reclaim-by-preemption rides the same sweep: a
+            # lender whose pod parked on "cohort exhausted by loans" evicts
+            # borrower pods newest-loan-first (cooldown + SLO breaker
+            # paced inside the pass; no-op without recorded demand)
+            quota = self._quota_plugin()
+            if quota is not None:
+                quota.run_reclaim(now)
         if now - self._last_unsched_flush >= 30.0:
             self._last_unsched_flush = now
             self.queue.flush_unschedulable_left_over()

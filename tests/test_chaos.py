@@ -2363,3 +2363,124 @@ class TestRebalanceChaos:
             _assert_oracle_replay_valid(store)
         finally:
             latency_ledger.disable()
+
+
+class TestBorrowChaos:
+    """Device death mid-reclaim (ISSUE 19): the reclaim pass has evicted
+    the borrower's loans (delete + recreate through the drain machinery)
+    and the lender's woken pods plus the recreated borrowers are in flight
+    when the relay dies. Required outcome: zero lost / double-bound pods,
+    the loan ledger reconciled to the post-reclaim truth, and the rebuilt
+    device mirror byte-identical to a fresh sync.
+
+    Runs under KTPU_LOCKTRACE=1 (the ``locktraced`` fixture): the
+    reclaim's queue-lock/ledger-lock/drain interleavings must keep the
+    lock-order graph acyclic with no blocking-under-lock events."""
+
+    @pytest.fixture(autouse=True)
+    def _traced(self, locktraced):
+        yield
+
+    def _quota(self, store, ns, pods_cap, cohort):
+        from kubernetes_tpu.api.types import ObjectMeta, SchedulingQuota
+
+        if ns not in store.namespaces:
+            from kubernetes_tpu.api.types import Namespace
+
+            store.create_namespace(Namespace(meta=ObjectMeta(name=ns)))
+        store.create_object("SchedulingQuota", SchedulingQuota(
+            meta=ObjectMeta(name="quota", namespace=ns),
+            hard={"pods": pods_cap}, cohort=cohort))
+
+    def test_device_kill_mid_reclaim_no_lost_no_double_bind(self, monkeypatch):
+        store = ClusterStore()
+        _cluster(store, 6)
+        self._quota(store, "lend", 4, "pool")
+        self._quota(store, "hungry", 2, "pool")
+        sched = TPUScheduler(store, batch_size=8, comparer_every_n=1,
+                             pod_initial_backoff=0.01, pod_max_backoff=0.05)
+        for i in range(6):
+            store.create_pod(make_pod(f"b{i}", namespace="hungry")
+                             .req({"cpu": "100m"}).obj())
+        sched.run_batched_until_settled()
+        plugin = next(iter(sched.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.borrowed("hungry")["pods"] == 4
+        # the lender wakes: four own-fit pods, pool exhausted by loans —
+        # the gate parks them and records reclaim demand
+        for i in range(4):
+            store.create_pod(make_pod(f"l{i}", namespace="lend")
+                             .req({"cpu": "100m"}).obj())
+        sched.schedule_batch_cycle()
+        sched._drain_inflight()
+        assert plugin._reclaim_demand.get("pool")
+        # the reclaim pass fires (housekeeping-driven in steady state;
+        # invoked directly here to pin the chaos window): loans evicted,
+        # borrower pods recreated unbound, lender pods reactivated
+        evicted = plugin.run_reclaim(now=sched.now_fn())
+        assert evicted == 4
+        assert plugin.borrowed("hungry").get("pods", 0) == 0
+        assert not plugin._loans
+
+        # the device dies exactly as the post-reclaim wave materializes
+        from kubernetes_tpu.backend import batch as batch_mod
+
+        real_unpack = batch_mod.unpack_result_block
+
+        def dead(*a, **kw):
+            raise RuntimeError("relay dropped mid-reclaim")
+
+        monkeypatch.setattr(batch_mod, "unpack_result_block", dead)
+        sched.schedule_batch_cycle()
+        sched._drain_inflight()
+        assert sched.device is None  # poisoned: marked for rebuild
+        monkeypatch.setattr(batch_mod, "unpack_result_block", real_unpack)
+        import time as _time
+
+        _time.sleep(0.06)  # let the (shortened) error backoff expire
+        sched.run_until_settled()
+
+        # zero lost / double-bound: ten pods exist exactly once; the
+        # lender's four own-cap pods all bound, the borrower holds its own
+        # cap and the four recreated ex-loan pods park behind the gate
+        assert len(store.pods) == 10
+        bound = _bound(store)
+        assert len(bound) == 6
+        lend_bound = [n for n in bound if n.startswith("l")]
+        assert len(lend_bound) == 4
+        assert sched.comparer_mismatches == 0
+        # loan ledger reconciled to post-reclaim truth: the pool is full
+        # of guaranteed usage, zero loans outstanding
+        assert plugin.usage("lend")["pods"] == 4
+        assert plugin.usage("hungry")["pods"] == 2
+        assert plugin.borrowed("hungry").get("pods", 0) == 0
+        assert not plugin._loans
+        caps, used = plugin.cohort_state("pool")
+        assert used["pods"] == caps["pods"] == 6
+        pending = sched.queue.pending_pods()
+        assert pending["gated"] == 4, pending
+
+        # byte-identical resync: the rebuilt mirror equals a fresh device
+        # synced from the same host snapshot, field for field
+        from kubernetes_tpu.backend.device_state import DeviceState
+
+        sched.cache.update_snapshot(sched.snapshot)
+        fresh = DeviceState(sched.device.caps,
+                            ns_labels_fn=sched.store.ns_labels)
+        fresh.sync(sched.snapshot)
+        for field, arr in sched.device._mirror.items():
+            assert np.array_equal(arr, fresh._mirror[field]), field
+        # including the namespace-quota tensor pair the screen reads: the
+        # rows are synced per DISPATCH (so the device may lag the final
+        # commits), but one sync from the live ledger converges both
+        # devices to identical content
+        assert sched.device.nsq_slots
+        table = plugin.device_quota_table()
+        fresh.set_ns_quota(table)
+        sched.device.set_ns_quota(table)
+        assert sched.device.set_ns_quota(table) is False  # now steady-state
+        for ns, slot in sched.device.nsq_slots.items():
+            fslot = fresh.nsq_slots[ns]
+            assert np.array_equal(sched.device._nsq_used_m[slot],
+                                  fresh._nsq_used_m[fslot]), ns
+            assert np.array_equal(sched.device._nsq_limit_m[slot],
+                                  fresh._nsq_limit_m[fslot]), ns

@@ -281,6 +281,50 @@ class TestDebugEndpoints:
         finally:
             app.server.stop()
 
+    def test_debug_quota_cohort_view(self):
+        """ISSUE 19 satellite: /debug/quota grows the per-cohort borrowing
+        pool view (guaranteed/lent/headroom, outstanding loans newest-first,
+        reclaim breaker state) and the loans list honours ?limit=."""
+        from kubernetes_tpu.api.types import (
+            Namespace, ObjectMeta, SchedulingQuota)
+
+        store = ClusterStore()
+        store.create_node(make_node("n0").capacity(
+            {"cpu": "32", "memory": "64Gi", "pods": 64}).obj())
+        for ns in ("team-a", "team-b"):
+            store.create_namespace(Namespace(meta=ObjectMeta(name=ns)))
+            store.create_object("SchedulingQuota", SchedulingQuota(
+                meta=ObjectMeta(name="q", namespace=ns),
+                hard={"pods": 3}, cohort="ml"))
+        app = SchedulerApp(store, raw_config=None)
+        port = app.server.start()
+        try:
+            # team-b runs past its own cap into team-a's idle headroom
+            for i in range(5):
+                store.create_pod(make_pod(
+                    f"b{i}", namespace="team-b").req({"cpu": "100m"}).obj())
+            app.tick()
+
+            status, body = _get(port, "/debug/quota")
+            doc = json.loads(body)
+            assert status == 200 and doc["enabled"] is True
+            assert "_cohorts" not in doc["namespaces"]
+            ml = doc["cohorts"]["ml"]
+            assert sorted(ml["members"]) == ["team-a", "team-b"]
+            assert ml["lent"]["pods"] == 2
+            assert len(ml["loans"]) == 2
+            assert ml["reclaim_breaker"]["state"] == "closed"
+            assert doc["namespaces"]["team-b"]["borrowed"]["pods"] == 2
+            assert doc["namespaces"]["team-b"]["cohort"] == "ml"
+
+            # loans honour the uniform entry cap, truncation visible
+            status, body = _get(port, "/debug/quota?limit=1")
+            doc = json.loads(body)
+            assert len(doc["cohorts"]["ml"]["loans"]) == 1
+            assert doc["cohorts"]["ml"]["loansTruncated"] == 2
+        finally:
+            app.server.stop()
+
     def test_debug_flightrecorder_endpoint(self):
         from kubernetes_tpu.backend import telemetry
 

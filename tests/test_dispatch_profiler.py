@@ -48,10 +48,12 @@ class TestDisabledContract:
 
         result = types.SimpleNamespace(packed=None,
                                        node_idx=np.arange(4, dtype=np.int32))
-        (node_idx, ff, slice_words, packed_ok), disp = materialize_profiled(
+        (node_idx, ff, slice_words, quota_words,
+         packed_ok), disp = materialize_profiled(
             result, 4, program="schedule_batch")
         assert disp is None
         assert ff is None and slice_words is None and not packed_ok
+        assert quota_words is None
         np.testing.assert_array_equal(node_idx, np.arange(4))
 
     def test_program_names_registry_is_declared(self):

@@ -276,7 +276,9 @@ def test_packed_result_block_parity(seed):
     res = schedule_batch(pb, et, nt, tc, tb, jax.random.PRNGKey(seed),
                          topo_enabled=False)
     assert res.packed is not None
-    node_idx, ff, slice_words = unpack_result_block(res.packed, nt.capacity)
+    node_idx, ff, slice_words, quota_words = unpack_result_block(
+        res.packed, nt.capacity)
     assert np.array_equal(node_idx, np.asarray(res.node_idx))
     assert np.array_equal(ff, np.asarray(res.first_fail))
     assert slice_words is None  # no slice gangs -> no verdict column
+    assert quota_words is None  # no screened namespaces -> no quota column

@@ -35,10 +35,10 @@ def ensure_ns(store, ns):
         store.create_namespace(Namespace(meta=ObjectMeta(name=ns)))
 
 
-def quota(store, ns, hard, weight=1, name="quota"):
+def quota(store, ns, hard, weight=1, name="quota", cohort=""):
     ensure_ns(store, ns)
     sq = SchedulingQuota(meta=ObjectMeta(name=name, namespace=ns),
-                         hard=dict(hard), weight=weight)
+                         hard=dict(hard), weight=weight, cohort=cohort)
     store.create_object("SchedulingQuota", sq)
     return sq
 
@@ -66,6 +66,16 @@ def sched_with_clock(store, **kw):
     s = Scheduler(store, now_fn=clock, pod_initial_backoff=0.1,
                   pod_max_backoff=0.5, **kw)
     return s, clock
+
+
+def churn(s, clock, rounds=60, step=0.2):
+    """Like settle, but keeps sweeping after the active queue drains —
+    the reclaim pass runs from housekeeping, which only ticks while the
+    scheduler loop turns."""
+    for _ in range(rounds):
+        s.schedule_one()
+        clock.advance(step)
+        s.queue.flush_backoff_completed()
 
 
 def settle(s, clock, rounds=60):
@@ -588,3 +598,302 @@ class TestPreFilterStatus:
         assert not st.is_success()
         assert st.code == 3  # UNSCHEDULABLE_AND_UNRESOLVABLE: no preemption
         assert any(ERR_REASON_QUOTA_EXCEEDED in r for r in st.reasons)
+
+
+# ---------------------------------------------------------------------------
+# cohort borrowing (ISSUE 19)
+
+
+def cohort_pair(store, lender_cap=4, borrower_cap=2, cohort="pool"):
+    quota(store, "lend", {QUOTA_PODS: lender_cap}, weight=2, cohort=cohort)
+    quota(store, "hungry", {QUOTA_PODS: borrower_cap}, cohort=cohort)
+
+
+class TestCohortBorrowing:
+    def test_borrow_grants_idle_headroom(self):
+        """A tenant over its own cap charges the cohort's idle guaranteed
+        headroom; the loans are recorded per pod, newest-seq-last."""
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store)  # pool = 4 + 2 = 6
+        s, clock = sched_with_clock(store)
+        for i in range(7):
+            pod(store, f"b{i}", ns="hungry")
+        settle(s, clock)
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 6
+        assert s.queue.pending_pods()["gated"] == 1
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.usage("hungry")[QUOTA_PODS] == 6
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 4
+        assert len(plugin._loans) == 4
+        assert plugin.cohort_headroom("pool").get(QUOTA_PODS, 0) == 0
+        assert s.smetrics.quota_borrowed.labels("hungry", QUOTA_PODS) == 4
+        assert s.smetrics.quota_decisions.labels("hungry", "borrowed") == 4
+
+    def test_no_borrowing_without_cohort(self):
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store, cohort="")
+        s, clock = sched_with_clock(store)
+        for i in range(7):
+            pod(store, f"b{i}", ns="hungry")
+        settle(s, clock)
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 2
+
+    def test_release_of_loan_decrements_borrowed(self):
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store)
+        s, clock = sched_with_clock(store)
+        for i in range(4):
+            pod(store, f"b{i}", ns="hungry")
+        settle(s, clock)
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 2
+        loan_key = next(iter(plugin._loans))
+        store.delete_pod(loan_key)
+        settle(s, clock)
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 1
+        assert plugin.usage("hungry")[QUOTA_PODS] == 3
+
+    def test_lender_wakeup_reclaims_newest_loans_first(self):
+        """The lender's own arrivals, blocked only by outstanding loans,
+        trigger reclaim-by-preemption of the NEWEST loans — and only as
+        many as the aggregate lender demand needs."""
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store)  # lend cap 4, hungry cap 2, pool 6
+        s, clock = sched_with_clock(store)
+        for i in range(6):
+            pod(store, f"b{i}", ns="hungry")
+            settle(s, clock, rounds=4)  # serialize: loan seq order == i
+        settle(s, clock)
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 4
+        oldest = sorted(plugin._loans.items(), key=lambda kv: kv[1][2])
+        oldest_keys = [k for k, _v in oldest[:2]]
+        # the lender wakes up with 2 pods: own-fit, pool exhausted
+        pod(store, "l0", ns="lend")
+        pod(store, "l1", ns="lend")
+        churn(s, clock, rounds=120)
+        assert plugin.reclaims_executed >= 1
+        lender_bound = [p for p in store.pods.values()
+                        if p.spec.node_name and p.meta.namespace == "lend"]
+        assert len(lender_bound) == 2
+        # exactly the aggregate demand was reclaimed, newest loans first:
+        # the two OLDEST loans survive
+        assert sorted(plugin._loans) == sorted(oldest_keys)
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 2
+        assert s.smetrics.quota_reclaims.labels("evicted") >= 1
+        # pool invariant held: used never exceeds guaranteed
+        caps, used = plugin.cohort_state("pool")
+        assert used[QUOTA_PODS] <= caps[QUOTA_PODS]
+
+    def test_borrowing_frozen_while_lender_demand_pending(self):
+        """Outstanding lender demand blocks NEW loans: freed capacity is
+        spoken for, and must not be re-stolen ahead of the lender."""
+        store = ClusterStore()
+        quota(store, "lend", {QUOTA_PODS: 2}, cohort="pool")
+        quota(store, "hungry", {QUOTA_PODS: 1}, cohort="pool")
+        plugin = QuotaAdmission(client=store)
+        ensure_ns(store, "hungry")
+        b0 = make_pod("b0", namespace="hungry").req({"cpu": "1"}).obj()
+        b0.spec.node_name = "n0"
+        store.create_pod(b0)
+        b1 = make_pod("b1", namespace="hungry").req({"cpu": "1"}).obj()
+        b1.spec.node_name = "n0"
+        store.create_pod(b1)
+        plugin.pod_observed_bound(b0)
+        plugin.pod_observed_bound(b1)  # 1 own + 1 loan, pool 3 used... 
+        # lender pod own-fits but one more would exceed the pool? no —
+        # pool = 3, used 2: the lender pod fits; fill the pool first
+        b2 = make_pod("b2", namespace="hungry").req({"cpu": "1"}).obj()
+        b2.spec.node_name = "n0"
+        store.create_pod(b2)
+        plugin.pod_observed_bound(b2)
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 2
+        lp = make_pod("l0", namespace="lend").req({"cpu": "1"}).obj()
+        store.create_pod(lp)
+        st = plugin.pre_enqueue_status(lp)
+        assert st is not None and "cohort exhausted" in str(st.reasons)
+        assert plugin._reclaim_demand.get("pool")
+        # a loan is released (borrower pod gone) — the freed slot must NOT
+        # be borrowable while the lender's demand is pending
+        loan_key = sorted(plugin._loans)[0]
+        store.delete_pod(loan_key)
+        plugin.pod_deleted(store.get_pod(loan_key) or b2
+                           if loan_key != b2.key() else b2)
+        nb = make_pod("b3", namespace="hungry").req({"cpu": "1"}).obj()
+        store.create_pod(nb)
+        st2 = plugin.pre_enqueue_status(nb)
+        assert st2 is not None  # borrow frozen
+        # the lender pod, by contrast, admits into the freed slot
+        assert plugin.pre_enqueue_status(lp) is None
+
+    def test_reclaim_cooldown_paces_same_demand(self):
+        """A pass that cannot free enough (no loans left to evict) does
+        not re-run at sweep cadence for the SAME demand — the cooldown
+        paces it; fresh demand bypasses the cooldown."""
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store)
+        s, clock = sched_with_clock(store)
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        evict_calls = []
+        real_evict = plugin.on_evict
+        plugin.on_evict = lambda pods, reason: (
+            evict_calls.append([p.key() for p in pods]),
+            real_evict(pods, reason))[1]
+        for i in range(6):
+            pod(store, f"b{i}", ns="hungry")
+        settle(s, clock)
+        pod(store, "l0", ns="lend")
+        churn(s, clock, rounds=40)
+        assert plugin.reclaims_executed == 1
+        n_first = len(evict_calls)
+        # the demand is satisfied; repeated sweeps with no new demand
+        # must not evict again
+        for _ in range(30):
+            plugin.run_reclaim(now=clock())
+            clock.advance(0.3)
+        assert len(evict_calls) == n_first
+
+    def test_reclaim_breaker_suspends_on_slo_regression(self):
+        """PR-17 pattern: a guard_fn that judges the wave a lender-SLO
+        regression feeds the breaker; at the threshold the breaker opens
+        and reclaim suspends (event + metric) instead of storming."""
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store, lender_cap=6, borrower_cap=2)
+        s, clock = sched_with_clock(store)
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        plugin.reclaim_guard_fn = lambda: False  # every wave "regresses"
+        plugin.reclaim_cooldown_s = 0.0
+        for i in range(8):
+            pod(store, f"b{i}", ns="hungry")
+        settle(s, clock)
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 6
+        # three lender wake-ups, one pod each: passes 1+2 execute and
+        # record failures; the third finds the breaker open
+        for i in range(3):
+            pod(store, f"l{i}", ns="lend")
+            churn(s, clock, rounds=40)
+        assert plugin.reclaim_breaker.state == "open"
+        assert plugin.reclaim_suspended is True
+        assert s.smetrics.quota_reclaims.labels("suspended") >= 1
+        assert plugin.reclaims_executed == 2
+
+    def test_gang_never_half_admitted_past_quota(self):
+        """Gang members price the remaining gang aggregate against quota
+        AND cohort headroom: a gang that cannot fully fit the pool is
+        admitted zero-members, never partially."""
+        from kubernetes_tpu.api.types import PodGroup
+
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store, lender_cap=2, borrower_cap=1)  # pool = 3
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name="g4", namespace="hungry"), min_member=4))
+        s, clock = sched_with_clock(store)
+        for i in range(4):
+            pod(store, f"g{i}", ns="hungry", group="g4")
+        settle(s, clock)
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 0
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.usage("hungry").get(QUOTA_PODS, 0) == 0
+        # a gang that fits the pool whole admits whole
+        store.create_object("PodGroup", PodGroup(
+            meta=ObjectMeta(name="g3", namespace="hungry"), min_member=3))
+        for i in range(3):
+            pod(store, f"h{i}", ns="hungry", group="g3")
+        settle(s, clock)
+        bound = [p for p in store.pods.values()
+                 if p.spec.node_name and p.meta.name.startswith("h")]
+        assert len(bound) == 3
+
+    def test_borrower_delete_wakes_gated_lender(self):
+        """_fire_release fans out to every cohort member: the lender's
+        gated pod lives in a DIFFERENT namespace than the freed loan."""
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store, lender_cap=2, borrower_cap=1)
+        s, clock = sched_with_clock(store)
+        for i in range(3):
+            pod(store, f"b{i}", ns="hungry")
+        settle(s, clock)
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 2
+        # stop the reclaim sweep: this test isolates the release fan-out
+        plugin.on_evict = None
+        pod(store, "l0", ns="lend")
+        settle(s, clock, rounds=10)
+        assert s.queue.pending_pods()["gated"] == 1
+        loan_key = max(plugin._loans.items(), key=lambda kv: kv[1][2])[0]
+        store.delete_pod(loan_key)
+        settle(s, clock)
+        lender_bound = [p for p in store.pods.values()
+                        if p.spec.node_name and p.meta.namespace == "lend"]
+        assert len(lender_bound) == 1
+
+    def test_dump_carries_cohort_view(self):
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store)
+        s, clock = sched_with_clock(store)
+        for i in range(4):
+            pod(store, f"b{i}", ns="hungry")
+        settle(s, clock)
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        out = plugin.dump()
+        assert out["hungry"]["borrowed"][QUOTA_PODS] == 2
+        assert out["hungry"]["cohort"] == "pool"
+        pool = out["_cohorts"]["pool"]
+        assert sorted(pool["members"]) == ["hungry", "lend"]
+        assert pool["guaranteed"][QUOTA_PODS] == 6
+        assert pool["lent"][QUOTA_PODS] == 2
+        assert pool["headroom"][QUOTA_PODS] == 2
+        assert len(pool["loans"]) == 2
+        # newest first
+        seqs = [plugin._loans[ln["pod"]][2] for ln in pool["loans"]]
+        assert seqs == sorted(seqs, reverse=True)
+        assert pool["reclaim_breaker"]["state"] == "closed"
+
+
+class TestBorrowRestartReseed:
+    def test_mid_borrow_restart_reconstructs_loan_split(self):
+        """ISSUE 19 satellite: a scheduler taking over mid-borrow reseeds
+        the ledger charge-order own-quota-first-then-cohort, so the
+        outstanding-loan split survives restart — without it borrowed
+        capacity double-counts as both used and lendable."""
+        store = ClusterStore()
+        nodes(store)
+        cohort_pair(store, lender_cap=3, borrower_cap=2)  # pool = 5
+        ensure_ns(store, "hungry")
+        for i in range(4):  # bound by the previous incarnation: 2 own + 2 loans
+            p = make_pod(f"pre{i}", namespace="hungry").req(
+                {"cpu": "1", "memory": "1Gi"}).obj()
+            p.spec.node_name = f"n{i % 4}"
+            store.create_pod(p)
+        s, clock = sched_with_clock(store)
+        plugin = next(iter(s.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.usage("hungry")[QUOTA_PODS] == 4
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 2
+        assert len(plugin._loans) == 2
+        # remaining pool headroom is exactly 1 — not 3: the loans are NOT
+        # double-counted as lendable
+        assert plugin.cohort_headroom("pool")[QUOTA_PODS] == 1
+        pod(store, "b-new", ns="hungry")
+        pod(store, "b-new2", ns="hungry")
+        settle(s, clock)
+        assert plugin.usage("hungry")[QUOTA_PODS] == 5
+        assert s.queue.pending_pods()["gated"] == 1
+        # and the lender's guarantee is still reclaimable after takeover:
+        # its own pods preempt the reseeded loans
+        for i in range(3):
+            pod(store, f"l{i}", ns="lend")
+        churn(s, clock, rounds=160)
+        lender_bound = [p for p in store.pods.values()
+                        if p.spec.node_name and p.meta.namespace == "lend"]
+        assert len(lender_bound) == 3
+        caps, used = plugin.cohort_state("pool")
+        assert used[QUOTA_PODS] <= caps[QUOTA_PODS]

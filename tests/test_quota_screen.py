@@ -1,0 +1,329 @@
+"""Device-side over-quota screen (ISSUE 19 tentpole): oracle<->tpu<->wire
+parity of the verdict column, the in-batch sequential-charge semantics, the
+namespace-quota tensor sync, and the relay guard — a screened batch still
+costs exactly one blocking read and zero extra dispatches (the screen is
+traced into the batch program; its words ride the packed result block)."""
+
+import random
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.types import QUOTA_DIM_ORDER, QUOTA_PODS
+from kubernetes_tpu.api.wrappers import make_node, make_pod
+from kubernetes_tpu.apiserver import ClusterStore
+from kubernetes_tpu.backend import TPUScheduler
+from kubernetes_tpu.backend.device_state import DeviceState, caps_for_cluster
+from kubernetes_tpu.ops.quota import (
+    QUOTA_DIMS,
+    QUOTA_NO_LIMIT,
+    QUOTA_OK_BIT,
+    QUOTA_SCREEN_BIT,
+    build_quota_batch_args,
+    quota_screen,
+    quota_screen_host,
+)
+from kubernetes_tpu.utils import relay
+
+
+# ---------------------------------------------------------------------------
+# kernel <-> host-oracle parity
+
+
+def _random_case(seed):
+    rng = random.Random(seed)
+    p = rng.choice([4, 8, 16])
+    ns_n = rng.randint(1, 4)
+    node_idx = np.array([rng.randint(-1, 7) for _ in range(p)], np.int32)
+    ns_idx = np.array([rng.randint(-1, ns_n - 1) for _ in range(p)], np.int32)
+    req = np.array([[rng.randint(0, 5) for _ in range(QUOTA_DIMS)]
+                    for _ in range(p)], np.int32)
+    used = np.array([[rng.randint(0, 6) for _ in range(QUOTA_DIMS)]
+                     for _ in range(ns_n)], np.int32)
+    limit = np.array([[rng.choice([rng.randint(0, 10), int(QUOTA_NO_LIMIT)])
+                       for _ in range(QUOTA_DIMS)]
+                      for _ in range(ns_n)], np.int32)
+    return node_idx, ns_idx, req, used, limit
+
+
+class TestKernelHostParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_randomized_parity(self, seed):
+        """The parity contract: the lax.scan kernel and its numpy twin
+        judge every randomized batch identically, bit for bit."""
+        import jax.numpy as jnp
+
+        node_idx, ns_idx, req, used, limit = _random_case(seed)
+        dev = np.asarray(quota_screen(
+            jnp.asarray(node_idx), jnp.asarray(ns_idx), jnp.asarray(req),
+            jnp.asarray(used), jnp.asarray(limit)))
+        host = quota_screen_host(node_idx, ns_idx, req, used, limit)
+        assert np.array_equal(dev, host), (seed, dev, host)
+
+    def test_sequential_same_namespace_charging(self):
+        """Two same-namespace winners in one batch see each other's
+        charges (the scan carries evolving usage): with headroom for one,
+        the FIRST in batch order passes and the second flags."""
+        import jax.numpy as jnp
+
+        node_idx = np.array([0, 1], np.int32)
+        ns_idx = np.array([0, 0], np.int32)
+        req = np.zeros((2, QUOTA_DIMS), np.int32)
+        pods_col = QUOTA_DIM_ORDER.index(QUOTA_PODS)
+        req[:, pods_col] = 1
+        used = np.zeros((1, QUOTA_DIMS), np.int32)
+        limit = np.full((1, QUOTA_DIMS), QUOTA_NO_LIMIT, np.int32)
+        limit[0, pods_col] = 1
+        words = np.asarray(quota_screen(
+            jnp.asarray(node_idx), jnp.asarray(ns_idx), jnp.asarray(req),
+            jnp.asarray(used), jnp.asarray(limit)))
+        assert int(words[0]) == QUOTA_SCREEN_BIT | QUOTA_OK_BIT
+        assert int(words[1]) == QUOTA_SCREEN_BIT
+        host = quota_screen_host(node_idx, ns_idx, req, used, limit)
+        assert np.array_equal(words, host)
+
+    def test_losers_read_ok_and_never_charge(self):
+        """An unplaced pod (node_idx < 0) reads as ok — there is nothing
+        to reject — and must not consume the namespace's headroom from a
+        later winner in the same batch."""
+        import jax.numpy as jnp
+
+        node_idx = np.array([-1, 3], np.int32)
+        ns_idx = np.array([0, 0], np.int32)
+        req = np.zeros((2, QUOTA_DIMS), np.int32)
+        pods_col = QUOTA_DIM_ORDER.index(QUOTA_PODS)
+        req[:, pods_col] = 1
+        used = np.zeros((1, QUOTA_DIMS), np.int32)
+        limit = np.full((1, QUOTA_DIMS), QUOTA_NO_LIMIT, np.int32)
+        limit[0, pods_col] = 1
+        words = np.asarray(quota_screen(
+            jnp.asarray(node_idx), jnp.asarray(ns_idx), jnp.asarray(req),
+            jnp.asarray(used), jnp.asarray(limit)))
+        # the loser is screened-and-ok; the winner takes the last slot
+        assert int(words[0]) == QUOTA_SCREEN_BIT | QUOTA_OK_BIT
+        assert int(words[1]) == QUOTA_SCREEN_BIT | QUOTA_OK_BIT
+        host = quota_screen_host(node_idx, ns_idx, req, used, limit)
+        assert np.array_equal(words, host)
+
+    def test_unscreened_namespace_word_zero(self):
+        import jax.numpy as jnp
+
+        node_idx = np.array([0], np.int32)
+        ns_idx = np.array([-1], np.int32)
+        req = np.ones((1, QUOTA_DIMS), np.int32)
+        used = np.zeros((1, QUOTA_DIMS), np.int32)
+        limit = np.zeros((1, QUOTA_DIMS), np.int32)
+        words = np.asarray(quota_screen(
+            jnp.asarray(node_idx), jnp.asarray(ns_idx), jnp.asarray(req),
+            jnp.asarray(used), jnp.asarray(limit)))
+        assert int(words[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# batch-arg builder + device tensor sync
+
+
+def _pods(n, ns="default"):
+    return [make_pod(f"p{i}", namespace=ns).req({"cpu": "1"}).obj()
+            for i in range(n)]
+
+
+def _row(pods_cap):
+    limit = [int(QUOTA_NO_LIMIT)] * QUOTA_DIMS
+    limit[QUOTA_DIM_ORDER.index(QUOTA_PODS)] = pods_cap
+    return [0] * QUOTA_DIMS, limit
+
+
+class TestBuildArgsAndSync:
+    def test_no_screened_namespace_is_none(self):
+        """The common case — no pod in a quota'd namespace — adds NO args:
+        the batch program is byte-identical to the pre-screen one."""
+        device = DeviceState(caps_for_cluster(4))
+        ns_idx, req = build_quota_batch_args(_pods(3), device, table={})
+        assert ns_idx is None and req is None
+
+    def test_padding_rows_are_exempt(self):
+        device = DeviceState(caps_for_cluster(4))
+        used, limit = _row(5)
+        ns_idx, req = build_quota_batch_args(
+            _pods(2, ns="team-a"), device,
+            table={"team-a": (used, limit)}, pad_to=8)
+        assert ns_idx is not None and len(ns_idx) == 8
+        assert (ns_idx[2:] == -1).all()
+        assert (ns_idx[:2] >= 0).all()
+        assert req.shape == (8, QUOTA_DIMS)
+
+    def test_table_sync_is_content_diffed(self):
+        """A steady-state table uploads nothing (the screen must not add
+        per-batch transfer traffic); only content changes re-upload."""
+        device = DeviceState(caps_for_cluster(4))
+        table = {"team-a": _row(5)}
+        assert device.set_ns_quota(table) is True
+        n = device.nsq_uploads
+        assert device.set_ns_quota({"team-a": _row(5)}) is False
+        assert device.nsq_uploads == n
+        assert device.set_ns_quota({"team-a": _row(6)}) is True
+        assert device.nsq_uploads == n + 1
+
+    def test_deleted_namespace_resets_to_never_flags(self):
+        """The table is the COMPLETE desired state: a registered namespace
+        absent from it (quota deleted) resets to never-flags rows — a
+        stale row would reject-and-requeue what the host gate re-admits,
+        forever."""
+        device = DeviceState(caps_for_cluster(4))
+        used = [3] * QUOTA_DIMS
+        _z, limit = _row(1)
+        device.set_ns_quota({"team-a": (used, limit)})
+        slot = device.nsq_slots["team-a"]
+        device.set_ns_quota({})  # quota deleted
+        assert not device._nsq_used_m[slot].any()
+        assert (device._nsq_limit_m[slot] == int(QUOTA_NO_LIMIT)).all()
+        # the slot survives (slot indices are sticky for in-flight batches)
+        assert device.nsq_slots["team-a"] == slot
+
+
+# ---------------------------------------------------------------------------
+# the batched path end-to-end: screen fires in-jit, one read, no extras
+
+
+def _spy_materialize(monkeypatch):
+    """Record each batch's materialized quota column without adding reads:
+    wraps commit_plane.materialize_profiled (imported at call time)."""
+    from kubernetes_tpu.backend import commit_plane
+
+    seen = []
+    real = commit_plane.materialize_profiled
+
+    def spy(*a, **kw):
+        out, disp = real(*a, **kw)
+        seen.append(out[3])  # quota_words column (or None)
+        return out, disp
+
+    monkeypatch.setattr(commit_plane, "materialize_profiled", spy)
+    return seen
+
+
+class TestBatchedScreenEndToEnd:
+    def test_in_batch_over_admission_is_screened(self, monkeypatch):
+        """Six same-namespace pods in ONE batch against a pods=2 cap: the
+        host gate passes all six (the ledger charges at commit), so the
+        in-jit screen is the thing that stops the four over-quota winners
+        — its verdict column must carry exactly four screened-not-ok
+        words, and the commit must bind exactly two pods."""
+        words_per_batch = _spy_materialize(monkeypatch)
+        store = ClusterStore()
+        from tests.test_quota import nodes, pod, quota
+
+        nodes(store)
+        quota(store, "team-a", {QUOTA_PODS: 2})
+        sched = TPUScheduler(store, batch_size=8)
+        with relay.track() as counts:
+            for i in range(6):
+                pod(store, f"p{i}", ns="team-a")
+            sched.run_batched_until_settled()
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 2
+        plugin = next(iter(sched.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.usage("team-a")[QUOTA_PODS] == 2
+        # the first batch carried the screen column and flagged the four
+        # over-quota winners IN-JIT (not at host revalidation)
+        first = words_per_batch[0]
+        assert first is not None
+        flagged = sum(1 for w in np.asarray(first)[:6]
+                      if (int(w) & QUOTA_SCREEN_BIT)
+                      and not (int(w) & QUOTA_OK_BIT))
+        assert flagged == 4, np.asarray(first)[:6]
+        # THE relay guard: screened batches still cost exactly one
+        # blocking read each, and nothing else
+        assert counts["commit-read"] == sched.batch_counter
+        assert sum(counts.values()) == counts["commit-read"], dict(counts)
+
+    def test_unquotad_namespaces_skip_the_screen(self, monkeypatch):
+        """No quota anywhere: every batch dispatches without the quota
+        column — the screen costs nothing when unused."""
+        words_per_batch = _spy_materialize(monkeypatch)
+        store = ClusterStore()
+        from tests.test_quota import nodes
+
+        nodes(store)
+        sched = TPUScheduler(store, batch_size=8)
+        with relay.track() as counts:
+            for i in range(6):
+                store.create_pod(
+                    make_pod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+            sched.run_batched_until_settled()
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 6
+        assert all(w is None for w in words_per_batch)
+        assert counts["commit-read"] == sched.batch_counter
+        assert sum(counts.values()) == counts["commit-read"], dict(counts)
+
+    def test_screen_covers_borrowed_headroom(self, monkeypatch):
+        """The synced limit rows are the ledger's EFFECTIVE caps (own hard
+        + borrowable cohort headroom): a borrower's in-batch winners pass
+        the screen up to the pool, not its own cap."""
+        words_per_batch = _spy_materialize(monkeypatch)
+        store = ClusterStore()
+        from tests.test_quota import nodes, pod, quota
+
+        nodes(store)
+        quota(store, "lend", {QUOTA_PODS: 3}, cohort="pool")
+        quota(store, "hungry", {QUOTA_PODS: 2}, cohort="pool")
+        sched = TPUScheduler(store, batch_size=8)
+        for i in range(7):  # pool = 5: five admit (3 borrowed), two flag
+            pod(store, f"b{i}", ns="hungry")
+        sched.run_batched_until_settled()
+        assert sum(1 for p in store.pods.values() if p.spec.node_name) == 5
+        plugin = next(iter(sched.profiles.values())).plugin("QuotaAdmission")
+        assert plugin.borrowed("hungry")[QUOTA_PODS] == 3
+        assert any(w is not None for w in words_per_batch)
+
+
+# ---------------------------------------------------------------------------
+# wire parity: the verdict word rides the result rows; the server screens
+# with the same shared builder, so both transports place identically
+
+
+class TestWireScreenParity:
+    def test_wire_matches_in_process_with_quota(self):
+        import os
+
+        from kubernetes_tpu.backend.service import (
+            DeviceService, WireScheduler, serve)
+        from tests.test_quota import nodes, pod, quota
+
+        def build(store):
+            nodes(store)
+            quota(store, "team-a", {QUOTA_PODS: 3})
+            for i in range(8):
+                pod(store, f"p{i}", ns="team-a")
+
+        service = DeviceService(batch_size=32)
+        server, port = serve(service)
+        try:
+            store_w = ClusterStore()
+            sched_w = WireScheduler(
+                store_w, endpoint=f"http://127.0.0.1:{port}", batch_size=8)
+            build(store_w)
+            sched_w.run_until_settled()
+
+            os.environ["KTPU_PIPELINE"] = "0"
+            try:
+                store_l = ClusterStore()
+                sched_l = TPUScheduler(store_l, batch_size=8)
+                build(store_l)
+                sched_l.run_batched_until_settled()
+            finally:
+                os.environ.pop("KTPU_PIPELINE", None)
+
+            def bound(store):
+                return {p.meta.name: p.spec.node_name
+                        for p in store.pods.values() if p.spec.node_name}
+
+            assert len(bound(store_w)) == len(bound(store_l)) == 3
+            assert bound(store_w) == bound(store_l)
+            # zero oversubscription on both transports, judged by the ledger
+            for sched in (sched_w, sched_l):
+                plugin = next(iter(sched.profiles.values())).plugin(
+                    "QuotaAdmission")
+                assert plugin.usage("team-a")[QUOTA_PODS] == 3
+        finally:
+            server.shutdown()

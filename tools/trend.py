@@ -203,6 +203,16 @@ FENCE_TOLERANCES = {
     # (pre-replay baselines, or a budget-skipped matrix).
     "workload_replay_packing_eff": 40.0,
     "workload_replay_tenant_p99_s": 200.0,
+    # SchedulingBorrow row (first recorded r19+): util_lift is the A/B
+    # pool-utilization delta (borrowing ON − OFF, in [0, 1]) — the
+    # headline "borrowing un-strands lender headroom" number, judged
+    # higher-is-better; lender_p99_on_s reads from the same ~2x e2e
+    # histogram buckets as the other e2e rows (one bucket step ~100%),
+    # and reclaim latency rides the housekeeping sweep cadence, so the
+    # fence is loose. check() skips when either round lacks the block
+    # (pre-borrowing baselines, or a budget-skipped matrix).
+    "workload_borrow_util_lift": 50.0,
+    "workload_borrow_lender_p99_s": 200.0,
 }
 # per-workload overrides for rows whose history is structurally volatile
 # (PreemptionBasic swung 2953 -> 69 -> 243 pods/s across r02-r05 as the
@@ -364,6 +374,20 @@ def fence(current: dict, rounds: Optional[List[dict]] = None) -> dict:
               (b.get("replay") or {}).get("tenant_p99_s"),
               over.get("workload_replay_tenant_p99_s",
                        tol["workload_replay_tenant_p99_s"]), False)
+        # cohort-borrowing rows only (same skip-when-absent): the A/B
+        # utilization lift must not decay, and funding the lender's
+        # wake-up by reclaim must never cost the lender its e2e p99 —
+        # the ISSUE 19 acceptance pair
+        check(f"workload {name} borrow util lift",
+              (c.get("borrowing") or {}).get("util_lift"),
+              (b.get("borrowing") or {}).get("util_lift"),
+              over.get("workload_borrow_util_lift",
+                       tol["workload_borrow_util_lift"]), True)
+        check(f"workload {name} borrow lender p99",
+              (c.get("borrowing") or {}).get("lender_p99_on_s"),
+              (b.get("borrowing") or {}).get("lender_p99_on_s"),
+              over.get("workload_borrow_lender_p99_s",
+                       tol["workload_borrow_lender_p99_s"]), False)
     return {"baselineRound": base.get("_round"), "checked": checked,
             "violations": violations, "tolerances": FENCE_TOLERANCES}
 
